@@ -1,0 +1,23 @@
+"""nomad-tpu: a TPU-native workload-orchestration framework.
+
+A brand-new framework with the capabilities of HashiCorp Nomad (reference:
+/root/reference, v1.3.x, Go), redesigned TPU-first: the per-evaluation
+scheduling hot path (feasibility -> bin-pack -> spread -> score-normalization,
+reference scheduler/stack.go:43-69) is a batched node-tensor kernel in JAX --
+constraint checks are boolean masks, scoring is a vmap'd kernel, global node
+selection is top-k/argmax, and the node axis shards across a TPU slice via
+``jax.sharding`` + ``shard_map`` with ``psum``-style collectives.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  structs/    core data model (reference nomad/structs/)
+  tensors/    NodeTensor/AskTensor flattening contract (TPU-native, new)
+  ops/        JAX scheduling kernels (replaces scheduler/ iterator hot loop)
+  scheduler/  scheduler interface, reconciler, stacks (reference scheduler/)
+  state/      versioned in-memory state store (reference nomad/state/)
+  server/     eval broker, plan applier, workers, leader (reference nomad/)
+  client/     node agent, fingerprinting, task runners (reference client/)
+  api/        HTTP API + SDK (reference command/agent/, api/)
+  parallel/   mesh/sharding utilities (TPU-native, new)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,74 @@
+"""Core data model (reference: nomad/structs/).
+
+Python dataclasses for the orchestration currency -- Job/TaskGroup/Task,
+Node, Allocation, Evaluation, Plan -- plus the resource math that the
+scheduler kernel reproduces on device (reference nomad/structs/funcs.go).
+"""
+
+from nomad_tpu.structs.consts import *  # noqa: F401,F403
+from nomad_tpu.structs.resources import (  # noqa: F401
+    AllocatedCpuResources,
+    AllocatedDeviceResource,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    ComparableResources,
+    NodeCpuResources,
+    NodeDeviceResource,
+    NodeDiskResources,
+    NodeMemoryResources,
+    NodeReservedResources,
+    NodeResources,
+    RequestedDevice,
+    Resources,
+    allocs_fit,
+    compute_free_percentage,
+    score_fit_binpack,
+    score_fit_spread,
+)
+from nomad_tpu.structs.network import (  # noqa: F401
+    NetworkIndex,
+    NetworkResource,
+    Port,
+    PortBitmap,
+)
+from nomad_tpu.structs.constraints import (  # noqa: F401
+    Affinity,
+    Constraint,
+    Spread,
+    SpreadTarget,
+    check_constraint,
+    resolve_target,
+)
+from nomad_tpu.structs.job import (  # noqa: F401
+    EphemeralDisk,
+    Job,
+    MigrateStrategy,
+    PeriodicConfig,
+    ReschedulePolicy,
+    RestartPolicy,
+    ScalingPolicy,
+    Task,
+    TaskGroup,
+    TaskLifecycleConfig,
+    UpdateStrategy,
+)
+from nomad_tpu.structs.node import DriverInfo, Node  # noqa: F401
+from nomad_tpu.structs.alloc import (  # noqa: F401
+    AllocMetric,
+    Allocation,
+    DesiredTransition,
+    RescheduleEvent,
+    RescheduleTracker,
+    TaskEvent,
+    TaskState,
+)
+from nomad_tpu.structs.eval_plan import (  # noqa: F401
+    Deployment,
+    DeploymentState,
+    Evaluation,
+    Plan,
+    PlanAnnotations,
+    PlanResult,
+)

@@ -1,0 +1,300 @@
+"""Core data-model tests (reference: nomad/structs/*_test.go semantics)."""
+
+import math
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.constraints import (
+    check_constraint,
+    check_version_constraint,
+    node_meets_constraints,
+)
+
+
+class TestScoreFit:
+    """Reference: structs/funcs_test.go TestScoreFitBinPack/Spread."""
+
+    def _node(self, cpu=4096, mem=8192):
+        n = mock.node()
+        n.node_resources.cpu.cpu_shares = cpu
+        n.node_resources.memory.memory_mb = mem
+        n.reserved_resources = structs.NodeReservedResources()
+        return n
+
+    def test_binpack_half_util(self):
+        node = self._node()
+        util = structs.ComparableResources(cpu_shares=2048, memory_mb=4096)
+        # freePct = 0.5 each: 20 - 2*10^0.5 ~= 13.675
+        score = structs.score_fit_binpack(node, util)
+        assert score == pytest.approx(20.0 - 2 * math.pow(10, 0.5), abs=1e-9)
+
+    def test_binpack_full_util(self):
+        node = self._node()
+        util = structs.ComparableResources(cpu_shares=4096, memory_mb=8192)
+        assert structs.score_fit_binpack(node, util) == pytest.approx(18.0)
+
+    def test_binpack_zero_util(self):
+        node = self._node()
+        util = structs.ComparableResources()
+        assert structs.score_fit_binpack(node, util) == pytest.approx(0.0)
+
+    def test_spread_is_inverse(self):
+        node = self._node()
+        util = structs.ComparableResources(cpu_shares=2048, memory_mb=4096)
+        b = structs.score_fit_binpack(node, util)
+        s = structs.score_fit_spread(node, util)
+        assert s == pytest.approx(2 * math.pow(10, 0.5) - 2, abs=1e-9)
+        assert b != s
+
+    def test_reserved_resources_shrink_capacity(self):
+        node = self._node()
+        node.reserved_resources = structs.NodeReservedResources(
+            cpu_shares=2048, memory_mb=4096
+        )
+        util = structs.ComparableResources(cpu_shares=2048, memory_mb=4096)
+        # all remaining capacity used -> perfect fit
+        assert structs.score_fit_binpack(node, util) == pytest.approx(18.0)
+
+
+class TestAllocsFit:
+    """Reference: structs/funcs_test.go TestAllocsFit*."""
+
+    def test_fits(self):
+        node = mock.node()
+        a = mock.alloc()
+        fit, dim, used = structs.allocs_fit(node, [a], None, False)
+        assert fit, dim
+        assert used.cpu_shares == 500
+        assert used.memory_mb == 256
+
+    def test_exceeds_memory(self):
+        node = mock.node()
+        big = mock.alloc()
+        big.allocated_resources.tasks["web"].memory.memory_mb = 9000
+        fit, dim, _ = structs.allocs_fit(node, [big], None, False)
+        assert not fit
+        assert dim == "memory"
+
+    def test_terminal_allocs_ignored(self):
+        node = mock.node()
+        stopped = mock.alloc()
+        stopped.desired_status = consts.ALLOC_DESIRED_STOP
+        allocs = [mock.alloc() for _ in range(4)] + [stopped]
+        fit, dim, used = structs.allocs_fit(node, allocs, None, False)
+        assert fit, dim
+        assert used.cpu_shares == 2000
+
+    def test_core_overlap(self):
+        node = mock.node()
+        a1, a2 = mock.alloc(), mock.alloc()
+        a1.allocated_resources.tasks["web"].cpu.reserved_cores = [0]
+        a2.allocated_resources.tasks["web"].cpu.reserved_cores = [0]
+        fit, dim, _ = structs.allocs_fit(node, [a1, a2], None, False)
+        assert not fit
+        assert dim == "cores"
+
+    def test_port_collision(self):
+        node = mock.node()
+        a1, a2 = mock.alloc(), mock.alloc()
+        for a in (a1, a2):
+            a.allocated_resources.tasks["web"].networks = [
+                structs.NetworkResource(
+                    device="eth0", ip="192.168.0.100",
+                    reserved_ports=[structs.Port(label="main", value=8000)],
+                )
+            ]
+        fit, dim, _ = structs.allocs_fit(node, [a1, a2], None, False)
+        assert not fit
+        assert "collision" in dim
+
+    def test_device_oversubscription(self):
+        node = mock.node()
+        node.node_resources.devices = [
+            structs.NodeDeviceResource(
+                vendor="nvidia", type="gpu", name="1080ti",
+                instance_ids=["d1"],
+            )
+        ]
+        a1, a2 = mock.alloc(), mock.alloc()
+        for a in (a1, a2):
+            a.allocated_resources.tasks["web"].devices = [
+                structs.AllocatedDeviceResource(
+                    vendor="nvidia", type="gpu", name="1080ti", device_ids=["d1"]
+                )
+            ]
+        fit, dim, _ = structs.allocs_fit(node, [a1, a2], None, True)
+        assert not fit
+        assert dim == "device oversubscribed"
+
+
+class TestNetworkIndex:
+    """Reference: structs/network_test.go semantics."""
+
+    def test_set_node_reserved_port(self):
+        idx = structs.NetworkIndex()
+        node = mock.node()
+        collide, _ = idx.set_node(node)
+        assert not collide
+        # port 22 is agent-reserved
+        used = idx.port_words()
+        assert used[22 // 64] & (1 << (22 % 64))
+
+    def test_assign_network_dynamic(self):
+        idx = structs.NetworkIndex()
+        idx.set_node(mock.node())
+        ask = structs.NetworkResource(
+            mbits=50, dynamic_ports=[structs.Port(label="http")]
+        )
+        offer, err = idx.assign_network(ask)
+        assert offer is not None, err
+        port = offer.dynamic_ports[0].value
+        assert 20000 <= port <= 32000
+
+    def test_assign_network_reserved_collision(self):
+        idx = structs.NetworkIndex()
+        idx.set_node(mock.node())
+        ask = structs.NetworkResource(
+            mbits=10, reserved_ports=[structs.Port(label="ssh", value=22)]
+        )
+        offer, err = idx.assign_network(ask)
+        assert offer is None
+        assert "collision" in err
+
+    def test_bandwidth_overcommit(self):
+        idx = structs.NetworkIndex()
+        idx.set_node(mock.node())
+        ask = structs.NetworkResource(mbits=800)
+        offer, err = idx.assign_network(ask)
+        assert offer is not None
+        idx.add_reserved(offer)
+        offer2, err2 = idx.assign_network(structs.NetworkResource(mbits=300))
+        assert offer2 is None
+        assert "bandwidth" in err2
+
+    def test_assign_ports_group(self):
+        idx = structs.NetworkIndex()
+        idx.set_node(mock.node())
+        ask = structs.NetworkResource(
+            reserved_ports=[structs.Port(label="db", value=5432)],
+            dynamic_ports=[structs.Port(label="http", to=-1)],
+        )
+        offer, err = idx.assign_ports(ask)
+        assert offer is not None, err
+        labels = {p.label: p for p in offer}
+        assert labels["db"].value == 5432
+        assert labels["http"].to == labels["http"].value
+
+
+class TestConstraints:
+    def test_operands(self):
+        assert check_constraint("=", "linux", "linux", True, True)
+        assert not check_constraint("=", "linux", "windows", True, True)
+        assert check_constraint("!=", "linux", "windows", True, True)
+        assert check_constraint("!=", None, "windows", False, True)
+        assert not check_constraint("!=", None, None, False, False)
+        assert check_constraint("regexp", "ubuntu-20.04", r"ubuntu-\d+", True, True)
+        assert not check_constraint("regexp", "centos", r"ubuntu-\d+", True, True)
+        assert check_constraint("set_contains", "a,b,c", "a,c", True, True)
+        assert not check_constraint("set_contains", "a,b", "a,z", True, True)
+        assert check_constraint("set_contains_any", "a,b", "z,b", True, True)
+        assert check_constraint("is_set", "anything", None, True, False)
+        assert check_constraint("is_not_set", None, None, False, False)
+        assert check_constraint(">", "b", "a", True, True)
+        assert check_constraint("<=", "a", "a", True, True)
+
+    def test_version_constraints(self):
+        assert check_version_constraint("1.2.3", ">= 1.0, < 2.0")
+        assert not check_version_constraint("2.1.0", ">= 1.0, < 2.0")
+        assert check_version_constraint("1.2.3", "~> 1.2")
+        assert not check_version_constraint("2.0.0", "~> 1.2")
+        assert check_version_constraint("1.2.4", "~> 1.2.3")
+        assert not check_version_constraint("1.3.0", "~> 1.2.3")
+        assert check_version_constraint("1.7.0-beta1", ">= 1.6.0")
+        # semver: prerelease does not satisfy plain range
+        assert not check_version_constraint("1.7.0-beta1", ">= 1.6.0", semver=True)
+
+    def test_node_meets_constraints(self):
+        node = mock.node()
+        ok = node_meets_constraints(
+            node,
+            [structs.Constraint(ltarget="${attr.kernel.name}", rtarget="linux")],
+        )
+        assert ok
+        bad = node_meets_constraints(
+            node,
+            [structs.Constraint(ltarget="${attr.kernel.name}", rtarget="darwin")],
+        )
+        assert not bad
+
+
+class TestAllocStatuses:
+    def test_terminal(self):
+        a = mock.alloc()
+        assert not a.terminal_status()
+        a.desired_status = consts.ALLOC_DESIRED_STOP
+        assert a.terminal_status()
+        b = mock.alloc()
+        b.client_status = consts.ALLOC_CLIENT_FAILED
+        assert b.terminal_status()
+
+    def test_index_parse(self):
+        a = mock.alloc()
+        a.name = "my-job.web[13]"
+        assert a.index() == 13
+
+    def test_next_delay(self):
+        a = mock.alloc()
+        pol = structs.ReschedulePolicy(
+            attempts=3, interval_s=600, delay_s=5, delay_function="exponential",
+            max_delay_s=100,
+        )
+        assert a._next_delay(pol, 0) == 5
+        assert a._next_delay(pol, 2) == 20
+        assert a._next_delay(pol, 10) == 100  # capped
+        fib = structs.ReschedulePolicy(
+            delay_s=5, delay_function="fibonacci", max_delay_s=1000
+        )
+        assert a._next_delay(fib, 0) == 5
+        assert a._next_delay(fib, 1) == 5
+        assert a._next_delay(fib, 2) == 10
+        assert a._next_delay(fib, 3) == 15
+        assert a._next_delay(fib, 4) == 25
+
+
+class TestNodeClass:
+    def test_same_attrs_same_class(self):
+        n1, n2 = mock.node(), mock.node()
+        assert n1.computed_class == n2.computed_class
+
+    def test_different_class(self):
+        n1 = mock.node()
+        n2 = mock.node()
+        n2.attributes["kernel.name"] = "windows"
+        n2.compute_class()
+        assert n1.computed_class != n2.computed_class
+
+    def test_unique_attrs_excluded(self):
+        n1 = mock.node()
+        n2 = mock.node()
+        n2.attributes["unique.hostname"] = "different"
+        n2.compute_class()
+        assert n1.computed_class == n2.computed_class
+
+
+class TestPlan:
+    def test_append_stopped(self):
+        plan = structs.Plan()
+        a = mock.alloc()
+        plan.append_stopped_alloc(a, "no longer needed")
+        assert plan.node_update[a.node_id][0].desired_status == "stop"
+        # original untouched
+        assert a.desired_status == "run"
+
+    def test_make_plan(self):
+        e = mock.eval()
+        j = mock.job()
+        p = e.make_plan(j)
+        assert p.eval_id == e.id
+        assert p.job is j
