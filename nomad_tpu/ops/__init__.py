@@ -1,0 +1,20 @@
+"""JAX scheduling kernels: the TPU-native hot path.
+
+Replaces the reference's per-node iterator chain
+(scheduler/stack.go GenericStack.Select -> feasible.go -> rank.go ->
+spread.go -> select.go) with one batched kernel over the node axis:
+feasibility is boolean mask algebra, scoring is elementwise math over
+score planes, selection is a global argmax, and sequential resource
+deduction between placements of the same task group is a ``lax.scan``
+(place -> update planes -> repeat).
+"""
+
+from nomad_tpu.ops.kernel import (  # noqa: F401
+    KernelIn,
+    KernelOut,
+    TOPK,
+    build_kernel_in,
+    pad_steps,
+    place_taskgroup,
+    place_taskgroup_jit,
+)

@@ -1,0 +1,413 @@
+"""The batched placement kernel.
+
+Semantics parity map (Go reference -> tensor formulation):
+
+- FeasibilityWrapper + checkers (feasible.go:1050, :135-1193): host-side
+  per-class evaluation folded into ``base_mask``; numeric resource checks
+  (cpu/mem/disk/ports/devices/bandwidth/cores) run on device as mask algebra.
+- BinPackIterator.Next (rank.go:193-557): utilization = proposed + ask;
+  score = ScoreFitBinPack (funcs.go:259) or ScoreFitSpread (funcs.go:286)
+  under the cluster scheduler algorithm, normalized by 18 (rank.go:547).
+- JobAntiAffinityIterator (rank.go:560): penalty -(collisions+1)/count,
+  plane appended only where collisions > 0.
+- NodeReschedulingPenaltyIterator (rank.go:630): -1 plane on penalty nodes.
+- NodeAffinityIterator (rank.go:674): weighted-sum plane appended where
+  nonzero (host precomputes the per-node normalized score).
+- SpreadIterator (spread.go:116-245): desired-count boost and
+  evenSpreadScoreBoost reproduced on device from bucket counts.
+- ScoreNormalizationIterator (rank.go:764): mean over *appended* planes --
+  reproduced exactly via per-plane appended masks.
+- LimitIterator/MaxScoreIterator (select.go): replaced by global argmax
+  over ALL feasible nodes (strictly better placement quality than the
+  log2-limited iteration; SURVEY.md section 7.2).
+- Sequential resource deduction between placements of one task group
+  (generic_sched.go computePlacements loop): ``lax.scan`` steps that
+  deduct the chosen node's planes before the next argmax.
+
+Everything is static-shaped; node axis padded (ClusterTensors.n_pad),
+placement axis padded to step buckets (``pad_steps``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nomad_tpu.tensors.schema import (
+    MAX_DEV_REQS,
+    MAX_SPREADS,
+    SPREAD_BUCKETS,
+    ClusterTensors,
+    EvalTensors,
+)
+
+NEG_INF = -1.0e30
+TOPK = 8          # top-K score metadata returned per placement (AllocMetric)
+_STEP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def pad_steps(k: int) -> int:
+    for b in _STEP_BUCKETS:
+        if k <= b:
+            return b
+    return ((k + 4095) // 4096) * 4096
+
+
+class KernelIn(NamedTuple):
+    """Device-side planes for one (eval, task group). All arrays."""
+
+    # cluster planes (f32/i32/bool over padded node axis)
+    cap_cpu: jnp.ndarray
+    cap_mem: jnp.ndarray
+    cap_disk: jnp.ndarray
+    free_cores: jnp.ndarray
+    shares_per_core: jnp.ndarray
+    free_dyn: jnp.ndarray
+    # eval planes
+    base_mask: jnp.ndarray
+    used_cpu: jnp.ndarray
+    used_mem: jnp.ndarray
+    used_disk: jnp.ndarray
+    used_cores: jnp.ndarray
+    used_mbits: jnp.ndarray
+    avail_mbits: jnp.ndarray
+    port_conflict: jnp.ndarray       # bool[N]: ask reserved port already used
+    dev_free: jnp.ndarray            # f32[N, MAX_DEV_REQS]
+    dev_aff_score: jnp.ndarray       # f32[N]
+    has_dev_affinity: jnp.ndarray    # bool scalar
+    job_tg_count: jnp.ndarray        # i32[N]
+    penalty: jnp.ndarray             # bool[N]
+    aff_score: jnp.ndarray           # f32[N]
+    # spreads, stacked [S, ...]
+    spread_active: jnp.ndarray       # bool[S]
+    spread_even: jnp.ndarray         # bool[S]
+    spread_weight: jnp.ndarray       # f32[S]
+    spread_bucket: jnp.ndarray       # i32[S, N]
+    spread_counts: jnp.ndarray       # f32[S, B]
+    spread_desired: jnp.ndarray      # f32[S, B]
+    # ask scalars
+    ask_cpu: jnp.ndarray
+    ask_mem: jnp.ndarray
+    ask_disk: jnp.ndarray
+    ask_cores: jnp.ndarray
+    ask_dyn_ports: jnp.ndarray
+    ask_has_reserved_ports: jnp.ndarray  # bool scalar
+    ask_dev: jnp.ndarray             # f32[MAX_DEV_REQS]
+    ask_mbits: jnp.ndarray
+    desired_count: jnp.ndarray       # i32 scalar (anti-affinity denominator)
+    algorithm_spread: jnp.ndarray    # bool scalar: ScoreFitSpread mode
+    n_steps: jnp.ndarray             # i32 scalar: real placements wanted
+
+
+class KernelOut(NamedTuple):
+    chosen: jnp.ndarray          # i32[K]: node row per placement (-1 none)
+    scores: jnp.ndarray          # f32[K]: final normalized score
+    found: jnp.ndarray           # bool[K]
+    topk_idx: jnp.ndarray        # i32[K, TOPK]
+    topk_scores: jnp.ndarray     # f32[K, TOPK]
+    # metrics from the first step's masks (AllocMetric inputs)
+    nodes_evaluated: jnp.ndarray     # i32: base-eligible nodes
+    nodes_feasible: jnp.ndarray      # i32: passed all resource checks
+    exhausted_cpu: jnp.ndarray
+    exhausted_mem: jnp.ndarray
+    exhausted_disk: jnp.ndarray
+    exhausted_ports: jnp.ndarray
+    exhausted_devices: jnp.ndarray
+    exhausted_cores: jnp.ndarray
+
+
+def _feasible(kin: KernelIn, st) -> tuple:
+    """Resource-fit mask planes for the current carry state."""
+    free_cpu = kin.cap_cpu - st["used_cpu"]
+    free_mem = kin.cap_mem - st["used_mem"]
+    free_disk = kin.cap_disk - st["used_disk"]
+    ask_cpu_total = kin.ask_cpu + kin.ask_cores.astype(jnp.float32) * kin.shares_per_core
+    fit_cpu = free_cpu >= ask_cpu_total
+    fit_mem = free_mem >= kin.ask_mem
+    fit_disk = free_disk >= kin.ask_disk
+    fit_cores = (kin.free_cores - st["used_cores"]) >= kin.ask_cores
+    fit_dyn = st["free_dyn"] >= kin.ask_dyn_ports
+    fit_ports = jnp.logical_and(~st["port_conflict"], fit_dyn)
+    fit_dev = jnp.all(st["dev_free"] >= kin.ask_dev[None, :], axis=1)
+    fit_bw = (st["used_mbits"] + kin.ask_mbits) <= kin.avail_mbits
+    feasible = (
+        kin.base_mask
+        & fit_cpu & fit_mem & fit_disk & fit_cores
+        & fit_ports & fit_dev & fit_bw
+    )
+    return feasible, ask_cpu_total, dict(
+        fit_cpu=fit_cpu, fit_mem=fit_mem, fit_disk=fit_disk,
+        fit_cores=fit_cores, fit_ports=fit_ports, fit_dev=fit_dev,
+    )
+
+
+def _score(kin: KernelIn, st, ask_cpu_total) -> tuple:
+    """Score planes + appended-mask normalization (rank.go semantics)."""
+    util_cpu = st["used_cpu"] + ask_cpu_total
+    util_mem = st["used_mem"] + kin.ask_mem
+
+    # computeFreePercentage (funcs.go:235) with zero-capacity guard
+    fc = jnp.where(kin.cap_cpu > 0, 1.0 - util_cpu / kin.cap_cpu, 0.0)
+    fm = jnp.where(kin.cap_mem > 0, 1.0 - util_mem / kin.cap_mem, 0.0)
+    total = jnp.power(10.0, fc) + jnp.power(10.0, fm)
+    binpack = jnp.clip(20.0 - total, 0.0, 18.0)        # funcs.go:259
+    spreadfit = jnp.clip(total - 2.0, 0.0, 18.0)       # funcs.go:286
+    fit = jnp.where(kin.algorithm_spread, spreadfit, binpack) / 18.0
+
+    # plane sum with per-plane appended masks (ScoreNormalizationIterator
+    # averages only appended scores, rank.go:764)
+    score_sum = fit
+    nplanes = jnp.ones_like(fit)
+
+    # device affinity (rank.go:549-554): appended when the ask has device
+    # affinities at all
+    dev_on = kin.has_dev_affinity
+    score_sum = score_sum + jnp.where(dev_on, kin.dev_aff_score, 0.0)
+    nplanes = nplanes + jnp.where(dev_on, 1.0, 0.0)
+
+    # job anti-affinity (rank.go:588-607)
+    collisions = st["job_tg_count"].astype(jnp.float32)
+    denom = jnp.maximum(kin.desired_count.astype(jnp.float32), 1.0)
+    anti = -(collisions + 1.0) / denom
+    anti_on = collisions > 0
+    score_sum = score_sum + jnp.where(anti_on, anti, 0.0)
+    nplanes = nplanes + anti_on.astype(jnp.float32)
+
+    # rescheduling penalty (rank.go:655-663)
+    score_sum = score_sum + jnp.where(kin.penalty, -1.0, 0.0)
+    nplanes = nplanes + kin.penalty.astype(jnp.float32)
+
+    # node affinity (rank.go:730-745): appended where nonzero
+    aff_on = kin.aff_score != 0.0
+    score_sum = score_sum + jnp.where(aff_on, kin.aff_score, 0.0)
+    nplanes = nplanes + aff_on.astype(jnp.float32)
+
+    # spread (spread.go:116-245)
+    spread_total = _spread_score(kin, st)
+    spread_on = spread_total != 0.0
+    score_sum = score_sum + jnp.where(spread_on, spread_total, 0.0)
+    nplanes = nplanes + spread_on.astype(jnp.float32)
+
+    return score_sum / nplanes
+
+
+def _spread_score(kin: KernelIn, st) -> jnp.ndarray:
+    """Sum of per-stanza spread boosts for every node."""
+    n = kin.cap_cpu.shape[0]
+    total = jnp.zeros(n, jnp.float32)
+    counts = st["spread_counts"]  # [S, B]
+    for s in range(MAX_SPREADS):   # static unroll, S is tiny
+        bucket = kin.spread_bucket[s]            # i32[N], -1 missing
+        missing = bucket < 0
+        b_safe = jnp.clip(bucket, 0, SPREAD_BUCKETS - 1)
+        cnt = counts[s][b_safe]                  # f32[N]
+        # -- desired-count path (spread.go:158-183): usedCount+1 --
+        des = kin.spread_desired[s][b_safe]
+        desired_boost = jnp.where(
+            des > 0.0,
+            ((des - (cnt + 1.0)) / des) * kin.spread_weight[s],
+            -1.0,
+        )
+        # -- even-spread path (spread.go evenSpreadScoreBoost :193) --
+        present = counts[s] > 0.0
+        any_alloc = jnp.any(present)
+        minc = jnp.min(jnp.where(present, counts[s], jnp.inf))
+        maxc = jnp.max(jnp.where(present, counts[s], -jnp.inf))
+        cur = cnt
+        delta_boost = jnp.where(minc > 0, (minc - cur) / jnp.maximum(minc, 1.0), -1.0)
+        even_boost = jnp.where(
+            cur != minc,
+            delta_boost,
+            jnp.where(
+                minc == maxc,
+                -1.0,
+                jnp.where(minc == 0, 1.0, (maxc - minc) / jnp.maximum(minc, 1.0)),
+            ),
+        )
+        even_boost = jnp.where(any_alloc, even_boost, 0.0)
+        stanza = jnp.where(
+            missing, -1.0, jnp.where(kin.spread_even[s], even_boost, desired_boost)
+        )
+        total = total + jnp.where(kin.spread_active[s], stanza, 0.0)
+    return total
+
+
+def place_taskgroup(kin: KernelIn, k_steps: int) -> KernelOut:
+    """Place up to ``k_steps`` allocations of one task group.
+
+    Each scan step: mask -> score -> argmax -> deduct chosen node's
+    planes. Steps past ``kin.n_steps`` are inactive (static padding).
+    """
+    n = kin.cap_cpu.shape[0]
+
+    init = dict(
+        used_cpu=kin.used_cpu,
+        used_mem=kin.used_mem,
+        used_disk=kin.used_disk,
+        used_cores=kin.used_cores,
+        used_mbits=kin.used_mbits,
+        free_dyn=kin.free_dyn,
+        port_conflict=kin.port_conflict,
+        dev_free=kin.dev_free,
+        job_tg_count=kin.job_tg_count,
+        spread_counts=kin.spread_counts,
+    )
+
+    # metrics from the initial state (one extra mask pass, outside scan)
+    feas0, _, dims0 = _feasible(kin, init)
+    base_i = kin.base_mask
+    exhausted = lambda fit: jnp.sum(base_i & ~fit).astype(jnp.int32)  # noqa: E731
+
+    def step(st, i):
+        feasible, ask_cpu_total, _ = _feasible(kin, st)
+        final = _score(kin, st, ask_cpu_total)
+        active = i < kin.n_steps
+        masked = jnp.where(feasible & active, final, NEG_INF)
+        idx = jnp.argmax(masked)
+        found = masked[idx] > NEG_INF / 2
+
+        topv, topi = jax.lax.top_k(masked, TOPK)
+
+        # deduct the chosen node's planes (only when found & active)
+        upd = (found & active).astype(jnp.float32)
+        updi = (found & active).astype(jnp.int32)
+        one = jax.nn.one_hot(idx, n, dtype=jnp.float32) * upd
+        onei = jax.nn.one_hot(idx, n, dtype=jnp.int32) * updi
+        st2 = dict(
+            used_cpu=st["used_cpu"] + one * ask_cpu_total,
+            used_mem=st["used_mem"] + one * kin.ask_mem,
+            used_disk=st["used_disk"] + one * kin.ask_disk,
+            used_cores=st["used_cores"] + onei * kin.ask_cores,
+            used_mbits=st["used_mbits"] + onei * kin.ask_mbits,
+            free_dyn=st["free_dyn"] - onei * kin.ask_dyn_ports,
+            # same reserved ports collide on the chosen node next step
+            port_conflict=st["port_conflict"]
+            | ((one > 0) & kin.ask_has_reserved_ports),
+            dev_free=st["dev_free"] - one[:, None] * kin.ask_dev[None, :],
+            job_tg_count=st["job_tg_count"] + onei,
+            spread_counts=_bump_spread(kin, st["spread_counts"], idx, upd),
+        )
+        out = (
+            jnp.where(found, idx, -1).astype(jnp.int32),
+            jnp.where(found, masked[idx], 0.0),
+            found & active,
+            topi.astype(jnp.int32),
+            topv,
+        )
+        return st2, out
+
+    _, (chosen, scores, found, topk_idx, topk_scores) = jax.lax.scan(
+        step, init, jnp.arange(k_steps)
+    )
+
+    return KernelOut(
+        chosen=chosen,
+        scores=scores,
+        found=found,
+        topk_idx=topk_idx,
+        topk_scores=topk_scores,
+        nodes_evaluated=jnp.sum(base_i).astype(jnp.int32),
+        nodes_feasible=jnp.sum(feas0).astype(jnp.int32),
+        exhausted_cpu=exhausted(dims0["fit_cpu"]),
+        exhausted_mem=exhausted(dims0["fit_mem"]),
+        exhausted_disk=exhausted(dims0["fit_disk"]),
+        exhausted_ports=exhausted(dims0["fit_ports"]),
+        exhausted_devices=exhausted(dims0["fit_dev"]),
+        exhausted_cores=exhausted(dims0["fit_cores"]),
+    )
+
+
+def _bump_spread(kin: KernelIn, counts, idx, upd):
+    """counts[s, bucket_of_chosen] += 1 for active stanzas."""
+    bump = jnp.zeros_like(counts)
+    for s in range(MAX_SPREADS):
+        b = kin.spread_bucket[s][idx]
+        valid = (b >= 0) & kin.spread_active[s]
+        b_safe = jnp.clip(b, 0, SPREAD_BUCKETS - 1)
+        row = jax.nn.one_hot(b_safe, SPREAD_BUCKETS, dtype=counts.dtype)
+        bump = bump.at[s].add(jnp.where(valid, row * upd, 0.0))
+    return counts + bump
+
+
+place_taskgroup_jit = jax.jit(place_taskgroup, static_argnums=(1,))
+
+
+def build_kernel_in(
+    cluster: ClusterTensors, ev: EvalTensors, n_steps: int
+) -> KernelIn:
+    """Assemble device inputs from the host-side tensor schema."""
+    from nomad_tpu.tensors.schema import AskLimitError
+
+    S, N = MAX_SPREADS, cluster.n_pad
+    if len(ev.spreads) > S:
+        raise AskLimitError(
+            f"task group has {len(ev.spreads)} spread stanzas; kernel "
+            f"supports {S}"
+        )
+    sp_active = np.zeros(S, bool)
+    sp_even = np.zeros(S, bool)
+    sp_weight = np.zeros(S, np.float32)
+    sp_bucket = np.full((S, N), -1, np.int32)
+    sp_counts = np.zeros((S, SPREAD_BUCKETS), np.float32)
+    sp_desired = np.full((S, SPREAD_BUCKETS), -1.0, np.float32)
+    for s, sp in enumerate(ev.spreads[:S]):
+        sp_active[s] = True
+        sp_even[s] = sp.even
+        sp_weight[s] = sp.weight_frac
+        sp_bucket[s] = sp.bucket_id
+        sp_counts[s] = sp.counts
+        sp_desired[s] = sp.desired
+
+    # reserved-port conflict: ask bits already set in node planes or the
+    # in-plan conflict words
+    if ev.ask.reserved_ports:
+        words = cluster.port_words | ev.port_conflict_words
+        conflict = np.any(words & ev.ask.port_mask[None, :], axis=1)
+        has_res = True
+    else:
+        conflict = np.zeros(N, bool)
+        has_res = False
+
+    return KernelIn(
+        cap_cpu=jnp.asarray(cluster.cap_cpu),
+        cap_mem=jnp.asarray(cluster.cap_mem),
+        cap_disk=jnp.asarray(cluster.cap_disk),
+        free_cores=jnp.asarray(cluster.free_cores),
+        shares_per_core=jnp.asarray(cluster.shares_per_core),
+        free_dyn=jnp.asarray(cluster.free_dyn - ev.free_dyn_delta),
+        base_mask=jnp.asarray(ev.base_mask),
+        used_cpu=jnp.asarray(ev.used_cpu),
+        used_mem=jnp.asarray(ev.used_mem),
+        used_disk=jnp.asarray(ev.used_disk),
+        used_cores=jnp.asarray(ev.used_cores),
+        used_mbits=jnp.asarray(ev.used_mbits),
+        avail_mbits=jnp.asarray(ev.avail_mbits),
+        port_conflict=jnp.asarray(conflict),
+        dev_free=jnp.asarray(ev.dev_free),
+        dev_aff_score=jnp.asarray(ev.dev_aff_score),
+        has_dev_affinity=jnp.asarray(ev.has_dev_affinity),
+        job_tg_count=jnp.asarray(ev.job_tg_count),
+        penalty=jnp.asarray(ev.penalty),
+        aff_score=jnp.asarray(ev.aff_score),
+        spread_active=jnp.asarray(sp_active),
+        spread_even=jnp.asarray(sp_even),
+        spread_weight=jnp.asarray(sp_weight),
+        spread_bucket=jnp.asarray(sp_bucket),
+        spread_counts=jnp.asarray(sp_counts),
+        spread_desired=jnp.asarray(sp_desired),
+        ask_cpu=jnp.asarray(ev.ask.cpu, jnp.float32),
+        ask_mem=jnp.asarray(ev.ask.mem, jnp.float32),
+        ask_disk=jnp.asarray(ev.ask.disk, jnp.float32),
+        ask_cores=jnp.asarray(ev.ask.cores, jnp.int32),
+        ask_dyn_ports=jnp.asarray(ev.ask.n_dyn_ports, jnp.int32),
+        ask_has_reserved_ports=jnp.asarray(has_res),
+        ask_dev=jnp.asarray(ev.ask.dev_counts, jnp.float32),
+        ask_mbits=jnp.asarray(ev.ask.total_mbits, jnp.int32),
+        desired_count=jnp.asarray(ev.desired_count, jnp.int32),
+        algorithm_spread=jnp.asarray(ev.algorithm == "spread"),
+        n_steps=jnp.asarray(n_steps, jnp.int32),
+    )

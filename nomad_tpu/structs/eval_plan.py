@@ -13,8 +13,12 @@ from typing import Dict, List, Optional
 
 from nomad_tpu.structs.alloc import Allocation
 from nomad_tpu.structs.consts import (
+    ALLOC_DESIRED_EVICT,
     ALLOC_DESIRED_STOP,
     DEPLOYMENT_STATUS_RUNNING,
+    EVAL_STATUS_CANCELLED,
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
     EVAL_STATUS_PENDING,
 )
 
@@ -59,7 +63,9 @@ class Evaluation:
     leader_ack: str = ""             # broker token
 
     def terminal_status(self) -> bool:
-        return self.status in ("complete", "failed", "canceled")
+        return self.status in (
+            EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, EVAL_STATUS_CANCELLED
+        )
 
     def should_enqueue(self) -> bool:
         return self.status in (EVAL_STATUS_PENDING,)
@@ -153,7 +159,7 @@ class Plan:
     def append_preempted_alloc(self, alloc: Allocation, preempting_alloc_id: str) -> None:
         """structs.go Plan.AppendPreemptedAlloc."""
         new = alloc.copy_skip_job()
-        new.desired_status = "evict"
+        new.desired_status = ALLOC_DESIRED_EVICT
         new.preempted_by_allocation = preempting_alloc_id
         new.desired_description = f"Preempted by alloc ID {preempting_alloc_id}"
         self.node_preemptions.setdefault(alloc.node_id, []).append(new)

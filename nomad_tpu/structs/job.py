@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 from nomad_tpu.structs.consts import (
     JOB_DEFAULT_PRIORITY,
     JOB_STATUS_PENDING,
+    JOB_TYPE_BATCH,
     JOB_TYPE_SERVICE,
     JOB_TYPE_SYSTEM,
 )
@@ -315,7 +316,7 @@ class Job:
         tg = self.lookup_task_group(tg_name)
         if tg is not None and tg.reschedule_policy is not None:
             return tg.reschedule_policy
-        if self.type == "batch":
+        if self.type == JOB_TYPE_BATCH:
             return DEFAULT_BATCH_RESCHEDULE.copy()
         return DEFAULT_SERVICE_RESCHEDULE.copy()
 

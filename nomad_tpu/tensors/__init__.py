@@ -1,0 +1,17 @@
+"""Tensor flattening contract: structs -> fixed-width device arrays.
+
+This is the TPU-native seam that has no analog in the reference: the
+scheduling-relevant state of the cluster (reference structs.NodeResources /
+AllocatedResources, SURVEY.md section 2.1 TPU note) flattens into
+struct-of-arrays numpy planes with static, bucket-padded shapes so the
+JAX kernel in ``nomad_tpu.ops`` never recompiles as the cluster grows.
+"""
+
+from nomad_tpu.tensors.schema import (  # noqa: F401
+    AskTensor,
+    ClusterTensors,
+    EvalTensors,
+    MAX_RESERVED_PORT_ASKS,
+    MAX_SPREADS,
+    pad_bucket,
+)

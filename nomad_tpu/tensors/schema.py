@@ -1,0 +1,236 @@
+"""NodeTensor / AskTensor / EvalTensors: the flattening contract.
+
+Reference mapping (SURVEY.md section 2.1 "TPU note"): structs.NodeResources
+and structs.AllocatedResources flatten to fixed-width f32/i32 planes --
+cpu shares, memory MB, disk MB, port-bitmap words, per-request device
+counts -- so feasibility and scoring become elementwise ops on device.
+Ragged data (regex/version constraints, attribute strings, device
+attributes) is evaluated host-side per computed node class (the
+eligibility-cache idea, reference scheduler/feasible.go:1050) and enters
+the kernel only as boolean mask planes or integer bucket ids.
+
+Shapes are bucket-padded (``pad_bucket``) so XLA compiles once per size
+bucket, not once per cluster size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# Static widths (kernel recompiles if these change; they are framework
+# constants, not per-cluster values). Asks exceeding a width raise
+# AskLimitError -- the scheduler surfaces it as an eval failure rather
+# than silently mis-scheduling.
+MAX_RESERVED_PORT_ASKS = 16   # reserved-port asks per task group
+MAX_DEV_REQS = 4              # device requests per task group
+MAX_SPREADS = 4               # spread stanzas per task group (job+tg merged)
+SPREAD_BUCKETS = 64           # distinct attribute values per spread stanza
+PORT_WORDS = 65536 // 32      # u32 words covering the port space
+
+
+class AskLimitError(ValueError):
+    """A task group exceeds a static kernel width (device requests,
+    spread stanzas). The reference has no such limits (iterators are
+    unbounded); the tensor formulation trades that for static shapes."""
+
+_MIN_BUCKET = 64
+
+
+def pad_bucket(n: int) -> int:
+    """Round up to the next power of two (min 64) for static shapes."""
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class ClusterTensors:
+    """Per-snapshot node planes, node axis padded to ``n_pad``.
+
+    Built once per scheduling snapshot (and incrementally updatable);
+    shared by every evaluation scheduled against that snapshot.
+    Capacities are net of node-reserved resources (the subtraction in
+    reference funcs.go:199-204 is pre-applied).
+    """
+
+    n_real: int
+    n_pad: int
+    node_ids: List[str]                      # host-side, len n_real
+    index: Dict[str, int]                    # node id -> row
+    cap_cpu: np.ndarray                      # f32[n_pad]
+    cap_mem: np.ndarray                      # f32[n_pad]
+    cap_disk: np.ndarray                     # f32[n_pad]
+    ready: np.ndarray                        # bool[n_pad]
+    port_words: np.ndarray                   # u32[n_pad, PORT_WORDS]
+    free_dyn: np.ndarray                     # i32[n_pad] free dynamic ports
+    free_cores: np.ndarray                   # i32[n_pad] unreserved core count
+    shares_per_core: np.ndarray              # f32[n_pad]
+    # host-side ragged companions (never shipped to device)
+    datacenters: List[str] = field(default_factory=list)
+    node_classes: List[str] = field(default_factory=list)
+    computed_classes: List[str] = field(default_factory=list)
+    node_pools: List[str] = field(default_factory=list)
+
+    @classmethod
+    def build(cls, nodes: Sequence) -> "ClusterTensors":
+        """Flatten structs.Node rows. Nodes keep their given order; the
+        caller owns any shuffling (reference util.go:464 shuffleNodes is
+        unnecessary under global argmax selection)."""
+        from nomad_tpu.structs.network import NetworkIndex
+
+        n = len(nodes)
+        npad = pad_bucket(n)
+        cap_cpu = np.zeros(npad, np.float32)
+        cap_mem = np.zeros(npad, np.float32)
+        cap_disk = np.zeros(npad, np.float32)
+        ready = np.zeros(npad, bool)
+        port_words = np.zeros((npad, PORT_WORDS), np.uint32)
+        free_dyn = np.zeros(npad, np.int32)
+        free_cores = np.zeros(npad, np.int32)
+        spc = np.zeros(npad, np.float32)
+        ids, dcs, classes, cclasses, pools = [], [], [], [], []
+
+        for i, node in enumerate(nodes):
+            res = node.node_resources
+            rsv = node.reserved_resources
+            cap_cpu[i] = max(res.cpu.cpu_shares - rsv.cpu_shares, 0)
+            cap_mem[i] = max(res.memory.memory_mb - rsv.memory_mb, 0)
+            cap_disk[i] = max(res.disk.disk_mb - rsv.disk_mb, 0)
+            ready[i] = node.ready()
+            idx = NetworkIndex()
+            idx.set_node(node)
+            w64 = idx.port_words()            # u64[1024]
+            port_words[i] = w64.view(np.uint32)
+            free_dyn[i] = idx.free_dynamic_count()
+            free_cores[i] = len(
+                set(res.cpu.reservable_cpu_cores) - set(rsv.reserved_cpu_cores)
+            )
+            spc[i] = res.cpu.shares_per_core()
+            ids.append(node.id)
+            dcs.append(node.datacenter)
+            classes.append(node.node_class)
+            cclasses.append(node.computed_class or node.compute_class())
+            pools.append(node.node_pool)
+
+        return cls(
+            n_real=n, n_pad=npad, node_ids=ids,
+            index={nid: i for i, nid in enumerate(ids)},
+            cap_cpu=cap_cpu, cap_mem=cap_mem, cap_disk=cap_disk,
+            ready=ready, port_words=port_words, free_dyn=free_dyn,
+            free_cores=free_cores, shares_per_core=spc,
+            datacenters=dcs, node_classes=classes,
+            computed_classes=cclasses, node_pools=pools,
+        )
+
+
+@dataclass
+class AskTensor:
+    """Node-independent flattening of one task group's resource ask.
+
+    The per-task loop in reference rank.go:349-500 collapses: tasks of a
+    group are summed host-side (cpu/mem; group disk; group+task ports;
+    device request counts) because the kernel places whole groups.
+    """
+
+    cpu: float = 0.0                 # summed task cpu shares (MHz)
+    mem: float = 0.0                 # summed task memory MB
+    disk: float = 0.0                # group ephemeral disk MB
+    cores: int = 0                   # summed reserved-core asks
+    n_dyn_ports: int = 0
+    reserved_ports: List[int] = None     # host-side full list of asks
+    port_mask: np.ndarray = None         # u32[PORT_WORDS] bits of ALL asks
+    n_dev_reqs: int = 0
+    dev_counts: np.ndarray = None        # i32[MAX_DEV_REQS], 0 pad
+    total_mbits: int = 0
+
+    @classmethod
+    def build(cls, tg) -> "AskTensor":
+        a = cls()
+        a.reserved_ports = []
+        a.port_mask = np.zeros(PORT_WORDS, np.uint32)
+        a.dev_counts = np.zeros(MAX_DEV_REQS, np.int32)
+        a.disk = float(tg.ephemeral_disk.size_mb)
+
+        ndev = 0
+        for net in tg.networks:
+            a.n_dyn_ports += len(net.dynamic_ports)
+            a.total_mbits += net.mbits
+            a.reserved_ports += [p.value for p in net.reserved_ports]
+        for task in tg.tasks:
+            r = task.resources
+            if r.cores > 0:
+                a.cores += r.cores
+            else:
+                a.cpu += float(r.cpu)
+            a.mem += float(r.memory_mb)
+            for net in r.networks:
+                a.n_dyn_ports += len(net.dynamic_ports)
+                a.total_mbits += net.mbits
+                a.reserved_ports += [p.value for p in net.reserved_ports]
+            for dev in r.devices:
+                if ndev >= MAX_DEV_REQS:
+                    raise AskLimitError(
+                        f"task group {tg.name!r} has more than "
+                        f"{MAX_DEV_REQS} device requests"
+                    )
+                a.dev_counts[ndev] = dev.count
+                ndev += 1
+        a.n_dev_reqs = ndev
+        for port in a.reserved_ports:
+            a.port_mask[port >> 5] |= np.uint32(1 << (port & 31))
+        return a
+
+
+@dataclass
+class SpreadTensor:
+    """One spread stanza flattened to bucket arrays.
+
+    ``bucket_id[n]`` maps each node's attribute value into the stanza's
+    value table (-1 when the node lacks the attribute); ``counts[b]``
+    is existing+proposed allocs per value (reference propertyset.go);
+    ``desired[b]`` is the target count per value, or -1 everywhere for
+    even-spread mode (no targets specified, reference spread.go:193).
+    """
+
+    bucket_id: np.ndarray        # i32[n_pad]
+    counts: np.ndarray           # f32[SPREAD_BUCKETS]
+    desired: np.ndarray          # f32[SPREAD_BUCKETS]; -1 = even-spread mode
+    weight_frac: float = 1.0     # weight / sumSpreadWeights
+    even: bool = False
+
+
+@dataclass
+class EvalTensors:
+    """Everything one (evaluation, task group) pair ships to the kernel.
+
+    The boolean/score planes are the tensorized residue of the
+    feasibility+rank iterator chain (reference stack.go:344-439):
+    ``base_mask`` folds RandomIterator eligibility, class-level constraint
+    checks, driver checks, distinct_hosts/property and volume checks;
+    ``aff_score``/``penalty``/``job_tg_count`` feed the soft-score planes.
+    """
+
+    base_mask: np.ndarray            # bool[n_pad]
+    used_cpu: np.ndarray             # f32[n_pad] proposed utilization
+    used_mem: np.ndarray             # f32[n_pad]
+    used_disk: np.ndarray            # f32[n_pad]
+    used_mbits: np.ndarray           # i32[n_pad]
+    avail_mbits: np.ndarray          # i32[n_pad]
+    used_cores: np.ndarray           # i32[n_pad] count of reserved cores used
+    port_conflict_words: np.ndarray  # u32[n_pad, PORT_WORDS] in-plan port bits
+    free_dyn_delta: np.ndarray       # i32[n_pad] dyn ports consumed in-plan
+    dev_free: np.ndarray             # f32[n_pad, MAX_DEV_REQS] per-request
+    dev_aff_score: np.ndarray        # f32[n_pad]
+    has_dev_affinity: bool
+    job_tg_count: np.ndarray         # i32[n_pad] same job+tg proposed allocs
+    penalty: np.ndarray              # bool[n_pad] rescheduling penalty nodes
+    aff_score: np.ndarray            # f32[n_pad] normalized affinity score
+    has_affinities: bool
+    spreads: List[SpreadTensor]
+    ask: AskTensor
+    desired_count: int               # tg.count (anti-affinity denominator)
+    algorithm: str = "binpack"       # binpack | spread (cluster config)

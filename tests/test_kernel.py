@@ -1,0 +1,456 @@
+"""Kernel golden-parity tests.
+
+An independent pure-Python oracle reproduces the Go iterator semantics
+(feasible.go / rank.go / spread.go / select.go MaxScore) with float64
+math; the JAX kernel must match its choices exactly and its scores to
+float32 tolerance. This is the port of the reference's scheduler unit
+tests' role (rank_test.go, spread_test.go) onto the batched formulation.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from nomad_tpu.ops.kernel import KernelOut, build_kernel_in, pad_steps, place_taskgroup_jit
+from nomad_tpu.tensors.schema import (
+    MAX_DEV_REQS,
+    SPREAD_BUCKETS,
+    AskTensor,
+    ClusterTensors,
+    EvalTensors,
+    SpreadTensor,
+    pad_bucket,
+)
+
+
+# ---------------------------------------------------------------------------
+# Helpers to build small synthetic clusters without full structs
+# ---------------------------------------------------------------------------
+
+
+def make_cluster(caps):
+    """caps: list of (cpu, mem) tuples."""
+    n = len(caps)
+    npad = pad_bucket(n)
+    c = ClusterTensors(
+        n_real=n,
+        n_pad=npad,
+        node_ids=[f"node-{i}" for i in range(n)],
+        index={f"node-{i}": i for i in range(n)},
+        cap_cpu=np.zeros(npad, np.float32),
+        cap_mem=np.zeros(npad, np.float32),
+        cap_disk=np.full(npad, 1 << 20, np.float32),
+        ready=np.zeros(npad, bool),
+        port_words=np.zeros((npad, 2048), np.uint32),
+        free_dyn=np.full(npad, 12001, np.int32),
+        free_cores=np.full(npad, 8, np.int32),
+        shares_per_core=np.full(npad, 1000.0, np.float32),
+        datacenters=["dc1"] * n,
+        node_classes=[""] * n,
+        computed_classes=["c0"] * n,
+        node_pools=["default"] * n,
+    )
+    for i, (cpu, mem) in enumerate(caps):
+        c.cap_cpu[i] = cpu
+        c.cap_mem[i] = mem
+        c.ready[i] = True
+    return c
+
+
+def make_eval(cluster, ask=None, **kw):
+    n = cluster.n_pad
+    base = np.zeros(n, bool)
+    base[: cluster.n_real] = True
+    ev = EvalTensors(
+        base_mask=kw.get("base_mask", base),
+        used_cpu=kw.get("used_cpu", np.zeros(n, np.float32)),
+        used_mem=kw.get("used_mem", np.zeros(n, np.float32)),
+        used_disk=np.zeros(n, np.float32),
+        used_mbits=np.zeros(n, np.int32),
+        avail_mbits=np.full(n, 1000, np.int32),
+        used_cores=np.zeros(n, np.int32),
+        port_conflict_words=np.zeros((n, 2048), np.uint32),
+        free_dyn_delta=np.zeros(n, np.int32),
+        dev_free=kw.get("dev_free", np.zeros((n, MAX_DEV_REQS), np.float32)),
+        dev_aff_score=kw.get("dev_aff_score", np.zeros(n, np.float32)),
+        has_dev_affinity=kw.get("has_dev_affinity", False),
+        job_tg_count=kw.get("job_tg_count", np.zeros(n, np.int32)),
+        penalty=kw.get("penalty", np.zeros(n, bool)),
+        aff_score=kw.get("aff_score", np.zeros(n, np.float32)),
+        has_affinities=bool(np.any(kw.get("aff_score", np.zeros(1)) != 0)),
+        spreads=kw.get("spreads", []),
+        ask=ask or AskTensor.build_from_simple(),
+        desired_count=kw.get("desired_count", 1),
+        algorithm=kw.get("algorithm", "binpack"),
+    )
+    return ev
+
+
+def simple_ask(cpu=500, mem=256, disk=0, dyn=0, dev=None):
+    a = AskTensor()
+    a.cpu, a.mem, a.disk = float(cpu), float(mem), float(disk)
+    a.n_dyn_ports = dyn
+    a.reserved_ports = []
+    a.port_mask = np.zeros(2048, np.uint32)
+    a.dev_counts = np.zeros(MAX_DEV_REQS, np.int32)
+    if dev:
+        for i, d in enumerate(dev):
+            a.dev_counts[i] = d
+    return a
+
+
+AskTensor.build_from_simple = staticmethod(simple_ask)
+
+
+def run_kernel(cluster, ev, k):
+    kin = build_kernel_in(cluster, ev, k)
+    out = place_taskgroup_jit(kin, pad_steps(k))
+    return KernelOut(*[np.asarray(x) for x in out])
+
+
+# ---------------------------------------------------------------------------
+# The float64 oracle (Go semantics)
+# ---------------------------------------------------------------------------
+
+
+def oracle_place(cluster, ev, k):
+    """Sequential max-score placement with Go's scoring rules."""
+    n = cluster.n_real
+    used_cpu = ev.used_cpu.astype(np.float64).copy()
+    used_mem = ev.used_mem.astype(np.float64).copy()
+    job_cnt = ev.job_tg_count.astype(np.int64).copy()
+    dev_free = ev.dev_free.astype(np.float64).copy()
+    free_dyn = (cluster.free_dyn - ev.free_dyn_delta).astype(np.int64).copy()
+    sp_counts = [s.counts.astype(np.float64).copy() for s in ev.spreads]
+    results = []
+    ask = ev.ask
+    for _ in range(k):
+        best_i, best_s = -1, None
+        for i in range(n):
+            if not ev.base_mask[i]:
+                continue
+            cap_c, cap_m = cluster.cap_cpu[i], cluster.cap_mem[i]
+            if cap_c - used_cpu[i] < ask.cpu or cap_m - used_mem[i] < ask.mem:
+                continue
+            if free_dyn[i] < ask.n_dyn_ports:
+                continue
+            if np.any(dev_free[i] < ask.dev_counts):
+                continue
+            util_c, util_m = used_cpu[i] + ask.cpu, used_mem[i] + ask.mem
+            fc = 1 - util_c / cap_c if cap_c > 0 else 0.0
+            fm = 1 - util_m / cap_m if cap_m > 0 else 0.0
+            total = 10.0 ** fc + 10.0 ** fm
+            if ev.algorithm == "spread":
+                raw = min(max(total - 2.0, 0.0), 18.0)
+            else:
+                raw = min(max(20.0 - total, 0.0), 18.0)
+            scores = [raw / 18.0]
+            if ev.has_dev_affinity:
+                scores.append(float(ev.dev_aff_score[i]))
+            col = int(job_cnt[i])
+            if col > 0:
+                scores.append(-(col + 1) / max(ev.desired_count, 1))
+            if ev.penalty[i]:
+                scores.append(-1.0)
+            if ev.aff_score[i] != 0.0:
+                scores.append(float(ev.aff_score[i]))
+            sp_total = 0.0
+            for s_i, sp in enumerate(ev.spreads):
+                b = int(sp.bucket_id[i])
+                if b < 0:
+                    sp_total += -1.0
+                    continue
+                cnt = sp_counts[s_i][b]
+                if sp.even:
+                    counts = sp_counts[s_i]
+                    present = counts > 0
+                    if not present.any():
+                        continue
+                    minc = counts[present].min()
+                    maxc = counts[present].max()
+                    if cnt != minc:
+                        sp_total += (minc - cnt) / minc if minc > 0 else -1.0
+                    elif minc == maxc:
+                        sp_total += -1.0
+                    elif minc == 0:
+                        sp_total += 1.0
+                    else:
+                        sp_total += (maxc - minc) / minc
+                else:
+                    des = sp.desired[b]
+                    if des > 0:
+                        sp_total += ((des - (cnt + 1)) / des) * sp.weight_frac
+                    else:
+                        sp_total += -1.0
+            if sp_total != 0.0:
+                scores.append(sp_total)
+            final = sum(scores) / len(scores)
+            if best_s is None or final > best_s:
+                best_i, best_s = i, final
+        if best_i < 0:
+            results.append((-1, 0.0))
+            continue
+        results.append((best_i, best_s))
+        used_cpu[best_i] += ask.cpu
+        used_mem[best_i] += ask.mem
+        job_cnt[best_i] += 1
+        dev_free[best_i] -= ask.dev_counts
+        free_dyn[best_i] -= ask.n_dyn_ports
+        for s_i, sp in enumerate(ev.spreads):
+            b = int(sp.bucket_id[best_i])
+            if b >= 0:
+                sp_counts[s_i][b] += 1
+    return results
+
+
+def assert_parity(cluster, ev, k):
+    out = run_kernel(cluster, ev, k)
+    want = oracle_place(cluster, ev, k)
+    for step, (wi, ws) in enumerate(want):
+        assert out.chosen[step] == wi, (
+            f"step {step}: kernel chose {out.chosen[step]}, oracle {wi} "
+            f"(kernel score {out.scores[step]}, oracle {ws})"
+        )
+        if wi >= 0:
+            assert out.scores[step] == pytest.approx(ws, abs=2e-5)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tests
+# ---------------------------------------------------------------------------
+
+
+class TestBinpackScoring:
+    def test_picks_most_packed_feasible(self):
+        # binpack prefers the node that ends up most utilized
+        cluster = make_cluster([(4000, 8192), (4000, 8192), (4000, 8192)])
+        used = np.zeros(cluster.n_pad, np.float32)
+        used[1] = 2000  # node 1 is half full on cpu
+        ev = make_eval(cluster, ask=simple_ask(), used_cpu=used)
+        out = assert_parity(cluster, ev, 1)
+        assert out.chosen[0] == 1
+
+    def test_score_matches_structs_math(self):
+        from nomad_tpu import structs, mock
+
+        cluster = make_cluster([(4000, 8192)])
+        ev = make_eval(cluster, ask=simple_ask(cpu=2000, mem=4096))
+        out = run_kernel(cluster, ev, 1)
+        node = mock.node()
+        node.node_resources.cpu.cpu_shares = 4000
+        node.node_resources.memory.memory_mb = 8192
+        node.reserved_resources = structs.NodeReservedResources()
+        want = structs.score_fit_binpack(
+            node, structs.ComparableResources(cpu_shares=2000, memory_mb=4096)
+        ) / 18.0
+        assert out.scores[0] == pytest.approx(want, abs=2e-5)  # f32 pow
+
+    def test_spread_algorithm_flips_score(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        used = np.zeros(cluster.n_pad, np.float32)
+        used[0] = 2000
+        ev = make_eval(cluster, ask=simple_ask(), used_cpu=used, algorithm="spread")
+        out = assert_parity(cluster, ev, 1)
+        assert out.chosen[0] == 1  # worst-fit prefers the empty node
+
+    def test_infeasible_all(self):
+        cluster = make_cluster([(400, 512)])
+        ev = make_eval(cluster, ask=simple_ask(cpu=500, mem=256))
+        out = run_kernel(cluster, ev, 1)
+        assert out.chosen[0] == -1
+        assert not out.found[0]
+        assert out.exhausted_cpu == 1
+
+
+class TestSequentialDeduction:
+    def test_resources_deducted_between_placements(self):
+        # one node fits exactly two asks; third placement must go elsewhere
+        cluster = make_cluster([(1000, 1024), (4000, 8192)])
+        used = np.zeros(cluster.n_pad, np.float32)
+        used[1] = 3000  # node 1 more packed -> preferred until full
+        ev = make_eval(cluster, ask=simple_ask(cpu=500, mem=256), used_cpu=used)
+        assert_parity(cluster, ev, 5)
+
+    def test_exhaustion_mid_sequence(self):
+        cluster = make_cluster([(1000, 512), (1000, 512)])
+        ev = make_eval(cluster, ask=simple_ask(cpu=400, mem=200))
+        out = assert_parity(cluster, ev, 5)
+        # 2 per node fit (400*2=800<1000, 200*2=400<512), 5th fails
+        assert list(out.found[:5]) == [True, True, True, True, False]
+
+
+class TestAntiAffinity:
+    def test_collision_penalty(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        cnt = np.zeros(cluster.n_pad, np.int32)
+        cnt[0] = 2  # node 0 already has 2 allocs of this job/tg
+        ev = make_eval(
+            cluster, ask=simple_ask(), job_tg_count=cnt, desired_count=10
+        )
+        out = assert_parity(cluster, ev, 1)
+        assert out.chosen[0] == 1
+
+    def test_spreads_across_nodes(self):
+        # with anti-affinity via job_tg_count updates, placements alternate
+        cluster = make_cluster([(8000, 16384), (8000, 16384)])
+        ev = make_eval(cluster, ask=simple_ask(), desired_count=4)
+        out = assert_parity(cluster, ev, 4)
+        assert sorted(np.bincount(out.chosen[:4], minlength=2)[:2].tolist()) == [2, 2]
+
+
+class TestPenaltyAndAffinity:
+    def test_reschedule_penalty(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        pen = np.zeros(cluster.n_pad, bool)
+        pen[0] = True
+        ev = make_eval(cluster, ask=simple_ask(), penalty=pen)
+        out = assert_parity(cluster, ev, 1)
+        assert out.chosen[0] == 1
+
+    def test_node_affinity_attracts(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        aff = np.zeros(cluster.n_pad, np.float32)
+        aff[0] = 0.8
+        ev = make_eval(cluster, ask=simple_ask(), aff_score=aff)
+        out = assert_parity(cluster, ev, 1)
+        assert out.chosen[0] == 0
+
+    def test_negative_affinity_repels(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        aff = np.zeros(cluster.n_pad, np.float32)
+        aff[0] = -0.5
+        ev = make_eval(cluster, ask=simple_ask(), aff_score=aff)
+        out = assert_parity(cluster, ev, 1)
+        assert out.chosen[0] == 1
+
+
+class TestSpreadStanza:
+    def _spread(self, cluster, buckets, counts, desired, weight=1.0, even=False):
+        b = np.full(cluster.n_pad, -1, np.int32)
+        b[: len(buckets)] = buckets
+        c = np.zeros(SPREAD_BUCKETS, np.float32)
+        c[: len(counts)] = counts
+        d = np.full(SPREAD_BUCKETS, -1.0, np.float32)
+        if desired is not None:
+            d[: len(desired)] = desired
+        return SpreadTensor(
+            bucket_id=b, counts=c, desired=d if desired is not None else np.full(SPREAD_BUCKETS, -1.0, np.float32),
+            weight_frac=weight, even=even,
+        )
+
+    def test_desired_count_spread(self):
+        # 4 nodes: dc0,dc0,dc1,dc1; desire 3 in dc0, 1 in dc1 (count 4)
+        cluster = make_cluster([(4000, 8192)] * 4)
+        sp = self._spread(
+            cluster, buckets=[0, 0, 1, 1], counts=[0, 0], desired=[3.0, 1.0]
+        )
+        ev = make_eval(cluster, ask=simple_ask(), spreads=[sp], desired_count=4)
+        out = assert_parity(cluster, ev, 4)
+        placed = out.chosen[:4]
+        dc0 = sum(1 for i in placed if i in (0, 1))
+        assert dc0 == 3  # 3 of 4 land in dc0
+
+    def test_even_spread(self):
+        cluster = make_cluster([(8000, 16384)] * 4)
+        sp = self._spread(
+            cluster, buckets=[0, 0, 1, 1], counts=[2, 0], desired=None, even=True
+        )
+        ev = make_eval(cluster, ask=simple_ask(), spreads=[sp], desired_count=2)
+        out = assert_parity(cluster, ev, 2)
+        # bucket 1 has fewer allocs -> both placements favor nodes 2,3
+        assert set(out.chosen[:2].tolist()) == {2, 3}
+
+    def test_missing_attribute_penalized(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        b = np.full(cluster.n_pad, -1, np.int32)
+        b[0] = 0  # node 1 lacks the attribute
+        sp = SpreadTensor(
+            bucket_id=b,
+            counts=np.zeros(SPREAD_BUCKETS, np.float32),
+            desired=np.full(SPREAD_BUCKETS, -1.0, np.float32),
+            weight_frac=1.0,
+            even=True,
+        )
+        ev = make_eval(cluster, ask=simple_ask(), spreads=[sp])
+        out = assert_parity(cluster, ev, 1)
+        assert out.chosen[0] == 0
+
+
+class TestPortsAndDevices:
+    def test_reserved_port_conflict(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        # node 0 has port 8080 in use
+        cluster.port_words[0, 8080 // 32] |= np.uint32(1 << (8080 % 32))
+        ask = simple_ask()
+        ask.reserved_ports.append(8080)
+        ask.port_mask[8080 // 32] |= np.uint32(1 << (8080 % 32))
+        ev = make_eval(cluster, ask=ask)
+        out = run_kernel(cluster, ev, 2)
+        assert out.chosen[0] == 1
+        # second placement of same group also needs 8080 -> node 1 now
+        # conflicts with itself -> no placement
+        assert out.chosen[1] == -1
+        assert out.exhausted_ports >= 1
+
+    def test_dynamic_port_exhaustion(self):
+        cluster = make_cluster([(4000, 8192)])
+        cluster.free_dyn[0] = 1
+        ev = make_eval(cluster, ask=simple_ask(dyn=2))
+        out = run_kernel(cluster, ev, 1)
+        assert out.chosen[0] == -1
+
+    def test_device_fit_and_deduction(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        dev = np.zeros((cluster.n_pad, MAX_DEV_REQS), np.float32)
+        dev[0, 0] = 2  # node 0 has 2 GPUs free
+        dev[1, 0] = 1
+        ev = make_eval(cluster, ask=simple_ask(dev=[1]), dev_free=dev)
+        out = assert_parity(cluster, ev, 3)
+        # 3 placements: two on node 0, one on node 1 (order per scoring)
+        assert sorted(out.chosen[:3].tolist()) == [0, 0, 1]
+        assert bool(out.found[2])
+
+    def test_device_affinity_plane(self):
+        cluster = make_cluster([(4000, 8192), (4000, 8192)])
+        dev = np.ones((cluster.n_pad, MAX_DEV_REQS), np.float32)
+        daff = np.zeros(cluster.n_pad, np.float32)
+        daff[1] = 0.9
+        ev = make_eval(
+            cluster, ask=simple_ask(dev=[1]), dev_free=dev,
+            dev_aff_score=daff, has_dev_affinity=True,
+        )
+        out = assert_parity(cluster, ev, 1)
+        assert out.chosen[0] == 1
+
+
+class TestMetrics:
+    def test_counts(self):
+        cluster = make_cluster([(4000, 8192), (400, 128), (4000, 8192)])
+        base = np.zeros(cluster.n_pad, bool)
+        base[:3] = True
+        base[2] = False  # class-filtered
+        ev = make_eval(cluster, ask=simple_ask(), base_mask=base)
+        out = run_kernel(cluster, ev, 1)
+        assert out.nodes_evaluated == 2
+        assert out.nodes_feasible == 1
+        assert out.exhausted_cpu == 1
+        assert out.exhausted_mem == 1
+
+
+class TestStepPadding:
+    def test_padded_steps_inactive(self):
+        cluster = make_cluster([(8000, 16384)])
+        ev = make_eval(cluster, ask=simple_ask())
+        kin = build_kernel_in(cluster, ev, 3)
+        out = place_taskgroup_jit(kin, pad_steps(3))  # pads to 4
+        out = KernelOut(*[np.asarray(x) for x in out])
+        assert list(out.found[:3]) == [True, True, True]
+        assert not out.found[3]  # padded step places nothing
+
+    def test_pad_steps_buckets(self):
+        assert pad_steps(1) == 1
+        assert pad_steps(3) == 4
+        assert pad_steps(100) == 128
+        assert pad_steps(5000) == 8192
