@@ -30,8 +30,7 @@ placement axis padded to step buckets (``pad_steps``).
 
 from __future__ import annotations
 
-import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +46,7 @@ from nomad_tpu.tensors.schema import (
 
 NEG_INF = -1.0e30
 TOPK = 8          # top-K score metadata returned per placement (AllocMetric)
+MAX_PENALTY_NODES = 4   # previous nodes penalized per rescheduled placement
 _STEP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
@@ -82,6 +82,17 @@ class KernelIn(NamedTuple):
     job_tg_count: jnp.ndarray        # i32[N]
     penalty: jnp.ndarray             # bool[N]
     aff_score: jnp.ndarray           # f32[N]
+    # per-step planes (placement axis K): rescheduled allocs penalize
+    # their previous node(s) (rank.go:630 SetPenaltyNodes is per-Select)
+    # and sticky/preferred placements pin a node (stack.go:120-139)
+    step_penalty: jnp.ndarray        # i32[K, MAX_PENALTY_NODES], -1 pad
+    step_preferred: jnp.ndarray      # i32[K], -1 none
+    # distinct_hosts enforcement inside the scan (feasible.go:526):
+    # job-level forbids co-location with any of the job's allocs,
+    # tg-level with the same task group's
+    job_any_count: jnp.ndarray       # i32[N] job allocs on node (any tg)
+    distinct_hosts_job: jnp.ndarray  # bool scalar
+    distinct_hosts_tg: jnp.ndarray   # bool scalar
     # spreads, stacked [S, ...]
     spread_active: jnp.ndarray       # bool[S]
     spread_even: jnp.ndarray         # bool[S]
@@ -134,10 +145,14 @@ def _feasible(kin: KernelIn, st) -> tuple:
     fit_ports = jnp.logical_and(~st["port_conflict"], fit_dyn)
     fit_dev = jnp.all(st["dev_free"] >= kin.ask_dev[None, :], axis=1)
     fit_bw = (st["used_mbits"] + kin.ask_mbits) <= kin.avail_mbits
+    distinct_ok = ~(
+        (kin.distinct_hosts_job & (st["job_any_count"] > 0))
+        | (kin.distinct_hosts_tg & (st["job_tg_count"] > 0))
+    )
     feasible = (
         kin.base_mask
         & fit_cpu & fit_mem & fit_disk & fit_cores
-        & fit_ports & fit_dev & fit_bw
+        & fit_ports & fit_dev & fit_bw & distinct_ok
     )
     return feasible, ask_cpu_total, dict(
         fit_cpu=fit_cpu, fit_mem=fit_mem, fit_disk=fit_disk,
@@ -145,7 +160,7 @@ def _feasible(kin: KernelIn, st) -> tuple:
     )
 
 
-def _score(kin: KernelIn, st, ask_cpu_total) -> tuple:
+def _score(kin: KernelIn, st, ask_cpu_total, penalty) -> tuple:
     """Score planes + appended-mask normalization (rank.go semantics)."""
     util_cpu = st["used_cpu"] + ask_cpu_total
     util_mem = st["used_mem"] + kin.ask_mem
@@ -178,8 +193,8 @@ def _score(kin: KernelIn, st, ask_cpu_total) -> tuple:
     nplanes = nplanes + anti_on.astype(jnp.float32)
 
     # rescheduling penalty (rank.go:655-663)
-    score_sum = score_sum + jnp.where(kin.penalty, -1.0, 0.0)
-    nplanes = nplanes + kin.penalty.astype(jnp.float32)
+    score_sum = score_sum + jnp.where(penalty, -1.0, 0.0)
+    nplanes = nplanes + penalty.astype(jnp.float32)
 
     # node affinity (rank.go:730-745): appended where nonzero
     aff_on = kin.aff_score != 0.0
@@ -254,6 +269,7 @@ def place_taskgroup(kin: KernelIn, k_steps: int) -> KernelOut:
         port_conflict=kin.port_conflict,
         dev_free=kin.dev_free,
         job_tg_count=kin.job_tg_count,
+        job_any_count=kin.job_any_count,
         spread_counts=kin.spread_counts,
     )
 
@@ -262,12 +278,23 @@ def place_taskgroup(kin: KernelIn, k_steps: int) -> KernelOut:
     base_i = kin.base_mask
     exhausted = lambda fit: jnp.sum(base_i & ~fit).astype(jnp.int32)  # noqa: E731
 
+    iota = jnp.arange(n, dtype=jnp.int32)
+
     def step(st, i):
         feasible, ask_cpu_total, _ = _feasible(kin, st)
-        final = _score(kin, st, ask_cpu_total)
+        # per-step penalty node ids OR'd into the eval-level plane
+        pen_ids = kin.step_penalty[i]                       # i32[P]
+        step_pen = jnp.any(iota[:, None] == pen_ids[None, :], axis=1)
+        penalty = kin.penalty | step_pen
+        final = _score(kin, st, ask_cpu_total, penalty)
         active = i < kin.n_steps
         masked = jnp.where(feasible & active, final, NEG_INF)
-        idx = jnp.argmax(masked)
+        best = jnp.argmax(masked)
+        # preferred-node pin: take it when feasible (stack.go preferred-
+        # source select), else fall back to the global argmax
+        pref = kin.step_preferred[i]
+        pref_ok = (pref >= 0) & feasible[jnp.clip(pref, 0, n - 1)] & active
+        idx = jnp.where(pref_ok, jnp.clip(pref, 0, n - 1), best)
         found = masked[idx] > NEG_INF / 2
 
         topv, topi = jax.lax.top_k(masked, TOPK)
@@ -289,6 +316,7 @@ def place_taskgroup(kin: KernelIn, k_steps: int) -> KernelOut:
             | ((one > 0) & kin.ask_has_reserved_ports),
             dev_free=st["dev_free"] - one[:, None] * kin.ask_dev[None, :],
             job_tg_count=st["job_tg_count"] + onei,
+            job_any_count=st["job_any_count"] + onei,
             spread_counts=_bump_spread(kin, st["spread_counts"], idx, upd),
         )
         out = (
@@ -337,9 +365,18 @@ place_taskgroup_jit = jax.jit(place_taskgroup, static_argnums=(1,))
 
 
 def build_kernel_in(
-    cluster: ClusterTensors, ev: EvalTensors, n_steps: int
+    cluster: ClusterTensors,
+    ev: EvalTensors,
+    n_steps: int,
+    step_penalty: Optional[np.ndarray] = None,
+    step_preferred: Optional[np.ndarray] = None,
 ) -> KernelIn:
-    """Assemble device inputs from the host-side tensor schema."""
+    """Assemble device inputs from the host-side tensor schema.
+
+    ``step_penalty``/``step_preferred`` are per-placement planes sized to
+    the padded step count (``pad_steps(n_steps)``); None means no
+    penalties/preferences.
+    """
     from nomad_tpu.tensors.schema import AskLimitError
 
     S, N = MAX_SPREADS, cluster.n_pad
@@ -372,6 +409,12 @@ def build_kernel_in(
         conflict = np.zeros(N, bool)
         has_res = False
 
+    k_pad = pad_steps(n_steps)
+    if step_penalty is None:
+        step_penalty = np.full((k_pad, MAX_PENALTY_NODES), -1, np.int32)
+    if step_preferred is None:
+        step_preferred = np.full(k_pad, -1, np.int32)
+
     return KernelIn(
         cap_cpu=jnp.asarray(cluster.cap_cpu),
         cap_mem=jnp.asarray(cluster.cap_mem),
@@ -393,6 +436,11 @@ def build_kernel_in(
         job_tg_count=jnp.asarray(ev.job_tg_count),
         penalty=jnp.asarray(ev.penalty),
         aff_score=jnp.asarray(ev.aff_score),
+        step_penalty=jnp.asarray(step_penalty, jnp.int32),
+        step_preferred=jnp.asarray(step_preferred, jnp.int32),
+        job_any_count=jnp.asarray(ev.job_any_count),
+        distinct_hosts_job=jnp.asarray(ev.distinct_hosts_job),
+        distinct_hosts_tg=jnp.asarray(ev.distinct_hosts_tg),
         spread_active=jnp.asarray(sp_active),
         spread_even=jnp.asarray(sp_even),
         spread_weight=jnp.asarray(sp_weight),

@@ -1,0 +1,20 @@
+"""Scheduler package: the capability layer around the TPU kernel.
+
+Reference: scheduler/ (~40k LoC Go). The iterator hot loop lives on
+device (nomad_tpu.ops.kernel); this package provides everything around
+it with the reference's interfaces: the Scheduler factory registry
+(scheduler.go:24-61), the reconciler (reconcile.go), the placement
+stacks (stack.go), host-side feasibility/eligibility caching
+(feasible.go), preemption, and the test harness (testing.go).
+"""
+
+from nomad_tpu.scheduler.scheduler import (  # noqa: F401
+    BUILTIN_SCHEDULERS,
+    Planner,
+    Scheduler,
+    SchedulerState,
+    SetStatusError,
+    new_scheduler,
+)
+from nomad_tpu.scheduler.generic import GenericScheduler  # noqa: F401
+from nomad_tpu.scheduler.system import SystemScheduler  # noqa: F401

@@ -1,0 +1,617 @@
+"""Placement stacks: the host orchestration around the device kernel.
+
+Reference behavior: scheduler/stack.go GenericStack (:43-187) and
+SystemStack (:191-341). One reference ``Select`` call places one alloc;
+the TPU stack's ``select_many`` places *all* missing allocs of a task
+group in one kernel launch (the lax.scan placement axis), then performs
+exact host-side port and device assignment for the chosen nodes
+(AssignPorts/AssignNetwork network.go:427,517; AssignDevice
+device.go:32). If exact assignment disagrees with the kernel's
+count-based planes (rare: overlapping device groups), the node is
+masked and the remaining placements re-run -- semantics stay exact,
+the kernel stays fast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nomad_tpu.ops.kernel import (
+    MAX_PENALTY_NODES,
+    NEG_INF,
+    KernelOut,
+    build_kernel_in,
+    pad_steps,
+    place_taskgroup_jit,
+)
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.device import DeviceAllocator, device_planes_for_node
+from nomad_tpu.scheduler.feasible import FeasibilityBuilder
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import AllocMetric
+from nomad_tpu.structs.constraints import matches_affinity, resolve_target
+from nomad_tpu.structs.network import NetworkIndex, NetworkResource
+from nomad_tpu.structs.resources import (
+    AllocatedCpuResources,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+)
+from nomad_tpu.tensors.schema import (
+    MAX_DEV_REQS,
+    SPREAD_BUCKETS,
+    AskTensor,
+    ClusterTensors,
+    EvalTensors,
+    SpreadTensor,
+)
+
+
+@dataclass
+class SelectRequest:
+    """One placement ask (reference SelectOptions + placement name)."""
+
+    name: str = ""
+    prev_alloc: Optional[object] = None
+    penalty_nodes: Tuple[str, ...] = ()
+    preferred_node: str = ""
+
+
+@dataclass
+class SelectedOption:
+    """One placement result (reference RankedNode after ranking)."""
+
+    node_id: str
+    node: object
+    final_score: float
+    task_resources: Dict[str, AllocatedTaskResources]
+    task_lifecycles: Dict[str, Optional[object]]
+    alloc_resources: Optional[AllocatedSharedResources]
+    metrics: AllocMetric
+    preempted_allocs: List = field(default_factory=list)
+
+
+class XLAGenericStack:
+    """The xla-binpack stack (GenericStack on the TPU kernel)."""
+
+    def __init__(self, batch: bool, ctx: EvalContext, cluster: ClusterTensors) -> None:
+        self.batch = batch
+        self.ctx = ctx
+        self.cluster = cluster
+        self.job = None
+        self._feas = FeasibilityBuilder(cluster, ctx.state, ctx)
+        self._affinity_cache: Dict[Tuple[str, str], float] = {}
+
+    # -- job/tg configuration (stack.go SetJob) --------------------------
+
+    def set_job(self, job) -> None:
+        self.job = job
+        self.ctx.eligibility.set_job(job)
+        self._affinity_cache.clear()
+
+    # -- main entry ------------------------------------------------------
+
+    def select_many(
+        self, tg, requests: List[SelectRequest]
+    ) -> List[Optional[SelectedOption]]:
+        """Place len(requests) allocs of task group tg."""
+        if not requests:
+            return []
+        c = self.cluster
+        snapshot = self.ctx.state
+        k = len(requests)
+        k_pad = pad_steps(k)
+
+        exclude = np.zeros(c.n_pad, bool)
+        results: List[Optional[SelectedOption]] = [None] * k
+        pending = list(range(k))
+        # assigners persist across retry attempts so ports/devices/cores
+        # consumed by already-accepted slots stay consumed
+        assigners: Dict[int, "_NodeAssigner"] = {}
+        # rows of placements accepted in earlier attempts of this call;
+        # their resources are re-applied to rebuilt eval tensors
+        accepted_rows: List[int] = []
+
+        for _attempt in range(3):
+            ev = self._build_eval_tensors(tg, exclude)
+            for row in accepted_rows:
+                self._apply_accepted(ev, row)
+            step_penalty = np.full((k_pad, MAX_PENALTY_NODES), -1, np.int32)
+            step_preferred = np.full(k_pad, -1, np.int32)
+            for slot, ri in enumerate(pending):
+                req = requests[ri]
+                for j, nid in enumerate(req.penalty_nodes[:MAX_PENALTY_NODES]):
+                    row = c.index.get(nid, -1)
+                    step_penalty[slot, j] = row
+                if req.preferred_node:
+                    step_preferred[slot] = c.index.get(req.preferred_node, -1)
+
+            kin = build_kernel_in(c, ev, len(pending), step_penalty, step_preferred)
+            out = place_taskgroup_jit(kin, k_pad)
+            out = KernelOut(*[np.asarray(x) for x in out])
+            self._merge_kernel_metrics(out)
+
+            # exact host-side assignment per chosen node
+            retry: List[int] = []
+            for slot, ri in enumerate(pending):
+                if not out.found[slot]:
+                    results[ri] = None
+                    continue
+                row = int(out.chosen[slot])
+                node = snapshot.node_by_id(c.node_ids[row])
+                if node is None:
+                    exclude[row] = True
+                    retry.append(ri)
+                    continue
+                asg = assigners.get(row)
+                if asg is None:
+                    asg = _NodeAssigner(node, self.ctx)
+                    assigners[row] = asg
+                option = asg.assign(tg, float(out.scores[slot]))
+                if option is None:
+                    # exact assignment failed: mask node, re-run this slot
+                    exclude[row] = True
+                    retry.append(ri)
+                    continue
+                option.metrics = self._metrics_for(out, slot)
+                results[ri] = option
+                accepted_rows.append(row)
+            if not retry:
+                break
+            pending = retry
+        return results
+
+    def _apply_accepted(self, ev: EvalTensors, row: int) -> None:
+        """Re-apply one already-accepted placement's resources to freshly
+        rebuilt eval tensors (retry attempts must not double-book)."""
+        ask = ev.ask
+        ev.used_cpu[row] += ask.cpu
+        ev.used_mem[row] += ask.mem
+        ev.used_disk[row] += ask.disk
+        ev.used_cores[row] += ask.cores
+        ev.used_mbits[row] += ask.total_mbits
+        ev.free_dyn_delta[row] += ask.n_dyn_ports
+        ev.job_tg_count[row] += 1
+        ev.job_any_count[row] += 1
+        ev.dev_free[row] -= ask.dev_counts
+        ev.port_conflict_words[row] |= ask.port_mask
+        for sp in ev.spreads:
+            b = int(sp.bucket_id[row])
+            if b >= 0:
+                sp.counts[b] += 1
+
+    def select(self, tg, request: Optional[SelectRequest] = None) -> Optional[SelectedOption]:
+        """Single-placement compatibility entry (stack.go Select)."""
+        return self.select_many(tg, [request or SelectRequest()])[0]
+
+    # -- tensor builders -------------------------------------------------
+
+    def _build_eval_tensors(self, tg, exclude: np.ndarray) -> EvalTensors:
+        c = self.cluster
+        snapshot = self.ctx.state
+        job = self.job
+        n = c.n_pad
+
+        job_allocs = snapshot.allocs_by_job(job.namespace, job.id)
+        # distinct_hosts/property masks see PROPOSED allocs (feasible.go
+        # uses ctx.ProposedAllocs): exclude plan-staged stops/preemptions,
+        # include plan placements
+        plan = self.ctx.plan
+        staged_out = {
+            a.id
+            for allocs in list(plan.node_update.values())
+            + list(plan.node_preemptions.values())
+            for a in allocs
+        }
+        job_allocs_by_node: Dict[str, List] = {}
+        for a in job_allocs:
+            if a.id in staged_out:
+                continue
+            job_allocs_by_node.setdefault(a.node_id, []).append(a)
+        for allocs in plan.node_allocation.values():
+            for a in allocs:
+                if a.job_id == job.id:
+                    job_allocs_by_node.setdefault(a.node_id, []).append(a)
+
+        base = self._feas.base_mask(job, tg, job_allocs_by_node)
+        base &= ~exclude
+
+        used_cpu = np.zeros(n, np.float32)
+        used_mem = np.zeros(n, np.float32)
+        used_disk = np.zeros(n, np.float32)
+        used_mbits = np.zeros(n, np.int32)
+        avail_mbits = np.zeros(n, np.int32)
+        used_cores = np.zeros(n, np.int32)
+        job_tg_count = np.zeros(n, np.int32)
+        job_any_count = np.zeros(n, np.int32)
+        conflict_words = np.zeros((n, c.port_words.shape[1]), np.uint32)
+        free_dyn_delta = np.zeros(n, np.int32)
+
+        ask = AskTensor.build(tg)
+
+        # proposed utilization per node (context.go ProposedAllocs over
+        # every node)
+        self._accumulate_usage(
+            used_cpu, used_mem, used_disk, used_mbits, used_cores,
+            job_tg_count, job_any_count, conflict_words, free_dyn_delta, tg, ask,
+        )
+        for i in range(c.n_real):
+            node = snapshot.node_by_id(c.node_ids[i])
+            if node is not None:
+                avail_mbits[i] = sum(
+                    net.mbits for net in node.node_resources.networks
+                )
+
+        # device planes
+        dev_free = np.zeros((n, MAX_DEV_REQS), np.float32)
+        dev_aff = np.zeros(n, np.float32)
+        has_dev_aff = False
+        dev_reqs = [d for task in tg.tasks for d in task.resources.devices]
+        if dev_reqs:
+            for i in range(c.n_real):
+                if not base[i]:
+                    continue
+                node = snapshot.node_by_id(c.node_ids[i])
+                if node is None:
+                    continue
+                proposed = self.ctx.proposed_allocs(c.node_ids[i])
+                counts, score, has_aff = device_planes_for_node(node, proposed, dev_reqs)
+                for r, cnt in enumerate(counts[:MAX_DEV_REQS]):
+                    dev_free[i, r] = cnt
+                dev_aff[i] = score
+                has_dev_aff = has_dev_aff or has_aff
+
+        # affinity plane (NodeAffinityIterator rank.go:674)
+        affinities = list(job.affinities) + list(tg.affinities)
+        for task in tg.tasks:
+            affinities.extend(task.affinities)
+        aff_score = np.zeros(n, np.float32)
+        if affinities:
+            sum_weight = sum(abs(float(a.weight)) for a in affinities)
+            cache: Dict[str, float] = {}
+            for i in range(c.n_real):
+                if not base[i]:
+                    continue
+                cls = c.computed_classes[i]
+                if cls in cache and not self.ctx.eligibility.has_escaped():
+                    aff_score[i] = cache[cls]
+                    continue
+                node = snapshot.node_by_id(c.node_ids[i])
+                if node is None:
+                    continue
+                total = sum(
+                    float(a.weight) for a in affinities if matches_affinity(a, node)
+                )
+                score = total / sum_weight if sum_weight else 0.0
+                aff_score[i] = score
+                cache[cls] = score
+
+        spreads = self._build_spreads(tg, job_allocs)
+
+        return EvalTensors(
+            base_mask=base,
+            used_cpu=used_cpu,
+            used_mem=used_mem,
+            used_disk=used_disk,
+            used_mbits=used_mbits,
+            avail_mbits=avail_mbits,
+            used_cores=used_cores,
+            port_conflict_words=conflict_words,
+            free_dyn_delta=free_dyn_delta,
+            dev_free=dev_free,
+            dev_aff_score=dev_aff,
+            has_dev_affinity=has_dev_aff,
+            job_tg_count=job_tg_count,
+            job_any_count=job_any_count,
+            distinct_hosts_job=any(
+                con.operand == consts.CONSTRAINT_DISTINCT_HOSTS
+                for con in job.constraints
+            ),
+            distinct_hosts_tg=any(
+                con.operand == consts.CONSTRAINT_DISTINCT_HOSTS
+                for con in tg.constraints
+            ),
+            penalty=np.zeros(n, bool),
+            aff_score=aff_score,
+            has_affinities=bool(affinities),
+            spreads=spreads,
+            ask=ask,
+            desired_count=tg.count,
+            algorithm=self.ctx.state.scheduler_config.effective_algorithm(),
+        )
+
+    def _accumulate_usage(
+        self, used_cpu, used_mem, used_disk, used_mbits, used_cores,
+        job_tg_count, job_any_count, conflict_words, free_dyn_delta, tg, ask,
+    ) -> None:
+        """Fold proposed allocs (state + in-flight plan) into the planes."""
+        c = self.cluster
+        snapshot = self.ctx.state
+        plan = self.ctx.plan
+        job = self.job
+
+        stopping = {
+            a.id
+            for allocs in list(plan.node_update.values())
+            + list(plan.node_preemptions.values())
+            for a in allocs
+        }
+
+        def add_alloc(a, sign: float) -> None:
+            row = c.index.get(a.node_id)
+            if row is None:
+                return
+            cr = a.comparable_resources()
+            used_cpu[row] += sign * cr.cpu_shares
+            used_mem[row] += sign * cr.memory_mb
+            used_disk[row] += sign * cr.disk_mb
+            used_cores[row] += int(sign) * len(cr.reserved_cores)
+            for net in cr.networks:
+                used_mbits[row] += int(sign) * net.mbits
+            if a.job_id == job.id:
+                job_any_count[row] += int(sign)
+                if a.task_group == tg.name:
+                    job_tg_count[row] += int(sign)
+
+        for a in snapshot.allocs_iter():
+            if a.terminal_status() or a.id in stopping:
+                continue
+            add_alloc(a, 1.0)
+        for allocs in plan.node_allocation.values():
+            for a in allocs:
+                add_alloc(a, 1.0)
+                # in-plan port usage -> conflict words + dyn delta
+                row = c.index.get(a.node_id)
+                if row is None or a.allocated_resources is None:
+                    continue
+                for tr in a.allocated_resources.tasks.values():
+                    for net in tr.networks:
+                        for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                            conflict_words[row, p.value >> 5] |= np.uint32(
+                                1 << (p.value & 31)
+                            )
+                            if 20000 <= p.value <= 32000:
+                                free_dyn_delta[row] += 1
+                for p in a.allocated_resources.shared.ports:
+                    conflict_words[row, p.value >> 5] |= np.uint32(1 << (p.value & 31))
+                    if 20000 <= p.value <= 32000:
+                        free_dyn_delta[row] += 1
+
+    def _build_spreads(self, tg, job_allocs) -> List[SpreadTensor]:
+        """SpreadIterator state -> SpreadTensor list (spread.go:82-113,
+        computeSpreadInfo :245)."""
+        c = self.cluster
+        job = self.job
+        combined = list(tg.spreads) + list(job.spreads)
+        if not combined:
+            return []
+        sum_weights = sum(abs(s.weight) for s in combined)
+        out = []
+        plan_allocs = [
+            a
+            for allocs in self.ctx.plan.node_allocation.values()
+            for a in allocs
+            if a.job_id == job.id and a.task_group == tg.name
+        ]
+        live_allocs = [
+            a
+            for a in job_allocs
+            if not a.terminal_status() and a.task_group == tg.name
+        ] + plan_allocs
+        node_of = {nid: i for i, nid in enumerate(c.node_ids)}
+        for spread in combined:
+            # value table: desired targets first, then observed node values
+            values: Dict[str, int] = {}
+            for t in spread.spread_target:
+                if t.value != "*":
+                    values.setdefault(t.value, len(values))
+            bucket_id = np.full(c.n_pad, -1, np.int32)
+            node_vals: List[Optional[str]] = [None] * c.n_real
+            for i in range(c.n_real):
+                node = self.ctx.state.node_by_id(c.node_ids[i])
+                if node is None:
+                    continue
+                val, ok = resolve_target(spread.attribute, node)
+                if not ok:
+                    continue
+                node_vals[i] = val
+                if val not in values:
+                    if len(values) >= SPREAD_BUCKETS:
+                        continue  # overflow: value scores as missing
+                    values[val] = len(values)
+                bucket_id[i] = values[val]
+            counts = np.zeros(SPREAD_BUCKETS, np.float32)
+            for a in live_allocs:
+                row = node_of.get(a.node_id)
+                if row is None or node_vals[row] is None:
+                    continue
+                b = values.get(node_vals[row])
+                if b is not None:
+                    counts[b] += 1
+            desired = np.full(SPREAD_BUCKETS, -1.0, np.float32)
+            even = not spread.spread_target
+            if not even:
+                total_count = float(tg.count)
+                sum_desired = 0.0
+                implicit_pct = None
+                for t in spread.spread_target:
+                    dc = (float(t.percent) / 100.0) * total_count
+                    if t.value == "*":
+                        implicit_pct = dc
+                        continue
+                    desired[values[t.value]] = dc
+                    sum_desired += dc
+                # implicit remainder target (spread.go:258-262)
+                remainder = total_count - sum_desired
+                if implicit_pct is None and 0 < sum_desired < total_count:
+                    implicit_pct = remainder
+                if implicit_pct is not None:
+                    for v, b in values.items():
+                        if desired[b] < 0:
+                            desired[b] = implicit_pct
+                    # nodes with unseen values also get the implicit target:
+                    # they were added to the table above, so covered.
+            out.append(
+                SpreadTensor(
+                    bucket_id=bucket_id,
+                    counts=counts,
+                    desired=desired,
+                    weight_frac=float(spread.weight) / float(sum_weights) if sum_weights else 0.0,
+                    even=even,
+                )
+            )
+        return out
+
+    def _merge_kernel_metrics(self, out: KernelOut) -> None:
+        """Fold the kernel's mask-population counts into the eval
+        context metrics so failed placements report why (the blocked
+        eval's FailedTGAllocs carries these, eval_endpoint surface)."""
+        m = self.ctx.metrics()
+        m.nodes_evaluated = int(out.nodes_evaluated)
+        m.nodes_exhausted = int(out.nodes_evaluated - out.nodes_feasible)
+        for dim, cnt in (
+            ("cpu", out.exhausted_cpu),
+            ("memory", out.exhausted_mem),
+            ("disk", out.exhausted_disk),
+            ("network: dynamic port selection failed", out.exhausted_ports),
+            ("devices", out.exhausted_devices),
+            ("cores", out.exhausted_cores),
+        ):
+            if int(cnt) > 0:
+                m.dimension_exhausted[dim] = int(cnt)
+
+    def _metrics_for(self, out: KernelOut, slot: int) -> AllocMetric:
+        m = AllocMetric()
+        m.nodes_evaluated = int(out.nodes_evaluated)
+        m.nodes_filtered = self.ctx.metrics().nodes_filtered
+        m.constraint_filtered = dict(self.ctx.metrics().constraint_filtered)
+        m.nodes_exhausted = int(out.nodes_evaluated - out.nodes_feasible)
+        for dim, cnt in (
+            ("cpu", out.exhausted_cpu),
+            ("memory", out.exhausted_mem),
+            ("disk", out.exhausted_disk),
+            ("network: dynamic port selection failed", out.exhausted_ports),
+            ("devices", out.exhausted_devices),
+            ("cores", out.exhausted_cores),
+        ):
+            if int(cnt) > 0:
+                m.dimension_exhausted[dim] = int(cnt)
+        c = self.cluster
+        for j in range(out.topk_idx.shape[1]):
+            score = float(out.topk_scores[slot, j])
+            if score <= NEG_INF / 2:
+                continue
+            row = int(out.topk_idx[slot, j])
+            if row < c.n_real:
+                m.score_meta.append(
+                    (c.node_ids[row], {"normalized-score": score}, score)
+                )
+        return m
+
+
+class _NodeAssigner:
+    """Exact per-node assignment of ports, devices, and cores for one or
+    more placements on the same chosen node (the tail of
+    BinPackIterator.Next, rank.go:280-520, run host-side only for
+    selected nodes)."""
+
+    def __init__(self, node, ctx: EvalContext) -> None:
+        self.node = node
+        self.ctx = ctx
+        proposed = ctx.proposed_allocs(node.id)
+        self.net_idx = NetworkIndex()
+        collide, reason = self.net_idx.set_node(node)
+        self.ok = not collide
+        if self.ok:
+            collide, reason = self.net_idx.add_allocs(proposed)
+            self.ok = not collide
+        if not self.ok:
+            from nomad_tpu.scheduler.context import PortCollisionEvent
+
+            ctx.send_event(PortCollisionEvent(reason, node=node))
+        self.dev_alloc = DeviceAllocator(node)
+        self.dev_alloc.add_allocs(proposed)
+        self.used_cores = set()
+        for a in proposed:
+            self.used_cores |= set(a.comparable_resources().reserved_cores)
+
+    def assign(self, tg, final_score: float) -> Optional[SelectedOption]:
+        if not self.ok:
+            return None
+        task_resources: Dict[str, AllocatedTaskResources] = {}
+        task_lifecycles: Dict[str, Optional[object]] = {}
+        alloc_resources = None
+
+        # group-level networks (rank.go:270-348)
+        if tg.networks:
+            group_ask = tg.networks[0].copy()
+            offer, err = self.net_idx.assign_ports(group_ask)
+            if offer is None:
+                return None
+            self.net_idx.add_reserved_ports(offer)
+            nw = NetworkResource(
+                mode=group_ask.mode,
+                device=(self.node.node_resources.networks[0].device
+                        if self.node.node_resources.networks else ""),
+                ip=(self.node.node_resources.networks[0].ip
+                    if self.node.node_resources.networks else ""),
+                reserved_ports=[p for p in offer],
+            )
+            alloc_resources = AllocatedSharedResources(
+                disk_mb=tg.ephemeral_disk.size_mb,
+                networks=[nw],
+                ports=offer,
+            )
+
+        for task in tg.tasks:
+            r = task.resources
+            tr = AllocatedTaskResources(
+                cpu=AllocatedCpuResources(cpu_shares=int(r.cpu)),
+                memory=AllocatedMemoryResources(memory_mb=int(r.memory_mb)),
+            )
+            # task-level legacy networks (rank.go:363-410)
+            if r.networks:
+                offer, err = self.net_idx.assign_network(r.networks[0])
+                if offer is None:
+                    return None
+                self.net_idx.add_reserved(offer)
+                tr.networks = [offer]
+            # devices (rank.go:413-460)
+            for req in r.devices:
+                offer, _weights, err = self.dev_alloc.assign(req)
+                if offer is None:
+                    return None
+                self.dev_alloc.add_reserved(offer)
+                tr.devices.append(offer)
+            # reserved cores (rank.go:462-492)
+            if r.cores > 0:
+                avail = [
+                    core
+                    for core in self.node.node_resources.cpu.reservable_cpu_cores
+                    if core not in self.used_cores
+                ]
+                if len(avail) < r.cores:
+                    return None
+                tr.cpu.reserved_cores = avail[: r.cores]
+                self.used_cores |= set(tr.cpu.reserved_cores)
+                tr.cpu.cpu_shares = (
+                    self.node.node_resources.cpu.shares_per_core() * r.cores
+                )
+            task_resources[task.name] = tr
+            task_lifecycles[task.name] = task.lifecycle
+
+        return SelectedOption(
+            node_id=self.node.id,
+            node=self.node,
+            final_score=final_score,
+            task_resources=task_resources,
+            task_lifecycles=task_lifecycles,
+            alloc_resources=alloc_resources,
+            metrics=AllocMetric(),
+        )

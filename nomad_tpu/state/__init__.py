@@ -1,0 +1,11 @@
+"""Versioned in-memory state store (reference: nomad/state/state_store.go).
+
+The reference uses go-memdb (immutable radix trees with MVCC snapshots).
+The TPU-native build keeps the same contract -- monotonically indexed
+tables, point-in-time snapshots, watch notification -- with a
+copy-on-write dict implementation plus *incremental tensor maintenance*:
+the store keeps the cluster's scheduling planes (used cpu/mem/disk per
+node) up to date on every alloc write so evaluations never rebuild them.
+"""
+
+from nomad_tpu.state.store import StateStore, StateSnapshot  # noqa: F401

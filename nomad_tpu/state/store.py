@@ -1,0 +1,422 @@
+"""The state store: indexed tables, snapshots, watches, plan application.
+
+Reference behavior: nomad/state/state_store.go (6,611 LoC) -- the subset
+that the scheduler, brokers, and API depend on. Tables mirror
+schema.go:50-72: nodes, jobs, job_version, evals, allocs, deployments,
+index, scheduler_config (plus more added as subsystems land).
+
+Concurrency model: a single writer lock; readers take snapshots
+(shallow table copies -- rows are treated as immutable once inserted;
+all mutation paths copy the row first, matching memdb discipline).
+Watches fire per-table on commit, giving blocking queries the same
+index+watch contract as memdb WatchSets (state_store.go blocking-query
+support, rpc.go:808).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import Allocation
+from nomad_tpu.structs.eval_plan import Deployment, Evaluation, Plan, PlanResult
+
+
+class SchedulerConfiguration:
+    """Runtime-mutable scheduler config (reference structs.go
+    SchedulerConfiguration; stored in raft, schema.go:65)."""
+
+    def __init__(self) -> None:
+        self.scheduler_algorithm = consts.SCHEDULER_ALGORITHM_BINPACK
+        self.preemption_system_enabled = True
+        self.preemption_batch_enabled = False
+        self.preemption_service_enabled = False
+        self.memory_oversubscription_enabled = False
+        self.pause_eval_broker = False
+
+    def effective_algorithm(self) -> str:
+        return self.scheduler_algorithm
+
+    def preemption_enabled(self, scheduler_type: str) -> bool:
+        return {
+            consts.JOB_TYPE_SERVICE: self.preemption_service_enabled,
+            consts.JOB_TYPE_BATCH: self.preemption_batch_enabled,
+            consts.JOB_TYPE_SYSTEM: self.preemption_system_enabled,
+            consts.JOB_TYPE_SYSBATCH: self.preemption_system_enabled,
+        }.get(scheduler_type, False)
+
+
+class StateSnapshot:
+    """A point-in-time read view (memdb Snapshot analog).
+
+    Implements the scheduler's ``State`` interface
+    (reference scheduler/scheduler.go:67-141).
+    """
+
+    def __init__(self, store: "StateStore") -> None:
+        with store._lock:
+            self.index = store._index
+            self._nodes = dict(store._nodes)
+            self._jobs = dict(store._jobs)
+            self._job_versions = dict(store._job_versions)
+            self._evals = dict(store._evals)
+            self._allocs = dict(store._allocs)
+            self._deployments = dict(store._deployments)
+            self._allocs_by_job = {k: set(v) for k, v in store._allocs_by_job.items()}
+            self._allocs_by_node = {k: set(v) for k, v in store._allocs_by_node.items()}
+            self._allocs_by_eval = {k: set(v) for k, v in store._allocs_by_eval.items()}
+            self.scheduler_config = store.scheduler_config
+
+    # --- State interface (scheduler.go:67-141) ---
+
+    def nodes(self) -> List:
+        return list(self._nodes.values())
+
+    def node_by_id(self, node_id: str):
+        return self._nodes.get(node_id)
+
+    def ready_nodes_in_pool(self, pool: str = "default") -> List:
+        return [n for n in self._nodes.values() if n.ready()]
+
+    def job_by_id(self, namespace: str, job_id: str):
+        return self._jobs.get((namespace, job_id))
+
+    def job_by_id_and_version(self, namespace: str, job_id: str, version: int):
+        return self._job_versions.get((namespace, job_id, version))
+
+    def jobs(self) -> List:
+        return list(self._jobs.values())
+
+    def eval_by_id(self, eval_id: str):
+        return self._evals.get(eval_id)
+
+    def allocs_by_job(self, namespace: str, job_id: str, anyCreateIndex: bool = True) -> List[Allocation]:
+        ids = self._allocs_by_job.get((namespace, job_id), ())
+        return [self._allocs[i] for i in ids]
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        ids = self._allocs_by_node.get(node_id, ())
+        return [self._allocs[i] for i in ids]
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id) if a.terminal_status() == terminal]
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        ids = self._allocs_by_eval.get(eval_id, ())
+        return [self._allocs[i] for i in ids]
+
+    def alloc_by_id(self, alloc_id: str):
+        return self._allocs.get(alloc_id)
+
+    def allocs_iter(self):
+        return self._allocs.values()
+
+    def latest_deployment_by_job_id(self, namespace: str, job_id: str):
+        best = None
+        for d in self._deployments.values():
+            if d.namespace == namespace and d.job_id == job_id:
+                if best is None or d.create_index > best.create_index:
+                    best = d
+        return best
+
+    def deployments_by_job_id(self, namespace: str, job_id: str) -> List[Deployment]:
+        return [
+            d for d in self._deployments.values()
+            if d.namespace == namespace and d.job_id == job_id
+        ]
+
+    def deployment_by_id(self, deployment_id: str):
+        return self._deployments.get(deployment_id)
+
+    def latest_index(self) -> int:
+        return self.index
+
+
+class StateStore:
+    """The writable store. One per server; FSM applies Raft entries here."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._index = 0
+        self._nodes: Dict[str, object] = {}
+        self._jobs: Dict[Tuple[str, str], object] = {}
+        self._job_versions: Dict[Tuple[str, str, int], object] = {}
+        self._evals: Dict[str, Evaluation] = {}
+        self._allocs: Dict[str, Allocation] = {}
+        self._deployments: Dict[str, Deployment] = {}
+        self._allocs_by_job: Dict[Tuple[str, str], set] = {}
+        self._allocs_by_node: Dict[str, set] = {}
+        self._allocs_by_eval: Dict[str, set] = {}
+        self.scheduler_config = SchedulerConfiguration()
+        # table name -> [callback(index)]; fired outside the lock
+        self._watchers: Dict[str, List[Callable[[int], None]]] = {}
+
+    # --- infrastructure ---
+
+    def snapshot(self) -> StateSnapshot:
+        return StateSnapshot(self)
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def watch(self, table: str, cb: Callable[[int], None]) -> Callable[[], None]:
+        """Register a commit callback for a table; returns unwatch fn."""
+        with self._lock:
+            self._watchers.setdefault(table, []).append(cb)
+
+        def unwatch() -> None:
+            with self._lock:
+                lst = self._watchers.get(table, [])
+                if cb in lst:
+                    lst.remove(cb)
+
+        return unwatch
+
+    def _notify(self, tables: List[str], index: int) -> None:
+        cbs: List[Callable[[int], None]] = []
+        with self._lock:
+            for t in tables:
+                cbs.extend(self._watchers.get(t, ()))
+        for cb in cbs:
+            cb(index)
+
+    def _next_index(self) -> int:
+        self._index += 1
+        return self._index
+
+    # --- writes (FSM apply targets, fsm.go:194-280 dispatch) ---
+
+    def upsert_node(self, node) -> int:
+        with self._lock:
+            idx = self._next_index()
+            if not node.computed_class:
+                node.compute_class()
+            node.modify_index = idx
+            if node.create_index == 0:
+                node.create_index = idx
+            self._nodes[node.id] = node
+        self._notify(["nodes"], idx)
+        return idx
+
+    def delete_node(self, node_id: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._nodes.pop(node_id, None)
+        self._notify(["nodes"], idx)
+        return idx
+
+    def update_node_status(self, node_id: str, status: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node = node.copy()
+                node.status = status
+                node.modify_index = idx
+                self._nodes[node_id] = node
+        self._notify(["nodes"], idx)
+        return idx
+
+    def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node = node.copy()
+                node.scheduling_eligibility = eligibility
+                node.modify_index = idx
+                self._nodes[node_id] = node
+        self._notify(["nodes"], idx)
+        return idx
+
+    def update_node_drain(self, node_id: str, drain: bool, strategy=None) -> int:
+        with self._lock:
+            idx = self._next_index()
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node = node.copy()
+                node.drain = drain
+                node.drain_strategy = strategy
+                node.scheduling_eligibility = (
+                    consts.NODE_SCHEDULING_INELIGIBLE if drain
+                    else consts.NODE_SCHEDULING_ELIGIBLE
+                )
+                node.modify_index = idx
+                self._nodes[node_id] = node
+        self._notify(["nodes"], idx)
+        return idx
+
+    def upsert_job(self, job) -> int:
+        """UpsertJob: bumps version when the spec changed
+        (state_store.go upsertJobImpl semantics)."""
+        with self._lock:
+            idx = self._next_index()
+            key = (job.namespace, job.id)
+            existing = self._jobs.get(key)
+            if existing is not None:
+                if existing.spec_hash() != job.spec_hash():
+                    job.version = existing.version + 1
+                else:
+                    job.version = existing.version
+                job.create_index = existing.create_index
+            else:
+                job.create_index = idx
+                job.version = 0
+            job.modify_index = idx
+            job.job_modify_index = idx
+            job.status = _job_status(job)
+            self._jobs[key] = job
+            self._job_versions[(job.namespace, job.id, job.version)] = job
+        self._notify(["jobs"], idx)
+        return idx
+
+    def delete_job(self, namespace: str, job_id: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._jobs.pop((namespace, job_id), None)
+        self._notify(["jobs"], idx)
+        return idx
+
+    def upsert_evals(self, evals: List[Evaluation]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for e in evals:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["evals"], idx)
+        return idx
+
+    def delete_evals(self, eval_ids: List[str]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for eid in eval_ids:
+                self._evals.pop(eid, None)
+        self._notify(["evals"], idx)
+        return idx
+
+    def upsert_allocs(self, allocs: List[Allocation]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for a in allocs:
+                self._upsert_alloc_locked(a, idx)
+        self._notify(["allocs"], idx)
+        return idx
+
+    def _upsert_alloc_locked(self, a: Allocation, idx: int) -> None:
+        existing = self._allocs.get(a.id)
+        if existing is not None:
+            # merge client-only fields if this is a server-side update
+            a.create_index = existing.create_index
+            if a.job is None:
+                a.job = existing.job
+        else:
+            a.create_index = idx
+        a.modify_index = idx
+        self._allocs[a.id] = a
+        self._allocs_by_job.setdefault((a.namespace, a.job_id), set()).add(a.id)
+        self._allocs_by_node.setdefault(a.node_id, set()).add(a.id)
+        self._allocs_by_eval.setdefault(a.eval_id, set()).add(a.id)
+
+    def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
+        """Client status updates (state_store.go UpdateAllocsFromClient)."""
+        with self._lock:
+            idx = self._next_index()
+            for update in allocs:
+                existing = self._allocs.get(update.id)
+                if existing is None:
+                    continue
+                new = existing.copy_skip_job()
+                new.client_status = update.client_status
+                new.client_description = update.client_description
+                new.task_states = dict(update.task_states)
+                if update.deployment_status is not None:
+                    new.deployment_status = update.deployment_status
+                if update.network_status is not None:
+                    new.network_status = update.network_status
+                new.modify_index = idx
+                new.modify_time_ns = update.modify_time_ns
+                self._allocs[new.id] = new
+        self._notify(["allocs"], idx)
+        return idx
+
+    def upsert_deployment(self, d: Deployment) -> int:
+        with self._lock:
+            idx = self._next_index()
+            d.modify_index = idx
+            if d.create_index == 0:
+                d.create_index = idx
+            self._deployments[d.id] = d
+        self._notify(["deployment"], idx)
+        return idx
+
+    def update_deployment_status(self, deployment_id: str, status: str, description: str = "") -> int:
+        with self._lock:
+            idx = self._next_index()
+            d = self._deployments.get(deployment_id)
+            if d is not None:
+                d = d.copy()
+                d.status = status
+                d.status_description = description or d.status_description
+                d.modify_index = idx
+                self._deployments[deployment_id] = d
+        self._notify(["deployment"], idx)
+        return idx
+
+    def set_scheduler_config(self, config: SchedulerConfiguration) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self.scheduler_config = config
+        self._notify(["scheduler_config"], idx)
+        return idx
+
+    # --- plan application (FSM ApplyPlanResults, fsm.go applyPlanResults) ---
+
+    def upsert_plan_results(
+        self,
+        alloc_index: int,
+        plan: Plan,
+        node_allocation: Dict[str, List[Allocation]],
+        node_update: Dict[str, List[Allocation]],
+        node_preemptions: Dict[str, List[Allocation]],
+        deployment: Optional[Deployment] = None,
+        deployment_updates: Optional[List[Dict]] = None,
+    ) -> int:
+        """Commit the (possibly partial) plan the applier validated."""
+        with self._lock:
+            idx = self._next_index()
+            for allocs in node_update.values():
+                for a in allocs:
+                    self._upsert_alloc_locked(a, idx)
+            for allocs in node_preemptions.values():
+                for a in allocs:
+                    self._upsert_alloc_locked(a, idx)
+            for allocs in node_allocation.values():
+                for a in allocs:
+                    if a.job is None:
+                        a.job = plan.job
+                    self._upsert_alloc_locked(a, idx)
+            if deployment is not None:
+                deployment.modify_index = idx
+                if deployment.create_index == 0:
+                    deployment.create_index = idx
+                self._deployments[deployment.id] = deployment
+            for du in deployment_updates or []:
+                d = self._deployments.get(du.get("deployment_id"))
+                if d is not None:
+                    d = d.copy()
+                    d.status = du.get("status", d.status)
+                    d.status_description = du.get("status_description", d.status_description)
+                    d.modify_index = idx
+                    self._deployments[d.id] = d
+        self._notify(["allocs", "deployment"], idx)
+        return idx
+
+
+def _job_status(job) -> str:
+    if job.stop:
+        return consts.JOB_STATUS_DEAD
+    return consts.JOB_STATUS_PENDING

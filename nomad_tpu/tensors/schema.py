@@ -227,6 +227,9 @@ class EvalTensors:
     dev_aff_score: np.ndarray        # f32[n_pad]
     has_dev_affinity: bool
     job_tg_count: np.ndarray         # i32[n_pad] same job+tg proposed allocs
+    job_any_count: np.ndarray        # i32[n_pad] job allocs on node (any tg)
+    distinct_hosts_job: bool         # job-level distinct_hosts constraint
+    distinct_hosts_tg: bool          # tg-level distinct_hosts constraint
     penalty: np.ndarray              # bool[n_pad] rescheduling penalty nodes
     aff_score: np.ndarray            # f32[n_pad] normalized affinity score
     has_affinities: bool

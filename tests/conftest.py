@@ -3,7 +3,12 @@
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding
 (`shard_map` over the node axis) is exercised without TPU hardware;
 the driver's dryrun separately validates the real multi-chip path.
-Must set env before jax import.
+
+NOTE: the environment's sitecustomize imports jax at interpreter
+startup (before this file runs), so setting JAX_PLATFORMS via
+os.environ here is too late -- we must also update the live jax
+config. XLA_FLAGS still works because the CPU backend has not been
+initialized yet when conftest runs.
 """
 
 import os
@@ -15,3 +20,7 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

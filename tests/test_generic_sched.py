@@ -1,0 +1,482 @@
+"""GenericScheduler end-to-end tests via the Harness.
+
+Modeled on reference scheduler/generic_sched_test.go (6,715 LoC Go);
+these port its core scenarios: register, scale, update in-place vs
+destructive, failed placement -> blocked eval, drain migration, node
+down rescheduling, stopped job, spread/distinct-hosts placement, and
+the system scheduler.
+"""
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import consts
+
+
+def make_harness(n_nodes=10):
+    h = Harness()
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for n in nodes:
+        h.state.upsert_node(n)
+    return h, nodes
+
+
+def run_eval(h, job, trigger=consts.EVAL_TRIGGER_JOB_REGISTER, sched=None):
+    ev = mock.eval(
+        job_id=job.id,
+        namespace=job.namespace,
+        type=job.type,
+        triggered_by=trigger,
+        priority=job.priority,
+    )
+    h.state.upsert_evals([ev])
+    h.process(sched or job.type, ev)
+    return ev
+
+
+class TestServiceRegister:
+    def test_place_all(self):
+        # generic_sched_test.go TestServiceSched_JobRegister
+        h, nodes = make_harness(10)
+        job = mock.simple_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+
+        assert len(h.plans) == 1
+        placed = h.placed_allocs()
+        assert len(placed) == 10
+        # names are unique indexes [0..9]
+        names = sorted(a.name for a in placed)
+        assert names == sorted(f"{job.id}.web[{i}]" for i in range(10))
+        # allocs landed in state
+        out = h.state.snapshot().allocs_by_job(job.namespace, job.id)
+        assert len(out) == 10
+        # resources recorded
+        for a in placed:
+            assert a.allocated_resources.tasks["web"].cpu.cpu_shares == 500
+            assert a.metrics is not None
+            assert a.metrics.nodes_evaluated > 0
+        # eval marked complete
+        assert h.evals[-1].status == consts.EVAL_STATUS_COMPLETE
+
+    def test_anti_affinity_spreads_allocs(self):
+        h, nodes = make_harness(5)
+        job = mock.simple_job()
+        job.task_groups[0].count = 5
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 5
+        # job anti-affinity should spread 5 allocs across 5 empty nodes
+        assert len({a.node_id for a in placed}) == 5
+
+    def test_ports_assigned(self):
+        h, nodes = make_harness(3)
+        job = mock.job()  # has 2 dynamic ports on the task network
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 10
+        for a in placed:
+            nets = a.allocated_resources.tasks["web"].networks
+            assert len(nets) == 1
+            ports = [p.value for p in nets[0].dynamic_ports]
+            assert len(ports) == 2
+            assert all(20000 <= p <= 32000 for p in ports)
+        # no two allocs on the same node share a port
+        by_node = {}
+        for a in placed:
+            ports = [
+                p.value
+                for p in a.allocated_resources.tasks["web"].networks[0].dynamic_ports
+            ]
+            for p in ports:
+                key = (a.node_id, p)
+                assert key not in by_node, f"port collision {key}"
+                by_node[key] = a.id
+
+    def test_failed_placement_creates_blocked_eval(self):
+        # generic_sched_test.go TestServiceSched_JobRegister_CreateBlockedEval
+        h, _ = make_harness(2)
+        job = mock.simple_job()
+        job.task_groups[0].tasks[0].resources.cpu = 100000  # too big
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        assert len(h.placed_allocs()) == 0
+        assert len(h.create_evals) == 1
+        blocked = h.create_evals[0]
+        assert blocked.status == consts.EVAL_STATUS_BLOCKED
+        assert "web" in blocked.failed_tg_allocs
+        ev = h.evals[-1]
+        assert ev.status == consts.EVAL_STATUS_COMPLETE
+        assert ev.queued_allocations.get("web") == 10
+
+    def test_partial_placement(self):
+        # only some fit -> blocked eval for the rest
+        h, nodes = make_harness(2)
+        job = mock.simple_job()
+        job.task_groups[0].tasks[0].resources.cpu = 3000  # 1 per node fits
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 2
+        assert len(h.create_evals) == 1
+        assert h.evals[-1].queued_allocations.get("web") == 8
+
+    def test_no_nodes(self):
+        h = Harness()
+        job = mock.simple_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        assert len(h.placed_allocs()) == 0
+        assert len(h.create_evals) == 1
+
+    def test_constraint_filters_nodes(self):
+        h, nodes = make_harness(4)
+        windows = mock.node()
+        windows.attributes["kernel.name"] = "windows"
+        windows.compute_class()
+        h.state.upsert_node(windows)
+        job = mock.job()  # constrained to kernel.name = linux
+        job.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 10
+        assert windows.id not in {a.node_id for a in placed}
+
+
+class TestScaling:
+    def _register(self, h, job):
+        h.state.upsert_job(job)
+        run_eval(h, job)
+
+    def test_scale_up(self):
+        h, _ = make_harness(10)
+        job = mock.simple_job()
+        self._register(h, job)
+        assert len(h.placed_allocs()) == 10
+
+        job2 = job.copy()
+        job2.task_groups[0].count = 15
+        h.state.upsert_job(job2)
+        run_eval(h, job2, trigger=consts.EVAL_TRIGGER_SCALING)
+        # second plan: 10 in-place updates (job version bumped) plus
+        # exactly 5 fresh placements with the next indexes
+        plan_allocs = [
+            a for allocs in h.plans[-1].node_allocation.values() for a in allocs
+        ]
+        new = [a for a in plan_allocs if a.index() >= 10]
+        assert len(plan_allocs) == 15
+        assert sorted(a.index() for a in new) == [10, 11, 12, 13, 14]
+
+    def test_scale_down(self):
+        h, _ = make_harness(10)
+        job = mock.simple_job()
+        self._register(h, job)
+        job2 = job.copy()
+        job2.task_groups[0].count = 3
+        h.state.upsert_job(job2)
+        run_eval(h, job2, trigger=consts.EVAL_TRIGGER_SCALING)
+        stops = [a for allocs in h.plans[-1].node_update.values() for a in allocs]
+        assert len(stops) == 7
+        # highest indexes stopped first
+        stopped_idx = sorted(a.index() for a in stops)
+        assert stopped_idx == list(range(3, 10))
+
+    def test_stop_job(self):
+        h, _ = make_harness(5)
+        job = mock.simple_job()
+        self._register(h, job)
+        job2 = job.copy()
+        job2.stop = True
+        h.state.upsert_job(job2)
+        run_eval(h, job2, trigger=consts.EVAL_TRIGGER_JOB_DEREGISTER)
+        stops = [a for allocs in h.plans[-1].node_update.values() for a in allocs]
+        assert len(stops) == 10
+
+
+class TestUpdates:
+    def test_inplace_update(self):
+        # generic_sched_test.go TestServiceSched_JobModify_InPlace
+        h, _ = make_harness(10)
+        job = mock.simple_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+
+        job2 = job.copy()
+        job2.task_groups[0].meta = {"new": "meta"}  # non-destructive change
+        h.state.upsert_job(job2)
+        run_eval(h, job2)
+        plan = h.plans[-1]
+        # in-place: allocs re-appended, nothing stopped
+        assert not plan.node_update
+        updated = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(updated) == 10
+
+    def test_destructive_update(self):
+        # driver change forces destructive update
+        h, _ = make_harness(10)
+        job = mock.simple_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+        h.state.upsert_job(job2)
+        run_eval(h, job2)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        places = [a for allocs in plan.node_allocation.values() for a in allocs]
+        # no update stanza -> all 10 replaced at once
+        assert len(stops) == 10
+        assert len(places) == 10
+
+    def test_destructive_update_respects_max_parallel(self):
+        h, _ = make_harness(10)
+        job = mock.simple_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        # mark existing allocs healthy/running so update pacing applies
+        snap = h.state.snapshot()
+        updates = []
+        for a in snap.allocs_by_job(job.namespace, job.id):
+            b = a.copy_skip_job()
+            b.client_status = consts.ALLOC_CLIENT_RUNNING
+            updates.append(b)
+        h.state.upsert_allocs(updates)
+
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+        job2.task_groups[0].update = structs.UpdateStrategy(max_parallel=3)
+        h.state.upsert_job(job2)
+        run_eval(h, job2)
+        plan = h.plans[-1]
+        places = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(places) == 3  # limited by max_parallel
+        assert plan.deployment is not None
+
+
+class TestNodeFailures:
+    def test_node_drain_migrates(self):
+        # generic_sched_test.go TestServiceSched_NodeDrain
+        h, nodes = make_harness(4)
+        job = mock.simple_job()
+        job.task_groups[0].count = 4
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        victim_alloc = h.placed_allocs()[0]
+        victim_node = victim_alloc.node_id
+
+        h.state.update_node_drain(victim_node, True)
+        # drainer marks allocs for migration
+        snap = h.state.snapshot()
+        migrating = []
+        for a in snap.allocs_by_node(victim_node):
+            b = a.copy_skip_job()
+            b.desired_transition = structs.DesiredTransition(migrate=True)
+            migrating.append(b)
+        h.state.upsert_allocs(migrating)
+
+        run_eval(h, job, trigger=consts.EVAL_TRIGGER_NODE_DRAIN)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        places = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(stops) == len(migrating)
+        assert len(places) == len(migrating)
+        assert all(a.node_id != victim_node for a in places)
+
+    def test_node_down_reschedules(self):
+        h, nodes = make_harness(4)
+        job = mock.simple_job()
+        job.task_groups[0].count = 4
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        victim = h.placed_allocs()[0].node_id
+        n_on_victim = len(h.state.snapshot().allocs_by_node(victim))
+        h.state.update_node_status(victim, consts.NODE_STATUS_DOWN)
+
+        run_eval(h, job, trigger=consts.EVAL_TRIGGER_NODE_UPDATE)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        places = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(stops) == n_on_victim
+        assert all(a.client_status == consts.ALLOC_CLIENT_LOST for a in stops)
+        assert len(places) == n_on_victim
+        assert all(a.node_id != victim for a in places)
+
+
+class TestRescheduling:
+    def test_failed_alloc_rescheduled_with_penalty(self):
+        h, nodes = make_harness(3)
+        job = mock.simple_job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].reschedule_policy = structs.ReschedulePolicy(
+            attempts=3, interval_s=3600, delay_s=0, delay_function="constant"
+        )
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        orig = h.placed_allocs()[0]
+        orig_node = orig.node_id
+
+        failed = orig.copy_skip_job()
+        failed.client_status = consts.ALLOC_CLIENT_FAILED
+        import time
+
+        failed.modify_time_ns = int(time.time() * 1e9)
+        h.state.upsert_allocs([failed])
+
+        run_eval(h, job, trigger=consts.EVAL_TRIGGER_RETRY_FAILED_ALLOC)
+        plan = h.plans[-1]
+        places = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(places) == 1
+        new = places[0]
+        # rescheduled elsewhere (penalty) with tracker chain
+        assert new.node_id != orig_node
+        assert new.previous_allocation == failed.id
+        assert new.reschedule_tracker is not None
+        assert new.reschedule_tracker.events[0].prev_node_id == orig_node
+
+    def test_delayed_reschedule_creates_followup(self):
+        h, nodes = make_harness(3)
+        job = mock.simple_job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].reschedule_policy = structs.ReschedulePolicy(
+            attempts=3, interval_s=3600, delay_s=300, delay_function="constant"
+        )
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        orig = h.placed_allocs()[0]
+        failed = orig.copy_skip_job()
+        failed.client_status = consts.ALLOC_CLIENT_FAILED
+        import time
+
+        failed.modify_time_ns = int(time.time() * 1e9)
+        h.state.upsert_allocs([failed])
+
+        run_eval(h, job, trigger=consts.EVAL_TRIGGER_RETRY_FAILED_ALLOC)
+        # a WaitUntil follow-up eval was created instead of placing now
+        followups = [e for e in h.create_evals if e.wait_until_s > 0]
+        assert len(followups) == 1
+        assert followups[0].wait_until_s > time.time() + 250
+
+
+class TestSpreadAndDistinct:
+    def test_spread_stanza_across_dcs(self):
+        h = Harness()
+        for dc, cnt in (("dc1", 4), ("dc2", 4)):
+            for _ in range(cnt):
+                h.state.upsert_node(mock.node(datacenter=dc))
+        job = mock.simple_job()
+        job.datacenters = ["dc1", "dc2"]
+        job.task_groups[0].count = 6
+        job.task_groups[0].spreads = [
+            structs.Spread(
+                attribute="${node.datacenter}",
+                weight=100,
+                spread_target=[
+                    structs.SpreadTarget(value="dc1", percent=50),
+                    structs.SpreadTarget(value="dc2", percent=50),
+                ],
+            )
+        ]
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 6
+        snap = h.state.snapshot()
+        by_dc = {}
+        for a in placed:
+            dc = snap.node_by_id(a.node_id).datacenter
+            by_dc[dc] = by_dc.get(dc, 0) + 1
+        assert by_dc == {"dc1": 3, "dc2": 3}
+
+    def test_distinct_hosts(self):
+        h, _ = make_harness(4)
+        job = mock.simple_job()
+        job.constraints = [structs.Constraint(operand=consts.CONSTRAINT_DISTINCT_HOSTS)]
+        job.task_groups[0].count = 6
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        # only 4 nodes -> only 4 placements, 2 blocked
+        assert len(placed) == 4
+        assert len({a.node_id for a in placed}) == 4
+        assert len(h.create_evals) == 1
+
+    def test_affinity_prefers_matching_nodes(self):
+        h = Harness()
+        big = [mock.node() for _ in range(2)]
+        for n in big:
+            n.attributes["machine.class"] = "big"
+            n.compute_class()
+            h.state.upsert_node(n)
+        for _ in range(4):
+            n = mock.node()
+            n.attributes["machine.class"] = "small"
+            n.compute_class()
+            h.state.upsert_node(n)
+        job = mock.simple_job()
+        job.task_groups[0].count = 2
+        job.affinities = [
+            structs.Affinity(
+                ltarget="${attr.machine.class}", rtarget="big", operand="=",
+                weight=100,
+            )
+        ]
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        big_ids = {n.id for n in big}
+        assert len(placed) == 2
+        assert all(a.node_id in big_ids for a in placed)
+
+
+class TestSystemSched:
+    def test_system_places_on_all_nodes(self):
+        # scheduler_system_test.go TestSystemSched_JobRegister
+        h, nodes = make_harness(6)
+        job = mock.system_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 6
+        assert len({a.node_id for a in placed}) == 6
+
+    def test_system_skips_ineligible(self):
+        h, nodes = make_harness(4)
+        h.state.update_node_drain(nodes[0].id, True)
+        h.state.update_node_status(nodes[1].id, consts.NODE_STATUS_DOWN)
+        job = mock.system_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 2
+
+    def test_system_stops_on_drained(self):
+        h, nodes = make_harness(3)
+        job = mock.system_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        assert len(h.placed_allocs()) == 3
+        h.state.update_node_drain(nodes[0].id, True)
+        run_eval(h, job, trigger=consts.EVAL_TRIGGER_NODE_UPDATE)
+        stops = [a for allocs in h.plans[-1].node_update.values() for a in allocs]
+        assert len(stops) == 1
+        assert stops[0].node_id == nodes[0].id
+
+
+class TestPlanRejection:
+    def test_reject_then_blocked(self):
+        h, _ = make_harness(2)
+        h.reject_plan = True
+        job = mock.simple_job()
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        # all attempts rejected -> failed status + blocked eval
+        assert h.evals[-1].status == consts.EVAL_STATUS_FAILED
+        assert any(
+            e.triggered_by == consts.EVAL_TRIGGER_MAX_PLAN_ATTEMPTS
+            for e in h.create_evals
+        )

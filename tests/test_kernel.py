@@ -76,6 +76,9 @@ def make_eval(cluster, ask=None, **kw):
         dev_aff_score=kw.get("dev_aff_score", np.zeros(n, np.float32)),
         has_dev_affinity=kw.get("has_dev_affinity", False),
         job_tg_count=kw.get("job_tg_count", np.zeros(n, np.int32)),
+        job_any_count=kw.get("job_any_count", np.zeros(n, np.int32)),
+        distinct_hosts_job=kw.get("distinct_hosts_job", False),
+        distinct_hosts_tg=kw.get("distinct_hosts_tg", False),
         penalty=kw.get("penalty", np.zeros(n, bool)),
         aff_score=kw.get("aff_score", np.zeros(n, np.float32)),
         has_affinities=bool(np.any(kw.get("aff_score", np.zeros(1)) != 0)),
