@@ -196,6 +196,7 @@ def eval(**overrides) -> structs.Evaluation:
         type=consts.JOB_TYPE_SERVICE,
         job_id=_uuid(),
         status=consts.EVAL_STATUS_PENDING,
+        triggered_by=consts.EVAL_TRIGGER_JOB_REGISTER,
     )
     for k, v in overrides.items():
         setattr(e, k, v)

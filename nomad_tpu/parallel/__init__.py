@@ -1,0 +1,31 @@
+"""Multi-chip execution: device meshes and sharded placement kernels.
+
+The reference scales horizontally (SURVEY.md section 2.11): N servers x
+M workers process evaluations concurrently (the data-parallel axis) and
+node-set scaling is handled by class caching + candidate limiting (the
+"long context" axis). The TPU build maps both onto a 2D device mesh:
+
+- ``evals`` axis: independent evaluations batch together and shard
+  across devices (the worker-parallelism analog, dp).
+- ``nodes`` axis: the cluster's node planes shard across devices over
+  ICI (the sequence-parallel analog, sp); global node selection is an
+  XLA collective (all-gather + argmax under GSPMD).
+"""
+
+from nomad_tpu.parallel.mesh import AXIS_EVALS, AXIS_NODES, make_mesh
+from nomad_tpu.parallel.sharded import (
+    batched_in_shardings,
+    batched_out_shardings,
+    make_place_batch,
+    stack_kernel_ins,
+)
+
+__all__ = [
+    "AXIS_EVALS",
+    "AXIS_NODES",
+    "make_mesh",
+    "make_place_batch",
+    "stack_kernel_ins",
+    "batched_in_shardings",
+    "batched_out_shardings",
+]

@@ -1,0 +1,47 @@
+"""Device mesh construction for the scheduler kernel.
+
+Axis semantics (SURVEY.md section 2.10/2.11 TPU mapping):
+
+- ``evals``: data parallelism over independent evaluations (the analog
+  of Nomad's N-servers x M-workers horizontal scheduler parallelism,
+  reference nomad/worker.go:386).
+- ``nodes``: the cluster node axis sharded over ICI (the analog of the
+  10k-node table that reference scheduler/feasible.go iterates; here a
+  tensor axis split across the slice).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_EVALS = "evals"   # dp axis
+AXIS_NODES = "nodes"   # sp/long-context axis
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    evals_parallel: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a 2D (evals, nodes) mesh over the available devices.
+
+    ``evals_parallel`` fixes the dp-axis size; by default it is 2 when
+    the device count is even and >=4 (so both axes are exercised) and
+    1 otherwise.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = n_devices if n_devices is not None else len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    devs = devs[:n]
+    if evals_parallel is None:
+        evals_parallel = 2 if (n % 2 == 0 and n >= 4) else 1
+    if n % evals_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by evals axis {evals_parallel}")
+    nodes_parallel = n // evals_parallel
+    grid = np.asarray(devs).reshape(evals_parallel, nodes_parallel)
+    return Mesh(grid, (AXIS_EVALS, AXIS_NODES))

@@ -1,0 +1,108 @@
+"""Sharded, batched placement: the multi-chip scheduler hot path.
+
+A batch of B independent (evaluation, task group) placement problems —
+each a :class:`~nomad_tpu.ops.kernel.KernelIn` over the same padded
+node axis — runs as ONE ``jit`` over a 2D device mesh:
+
+- every array gains a leading batch dim, sharded over the ``evals``
+  mesh axis (dp: the analog of reference worker parallelism,
+  nomad/worker.go:386);
+- node-axis planes shard over the ``nodes`` mesh axis (sp: the cluster
+  table split across the slice over ICI).
+
+Sharding is GSPMD-style: we annotate in/out shardings on the
+*unmodified* single-problem kernel (vmapped), and XLA inserts the
+collectives — the global ``argmax``/``top_k`` over the sharded node
+axis compiles to an all-gather+reduce riding ICI, which is the tensor
+formulation of the reference's MaxScore/Limit iterators
+(scheduler/select.go) and of the leader's global plan ordering.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nomad_tpu.ops.kernel import KernelIn, KernelOut, place_taskgroup
+from nomad_tpu.parallel.mesh import AXIS_EVALS, AXIS_NODES
+
+_B = AXIS_EVALS
+_N = AXIS_NODES
+
+# PartitionSpec per KernelIn field for the BATCHED layout (leading B dim).
+_IN_SPECS = dict(
+    # [B, N] node planes
+    cap_cpu=P(_B, _N), cap_mem=P(_B, _N), cap_disk=P(_B, _N),
+    free_cores=P(_B, _N), shares_per_core=P(_B, _N), free_dyn=P(_B, _N),
+    base_mask=P(_B, _N), used_cpu=P(_B, _N), used_mem=P(_B, _N),
+    used_disk=P(_B, _N), used_cores=P(_B, _N), used_mbits=P(_B, _N),
+    avail_mbits=P(_B, _N), port_conflict=P(_B, _N),
+    dev_aff_score=P(_B, _N), job_tg_count=P(_B, _N), penalty=P(_B, _N),
+    aff_score=P(_B, _N), job_any_count=P(_B, _N),
+    # [B, N, D]
+    dev_free=P(_B, _N, None),
+    # [B] scalars
+    has_dev_affinity=P(_B), distinct_hosts_job=P(_B), distinct_hosts_tg=P(_B),
+    ask_cpu=P(_B), ask_mem=P(_B), ask_disk=P(_B), ask_cores=P(_B),
+    ask_dyn_ports=P(_B), ask_has_reserved_ports=P(_B), ask_mbits=P(_B),
+    desired_count=P(_B), algorithm_spread=P(_B), n_steps=P(_B),
+    # per-step planes [B, K, ...]
+    step_penalty=P(_B, None, None), step_preferred=P(_B, None),
+    # spreads
+    spread_active=P(_B, None), spread_even=P(_B, None),
+    spread_weight=P(_B, None),
+    spread_bucket=P(_B, None, _N),
+    spread_counts=P(_B, None, None), spread_desired=P(_B, None, None),
+    # [B, D]
+    ask_dev=P(_B, None),
+)
+
+assert set(_IN_SPECS) == set(KernelIn._fields)
+
+
+def batched_in_shardings(mesh: Mesh) -> KernelIn:
+    return KernelIn(**{f: NamedSharding(mesh, s) for f, s in _IN_SPECS.items()})
+
+
+def batched_out_shardings(mesh: Mesh) -> KernelOut:
+    # outputs are small (per-placement rows); shard only the batch axis
+    return KernelOut(
+        **{f: NamedSharding(mesh, P(_B)) for f in KernelOut._fields}
+    )
+
+
+def stack_kernel_ins(kins: Sequence[KernelIn]) -> KernelIn:
+    """Stack B single-problem inputs into one batched KernelIn.
+
+    All problems must share the same padded node axis (the bucketed
+    static shapes from tensors/schema.pad_bucket guarantee few distinct
+    buckets; the broker batches compatible evals together).
+    """
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *kins)
+
+
+def make_place_batch(mesh: Mesh, k_steps: int):
+    """Compile the batched, sharded placement step for ``mesh``.
+
+    Returns ``fn(kin_batched) -> KernelOut`` (batched) — the framework's
+    "training step": one launch schedules a whole batch of evaluations
+    across the slice.
+    """
+    vmapped = jax.vmap(lambda kin: place_taskgroup(kin, k_steps))
+    return jax.jit(
+        vmapped,
+        in_shardings=(batched_in_shardings(mesh),),
+        out_shardings=batched_out_shardings(mesh),
+    )
+
+
+def unstack_kernel_outs(out: KernelOut) -> List[KernelOut]:
+    """Split a batched KernelOut back into per-problem results."""
+    b = out.chosen.shape[0]
+    import numpy as np
+
+    host = KernelOut(*[np.asarray(x) for x in out])
+    return [KernelOut(*[f[i] for f in host]) for i in range(b)]

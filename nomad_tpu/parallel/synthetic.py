@@ -1,0 +1,178 @@
+"""Synthetic placement problems for benchmarks, dryruns, and entry points.
+
+Shapes mirror the reference benchmark grid (scheduler/benchmarks/
+benchmarks_test.go:71-124): mock-node clusters (4000 MHz / 8192 MB,
+mock.go defaults) with rack attributes for spread stanzas, and service
+asks of 500 MHz / 256 MB (mock.Job defaults).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from nomad_tpu.ops.kernel import KernelIn, build_kernel_in
+from nomad_tpu.tensors.schema import (
+    MAX_DEV_REQS,
+    PORT_WORDS,
+    SPREAD_BUCKETS,
+    AskTensor,
+    ClusterTensors,
+    EvalTensors,
+    SpreadTensor,
+    pad_bucket,
+)
+
+
+def synthetic_cluster(
+    n_nodes: int,
+    cpu: float = 4000.0,
+    mem: float = 8192.0,
+    disk: float = 100 * 1024.0,
+    seed: int = 0,
+    n_pad: Optional[int] = None,
+) -> ClusterTensors:
+    """Node planes without the structs round-trip (bench fast path).
+
+    ``n_pad`` overrides the power-of-two bucket when the node axis must
+    divide a non-power-of-two mesh axis (e.g. a 6-device slice).
+    """
+    rng = np.random.default_rng(seed)
+    npad = n_pad if n_pad is not None else pad_bucket(n_nodes)
+    if npad < n_nodes:
+        raise ValueError(f"n_pad {npad} < n_nodes {n_nodes}")
+    ready = np.zeros(npad, bool)
+    ready[:n_nodes] = True
+    cap_cpu = np.zeros(npad, np.float32)
+    cap_mem = np.zeros(npad, np.float32)
+    cap_disk = np.zeros(npad, np.float32)
+    cap_cpu[:n_nodes] = cpu
+    cap_mem[:n_nodes] = mem
+    cap_disk[:n_nodes] = disk
+    free_cores = np.zeros(npad, np.int32)
+    free_cores[:n_nodes] = 4
+    spc = np.zeros(npad, np.float32)
+    spc[:n_nodes] = cpu / 4.0
+    free_dyn = np.zeros(npad, np.int32)
+    free_dyn[:n_nodes] = 12001
+    ids = [f"node-{i:06d}" for i in range(n_nodes)]
+    racks = rng.integers(0, 50, size=n_nodes)
+    return ClusterTensors(
+        n_real=n_nodes,
+        n_pad=npad,
+        node_ids=ids,
+        index={nid: i for i, nid in enumerate(ids)},
+        cap_cpu=cap_cpu,
+        cap_mem=cap_mem,
+        cap_disk=cap_disk,
+        ready=ready,
+        port_words=np.zeros((npad, PORT_WORDS), np.uint32),
+        free_dyn=free_dyn,
+        free_cores=free_cores,
+        shares_per_core=spc,
+        datacenters=[f"dc{r % 3}" for r in racks],
+        node_classes=[""] * n_nodes,
+        computed_classes=[f"rack-{r}" for r in racks],
+        node_pools=["default"] * n_nodes,
+    )
+
+
+def synthetic_eval(
+    cluster: ClusterTensors,
+    ask_cpu: float = 500.0,
+    ask_mem: float = 256.0,
+    ask_disk: float = 150.0,
+    desired_count: int = 10,
+    with_spread: bool = False,
+    used_frac: float = 0.0,
+    seed: int = 0,
+) -> EvalTensors:
+    """One task group's eval planes over ``cluster``.
+
+    ``used_frac`` pre-loads utilization (a partially packed cluster);
+    ``with_spread`` adds one even-spread stanza over the rack attribute
+    (the reference bench's spread configuration).
+    """
+    rng = np.random.default_rng(seed + 1)
+    n = cluster.n_pad
+    ask = AskTensor(
+        cpu=ask_cpu,
+        mem=ask_mem,
+        disk=ask_disk,
+        cores=0,
+        n_dyn_ports=0,
+        reserved_ports=[],
+        port_mask=np.zeros(PORT_WORDS, np.uint32),
+        n_dev_reqs=0,
+        dev_counts=np.zeros(MAX_DEV_REQS, np.int32),
+        total_mbits=0,
+    )
+    used_cpu = np.zeros(n, np.float32)
+    used_mem = np.zeros(n, np.float32)
+    if used_frac > 0.0:
+        used_cpu[: cluster.n_real] = (
+            cluster.cap_cpu[: cluster.n_real]
+            * rng.uniform(0, used_frac, cluster.n_real)
+        ).astype(np.float32)
+        used_mem[: cluster.n_real] = (
+            cluster.cap_mem[: cluster.n_real]
+            * rng.uniform(0, used_frac, cluster.n_real)
+        ).astype(np.float32)
+
+    spreads: List[SpreadTensor] = []
+    if with_spread:
+        bucket_id = np.full(n, -1, np.int32)
+        for i in range(cluster.n_real):
+            rack = int(cluster.computed_classes[i].split("-")[1])
+            bucket_id[i] = rack % SPREAD_BUCKETS
+        spreads.append(
+            SpreadTensor(
+                bucket_id=bucket_id,
+                counts=np.zeros(SPREAD_BUCKETS, np.float32),
+                desired=np.full(SPREAD_BUCKETS, -1.0, np.float32),
+                weight_frac=1.0,
+                even=True,
+            )
+        )
+
+    return EvalTensors(
+        base_mask=cluster.ready.copy(),
+        used_cpu=used_cpu,
+        used_mem=used_mem,
+        used_disk=np.zeros(n, np.float32),
+        used_mbits=np.zeros(n, np.int32),
+        avail_mbits=np.full(n, 1000, np.int32),
+        used_cores=np.zeros(n, np.int32),
+        port_conflict_words=np.zeros((n, PORT_WORDS), np.uint32),
+        free_dyn_delta=np.zeros(n, np.int32),
+        dev_free=np.zeros((n, MAX_DEV_REQS), np.float32),
+        dev_aff_score=np.zeros(n, np.float32),
+        has_dev_affinity=False,
+        job_tg_count=np.zeros(n, np.int32),
+        job_any_count=np.zeros(n, np.int32),
+        distinct_hosts_job=False,
+        distinct_hosts_tg=False,
+        penalty=np.zeros(n, bool),
+        aff_score=np.zeros(n, np.float32),
+        has_affinities=False,
+        spreads=spreads,
+        ask=ask,
+        desired_count=desired_count,
+        algorithm="binpack",
+    )
+
+
+def synthetic_kernel_in(
+    n_nodes: int = 300,
+    n_steps: int = 16,
+    with_spread: bool = False,
+    used_frac: float = 0.5,
+    seed: int = 0,
+    n_pad: Optional[int] = None,
+) -> KernelIn:
+    cluster = synthetic_cluster(n_nodes, seed=seed, n_pad=n_pad)
+    ev = synthetic_eval(
+        cluster, with_spread=with_spread, used_frac=used_frac, seed=seed
+    )
+    return build_kernel_in(cluster, ev, n_steps)

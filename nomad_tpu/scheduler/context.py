@@ -130,4 +130,10 @@ class EvalContext:
         stopping = self.plan.node_update.get(node_id, [])
         preempting = self.plan.node_preemptions.get(node_id, [])
         proposed = remove_allocs(existing, list(stopping) + list(preempting))
-        return proposed + list(self.plan.node_allocation.get(node_id, []))
+        # index by ID so an in-place update (same ID in state and in
+        # plan.node_allocation) overrides instead of double counting
+        # (context.go:193-207)
+        by_id = {a.id: a for a in proposed}
+        for a in self.plan.node_allocation.get(node_id, []):
+            by_id[a.id] = a
+        return list(by_id.values())

@@ -139,7 +139,9 @@ class FeasibilityBuilder:
         return self._class_rows
 
     def eligible_in_dcs(self, datacenters: List[str], node_pool: str = "default") -> np.ndarray:
-        """readyNodesInDCs (util.go:351) as a mask."""
+        """readyNodesInDCs (util.go:351) as a mask; a job's node_pool
+        restricts to matching nodes ('all' is the match-everything
+        pool)."""
         c = self.cluster
         mask = c.ready.copy()
         dcs = set(datacenters)
@@ -148,6 +150,9 @@ class FeasibilityBuilder:
             if c.datacenters[i] not in dcs:
                 if not (wildcard and _dc_glob_match(dcs, c.datacenters[i])):
                     mask[i] = False
+                    continue
+            if node_pool and node_pool != "all" and c.node_pools[i] != node_pool:
+                mask[i] = False
         return mask
 
     def base_mask(self, job, tg, job_allocs_by_node: Dict[str, List]) -> np.ndarray:

@@ -320,13 +320,6 @@ class AllocNameIndex:
             idx = a.index()
             if idx >= 0:
                 self.taken.add(idx)
-        self.duplicates: Dict[int, int] = {}
-        seen = set()
-        for a in in_use.values():
-            idx = a.index()
-            if idx in seen:
-                self.duplicates[idx] = self.duplicates.get(idx, 1) + 1
-            seen.add(idx)
 
     def _name(self, idx: int) -> str:
         return f"{self.job_id}.{self.group}[{idx}]"

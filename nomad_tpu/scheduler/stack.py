@@ -209,9 +209,12 @@ class XLAGenericStack:
             + list(plan.node_preemptions.values())
             for a in allocs
         }
+        staged_in = {
+            a.id for allocs in plan.node_allocation.values() for a in allocs
+        }
         job_allocs_by_node: Dict[str, List] = {}
         for a in job_allocs:
-            if a.id in staged_out:
+            if a.id in staged_out or a.id in staged_in:
                 continue
             job_allocs_by_node.setdefault(a.node_id, []).append(a)
         for allocs in plan.node_allocation.values():
@@ -342,6 +345,11 @@ class XLAGenericStack:
             + list(plan.node_preemptions.values())
             for a in allocs
         }
+        # in-plan placements override same-ID state rows (in-place
+        # updates) rather than double counting (context.go:193-207)
+        planned_ids = {
+            a.id for allocs in plan.node_allocation.values() for a in allocs
+        }
 
         def add_alloc(a, sign: float) -> None:
             row = c.index.get(a.node_id)
@@ -360,7 +368,7 @@ class XLAGenericStack:
                     job_tg_count[row] += int(sign)
 
         for a in snapshot.allocs_iter():
-            if a.terminal_status() or a.id in stopping:
+            if a.terminal_status() or a.id in stopping or a.id in planned_ids:
                 continue
             add_alloc(a, 1.0)
         for allocs in plan.node_allocation.values():

@@ -276,6 +276,13 @@ class StateStore:
         with self._lock:
             idx = self._next_index()
             self._jobs.pop((namespace, job_id), None)
+            # purge version history too (state_store.go DeleteJobTxn
+            # deletes from the job_version table)
+            for key in [
+                k for k in self._job_versions
+                if k[0] == namespace and k[1] == job_id
+            ]:
+                del self._job_versions[key]
         self._notify(["jobs"], idx)
         return idx
 
