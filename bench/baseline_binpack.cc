@@ -1,0 +1,123 @@
+// Sequential binpack baseline: the reference scheduler's per-evaluation
+// hot loop re-expressed in native code (the environment has no Go
+// toolchain, so this C++ stands in for the Go implementation; -O2 C++
+// is at least as fast as the Go original, making the TPU-vs-baseline
+// ratio conservative).
+//
+// Semantics mirrored from the reference (yanc0/nomad):
+//  - shuffleNodes per eval             (scheduler/util.go:464)
+//  - feasibility: cpu/mem/disk fit     (nomad/structs/funcs.go:166 AllocsFit)
+//  - ScoreFitBinPack                   (funcs.go:259: 20 - (10^freeCpu% + 10^freeMem%))
+//  - LimitIterator: visit ceil(log2 n) feasible candidates per placement
+//                                      (scheduler/stack.go:84-91, select.go:5)
+//  - MaxScoreIterator: pick the best visited candidate (select.go:79)
+//  - sequential resource deduction between placements of one task group
+//                                      (scheduler/rank.go proposed-alloc flow)
+//
+// Usage: baseline_binpack <n_nodes> <placements_per_eval> <n_evals> [seed]
+// Prints: {"evals_per_sec": X, "mean_score": Y}
+
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+struct Node {
+  float cap_cpu, cap_mem, cap_disk;
+  float used_cpu, used_mem, used_disk;
+};
+
+static inline uint64_t xorshift(uint64_t &s) {
+  s ^= s << 13;
+  s ^= s >> 7;
+  s ^= s << 17;
+  return s;
+}
+
+int main(int argc, char **argv) {
+  int n = argc > 1 ? atoi(argv[1]) : 10000;
+  int k = argc > 2 ? atoi(argv[2]) : 10;
+  int evals = argc > 3 ? atoi(argv[3]) : 2000;
+  uint64_t seed = argc > 4 ? strtoull(argv[4], nullptr, 10) : 42;
+
+  // mock.Node defaults net of reserved (4000-100 MHz, 8192-256 MB,
+  // (100-4) GB), preloaded to a C2M-style partially packed cluster
+  std::vector<Node> base(n);
+  for (int i = 0; i < n; i++) {
+    base[i].cap_cpu = 3900.0f;
+    base[i].cap_mem = 7936.0f;
+    base[i].cap_disk = 98304.0f;
+    double r1 = (double)(xorshift(seed) % 1000) / 1000.0;
+    double r2 = (double)(xorshift(seed) % 1000) / 1000.0;
+    base[i].used_cpu = (float)(base[i].cap_cpu * 0.6 * r1);
+    base[i].used_mem = (float)(base[i].cap_mem * 0.6 * r2);
+    base[i].used_disk = 150.0f;
+  }
+
+  const float ask_cpu = 500.0f, ask_mem = 256.0f, ask_disk = 150.0f;
+  int limit = (int)std::ceil(std::log2((double)n));
+  if (limit < 2) limit = 2;
+
+  std::vector<int> order(n);
+  for (int i = 0; i < n; i++) order[i] = i;
+
+  std::vector<Node> nodes = base;
+  double score_sum = 0.0;
+  long placed = 0;
+
+  auto t0 = std::chrono::steady_clock::now();
+  for (int e = 0; e < evals; e++) {
+    // each eval schedules against the live cluster state (allocs from
+    // prior evals persist, like the applied plans in the Go bench);
+    // reset utilization periodically so the cluster never saturates
+    if (e % 200 == 0) nodes = base;
+
+    // shuffleNodes (util.go:464): Fisher-Yates over the full node list
+    for (int i = n - 1; i > 0; i--) {
+      int j = (int)(xorshift(seed) % (uint64_t)(i + 1));
+      int tmp = order[i];
+      order[i] = order[j];
+      order[j] = tmp;
+    }
+
+    for (int p = 0; p < k; p++) {
+      int best = -1;
+      float best_score = -1e30f;
+      int visited_feasible = 0;
+      for (int oi = 0; oi < n && visited_feasible < limit; oi++) {
+        Node &nd = nodes[order[oi]];
+        // feasibility chain (AllocsFit funcs.go:166)
+        if (nd.used_cpu + ask_cpu > nd.cap_cpu) continue;
+        if (nd.used_mem + ask_mem > nd.cap_mem) continue;
+        if (nd.used_disk + ask_disk > nd.cap_disk) continue;
+        visited_feasible++;
+        // ScoreFitBinPack (funcs.go:235,259)
+        float free_cpu = 1.0f - (nd.used_cpu + ask_cpu) / nd.cap_cpu;
+        float free_mem = 1.0f - (nd.used_mem + ask_mem) / nd.cap_mem;
+        float total = powf(10.0f, free_cpu) + powf(10.0f, free_mem);
+        float score = 20.0f - total;
+        if (score > 18.0f) score = 18.0f;
+        if (score < 0.0f) score = 0.0f;
+        score /= 18.0f;  // normalization (rank.go:547)
+        if (score > best_score) {
+          best_score = score;
+          best = order[oi];
+        }
+      }
+      if (best >= 0) {
+        nodes[best].used_cpu += ask_cpu;
+        nodes[best].used_mem += ask_mem;
+        nodes[best].used_disk += ask_disk;
+        score_sum += best_score;
+        placed++;
+      }
+    }
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double secs = std::chrono::duration<double>(t1 - t0).count();
+  printf("{\"evals_per_sec\": %.2f, \"mean_score\": %.6f, \"placed\": %ld}\n",
+         evals / secs, placed ? score_sum / placed : 0.0, placed);
+  return 0;
+}

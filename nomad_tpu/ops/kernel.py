@@ -57,6 +57,32 @@ def pad_steps(k: int) -> int:
     return ((k + 4095) // 4096) * 4096
 
 
+class KernelFeatures(NamedTuple):
+    """Static specialization flags (hashable; a jit static argument).
+
+    The reference's iterator pipeline only pays for the checkers a job
+    actually uses (stack.go wires checkers per ask); the tensor
+    formulation gets the same effect by compiling a lean kernel variant
+    per feature combination. Disabling a feature removes its planes
+    from the compiled program entirely; semantics are unchanged because
+    the host only disables features whose inputs are neutral (no ports
+    asked, no spreads, ...).
+    """
+
+    n_spreads: int = MAX_SPREADS
+    with_topk: bool = True        # per-step top-K score metadata (AllocMetric)
+    with_devices: bool = True
+    with_ports: bool = True
+    with_cores: bool = True
+    with_network: bool = True     # bandwidth accounting
+    with_distinct: bool = True    # distinct_hosts masks in the scan
+    with_step_penalties: bool = True  # per-placement penalty node ids
+    with_preferred: bool = True   # per-placement preferred-node pins
+
+
+FULL_FEATURES = KernelFeatures()
+
+
 class KernelIn(NamedTuple):
     """Device-side planes for one (eval, task group). All arrays."""
 
@@ -131,24 +157,55 @@ class KernelOut(NamedTuple):
     exhausted_cores: jnp.ndarray
 
 
-def _feasible(kin: KernelIn, st) -> tuple:
+def _feasible(kin: KernelIn, st, f: KernelFeatures) -> tuple:
     """Resource-fit mask planes for the current carry state."""
+    true_plane = jnp.ones_like(kin.base_mask)
     free_cpu = kin.cap_cpu - st["used_cpu"]
     free_mem = kin.cap_mem - st["used_mem"]
     free_disk = kin.cap_disk - st["used_disk"]
-    ask_cpu_total = kin.ask_cpu + kin.ask_cores.astype(jnp.float32) * kin.shares_per_core
+    # Optional dimensions apply only when the ask requests them — the
+    # reference checks bandwidth/ports/devices/cores inside the assign
+    # paths it only enters for a non-empty ask (rank.go:270-492), so a
+    # node overcommitted on a dimension the ask doesn't use stays
+    # feasible. This also makes the lean variants exactly equivalent.
+    if f.with_cores:
+        ask_cpu_total = (
+            kin.ask_cpu + kin.ask_cores.astype(jnp.float32) * kin.shares_per_core
+        )
+        fit_cores = (kin.ask_cores <= 0) | (
+            (kin.free_cores - st["used_cores"]) >= kin.ask_cores
+        )
+    else:
+        ask_cpu_total = kin.ask_cpu
+        fit_cores = true_plane
     fit_cpu = free_cpu >= ask_cpu_total
     fit_mem = free_mem >= kin.ask_mem
     fit_disk = free_disk >= kin.ask_disk
-    fit_cores = (kin.free_cores - st["used_cores"]) >= kin.ask_cores
-    fit_dyn = st["free_dyn"] >= kin.ask_dyn_ports
-    fit_ports = jnp.logical_and(~st["port_conflict"], fit_dyn)
-    fit_dev = jnp.all(st["dev_free"] >= kin.ask_dev[None, :], axis=1)
-    fit_bw = (st["used_mbits"] + kin.ask_mbits) <= kin.avail_mbits
-    distinct_ok = ~(
-        (kin.distinct_hosts_job & (st["job_any_count"] > 0))
-        | (kin.distinct_hosts_tg & (st["job_tg_count"] > 0))
-    )
+    if f.with_ports:
+        fit_dyn = (kin.ask_dyn_ports <= 0) | (st["free_dyn"] >= kin.ask_dyn_ports)
+        fit_ports = ~(st["port_conflict"] & kin.ask_has_reserved_ports) & fit_dyn
+    else:
+        fit_ports = true_plane
+    if f.with_devices:
+        fit_dev = jnp.all(
+            (kin.ask_dev[None, :] <= 0) | (st["dev_free"] >= kin.ask_dev[None, :]),
+            axis=1,
+        )
+    else:
+        fit_dev = true_plane
+    if f.with_network:
+        fit_bw = (kin.ask_mbits <= 0) | (
+            (st["used_mbits"] + kin.ask_mbits) <= kin.avail_mbits
+        )
+    else:
+        fit_bw = true_plane
+    if f.with_distinct:
+        distinct_ok = ~(
+            (kin.distinct_hosts_job & (st["job_any_count"] > 0))
+            | (kin.distinct_hosts_tg & (st["job_tg_count"] > 0))
+        )
+    else:
+        distinct_ok = true_plane
     feasible = (
         kin.base_mask
         & fit_cpu & fit_mem & fit_disk & fit_cores
@@ -160,7 +217,7 @@ def _feasible(kin: KernelIn, st) -> tuple:
     )
 
 
-def _score(kin: KernelIn, st, ask_cpu_total, penalty) -> tuple:
+def _score(kin: KernelIn, st, ask_cpu_total, penalty, f: KernelFeatures) -> tuple:
     """Score planes + appended-mask normalization (rank.go semantics)."""
     util_cpu = st["used_cpu"] + ask_cpu_total
     util_mem = st["used_mem"] + kin.ask_mem
@@ -180,9 +237,10 @@ def _score(kin: KernelIn, st, ask_cpu_total, penalty) -> tuple:
 
     # device affinity (rank.go:549-554): appended when the ask has device
     # affinities at all
-    dev_on = kin.has_dev_affinity
-    score_sum = score_sum + jnp.where(dev_on, kin.dev_aff_score, 0.0)
-    nplanes = nplanes + jnp.where(dev_on, 1.0, 0.0)
+    if f.with_devices:
+        dev_on = kin.has_dev_affinity
+        score_sum = score_sum + jnp.where(dev_on, kin.dev_aff_score, 0.0)
+        nplanes = nplanes + jnp.where(dev_on, 1.0, 0.0)
 
     # job anti-affinity (rank.go:588-607)
     collisions = st["job_tg_count"].astype(jnp.float32)
@@ -202,20 +260,21 @@ def _score(kin: KernelIn, st, ask_cpu_total, penalty) -> tuple:
     nplanes = nplanes + aff_on.astype(jnp.float32)
 
     # spread (spread.go:116-245)
-    spread_total = _spread_score(kin, st)
-    spread_on = spread_total != 0.0
-    score_sum = score_sum + jnp.where(spread_on, spread_total, 0.0)
-    nplanes = nplanes + spread_on.astype(jnp.float32)
+    if f.n_spreads > 0:
+        spread_total = _spread_score(kin, st, f.n_spreads)
+        spread_on = spread_total != 0.0
+        score_sum = score_sum + jnp.where(spread_on, spread_total, 0.0)
+        nplanes = nplanes + spread_on.astype(jnp.float32)
 
     return score_sum / nplanes
 
 
-def _spread_score(kin: KernelIn, st) -> jnp.ndarray:
+def _spread_score(kin: KernelIn, st, n_spreads: int) -> jnp.ndarray:
     """Sum of per-stanza spread boosts for every node."""
     n = kin.cap_cpu.shape[0]
     total = jnp.zeros(n, jnp.float32)
     counts = st["spread_counts"]  # [S, B]
-    for s in range(MAX_SPREADS):   # static unroll, S is tiny
+    for s in range(n_spreads):     # static unroll, S is tiny
         bucket = kin.spread_bucket[s]            # i32[N], -1 missing
         missing = bucket < 0
         b_safe = jnp.clip(bucket, 0, SPREAD_BUCKETS - 1)
@@ -251,53 +310,72 @@ def _spread_score(kin: KernelIn, st) -> jnp.ndarray:
     return total
 
 
-def place_taskgroup(kin: KernelIn, k_steps: int) -> KernelOut:
+def place_taskgroup(
+    kin: KernelIn, k_steps: int, features: KernelFeatures = FULL_FEATURES
+) -> KernelOut:
     """Place up to ``k_steps`` allocations of one task group.
 
     Each scan step: mask -> score -> argmax -> deduct chosen node's
     planes. Steps past ``kin.n_steps`` are inactive (static padding).
+    ``features`` statically removes planes the ask does not use.
     """
     n = kin.cap_cpu.shape[0]
+    f = features
 
     init = dict(
         used_cpu=kin.used_cpu,
         used_mem=kin.used_mem,
         used_disk=kin.used_disk,
-        used_cores=kin.used_cores,
-        used_mbits=kin.used_mbits,
-        free_dyn=kin.free_dyn,
-        port_conflict=kin.port_conflict,
-        dev_free=kin.dev_free,
         job_tg_count=kin.job_tg_count,
-        job_any_count=kin.job_any_count,
-        spread_counts=kin.spread_counts,
     )
+    if f.with_cores:
+        init["used_cores"] = kin.used_cores
+    if f.with_network:
+        init["used_mbits"] = kin.used_mbits
+    if f.with_ports:
+        init["free_dyn"] = kin.free_dyn
+        init["port_conflict"] = kin.port_conflict
+    if f.with_devices:
+        init["dev_free"] = kin.dev_free
+    if f.with_distinct:
+        init["job_any_count"] = kin.job_any_count
+    if f.n_spreads > 0:
+        init["spread_counts"] = kin.spread_counts
 
     # metrics from the initial state (one extra mask pass, outside scan)
-    feas0, _, dims0 = _feasible(kin, init)
+    feas0, _, dims0 = _feasible(kin, init, f)
     base_i = kin.base_mask
     exhausted = lambda fit: jnp.sum(base_i & ~fit).astype(jnp.int32)  # noqa: E731
 
     iota = jnp.arange(n, dtype=jnp.int32)
 
     def step(st, i):
-        feasible, ask_cpu_total, _ = _feasible(kin, st)
+        feasible, ask_cpu_total, _ = _feasible(kin, st, f)
         # per-step penalty node ids OR'd into the eval-level plane
-        pen_ids = kin.step_penalty[i]                       # i32[P]
-        step_pen = jnp.any(iota[:, None] == pen_ids[None, :], axis=1)
-        penalty = kin.penalty | step_pen
-        final = _score(kin, st, ask_cpu_total, penalty)
+        penalty = kin.penalty
+        if f.with_step_penalties:
+            pen_ids = kin.step_penalty[i]                   # i32[P]
+            step_pen = jnp.any(iota[:, None] == pen_ids[None, :], axis=1)
+            penalty = penalty | step_pen
+        final = _score(kin, st, ask_cpu_total, penalty, f)
         active = i < kin.n_steps
         masked = jnp.where(feasible & active, final, NEG_INF)
         best = jnp.argmax(masked)
         # preferred-node pin: take it when feasible (stack.go preferred-
         # source select), else fall back to the global argmax
-        pref = kin.step_preferred[i]
-        pref_ok = (pref >= 0) & feasible[jnp.clip(pref, 0, n - 1)] & active
-        idx = jnp.where(pref_ok, jnp.clip(pref, 0, n - 1), best)
+        if f.with_preferred:
+            pref = kin.step_preferred[i]
+            pref_ok = (pref >= 0) & feasible[jnp.clip(pref, 0, n - 1)] & active
+            idx = jnp.where(pref_ok, jnp.clip(pref, 0, n - 1), best)
+        else:
+            idx = best
         found = masked[idx] > NEG_INF / 2
 
-        topv, topi = jax.lax.top_k(masked, TOPK)
+        if f.with_topk:
+            topv, topi = jax.lax.top_k(masked, TOPK)
+        else:
+            topv = jnp.full(TOPK, NEG_INF)
+            topi = jnp.zeros(TOPK, jnp.int32)
 
         # deduct the chosen node's planes (only when found & active)
         upd = (found & active).astype(jnp.float32)
@@ -308,17 +386,26 @@ def place_taskgroup(kin: KernelIn, k_steps: int) -> KernelOut:
             used_cpu=st["used_cpu"] + one * ask_cpu_total,
             used_mem=st["used_mem"] + one * kin.ask_mem,
             used_disk=st["used_disk"] + one * kin.ask_disk,
-            used_cores=st["used_cores"] + onei * kin.ask_cores,
-            used_mbits=st["used_mbits"] + onei * kin.ask_mbits,
-            free_dyn=st["free_dyn"] - onei * kin.ask_dyn_ports,
-            # same reserved ports collide on the chosen node next step
-            port_conflict=st["port_conflict"]
-            | ((one > 0) & kin.ask_has_reserved_ports),
-            dev_free=st["dev_free"] - one[:, None] * kin.ask_dev[None, :],
             job_tg_count=st["job_tg_count"] + onei,
-            job_any_count=st["job_any_count"] + onei,
-            spread_counts=_bump_spread(kin, st["spread_counts"], idx, upd),
         )
+        if f.with_cores:
+            st2["used_cores"] = st["used_cores"] + onei * kin.ask_cores
+        if f.with_network:
+            st2["used_mbits"] = st["used_mbits"] + onei * kin.ask_mbits
+        if f.with_ports:
+            st2["free_dyn"] = st["free_dyn"] - onei * kin.ask_dyn_ports
+            # same reserved ports collide on the chosen node next step
+            st2["port_conflict"] = st["port_conflict"] | (
+                (one > 0) & kin.ask_has_reserved_ports
+            )
+        if f.with_devices:
+            st2["dev_free"] = st["dev_free"] - one[:, None] * kin.ask_dev[None, :]
+        if f.with_distinct:
+            st2["job_any_count"] = st["job_any_count"] + onei
+        if f.n_spreads > 0:
+            st2["spread_counts"] = _bump_spread(
+                kin, st["spread_counts"], idx, upd, f.n_spreads
+            )
         out = (
             jnp.where(found, idx, -1).astype(jnp.int32),
             jnp.where(found, masked[idx], 0.0),
@@ -349,10 +436,10 @@ def place_taskgroup(kin: KernelIn, k_steps: int) -> KernelOut:
     )
 
 
-def _bump_spread(kin: KernelIn, counts, idx, upd):
+def _bump_spread(kin: KernelIn, counts, idx, upd, n_spreads: int = MAX_SPREADS):
     """counts[s, bucket_of_chosen] += 1 for active stanzas."""
     bump = jnp.zeros_like(counts)
-    for s in range(MAX_SPREADS):
+    for s in range(n_spreads):
         b = kin.spread_bucket[s][idx]
         valid = (b >= 0) & kin.spread_active[s]
         b_safe = jnp.clip(b, 0, SPREAD_BUCKETS - 1)
@@ -361,7 +448,24 @@ def _bump_spread(kin: KernelIn, counts, idx, upd):
     return counts + bump
 
 
-place_taskgroup_jit = jax.jit(place_taskgroup, static_argnums=(1,))
+place_taskgroup_jit = jax.jit(place_taskgroup, static_argnums=(1, 2))
+
+
+def infer_features(ev, any_penalty: bool = True, any_preferred: bool = True,
+                   with_topk: bool = True) -> KernelFeatures:
+    """Derive the lean static variant for one EvalTensors' ask."""
+    ask = ev.ask
+    return KernelFeatures(
+        n_spreads=len(ev.spreads),
+        with_topk=with_topk,
+        with_devices=bool(ask.n_dev_reqs > 0 or ev.has_dev_affinity),
+        with_ports=bool(ask.n_dyn_ports > 0 or ask.reserved_ports),
+        with_cores=bool(ask.cores > 0),
+        with_network=bool(ask.total_mbits > 0),
+        with_distinct=bool(ev.distinct_hosts_job or ev.distinct_hosts_tg),
+        with_step_penalties=bool(any_penalty),
+        with_preferred=bool(any_preferred),
+    )
 
 
 def build_kernel_in(

@@ -1,0 +1,67 @@
+"""Eval batching: many evaluations, one kernel launch.
+
+This is the TPU-idiomatic throughput path (SURVEY.md section 7 step 5):
+the broker groups compatible evaluations — same cluster snapshot, same
+padded node bucket — and launches them as one batched kernel call. The
+cluster's node planes stay device-resident between launches; only the
+per-eval planes (utilization deltas, eligibility masks, ask scalars)
+cross PCIe per batch, which is what amortizes dispatch overhead over
+the reference's one-eval-at-a-time worker loop (nomad/worker.go:386).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nomad_tpu.ops.kernel import FULL_FEATURES, KernelFeatures, KernelIn, place_taskgroup
+
+
+def device_put_shared(kin: KernelIn) -> KernelIn:
+    """Stage the shared planes on device once."""
+    return jax.tree_util.tree_map(jnp.asarray, kin)
+
+
+def make_schedule_apply_step(k_steps: int, features: KernelFeatures = FULL_FEATURES):
+    """Fused batch-schedule + plan-apply with device-resident state.
+
+    The TPU-native steady-state loop: the cluster's utilization planes
+    live on device and are the carry; a batch of B evaluations is
+    scheduled against that snapshot (optimistic concurrency — evals in
+    a batch do not see each other's placements, exactly like reference
+    workers scheduling against a shared SnapshotMinIndex snapshot,
+    nomad/worker.go:537), then every accepted placement is committed as
+    a scatter-add delta (the plan applier's state update,
+    nomad/plan_apply.go:209, as on-device algebra). Per-batch host
+    traffic is just ask scalars and the result rows.
+
+    Returns fn(shared, used_cpu, used_mem, ask_cpu[B], ask_mem[B],
+    n_steps[B]) -> (KernelOut[B], used_cpu', used_mem').
+    """
+
+    def step(shared: KernelIn, used_cpu, used_mem, ask_cpu, ask_mem, n_steps):
+        def run_one(a_cpu, a_mem, ns):
+            kin = shared._replace(
+                used_cpu=used_cpu,
+                used_mem=used_mem,
+                ask_cpu=a_cpu,
+                ask_mem=a_mem,
+                n_steps=ns,
+            )
+            return place_taskgroup(kin, k_steps, features)
+
+        out = jax.vmap(run_one)(ask_cpu, ask_mem, n_steps)
+
+        # plan apply: scatter the accepted placements into the planes
+        rows = out.chosen.reshape(-1)                       # i32[B*K]
+        ok = out.found.reshape(-1)
+        w_cpu = (jnp.broadcast_to(ask_cpu[:, None], out.chosen.shape)
+                 .reshape(-1) * ok)
+        w_mem = (jnp.broadcast_to(ask_mem[:, None], out.chosen.shape)
+                 .reshape(-1) * ok)
+        safe = jnp.where(ok, rows, 0)
+        used_cpu2 = used_cpu.at[safe].add(jnp.where(ok, w_cpu, 0.0))
+        used_mem2 = used_mem.at[safe].add(jnp.where(ok, w_mem, 0.0))
+        return out, used_cpu2, used_mem2
+
+    return jax.jit(step, donate_argnums=(1, 2))

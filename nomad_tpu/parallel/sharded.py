@@ -26,7 +26,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from nomad_tpu.ops.kernel import KernelIn, KernelOut, place_taskgroup
+from nomad_tpu.ops.kernel import (
+    FULL_FEATURES,
+    KernelFeatures,
+    KernelIn,
+    KernelOut,
+    place_taskgroup,
+)
 from nomad_tpu.parallel.mesh import AXIS_EVALS, AXIS_NODES
 
 _B = AXIS_EVALS
@@ -84,14 +90,16 @@ def stack_kernel_ins(kins: Sequence[KernelIn]) -> KernelIn:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *kins)
 
 
-def make_place_batch(mesh: Mesh, k_steps: int):
+def make_place_batch(
+    mesh: Mesh, k_steps: int, features: KernelFeatures = FULL_FEATURES
+):
     """Compile the batched, sharded placement step for ``mesh``.
 
     Returns ``fn(kin_batched) -> KernelOut`` (batched) — the framework's
     "training step": one launch schedules a whole batch of evaluations
     across the slice.
     """
-    vmapped = jax.vmap(lambda kin: place_taskgroup(kin, k_steps))
+    vmapped = jax.vmap(lambda kin: place_taskgroup(kin, k_steps, features))
     return jax.jit(
         vmapped,
         in_shardings=(batched_in_shardings(mesh),),

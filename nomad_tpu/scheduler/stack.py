@@ -26,6 +26,7 @@ from nomad_tpu.ops.kernel import (
     NEG_INF,
     KernelOut,
     build_kernel_in,
+    infer_features,
     pad_steps,
     place_taskgroup_jit,
 )
@@ -133,7 +134,12 @@ class XLAGenericStack:
                     step_preferred[slot] = c.index.get(req.preferred_node, -1)
 
             kin = build_kernel_in(c, ev, len(pending), step_penalty, step_preferred)
-            out = place_taskgroup_jit(kin, k_pad)
+            features = infer_features(
+                ev,
+                any_penalty=any(requests[ri].penalty_nodes for ri in pending),
+                any_preferred=any(requests[ri].preferred_node for ri in pending),
+            )
+            out = place_taskgroup_jit(kin, k_pad, features)
             out = KernelOut(*[np.asarray(x) for x in out])
             self._merge_kernel_metrics(out)
 

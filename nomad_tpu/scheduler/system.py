@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
-from nomad_tpu.ops.kernel import KernelIn, _feasible, build_kernel_in
+from nomad_tpu.ops.kernel import FULL_FEATURES, KernelIn, _feasible, build_kernel_in
 from nomad_tpu.scheduler.context import EvalContext
 from nomad_tpu.scheduler.scheduler import (
     Planner,
@@ -53,7 +53,7 @@ def _feasible_mask_jit(kin: KernelIn):
         dev_free=kin.dev_free, job_tg_count=kin.job_tg_count,
         job_any_count=kin.job_any_count, spread_counts=kin.spread_counts,
     )
-    feasible, _, dims = _feasible(kin, st)
+    feasible, _, dims = _feasible(kin, st, FULL_FEATURES)
     return feasible, dims
 
 

@@ -457,3 +457,54 @@ class TestStepPadding:
         assert pad_steps(3) == 4
         assert pad_steps(100) == 128
         assert pad_steps(5000) == 8192
+
+
+class TestKernelFeatures:
+    """Static specialization must not change semantics when the
+    disabled features' inputs are neutral."""
+
+    def test_lean_matches_full(self):
+        import numpy as np
+
+        from nomad_tpu.ops.kernel import (
+            FULL_FEATURES,
+            KernelFeatures,
+            KernelOut,
+            place_taskgroup_jit,
+        )
+        from nomad_tpu.parallel.synthetic import synthetic_kernel_in
+
+        kin = synthetic_kernel_in(n_nodes=100, n_steps=8, used_frac=0.5)
+        lean = KernelFeatures(
+            n_spreads=0, with_topk=False, with_devices=False,
+            with_ports=False, with_cores=False, with_network=False,
+            with_distinct=False, with_step_penalties=False,
+            with_preferred=False,
+        )
+        full = KernelOut(*[np.asarray(x) for x in place_taskgroup_jit(kin, 8, FULL_FEATURES)])
+        got = KernelOut(*[np.asarray(x) for x in place_taskgroup_jit(kin, 8, lean)])
+        np.testing.assert_array_equal(got.chosen, full.chosen)
+        np.testing.assert_array_equal(got.found, full.found)
+        np.testing.assert_allclose(got.scores, full.scores, rtol=1e-6)
+
+    def test_spread_specialization(self):
+        import numpy as np
+
+        from nomad_tpu.ops.kernel import (
+            FULL_FEATURES,
+            KernelOut,
+            infer_features,
+            place_taskgroup_jit,
+        )
+        from nomad_tpu.ops.kernel import build_kernel_in
+        from nomad_tpu.parallel.synthetic import synthetic_cluster, synthetic_eval
+
+        cluster = synthetic_cluster(100, seed=3)
+        ev = synthetic_eval(cluster, with_spread=True, used_frac=0.3, seed=3)
+        kin = build_kernel_in(cluster, ev, 8)
+        feats = infer_features(ev)
+        assert feats.n_spreads == 1
+        full = KernelOut(*[np.asarray(x) for x in place_taskgroup_jit(kin, 8, FULL_FEATURES)])
+        got = KernelOut(*[np.asarray(x) for x in place_taskgroup_jit(kin, 8, feats)])
+        np.testing.assert_array_equal(got.chosen, full.chosen)
+        np.testing.assert_allclose(got.scores, full.scores, rtol=1e-6)

@@ -69,3 +69,43 @@ def test_sharded_1d_nodes_only(problems):
     jax.block_until_ready(out)
     found = np.asarray(out.found)
     assert found[:, :n_steps].all()
+
+
+def test_fused_schedule_apply_step():
+    """Device-resident state loop: placements commit as scatter deltas
+    and later batches see them."""
+    import jax.numpy as jnp
+
+    from nomad_tpu.ops.kernel import KernelFeatures, build_kernel_in
+    from nomad_tpu.parallel.batching import (
+        device_put_shared,
+        make_schedule_apply_step,
+    )
+    from nomad_tpu.parallel.synthetic import synthetic_cluster, synthetic_eval
+
+    n_nodes, batch, k = 50, 4, 2
+    cluster = synthetic_cluster(n_nodes, seed=1)
+    ev = synthetic_eval(cluster, desired_count=k, seed=1)
+    shared = device_put_shared(build_kernel_in(cluster, ev, k))
+    lean = KernelFeatures(
+        n_spreads=0, with_topk=False, with_devices=False, with_ports=False,
+        with_cores=False, with_network=False, with_distinct=False,
+        with_step_penalties=False, with_preferred=False,
+    )
+    step = make_schedule_apply_step(k, lean)
+
+    uc = shared.used_cpu
+    um = shared.used_mem
+    ask_cpu = jnp.full(batch, 500.0, jnp.float32)
+    ask_mem = jnp.full(batch, 256.0, jnp.float32)
+    n_steps = jnp.full(batch, k, jnp.int32)
+
+    total_cpu0 = float(uc.sum())
+    out, uc, um = step(shared, uc, um, ask_cpu, ask_mem, n_steps)
+    found = np.asarray(out.found)
+    assert found.all()
+    # every accepted placement committed 500 MHz
+    assert float(uc.sum()) == pytest.approx(total_cpu0 + 500.0 * batch * k)
+    # run again: utilization monotonically grows
+    out2, uc2, um2 = step(shared, uc, um, ask_cpu, ask_mem, n_steps)
+    assert float(uc2.sum()) == pytest.approx(total_cpu0 + 2 * 500.0 * batch * k)
