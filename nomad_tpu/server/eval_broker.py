@@ -1,0 +1,356 @@
+"""EvalBroker: the leader's priority queue of evaluations.
+
+Reference behavior: nomad/eval_broker.go (:47-927). Per-scheduler-type
+ready queues ordered by priority then FIFO; only one eval per job is
+ever outstanding (others wait in a per-job pending heap, promoted on
+Ack); dequeued evals are tracked unacked with a nack timeout; Nack
+re-enqueues with a delay until the delivery limit routes the eval to
+the ``_failed`` queue; WaitUntil evals sit in a delay heap until due.
+
+TPU-native addition: ``dequeue_batch`` returns up to B compatible evals
+in one call so a worker can launch them as one batched kernel
+(SURVEY.md section 7 step 5 -- the key to the throughput target).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Evaluation, generate_uuid
+from nomad_tpu.utils.delayheap import DelayHeap
+
+# Queue that unackable evals land on after the delivery limit
+# (eval_broker.go:21 failedQueue).
+FAILED_QUEUE = "_failed"
+
+DEFAULT_NACK_TIMEOUT = 60.0
+DEFAULT_DELIVERY_LIMIT = 3
+DEFAULT_INITIAL_NACK_DELAY = 1.0
+DEFAULT_SUBSEQUENT_NACK_DELAY = 20.0
+
+
+class _ReadyQueue:
+    """Priority queue: highest priority first, FIFO within priority."""
+
+    def __init__(self) -> None:
+        self._heap: List[Tuple[int, int, Evaluation]] = []
+        self._seq = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(self._heap, (-ev.priority, next(self._seq), ev))
+
+    def peek(self) -> Optional[Evaluation]:
+        return self._heap[0][2] if self._heap else None
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap)[2]
+
+
+class _UnackedEval:
+    def __init__(self, ev: Evaluation, token: str) -> None:
+        self.eval = ev
+        self.token = token
+        self.nack_timer: Optional[threading.Timer] = None
+
+
+class EvalBroker:
+    def __init__(
+        self,
+        nack_timeout: float = DEFAULT_NACK_TIMEOUT,
+        delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+        initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
+        subsequent_nack_delay: float = DEFAULT_SUBSEQUENT_NACK_DELAY,
+    ) -> None:
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self.initial_nack_delay = initial_nack_delay
+        self.subsequent_nack_delay = subsequent_nack_delay
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._enabled = False
+        # scheduler type -> ready queue (eval_broker.go `ready`)
+        self._ready: Dict[str, _ReadyQueue] = {}
+        # eval id -> unacked tracking (eval_broker.go `unack`)
+        self._unack: Dict[str, _UnackedEval] = {}
+        # (ns, job) -> eval id outstanding in broker (`jobEvals` dedup)
+        self._job_evals: Dict[Tuple[str, str], str] = {}
+        # (ns, job) -> pending evals awaiting the outstanding one's Ack
+        # (`pendingEvals` heap per job)
+        self._pending: Dict[Tuple[str, str], List[Tuple[int, int, Evaluation]]] = {}
+        self._pending_seq = itertools.count()
+        # eval id -> nack delivery count (`evals` requeue tracking)
+        self._delivery: Dict[str, int] = {}
+        # eval id -> eval to re-enqueue once the outstanding copy is
+        # acked (eval_broker.go `requeue`: an Enqueue that races with an
+        # unacked delivery of the same eval must not be dropped)
+        self._requeue_on_ack: Dict[str, Evaluation] = {}
+        # WaitUntil evals (eval_broker.go:758 delayedEvalQueue)
+        self._delayed = DelayHeap()
+        self._delay_thread: Optional[threading.Thread] = None
+        self._delay_wake = threading.Event()
+        self.stats_lock = threading.Lock()
+
+    # --- lifecycle (eval_broker.go SetEnabled/Flush) --------------------
+
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            prev, self._enabled = self._enabled, enabled
+        if prev and not enabled:
+            self.flush()
+        if enabled and not prev:
+            self._delay_wake.clear()
+            self._delay_thread = threading.Thread(
+                target=self._run_delayed, daemon=True, name="broker-delayed"
+            )
+            self._delay_thread.start()
+
+    def flush(self) -> None:
+        with self._lock:
+            for un in self._unack.values():
+                if un.nack_timer is not None:
+                    un.nack_timer.cancel()
+            self._ready.clear()
+            self._unack.clear()
+            self._job_evals.clear()
+            self._pending.clear()
+            self._delivery.clear()
+            self._requeue_on_ack.clear()
+            self._delayed = DelayHeap()
+            self._cond.notify_all()
+        self._delay_wake.set()
+
+    # --- enqueue (eval_broker.go:182 Enqueue, :214 processEnqueue) ------
+
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._process_enqueue(ev, "")
+
+    def enqueue_all(self, evals: List[Tuple[Evaluation, str]]) -> None:
+        """[(eval, token)] -- re-enqueue evals a worker still holds
+        (eval_broker.go:190 EnqueueAll: ack-if-held then enqueue)."""
+        with self._lock:
+            for ev, token in evals:
+                un = self._unack.get(ev.id)
+                if un is not None and un.token == token:
+                    self._ack_locked(ev.id)
+                self._process_enqueue(ev, token)
+
+    def _process_enqueue(self, ev: Evaluation, token: str) -> None:
+        if not self._enabled:
+            return
+        if ev.id in self._unack:
+            self._requeue_on_ack[ev.id] = ev
+            return
+        if ev.id in self._delayed:
+            return
+        if ev.wait_until_s and ev.wait_until_s > time.time():
+            self._delayed.push(ev.id, ev.wait_until_s, ev)
+            self._delay_wake.set()
+            return
+        self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        if queue == FAILED_QUEUE:
+            # failed evals bypass per-job dedup entirely: the job may
+            # legitimately have another live eval outstanding
+            self._ready.setdefault(queue, _ReadyQueue()).push(ev)
+            self._cond.notify_all()
+            return
+        ns_job = (ev.namespace, ev.job_id)
+        outstanding = self._job_evals.get(ns_job)
+        if outstanding and outstanding != ev.id:
+            heapq.heappush(
+                self._pending.setdefault(ns_job, []),
+                (-ev.priority, next(self._pending_seq), ev),
+            )
+            return
+        self._job_evals[ns_job] = ev.id
+        self._ready.setdefault(queue, _ReadyQueue()).push(ev)
+        self._cond.notify_all()
+
+    # --- dequeue (eval_broker.go:335 Dequeue) ---------------------------
+
+    def dequeue(
+        self, schedulers: List[str], timeout: Optional[float] = None
+    ) -> Tuple[Optional[Evaluation], str]:
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            while True:
+                ev = self._dequeue_locked(schedulers)
+                if ev is not None:
+                    token = generate_uuid()
+                    un = _UnackedEval(ev, token)
+                    self._unack[ev.id] = un
+                    if self.nack_timeout > 0:
+                        un.nack_timer = threading.Timer(
+                            self.nack_timeout, self.nack, args=(ev.id, token)
+                        )
+                        un.nack_timer.daemon = True
+                        un.nack_timer.start()
+                    return ev, token
+                if not self._enabled:
+                    return None, ""
+                wait = None if deadline is None else deadline - time.time()
+                if wait is not None and wait <= 0:
+                    return None, ""
+                self._cond.wait(wait)
+
+    def dequeue_batch(
+        self, schedulers: List[str], batch: int, timeout: Optional[float] = None
+    ) -> List[Tuple[Evaluation, str]]:
+        """Dequeue up to ``batch`` evals: one blocking dequeue then a
+        non-blocking drain. Batched-kernel feed path."""
+        first, token = self.dequeue(schedulers, timeout)
+        if first is None:
+            return []
+        out = [(first, token)]
+        while len(out) < batch:
+            ev, tok = self.dequeue(schedulers, timeout=0)
+            if ev is None:
+                break
+            out.append((ev, tok))
+        return out
+
+    def _dequeue_locked(self, schedulers: List[str]) -> Optional[Evaluation]:
+        best_q = None
+        best: Optional[Evaluation] = None
+        for s in schedulers:
+            q = self._ready.get(s)
+            if q is None:
+                continue
+            head = q.peek()
+            if head is None:
+                continue
+            if best is None or head.priority > best.priority:
+                best, best_q = head, q
+        if best_q is not None:
+            return best_q.pop()
+        return None
+
+    # --- ack / nack (eval_broker.go:537 Ack, :601 Nack) -----------------
+
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        with self._lock:
+            un = self._unack.get(eval_id)
+            return un.token if un is not None else None
+
+    def outstanding_reset(self, eval_id: str, token: str) -> None:
+        """Reset the nack timer (worker heartbeat during long
+        scheduling; eval_broker.go OutstandingReset)."""
+        with self._lock:
+            un = self._unack.get(eval_id)
+            if un is None or un.token != token:
+                return
+            if un.nack_timer is not None:
+                un.nack_timer.cancel()
+                un.nack_timer = threading.Timer(
+                    self.nack_timeout, self.nack, args=(eval_id, token)
+                )
+                un.nack_timer.daemon = True
+                un.nack_timer.start()
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            un = self._unack.get(eval_id)
+            if un is None:
+                raise ValueError(f"evaluation {eval_id} is not outstanding")
+            if un.token != token:
+                raise ValueError(f"token mismatch for evaluation {eval_id}")
+            self._ack_locked(eval_id)
+
+    def _ack_locked(self, eval_id: str) -> None:
+        un = self._unack.pop(eval_id)
+        if un.nack_timer is not None:
+            un.nack_timer.cancel()
+        self._delivery.pop(eval_id, None)
+        ns_job = (un.eval.namespace, un.eval.job_id)
+        if self._job_evals.get(ns_job) == eval_id:
+            del self._job_evals[ns_job]
+        # promote the highest-priority pending eval for this job
+        pending = self._pending.get(ns_job)
+        if pending:
+            _, _, nxt = heapq.heappop(pending)
+            if not pending:
+                del self._pending[ns_job]
+            self._enqueue_locked(nxt, nxt.type)
+        # an enqueue raced with this delivery: honor it now
+        requeued = self._requeue_on_ack.pop(eval_id, None)
+        if requeued is not None:
+            self._enqueue_locked(requeued, requeued.type)
+
+    def nack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            un = self._unack.get(eval_id)
+            if un is None or un.token != token:
+                return
+            count = self._delivery.get(eval_id, 0) + 1
+            self._ack_locked(eval_id)   # clears delivery tracking too
+            ev = un.eval
+            self._delivery[eval_id] = count
+            if count >= self.delivery_limit:
+                # terminal: route to the failed queue for the leader's
+                # reapFailedEvaluations loop (leader.go:759)
+                self._enqueue_locked(ev, FAILED_QUEUE)
+                return
+            delay = (
+                self.initial_nack_delay
+                if count == 1
+                else self.subsequent_nack_delay
+            )
+            if delay > 0:
+                self._delayed.push(ev.id, time.time() + delay, ev)
+                self._delay_wake.set()
+            else:
+                self._enqueue_locked(ev, ev.type)
+
+    # --- delayed eval loop (eval_broker.go:758 runDelayedEvalsWatcher) --
+
+    def _run_delayed(self) -> None:
+        while True:
+            with self._lock:
+                if not self._enabled:
+                    return
+                due = self._delayed.pop_due(time.time())
+                for _, ev in due:
+                    self._enqueue_locked(ev, ev.type)
+                head = self._delayed.peek()
+            wait = max(head[1] - time.time(), 0.01) if head else 1.0
+            self._delay_wake.wait(wait)
+            self._delay_wake.clear()
+
+    # --- introspection (eval_broker.go:811 Stats) -----------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            by_scheduler = {
+                s: {"ready": len(q), "unacked": 0}
+                for s, q in self._ready.items()
+                if len(q)
+            }
+            for un in self._unack.values():
+                t = un.eval.type
+                by_scheduler.setdefault(t, {"ready": 0, "unacked": 0})
+                by_scheduler[t]["unacked"] += 1
+            return {
+                "total_ready": sum(len(q) for q in self._ready.values()),
+                "total_unacked": len(self._unack),
+                "total_pending": sum(len(p) for p in self._pending.values()),
+                "total_waiting": len(self._delayed),
+                "delayed_evals": len(self._delayed),
+                "by_scheduler": by_scheduler,
+            }

@@ -1,0 +1,223 @@
+"""FSM: the replicated state machine applied at the Raft boundary.
+
+Reference behavior: nomad/fsm.go -- ``nomadFSM.Apply`` dispatches ~45
+message types onto StateStore mutations (fsm.go:194-280) and notifies
+the leader-only subsystems (eval broker, blocked evals) which are
+no-ops on followers because they are disabled there. Every state
+mutation in the server flows through ``FSM.apply`` so that task-2's
+replication layer can ship the same (msg_type, payload) entries through
+a real log.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Evaluation
+
+# Message types (fsm.go MessageType constants)
+NODE_REGISTER = "NodeRegisterRequestType"
+NODE_DEREGISTER = "NodeDeregisterRequestType"
+NODE_UPDATE_STATUS = "NodeUpdateStatusRequestType"
+NODE_UPDATE_DRAIN = "NodeUpdateDrainRequestType"
+NODE_UPDATE_ELIGIBILITY = "NodeUpdateEligibilityRequestType"
+JOB_REGISTER = "JobRegisterRequestType"
+JOB_DEREGISTER = "JobDeregisterRequestType"
+EVAL_UPDATE = "EvalUpdateRequestType"
+EVAL_DELETE = "EvalDeleteRequestType"
+ALLOC_CLIENT_UPDATE = "AllocClientUpdateRequestType"
+ALLOC_UPDATE_DESIRED_TRANSITION = "AllocUpdateDesiredTransitionRequestType"
+ALLOC_STOP = "AllocStopRequestType"
+APPLY_PLAN_RESULTS = "ApplyPlanResultsRequestType"
+DEPLOYMENT_STATUS_UPDATE = "DeploymentStatusUpdateRequestType"
+SCHEDULER_CONFIG = "SchedulerConfigRequestType"
+
+
+class NomadFSM:
+    """Applies committed log entries to the state store."""
+
+    def __init__(self, state_store, eval_broker=None, blocked_evals=None) -> None:
+        self.state = state_store
+        # leader-only subsystems; disabled instances ignore calls
+        self.eval_broker = eval_broker
+        self.blocked_evals = blocked_evals
+        self._lock = threading.Lock()
+
+    def apply(self, msg_type: str, req: Dict) -> int:
+        handler = self._DISPATCH.get(msg_type)
+        if handler is None:
+            raise ValueError(f"unknown FSM message type {msg_type}")
+        with self._lock:
+            return handler(self, req)
+
+    # --- node (fsm.go applyUpsertNode etc.) -----------------------------
+
+    def _apply_node_register(self, req: Dict) -> int:
+        return self.state.upsert_node(req["node"])
+
+    def _apply_node_deregister(self, req: Dict) -> int:
+        return self.state.delete_node(req["node_id"])
+
+    def _apply_node_update_status(self, req: Dict) -> int:
+        return self.state.update_node_status(req["node_id"], req["status"])
+
+    def _apply_node_update_drain(self, req: Dict) -> int:
+        return self.state.update_node_drain(
+            req["node_id"], req["drain"], req.get("strategy")
+        )
+
+    def _apply_node_update_eligibility(self, req: Dict) -> int:
+        return self.state.update_node_eligibility(
+            req["node_id"], req["eligibility"]
+        )
+
+    # --- job ------------------------------------------------------------
+
+    def _apply_job_register(self, req: Dict) -> int:
+        index = self.state.upsert_job(req["job"])
+        for ev in req.get("evals", []):
+            self._upsert_eval(ev, index)
+        return index
+
+    def _apply_job_deregister(self, req: Dict) -> int:
+        ns, job_id = req["namespace"], req["job_id"]
+        if req.get("purge"):
+            index = self.state.delete_job(ns, job_id)
+        else:
+            job = self.state.snapshot().job_by_id(ns, job_id)
+            if job is None:
+                index = self.state.latest_index()
+            else:
+                stopped = job.copy()
+                stopped.stop = True
+                index = self.state.upsert_job(stopped)
+        for ev in req.get("evals", []):
+            self._upsert_eval(ev, index)
+        if self.blocked_evals is not None:
+            self.blocked_evals.untrack(ns, job_id)
+        return index
+
+    # --- evals (fsm.go applyUpdateEval -> upsertEvals) ------------------
+
+    def _apply_eval_update(self, req: Dict) -> int:
+        evals: List[Evaluation] = req["evals"]
+        index = self.state.upsert_evals(evals)
+        for ev in evals:
+            self._eval_notify(ev)
+        return index
+
+    def _upsert_eval(self, ev: Evaluation, index: int) -> None:
+        self.state.upsert_evals([ev])
+        self._eval_notify(ev)
+
+    def _eval_notify(self, ev: Evaluation) -> None:
+        """fsm.go upsertEvals: enqueue pending evals on the leader's
+        broker, track blocked ones, untrack on terminal."""
+        if ev.should_enqueue() and self.eval_broker is not None:
+            self.eval_broker.enqueue(ev)
+        elif ev.should_block() and self.blocked_evals is not None:
+            self.blocked_evals.block(ev)
+        elif (
+            ev.status == consts.EVAL_STATUS_COMPLETE
+            and not ev.failed_tg_allocs
+            and self.blocked_evals is not None
+        ):
+            # fully-successful eval: drop any stale blocked entry for the
+            # job (fsm.go upsertEvals untrack-on-complete; the guard on
+            # failed_tg_allocs keeps the blocked eval the same batch
+            # created)
+            self.blocked_evals.untrack(ev.namespace, ev.job_id)
+
+    def _apply_eval_delete(self, req: Dict) -> int:
+        return self.state.delete_evals(req["eval_ids"])
+
+    # --- allocs ---------------------------------------------------------
+
+    def _apply_alloc_client_update(self, req: Dict) -> int:
+        allocs = req["allocs"]
+        index = self.state.update_allocs_from_client(allocs)
+        for ev in req.get("evals", []):
+            self._upsert_eval(ev, index)
+        # terminal client status frees capacity: unblock by node class
+        # (fsm.go applyAllocClientUpdate -> blockedEvals.Unblock)
+        if self.blocked_evals is not None:
+            snap = self.state.snapshot()
+            for a in allocs:
+                if a.client_terminal_status():
+                    node = snap.node_by_id(a.node_id)
+                    if node is not None:
+                        self.blocked_evals.unblock(node.computed_class, index)
+        return index
+
+    def _apply_alloc_update_desired_transition(self, req: Dict) -> int:
+        index = self.state.update_allocs_desired_transition(
+            req["allocs"], req.get("evals", [])
+        )
+        for ev in req.get("evals", []):
+            self._eval_notify(ev)
+        return index
+
+    def _apply_alloc_stop(self, req: Dict) -> int:
+        index = self.state.stop_alloc(req["alloc_id"], req.get("evals", []))
+        for ev in req.get("evals", []):
+            self._eval_notify(ev)
+        return index
+
+    # --- plan results ---------------------------------------------------
+
+    def _apply_plan_results(self, req: Dict) -> int:
+        index = self.state.upsert_plan_results(
+            req.get("alloc_index", 0),
+            req["plan"],
+            req["node_allocation"],
+            req["node_update"],
+            req["node_preemptions"],
+            req.get("deployment"),
+            req.get("deployment_updates"),
+        )
+        # preempted/stopped allocs free capacity
+        if self.blocked_evals is not None and (
+            req["node_update"] or req["node_preemptions"]
+        ):
+            snap = self.state.snapshot()
+            classes = set()
+            for nid in list(req["node_update"]) + list(req["node_preemptions"]):
+                node = snap.node_by_id(nid)
+                if node is not None:
+                    classes.add(node.computed_class)
+            for cls in classes:
+                self.blocked_evals.unblock(cls, index)
+        return index
+
+    # --- deployment / config --------------------------------------------
+
+    def _apply_deployment_status_update(self, req: Dict) -> int:
+        index = self.state.update_deployment_status(
+            req["deployment_id"], req["status"], req.get("description", "")
+        )
+        for ev in req.get("evals", []):
+            self._upsert_eval(ev, index)
+        return index
+
+    def _apply_scheduler_config(self, req: Dict) -> int:
+        return self.state.set_scheduler_config(req["config"])
+
+    _DISPATCH = {
+        NODE_REGISTER: _apply_node_register,
+        NODE_DEREGISTER: _apply_node_deregister,
+        NODE_UPDATE_STATUS: _apply_node_update_status,
+        NODE_UPDATE_DRAIN: _apply_node_update_drain,
+        NODE_UPDATE_ELIGIBILITY: _apply_node_update_eligibility,
+        JOB_REGISTER: _apply_job_register,
+        JOB_DEREGISTER: _apply_job_deregister,
+        EVAL_UPDATE: _apply_eval_update,
+        EVAL_DELETE: _apply_eval_delete,
+        ALLOC_CLIENT_UPDATE: _apply_alloc_client_update,
+        ALLOC_UPDATE_DESIRED_TRANSITION: _apply_alloc_update_desired_transition,
+        ALLOC_STOP: _apply_alloc_stop,
+        APPLY_PLAN_RESULTS: _apply_plan_results,
+        DEPLOYMENT_STATUS_UPDATE: _apply_deployment_status_update,
+        SCHEDULER_CONFIG: _apply_scheduler_config,
+    }

@@ -1,0 +1,71 @@
+"""Node heartbeat tracking on the leader.
+
+Reference behavior: nomad/heartbeat.go (:34-260). The leader arms a TTL
+timer per node; a client heartbeat (Node.UpdateStatus) resets it; expiry
+marks the node down through the Raft boundary, which triggers
+node-update evaluations so the scheduler reschedules the node's allocs
+(reconcile marks them lost/disconnecting).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Dict
+
+
+class HeartbeatTimers:
+    def __init__(
+        self,
+        on_expire: Callable[[str], None],
+        ttl: float = 10.0,
+        ttl_jitter: float = 0.1,
+    ) -> None:
+        self._on_expire = on_expire
+        self.ttl = ttl
+        self.ttl_jitter = ttl_jitter
+        self._lock = threading.Lock()
+        self._timers: Dict[str, threading.Timer] = {}
+        self._enabled = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                for t in self._timers.values():
+                    t.cancel()
+                self._timers.clear()
+
+    def reset(self, node_id: str) -> float:
+        """Arm/re-arm the node's TTL; returns the granted TTL
+        (heartbeat.go:56 resetHeartbeatTimer). Jitter decorrelates
+        thundering-herd heartbeats after a leader transition."""
+        ttl = self.ttl * (1.0 + random.random() * self.ttl_jitter)
+        with self._lock:
+            if not self._enabled:
+                return ttl
+            old = self._timers.pop(node_id, None)
+            if old is not None:
+                old.cancel()
+            timer = threading.Timer(ttl, self._expire, args=(node_id,))
+            timer.daemon = True
+            self._timers[node_id] = timer
+            timer.start()
+        return ttl
+
+    def clear(self, node_id: str) -> None:
+        with self._lock:
+            old = self._timers.pop(node_id, None)
+            if old is not None:
+                old.cancel()
+
+    def _expire(self, node_id: str) -> None:
+        with self._lock:
+            self._timers.pop(node_id, None)
+            if not self._enabled:
+                return
+        self._on_expire(node_id)
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._timers)

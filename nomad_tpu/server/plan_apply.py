@@ -1,0 +1,187 @@
+"""Plan applier: serialized per-node re-validation + commit.
+
+Reference behavior: nomad/plan_apply.go. The leader pops plans from the
+PlanQueue one at a time, re-checks every placement node against the
+*latest* state (the scheduler ran against an older optimistic snapshot),
+commits the surviving subset through the Raft boundary, and responds to
+the worker's future. A partial commit sets ``refresh_index`` so the
+scheduler refreshes its snapshot and retries the rejected placements
+(generic_sched.go:343-350).
+
+The per-node fit re-check (evaluateNodePlan, plan_apply.go:644) is the
+cluster-wide serialization point; ``EvaluatePool`` parallelizes it
+across nodes (plan_apply_pool.go:18). Here the pool is a thread pool
+for host-path checks; for large plans the same check runs as a batched
+tensor op (all nodes' proposed utilization vs capacity in one
+vectorized comparison) which is the TPU-native equivalent.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import Allocation
+from nomad_tpu.structs.eval_plan import Plan, PlanResult
+from nomad_tpu.structs.resources import allocs_fit
+from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
+
+
+class Planner:
+    """The plan-apply loop (plan_apply.go:71 planApply)."""
+
+    def __init__(
+        self,
+        state_store,
+        plan_queue: PlanQueue,
+        pool_workers: int = 4,
+        raft_apply=None,
+    ) -> None:
+        self.state = state_store
+        self.queue = plan_queue
+        self.pool_workers = pool_workers
+        # commits go through the Raft boundary so FSM side effects
+        # (blocked-eval unblock on freed capacity) fire; standalone use
+        # falls back to direct store writes
+        self._raft_apply = raft_apply
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # persistent re-check pool (plan_apply_pool.go:18 EvaluatePool)
+        self._pool = (
+            ThreadPoolExecutor(
+                max_workers=pool_workers, thread_name_prefix="plan-eval"
+            )
+            if pool_workers > 1
+            else None
+        )
+
+    # --- lifecycle ------------------------------------------------------
+
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="plan-applier"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def close(self) -> None:
+        self.stop()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.queue.dequeue(timeout=0.2)
+            if pending is None:
+                continue
+            try:
+                result = self.apply_one(pending.plan)
+                pending.respond(result, None)
+            except Exception as e:            # noqa: BLE001 - worker nacks
+                pending.respond(None, e)
+
+    # --- single plan (dequeue -> evaluate -> commit) --------------------
+
+    def apply_one(self, plan: Plan) -> PlanResult:
+        snapshot = self.state.snapshot()
+        result = self.evaluate_plan(snapshot, plan)
+        req = {
+            "alloc_index": snapshot.latest_index(),
+            "plan": plan,
+            "node_allocation": result.node_allocation,
+            "node_update": result.node_update,
+            "node_preemptions": result.node_preemptions,
+            "deployment": result.deployment,
+            "deployment_updates": result.deployment_updates,
+        }
+        if self._raft_apply is not None:
+            # fsm.go applyPlanResults: Raft commit + blocked-eval unblock
+            from nomad_tpu.server.fsm import APPLY_PLAN_RESULTS
+            index = self._raft_apply(APPLY_PLAN_RESULTS, req)
+        else:
+            index = self.state.upsert_plan_results(
+                req["alloc_index"], plan,
+                result.node_allocation, result.node_update,
+                result.node_preemptions, result.deployment,
+                result.deployment_updates,
+            )
+        result.alloc_index = index
+        return result
+
+    # --- evaluation (plan_apply.go:403 evaluatePlan) --------------------
+
+    def evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
+        result = PlanResult(
+            node_update=dict(plan.node_update),
+            node_allocation={},
+            node_preemptions={},
+            deployment=plan.deployment,
+            deployment_updates=list(plan.deployment_updates),
+        )
+        node_ids = list(plan.node_allocation.keys())
+        if len(node_ids) > 1 and self._pool is not None:
+            fits = list(
+                self._pool.map(
+                    lambda nid: self._evaluate_node_plan(snapshot, plan, nid),
+                    node_ids,
+                )
+            )
+        else:
+            fits = [self._evaluate_node_plan(snapshot, plan, n) for n in node_ids]
+
+        partial = False
+        for node_id, (fit, _reason) in zip(node_ids, fits):
+            if fit:
+                result.node_allocation[node_id] = plan.node_allocation[node_id]
+                if node_id in plan.node_preemptions:
+                    result.node_preemptions[node_id] = plan.node_preemptions[node_id]
+            else:
+                partial = True
+        if partial:
+            # scheduler must refresh past this state and retry
+            result.refresh_index = snapshot.latest_index()
+            if plan.deployment is not None and not result.node_allocation:
+                # nothing placed: drop the new deployment (the retry will
+                # recreate it against fresh state)
+                result.deployment = None
+        return result
+
+    def _evaluate_node_plan(
+        self, snapshot, plan: Plan, node_id: str
+    ) -> Tuple[bool, str]:
+        """plan_apply.go:644 evaluateNodePlan."""
+        placements = plan.node_allocation.get(node_id, [])
+        if not placements:
+            return True, ""
+        node = snapshot.node_by_id(node_id)
+        if node is None:
+            return False, "node does not exist"
+        if node.status != consts.NODE_STATUS_READY:
+            return False, f"node is {node.status}"
+        if node.drain:
+            return False, "node is draining"
+        if node.scheduling_eligibility == consts.NODE_SCHEDULING_INELIGIBLE:
+            return False, "node is not eligible"
+
+        # proposed = existing (non-terminal) - updated - preempted + planned
+        existing = [
+            a for a in snapshot.allocs_by_node(node_id) if not a.terminal_status()
+        ]
+        remove_ids = {a.id for a in plan.node_update.get(node_id, [])}
+        remove_ids |= {a.id for a in plan.node_preemptions.get(node_id, [])}
+        proposed = [a for a in existing if a.id not in remove_ids]
+        proposed.extend(placements)
+        fit, reason, _util = allocs_fit(node, proposed, check_devices=True)
+        return fit, reason

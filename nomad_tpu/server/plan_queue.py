@@ -1,0 +1,87 @@
+"""PlanQueue: the leader's serialized queue of submitted plans.
+
+Reference behavior: nomad/plan_queue.go (:30-259). Workers submit plans
+with a future; the single plan-applier goroutine pops them in priority
+order (then FIFO) and resolves the future with the PlanResult after
+Raft commit. Serialization here is what makes optimistic scheduler
+concurrency safe.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.structs.eval_plan import Plan, PlanResult
+
+
+class PendingPlan:
+    def __init__(self, plan: Plan) -> None:
+        self.plan = plan
+        self._done = threading.Event()
+        self._result: Optional[PlanResult] = None
+        self._error: Optional[Exception] = None
+
+    # future (plan_queue.go planFuture)
+    def respond(self, result: Optional[PlanResult], err: Optional[Exception]) -> None:
+        self._result = result
+        self._error = err
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> PlanResult:
+        if not self._done.wait(timeout):
+            raise TimeoutError("plan result timeout")
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+class PlanQueue:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._enabled = False
+        self._heap: List[Tuple[int, int, PendingPlan]] = []
+        self._seq = itertools.count()
+
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            prev, self._enabled = self._enabled, enabled
+            if prev and not enabled:
+                self._flush_locked()
+            self._cond.notify_all()
+
+    def _flush_locked(self) -> None:
+        for _, _, pending in self._heap:
+            pending.respond(None, RuntimeError("plan queue flushed"))
+        self._heap.clear()
+
+    def enqueue(self, plan: Plan) -> PendingPlan:
+        with self._lock:
+            if not self._enabled:
+                raise RuntimeError("plan queue is disabled")
+            pending = PendingPlan(plan)
+            heapq.heappush(
+                self._heap, (-plan.priority, next(self._seq), pending)
+            )
+            self._cond.notify_all()
+            return pending
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        with self._lock:
+            if not self._heap:
+                self._cond.wait(timeout)
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"depth": len(self._heap)}

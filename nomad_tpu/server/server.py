@@ -1,0 +1,472 @@
+"""The Server: broker + planner + workers + heartbeats + leadership.
+
+Reference behavior: nomad/server.go (Server struct :97-260, NewServer
+:294), nomad/leader.go (establishLeadership :277-404), and the endpoint
+semantics of nomad/job_endpoint.go, node_endpoint.go, eval_endpoint.go,
+plan_endpoint.go. Single-process mode: ``raft_apply`` goes straight to
+the FSM; the replication layer (task: control plane) swaps in a real
+log without changing any caller.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from nomad_tpu.server import fsm as fsm_msgs
+from nomad_tpu.server.blocked_evals import BlockedEvals
+from nomad_tpu.server.eval_broker import FAILED_QUEUE, EvalBroker
+from nomad_tpu.server.fsm import NomadFSM
+from nomad_tpu.server.heartbeat import HeartbeatTimers
+from nomad_tpu.server.plan_apply import Planner
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.server.worker import Worker
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Evaluation, Plan, PlanResult
+
+LOG = logging.getLogger(__name__)
+
+
+class ServerConfig:
+    def __init__(
+        self,
+        num_workers: int = 2,
+        worker_batch_size: int = 1,
+        heartbeat_ttl: float = 10.0,
+        nack_timeout: float = 60.0,
+        eval_delivery_limit: int = 3,
+        failed_eval_follow_up_wait: float = 60.0,
+        plan_pool_workers: int = 4,
+        region: str = "global",
+        datacenter: str = "dc1",
+        name: str = "server-1",
+    ) -> None:
+        self.num_workers = num_workers
+        self.worker_batch_size = worker_batch_size
+        self.heartbeat_ttl = heartbeat_ttl
+        self.nack_timeout = nack_timeout
+        self.eval_delivery_limit = eval_delivery_limit
+        self.failed_eval_follow_up_wait = failed_eval_follow_up_wait
+        self.plan_pool_workers = plan_pool_workers
+        self.region = region
+        self.datacenter = datacenter
+        self.name = name
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None) -> None:
+        self.config = config or ServerConfig()
+        self.state = StateStore()
+        self.eval_broker = EvalBroker(
+            nack_timeout=self.config.nack_timeout,
+            delivery_limit=self.config.eval_delivery_limit,
+        )
+        self.blocked_evals = BlockedEvals(self.eval_broker.enqueue)
+        self.fsm = NomadFSM(self.state, self.eval_broker, self.blocked_evals)
+        self.plan_queue = PlanQueue()
+        self.planner = Planner(
+            self.state, self.plan_queue, self.config.plan_pool_workers,
+            raft_apply=self.raft_apply,
+        )
+        self.heartbeats = HeartbeatTimers(
+            self._on_heartbeat_expire, ttl=self.config.heartbeat_ttl
+        )
+        self.workers: List[Worker] = [
+            Worker(self, i, batch_size=self.config.worker_batch_size)
+            for i in range(self.config.num_workers)
+        ]
+        self._leader = False
+        self._shutdown = threading.Event()
+        self._leader_threads: List[threading.Thread] = []
+        # core scheduler factory, installed by nomad_tpu.server.core_sched
+        self._core_scheduler_factory = None
+
+    # --- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        """Single-server mode: become leader immediately and start
+        workers (server.go NewServer + monitorLeadership)."""
+        self._shutdown.clear()
+        self.establish_leadership()
+        for w in self.workers:
+            w.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for w in self.workers:
+            w.stop()
+        self.revoke_leadership()
+        self.planner.close()
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def establish_leadership(self) -> None:
+        """leader.go:277 establishLeadership: enable the leader-only
+        subsystems and restore broker/blocked state from the store."""
+        self._leader = True
+        self.plan_queue.set_enabled(True)
+        self.planner.start()
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.heartbeats.set_enabled(True)
+        self._restore_evals()
+        self._init_heartbeats()
+        for w in self.workers:
+            w.set_pause(False)
+        for name, fn, interval in (
+            ("reap-failed-evals", self.reap_failed_evals_once, 0.2),
+            ("reap-dup-blocked", self.reap_dup_blocked_once, 0.2),
+        ):
+            t = threading.Thread(
+                target=self._leader_loop, args=(fn, interval),
+                daemon=True, name=name,
+            )
+            self._leader_threads.append(t)
+            t.start()
+
+    def revoke_leadership(self) -> None:
+        """leader.go revokeLeadership."""
+        self._leader = False
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+        self.planner.stop()
+        self.heartbeats.set_enabled(False)
+        for w in self.workers:
+            w.set_pause(True)
+        self._leader_threads.clear()
+
+    def _leader_loop(self, fn, interval: float) -> None:
+        while self._leader and not self._shutdown.is_set():
+            try:
+                fn()
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("leader loop %s: %s", fn.__name__, e)
+            self._shutdown.wait(interval)
+
+    def _restore_evals(self) -> None:
+        """leader.go:430 restoreEvals: re-seed broker/blocked from the
+        replicated state after a leadership transition."""
+        snap = self.state.snapshot()
+        for ev in snap.evals_iter():
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def _init_heartbeats(self) -> None:
+        """heartbeat.go initializeHeartbeatTimers."""
+        for node in self.state.snapshot().nodes():
+            if node.terminal_status():
+                continue
+            self.heartbeats.reset(node.id)
+
+    # --- raft boundary --------------------------------------------------
+
+    def raft_apply(self, msg_type: str, req: Dict) -> int:
+        """rpc.go:750 raftApply. Single-process: direct FSM apply."""
+        return self.fsm.apply(msg_type, req)
+
+    def snapshot_min_index(self, index: int, timeout: float = 5.0):
+        """worker.go:537 SnapshotMinIndex: wait for local state to reach
+        `index` then snapshot. Immediate in single-process mode."""
+        deadline = time.time() + timeout
+        while self.state.latest_index() < index:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"state index {self.state.latest_index()} < {index}"
+                )
+            time.sleep(0.001)
+        return self.state.snapshot()
+
+    # --- Job endpoint (nomad/job_endpoint.go) ---------------------------
+
+    def job_register(self, job) -> Dict:
+        """Job.Register: validate, commit, create+enqueue an eval."""
+        warnings = job.validate() if hasattr(job, "validate") else []
+        evals = []
+        if job.type != consts.JOB_TYPE_CORE and not job.is_periodic() \
+                and not job.is_parameterized():
+            evals.append(
+                Evaluation(
+                    namespace=job.namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=consts.EVAL_TRIGGER_JOB_REGISTER,
+                    job_id=job.id,
+                    status=consts.EVAL_STATUS_PENDING,
+                )
+            )
+        index = self.raft_apply(
+            fsm_msgs.JOB_REGISTER, {"job": job, "evals": evals}
+        )
+        return {
+            "eval_id": evals[0].id if evals else "",
+            "index": index,
+            "warnings": warnings,
+        }
+
+    def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> Dict:
+        snap = self.state.snapshot()
+        job = snap.job_by_id(namespace, job_id)
+        evals = []
+        if job is not None and job.type != consts.JOB_TYPE_CORE:
+            evals.append(
+                Evaluation(
+                    namespace=namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=consts.EVAL_TRIGGER_JOB_DEREGISTER,
+                    job_id=job_id,
+                    status=consts.EVAL_STATUS_PENDING,
+                )
+            )
+        index = self.raft_apply(
+            fsm_msgs.JOB_DEREGISTER,
+            {"namespace": namespace, "job_id": job_id, "purge": purge,
+             "evals": evals},
+        )
+        return {"eval_id": evals[0].id if evals else "", "index": index}
+
+    # --- Node endpoint (nomad/node_endpoint.go) -------------------------
+
+    def node_register(self, node) -> Dict:
+        snap = self.state.snapshot()
+        existing = snap.node_by_id(node.id)
+        index = self.raft_apply(fsm_msgs.NODE_REGISTER, {"node": node})
+        ttl = self.heartbeats.reset(node.id)
+        transitioned = existing is None or existing.status != node.status
+        if transitioned and node.status == consts.NODE_STATUS_READY:
+            self.blocked_evals.unblock(node.computed_class, index)
+            self._create_node_evals(node.id, index)
+        return {"heartbeat_ttl": ttl, "index": index}
+
+    def node_update_status(self, node_id: str, status: str) -> Dict:
+        """Heartbeat + status transitions (node_endpoint.go UpdateStatus)."""
+        snap = self.state.snapshot()
+        node = snap.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"unknown node {node_id}")
+        index = snap.latest_index()
+        if node.status != status:
+            index = self.raft_apply(
+                fsm_msgs.NODE_UPDATE_STATUS,
+                {"node_id": node_id, "status": status},
+            )
+            self._create_node_evals(node_id, index)
+            if status == consts.NODE_STATUS_READY:
+                self.blocked_evals.unblock(node.computed_class, index)
+        ttl = 0.0
+        if status != consts.NODE_STATUS_DOWN:
+            ttl = self.heartbeats.reset(node_id)
+        else:
+            self.heartbeats.clear(node_id)
+        return {"heartbeat_ttl": ttl, "index": index}
+
+    def node_update_drain(self, node_id: str, drain: bool, strategy=None) -> int:
+        index = self.raft_apply(
+            fsm_msgs.NODE_UPDATE_DRAIN,
+            {"node_id": node_id, "drain": drain, "strategy": strategy},
+        )
+        self._create_node_evals(node_id, index, consts.EVAL_TRIGGER_NODE_DRAIN)
+        return index
+
+    def node_update_eligibility(self, node_id: str, eligibility: str) -> int:
+        snap = self.state.snapshot()
+        node = snap.node_by_id(node_id)
+        index = self.raft_apply(
+            fsm_msgs.NODE_UPDATE_ELIGIBILITY,
+            {"node_id": node_id, "eligibility": eligibility},
+        )
+        if (
+            node is not None
+            and eligibility == consts.NODE_SCHEDULING_ELIGIBLE
+        ):
+            self.blocked_evals.unblock(node.computed_class, index)
+        return index
+
+    def node_heartbeat(self, node_id: str, status: str) -> Dict:
+        return self.node_update_status(node_id, status)
+
+    def _on_heartbeat_expire(self, node_id: str) -> None:
+        """heartbeat.go invalidateHeartbeat: TTL missed => node down."""
+        LOG.info("heartbeat missed for node %s: marking down", node_id)
+        try:
+            index = self.raft_apply(
+                fsm_msgs.NODE_UPDATE_STATUS,
+                {"node_id": node_id, "status": consts.NODE_STATUS_DOWN},
+            )
+            self._create_node_evals(node_id, index)
+        except Exception as e:                  # noqa: BLE001
+            LOG.warning("failed to invalidate heartbeat for %s: %s", node_id, e)
+
+    def _create_node_evals(
+        self, node_id: str, index: int, trigger: str = consts.EVAL_TRIGGER_NODE_UPDATE
+    ) -> List[str]:
+        """node_endpoint.go:1606 createNodeEvals: one eval per job with a
+        non-terminal alloc on the node, plus every system job."""
+        snap = self.state.snapshot()
+        evals: List[Evaluation] = []
+        seen = set()
+        for alloc in snap.allocs_by_node(node_id):
+            if alloc.terminal_status() or alloc.job is None:
+                continue
+            key = (alloc.namespace, alloc.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            evals.append(
+                Evaluation(
+                    namespace=alloc.namespace,
+                    priority=alloc.job.priority,
+                    type=alloc.job.type,
+                    triggered_by=trigger,
+                    job_id=alloc.job_id,
+                    node_id=node_id,
+                    node_modify_index=index,
+                    status=consts.EVAL_STATUS_PENDING,
+                )
+            )
+        for job in snap.jobs():
+            if job.type != consts.JOB_TYPE_SYSTEM or job.stop:
+                continue
+            key = (job.namespace, job.id)
+            if key in seen:
+                continue
+            seen.add(key)
+            evals.append(
+                Evaluation(
+                    namespace=job.namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=trigger,
+                    job_id=job.id,
+                    node_id=node_id,
+                    node_modify_index=index,
+                    status=consts.EVAL_STATUS_PENDING,
+                )
+            )
+        if evals:
+            self.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": evals})
+        return [e.id for e in evals]
+
+    def update_allocs_from_client(self, allocs: List) -> int:
+        """Node.UpdateAlloc: client status batch + reschedule evals for
+        failures (node_endpoint.go:1155)."""
+        snap = self.state.snapshot()
+        evals: List[Evaluation] = []
+        seen = set()
+        for a in allocs:
+            existing = snap.alloc_by_id(a.id)
+            if existing is None or existing.job is None:
+                continue
+            failed = a.client_status == consts.ALLOC_CLIENT_FAILED
+            if not failed:
+                continue
+            key = (existing.namespace, existing.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            evals.append(
+                Evaluation(
+                    namespace=existing.namespace,
+                    priority=existing.job.priority,
+                    type=existing.job.type,
+                    triggered_by=consts.EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+                    job_id=existing.job_id,
+                    status=consts.EVAL_STATUS_PENDING,
+                )
+            )
+        return self.raft_apply(
+            fsm_msgs.ALLOC_CLIENT_UPDATE, {"allocs": allocs, "evals": evals}
+        )
+
+    # --- Eval endpoint (worker-facing; nomad/eval_endpoint.go) ----------
+
+    def update_eval(self, ev: Evaluation, token: str = "") -> int:
+        return self.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": [ev]})
+
+    def create_eval(self, ev: Evaluation, token: str = "") -> int:
+        return self.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": [ev]})
+
+    def reblock_eval(self, ev: Evaluation, token: str = "") -> int:
+        """Eval.Reblock: the worker re-blocks an eval it still holds."""
+        outstanding = self.eval_broker.outstanding(ev.id)
+        if outstanding is None:
+            raise ValueError(f"evaluation {ev.id} is not outstanding")
+        if token and outstanding != token:
+            raise ValueError(f"token mismatch for evaluation {ev.id}")
+        return self.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": [ev]})
+
+    # --- Plan endpoint (nomad/plan_endpoint.go) -------------------------
+
+    def submit_plan(self, plan: Plan) -> PlanResult:
+        if self.planner.running():
+            pending = self.plan_queue.enqueue(plan)
+            return pending.wait(timeout=30.0)
+        # synchronous mode (tests without the applier thread)
+        return self.planner.apply_one(plan)
+
+    # --- core scheduler hook (GC; nomad/core_sched.go) ------------------
+
+    def new_core_scheduler(self, snapshot, planner):
+        if self._core_scheduler_factory is None:
+            raise ValueError("core scheduler not installed")
+        return self._core_scheduler_factory(snapshot, planner, self)
+
+    # --- leader reaping loops (leader.go:759, :795) ---------------------
+
+    def reap_failed_evals_once(self) -> int:
+        """Dequeue from the _failed queue, mark failed, create a delayed
+        follow-up eval (leader.go reapFailedEvaluations)."""
+        n = 0
+        while True:
+            ev, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout=0)
+            if ev is None:
+                return n
+            updated = ev.copy()
+            updated.status = consts.EVAL_STATUS_FAILED
+            updated.status_description = (
+                f"evaluation reached delivery limit "
+                f"({self.config.eval_delivery_limit})"
+            )
+            follow_up = updated.create_failed_follow_up_eval(
+                self.config.failed_eval_follow_up_wait
+            )
+            self.raft_apply(
+                fsm_msgs.EVAL_UPDATE, {"evals": [updated, follow_up]}
+            )
+            self.eval_broker.ack(ev.id, token)
+            n += 1
+
+    def reap_dup_blocked_once(self) -> int:
+        """Cancel duplicate blocked evals (leader.go
+        reapDupBlockedEvaluations)."""
+        dups = self.blocked_evals.get_duplicates(timeout=0.0)
+        if not dups:
+            return 0
+        updated = []
+        for ev in dups:
+            new = ev.copy()
+            new.status = consts.EVAL_STATUS_CANCELLED
+            new.status_description = "existing blocked evaluation exists for this job"
+            updated.append(new)
+        self.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": updated})
+        return len(updated)
+
+    # --- introspection --------------------------------------------------
+
+    def stats(self) -> Dict:
+        return {
+            "leader": self._leader,
+            "broker": self.eval_broker.stats(),
+            "blocked": self.blocked_evals.stats(),
+            "plan_queue": self.plan_queue.stats(),
+            "heartbeats": self.heartbeats.count(),
+            "workers": len(self.workers),
+            "state_index": self.state.latest_index(),
+        }

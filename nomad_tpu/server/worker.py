@@ -1,0 +1,167 @@
+"""Scheduler workers: dequeue -> snapshot -> schedule -> submit -> ack.
+
+Reference behavior: nomad/worker.go (:86-846). Each server runs N
+workers (default = #cores). A worker dequeues an evaluation from the
+broker, waits for its local state to catch up to the eval's index
+(SnapshotMinIndex, worker.go:537), instantiates the scheduler for the
+eval type against that immutable snapshot, and acts as the scheduler's
+``Planner``: SubmitPlan routes to the leader's plan queue and returns a
+refreshed snapshot on partial commit; Create/Update/ReblockEval route
+through the Raft boundary (here: the server's apply path).
+
+TPU-native addition: a worker can dequeue a *batch* of evals and
+process them back-to-back against one device-resident snapshot --
+the eval-batching throughput path (SURVEY.md section 7 step 5).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Tuple
+
+from nomad_tpu.scheduler.scheduler import SetStatusError, new_scheduler
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Evaluation, Plan, PlanResult
+
+LOG = logging.getLogger(__name__)
+
+# Queues a worker services (worker.go:60 area -- all builtin types plus
+# the core GC scheduler).
+DEFAULT_SCHEDULERS = [
+    consts.JOB_TYPE_SERVICE,
+    consts.JOB_TYPE_BATCH,
+    consts.JOB_TYPE_SYSTEM,
+    consts.JOB_TYPE_SYSBATCH,
+    consts.JOB_TYPE_CORE,
+]
+
+
+class Worker:
+    def __init__(
+        self,
+        server,
+        worker_id: int = 0,
+        schedulers: Optional[List[str]] = None,
+        batch_size: int = 1,
+    ) -> None:
+        self.server = server
+        self.id = worker_id
+        self.schedulers = schedulers or list(DEFAULT_SCHEDULERS)
+        self.batch_size = batch_size
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._pause = threading.Event()
+        self.processed = 0
+        self.last_error: Optional[str] = None
+
+        # current eval context (set while scheduling; used by Planner calls)
+        self._eval: Optional[Evaluation] = None
+        self._token: str = ""
+        self._snapshot = None
+
+    # --- lifecycle (worker.go run/pause) --------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"worker-{self.id}"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def set_pause(self, paused: bool) -> None:
+        """Leadership-change pause (leader.go:496 handlePausableWorkers)."""
+        if paused:
+            self._pause.set()
+        else:
+            self._pause.clear()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._pause.is_set():
+                self._stop.wait(0.05)
+                continue
+            self.run_once(timeout=0.2)
+
+    # --- one dequeue->process->ack cycle --------------------------------
+
+    def run_once(self, timeout: Optional[float] = 0.0) -> bool:
+        """Process up to batch_size evals; returns True if any ran."""
+        batch = self.server.eval_broker.dequeue_batch(
+            self.schedulers, self.batch_size, timeout
+        )
+        if not batch:
+            return False
+        for ev, token in batch:
+            self._process(ev, token)
+        return True
+
+    def _process(self, ev: Evaluation, token: str) -> None:
+        try:
+            # SnapshotMinIndex: local raft must catch up to the eval
+            # before scheduling (worker.go:537)
+            wait_index = max(ev.modify_index, ev.snapshot_index)
+            self._snapshot = self.server.snapshot_min_index(wait_index)
+            # stamp the snapshot the scheduler runs against on a copy --
+            # the store's row must stay immutable (worker.go
+            # updateEvalSnapshotIndex routes this through Raft); blocked
+            # evals derived from this one inherit the stamp
+            ev = ev.copy()
+            ev.snapshot_index = self._snapshot.latest_index()
+            self._eval = ev
+            self._token = token
+            if ev.type == consts.JOB_TYPE_CORE:
+                sched = self.server.new_core_scheduler(self._snapshot, self)
+            else:
+                sched = new_scheduler(ev.type, self._snapshot, self)
+            sched.process(ev)
+            self.server.eval_broker.ack(ev.id, token)
+            self.processed += 1
+        except Exception as e:                      # noqa: BLE001
+            import traceback
+            self.last_error = traceback.format_exc()
+            LOG.warning("worker %d: eval %s failed: %s", self.id, ev.id, e)
+            try:
+                self.server.eval_broker.nack(ev.id, token)
+            except Exception:                       # noqa: BLE001
+                pass
+        finally:
+            self._eval = None
+            self._token = ""
+            self._snapshot = None
+
+    # --- Planner interface (worker.go:593 SubmitPlan etc.) --------------
+
+    def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
+        plan.eval_id = self._eval.id if self._eval is not None else plan.eval_id
+        plan.eval_token = self._token
+        plan.snapshot_index = (
+            self._snapshot.latest_index() if self._snapshot is not None else 0
+        )
+        result = self.server.submit_plan(plan)
+        state = None
+        if result is not None and result.refresh_index > 0:
+            # partial commit: hand the scheduler a newer snapshot to
+            # retry against (worker.go:631-646)
+            state = self.server.snapshot_min_index(result.refresh_index)
+        return result, state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.update_eval(ev, token=self._token)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        if self._eval is not None:
+            ev.previous_eval = self._eval.id
+        self.server.create_eval(ev, token=self._token)
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.reblock_eval(ev, token=self._token)
+
+    def serve_rs_meet_minimum_version(self) -> bool:
+        return True

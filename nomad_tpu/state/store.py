@@ -91,6 +91,15 @@ class StateSnapshot:
     def eval_by_id(self, eval_id: str):
         return self._evals.get(eval_id)
 
+    def evals_iter(self):
+        return self._evals.values()
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return [
+            e for e in self._evals.values()
+            if e.namespace == namespace and e.job_id == job_id
+        ]
+
     def allocs_by_job(self, namespace: str, job_id: str, anyCreateIndex: bool = True) -> List[Allocation]:
         ids = self._allocs_by_job.get((namespace, job_id), ())
         return [self._allocs[i] for i in ids]
@@ -348,6 +357,46 @@ class StateStore:
                 new.modify_time_ns = update.modify_time_ns
                 self._allocs[new.id] = new
         self._notify(["allocs"], idx)
+        return idx
+
+    def update_allocs_desired_transition(self, transitions: Dict[str, object], evals: List[Evaluation]) -> int:
+        """{alloc_id: DesiredTransition} -- drainer/operator migrate
+        requests (state_store.go UpdateAllocsDesiredTransitions)."""
+        with self._lock:
+            idx = self._next_index()
+            for alloc_id, transition in transitions.items():
+                existing = self._allocs.get(alloc_id)
+                if existing is None:
+                    continue
+                new = existing.copy_skip_job()
+                new.desired_transition = transition
+                new.modify_index = idx
+                self._allocs[alloc_id] = new
+            for e in evals:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["allocs", "evals"], idx)
+        return idx
+
+    def stop_alloc(self, alloc_id: str, evals: List[Evaluation]) -> int:
+        """Mark one alloc desired=stop (`nomad alloc stop`;
+        state_store.go UpdateAllocDesiredTransition + stop)."""
+        with self._lock:
+            idx = self._next_index()
+            existing = self._allocs.get(alloc_id)
+            if existing is not None:
+                new = existing.copy_skip_job()
+                new.desired_status = consts.ALLOC_DESIRED_STOP
+                new.modify_index = idx
+                self._allocs[alloc_id] = new
+            for e in evals:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["allocs", "evals"], idx)
         return idx
 
     def upsert_deployment(self, d: Deployment) -> int:

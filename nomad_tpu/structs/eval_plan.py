@@ -7,6 +7,7 @@ Plan (:11120), PlanResult (:11375), Deployment/DeploymentState.
 from __future__ import annotations
 
 import copy as _copy
+import time as _time
 import uuid as _uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -99,9 +100,15 @@ class Evaluation:
             escaped_computed_class=escaped,
             quota_limit_reached=quota_reached,
             failed_tg_allocs=dict(failed_tg_allocs or {}),
+            # inherited so BlockedEvals' missed-unblock check compares
+            # against the snapshot this eval was actually scheduled from
+            snapshot_index=self.snapshot_index,
         )
 
     def create_failed_follow_up_eval(self, wait_s: float) -> "Evaluation":
+        """``wait_s`` is a delay from now; wait_until_s stores absolute
+        epoch seconds (structs.go CreateFailedFollowUpEval uses
+        now.Add(wait))."""
         return Evaluation(
             namespace=self.namespace,
             priority=self.priority,
@@ -109,7 +116,7 @@ class Evaluation:
             triggered_by="failed-follow-up",
             job_id=self.job_id,
             status=EVAL_STATUS_PENDING,
-            wait_until_s=wait_s,
+            wait_until_s=_time.time() + wait_s,
             previous_eval=self.id,
         )
 

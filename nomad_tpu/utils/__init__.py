@@ -1,0 +1,6 @@
+"""Small self-contained libraries (reference lib/ and helper/)."""
+
+from nomad_tpu.utils.delayheap import DelayHeap
+from nomad_tpu.utils.kheap import ScoreHeap
+
+__all__ = ["DelayHeap", "ScoreHeap"]

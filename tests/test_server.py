@@ -1,0 +1,384 @@
+"""Server runtime tests: broker, blocked evals, planner, workers.
+
+Modeled on reference nomad/eval_broker_test.go, blocked_evals_test.go,
+plan_apply_test.go, worker_test.go, and the in-process TestServer
+pattern (nomad/testing.go:41).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server import fsm as fsm_msgs
+from nomad_tpu.server.blocked_evals import BlockedEvals
+from nomad_tpu.server.eval_broker import FAILED_QUEUE, EvalBroker
+from nomad_tpu.server.plan_apply import Planner
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.server.worker import Worker
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Evaluation, Plan
+
+
+def make_eval(**kw):
+    defaults = dict(
+        type=consts.JOB_TYPE_SERVICE,
+        job_id="job-1",
+        namespace="default",
+        priority=50,
+        status=consts.EVAL_STATUS_PENDING,
+    )
+    defaults.update(kw)
+    return Evaluation(**defaults)
+
+
+def make_broker(**kw):
+    kw.setdefault("nack_timeout", 5.0)
+    b = EvalBroker(**kw)
+    b.set_enabled(True)
+    return b
+
+
+class TestEvalBroker:
+    def test_enqueue_dequeue_ack(self):
+        # eval_broker_test.go TestEvalBroker_Enqueue_Dequeue_Nack_Ack
+        b = make_broker()
+        ev = make_eval()
+        b.enqueue(ev)
+        assert b.stats()["total_ready"] == 1
+        out, token = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=1)
+        assert out.id == ev.id
+        assert b.stats()["total_unacked"] == 1
+        b.ack(ev.id, token)
+        assert b.stats()["total_ready"] == 0
+        assert b.stats()["total_unacked"] == 0
+
+    def test_priority_ordering(self):
+        b = make_broker()
+        low = make_eval(priority=20, job_id="low")
+        high = make_eval(priority=90, job_id="high")
+        b.enqueue(low)
+        b.enqueue(high)
+        out, _ = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=1)
+        assert out.id == high.id
+
+    def test_scheduler_type_filter(self):
+        b = make_broker()
+        b.enqueue(make_eval(type=consts.JOB_TYPE_BATCH, job_id="b"))
+        out, _ = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=0)
+        assert out is None
+        out, _ = b.dequeue([consts.JOB_TYPE_BATCH], timeout=1)
+        assert out is not None
+
+    def test_job_dedup_pending_promoted_on_ack(self):
+        # eval_broker_test.go TestEvalBroker_Enqueue_Disable / pending
+        b = make_broker()
+        first = make_eval(job_id="j")
+        second = make_eval(job_id="j", priority=70)
+        b.enqueue(first)
+        b.enqueue(second)
+        # only one outstanding per job
+        assert b.stats()["total_ready"] == 1
+        assert b.stats()["total_pending"] == 1
+        out, token = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=1)
+        assert out.id == first.id
+        b.ack(first.id, token)
+        out2, token2 = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=1)
+        assert out2.id == second.id
+        b.ack(second.id, token2)
+
+    def test_nack_requeues_then_fails(self):
+        b = make_broker(
+            delivery_limit=2, initial_nack_delay=0.0, subsequent_nack_delay=0.0
+        )
+        ev = make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=1)
+        b.nack(ev.id, token)
+        out, token = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=1)
+        assert out.id == ev.id
+        b.nack(ev.id, token)
+        # delivery limit reached -> failed queue
+        out, token = b.dequeue([FAILED_QUEUE], timeout=1)
+        assert out.id == ev.id
+
+    def test_token_mismatch_rejected(self):
+        b = make_broker()
+        ev = make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=1)
+        with pytest.raises(ValueError):
+            b.ack(ev.id, "wrong-token")
+
+    def test_delayed_eval(self):
+        b = make_broker()
+        ev = make_eval(wait_until_s=time.time() + 0.15)
+        b.enqueue(ev)
+        assert b.stats()["delayed_evals"] == 1
+        out, _ = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=0)
+        assert out is None
+        out, token = b.dequeue([consts.JOB_TYPE_SERVICE], timeout=2)
+        assert out is not None and out.id == ev.id
+
+    def test_disabled_drops(self):
+        b = EvalBroker()
+        b.enqueue(make_eval())
+        assert b.stats()["total_ready"] == 0
+
+    def test_dequeue_batch(self):
+        b = make_broker()
+        for i in range(5):
+            b.enqueue(make_eval(job_id=f"j{i}"))
+        batch = b.dequeue_batch([consts.JOB_TYPE_SERVICE], 3, timeout=1)
+        assert len(batch) == 3
+        for ev, token in batch:
+            b.ack(ev.id, token)
+
+
+class TestBlockedEvals:
+    def make(self):
+        released = []
+        be = BlockedEvals(released.append)
+        be.set_enabled(True)
+        return be, released
+
+    def test_block_unblock_class(self):
+        be, released = self.make()
+        ev = make_eval(status=consts.EVAL_STATUS_BLOCKED, snapshot_index=5)
+        ev.class_eligibility = {"class-a": True}
+        be.block(ev)
+        assert be.stats()["total_blocked"] == 1
+        n = be.unblock("class-a", index=10)
+        assert n == 1
+        assert released == [ev]
+        assert be.stats()["total_blocked"] == 0
+
+    def test_ineligible_class_not_unblocked(self):
+        be, released = self.make()
+        ev = make_eval(status=consts.EVAL_STATUS_BLOCKED, snapshot_index=5)
+        ev.class_eligibility = {"class-a": False}
+        be.block(ev)
+        assert be.unblock("class-a", index=10) == 0
+        # unseen class: optimistically unblock
+        assert be.unblock("class-b", index=11) == 1
+
+    def test_escaped_unblocks_on_any_change(self):
+        be, released = self.make()
+        ev = make_eval(status=consts.EVAL_STATUS_BLOCKED, snapshot_index=5)
+        ev.escaped_computed_class = True
+        be.block(ev)
+        assert be.stats()["total_escaped"] == 1
+        assert be.unblock("whatever", index=9) == 1
+
+    def test_duplicate_per_job(self):
+        be, released = self.make()
+        first = make_eval(status=consts.EVAL_STATUS_BLOCKED, job_id="j")
+        second = make_eval(status=consts.EVAL_STATUS_BLOCKED, job_id="j")
+        be.block(first)
+        be.block(second)
+        assert be.stats()["total_blocked"] == 1
+        dups = be.get_duplicates(timeout=0)
+        assert dups == [first]
+
+    def test_missed_unblock(self):
+        # capacity changed after the scheduler snapshot but before Block
+        be, released = self.make()
+        be.unblock("class-a", index=100)
+        ev = make_eval(status=consts.EVAL_STATUS_BLOCKED, snapshot_index=50)
+        be.block(ev)
+        assert released == [ev]
+        assert be.stats()["total_blocked"] == 0
+
+
+class TestPlanApply:
+    def test_apply_commits_allocs(self):
+        server = Server(ServerConfig(num_workers=0))
+        node = mock.node()
+        server.state.upsert_node(node)
+        job = mock.job()
+        alloc = mock.alloc(node_id=node.id, job=job)
+        plan = Plan(priority=50, job=job, node_allocation={node.id: [alloc]})
+        result = server.planner.apply_one(plan)
+        assert result.refresh_index == 0
+        assert server.state.snapshot().alloc_by_id(alloc.id) is not None
+
+    def test_overcommit_rejected_partial(self):
+        # plan_apply_test.go TestPlanApply_EvalPlan_Partial
+        server = Server(ServerConfig(num_workers=0))
+        node = mock.node()
+        server.state.upsert_node(node)
+        job = mock.job()
+        good = mock.alloc(node_id=node.id, job=job)
+        # a second node that does not exist -> that node's placements drop
+        bad = mock.alloc(node_id="missing-node", job=job)
+        plan = Plan(
+            priority=50, job=job,
+            node_allocation={node.id: [good], "missing-node": [bad]},
+        )
+        result = server.planner.apply_one(plan)
+        assert node.id in result.node_allocation
+        assert "missing-node" not in result.node_allocation
+        assert result.refresh_index > 0
+
+    def test_down_node_rejected(self):
+        server = Server(ServerConfig(num_workers=0))
+        node = mock.node(status=consts.NODE_STATUS_DOWN)
+        server.state.upsert_node(node)
+        job = mock.job()
+        alloc = mock.alloc(node_id=node.id, job=job)
+        plan = Plan(priority=50, job=job, node_allocation={node.id: [alloc]})
+        result = server.planner.apply_one(plan)
+        assert not result.node_allocation
+        assert result.refresh_index > 0
+
+
+class TestServerEndToEnd:
+    def make_server(self, n_nodes=5, **cfg):
+        cfg.setdefault("num_workers", 2)
+        cfg.setdefault("heartbeat_ttl", 60.0)
+        server = Server(ServerConfig(**cfg))
+        server.start()
+        for _ in range(n_nodes):
+            server.node_register(mock.node())
+        return server
+
+    def wait_for(self, fn, timeout=10.0, msg="condition", server=None):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if fn():
+                return
+            time.sleep(0.02)
+        detail = ""
+        if server is not None:
+            errors = [w.last_error for w in server.workers if w.last_error]
+            if errors:
+                detail = f"; worker errors: {errors}"
+        raise AssertionError(f"timeout waiting for {msg}{detail}")
+
+    def test_job_register_places_allocs(self):
+        server = self.make_server()
+        try:
+            job = mock.job()
+            resp = server.job_register(job)
+            assert resp["eval_id"]
+            self.wait_for(
+                lambda: len([
+                    a for a in server.state.snapshot().allocs_by_job(
+                        job.namespace, job.id)
+                    if a.desired_status == consts.ALLOC_DESIRED_RUN
+                ]) == 10,
+                msg="10 allocs placed",
+            )
+            ev = server.state.snapshot().eval_by_id(resp["eval_id"])
+            assert ev.status == consts.EVAL_STATUS_COMPLETE
+        finally:
+            server.shutdown()
+
+    def test_exhausted_job_blocks_then_unblocks(self):
+        server = self.make_server(n_nodes=1)
+        try:
+            job = mock.job()
+            # each mock node fits at most 7 tasks (3900 MHz usable / 500)
+            job.task_groups[0].count = 20
+            server.job_register(job)
+            self.wait_for(
+                lambda: server.blocked_evals.stats()["total_blocked"] == 1,
+                msg="blocked eval created",
+                server=server,
+            )
+            placed = len(server.state.snapshot().allocs_by_job(job.namespace, job.id))
+            assert placed < 20
+            # capacity arrives: blocked eval unblocks and placement finishes
+            for _ in range(4):
+                server.node_register(mock.node())
+            self.wait_for(
+                lambda: len([
+                    a for a in server.state.snapshot().allocs_by_job(
+                        job.namespace, job.id)
+                    if a.desired_status == consts.ALLOC_DESIRED_RUN
+                ]) == 20,
+                msg="all 20 allocs placed after unblock",
+            )
+        finally:
+            server.shutdown()
+
+    def test_job_deregister_stops_allocs(self):
+        server = self.make_server()
+        try:
+            job = mock.job()
+            server.job_register(job)
+            self.wait_for(
+                lambda: len(server.state.snapshot().allocs_by_job(
+                    job.namespace, job.id)) == 10,
+                msg="allocs placed",
+            )
+            server.job_deregister(job.namespace, job.id)
+            self.wait_for(
+                lambda: all(
+                    a.desired_status == consts.ALLOC_DESIRED_STOP
+                    for a in server.state.snapshot().allocs_by_job(
+                        job.namespace, job.id)
+                ),
+                msg="allocs stopped",
+            )
+        finally:
+            server.shutdown()
+
+    def test_heartbeat_expiry_marks_node_down(self):
+        server = Server(ServerConfig(num_workers=2, heartbeat_ttl=0.2))
+        server.start()
+        try:
+            node = mock.node()
+            server.node_register(node)
+            self.wait_for(
+                lambda: server.state.snapshot().node_by_id(node.id).status
+                == consts.NODE_STATUS_DOWN,
+                timeout=5,
+                msg="node down after missed heartbeat",
+            )
+        finally:
+            server.shutdown()
+
+    def test_heartbeat_keeps_node_alive(self):
+        server = Server(ServerConfig(num_workers=0, heartbeat_ttl=0.3))
+        server.start()
+        try:
+            node = mock.node()
+            server.node_register(node)
+            for _ in range(4):
+                time.sleep(0.1)
+                server.node_heartbeat(node.id, consts.NODE_STATUS_READY)
+            assert (
+                server.state.snapshot().node_by_id(node.id).status
+                == consts.NODE_STATUS_READY
+            )
+        finally:
+            server.shutdown()
+
+    def test_failed_eval_reaped_with_follow_up(self):
+        server = Server(
+            ServerConfig(num_workers=0, eval_delivery_limit=1)
+        )
+        server.eval_broker.initial_nack_delay = 0.0
+        server.eval_broker.subsequent_nack_delay = 0.0
+        server.start()
+        try:
+            ev = make_eval()
+            server.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": [ev]})
+            out, token = server.eval_broker.dequeue(
+                [consts.JOB_TYPE_SERVICE], timeout=1
+            )
+            server.eval_broker.nack(out.id, token)
+            self.wait_for(
+                lambda: server.state.snapshot().eval_by_id(ev.id).status
+                == consts.EVAL_STATUS_FAILED,
+                msg="failed eval reaped",
+            )
+            follow_ups = [
+                e for e in server.state.snapshot().evals_iter()
+                if e.triggered_by == consts.EVAL_TRIGGER_FAILED_FOLLOW_UP
+            ]
+            assert len(follow_ups) == 1
+        finally:
+            server.shutdown()
