@@ -1,0 +1,673 @@
+"""Raft consensus node: election, replication, commit, FSM apply.
+
+Reference behavior: hashicorp/raft v1.3.5 as wired by nomad
+(server.go:1228 setupRaft, fsm.go): every authoritative mutation is a
+log entry; the FSM applies committed entries in order; leadership
+changes drive nomad's establishLeadership/revokeLeadership
+(leader.go:54). This is a from-scratch implementation of the standard
+algorithm (election timeout randomization, AppendEntries consistency
+check, majority commit with current-term guard, InstallSnapshot for
+lagging followers).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from nomad_tpu.raft.log import LOG_COMMAND, LOG_NOOP, LogEntry, LogStore
+
+LOG = logging.getLogger(__name__)
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader: Optional[str]) -> None:
+        super().__init__(f"not leader; leader is {leader}")
+        self.leader = leader
+
+
+class RaftConfig:
+    def __init__(
+        self,
+        heartbeat_interval: float = 0.05,
+        election_timeout_min: float = 0.15,
+        election_timeout_max: float = 0.30,
+        max_append_entries: int = 64,
+        snapshot_threshold: int = 8192,
+    ) -> None:
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout_min = election_timeout_min
+        self.election_timeout_max = election_timeout_max
+        self.max_append_entries = max_append_entries
+        self.snapshot_threshold = snapshot_threshold
+
+
+class _ApplyFuture:
+    def __init__(self, index: int) -> None:
+        self.index = index
+        self._done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[Exception] = None
+
+    def respond(self, result: Any, error: Optional[Exception]) -> None:
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float]) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError("apply timeout")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: str,
+        peers: List[str],
+        transport,
+        fsm_apply: Callable[[str, Dict], Any],
+        config: Optional[RaftConfig] = None,
+        snapshot_fn: Optional[Callable[[], bytes]] = None,
+        restore_fn: Optional[Callable[[bytes], None]] = None,
+        on_leader: Optional[Callable[[], None]] = None,
+        on_follower: Optional[Callable[[], None]] = None,
+        log_store: Optional[LogStore] = None,
+    ) -> None:
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.transport = transport
+        transport.set_handler(self._handle_rpc)
+        self.fsm_apply = fsm_apply
+        self.config = config or RaftConfig()
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.on_leader = on_leader
+        self.on_follower = on_follower
+
+        self._lock = threading.RLock()
+        self.state = FOLLOWER
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log = log_store or LogStore()
+        self.commit_index = 0
+        self.last_applied = self.log.base_index()
+        self.leader_id: Optional[str] = None
+        self._last_contact = time.monotonic()
+        self._votes = 0
+
+        # leader volatile state
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+
+        self._futures: Dict[int, _ApplyFuture] = {}
+        self._apply_cond = threading.Condition(self._lock)
+        # one persistent replicator per peer, individually woken -- a
+        # slow peer must not delay heartbeats to the others
+        self._peer_wakes: Dict[str, threading.Event] = {
+            p: threading.Event() for p in self.peers
+        }
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # the term whose noop barrier marks leadership fully established
+        self._leader_barrier_term = -1
+        # serializes FSM apply against snapshot capture so a snapshot is
+        # exactly the state at last_applied (no torn snapshots)
+        self._fsm_lock = threading.Lock()
+        # request-id -> result for forwarded applies (at-most-once: a
+        # retry after a dropped response must not re-apply the command)
+        self._forward_results: Dict[str, Any] = {}
+        self._forward_order: List[str] = []
+
+    # --- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._shutdown.clear()
+        for name, target in (
+            ("raft-tick", self._run_ticker),
+            ("raft-apply", self._run_apply),
+        ):
+            t = threading.Thread(target=target, daemon=True, name=f"{name}-{self.id}")
+            self._threads.append(t)
+            t.start()
+        for peer in self.peers:
+            t = threading.Thread(
+                target=self._run_peer_replicator, args=(peer,),
+                daemon=True, name=f"raft-repl-{self.id}-{peer}",
+            )
+            self._threads.append(t)
+            t.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            self._apply_cond.notify_all()
+        self._wake_replicators()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+        self.transport.close()
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def leader_addr(self) -> Optional[str]:
+        with self._lock:
+            return self.id if self.state == LEADER else self.leader_id
+
+    # --- public apply ---------------------------------------------------
+
+    def apply(self, msg_type: str, req: Dict, timeout: float = 10.0) -> Any:
+        """Append a command; block until committed + FSM-applied locally.
+        On followers raises NotLeaderError (callers forward)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            entry = LogEntry(
+                index=self.log.last_index() + 1,
+                term=self.current_term,
+                kind=LOG_COMMAND,
+                data=(msg_type, req),
+            )
+            self.log.append(entry)
+            fut = _ApplyFuture(entry.index)
+            self._futures[entry.index] = fut
+            self.match_index[self.id] = entry.index
+            if not self.peers:
+                self._advance_commit_locked()
+        self._wake_replicators()
+        return fut.wait(timeout)
+
+    def barrier(self, timeout: float = 5.0) -> None:
+        """Commit a noop and wait (leadership barrier)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            entry = LogEntry(
+                index=self.log.last_index() + 1,
+                term=self.current_term,
+                kind=LOG_NOOP,
+                data=None,
+            )
+            self.log.append(entry)
+            fut = _ApplyFuture(entry.index)
+            self._futures[entry.index] = fut
+            self.match_index[self.id] = entry.index
+            if not self.peers:
+                self._advance_commit_locked()
+        self._wake_replicators()
+        fut.wait(timeout)
+
+    # --- ticker: elections + heartbeats ---------------------------------
+
+    def _election_timeout(self) -> float:
+        return random.uniform(
+            self.config.election_timeout_min, self.config.election_timeout_max
+        )
+
+    def _run_ticker(self) -> None:
+        timeout = self._election_timeout()
+        while not self._shutdown.is_set():
+            time.sleep(self.config.heartbeat_interval / 2)
+            with self._lock:
+                state = self.state
+                elapsed = time.monotonic() - self._last_contact
+            if state == LEADER:
+                self._wake_replicators()   # heartbeat
+                continue
+            if elapsed >= timeout:
+                timeout = self._election_timeout()
+                self._start_election()
+
+    def _start_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            term = self.current_term
+            self.voted_for = self.id
+            self._votes = 1
+            self.leader_id = None
+            self._last_contact = time.monotonic()
+            last_index = self.log.last_index()
+            last_term = self.log.last_term()
+            peers = list(self.peers)
+        LOG.debug("%s starting election term %d", self.id, term)
+        if not peers:
+            self._maybe_win_locked_check(term)
+            return
+        for peer in peers:
+            threading.Thread(
+                target=self._request_vote_from,
+                args=(peer, term, last_index, last_term),
+                daemon=True,
+            ).start()
+
+    def _request_vote_from(self, peer: str, term: int, last_index: int, last_term: int) -> None:
+        try:
+            resp = self.transport.send(
+                peer, "request_vote",
+                {"term": term, "candidate": self.id,
+                 "last_log_index": last_index, "last_log_term": last_term},
+            )
+        except ConnectionError:
+            return
+        with self._lock:
+            if self.state != CANDIDATE or self.current_term != term:
+                return
+            if resp["term"] > self.current_term:
+                self._step_down_locked(resp["term"])
+                return
+            if resp.get("granted"):
+                self._votes += 1
+        self._maybe_win_locked_check(term)
+
+    def _maybe_win_locked_check(self, term: int) -> None:
+        became_leader = False
+        with self._lock:
+            n_voters = len(self.peers) + 1
+            if (
+                self.state == CANDIDATE
+                and self.current_term == term
+                and self._votes > n_voters // 2
+            ):
+                self.state = LEADER
+                self.leader_id = self.id
+                last = self.log.last_index()
+                self.next_index = {p: last + 1 for p in self.peers}
+                self.match_index = {p: 0 for p in self.peers}
+                self.match_index[self.id] = last
+                became_leader = True
+                LOG.info("%s became leader for term %d", self.id, term)
+        if became_leader:
+            # commit a barrier noop from this term; on_leader fires when
+            # it applies (guarantees the FSM has all prior state)
+            with self._lock:
+                entry = LogEntry(
+                    index=self.log.last_index() + 1,
+                    term=term,
+                    kind=LOG_NOOP,
+                    data=None,
+                )
+                self.log.append(entry)
+                self.match_index[self.id] = entry.index
+                self._leader_barrier_term = term
+                if not self.peers:
+                    self._advance_commit_locked()
+            self._wake_replicators()
+
+    def _step_down_locked(self, term: int) -> None:
+        was_leader = self.state == LEADER
+        self.state = FOLLOWER
+        if term > self.current_term:
+            # only a NEW term clears the vote -- resetting within the
+            # same term would allow double-voting
+            self.current_term = term
+            self.voted_for = None
+        self._last_contact = time.monotonic()
+        if was_leader:
+            # fail pending futures; a new leader owns them now
+            for fut in self._futures.values():
+                fut.respond(None, NotLeaderError(self.leader_id))
+            self._futures.clear()
+            if self.on_follower is not None:
+                threading.Thread(target=self.on_follower, daemon=True).start()
+
+    # --- replication (leader) -------------------------------------------
+
+    def _wake_replicators(self) -> None:
+        for ev in self._peer_wakes.values():
+            ev.set()
+
+    def _run_peer_replicator(self, peer: str) -> None:
+        wake = self._peer_wakes[peer]
+        while not self._shutdown.is_set():
+            wake.wait(self.config.heartbeat_interval)
+            wake.clear()
+            if self._shutdown.is_set():
+                return
+            with self._lock:
+                if self.state != LEADER:
+                    continue
+            try:
+                self._replicate_to(peer)
+            except Exception as e:              # noqa: BLE001
+                LOG.debug("%s: replicate to %s failed: %s", self.id, peer, e)
+
+    def _replicate_to(self, peer: str) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            term = self.current_term
+            next_idx = self.next_index.get(peer, self.log.last_index() + 1)
+            base = self.log.base_index()
+            need_snapshot = next_idx <= base
+        if need_snapshot and self._snapshot_cache is None:
+            # log is compacted past the peer but no snapshot bytes are
+            # in memory (e.g. restart from a persisted compacted log):
+            # capture one now, never ship data=None
+            self.force_snapshot()
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                return
+            if need_snapshot:
+                if self._snapshot_cache is None:
+                    LOG.error(
+                        "%s: peer %s needs snapshot but none available",
+                        self.id, peer,
+                    )
+                    return
+                snapshot_req = self._build_snapshot_req_locked()
+            else:
+                snapshot_req = None
+                prev_index = next_idx - 1
+                prev_term = self.log.term_at(prev_index)
+                if prev_term is None:
+                    return
+                entries = self.log.entries_from(
+                    next_idx, self.config.max_append_entries
+                )
+                commit = self.commit_index
+        try:
+            if snapshot_req is not None:
+                resp = self.transport.send(peer, "install_snapshot", snapshot_req)
+                with self._lock:
+                    if resp["term"] > self.current_term:
+                        self._step_down_locked(resp["term"])
+                        return
+                    self.next_index[peer] = snapshot_req["last_index"] + 1
+                    self.match_index[peer] = snapshot_req["last_index"]
+                return
+            resp = self.transport.send(
+                peer, "append_entries",
+                {"term": term, "leader": self.id,
+                 "prev_log_index": prev_index, "prev_log_term": prev_term,
+                 "entries": entries, "leader_commit": commit},
+            )
+        except ConnectionError:
+            return
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                return
+            if resp["term"] > self.current_term:
+                self._step_down_locked(resp["term"])
+                return
+            if resp.get("success"):
+                if entries:
+                    self.match_index[peer] = entries[-1].index
+                    self.next_index[peer] = entries[-1].index + 1
+                    self._advance_commit_locked()
+                    if self.next_index[peer] <= self.log.last_index():
+                        self._wake_replicators()
+            else:
+                # follower log conflict: back off (fast with hint)
+                hint = resp.get("conflict_index")
+                self.next_index[peer] = max(
+                    1, hint if hint else self.next_index.get(peer, 2) - 1
+                )
+                self._wake_replicators()
+
+    def _build_snapshot_req_locked(self) -> Dict:
+        return {
+            "term": self.current_term,
+            "leader": self.id,
+            "last_index": self.log.base_index(),
+            "last_term": self.log.term_at(self.log.base_index()) or 0,
+            "data": self._snapshot_cache,
+        }
+
+    def _advance_commit_locked(self) -> None:
+        """Majority match with current-term guard (Raft section 5.4.2)."""
+        matches = sorted(self.match_index.values(), reverse=True)
+        n_voters = len(self.peers) + 1
+        majority_idx = matches[n_voters // 2] if len(matches) >= n_voters else 0
+        if majority_idx > self.commit_index:
+            term_at = self.log.term_at(majority_idx)
+            if term_at == self.current_term:
+                self.commit_index = majority_idx
+                self._apply_cond.notify_all()
+
+    # --- apply loop -----------------------------------------------------
+
+    def _run_apply(self) -> None:
+        while not self._shutdown.is_set():
+            with self._lock:
+                if self.last_applied >= self.commit_index:
+                    self._apply_cond.wait(0.2)
+                if self._shutdown.is_set():
+                    return
+                if self.last_applied >= self.commit_index:
+                    continue
+                index = self.last_applied + 1
+                entry = self.log.get(index)
+                fut = self._futures.pop(index, None)
+                barrier_hit = (
+                    entry is not None
+                    and entry.kind == LOG_NOOP
+                    and entry.term == self._leader_barrier_term
+                    and self.state == LEADER
+                )
+            if entry is None:
+                with self._lock:
+                    self.last_applied = index
+                continue
+            result, error = None, None
+            with self._fsm_lock:
+                if entry.kind == LOG_COMMAND:
+                    msg_type, req = entry.data
+                    try:
+                        result = self.fsm_apply(msg_type, req)
+                    except Exception as e:          # noqa: BLE001
+                        error = e
+                        LOG.warning(
+                            "%s: FSM apply %s failed: %s", self.id, msg_type, e
+                        )
+                with self._lock:
+                    self.last_applied = index
+            if fut is not None:
+                fut.respond(result, error)
+            if barrier_hit:
+                with self._lock:
+                    self._leader_barrier_term = -1
+                if self.on_leader is not None:
+                    threading.Thread(target=self.on_leader, daemon=True).start()
+            self._maybe_snapshot()
+
+    # --- snapshots ------------------------------------------------------
+
+    _snapshot_cache: Optional[bytes] = None
+
+    def _maybe_snapshot(self) -> None:
+        if self.snapshot_fn is None:
+            return
+        with self._lock:
+            applied = self.last_applied
+            base = self.log.base_index()
+        if applied - base < self.config.snapshot_threshold:
+            return
+        self.force_snapshot()
+
+    def force_snapshot(self) -> None:
+        """Operator snapshot (nomad /v1/operator/snapshot analog).
+
+        Holding _fsm_lock quiesces the apply loop so the captured bytes
+        are exactly the state at last_applied -- compacting to any other
+        index would lose or double-apply entries on restore."""
+        if self.snapshot_fn is None:
+            return
+        with self._fsm_lock:
+            with self._lock:
+                applied = self.last_applied
+            data = self.snapshot_fn()
+            with self._lock:
+                term = self.log.term_at(applied) or self.current_term
+                self.log.compact_to(applied, term)
+                self._snapshot_cache = data
+        self.log.persist()
+
+    # --- RPC handlers ---------------------------------------------------
+
+    def _handle_rpc(self, method: str, req: Dict) -> Dict:
+        if method == "request_vote":
+            return self._on_request_vote(req)
+        if method == "append_entries":
+            return self._on_append_entries(req)
+        if method == "install_snapshot":
+            return self._on_install_snapshot(req)
+        if method == "forward_apply":
+            return self._on_forward_apply(req)
+        raise ValueError(f"unknown raft RPC {method}")
+
+    def _on_request_vote(self, req: Dict) -> Dict:
+        with self._lock:
+            if req["term"] > self.current_term:
+                self._step_down_locked(req["term"])
+            granted = False
+            if req["term"] == self.current_term and (
+                self.voted_for is None or self.voted_for == req["candidate"]
+            ):
+                # candidate's log must be at least as up-to-date
+                my_last_term = self.log.last_term()
+                my_last_index = self.log.last_index()
+                if (req["last_log_term"], req["last_log_index"]) >= (
+                    my_last_term, my_last_index,
+                ):
+                    granted = True
+                    self.voted_for = req["candidate"]
+                    self._last_contact = time.monotonic()
+            return {"term": self.current_term, "granted": granted}
+
+    def _on_append_entries(self, req: Dict) -> Dict:
+        with self._lock:
+            if req["term"] < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if req["term"] > self.current_term or self.state != FOLLOWER:
+                self._step_down_locked(req["term"])
+            self.current_term = req["term"]
+            self.leader_id = req["leader"]
+            self._last_contact = time.monotonic()
+
+            prev_index = req["prev_log_index"]
+            prev_term = req["prev_log_term"]
+            if prev_index > 0:
+                local_term = self.log.term_at(prev_index)
+                if local_term is None:
+                    return {
+                        "term": self.current_term, "success": False,
+                        "conflict_index": self.log.last_index() + 1,
+                    }
+                if local_term != prev_term:
+                    return {
+                        "term": self.current_term, "success": False,
+                        "conflict_index": max(1, prev_index - 1),
+                    }
+            for entry in req["entries"]:
+                local = self.log.get(entry.index)
+                if local is not None and local.term != entry.term:
+                    self.log.truncate_from(entry.index)
+                    local = None
+                if local is None:
+                    if self.log.last_index() + 1 == entry.index:
+                        self.log.append(entry)
+                    # else: gap; leader will back off via conflict_index
+            # commit may only advance to the last entry VERIFIED by this
+            # batch -- a stale uncommitted tail beyond it must not be
+            # applied (Raft figure 2: min(leaderCommit, index of last
+            # new entry))
+            last_verified = (
+                req["entries"][-1].index if req["entries"] else prev_index
+            )
+            if req["leader_commit"] > self.commit_index:
+                new_commit = min(req["leader_commit"], last_verified)
+                if new_commit > self.commit_index:
+                    self.commit_index = new_commit
+                    self._apply_cond.notify_all()
+            return {"term": self.current_term, "success": True}
+
+    def _on_install_snapshot(self, req: Dict) -> Dict:
+        with self._lock:
+            if req["term"] < self.current_term:
+                return {"term": self.current_term}
+            self._step_down_locked(req["term"])
+            self.current_term = req["term"]
+            self.leader_id = req["leader"]
+            self._last_contact = time.monotonic()
+            if req["data"] is None:
+                # never wipe local state for an empty snapshot
+                return {"term": self.current_term}
+            if self.restore_fn is not None:
+                self.restore_fn(req["data"])
+            self.log.compact_to(req["last_index"], req["last_term"])
+            self.log.truncate_from(req["last_index"] + 1)
+            self.commit_index = req["last_index"]
+            self.last_applied = req["last_index"]
+            return {"term": self.current_term}
+
+    def _on_forward_apply(self, req: Dict) -> Dict:
+        """Leader-side handler for follower-forwarded applies
+        (rpc.go:537 forwarding). request_id gives at-most-once: a retry
+        after a dropped response returns the cached result instead of
+        re-applying."""
+        request_id = req.get("request_id")
+        if request_id is not None:
+            with self._lock:
+                if request_id in self._forward_results:
+                    return {"ok": True, "result": self._forward_results[request_id]}
+        try:
+            result = self.apply(req["msg_type"], req["req"], timeout=10.0)
+        except NotLeaderError as e:
+            return {"ok": False, "not_leader": True, "leader": e.leader}
+        if request_id is not None:
+            with self._lock:
+                self._forward_results[request_id] = result
+                self._forward_order.append(request_id)
+                while len(self._forward_order) > 1024:
+                    self._forward_results.pop(self._forward_order.pop(0), None)
+        return {"ok": True, "result": result}
+
+    def forward_apply(self, msg_type: str, req: Dict, timeout: float = 10.0) -> Any:
+        """Follower-side: route an apply to the current leader."""
+        import uuid
+        request_id = str(uuid.uuid4())   # stable across retries
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            leader = self.leader_addr()
+            if leader is None or leader == self.id:
+                if self.is_leader():
+                    return self.apply(msg_type, req, timeout)
+                time.sleep(0.05)
+                continue
+            try:
+                resp = self.transport.send(
+                    leader, "forward_apply",
+                    {"msg_type": msg_type, "req": req,
+                     "request_id": request_id},
+                    timeout=timeout,
+                )
+            except ConnectionError:
+                time.sleep(0.05)
+                continue
+            if resp.get("ok"):
+                return resp["result"]
+            time.sleep(0.05)
+        raise TimeoutError("could not reach a leader")
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "term": self.current_term,
+                "leader": self.leader_id,
+                "commit_index": self.commit_index,
+                "last_applied": self.last_applied,
+                "last_log_index": self.log.last_index(),
+            }

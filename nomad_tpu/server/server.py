@@ -57,8 +57,14 @@ class ServerConfig:
 
 
 class Server:
+    """``raft`` is optional: without it the server is a single-process
+    authority (raft_apply goes straight to the FSM); with it, applies
+    replicate through the log and leadership drives
+    establish/revoke_leadership (leader.go:54 monitorLeadership)."""
+
     def __init__(self, config: Optional[ServerConfig] = None) -> None:
         self.config = config or ServerConfig()
+        self.raft = None
         self.state = StateStore()
         self.eval_broker = EvalBroker(
             nack_timeout=self.config.nack_timeout,
@@ -81,16 +87,40 @@ class Server:
         self._leader = False
         self._shutdown = threading.Event()
         self._leader_threads: List[threading.Thread] = []
+        # serializes establish/revoke (raft fires them from separate
+        # threads on leadership flaps); the generation lets stale leader
+        # loops from a previous term notice and exit
+        self._leadership_lock = threading.Lock()
+        self._leader_gen = 0
         # core scheduler factory, installed by nomad_tpu.server.core_sched
         self._core_scheduler_factory = None
 
     # --- lifecycle ------------------------------------------------------
 
+    def setup_raft(self, node_id: str, peers: List[str], transport, raft_config=None) -> None:
+        """Attach a replication log (server.go:1228 setupRaft)."""
+        from nomad_tpu.raft.node import RaftNode
+
+        self.raft = RaftNode(
+            node_id=node_id,
+            peers=peers,
+            transport=transport,
+            fsm_apply=self.fsm.apply,
+            config=raft_config,
+            snapshot_fn=self.state.to_snapshot_bytes,
+            restore_fn=self.state.restore_from_bytes,
+            on_leader=self.establish_leadership,
+            on_follower=self.revoke_leadership,
+        )
+
     def start(self) -> None:
-        """Single-server mode: become leader immediately and start
-        workers (server.go NewServer + monitorLeadership)."""
+        """Start workers; leadership comes from raft when attached,
+        otherwise immediately (single-process authority)."""
         self._shutdown.clear()
-        self.establish_leadership()
+        if self.raft is not None:
+            self.raft.start()
+        else:
+            self.establish_leadership()
         for w in self.workers:
             w.start()
 
@@ -98,6 +128,8 @@ class Server:
         self._shutdown.set()
         for w in self.workers:
             w.stop()
+        if self.raft is not None:
+            self.raft.shutdown()
         self.revoke_leadership()
         self.planner.close()
 
@@ -107,41 +139,59 @@ class Server:
     def establish_leadership(self) -> None:
         """leader.go:277 establishLeadership: enable the leader-only
         subsystems and restore broker/blocked state from the store."""
-        self._leader = True
-        self.plan_queue.set_enabled(True)
-        self.planner.start()
-        self.eval_broker.set_enabled(True)
-        self.blocked_evals.set_enabled(True)
-        self.heartbeats.set_enabled(True)
-        self._restore_evals()
-        self._init_heartbeats()
-        for w in self.workers:
-            w.set_pause(False)
-        for name, fn, interval in (
-            ("reap-failed-evals", self.reap_failed_evals_once, 0.2),
-            ("reap-dup-blocked", self.reap_dup_blocked_once, 0.2),
-        ):
-            t = threading.Thread(
-                target=self._leader_loop, args=(fn, interval),
-                daemon=True, name=name,
-            )
-            self._leader_threads.append(t)
-            t.start()
+        with self._leadership_lock:
+            # raft may have flapped before this callback ran
+            if self.raft is not None and not self.raft.is_leader():
+                return
+            if self._leader:
+                return
+            self._leader = True
+            self._leader_gen += 1
+            gen = self._leader_gen
+            self.plan_queue.set_enabled(True)
+            self.planner.start()
+            self.eval_broker.set_enabled(True)
+            self.blocked_evals.set_enabled(True)
+            self.heartbeats.set_enabled(True)
+            self._restore_evals()
+            self._init_heartbeats()
+            for w in self.workers:
+                w.set_pause(False)
+            for name, fn, interval in (
+                ("reap-failed-evals", self.reap_failed_evals_once, 0.2),
+                ("reap-dup-blocked", self.reap_dup_blocked_once, 0.2),
+            ):
+                t = threading.Thread(
+                    target=self._leader_loop, args=(fn, interval, gen),
+                    daemon=True, name=name,
+                )
+                self._leader_threads.append(t)
+                t.start()
 
     def revoke_leadership(self) -> None:
         """leader.go revokeLeadership."""
-        self._leader = False
-        self.eval_broker.set_enabled(False)
-        self.blocked_evals.set_enabled(False)
-        self.plan_queue.set_enabled(False)
-        self.planner.stop()
-        self.heartbeats.set_enabled(False)
-        for w in self.workers:
-            w.set_pause(True)
-        self._leader_threads.clear()
+        with self._leadership_lock:
+            if not self._shutdown.is_set():
+                if self.raft is not None and self.raft.is_leader():
+                    return   # already re-elected; keep leader state
+                if not self._leader and self.raft is not None:
+                    return
+            self._leader = False
+            self.eval_broker.set_enabled(False)
+            self.blocked_evals.set_enabled(False)
+            self.plan_queue.set_enabled(False)
+            self.planner.stop()
+            self.heartbeats.set_enabled(False)
+            for w in self.workers:
+                w.set_pause(True)
+            self._leader_threads.clear()
 
-    def _leader_loop(self, fn, interval: float) -> None:
-        while self._leader and not self._shutdown.is_set():
+    def _leader_loop(self, fn, interval: float, gen: int) -> None:
+        while (
+            self._leader
+            and self._leader_gen == gen
+            and not self._shutdown.is_set()
+        ):
             try:
                 fn()
             except Exception as e:              # noqa: BLE001
@@ -168,8 +218,17 @@ class Server:
     # --- raft boundary --------------------------------------------------
 
     def raft_apply(self, msg_type: str, req: Dict) -> int:
-        """rpc.go:750 raftApply. Single-process: direct FSM apply."""
-        return self.fsm.apply(msg_type, req)
+        """rpc.go:750 raftApply: replicate through the log when present
+        (followers forward to the leader), else direct FSM apply."""
+        if self.raft is None:
+            return self.fsm.apply(msg_type, req)
+        if self.raft.is_leader():
+            from nomad_tpu.raft.node import NotLeaderError
+            try:
+                return self.raft.apply(msg_type, req)
+            except NotLeaderError:
+                pass   # lost leadership mid-apply: route to the new one
+        return self.raft.forward_apply(msg_type, req)
 
     def snapshot_min_index(self, index: int, timeout: float = 5.0):
         """worker.go:537 SnapshotMinIndex: wait for local state to reach

@@ -15,6 +15,7 @@ support, rpc.go:808).
 
 from __future__ import annotations
 
+import pickle
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -194,6 +195,45 @@ class StateStore:
     def _next_index(self) -> int:
         self._index += 1
         return self._index
+
+    # --- snapshot persist/restore (fsm.go:1393 Snapshot, :1407 Restore) -
+
+    def to_snapshot_bytes(self) -> bytes:
+        """Serialize every table for raft snapshots / operator backup."""
+        with self._lock:
+            payload = {
+                "index": self._index,
+                "nodes": dict(self._nodes),
+                "jobs": dict(self._jobs),
+                "job_versions": dict(self._job_versions),
+                "evals": dict(self._evals),
+                "allocs": dict(self._allocs),
+                "deployments": dict(self._deployments),
+                "allocs_by_job": {k: set(v) for k, v in self._allocs_by_job.items()},
+                "allocs_by_node": {k: set(v) for k, v in self._allocs_by_node.items()},
+                "allocs_by_eval": {k: set(v) for k, v in self._allocs_by_eval.items()},
+                "scheduler_config": self.scheduler_config,
+            }
+            return pickle.dumps(payload)
+
+    def restore_from_bytes(self, data: bytes) -> None:
+        payload = pickle.loads(data)
+        with self._lock:
+            self._index = payload["index"]
+            self._nodes = payload["nodes"]
+            self._jobs = payload["jobs"]
+            self._job_versions = payload["job_versions"]
+            self._evals = payload["evals"]
+            self._allocs = payload["allocs"]
+            self._deployments = payload["deployments"]
+            self._allocs_by_job = payload["allocs_by_job"]
+            self._allocs_by_node = payload["allocs_by_node"]
+            self._allocs_by_eval = payload["allocs_by_eval"]
+            self.scheduler_config = payload["scheduler_config"]
+        self._notify(
+            ["nodes", "jobs", "evals", "allocs", "deployment", "scheduler_config"],
+            payload["index"],
+        )
 
     # --- writes (FSM apply targets, fsm.go:194-280 dispatch) ---
 

@@ -1,0 +1,291 @@
+"""Raft consensus + replicated-server tests.
+
+Modeled on reference in-process multi-server raft tests
+(nomad/server_test.go TestJoin-style, nomad/leader_test.go,
+plan_normalization_test.go): real 3-node clusters in one process over
+an in-memory transport.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft.log import LogStore, LogEntry
+from nomad_tpu.raft.node import NotLeaderError, RaftConfig, RaftNode
+from nomad_tpu.raft.transport import InmemTransport, TransportRegistry
+from nomad_tpu.server.testing import make_cluster, wait_for_leader, wait_until
+from nomad_tpu.structs import consts
+
+FAST = RaftConfig(
+    heartbeat_interval=0.02,
+    election_timeout_min=0.06,
+    election_timeout_max=0.12,
+)
+
+
+def make_raft_cluster(n, fsm_factory=None):
+    """N bare RaftNodes over an in-memory transport; each applies into
+    its own list (the FSM)."""
+    registry = TransportRegistry()
+    addrs = [f"n{i}" for i in range(n)]
+    nodes, logs = [], []
+    for addr in addrs:
+        applied = []
+        logs.append(applied)
+        node = RaftNode(
+            node_id=addr,
+            peers=addrs,
+            transport=InmemTransport(addr, registry),
+            fsm_apply=(lambda a: lambda t, r: a.append((t, r)) or len(a))(applied),
+            config=FAST,
+        )
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    return nodes, logs, registry
+
+
+def leader_of(nodes, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [n for n in nodes if n.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.01)
+    raise TimeoutError("no single leader")
+
+
+def shutdown_all(nodes):
+    for n in nodes:
+        n.shutdown()
+
+
+class TestRaftCore:
+    def test_single_node_elects_self(self):
+        nodes, logs, _ = make_raft_cluster(1)
+        try:
+            leader = leader_of(nodes)
+            assert leader is nodes[0]
+            result = leader.apply("set", {"k": 1})
+            assert result == 1
+            assert logs[0] == [("set", {"k": 1})]
+        finally:
+            shutdown_all(nodes)
+
+    def test_three_node_replication(self):
+        nodes, logs, _ = make_raft_cluster(3)
+        try:
+            leader = leader_of(nodes)
+            for i in range(5):
+                leader.apply("op", {"i": i})
+            wait_until(
+                lambda: all(len(l) == 5 for l in logs),
+                msg="all FSMs applied 5 entries",
+            )
+            assert logs[0] == logs[1] == logs[2]
+        finally:
+            shutdown_all(nodes)
+
+    def test_apply_on_follower_raises(self):
+        nodes, logs, _ = make_raft_cluster(3)
+        try:
+            leader = leader_of(nodes)
+            follower = next(n for n in nodes if n is not leader)
+            with pytest.raises(NotLeaderError):
+                follower.apply("op", {})
+        finally:
+            shutdown_all(nodes)
+
+    def test_forward_apply_from_follower(self):
+        nodes, logs, _ = make_raft_cluster(3)
+        try:
+            leader = leader_of(nodes)
+            follower = next(n for n in nodes if n is not leader)
+            result = follower.forward_apply("op", {"x": 1})
+            assert result == 1
+        finally:
+            shutdown_all(nodes)
+
+    def test_leader_failover(self):
+        nodes, logs, _ = make_raft_cluster(3)
+        try:
+            leader = leader_of(nodes)
+            leader.apply("op", {"i": 0})
+            leader.shutdown()
+            rest = [n for n in nodes if n is not leader]
+            new_leader = leader_of(rest)
+            assert new_leader is not leader
+            new_leader.apply("op", {"i": 1})
+            live_logs = [logs[nodes.index(n)] for n in rest]
+            wait_until(
+                lambda: all(len(l) == 2 for l in live_logs),
+                msg="survivors applied both entries",
+            )
+        finally:
+            shutdown_all(n for n in nodes if n._threads)
+
+    def test_partition_heals(self):
+        nodes, logs, registry = make_raft_cluster(3)
+        try:
+            leader = leader_of(nodes)
+            followers = [n for n in nodes if n is not leader]
+            # cut the leader from both followers: majority elects anew
+            for f in followers:
+                registry.partition(leader.id, f.id)
+            new_leader = leader_of(followers)
+            new_leader.apply("op", {"after": "partition"})
+            # heal: old leader steps down and catches up
+            registry.heal()
+            wait_until(
+                lambda: not leader.is_leader(),
+                msg="old leader stepped down",
+            )
+            wait_until(
+                lambda: all(len(l) == 1 for l in logs),
+                msg="all logs converged",
+            )
+        finally:
+            shutdown_all(nodes)
+
+    def test_log_store_compaction(self):
+        log = LogStore()
+        for i in range(1, 11):
+            log.append(LogEntry(index=i, term=1, data=i))
+        log.compact_to(5, 1)
+        assert log.base_index() == 5
+        assert log.get(5) is None
+        assert log.get(6).data == 6
+        assert log.last_index() == 10
+        log.truncate_from(8)
+        assert log.last_index() == 7
+
+
+class TestTcpTransport:
+    def test_three_node_cluster_over_tcp(self):
+        # raft_rpc.go RaftLayer analog: same RPCs over real sockets
+        from nomad_tpu.raft.transport import TcpTransport
+
+        transports = [TcpTransport() for _ in range(3)]
+        addrs = [t.addr for t in transports]
+        nodes, logs = [], []
+        for t in transports:
+            applied = []
+            logs.append(applied)
+            node = RaftNode(
+                node_id=t.addr,
+                peers=addrs,
+                transport=t,
+                fsm_apply=(lambda a: lambda ty, r: a.append((ty, r)) or len(a))(applied),
+                config=FAST,
+            )
+            nodes.append(node)
+        for n in nodes:
+            n.start()
+        try:
+            leader = leader_of(nodes, timeout=10)
+            for i in range(3):
+                leader.apply("op", {"i": i})
+            wait_until(
+                lambda: all(len(l) == 3 for l in logs),
+                msg="TCP replication to all nodes",
+            )
+            assert logs[0] == logs[1] == logs[2]
+        finally:
+            shutdown_all(nodes)
+
+
+class TestReplicatedServer:
+    def test_job_register_replicates(self):
+        servers, _ = make_cluster(3)
+        try:
+            leader = wait_for_leader(servers)
+            for _ in range(3):
+                leader.node_register(mock.node())
+            job = mock.job()
+            resp = leader.job_register(job)
+            assert resp["eval_id"]
+            # every server's state store converges on the same job+allocs
+            wait_until(
+                lambda: all(
+                    s.state.snapshot().job_by_id(job.namespace, job.id) is not None
+                    for s in servers
+                ),
+                msg="job replicated to all servers",
+            )
+            wait_until(
+                lambda: all(
+                    len(s.state.snapshot().allocs_by_job(job.namespace, job.id)) == 10
+                    for s in servers
+                ),
+                timeout=30,
+                msg="allocs replicated to all servers",
+            )
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_follower_forwards_writes(self):
+        servers, _ = make_cluster(3)
+        try:
+            leader = wait_for_leader(servers)
+            follower = next(s for s in servers if s is not leader)
+            node = mock.node()
+            follower.node_register(node)
+            wait_until(
+                lambda: all(
+                    s.state.snapshot().node_by_id(node.id) is not None
+                    for s in servers
+                ),
+                msg="node visible on all servers",
+            )
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_leader_failover_keeps_scheduling(self):
+        servers, _ = make_cluster(3)
+        try:
+            leader = wait_for_leader(servers)
+            for _ in range(3):
+                leader.node_register(mock.node())
+            job1 = mock.job()
+            leader.job_register(job1)
+            wait_until(
+                lambda: len(leader.state.snapshot().allocs_by_job(
+                    job1.namespace, job1.id)) == 10,
+                timeout=30,
+                msg="first job placed",
+            )
+            leader.shutdown()
+            rest = [s for s in servers if s is not leader]
+            new_leader = wait_for_leader(rest, timeout=10)
+            job2 = mock.job()
+            new_leader.job_register(job2)
+            wait_until(
+                lambda: len(new_leader.state.snapshot().allocs_by_job(
+                    job2.namespace, job2.id)) == 10,
+                timeout=30,
+                msg="second job placed by new leader",
+            )
+        finally:
+            for s in servers:
+                if s.raft is not None and s.raft._threads:
+                    s.shutdown()
+
+    def test_snapshot_restore_roundtrip(self):
+        from nomad_tpu.state.store import StateStore
+
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        job = mock.job()
+        store.upsert_job(job)
+        data = store.to_snapshot_bytes()
+
+        fresh = StateStore()
+        fresh.restore_from_bytes(data)
+        snap = fresh.snapshot()
+        assert snap.node_by_id(node.id) is not None
+        assert snap.job_by_id(job.namespace, job.id) is not None
+        assert snap.latest_index() == store.latest_index()
