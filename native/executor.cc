@@ -1,0 +1,108 @@
+// Task executor: out-of-process supervisor for exec-family drivers.
+//
+// Reference behavior: drivers/shared/executor/executor.go:54 -- the
+// driver spawns a separate `nomad executor` process which launches and
+// supervises the workload, so the workload survives agent restarts and
+// the agent can reattach (RecoverTask) by talking to this supervisor's
+// on-disk state instead of holding the child directly.
+//
+// Protocol (file-based, the pipe/gRPC analog):
+//   argv: executor <status_path> <stdout_path> <stderr_path> <cwd> -- cmd [args...]
+//   status file lines, appended atomically:
+//     pid <child_pid> <child_pgid>
+//     exit <code> <signal>
+// The agent reads `pid` to learn the supervised process group, sends
+// signals to -pgid to stop, and polls for `exit`.
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+static void append_status(const std::string &path, const std::string &line) {
+  int fd = open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd < 0) return;
+  std::string l = line + "\n";
+  ssize_t ignored = write(fd, l.c_str(), l.size());
+  (void)ignored;
+  fsync(fd);
+  close(fd);
+}
+
+int main(int argc, char **argv) {
+  if (argc < 7) {
+    fprintf(stderr,
+            "usage: executor <status> <stdout> <stderr> <cwd> -- cmd [args]\n");
+    return 2;
+  }
+  std::string status_path = argv[1];
+  std::string stdout_path = argv[2];
+  std::string stderr_path = argv[3];
+  std::string cwd = argv[4];
+  int cmd_start = 0;
+  for (int i = 5; i < argc; i++) {
+    if (strcmp(argv[i], "--") == 0) {
+      cmd_start = i + 1;
+      break;
+    }
+  }
+  if (cmd_start == 0 || cmd_start >= argc) {
+    fprintf(stderr, "executor: missing -- cmd\n");
+    return 2;
+  }
+
+  // Detach from the agent: new session so agent exit/restart cannot
+  // take the workload down (executor_linux.go session handling).
+  if (setsid() < 0 && errno != EPERM) {
+    // already a session leader is fine
+  }
+  signal(SIGHUP, SIG_IGN);
+
+  pid_t child = fork();
+  if (child < 0) {
+    append_status(status_path, "exit 127 0");
+    return 1;
+  }
+  if (child == 0) {
+    // workload child: own process group so the whole tree is signalable
+    setpgid(0, 0);
+    if (!cwd.empty()) {
+      if (chdir(cwd.c_str()) != 0) _exit(126);
+    }
+    int out = open(stdout_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    int err = open(stderr_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (out >= 0) dup2(out, STDOUT_FILENO);
+    if (err >= 0) dup2(err, STDERR_FILENO);
+    std::vector<char *> args;
+    for (int i = cmd_start; i < argc; i++) args.push_back(argv[i]);
+    args.push_back(nullptr);
+    execvp(args[0], args.data());
+    _exit(127);
+  }
+
+  setpgid(child, child);
+  char buf[128];
+  snprintf(buf, sizeof(buf), "pid %d %d", (int)child, (int)child);
+  append_status(status_path, buf);
+
+  int wstatus = 0;
+  pid_t got;
+  do {
+    got = waitpid(child, &wstatus, 0);
+  } while (got < 0 && errno == EINTR);
+
+  int code = 0, sig = 0;
+  if (WIFEXITED(wstatus)) code = WEXITSTATUS(wstatus);
+  if (WIFSIGNALED(wstatus)) sig = WTERMSIG(wstatus);
+  snprintf(buf, sizeof(buf), "exit %d %d", code, sig);
+  append_status(status_path, buf);
+  return 0;
+}

@@ -1,0 +1,183 @@
+"""AllocRunner: per-allocation lifecycle.
+
+Reference behavior: client/allocrunner/alloc_runner.go -- owns the
+alloc dir, runs the hook chain (here: allocdir setup), builds one
+TaskRunner per task in the group, aggregates task states into the
+alloc's client status (alloc_runner.go clientAlloc/getClientStatus),
+and reports updates to the client for batched upload to servers.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import os
+import shutil
+import threading
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu.client.task_runner import STATE_DEAD, STATE_PENDING, STATE_RUNNING, TaskRunner
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import Allocation, TaskState
+
+LOG = logging.getLogger(__name__)
+
+
+class AllocRunner:
+    def __init__(
+        self,
+        alloc: Allocation,
+        drivers: Dict[str, object],
+        data_dir: str,
+        on_alloc_update: Callable[[Allocation], None],
+        state_db=None,
+    ) -> None:
+        self.alloc = alloc
+        self.drivers = drivers
+        self.data_dir = data_dir
+        self.on_alloc_update = on_alloc_update
+        self.state_db = state_db
+        self.alloc_dir = os.path.join(data_dir, "allocs", alloc.id)
+        self.task_runners: Dict[str, TaskRunner] = {}
+        self._lock = threading.Lock()
+        self._destroyed = False
+        self._waiter: Optional[threading.Thread] = None
+        self.task_states: Dict[str, TaskState] = {}
+
+    # --- lifecycle ------------------------------------------------------
+
+    def run(self) -> None:
+        """alloc_runner.go Run: prerun hooks then task runners."""
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job is not None else None
+        if tg is None:
+            LOG.warning("alloc %s: unknown task group %s",
+                        self.alloc.id, self.alloc.task_group)
+            return
+        os.makedirs(self.alloc_dir, exist_ok=True)
+        for task in tg.tasks:
+            driver = self.drivers.get(task.driver)
+            if driver is None:
+                ts = TaskState(state=STATE_DEAD, failed=True)
+                self._on_task_state(task.name, ts)
+                LOG.warning("alloc %s: no driver %s", self.alloc.id, task.driver)
+                continue
+            tr = TaskRunner(
+                alloc=self.alloc,
+                task=task,
+                driver=driver,
+                alloc_dir=self.alloc_dir,
+                on_state_change=self._on_task_state,
+                state_db=self.state_db,
+                restart_policy=tg.restart_policy,
+            )
+            self.task_runners[task.name] = tr
+            tr.start()
+        self._watch_done()
+
+    def restore(self) -> None:
+        """Rebuild task runners after agent restart, reattaching to live
+        tasks (alloc_runner restore path; client.go:1109)."""
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job is not None else None
+        if tg is None:
+            return
+        os.makedirs(self.alloc_dir, exist_ok=True)
+        for task in tg.tasks:
+            driver = self.drivers.get(task.driver)
+            if driver is None:
+                continue
+            tr = TaskRunner(
+                alloc=self.alloc,
+                task=task,
+                driver=driver,
+                alloc_dir=self.alloc_dir,
+                on_state_change=self._on_task_state,
+                state_db=self.state_db,
+                restart_policy=tg.restart_policy,
+            )
+            local_state, handle = (None, None)
+            if self.state_db is not None:
+                local_state, handle = self.state_db.get_task_state(
+                    self.alloc.id, task.name
+                )
+            recovered = tr.restore(local_state, handle)
+            self.task_runners[task.name] = tr
+            if not recovered and (local_state is None
+                                  or local_state.state != STATE_DEAD):
+                # task wasn't running anymore: start fresh
+                tr.start()
+        self._watch_done()
+
+    def _watch_done(self) -> None:
+        self._waiter = threading.Thread(
+            target=self._wait_all, daemon=True,
+            name=f"alloc-{self.alloc.id[:8]}",
+        )
+        self._waiter.start()
+
+    def _wait_all(self) -> None:
+        for tr in list(self.task_runners.values()):
+            tr.wait()
+
+    # --- state aggregation (alloc_runner.go getClientStatus) ------------
+
+    def _on_task_state(self, task_name: str, state: TaskState) -> None:
+        # deep-copy at the boundary: the TaskRunner keeps mutating its
+        # state object, and everything downstream (client batch, server
+        # store, raft snapshot pickling) must own immutable rows
+        state = copy.deepcopy(state)
+        with self._lock:
+            self.task_states[task_name] = state
+            status, desc = self._client_status_locked()
+        updated = self.alloc.copy_skip_job()
+        updated.client_status = status
+        updated.client_description = desc
+        updated.task_states = dict(self.task_states)
+        self.on_alloc_update(updated)
+
+    def _client_status_locked(self) -> (str, str):
+        states = list(self.task_states.values())
+        if not states:
+            return consts.ALLOC_CLIENT_PENDING, "no tasks have started"
+        if any(s.state == STATE_RUNNING for s in states):
+            return consts.ALLOC_CLIENT_RUNNING, "tasks are running"
+        if all(s.state == STATE_DEAD for s in states):
+            if any(s.failed for s in states):
+                return consts.ALLOC_CLIENT_FAILED, "failed tasks"
+            return consts.ALLOC_CLIENT_COMPLETE, "all tasks have completed"
+        if any(s.state == STATE_DEAD and s.failed for s in states):
+            return consts.ALLOC_CLIENT_FAILED, "failed tasks"
+        return consts.ALLOC_CLIENT_PENDING, "no tasks have started"
+
+    def client_status(self) -> str:
+        with self._lock:
+            return self._client_status_locked()[0]
+
+    # --- updates / teardown ---------------------------------------------
+
+    def update(self, alloc: Allocation) -> None:
+        """Server pushed a new alloc version (alloc_runner.go Update)."""
+        self.alloc = alloc
+        if alloc.server_terminal_status():
+            self.stop("alloc stopped by server")
+
+    def stop(self, reason: str = "") -> None:
+        for tr in self.task_runners.values():
+            tr.kill(reason)
+
+    def destroy(self) -> None:
+        self.stop("alloc destroyed")
+        for tr in self.task_runners.values():
+            tr.wait(timeout=5)
+            try:
+                tr.driver.destroy_task(tr.task_id, force=True)
+            except Exception:                   # noqa: BLE001
+                pass
+        self._destroyed = True
+        if self.state_db is not None:
+            self.state_db.delete_allocation(self.alloc.id)
+        shutil.rmtree(self.alloc_dir, ignore_errors=True)
+
+    def is_done(self) -> bool:
+        return all(tr.is_done() for tr in self.task_runners.values())

@@ -1,0 +1,327 @@
+"""The Client: node agent main loop.
+
+Reference behavior: client/client.go (3,174 LoC) -- fingerprint the
+host into a Node, register with servers and heartbeat
+(registerAndHeartbeat :1609), watch assigned allocations with a
+blocking query (watchAllocations :2063), diff into add/update/remove
+(runAllocs :2293), run allocRunners, batch alloc status updates back to
+the server, persist state for restart recovery (restoreState
+:1109-1180), and GC terminal allocs.
+
+The RPC boundary is the ``ClientRPC`` protocol: ``InProcessRPC`` talks
+to a Server object directly (the test topology); the HTTP transport
+plugs in at the same seam.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Protocol
+
+from nomad_tpu.client.alloc_runner import AllocRunner
+from nomad_tpu.client.fingerprint import fingerprint_node
+from nomad_tpu.client.state_db import MemStateDB, StateDB
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import Allocation
+
+LOG = logging.getLogger(__name__)
+
+
+class ClientRPC(Protocol):
+    def register_node(self, node) -> Dict: ...
+    def update_status(self, node_id: str, status: str) -> Dict: ...
+    def get_client_allocs(self, node_id: str, min_index: int, timeout: float) -> Dict: ...
+    def update_allocs(self, allocs: List[Allocation]) -> int: ...
+
+
+class InProcessRPC:
+    """Direct-call transport to a Server (test topology; the reference
+    equivalent is the client and server sharing an agent process)."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def register_node(self, node) -> Dict:
+        return self.server.node_register(node)
+
+    def update_status(self, node_id: str, status: str) -> Dict:
+        return self.server.node_update_status(node_id, status)
+
+    def get_client_allocs(self, node_id: str, min_index: int, timeout: float) -> Dict:
+        return self.server.get_client_allocs(node_id, min_index, timeout)
+
+    def update_allocs(self, allocs: List[Allocation]) -> int:
+        return self.server.update_allocs_from_client(allocs)
+
+
+class ClientConfig:
+    def __init__(
+        self,
+        data_dir: str = "/tmp/nomad-tpu-client",
+        datacenter: str = "dc1",
+        node_class: str = "",
+        meta: Optional[Dict[str, str]] = None,
+        persistent_state: bool = False,
+        update_batch_interval: float = 0.2,
+        max_terminal_allocs: int = 50,
+    ) -> None:
+        self.data_dir = data_dir
+        self.datacenter = datacenter
+        self.node_class = node_class
+        self.meta = meta or {}
+        self.persistent_state = persistent_state
+        self.update_batch_interval = update_batch_interval
+        self.max_terminal_allocs = max_terminal_allocs
+
+
+class Client:
+    def __init__(
+        self,
+        rpc: ClientRPC,
+        config: Optional[ClientConfig] = None,
+        drivers: Optional[Dict] = None,
+        device_plugins: Optional[List] = None,
+        node_id: Optional[str] = None,
+    ) -> None:
+        self.rpc = rpc
+        self.config = config or ClientConfig()
+        if drivers is None:
+            from nomad_tpu.drivers import builtin_drivers
+            drivers = builtin_drivers()
+        self.drivers = drivers
+        self.device_plugins = device_plugins or []
+
+        os.makedirs(self.config.data_dir, exist_ok=True)
+        if self.config.persistent_state:
+            self.state_db: StateDB = StateDB(
+                os.path.join(self.config.data_dir, "client_state.db")
+            )
+        else:
+            self.state_db = MemStateDB()
+
+        # stable node ID across restarts (client.go nodeID persistence)
+        self.node_id = node_id or self.state_db.get_meta("node_id") or str(uuid.uuid4())
+        self.state_db.put_meta("node_id", self.node_id)
+
+        self.node = fingerprint_node(
+            self.node_id,
+            datacenter=self.config.datacenter,
+            node_class=self.config.node_class,
+            drivers=self.drivers,
+            device_plugins=self.device_plugins,
+            meta=self.config.meta,
+        )
+        self.allocs: Dict[str, AllocRunner] = {}
+        self._alloc_lock = threading.Lock()
+        self._alloc_indexes: Dict[str, int] = {}    # alloc_id -> modify_index
+        self._pending_updates: Dict[str, Allocation] = {}
+        self._update_lock = threading.Lock()
+        self.heartbeat_ttl = 10.0
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # --- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._restore_state()
+        self._register()
+        for name, target in (
+            ("heartbeat", self._run_heartbeat),
+            ("watch-allocs", self._run_watch_allocations),
+            ("update-allocs", self._run_update_batcher),
+        ):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"client-{name}-{self.node_id[:8]}")
+            self._threads.append(t)
+            t.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+        self._flush_updates()
+        self.state_db.close()
+
+    def stop_allocs(self) -> None:
+        """Stop all running allocs (used by tests/drain shutdown)."""
+        with self._alloc_lock:
+            runners = list(self.allocs.values())
+        for ar in runners:
+            ar.stop("client shutting down")
+
+    # --- registration + heartbeat (client.go:1609) ----------------------
+
+    def _register(self) -> None:
+        self.node.status = consts.NODE_STATUS_INIT
+        resp = self.rpc.register_node(self.node)
+        self.heartbeat_ttl = resp.get("heartbeat_ttl", 10.0) or 10.0
+        # first heartbeat flips the node ready (client.go watchNodeUpdates)
+        self.rpc.update_status(self.node_id, consts.NODE_STATUS_READY)
+
+    def _run_heartbeat(self) -> None:
+        while not self._shutdown.is_set():
+            # heartbeat at a fraction of the TTL (client.go heartbeats
+            # at intervals inside the server-granted TTL)
+            wait = max(self.heartbeat_ttl * 0.4, 0.05)
+            if self._shutdown.wait(wait):
+                return
+            try:
+                resp = self.rpc.update_status(
+                    self.node_id, consts.NODE_STATUS_READY
+                )
+                self.heartbeat_ttl = resp.get("heartbeat_ttl", self.heartbeat_ttl) or self.heartbeat_ttl
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("client %s: heartbeat failed: %s", self.node_id[:8], e)
+                # the server may have lost our node (restart, GC):
+                # re-register instead of retrying forever
+                # (client.go retryRegisterNode on "node not found")
+                try:
+                    self._register()
+                except Exception as re_err:     # noqa: BLE001
+                    LOG.warning(
+                        "client %s: re-register failed: %s",
+                        self.node_id[:8], re_err,
+                    )
+
+    # --- allocation watching (client.go:2063, :2293) --------------------
+
+    def _run_watch_allocations(self) -> None:
+        index = 0
+        while not self._shutdown.is_set():
+            try:
+                resp = self.rpc.get_client_allocs(
+                    self.node_id, min_index=index, timeout=1.0
+                )
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("client %s: alloc watch failed: %s", self.node_id[:8], e)
+                if self._shutdown.wait(1.0):
+                    return
+                continue
+            index = max(index, resp.get("index", index))
+            self._run_allocs(resp.get("allocs", []))
+
+    def _run_allocs(self, server_allocs: List[Allocation]) -> None:
+        """runAllocs: diff server view against local runners."""
+        with self._alloc_lock:
+            existing = dict(self.allocs)
+        server_by_id = {a.id: a for a in server_allocs}
+
+        for alloc in server_allocs:
+            runner = existing.get(alloc.id)
+            if runner is None:
+                if alloc.server_terminal_status() or alloc.client_terminal_status():
+                    continue
+                self._add_alloc(alloc)
+            elif alloc.modify_index > self._alloc_indexes.get(alloc.id, 0):
+                self._alloc_indexes[alloc.id] = alloc.modify_index
+                if alloc.job is None:
+                    alloc.job = runner.alloc.job
+                runner.update(alloc)
+
+        # GC runners the server no longer knows (garbage collected)
+        for alloc_id, runner in existing.items():
+            if alloc_id not in server_by_id:
+                runner.destroy()
+                with self._alloc_lock:
+                    self.allocs.pop(alloc_id, None)
+
+        self._gc_terminal()
+
+    def _add_alloc(self, alloc: Allocation) -> None:
+        runner = AllocRunner(
+            alloc=alloc,
+            drivers=self.drivers,
+            data_dir=self.config.data_dir,
+            on_alloc_update=self._queue_update,
+            state_db=self.state_db,
+        )
+        with self._alloc_lock:
+            self.allocs[alloc.id] = runner
+            self._alloc_indexes[alloc.id] = alloc.modify_index
+        self.state_db.put_allocation(alloc)
+        threading.Thread(
+            target=runner.run, daemon=True, name=f"allocrun-{alloc.id[:8]}"
+        ).start()
+
+    def _gc_terminal(self) -> None:
+        """client/gc.go: bound the number of terminal alloc runners."""
+        with self._alloc_lock:
+            terminal = [
+                (aid, ar) for aid, ar in self.allocs.items()
+                if ar.is_done() and ar.alloc.terminal_status()
+            ]
+            excess = len(terminal) - self.config.max_terminal_allocs
+            victims = terminal[:max(excess, 0)]
+            for aid, _ar in victims:
+                self.allocs.pop(aid, None)
+        # destroy outside the lock: it blocks on task teardown
+        for _aid, ar in victims:
+            ar.destroy()
+
+    # --- status updates (client.go allocSync batching) ------------------
+
+    def _queue_update(self, alloc: Allocation) -> None:
+        with self._update_lock:
+            self._pending_updates[alloc.id] = alloc
+
+    def _run_update_batcher(self) -> None:
+        while not self._shutdown.is_set():
+            if self._shutdown.wait(self.config.update_batch_interval):
+                break
+            self._flush_updates()
+
+    def _flush_updates(self) -> None:
+        with self._update_lock:
+            updates, self._pending_updates = self._pending_updates, {}
+        if not updates:
+            return
+        try:
+            self.rpc.update_allocs(list(updates.values()))
+        except Exception as e:                  # noqa: BLE001
+            LOG.warning("client %s: alloc update failed: %s", self.node_id[:8], e)
+            with self._update_lock:
+                for a in updates.values():
+                    self._pending_updates.setdefault(a.id, a)
+
+    # --- restore (client.go:1109 restoreState) --------------------------
+
+    def _restore_state(self) -> None:
+        for alloc in self.state_db.get_allocations():
+            if alloc.server_terminal_status():
+                continue
+            runner = AllocRunner(
+                alloc=alloc,
+                drivers=self.drivers,
+                data_dir=self.config.data_dir,
+                on_alloc_update=self._queue_update,
+                state_db=self.state_db,
+            )
+            with self._alloc_lock:
+                self.allocs[alloc.id] = runner
+                self._alloc_indexes[alloc.id] = alloc.modify_index
+            runner.restore()
+
+    # --- introspection --------------------------------------------------
+
+    def num_allocs(self) -> int:
+        with self._alloc_lock:
+            return len(self.allocs)
+
+    def alloc_runner(self, alloc_id: str) -> Optional[AllocRunner]:
+        with self._alloc_lock:
+            return self.allocs.get(alloc_id)
+
+    def stats(self) -> Dict:
+        with self._alloc_lock:
+            return {
+                "node_id": self.node_id,
+                "allocs": len(self.allocs),
+                "running": sum(
+                    1 for ar in self.allocs.values() if not ar.is_done()
+                ),
+            }

@@ -1,0 +1,303 @@
+"""TaskRunner: the per-task state machine.
+
+Reference behavior: client/allocrunner/taskrunner/task_runner.go:498
+Run loop -- restore -> prestart hooks -> driver start -> wait -> restart
+policy -> exit; hooks (task_runner_hooks.go:61-130) here are the
+built-in subset: validate, task dir, logs, dispatch env. Restart policy
+semantics follow taskrunner/restarts/restarts.go: up to ``attempts``
+restarts inside ``interval``; beyond that ``mode=fail`` kills the task,
+``mode=delay`` waits out the interval and continues.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu.plugins.drivers import DriverPlugin, TaskConfig, TaskHandle
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import TaskEvent, TaskState
+from nomad_tpu.structs.job import RestartPolicy, Task
+
+LOG = logging.getLogger(__name__)
+
+# task_runner event types (structs.go TaskEvent consts)
+EVENT_RECEIVED = "Received"
+EVENT_TASK_SETUP = "Task Setup"
+EVENT_STARTED = "Started"
+EVENT_TERMINATED = "Terminated"
+EVENT_RESTARTING = "Restarting"
+EVENT_NOT_RESTARTING = "Not Restarting"
+EVENT_KILLING = "Killing"
+EVENT_KILLED = "Killed"
+EVENT_DRIVER_FAILURE = "Driver Failure"
+
+STATE_PENDING = "pending"
+STATE_RUNNING = "running"
+STATE_DEAD = "dead"
+
+
+class RestartTracker:
+    """taskrunner/restarts/restarts.go."""
+
+    def __init__(self, policy: RestartPolicy, job_type: str) -> None:
+        self.policy = policy
+        self.job_type = job_type
+        self.count = 0
+        self.interval_start = time.time()
+
+    def next_restart(self, exit_success: bool) -> (str, float):
+        """Returns (decision, delay): decision in {restart, fail, exit}."""
+        if exit_success and self.job_type in (
+            consts.JOB_TYPE_BATCH, consts.JOB_TYPE_SYSBATCH,
+        ):
+            # batch-family tasks that succeed are done; service/system
+            # tasks restart on any exit (restarts.go GetState)
+            return "exit", 0.0
+        now = time.time()
+        if now - self.interval_start > self.policy.interval_s:
+            self.interval_start = now
+            self.count = 0
+        self.count += 1
+        if self.count <= self.policy.attempts:
+            return "restart", self.policy.delay_s
+        if self.policy.mode == "delay":
+            remaining = self.policy.interval_s - (now - self.interval_start)
+            return "restart", max(remaining, self.policy.delay_s)
+        return "fail", 0.0
+
+
+class TaskRunner:
+    def __init__(
+        self,
+        alloc,
+        task: Task,
+        driver: DriverPlugin,
+        alloc_dir: str,
+        on_state_change: Callable[[str, TaskState], None],
+        state_db=None,
+        restart_policy: Optional[RestartPolicy] = None,
+    ) -> None:
+        self.alloc = alloc
+        self.task = task
+        self.driver = driver
+        self.alloc_dir = alloc_dir
+        self.on_state_change = on_state_change
+        self.state_db = state_db
+        self.task_state = TaskState()
+        self.handle: Optional[TaskHandle] = None
+        policy = restart_policy or RestartPolicy()
+        job_type = alloc.job.type if alloc.job is not None else consts.JOB_TYPE_SERVICE
+        self.restart_tracker = RestartTracker(policy, job_type)
+        self._kill = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._kill_reason = ""
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.alloc.id[:8]}-{self.task.name}"
+
+    # --- events/state ---------------------------------------------------
+
+    def _emit(self, event_type: str, message: str = "") -> None:
+        self.task_state.events.append(
+            TaskEvent(type=event_type, time_ns=time.time_ns(), message=message)
+        )
+        self._notify()
+
+    def _set_state(self, state: str, failed: Optional[bool] = None) -> None:
+        self.task_state.state = state
+        if failed is not None:
+            self.task_state.failed = failed
+        if state == STATE_RUNNING and not self.task_state.started_at_ns:
+            self.task_state.started_at_ns = time.time_ns()
+        if state == STATE_DEAD:
+            self.task_state.finished_at_ns = time.time_ns()
+        self._notify()
+
+    def _notify(self) -> None:
+        self.on_state_change(self.task.name, self.task_state)
+        if self.state_db is not None:
+            try:
+                self.state_db.put_task_state(
+                    self.alloc.id, self.task.name,
+                    local_state=self.task_state, task_handle=self.handle,
+                )
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("task %s: state persist failed: %s", self.task_id, e)
+
+    # --- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, daemon=True, name=f"task-{self.task_id}"
+        )
+        self._thread.start()
+
+    def run(self) -> None:
+        """task_runner.go:498 Run: the main loop."""
+        try:
+            self._run_inner()
+        except Exception as e:                  # noqa: BLE001
+            LOG.warning("task %s: runner crashed: %s", self.task_id, e)
+            self._set_state(STATE_DEAD, failed=True)
+        finally:
+            self._done.set()
+
+    def _run_inner(self) -> None:
+        self._emit(EVENT_RECEIVED)
+        try:
+            self._prestart()
+        except Exception as e:                  # noqa: BLE001
+            self._emit(EVENT_TASK_SETUP, f"prestart failed: {e}")
+            self._set_state(STATE_DEAD, failed=True)
+            return
+        while not self._kill.is_set():
+            try:
+                self.handle = self.driver.start_task(self._task_config())
+            except Exception as e:              # noqa: BLE001
+                self._emit(EVENT_DRIVER_FAILURE, str(e))
+                decision, delay = self.restart_tracker.next_restart(False)
+                if decision != "restart" or self._kill.wait(delay):
+                    self._set_state(STATE_DEAD, failed=True)
+                    break
+                continue
+            self._set_state(STATE_RUNNING)
+            self._emit(EVENT_STARTED)
+
+            result = None
+            while result is None and not self._kill.is_set():
+                try:
+                    result = self.driver.wait_task(self.task_id, timeout=0.25)
+                except KeyError:
+                    # task force-destroyed underneath us
+                    self._set_state(STATE_DEAD, failed=False)
+                    return
+            if self._kill.is_set():
+                self._handle_kill()
+                break
+            success = result.successful()
+            self._emit(
+                EVENT_TERMINATED,
+                f"exit code {result.exit_code}, signal {result.signal}"
+                + (f", err {result.err}" if result.err else ""),
+            )
+            self.task_state.restarts = self.restart_tracker.count
+            decision, delay = self.restart_tracker.next_restart(success)
+            if decision == "exit":
+                self._set_state(STATE_DEAD, failed=False)
+                break
+            if decision == "fail":
+                self._emit(EVENT_NOT_RESTARTING, "exceeded restart policy")
+                self._set_state(STATE_DEAD, failed=not success)
+                break
+            self._emit(EVENT_RESTARTING, f"restart in {delay:.1f}s")
+            self.task_state.restarts = self.restart_tracker.count
+            try:
+                self.driver.destroy_task(self.task_id, force=True)
+            except Exception:                   # noqa: BLE001
+                pass
+            if self._kill.wait(delay):
+                self._handle_kill()
+                break
+
+    def _handle_kill(self) -> None:
+        self._emit(EVENT_KILLING, self._kill_reason)
+        try:
+            self.driver.stop_task(
+                self.task_id, timeout=self.task.kill_timeout_s,
+                signal=self.task.kill_signal or "SIGTERM",
+            )
+        except Exception:                       # noqa: BLE001
+            pass
+        self._emit(EVENT_KILLED)
+        self._set_state(STATE_DEAD, failed=False)
+
+    def _prestart(self) -> None:
+        """Built-in prestart hooks: validate + task dir + logs
+        (task_runner_hooks.go validate/taskDir/logmon subset)."""
+        if not self.task.name:
+            raise ValueError("task has no name")
+        task_dir = os.path.join(self.alloc_dir, self.task.name)
+        os.makedirs(os.path.join(task_dir, "local"), exist_ok=True)
+        os.makedirs(os.path.join(task_dir, "secrets"), exist_ok=True)
+        os.makedirs(os.path.join(self.alloc_dir, "alloc", "logs"), exist_ok=True)
+        self._emit(EVENT_TASK_SETUP, "Building Task Directory")
+
+    def _task_config(self) -> TaskConfig:
+        logs = os.path.join(self.alloc_dir, "alloc", "logs")
+        env = {
+            "NOMAD_ALLOC_ID": self.alloc.id,
+            "NOMAD_ALLOC_NAME": self.alloc.name,
+            "NOMAD_TASK_NAME": self.task.name,
+            "NOMAD_JOB_ID": self.alloc.job_id,
+            "NOMAD_JOB_NAME": self.alloc.job.name if self.alloc.job else "",
+            "NOMAD_TASK_DIR": os.path.join(self.alloc_dir, self.task.name, "local"),
+            "NOMAD_SECRETS_DIR": os.path.join(self.alloc_dir, self.task.name, "secrets"),
+        }
+        env.update(self.task.env)
+        return TaskConfig(
+            id=self.task_id,
+            name=self.task.name,
+            alloc_id=self.alloc.id,
+            job_name=self.alloc.job.name if self.alloc.job else "",
+            task_group_name=self.alloc.task_group,
+            env=env,
+            driver_config=dict(self.task.config),
+            resources=self.task.resources,
+            std_out_path=os.path.join(logs, f"{self.task.name}.stdout.0"),
+            std_err_path=os.path.join(logs, f"{self.task.name}.stderr.0"),
+            alloc_dir=self.alloc_dir,
+        )
+
+    def restore(self, task_state: TaskState, handle: Optional[TaskHandle]) -> bool:
+        """Reattach to a live task (task_runner.go:1154 restore ->
+        driver RecoverTask). Returns True when the task is live again."""
+        self.task_state = task_state or TaskState()
+        if self.task_state.state == STATE_DEAD:
+            # already finished in a previous agent life: nothing to run,
+            # but the runner must read as done for GC/is_done
+            self._done.set()
+            return False
+        if handle is None:
+            return False
+        try:
+            self.driver.recover_task(handle)
+            self.handle = handle
+        except Exception as e:                  # noqa: BLE001
+            LOG.info("task %s: recover failed, restarting: %s", self.task_id, e)
+            return False
+        # resume waiting on the recovered task
+        self._thread = threading.Thread(
+            target=self._run_recovered, daemon=True, name=f"task-{self.task_id}"
+        )
+        self._thread.start()
+        return True
+
+    def _run_recovered(self) -> None:
+        result = None
+        while result is None and not self._kill.is_set():
+            try:
+                result = self.driver.wait_task(self.task_id, timeout=0.25)
+            except KeyError:
+                break
+        if self._kill.is_set():
+            self._handle_kill()
+        elif result is not None:
+            self._emit(EVENT_TERMINATED, f"exit code {result.exit_code}")
+            self._set_state(STATE_DEAD, failed=not result.successful())
+        self._done.set()
+
+    def kill(self, reason: str = "") -> None:
+        self._kill_reason = reason
+        self._kill.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def is_done(self) -> bool:
+        return self._done.is_set()

@@ -1,0 +1,28 @@
+"""Built-in task drivers.
+
+Reference behavior: drivers/ (SURVEY.md section 2.8) -- docker, exec,
+rawexec, java, qemu, mock, registered in-process via the plugin catalog
+(helper/pluginutils/catalog/register.go). Built-ins here: ``mock`` (the
+fully scriptable test driver, drivers/mock), ``raw_exec`` (host
+subprocesses, drivers/rawexec), ``exec`` (subprocesses with best-effort
+isolation, drivers/exec). The shared native executor
+(drivers/shared/executor) supervises children from a separate process
+so tasks survive agent restarts.
+"""
+
+from typing import Dict
+
+from nomad_tpu.plugins.drivers import DriverPlugin
+
+
+def builtin_drivers() -> Dict[str, DriverPlugin]:
+    """catalog/register.go: the in-process driver registry."""
+    from nomad_tpu.drivers.mock import MockDriver
+    from nomad_tpu.drivers.rawexec import RawExecDriver
+    from nomad_tpu.drivers.execdriver import ExecDriver
+
+    return {
+        "mock_driver": MockDriver(),
+        "raw_exec": RawExecDriver(),
+        "exec": ExecDriver(),
+    }

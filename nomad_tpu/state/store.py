@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from nomad_tpu.structs import consts
@@ -161,6 +162,9 @@ class StateStore:
         self.scheduler_config = SchedulerConfiguration()
         # table name -> [callback(index)]; fired outside the lock
         self._watchers: Dict[str, List[Callable[[int], None]]] = {}
+        # table name -> index of its last commit (memdb per-table index
+        # rows; lets blocking queries ignore unrelated tables)
+        self._table_indexes: Dict[str, int] = {}
 
     # --- infrastructure ---
 
@@ -188,13 +192,42 @@ class StateStore:
         cbs: List[Callable[[int], None]] = []
         with self._lock:
             for t in tables:
+                self._table_indexes[t] = max(self._table_indexes.get(t, 0), index)
                 cbs.extend(self._watchers.get(t, ()))
         for cb in cbs:
             cb(index)
 
+    def table_index(self, tables: List[str]) -> int:
+        """Highest commit index across the given tables."""
+        with self._lock:
+            return max((self._table_indexes.get(t, 0) for t in tables), default=0)
+
     def _next_index(self) -> int:
         self._index += 1
         return self._index
+
+    def block_until(self, tables: List[str], min_index: int, timeout: float) -> int:
+        """Block until one of `tables` commits past min_index or the
+        timeout passes; returns those tables' current index. This is the
+        memdb WatchSet + min-index contract behind blocking queries
+        (reference rpc.go:808 blockingRPC). Keyed on per-table indexes
+        so unrelated commits don't wake every watcher."""
+        if self.table_index(tables) > min_index or timeout <= 0:
+            return max(self.table_index(tables), min_index)
+        event = threading.Event()
+        unwatchers = [self.watch(t, lambda _i: event.set()) for t in tables]
+        try:
+            deadline = time.time() + timeout
+            while self.table_index(tables) <= min_index:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                event.wait(remaining)
+                event.clear()
+            return max(self.table_index(tables), min_index)
+        finally:
+            for unwatch in unwatchers:
+                unwatch()
 
     # --- snapshot persist/restore (fsm.go:1393 Snapshot, :1407 Restore) -
 
