@@ -14,6 +14,7 @@ import logging
 import os
 import shutil
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from nomad_tpu.client.task_runner import STATE_DEAD, STATE_PENDING, STATE_RUNNING, TaskRunner
@@ -115,6 +116,60 @@ class AllocRunner:
             name=f"alloc-{self.alloc.id[:8]}",
         )
         self._waiter.start()
+        if self.alloc.deployment_id:
+            threading.Thread(
+                target=self._watch_health, daemon=True,
+                name=f"health-{self.alloc.id[:8]}",
+            ).start()
+
+    def _watch_health(self) -> None:
+        """Deployment health watcher (allocrunner allocHealthWatcher /
+        health_hook.go): healthy once every task has been running
+        continuously for min_healthy_time; unhealthy on task failure or
+        the healthy deadline."""
+        from nomad_tpu.structs.alloc import AllocDeploymentStatus
+        from nomad_tpu.structs.job import UpdateStrategy
+
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job is not None else None
+        update = (tg.update if tg is not None and tg.update is not None
+                  else UpdateStrategy())
+        deadline = time.time() + update.healthy_deadline_s
+        healthy_since = None
+        while time.time() < deadline and not self._destroyed:
+            with self._lock:
+                states = dict(self.task_states)
+            if states and any(s.state == STATE_DEAD and s.failed
+                              for s in states.values()):
+                self._report_health(False)
+                return
+            tasks = (len(tg.tasks) if tg is not None else 0) or 1
+            all_running = (
+                len(states) >= tasks
+                and all(s.state == STATE_RUNNING for s in states.values())
+            )
+            if all_running:
+                healthy_since = healthy_since or time.time()
+                if time.time() - healthy_since >= update.min_healthy_time_s:
+                    self._report_health(True)
+                    return
+            else:
+                healthy_since = None
+            time.sleep(0.05)
+        if not self._destroyed:
+            self._report_health(False)
+
+    def _report_health(self, healthy: bool) -> None:
+        from nomad_tpu.structs.alloc import AllocDeploymentStatus
+
+        updated = self.alloc.copy_skip_job()
+        with self._lock:
+            updated.task_states = dict(self.task_states)
+        updated.client_status = self.client_status()
+        updated.deployment_status = AllocDeploymentStatus(
+            healthy=healthy, timestamp_ns=time.time_ns(),
+        )
+        self.on_alloc_update(updated)
 
     def _wait_all(self) -> None:
         for tr in list(self.task_runners.values()):

@@ -267,6 +267,12 @@ class Client:
 
     def _queue_update(self, alloc: Allocation) -> None:
         with self._update_lock:
+            prior = self._pending_updates.get(alloc.id)
+            if (prior is not None and alloc.deployment_status is None
+                    and prior.deployment_status is not None):
+                # don't let a task-state update clobber an unflushed
+                # deployment-health report
+                alloc.deployment_status = prior.deployment_status
             self._pending_updates[alloc.id] = alloc
 
     def _run_update_batcher(self) -> None:

@@ -1,0 +1,228 @@
+"""Deployment watcher: drives rolling updates, canaries, auto-revert.
+
+Reference behavior: nomad/deploymentwatcher/ -- one watcher per active
+deployment on the leader. Each watcher observes the deployment's allocs
+via blocking queries, records health transitions through the Raft
+boundary (UpdateDeploymentAllocHealth), promotes canaries when
+auto_promote is set, creates follow-up evals so the scheduler places
+the next batch, marks the deployment successful when every group hits
+its desired healthy count, fails it on unhealthy allocs or a blown
+progress deadline, and rolls the job back to the latest stable version
+when auto_revert is set.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from nomad_tpu.server import fsm as fsm_msgs
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Evaluation
+
+LOG = logging.getLogger(__name__)
+
+
+class _Watcher:
+    def __init__(self, parent: "DeploymentsWatcher", deployment_id: str) -> None:
+        self.parent = parent
+        self.server = parent.server
+        self.deployment_id = deployment_id
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"deploy-{deployment_id[:8]}",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        index = 0
+        deadline = None
+        last_healthy = -1
+        promoted = False
+        while not self._stop.is_set():
+            index = self.server.state.block_until(
+                ["allocs", "deployment"], index, timeout=0.5
+            )
+            snap = self.server.state.snapshot()
+            d = snap.deployment_by_id(self.deployment_id)
+            if d is None or not d.active():
+                break
+            if deadline is None:
+                deadline = time.time() + max(
+                    (s.progress_deadline_s for s in d.task_groups.values()),
+                    default=600.0,
+                )
+            try:
+                done, last_healthy, promoted = self._tick(
+                    d, deadline, last_healthy, promoted
+                )
+                if done:
+                    break
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("deployment %s watcher: %s", self.deployment_id, e)
+        self.parent._forget(self.deployment_id)
+
+    def _tick(self, d, deadline: float, last_healthy: int, promoted: bool):
+        """One pass over the deployment's rolled-up counters (the store
+        maintains them from client health reports,
+        updateDeploymentWithAlloc). Returns (done, last_healthy,
+        promoted)."""
+        if any(s.unhealthy_allocs > 0 for s in d.task_groups.values()):
+            self._fail(d, "Failed due to unhealthy allocations")
+            return True, last_healthy, promoted
+        if time.time() > deadline:
+            self._fail(d, "Failed due to progress deadline")
+            return True, last_healthy, promoted
+
+        # auto-promote canaries once they are all healthy
+        if not promoted and d.requires_promotion() and d.has_auto_promote():
+            if all(
+                s.healthy_allocs >= s.desired_canaries
+                for s in d.task_groups.values() if s.desired_canaries > 0
+            ):
+                self.server.raft_apply(
+                    fsm_msgs.DEPLOYMENT_PROMOTE,
+                    {"deployment_id": d.id, "groups": None,
+                     "evals": [self._new_eval(d)]},
+                )
+                return False, last_healthy, True
+
+        # success when every group hit its target
+        if d.task_groups and all(
+            s.healthy_allocs >= s.desired_total
+            for s in d.task_groups.values()
+        ):
+            self.server.raft_apply(
+                fsm_msgs.DEPLOYMENT_STATUS_UPDATE,
+                {
+                    "deployment_id": d.id,
+                    "status": consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+                    "description": "Deployment completed successfully",
+                },
+            )
+            return True, last_healthy, promoted
+
+        # progress: newly healthy allocs unblock the next rolling batch
+        healthy_now = sum(s.healthy_allocs for s in d.task_groups.values())
+        if healthy_now > last_healthy:
+            if last_healthy >= 0:
+                self.server.update_eval(self._new_eval(d))
+            last_healthy = healthy_now
+        return False, last_healthy, promoted
+
+    def _new_eval(self, d) -> Evaluation:
+        return Evaluation(
+            namespace=d.namespace,
+            priority=50,
+            type=consts.JOB_TYPE_SERVICE,
+            triggered_by=consts.EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+            job_id=d.job_id,
+            deployment_id=d.id,
+            status=consts.EVAL_STATUS_PENDING,
+        )
+
+    def _fail(self, d, reason: str) -> None:
+        LOG.info("deployment %s failed: %s", d.id, reason)
+        auto_revert = any(s.auto_revert for s in d.task_groups.values())
+        desc = reason
+        evals = [self._new_eval(d)]
+        self.server.raft_apply(
+            fsm_msgs.DEPLOYMENT_STATUS_UPDATE,
+            {
+                "deployment_id": d.id,
+                "status": consts.DEPLOYMENT_STATUS_FAILED,
+                "description": desc,
+                "evals": evals,
+            },
+        )
+        if auto_revert:
+            self._revert_job(d)
+
+    def _revert_job(self, d) -> None:
+        """deployments_watcher.go auto-revert: re-register the latest
+        stable prior version."""
+        snap = self.server.state.snapshot()
+        current = snap.job_by_id(d.namespace, d.job_id)
+        if current is None:
+            return
+        target = None
+        for version in range(current.version - 1, -1, -1):
+            job = snap.job_by_id_and_version(d.namespace, d.job_id, version)
+            if job is not None and getattr(job, "stable", False):
+                target = job
+                break
+        if target is None:
+            LOG.info("deployment %s: no stable version to revert to", d.id)
+            return
+        reverted = target.copy()
+        LOG.info("deployment %s: auto-reverting %s to version %d",
+                 d.id, d.job_id, target.version)
+        self.server.job_register(reverted)
+
+
+class DeploymentsWatcher:
+    """Tracks active deployments, one watcher each
+    (deployments_watcher.go Watcher)."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+        self._lock = threading.Lock()
+        self._watchers: Dict[str, _Watcher] = {}
+        self._health_seen: Dict[str, Dict[str, bool]] = {}
+        self._enabled = False
+        self._thread: Optional[threading.Thread] = None
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            prev, self._enabled = self._enabled, enabled
+            if not enabled:
+                for w in self._watchers.values():
+                    w.stop()
+                self._watchers.clear()
+                self._health_seen.clear()
+        if enabled and not prev:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="deployments-watcher"
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        index = 0
+        while self._enabled:
+            index = self.server.state.block_until(
+                ["deployment"], index, timeout=0.5
+            )
+            snap = self.server.state.snapshot()
+            with self._lock:
+                if not self._enabled:
+                    return
+                for d in snap.deployments_iter():
+                    if d.active() and d.id not in self._watchers:
+                        self._watchers[d.id] = _Watcher(self, d.id)
+
+    def _forget(self, deployment_id: str) -> None:
+        with self._lock:
+            self._watchers.pop(deployment_id, None)
+            self._health_seen.pop(deployment_id, None)
+
+    def _record(self, deployment_id: str, healthy: List[str], unhealthy: List[str]) -> None:
+        with self._lock:
+            seen = self._health_seen.setdefault(deployment_id, {})
+            for i in healthy:
+                seen[i] = True
+            for i in unhealthy:
+                seen[i] = False
+
+    def _recorded_health(self, deployment_id: str, alloc_id: str) -> Optional[bool]:
+        with self._lock:
+            return self._health_seen.get(deployment_id, {}).get(alloc_id)
+
+    def num_watchers(self) -> int:
+        with self._lock:
+            return len(self._watchers)

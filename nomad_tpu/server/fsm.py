@@ -32,17 +32,24 @@ ALLOC_UPDATE_DESIRED_TRANSITION = "AllocUpdateDesiredTransitionRequestType"
 ALLOC_STOP = "AllocStopRequestType"
 APPLY_PLAN_RESULTS = "ApplyPlanResultsRequestType"
 DEPLOYMENT_STATUS_UPDATE = "DeploymentStatusUpdateRequestType"
+DEPLOYMENT_ALLOC_HEALTH = "DeploymentAllocHealthRequestType"
+DEPLOYMENT_PROMOTE = "DeploymentPromoteRequestType"
+DEPLOYMENT_DELETE = "DeploymentDeleteRequestType"
+ALLOC_DELETE = "AllocDeleteRequestType"
 SCHEDULER_CONFIG = "SchedulerConfigRequestType"
 
 
 class NomadFSM:
     """Applies committed log entries to the state store."""
 
-    def __init__(self, state_store, eval_broker=None, blocked_evals=None) -> None:
+    def __init__(self, state_store, eval_broker=None, blocked_evals=None,
+                 event_broker=None) -> None:
         self.state = state_store
         # leader-only subsystems; disabled instances ignore calls
         self.eval_broker = eval_broker
         self.blocked_evals = blocked_evals
+        # change feed (nomad/stream; events published as applies commit)
+        self.event_broker = event_broker
         self._lock = threading.Lock()
 
     def apply(self, msg_type: str, req: Dict) -> int:
@@ -50,7 +57,52 @@ class NomadFSM:
         if handler is None:
             raise ValueError(f"unknown FSM message type {msg_type}")
         with self._lock:
-            return handler(self, req)
+            index = handler(self, req)
+        self._publish_events(msg_type, req, index)
+        return index
+
+    def _publish_events(self, msg_type: str, req: Dict, index: int) -> None:
+        if self.event_broker is None:
+            return
+        from nomad_tpu.server import stream
+
+        events = []
+
+        def ev(topic, etype, key, payload=None, ns=""):
+            events.append(stream.Event(
+                topic=topic, type=etype, key=key, index=index,
+                payload=payload, namespace=ns,
+            ))
+
+        if msg_type == NODE_REGISTER:
+            ev(stream.TOPIC_NODE, "NodeRegistration", req["node"].id, req["node"])
+        elif msg_type == NODE_DEREGISTER:
+            ev(stream.TOPIC_NODE, "NodeDeregistration", req["node_id"])
+        elif msg_type in (NODE_UPDATE_STATUS, NODE_UPDATE_DRAIN,
+                          NODE_UPDATE_ELIGIBILITY):
+            ev(stream.TOPIC_NODE, "NodeUpdate", req["node_id"])
+        elif msg_type == JOB_REGISTER:
+            job = req["job"]
+            ev(stream.TOPIC_JOB, "JobRegistered", job.id, job, job.namespace)
+        elif msg_type == JOB_DEREGISTER:
+            ev(stream.TOPIC_JOB, "JobDeregistered", req["job_id"],
+               None, req["namespace"])
+        elif msg_type == EVAL_UPDATE:
+            for e in req.get("evals", []):
+                ev(stream.TOPIC_EVAL, "EvaluationUpdated", e.id, e, e.namespace)
+        elif msg_type == ALLOC_CLIENT_UPDATE:
+            for a in req.get("allocs", []):
+                ev(stream.TOPIC_ALLOC, "AllocationUpdated", a.id, a, a.namespace)
+        elif msg_type == APPLY_PLAN_RESULTS:
+            for allocs in req.get("node_allocation", {}).values():
+                for a in allocs:
+                    ev(stream.TOPIC_ALLOC, "PlanResult", a.id, a, a.namespace)
+        elif msg_type in (DEPLOYMENT_STATUS_UPDATE, DEPLOYMENT_ALLOC_HEALTH,
+                          DEPLOYMENT_PROMOTE):
+            ev(stream.TOPIC_DEPLOYMENT, "DeploymentUpdate",
+               req["deployment_id"])
+        if events:
+            self.event_broker.publish(events)
 
     # --- node (fsm.go applyUpsertNode etc.) -----------------------------
 
@@ -65,7 +117,8 @@ class NomadFSM:
 
     def _apply_node_update_drain(self, req: Dict) -> int:
         return self.state.update_node_drain(
-            req["node_id"], req["drain"], req.get("strategy")
+            req["node_id"], req["drain"], req.get("strategy"),
+            req.get("mark_eligible", True),
         )
 
     def _apply_node_update_eligibility(self, req: Dict) -> int:
@@ -75,10 +128,16 @@ class NomadFSM:
 
     # --- job ------------------------------------------------------------
 
+    # set by the server; leader-only (no-op while disabled)
+    periodic_dispatcher = None
+
     def _apply_job_register(self, req: Dict) -> int:
         index = self.state.upsert_job(req["job"])
         for ev in req.get("evals", []):
             self._upsert_eval(ev, index)
+        if self.periodic_dispatcher is not None:
+            # fsm.go applyUpsertJob -> periodicDispatcher.Add
+            self.periodic_dispatcher.add(req["job"])
         return index
 
     def _apply_job_deregister(self, req: Dict) -> int:
@@ -97,6 +156,8 @@ class NomadFSM:
             self._upsert_eval(ev, index)
         if self.blocked_evals is not None:
             self.blocked_evals.untrack(ns, job_id)
+        if self.periodic_dispatcher is not None:
+            self.periodic_dispatcher.remove(ns, job_id)
         return index
 
     # --- evals (fsm.go applyUpdateEval -> upsertEvals) ------------------
@@ -193,6 +254,32 @@ class NomadFSM:
 
     # --- deployment / config --------------------------------------------
 
+    def _apply_deployment_alloc_health(self, req: Dict) -> int:
+        index = self.state.update_deployment_alloc_health(
+            req["deployment_id"],
+            req.get("healthy_ids", []),
+            req.get("unhealthy_ids", []),
+            req.get("deployment_update"),
+            req.get("evals", []),
+        )
+        for ev in req.get("evals", []):
+            self._eval_notify(ev)
+        return index
+
+    def _apply_deployment_promote(self, req: Dict) -> int:
+        index = self.state.update_deployment_promotion(
+            req["deployment_id"], req.get("groups"), req.get("evals", []),
+        )
+        for ev in req.get("evals", []):
+            self._eval_notify(ev)
+        return index
+
+    def _apply_deployment_delete(self, req: Dict) -> int:
+        return self.state.delete_deployments(req["deployment_ids"])
+
+    def _apply_alloc_delete(self, req: Dict) -> int:
+        return self.state.delete_allocs(req["alloc_ids"])
+
     def _apply_deployment_status_update(self, req: Dict) -> int:
         index = self.state.update_deployment_status(
             req["deployment_id"], req["status"], req.get("description", "")
@@ -219,5 +306,9 @@ class NomadFSM:
         ALLOC_STOP: _apply_alloc_stop,
         APPLY_PLAN_RESULTS: _apply_plan_results,
         DEPLOYMENT_STATUS_UPDATE: _apply_deployment_status_update,
+        DEPLOYMENT_ALLOC_HEALTH: _apply_deployment_alloc_health,
+        DEPLOYMENT_PROMOTE: _apply_deployment_promote,
+        DEPLOYMENT_DELETE: _apply_deployment_delete,
+        ALLOC_DELETE: _apply_alloc_delete,
         SCHEDULER_CONFIG: _apply_scheduler_config,
     }

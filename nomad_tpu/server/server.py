@@ -43,6 +43,11 @@ class ServerConfig:
         region: str = "global",
         datacenter: str = "dc1",
         name: str = "server-1",
+        gc_interval: float = 60.0,
+        eval_gc_threshold: float = 3600.0,
+        job_gc_threshold: float = 4 * 3600.0,
+        node_gc_threshold: float = 24 * 3600.0,
+        deployment_gc_threshold: float = 3600.0,
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -54,6 +59,11 @@ class ServerConfig:
         self.region = region
         self.datacenter = datacenter
         self.name = name
+        self.gc_interval = gc_interval
+        self.eval_gc_threshold = eval_gc_threshold
+        self.job_gc_threshold = job_gc_threshold
+        self.node_gc_threshold = node_gc_threshold
+        self.deployment_gc_threshold = deployment_gc_threshold
 
 
 class Server:
@@ -71,7 +81,12 @@ class Server:
             delivery_limit=self.config.eval_delivery_limit,
         )
         self.blocked_evals = BlockedEvals(self.eval_broker.enqueue)
-        self.fsm = NomadFSM(self.state, self.eval_broker, self.blocked_evals)
+        from nomad_tpu.server.stream import EventBroker
+        self.event_broker = EventBroker()
+        self.fsm = NomadFSM(
+            self.state, self.eval_broker, self.blocked_evals,
+            event_broker=self.event_broker,
+        )
         self.plan_queue = PlanQueue()
         self.planner = Planner(
             self.state, self.plan_queue, self.config.plan_pool_workers,
@@ -84,6 +99,21 @@ class Server:
             Worker(self, i, batch_size=self.config.worker_batch_size)
             for i in range(self.config.num_workers)
         ]
+        # leader-only lifecycle subsystems (leader.go establishLeadership
+        # enables: periodic dispatcher, deployment watcher, drainer)
+        from nomad_tpu.server.deployment_watcher import DeploymentsWatcher
+        from nomad_tpu.server.drainer import NodeDrainer
+        from nomad_tpu.server.periodic import PeriodicDispatcher
+        from nomad_tpu.server import core_sched
+        from nomad_tpu.utils.timetable import TimeTable
+
+        self.periodic_dispatcher = PeriodicDispatcher(self)
+        self.deployments_watcher = DeploymentsWatcher(self)
+        self.node_drainer = NodeDrainer(self)
+        self.time_table = TimeTable()
+        self.fsm.periodic_dispatcher = self.periodic_dispatcher
+        core_sched.install(self)
+
         self._leader = False
         self._shutdown = threading.Event()
         self._leader_threads: List[threading.Thread] = []
@@ -92,8 +122,6 @@ class Server:
         # loops from a previous term notice and exit
         self._leadership_lock = threading.Lock()
         self._leader_gen = 0
-        # core scheduler factory, installed by nomad_tpu.server.core_sched
-        self._core_scheduler_factory = None
 
     # --- lifecycle ------------------------------------------------------
 
@@ -157,9 +185,15 @@ class Server:
             self._init_heartbeats()
             for w in self.workers:
                 w.set_pause(False)
+            self.periodic_dispatcher.set_enabled(True)
+            self.periodic_dispatcher.restore(self.state.snapshot())
+            self.deployments_watcher.set_enabled(True)
+            self.node_drainer.set_enabled(True)
             for name, fn, interval in (
                 ("reap-failed-evals", self.reap_failed_evals_once, 0.2),
                 ("reap-dup-blocked", self.reap_dup_blocked_once, 0.2),
+                ("timetable-witness", self._witness_time, 0.5),
+                ("schedule-gc", self.schedule_core_gc, self.config.gc_interval),
             ):
                 t = threading.Thread(
                     target=self._leader_loop, args=(fn, interval, gen),
@@ -182,6 +216,9 @@ class Server:
             self.plan_queue.set_enabled(False)
             self.planner.stop()
             self.heartbeats.set_enabled(False)
+            self.periodic_dispatcher.set_enabled(False)
+            self.deployments_watcher.set_enabled(False)
+            self.node_drainer.set_enabled(False)
             for w in self.workers:
                 w.set_pause(True)
             self._leader_threads.clear()
@@ -514,6 +551,25 @@ class Server:
             )
             self.eval_broker.ack(ev.id, token)
             n += 1
+
+    def _witness_time(self) -> None:
+        self.time_table.witness(self.state.latest_index())
+
+    def schedule_core_gc(self) -> None:
+        """leader.go schedulePeriodic: enqueue the _core GC evals."""
+        from nomad_tpu.server import core_sched
+        for core_job in core_sched.ALL_CORE_JOBS:
+            self.eval_broker.enqueue(core_sched.new_core_eval(core_job))
+
+    def force_gc(self) -> None:
+        """`nomad system gc` (system_endpoint.go): run every collector
+        ignoring thresholds."""
+        from nomad_tpu.server import core_sched
+        sched = core_sched.CoreScheduler(self.state.snapshot(), None, self)
+        sched.eval_gc(force=True)
+        sched.job_gc(force=True)
+        sched.node_gc(force=True)
+        sched.deployment_gc(force=True)
 
     def reap_dup_blocked_once(self) -> int:
         """Cancel duplicate blocked evals (leader.go

@@ -1,0 +1,120 @@
+"""Event broker: the cluster's change feed.
+
+Reference behavior: nomad/stream/ -- an in-memory ring buffer of typed
+events (event_buffer.go) with per-subscriber cursors and topic/key
+filters (event_broker.go:30-260), feeding the ``/v1/event/stream``
+NDJSON endpoint. Events are published by the FSM as applies commit.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+TOPIC_ALL = "*"
+TOPIC_NODE = "Node"
+TOPIC_JOB = "Job"
+TOPIC_EVAL = "Evaluation"
+TOPIC_ALLOC = "Allocation"
+TOPIC_DEPLOYMENT = "Deployment"
+
+
+@dataclass
+class Event:
+    topic: str
+    type: str            # e.g. NodeRegistration, JobRegistered, AllocationUpdated
+    key: str             # entity id
+    index: int
+    payload: object = None
+    namespace: str = ""
+
+
+class Subscription:
+    def __init__(self, broker: "EventBroker", topics: Dict[str, List[str]]) -> None:
+        self._broker = broker
+        # topic -> keys ("*" for all); {"*": ["*"]} subscribes to everything
+        self.topics = topics
+        self._queue: "queue.Queue[Event]" = queue.Queue(maxsize=2048)
+        self.closed = False
+
+    def _matches(self, event: Event) -> bool:
+        for topic, keys in self.topics.items():
+            if topic not in (TOPIC_ALL, event.topic):
+                continue
+            if TOPIC_ALL in keys or event.key in keys:
+                return True
+        return False
+
+    def _offer(self, event: Event) -> None:
+        if not self._matches(event):
+            return
+        try:
+            self._queue.put_nowait(event)
+        except queue.Full:
+            # slow consumer: drop oldest (ring-buffer overwrite semantics)
+            try:
+                self._queue.get_nowait()
+                self._queue.put_nowait(event)
+            except queue.Empty:
+                pass
+
+    def next_events(self, timeout: float = 1.0, max_events: int = 64) -> List[Event]:
+        out: List[Event] = []
+        try:
+            out.append(self._queue.get(timeout=timeout))
+            while len(out) < max_events:
+                out.append(self._queue.get_nowait())
+        except queue.Empty:
+            pass
+        return out
+
+    def close(self) -> None:
+        self.closed = True
+        self._broker.unsubscribe(self)
+
+
+class EventBroker:
+    def __init__(self, buffer_size: int = 4096) -> None:
+        self.buffer_size = buffer_size
+        self._lock = threading.Lock()
+        self._buffer: List[Event] = []        # ring of recent events
+        self._subs: List[Subscription] = []
+        self.latest_index = 0
+
+    def publish(self, events: List[Event]) -> None:
+        if not events:
+            return
+        with self._lock:
+            self._buffer.extend(events)
+            if len(self._buffer) > self.buffer_size:
+                del self._buffer[: len(self._buffer) - self.buffer_size]
+            self.latest_index = max(self.latest_index, events[-1].index)
+            subs = list(self._subs)
+        for sub in subs:
+            for ev in events:
+                sub._offer(ev)
+
+    def subscribe(
+        self,
+        topics: Optional[Dict[str, List[str]]] = None,
+        from_index: int = 0,
+    ) -> Subscription:
+        sub = Subscription(self, topics or {TOPIC_ALL: [TOPIC_ALL]})
+        with self._lock:
+            replay = [e for e in self._buffer if e.index > from_index] \
+                if from_index else []
+            self._subs.append(sub)
+        for ev in replay:
+            sub._offer(ev)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def num_subscribers(self) -> int:
+        with self._lock:
+            return len(self._subs)

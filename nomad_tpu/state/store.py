@@ -140,6 +140,9 @@ class StateSnapshot:
     def deployment_by_id(self, deployment_id: str):
         return self._deployments.get(deployment_id)
 
+    def deployments_iter(self):
+        return self._deployments.values()
+
     def latest_index(self) -> int:
         return self.index
 
@@ -313,7 +316,8 @@ class StateStore:
         self._notify(["nodes"], idx)
         return idx
 
-    def update_node_drain(self, node_id: str, drain: bool, strategy=None) -> int:
+    def update_node_drain(self, node_id: str, drain: bool, strategy=None,
+                          mark_eligible: bool = True) -> int:
         with self._lock:
             idx = self._next_index()
             node = self._nodes.get(node_id)
@@ -321,10 +325,12 @@ class StateStore:
                 node = node.copy()
                 node.drain = drain
                 node.drain_strategy = strategy
-                node.scheduling_eligibility = (
-                    consts.NODE_SCHEDULING_INELIGIBLE if drain
-                    else consts.NODE_SCHEDULING_ELIGIBLE
-                )
+                if drain or not mark_eligible:
+                    # drain completion keeps the node ineligible until
+                    # the operator re-enables (drainer semantics)
+                    node.scheduling_eligibility = consts.NODE_SCHEDULING_INELIGIBLE
+                else:
+                    node.scheduling_eligibility = consts.NODE_SCHEDULING_ELIGIBLE
                 node.modify_index = idx
                 self._nodes[node_id] = node
         self._notify(["nodes"], idx)
@@ -406,6 +412,7 @@ class StateStore:
             a.create_index = idx
         a.modify_index = idx
         self._allocs[a.id] = a
+        self._update_deployment_with_alloc_locked(existing, a, idx)
         self._allocs_by_job.setdefault((a.namespace, a.job_id), set()).add(a.id)
         self._allocs_by_node.setdefault(a.node_id, set()).add(a.id)
         self._allocs_by_eval.setdefault(a.eval_id, set()).add(a.id)
@@ -429,8 +436,41 @@ class StateStore:
                 new.modify_index = idx
                 new.modify_time_ns = update.modify_time_ns
                 self._allocs[new.id] = new
-        self._notify(["allocs"], idx)
+                # health transitions roll up into the deployment
+                # (state_store.go updateDeploymentWithAlloc)
+                self._update_deployment_with_alloc_locked(existing, new, idx)
+        self._notify(["allocs", "deployment"], idx)
         return idx
+
+    def _update_deployment_with_alloc_locked(
+        self, old: Optional[Allocation], new: Allocation, idx: int
+    ) -> None:
+        """Bump DeploymentState counters on placement/health changes
+        (state_store.go updateDeploymentWithAlloc)."""
+        if not new.deployment_id:
+            return
+        d = self._deployments.get(new.deployment_id)
+        if d is None or not d.active():
+            return
+        state = d.task_groups.get(new.task_group)
+        if state is None:
+            return
+        placed = 1 if old is None else 0
+        old_h = old.deployment_status.healthy \
+            if old is not None and old.deployment_status is not None else None
+        new_h = new.deployment_status.healthy \
+            if new.deployment_status is not None else None
+        d_healthy = (1 if new_h is True else 0) - (1 if old_h is True else 0)
+        d_unhealthy = (1 if new_h is False else 0) - (1 if old_h is False else 0)
+        if not (placed or d_healthy or d_unhealthy):
+            return
+        d = d.copy()
+        state = d.task_groups[new.task_group]
+        state.placed_allocs += placed
+        state.healthy_allocs += d_healthy
+        state.unhealthy_allocs += d_unhealthy
+        d.modify_index = idx
+        self._deployments[d.id] = d
 
     def update_allocs_desired_transition(self, transitions: Dict[str, object], evals: List[Evaluation]) -> int:
         """{alloc_id: DesiredTransition} -- drainer/operator migrate
@@ -493,6 +533,109 @@ class StateStore:
                 d.modify_index = idx
                 self._deployments[deployment_id] = d
         self._notify(["deployment"], idx)
+        return idx
+
+    def delete_allocs(self, alloc_ids: List[str]) -> int:
+        """GC path (state_store.go DeleteEval also reaps allocs)."""
+        with self._lock:
+            idx = self._next_index()
+            for aid in alloc_ids:
+                a = self._allocs.pop(aid, None)
+                if a is None:
+                    continue
+                self._allocs_by_job.get((a.namespace, a.job_id), set()).discard(aid)
+                self._allocs_by_node.get(a.node_id, set()).discard(aid)
+                self._allocs_by_eval.get(a.eval_id, set()).discard(aid)
+        self._notify(["allocs"], idx)
+        return idx
+
+    def delete_deployments(self, deployment_ids: List[str]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for did in deployment_ids:
+                self._deployments.pop(did, None)
+        self._notify(["deployment"], idx)
+        return idx
+
+    def update_deployment_alloc_health(
+        self,
+        deployment_id: str,
+        healthy_ids: List[str],
+        unhealthy_ids: List[str],
+        deployment_update: Optional[Dict] = None,
+        evals: Optional[List[Evaluation]] = None,
+    ) -> int:
+        """state_store.go UpdateDeploymentAllocHealth: record per-alloc
+        deployment health and bump the DeploymentState counters."""
+        from nomad_tpu.structs.alloc import AllocDeploymentStatus
+
+        with self._lock:
+            idx = self._next_index()
+            d = self._deployments.get(deployment_id)
+            if d is not None:
+                d = d.copy()
+                for aid, healthy in [(i, True) for i in healthy_ids] + [
+                    (i, False) for i in unhealthy_ids
+                ]:
+                    a = self._allocs.get(aid)
+                    if a is None:
+                        continue
+                    new = a.copy_skip_job()
+                    new.job = a.job
+                    status = new.deployment_status or AllocDeploymentStatus()
+                    was = status.healthy
+                    status.healthy = healthy
+                    status.modify_index = idx
+                    new.deployment_status = status
+                    new.modify_index = idx
+                    self._allocs[aid] = new
+                    state = d.task_groups.get(new.task_group)
+                    if state is not None and was != healthy:
+                        if healthy:
+                            state.healthy_allocs += 1
+                            if was is False:
+                                state.unhealthy_allocs -= 1
+                        else:
+                            state.unhealthy_allocs += 1
+                            if was is True:
+                                state.healthy_allocs -= 1
+                d.modify_index = idx
+                if deployment_update:
+                    d.status = deployment_update.get("status", d.status)
+                    d.status_description = deployment_update.get(
+                        "status_description", d.status_description
+                    )
+                self._deployments[deployment_id] = d
+            for e in evals or []:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["allocs", "deployment", "evals"], idx)
+        return idx
+
+    def update_deployment_promotion(
+        self, deployment_id: str, groups: Optional[List[str]] = None,
+        evals: Optional[List[Evaluation]] = None,
+    ) -> int:
+        """state_store.go UpdateDeploymentPromotion: mark canaries
+        promoted for all (or the given) groups."""
+        with self._lock:
+            idx = self._next_index()
+            d = self._deployments.get(deployment_id)
+            if d is not None:
+                d = d.copy()
+                for name, state in d.task_groups.items():
+                    if groups is None or name in groups:
+                        state.promoted = True
+                d.modify_index = idx
+                self._deployments[deployment_id] = d
+            for e in evals or []:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["deployment", "evals"], idx)
         return idx
 
     def set_scheduler_config(self, config: SchedulerConfiguration) -> int:
