@@ -286,9 +286,18 @@ class GenericScheduler(Scheduler):
                 )
 
             options = self.stack.select_many(tg, requests)
+            preempt_ok = self._preemption_enabled()
 
             for missing, req, option in zip(missings, requests, options):
                 prev = req.prev_alloc
+                if option is None and preempt_ok:
+                    # preemption second pass (generic_sched.go:800-819
+                    # selectNextOption), one slot at a time INSIDE the
+                    # placement loop: each call sees the plan with the
+                    # previous slots' placements and staged evictions,
+                    # so freed capacity and victims are never counted
+                    # twice across slots
+                    option = self.stack.select_preempting(tg, req)
                 if option is None:
                     if tg_name not in self.failed_tg_allocs:
                         m = self.ctx.metrics().copy()
@@ -344,6 +353,17 @@ class GenericScheduler(Scheduler):
                     alloc.previous_allocation = prev.id
                     if getattr(missing, "reschedule", False):
                         _update_reschedule_tracker(alloc, prev, now)
+                # handlePreemptions (generic_sched.go:821-843)
+                if option.preempted_allocs:
+                    preempted_ids = []
+                    for stop in option.preempted_allocs:
+                        self.plan.append_preempted_alloc(stop, alloc.id)
+                        preempted_ids.append(stop.id)
+                        if self.eval.annotate_plan and self.plan.annotations is not None:
+                            desired = self.plan.annotations.desired_tg_updates.get(tg.name)
+                            if desired is not None:
+                                desired.preemptions += 1
+                    alloc.preempted_allocations = preempted_ids
                 if getattr(missing, "canary", False) and self.deployment is not None:
                     from nomad_tpu.structs.alloc import AllocDeploymentStatus
 
@@ -354,6 +374,12 @@ class GenericScheduler(Scheduler):
 
                 self.plan.append_alloc(alloc, None)
         return None
+
+    def _preemption_enabled(self) -> bool:
+        """Scheduler-config preemption toggle for this job type
+        (generic_sched.go:802-812; defaults: service/batch off)."""
+        sched_type = self.job.type if self.job is not None else consts.JOB_TYPE_SERVICE
+        return self.state.scheduler_config.preemption_enabled(sched_type)
 
     def _find_preferred_node(self, tg, prev) -> Optional[str]:
         """Sticky ephemeral disk prefers the previous node

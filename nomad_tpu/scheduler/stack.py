@@ -196,6 +196,131 @@ class XLAGenericStack:
         """Single-placement compatibility entry (stack.go Select)."""
         return self.select_many(tg, [request or SelectRequest()])[0]
 
+    # -- preemption fallback (SelectOptions.Preempt second pass) ---------
+
+    def select_preempting(self, tg, request: Optional[SelectRequest] = None) -> Optional[SelectedOption]:
+        """Place one alloc by evicting lower-priority work.
+
+        Reference: BinPackIterator's preempt branch (rank.go:258-268 area)
+        + PreemptionScoringIterator (rank.go:799), invoked via
+        SelectOptions.Preempt (generic_sched.go:800-819). TPU split:
+        candidate nodes and their upper-bound scores come from one numpy
+        sweep over the planes; the exact greedy eviction set runs only
+        for the ranked top candidates.
+        """
+        from nomad_tpu.scheduler.preemption import (
+            Preemptor,
+            net_priority,
+            preemptible_planes,
+            preemption_score,
+        )
+
+        c = self.cluster
+        snapshot = self.ctx.state
+        job = self.job
+        if job is None:
+            return None
+        ev = self._build_eval_tensors(tg, np.zeros(c.n_pad, bool))
+        ask = ev.ask
+        pre_cpu, pre_mem, pre_disk, pre_score = preemptible_planes(
+            c, snapshot, self.ctx, job.priority, job.namespace, job.id
+        )
+        free_cpu = c.cap_cpu - ev.used_cpu + pre_cpu
+        free_mem = c.cap_mem - ev.used_mem + pre_mem
+        free_disk = c.cap_disk - ev.used_disk + pre_disk
+        cand = (
+            ev.base_mask
+            & ((pre_cpu > 0) | (pre_mem > 0) | (pre_disk > 0))
+            & (free_cpu >= ask.cpu)
+            & (free_mem >= ask.mem)
+            & (free_disk >= ask.disk)
+        )
+        rows = np.nonzero(cand)[0]
+        if rows.size == 0:
+            return None
+
+        # upper-bound score per candidate: binpack fit after hypothetical
+        # full eviction, averaged with the preemption-score plane (the
+        # exact set can only evict less, scoring no worse on fit)
+        util_cpu = ev.used_cpu[rows] - pre_cpu[rows] + ask.cpu
+        util_mem = ev.used_mem[rows] - pre_mem[rows] + ask.mem
+        with np.errstate(divide="ignore", invalid="ignore"):
+            fc = np.where(c.cap_cpu[rows] > 0, 1.0 - util_cpu / c.cap_cpu[rows], 0.0)
+            fm = np.where(c.cap_mem[rows] > 0, 1.0 - util_mem / c.cap_mem[rows], 0.0)
+        total = np.power(10.0, fc) + np.power(10.0, fm)
+        if self.ctx.state.scheduler_config.effective_algorithm() == consts.SCHEDULER_ALGORITHM_SPREAD:
+            fit = np.clip(total - 2.0, 0.0, 18.0) / 18.0
+        else:
+            fit = np.clip(20.0 - total, 0.0, 18.0) / 18.0
+        # rescheduling-penalty / preferred-node planes from the request
+        # (NodeReschedulingPenaltyIterator rank.go:630 appends -1 for
+        # penalized nodes; the preferred node is examined first)
+        request = request or SelectRequest()
+        penalty_rows = {
+            c.index[nid] for nid in request.penalty_nodes if nid in c.index
+        }
+        penalized = np.array([int(r) in penalty_rows for r in rows], bool)
+        est = np.where(
+            penalized,
+            (fit + pre_score[rows] - 1.0) / 3.0,
+            (fit + pre_score[rows]) / 2.0,
+        )
+        preferred_row = c.index.get(request.preferred_node, -1)
+        if preferred_row >= 0:
+            est = np.where(rows == preferred_row, est + 2.0, est)
+        order = np.argsort(-est)
+
+        # LimitIterator semantics: examine a bounded candidate prefix
+        limit = max(2, int(math.log2(max(2, c.n_real))))
+        plan = self.ctx.plan
+        staged = [
+            a for allocs in plan.node_preemptions.values() for a in allocs
+        ]
+        preemptor = Preemptor(job.priority, job.namespace, job.id)
+
+        best_option: Optional[SelectedOption] = None
+        best_score = -float("inf")
+        examined = 0
+        for pos in order:
+            if examined >= limit and best_option is not None:
+                break
+            examined += 1
+            row = int(rows[pos])
+            node = snapshot.node_by_id(c.node_ids[row])
+            if node is None:
+                continue
+            proposed = self.ctx.proposed_allocs(node.id)
+            preemptor.set_node(node)
+            preemptor.set_candidates(proposed)
+            preemptor.set_preemptions(staged)
+            ask_cr = _tg_comparable_ask(tg)
+            victims = preemptor.preempt_for_task_group(ask_cr)
+            if not victims:
+                continue
+            victim_ids = {a.id for a in victims}
+            remaining = [a for a in proposed if a.id not in victim_ids]
+            asg = _NodeAssigner(node, self.ctx, proposed=remaining)
+            option = asg.assign(tg, 0.0)
+            if option is None:
+                continue
+            p_score = preemption_score(net_priority(victims))
+            planes = [float(fit[pos]), p_score]
+            if penalized[pos]:
+                planes.append(-1.0)
+            final = sum(planes) / len(planes)
+            if final > best_score:
+                best_score = final
+                option.final_score = final
+                option.preempted_allocs = victims
+                m = self.ctx.metrics().copy()
+                m.score_meta.append(
+                    (node.id, {"binpack": float(fit[pos]),
+                               "preemption": p_score}, final)
+                )
+                option.metrics = m
+                best_option = option
+        return best_option
+
     # -- tensor builders -------------------------------------------------
 
     def _build_eval_tensors(self, tg, exclude: np.ndarray) -> EvalTensors:
@@ -529,16 +654,29 @@ class XLAGenericStack:
         return m
 
 
+def _tg_comparable_ask(tg) -> "ComparableResources":
+    """Flatten a task group's total ask to ComparableResources (the
+    resourceAsk.Comparable() the Preemptor scores against)."""
+    from nomad_tpu.structs.resources import ComparableResources
+
+    ask = ComparableResources(disk_mb=int(tg.ephemeral_disk.size_mb))
+    for task in tg.tasks:
+        ask.cpu_shares += int(task.resources.cpu)
+        ask.memory_mb += int(task.resources.memory_mb)
+    return ask
+
+
 class _NodeAssigner:
     """Exact per-node assignment of ports, devices, and cores for one or
     more placements on the same chosen node (the tail of
     BinPackIterator.Next, rank.go:280-520, run host-side only for
     selected nodes)."""
 
-    def __init__(self, node, ctx: EvalContext) -> None:
+    def __init__(self, node, ctx: EvalContext, proposed=None) -> None:
         self.node = node
         self.ctx = ctx
-        proposed = ctx.proposed_allocs(node.id)
+        if proposed is None:
+            proposed = ctx.proposed_allocs(node.id)
         self.net_idx = NetworkIndex()
         collide, reason = self.net_idx.set_node(node)
         self.ok = not collide

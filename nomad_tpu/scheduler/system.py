@@ -177,7 +177,7 @@ class SystemScheduler(Scheduler):
                                 a0, "alloc is being updated due to job update"
                             )
                             if self._fits_after_evict(node, tg):
-                                self._place_on(stack, cluster, tg, i, now)
+                                self._place_on(cluster, tg, i, now)
                                 placed += 1
                             else:
                                 m = self.failed_tg_allocs.setdefault(
@@ -194,12 +194,19 @@ class SystemScheduler(Scheduler):
                 if not node_ok or not ev.base_mask[i]:
                     continue
                 if not feasible[i]:
+                    # preemption attempt (scheduler_system.go: system
+                    # preemption defaults on) before reporting exhaustion
+                    if self.state.scheduler_config.preemption_enabled(
+                        self.job.type
+                    ) and self._place_preempting(cluster, tg, i, now):
+                        placed += 1
+                        continue
                     # resource-exhausted eligible node -> failed placement
                     m = self.failed_tg_allocs.setdefault(tg.name, AllocMetric())
                     m.exhausted_node(node, "resources")
                     self.queued_allocs[tg.name] = self.queued_allocs.get(tg.name, 0)
                     continue
-                self._place_on(stack, cluster, tg, i, now)
+                self._place_on(cluster, tg, i, now)
                 placed += 1
             self.queued_allocs.setdefault(tg.name, 0)
 
@@ -217,14 +224,42 @@ class SystemScheduler(Scheduler):
         fit, _, _ = allocs_fit(node, proposed + [probe])
         return fit
 
-    def _place_on(self, stack, cluster, tg, row: int, now: float) -> None:
+    def _place_preempting(self, cluster, tg, row: int, now: float) -> bool:
+        """Evict lower-priority allocs on this node so the system alloc
+        fits (the SystemScheduler preemption branch)."""
+        from nomad_tpu.scheduler.preemption import Preemptor
+        from nomad_tpu.scheduler.stack import _tg_comparable_ask
+
         node = self.state.node_by_id(cluster.node_ids[row])
-        assigner = _NodeAssigner(node, self.ctx)
+        if node is None:
+            return False
+        proposed = self.ctx.proposed_allocs(node.id)
+        preemptor = Preemptor(self.job.priority, self.job.namespace, self.job.id)
+        preemptor.set_node(node)
+        preemptor.set_candidates(proposed)
+        preemptor.set_preemptions(
+            [a for allocs in self.plan.node_preemptions.values() for a in allocs]
+        )
+        victims = preemptor.preempt_for_task_group(_tg_comparable_ask(tg))
+        if not victims:
+            return False
+        victim_ids = {a.id for a in victims}
+        remaining = [a for a in proposed if a.id not in victim_ids]
+        return self._place_on(cluster, tg, row, now,
+                              proposed=remaining, victims=victims)
+
+    def _place_on(self, cluster, tg, row: int, now: float,
+                  proposed=None, victims=None) -> bool:
+        node = self.state.node_by_id(cluster.node_ids[row])
+        assigner = _NodeAssigner(node, self.ctx, proposed=proposed)
         option = assigner.assign(tg, 0.0)
         if option is None:
-            m = self.failed_tg_allocs.setdefault(tg.name, AllocMetric())
-            m.exhausted_node(node, "resources")
-            return
+            # the preempting path's caller records the exhaustion on
+            # fall-through; recording here too would double count
+            if victims is None:
+                m = self.failed_tg_allocs.setdefault(tg.name, AllocMetric())
+                m.exhausted_node(node, "resources")
+            return False
         from nomad_tpu.structs.resources import (
             AllocatedResources,
             AllocatedSharedResources,
@@ -255,7 +290,14 @@ class SystemScheduler(Scheduler):
             create_time_ns=int(now * 1e9),
             modify_time_ns=int(now * 1e9),
         )
+        if victims:
+            preempted_ids = []
+            for stop in victims:
+                self.plan.append_preempted_alloc(stop, alloc.id)
+                preempted_ids.append(stop.id)
+            alloc.preempted_allocations = preempted_ids
         self.plan.append_alloc(alloc, None)
+        return True
 
     def _set_status(self, status: str, desc: str) -> None:
         new_eval = self.eval.copy()

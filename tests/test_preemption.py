@@ -1,0 +1,247 @@
+"""Preemption tests.
+
+Modeled on reference scheduler/preemption_test.go: eligibility delta,
+greedy distance-minimizing victim selection, superset filtering, the
+generic scheduler's preemption second pass, and the system scheduler's
+per-node preemption branch.
+"""
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.preemption import (
+    PRIORITY_DELTA,
+    Preemptor,
+    basic_resource_distance,
+    filter_and_group_preemptible,
+    net_priority,
+    preemption_score,
+)
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.resources import ComparableResources
+
+
+def _alloc_on(node, cpu, mem, priority, job_type=consts.JOB_TYPE_SERVICE,
+              disk=10):
+    j = mock.job()
+    j.priority = priority
+    j.type = job_type
+    a = mock.alloc(job=j)
+    a.job_id = j.id
+    a.node_id = node.id
+    a.client_status = consts.ALLOC_CLIENT_RUNNING
+    tr = a.allocated_resources.tasks["web"]
+    tr.cpu.cpu_shares = cpu
+    tr.memory.memory_mb = mem
+    a.allocated_resources.shared.disk_mb = disk
+    return a
+
+
+class TestPreemptionScoring:
+    def test_basic_resource_distance_exact_match_is_zero(self):
+        ask = ComparableResources(cpu_shares=100, memory_mb=256, disk_mb=10)
+        assert basic_resource_distance(ask, ask) == 0.0
+
+    def test_distance_prefers_closer_alloc(self):
+        ask = ComparableResources(cpu_shares=1000, memory_mb=1000, disk_mb=0)
+        close = ComparableResources(cpu_shares=900, memory_mb=900, disk_mb=0)
+        far = ComparableResources(cpu_shares=100, memory_mb=100, disk_mb=0)
+        assert basic_resource_distance(ask, close) < basic_resource_distance(ask, far)
+
+    def test_preemption_score_logistic(self):
+        # inflection at 2048; low net priority scores near 1
+        assert preemption_score(2048.0) == 0.5
+        assert preemption_score(0.0) > 0.99
+        assert preemption_score(10000.0) < 0.01
+
+    def test_net_priority_penalizes_many_allocs(self):
+        j_lo = mock.job(); j_lo.priority = 20
+        a1 = mock.alloc(job=j_lo)
+        several = [mock.alloc(job=j_lo) for _ in range(5)]
+        assert net_priority(several) > net_priority([a1])
+
+
+class TestEligibility:
+    def test_delta_filter(self):
+        jobs = []
+        for pri in (10, 40, 41, 45, 50):
+            j = mock.job()
+            j.priority = pri
+            jobs.append(mock.alloc(job=j))
+        groups = filter_and_group_preemptible(50, jobs)
+        # only priority 10 and 40 qualify (50 - p >= 10)
+        flat_pris = [pri for pri, _ in groups]
+        assert flat_pris == [10, 40]
+        # lowest priority group first
+        assert groups[0][0] == 10
+
+
+class TestPreemptor:
+    def test_picks_minimal_victim_set(self):
+        node = mock.node()  # 4000 cpu (3900 usable), 8192 mem
+        lo1 = _alloc_on(node, 3000, 6000, priority=10)
+        lo2 = _alloc_on(node, 500, 512, priority=10)
+        p = Preemptor(50, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates([lo1, lo2])
+        # ask fits once lo1 is gone; lo2 need not die
+        victims = p.preempt_for_task_group(
+            ComparableResources(cpu_shares=2000, memory_mb=4000, disk_mb=10)
+        )
+        assert [v.id for v in victims] == [lo1.id]
+
+    def test_no_preemption_when_insufficient(self):
+        node = mock.node()
+        lo = _alloc_on(node, 500, 512, priority=10)
+        hi = _alloc_on(node, 3000, 7000, priority=48)  # delta < 10: protected
+        p = Preemptor(50, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates([lo, hi])
+        victims = p.preempt_for_task_group(
+            ComparableResources(cpu_shares=3500, memory_mb=7000, disk_mb=10)
+        )
+        assert victims == []
+
+    def test_lowest_priority_evicted_first(self):
+        node = mock.node()
+        lo = _alloc_on(node, 1500, 3000, priority=5)
+        mid = _alloc_on(node, 1500, 3000, priority=30)
+        p = Preemptor(50, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates([mid, lo])
+        victims = p.preempt_for_task_group(
+            ComparableResources(cpu_shares=1200, memory_mb=2500, disk_mb=10)
+        )
+        assert [v.id for v in victims] == [lo.id]
+
+    def test_own_job_never_preempted(self):
+        node = mock.node()
+        j = mock.job()
+        j.priority = 10
+        own = mock.alloc(job=j)
+        own.node_id = node.id
+        own.client_status = consts.ALLOC_CLIENT_RUNNING
+        p = Preemptor(50, own.namespace, own.job_id)
+        p.set_node(node)
+        p.set_candidates([own])
+        assert p._current_allocs == []
+
+
+def _packed_cluster(h, n_nodes, fill_priority=10):
+    """Nodes each fully packed by one low-priority alloc."""
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for n in nodes:
+        h.state.upsert_node(n)
+    fillers = []
+    for n in nodes:
+        a = _alloc_on(n, 3500, 7000, priority=fill_priority)
+        fillers.append(a)
+    h.state.upsert_allocs(fillers)
+    return nodes, fillers
+
+
+class TestSchedulerPreemption:
+    def test_service_preempts_when_enabled(self):
+        h = Harness()
+        h.state.scheduler_config.preemption_service_enabled = True
+        nodes, fillers = _packed_cluster(h, 3)
+
+        job = mock.simple_job()
+        job.priority = 100
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 4000
+        h.state.upsert_job(job)
+        ev = mock.eval(job_id=job.id, namespace=job.namespace,
+                       type=job.type, priority=job.priority,
+                       triggered_by=consts.EVAL_TRIGGER_JOB_REGISTER)
+        h.state.upsert_evals([ev])
+        h.process(job.type, ev)
+
+        placed = h.placed_allocs()
+        assert len(placed) == 1
+        assert placed[0].preempted_allocations
+        # a preemption landed in the plan
+        plan = h.plans[-1]
+        victims = [a for allocs in plan.node_preemptions.values() for a in allocs]
+        assert len(victims) >= 1
+        assert victims[0].desired_status == consts.ALLOC_DESIRED_EVICT
+        assert victims[0].preempted_by_allocation == placed[0].id
+        # eviction and placement agree on the node
+        assert victims[0].node_id == placed[0].node_id
+
+    def test_service_no_preempt_when_disabled(self):
+        h = Harness()
+        h.state.scheduler_config.preemption_service_enabled = False
+        _packed_cluster(h, 3)
+        job = mock.simple_job()
+        job.priority = 100
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 4000
+        h.state.upsert_job(job)
+        ev = mock.eval(job_id=job.id, namespace=job.namespace,
+                       type=job.type, priority=job.priority,
+                       triggered_by=consts.EVAL_TRIGGER_JOB_REGISTER)
+        h.state.upsert_evals([ev])
+        h.process(job.type, ev)
+        assert len(h.placed_allocs()) == 0
+
+    def test_low_priority_job_cannot_preempt(self):
+        h = Harness()
+        h.state.scheduler_config.preemption_service_enabled = True
+        _packed_cluster(h, 2, fill_priority=50)
+        job = mock.simple_job()
+        job.priority = 55  # delta < 10
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 4000
+        h.state.upsert_job(job)
+        ev = mock.eval(job_id=job.id, namespace=job.namespace,
+                       type=job.type, priority=job.priority,
+                       triggered_by=consts.EVAL_TRIGGER_JOB_REGISTER)
+        h.state.upsert_evals([ev])
+        h.process(job.type, ev)
+        assert len(h.placed_allocs()) == 0
+
+    def test_system_job_preempts(self):
+        h = Harness()
+        # system preemption defaults on
+        nodes, fillers = _packed_cluster(h, 2)
+        job = mock.system_job()
+        job.priority = 100
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 4000
+        h.state.upsert_job(job)
+        ev = mock.eval(job_id=job.id, namespace=job.namespace,
+                       type=job.type, priority=job.priority,
+                       triggered_by=consts.EVAL_TRIGGER_JOB_REGISTER)
+        h.state.upsert_evals([ev])
+        h.process(job.type, ev)
+        placed = h.placed_allocs()
+        assert len(placed) == 2  # one per node, both via preemption
+        for a in placed:
+            assert a.preempted_allocations
+        plan = h.plans[-1]
+        victims = [a for allocs in plan.node_preemptions.values() for a in allocs]
+        assert len(victims) == 2
+
+    def test_preempted_allocs_freed_in_state(self):
+        """Plan apply must upsert preempted allocs as evicted."""
+        h = Harness()
+        h.state.scheduler_config.preemption_service_enabled = True
+        nodes, fillers = _packed_cluster(h, 1)
+        job = mock.simple_job()
+        job.priority = 100
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 4000
+        h.state.upsert_job(job)
+        ev = mock.eval(job_id=job.id, namespace=job.namespace,
+                       type=job.type, priority=job.priority,
+                       triggered_by=consts.EVAL_TRIGGER_JOB_REGISTER)
+        h.state.upsert_evals([ev])
+        h.process(job.type, ev)
+        snap = h.state.snapshot()
+        evicted = snap.alloc_by_id(fillers[0].id)
+        assert evicted is not None
+        assert evicted.desired_status == consts.ALLOC_DESIRED_EVICT
