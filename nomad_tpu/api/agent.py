@@ -1,0 +1,124 @@
+"""The merged agent process: server and/or client plus the HTTP API.
+
+Reference behavior: command/agent/agent.go — NewAgent (:122) builds
+server (setupServer :731) and/or client (setupClient :906) from one
+merged config, then NewHTTPServers (http.go:86) exposes /v1.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class AgentConfig:
+    """Merged agent configuration (command/agent/config.go:39)."""
+
+    name: str = "agent-1"
+    region: str = "global"
+    datacenter: str = "dc1"
+    bind_addr: str = "127.0.0.1"
+    http_port: int = 0            # 0 = ephemeral (reference default 4646)
+    server_enabled: bool = True
+    client_enabled: bool = False
+    dev_mode: bool = False
+    acl_enabled: bool = False
+    num_schedulers: int = 2
+    node_class: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def dev(cls) -> "AgentConfig":
+        """-dev preset: server + client in one process."""
+        return cls(server_enabled=True, client_enabled=True, dev_mode=True)
+
+
+class Agent:
+    def __init__(self, config: Optional[AgentConfig] = None) -> None:
+        self.config = config or AgentConfig()
+        self.server = None
+        self.client = None
+        self.http = None
+        self.acl_resolver = None
+
+        if self.config.server_enabled:
+            self._setup_server()
+        if self.config.client_enabled:
+            self._setup_client()
+
+        from nomad_tpu.api.http import HTTPAgent
+
+        self.http = HTTPAgent(
+            self, bind=self.config.bind_addr, port=self.config.http_port
+        )
+
+    def _setup_server(self) -> None:
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        cfg = ServerConfig(
+            num_workers=self.config.num_schedulers,
+            region=self.config.region,
+            datacenter=self.config.datacenter,
+            name=self.config.name,
+        )
+        self.server = Server(cfg)
+        if self.config.acl_enabled:
+            from nomad_tpu.acl.resolver import TokenResolver
+
+            self.acl_resolver = TokenResolver(self.server)
+        # default namespace always exists (reference creates it on boot)
+        from nomad_tpu.structs.namespace import Namespace
+
+        self.server.state.upsert_namespace(
+            Namespace(name="default", description="Default shared namespace")
+        )
+
+    def _setup_client(self) -> None:
+        from nomad_tpu.client.client import Client, ClientConfig, InProcessRPC
+
+        if self.server is None:
+            raise ValueError(
+                "client-only agents need a server address (in-process "
+                "agent requires server_enabled)"
+            )
+        cfg = ClientConfig(
+            node_class=self.config.node_class,
+        )
+        self.client = Client(InProcessRPC(self.server), cfg)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self.server is not None:
+            self.server.start()
+            if self.server.raft is None:
+                # standalone server is immediately the authority
+                self.server.establish_leadership()
+        if self.client is not None:
+            self.client.start()
+        self.http.start()
+
+    def shutdown(self) -> None:
+        if self.client is not None:
+            self.client.shutdown()
+        if self.server is not None:
+            self.server.shutdown()
+        if self.http is not None:
+            self.http.shutdown()
+
+    @property
+    def http_addr(self) -> str:
+        return self.http.addr
+
+    def members(self) -> List[Dict]:
+        serf = getattr(self, "_serf", None)
+        if serf is not None:
+            return serf.members()
+        return [{
+            "Name": self.config.name, "Status": "alive",
+            "Addr": self.http.addr if self.http else "",
+            "Tags": {"region": self.config.region,
+                     "dc": self.config.datacenter},
+        }]

@@ -1,0 +1,411 @@
+"""Python SDK for the /v1 API (the api/ Go module analog).
+
+Reference behavior: api/api.go:448 Client — per-endpoint typed
+helpers, QueryOptions with blocking-query support (WaitIndex/WaitTime),
+WriteOptions with namespace/token, event-stream decoding.
+
+Usage::
+
+    c = APIClient("http://127.0.0.1:4646")
+    c.jobs.register(job_dict)
+    for ev in c.events.stream(topics={"Job": ["*"]}):
+        ...
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+@dataclass
+class QueryOptions:
+    """api.QueryOptions: blocking + filtering knobs."""
+
+    namespace: str = ""
+    wait_index: int = 0
+    wait_time_s: float = 0.0
+    prefix: str = ""
+    auth_token: str = ""
+    params: Dict[str, str] = field(default_factory=dict)
+
+
+class APIClient:
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 token: str = "", namespace: str = "default",
+                 timeout: float = 305.0) -> None:
+        self.address = address.rstrip("/")
+        self.token = token
+        self.namespace = namespace
+        self.timeout = timeout
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.allocations = Allocations(self)
+        self.evaluations = Evaluations(self)
+        self.deployments = Deployments(self)
+        self.system = System(self)
+        self.operator = Operator(self)
+        self.agent = AgentAPI(self)
+        self.search = Search(self)
+        self.namespaces = Namespaces(self)
+        self.acl = ACLAPI(self)
+        self.events = Events(self)
+        self.scaling = Scaling(self)
+
+    # -- transport -------------------------------------------------------
+
+    def _url(self, path: str, q: Optional[QueryOptions] = None) -> str:
+        params: Dict[str, str] = {}
+        ns = (q.namespace if q and q.namespace else self.namespace)
+        if ns:
+            params["namespace"] = ns
+        if q is not None:
+            if q.wait_index:
+                params["index"] = str(q.wait_index)
+            if q.wait_time_s:
+                params["wait"] = f"{q.wait_time_s}s"
+            if q.prefix:
+                params["prefix"] = q.prefix
+            params.update(q.params)
+        qs = urllib.parse.urlencode(params)
+        return f"{self.address}{path}" + (f"?{qs}" if qs else "")
+
+    def request(self, method: str, path: str, body: Any = None,
+                q: Optional[QueryOptions] = None) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self._url(path, q), data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        token = (q.auth_token if q and q.auth_token else self.token)
+        if token:
+            req.add_header("X-Nomad-Token", token)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else None
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+                msg = payload.get("error", str(payload))
+            except Exception:
+                msg = str(e)
+            raise APIError(e.code, msg) from None
+
+    def get(self, path: str, q: Optional[QueryOptions] = None) -> Any:
+        return self.request("GET", path, None, q)
+
+    def put(self, path: str, body: Any = None, q: Optional[QueryOptions] = None) -> Any:
+        return self.request("PUT", path, body, q)
+
+    def post(self, path: str, body: Any = None, q: Optional[QueryOptions] = None) -> Any:
+        return self.request("POST", path, body, q)
+
+    def delete(self, path: str, q: Optional[QueryOptions] = None) -> Any:
+        return self.request("DELETE", path, None, q)
+
+
+class _Endpoint:
+    def __init__(self, client: APIClient) -> None:
+        self.c = client
+
+
+class Jobs(_Endpoint):
+    def list(self, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get("/v1/jobs", q)
+
+    def register(self, job: Dict, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.put("/v1/jobs", {"Job": job}, q)
+
+    def info(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/job/{job_id}", q)
+
+    def deregister(self, job_id: str, purge: bool = False,
+                   q: Optional[QueryOptions] = None) -> Dict:
+        q = q or QueryOptions()
+        if purge:
+            q.params["purge"] = "true"
+        return self.c.delete(f"/v1/job/{job_id}", q)
+
+    def plan(self, job: Dict, diff: bool = False,
+             q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.put(f"/v1/job/{job['ID']}/plan",
+                          {"Job": job, "Diff": diff}, q)
+
+    def allocations(self, job_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get(f"/v1/job/{job_id}/allocations", q)
+
+    def evaluations(self, job_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get(f"/v1/job/{job_id}/evaluations", q)
+
+    def deployments(self, job_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get(f"/v1/job/{job_id}/deployments", q)
+
+    def summary(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/job/{job_id}/summary", q)
+
+    def versions(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/job/{job_id}/versions", q)
+
+    def revert(self, job_id: str, version: int,
+               q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/job/{job_id}/revert",
+                           {"JobID": job_id, "JobVersion": version}, q)
+
+    def stable(self, job_id: str, version: int, stable: bool,
+               q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/job/{job_id}/stable",
+                           {"JobVersion": version, "Stable": stable}, q)
+
+    def dispatch(self, job_id: str, meta: Optional[Dict] = None,
+                 payload: bytes = b"", q: Optional[QueryOptions] = None) -> Dict:
+        import base64
+
+        return self.c.post(
+            f"/v1/job/{job_id}/dispatch",
+            {"Meta": meta or {},
+             "Payload": base64.b64encode(payload).decode()}, q,
+        )
+
+    def scale(self, job_id: str, group: str, count: int, message: str = "",
+              q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(
+            f"/v1/job/{job_id}/scale",
+            {"Target": {"Group": group}, "Count": count, "Message": message}, q,
+        )
+
+    def scale_status(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/job/{job_id}/scale", q)
+
+    def periodic_force(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/job/{job_id}/periodic/force", {}, q)
+
+    def parse(self, hcl: str) -> Dict:
+        return self.c.post("/v1/jobs/parse", {"JobHCL": hcl})
+
+
+class Nodes(_Endpoint):
+    def list(self, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get("/v1/nodes", q)
+
+    def info(self, node_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/node/{node_id}", q)
+
+    def allocations(self, node_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get(f"/v1/node/{node_id}/allocations", q)
+
+    def drain(self, node_id: str, enable: bool = True,
+              deadline_s: float = 0.0, ignore_system: bool = False,
+              q: Optional[QueryOptions] = None) -> Dict:
+        spec = None
+        if enable:
+            spec = {"Deadline": int(deadline_s * 1e9),
+                    "IgnoreSystemJobs": ignore_system}
+        return self.c.post(f"/v1/node/{node_id}/drain", {"DrainSpec": spec}, q)
+
+    def eligibility(self, node_id: str, eligible: bool,
+                    q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(
+            f"/v1/node/{node_id}/eligibility",
+            {"Eligibility": "eligible" if eligible else "ineligible"}, q,
+        )
+
+    def evaluate(self, node_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/node/{node_id}/evaluate", {}, q)
+
+    def purge(self, node_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/node/{node_id}/purge", {}, q)
+
+
+class Allocations(_Endpoint):
+    def list(self, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get("/v1/allocations", q)
+
+    def info(self, alloc_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/allocation/{alloc_id}", q)
+
+    def stop(self, alloc_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/allocation/{alloc_id}/stop", {}, q)
+
+
+class Evaluations(_Endpoint):
+    def list(self, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get("/v1/evaluations", q)
+
+    def info(self, eval_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/evaluation/{eval_id}", q)
+
+    def allocations(self, eval_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get(f"/v1/evaluation/{eval_id}/allocations", q)
+
+
+class Deployments(_Endpoint):
+    def list(self, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get("/v1/deployments", q)
+
+    def info(self, deployment_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/deployment/{deployment_id}", q)
+
+    def fail(self, deployment_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/deployment/fail/{deployment_id}", {}, q)
+
+    def pause(self, deployment_id: str, pause: bool = True,
+              q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/deployment/pause/{deployment_id}",
+                           {"Pause": pause}, q)
+
+    def promote(self, deployment_id: str, groups: Optional[List[str]] = None,
+                q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(
+            f"/v1/deployment/promote/{deployment_id}",
+            {"All": groups is None, "Groups": groups}, q,
+        )
+
+
+class System(_Endpoint):
+    def gc(self) -> None:
+        self.c.put("/v1/system/gc")
+
+    def reconcile_summaries(self) -> None:
+        self.c.put("/v1/system/reconcile/summaries")
+
+
+class Operator(_Endpoint):
+    def scheduler_config(self) -> Dict:
+        return self.c.get("/v1/operator/scheduler/configuration")
+
+    def set_scheduler_config(self, config: Dict) -> Dict:
+        return self.c.put("/v1/operator/scheduler/configuration", config)
+
+    def raft_configuration(self) -> Dict:
+        return self.c.get("/v1/operator/raft/configuration")
+
+    def snapshot_save(self) -> bytes:
+        import base64
+
+        res = self.c.get("/v1/operator/snapshot")
+        return base64.b64decode(res["Snapshot"])
+
+    def snapshot_restore(self, data: bytes) -> Dict:
+        import base64
+
+        return self.c.put("/v1/operator/snapshot",
+                          {"Snapshot": base64.b64encode(data).decode()})
+
+
+class AgentAPI(_Endpoint):
+    def self(self) -> Dict:
+        return self.c.get("/v1/agent/self")
+
+    def health(self) -> Dict:
+        return self.c.get("/v1/agent/health")
+
+    def members(self) -> Dict:
+        return self.c.get("/v1/agent/members")
+
+    def metrics(self) -> Dict:
+        return self.c.get("/v1/metrics")
+
+
+class Search(_Endpoint):
+    def prefix(self, prefix: str, context: str = "all",
+               q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post("/v1/search",
+                           {"Prefix": prefix, "Context": context}, q)
+
+    def fuzzy(self, text: str, context: str = "all",
+              q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post("/v1/search/fuzzy",
+                           {"Text": text, "Context": context}, q)
+
+
+class Namespaces(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/namespaces")
+
+    def info(self, name: str) -> Dict:
+        return self.c.get(f"/v1/namespace/{name}")
+
+    def register(self, name: str, description: str = "") -> Dict:
+        return self.c.put(f"/v1/namespace/{name}",
+                          {"Name": name, "Description": description})
+
+    def delete(self, name: str) -> Dict:
+        return self.c.delete(f"/v1/namespace/{name}")
+
+
+class Scaling(_Endpoint):
+    def policies(self) -> List[Dict]:
+        return self.c.get("/v1/scaling/policies")
+
+    def policy(self, policy_id: str) -> Dict:
+        return self.c.get(f"/v1/scaling/policy/{policy_id}")
+
+
+class ACLAPI(_Endpoint):
+    def bootstrap(self) -> Dict:
+        return self.c.post("/v1/acl/bootstrap")
+
+    def policies(self) -> List[Dict]:
+        return self.c.get("/v1/acl/policies")
+
+    def policy(self, name: str) -> Dict:
+        return self.c.get(f"/v1/acl/policy/{name}")
+
+    def put_policy(self, name: str, rules: str, description: str = "") -> Dict:
+        return self.c.put(f"/v1/acl/policy/{name}",
+                          {"Rules": rules, "Description": description})
+
+    def delete_policy(self, name: str) -> Dict:
+        return self.c.delete(f"/v1/acl/policy/{name}")
+
+    def tokens(self) -> List[Dict]:
+        return self.c.get("/v1/acl/tokens")
+
+    def create_token(self, name: str = "", type: str = "client",
+                     policies: Optional[List[str]] = None,
+                     global_: bool = False) -> Dict:
+        return self.c.put("/v1/acl/token", {
+            "Name": name, "Type": type, "Policies": policies or [],
+            "Global": global_,
+        })
+
+    def self_token(self) -> Dict:
+        return self.c.get("/v1/acl/token/self")
+
+    def delete_token(self, accessor_id: str) -> Dict:
+        return self.c.delete(f"/v1/acl/token/{accessor_id}")
+
+
+class Events(_Endpoint):
+    def stream(self, topics: Optional[Dict[str, List[str]]] = None,
+               index: int = 0, timeout: float = 60.0) -> Iterator[Dict]:
+        """Yield event batches from /v1/event/stream (NDJSON frames)."""
+        params = []
+        for topic, keys in (topics or {"*": ["*"]}).items():
+            for key in keys:
+                params.append(("topic", f"{topic}:{key}"))
+        if index:
+            params.append(("index", str(index)))
+        qs = urllib.parse.urlencode(params)
+        req = urllib.request.Request(
+            f"{self.c.address}/v1/event/stream?{qs}",
+            headers={"X-Nomad-Token": self.c.token} if self.c.token else {},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line or line == b"{}":
+                    continue
+                yield json.loads(line)

@@ -1,0 +1,1125 @@
+"""The /v1 HTTP API agent.
+
+Reference: command/agent/http.go — registerHandlers (:321-411) route
+table, wrap() error handling, blocking-query parameters
+(parseWait/parseConsistency), NDJSON event streaming, and the merged
+server+client agent process.
+
+Implementation: stdlib ThreadingHTTPServer + a regex route table. Each
+handler receives a Request carrying path params, query, decoded JSON
+body, and the resolved ACL token; blocking queries ride
+StateStore.block_until (the memdb WatchSet analog).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.api.codec import decode, encode
+from nomad_tpu.server import endpoints
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.job import Job
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    """One parsed HTTP request handed to route handlers."""
+
+    def __init__(self, method: str, path: str, params: Dict[str, str],
+                 query: Dict[str, List[str]], body: Optional[Any],
+                 token: str, handler: BaseHTTPRequestHandler) -> None:
+        self.method = method
+        self.path = path
+        self.params = params
+        self.query = query
+        self.body = body
+        self.token = token
+        self.handler = handler
+
+    def q(self, name: str, default: str = "") -> str:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def flag(self, name: str) -> bool:
+        return self.q(name) not in ("", "false", "0")
+
+    @property
+    def namespace(self) -> str:
+        return self.q("namespace", "default")
+
+    def wait_params(self) -> Tuple[int, float]:
+        """parseWait: ?index=N&wait=Dur -> (min_index, timeout_s)."""
+        index = int(self.q("index", "0") or 0)
+        wait = self.q("wait", "")
+        timeout = 300.0
+        if wait:
+            m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", wait)
+            if m:
+                mult = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2) or "s"]
+                timeout = float(m.group(1)) * mult
+        return index, min(timeout, 600.0)
+
+
+class HTTPAgent:
+    """Routes + lifecycle for one agent's HTTP server."""
+
+    def __init__(self, agent, bind: str = "127.0.0.1", port: int = 0) -> None:
+        self.agent = agent
+        self._routes: List[Tuple[str, re.Pattern, Callable]] = []
+        self._register_routes()
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _dispatch(self, method: str) -> None:
+                outer._handle(self, method)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self.httpd = ThreadingHTTPServer((bind, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.addr = f"http://{self.httpd.server_address[0]}:{self.httpd.server_address[1]}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="http-agent", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- request plumbing (http.go wrap()) -------------------------------
+
+    def _handle(self, handler: BaseHTTPRequestHandler, method: str) -> None:
+        parsed = urllib.parse.urlparse(handler.path)
+        path = parsed.path
+        query = urllib.parse.parse_qs(parsed.query)
+        body = None
+        length = int(handler.headers.get("Content-Length") or 0)
+        if length:
+            raw = handler.rfile.read(length)
+            if raw:
+                try:
+                    body = json.loads(raw)
+                except json.JSONDecodeError:
+                    body = raw
+        token = handler.headers.get("X-Nomad-Token", "")
+        if not token:
+            auth = handler.headers.get("Authorization", "")
+            if auth.startswith("Bearer "):
+                token = auth[7:]
+
+        for route_method, pattern, fn in self._routes:
+            if route_method != method:
+                continue
+            m = pattern.fullmatch(path)
+            if m is None:
+                continue
+            req = Request(method, path, m.groupdict(), query, body, token, handler)
+            try:
+                result = fn(req)
+            except HTTPError as e:
+                self._send(handler, e.status, {"error": e.message})
+            except PermissionError as e:
+                self._send(handler, 403, {"error": str(e)})
+            except KeyError as e:
+                self._send(handler, 404, {"error": str(e)})
+            except (ValueError, TypeError) as e:
+                self._send(handler, 400, {"error": str(e)})
+            except Exception as e:  # wrap(): 500 + message
+                self._send(handler, 500, {"error": f"{type(e).__name__}: {e}"})
+            else:
+                if result is not StreamedResponse:
+                    status, payload = result if isinstance(result, tuple) else (200, result)
+                    self._send(handler, status, payload)
+            return
+        self._send(handler, 404, {"error": f"no handler for {method} {path}"})
+
+    def _send(self, handler, status: int, payload) -> None:
+        try:
+            data = json.dumps(encode(payload)).encode()
+            handler.send_response(status)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(data)))
+            idx = self.agent.server.state.latest_index() if self.agent.server else 0
+            handler.send_header("X-Nomad-Index", str(idx))
+            handler.end_headers()
+            handler.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _block(self, req: Request, tables: List[str]) -> None:
+        """Blocking query: wait until any listed table passes ?index."""
+        min_index, timeout = req.wait_params()
+        if min_index > 0 and self.agent.server is not None:
+            self.agent.server.state.block_until(tables, min_index + 1, timeout)
+
+    # -- ACL gate --------------------------------------------------------
+
+    def _acl(self, req: Request, check: str, *args) -> None:
+        """Resolve + enforce (nomad/acl.go ResolveToken). No-op until
+        ACLs are enabled on the agent."""
+        resolver = getattr(self.agent, "acl_resolver", None)
+        if resolver is None:
+            return
+        acl = resolver.resolve(req.token)
+        if not getattr(acl, check)(*args):
+            raise HTTPError(403, "Permission denied")
+
+    @property
+    def _server(self):
+        s = self.agent.server
+        if s is None:
+            raise HTTPError(400, "server is not enabled on this agent")
+        return s
+
+    # -- route table (http.go:321-411) -----------------------------------
+
+    def _register_routes(self) -> None:
+        def add(method: str, pattern: str, fn) -> None:
+            self._routes.append((method, re.compile(pattern), fn))
+
+        # jobs
+        add("GET", r"/v1/jobs", self.jobs_list)
+        add("PUT", r"/v1/jobs", self.job_register)
+        add("POST", r"/v1/jobs", self.job_register)
+        add("POST", r"/v1/jobs/parse", self.jobs_parse)
+        add("GET", r"/v1/job/(?P<id>[^/]+)", self.job_get)
+        add("POST", r"/v1/job/(?P<id>[^/]+)", self.job_update)
+        add("PUT", r"/v1/job/(?P<id>[^/]+)", self.job_update)
+        add("DELETE", r"/v1/job/(?P<id>[^/]+)", self.job_delete)
+        add("PUT", r"/v1/job/(?P<id>[^/]+)/plan", self.job_plan)
+        add("POST", r"/v1/job/(?P<id>[^/]+)/plan", self.job_plan)
+        add("GET", r"/v1/job/(?P<id>[^/]+)/allocations", self.job_allocs)
+        add("GET", r"/v1/job/(?P<id>[^/]+)/evaluations", self.job_evals)
+        add("GET", r"/v1/job/(?P<id>[^/]+)/deployments", self.job_deployments)
+        add("GET", r"/v1/job/(?P<id>[^/]+)/deployment", self.job_latest_deployment)
+        add("GET", r"/v1/job/(?P<id>[^/]+)/summary", self.job_summary)
+        add("GET", r"/v1/job/(?P<id>[^/]+)/versions", self.job_versions)
+        add("POST", r"/v1/job/(?P<id>[^/]+)/revert", self.job_revert)
+        add("PUT", r"/v1/job/(?P<id>[^/]+)/revert", self.job_revert)
+        add("POST", r"/v1/job/(?P<id>[^/]+)/stable", self.job_stable)
+        add("PUT", r"/v1/job/(?P<id>[^/]+)/stable", self.job_stable)
+        add("POST", r"/v1/job/(?P<id>[^/]+)/dispatch", self.job_dispatch)
+        add("PUT", r"/v1/job/(?P<id>[^/]+)/dispatch", self.job_dispatch)
+        add("POST", r"/v1/job/(?P<id>[^/]+)/scale", self.job_scale)
+        add("PUT", r"/v1/job/(?P<id>[^/]+)/scale", self.job_scale)
+        add("GET", r"/v1/job/(?P<id>[^/]+)/scale", self.job_scale_status)
+        add("POST", r"/v1/job/(?P<id>[^/]+)/periodic/force", self.job_periodic_force)
+        add("PUT", r"/v1/job/(?P<id>[^/]+)/periodic/force", self.job_periodic_force)
+
+        # nodes
+        add("GET", r"/v1/nodes", self.nodes_list)
+        add("GET", r"/v1/node/(?P<id>[^/]+)", self.node_get)
+        add("GET", r"/v1/node/(?P<id>[^/]+)/allocations", self.node_allocs)
+        add("POST", r"/v1/node/(?P<id>[^/]+)/drain", self.node_drain)
+        add("PUT", r"/v1/node/(?P<id>[^/]+)/drain", self.node_drain)
+        add("POST", r"/v1/node/(?P<id>[^/]+)/eligibility", self.node_eligibility)
+        add("PUT", r"/v1/node/(?P<id>[^/]+)/eligibility", self.node_eligibility)
+        add("POST", r"/v1/node/(?P<id>[^/]+)/evaluate", self.node_evaluate)
+        add("PUT", r"/v1/node/(?P<id>[^/]+)/evaluate", self.node_evaluate)
+        add("POST", r"/v1/node/(?P<id>[^/]+)/purge", self.node_purge)
+        add("PUT", r"/v1/node/(?P<id>[^/]+)/purge", self.node_purge)
+
+        # allocations
+        add("GET", r"/v1/allocations", self.allocs_list)
+        add("GET", r"/v1/allocation/(?P<id>[^/]+)", self.alloc_get)
+        add("POST", r"/v1/allocation/(?P<id>[^/]+)/stop", self.alloc_stop)
+        add("PUT", r"/v1/allocation/(?P<id>[^/]+)/stop", self.alloc_stop)
+
+        # evaluations
+        add("GET", r"/v1/evaluations", self.evals_list)
+        add("GET", r"/v1/evaluation/(?P<id>[^/]+)", self.eval_get)
+        add("GET", r"/v1/evaluation/(?P<id>[^/]+)/allocations", self.eval_allocs)
+
+        # deployments
+        add("GET", r"/v1/deployments", self.deployments_list)
+        add("GET", r"/v1/deployment/(?P<id>[^/]+)", self.deployment_get)
+        add("GET", r"/v1/deployment/allocations/(?P<id>[^/]+)", self.deployment_allocs)
+        add("POST", r"/v1/deployment/fail/(?P<id>[^/]+)", self.deployment_fail)
+        add("PUT", r"/v1/deployment/fail/(?P<id>[^/]+)", self.deployment_fail)
+        add("POST", r"/v1/deployment/pause/(?P<id>[^/]+)", self.deployment_pause)
+        add("PUT", r"/v1/deployment/pause/(?P<id>[^/]+)", self.deployment_pause)
+        add("POST", r"/v1/deployment/promote/(?P<id>[^/]+)", self.deployment_promote)
+        add("PUT", r"/v1/deployment/promote/(?P<id>[^/]+)", self.deployment_promote)
+
+        # status / agent / operator
+        add("GET", r"/v1/status/leader", self.status_leader)
+        add("GET", r"/v1/status/peers", self.status_peers)
+        add("GET", r"/v1/agent/self", self.agent_self)
+        add("GET", r"/v1/agent/health", self.agent_health)
+        add("GET", r"/v1/agent/members", self.agent_members)
+        add("GET", r"/v1/agent/servers", self.agent_servers)
+        add("GET", r"/v1/metrics", self.metrics)
+        add("GET", r"/v1/operator/scheduler/configuration", self.sched_config_get)
+        add("PUT", r"/v1/operator/scheduler/configuration", self.sched_config_put)
+        add("POST", r"/v1/operator/scheduler/configuration", self.sched_config_put)
+        add("GET", r"/v1/operator/raft/configuration", self.raft_config)
+        add("GET", r"/v1/operator/snapshot", self.snapshot_save)
+        add("PUT", r"/v1/operator/snapshot", self.snapshot_restore)
+        add("POST", r"/v1/operator/snapshot", self.snapshot_restore)
+
+        # system
+        add("PUT", r"/v1/system/gc", self.system_gc)
+        add("POST", r"/v1/system/gc", self.system_gc)
+        add("PUT", r"/v1/system/reconcile/summaries", self.system_reconcile)
+        add("POST", r"/v1/system/reconcile/summaries", self.system_reconcile)
+
+        # search
+        add("POST", r"/v1/search", self.search)
+        add("PUT", r"/v1/search", self.search)
+        add("POST", r"/v1/search/fuzzy", self.search_fuzzy)
+        add("PUT", r"/v1/search/fuzzy", self.search_fuzzy)
+
+        # namespaces
+        add("GET", r"/v1/namespaces", self.namespaces_list)
+        add("GET", r"/v1/namespace/(?P<name>[^/]+)", self.namespace_get)
+        add("PUT", r"/v1/namespace/(?P<name>[^/]+)", self.namespace_upsert)
+        add("POST", r"/v1/namespace/(?P<name>[^/]+)", self.namespace_upsert)
+        add("PUT", r"/v1/namespace", self.namespace_upsert)
+        add("POST", r"/v1/namespace", self.namespace_upsert)
+        add("DELETE", r"/v1/namespace/(?P<name>[^/]+)", self.namespace_delete)
+
+        # scaling
+        add("GET", r"/v1/scaling/policies", self.scaling_policies)
+        add("GET", r"/v1/scaling/policy/(?P<id>.+)", self.scaling_policy)
+
+        # event stream
+        add("GET", r"/v1/event/stream", self.event_stream)
+
+        # ACL
+        add("POST", r"/v1/acl/bootstrap", self.acl_bootstrap)
+        add("PUT", r"/v1/acl/bootstrap", self.acl_bootstrap)
+        add("GET", r"/v1/acl/policies", self.acl_policies_list)
+        add("GET", r"/v1/acl/policy/(?P<name>[^/]+)", self.acl_policy_get)
+        add("PUT", r"/v1/acl/policy/(?P<name>[^/]+)", self.acl_policy_put)
+        add("POST", r"/v1/acl/policy/(?P<name>[^/]+)", self.acl_policy_put)
+        add("DELETE", r"/v1/acl/policy/(?P<name>[^/]+)", self.acl_policy_delete)
+        add("GET", r"/v1/acl/tokens", self.acl_tokens_list)
+        add("PUT", r"/v1/acl/token", self.acl_token_put)
+        add("POST", r"/v1/acl/token", self.acl_token_put)
+        add("GET", r"/v1/acl/token/self", self.acl_token_self)
+        add("GET", r"/v1/acl/token/(?P<id>[^/]+)", self.acl_token_get)
+        add("PUT", r"/v1/acl/token/(?P<id>[^/]+)", self.acl_token_put)
+        add("POST", r"/v1/acl/token/(?P<id>[^/]+)", self.acl_token_put)
+        add("DELETE", r"/v1/acl/token/(?P<id>[^/]+)", self.acl_token_delete)
+
+        # client (stats/fs) routes
+        add("GET", r"/v1/client/allocation/(?P<id>[^/]+)/stats", self.client_alloc_stats)
+        add("GET", r"/v1/client/fs/logs/(?P<id>[^/]+)", self.client_fs_logs)
+        add("GET", r"/v1/client/fs/ls/(?P<id>[^/]+)", self.client_fs_ls)
+        add("GET", r"/v1/client/stats", self.client_stats)
+
+    # -- job handlers ----------------------------------------------------
+
+    def _decode_job(self, data: Dict) -> Job:
+        payload = data.get("Job", data) if isinstance(data, dict) else data
+        job = decode(payload, Job)
+        if job is None or not job.id:
+            raise HTTPError(400, "Job must be specified")
+        if not job.namespace:
+            job.namespace = "default"
+        return job
+
+    def jobs_list(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "read-job")
+        self._block(req, ["jobs"])
+        snap = self._server.state.snapshot()
+        prefix = req.q("prefix")
+        jobs = [
+            _job_stub(j) for j in snap.jobs()
+            if j.namespace == req.namespace and j.id.startswith(prefix)
+        ]
+        return sorted(jobs, key=lambda j: j["ID"])
+
+    def job_register(self, req: Request):
+        job = self._decode_job(req.body)
+        self._acl(req, "allow_ns_op", job.namespace, "submit-job")
+        res = self._server.job_register(job)
+        return {"EvalID": res["eval_id"], "EvalCreateIndex": res["index"],
+                "JobModifyIndex": res["index"], "Warnings": "; ".join(res["warnings"])}
+
+    def job_update(self, req: Request):
+        return self.job_register(req)
+
+    def jobs_parse(self, req: Request):
+        from nomad_tpu.jobspec.parse import parse_hcl
+
+        if not isinstance(req.body, dict) or "JobHCL" not in req.body:
+            raise HTTPError(400, "JobHCL is required")
+        job = parse_hcl(req.body["JobHCL"])
+        return encode(job)
+
+    def job_get(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "read-job")
+        self._block(req, ["jobs"])
+        snap = self._server.state.snapshot()
+        job = snap.job_by_id(req.namespace, req.params["id"])
+        if job is None:
+            raise HTTPError(404, "job not found")
+        return job
+
+    def job_delete(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "submit-job")
+        res = self._server.job_deregister(
+            req.namespace, req.params["id"], purge=req.flag("purge")
+        )
+        return {"EvalID": res["eval_id"], "EvalCreateIndex": res["index"],
+                "JobModifyIndex": res["index"]}
+
+    def job_plan(self, req: Request):
+        job = self._decode_job(req.body)
+        self._acl(req, "allow_ns_op", job.namespace, "submit-job")
+        diff = bool(req.body.get("Diff")) if isinstance(req.body, dict) else False
+        res = endpoints.job_plan(self._server, job, diff=diff)
+        return {
+            "Annotations": res["annotations"],
+            "FailedTGAllocs": res["failed_tg_allocs"],
+            "Diff": res["diff"],
+            "JobModifyIndex": res["job_modify_index"],
+            "CreatedEvals": res["created_evals"],
+        }
+
+    def job_allocs(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "read-job")
+        self._block(req, ["allocs"])
+        snap = self._server.state.snapshot()
+        allocs = snap.allocs_by_job(req.namespace, req.params["id"])
+        return [_alloc_stub(a) for a in allocs]
+
+    def job_evals(self, req: Request):
+        self._block(req, ["evals"])
+        snap = self._server.state.snapshot()
+        return snap.evals_by_job(req.namespace, req.params["id"])
+
+    def job_deployments(self, req: Request):
+        self._block(req, ["deployment"])
+        snap = self._server.state.snapshot()
+        return snap.deployments_by_job_id(req.namespace, req.params["id"])
+
+    def job_latest_deployment(self, req: Request):
+        self._block(req, ["deployment"])
+        snap = self._server.state.snapshot()
+        return snap.latest_deployment_by_job_id(req.namespace, req.params["id"])
+
+    def job_summary(self, req: Request):
+        self._block(req, ["allocs"])
+        snap = self._server.state.snapshot()
+        job = snap.job_by_id(req.namespace, req.params["id"])
+        if job is None:
+            raise HTTPError(404, "job not found")
+        summary: Dict[str, Dict[str, int]] = {}
+        for tg in job.task_groups:
+            summary[tg.name] = {
+                "Queued": 0, "Complete": 0, "Failed": 0, "Running": 0,
+                "Starting": 0, "Lost": 0, "Unknown": 0,
+            }
+        for a in snap.allocs_by_job(req.namespace, job.id):
+            tg = summary.setdefault(a.task_group, {
+                "Queued": 0, "Complete": 0, "Failed": 0, "Running": 0,
+                "Starting": 0, "Lost": 0, "Unknown": 0,
+            })
+            status = {
+                consts.ALLOC_CLIENT_PENDING: "Starting",
+                consts.ALLOC_CLIENT_RUNNING: "Running",
+                consts.ALLOC_CLIENT_COMPLETE: "Complete",
+                consts.ALLOC_CLIENT_FAILED: "Failed",
+                consts.ALLOC_CLIENT_LOST: "Lost",
+                consts.ALLOC_CLIENT_UNKNOWN: "Unknown",
+            }.get(a.client_status, "Starting")
+            tg[status] += 1
+        return {"JobID": job.id, "Namespace": job.namespace, "Summary": summary}
+
+    def job_versions(self, req: Request):
+        self._block(req, ["jobs"])
+        snap = self._server.state.snapshot()
+        versions = []
+        v = 0
+        job = snap.job_by_id(req.namespace, req.params["id"])
+        if job is None:
+            raise HTTPError(404, "job not found")
+        for v in range(job.version, -1, -1):
+            jv = snap.job_by_id_and_version(req.namespace, req.params["id"], v)
+            if jv is not None:
+                versions.append(jv)
+        return {"Versions": versions}
+
+    def job_revert(self, req: Request):
+        body = req.body or {}
+        res = endpoints.job_revert(
+            self._server, req.namespace, req.params["id"],
+            int(body.get("JobVersion", 0)),
+            body.get("EnforcePriorVersion"),
+        )
+        return {"EvalID": res["eval_id"], "Index": res["index"]}
+
+    def job_stable(self, req: Request):
+        body = req.body or {}
+        res = endpoints.job_stable(
+            self._server, req.namespace, req.params["id"],
+            int(body.get("JobVersion", 0)), bool(body.get("Stable", False)),
+        )
+        return {"Index": res["index"]}
+
+    def job_dispatch(self, req: Request):
+        body = req.body or {}
+        import base64
+
+        payload = base64.b64decode(body.get("Payload", "") or "")
+        res = endpoints.job_dispatch(
+            self._server, req.namespace, req.params["id"],
+            payload=payload, meta=body.get("Meta") or {},
+        )
+        return {"DispatchedJobID": res["dispatched_job_id"],
+                "EvalID": res["eval_id"], "Index": res["index"]}
+
+    def job_scale(self, req: Request):
+        body = req.body or {}
+        target = body.get("Target") or {}
+        res = endpoints.job_scale(
+            self._server, req.namespace, req.params["id"],
+            target.get("Group", ""),
+            body.get("Count"),
+            message=body.get("Message", ""),
+            error=bool(body.get("Error", False)),
+            meta=body.get("Meta"),
+        )
+        return {"EvalID": res["eval_id"], "EvalCreateIndex": res["index"]}
+
+    def job_scale_status(self, req: Request):
+        snap = self._server.state.snapshot()
+        job = snap.job_by_id(req.namespace, req.params["id"])
+        if job is None:
+            raise HTTPError(404, "job not found")
+        groups = {}
+        allocs = snap.allocs_by_job(req.namespace, job.id)
+        for tg in job.task_groups:
+            running = sum(
+                1 for a in allocs
+                if a.task_group == tg.name
+                and a.client_status == consts.ALLOC_CLIENT_RUNNING
+            )
+            groups[tg.name] = {
+                "Desired": tg.count,
+                "Running": running,
+                "Events": self._server.state.scaling_events(req.namespace, job.id),
+            }
+        return {"JobID": job.id, "JobStopped": job.stopped(),
+                "TaskGroups": groups}
+
+    def job_periodic_force(self, req: Request):
+        snap = self._server.state.snapshot()
+        job = snap.job_by_id(req.namespace, req.params["id"])
+        if job is None:
+            raise HTTPError(404, "job not found")
+        if not job.is_periodic():
+            raise HTTPError(400, "job is not periodic")
+        child = self._server.periodic_dispatcher.force_run(job)
+        return {"EvalCreateIndex": self._server.state.latest_index(),
+                "EvalID": child}
+
+    # -- node handlers ---------------------------------------------------
+
+    def nodes_list(self, req: Request):
+        self._acl(req, "allow_node_read")
+        self._block(req, ["nodes"])
+        snap = self._server.state.snapshot()
+        prefix = req.q("prefix")
+        return sorted(
+            (_node_stub(n) for n in snap.nodes() if n.id.startswith(prefix)),
+            key=lambda n: n["ID"],
+        )
+
+    def node_get(self, req: Request):
+        self._acl(req, "allow_node_read")
+        self._block(req, ["nodes"])
+        snap = self._server.state.snapshot()
+        node = snap.node_by_id(req.params["id"])
+        if node is None:
+            raise HTTPError(404, "node not found")
+        return node
+
+    def node_allocs(self, req: Request):
+        self._block(req, ["allocs"])
+        snap = self._server.state.snapshot()
+        return snap.allocs_by_node(req.params["id"])
+
+    def node_drain(self, req: Request):
+        self._acl(req, "allow_node_write")
+        body = req.body or {}
+        spec = body.get("DrainSpec")
+        enable = spec is not None
+        strategy = None
+        if enable:
+            strategy = {
+                "deadline_s": float(spec.get("Deadline", 0)) / 1e9
+                if spec.get("Deadline") else 0.0,
+                "ignore_system_jobs": bool(spec.get("IgnoreSystemJobs", False)),
+            }
+        index = self._server.node_update_drain(req.params["id"], enable, strategy)
+        return {"EvalIDs": [], "EvalCreateIndex": index, "NodeModifyIndex": index}
+
+    def node_eligibility(self, req: Request):
+        self._acl(req, "allow_node_write")
+        body = req.body or {}
+        elig = body.get("Eligibility", "")
+        if elig not in (consts.NODE_SCHEDULING_ELIGIBLE,
+                        consts.NODE_SCHEDULING_INELIGIBLE):
+            raise HTTPError(400, f"invalid eligibility '{elig}'")
+        index = self._server.node_update_eligibility(req.params["id"], elig)
+        return {"NodeModifyIndex": index}
+
+    def node_evaluate(self, req: Request):
+        res = endpoints.node_evaluate(self._server, req.params["id"])
+        return {"EvalIDs": res["eval_ids"], "EvalCreateIndex": res["index"]}
+
+    def node_purge(self, req: Request):
+        self._acl(req, "allow_node_write")
+        res = endpoints.node_deregister(self._server, req.params["id"])
+        return {"EvalIDs": res["eval_ids"], "NodeModifyIndex": res["index"]}
+
+    # -- alloc / eval handlers -------------------------------------------
+
+    def allocs_list(self, req: Request):
+        self._block(req, ["allocs"])
+        snap = self._server.state.snapshot()
+        prefix = req.q("prefix")
+        out = [
+            _alloc_stub(a) for a in snap.allocs_iter()
+            if a.namespace == req.namespace and a.id.startswith(prefix)
+        ]
+        return sorted(out, key=lambda a: a["ID"])
+
+    def alloc_get(self, req: Request):
+        self._block(req, ["allocs"])
+        snap = self._server.state.snapshot()
+        alloc = snap.alloc_by_id(req.params["id"])
+        if alloc is None:
+            raise HTTPError(404, "alloc not found")
+        return alloc
+
+    def alloc_stop(self, req: Request):
+        res = endpoints.alloc_stop(self._server, req.params["id"])
+        return {"EvalID": res["eval_id"], "Index": res["index"]}
+
+    def evals_list(self, req: Request):
+        self._block(req, ["evals"])
+        snap = self._server.state.snapshot()
+        prefix = req.q("prefix")
+        return sorted(
+            (e for e in snap.evals_iter()
+             if e.namespace == req.namespace and e.id.startswith(prefix)),
+            key=lambda e: e.id,
+        )
+
+    def eval_get(self, req: Request):
+        self._block(req, ["evals"])
+        snap = self._server.state.snapshot()
+        ev = snap.eval_by_id(req.params["id"])
+        if ev is None:
+            raise HTTPError(404, "eval not found")
+        return ev
+
+    def eval_allocs(self, req: Request):
+        self._block(req, ["allocs"])
+        snap = self._server.state.snapshot()
+        return [_alloc_stub(a) for a in snap.allocs_by_eval(req.params["id"])]
+
+    # -- deployment handlers ---------------------------------------------
+
+    def deployments_list(self, req: Request):
+        self._block(req, ["deployment"])
+        snap = self._server.state.snapshot()
+        return sorted(
+            (d for d in snap.deployments_iter() if d.namespace == req.namespace),
+            key=lambda d: d.id,
+        )
+
+    def deployment_get(self, req: Request):
+        self._block(req, ["deployment"])
+        snap = self._server.state.snapshot()
+        d = snap.deployment_by_id(req.params["id"])
+        if d is None:
+            raise HTTPError(404, "deployment not found")
+        return d
+
+    def deployment_allocs(self, req: Request):
+        snap = self._server.state.snapshot()
+        return [
+            _alloc_stub(a) for a in snap.allocs_iter()
+            if a.deployment_id == req.params["id"]
+        ]
+
+    def deployment_fail(self, req: Request):
+        index = self._server.deployments_watcher.fail_deployment(req.params["id"])
+        return {"DeploymentModifyIndex": index}
+
+    def deployment_pause(self, req: Request):
+        body = req.body or {}
+        index = self._server.deployments_watcher.pause_deployment(
+            req.params["id"], bool(body.get("Pause", False))
+        )
+        return {"DeploymentModifyIndex": index}
+
+    def deployment_promote(self, req: Request):
+        body = req.body or {}
+        index = self._server.deployments_watcher.promote_deployment(
+            req.params["id"], body.get("Groups"), bool(body.get("All", True)),
+        )
+        return {"DeploymentModifyIndex": index}
+
+    # -- status / agent / operator ---------------------------------------
+
+    def status_leader(self, req: Request):
+        s = self._server
+        if s.raft is not None:
+            return s.raft.leader_id or ""
+        return s.config.name
+
+    def status_peers(self, req: Request):
+        s = self._server
+        if s.raft is not None:
+            return list(s.raft.peers)
+        return [s.config.name]
+
+    def agent_self(self, req: Request):
+        a = self.agent
+        stats = {}
+        if a.server is not None:
+            stats["nomad"] = a.server.stats()
+        if a.client is not None:
+            stats["client"] = a.client.stats()
+        return {
+            "Config": {
+                "Region": a.config.region,
+                "Datacenter": a.config.datacenter,
+                "Name": a.config.name,
+                "Server": a.server is not None,
+                "Client": a.client is not None,
+                "Version": {"Version": "0.1.0"},
+            },
+            "Stats": stats,
+            "Member": {"Name": a.config.name, "Addr": self.addr},
+        }
+
+    def agent_health(self, req: Request):
+        ok = {"ok": True, "message": "ok"}
+        return {
+            "server": ok if self.agent.server is not None else None,
+            "client": ok if self.agent.client is not None else None,
+        }
+
+    def agent_members(self, req: Request):
+        members = getattr(self.agent, "members", None)
+        if members is not None:
+            return {"ServerRegion": self.agent.config.region,
+                    "Members": members()}
+        return {"ServerRegion": self.agent.config.region,
+                "Members": [{"Name": self.agent.config.name,
+                             "Status": "alive", "Addr": self.addr}]}
+
+    def agent_servers(self, req: Request):
+        return [self.addr]
+
+    def metrics(self, req: Request):
+        from nomad_tpu.utils import metrics as m
+
+        if req.q("format") == "prometheus":
+            body = m.global_registry.prometheus_text()
+            return body
+        return m.global_registry.summary()
+
+    def sched_config_get(self, req: Request):
+        cfg = self._server.state.scheduler_config
+        return {
+            "SchedulerConfig": {
+                "SchedulerAlgorithm": cfg.scheduler_algorithm,
+                "MemoryOversubscriptionEnabled": cfg.memory_oversubscription_enabled,
+                "PauseEvalBroker": cfg.pause_eval_broker,
+                "PreemptionConfig": {
+                    "SystemSchedulerEnabled": cfg.preemption_system_enabled,
+                    "SysBatchSchedulerEnabled": cfg.preemption_system_enabled,
+                    "BatchSchedulerEnabled": cfg.preemption_batch_enabled,
+                    "ServiceSchedulerEnabled": cfg.preemption_service_enabled,
+                },
+            }
+        }
+
+    def sched_config_put(self, req: Request):
+        from nomad_tpu.server import fsm as fsm_msgs
+        from nomad_tpu.state.store import SchedulerConfiguration
+
+        body = req.body or {}
+        cfg = SchedulerConfiguration()
+        cfg.scheduler_algorithm = body.get(
+            "SchedulerAlgorithm", consts.SCHEDULER_ALGORITHM_BINPACK
+        )
+        cfg.memory_oversubscription_enabled = bool(
+            body.get("MemoryOversubscriptionEnabled", False)
+        )
+        cfg.pause_eval_broker = bool(body.get("PauseEvalBroker", False))
+        pre = body.get("PreemptionConfig") or {}
+        cfg.preemption_system_enabled = bool(pre.get("SystemSchedulerEnabled", True))
+        cfg.preemption_batch_enabled = bool(pre.get("BatchSchedulerEnabled", False))
+        cfg.preemption_service_enabled = bool(pre.get("ServiceSchedulerEnabled", False))
+        index = self._server.raft_apply(fsm_msgs.SCHEDULER_CONFIG, {"config": cfg})
+        return {"Updated": True, "Index": index}
+
+    def raft_config(self, req: Request):
+        s = self._server
+        if s.raft is None:
+            return {"Servers": [{"ID": s.config.name, "Node": s.config.name,
+                                 "Leader": True, "Voter": True}], "Index": 0}
+        return {
+            "Servers": [
+                {"ID": p, "Node": p, "Leader": p == s.raft.leader_id,
+                 "Voter": True}
+                for p in s.raft.peers
+            ],
+            "Index": s.raft.commit_index,
+        }
+
+    def snapshot_save(self, req: Request):
+        import base64
+
+        from nomad_tpu.utils.snapshot import archive_snapshot
+
+        data = archive_snapshot(self._server)
+        return {"Snapshot": base64.b64encode(data).decode()}
+
+    def snapshot_restore(self, req: Request):
+        import base64
+
+        from nomad_tpu.utils.snapshot import restore_snapshot
+
+        body = req.body or {}
+        if "Snapshot" not in body:
+            raise HTTPError(400, "Snapshot is required")
+        restore_snapshot(self._server, base64.b64decode(body["Snapshot"]))
+        return {"Restored": True}
+
+    def system_gc(self, req: Request):
+        self._server.force_gc()
+        return {}
+
+    def system_reconcile(self, req: Request):
+        return {}
+
+    # -- search ----------------------------------------------------------
+
+    def search(self, req: Request):
+        from nomad_tpu.server.search import prefix_search
+
+        body = req.body or {}
+        return prefix_search(
+            self._server.state.snapshot(),
+            body.get("Prefix", ""), body.get("Context", "all"),
+            namespace=req.namespace,
+        )
+
+    def search_fuzzy(self, req: Request):
+        from nomad_tpu.server.search import fuzzy_search
+
+        body = req.body or {}
+        return fuzzy_search(
+            self._server.state.snapshot(),
+            body.get("Text", ""), body.get("Context", "all"),
+            namespace=req.namespace,
+        )
+
+    # -- namespaces / scaling --------------------------------------------
+
+    def namespaces_list(self, req: Request):
+        return sorted(self._server.state.namespaces(), key=lambda n: n.name)
+
+    def namespace_get(self, req: Request):
+        ns = self._server.state.namespace_by_name(req.params["name"])
+        if ns is None:
+            raise HTTPError(404, "namespace not found")
+        return ns
+
+    def namespace_upsert(self, req: Request):
+        from nomad_tpu.server import fsm as fsm_msgs
+        from nomad_tpu.structs.namespace import Namespace
+
+        body = req.body or {}
+        name = req.params.get("name") or body.get("Name", "")
+        if not name:
+            raise HTTPError(400, "namespace name required")
+        ns = Namespace(name=name, description=body.get("Description", ""),
+                       quota=body.get("Quota", ""))
+        index = self._server.raft_apply(
+            fsm_msgs.NAMESPACE_UPSERT, {"namespaces": [ns]}
+        )
+        return {"Index": index}
+
+    def namespace_delete(self, req: Request):
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        index = self._server.raft_apply(
+            fsm_msgs.NAMESPACE_DELETE, {"names": [req.params["name"]]}
+        )
+        return {"Index": index}
+
+    def scaling_policies(self, req: Request):
+        return self._server.state.scaling_policies()
+
+    def scaling_policy(self, req: Request):
+        p = self._server.state.scaling_policy_by_id(req.params["id"])
+        if p is None:
+            raise HTTPError(404, "scaling policy not found")
+        return p
+
+    # -- event stream (stream/ndjson.go) ---------------------------------
+
+    def event_stream(self, req: Request):
+        broker = self._server.event_broker
+        topics: Dict[str, List[str]] = {}
+        for t in req.query.get("topic", []):
+            if ":" in t:
+                topic, key = t.split(":", 1)
+            else:
+                topic, key = t, "*"
+            topics.setdefault(topic, []).append(key)
+        index, _ = req.wait_params()
+        sub = broker.subscribe(topics or {"*": ["*"]}, from_index=index)
+        h = req.handler
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+
+            def write_chunk(payload: bytes) -> None:
+                h.wfile.write(f"{len(payload):x}\r\n".encode())
+                h.wfile.write(payload + b"\r\n")
+                h.wfile.flush()
+
+            deadline = time.time() + 600
+            while time.time() < deadline:
+                events = sub.next_events(timeout=5.0)
+                if not events:
+                    write_chunk(b"{}\n")  # heartbeat newline frame
+                    continue
+                batch = {
+                    "Index": events[-1].index,
+                    "Events": [encode(e) for e in events],
+                }
+                write_chunk((json.dumps(batch) + "\n").encode())
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            sub.close()
+        return StreamedResponse
+
+    # -- ACL handlers ----------------------------------------------------
+
+    @property
+    def _acl_store(self):
+        resolver = getattr(self.agent, "acl_resolver", None)
+        if resolver is None:
+            raise HTTPError(400, "ACL support disabled")
+        return resolver
+
+    def acl_bootstrap(self, req: Request):
+        return self._acl_store.bootstrap()
+
+    def acl_policies_list(self, req: Request):
+        self._acl(req, "is_management")
+        return [
+            {"Name": p.name, "Description": p.description}
+            for p in self._server.state.acl_policies()
+        ]
+
+    def acl_policy_get(self, req: Request):
+        self._acl(req, "is_management")
+        p = self._server.state.acl_policy_by_name(req.params["name"])
+        if p is None:
+            raise HTTPError(404, "policy not found")
+        return p
+
+    def acl_policy_put(self, req: Request):
+        from nomad_tpu.acl.policy import ACLPolicy
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        self._acl(req, "is_management")
+        body = req.body or {}
+        p = ACLPolicy(
+            name=req.params["name"],
+            description=body.get("Description", ""),
+            rules=body.get("Rules", ""),
+        )
+        p.validate()
+        index = self._server.raft_apply(
+            fsm_msgs.ACL_POLICY_UPSERT, {"policies": [p]}
+        )
+        return {"Index": index}
+
+    def acl_policy_delete(self, req: Request):
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        self._acl(req, "is_management")
+        index = self._server.raft_apply(
+            fsm_msgs.ACL_POLICY_DELETE, {"names": [req.params["name"]]}
+        )
+        return {"Index": index}
+
+    def acl_tokens_list(self, req: Request):
+        self._acl(req, "is_management")
+        return [
+            {"AccessorID": t.accessor_id, "Name": t.name, "Type": t.type,
+             "Policies": t.policies, "Global": t.global_}
+            for t in self._server.state.acl_tokens()
+        ]
+
+    def acl_token_self(self, req: Request):
+        t = self._server.state.acl_token_by_secret(req.token)
+        if t is None:
+            raise HTTPError(403, "token not found")
+        return t
+
+    def acl_token_get(self, req: Request):
+        self._acl(req, "is_management")
+        t = self._server.state.acl_token_by_accessor(req.params["id"])
+        if t is None:
+            raise HTTPError(404, "token not found")
+        return t
+
+    def acl_token_put(self, req: Request):
+        from nomad_tpu.acl.policy import ACLToken
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        self._acl(req, "is_management")
+        body = req.body or {}
+        t = ACLToken.create(
+            name=body.get("Name", ""),
+            type=body.get("Type", "client"),
+            policies=body.get("Policies") or [],
+            global_=bool(body.get("Global", False)),
+        )
+        if req.params.get("id"):
+            existing = self._server.state.acl_token_by_accessor(req.params["id"])
+            if existing is None:
+                raise HTTPError(404, "token not found")
+            t.accessor_id = existing.accessor_id
+            t.secret_id = existing.secret_id
+        index = self._server.raft_apply(
+            fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [t]}
+        )
+        out = encode(t)
+        out["Index"] = index
+        return out
+
+    def acl_token_delete(self, req: Request):
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        self._acl(req, "is_management")
+        index = self._server.raft_apply(
+            fsm_msgs.ACL_TOKEN_DELETE, {"accessor_ids": [req.params["id"]]}
+        )
+        return {"Index": index}
+
+    # -- client handlers -------------------------------------------------
+
+    @property
+    def _client(self):
+        c = self.agent.client
+        if c is None:
+            raise HTTPError(400, "client is not enabled on this agent")
+        return c
+
+    def client_alloc_stats(self, req: Request):
+        runner = self._client.alloc_runner(req.params["id"])
+        if runner is None:
+            raise HTTPError(404, "unknown allocation")
+        return runner.stats() if hasattr(runner, "stats") else {}
+
+    def client_fs_logs(self, req: Request):
+        runner = self._client.alloc_runner(req.params["id"])
+        if runner is None:
+            raise HTTPError(404, "unknown allocation")
+        task = req.q("task")
+        logtype = req.q("type", "stdout")
+        logs = runner.task_logs(task, logtype) if hasattr(runner, "task_logs") else ""
+        return {"Data": logs}
+
+    def client_fs_ls(self, req: Request):
+        runner = self._client.alloc_runner(req.params["id"])
+        if runner is None:
+            raise HTTPError(404, "unknown allocation")
+        entries = runner.list_dir(req.q("path", "/")) if hasattr(runner, "list_dir") else []
+        return entries
+
+    def client_stats(self, req: Request):
+        return self._client.stats()
+
+
+class StreamedResponse:
+    """Sentinel: handler already wrote the response body."""
+
+
+def _job_stub(j) -> Dict:
+    return {
+        "ID": j.id, "ParentID": j.parent_id, "Name": j.name or j.id,
+        "Namespace": j.namespace, "Type": j.type, "Priority": j.priority,
+        "Status": j.status,
+        "Stop": j.stop, "Version": j.version,
+        "CreateIndex": j.create_index, "ModifyIndex": j.modify_index,
+        "JobModifyIndex": j.job_modify_index,
+    }
+
+
+def _node_stub(n) -> Dict:
+    return {
+        "ID": n.id, "Name": n.name, "Datacenter": n.datacenter,
+        "NodeClass": n.node_class, "Status": n.status,
+        "SchedulingEligibility": n.scheduling_eligibility,
+        "Drain": n.drain_strategy is not None,
+        "Address": getattr(n, "http_addr", ""),
+        "NodePool": getattr(n, "node_pool", "default"),
+    }
+
+
+def _alloc_stub(a) -> Dict:
+    return {
+        "ID": a.id, "EvalID": a.eval_id, "Name": a.name,
+        "Namespace": a.namespace, "NodeID": a.node_id, "NodeName": a.node_name,
+        "JobID": a.job_id, "JobVersion": a.job_version,
+        "TaskGroup": a.task_group,
+        "DesiredStatus": a.desired_status, "ClientStatus": a.client_status,
+        "DeploymentID": a.deployment_id,
+        "CreateIndex": a.create_index, "ModifyIndex": a.modify_index,
+        "CreateTime": a.create_time_ns, "ModifyTime": a.modify_time_ns,
+        "FollowupEvalID": a.follow_up_eval_id,
+    }

@@ -226,3 +226,66 @@ class DeploymentsWatcher:
     def num_watchers(self) -> int:
         with self._lock:
             return len(self._watchers)
+
+    # -- operator RPCs (deployment_endpoint.go Fail/Pause/Promote) -------
+
+    def _get_active(self, deployment_id: str):
+        snap = self.server.state.snapshot()
+        d = snap.deployment_by_id(deployment_id)
+        if d is None:
+            raise KeyError(f"deployment '{deployment_id}' not found")
+        if not d.active():
+            raise ValueError(f"deployment '{deployment_id}' is terminal")
+        return d
+
+    def fail_deployment(self, deployment_id: str) -> int:
+        d = self._get_active(deployment_id)
+        return self.server.raft_apply(
+            fsm_msgs.DEPLOYMENT_STATUS_UPDATE,
+            {
+                "deployment_id": d.id,
+                "status": consts.DEPLOYMENT_STATUS_FAILED,
+                "description": "Deployment marked as failed",
+                "evals": [_operator_eval(d)],
+            },
+        )
+
+    def pause_deployment(self, deployment_id: str, pause: bool) -> int:
+        d = self._get_active(deployment_id)
+        status = (consts.DEPLOYMENT_STATUS_PAUSED if pause
+                  else consts.DEPLOYMENT_STATUS_RUNNING)
+        desc = ("Deployment is paused" if pause
+                else "Deployment is resuming")
+        return self.server.raft_apply(
+            fsm_msgs.DEPLOYMENT_STATUS_UPDATE,
+            {
+                "deployment_id": d.id,
+                "status": status,
+                "description": desc,
+                "evals": [] if pause else [_operator_eval(d)],
+            },
+        )
+
+    def promote_deployment(self, deployment_id: str, groups=None,
+                           all_groups: bool = True) -> int:
+        d = self._get_active(deployment_id)
+        return self.server.raft_apply(
+            fsm_msgs.DEPLOYMENT_PROMOTE,
+            {
+                "deployment_id": d.id,
+                "groups": None if all_groups else groups,
+                "evals": [_operator_eval(d)],
+            },
+        )
+
+
+def _operator_eval(d) -> Evaluation:
+    return Evaluation(
+        namespace=d.namespace,
+        priority=50,
+        type=consts.JOB_TYPE_SERVICE,
+        triggered_by=consts.EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+        job_id=d.job_id,
+        deployment_id=d.id,
+        status=consts.EVAL_STATUS_PENDING,
+    )

@@ -37,6 +37,14 @@ DEPLOYMENT_PROMOTE = "DeploymentPromoteRequestType"
 DEPLOYMENT_DELETE = "DeploymentDeleteRequestType"
 ALLOC_DELETE = "AllocDeleteRequestType"
 SCHEDULER_CONFIG = "SchedulerConfigRequestType"
+JOB_STABILITY = "JobStabilityRequestType"
+SCALING_EVENT = "ScalingEventRegisterRequestType"
+NAMESPACE_UPSERT = "NamespaceUpsertRequestType"
+NAMESPACE_DELETE = "NamespaceDeleteRequestType"
+ACL_POLICY_UPSERT = "ACLPolicyUpsertRequestType"
+ACL_POLICY_DELETE = "ACLPolicyDeleteRequestType"
+ACL_TOKEN_UPSERT = "ACLTokenUpsertRequestType"
+ACL_TOKEN_DELETE = "ACLTokenDeleteRequestType"
 
 
 class NomadFSM:
@@ -291,6 +299,54 @@ class NomadFSM:
     def _apply_scheduler_config(self, req: Dict) -> int:
         return self.state.set_scheduler_config(req["config"])
 
+    # --- aux tables (stability / scaling / namespaces / ACL) ------------
+
+    def _apply_job_stability(self, req: Dict) -> int:
+        return self.state.set_job_stability(
+            req["namespace"], req["job_id"], req["version"], req["stable"]
+        )
+
+    def _apply_scaling_event(self, req: Dict) -> int:
+        return self.state.record_scaling_event(
+            req["namespace"], req["job_id"], req["group"], req["event"]
+        )
+
+    def _apply_namespace_upsert(self, req: Dict) -> int:
+        idx = 0
+        for ns in req["namespaces"]:
+            idx = self.state.upsert_namespace(ns)
+        return idx
+
+    def _apply_namespace_delete(self, req: Dict) -> int:
+        idx = 0
+        for name in req["names"]:
+            idx = self.state.delete_namespace(name)
+        return idx
+
+    def _apply_acl_policy_upsert(self, req: Dict) -> int:
+        idx = 0
+        for p in req["policies"]:
+            idx = self.state.upsert_acl_policy(p)
+        return idx
+
+    def _apply_acl_policy_delete(self, req: Dict) -> int:
+        idx = 0
+        for name in req["names"]:
+            idx = self.state.delete_acl_policy(name)
+        return idx
+
+    def _apply_acl_token_upsert(self, req: Dict) -> int:
+        idx = 0
+        for t in req["tokens"]:
+            idx = self.state.upsert_acl_token(t)
+        return idx
+
+    def _apply_acl_token_delete(self, req: Dict) -> int:
+        idx = 0
+        for aid in req["accessor_ids"]:
+            idx = self.state.delete_acl_token(aid)
+        return idx
+
     _DISPATCH = {
         NODE_REGISTER: _apply_node_register,
         NODE_DEREGISTER: _apply_node_deregister,
@@ -311,4 +367,12 @@ class NomadFSM:
         DEPLOYMENT_DELETE: _apply_deployment_delete,
         ALLOC_DELETE: _apply_alloc_delete,
         SCHEDULER_CONFIG: _apply_scheduler_config,
+        JOB_STABILITY: _apply_job_stability,
+        SCALING_EVENT: _apply_scaling_event,
+        NAMESPACE_UPSERT: _apply_namespace_upsert,
+        NAMESPACE_DELETE: _apply_namespace_delete,
+        ACL_POLICY_UPSERT: _apply_acl_policy_upsert,
+        ACL_POLICY_DELETE: _apply_acl_policy_delete,
+        ACL_TOKEN_UPSERT: _apply_acl_token_upsert,
+        ACL_TOKEN_DELETE: _apply_acl_token_delete,
     }

@@ -119,13 +119,19 @@ class PeriodicDispatcher:
             self._wake.wait(wait)
             self._wake.clear()
 
-    def _dispatch(self, parent) -> None:
+    def force_run(self, parent) -> str:
+        """periodic_endpoint.go Force: launch the child now regardless
+        of schedule; returns the child job id."""
+        return self._dispatch(parent, force=True) or ""
+
+    def _dispatch(self, parent, force: bool = False) -> Optional[str]:
         """periodic.go createEval: derive + register the child job."""
         now = time.time()
-        if parent.periodic.prohibit_overlap and self._child_running(parent):
+        if not force and parent.periodic.prohibit_overlap \
+                and self._child_running(parent):
             LOG.info("periodic job %s: skipping launch (overlap prohibited)",
                      parent.id)
-            return
+            return None
         child = parent.copy()
         child.id = periodic_child_id(parent.id, now)
         child.parent_id = parent.id
@@ -145,6 +151,7 @@ class PeriodicDispatcher:
         self.server.raft_apply(
             fsm_msgs.JOB_REGISTER, {"job": child, "evals": [ev]}
         )
+        return child.id
 
     def _child_running(self, parent) -> bool:
         snap = self.server.state.snapshot()

@@ -162,6 +162,12 @@ class StateStore:
         self._allocs_by_job: Dict[Tuple[str, str], set] = {}
         self._allocs_by_node: Dict[str, set] = {}
         self._allocs_by_eval: Dict[str, set] = {}
+        # aux tables (schema.go:50-72: namespaces, scaling_event,
+        # scaling_policy, acl_policy, acl_token)
+        self._namespaces: Dict[str, object] = {}
+        self._scaling_events: Dict[Tuple[str, str], List] = {}
+        self._acl_policies: Dict[str, object] = {}
+        self._acl_tokens: Dict[str, object] = {}
         self.scheduler_config = SchedulerConfiguration()
         # table name -> [callback(index)]; fired outside the lock
         self._watchers: Dict[str, List[Callable[[int], None]]] = {}
@@ -234,6 +240,133 @@ class StateStore:
 
     # --- snapshot persist/restore (fsm.go:1393 Snapshot, :1407 Restore) -
 
+    # --- aux tables: namespaces / scaling / ACL / stability -------------
+
+    def upsert_namespace(self, ns) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._namespaces[ns.name] = ns
+        self._notify(["namespaces"], idx)
+        return idx
+
+    def delete_namespace(self, name: str) -> int:
+        with self._lock:
+            if any(key[0] == name for key in self._jobs):
+                raise ValueError(f"namespace '{name}' has registered jobs")
+            idx = self._next_index()
+            self._namespaces.pop(name, None)
+        self._notify(["namespaces"], idx)
+        return idx
+
+    def namespaces(self) -> List:
+        with self._lock:
+            return list(self._namespaces.values())
+
+    def namespace_by_name(self, name: str):
+        with self._lock:
+            return self._namespaces.get(name)
+
+    def record_scaling_event(self, namespace: str, job_id: str, group: str,
+                             event: Dict) -> int:
+        """state_store.go UpsertScalingEvent (bounded history per group)."""
+        with self._lock:
+            idx = self._next_index()
+            event = dict(event)
+            event.setdefault("task_group", group)
+            events = self._scaling_events.setdefault((namespace, job_id), [])
+            events.insert(0, event)
+            del events[20:]  # structs.go JobTrackedScalingEvents
+        self._notify(["scaling_event"], idx)
+        return idx
+
+    def scaling_events(self, namespace: str, job_id: str) -> List[Dict]:
+        with self._lock:
+            return list(self._scaling_events.get((namespace, job_id), []))
+
+    def scaling_policies(self) -> List[Dict]:
+        """Derived view: one policy per task group with a scaling stanza
+        (reference stores these in a table keyed by target; deriving
+        from the jobs table keeps them trivially consistent)."""
+        with self._lock:
+            out = []
+            for (ns, jid), job in self._jobs.items():
+                for tg in job.task_groups:
+                    if tg.scaling is not None:
+                        out.append({
+                            "id": f"{ns}/{jid}/{tg.name}",
+                            "namespace": ns, "job_id": jid, "group": tg.name,
+                            "policy": tg.scaling, "enabled": tg.scaling.enabled,
+                        })
+            return out
+
+    def scaling_policy_by_id(self, policy_id: str):
+        for p in self.scaling_policies():
+            if p["id"] == policy_id:
+                return p
+        return None
+
+    def set_job_stability(self, namespace: str, job_id: str, version: int,
+                          stable: bool) -> int:
+        with self._lock:
+            idx = self._next_index()
+            job = self._job_versions.get((namespace, job_id, version))
+            if job is not None:
+                job.stable = stable
+                job.modify_index = idx
+        self._notify(["jobs"], idx)
+        return idx
+
+    def upsert_acl_policy(self, policy) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._acl_policies[policy.name] = policy
+        self._notify(["acl_policy"], idx)
+        return idx
+
+    def delete_acl_policy(self, name: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._acl_policies.pop(name, None)
+        self._notify(["acl_policy"], idx)
+        return idx
+
+    def acl_policies(self) -> List:
+        with self._lock:
+            return list(self._acl_policies.values())
+
+    def acl_policy_by_name(self, name: str):
+        with self._lock:
+            return self._acl_policies.get(name)
+
+    def upsert_acl_token(self, token) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._acl_tokens[token.accessor_id] = token
+        self._notify(["acl_token"], idx)
+        return idx
+
+    def delete_acl_token(self, accessor_id: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._acl_tokens.pop(accessor_id, None)
+        self._notify(["acl_token"], idx)
+        return idx
+
+    def acl_tokens(self) -> List:
+        with self._lock:
+            return list(self._acl_tokens.values())
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        with self._lock:
+            return self._acl_tokens.get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        with self._lock:
+            for t in self._acl_tokens.values():
+                if t.secret_id == secret_id:
+                    return t
+            return None
+
     def to_snapshot_bytes(self) -> bytes:
         """Serialize every table for raft snapshots / operator backup."""
         with self._lock:
@@ -249,6 +382,10 @@ class StateStore:
                 "allocs_by_node": {k: set(v) for k, v in self._allocs_by_node.items()},
                 "allocs_by_eval": {k: set(v) for k, v in self._allocs_by_eval.items()},
                 "scheduler_config": self.scheduler_config,
+                "namespaces": dict(self._namespaces),
+                "scaling_events": {k: list(v) for k, v in self._scaling_events.items()},
+                "acl_policies": dict(self._acl_policies),
+                "acl_tokens": dict(self._acl_tokens),
             }
             return pickle.dumps(payload)
 
@@ -266,6 +403,10 @@ class StateStore:
             self._allocs_by_node = payload["allocs_by_node"]
             self._allocs_by_eval = payload["allocs_by_eval"]
             self.scheduler_config = payload["scheduler_config"]
+            self._namespaces = payload.get("namespaces", {})
+            self._scaling_events = payload.get("scaling_events", {})
+            self._acl_policies = payload.get("acl_policies", {})
+            self._acl_tokens = payload.get("acl_tokens", {})
         self._notify(
             ["nodes", "jobs", "evals", "allocs", "deployment", "scheduler_config"],
             payload["index"],

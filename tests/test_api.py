@@ -1,0 +1,273 @@
+"""HTTP API + SDK tests.
+
+Modeled on reference command/agent/*_test.go and api/ SDK tests
+(testagent.go pattern: full agent + HTTP on an ephemeral port).
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.api.client import APIClient, APIError, QueryOptions
+from nomad_tpu.api.codec import decode, encode, wire_name
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.job import Job
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(name="test-agent", num_schedulers=1))
+    a.start()
+    # register some nodes straight into state (no client data plane here)
+    for _ in range(4):
+        a.server.node_register(mock.node())
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def api(agent):
+    return APIClient(agent.http_addr)
+
+
+def wait_until(fn, timeout=10.0, every=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(every)
+    return False
+
+
+class TestCodec:
+    def test_wire_name(self):
+        assert wire_name("job_id") == "JobID"
+        assert wire_name("cpu_shares") == "CPUShares"
+        assert wire_name("memory_mb") == "MemoryMB"
+        assert wire_name("task_groups") == "TaskGroups"
+
+    def test_roundtrip_job(self):
+        job = mock.simple_job()
+        wire = encode(job)
+        assert wire["ID"] == job.id
+        assert wire["TaskGroups"][0]["Tasks"][0]["Resources"]["CPU"] == 500
+        back = decode(wire, Job)
+        assert back.id == job.id
+        assert back.task_groups[0].tasks[0].resources.cpu == 500
+        assert back.task_groups[0].count == job.task_groups[0].count
+
+    def test_decode_ignores_unknown_keys(self):
+        job = decode({"ID": "x", "Bogus": 1}, Job)
+        assert job.id == "x"
+
+
+class TestJobsAPI:
+    def test_register_and_run(self, agent, api):
+        job = encode(mock.simple_job())
+        res = api.jobs.register(job)
+        assert res["EvalID"]
+        # scheduler places all 10 allocs
+        assert wait_until(
+            lambda: len(api.jobs.allocations(job["ID"])) == 10
+        ), "allocations never appeared"
+        info = api.jobs.info(job["ID"])
+        assert info["ID"] == job["ID"]
+        listed = api.jobs.list()
+        assert any(j["ID"] == job["ID"] for j in listed)
+        summ = api.jobs.summary(job["ID"])
+        assert sum(v for v in summ["Summary"]["web"].values()) == 10
+        evals = api.jobs.evaluations(job["ID"])
+        assert evals and evals[0]["JobID"] == job["ID"]
+
+    def test_job_plan_dry_run(self, agent, api):
+        job = encode(mock.simple_job())
+        res = api.jobs.plan(job, diff=True)
+        assert res["Diff"]["Type"] == "Added"
+        # dry run must not register the job
+        with pytest.raises(APIError) as e:
+            api.jobs.info(job["ID"])
+        assert e.value.status == 404
+
+    def test_deregister(self, agent, api):
+        job = encode(mock.simple_job())
+        api.jobs.register(job)
+        api.jobs.deregister(job["ID"], purge=True)
+        with pytest.raises(APIError):
+            api.jobs.info(job["ID"])
+
+    def test_blocking_query_unblocks_on_register(self, agent, api):
+        start_jobs = api.jobs.list()
+        index = agent.server.state.latest_index()
+        got = {}
+
+        def blocked():
+            got["jobs"] = api.jobs.list(QueryOptions(wait_index=index,
+                                                     wait_time_s=5.0))
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.1)
+        job = encode(mock.simple_job())
+        api.jobs.register(job)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert len(got["jobs"]) >= len(start_jobs)
+
+    def test_versions_and_revert(self, agent, api):
+        job = mock.simple_job()
+        wire = encode(job)
+        api.jobs.register(wire)
+        wire2 = encode(job)
+        wire2["TaskGroups"][0]["Count"] = 3
+        api.jobs.register(wire2)
+        versions = api.jobs.versions(job.id)["Versions"]
+        assert len(versions) >= 2
+        api.jobs.revert(job.id, 0)
+        info = api.jobs.info(job.id)
+        assert info["Version"] >= 2  # revert re-registers as a new version
+
+    def test_scale(self, agent, api):
+        job = mock.simple_job()
+        api.jobs.register(encode(job))
+        api.jobs.scale(job.id, "web", 5, message="scale test")
+        status = api.jobs.scale_status(job.id)
+        assert status["TaskGroups"]["web"]["Desired"] == 5
+        assert status["TaskGroups"]["web"]["Events"]
+
+    def test_dispatch_parameterized(self, agent, api):
+        from nomad_tpu.structs.job import ParameterizedJobConfig
+
+        job = mock.simple_job()
+        job.parameterized = ParameterizedJobConfig(meta_required=["input"])
+        api.jobs.register(encode(job))
+        res = api.jobs.dispatch(job.id, meta={"input": "x"})
+        assert res["DispatchedJobID"].startswith(f"{job.id}/dispatch-")
+        with pytest.raises(APIError):
+            api.jobs.dispatch(job.id, meta={})  # missing required meta
+
+
+class TestNodesAPI:
+    def test_list_and_info(self, agent, api):
+        nodes = api.nodes.list()
+        assert len(nodes) >= 4
+        info = api.nodes.info(nodes[0]["ID"])
+        assert info["ID"] == nodes[0]["ID"]
+
+    def test_drain_and_eligibility(self, agent, api):
+        node = api.nodes.list()[0]
+        api.nodes.drain(node["ID"], enable=True, deadline_s=1.0)
+        info = api.nodes.info(node["ID"])
+        assert info["DrainStrategy"] or info["SchedulingEligibility"] == "ineligible"
+        api.nodes.drain(node["ID"], enable=False)
+        api.nodes.eligibility(node["ID"], eligible=True)
+        info = api.nodes.info(node["ID"])
+        assert info["SchedulingEligibility"] == "eligible"
+
+
+class TestOperatorAPI:
+    def test_scheduler_config_roundtrip(self, agent, api):
+        cfg = api.operator.scheduler_config()["SchedulerConfig"]
+        assert cfg["SchedulerAlgorithm"] == "binpack"
+        cfg["SchedulerAlgorithm"] = "spread"
+        api.operator.set_scheduler_config(cfg)
+        cfg2 = api.operator.scheduler_config()["SchedulerConfig"]
+        assert cfg2["SchedulerAlgorithm"] == "spread"
+        cfg2["SchedulerAlgorithm"] = "binpack"
+        api.operator.set_scheduler_config(cfg2)
+
+    def test_snapshot_save_restore(self, agent, api):
+        job = mock.simple_job()
+        api.jobs.register(encode(job))
+        snap = api.operator.snapshot_save()
+        assert len(snap) > 100
+        api.jobs.deregister(job.id, purge=True)
+        with pytest.raises(APIError):
+            api.jobs.info(job.id)
+        api.operator.snapshot_restore(snap)
+        assert api.jobs.info(job.id)["ID"] == job.id
+
+
+class TestSearchAPI:
+    def test_prefix_search(self, agent, api):
+        job = mock.simple_job()
+        api.jobs.register(encode(job))
+        res = api.search.prefix(job.id[:5], "jobs")
+        assert job.id in res["Matches"]["jobs"]
+
+    def test_fuzzy_search(self, agent, api):
+        nodes = api.nodes.list()
+        name = nodes[0]["Name"]
+        res = api.search.fuzzy(name[:4], "nodes")
+        assert any(name in m["ID"] for m in res["Matches"]["nodes"])
+
+
+class TestNamespacesAPI:
+    def test_crud(self, agent, api):
+        api.namespaces.register("apps", "application namespace")
+        names = {n["Name"] for n in api.namespaces.list()}
+        assert {"default", "apps"} <= names
+        info = api.namespaces.info("apps")
+        assert info["Description"] == "application namespace"
+        api.namespaces.delete("apps")
+        names = {n["Name"] for n in api.namespaces.list()}
+        assert "apps" not in names
+
+
+class TestAgentAPI:
+    def test_self_and_health(self, agent, api):
+        self_info = api.agent.self()
+        assert self_info["Config"]["Name"] == "test-agent"
+        assert self_info["Config"]["Server"] is True
+        health = api.agent.health()
+        assert health["server"]["ok"]
+
+    def test_members(self, agent, api):
+        members = api.agent.members()
+        assert members["Members"][0]["Name"] == "test-agent"
+
+    def test_metrics(self, agent, api):
+        from nomad_tpu.utils.metrics import global_registry
+
+        global_registry.incr_counter("nomad.test.counter", 2)
+        res = api.agent.metrics()
+        assert any(c["Name"] == "nomad.test.counter" for c in res["Counters"])
+
+
+class TestEventStream:
+    def test_stream_delivers_job_events(self, agent, api):
+        got = []
+
+        def consume():
+            try:
+                for batch in api.events.stream(topics={"Job": ["*"]},
+                                               timeout=10.0):
+                    got.extend(batch.get("Events", []))
+                    if got:
+                        return
+            except Exception:
+                pass
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        api.jobs.register(encode(mock.simple_job()))
+        t.join(timeout=10)
+        assert got, "no events received"
+        assert got[0]["Topic"] == "Job"
+
+
+class TestAllocAPI:
+    def test_alloc_lifecycle(self, agent, api):
+        job = encode(mock.simple_job())
+        api.jobs.register(job)
+        assert wait_until(lambda: api.jobs.allocations(job["ID"]))
+        allocs = api.jobs.allocations(job["ID"])
+        info = api.allocations.info(allocs[0]["ID"])
+        assert info["JobID"] == job["ID"]
+        res = api.allocations.stop(allocs[0]["ID"])
+        assert res["EvalID"]
+        listed = api.allocations.list()
+        assert any(a["ID"] == allocs[0]["ID"] for a in listed)
