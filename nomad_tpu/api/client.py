@@ -14,12 +14,20 @@ Usage::
 
 from __future__ import annotations
 
+import copy
 import json
 import urllib.error
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
+
+
+def _esc(segment: Any) -> str:
+    """URL-escape a path segment. Dispatched job IDs contain '/'
+    (``parent/dispatch-...``, structs.go DispatchedID), so any ID embedded
+    in a route path must be quoted."""
+    return urllib.parse.quote(str(segment), safe="")
 
 
 class APIError(Exception):
@@ -129,43 +137,43 @@ class Jobs(_Endpoint):
         return self.c.put("/v1/jobs", {"Job": job}, q)
 
     def info(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.get(f"/v1/job/{job_id}", q)
+        return self.c.get(f"/v1/job/{_esc(job_id)}", q)
 
     def deregister(self, job_id: str, purge: bool = False,
                    q: Optional[QueryOptions] = None) -> Dict:
-        q = q or QueryOptions()
+        q = copy.deepcopy(q) if q is not None else QueryOptions()
         if purge:
             q.params["purge"] = "true"
-        return self.c.delete(f"/v1/job/{job_id}", q)
+        return self.c.delete(f"/v1/job/{_esc(job_id)}", q)
 
     def plan(self, job: Dict, diff: bool = False,
              q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.put(f"/v1/job/{job['ID']}/plan",
+        return self.c.put(f"/v1/job/{_esc(job['ID'])}/plan",
                           {"Job": job, "Diff": diff}, q)
 
     def allocations(self, job_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
-        return self.c.get(f"/v1/job/{job_id}/allocations", q)
+        return self.c.get(f"/v1/job/{_esc(job_id)}/allocations", q)
 
     def evaluations(self, job_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
-        return self.c.get(f"/v1/job/{job_id}/evaluations", q)
+        return self.c.get(f"/v1/job/{_esc(job_id)}/evaluations", q)
 
     def deployments(self, job_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
-        return self.c.get(f"/v1/job/{job_id}/deployments", q)
+        return self.c.get(f"/v1/job/{_esc(job_id)}/deployments", q)
 
     def summary(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.get(f"/v1/job/{job_id}/summary", q)
+        return self.c.get(f"/v1/job/{_esc(job_id)}/summary", q)
 
     def versions(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.get(f"/v1/job/{job_id}/versions", q)
+        return self.c.get(f"/v1/job/{_esc(job_id)}/versions", q)
 
     def revert(self, job_id: str, version: int,
                q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.post(f"/v1/job/{job_id}/revert",
+        return self.c.post(f"/v1/job/{_esc(job_id)}/revert",
                            {"JobID": job_id, "JobVersion": version}, q)
 
     def stable(self, job_id: str, version: int, stable: bool,
                q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.post(f"/v1/job/{job_id}/stable",
+        return self.c.post(f"/v1/job/{_esc(job_id)}/stable",
                            {"JobVersion": version, "Stable": stable}, q)
 
     def dispatch(self, job_id: str, meta: Optional[Dict] = None,
@@ -173,7 +181,7 @@ class Jobs(_Endpoint):
         import base64
 
         return self.c.post(
-            f"/v1/job/{job_id}/dispatch",
+            f"/v1/job/{_esc(job_id)}/dispatch",
             {"Meta": meta or {},
              "Payload": base64.b64encode(payload).decode()}, q,
         )
@@ -181,15 +189,15 @@ class Jobs(_Endpoint):
     def scale(self, job_id: str, group: str, count: int, message: str = "",
               q: Optional[QueryOptions] = None) -> Dict:
         return self.c.post(
-            f"/v1/job/{job_id}/scale",
+            f"/v1/job/{_esc(job_id)}/scale",
             {"Target": {"Group": group}, "Count": count, "Message": message}, q,
         )
 
     def scale_status(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.get(f"/v1/job/{job_id}/scale", q)
+        return self.c.get(f"/v1/job/{_esc(job_id)}/scale", q)
 
     def periodic_force(self, job_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.post(f"/v1/job/{job_id}/periodic/force", {}, q)
+        return self.c.post(f"/v1/job/{_esc(job_id)}/periodic/force", {}, q)
 
     def parse(self, hcl: str) -> Dict:
         return self.c.post("/v1/jobs/parse", {"JobHCL": hcl})
@@ -200,10 +208,10 @@ class Nodes(_Endpoint):
         return self.c.get("/v1/nodes", q)
 
     def info(self, node_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.get(f"/v1/node/{node_id}", q)
+        return self.c.get(f"/v1/node/{_esc(node_id)}", q)
 
     def allocations(self, node_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
-        return self.c.get(f"/v1/node/{node_id}/allocations", q)
+        return self.c.get(f"/v1/node/{_esc(node_id)}/allocations", q)
 
     def drain(self, node_id: str, enable: bool = True,
               deadline_s: float = 0.0, ignore_system: bool = False,
@@ -212,20 +220,20 @@ class Nodes(_Endpoint):
         if enable:
             spec = {"Deadline": int(deadline_s * 1e9),
                     "IgnoreSystemJobs": ignore_system}
-        return self.c.post(f"/v1/node/{node_id}/drain", {"DrainSpec": spec}, q)
+        return self.c.post(f"/v1/node/{_esc(node_id)}/drain", {"DrainSpec": spec}, q)
 
     def eligibility(self, node_id: str, eligible: bool,
                     q: Optional[QueryOptions] = None) -> Dict:
         return self.c.post(
-            f"/v1/node/{node_id}/eligibility",
+            f"/v1/node/{_esc(node_id)}/eligibility",
             {"Eligibility": "eligible" if eligible else "ineligible"}, q,
         )
 
     def evaluate(self, node_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.post(f"/v1/node/{node_id}/evaluate", {}, q)
+        return self.c.post(f"/v1/node/{_esc(node_id)}/evaluate", {}, q)
 
     def purge(self, node_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.post(f"/v1/node/{node_id}/purge", {}, q)
+        return self.c.post(f"/v1/node/{_esc(node_id)}/purge", {}, q)
 
 
 class Allocations(_Endpoint):
@@ -233,10 +241,10 @@ class Allocations(_Endpoint):
         return self.c.get("/v1/allocations", q)
 
     def info(self, alloc_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.get(f"/v1/allocation/{alloc_id}", q)
+        return self.c.get(f"/v1/allocation/{_esc(alloc_id)}", q)
 
     def stop(self, alloc_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.post(f"/v1/allocation/{alloc_id}/stop", {}, q)
+        return self.c.post(f"/v1/allocation/{_esc(alloc_id)}/stop", {}, q)
 
 
 class Evaluations(_Endpoint):
@@ -244,10 +252,10 @@ class Evaluations(_Endpoint):
         return self.c.get("/v1/evaluations", q)
 
     def info(self, eval_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.get(f"/v1/evaluation/{eval_id}", q)
+        return self.c.get(f"/v1/evaluation/{_esc(eval_id)}", q)
 
     def allocations(self, eval_id: str, q: Optional[QueryOptions] = None) -> List[Dict]:
-        return self.c.get(f"/v1/evaluation/{eval_id}/allocations", q)
+        return self.c.get(f"/v1/evaluation/{_esc(eval_id)}/allocations", q)
 
 
 class Deployments(_Endpoint):
@@ -255,20 +263,20 @@ class Deployments(_Endpoint):
         return self.c.get("/v1/deployments", q)
 
     def info(self, deployment_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.get(f"/v1/deployment/{deployment_id}", q)
+        return self.c.get(f"/v1/deployment/{_esc(deployment_id)}", q)
 
     def fail(self, deployment_id: str, q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.post(f"/v1/deployment/fail/{deployment_id}", {}, q)
+        return self.c.post(f"/v1/deployment/fail/{_esc(deployment_id)}", {}, q)
 
     def pause(self, deployment_id: str, pause: bool = True,
               q: Optional[QueryOptions] = None) -> Dict:
-        return self.c.post(f"/v1/deployment/pause/{deployment_id}",
+        return self.c.post(f"/v1/deployment/pause/{_esc(deployment_id)}",
                            {"Pause": pause}, q)
 
     def promote(self, deployment_id: str, groups: Optional[List[str]] = None,
                 q: Optional[QueryOptions] = None) -> Dict:
         return self.c.post(
-            f"/v1/deployment/promote/{deployment_id}",
+            f"/v1/deployment/promote/{_esc(deployment_id)}",
             {"All": groups is None, "Groups": groups}, q,
         )
 
@@ -335,14 +343,14 @@ class Namespaces(_Endpoint):
         return self.c.get("/v1/namespaces")
 
     def info(self, name: str) -> Dict:
-        return self.c.get(f"/v1/namespace/{name}")
+        return self.c.get(f"/v1/namespace/{_esc(name)}")
 
     def register(self, name: str, description: str = "") -> Dict:
-        return self.c.put(f"/v1/namespace/{name}",
+        return self.c.put(f"/v1/namespace/{_esc(name)}",
                           {"Name": name, "Description": description})
 
     def delete(self, name: str) -> Dict:
-        return self.c.delete(f"/v1/namespace/{name}")
+        return self.c.delete(f"/v1/namespace/{_esc(name)}")
 
 
 class Scaling(_Endpoint):
@@ -350,7 +358,7 @@ class Scaling(_Endpoint):
         return self.c.get("/v1/scaling/policies")
 
     def policy(self, policy_id: str) -> Dict:
-        return self.c.get(f"/v1/scaling/policy/{policy_id}")
+        return self.c.get(f"/v1/scaling/policy/{_esc(policy_id)}")
 
 
 class ACLAPI(_Endpoint):
@@ -361,14 +369,14 @@ class ACLAPI(_Endpoint):
         return self.c.get("/v1/acl/policies")
 
     def policy(self, name: str) -> Dict:
-        return self.c.get(f"/v1/acl/policy/{name}")
+        return self.c.get(f"/v1/acl/policy/{_esc(name)}")
 
     def put_policy(self, name: str, rules: str, description: str = "") -> Dict:
-        return self.c.put(f"/v1/acl/policy/{name}",
+        return self.c.put(f"/v1/acl/policy/{_esc(name)}",
                           {"Rules": rules, "Description": description})
 
     def delete_policy(self, name: str) -> Dict:
-        return self.c.delete(f"/v1/acl/policy/{name}")
+        return self.c.delete(f"/v1/acl/policy/{_esc(name)}")
 
     def tokens(self) -> List[Dict]:
         return self.c.get("/v1/acl/tokens")
@@ -385,7 +393,7 @@ class ACLAPI(_Endpoint):
         return self.c.get("/v1/acl/token/self")
 
     def delete_token(self, accessor_id: str) -> Dict:
-        return self.c.delete(f"/v1/acl/token/{accessor_id}")
+        return self.c.delete(f"/v1/acl/token/{_esc(accessor_id)}")
 
 
 class Events(_Endpoint):

@@ -146,7 +146,14 @@ class HTTPAgent:
             m = pattern.fullmatch(path)
             if m is None:
                 continue
-            req = Request(method, path, m.groupdict(), query, body, token, handler)
+            # path params arrive percent-encoded (dispatched job IDs
+            # contain '/'); decode before handing to endpoint handlers
+            params = {
+                k: urllib.parse.unquote(v)
+                for k, v in m.groupdict().items()
+                if v is not None
+            }
+            req = Request(method, path, params, query, body, token, handler)
             try:
                 result = fn(req)
             except HTTPError as e:

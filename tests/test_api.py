@@ -145,6 +145,12 @@ class TestJobsAPI:
         api.jobs.register(encode(job))
         res = api.jobs.dispatch(job.id, meta={"input": "x"})
         assert res["DispatchedJobID"].startswith(f"{job.id}/dispatch-")
+        # dispatched IDs contain '/': the SDK must escape them in paths
+        child = res["DispatchedJobID"]
+        assert api.jobs.info(child)["ID"] == child
+        api.jobs.deregister(child, purge=True)
+        with pytest.raises(APIError):
+            api.jobs.info(child)
         with pytest.raises(APIError):
             api.jobs.dispatch(job.id, meta={})  # missing required meta
 
@@ -261,6 +267,12 @@ class TestEventStream:
 
 class TestAllocAPI:
     def test_alloc_lifecycle(self, agent, api):
+        # earlier module-scoped tests leave jobs (some blocked on capacity)
+        # behind; purge them and add fresh nodes so this job always places
+        for j in api.jobs.list():
+            api.jobs.deregister(j["ID"], purge=True)
+        for _ in range(2):
+            agent.server.node_register(mock.node())
         job = encode(mock.simple_job())
         api.jobs.register(job)
         assert wait_until(lambda: api.jobs.allocations(job["ID"]))
