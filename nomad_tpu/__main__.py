@@ -1,0 +1,7 @@
+"""`python -m nomad_tpu` — the single-binary entry point (main.go:80)."""
+
+import sys
+
+from nomad_tpu.cli import main
+
+sys.exit(main())

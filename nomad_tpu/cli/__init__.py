@@ -1,0 +1,3 @@
+"""CLI verb tree over the /v1 SDK (reference: command/ + main.go)."""
+
+from nomad_tpu.cli.main import main  # noqa: F401

@@ -160,6 +160,8 @@ class TaskRunner:
             try:
                 self.handle = self.driver.start_task(self._task_config())
             except Exception as e:              # noqa: BLE001
+                LOG.warning("task %s: driver start failed: %s",
+                            self.task.name, e)
                 self._emit(EVENT_DRIVER_FAILURE, str(e))
                 decision, delay = self.restart_tracker.next_restart(False)
                 if decision != "restart" or self._kill.wait(delay):

@@ -37,7 +37,11 @@ class _MockTask:
         self.exit_result: Optional[ExitResult] = None
         self.done = threading.Event()
         self.kill = threading.Event()
-        run_for = float(config.driver_config.get("run_for", 0))
+        # run_for accepts Go-style durations ("10s", "1m") like the
+        # reference mock driver's time.ParseDuration config fields
+        from nomad_tpu.jobspec.hcl import duration_s
+
+        run_for = duration_s(config.driver_config.get("run_for", 0))
         exit_code = int(config.driver_config.get("exit_code", 0))
         self.thread = threading.Thread(
             target=self._run, args=(run_for, exit_code), daemon=True

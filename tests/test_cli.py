@@ -1,0 +1,245 @@
+"""CLI tests — drive the verb tree against a live in-process agent.
+
+Modeled on the reference's command/*_test.go pattern (testagent + CLI
+Run() with captured output).
+"""
+
+import json
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.api.codec import encode
+from nomad_tpu.cli.main import main
+
+JOB_HCL = """
+job "cli-example" {
+  datacenters = ["dc1"]
+  type = "service"
+
+  group "web" {
+    count = 2
+
+    task "frontend" {
+      driver = "mock_driver"
+      config {
+        run_for = "10s"
+      }
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+    }
+  }
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(name="cli-agent", num_schedulers=1))
+    a.start()
+    for _ in range(4):
+        a.server.node_register(mock.node())
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def addr(agent):
+    return agent.http_addr
+
+
+@pytest.fixture()
+def jobfile(tmp_path):
+    p = tmp_path / "example.hcl"
+    p.write_text(JOB_HCL)
+    return str(p)
+
+
+def run_cli(addr, *argv):
+    return main(["-address", addr, *argv])
+
+
+class TestJobCommands:
+    def test_run_and_status(self, addr, jobfile, capsys):
+        rc = run_cli(addr, "job", "run", jobfile)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert 'status "complete"' in out
+        assert out.count("Allocation") == 2
+
+        rc = run_cli(addr, "job", "status", "cli-example")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cli-example" in out
+        assert "Summary" in out
+        assert "Allocations" in out
+
+    def test_job_list(self, addr, capsys):
+        rc = run_cli(addr, "job", "status")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cli-example" in out
+
+    def test_plan_detects_change(self, addr, jobfile, capsys):
+        # same job, higher count => diff => exit code 1
+        changed = JOB_HCL.replace("count = 2", "count = 4")
+        p = jobfile + ".changed"
+        with open(p, "w") as f:
+            f.write(changed)
+        rc = run_cli(addr, "job", "plan", p)
+        capsys.readouterr()
+        assert rc == 1  # non-empty diff
+
+    def test_inspect(self, addr, capsys):
+        rc = run_cli(addr, "job", "inspect", "cli-example")
+        out = capsys.readouterr().out
+        assert rc == 0
+        parsed = json.loads(out)
+        assert parsed["Job"]["ID"] == "cli-example"
+
+    def test_top_level_run_alias(self, addr, jobfile, capsys):
+        rc = run_cli(addr, "run", "-detach", jobfile)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "registration successful" in out
+
+    def test_stop(self, addr, capsys):
+        rc = run_cli(addr, "job", "stop", "-detach", "-purge", "cli-example")
+        capsys.readouterr()
+        assert rc == 0
+        rc = run_cli(addr, "job", "status", "cli-example")
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "no jobs match" in err
+
+
+class TestNodeCommands:
+    def test_node_status_list(self, addr, capsys):
+        rc = run_cli(addr, "node", "status")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "foobar-" in out
+        assert "ready" in out
+
+    def test_node_status_one_by_prefix(self, agent, addr, capsys):
+        node_id = agent.server.state.snapshot().nodes()[0].id
+        rc = run_cli(addr, "node", "status", node_id[:8])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert node_id in out
+
+    def test_node_eligibility(self, agent, addr, capsys):
+        node_id = agent.server.state.snapshot().nodes()[0].id
+        rc = run_cli(addr, "node", "eligibility", "-disable", node_id)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ineligible" in out
+        rc = run_cli(addr, "node", "eligibility", "-enable", node_id)
+        out = capsys.readouterr().out
+        assert rc == 0
+
+
+class TestAllocEvalCommands:
+    def test_alloc_and_eval_status(self, agent, addr, jobfile, capsys):
+        rc = run_cli(addr, "job", "run", jobfile)
+        capsys.readouterr()
+        assert rc == 0
+        api = APIClient(addr)
+        allocs = api.jobs.allocations("cli-example")
+        assert allocs
+        rc = run_cli(addr, "alloc", "status", allocs[0]["ID"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cli-example" in out
+
+        rc = run_cli(addr, "eval", "list")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cli-example" in out
+
+        ev_id = allocs[0]["EvalID"]
+        rc = run_cli(addr, "eval", "status", ev_id)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "complete" in out
+
+    def test_generic_status_resolves_alloc(self, addr, capsys):
+        api = APIClient(addr)
+        allocs = api.jobs.allocations("cli-example")
+        rc = run_cli(addr, "status", allocs[0]["ID"][:8])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Client Status" in out
+
+
+class TestOperatorCommands:
+    def test_scheduler_config(self, addr, capsys):
+        rc = run_cli(addr, "operator", "scheduler", "get-config")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "binpack" in out
+        rc = run_cli(addr, "operator", "scheduler", "set-config",
+                     "-scheduler-algorithm", "spread")
+        capsys.readouterr()
+        assert rc == 0
+        rc = run_cli(addr, "operator", "scheduler", "get-config")
+        out = capsys.readouterr().out
+        assert "spread" in out
+        run_cli(addr, "operator", "scheduler", "set-config",
+                "-scheduler-algorithm", "binpack")
+        capsys.readouterr()
+
+    def test_snapshot_roundtrip(self, addr, tmp_path, capsys):
+        snap = str(tmp_path / "state.snap")
+        rc = run_cli(addr, "operator", "snapshot", "save", snap)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "written" in out
+        rc = run_cli(addr, "operator", "snapshot", "restore", snap)
+        out = capsys.readouterr().out
+        assert rc == 0
+
+    def test_server_members(self, addr, capsys):
+        rc = run_cli(addr, "server", "members")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cli-agent" in out
+
+
+class TestMiscCommands:
+    def test_namespace_lifecycle(self, addr, capsys):
+        rc = run_cli(addr, "namespace", "apply", "ns-test",
+                     "-description", "x")
+        capsys.readouterr()
+        assert rc == 0
+        rc = run_cli(addr, "namespace", "list")
+        out = capsys.readouterr().out
+        assert "ns-test" in out
+        rc = run_cli(addr, "namespace", "delete", "ns-test")
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_system_gc(self, addr, capsys):
+        assert run_cli(addr, "system", "gc") == 0
+        capsys.readouterr()
+
+    def test_version(self, addr, capsys):
+        assert run_cli(addr, "version") == 0
+        assert "nomad-tpu" in capsys.readouterr().out
+
+    def test_dispatch(self, agent, addr, capsys):
+        from nomad_tpu.structs.job import ParameterizedJobConfig
+
+        job = mock.simple_job()
+        job.parameterized = ParameterizedJobConfig(meta_required=["input"])
+        api = APIClient(addr)
+        api.jobs.register(encode(job))
+        rc = run_cli(addr, "job", "dispatch", "-detach",
+                     "-meta", "input=x", job.id)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Dispatched Job ID" in out
