@@ -70,6 +70,8 @@ class APIClient:
         self.acl = ACLAPI(self)
         self.events = Events(self)
         self.scaling = Scaling(self)
+        self.csi_volumes = CSIVolumes(self)
+        self.csi_plugins = CSIPlugins(self)
 
     # -- transport -------------------------------------------------------
 
@@ -359,6 +361,56 @@ class Scaling(_Endpoint):
 
     def policy(self, policy_id: str) -> Dict:
         return self.c.get(f"/v1/scaling/policy/{_esc(policy_id)}")
+
+
+class CSIVolumes(_Endpoint):
+    """api/csi.go CSIVolumes."""
+
+    def list(self, plugin_id: str = "",
+             q: Optional[QueryOptions] = None) -> List[Dict]:
+        q = q or QueryOptions()
+        if plugin_id:
+            q.params["plugin_id"] = plugin_id
+        return self.c.get("/v1/volumes", q)
+
+    def info(self, volume_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/volume/csi/{_esc(volume_id)}", q)
+
+    def register(self, volume: Dict, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.put("/v1/volumes", {"Volumes": [volume]}, q)
+
+    def deregister(self, volume_id: str, force: bool = False,
+                   q: Optional[QueryOptions] = None) -> Dict:
+        q = q or QueryOptions()
+        if force:
+            q.params["force"] = "true"
+        return self.c.delete(f"/v1/volume/csi/{_esc(volume_id)}", q)
+
+    def create(self, volume: Dict, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.put(
+            f"/v1/volume/csi/{_esc(volume.get('ID', volume.get('id', '')))}/create",
+            {"Volumes": [volume]}, q,
+        )
+
+    def delete(self, volume_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.delete(f"/v1/volume/csi/{_esc(volume_id)}/delete", q)
+
+    def detach(self, volume_id: str, node_id: str = "", alloc_id: str = "",
+               q: Optional[QueryOptions] = None) -> Dict:
+        q = q or QueryOptions()
+        if node_id:
+            q.params["node"] = node_id
+        if alloc_id:
+            q.params["alloc"] = alloc_id
+        return self.c.put(f"/v1/volume/csi/{_esc(volume_id)}/detach", q=q)
+
+
+class CSIPlugins(_Endpoint):
+    def list(self, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get("/v1/plugins", q)
+
+    def info(self, plugin_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/plugin/csi/{_esc(plugin_id)}", q)
 
 
 class ACLAPI(_Endpoint):

@@ -322,6 +322,22 @@ class HTTPAgent:
         add("GET", r"/v1/scaling/policies", self.scaling_policies)
         add("GET", r"/v1/scaling/policy/(?P<id>.+)", self.scaling_policy)
 
+        # CSI volumes + plugins (http.go CSIVolumesRequest)
+        add("GET", r"/v1/volumes", self.volumes_list)
+        add("PUT", r"/v1/volumes", self.volume_register)
+        add("POST", r"/v1/volumes", self.volume_register)
+        add("GET", r"/v1/volume/csi/(?P<id>[^/]+)", self.volume_get)
+        add("PUT", r"/v1/volume/csi/(?P<id>[^/]+)", self.volume_register)
+        add("POST", r"/v1/volume/csi/(?P<id>[^/]+)", self.volume_register)
+        add("DELETE", r"/v1/volume/csi/(?P<id>[^/]+)", self.volume_deregister)
+        add("PUT", r"/v1/volume/csi/(?P<id>[^/]+)/create", self.volume_create)
+        add("POST", r"/v1/volume/csi/(?P<id>[^/]+)/create", self.volume_create)
+        add("DELETE", r"/v1/volume/csi/(?P<id>[^/]+)/delete", self.volume_delete)
+        add("PUT", r"/v1/volume/csi/(?P<id>[^/]+)/detach", self.volume_detach)
+        add("POST", r"/v1/volume/csi/(?P<id>[^/]+)/detach", self.volume_detach)
+        add("GET", r"/v1/plugins", self.plugins_list)
+        add("GET", r"/v1/plugin/csi/(?P<id>[^/]+)", self.plugin_get)
+
         # event stream
         add("GET", r"/v1/event/stream", self.event_stream)
 
@@ -907,6 +923,130 @@ class HTTPAgent:
         if p is None:
             raise HTTPError(404, "scaling policy not found")
         return p
+
+    # -- CSI volumes + plugins (csi_endpoint.go) -------------------------
+
+    def volumes_list(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "csi-list-volume")
+        self._block(req, ["csi_volumes"])
+        ns = req.namespace
+        plugin_id = req.q("plugin_id")
+        vols = [
+            v for v in self._server.state.csi_volumes()
+            if (ns in ("*", v.namespace))
+            and (not plugin_id or v.plugin_id == plugin_id)
+        ]
+        return [v.stub() for v in sorted(vols, key=lambda v: v.id)]
+
+    def volume_get(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "csi-read-volume")
+        self._block(req, ["csi_volumes"])
+        vol = self._server.state.csi_volume_by_id(
+            req.namespace, req.params["id"]
+        )
+        if vol is None:
+            raise HTTPError(404, "volume not found")
+        # secrets never leave the server (csi_endpoint.go Get strips
+        # Secrets before responding)
+        redacted = vol.copy()
+        redacted.secrets = {k: "[REDACTED]" for k in vol.secrets}
+        return redacted
+
+    def volume_register(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "csi-write-volume")
+        vols = self._decode_volumes(req)
+        try:
+            index = self._server.csi_volume_register(vols)
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        return {"Index": index}
+
+    def volume_create(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "csi-write-volume")
+        vols = self._decode_volumes(req)
+        try:
+            created = self._server.csi_volume_create(vols)
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        return {"Volumes": created}
+
+    def _decode_volumes(self, req: Request):
+        from nomad_tpu.api.codec import decode
+        from nomad_tpu.structs.csi import CSIVolume
+
+        body = req.body or {}
+        raw = body.get("Volumes") or ([body.get("Volume")]
+                                      if body.get("Volume") else [])
+        if not raw:
+            raise HTTPError(400, "no volumes provided")
+        vols = []
+        for r in raw:
+            v = decode(r, CSIVolume)
+            if not v.namespace or v.namespace == "default":
+                v.namespace = req.namespace if req.namespace != "*" \
+                    else "default"
+            vols.append(v)
+        return vols
+
+    def volume_deregister(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "csi-write-volume")
+        try:
+            index = self._server.csi_volume_deregister(
+                req.namespace, req.params["id"], force=req.flag("force")
+            )
+        except ValueError as e:
+            raise HTTPError(400 if "in use" in str(e) else 404, str(e))
+        return {"Index": index}
+
+    def volume_delete(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "csi-write-volume")
+        try:
+            index = self._server.csi_volume_delete(
+                req.namespace, req.params["id"]
+            )
+        except ValueError as e:
+            raise HTTPError(400 if "in use" in str(e) else 404, str(e))
+        return {"Index": index}
+
+    def volume_detach(self, req: Request):
+        """Force-release one alloc's (or node's) claims
+        (csi_endpoint.go Unpublish)."""
+        self._acl(req, "allow_ns_op", req.namespace, "csi-write-volume")
+        vol = self._server.state.csi_volume_by_id(
+            req.namespace, req.params["id"]
+        )
+        if vol is None:
+            raise HTTPError(404, "volume not found")
+        node_id = req.q("node")
+        alloc_id = req.q("alloc")
+        index = self._server.state.latest_index()
+        for claims in (vol.read_claims, vol.write_claims):
+            for aid, claim in list(claims.items()):
+                if alloc_id and aid != alloc_id:
+                    continue
+                if node_id and claim.node_id != node_id:
+                    continue
+                index = self._server.csi_volume_claim(
+                    vol.namespace, vol.id, claim.release_copy()
+                )
+        return {"Index": index}
+
+    def plugins_list(self, req: Request):
+        self._acl(req, "allow_plugin_read")
+        self._block(req, ["nodes"])
+        plugins = self._server.csi_plugins()
+        return [p.stub() for p in sorted(plugins.values(), key=lambda p: p.id)]
+
+    def plugin_get(self, req: Request):
+        self._acl(req, "allow_plugin_read")
+        self._block(req, ["nodes"])
+        p = self._server.csi_plugins().get(req.params["id"])
+        if p is None:
+            raise HTTPError(404, "plugin not found")
+        out = p.stub()
+        out["Controllers"] = p.controllers
+        out["Nodes"] = p.nodes
+        return out
 
     # -- event stream (stream/ndjson.go) ---------------------------------
 

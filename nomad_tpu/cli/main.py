@@ -552,6 +552,74 @@ def cmd_namespace_delete(args) -> int:
     return 0
 
 
+def cmd_volume_register(args) -> int:
+    import json as _json
+
+    api = make_client(args)
+    with open(args.file) as f:
+        spec = f.read()
+    try:
+        vol = _json.loads(spec)
+    except _json.JSONDecodeError:
+        from nomad_tpu.jobspec.hcl import parse_hcl
+        vol = parse_hcl(spec).get("volume", {})
+    api.csi_volumes.register(vol)
+    print(f"Successfully registered volume \"{vol.get('ID', vol.get('id', ''))}\"")
+    return 0
+
+
+def cmd_volume_status(args) -> int:
+    api = make_client(args)
+    if args.volume_id:
+        v = api.csi_volumes.info(args.volume_id)
+        print(format_kv([
+            f"ID|{v.get('ID', '')}",
+            f"Name|{v.get('Name', '')}",
+            f"External ID|{v.get('ExternalID', '')}",
+            f"Plugin ID|{v.get('PluginID', '')}",
+            f"Schedulable|{v.get('Schedulable', '')}",
+            f"Readers|{len(v.get('ReadClaims') or {})}",
+            f"Writers|{len(v.get('WriteClaims') or {})}",
+        ]))
+    else:
+        vols = api.csi_volumes.list()
+        print(dict_rows(vols, ["ID", "Name", "PluginID", "Schedulable"]))
+    return 0
+
+
+def cmd_volume_deregister(args) -> int:
+    api = make_client(args)
+    api.csi_volumes.deregister(args.volume_id, force=args.force)
+    print(f"Successfully deregistered volume \"{args.volume_id}\"")
+    return 0
+
+
+def cmd_volume_detach(args) -> int:
+    api = make_client(args)
+    api.csi_volumes.detach(args.volume_id, node_id=args.node or "")
+    print(f"Successfully detached volume \"{args.volume_id}\"")
+    return 0
+
+
+def cmd_plugin_status(args) -> int:
+    api = make_client(args)
+    if args.plugin_id:
+        p = api.csi_plugins.info(args.plugin_id)
+        print(format_kv([
+            f"ID|{p.get('ID', '')}",
+            f"Provider|{p.get('Provider', '')}",
+            f"Controllers Healthy|{p.get('ControllersHealthy', 0)}",
+            f"Nodes Healthy|{p.get('NodesHealthy', 0)}",
+        ]))
+    else:
+        plugins = api.csi_plugins.list()
+        print(dict_rows(
+            plugins,
+            ["ID", "Provider", "ControllersHealthy", "NodesHealthy"],
+        ))
+    return 0
+
+
 def cmd_acl_bootstrap(args) -> int:
     api = make_client(args)
     tok = api.acl.bootstrap()
@@ -908,6 +976,30 @@ def build_parser() -> argparse.ArgumentParser:
     ndel = nsp.add_parser("delete")
     ndel.add_argument("name")
     ndel.set_defaults(fn=cmd_namespace_delete)
+
+    # volume + plugin (CSI)
+    vol = sub.add_parser("volume").add_subparsers(dest="subcommand",
+                                                  required=True)
+    vreg = vol.add_parser("register")
+    vreg.add_argument("file")
+    vreg.set_defaults(fn=cmd_volume_register)
+    vst = vol.add_parser("status")
+    vst.add_argument("volume_id", nargs="?", default="")
+    vst.set_defaults(fn=cmd_volume_status)
+    vdereg = vol.add_parser("deregister")
+    vdereg.add_argument("volume_id")
+    vdereg.add_argument("-force", action="store_true")
+    vdereg.set_defaults(fn=cmd_volume_deregister)
+    vdet = vol.add_parser("detach")
+    vdet.add_argument("volume_id")
+    vdet.add_argument("-node", default="")
+    vdet.set_defaults(fn=cmd_volume_detach)
+
+    plug = sub.add_parser("plugin").add_subparsers(dest="subcommand",
+                                                   required=True)
+    pst = plug.add_parser("status")
+    pst.add_argument("plugin_id", nargs="?", default="")
+    pst.set_defaults(fn=cmd_plugin_status)
 
     # acl
     acl = sub.add_parser("acl").add_subparsers(dest="subcommand",

@@ -32,12 +32,17 @@ class AllocRunner:
         data_dir: str,
         on_alloc_update: Callable[[Allocation], None],
         state_db=None,
+        csi_manager=None,
     ) -> None:
         self.alloc = alloc
         self.drivers = drivers
         self.data_dir = data_dir
         self.on_alloc_update = on_alloc_update
         self.state_db = state_db
+        self.csi_manager = csi_manager
+        # volume name -> CSIMountInfo (csi_hook.go populates these for
+        # task volume_mounts)
+        self.csi_mounts: Dict[str, object] = {}
         self.alloc_dir = os.path.join(data_dir, "allocs", alloc.id)
         self.task_runners: Dict[str, TaskRunner] = {}
         self._lock = threading.Lock()
@@ -56,6 +61,32 @@ class AllocRunner:
                         self.alloc.id, self.alloc.task_group)
             return
         os.makedirs(self.alloc_dir, exist_ok=True)
+        # CSI prerun hook (allocrunner/csi_hook.go): claim + mount each
+        # requested volume before any task starts; a claim failure fails
+        # the whole alloc
+        if self.csi_manager is not None:
+            for name, req in tg.volumes.items():
+                if req.type != "csi":
+                    continue
+                try:
+                    self.csi_mounts[name] = \
+                        self.csi_manager.mount_volume(self.alloc, req)
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("alloc %s: csi mount %s: %s",
+                                self.alloc.id, name, e)
+                    for task in tg.tasks:
+                        self._on_task_state(
+                            task.name, TaskState(state=STATE_DEAD, failed=True)
+                        )
+                    return
+        # mount paths surface to tasks as env (the reference bind-mounts
+        # them into the task via VolumeMounts; env is this build's
+        # equivalent until drivers gain mount plumbing)
+        volume_env = {
+            f"NOMAD_ALLOC_VOLUME_{name.upper().replace('-', '_')}":
+                m.target_path
+            for name, m in self.csi_mounts.items()
+        }
         for task in tg.tasks:
             driver = self.drivers.get(task.driver)
             if driver is None:
@@ -71,6 +102,7 @@ class AllocRunner:
                 on_state_change=self._on_task_state,
                 state_db=self.state_db,
                 restart_policy=tg.restart_policy,
+                extra_env=volume_env,
             )
             self.task_runners[task.name] = tr
             tr.start()
@@ -229,6 +261,15 @@ class AllocRunner:
                 tr.driver.destroy_task(tr.task_id, force=True)
             except Exception:                   # noqa: BLE001
                 pass
+        # CSI postrun: unpublish this alloc's mounts (csi_hook.go
+        # Postrun); the server-side watcher releases the claim itself
+        if self.csi_manager is not None:
+            for mount in self.csi_mounts.values():
+                try:
+                    self.csi_manager.unmount_volume(self.alloc.id, mount)
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("alloc %s: csi unmount: %s", self.alloc.id, e)
+            self.csi_mounts.clear()
         self._destroyed = True
         if self.state_db is not None:
             self.state_db.delete_allocation(self.alloc.id)

@@ -36,6 +36,7 @@ class ClientRPC(Protocol):
     def update_status(self, node_id: str, status: str) -> Dict: ...
     def get_client_allocs(self, node_id: str, min_index: int, timeout: float) -> Dict: ...
     def update_allocs(self, allocs: List[Allocation]) -> int: ...
+    def csi_claim(self, namespace: str, volume_id: str, claim): ...
 
 
 class InProcessRPC:
@@ -56,6 +57,11 @@ class InProcessRPC:
 
     def update_allocs(self, allocs: List[Allocation]) -> int:
         return self.server.update_allocs_from_client(allocs)
+
+    def csi_claim(self, namespace: str, volume_id: str, claim):
+        """CSIVolume.Claim RPC (allocrunner/csi_hook.go)."""
+        self.server.csi_volume_claim(namespace, volume_id, claim)
+        return self.server.state.csi_volume_by_id(namespace, volume_id)
 
 
 class ClientConfig:
@@ -86,6 +92,7 @@ class Client:
         drivers: Optional[Dict] = None,
         device_plugins: Optional[List] = None,
         node_id: Optional[str] = None,
+        csi_clients: Optional[Dict] = None,
     ) -> None:
         self.rpc = rpc
         self.config = config or ClientConfig()
@@ -94,6 +101,7 @@ class Client:
             drivers = builtin_drivers()
         self.drivers = drivers
         self.device_plugins = device_plugins or []
+        self.csi_clients = csi_clients or {}
 
         os.makedirs(self.config.data_dir, exist_ok=True)
         if self.config.persistent_state:
@@ -115,6 +123,23 @@ class Client:
             device_plugins=self.device_plugins,
             meta=self.config.meta,
         )
+        # advertise CSI node plugins this agent runs (the reference
+        # fingerprints these from plugin allocs via dynamicplugins; the
+        # build registers them at agent config time)
+        for pid, client in self.csi_clients.items():
+            info = {"healthy": True}
+            try:
+                detail = client.plugin_get_info()
+                info["provider"] = detail.get("name", "")
+                info["version"] = detail.get("version", "")
+            except Exception:                   # noqa: BLE001
+                info["healthy"] = False
+            self.node.csi_node_plugins[pid] = info
+        from nomad_tpu.client.csimanager import CSIManager
+
+        self.csi_manager = CSIManager(
+            rpc, self.csi_clients, self.node_id, self.config.data_dir
+        ) if hasattr(rpc, "csi_claim") else None
         self.allocs: Dict[str, AllocRunner] = {}
         self._alloc_lock = threading.Lock()
         self._alloc_indexes: Dict[str, int] = {}    # alloc_id -> modify_index
@@ -239,6 +264,7 @@ class Client:
             data_dir=self.config.data_dir,
             on_alloc_update=self._queue_update,
             state_db=self.state_db,
+            csi_manager=self.csi_manager,
         )
         with self._alloc_lock:
             self.allocs[alloc.id] = runner
@@ -306,6 +332,7 @@ class Client:
                 data_dir=self.config.data_dir,
                 on_alloc_update=self._queue_update,
                 state_db=self.state_db,
+                csi_manager=self.csi_manager,
             )
             with self._alloc_lock:
                 self.allocs[alloc.id] = runner

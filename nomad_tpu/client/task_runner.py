@@ -80,6 +80,7 @@ class TaskRunner:
         on_state_change: Callable[[str, TaskState], None],
         state_db=None,
         restart_policy: Optional[RestartPolicy] = None,
+        extra_env: Optional[Dict[str, str]] = None,
     ) -> None:
         self.alloc = alloc
         self.task = task
@@ -87,6 +88,8 @@ class TaskRunner:
         self.alloc_dir = alloc_dir
         self.on_state_change = on_state_change
         self.state_db = state_db
+        # alloc-level env contributions (e.g. CSI volume mount paths)
+        self.extra_env = extra_env or {}
         self.task_state = TaskState()
         self.handle: Optional[TaskHandle] = None
         policy = restart_policy or RestartPolicy()
@@ -241,6 +244,7 @@ class TaskRunner:
             "NOMAD_TASK_DIR": os.path.join(self.alloc_dir, self.task.name, "local"),
             "NOMAD_SECRETS_DIR": os.path.join(self.alloc_dir, self.task.name, "secrets"),
         }
+        env.update(self.extra_env)
         env.update(self.task.env)
         return TaskConfig(
             id=self.task_id,

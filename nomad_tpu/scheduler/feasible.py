@@ -81,13 +81,31 @@ def host_volumes_ok(node, tg) -> bool:
     return True
 
 
-def csi_ok(node, tg) -> bool:
-    """CSIVolumeChecker (feasible.go:212): node must run the plugin for
-    any CSI volume the group claims."""
+def csi_ok(node, tg, snapshot=None, namespace: str = "default") -> bool:
+    """CSIVolumeChecker (feasible.go:212): the node must run a healthy
+    instance of each claimed volume's plugin, and the volume itself must
+    have claim capacity for the requested mode (csi.go
+    WriteSchedulable/ReadSchedulable)."""
+    from nomad_tpu.structs import csi as csi_structs
+
     for req in tg.volumes.values():
         if req.type != "csi":
             continue
-        if req.source not in node.csi_node_plugins:
+        vol = None
+        if snapshot is not None and hasattr(snapshot, "csi_volume_by_id"):
+            vol = snapshot.csi_volume_by_id(namespace, req.source)
+        if vol is None:
+            # no registered volume: fall back to plugin presence keyed
+            # by source (pre-registration dev mode)
+            if req.source not in node.csi_node_plugins:
+                return False
+            continue
+        info = node.csi_node_plugins.get(vol.plugin_id)
+        if info is None or not info.get("healthy", False):
+            return False
+        mode = csi_structs.CLAIM_READ if req.read_only \
+            else csi_structs.CLAIM_WRITE
+        if not vol.claimable(mode):
             return False
     return True
 
@@ -240,7 +258,9 @@ class FeasibilityBuilder:
                 if has_host_vols and not host_volumes_ok(node, tg):
                     mask[i] = False
                     metrics.filter_node(node, FILTER_CONSTRAINT_HOST_VOLUMES)
-                elif has_csi_vols and not csi_ok(node, tg):
+                elif has_csi_vols and not csi_ok(
+                    node, tg, self.snapshot, job.namespace
+                ):
                     mask[i] = False
                     metrics.filter_node(node, FILTER_CONSTRAINT_CSI_PLUGINS)
 

@@ -23,11 +23,14 @@ CORE_JOB_EVAL_GC = "eval-gc"
 CORE_JOB_JOB_GC = "job-gc"
 CORE_JOB_NODE_GC = "node-gc"
 CORE_JOB_DEPLOYMENT_GC = "deployment-gc"
+CORE_JOB_CSI_VOLUME_CLAIM_GC = "csi-volume-claim-gc"
+CORE_JOB_ONE_TIME_TOKEN_GC = "one-time-token-gc"
 CORE_JOB_FORCE_GC = "force-gc"
 
 ALL_CORE_JOBS = [
     CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,
-    CORE_JOB_DEPLOYMENT_GC,
+    CORE_JOB_DEPLOYMENT_GC, CORE_JOB_CSI_VOLUME_CLAIM_GC,
+    CORE_JOB_ONE_TIME_TOKEN_GC,
 ]
 
 
@@ -69,6 +72,10 @@ class CoreScheduler:
             self.node_gc(force)
         if job in (CORE_JOB_DEPLOYMENT_GC,) or force:
             self.deployment_gc(force)
+        if job in (CORE_JOB_CSI_VOLUME_CLAIM_GC,) or force:
+            self.csi_volume_claim_gc(force)
+        if job in (CORE_JOB_ONE_TIME_TOKEN_GC,) or force:
+            self.one_time_token_gc(force)
         done = evaluation.copy()
         done.status = consts.EVAL_STATUS_COMPLETE
         self.planner.update_eval(done)
@@ -185,6 +192,36 @@ class CoreScheduler:
             )
             LOG.info("deployment GC: %d deployments", len(gc))
         return len(gc)
+
+
+    def csi_volume_claim_gc(self, force: bool = False) -> int:
+        """Claims held by GC'd or terminal allocs get released so the
+        volume watcher unpublishes them (core_sched.go
+        csiVolumeClaimGC). Live claims only -- past claims already in
+        the unpublish pipeline belong to the watcher (re-releasing them
+        from a stale snapshot would rewind their state)."""
+        n = 0
+        for vol in self.snapshot.csi_volumes_iter():
+            for claims in (vol.read_claims, vol.write_claims):
+                for alloc_id, claim in list(claims.items()):
+                    alloc = self.snapshot.alloc_by_id(alloc_id)
+                    if alloc is not None and not (
+                        alloc.terminal_status() or alloc.client_terminal_status()
+                    ):
+                        continue
+                    self.server.raft_apply(fsm_msgs.CSI_VOLUME_CLAIM, {
+                        "namespace": vol.namespace, "volume_id": vol.id,
+                        "claim": claim.release_copy(),
+                    })
+                    n += 1
+        if n:
+            LOG.info("csi volume claim GC: %d claims released", n)
+        return n
+
+    def one_time_token_gc(self, force: bool = False) -> int:
+        """Expired one-time tokens (core_sched.go expiredOneTimeTokenGC)."""
+        expire = getattr(self.server, "expire_one_time_tokens", None)
+        return expire(force) if expire is not None else 0
 
 
 def install(server) -> None:

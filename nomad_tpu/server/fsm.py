@@ -45,6 +45,10 @@ ACL_POLICY_UPSERT = "ACLPolicyUpsertRequestType"
 ACL_POLICY_DELETE = "ACLPolicyDeleteRequestType"
 ACL_TOKEN_UPSERT = "ACLTokenUpsertRequestType"
 ACL_TOKEN_DELETE = "ACLTokenDeleteRequestType"
+CSI_VOLUME_REGISTER = "CSIVolumeRegisterRequestType"
+CSI_VOLUME_DEREGISTER = "CSIVolumeDeregisterRequestType"
+CSI_VOLUME_CLAIM = "CSIVolumeClaimRequestType"
+CSI_VOLUME_CLAIM_BATCH = "CSIVolumeClaimBatchRequestType"
 
 
 class NomadFSM:
@@ -347,6 +351,29 @@ class NomadFSM:
             idx = self.state.delete_acl_token(aid)
         return idx
 
+    def _apply_csi_volume_register(self, req: Dict) -> int:
+        return self.state.upsert_csi_volumes(req["volumes"])
+
+    def _apply_csi_volume_deregister(self, req: Dict) -> int:
+        return self.state.csi_volume_deregister(
+            req["namespace"], req["volume_id"], req.get("force", False)
+        )
+
+    def _apply_csi_volume_claim(self, req: Dict) -> int:
+        return self.state.csi_volume_claim(
+            req["namespace"], req["volume_id"], req["claim"]
+        )
+
+    def _apply_csi_volume_claim_batch(self, req: Dict) -> int:
+        """volumewatcher batched claim updates (fsm.go
+        applyCSIVolumeBatchClaim)."""
+        idx = 0
+        for c in req["claims"]:
+            idx = self.state.csi_volume_claim(
+                c["namespace"], c["volume_id"], c["claim"]
+            )
+        return idx
+
     _DISPATCH = {
         NODE_REGISTER: _apply_node_register,
         NODE_DEREGISTER: _apply_node_deregister,
@@ -375,4 +402,8 @@ class NomadFSM:
         ACL_POLICY_DELETE: _apply_acl_policy_delete,
         ACL_TOKEN_UPSERT: _apply_acl_token_upsert,
         ACL_TOKEN_DELETE: _apply_acl_token_delete,
+        CSI_VOLUME_REGISTER: _apply_csi_volume_register,
+        CSI_VOLUME_DEREGISTER: _apply_csi_volume_deregister,
+        CSI_VOLUME_CLAIM: _apply_csi_volume_claim,
+        CSI_VOLUME_CLAIM_BATCH: _apply_csi_volume_claim_batch,
     }

@@ -107,9 +107,15 @@ class Server:
         from nomad_tpu.server import core_sched
         from nomad_tpu.utils.timetable import TimeTable
 
+        from nomad_tpu.server.volume_watcher import VolumesWatcher
+
         self.periodic_dispatcher = PeriodicDispatcher(self)
         self.deployments_watcher = DeploymentsWatcher(self)
         self.node_drainer = NodeDrainer(self)
+        self.volumes_watcher = VolumesWatcher(self)
+        # CSI plugin clients keyed by plugin id; dev/test deployments
+        # register FakeCSIClient instances (plugins/csi fake)
+        self.csi_clients: Dict[str, object] = {}
         self.time_table = TimeTable()
         self.fsm.periodic_dispatcher = self.periodic_dispatcher
         core_sched.install(self)
@@ -189,6 +195,7 @@ class Server:
             self.periodic_dispatcher.restore(self.state.snapshot())
             self.deployments_watcher.set_enabled(True)
             self.node_drainer.set_enabled(True)
+            self.volumes_watcher.set_enabled(True)
             for name, fn, interval in (
                 ("reap-failed-evals", self.reap_failed_evals_once, 0.2),
                 ("reap-dup-blocked", self.reap_dup_blocked_once, 0.2),
@@ -219,6 +226,7 @@ class Server:
             self.periodic_dispatcher.set_enabled(False)
             self.deployments_watcher.set_enabled(False)
             self.node_drainer.set_enabled(False)
+            self.volumes_watcher.set_enabled(False)
             for w in self.workers:
                 w.set_pause(True)
             self._leader_threads.clear()
@@ -520,6 +528,108 @@ class Server:
         # synchronous mode (tests without the applier thread)
         return self.planner.apply_one(plan)
 
+    # --- CSI (nomad/csi_endpoint.go + plugins/csi) ----------------------
+
+    def csi_volume_register(self, volumes: List) -> int:
+        """CSIVolume.Register: validate capabilities against the
+        controller plugin (csi_endpoint.go Register) then commit."""
+        for v in volumes:
+            v.validate()
+            client = self.csi_clients.get(v.plugin_id)
+            if client is not None and v.external_id:
+                client.controller_validate_capabilities(
+                    v.external_id,
+                    [c.__dict__ for c in v.requested_capabilities],
+                )
+        return self.raft_apply(fsm_msgs.CSI_VOLUME_REGISTER,
+                               {"volumes": volumes})
+
+    def csi_volume_deregister(self, namespace: str, volume_id: str,
+                              force: bool = False) -> int:
+        return self.raft_apply(fsm_msgs.CSI_VOLUME_DEREGISTER, {
+            "namespace": namespace, "volume_id": volume_id, "force": force,
+        })
+
+    def csi_volume_claim(self, namespace: str, volume_id: str, claim) -> int:
+        """CSIVolume.Claim: controller-publish (if required) then record
+        the claim (csi_endpoint.go Claim -> controllerPublishVolume)."""
+        from nomad_tpu.structs import csi as csi_structs
+
+        vol = self.state.csi_volume_by_id(namespace, volume_id)
+        if vol is None:
+            raise ValueError(f"volume not found: {volume_id}")
+        if claim.mode != csi_structs.CLAIM_RELEASE \
+                and not vol.claimable(claim.mode):
+            raise ValueError(
+                f"volume {volume_id} unschedulable or max claims reached"
+            )
+        client = self.csi_clients.get(vol.plugin_id)
+        plugin = self.csi_plugin_by_id(vol.plugin_id)
+        if (claim.mode != csi_structs.CLAIM_RELEASE and client is not None
+                and plugin is not None and plugin.controller_required):
+            client.controller_publish_volume(
+                vol.external_id, claim.external_node_id or claim.node_id,
+                claim.mode == csi_structs.CLAIM_READ,
+                {"access_mode": claim.access_mode,
+                 "attachment_mode": claim.attachment_mode},
+            )
+        return self.raft_apply(fsm_msgs.CSI_VOLUME_CLAIM, {
+            "namespace": namespace, "volume_id": volume_id, "claim": claim,
+        })
+
+    def csi_volume_create(self, volumes: List) -> List:
+        """CSIVolume.Create: ask the controller plugin to provision the
+        external volume, then register (csi_endpoint.go Create)."""
+        created = []
+        for v in volumes:
+            v.validate()
+            client = self.csi_clients.get(v.plugin_id)
+            if client is not None:
+                resp = client.controller_create_volume(
+                    v.name or v.id, v.capacity_min, v.capacity_max,
+                    [c.__dict__ for c in v.requested_capabilities],
+                    v.parameters,
+                )
+                v.external_id = resp.get("external_id", v.external_id)
+            created.append(v)
+        self.raft_apply(fsm_msgs.CSI_VOLUME_REGISTER, {"volumes": created})
+        return created
+
+    def csi_volume_delete(self, namespace: str, volume_id: str) -> int:
+        """CSIVolume.Delete: delete the external volume then deregister."""
+        vol = self.state.csi_volume_by_id(namespace, volume_id)
+        if vol is None:
+            raise ValueError(f"volume not found: {volume_id}")
+        client = self.csi_clients.get(vol.plugin_id)
+        if client is not None and vol.external_id:
+            client.controller_delete_volume(vol.external_id)
+        return self.csi_volume_deregister(namespace, volume_id)
+
+    def csi_plugin_by_id(self, plugin_id: str):
+        from nomad_tpu.structs.csi import plugins_from_nodes
+
+        return plugins_from_nodes(self.state.snapshot().nodes()).get(plugin_id)
+
+    def csi_plugins(self) -> Dict:
+        from nomad_tpu.structs.csi import plugins_from_nodes
+
+        return plugins_from_nodes(self.state.snapshot().nodes())
+
+    def csi_node_unpublish(self, vol, claim) -> None:
+        """volumewatcher step 1: unpublish on the claiming node (the
+        reference RPCs the client, which calls the node plugin). The
+        claim carries the paths the node actually published at."""
+        client = self.csi_clients.get(vol.plugin_id)
+        if client is not None and claim.target_path:
+            client.node_unpublish_volume(vol.external_id, claim.target_path)
+
+    def csi_controller_unpublish(self, vol, claim) -> None:
+        client = self.csi_clients.get(vol.plugin_id)
+        if client is not None:
+            client.controller_unpublish_volume(
+                vol.external_id, claim.external_node_id or claim.node_id
+            )
+
     # --- core scheduler hook (GC; nomad/core_sched.go) ------------------
 
     def new_core_scheduler(self, snapshot, planner):
@@ -570,6 +680,8 @@ class Server:
         sched.job_gc(force=True)
         sched.node_gc(force=True)
         sched.deployment_gc(force=True)
+        sched.csi_volume_claim_gc(force=True)
+        sched.one_time_token_gc(force=True)
 
     def reap_dup_blocked_once(self) -> int:
         """Cancel duplicate blocked evals (leader.go

@@ -68,6 +68,7 @@ class StateSnapshot:
             self._allocs_by_job = {k: set(v) for k, v in store._allocs_by_job.items()}
             self._allocs_by_node = {k: set(v) for k, v in store._allocs_by_node.items()}
             self._allocs_by_eval = {k: set(v) for k, v in store._allocs_by_eval.items()}
+            self._csi_volumes = dict(store._csi_volumes)
             self.scheduler_config = store.scheduler_config
 
     # --- State interface (scheduler.go:67-141) ---
@@ -143,6 +144,12 @@ class StateSnapshot:
     def deployments_iter(self):
         return self._deployments.values()
 
+    def csi_volume_by_id(self, namespace: str, volume_id: str):
+        return self._csi_volumes.get((namespace, volume_id))
+
+    def csi_volumes_iter(self):
+        return self._csi_volumes.values()
+
     def latest_index(self) -> int:
         return self.index
 
@@ -168,6 +175,9 @@ class StateStore:
         self._scaling_events: Dict[Tuple[str, str], List] = {}
         self._acl_policies: Dict[str, object] = {}
         self._acl_tokens: Dict[str, object] = {}
+        # CSI volumes keyed (namespace, id) (schema.go csi_volumes;
+        # plugins are derived from node fingerprints on read)
+        self._csi_volumes: Dict[Tuple[str, str], object] = {}
         self.scheduler_config = SchedulerConfiguration()
         # table name -> [callback(index)]; fired outside the lock
         self._watchers: Dict[str, List[Callable[[int], None]]] = {}
@@ -367,6 +377,68 @@ class StateStore:
                     return t
             return None
 
+    # --- CSI volumes (state_store.go UpsertCSIVolume/CSIVolumeClaim) ----
+
+    def upsert_csi_volumes(self, volumes: List) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for v in volumes:
+                existing = self._csi_volumes.get((v.namespace, v.id))
+                if existing is not None:
+                    # re-register keeps live claims (csi_endpoint.go
+                    # Register merge semantics)
+                    v.read_claims = existing.read_claims
+                    v.write_claims = existing.write_claims
+                    v.past_claims = existing.past_claims
+                    v.create_index = existing.create_index
+                else:
+                    v.create_index = idx
+                v.modify_index = idx
+                self._csi_volumes[(v.namespace, v.id)] = v
+        self._notify(["csi_volumes"], idx)
+        return idx
+
+    def csi_volume_deregister(self, namespace: str, volume_id: str,
+                              force: bool = False) -> int:
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, volume_id))
+            if vol is None:
+                raise ValueError(f"volume not found: {volume_id}")
+            if vol.in_use() and not force:
+                raise ValueError(f"volume in use: {volume_id}")
+            idx = self._next_index()
+            del self._csi_volumes[(namespace, volume_id)]
+        self._notify(["csi_volumes"], idx)
+        return idx
+
+    def csi_volume_claim(self, namespace: str, volume_id: str, claim) -> int:
+        """Apply a claim transition copy-on-write (state_store.go
+        CSIVolumeClaim)."""
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, volume_id))
+            if vol is None:
+                raise ValueError(f"volume not found: {volume_id}")
+            vol = vol.copy()
+            vol.claim(claim)
+            idx = self._next_index()
+            vol.modify_index = idx
+            self._csi_volumes[(namespace, volume_id)] = vol
+        self._notify(["csi_volumes"], idx)
+        return idx
+
+    def csi_volumes(self) -> List:
+        with self._lock:
+            return list(self._csi_volumes.values())
+
+    def csi_volume_by_id(self, namespace: str, volume_id: str):
+        with self._lock:
+            return self._csi_volumes.get((namespace, volume_id))
+
+    def csi_volumes_by_plugin(self, plugin_id: str) -> List:
+        with self._lock:
+            return [v for v in self._csi_volumes.values()
+                    if v.plugin_id == plugin_id]
+
     def to_snapshot_bytes(self) -> bytes:
         """Serialize every table for raft snapshots / operator backup."""
         with self._lock:
@@ -386,6 +458,7 @@ class StateStore:
                 "scaling_events": {k: list(v) for k, v in self._scaling_events.items()},
                 "acl_policies": dict(self._acl_policies),
                 "acl_tokens": dict(self._acl_tokens),
+                "csi_volumes": dict(self._csi_volumes),
             }
             return pickle.dumps(payload)
 
@@ -407,8 +480,10 @@ class StateStore:
             self._scaling_events = payload.get("scaling_events", {})
             self._acl_policies = payload.get("acl_policies", {})
             self._acl_tokens = payload.get("acl_tokens", {})
+            self._csi_volumes = payload.get("csi_volumes", {})
         self._notify(
-            ["nodes", "jobs", "evals", "allocs", "deployment", "scheduler_config"],
+            ["nodes", "jobs", "evals", "allocs", "deployment",
+             "scheduler_config", "csi_volumes"],
             payload["index"],
         )
 

@@ -53,6 +53,13 @@ from nomad_tpu.structs.job import (  # noqa: F401
     TaskGroup,
     TaskLifecycleConfig,
     UpdateStrategy,
+    VolumeRequest,
+)
+from nomad_tpu.structs.csi import (  # noqa: F401
+    CSIPlugin,
+    CSIVolume,
+    CSIVolumeCapability,
+    CSIVolumeClaim,
 )
 from nomad_tpu.structs.node import DriverInfo, Node  # noqa: F401
 from nomad_tpu.structs.alloc import (  # noqa: F401
