@@ -72,6 +72,7 @@ class APIClient:
         self.scaling = Scaling(self)
         self.csi_volumes = CSIVolumes(self)
         self.csi_plugins = CSIPlugins(self)
+        self.services = Services(self)
 
     # -- transport -------------------------------------------------------
 
@@ -411,6 +412,23 @@ class CSIPlugins(_Endpoint):
 
     def info(self, plugin_id: str, q: Optional[QueryOptions] = None) -> Dict:
         return self.c.get(f"/v1/plugin/csi/{_esc(plugin_id)}", q)
+
+
+class Services(_Endpoint):
+    """api/services.go: native service discovery."""
+
+    def list(self, q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get("/v1/services", q)
+
+    def get(self, service_name: str,
+            q: Optional[QueryOptions] = None) -> List[Dict]:
+        return self.c.get(f"/v1/service/{_esc(service_name)}", q)
+
+    def delete(self, service_name: str, service_id: str,
+               q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.delete(
+            f"/v1/service/{_esc(service_name)}/{_esc(service_id)}", q
+        )
 
 
 class ACLAPI(_Endpoint):

@@ -338,6 +338,12 @@ class HTTPAgent:
         add("GET", r"/v1/plugins", self.plugins_list)
         add("GET", r"/v1/plugin/csi/(?P<id>[^/]+)", self.plugin_get)
 
+        # native service discovery (http.go ServiceRegistrations)
+        add("GET", r"/v1/services", self.services_list)
+        add("GET", r"/v1/service/(?P<name>[^/]+)", self.service_get)
+        add("DELETE", r"/v1/service/(?P<name>[^/]+)/(?P<id>[^/]+)",
+            self.service_delete)
+
         # event stream
         add("GET", r"/v1/event/stream", self.event_stream)
 
@@ -1047,6 +1053,51 @@ class HTTPAgent:
         out["Controllers"] = p.controllers
         out["Nodes"] = p.nodes
         return out
+
+    # -- native service discovery (service_registration_endpoint.go) -----
+
+    def services_list(self, req: Request):
+        """Grouped stubs: [{Namespace, Services: [{ServiceName, Tags}]}]
+        (service_registration_endpoint.go List)."""
+        self._acl(req, "allow_ns_op", req.namespace, "read-job")
+        self._block(req, ["services"])
+        regs = self._server.state.service_registrations(req.namespace)
+        by_ns: Dict[str, Dict[str, set]] = {}
+        for r in regs:
+            tags = by_ns.setdefault(r.namespace, {}).setdefault(
+                r.service_name, set()
+            )
+            tags.update(r.tags)
+        return [
+            {
+                "Namespace": ns,
+                "Services": [
+                    {"ServiceName": name, "Tags": sorted(tags)}
+                    for name, tags in sorted(services.items())
+                ],
+            }
+            for ns, services in sorted(by_ns.items())
+        ]
+
+    def service_get(self, req: Request):
+        self._acl(req, "allow_ns_op", req.namespace, "read-job")
+        self._block(req, ["services"])
+        regs = self._server.state.service_registrations_by_name(
+            req.namespace, req.params["name"]
+        )
+        return [r.stub() for r in sorted(regs, key=lambda r: r.id)]
+
+    def service_delete(self, req: Request):
+        reg = self._server.state.service_registration_by_id(req.params["id"])
+        if reg is None or reg.service_name != req.params["name"] \
+                or reg.namespace != req.namespace:
+            raise HTTPError(404, "service registration not found")
+        self._acl(req, "allow_ns_op", reg.namespace, "submit-job")
+        try:
+            index = self._server.service_deregister(reg.id)
+        except ValueError as e:
+            raise HTTPError(404, str(e))
+        return {"Index": index}
 
     # -- event stream (stream/ndjson.go) ---------------------------------
 

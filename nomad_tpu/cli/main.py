@@ -552,6 +552,34 @@ def cmd_namespace_delete(args) -> int:
     return 0
 
 
+def cmd_service_list(args) -> int:
+    api = make_client(args)
+    rows = []
+    for ns_block in api.services.list():
+        for svc in ns_block.get("Services", []):
+            rows.append({
+                "ServiceName": svc.get("ServiceName", ""),
+                "Namespace": ns_block.get("Namespace", ""),
+                "Tags": ",".join(svc.get("Tags", [])),
+            })
+    print(dict_rows(rows, ["ServiceName", "Namespace", "Tags"]))
+    return 0
+
+
+def cmd_service_info(args) -> int:
+    api = make_client(args)
+    regs = api.services.get(args.service_name)
+    print(dict_rows(regs, ["ID", "Address", "Port", "NodeID", "AllocID"]))
+    return 0
+
+
+def cmd_service_delete(args) -> int:
+    api = make_client(args)
+    api.services.delete(args.service_name, args.service_id)
+    print(f"Successfully deleted service registration \"{args.service_id}\"")
+    return 0
+
+
 def cmd_volume_register(args) -> int:
     import json as _json
 
@@ -976,6 +1004,19 @@ def build_parser() -> argparse.ArgumentParser:
     ndel = nsp.add_parser("delete")
     ndel.add_argument("name")
     ndel.set_defaults(fn=cmd_namespace_delete)
+
+    # service (native discovery)
+    svc = sub.add_parser("service").add_subparsers(dest="subcommand",
+                                                   required=True)
+    svl = svc.add_parser("list")
+    svl.set_defaults(fn=cmd_service_list)
+    svi = svc.add_parser("info")
+    svi.add_argument("service_name")
+    svi.set_defaults(fn=cmd_service_info)
+    svd = svc.add_parser("delete")
+    svd.add_argument("service_name")
+    svd.add_argument("service_id")
+    svd.set_defaults(fn=cmd_service_delete)
 
     # volume + plugin (CSI)
     vol = sub.add_parser("volume").add_subparsers(dest="subcommand",

@@ -33,6 +33,7 @@ class AllocRunner:
         on_alloc_update: Callable[[Allocation], None],
         state_db=None,
         csi_manager=None,
+        service_reg=None,
     ) -> None:
         self.alloc = alloc
         self.drivers = drivers
@@ -40,6 +41,9 @@ class AllocRunner:
         self.on_alloc_update = on_alloc_update
         self.state_db = state_db
         self.csi_manager = csi_manager
+        self.service_reg = service_reg
+        # tasks whose services are currently registered
+        self._registered_tasks: set = set()
         # volume name -> CSIMountInfo (csi_hook.go populates these for
         # task volume_mounts)
         self.csi_mounts: Dict[str, object] = {}
@@ -136,8 +140,20 @@ class AllocRunner:
                 )
             recovered = tr.restore(local_state, handle)
             self.task_runners[task.name] = tr
-            if not recovered and (local_state is None
-                                  or local_state.state != STATE_DEAD):
+            if recovered:
+                # reattached to a live task: re-assert its service
+                # registrations (deterministic ids make this an
+                # idempotent upsert) so the dead-task path knows to
+                # deregister later
+                if self.service_reg is not None:
+                    with self._lock:
+                        first = not self._registered_tasks
+                        self._registered_tasks.add(task.name)
+                    if first:
+                        self.service_reg.register(self.alloc, tg.services)
+                    self.service_reg.register(self.alloc, task.services,
+                                              task.name)
+            elif local_state is None or local_state.state != STATE_DEAD:
                 # task wasn't running anymore: start fresh
                 tr.start()
         self._watch_done()
@@ -217,11 +233,56 @@ class AllocRunner:
         with self._lock:
             self.task_states[task_name] = state
             status, desc = self._client_status_locked()
+        self._sync_services(task_name, state)
         updated = self.alloc.copy_skip_job()
         updated.client_status = status
         updated.client_description = desc
         updated.task_states = dict(self.task_states)
         self.on_alloc_update(updated)
+
+    def _sync_services(self, task_name: str, state: TaskState) -> None:
+        """Register a task's (and the group's) services when it starts
+        running; pull everything when the alloc goes terminal
+        (client/serviceregistration workload lifecycle)."""
+        if self.service_reg is None:
+            return
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job is not None else None
+        if tg is None:
+            return
+        if not tg.services and not any(t.services for t in tg.tasks):
+            return
+        if state.state == STATE_RUNNING:
+            with self._lock:
+                first = not self._registered_tasks
+                fresh = task_name not in self._registered_tasks
+                self._registered_tasks.add(task_name)
+            if first:
+                self.service_reg.register(self.alloc, tg.services)
+            if fresh:
+                task = tg.lookup_task(task_name)
+                if task is not None:
+                    self.service_reg.register(self.alloc, task.services,
+                                              task_name)
+        elif state.state == STATE_DEAD:
+            with self._lock:
+                terminal = all(s.state == STATE_DEAD
+                               for s in self.task_states.values())
+                was_registered = task_name in self._registered_tasks
+                self._registered_tasks.discard(task_name)
+            if terminal:
+                # covers group services and any strays (also correct
+                # after agent restart, where _registered_tasks was
+                # rebuilt only from recovered tasks)
+                self.service_reg.deregister_alloc(self.alloc.id)
+            elif was_registered:
+                # a dead task among live siblings pulls only its own
+                # instances
+                task = tg.lookup_task(task_name)
+                if task is not None:
+                    self.service_reg.deregister_task(
+                        self.alloc, task.services, task_name
+                    )
 
     def _client_status_locked(self) -> (str, str):
         states = list(self.task_states.values())

@@ -63,6 +63,23 @@ class InProcessRPC:
         self.server.csi_volume_claim(namespace, volume_id, claim)
         return self.server.state.csi_volume_by_id(namespace, volume_id)
 
+    def register_services(self, regs) -> int:
+        """ServiceRegistration.Upsert RPC (client serviceregistration
+        wrapper -> NomadServiceProvider)."""
+        return self.server.service_register(regs)
+
+    def deregister_services_by_alloc(self, alloc_ids) -> int:
+        return self.server.service_deregister_by_alloc(alloc_ids)
+
+    def deregister_services(self, reg_ids) -> int:
+        index = 0
+        for rid in reg_ids:
+            try:
+                index = self.server.service_deregister(rid)
+            except ValueError:
+                pass   # already gone (idempotent dereg)
+        return index
+
 
 class ClientConfig:
     def __init__(
@@ -140,6 +157,10 @@ class Client:
         self.csi_manager = CSIManager(
             rpc, self.csi_clients, self.node_id, self.config.data_dir
         ) if hasattr(rpc, "csi_claim") else None
+        from nomad_tpu.client.servicereg import ServiceRegWrapper
+
+        self.service_reg = ServiceRegWrapper(rpc, self.node) \
+            if hasattr(rpc, "register_services") else None
         self.allocs: Dict[str, AllocRunner] = {}
         self._alloc_lock = threading.Lock()
         self._alloc_indexes: Dict[str, int] = {}    # alloc_id -> modify_index
@@ -265,6 +286,7 @@ class Client:
             on_alloc_update=self._queue_update,
             state_db=self.state_db,
             csi_manager=self.csi_manager,
+            service_reg=self.service_reg,
         )
         with self._alloc_lock:
             self.allocs[alloc.id] = runner
@@ -333,6 +355,7 @@ class Client:
                 on_alloc_update=self._queue_update,
                 state_db=self.state_db,
                 csi_manager=self.csi_manager,
+                service_reg=self.service_reg,
             )
             with self._alloc_lock:
                 self.allocs[alloc.id] = runner

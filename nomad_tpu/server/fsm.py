@@ -49,6 +49,10 @@ CSI_VOLUME_REGISTER = "CSIVolumeRegisterRequestType"
 CSI_VOLUME_DEREGISTER = "CSIVolumeDeregisterRequestType"
 CSI_VOLUME_CLAIM = "CSIVolumeClaimRequestType"
 CSI_VOLUME_CLAIM_BATCH = "CSIVolumeClaimBatchRequestType"
+SERVICE_REG_UPSERT = "ServiceRegistrationUpsertRequestType"
+SERVICE_REG_DELETE_BY_ID = "ServiceRegistrationDeleteByIDRequestType"
+SERVICE_REG_DELETE_BY_ALLOC = "ServiceRegistrationDeleteByAllocRequestType"
+SERVICE_REG_DELETE_BY_NODE = "ServiceRegistrationDeleteByNodeIDRequestType"
 
 
 class NomadFSM:
@@ -374,6 +378,20 @@ class NomadFSM:
             )
         return idx
 
+    def _apply_service_reg_upsert(self, req: Dict) -> int:
+        return self.state.upsert_service_registrations(req["services"])
+
+    def _apply_service_reg_delete_by_id(self, req: Dict) -> int:
+        return self.state.delete_service_registration(req["id"])
+
+    def _apply_service_reg_delete_by_alloc(self, req: Dict) -> int:
+        return self.state.delete_service_registrations_by_alloc(
+            req["alloc_ids"]
+        )
+
+    def _apply_service_reg_delete_by_node(self, req: Dict) -> int:
+        return self.state.delete_service_registrations_by_node(req["node_id"])
+
     _DISPATCH = {
         NODE_REGISTER: _apply_node_register,
         NODE_DEREGISTER: _apply_node_deregister,
@@ -406,4 +424,8 @@ class NomadFSM:
         CSI_VOLUME_DEREGISTER: _apply_csi_volume_deregister,
         CSI_VOLUME_CLAIM: _apply_csi_volume_claim,
         CSI_VOLUME_CLAIM_BATCH: _apply_csi_volume_claim_batch,
+        SERVICE_REG_UPSERT: _apply_service_reg_upsert,
+        SERVICE_REG_DELETE_BY_ID: _apply_service_reg_delete_by_id,
+        SERVICE_REG_DELETE_BY_ALLOC: _apply_service_reg_delete_by_alloc,
+        SERVICE_REG_DELETE_BY_NODE: _apply_service_reg_delete_by_node,
     }

@@ -364,6 +364,11 @@ class Server:
             self._create_node_evals(node_id, index)
             if status == consts.NODE_STATUS_READY:
                 self.blocked_evals.unblock(node.computed_class, index)
+            elif status == consts.NODE_STATUS_DOWN:
+                # a down node's service instances are unreachable
+                # (node_endpoint.go UpdateStatus -> service reg reaping)
+                self.raft_apply(fsm_msgs.SERVICE_REG_DELETE_BY_NODE,
+                                {"node_id": node_id})
         ttl = 0.0
         if status != consts.NODE_STATUS_DOWN:
             ttl = self.heartbeats.reset(node_id)
@@ -405,6 +410,8 @@ class Server:
                 {"node_id": node_id, "status": consts.NODE_STATUS_DOWN},
             )
             self._create_node_evals(node_id, index)
+            self.raft_apply(fsm_msgs.SERVICE_REG_DELETE_BY_NODE,
+                            {"node_id": node_id})
         except Exception as e:                  # noqa: BLE001
             LOG.warning("failed to invalidate heartbeat for %s: %s", node_id, e)
 
@@ -527,6 +534,24 @@ class Server:
             return pending.wait(timeout=30.0)
         # synchronous mode (tests without the applier thread)
         return self.planner.apply_one(plan)
+
+    # --- service registrations (service_registration_endpoint.go) ------
+
+    def service_register(self, regs: List) -> int:
+        """ServiceRegistration.Upsert: clients report their running
+        service instances."""
+        for r in regs:
+            r.validate()
+        return self.raft_apply(fsm_msgs.SERVICE_REG_UPSERT,
+                               {"services": regs})
+
+    def service_deregister(self, reg_id: str) -> int:
+        return self.raft_apply(fsm_msgs.SERVICE_REG_DELETE_BY_ID,
+                               {"id": reg_id})
+
+    def service_deregister_by_alloc(self, alloc_ids: List[str]) -> int:
+        return self.raft_apply(fsm_msgs.SERVICE_REG_DELETE_BY_ALLOC,
+                               {"alloc_ids": alloc_ids})
 
     # --- CSI (nomad/csi_endpoint.go + plugins/csi) ----------------------
 

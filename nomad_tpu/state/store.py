@@ -178,6 +178,9 @@ class StateStore:
         # CSI volumes keyed (namespace, id) (schema.go csi_volumes;
         # plugins are derived from node fingerprints on read)
         self._csi_volumes: Dict[Tuple[str, str], object] = {}
+        # native service registrations keyed by instance id
+        # (schema.go service_registrations)
+        self._services: Dict[str, object] = {}
         self.scheduler_config = SchedulerConfiguration()
         # table name -> [callback(index)]; fired outside the lock
         self._watchers: Dict[str, List[Callable[[int], None]]] = {}
@@ -439,6 +442,70 @@ class StateStore:
             return [v for v in self._csi_volumes.values()
                     if v.plugin_id == plugin_id]
 
+    # --- service registrations (state_store_service_registration.go) ----
+
+    def upsert_service_registrations(self, regs: List) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for r in regs:
+                existing = self._services.get(r.id)
+                r.create_index = existing.create_index if existing else idx
+                r.modify_index = idx
+                self._services[r.id] = r
+        self._notify(["services"], idx)
+        return idx
+
+    def delete_service_registration(self, reg_id: str) -> int:
+        with self._lock:
+            if reg_id not in self._services:
+                raise ValueError(f"service registration not found: {reg_id}")
+            idx = self._next_index()
+            del self._services[reg_id]
+        self._notify(["services"], idx)
+        return idx
+
+    def delete_service_registrations_by_alloc(self, alloc_ids: List[str]) -> int:
+        """Client dereg batches + alloc GC
+        (DeleteServiceRegistrationByAllocID)."""
+        doomed_allocs = set(alloc_ids)
+        with self._lock:
+            doomed = [r.id for r in self._services.values()
+                      if r.alloc_id in doomed_allocs]
+            if not doomed:
+                return self._index
+            idx = self._next_index()
+            for rid in doomed:
+                del self._services[rid]
+        self._notify(["services"], idx)
+        return idx
+
+    def delete_service_registrations_by_node(self, node_id: str) -> int:
+        """Node down/deregister reaping (DeleteServiceRegistrationByNodeID)."""
+        with self._lock:
+            doomed = [r.id for r in self._services.values()
+                      if r.node_id == node_id]
+            if not doomed:
+                return self._index
+            idx = self._next_index()
+            for rid in doomed:
+                del self._services[rid]
+        self._notify(["services"], idx)
+        return idx
+
+    def service_registrations(self, namespace: str = "*") -> List:
+        with self._lock:
+            return [r for r in self._services.values()
+                    if namespace in ("*", r.namespace)]
+
+    def service_registrations_by_name(self, namespace: str, name: str) -> List:
+        with self._lock:
+            return [r for r in self._services.values()
+                    if r.namespace == namespace and r.service_name == name]
+
+    def service_registration_by_id(self, reg_id: str):
+        with self._lock:
+            return self._services.get(reg_id)
+
     def to_snapshot_bytes(self) -> bytes:
         """Serialize every table for raft snapshots / operator backup."""
         with self._lock:
@@ -459,6 +526,7 @@ class StateStore:
                 "acl_policies": dict(self._acl_policies),
                 "acl_tokens": dict(self._acl_tokens),
                 "csi_volumes": dict(self._csi_volumes),
+                "services": dict(self._services),
             }
             return pickle.dumps(payload)
 
@@ -481,9 +549,10 @@ class StateStore:
             self._acl_policies = payload.get("acl_policies", {})
             self._acl_tokens = payload.get("acl_tokens", {})
             self._csi_volumes = payload.get("csi_volumes", {})
+            self._services = payload.get("services", {})
         self._notify(
             ["nodes", "jobs", "evals", "allocs", "deployment",
-             "scheduler_config", "csi_volumes"],
+             "scheduler_config", "csi_volumes", "services"],
             payload["index"],
         )
 
@@ -752,9 +821,11 @@ class StateStore:
         return idx
 
     def delete_allocs(self, alloc_ids: List[str]) -> int:
-        """GC path (state_store.go DeleteEval also reaps allocs)."""
+        """GC path (state_store.go DeleteEval also reaps allocs; service
+        registrations of reaped allocs go with them)."""
         with self._lock:
             idx = self._next_index()
+            doomed = set(alloc_ids)
             for aid in alloc_ids:
                 a = self._allocs.pop(aid, None)
                 if a is None:
@@ -762,7 +833,11 @@ class StateStore:
                 self._allocs_by_job.get((a.namespace, a.job_id), set()).discard(aid)
                 self._allocs_by_node.get(a.node_id, set()).discard(aid)
                 self._allocs_by_eval.get(a.eval_id, set()).discard(aid)
-        self._notify(["allocs"], idx)
+            stale_regs = [r.id for r in self._services.values()
+                          if r.alloc_id in doomed]
+            for rid in stale_regs:
+                del self._services[rid]
+        self._notify(["allocs", "services"] if stale_regs else ["allocs"], idx)
         return idx
 
     def delete_deployments(self, deployment_ids: List[str]) -> int:

@@ -418,6 +418,7 @@ class TestEndToEnd:
                     attachment_mode=csi.ATTACHMENT_MODE_FS,
                 ),
             }
+            job.task_groups[0].tasks[0].driver = "mock_driver"
             job.task_groups[0].tasks[0].config = {"run_for": 30}
             server.job_register(job)
 
