@@ -299,6 +299,15 @@ class Operator(_Endpoint):
     def set_scheduler_config(self, config: Dict) -> Dict:
         return self.c.put("/v1/operator/scheduler/configuration", config)
 
+    def autopilot_configuration(self) -> Dict:
+        return self.c.get("/v1/operator/autopilot/configuration")
+
+    def set_autopilot_configuration(self, config: Dict) -> Dict:
+        return self.c.put("/v1/operator/autopilot/configuration", config)
+
+    def autopilot_health(self) -> Dict:
+        return self.c.get("/v1/operator/autopilot/health")
+
     def raft_configuration(self) -> Dict:
         return self.c.get("/v1/operator/raft/configuration")
 
@@ -461,6 +470,13 @@ class ACLAPI(_Endpoint):
 
     def self_token(self) -> Dict:
         return self.c.get("/v1/acl/token/self")
+
+    def create_one_time_token(self) -> Dict:
+        return self.c.post("/v1/acl/token/onetime")
+
+    def exchange_one_time_token(self, secret: str) -> Dict:
+        return self.c.post("/v1/acl/token/onetime/exchange",
+                           {"OneTimeSecretID": secret})
 
     def delete_token(self, accessor_id: str) -> Dict:
         return self.c.delete(f"/v1/acl/token/{_esc(accessor_id)}")

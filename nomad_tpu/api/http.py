@@ -65,11 +65,24 @@ class Request:
         wait = self.q("wait", "")
         timeout = 300.0
         if wait:
-            m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", wait)
-            if m:
-                mult = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2) or "s"]
-                timeout = float(m.group(1)) * mult
+            parsed = parse_duration(wait)
+            if parsed is not None:
+                timeout = parsed
         return index, min(timeout, 600.0)
+
+
+def parse_duration(v) -> Optional[float]:
+    """Go-style duration -> seconds ('500ms', '10s', '1m', '2h', bare
+    numbers are seconds); None if unparseable."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    if not isinstance(v, str):
+        return None
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", v)
+    if m is None:
+        return None
+    mult = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2) or "s"]
+    return float(m.group(1)) * mult
 
 
 class HTTPAgent:
@@ -293,6 +306,13 @@ class HTTPAgent:
         add("PUT", r"/v1/operator/scheduler/configuration", self.sched_config_put)
         add("POST", r"/v1/operator/scheduler/configuration", self.sched_config_put)
         add("GET", r"/v1/operator/raft/configuration", self.raft_config)
+        add("GET", r"/v1/operator/autopilot/configuration",
+            self.autopilot_config_get)
+        add("PUT", r"/v1/operator/autopilot/configuration",
+            self.autopilot_config_put)
+        add("POST", r"/v1/operator/autopilot/configuration",
+            self.autopilot_config_put)
+        add("GET", r"/v1/operator/autopilot/health", self.autopilot_health)
         add("GET", r"/v1/operator/snapshot", self.snapshot_save)
         add("PUT", r"/v1/operator/snapshot", self.snapshot_restore)
         add("POST", r"/v1/operator/snapshot", self.snapshot_restore)
@@ -356,6 +376,10 @@ class HTTPAgent:
         add("POST", r"/v1/acl/policy/(?P<name>[^/]+)", self.acl_policy_put)
         add("DELETE", r"/v1/acl/policy/(?P<name>[^/]+)", self.acl_policy_delete)
         add("GET", r"/v1/acl/tokens", self.acl_tokens_list)
+        add("POST", r"/v1/acl/token/onetime", self.acl_ott_create)
+        add("PUT", r"/v1/acl/token/onetime", self.acl_ott_create)
+        add("POST", r"/v1/acl/token/onetime/exchange", self.acl_ott_exchange)
+        add("PUT", r"/v1/acl/token/onetime/exchange", self.acl_ott_exchange)
         add("PUT", r"/v1/acl/token", self.acl_token_put)
         add("POST", r"/v1/acl/token", self.acl_token_put)
         add("GET", r"/v1/acl/token/self", self.acl_token_self)
@@ -839,6 +863,46 @@ class HTTPAgent:
             "Index": s.raft.commit_index,
         }
 
+    def autopilot_config_get(self, req: Request):
+        self._acl(req, "allow_operator_read")
+        cfg = self._server.state.autopilot_config
+        return {
+            "CleanupDeadServers": cfg.get("cleanup_dead_servers", True),
+            "LastContactThreshold":
+                f"{cfg.get('last_contact_threshold_s', 10.0)}s",
+            "ServerStabilizationTime":
+                f"{cfg.get('server_stabilization_time_s', 10.0)}s",
+        }
+
+    def autopilot_config_put(self, req: Request):
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        self._acl(req, "allow_operator_write")
+        body = req.body or {}
+
+        def dur(key, default):
+            raw = body.get(key)
+            if raw is None:
+                return default
+            parsed = parse_duration(raw)
+            if parsed is None:
+                raise HTTPError(400, f"invalid duration for {key}: {raw!r}")
+            return parsed
+
+        cfg = {
+            "cleanup_dead_servers": bool(body.get("CleanupDeadServers", True)),
+            "last_contact_threshold_s": dur("LastContactThreshold", 10.0),
+            "server_stabilization_time_s": dur("ServerStabilizationTime", 10.0),
+        }
+        index = self._server.raft_apply(
+            fsm_msgs.AUTOPILOT_CONFIG, {"config": cfg}
+        )
+        return {"Updated": True, "Index": index}
+
+    def autopilot_health(self, req: Request):
+        self._acl(req, "allow_operator_read")
+        return self._server.autopilot.health()
+
     def snapshot_save(self, req: Request):
         import base64
 
@@ -1238,6 +1302,28 @@ class HTTPAgent:
         out = encode(t)
         out["Index"] = index
         return out
+
+    def acl_ott_create(self, req: Request):
+        """POST /v1/acl/token/onetime: mint a one-time token for the
+        caller's ACL token (acl_endpoint.go UpsertOneTimeToken)."""
+        t = self._server.state.acl_token_by_secret(req.token)
+        if t is None:
+            raise HTTPError(403, "token not found")
+        ott = self._server.create_one_time_token(t.accessor_id)
+        return {"OneTimeToken": {
+            "OneTimeSecretID": ott["one_time_secret_id"],
+            "AccessorID": ott["accessor_id"],
+            "ExpiresAt": ott["expires_at"],
+        }}
+
+    def acl_ott_exchange(self, req: Request):
+        body = req.body or {}
+        secret = body.get("OneTimeSecretID", "")
+        try:
+            token = self._server.exchange_one_time_token(secret)
+        except ValueError as e:
+            raise HTTPError(403, str(e))
+        return {"Token": token}
 
     def acl_token_delete(self, req: Request):
         from nomad_tpu.server import fsm as fsm_msgs

@@ -750,6 +750,39 @@ def cmd_operator_snapshot_restore(args) -> int:
     return 0
 
 
+def cmd_operator_autopilot_get(args) -> int:
+    api = make_client(args)
+    cfg = api.operator.autopilot_configuration()
+    print(format_kv([
+        f"CleanupDeadServers|{cfg.get('CleanupDeadServers', '')}",
+        f"LastContactThreshold|{cfg.get('LastContactThreshold', '')}",
+        f"ServerStabilizationTime|{cfg.get('ServerStabilizationTime', '')}",
+    ]))
+    return 0
+
+
+def cmd_operator_autopilot_set(args) -> int:
+    api = make_client(args)
+    cfg = api.operator.autopilot_configuration()
+    if args.cleanup_dead_servers is not None:
+        cfg["CleanupDeadServers"] = args.cleanup_dead_servers == "true"
+    if args.last_contact_threshold:
+        cfg["LastContactThreshold"] = args.last_contact_threshold
+    api.operator.set_autopilot_configuration(cfg)
+    print("Configuration updated!")
+    return 0
+
+
+def cmd_operator_autopilot_health(args) -> int:
+    api = make_client(args)
+    h = api.operator.autopilot_health()
+    print(f"Healthy: {h.get('Healthy')}")
+    print(f"FailureTolerance: {h.get('FailureTolerance')}")
+    print(dict_rows(h.get("Servers", []),
+                    ["ID", "Leader", "Healthy", "LastContact"]))
+    return 0
+
+
 def cmd_operator_raft_list(args) -> int:
     api = make_client(args)
     cfg = api.operator.raft_configuration()
@@ -1092,6 +1125,18 @@ def build_parser() -> argparse.ArgumentParser:
     osr = osnap.add_parser("restore")
     osr.add_argument("file")
     osr.set_defaults(fn=cmd_operator_snapshot_restore)
+    oauto = op.add_parser("autopilot").add_subparsers(dest="subsub",
+                                                      required=True)
+    oag = oauto.add_parser("get-config")
+    oag.set_defaults(fn=cmd_operator_autopilot_get)
+    oas = oauto.add_parser("set-config")
+    oas.add_argument("-cleanup-dead-servers", dest="cleanup_dead_servers",
+                     choices=["true", "false"], default=None)
+    oas.add_argument("-last-contact-threshold",
+                     dest="last_contact_threshold", default="")
+    oas.set_defaults(fn=cmd_operator_autopilot_set)
+    oah = oauto.add_parser("health")
+    oah.set_defaults(fn=cmd_operator_autopilot_health)
     oraft = op.add_parser("raft").add_subparsers(dest="subsub",
                                                  required=True)
     orl = oraft.add_parser("list-peers")

@@ -20,6 +20,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 from nomad_tpu.raft.log import LOG_COMMAND, LOG_NOOP, LogEntry, LogStore
 
+# reserved msg_type for replicated membership changes, handled by the
+# raft layer itself instead of the FSM (hashicorp/raft RemoveServer)
+RAFT_REMOVE_PEER = "__RaftRemovePeerConfigChange__"
+
 LOG = logging.getLogger(__name__)
 
 FOLLOWER = "follower"
@@ -104,10 +108,15 @@ class RaftNode:
         self.leader_id: Optional[str] = None
         self._last_contact = time.monotonic()
         self._votes = 0
+        # set when a committed config change removed this node
+        self._removed = False
 
         # leader volatile state
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
+        # last successful RPC round-trip per peer (autopilot's
+        # last-contact health signal)
+        self.peer_last_contact: Dict[str, float] = {}
 
         self._futures: Dict[int, _ApplyFuture] = {}
         self._apply_cond = threading.Condition(self._lock)
@@ -222,6 +231,8 @@ class RaftNode:
             with self._lock:
                 state = self.state
                 elapsed = time.monotonic() - self._last_contact
+                if self._removed:
+                    continue   # voted off the cluster: never campaign
             if state == LEADER:
                 self._wake_replicators()   # heartbeat
                 continue
@@ -336,6 +347,8 @@ class RaftNode:
             if self._shutdown.is_set():
                 return
             with self._lock:
+                if peer not in self.peers:
+                    return   # removed from the voting set (autopilot)
                 if self.state != LEADER:
                     continue
             try:
@@ -386,6 +399,7 @@ class RaftNode:
                         return
                     self.next_index[peer] = snapshot_req["last_index"] + 1
                     self.match_index[peer] = snapshot_req["last_index"]
+                    self.peer_last_contact[peer] = time.monotonic()
                 return
             resp = self.transport.send(
                 peer, "append_entries",
@@ -401,6 +415,7 @@ class RaftNode:
             if resp["term"] > self.current_term:
                 self._step_down_locked(resp["term"])
                 return
+            self.peer_last_contact[peer] = time.monotonic()
             if resp.get("success"):
                 if entries:
                     self.match_index[peer] = entries[-1].index
@@ -465,7 +480,13 @@ class RaftNode:
                 if entry.kind == LOG_COMMAND:
                     msg_type, req = entry.data
                     try:
-                        result = self.fsm_apply(msg_type, req)
+                        if msg_type == RAFT_REMOVE_PEER:
+                            # replicated membership change: applied on
+                            # every replica at the same log position
+                            self._apply_remove_peer(req["peer"])
+                            result = index
+                        else:
+                            result = self.fsm_apply(msg_type, req)
                     except Exception as e:          # noqa: BLE001
                         error = e
                         LOG.warning(
@@ -532,7 +553,10 @@ class RaftNode:
             if req["term"] > self.current_term:
                 self._step_down_locked(req["term"])
             granted = False
-            if req["term"] == self.current_term and (
+            # a candidate this replica knows was removed from the
+            # voting set cannot get our vote (post-removal rejoin guard)
+            known_voter = req["candidate"] in self.peers
+            if known_voter and req["term"] == self.current_term and (
                 self.voted_for is None or self.voted_for == req["candidate"]
             ):
                 # candidate's log must be at least as up-to-date
@@ -671,3 +695,55 @@ class RaftNode:
                 "last_applied": self.last_applied,
                 "last_log_index": self.log.last_index(),
             }
+
+    # --- membership + health (autopilot's raft surface) -----------------
+
+    def remove_peer(self, peer: str) -> None:
+        """Replicated membership change (raft RemoveServer; autopilot
+        dead-server cleanup): commits a config-change entry through the
+        log so every replica -- including a future leader -- drops the
+        peer at the same position. Single-server changes only (no joint
+        consensus), matching hashicorp/raft's RemoveServer. Note:
+        membership is re-derived from static config on process restart;
+        the entry protects against failover amnesia, not restarts."""
+        self.apply(RAFT_REMOVE_PEER, {"peer": peer})
+
+    def _apply_remove_peer(self, peer: str) -> None:
+        if peer == self.id:
+            # we were voted off the island: stop participating
+            with self._lock:
+                self._removed = True
+                self.state = FOLLOWER
+                self.peers = []
+            LOG.info("%s: removed from the cluster by config change", self.id)
+            return
+        with self._lock:
+            if peer not in self.peers:
+                return
+            self.peers.remove(peer)
+            self.next_index.pop(peer, None)
+            self.match_index.pop(peer, None)
+            self.peer_last_contact.pop(peer, None)
+            wake = self._peer_wakes.pop(peer, None)
+        if wake is not None:
+            wake.set()
+        LOG.info("%s: removed raft peer %s", self.id, peer)
+
+    def server_health(self) -> List[Dict]:
+        """Per-peer health view (autopilot ServerHealth): last contact
+        age and log lag, leader's perspective."""
+        now = time.monotonic()
+        with self._lock:
+            last_log = self.log.last_index()
+            return [
+                {
+                    "id": p,
+                    "last_contact_s": (
+                        now - self.peer_last_contact[p]
+                        if p in self.peer_last_contact else float("inf")
+                    ),
+                    "match_index": self.match_index.get(p, 0),
+                    "log_lag": last_log - self.match_index.get(p, 0),
+                }
+                for p in self.peers
+            ]

@@ -53,6 +53,12 @@ SERVICE_REG_UPSERT = "ServiceRegistrationUpsertRequestType"
 SERVICE_REG_DELETE_BY_ID = "ServiceRegistrationDeleteByIDRequestType"
 SERVICE_REG_DELETE_BY_ALLOC = "ServiceRegistrationDeleteByAllocRequestType"
 SERVICE_REG_DELETE_BY_NODE = "ServiceRegistrationDeleteByNodeIDRequestType"
+ONE_TIME_TOKEN_UPSERT = "OneTimeTokenUpsertRequestType"
+ONE_TIME_TOKEN_DELETE = "OneTimeTokenDeleteRequestType"
+ONE_TIME_TOKEN_EXPIRE = "OneTimeTokenExpireRequestType"
+PERIODIC_LAUNCH_UPSERT = "PeriodicLaunchRequestType"
+PERIODIC_LAUNCH_DELETE = "PeriodicLaunchDeleteRequestType"
+AUTOPILOT_CONFIG = "AutopilotRequestType"
 
 
 class NomadFSM:
@@ -392,6 +398,29 @@ class NomadFSM:
     def _apply_service_reg_delete_by_node(self, req: Dict) -> int:
         return self.state.delete_service_registrations_by_node(req["node_id"])
 
+    def _apply_one_time_token_upsert(self, req: Dict) -> int:
+        return self.state.upsert_one_time_token(req["token"])
+
+    def _apply_one_time_token_delete(self, req: Dict) -> int:
+        return self.state.delete_one_time_tokens(req["secrets"])
+
+    def _apply_one_time_token_expire(self, req: Dict) -> int:
+        expired = self.state.expire_one_time_tokens(req["now"])
+        return self.state.delete_one_time_tokens(expired)
+
+    def _apply_periodic_launch_upsert(self, req: Dict) -> int:
+        return self.state.upsert_periodic_launch(
+            req["namespace"], req["job_id"], req["launch_time"]
+        )
+
+    def _apply_periodic_launch_delete(self, req: Dict) -> int:
+        return self.state.delete_periodic_launch(
+            req["namespace"], req["job_id"]
+        )
+
+    def _apply_autopilot_config(self, req: Dict) -> int:
+        return self.state.set_autopilot_config(req["config"])
+
     _DISPATCH = {
         NODE_REGISTER: _apply_node_register,
         NODE_DEREGISTER: _apply_node_deregister,
@@ -428,4 +457,10 @@ class NomadFSM:
         SERVICE_REG_DELETE_BY_ID: _apply_service_reg_delete_by_id,
         SERVICE_REG_DELETE_BY_ALLOC: _apply_service_reg_delete_by_alloc,
         SERVICE_REG_DELETE_BY_NODE: _apply_service_reg_delete_by_node,
+        ONE_TIME_TOKEN_UPSERT: _apply_one_time_token_upsert,
+        ONE_TIME_TOKEN_DELETE: _apply_one_time_token_delete,
+        ONE_TIME_TOKEN_EXPIRE: _apply_one_time_token_expire,
+        PERIODIC_LAUNCH_UPSERT: _apply_periodic_launch_upsert,
+        PERIODIC_LAUNCH_DELETE: _apply_periodic_launch_delete,
+        AUTOPILOT_CONFIG: _apply_autopilot_config,
     }

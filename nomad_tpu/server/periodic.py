@@ -54,10 +54,29 @@ class PeriodicDispatcher:
 
     def restore(self, snapshot) -> None:
         """leader.go restorePeriodicDispatcher: re-track all periodic
-        jobs from replicated state."""
+        jobs from replicated state; any job whose next launch after its
+        recorded last launch has already passed is force-run to catch
+        up (the periodic_launch ledger survives leader failover)."""
+        now = time.time()
         for job in snapshot.jobs():
-            if job.is_periodic() and not job.stop:
-                self.add(job)
+            if not (job.is_periodic() and not job.stop):
+                continue
+            self.add(job)
+            last = self.server.state.periodic_launch_by_id(
+                job.namespace, job.id
+            )
+            if last <= 0:
+                continue
+            with self._lock:
+                entry = self._tracked.get((job.namespace, job.id))
+            if entry is None:   # add() rejected the spec
+                continue
+            _job, expr = entry
+            if expr.next_after(last) < now:
+                try:
+                    self._dispatch(job)
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("periodic catch-up %s failed: %s", job.id, e)
 
     # --- tracking (periodic.go Add/Remove) ------------------------------
 
@@ -151,6 +170,12 @@ class PeriodicDispatcher:
         self.server.raft_apply(
             fsm_msgs.JOB_REGISTER, {"job": child, "evals": [ev]}
         )
+        # ledger write so a new leader knows the last launch
+        # (periodic.go createEval -> UpsertPeriodicLaunch)
+        self.server.raft_apply(fsm_msgs.PERIODIC_LAUNCH_UPSERT, {
+            "namespace": parent.namespace, "job_id": parent.id,
+            "launch_time": now,
+        })
         return child.id
 
     def _child_running(self, parent) -> bool:

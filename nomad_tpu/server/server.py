@@ -108,7 +108,9 @@ class Server:
         from nomad_tpu.utils.timetable import TimeTable
 
         from nomad_tpu.server.volume_watcher import VolumesWatcher
+        from nomad_tpu.server.autopilot import Autopilot
 
+        self.autopilot = Autopilot(self)
         self.periodic_dispatcher = PeriodicDispatcher(self)
         self.deployments_watcher = DeploymentsWatcher(self)
         self.node_drainer = NodeDrainer(self)
@@ -121,6 +123,7 @@ class Server:
         core_sched.install(self)
 
         self._leader = False
+        self._ott_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._leader_threads: List[threading.Thread] = []
         # serializes establish/revoke (raft fires them from separate
@@ -196,6 +199,7 @@ class Server:
             self.deployments_watcher.set_enabled(True)
             self.node_drainer.set_enabled(True)
             self.volumes_watcher.set_enabled(True)
+            self.autopilot.set_enabled(True)
             for name, fn, interval in (
                 ("reap-failed-evals", self.reap_failed_evals_once, 0.2),
                 ("reap-dup-blocked", self.reap_dup_blocked_once, 0.2),
@@ -227,6 +231,7 @@ class Server:
             self.deployments_watcher.set_enabled(False)
             self.node_drainer.set_enabled(False)
             self.volumes_watcher.set_enabled(False)
+            self.autopilot.set_enabled(False)
             for w in self.workers:
                 w.set_pause(True)
             self._leader_threads.clear()
@@ -534,6 +539,45 @@ class Server:
             return pending.wait(timeout=30.0)
         # synchronous mode (tests without the applier thread)
         return self.planner.apply_one(plan)
+
+    # --- one-time tokens (acl_endpoint.go UpsertOneTimeToken/Exchange) --
+
+    def create_one_time_token(self, accessor_id: str,
+                              ttl_s: float = 600.0) -> Dict:
+        """Mint a one-time token for an ACL token holder (used by `nomad
+        ui -authenticate`; acl_endpoint.go UpsertOneTimeToken)."""
+        import uuid as _uuid
+
+        ott = {
+            "one_time_secret_id": str(_uuid.uuid4()),
+            "accessor_id": accessor_id,
+            "expires_at": time.time() + ttl_s,
+        }
+        self.raft_apply(fsm_msgs.ONE_TIME_TOKEN_UPSERT, {"token": ott})
+        return ott
+
+    def exchange_one_time_token(self, secret: str):
+        """Exchange a one-time secret for the underlying ACL token
+        (acl_endpoint.go ExchangeOneTimeToken); single use. The lock
+        makes check-then-delete atomic against concurrent exchanges on
+        this server (the HTTP agent is threaded)."""
+        with self._ott_lock:
+            ott = self.state.one_time_token_by_secret(secret)
+            if ott is None or ott["expires_at"] <= time.time():
+                raise ValueError("one-time token expired or not found")
+            token = self.state.acl_token_by_accessor(ott["accessor_id"])
+            self.raft_apply(fsm_msgs.ONE_TIME_TOKEN_DELETE,
+                            {"secrets": [secret]})
+        if token is None:
+            raise ValueError("one-time token's ACL token no longer exists")
+        return token
+
+    def expire_one_time_tokens(self, force: bool = False) -> int:
+        now = time.time() + (10**9 if force else 0)
+        expired = self.state.expire_one_time_tokens(now)
+        if expired:
+            self.raft_apply(fsm_msgs.ONE_TIME_TOKEN_EXPIRE, {"now": now})
+        return len(expired)
 
     # --- service registrations (service_registration_endpoint.go) ------
 

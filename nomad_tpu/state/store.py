@@ -181,6 +181,18 @@ class StateStore:
         # native service registrations keyed by instance id
         # (schema.go service_registrations)
         self._services: Dict[str, object] = {}
+        # one-time ACL tokens keyed by one-time secret
+        # (schema.go one_time_token): {"accessor_id", "expires_at"}
+        self._one_time_tokens: Dict[str, Dict] = {}
+        # periodic launch ledger keyed (namespace, job_id) -> last
+        # launch unix time (schema.go periodic_launch)
+        self._periodic_launches: Dict[Tuple[str, str], float] = {}
+        # autopilot config (schema.go autopilot-config)
+        self.autopilot_config: Dict = {
+            "cleanup_dead_servers": True,
+            "last_contact_threshold_s": 10.0,
+            "server_stabilization_time_s": 10.0,
+        }
         self.scheduler_config = SchedulerConfiguration()
         # table name -> [callback(index)]; fired outside the lock
         self._watchers: Dict[str, List[Callable[[int], None]]] = {}
@@ -506,6 +518,62 @@ class StateStore:
         with self._lock:
             return self._services.get(reg_id)
 
+    # --- one-time tokens (state_store.go UpsertOneTimeToken) -----------
+
+    def upsert_one_time_token(self, ott: Dict) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._one_time_tokens[ott["one_time_secret_id"]] = dict(ott)
+        self._notify(["one_time_token"], idx)
+        return idx
+
+    def one_time_token_by_secret(self, secret: str):
+        with self._lock:
+            return self._one_time_tokens.get(secret)
+
+    def delete_one_time_tokens(self, secrets: List[str]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for s in secrets:
+                self._one_time_tokens.pop(s, None)
+        self._notify(["one_time_token"], idx)
+        return idx
+
+    def expire_one_time_tokens(self, now: float) -> List[str]:
+        with self._lock:
+            return [s for s, t in self._one_time_tokens.items()
+                    if t.get("expires_at", 0) <= now]
+
+    # --- periodic launch ledger (state_store.go UpsertPeriodicLaunch) ---
+
+    def upsert_periodic_launch(self, namespace: str, job_id: str,
+                               launch_time: float) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._periodic_launches[(namespace, job_id)] = launch_time
+        self._notify(["periodic_launch"], idx)
+        return idx
+
+    def delete_periodic_launch(self, namespace: str, job_id: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._periodic_launches.pop((namespace, job_id), None)
+        self._notify(["periodic_launch"], idx)
+        return idx
+
+    def periodic_launch_by_id(self, namespace: str, job_id: str) -> float:
+        with self._lock:
+            return self._periodic_launches.get((namespace, job_id), 0.0)
+
+    # --- autopilot config (state_store.go AutopilotConfig) --------------
+
+    def set_autopilot_config(self, config: Dict) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self.autopilot_config = dict(config)
+        self._notify(["autopilot-config"], idx)
+        return idx
+
     def to_snapshot_bytes(self) -> bytes:
         """Serialize every table for raft snapshots / operator backup."""
         with self._lock:
@@ -527,6 +595,9 @@ class StateStore:
                 "acl_tokens": dict(self._acl_tokens),
                 "csi_volumes": dict(self._csi_volumes),
                 "services": dict(self._services),
+                "one_time_tokens": dict(self._one_time_tokens),
+                "periodic_launches": dict(self._periodic_launches),
+                "autopilot_config": dict(self.autopilot_config),
             }
             return pickle.dumps(payload)
 
@@ -550,6 +621,11 @@ class StateStore:
             self._acl_tokens = payload.get("acl_tokens", {})
             self._csi_volumes = payload.get("csi_volumes", {})
             self._services = payload.get("services", {})
+            self._one_time_tokens = payload.get("one_time_tokens", {})
+            self._periodic_launches = payload.get("periodic_launches", {})
+            self.autopilot_config = payload.get(
+                "autopilot_config", self.autopilot_config
+            )
         self._notify(
             ["nodes", "jobs", "evals", "allocs", "deployment",
              "scheduler_config", "csi_volumes", "services"],

@@ -1,0 +1,199 @@
+"""One-time tokens, autopilot, and periodic-launch-ledger tests.
+
+Modeled on reference nomad/acl_endpoint_test.go (OneTimeToken),
+nomad/autopilot_test.go (CleanupDeadServer), and periodic_test.go
+restore semantics.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.server.testing import make_cluster, wait_for_leader, wait_until
+from nomad_tpu.structs import consts
+
+
+class TestOneTimeTokens:
+    def _server_with_token(self):
+        from nomad_tpu.acl.policy import ACLToken
+
+        server = Server(ServerConfig(num_workers=0))
+        server.start()
+        token = ACLToken.create(name="ops", type="management")
+        server.raft_apply("ACLTokenUpsertRequestType", {"tokens": [token]})
+        return server, token
+
+    def test_create_and_exchange(self):
+        server, token = self._server_with_token()
+        try:
+            ott = server.create_one_time_token(token.accessor_id)
+            assert ott["expires_at"] > time.time()
+            got = server.exchange_one_time_token(ott["one_time_secret_id"])
+            assert got.accessor_id == token.accessor_id
+            # single use
+            with pytest.raises(ValueError):
+                server.exchange_one_time_token(ott["one_time_secret_id"])
+        finally:
+            server.shutdown()
+
+    def test_expired_rejected_and_gcd(self):
+        server, token = self._server_with_token()
+        try:
+            ott = server.create_one_time_token(token.accessor_id, ttl_s=-1)
+            with pytest.raises(ValueError):
+                server.exchange_one_time_token(ott["one_time_secret_id"])
+            assert server.expire_one_time_tokens() == 1
+            assert server.state.one_time_token_by_secret(
+                ott["one_time_secret_id"]) is None
+        finally:
+            server.shutdown()
+
+    def test_over_http(self):
+        from nomad_tpu.api.agent import Agent, AgentConfig
+        from nomad_tpu.api.client import APIClient, APIError
+        from nomad_tpu.acl.policy import ACLToken
+
+        agent = Agent(AgentConfig(num_schedulers=0))
+        agent.start()
+        try:
+            token = ACLToken.create(name="ops", type="management")
+            agent.server.raft_apply("ACLTokenUpsertRequestType",
+                                    {"tokens": [token]})
+            api = APIClient(agent.http.addr, token=token.secret_id)
+            resp = api.acl.create_one_time_token()
+            secret = resp["OneTimeToken"]["OneTimeSecretID"]
+            anon = APIClient(agent.http.addr)
+            got = anon.acl.exchange_one_time_token(secret)
+            assert got["Token"]["AccessorID"] == token.accessor_id
+            with pytest.raises(APIError):
+                anon.acl.exchange_one_time_token(secret)
+        finally:
+            agent.shutdown()
+
+
+class TestPeriodicLedger:
+    def test_dispatch_records_launch(self):
+        server = Server(ServerConfig(num_workers=0))
+        server.start()
+        try:
+            job = mock.job()
+            job.periodic = structs.PeriodicConfig(enabled=True,
+                                                  spec="@every 3600s")
+            server.job_register(job)
+            child = server.periodic_dispatcher.force_run(job)
+            assert child
+            assert server.state.periodic_launch_by_id(
+                "default", job.id) > 0
+        finally:
+            server.shutdown()
+
+    def test_restore_catches_up_missed_launch(self):
+        server = Server(ServerConfig(num_workers=0))
+        server.start()
+        try:
+            job = mock.job()
+            job.periodic = structs.PeriodicConfig(enabled=True,
+                                                  spec="@every 0.5s")
+            server.job_register(job)
+            # ledger says the last launch was long ago -> the next
+            # scheduled launch has been missed
+            server.state.upsert_periodic_launch(
+                "default", job.id, time.time() - 3600
+            )
+            before = len([
+                j for j in server.state.snapshot().jobs()
+                if getattr(j, "parent_id", "") == job.id
+            ])
+            server.periodic_dispatcher.restore(server.state.snapshot())
+            after = len([
+                j for j in server.state.snapshot().jobs()
+                if getattr(j, "parent_id", "") == job.id
+            ])
+            assert after == before + 1
+        finally:
+            server.shutdown()
+
+
+class TestAutopilot:
+    def test_health_view(self):
+        servers, registry = make_cluster(3)
+        try:
+            leader = wait_for_leader(servers)
+            wait_until(
+                lambda: all(
+                    h["last_contact_s"] < 5.0
+                    for h in leader.raft.server_health()
+                ),
+                msg="peers contacted",
+            )
+            h = leader.autopilot.health()
+            assert h["Healthy"] is True
+            assert len(h["Servers"]) == 3
+            assert sum(1 for s in h["Servers"] if s["Leader"]) == 1
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_dead_server_cleanup(self):
+        servers, registry = make_cluster(3)
+        try:
+            leader = wait_for_leader(servers)
+            # tighten thresholds so the test is fast
+            leader.state.set_autopilot_config({
+                "cleanup_dead_servers": True,
+                "last_contact_threshold_s": 0.5,
+                "server_stabilization_time_s": 0.2,
+            })
+            dead = next(s for s in servers if s is not leader)
+            dead_id = dead.raft.id
+            dead.shutdown()
+            registry.partition(leader.raft.id, dead_id)
+            wait_until(
+                lambda: leader.autopilot.evaluate_once() or
+                dead_id not in leader.raft.peers,
+                timeout=10.0, msg="dead server removed",
+            )
+            assert dead_id not in leader.raft.peers
+            # the removal is a replicated config change: the surviving
+            # follower drops the peer too, so a failover cannot
+            # resurrect it
+            follower = next(s for s in servers
+                            if s is not leader and s.raft.id != dead_id)
+            wait_until(lambda: dead_id not in follower.raft.peers,
+                       msg="follower applied removal")
+            # cluster still works with the remaining pair
+            job = mock.job()
+            leader.job_register(job)
+            assert leader.state.snapshot().job_by_id(
+                "default", job.id) is not None
+        finally:
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:
+                    pass
+
+    def test_quorum_guard(self):
+        servers, registry = make_cluster(3)
+        try:
+            leader = wait_for_leader(servers)
+            leader.state.set_autopilot_config({
+                "cleanup_dead_servers": True,
+                "last_contact_threshold_s": 0.3,
+                "server_stabilization_time_s": 0.1,
+            })
+            others = [s for s in servers if s is not leader]
+            for s in others:
+                registry.partition(leader.raft.id, s.raft.id)
+            time.sleep(0.6)
+            # both peers dead: removing either would leave the leader
+            # alone -> quorum guard refuses
+            removed = leader.autopilot.evaluate_once()
+            assert removed == []
+            assert len(leader.raft.peers) == 2
+        finally:
+            registry.heal()
+            for s in servers:
+                s.shutdown()
