@@ -42,6 +42,7 @@ class QueryOptions:
     """api.QueryOptions: blocking + filtering knobs."""
 
     namespace: str = ""
+    region: str = ""
     wait_index: int = 0
     wait_time_s: float = 0.0
     prefix: str = ""
@@ -52,10 +53,11 @@ class QueryOptions:
 class APIClient:
     def __init__(self, address: str = "http://127.0.0.1:4646",
                  token: str = "", namespace: str = "default",
-                 timeout: float = 305.0) -> None:
+                 timeout: float = 305.0, region: str = "") -> None:
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
+        self.region = region
         self.timeout = timeout
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
@@ -81,6 +83,9 @@ class APIClient:
         ns = (q.namespace if q and q.namespace else self.namespace)
         if ns:
             params["namespace"] = ns
+        region = (q.region if q and q.region else self.region)
+        if region:
+            params["region"] = region
         if q is not None:
             if q.wait_index:
                 params["index"] = str(q.wait_index)
@@ -470,6 +475,9 @@ class ACLAPI(_Endpoint):
 
     def self_token(self) -> Dict:
         return self.c.get("/v1/acl/token/self")
+
+    def token(self, accessor_id: str) -> Dict:
+        return self.c.get(f"/v1/acl/token/{_esc(accessor_id)}")
 
     def create_one_time_token(self) -> Dict:
         return self.c.post("/v1/acl/token/onetime")

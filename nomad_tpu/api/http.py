@@ -139,19 +139,29 @@ class HTTPAgent:
         path = parsed.path
         query = urllib.parse.parse_qs(parsed.query)
         body = None
+        raw_body = b""
         length = int(handler.headers.get("Content-Length") or 0)
         if length:
-            raw = handler.rfile.read(length)
-            if raw:
+            raw_body = handler.rfile.read(length)
+            if raw_body:
                 try:
-                    body = json.loads(raw)
+                    body = json.loads(raw_body)
                 except json.JSONDecodeError:
-                    body = raw
+                    body = raw_body
         token = handler.headers.get("X-Nomad-Token", "")
         if not token:
             auth = handler.headers.get("Authorization", "")
             if auth.startswith("Bearer "):
                 token = auth[7:]
+
+        # cross-region forwarding (rpc.go:537 forward/forwardRegion):
+        # a request naming another region proxies to a server there
+        region = (query.get("region") or [""])[0]
+        agent_region = self.agent.config.region
+        if region and region != agent_region and self.agent.server is not None:
+            self._forward_region(handler, method, region, parsed, token,
+                                 raw_body)
+            return
 
         for route_method, pattern, fn in self._routes:
             if route_method != method:
@@ -186,14 +196,67 @@ class HTTPAgent:
             return
         self._send(handler, 404, {"error": f"no handler for {method} {path}"})
 
-    def _send(self, handler, status: int, payload) -> None:
+    def _forward_region(self, handler, method: str, region: str,
+                        parsed, token: str, raw_body: bytes) -> None:
+        """Proxy the request to the named region's server verbatim
+        (minus the region param, so it doesn't loop)."""
+        import urllib.error
+        import urllib.request
+
+        addr = self.agent.server.region_addr(region)
+        if addr is None:
+            self._send(handler, 400, {"error": f"No path to region {region}"})
+            return
+        pairs = [(k, v) for k, v in urllib.parse.parse_qsl(parsed.query)
+                 if k != "region"]
+        url = addr + parsed.path
+        if pairs:
+            url += "?" + urllib.parse.urlencode(pairs)
+        req = urllib.request.Request(url, data=raw_body or None,
+                                     method=method)
+        req.add_header("Content-Type", "application/json")
+        if token:
+            req.add_header("X-Nomad-Token", token)
+        # outlive the remote's blocking-query hold (default 300s,
+        # capped at 600s server-side) plus slack
+        wait = dict(pairs).get("wait", "")
+        hold = parse_duration(wait) if wait else 300.0
+        fwd_timeout = min(hold if hold is not None else 300.0, 600.0) + 10.0
+        remote_index = None
+        try:
+            with urllib.request.urlopen(req, timeout=fwd_timeout) as resp:
+                raw = resp.read()
+                status = resp.status
+                remote_index = resp.headers.get("X-Nomad-Index")
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status = e.code
+            remote_index = e.headers.get("X-Nomad-Index")
+        except OSError as e:
+            self._send(handler, 502,
+                       {"error": f"region {region} unreachable: {e}"})
+            return
+        try:
+            payload = json.loads(raw) if raw else None
+        except json.JSONDecodeError:
+            # a success code with an unparseable body must not reach the
+            # caller looking like data
+            status, payload = 502, {"error": "bad upstream response"}
+        self._send(handler, status, payload, index=remote_index)
+
+    def _send(self, handler, status: int, payload, index=None) -> None:
+        """``index`` overrides the stamped X-Nomad-Index (forwarded
+        responses must carry the REMOTE region's index or cross-region
+        blocking queries spin)."""
         try:
             data = json.dumps(encode(payload)).encode()
             handler.send_response(status)
             handler.send_header("Content-Type", "application/json")
             handler.send_header("Content-Length", str(len(data)))
-            idx = self.agent.server.state.latest_index() if self.agent.server else 0
-            handler.send_header("X-Nomad-Index", str(idx))
+            if index is None:
+                index = self.agent.server.state.latest_index() \
+                    if self.agent.server else 0
+            handler.send_header("X-Nomad-Index", str(index))
             handler.end_headers()
             handler.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError):
@@ -295,11 +358,14 @@ class HTTPAgent:
         add("PUT", r"/v1/deployment/promote/(?P<id>[^/]+)", self.deployment_promote)
 
         # status / agent / operator
+        add("GET", r"/v1/regions", self.regions_list)
         add("GET", r"/v1/status/leader", self.status_leader)
         add("GET", r"/v1/status/peers", self.status_peers)
         add("GET", r"/v1/agent/self", self.agent_self)
         add("GET", r"/v1/agent/health", self.agent_health)
         add("GET", r"/v1/agent/members", self.agent_members)
+        add("PUT", r"/v1/agent/join", self.agent_join)
+        add("POST", r"/v1/agent/join", self.agent_join)
         add("GET", r"/v1/agent/servers", self.agent_servers)
         add("GET", r"/v1/metrics", self.metrics)
         add("GET", r"/v1/operator/scheduler/configuration", self.sched_config_get)
@@ -793,6 +859,19 @@ class HTTPAgent:
             "client": ok if self.agent.client is not None else None,
         }
 
+    def agent_join(self, req: Request):
+        """PUT /v1/agent/join?address=<http addr>&join_region=<name>:
+        federate with another region (serf WAN join analog). agent:write
+        gated -- an open join would let anyone redirect token-bearing
+        forwarded requests to their own endpoint."""
+        self._acl(req, "allow_agent_write")
+        addr = req.q("address")
+        region = req.q("join_region")
+        if not addr or not region:
+            raise HTTPError(400, "address and join_region are required")
+        self._server.join_region(region, addr)
+        return {"num_joined": 1}
+
     def agent_members(self, req: Request):
         members = getattr(self.agent, "members", None)
         if members is not None:
@@ -848,6 +927,10 @@ class HTTPAgent:
         cfg.preemption_service_enabled = bool(pre.get("ServiceSchedulerEnabled", False))
         index = self._server.raft_apply(fsm_msgs.SCHEDULER_CONFIG, {"config": cfg})
         return {"Updated": True, "Index": index}
+
+    def regions_list(self, req: Request):
+        """region_endpoint.go List."""
+        return self._server.known_regions()
 
     def raft_config(self, req: Request):
         s = self._server

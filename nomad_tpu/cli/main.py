@@ -34,6 +34,7 @@ def make_client(args) -> APIClient:
         address=args.address,
         token=args.token,
         namespace=args.namespace,
+        region=getattr(args, "region", "") or "",
     )
 
 

@@ -59,6 +59,7 @@ ONE_TIME_TOKEN_EXPIRE = "OneTimeTokenExpireRequestType"
 PERIODIC_LAUNCH_UPSERT = "PeriodicLaunchRequestType"
 PERIODIC_LAUNCH_DELETE = "PeriodicLaunchDeleteRequestType"
 AUTOPILOT_CONFIG = "AutopilotRequestType"
+REGION_UPSERT = "RegionUpsertRequestType"
 
 
 class NomadFSM:
@@ -421,6 +422,9 @@ class NomadFSM:
     def _apply_autopilot_config(self, req: Dict) -> int:
         return self.state.set_autopilot_config(req["config"])
 
+    def _apply_region_upsert(self, req: Dict) -> int:
+        return self.state.upsert_region(req["region"], req["http_addr"])
+
     _DISPATCH = {
         NODE_REGISTER: _apply_node_register,
         NODE_DEREGISTER: _apply_node_deregister,
@@ -463,4 +467,5 @@ class NomadFSM:
         PERIODIC_LAUNCH_UPSERT: _apply_periodic_launch_upsert,
         PERIODIC_LAUNCH_DELETE: _apply_periodic_launch_delete,
         AUTOPILOT_CONFIG: _apply_autopilot_config,
+        REGION_UPSERT: _apply_region_upsert,
     }

@@ -43,6 +43,9 @@ class ServerConfig:
         region: str = "global",
         datacenter: str = "dc1",
         name: str = "server-1",
+        authoritative_region: str = "",
+        replication_token: str = "",
+        replication_interval: float = 1.0,
         gc_interval: float = 60.0,
         eval_gc_threshold: float = 3600.0,
         job_gc_threshold: float = 4 * 3600.0,
@@ -59,6 +62,9 @@ class ServerConfig:
         self.region = region
         self.datacenter = datacenter
         self.name = name
+        self.authoritative_region = authoritative_region
+        self.replication_token = replication_token
+        self.replication_interval = replication_interval
         self.gc_interval = gc_interval
         self.eval_gc_threshold = eval_gc_threshold
         self.job_gc_threshold = job_gc_threshold
@@ -200,12 +206,17 @@ class Server:
             self.node_drainer.set_enabled(True)
             self.volumes_watcher.set_enabled(True)
             self.autopilot.set_enabled(True)
-            for name, fn, interval in (
+            loops = [
                 ("reap-failed-evals", self.reap_failed_evals_once, 0.2),
                 ("reap-dup-blocked", self.reap_dup_blocked_once, 0.2),
                 ("timetable-witness", self._witness_time, 0.5),
                 ("schedule-gc", self.schedule_core_gc, self.config.gc_interval),
-            ):
+            ]
+            if self.config.authoritative_region and \
+                    self.config.authoritative_region != self.config.region:
+                loops.append(("acl-replication", self.replicate_acl_once,
+                              self.config.replication_interval))
+            for name, fn, interval in loops:
                 t = threading.Thread(
                     target=self._leader_loop, args=(fn, interval, gen),
                     daemon=True, name=name,
@@ -539,6 +550,102 @@ class Server:
             return pending.wait(timeout=30.0)
         # synchronous mode (tests without the applier thread)
         return self.planner.apply_one(plan)
+
+    # --- federation (serf WAN + rpc.go:537 region forwarding) -----------
+
+    def join_region(self, region: str, http_addr: str) -> None:
+        """Record a federated region's entry point (serf WAN join);
+        replicated through raft so failover keeps forwarding working."""
+        if region != self.config.region:
+            self.raft_apply(fsm_msgs.REGION_UPSERT,
+                            {"region": region, "http_addr": http_addr})
+
+    def known_regions(self) -> List[str]:
+        """region_endpoint.go List: own region + WAN-known regions."""
+        return sorted({self.config.region, *self.state.regions()})
+
+    def region_addr(self, region: str) -> Optional[str]:
+        return self.state.regions().get(region)
+
+    def replicate_acl_once(self) -> int:
+        """leader.go:1347 replicateACLPolicies/Tokens: non-authoritative
+        regions diff against the authoritative region -- upserting what
+        changed and deleting what the authority no longer has (a revoked
+        global token must die everywhere). Returns applied change count."""
+        auth = self.config.authoritative_region
+        if not auth or auth == self.config.region:
+            return 0
+        addr = self.region_addr(auth)
+        if addr is None:
+            return 0
+        from nomad_tpu.api.client import APIClient
+        from nomad_tpu.acl.policy import ACLPolicy, ACLToken
+
+        api = APIClient(addr, token=self.config.replication_token)
+        n = 0
+
+        # policies: upsert changed, delete stale
+        remote_names = set()
+        upserts = []
+        for stub in api.acl.policies():
+            full = api.acl.policy(stub["Name"])
+            name = full.get("Name", "")
+            remote_names.add(name)
+            local = self.state.acl_policy_by_name(name)
+            if local is not None \
+                    and local.rules == full.get("Rules", "") \
+                    and local.description == full.get("Description", ""):
+                continue
+            upserts.append(ACLPolicy(
+                name=name,
+                description=full.get("Description", ""),
+                rules=full.get("Rules", ""),
+            ))
+        if upserts:
+            self.raft_apply(fsm_msgs.ACL_POLICY_UPSERT,
+                            {"policies": upserts})
+            n += len(upserts)
+        stale = [p.name for p in self.state.acl_policies()
+                 if p.name not in remote_names]
+        if stale:
+            self.raft_apply(fsm_msgs.ACL_POLICY_DELETE, {"names": stale})
+            n += len(stale)
+
+        # global tokens follow the authoritative region; local tokens
+        # never replicate (leader.go replicateACLTokens)
+        remote_accessors = set()
+        tok_upserts = []
+        for stub in api.acl.tokens():
+            full = api.acl.token(stub["AccessorID"])
+            if not full.get("Global", False):
+                continue
+            accessor = full.get("AccessorID", "")
+            remote_accessors.add(accessor)
+            local = self.state.acl_token_by_accessor(accessor)
+            if local is not None \
+                    and local.secret_id == full.get("SecretID", "") \
+                    and local.policies == (full.get("Policies") or []) \
+                    and local.type == full.get("Type", "client"):
+                continue
+            tok_upserts.append(ACLToken(
+                accessor_id=accessor,
+                secret_id=full.get("SecretID", ""),
+                name=full.get("Name", ""),
+                type=full.get("Type", "client"),
+                policies=full.get("Policies") or [],
+                global_=True,
+            ))
+        if tok_upserts:
+            self.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT,
+                            {"tokens": tok_upserts})
+            n += len(tok_upserts)
+        stale_toks = [t.accessor_id for t in self.state.acl_tokens()
+                      if t.global_ and t.accessor_id not in remote_accessors]
+        if stale_toks:
+            self.raft_apply(fsm_msgs.ACL_TOKEN_DELETE,
+                            {"accessor_ids": stale_toks})
+            n += len(stale_toks)
+        return n
 
     # --- one-time tokens (acl_endpoint.go UpsertOneTimeToken/Exchange) --
 

@@ -187,6 +187,10 @@ class StateStore:
         # periodic launch ledger keyed (namespace, job_id) -> last
         # launch unix time (schema.go periodic_launch)
         self._periodic_launches: Dict[Tuple[str, str], float] = {}
+        # WAN federation registry: region -> HTTP address of a server
+        # there (serf WAN member list analog; replicated so failover
+        # keeps forwarding + ACL replication working)
+        self._regions: Dict[str, str] = {}
         # autopilot config (schema.go autopilot-config)
         self.autopilot_config: Dict = {
             "cleanup_dead_servers": True,
@@ -565,6 +569,19 @@ class StateStore:
         with self._lock:
             return self._periodic_launches.get((namespace, job_id), 0.0)
 
+    # --- federation registry --------------------------------------------
+
+    def upsert_region(self, region: str, http_addr: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._regions[region] = http_addr
+        self._notify(["regions"], idx)
+        return idx
+
+    def regions(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._regions)
+
     # --- autopilot config (state_store.go AutopilotConfig) --------------
 
     def set_autopilot_config(self, config: Dict) -> int:
@@ -598,6 +615,7 @@ class StateStore:
                 "one_time_tokens": dict(self._one_time_tokens),
                 "periodic_launches": dict(self._periodic_launches),
                 "autopilot_config": dict(self.autopilot_config),
+                "regions": dict(self._regions),
             }
             return pickle.dumps(payload)
 
@@ -626,6 +644,7 @@ class StateStore:
             self.autopilot_config = payload.get(
                 "autopilot_config", self.autopilot_config
             )
+            self._regions = payload.get("regions", {})
         self._notify(
             ["nodes", "jobs", "evals", "allocs", "deployment",
              "scheduler_config", "csi_volumes", "services"],

@@ -1,0 +1,156 @@
+"""Multi-region federation tests.
+
+Modeled on reference rpc.go:537-707 region forwarding,
+region_endpoint_test.go, and leader.go:1347 ACL replication.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.api.client import APIClient, APIError, QueryOptions
+
+
+def two_regions():
+    east = Agent(AgentConfig(name="east-1", region="east", num_schedulers=0))
+    west = Agent(AgentConfig(name="west-1", region="west", num_schedulers=0))
+    east.start()
+    west.start()
+    # WAN join both ways
+    east.server.join_region("west", west.http.addr)
+    west.server.join_region("east", east.http.addr)
+    return east, west
+
+
+class TestFederation:
+    def test_regions_list(self):
+        east, west = two_regions()
+        try:
+            api = APIClient(east.http.addr)
+            assert api.get("/v1/regions") == ["east", "west"]
+        finally:
+            east.shutdown()
+            west.shutdown()
+
+    def test_forwarding_reads_other_region(self):
+        east, west = two_regions()
+        try:
+            job = mock.job()
+            west.server.job_register(job)
+            api = APIClient(east.http.addr)
+            # local region: job not found
+            local = api.jobs.list()
+            assert all(j["ID"] != job.id for j in local)
+            # ?region=west forwards to the west server
+            remote = api.jobs.list(q=QueryOptions(region="west"))
+            assert any(j["ID"] == job.id for j in remote)
+        finally:
+            east.shutdown()
+            west.shutdown()
+
+    def test_forwarding_writes_other_region(self):
+        east, west = two_regions()
+        try:
+            api = APIClient(east.http.addr, region="west")
+            api.namespaces.register("team-a", "cross-region write")
+            assert west.server.state.namespace_by_name("team-a") is not None
+            assert east.server.state.namespace_by_name("team-a") is None
+        finally:
+            east.shutdown()
+            west.shutdown()
+
+    def test_unknown_region_rejected(self):
+        east, west = two_regions()
+        try:
+            api = APIClient(east.http.addr, region="mars")
+            with pytest.raises(APIError) as e:
+                api.jobs.list()
+            assert "No path to region" in str(e.value)
+        finally:
+            east.shutdown()
+            west.shutdown()
+
+    def test_join_over_http(self):
+        east = Agent(AgentConfig(name="e", region="east", num_schedulers=0))
+        west = Agent(AgentConfig(name="w", region="west", num_schedulers=0))
+        east.start()
+        west.start()
+        try:
+            api = APIClient(east.http.addr)
+            api.put("/v1/agent/join", q=QueryOptions(params={
+                "address": west.http.addr, "join_region": "west",
+            }))
+            assert api.get("/v1/regions") == ["east", "west"]
+        finally:
+            east.shutdown()
+            west.shutdown()
+
+
+class TestACLReplication:
+    def test_policies_and_global_tokens_replicate(self):
+        from nomad_tpu.acl.policy import ACLPolicy, ACLToken
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        auth = Agent(AgentConfig(name="auth-1", region="authority",
+                                 num_schedulers=0))
+        auth.start()
+        replica = Agent(AgentConfig(name="rep-1", region="replica",
+                                    num_schedulers=0))
+        replica.start()
+        try:
+            replica.server.config.authoritative_region = "authority"
+            replica.server.join_region("authority", auth.http.addr)
+
+            policy = ACLPolicy(name="readers", rules='namespace "*" '
+                               '{ policy = "read" }')
+            auth.server.raft_apply(fsm_msgs.ACL_POLICY_UPSERT,
+                                   {"policies": [policy]})
+            gtok = ACLToken.create(name="g", type="management", global_=True)
+            ltok = ACLToken.create(name="l", type="management", global_=False)
+            auth.server.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT,
+                                   {"tokens": [gtok, ltok]})
+
+            n = replica.server.replicate_acl_once()
+            assert n >= 2
+            got = replica.server.state.acl_policy_by_name("readers")
+            assert got is not None and "read" in got.rules
+            assert replica.server.state.acl_token_by_accessor(
+                gtok.accessor_id) is not None
+            # local tokens never replicate
+            assert replica.server.state.acl_token_by_accessor(
+                ltok.accessor_id) is None
+
+            # steady state: a second pass applies nothing
+            assert replica.server.replicate_acl_once() == 0
+
+            # revocation in the authority propagates (diff-and-delete)
+            auth.server.raft_apply(
+                fsm_msgs.ACL_TOKEN_DELETE,
+                {"accessor_ids": [gtok.accessor_id]},
+            )
+            auth.server.raft_apply(
+                fsm_msgs.ACL_POLICY_DELETE, {"names": ["readers"]}
+            )
+            assert replica.server.replicate_acl_once() == 2
+            assert replica.server.state.acl_token_by_accessor(
+                gtok.accessor_id) is None
+            assert replica.server.state.acl_policy_by_name("readers") is None
+        finally:
+            auth.shutdown()
+            replica.shutdown()
+
+    def test_regions_survive_snapshot_restore(self):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_workers=0, region="east"))
+        server.start()
+        try:
+            server.join_region("west", "http://west:4646")
+            data = server.state.to_snapshot_bytes()
+            fresh = Server(ServerConfig(num_workers=0, region="east"))
+            fresh.state.restore_from_bytes(data)
+            assert fresh.region_addr("west") == "http://west:4646"
+        finally:
+            server.shutdown()
