@@ -158,9 +158,17 @@ class HTTPAgent:
         # a request naming another region proxies to a server there
         region = (query.get("region") or [""])[0]
         agent_region = self.agent.config.region
-        if region and region != agent_region and self.agent.server is not None:
-            self._forward_region(handler, method, region, parsed, token,
-                                 raw_body)
+        if region and region != agent_region:
+            if self.agent.server is None:
+                # a client-only agent has no WAN registry; answering
+                # locally would masquerade as the remote region
+                self._send(handler, 400, {
+                    "error": f"No path to region {region}: "
+                             "agent has no server",
+                })
+            else:
+                self._forward_region(handler, method, region, parsed,
+                                     token, raw_body)
             return
 
         for route_method, pattern, fn in self._routes:
@@ -225,6 +233,11 @@ class HTTPAgent:
         remote_index = None
         try:
             with urllib.request.urlopen(req, timeout=fwd_timeout) as resp:
+                if parsed.path == "/v1/event/stream":
+                    # infinite NDJSON: relay line by line instead of
+                    # buffering an unbounded body
+                    self._relay_stream(handler, resp)
+                    return
                 raw = resp.read()
                 status = resp.status
                 remote_index = resp.headers.get("X-Nomad-Index")
@@ -232,7 +245,8 @@ class HTTPAgent:
             raw = e.read()
             status = e.code
             remote_index = e.headers.get("X-Nomad-Index")
-        except OSError as e:
+        except (OSError, ValueError) as e:
+            # ValueError: malformed registered address (bad scheme)
             self._send(handler, 502,
                        {"error": f"region {region} unreachable: {e}"})
             return
@@ -243,6 +257,21 @@ class HTTPAgent:
             # caller looking like data
             status, payload = 502, {"error": "bad upstream response"}
         self._send(handler, status, payload, index=remote_index)
+
+    def _relay_stream(self, handler, resp) -> None:
+        """Pipe a remote NDJSON stream to the client as it arrives."""
+        try:
+            handler.send_response(resp.status)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.end_headers()
+            for line in resp:
+                handler.wfile.write(f"{len(line):x}\r\n".encode())
+                handler.wfile.write(line + b"\r\n")
+                handler.wfile.flush()
+            handler.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
 
     def _send(self, handler, status: int, payload, index=None) -> None:
         """``index`` overrides the stamped X-Nomad-Index (forwarded
@@ -869,6 +898,10 @@ class HTTPAgent:
         region = req.q("join_region")
         if not addr or not region:
             raise HTTPError(400, "address and join_region are required")
+        if not addr.startswith(("http://", "https://")):
+            raise HTTPError(400, f"address must be an http(s) URL: {addr!r}")
+        if region == self.agent.config.region:
+            raise HTTPError(400, f"cannot join own region {region!r}")
         self._server.join_region(region, addr)
         return {"num_joined": 1}
 

@@ -2,12 +2,12 @@
 
 Reference behavior: drivers/ (SURVEY.md section 2.8) -- docker, exec,
 rawexec, java, qemu, mock, registered in-process via the plugin catalog
-(helper/pluginutils/catalog/register.go). Built-ins here: ``mock`` (the
-fully scriptable test driver, drivers/mock), ``raw_exec`` (host
-subprocesses, drivers/rawexec), ``exec`` (subprocesses with best-effort
-isolation, drivers/exec). The shared native executor
-(drivers/shared/executor) supervises children from a separate process
-so tasks survive agent restarts.
+(helper/pluginutils/catalog/register.go). All six are registered here;
+fingerprinting gates placement (the scheduler's DriverChecker skips
+nodes where a driver is undetected, e.g. no JVM / no qemu binary / no
+docker daemon). The shared native executor (drivers/shared/executor)
+supervises children from a separate process so tasks survive agent
+restarts.
 """
 
 from typing import Dict
@@ -20,9 +20,15 @@ def builtin_drivers() -> Dict[str, DriverPlugin]:
     from nomad_tpu.drivers.mock import MockDriver
     from nomad_tpu.drivers.rawexec import RawExecDriver
     from nomad_tpu.drivers.execdriver import ExecDriver
+    from nomad_tpu.drivers.java import JavaDriver
+    from nomad_tpu.drivers.qemu import QemuDriver
+    from nomad_tpu.drivers.docker import DockerDriver
 
     return {
         "mock_driver": MockDriver(),
         "raw_exec": RawExecDriver(),
         "exec": ExecDriver(),
+        "java": JavaDriver(),
+        "qemu": QemuDriver(),
+        "docker": DockerDriver(),
     }

@@ -1,0 +1,73 @@
+"""java driver: run JVM workloads.
+
+Reference behavior: drivers/java/driver.go -- fingerprints the host JVM
+(`java -version` parsed into driver.java.version/runtime/vm attributes)
+and launches `java [jvm_options] -jar <jar_path> [args]` (or
+`-cp <class_path> <class>`) under the shared executor, inheriting
+raw_exec's supervision/reattach machinery.
+"""
+
+from __future__ import annotations
+
+import re
+import shutil
+import subprocess
+from typing import Dict, List
+
+from nomad_tpu.drivers.rawexec import RawExecDriver
+from nomad_tpu.plugins.base import PLUGIN_TYPE_DRIVER, PluginInfo
+from nomad_tpu.plugins.drivers import (
+    HEALTH_HEALTHY,
+    HEALTH_UNDETECTED,
+    Fingerprint,
+    TaskConfig,
+)
+
+
+class JavaDriver(RawExecDriver):
+    name = "java"
+
+    def plugin_info(self) -> PluginInfo:
+        return PluginInfo(name=self.name, type=PLUGIN_TYPE_DRIVER)
+
+    def fingerprint(self) -> Fingerprint:
+        java = shutil.which("java")
+        if java is None:
+            return Fingerprint(health=HEALTH_UNDETECTED,
+                               health_description="java not found")
+        attrs = {f"driver.{self.name}": "1"}
+        try:
+            out = subprocess.run(
+                [java, "-version"], capture_output=True, text=True, timeout=10
+            ).stderr
+            m = re.search(r'version "([^"]+)"', out)
+            if m:
+                attrs["driver.java.version"] = m.group(1)
+        except Exception:                       # noqa: BLE001
+            pass
+        return Fingerprint(attributes=attrs, health=HEALTH_HEALTHY,
+                           health_description="Healthy")
+
+    def task_config_schema(self) -> Dict:
+        return {
+            "jar_path": {"type": "string"},
+            "class": {"type": "string"},
+            "class_path": {"type": "string"},
+            "jvm_options": {"type": "list"},
+            "args": {"type": "list"},
+        }
+
+    def _command(self, config: TaskConfig) -> List[str]:
+        cfg = config.driver_config
+        argv: List[str] = ["java"]
+        argv.extend(cfg.get("jvm_options") or [])
+        if cfg.get("jar_path"):
+            argv += ["-jar", cfg["jar_path"]]
+        elif cfg.get("class"):
+            if cfg.get("class_path"):
+                argv += ["-cp", cfg["class_path"]]
+            argv.append(cfg["class"])
+        else:
+            raise ValueError("java driver requires jar_path or class")
+        argv.extend(cfg.get("args") or [])
+        return argv

@@ -1,0 +1,95 @@
+"""qemu driver: virtual machine workloads.
+
+Reference behavior: drivers/qemu/driver.go -- fingerprints the
+`qemu-system-x86_64` binary (driver.qemu.version), then launches the VM
+with `-m <memory>`, the image as the boot drive, `-nographic`, optional
+KVM acceleration, and user-net port forwards from ``port_map``. The VM
+process rides the shared executor for supervision/reattach.
+"""
+
+from __future__ import annotations
+
+import re
+import shutil
+import subprocess
+from typing import Dict, List
+
+from nomad_tpu.drivers.rawexec import RawExecDriver
+from nomad_tpu.plugins.base import PLUGIN_TYPE_DRIVER, PluginInfo
+from nomad_tpu.plugins.drivers import (
+    HEALTH_HEALTHY,
+    HEALTH_UNDETECTED,
+    Fingerprint,
+    TaskConfig,
+)
+
+QEMU_BIN = "qemu-system-x86_64"
+
+
+class QemuDriver(RawExecDriver):
+    name = "qemu"
+
+    def plugin_info(self) -> PluginInfo:
+        return PluginInfo(name=self.name, type=PLUGIN_TYPE_DRIVER)
+
+    def fingerprint(self) -> Fingerprint:
+        qemu = shutil.which(QEMU_BIN)
+        if qemu is None:
+            return Fingerprint(health=HEALTH_UNDETECTED,
+                               health_description=f"{QEMU_BIN} not found")
+        attrs = {f"driver.{self.name}": "1"}
+        try:
+            out = subprocess.run(
+                [qemu, "--version"], capture_output=True, text=True,
+                timeout=10,
+            ).stdout
+            m = re.search(r"version ([\d.]+)", out)
+            if m:
+                attrs["driver.qemu.version"] = m.group(1)
+        except Exception:                       # noqa: BLE001
+            pass
+        return Fingerprint(attributes=attrs, health=HEALTH_HEALTHY,
+                           health_description="Healthy")
+
+    def task_config_schema(self) -> Dict:
+        return {
+            "image_path": {"type": "string", "required": True},
+            "accelerator": {"type": "string"},
+            "memory": {"type": "string"},     # e.g. "512M"
+            "port_map": {"type": "map"},      # {label: guest_port}
+            "args": {"type": "list"},
+        }
+
+    def _command(self, config: TaskConfig) -> List[str]:
+        cfg = config.driver_config
+        image = cfg.get("image_path")
+        if not image:
+            raise ValueError("qemu driver requires image_path")
+        argv: List[str] = [
+            QEMU_BIN,
+            "-machine", f"type=pc,accel={cfg.get('accelerator', 'tcg')}",
+            "-m", str(cfg.get("memory")
+                       or f"{config.resources.memory_mb or 512}M"),
+            "-drive", f"file={image}",
+            "-nographic",
+        ]
+        # user-net port forwards: hostfwd per mapped label
+        port_map = cfg.get("port_map") or {}
+        if port_map:
+            fwds = []
+            for label, guest_port in port_map.items():
+                host_port = 0
+                for net in config.resources.networks:
+                    assigned = net.port_for_label(label)
+                    if assigned:
+                        host_port = assigned
+                        break
+                if host_port:
+                    fwds.append(
+                        f"hostfwd=tcp::{host_port}-:{guest_port}"
+                    )
+            argv += ["-netdev", "user,id=user.0" +
+                     "".join("," + f for f in fwds),
+                     "-device", "virtio-net,netdev=user.0"]
+        argv.extend(cfg.get("args") or [])
+        return argv

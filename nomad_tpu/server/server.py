@@ -616,9 +616,11 @@ class Server:
         remote_accessors = set()
         tok_upserts = []
         for stub in api.acl.tokens():
-            full = api.acl.token(stub["AccessorID"])
-            if not full.get("Global", False):
+            # the list stub carries Global: skip local tokens without a
+            # per-token fetch (they never replicate)
+            if not stub.get("Global", False):
                 continue
+            full = api.acl.token(stub["AccessorID"])
             accessor = full.get("AccessorID", "")
             remote_accessors.add(accessor)
             local = self.state.acl_token_by_accessor(accessor)

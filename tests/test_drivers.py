@@ -1,0 +1,133 @@
+"""java/qemu/docker driver tests.
+
+Modeled on reference drivers/java/driver_test.go,
+drivers/qemu/driver_test.go, drivers/docker/driver_test.go -- command
+construction, config validation, and fingerprint gating (none of the
+three binaries exist in this image, so fingerprints must come back
+undetected and the catalog must still register the drivers).
+"""
+
+import pytest
+
+from nomad_tpu import structs
+from nomad_tpu.client.fingerprint import fingerprint_node
+from nomad_tpu.drivers import builtin_drivers
+from nomad_tpu.drivers.docker import DockerDriver, _container_name
+from nomad_tpu.drivers.java import JavaDriver
+from nomad_tpu.drivers.qemu import QemuDriver
+from nomad_tpu.plugins.drivers import HEALTH_UNDETECTED, TaskConfig
+
+
+def cfg(driver_config, **kw):
+    return TaskConfig(id="t1", name="web", alloc_id="a1-xyz",
+                      driver_config=driver_config,
+                      resources=kw.pop("resources", structs.Resources()),
+                      **kw)
+
+
+class TestCatalog:
+    def test_all_six_registered(self):
+        drivers = builtin_drivers()
+        assert set(drivers) == {"mock_driver", "raw_exec", "exec",
+                                "java", "qemu", "docker"}
+
+    def test_fingerprint_gating_in_node(self):
+        node = fingerprint_node("n1", drivers=builtin_drivers())
+        # binaries absent in this image -> undetected, never placed on
+        assert not node.drivers["java"].detected
+        assert not node.drivers["qemu"].detected
+        assert not node.drivers["docker"].detected
+        assert node.drivers["raw_exec"].detected
+
+
+class TestJava:
+    def test_fingerprint_gated(self):
+        assert JavaDriver().fingerprint().health == HEALTH_UNDETECTED
+
+    def test_jar_command(self):
+        argv = JavaDriver()._command(cfg({
+            "jar_path": "/opt/app.jar",
+            "jvm_options": ["-Xmx512m"],
+            "args": ["serve"],
+        }))
+        assert argv == ["java", "-Xmx512m", "-jar", "/opt/app.jar", "serve"]
+
+    def test_class_command(self):
+        argv = JavaDriver()._command(cfg({
+            "class": "com.example.Main", "class_path": "/opt/lib",
+        }))
+        assert argv == ["java", "-cp", "/opt/lib", "com.example.Main"]
+
+    def test_requires_jar_or_class(self):
+        with pytest.raises(ValueError):
+            JavaDriver()._command(cfg({}))
+
+
+class TestQemu:
+    def test_fingerprint_gated(self):
+        assert QemuDriver().fingerprint().health == HEALTH_UNDETECTED
+
+    def test_command(self):
+        res = structs.Resources(memory_mb=1024)
+        argv = QemuDriver()._command(cfg({"image_path": "/img/linux.img"},
+                                         resources=res))
+        assert argv[0] == "qemu-system-x86_64"
+        assert "-nographic" in argv
+        assert "file=/img/linux.img" in argv
+        assert "1024M" in argv
+
+    def test_port_forwards(self):
+        res = structs.Resources(
+            memory_mb=512,
+            networks=[structs.NetworkResource(
+                reserved_ports=[structs.Port(label="ssh", value=2222)],
+            )],
+        )
+        argv = QemuDriver()._command(cfg({
+            "image_path": "/img/linux.img",
+            "port_map": {"ssh": 22},
+        }, resources=res))
+        netdev = argv[argv.index("-netdev") + 1]
+        assert "hostfwd=tcp::2222-:22" in netdev
+
+    def test_requires_image(self):
+        with pytest.raises(ValueError):
+            QemuDriver()._command(cfg({}))
+
+
+class TestDocker:
+    def test_fingerprint_gated(self):
+        assert DockerDriver().fingerprint().health == HEALTH_UNDETECTED
+
+    def test_command(self):
+        res = structs.Resources(cpu=500, memory_mb=256)
+        argv = DockerDriver()._command(cfg(
+            {"image": "redis:7", "command": "redis-server",
+             "args": ["--appendonly", "yes"]},
+            env={"FOO": "bar"}, resources=res,
+        ))
+        assert argv[:3] == ["docker", "run", "--rm"]
+        assert "--memory" in argv and "256m" in argv
+        assert "--cpu-shares" in argv and "500" in argv
+        assert "-e" in argv and "FOO=bar" in argv
+        assert argv[argv.index("redis:7"):] == \
+            ["redis:7", "redis-server", "--appendonly", "yes"]
+
+    def test_port_publish(self):
+        res = structs.Resources(networks=[structs.NetworkResource(
+            dynamic_ports=[structs.Port(label="http", value=20001, to=8080)],
+        )])
+        argv = DockerDriver()._command(cfg(
+            {"image": "nginx", "ports": ["http"]}, resources=res,
+        ))
+        assert "-p" in argv
+        assert "20001:8080" in argv
+
+    def test_container_name_stable(self):
+        c = cfg({"image": "nginx"})
+        assert _container_name(c) == "nomad-web-a1-xyz"[:len(_container_name(c))]
+        assert _container_name(c).startswith("nomad-web-")
+
+    def test_requires_image(self):
+        with pytest.raises(ValueError):
+            DockerDriver()._command(cfg({}))
