@@ -97,6 +97,10 @@ class Agent:
                 # standalone server is immediately the authority
                 self.server.establish_leadership()
         if self.client is not None:
+            # advertise this agent's HTTP address on the node so
+            # servers can pass /v1/client/* requests through
+            # (client.go HTTPAddr -> Node.HTTPAddr)
+            self.client.node.http_addr = self.http.addr
             self.client.start()
         self.http.start()
 

@@ -251,6 +251,55 @@ class Allocations(_Endpoint):
     def info(self, alloc_id: str, q: Optional[QueryOptions] = None) -> Dict:
         return self.c.get(f"/v1/allocation/{_esc(alloc_id)}", q)
 
+    def stats(self, alloc_id: str, q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.get(f"/v1/client/allocation/{_esc(alloc_id)}/stats", q)
+
+    def restart(self, alloc_id: str, task: str = "",
+                q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/client/allocation/{_esc(alloc_id)}/restart",
+                           {"TaskName": task}, q)
+
+    def signal(self, alloc_id: str, signal: str, task: str = "",
+               q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/client/allocation/{_esc(alloc_id)}/signal",
+                           {"Signal": signal, "TaskName": task}, q)
+
+    def exec(self, alloc_id: str, task: str, cmd: List[str],
+             q: Optional[QueryOptions] = None) -> Dict:
+        return self.c.post(f"/v1/client/allocation/{_esc(alloc_id)}/exec",
+                           {"Task": task, "Cmd": cmd}, q)
+
+    def logs(self, alloc_id: str, task: str, logtype: str = "stdout",
+             offset: int = 0, limit: int = 0,
+             q: Optional[QueryOptions] = None) -> str:
+        q = q or QueryOptions()
+        q.params.update({"task": task, "type": logtype})
+        if offset:
+            q.params["offset"] = str(offset)
+        if limit:
+            q.params["limit"] = str(limit)
+        resp = self.c.get(f"/v1/client/fs/logs/{_esc(alloc_id)}", q)
+        return resp.get("Data", "")
+
+    def fs_ls(self, alloc_id: str, path: str = "/",
+              q: Optional[QueryOptions] = None) -> List[Dict]:
+        q = q or QueryOptions()
+        q.params["path"] = path
+        return self.c.get(f"/v1/client/fs/ls/{_esc(alloc_id)}", q)
+
+    def fs_stat(self, alloc_id: str, path: str,
+                q: Optional[QueryOptions] = None) -> Dict:
+        q = q or QueryOptions()
+        q.params["path"] = path
+        return self.c.get(f"/v1/client/fs/stat/{_esc(alloc_id)}", q)
+
+    def fs_cat(self, alloc_id: str, path: str,
+               q: Optional[QueryOptions] = None) -> str:
+        q = q or QueryOptions()
+        q.params["path"] = path
+        resp = self.c.get(f"/v1/client/fs/cat/{_esc(alloc_id)}", q)
+        return resp.get("Data", "")
+
     def stop(self, alloc_id: str, q: Optional[QueryOptions] = None) -> Dict:
         return self.c.post(f"/v1/allocation/{_esc(alloc_id)}/stop", {}, q)
 

@@ -17,7 +17,9 @@ import json
 import re
 import threading
 import time
+import urllib.error
 import urllib.parse
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -171,6 +173,16 @@ class HTTPAgent:
                                      token, raw_body)
             return
 
+        # server->node pass-through (rpc.go:708 NodeStreamingRpc /
+        # nodeConns): proxy /v1/client/* to the HTTP agent on the
+        # allocation's node when the alloc doesn't run locally (covers
+        # server-only agents AND combined agents asked about another
+        # node's alloc)
+        if path.startswith("/v1/client/") and self.agent.server is not None \
+                and not self._alloc_is_local(parsed):
+            self._forward_client(handler, method, parsed, token, raw_body)
+            return
+
         for route_method, pattern, fn in self._routes:
             if route_method != method:
                 continue
@@ -208,9 +220,6 @@ class HTTPAgent:
                         parsed, token: str, raw_body: bytes) -> None:
         """Proxy the request to the named region's server verbatim
         (minus the region param, so it doesn't loop)."""
-        import urllib.error
-        import urllib.request
-
         addr = self.agent.server.region_addr(region)
         if addr is None:
             self._send(handler, 400, {"error": f"No path to region {region}"})
@@ -220,43 +229,103 @@ class HTTPAgent:
         url = addr + parsed.path
         if pairs:
             url += "?" + urllib.parse.urlencode(pairs)
-        req = urllib.request.Request(url, data=raw_body or None,
-                                     method=method)
-        req.add_header("Content-Type", "application/json")
-        if token:
-            req.add_header("X-Nomad-Token", token)
         # outlive the remote's blocking-query hold (default 300s,
         # capped at 600s server-side) plus slack
         wait = dict(pairs).get("wait", "")
         hold = parse_duration(wait) if wait else 300.0
         fwd_timeout = min(hold if hold is not None else 300.0, 600.0) + 10.0
+        if parsed.path == "/v1/event/stream":
+            # infinite NDJSON: relay line by line instead of buffering
+            # an unbounded body
+            req = urllib.request.Request(url, method=method)
+            if token:
+                req.add_header("X-Nomad-Token", token)
+            try:
+                with urllib.request.urlopen(req, timeout=fwd_timeout) as resp:
+                    self._relay_stream(handler, resp)
+            except (OSError, ValueError, urllib.error.HTTPError) as e:
+                self._send(handler, 502,
+                           {"error": f"region {region} unreachable: {e}"})
+            return
+        self._proxy(handler, method, url, token, raw_body,
+                    timeout=fwd_timeout, unreachable=f"region {region}")
+
+    _CLIENT_PATH_RE = re.compile(
+        r"/v1/client/(?:allocation|fs/[a-z]+)/(?P<id>[^/?]+)"
+    )
+
+    def _client_path_alloc_id(self, parsed) -> str:
+        m = self._CLIENT_PATH_RE.match(parsed.path)
+        return urllib.parse.unquote(m.group("id")) if m else ""
+
+    def _alloc_is_local(self, parsed) -> bool:
+        """Does this agent's client run the alloc the path names?"""
+        if self.agent.client is None:
+            return False
+        alloc_id = self._client_path_alloc_id(parsed)
+        if not alloc_id:
+            return True   # non-alloc client routes (e.g. /v1/client/stats)
+        return self.agent.client.alloc_runner(alloc_id) is not None
+
+    def _proxy(self, handler, method: str, url: str, token: str,
+               raw_body: bytes, timeout: float = 60.0,
+               unreachable: str = "upstream") -> None:
+        """Shared HTTP proxy plumbing (region + node forwarding)."""
+        req = urllib.request.Request(url, data=raw_body or None,
+                                     method=method)
+        req.add_header("Content-Type", "application/json")
+        if token:
+            req.add_header("X-Nomad-Token", token)
         remote_index = None
         try:
-            with urllib.request.urlopen(req, timeout=fwd_timeout) as resp:
-                if parsed.path == "/v1/event/stream":
-                    # infinite NDJSON: relay line by line instead of
-                    # buffering an unbounded body
-                    self._relay_stream(handler, resp)
-                    return
-                raw = resp.read()
-                status = resp.status
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                raw, status = resp.read(), resp.status
                 remote_index = resp.headers.get("X-Nomad-Index")
         except urllib.error.HTTPError as e:
-            raw = e.read()
-            status = e.code
+            raw, status = e.read(), e.code
             remote_index = e.headers.get("X-Nomad-Index")
         except (OSError, ValueError) as e:
-            # ValueError: malformed registered address (bad scheme)
             self._send(handler, 502,
-                       {"error": f"region {region} unreachable: {e}"})
+                       {"error": f"{unreachable} unreachable: {e}"})
             return
         try:
             payload = json.loads(raw) if raw else None
         except json.JSONDecodeError:
-            # a success code with an unparseable body must not reach the
-            # caller looking like data
             status, payload = 502, {"error": "bad upstream response"}
         self._send(handler, status, payload, index=remote_index)
+
+    def _forward_client(self, handler, method, parsed, token,
+                        raw_body) -> None:
+        """Resolve the alloc's node and proxy the request there."""
+        snap = self.agent.server.state.snapshot()
+        node = None
+        alloc_id = self._client_path_alloc_id(parsed)
+        if alloc_id:
+            alloc = snap.alloc_by_id(alloc_id)
+            if alloc is None:
+                self._send(handler, 404, {"error": "unknown allocation"})
+                return
+            node = snap.node_by_id(alloc.node_id)
+        else:
+            node_id = (urllib.parse.parse_qs(parsed.query)
+                       .get("node_id") or [""])[0]
+            if node_id:
+                node = snap.node_by_id(node_id)
+        if node is None or not getattr(node, "http_addr", ""):
+            self._send(handler, 404,
+                       {"error": "no client agent reachable for request"})
+            return
+        if node.http_addr == self.addr:
+            # the alloc is assigned here but its runner hasn't started
+            # yet; proxying to ourselves would loop
+            self._send(handler, 404,
+                       {"error": "allocation not yet running on node"})
+            return
+        url = node.http_addr + parsed.path
+        if parsed.query:
+            url += "?" + parsed.query
+        self._proxy(handler, method, url, token, raw_body,
+                    unreachable="node")
 
     def _relay_stream(self, handler, resp) -> None:
         """Pipe a remote NDJSON stream to the client as it arrives."""
@@ -485,8 +554,17 @@ class HTTPAgent:
 
         # client (stats/fs) routes
         add("GET", r"/v1/client/allocation/(?P<id>[^/]+)/stats", self.client_alloc_stats)
+        add("POST", r"/v1/client/allocation/(?P<id>[^/]+)/restart", self.client_alloc_restart)
+        add("PUT", r"/v1/client/allocation/(?P<id>[^/]+)/restart", self.client_alloc_restart)
+        add("POST", r"/v1/client/allocation/(?P<id>[^/]+)/signal", self.client_alloc_signal)
+        add("PUT", r"/v1/client/allocation/(?P<id>[^/]+)/signal", self.client_alloc_signal)
+        add("POST", r"/v1/client/allocation/(?P<id>[^/]+)/exec", self.client_alloc_exec)
+        add("PUT", r"/v1/client/allocation/(?P<id>[^/]+)/exec", self.client_alloc_exec)
         add("GET", r"/v1/client/fs/logs/(?P<id>[^/]+)", self.client_fs_logs)
         add("GET", r"/v1/client/fs/ls/(?P<id>[^/]+)", self.client_fs_ls)
+        add("GET", r"/v1/client/fs/stat/(?P<id>[^/]+)", self.client_fs_stat)
+        add("GET", r"/v1/client/fs/cat/(?P<id>[^/]+)", self.client_fs_cat)
+        add("GET", r"/v1/client/fs/readat/(?P<id>[^/]+)", self.client_fs_readat)
         add("GET", r"/v1/client/stats", self.client_stats)
 
     # -- job handlers ----------------------------------------------------
@@ -1460,29 +1538,116 @@ class HTTPAgent:
         return c
 
     def client_alloc_stats(self, req: Request):
-        runner = self._client.alloc_runner(req.params["id"])
-        if runner is None:
-            raise HTTPError(404, "unknown allocation")
-        return runner.stats() if hasattr(runner, "stats") else {}
+        return self._runner(req, "read-job").stats()
 
     def client_fs_logs(self, req: Request):
-        runner = self._client.alloc_runner(req.params["id"])
-        if runner is None:
-            raise HTTPError(404, "unknown allocation")
+        runner = self._runner(req, "read-logs")
         task = req.q("task")
         logtype = req.q("type", "stdout")
-        logs = runner.task_logs(task, logtype) if hasattr(runner, "task_logs") else ""
+        try:
+            logs = runner.task_logs(
+                task, logtype,
+                offset=int(req.q("offset", "0") or 0),
+                limit=int(req.q("limit", "0") or 0),
+            )
+        except PermissionError as e:
+            raise HTTPError(403, str(e))
         return {"Data": logs}
 
     def client_fs_ls(self, req: Request):
-        runner = self._client.alloc_runner(req.params["id"])
-        if runner is None:
-            raise HTTPError(404, "unknown allocation")
-        entries = runner.list_dir(req.q("path", "/")) if hasattr(runner, "list_dir") else []
-        return entries
+        try:
+            return self._runner(req, "read-fs").list_dir(req.q("path", "/"))
+        except FileNotFoundError:
+            raise HTTPError(404, "path not found")
+        except PermissionError as e:
+            raise HTTPError(403, str(e))
 
     def client_stats(self, req: Request):
         return self._client.stats()
+
+    def _runner(self, req: Request, capability: str = ""):
+        """Resolve the local runner; ACL-check against the alloc's REAL
+        namespace (the query param is caller-controlled)."""
+        runner = self._client.alloc_runner(req.params["id"])
+        if runner is None:
+            raise HTTPError(404, "unknown allocation")
+        if capability:
+            self._acl(req, "allow_ns_op", runner.alloc.namespace, capability)
+        return runner
+
+    def client_alloc_restart(self, req: Request):
+        body = req.body or {}
+        try:
+            self._runner(req, "alloc-lifecycle").restart_tasks(
+                body.get("TaskName", "")
+            )
+        except KeyError as e:
+            raise HTTPError(404, str(e))
+        return {}
+
+    def client_alloc_signal(self, req: Request):
+        body = req.body or {}
+        try:
+            self._runner(req, "alloc-lifecycle").signal_tasks(
+                body.get("Signal", "SIGTERM"), body.get("TaskName", "")
+            )
+        except KeyError as e:
+            raise HTTPError(404, str(e))
+        return {}
+
+    def client_alloc_exec(self, req: Request):
+        """One-shot exec (the reference is an interactive websocket;
+        this returns captured output)."""
+        body = req.body or {}
+        task = body.get("Task", "")
+        cmd = body.get("Cmd") or []
+        if not task or not cmd:
+            raise HTTPError(400, "Task and Cmd are required")
+        try:
+            out = self._runner(req, "alloc-exec").exec_in_task(task, cmd)
+        except KeyError as e:
+            raise HTTPError(404, str(e))
+        except NotImplementedError as e:
+            raise HTTPError(400, str(e))
+        for k in ("stdout", "stderr"):
+            if isinstance(out.get(k), bytes):
+                out[k] = out[k].decode(errors="replace")
+        return out
+
+    def client_fs_stat(self, req: Request):
+        try:
+            return self._runner(req, "read-fs").stat_file(req.q("path", "/"))
+        except FileNotFoundError:
+            raise HTTPError(404, "file not found")
+        except PermissionError as e:
+            raise HTTPError(403, str(e))
+
+    def client_fs_cat(self, req: Request):
+        try:
+            data = self._runner(req, "read-fs").cat_file(req.q("path", "/"))
+        except FileNotFoundError:
+            raise HTTPError(404, "file not found")
+        except IsADirectoryError:
+            raise HTTPError(400, "path is a directory")
+        except PermissionError as e:
+            raise HTTPError(403, str(e))
+        return {"Data": data.decode(errors="replace")}
+
+    def client_fs_readat(self, req: Request):
+        try:
+            data = self._runner(req, "read-fs").cat_file(
+                req.q("path", "/"),
+                offset=int(req.q("offset", "0") or 0),
+                limit=int(req.q("limit", "0") or 0),
+            )
+        except FileNotFoundError:
+            raise HTTPError(404, "file not found")
+        except IsADirectoryError:
+            raise HTTPError(400, "path is a directory")
+        except PermissionError as e:
+            raise HTTPError(403, str(e))
+        return {"Data": data.decode(errors="replace"),
+                "Offset": int(req.q("offset", "0") or 0)}
 
 
 class StreamedResponse:

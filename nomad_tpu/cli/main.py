@@ -394,6 +394,50 @@ def cmd_alloc_stop(args) -> int:
     return 0
 
 
+def cmd_alloc_logs(args) -> int:
+    api = make_client(args)
+    logtype = "stderr" if args.stderr else "stdout"
+    print(api.allocations.logs(args.alloc_id, args.task, logtype), end="")
+    return 0
+
+
+def cmd_alloc_restart(args) -> int:
+    api = make_client(args)
+    api.allocations.restart(args.alloc_id, args.task or "")
+    print(f"Restarted allocation \"{args.alloc_id}\"")
+    return 0
+
+
+def cmd_alloc_signal(args) -> int:
+    api = make_client(args)
+    api.allocations.signal(args.alloc_id, args.signal, args.task or "")
+    print(f"Signalled allocation \"{args.alloc_id}\"")
+    return 0
+
+
+def cmd_alloc_exec(args) -> int:
+    api = make_client(args)
+    out = api.allocations.exec(args.alloc_id, args.task, args.cmd)
+    if out.get("stdout"):
+        print(out["stdout"], end="")
+    if out.get("stderr"):
+        import sys as _sys
+        print(out["stderr"], end="", file=_sys.stderr)
+    return int(out.get("exit_code", 0) or 0)
+
+
+def cmd_alloc_fs(args) -> int:
+    api = make_client(args)
+    path = args.path or "/"
+    stat = api.allocations.fs_stat(args.alloc_id, path)
+    if stat.get("IsDir"):
+        entries = api.allocations.fs_ls(args.alloc_id, path)
+        print(dict_rows(entries, ["Name", "Size", "IsDir"]))
+    else:
+        print(api.allocations.fs_cat(args.alloc_id, path), end="")
+    return 0
+
+
 def cmd_eval_list(args) -> int:
     api = make_client(args)
     evals = api.evaluations.list()
@@ -991,6 +1035,29 @@ def build_parser() -> argparse.ArgumentParser:
     alst.add_argument("alloc_id")
     alst.add_argument("-detach", action="store_true")
     alst.set_defaults(fn=cmd_alloc_stop)
+    alog = alloc.add_parser("logs")
+    alog.add_argument("alloc_id")
+    alog.add_argument("task")
+    alog.add_argument("-stderr", action="store_true")
+    alog.set_defaults(fn=cmd_alloc_logs)
+    ares = alloc.add_parser("restart")
+    ares.add_argument("alloc_id")
+    ares.add_argument("task", nargs="?", default="")
+    ares.set_defaults(fn=cmd_alloc_restart)
+    asig = alloc.add_parser("signal")
+    asig.add_argument("-s", dest="signal", default="SIGTERM")
+    asig.add_argument("alloc_id")
+    asig.add_argument("task", nargs="?", default="")
+    asig.set_defaults(fn=cmd_alloc_signal)
+    aex = alloc.add_parser("exec")
+    aex.add_argument("-task", required=True)
+    aex.add_argument("alloc_id")
+    aex.add_argument("cmd", nargs="+")
+    aex.set_defaults(fn=cmd_alloc_exec)
+    afs = alloc.add_parser("fs")
+    afs.add_argument("alloc_id")
+    afs.add_argument("path", nargs="?", default="/")
+    afs.set_defaults(fn=cmd_alloc_fs)
 
     # eval
     ev = sub.add_parser("eval", help="eval commands").add_subparsers(

@@ -302,6 +302,115 @@ class AllocRunner:
         with self._lock:
             return self._client_status_locked()[0]
 
+    # --- filesystem + stats API (client fs_endpoint.go /
+    #     alloc_endpoint.go surfaces) ------------------------------------
+
+    def _safe_path(self, rel: str) -> str:
+        """Confine API paths to the alloc dir (helper/escapingfs); task
+        secrets dirs are never readable over the fs API
+        (fs_endpoint.go denies SecretsDir)."""
+        rel = rel.lstrip("/")
+        full = os.path.realpath(os.path.join(self.alloc_dir, rel))
+        root = os.path.realpath(self.alloc_dir)
+        if not (full == root or full.startswith(root + os.sep)):
+            raise PermissionError(f"path escapes allocation directory: {rel}")
+        parts = os.path.relpath(full, root).split(os.sep)
+        if "secrets" in parts:
+            raise PermissionError("secrets directories are not accessible")
+        return full
+
+    def task_logs(self, task: str, logtype: str = "stdout",
+                  offset: int = 0, limit: int = 0) -> str:
+        """fs_endpoint.go Logs (non-follow read)."""
+        path = self._safe_path(
+            os.path.join("alloc", "logs", f"{task}.{logtype}.0")
+        )
+        if not os.path.exists(path):
+            return ""
+        with open(path, "rb") as f:
+            if offset:
+                f.seek(offset)
+            data = f.read(limit or -1)
+        return data.decode(errors="replace")
+
+    def list_dir(self, rel: str = "/") -> List[Dict]:
+        """fs_endpoint.go List."""
+        path = self._safe_path(rel)
+        if not os.path.isdir(path):
+            raise FileNotFoundError(rel)
+        out = []
+        for name in sorted(os.listdir(path)):
+            st = os.stat(os.path.join(path, name))
+            out.append({
+                "Name": name,
+                "IsDir": os.path.isdir(os.path.join(path, name)),
+                "Size": st.st_size,
+                "ModTime": st.st_mtime,
+            })
+        return out
+
+    def stat_file(self, rel: str) -> Dict:
+        """fs_endpoint.go Stat."""
+        path = self._safe_path(rel)
+        if not os.path.exists(path):
+            raise FileNotFoundError(rel)
+        st = os.stat(path)
+        return {
+            "Name": os.path.basename(path) or "/",
+            "IsDir": os.path.isdir(path),
+            "Size": st.st_size,
+            "ModTime": st.st_mtime,
+        }
+
+    def cat_file(self, rel: str, offset: int = 0, limit: int = 0) -> bytes:
+        """fs_endpoint.go Cat/ReadAt."""
+        path = self._safe_path(rel)
+        if os.path.isdir(path):
+            raise IsADirectoryError(rel)
+        with open(path, "rb") as f:
+            if offset:
+                f.seek(offset)
+            return f.read(limit or -1)
+
+    def stats(self) -> Dict:
+        """Per-task resource usage (AllocStats / TaskStats)."""
+        tasks = {}
+        for name, tr in self.task_runners.items():
+            try:
+                tasks[name] = tr.driver.task_stats(tr.task_id)
+            except Exception:                   # noqa: BLE001
+                tasks[name] = {}
+        return {"Tasks": tasks}
+
+    def restart_tasks(self, task_name: str = "") -> None:
+        """alloc_endpoint.go Restart: bounce task(s) in place."""
+        if task_name and task_name not in self.task_runners:
+            raise KeyError(f"unknown task {task_name}")
+        for name, tr in self.task_runners.items():
+            if task_name and name != task_name:
+                continue
+            tr.restart("restart requested by user")
+
+    def signal_tasks(self, signal: str, task_name: str = "") -> None:
+        """alloc_endpoint.go Signal."""
+        if task_name and task_name not in self.task_runners:
+            raise KeyError(f"unknown task {task_name}")
+        for name, tr in self.task_runners.items():
+            if task_name and name != task_name:
+                continue
+            try:
+                tr.driver.signal_task(tr.task_id, signal)
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("signal %s to %s: %s", signal, name, e)
+
+    def exec_in_task(self, task_name: str, cmd: List[str],
+                     timeout: float = 30.0) -> Dict:
+        """alloc_endpoint.go Exec (non-interactive one-shot)."""
+        tr = self.task_runners.get(task_name)
+        if tr is None:
+            raise KeyError(f"unknown task {task_name}")
+        return tr.driver.exec_task(tr.task_id, cmd, timeout=timeout)
+
     # --- updates / teardown ---------------------------------------------
 
     def update(self, alloc: Allocation) -> None:

@@ -96,6 +96,9 @@ class TaskRunner:
         job_type = alloc.job.type if alloc.job is not None else consts.JOB_TYPE_SERVICE
         self.restart_tracker = RestartTracker(policy, job_type)
         self._kill = threading.Event()
+        # user-requested restart (alloc_endpoint.go Restart): bounces
+        # the task without counting against the restart policy
+        self._restart = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._kill_reason = ""
@@ -175,7 +178,8 @@ class TaskRunner:
             self._emit(EVENT_STARTED)
 
             result = None
-            while result is None and not self._kill.is_set():
+            while result is None and not self._kill.is_set() \
+                    and not self._restart.is_set():
                 try:
                     result = self.driver.wait_task(self.task_id, timeout=0.25)
                 except KeyError:
@@ -185,6 +189,21 @@ class TaskRunner:
             if self._kill.is_set():
                 self._handle_kill()
                 break
+            if self._restart.is_set():
+                # user restart wins even if the task happened to exit in
+                # the same poll window -- the caller was promised a
+                # bounce, not policy-driven exit handling
+                self._restart.clear()
+                self._emit(EVENT_RESTARTING, "user requested restart")
+                try:
+                    self.driver.stop_task(
+                        self.task_id, timeout=self.task.kill_timeout_s,
+                        signal=self.task.kill_signal or "SIGTERM",
+                    )
+                    self.driver.destroy_task(self.task_id, force=True)
+                except Exception:               # noqa: BLE001
+                    pass
+                continue
             success = result.successful()
             self._emit(
                 EVENT_TERMINATED,
@@ -297,6 +316,10 @@ class TaskRunner:
             self._emit(EVENT_TERMINATED, f"exit code {result.exit_code}")
             self._set_state(STATE_DEAD, failed=not result.successful())
         self._done.set()
+
+    def restart(self, reason: str = "") -> None:
+        """Bounce the running task (alloc_endpoint.go Restart)."""
+        self._restart.set()
 
     def kill(self, reason: str = "") -> None:
         self._kill_reason = reason
