@@ -119,6 +119,25 @@ class APIClient:
                 msg = str(e)
             raise APIError(e.code, msg) from None
 
+    def stream(self, path: str, params: Optional[List[tuple]] = None,
+               timeout: float = 60.0) -> Iterator[Any]:
+        """Yield parsed NDJSON frames from a chunked streaming endpoint
+        (/v1/event/stream, /v1/agent/monitor), skipping empty
+        keepalive frames."""
+        url = f"{self.address}{path}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(
+            url,
+            headers={"X-Nomad-Token": self.token} if self.token else {},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line or line == b"{}":
+                    continue
+                yield json.loads(line)
+
     def get(self, path: str, q: Optional[QueryOptions] = None) -> Any:
         return self.request("GET", path, None, q)
 
@@ -391,6 +410,28 @@ class AgentAPI(_Endpoint):
     def metrics(self) -> Dict:
         return self.c.get("/v1/metrics")
 
+    _PPROF_PROFILES = ("goroutine", "profile", "heap")
+
+    def pprof(self, profile: str = "goroutine", seconds: int = 1) -> str:
+        if profile not in self._PPROF_PROFILES:
+            raise ValueError(
+                f"unsupported profile {profile!r}; "
+                f"one of {', '.join(self._PPROF_PROFILES)}"
+            )
+        q = QueryOptions()
+        if profile == "profile":
+            q.params["seconds"] = str(seconds)
+        return self.c.get(f"/v1/agent/pprof/{_esc(profile)}",
+                          q).get("Profile", "")
+
+    def monitor(self, log_level: str = "info",
+                timeout: float = 60.0) -> Iterator[str]:
+        """Yield live log lines from /v1/agent/monitor."""
+        for payload in self.c.stream("/v1/agent/monitor",
+                                     [("log_level", log_level)], timeout):
+            if payload.get("Data"):
+                yield payload["Data"]
+
 
 class Search(_Endpoint):
     def prefix(self, prefix: str, context: str = "all",
@@ -549,14 +590,4 @@ class Events(_Endpoint):
                 params.append(("topic", f"{topic}:{key}"))
         if index:
             params.append(("index", str(index)))
-        qs = urllib.parse.urlencode(params)
-        req = urllib.request.Request(
-            f"{self.c.address}/v1/event/stream?{qs}",
-            headers={"X-Nomad-Token": self.c.token} if self.c.token else {},
-        )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            for line in resp:
-                line = line.strip()
-                if not line or line == b"{}":
-                    continue
-                yield json.loads(line)
+        yield from self.c.stream("/v1/event/stream", params, timeout)

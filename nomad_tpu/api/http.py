@@ -216,6 +216,10 @@ class HTTPAgent:
             return
         self._send(handler, 404, {"error": f"no handler for {method} {path}"})
 
+    # endpoints whose responses never end; forwarding must relay
+    # them incrementally rather than buffer the body
+    _STREAMING_PATHS = frozenset({"/v1/event/stream", "/v1/agent/monitor"})
+
     def _forward_region(self, handler, method: str, region: str,
                         parsed, token: str, raw_body: bytes) -> None:
         """Proxy the request to the named region's server verbatim
@@ -234,7 +238,7 @@ class HTTPAgent:
         wait = dict(pairs).get("wait", "")
         hold = parse_duration(wait) if wait else 300.0
         fwd_timeout = min(hold if hold is not None else 300.0, 600.0) + 10.0
-        if parsed.path == "/v1/event/stream":
+        if parsed.path in self._STREAMING_PATHS:
             # infinite NDJSON: relay line by line instead of buffering
             # an unbounded body
             req = urllib.request.Request(url, method=method)
@@ -464,6 +468,10 @@ class HTTPAgent:
         add("GET", r"/v1/agent/members", self.agent_members)
         add("PUT", r"/v1/agent/join", self.agent_join)
         add("POST", r"/v1/agent/join", self.agent_join)
+        add("GET", r"/v1/agent/monitor", self.agent_monitor)
+        add("GET", r"/v1/agent/pprof/goroutine", self.pprof_goroutine)
+        add("GET", r"/v1/agent/pprof/profile", self.pprof_profile)
+        add("GET", r"/v1/agent/pprof/heap", self.pprof_heap)
         add("GET", r"/v1/agent/servers", self.agent_servers)
         add("GET", r"/v1/metrics", self.metrics)
         add("GET", r"/v1/operator/scheduler/configuration", self.sched_config_get)
@@ -983,6 +991,66 @@ class HTTPAgent:
         self._server.join_region(region, addr)
         return {"num_joined": 1}
 
+    @staticmethod
+    def _begin_chunked(h):
+        """Start a chunked NDJSON response; returns the frame writer."""
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def write_chunk(payload: bytes) -> None:
+            h.wfile.write(f"{len(payload):x}\r\n".encode())
+            h.wfile.write(payload + b"\r\n")
+            h.wfile.flush()
+
+        return write_chunk
+
+    def agent_monitor(self, req: Request):
+        """GET /v1/agent/monitor?log_level=X: stream agent logs as
+        NDJSON frames (monitor.go / ndjson streaming)."""
+        from nomad_tpu.utils.monitor import LogMonitor
+
+        self._acl(req, "allow_agent_read")
+        level = req.q("log_level", "info")
+        mon = LogMonitor.install()
+        h = req.handler
+        deadline = time.time() + 600.0
+        stop = threading.Event()
+        try:
+            write_chunk = self._begin_chunked(h)
+            for line in mon.stream(level, stop):
+                if time.time() > deadline:
+                    stop.set()
+                    break
+                obj = {"Data": line} if line else {}
+                write_chunk(json.dumps(obj).encode() + b"\n")
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            stop.set()
+        return StreamedResponse
+
+    def pprof_goroutine(self, req: Request):
+        from nomad_tpu.utils.monitor import thread_dump
+
+        self._acl(req, "allow_agent_read")
+        return {"Profile": thread_dump()}
+
+    def pprof_profile(self, req: Request):
+        from nomad_tpu.utils.monitor import sample_profile
+
+        self._acl(req, "allow_agent_read")
+        seconds = min(float(req.q("seconds", "1") or 1), 30.0)
+        return {"Profile": sample_profile(seconds)}
+
+    def pprof_heap(self, req: Request):
+        from nomad_tpu.utils.monitor import heap_summary
+
+        self._acl(req, "allow_agent_read")
+        return {"Profile": heap_summary()}
+
     def agent_members(self, req: Request):
         members = getattr(self.agent, "members", None)
         if members is not None:
@@ -1372,16 +1440,7 @@ class HTTPAgent:
         sub = broker.subscribe(topics or {"*": ["*"]}, from_index=index)
         h = req.handler
         try:
-            h.send_response(200)
-            h.send_header("Content-Type", "application/json")
-            h.send_header("Transfer-Encoding", "chunked")
-            h.end_headers()
-
-            def write_chunk(payload: bytes) -> None:
-                h.wfile.write(f"{len(payload):x}\r\n".encode())
-                h.wfile.write(payload + b"\r\n")
-                h.wfile.flush()
-
+            write_chunk = self._begin_chunked(h)
             deadline = time.time() + 600
             while time.time() < deadline:
                 events = sub.next_events(timeout=5.0)

@@ -828,6 +828,59 @@ def cmd_operator_autopilot_health(args) -> int:
     return 0
 
 
+def cmd_monitor(args) -> int:
+    api = make_client(args)
+    try:
+        for line in api.agent.monitor(log_level=args.log_level):
+            print(line)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_operator_debug(args) -> int:
+    """operator_debug.go: capture a support bundle."""
+    import json as _json
+    import tarfile
+    import io
+    import time as _time
+
+    api = make_client(args)
+    captures = {
+        "agent-self.json": lambda: api.agent.self(),
+        "agent-health.json": lambda: api.agent.health(),
+        "agent-members.json": lambda: api.agent.members(),
+        "metrics.json": lambda: api.agent.metrics(),
+        "nodes.json": lambda: api.nodes.list(),
+        "regions.json": lambda: api.get("/v1/regions"),
+        "operator-raft.json": lambda: api.operator.raft_configuration(),
+        "operator-autopilot-health.json":
+            lambda: api.operator.autopilot_health(),
+        "operator-scheduler-config.json":
+            lambda: api.operator.scheduler_config(),
+        "pprof-goroutine.txt": lambda: api.agent.pprof("goroutine"),
+        "pprof-heap.txt": lambda: api.agent.pprof("heap"),
+        "pprof-profile.txt":
+            lambda: api.agent.pprof("profile", seconds=args.seconds),
+    }
+    out = args.output or f"nomad-debug-{int(_time.time())}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        for name, fn in captures.items():
+            try:
+                payload = fn()
+            except Exception as e:              # noqa: BLE001
+                payload = {"error": str(e)}
+            data = (payload if isinstance(payload, str)
+                    else _json.dumps(payload, indent=2, default=str)).encode()
+            info = tarfile.TarInfo(name=name)
+            info.size = len(data)
+            info.mtime = int(_time.time())
+            tar.addfile(info, io.BytesIO(data))
+            print(f"  captured {name}")
+    print(f"Created debug archive: {out}")
+    return 0
+
+
 def cmd_operator_raft_list(args) -> int:
     api = make_client(args)
     cfg = api.operator.raft_configuration()
@@ -1209,6 +1262,15 @@ def build_parser() -> argparse.ArgumentParser:
                                                  required=True)
     orl = oraft.add_parser("list-peers")
     orl.set_defaults(fn=cmd_operator_raft_list)
+    odbg = op.add_parser("debug")
+    odbg.add_argument("-output", default="")
+    odbg.add_argument("-seconds", type=int, default=2)
+    odbg.set_defaults(fn=cmd_operator_debug)
+
+    # monitor
+    mon = sub.add_parser("monitor", help="stream agent logs")
+    mon.add_argument("-log-level", dest="log_level", default="info")
+    mon.set_defaults(fn=cmd_monitor)
 
     # server
     srv = sub.add_parser("server").add_subparsers(dest="subcommand",

@@ -1,0 +1,129 @@
+"""Agent monitor / pprof / debug bundle tests.
+
+Modeled on reference command/agent/monitor/monitor_test.go and
+agent_endpoint_test.go pprof coverage.
+"""
+
+import logging
+import threading
+import time
+
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.utils.monitor import (
+    LogMonitor,
+    heap_summary,
+    sample_profile,
+    thread_dump,
+)
+
+
+class TestLogMonitor:
+    def test_subscribe_receives_lines(self):
+        mon = LogMonitor.install()
+        q = mon.subscribe("info")
+        try:
+            logging.getLogger("nomad_tpu.test").warning("hello-monitor")
+            level, line = q.get(timeout=2)
+            assert "hello-monitor" in line
+        finally:
+            mon.unsubscribe(q)
+
+    def test_level_filter_in_stream(self):
+        mon = LogMonitor.install()
+        stop = threading.Event()
+        got = []
+
+        def consume():
+            for line in mon.stream("error", stop):
+                if line:
+                    got.append(line)
+                    stop.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        logging.getLogger("nomad_tpu.test").info("below-threshold")
+        logging.getLogger("nomad_tpu.test").error("boom-error")
+        t.join(timeout=5)
+        assert got and "boom-error" in got[0]
+        assert all("below-threshold" not in l for l in got)
+
+    def test_info_records_pass_root_level_gate(self):
+        """Regression: the unconfigured root logger gates at WARNING;
+        subscribing at info must lower it so LOG.info lines stream,
+        and restore it once the last subscriber leaves."""
+        mon = LogMonitor.install()
+        root = logging.getLogger()
+        before = root.level
+        q = mon.subscribe("info")
+        try:
+            logging.getLogger("nomad_tpu.core_sched").info("info-visible")
+            level, line = q.get(timeout=2)
+            assert "info-visible" in line
+        finally:
+            mon.unsubscribe(q)
+        assert root.level == before
+
+
+class TestProfiles:
+    def test_thread_dump_contains_main(self):
+        dump = thread_dump()
+        assert "MainThread" in dump
+        assert "test_thread_dump_contains_main" in dump
+
+    def test_sample_profile(self):
+        done = threading.Event()
+
+        def spin():
+            while not done.is_set():
+                sum(range(1000))
+
+        t = threading.Thread(target=spin, name="spinner", daemon=True)
+        t.start()
+        try:
+            out = sample_profile(seconds=0.3, hz=50)
+        finally:
+            done.set()
+        assert "samples:" in out
+        assert "spin" in out
+
+    def test_heap_summary(self):
+        out = heap_summary()
+        assert "live objects" in out
+        assert "dict" in out
+
+
+class TestHTTP:
+    def test_pprof_endpoints(self):
+        agent = Agent(AgentConfig(num_schedulers=0))
+        agent.start()
+        try:
+            api = APIClient(agent.http_addr)
+            assert "MainThread" in api.agent.pprof("goroutine")
+            assert "live objects" in api.agent.pprof("heap")
+            assert "samples:" in api.agent.pprof("profile", seconds=1)
+        finally:
+            agent.shutdown()
+
+    def test_monitor_streams_logs(self):
+        agent = Agent(AgentConfig(num_schedulers=0))
+        agent.start()
+        try:
+            api = APIClient(agent.http_addr)
+            lines = []
+
+            def consume():
+                for line in api.agent.monitor(log_level="warning",
+                                              timeout=10):
+                    lines.append(line)
+                    return
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            time.sleep(0.3)
+            logging.getLogger("nomad_tpu.server").warning("stream-me-now")
+            t.join(timeout=10)
+            assert lines and "stream-me-now" in lines[0]
+        finally:
+            agent.shutdown()
