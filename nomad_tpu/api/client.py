@@ -95,7 +95,8 @@ class APIClient:
                 params["prefix"] = q.prefix
             params.update(q.params)
         qs = urllib.parse.urlencode(params)
-        return f"{self.address}{path}" + (f"?{qs}" if qs else "")
+        sep = "&" if "?" in path else "?"
+        return f"{self.address}{path}" + (f"{sep}{qs}" if qs else "")
 
     def request(self, method: str, path: str, body: Any = None,
                 q: Optional[QueryOptions] = None) -> Any:

@@ -14,6 +14,7 @@ StateStore.block_until (the memdb WatchSet analog).
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -394,6 +395,10 @@ class HTTPAgent:
     def _register_routes(self) -> None:
         def add(method: str, pattern: str, fn) -> None:
             self._routes.append((method, re.compile(pattern), fn))
+
+        # web UI (reference serves the Ember app at /ui; http.go:318)
+        add("GET", r"/", self.ui_redirect)
+        add("GET", r"/ui(?:/.*)?", self.ui_index)
 
         # jobs
         add("GET", r"/v1/jobs", self.jobs_list)
@@ -790,8 +795,10 @@ class HTTPAgent:
         self._block(req, ["nodes"])
         snap = self._server.state.snapshot()
         prefix = req.q("prefix")
+        with_res = req.flag("resources")
         return sorted(
-            (_node_stub(n) for n in snap.nodes() if n.id.startswith(prefix)),
+            (_node_stub(n, resources=with_res)
+             for n in snap.nodes() if n.id.startswith(prefix)),
             key=lambda n: n["ID"],
         )
 
@@ -849,8 +856,9 @@ class HTTPAgent:
         self._block(req, ["allocs"])
         snap = self._server.state.snapshot()
         prefix = req.q("prefix")
+        with_res = req.flag("resources")
         out = [
-            _alloc_stub(a) for a in snap.allocs_iter()
+            _alloc_stub(a, resources=with_res) for a in snap.allocs_iter()
             if a.namespace == req.namespace and a.id.startswith(prefix)
         ]
         return sorted(out, key=lambda a: a["ID"])
@@ -990,6 +998,35 @@ class HTTPAgent:
             raise HTTPError(400, f"cannot join own region {region!r}")
         self._server.join_region(region, addr)
         return {"num_joined": 1}
+
+    # -- web UI ----------------------------------------------------------
+
+    _UI_HTML: Optional[bytes] = None
+
+    def ui_redirect(self, req: Request):
+        h = req.handler
+        h.send_response(307)
+        h.send_header("Location", "/ui/")
+        h.send_header("Content-Length", "0")
+        h.end_headers()
+        return StreamedResponse
+
+    def ui_index(self, req: Request):
+        """Serve the single-file SPA; every /ui/* path gets the same
+        document (hash routing client-side)."""
+        cls = type(self)
+        if cls._UI_HTML is None:
+            path = os.path.join(os.path.dirname(__file__), "..", "ui",
+                                "index.html")
+            with open(path, "rb") as f:
+                cls._UI_HTML = f.read()
+        h = req.handler
+        h.send_response(200)
+        h.send_header("Content-Type", "text/html; charset=utf-8")
+        h.send_header("Content-Length", str(len(cls._UI_HTML)))
+        h.end_headers()
+        h.wfile.write(cls._UI_HTML)
+        return StreamedResponse
 
     @staticmethod
     def _begin_chunked(h):
@@ -1724,8 +1761,8 @@ def _job_stub(j) -> Dict:
     }
 
 
-def _node_stub(n) -> Dict:
-    return {
+def _node_stub(n, resources: bool = False) -> Dict:
+    out = {
         "ID": n.id, "Name": n.name, "Datacenter": n.datacenter,
         "NodeClass": n.node_class, "Status": n.status,
         "SchedulingEligibility": n.scheduling_eligibility,
@@ -1733,10 +1770,20 @@ def _node_stub(n) -> Dict:
         "Address": getattr(n, "http_addr", ""),
         "NodePool": getattr(n, "node_pool", "default"),
     }
+    if resources:
+        # ?resources=true includes flattened capacity on the stub
+        # (reference NodeListStub.NodeResources; the UI topology view
+        # reads capacity from one list call instead of N detail calls)
+        cr = n.node_resources.comparable()
+        out["NodeResources"] = {
+            "CPU": cr.cpu_shares, "MemoryMB": cr.memory_mb,
+            "DiskMB": cr.disk_mb,
+        }
+    return out
 
 
-def _alloc_stub(a) -> Dict:
-    return {
+def _alloc_stub(a, resources: bool = False) -> Dict:
+    out = {
         "ID": a.id, "EvalID": a.eval_id, "Name": a.name,
         "Namespace": a.namespace, "NodeID": a.node_id, "NodeName": a.node_name,
         "JobID": a.job_id, "JobVersion": a.job_version,
@@ -1747,3 +1794,13 @@ def _alloc_stub(a) -> Dict:
         "CreateTime": a.create_time_ns, "ModifyTime": a.modify_time_ns,
         "FollowupEvalID": a.follow_up_eval_id,
     }
+    if resources:
+        # ?resources=true includes flattened allocated resources on the
+        # stub (reference AllocationListStub.AllocatedResources; used by
+        # the UI topology view)
+        cr = a.comparable_resources()
+        out["AllocatedResources"] = {
+            "CPU": cr.cpu_shares, "MemoryMB": cr.memory_mb,
+            "DiskMB": cr.disk_mb,
+        }
+    return out

@@ -1,0 +1,89 @@
+"""Web UI serving tests.
+
+The reference serves its Ember app at /ui (command/agent/http.go:318
+UIEnabled handler); ours serves a single-file SPA. These tests cover
+the HTTP wiring — redirect, catch-all document serving, and the
+?resources=true stub extension the topology view uses.
+"""
+
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.api.codec import encode
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(name="ui-test-agent", num_schedulers=1))
+    a.start()
+    for _ in range(3):
+        a.server.node_register(mock.node())
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def api(agent):
+    return APIClient(agent.http_addr)
+
+
+def _get(agent, path):
+    req = urllib.request.Request(agent.http_addr + path)
+    return urllib.request.urlopen(req, timeout=10)
+
+
+class TestUIServing:
+    def test_root_redirects_to_ui(self, agent):
+        # urllib follows the 307; the final body is the app document
+        resp = _get(agent, "/")
+        assert resp.status == 200
+        assert resp.url.endswith("/ui/")
+
+    def test_ui_serves_app(self, agent):
+        body = _get(agent, "/ui/").read().decode()
+        assert "nomad-tpu" in body
+        assert "<script>" in body
+        # every app section is routable
+        for view in ("#/jobs", "#/clients", "#/allocations",
+                     "#/evaluations", "#/deployments", "#/topology",
+                     "#/servers", "#/settings"):
+            assert view in body
+
+    def test_ui_catchall_paths_serve_same_doc(self, agent):
+        a = _get(agent, "/ui/").read()
+        b = _get(agent, "/ui/jobs/some-job").read()
+        assert a == b
+        assert _get(agent, "/ui").read() == a
+
+    def test_content_type_is_html(self, agent):
+        resp = _get(agent, "/ui/")
+        assert resp.headers["Content-Type"].startswith("text/html")
+
+
+class TestAllocStubResources:
+    def test_resources_param_adds_allocated(self, agent, api):
+        job = mock.job()
+        api.jobs.register(encode(job))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            allocs = api.get("/v1/allocations?resources=true")
+            if allocs:
+                break
+            time.sleep(0.2)
+        assert allocs, "no allocations placed"
+        res = allocs[0]["AllocatedResources"]
+        assert res["CPU"] > 0 and res["MemoryMB"] > 0
+        # default stub stays lean
+        lean = api.get("/v1/allocations")
+        assert "AllocatedResources" not in lean[0]
+
+    def test_node_stub_resources(self, api):
+        nodes = api.get("/v1/nodes?resources=true")
+        assert nodes and nodes[0]["NodeResources"]["CPU"] > 0
+        assert nodes[0]["NodeResources"]["MemoryMB"] > 0
+        assert "NodeResources" not in api.get("/v1/nodes")[0]
