@@ -34,6 +34,7 @@ class AllocRunner:
         state_db=None,
         csi_manager=None,
         service_reg=None,
+        secrets=None,
     ) -> None:
         self.alloc = alloc
         self.drivers = drivers
@@ -42,6 +43,7 @@ class AllocRunner:
         self.state_db = state_db
         self.csi_manager = csi_manager
         self.service_reg = service_reg
+        self.secrets = secrets
         # tasks whose services are currently registered
         self._registered_tasks: set = set()
         # volume name -> CSIMountInfo (csi_hook.go populates these for
@@ -107,6 +109,7 @@ class AllocRunner:
                 state_db=self.state_db,
                 restart_policy=tg.restart_policy,
                 extra_env=volume_env,
+                secrets=self.secrets,
             )
             self.task_runners[task.name] = tr
             tr.start()
@@ -132,6 +135,7 @@ class AllocRunner:
                 on_state_change=self._on_task_state,
                 state_db=self.state_db,
                 restart_policy=tg.restart_policy,
+                secrets=self.secrets,
             )
             local_state, handle = (None, None)
             if self.state_db is not None:

@@ -63,6 +63,28 @@ class InProcessRPC:
         self.server.csi_volume_claim(namespace, volume_id, claim)
         return self.server.state.csi_volume_by_id(namespace, volume_id)
 
+    def derive_vault_tokens(self, alloc_id: str,
+                            task_names: List[str]) -> Dict[str, str]:
+        """Node.DeriveVaultToken RPC (taskrunner vault_hook)."""
+        return self.server.derive_vault_tokens(alloc_id, task_names)
+
+    def consul_kv_get(self, key: str):
+        """Consul KV read for template rendering."""
+        return self.server.consul.kv_get(key)
+
+    def consul_kv_index(self) -> int:
+        return self.server.consul.kv_index()
+
+    def vault_read_secret(self, path: str, token: str = ""):
+        """Policy-checked against the task's derived token."""
+        return self.server.vault.provider.read_secret(path, token=token)
+
+    def vault_secrets_index(self) -> int:
+        return self.server.vault.provider.secrets_index()
+
+    def vault_token_valid(self, token: str) -> bool:
+        return self.server.vault.provider.token_valid(token)
+
     def register_services(self, regs) -> int:
         """ServiceRegistration.Upsert RPC (client serviceregistration
         wrapper -> NomadServiceProvider)."""
@@ -79,6 +101,37 @@ class InProcessRPC:
             except ValueError:
                 pass   # already gone (idempotent dereg)
         return index
+
+
+class SecretsClient:
+    """Client-side facade over the server's Vault/Consul surface: the
+    data sources taskrunner vault/template hooks pull from
+    (vault_hook.go tokens; template.go Consul KV + Vault KV reads)."""
+
+    def __init__(self, rpc, node=None) -> None:
+        self.rpc = rpc
+        self.node = node
+
+    def derive_vault_tokens(self, alloc_id: str,
+                            task_names: List[str]) -> Dict[str, str]:
+        return self.rpc.derive_vault_tokens(alloc_id, task_names)
+
+    def kv_get(self, key: str):
+        return self.rpc.consul_kv_get(key)
+
+    def read_secret(self, path: str, token: str = ""):
+        return self.rpc.vault_read_secret(path, token)
+
+    def live_data_index(self) -> int:
+        """Combined monotonic index over every live template source
+        (Consul KV + Vault secrets); watchers poll this."""
+        return self.rpc.consul_kv_index() + self.rpc.vault_secrets_index()
+
+    def vault_token_valid(self, token: str) -> bool:
+        return self.rpc.vault_token_valid(token)
+
+    def node_attrs(self) -> Dict[str, str]:
+        return dict(self.node.attributes) if self.node is not None else {}
 
 
 class ClientConfig:
@@ -161,6 +214,8 @@ class Client:
 
         self.service_reg = ServiceRegWrapper(rpc, self.node) \
             if hasattr(rpc, "register_services") else None
+        self.secrets = SecretsClient(rpc, self.node) \
+            if hasattr(rpc, "derive_vault_tokens") else None
         self.allocs: Dict[str, AllocRunner] = {}
         self._alloc_lock = threading.Lock()
         self._alloc_indexes: Dict[str, int] = {}    # alloc_id -> modify_index
@@ -287,6 +342,7 @@ class Client:
             state_db=self.state_db,
             csi_manager=self.csi_manager,
             service_reg=self.service_reg,
+            secrets=self.secrets,
         )
         with self._alloc_lock:
             self.allocs[alloc.id] = runner
@@ -356,6 +412,7 @@ class Client:
                 state_db=self.state_db,
                 csi_manager=self.csi_manager,
                 service_reg=self.service_reg,
+                secrets=self.secrets,
             )
             with self._alloc_lock:
                 self.allocs[alloc.id] = runner

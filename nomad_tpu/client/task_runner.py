@@ -81,6 +81,7 @@ class TaskRunner:
         state_db=None,
         restart_policy: Optional[RestartPolicy] = None,
         extra_env: Optional[Dict[str, str]] = None,
+        secrets=None,
     ) -> None:
         self.alloc = alloc
         self.task = task
@@ -90,6 +91,14 @@ class TaskRunner:
         self.state_db = state_db
         # alloc-level env contributions (e.g. CSI volume mount paths)
         self.extra_env = extra_env or {}
+        # Vault/Consul data plane (vault_hook + template_hook sources)
+        self.secrets = secrets
+        self._vault_token = ""
+        self._template_watcher = None
+        self._changed_templates: List = []
+        self._vault_watch_stop = threading.Event()
+        #: token-validity poll cadence (tests shrink this)
+        self.vault_poll_interval_s = 5.0
         self.task_state = TaskState()
         self.handle: Optional[TaskHandle] = None
         policy = restart_policy or RestartPolicy()
@@ -152,6 +161,9 @@ class TaskRunner:
             LOG.warning("task %s: runner crashed: %s", self.task_id, e)
             self._set_state(STATE_DEAD, failed=True)
         finally:
+            if self._template_watcher is not None:
+                self._template_watcher.stop()
+            self._vault_watch_stop.set()
             self._done.set()
 
     def _run_inner(self) -> None:
@@ -242,8 +254,9 @@ class TaskRunner:
         self._set_state(STATE_DEAD, failed=False)
 
     def _prestart(self) -> None:
-        """Built-in prestart hooks: validate + task dir + logs
-        (task_runner_hooks.go validate/taskDir/logmon subset)."""
+        """Built-in prestart hooks: validate + task dir + logs + vault
+        + templates (task_runner_hooks.go validate/taskDir/logmon/
+        vault/template subset)."""
         if not self.task.name:
             raise ValueError("task has no name")
         task_dir = os.path.join(self.alloc_dir, self.task.name)
@@ -251,9 +264,159 @@ class TaskRunner:
         os.makedirs(os.path.join(task_dir, "secrets"), exist_ok=True)
         os.makedirs(os.path.join(self.alloc_dir, "alloc", "logs"), exist_ok=True)
         self._emit(EVENT_TASK_SETUP, "Building Task Directory")
+        self._vault_hook(task_dir)
+        self._template_hook(task_dir)
 
-    def _task_config(self) -> TaskConfig:
-        logs = os.path.join(self.alloc_dir, "alloc", "logs")
+    def _vault_hook(self, task_dir: str) -> None:
+        """vault_hook.go: derive the task's token via the server
+        (Node.DeriveVaultToken), write it to secrets/vault_token,
+        (with vault.env) expose VAULT_TOKEN, and watch the token —
+        if it is revoked/expires out from under the task, re-derive
+        and fire vault.change_mode (vault_hook.go renewal-failure →
+        updatedVaultToken path)."""
+        if self.task.vault is None:
+            return
+        if self.secrets is None:
+            raise RuntimeError(
+                f"task {self.task.name} has a vault block but the "
+                "client has no Vault integration configured")
+        self._derive_and_write_token(task_dir)
+        self._emit(EVENT_TASK_SETUP, "Vault token derived")
+        threading.Thread(
+            target=self._vault_token_watch, args=(task_dir,),
+            daemon=True, name=f"vault-watch-{self.task_id}",
+        ).start()
+
+    def _derive_and_write_token(self, task_dir: str) -> None:
+        tokens = self.secrets.derive_vault_tokens(
+            self.alloc.id, [self.task.name])
+        self._vault_token = tokens.get(self.task.name, "")
+        with open(os.path.join(task_dir, "secrets", "vault_token"), "w") as f:
+            f.write(self._vault_token)
+
+    def _vault_token_watch(self, task_dir: str) -> None:
+        while not self._vault_watch_stop.wait(self.vault_poll_interval_s):
+            if self._done.is_set():
+                return
+            try:
+                if self.secrets.vault_token_valid(self._vault_token):
+                    continue
+                self._derive_and_write_token(task_dir)
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("task %s: vault token re-derive failed: %s",
+                            self.task_id, e)
+                return
+            mode = self.task.vault.change_mode
+            if mode == "restart":
+                self._emit(EVENT_RESTARTING, "Vault token rotated")
+                self._restart.set()
+            elif mode == "signal":
+                sig = self.task.vault.change_signal or "SIGHUP"
+                try:
+                    self.driver.signal_task(self.task_id, sig)
+                    self._emit(EVENT_TASK_SETUP,
+                               f"Vault token rotated; sent {sig}")
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("task %s: vault signal failed: %s",
+                                self.task_id, e)
+
+    def _template_hook(self, task_dir: str) -> None:
+        """template_hook.go / template.go: render each template into
+        the task dir; watch live sources (Consul KV / Vault) and fire
+        change_mode on re-render."""
+        if not self.task.templates:
+            return
+        from nomad_tpu.client.template import (
+            TemplateWatcher, uses_live_data, uses_vault,
+        )
+
+        sources = self._template_sources(task_dir)
+        if self.task.vault is None and \
+                any(uses_vault(src) for _, src in sources):
+            raise RuntimeError(
+                f"task {self.task.name}: template reads Vault secrets "
+                "but the task has no vault block")
+        self._render_templates(task_dir)
+        live = any(uses_live_data(src) for _, src in sources)
+        if live and self.secrets is not None:
+            def rerender() -> bool:
+                self._changed_templates = self._render_templates(task_dir)
+                return bool(self._changed_templates)
+
+            self._template_watcher = TemplateWatcher(
+                poll_index=self.secrets.live_data_index,
+                rerender=rerender,
+                on_change=lambda: self._on_template_change(
+                    self._changed_templates),
+            )
+            self._template_watcher.start()
+
+    def _template_sources(self, task_dir: str):
+        """Resolve each template to its source text; file-backed
+        sources (source_path) read from the task's local dir."""
+        out = []
+        for tmpl in self.task.templates:
+            src = tmpl.embedded_tmpl
+            if not src and tmpl.source_path:
+                path = os.path.join(task_dir, "local", tmpl.source_path)
+                with open(path) as f:
+                    src = f.read()
+            out.append((tmpl, src))
+        return out
+
+    def _render_templates(self, task_dir: str):
+        """Render every template; returns the templates whose output
+        changed on disk."""
+        from nomad_tpu.client.template import TemplateContext, render
+
+        ctx = TemplateContext(
+            env=self._base_env(),
+            meta=dict(self.task.meta),
+            node_attrs=self.secrets.node_attrs() if self.secrets else {},
+            kv_get=self.secrets.kv_get if self.secrets else None,
+            # secret reads carry the task's derived token so the
+            # provider can enforce the task's policies; reading
+            # self._vault_token at call time picks up re-derivations
+            secret_get=(lambda p: self.secrets.read_secret(
+                p, self._vault_token)) if self.secrets else None,
+        )
+        changed = []
+        for tmpl, src in self._template_sources(task_dir):
+            out = render(src, ctx)
+            dest = os.path.join(task_dir, tmpl.dest_path or "local/rendered")
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            old = None
+            try:
+                with open(dest) as f:
+                    old = f.read()
+            except OSError:
+                pass
+            if out != old:
+                with open(dest, "w") as f:
+                    f.write(out)
+                changed.append(tmpl)
+        return changed
+
+    def _on_template_change(self, changed) -> None:
+        """Fire the strongest change_mode among the templates that
+        actually re-rendered (template.go change-mode dispatch)."""
+        modes = {t.change_mode for t in changed}
+        if "restart" in modes:
+            self._emit(EVENT_RESTARTING, "template re-rendered")
+            self._restart.set()
+        elif "signal" in modes:
+            sig = next((t.change_signal for t in changed
+                        if t.change_mode == "signal" and t.change_signal),
+                       "SIGHUP")
+            try:
+                self.driver.signal_task(self.task_id, sig)
+                self._emit(EVENT_TASK_SETUP,
+                           f"template re-rendered; sent {sig}")
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("task %s: template signal failed: %s",
+                            self.task_id, e)
+
+    def _base_env(self) -> Dict[str, str]:
         env = {
             "NOMAD_ALLOC_ID": self.alloc.id,
             "NOMAD_ALLOC_NAME": self.alloc.name,
@@ -265,6 +428,14 @@ class TaskRunner:
         }
         env.update(self.extra_env)
         env.update(self.task.env)
+        return env
+
+    def _task_config(self) -> TaskConfig:
+        logs = os.path.join(self.alloc_dir, "alloc", "logs")
+        env = self._base_env()
+        if self._vault_token and self.task.vault is not None \
+                and self.task.vault.env:
+            env["VAULT_TOKEN"] = self._vault_token
         return TaskConfig(
             id=self.task_id,
             name=self.task.name,

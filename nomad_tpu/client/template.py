@@ -1,0 +1,142 @@
+"""Task template rendering (the consul-template analog).
+
+Reference behavior: client/allocrunner/taskrunner/template/template.go
+runs embedded consul-template: templates interpolate Consul KV, Vault
+secrets, env vars, and node metadata into files under the task dir,
+re-render when upstream data changes, and fire the template's
+``change_mode`` (restart/signal/noop) on re-render.
+
+This engine implements the interpolation functions the reference's
+jobs use most, over the pluggable providers in server/secrets.py:
+
+    {{ key "path" }}              Consul KV lookup
+    {{ keyOrDefault "path" "d" }} Consul KV with fallback
+    {{ secret "path" "field" }}   Vault KV field lookup
+    {{ env "NAME" }}              task environment
+    {{ meta "key" }}              task meta
+    {{ node_attr "key" }}         node attribute
+
+(The reference's full Go-template pipeline — ranges, scratch,
+service() — is out of scope; jobs needing it would run a real
+consul-template binary as a task.)
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable, Dict, Optional
+
+_FUNC_RE = re.compile(
+    r"\{\{\s*(?P<fn>key|keyOrDefault|secret|env|meta|node_attr)"
+    r"\s+\"(?P<a1>[^\"]*)\"(?:\s+\"(?P<a2>[^\"]*)\")?\s*\}\}"
+)
+
+
+class TemplateContext:
+    """Data sources a render pulls from; any may be None (renders as
+    empty, the consul-template missing-key default)."""
+
+    def __init__(self, env: Optional[Dict[str, str]] = None,
+                 meta: Optional[Dict[str, str]] = None,
+                 node_attrs: Optional[Dict[str, str]] = None,
+                 kv_get: Optional[Callable[[str], Optional[str]]] = None,
+                 secret_get: Optional[Callable[[str], Optional[Dict]]] = None):
+        self.env = env or {}
+        self.meta = meta or {}
+        self.node_attrs = node_attrs or {}
+        self.kv_get = kv_get or (lambda k: None)
+        self.secret_get = secret_get or (lambda p: None)
+
+
+class MissingKeyError(KeyError):
+    """A template referenced a key that has no value and no default.
+    The reference blocks the task until the key appears; callers map
+    this to 'template not yet renderable'."""
+
+
+def render(tmpl: str, ctx: TemplateContext, strict: bool = False) -> str:
+    def repl(m: re.Match) -> str:
+        fn, a1, a2 = m.group("fn"), m.group("a1"), m.group("a2")
+        val: Optional[str] = None
+        if fn == "key":
+            val = ctx.kv_get(a1)
+        elif fn == "keyOrDefault":
+            val = ctx.kv_get(a1)
+            if val is None:
+                val = a2 or ""
+        elif fn == "secret":
+            data = ctx.secret_get(a1)
+            if data is not None:
+                val = data.get(a2 or "value")
+        elif fn == "env":
+            val = ctx.env.get(a1)
+        elif fn == "meta":
+            val = ctx.meta.get(a1)
+        elif fn == "node_attr":
+            val = ctx.node_attrs.get(a1)
+        if val is None:
+            if strict:
+                raise MissingKeyError(f"{fn} \"{a1}\" has no value")
+            val = ""
+        return str(val)
+
+    return _FUNC_RE.sub(repl, tmpl)
+
+
+def uses_live_data(tmpl: str) -> bool:
+    """Does this template read sources that can change under a running
+    task (KV/secrets)? Drives whether a change-watcher is needed."""
+    return any(m.group("fn") in ("key", "keyOrDefault", "secret")
+               for m in _FUNC_RE.finditer(tmpl))
+
+
+def uses_vault(tmpl: str) -> bool:
+    """Does this template read Vault secrets? Requires the task to
+    carry a vault block (its derived token authorizes the reads)."""
+    return any(m.group("fn") == "secret" for m in _FUNC_RE.finditer(tmpl))
+
+
+class TemplateWatcher:
+    """Re-render on upstream change and fire change_mode.
+
+    The reference's template manager subscribes to consul-template's
+    watcher; here the Dev providers expose a monotonic KV index that a
+    small poll loop checks (the blocking-query analog at poll
+    granularity).
+    """
+
+    def __init__(self, poll_index: Callable[[], int],
+                 rerender: Callable[[], bool],
+                 on_change: Callable[[], None],
+                 interval_s: float = 1.0) -> None:
+        self.poll_index = poll_index
+        self.rerender = rerender
+        self.on_change = on_change
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._last = self.poll_index()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="template-watcher"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                idx = self.poll_index()
+                if idx == self._last:
+                    continue
+                self._last = idx
+                if self.rerender():
+                    self.on_change()
+            except Exception:                   # noqa: BLE001
+                continue

@@ -228,6 +228,15 @@ def _task(name: str, tb: Body) -> Task:
             change_mode=str(t.attrs.get("change_mode", "restart")),
             change_signal=str(t.attrs.get("change_signal", "")),
         ))
+    vb = tb.first_block("vault")
+    if vb is not None:
+        from nomad_tpu.structs.job import Vault
+        task.vault = Vault(
+            policies=[str(p) for p in vb[1].attrs.get("policies", [])],
+            env=bool(vb[1].attrs.get("env", True)),
+            change_mode=str(vb[1].attrs.get("change_mode", "restart")),
+            change_signal=str(vb[1].attrs.get("change_signal", "")),
+        )
     for _l, art in tb.get_blocks("artifact"):
         task.artifacts.append(_body_to_dict(art))
     for labels, sb in tb.get_blocks("service"):

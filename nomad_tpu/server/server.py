@@ -116,6 +116,13 @@ class Server:
         from nomad_tpu.server.volume_watcher import VolumesWatcher
         from nomad_tpu.server.autopilot import Autopilot
 
+        # Consul/Vault integration (nomad/vault.go, consul.go): dev
+        # in-memory providers by default; real HTTP providers slot in
+        # via config without touching derivation/revocation paths
+        from nomad_tpu.server.secrets import DevConsulProvider, VaultManager
+        self.vault = VaultManager()
+        self.consul = DevConsulProvider()
+
         self.autopilot = Autopilot(self)
         self.periodic_dispatcher = PeriodicDispatcher(self)
         self.deployments_watcher = DeploymentsWatcher(self)
@@ -160,6 +167,7 @@ class Server:
         """Start workers; leadership comes from raft when attached,
         otherwise immediately (single-process authority)."""
         self._shutdown.clear()
+        self.vault.start()
         if self.raft is not None:
             self.raft.start()
         else:
@@ -169,6 +177,7 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        self.vault.stop()
         for w in self.workers:
             w.stop()
         if self.raft is not None:
@@ -491,6 +500,12 @@ class Server:
             existing = snap.alloc_by_id(a.id)
             if existing is None or existing.job is None:
                 continue
+            if a.client_status in (consts.ALLOC_CLIENT_COMPLETE,
+                                   consts.ALLOC_CLIENT_FAILED,
+                                   consts.ALLOC_CLIENT_LOST):
+                # terminal alloc: revoke any Vault tokens derived for it
+                # (vault.go RevokeTokens via the FSM alloc-update path)
+                self.vault.revoke_for_alloc(a.id)
             failed = a.client_status == consts.ALLOC_CLIENT_FAILED
             if not failed:
                 continue
@@ -511,6 +526,34 @@ class Server:
         return self.raft_apply(
             fsm_msgs.ALLOC_CLIENT_UPDATE, {"allocs": allocs, "evals": evals}
         )
+
+    def derive_vault_tokens(self, alloc_id: str,
+                            task_names: List[str]) -> Dict[str, str]:
+        """Node.DeriveVaultToken (node_endpoint.go DeriveVaultToken):
+        validate the alloc exists and each named task has a vault
+        block, then mint one token per task."""
+        snap = self.state.snapshot()
+        alloc = snap.alloc_by_id(alloc_id)
+        if alloc is None or alloc.job is None:
+            raise KeyError(f"allocation {alloc_id} not found")
+        if alloc.terminal_status():
+            # a lagging client asking for a dead alloc's tokens would
+            # mint accessors nothing ever revokes (the terminal update
+            # already ran); reject like node_endpoint.go does
+            raise ValueError(
+                f"allocation {alloc_id} is terminal; refusing to "
+                "derive Vault tokens")
+        tg = alloc.job.lookup_task_group(alloc.task_group)
+        asks: Dict[str, List[str]] = {}
+        for name in task_names:
+            task = next((t for t in tg.tasks if t.name == name), None) \
+                if tg is not None else None
+            if task is None or task.vault is None:
+                raise ValueError(
+                    f"task {name} does not request a Vault token")
+            asks[name] = task.vault.policies
+        infos = self.vault.derive_tokens(alloc_id, asks)
+        return {name: info.token for name, info in infos.items()}
 
     def get_client_allocs(self, node_id: str, min_index: int = 0,
                           timeout: float = 0.0) -> Dict:

@@ -174,6 +174,17 @@ class Template:
 
 
 @dataclass
+class Vault:
+    """Task Vault block (structs.go Vault): which policies the task's
+    derived token carries and how a new token is delivered."""
+
+    policies: List[str] = field(default_factory=list)
+    env: bool = True               # expose VAULT_TOKEN to the task
+    change_mode: str = "restart"   # restart | signal | noop
+    change_signal: str = ""
+
+
+@dataclass
 class Service:
     """Service registration + health checks (structs/services.go)."""
 
@@ -215,6 +226,7 @@ class Task:
     log_config: LogConfig = field(default_factory=LogConfig)
     templates: List[Template] = field(default_factory=list)
     artifacts: List[Dict] = field(default_factory=list)
+    vault: Optional[Vault] = None
     leader: bool = False
     kill_signal: str = ""
     user: str = ""
