@@ -28,6 +28,7 @@ class AgentConfig:
     num_schedulers: int = 2
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
+    tls: Optional[object] = None   # utils.tlsutil.TLSConfig
 
     @classmethod
     def dev(cls) -> "AgentConfig":
@@ -51,8 +52,18 @@ class Agent:
         from nomad_tpu.api.http import HTTPAgent
 
         self.http = HTTPAgent(
-            self, bind=self.config.bind_addr, port=self.config.http_port
+            self, bind=self.config.bind_addr, port=self.config.http_port,
+            tls_config=self.config.tls,
         )
+        tls = self.config.tls
+        if self.server is not None and tls is not None and tls.enabled:
+            # server-originated HTTP (ACL replication) must speak the
+            # cluster's TLS
+            self.server.tls_api = {
+                "ca_cert": tls.ca_file,
+                "client_cert": tls.cert_file,
+                "client_key": tls.key_file,
+            }
 
     def _setup_server(self) -> None:
         from nomad_tpu.server.server import Server, ServerConfig

@@ -53,12 +53,25 @@ class QueryOptions:
 class APIClient:
     def __init__(self, address: str = "http://127.0.0.1:4646",
                  token: str = "", namespace: str = "default",
-                 timeout: float = 305.0, region: str = "") -> None:
+                 timeout: float = 305.0, region: str = "",
+                 ca_cert: str = "", client_cert: str = "",
+                 client_key: str = "") -> None:
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
         self.region = region
         self.timeout = timeout
+        # TLS (api.Client TLSConfig; env NOMAD_CACERT/NOMAD_CLIENT_CERT/
+        # NOMAD_CLIENT_KEY in the CLI): a CA pins server verification,
+        # a client cert/key pair enables mTLS
+        self._ssl_context = None
+        if bool(client_cert) != bool(client_key):
+            raise ValueError(
+                "client_cert and client_key must be provided together")
+        if ca_cert or client_cert:
+            from nomad_tpu.utils.tlsutil import client_context
+            self._ssl_context = client_context(
+                ca_cert, client_cert, client_key)
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
@@ -109,7 +122,8 @@ class APIClient:
         if token:
             req.add_header("X-Nomad-Token", token)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl_context) as resp:
                 raw = resp.read()
                 return json.loads(raw) if raw else None
         except urllib.error.HTTPError as e:
@@ -132,7 +146,8 @@ class APIClient:
             url,
             headers={"X-Nomad-Token": self.token} if self.token else {},
         )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=self._ssl_context) as resp:
             for line in resp:
                 line = line.strip()
                 if not line or line == b"{}":

@@ -91,8 +91,10 @@ def parse_duration(v) -> Optional[float]:
 class HTTPAgent:
     """Routes + lifecycle for one agent's HTTP server."""
 
-    def __init__(self, agent, bind: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, agent, bind: str = "127.0.0.1", port: int = 0,
+                 tls_config=None) -> None:
         self.agent = agent
+        self.tls_config = tls_config
         self._routes: List[Tuple[str, re.Pattern, Callable]] = []
         self._register_routes()
         outer = self
@@ -120,7 +122,27 @@ class HTTPAgent:
 
         self.httpd = ThreadingHTTPServer((bind, port), _Handler)
         self.httpd.daemon_threads = True
-        self.addr = f"http://{self.httpd.server_address[0]}:{self.httpd.server_address[1]}"
+        scheme = "http"
+        # outbound SSL context for intra-cluster forwarding (region +
+        # node proxying must trust the cluster CA and present this
+        # agent's cert when peers enforce mTLS)
+        self._fwd_context = None
+        if tls_config is not None and tls_config.enabled:
+            # TLS listener (tlsutil/config.go IncomingTLSConfig); with
+            # verify_https_client the handshake requires a CA-signed
+            # client cert (mTLS). do_handshake_on_connect=False defers
+            # the handshake to the per-connection handler thread so a
+            # stalled peer can't block the accept loop.
+            from nomad_tpu.utils.tlsutil import client_context, server_context
+            self.httpd.socket = server_context(tls_config).wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
+            self._fwd_context = client_context(
+                tls_config.ca_file, tls_config.cert_file,
+                tls_config.key_file)
+            scheme = "https"
+        self.addr = (f"{scheme}://{self.httpd.server_address[0]}:"
+                     f"{self.httpd.server_address[1]}")
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle -------------------------------------------------------
@@ -246,7 +268,9 @@ class HTTPAgent:
             if token:
                 req.add_header("X-Nomad-Token", token)
             try:
-                with urllib.request.urlopen(req, timeout=fwd_timeout) as resp:
+                with urllib.request.urlopen(
+                        req, timeout=fwd_timeout,
+                        context=self._fwd_context) as resp:
                     self._relay_stream(handler, resp)
             except (OSError, ValueError, urllib.error.HTTPError) as e:
                 self._send(handler, 502,
@@ -283,7 +307,8 @@ class HTTPAgent:
             req.add_header("X-Nomad-Token", token)
         remote_index = None
         try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
+            with urllib.request.urlopen(req, timeout=timeout,
+                                        context=self._fwd_context) as resp:
                 raw, status = resp.read(), resp.status
                 remote_index = resp.headers.get("X-Nomad-Index")
         except urllib.error.HTTPError as e:

@@ -35,6 +35,9 @@ def make_client(args) -> APIClient:
         token=args.token,
         namespace=args.namespace,
         region=getattr(args, "region", "") or "",
+        ca_cert=getattr(args, "ca_cert", "") or "",
+        client_cert=getattr(args, "client_cert", "") or "",
+        client_key=getattr(args, "client_key", "") or "",
     )
 
 
@@ -828,6 +831,55 @@ def cmd_operator_autopilot_health(args) -> int:
     return 0
 
 
+def cmd_tls_ca_create(args) -> int:
+    """tls_ca_create.go: write nomad-agent-ca{,-key}.pem."""
+    from nomad_tpu.utils.tlsutil import generate_ca
+
+    cert, key = generate_ca(common_name=args.common_name)
+    for path, data, mode in (("nomad-agent-ca.pem", cert, 0o644),
+                             ("nomad-agent-ca-key.pem", key, 0o600)):
+        with open(path, "wb") as f:
+            f.write(data)
+        os.chmod(path, mode)
+        print(f"==> CA {'certificate' if mode == 0o644 else 'key'} "
+              f"saved to: {path}")
+    return 0
+
+
+def cmd_tls_cert_create(args) -> int:
+    """tls_cert_create.go: issue a server/client/cli cert off the CA."""
+    from nomad_tpu.utils.tlsutil import generate_cert
+
+    try:
+        with open(args.ca, "rb") as f:
+            ca_cert = f.read()
+        with open(args.key, "rb") as f:
+            ca_key = f.read()
+    except OSError as e:
+        return _fail(f"cannot read CA material (run 'tls ca create' "
+                     f"first?): {e}")
+    role = "server" if args.server else ("client" if args.client else "cli")
+    name = f"{role}.{args.cert_region}.nomad"
+    cert, key = generate_cert(
+        ca_cert, ca_key, common_name=name,
+        san_dns=[name] + (args.additional_dnsname or []),
+        # client *agents* also serve the HTTPS API (fs/exec proxying),
+        # so their certs carry serverAuth too; only cli certs are
+        # client-only (reference tls_cert_create.go)
+        server=args.server or args.client,
+        client=True,
+    )
+    base = f"{args.cert_region}-{role}-nomad"
+    for suffix, data, mode in ((".pem", cert, 0o644),
+                               ("-key.pem", key, 0o600)):
+        path = base + suffix
+        with open(path, "wb") as f:
+            f.write(data)
+        os.chmod(path, mode)
+        print(f"==> Cert saved to: {path}")
+    return 0
+
+
 def cmd_monitor(args) -> int:
     api = make_client(args)
     try:
@@ -948,6 +1000,15 @@ def cmd_agent(args) -> int:
     cfg.datacenter = args.dc or cfg.datacenter
     cfg.bind_addr = args.bind
     cfg.http_port = args.http_port
+    if args.tls_cert or args.tls_key:
+        if not (args.tls_cert and args.tls_key and args.tls_ca):
+            return _fail("TLS needs -tls-ca, -tls-cert and -tls-key")
+        from nomad_tpu.utils.tlsutil import TLSConfig
+        cfg.tls = TLSConfig(
+            enabled=True, ca_file=args.tls_ca, cert_file=args.tls_cert,
+            key_file=args.tls_key,
+            verify_https_client=args.tls_verify_https_client,
+        )
     try:
         agent = Agent(cfg)
     except ValueError as e:
@@ -985,6 +1046,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-namespace", default=os.environ.get(
         "NOMAD_NAMESPACE", "default"))
     p.add_argument("-region", default=os.environ.get("NOMAD_REGION", ""))
+    p.add_argument("-ca-cert", dest="ca_cert",
+                   default=os.environ.get("NOMAD_CACERT", ""))
+    p.add_argument("-client-cert", dest="client_cert",
+                   default=os.environ.get("NOMAD_CLIENT_CERT", ""))
+    p.add_argument("-client-key", dest="client_key",
+                   default=os.environ.get("NOMAD_CLIENT_KEY", ""))
     sub = p.add_subparsers(dest="command")
 
     # agent
@@ -996,6 +1063,11 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-dc", default="")
     ag.add_argument("-bind", default="127.0.0.1")
     ag.add_argument("-http-port", dest="http_port", type=int, default=4646)
+    ag.add_argument("-tls-ca", dest="tls_ca", default="")
+    ag.add_argument("-tls-cert", dest="tls_cert", default="")
+    ag.add_argument("-tls-key", dest="tls_key", default="")
+    ag.add_argument("-tls-verify-https-client", action="store_true",
+                    dest="tls_verify_https_client")
     ag.set_defaults(fn=cmd_agent)
 
     # job
@@ -1271,6 +1343,25 @@ def build_parser() -> argparse.ArgumentParser:
     mon = sub.add_parser("monitor", help="stream agent logs")
     mon.add_argument("-log-level", dest="log_level", default="info")
     mon.set_defaults(fn=cmd_monitor)
+
+    # tls
+    tls = sub.add_parser("tls", help="TLS certificate helpers") \
+        .add_subparsers(dest="subcommand", required=True)
+    tca = tls.add_parser("ca").add_subparsers(dest="verb", required=True)
+    tcac = tca.add_parser("create")
+    tcac.add_argument("-common-name", dest="common_name",
+                      default="nomad-tpu CA")
+    tcac.set_defaults(fn=cmd_tls_ca_create)
+    tcert = tls.add_parser("cert").add_subparsers(dest="verb", required=True)
+    tcc = tcert.add_parser("create")
+    tcc.add_argument("-ca", default="nomad-agent-ca.pem")
+    tcc.add_argument("-key", default="nomad-agent-ca-key.pem")
+    tcc.add_argument("-server", action="store_true")
+    tcc.add_argument("-client", action="store_true")
+    tcc.add_argument("-region", dest="cert_region", default="global")
+    tcc.add_argument("-additional-dnsname", action="append",
+                     dest="additional_dnsname")
+    tcc.set_defaults(fn=cmd_tls_cert_create)
 
     # server
     srv = sub.add_parser("server").add_subparsers(dest="subcommand",

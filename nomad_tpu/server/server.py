@@ -624,7 +624,10 @@ class Server:
         from nomad_tpu.api.client import APIClient
         from nomad_tpu.acl.policy import ACLPolicy, ACLToken
 
-        api = APIClient(addr, token=self.config.replication_token)
+        # tls_api is set by the agent when the cluster runs TLS so
+        # replication trusts the cluster CA / presents this agent's cert
+        tls = getattr(self, "tls_api", None) or {}
+        api = APIClient(addr, token=self.config.replication_token, **tls)
         n = 0
 
         # policies: upsert changed, delete stale
