@@ -82,6 +82,15 @@ class KernelFeatures(NamedTuple):
 
 FULL_FEATURES = KernelFeatures()
 
+#: the lean cpu/mem/disk binpack envelope — what a plain service/batch
+#: ask compiles to, and the exact feature set the pallas backend
+#: (ops/pallas_kernel.py) implements; bench + parity tests pin it
+LEAN_FEATURES = KernelFeatures(
+    n_spreads=0, with_topk=False, with_devices=False, with_ports=False,
+    with_cores=False, with_network=False, with_distinct=False,
+    with_step_penalties=False, with_preferred=False,
+)
+
 
 class KernelIn(NamedTuple):
     """Device-side planes for one (eval, task group). All arrays."""

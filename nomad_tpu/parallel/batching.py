@@ -51,17 +51,25 @@ def make_schedule_apply_step(k_steps: int, features: KernelFeatures = FULL_FEATU
             return place_taskgroup(kin, k_steps, features)
 
         out = jax.vmap(run_one)(ask_cpu, ask_mem, n_steps)
-
-        # plan apply: scatter the accepted placements into the planes
-        rows = out.chosen.reshape(-1)                       # i32[B*K]
-        ok = out.found.reshape(-1)
-        w_cpu = (jnp.broadcast_to(ask_cpu[:, None], out.chosen.shape)
-                 .reshape(-1) * ok)
-        w_mem = (jnp.broadcast_to(ask_mem[:, None], out.chosen.shape)
-                 .reshape(-1) * ok)
-        safe = jnp.where(ok, rows, 0)
-        used_cpu2 = used_cpu.at[safe].add(jnp.where(ok, w_cpu, 0.0))
-        used_mem2 = used_mem.at[safe].add(jnp.where(ok, w_mem, 0.0))
+        used_cpu2, used_mem2 = commit_placements(
+            used_cpu, used_mem, out, ask_cpu, ask_mem)
         return out, used_cpu2, used_mem2
 
     return jax.jit(step, donate_argnums=(1, 2))
+
+
+def commit_placements(used_cpu, used_mem, out, ask_cpu, ask_mem):
+    """The plan applier's state update as on-device algebra
+    (nomad/plan_apply.go:209): scatter every accepted placement's ask
+    into the cluster utilization planes. Shared by the XLA and pallas
+    step builders."""
+    rows = out.chosen.reshape(-1)                       # i32[B*K]
+    ok = out.found.reshape(-1)
+    w_cpu = (jnp.broadcast_to(ask_cpu[:, None], out.chosen.shape)
+             .reshape(-1) * ok)
+    w_mem = (jnp.broadcast_to(ask_mem[:, None], out.chosen.shape)
+             .reshape(-1) * ok)
+    safe = jnp.where(ok, rows, 0)
+    used_cpu2 = used_cpu.at[safe].add(jnp.where(ok, w_cpu, 0.0))
+    used_mem2 = used_mem.at[safe].add(jnp.where(ok, w_mem, 0.0))
+    return used_cpu2, used_mem2

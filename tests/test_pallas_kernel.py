@@ -1,0 +1,117 @@
+"""Pallas placement kernel parity tests.
+
+Golden parity against the XLA lean kernel (ops/kernel.py) on identical
+inputs: same chosen nodes, same scores, same sequential-deduction
+semantics. Runs the pallas kernel in interpret mode (tests force CPU).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nomad_tpu.ops.kernel import LEAN_FEATURES, build_kernel_in
+from nomad_tpu.ops.pallas_kernel import (
+    make_schedule_apply_step_pallas,
+    pallas_place_batch,
+)
+from nomad_tpu.parallel.batching import (
+    device_put_shared,
+    make_schedule_apply_step,
+)
+from nomad_tpu.parallel.synthetic import synthetic_cluster, synthetic_eval
+
+N_NODES = 200        # pads to a lane-aligned bucket
+K = 5
+B = 8
+LEAN = LEAN_FEATURES
+
+
+@pytest.fixture(scope="module")
+def shared():
+    cluster = synthetic_cluster(N_NODES, cpu=2000.0, mem=4096.0,
+                                disk=50000.0, seed=3)
+    ev = synthetic_eval(cluster, desired_count=K)
+    kin = device_put_shared(build_kernel_in(cluster, ev, K))
+    assert kin.cap_cpu.shape[0] % 128 == 0
+    return kin
+
+
+def _batch_inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    ask_cpu = jnp.asarray(
+        rng.choice([100.0, 250.0, 500.0], B).astype(np.float32))
+    ask_mem = jnp.asarray(
+        rng.choice([64.0, 128.0, 256.0], B).astype(np.float32))
+    n_steps = jnp.asarray(np.full(B, K, np.int32))
+    return ask_cpu, ask_mem, n_steps
+
+
+class TestParity:
+    def test_matches_xla_lean_kernel(self, shared):
+        npad = shared.cap_cpu.shape[0]
+        rng = np.random.default_rng(1)
+        used = np.zeros(npad, np.float32)
+        used[:N_NODES] = 2000.0 * 0.5 * rng.random(N_NODES,
+                                                   dtype=np.float32)
+        usedm = np.zeros(npad, np.float32)
+        usedm[:N_NODES] = 4096.0 * 0.5 * rng.random(N_NODES,
+                                                    dtype=np.float32)
+        ask_cpu, ask_mem, n_steps = _batch_inputs()
+
+        xla_step = make_schedule_apply_step(K, LEAN)
+        out_x, uc_x, um_x = xla_step(
+            shared, jnp.asarray(used), jnp.asarray(usedm),
+            ask_cpu, ask_mem, n_steps)
+
+        pl_step = make_schedule_apply_step_pallas(K, interpret=True)
+        out_p, uc_p, um_p = pl_step(
+            shared, jnp.asarray(used), jnp.asarray(usedm),
+            ask_cpu, ask_mem, n_steps)
+
+        np.testing.assert_array_equal(np.asarray(out_x.chosen),
+                                      np.asarray(out_p.chosen))
+        np.testing.assert_array_equal(np.asarray(out_x.found),
+                                      np.asarray(out_p.found))
+        np.testing.assert_allclose(np.asarray(out_x.scores),
+                                   np.asarray(out_p.scores),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(uc_x), np.asarray(uc_p),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(um_x), np.asarray(um_p),
+                                   rtol=1e-6)
+
+    def test_sequential_deduction_within_eval(self, shared):
+        """The K placements of one eval must spread across nodes when
+        one node can't hold them all (in-kernel deduction works)."""
+        npad = shared.cap_cpu.shape[0]
+        used = jnp.zeros(npad, jnp.float32)
+        # ask so large each node fits exactly one
+        ask_cpu = jnp.full(1, 1200.0, jnp.float32)
+        ask_mem = jnp.full(1, 64.0, jnp.float32)
+        out = pallas_place_batch(
+            shared.cap_cpu, shared.cap_mem, shared.cap_disk,
+            used, used, shared.used_disk,
+            shared.base_mask, shared.job_tg_count, shared.penalty,
+            shared.aff_score,
+            ask_cpu, ask_mem, shared.ask_disk,
+            jnp.full(1, K, jnp.int32), shared.desired_count,
+            shared.algorithm_spread, k_steps=K, interpret=True)
+        chosen = np.asarray(out.chosen[0])
+        assert np.asarray(out.found[0]).all()
+        assert len(set(chosen.tolist())) == K, chosen
+
+    def test_infeasible_returns_not_found(self, shared):
+        npad = shared.cap_cpu.shape[0]
+        used = jnp.zeros(npad, jnp.float32)
+        ask_cpu = jnp.full(1, 1e9, jnp.float32)   # impossible ask
+        ask_mem = jnp.full(1, 64.0, jnp.float32)
+        out = pallas_place_batch(
+            shared.cap_cpu, shared.cap_mem, shared.cap_disk,
+            used, used, shared.used_disk,
+            shared.base_mask, shared.job_tg_count, shared.penalty,
+            shared.aff_score,
+            ask_cpu, ask_mem, shared.ask_disk,
+            jnp.full(1, K, jnp.int32), shared.desired_count,
+            shared.algorithm_spread, k_steps=K, interpret=True)
+        assert not np.asarray(out.found).any()
+        assert (np.asarray(out.chosen) == -1).all()
