@@ -642,7 +642,8 @@ class HTTPAgent:
 
         if not isinstance(req.body, dict) or "JobHCL" not in req.body:
             raise HTTPError(400, "JobHCL is required")
-        job = parse_hcl(req.body["JobHCL"])
+        job = parse_hcl(req.body["JobHCL"],
+                        req.body.get("Variables") or None)
         return encode(job)
 
     def job_get(self, req: Request):

@@ -49,7 +49,22 @@ def _fail(msg: str) -> int:
 # --- job ----------------------------------------------------------------
 
 
-def _load_jobfile(path: str) -> Dict:
+def _job_variables(args) -> tuple:
+    """(-var flags, NOMAD_VAR_* env) — jobspec2 variable sources.
+    Flags naming undeclared variables error; env values for
+    undeclared variables are ignored."""
+    flags: Dict = {}
+    for item in getattr(args, "var", None) or []:
+        if "=" not in item:
+            raise ValueError(f"-var needs key=value, got {item!r}")
+        k, v = item.split("=", 1)
+        flags[k] = v
+    env = {k[len("NOMAD_VAR_"):]: v for k, v in os.environ.items()
+           if k.startswith("NOMAD_VAR_")}
+    return flags, env
+
+
+def _load_jobfile(path: str, variables: Optional[tuple] = None) -> Dict:
     """Parse an HCL or JSON jobspec file to a wire-format job dict
     (jobspec2.Parse → api.Job in the reference)."""
     from nomad_tpu.api.codec import encode
@@ -64,7 +79,8 @@ def _load_jobfile(path: str) -> Dict:
         data = json.loads(src)
         job = parse_json(data.get("Job", data))
     else:
-        job = parse_hcl(src)
+        flags, env = variables or ({}, {})
+        job = parse_hcl(src, flags, env)
     return encode(job)
 
 
@@ -111,7 +127,7 @@ def _monitor_eval(api: APIClient, eval_id: str, timeout: float = 30.0) -> int:
 def cmd_job_run(args) -> int:
     api = make_client(args)
     try:
-        job = _load_jobfile(args.jobfile)
+        job = _load_jobfile(args.jobfile, _job_variables(args))
     except Exception as e:
         return _fail(f"parsing jobspec: {e}")
     res = api.jobs.register(job)
@@ -127,7 +143,7 @@ def cmd_job_run(args) -> int:
 def cmd_job_plan(args) -> int:
     api = make_client(args)
     try:
-        job = _load_jobfile(args.jobfile)
+        job = _load_jobfile(args.jobfile, _job_variables(args))
     except Exception as e:
         return _fail(f"parsing jobspec: {e}")
     res = api.jobs.plan(job, diff=True)
@@ -1076,9 +1092,11 @@ def build_parser() -> argparse.ArgumentParser:
     jr = job.add_parser("run")
     jr.add_argument("jobfile")
     jr.add_argument("-detach", action="store_true")
+    jr.add_argument("-var", action="append", dest="var")
     jr.set_defaults(fn=cmd_job_run)
     jp = job.add_parser("plan")
     jp.add_argument("jobfile")
+    jp.add_argument("-var", action="append", dest="var")
     jp.set_defaults(fn=cmd_job_plan)
     js = job.add_parser("status")
     js.add_argument("job_id", nargs="?", default="")
@@ -1122,6 +1140,7 @@ def build_parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run")
     run.add_argument("jobfile")
     run.add_argument("-detach", action="store_true")
+    run.add_argument("-var", action="append", dest="var")
     run.set_defaults(fn=cmd_job_run)
     stop = sub.add_parser("stop")
     stop.add_argument("job_id")
@@ -1130,6 +1149,7 @@ def build_parser() -> argparse.ArgumentParser:
     stop.set_defaults(fn=cmd_job_stop)
     plan = sub.add_parser("plan")
     plan.add_argument("jobfile")
+    plan.add_argument("-var", action="append", dest="var")
     plan.set_defaults(fn=cmd_job_plan)
 
     # node

@@ -25,6 +25,15 @@ class HCLParseError(ValueError):
 
 
 @dataclass
+class Call:
+    """A function call expression, evaluated by jobspec/eval.py
+    (reference jobspec2/functions.go stdlib)."""
+
+    name: str
+    args: List[Any]
+
+
+@dataclass
 class Body:
     attrs: Dict[str, Any] = field(default_factory=dict)
     blocks: List[Tuple[str, List[str], "Body"]] = field(default_factory=list)
@@ -206,7 +215,22 @@ def _parse_value(lx: _Lexer) -> Any:
         return False
     if ident == "null":
         return None
-    # bare identifier (enum-ish value or interpolation leftover)
+    lx.skip_space(newlines=False)
+    if lx._peek() == "(":
+        # function call: name(arg, ...) — evaluated by jobspec/eval.py
+        lx._advance()
+        args: List[Any] = []
+        while True:
+            lx.skip_space()
+            if lx._peek() == ")":
+                lx._advance()
+                return Call(ident, args)
+            args.append(_parse_value(lx))
+            lx.skip_space()
+            if lx._peek() == ",":
+                lx._advance()
+    # bare identifier: enum-ish value, or a var./local./iterator
+    # reference the evaluator resolves against its scope
     return ident
 
 

@@ -36,9 +36,16 @@ from nomad_tpu.structs.network import NetworkResource, Port
 from nomad_tpu.structs.resources import RequestedDevice, Resources
 
 
-def parse_hcl(src: str) -> Job:
-    """HCL jobspec text -> Job (jobspec2/parse.go Parse)."""
-    body = parse(src)
+def parse_hcl(src: str, variables: Optional[Dict] = None,
+              env_variables: Optional[Dict] = None) -> Job:
+    """HCL jobspec text -> Job (jobspec2/parse.go Parse).
+
+    ``variables`` overrides `variable` block defaults (the -var CLI
+    flag — undeclared names error); ``env_variables`` are NOMAD_VAR_*
+    values (undeclared names ignored)."""
+    from nomad_tpu.jobspec.eval import evaluate
+
+    body = evaluate(parse(src), variables, env_variables)
     found = body.first_block("job")
     if found is None:
         raise ValueError("jobspec must contain a 'job' block")

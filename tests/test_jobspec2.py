@@ -1,0 +1,262 @@
+"""HCL2 evaluation tests: variables, locals, functions, interpolation,
+dynamic blocks.
+
+Modeled on reference jobspec2/parse_test.go (variable handling,
+functions, dynamic blocks) — the HCL2 features jobspec/parse.go HCL1
+lacks.
+"""
+
+import pytest
+
+from nomad_tpu.jobspec.eval import EvalError, FUNCS, Scope, eval_expr, evaluate
+from nomad_tpu.jobspec.hcl import parse
+from nomad_tpu.jobspec.parse import parse_hcl
+
+
+class TestVariables:
+    def test_default_and_override(self):
+        src = '''
+        variable "region" { default = "us-west" }
+        variable "count" { default = 3 }
+        job "j" {
+          region = var.region
+          group "g" { count = var.count }
+        }
+        '''
+        job = parse_hcl(src)
+        assert job.region == "us-west"
+        assert job.task_groups[0].count == 3
+        job2 = parse_hcl(src, {"region": "eu-east", "count": 5})
+        assert job2.region == "eu-east"
+        assert job2.task_groups[0].count == 5
+
+    def test_missing_value_errors(self):
+        src = 'variable "x" {}\njob "j" { region = var.x }'
+        with pytest.raises(EvalError):
+            parse_hcl(src)
+
+    def test_undeclared_override_errors(self):
+        src = 'job "j" {}'
+        with pytest.raises(EvalError):
+            parse_hcl(src, {"nope": 1})
+
+
+class TestLocals:
+    def test_locals_reference_vars_and_each_other(self):
+        src = '''
+        variable "env" { default = "prod" }
+        locals {
+          full    = "${var.env}-cluster"
+          shouted = upper(local.full)
+        }
+        job "j" { region = local.shouted }
+        '''
+        assert parse_hcl(src).region == "PROD-CLUSTER"
+
+    def test_local_cycle_errors(self):
+        src = '''
+        locals { a = local.b
+                 b = local.a }
+        job "j" {}
+        '''
+        with pytest.raises(EvalError):
+            parse_hcl(src)
+
+
+class TestInterpolation:
+    def test_expressions_inside_interpolation(self):
+        scope = Scope({"var": {"n": 4, "name": "web"}, "local": {}})
+        assert eval_expr("var.n + 2", scope) == 6
+        assert eval_expr("var.n * 2 - 1", scope) == 7
+        assert eval_expr("var.n > 3 && var.n < 10", scope) is True
+        assert eval_expr('var.n == 4 ? "big" : "small"', scope) == "big"
+        assert eval_expr('upper(var.name)', scope) == "WEB"
+        assert eval_expr('format("%s-%d", var.name, var.n)', scope) == "web-4"
+
+    def test_native_type_for_sole_interpolation(self):
+        src = '''
+        variable "count" { default = 7 }
+        job "j" { group "g" { count = "${var.count}" } }
+        '''
+        assert parse_hcl(src).task_groups[0].count == 7
+
+    def test_runtime_namespaces_pass_through(self):
+        """${attr...} / ${node...} / ${env...} resolve at schedule/run
+        time; the parser must keep them literal."""
+        src = '''
+        job "j" {
+          constraint {
+            attribute = "${attr.kernel.name}"
+            value     = "linux"
+          }
+          group "g" {
+            task "t" {
+              driver = "mock"
+              env { HOST = "${node.unique.name}" }
+            }
+          }
+        }
+        '''
+        job = parse_hcl(src)
+        assert job.constraints[0].ltarget == "${attr.kernel.name}"
+        assert job.task_groups[0].tasks[0].env["HOST"] == \
+            "${node.unique.name}"
+
+    def test_indexing(self):
+        scope = Scope({"var": {"dcs": ["dc1", "dc2"],
+                               "m": {"k": "v"}}, "local": {}})
+        assert eval_expr("var.dcs[1]", scope) == "dc2"
+        assert eval_expr('var.m["k"]', scope) == "v"
+
+
+class TestFunctions:
+    def test_stdlib_subset(self):
+        f = FUNCS
+        assert f["join"](",", ["a", "b"]) == "a,b"
+        assert f["split"](",", "a,b") == ["a", "b"]
+        assert f["replace"]("a-b", "-", "_") == "a_b"
+        assert f["length"]([1, 2, 3]) == 3
+        assert f["concat"]([1], [2, 3]) == [1, 2, 3]
+        assert f["contains"](["x"], "x") is True
+        assert f["coalesce"](None, "", "v") == "v"
+        assert f["ceil"](1.2) == 2 and f["floor"](1.8) == 1
+        assert f["range"](3) == [0, 1, 2]
+        assert f["element"](["a", "b"], 3) == "b"
+        assert f["merge"]({"a": 1}, {"b": 2}) == {"a": 1, "b": 2}
+        assert f["flatten"]([[1], [2, 3]]) == [1, 2, 3]
+        assert f["distinct"]([1, 1, 2]) == [1, 2]
+        assert f["jsondecode"](f["jsonencode"]({"x": 1})) == {"x": 1}
+        assert f["base64decode"](f["base64encode"]("hi")) == "hi"
+        assert f["lookup"]({"a": 1}, "b", 9) == 9
+        assert f["trimprefix"]("abc", "ab") == "c"
+        assert f["tonumber"]("4") == 4
+
+    def test_function_call_in_jobspec(self):
+        src = '''
+        variable "dcs" { default = ["dc1", "dc2"] }
+        job "j" {
+          datacenters = var.dcs
+          region      = join("-", var.dcs)
+        }
+        '''
+        job = parse_hcl(src)
+        assert job.datacenters == ["dc1", "dc2"]
+        assert job.region == "dc1-dc2"
+
+    def test_unknown_function_errors(self):
+        with pytest.raises(EvalError):
+            parse_hcl('job "j" { region = frobnicate("x") }')
+
+
+class TestDynamicBlocks:
+    def test_dynamic_expands_services(self):
+        src = '''
+        variable "ports" { default = ["http", "admin"] }
+        job "j" {
+          group "g" {
+            task "t" {
+              driver = "mock"
+              dynamic "service" {
+                for_each = var.ports
+                content {
+                  name = "svc-${service.value}"
+                  port = service.value
+                }
+              }
+            }
+          }
+        }
+        '''
+        task = parse_hcl(src).task_groups[0].tasks[0]
+        assert [s.name for s in task.services] == ["svc-http", "svc-admin"]
+        assert [s.port_label for s in task.services] == ["http", "admin"]
+
+    def test_dynamic_with_labels_and_iterator(self):
+        src = '''
+        locals { groups = { web = 2, db = 1 } }
+        job "j" {
+          dynamic "group" {
+            for_each = local.groups
+            iterator = it
+            labels   = ["${it.key}"]
+            content {
+              count = it.value
+              task "t" { driver = "mock" }
+            }
+          }
+        }
+        '''
+        job = parse_hcl(src)
+        names = {tg.name: tg.count for tg in job.task_groups}
+        assert names == {"web": 2, "db": 1}
+
+
+class TestBodyEvaluate:
+    def test_variable_blocks_dropped(self):
+        body = evaluate(parse('variable "x" { default = 1 }\na = var.x'))
+        assert body.attrs == {"a": 1}
+        assert body.get_blocks("variable") == []
+
+
+class TestReviewRegressions:
+    def test_nomad_env_interpolations_stay_literal(self):
+        """${NOMAD_TASK_DIR} and friends resolve at the client, never
+        at parse time."""
+        src = '''
+        job "j" { group "g" { task "t" {
+          driver = "mock"
+          config { command = "${NOMAD_TASK_DIR}/run.sh" }
+          env { D = "${NOMAD_ALLOC_DIR}/x" }
+        } } }
+        '''
+        task = parse_hcl(src).task_groups[0].tasks[0]
+        assert task.config["command"] == "${NOMAD_TASK_DIR}/run.sh"
+        assert task.env["D"] == "${NOMAD_ALLOC_DIR}/x"
+
+    def test_override_converted_to_declared_type(self):
+        src = '''
+        variable "n" { default = 3 }
+        job "j" { group "g" { count = "${var.n * 2}" } }
+        '''
+        job = parse_hcl(src, {"n": "5"})    # CLI strings coerce to int
+        assert job.task_groups[0].count == 10
+        src2 = '''
+        variable "dcs" { default = ["dc1"] }
+        job "j" { datacenters = var.dcs }
+        '''
+        job2 = parse_hcl(src2, {"dcs": '["a", "b"]'})
+        assert job2.datacenters == ["a", "b"]
+        with pytest.raises(EvalError):
+            parse_hcl(src, {"n": "not-a-number"})
+
+    def test_undeclared_env_variable_ignored(self):
+        src = 'variable "x" { default = 1 }\njob "j" {}'
+        # env-sourced unknown: fine; explicit flag unknown: error
+        parse_hcl(src, env_variables={"stray": "v"})
+        with pytest.raises(EvalError):
+            parse_hcl(src, variables={"stray": "v"})
+        # env value for a DECLARED variable applies (flag wins over env)
+        src2 = 'variable "r" { default = "a" }\njob "j" { region = var.r }'
+        assert parse_hcl(src2, env_variables={"r": "b"}).region == "b"
+        assert parse_hcl(src2, {"r": "c"}, {"r": "b"}).region == "c"
+
+    def test_sole_interpolation_keeps_native_list(self):
+        src = '''
+        variable "dcs" { default = ["dc1", "dc2"] }
+        job "j" { datacenters = "${var.dcs}" }
+        '''
+        assert parse_hcl(src).datacenters == ["dc1", "dc2"]
+
+    def test_ternary_guard_protects_dead_branch(self):
+        scope = Scope({"var": {"l": [], "f": ["x"]}, "local": {}})
+        assert eval_expr('length(var.l) > 0 ? var.l[0] : "none"',
+                         scope) == "none"
+        assert eval_expr('length(var.f) > 0 ? var.f[0] : "none"',
+                         scope) == "x"
+
+    def test_runtime_errors_become_eval_errors(self):
+        scope = Scope({"var": {"l": []}, "local": {}})
+        with pytest.raises(EvalError):
+            eval_expr("var.l[5]", scope)
+        with pytest.raises(EvalError):
+            eval_expr('"a" + 1', scope)
