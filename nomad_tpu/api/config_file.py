@@ -1,0 +1,134 @@
+"""Agent configuration files.
+
+Reference behavior: command/agent/config.go:39 + config_parse.go —
+the agent loads one or more HCL/JSON config files (or directories),
+merges them in order (later wins), then applies CLI flags on top.
+This module parses the same shape of file into AgentConfig:
+
+    name       = "node-1"
+    region     = "global"
+    datacenter = "dc1"
+    bind_addr  = "0.0.0.0"
+    ports { http = 4646 }
+    server {
+      enabled          = true
+      num_schedulers   = 2
+    }
+    client {
+      enabled    = true
+      node_class = "compute"
+      meta { rack = "r1" }
+    }
+    acl { enabled = true }
+    tls {
+      http      = true
+      ca_file   = "ca.pem"
+      cert_file = "cert.pem"
+      key_file  = "key.pem"
+      verify_https_client = false
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from nomad_tpu.jobspec.hcl import Body, parse
+
+
+def load_config_files(paths: List[str], base=None):
+    """Merge config files/directories into an AgentConfig
+    (config.go LoadConfig/Merge semantics: later files win)."""
+    from nomad_tpu.api.agent import AgentConfig
+
+    cfg = base or AgentConfig()
+    for path in paths:
+        if os.path.isdir(path):
+            entries = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.endswith((".hcl", ".json"))
+            )
+            for entry in entries:
+                cfg = _apply_file(cfg, entry)
+        else:
+            cfg = _apply_file(cfg, path)
+    return cfg
+
+
+def _apply_file(cfg, path: str):
+    with open(path) as f:
+        src = f.read()
+    if path.endswith(".json"):
+        data = json.loads(src)
+        body = _json_to_body(data)
+    else:
+        body = parse(src)
+    return _apply_body(cfg, body)
+
+
+def _json_to_body(data: dict) -> Body:
+    body = Body()
+    for k, v in data.items():
+        if isinstance(v, dict):
+            body.blocks.append((k, [], _json_to_body(v)))
+        else:
+            body.attrs[k] = v
+    return body
+
+
+def _apply_body(cfg, body: Body):
+    a = body.attrs
+    if "name" in a:
+        cfg.name = str(a["name"])
+    if "region" in a:
+        cfg.region = str(a["region"])
+    if "datacenter" in a:
+        cfg.datacenter = str(a["datacenter"])
+    if "bind_addr" in a:
+        cfg.bind_addr = str(a["bind_addr"])
+
+    ports = body.first_block("ports")
+    if ports is not None and "http" in ports[1].attrs:
+        cfg.http_port = int(ports[1].attrs["http"])
+
+    srv = body.first_block("server")
+    if srv is not None:
+        sa = srv[1].attrs
+        if "enabled" in sa:
+            cfg.server_enabled = bool(sa["enabled"])
+        if "num_schedulers" in sa:
+            cfg.num_schedulers = int(sa["num_schedulers"])
+
+    cli = body.first_block("client")
+    if cli is not None:
+        ca = cli[1].attrs
+        if "enabled" in ca:
+            cfg.client_enabled = bool(ca["enabled"])
+        if "node_class" in ca:
+            cfg.node_class = str(ca["node_class"])
+        meta = cli[1].first_block("meta")
+        if meta is not None:
+            cfg.meta = {str(k): str(v) for k, v in meta[1].attrs.items()}
+        elif isinstance(ca.get("meta"), dict):
+            cfg.meta = {str(k): str(v) for k, v in ca["meta"].items()}
+
+    acl = body.first_block("acl")
+    if acl is not None and "enabled" in acl[1].attrs:
+        cfg.acl_enabled = bool(acl[1].attrs["enabled"])
+
+    tls = body.first_block("tls")
+    if tls is not None:
+        ta = tls[1].attrs
+        if ta.get("http") or ta.get("cert_file"):
+            from nomad_tpu.utils.tlsutil import TLSConfig
+            cfg.tls = TLSConfig(
+                enabled=True,
+                ca_file=str(ta.get("ca_file", "")),
+                cert_file=str(ta.get("cert_file", "")),
+                key_file=str(ta.get("key_file", "")),
+                verify_https_client=bool(
+                    ta.get("verify_https_client", False)),
+            )
+    return cfg

@@ -1002,7 +1002,19 @@ def cmd_agent(args) -> int:
     """Run an agent process (command/agent/command.go Run)."""
     from nomad_tpu.api.agent import Agent, AgentConfig
 
-    if args.dev:
+    if args.config:
+        from nomad_tpu.api.config_file import load_config_files
+        try:
+            cfg = load_config_files(args.config)
+        except (OSError, ValueError) as e:
+            return _fail(f"loading config: {e}")
+        if args.dev:
+            cfg.server_enabled = cfg.client_enabled = True
+        cfg.server_enabled = cfg.server_enabled or args.server
+        cfg.client_enabled = cfg.client_enabled or args.client
+        if not (cfg.server_enabled or cfg.client_enabled):
+            return _fail("config enables neither server nor client")
+    elif args.dev:
         cfg = AgentConfig.dev()
     elif not args.server and not args.client:
         return _fail("must specify either -server, -client or -dev")
@@ -1010,12 +1022,18 @@ def cmd_agent(args) -> int:
         cfg = AgentConfig(
             server_enabled=args.server, client_enabled=args.client
         )
+    # explicit flags override config files (config.go merge order);
+    # -bind/-http-port default to None so "flag given" is unambiguous
     if args.name:
         cfg.name = args.name
     cfg.region = args.region or cfg.region
     cfg.datacenter = args.dc or cfg.datacenter
-    cfg.bind_addr = args.bind
-    cfg.http_port = args.http_port
+    if args.bind is not None:
+        cfg.bind_addr = args.bind
+    if args.http_port is not None:
+        cfg.http_port = args.http_port
+    elif cfg.http_port == 0:
+        cfg.http_port = 4646   # reference default port
     if args.tls_cert or args.tls_key:
         if not (args.tls_cert and args.tls_key and args.tls_ca):
             return _fail("TLS needs -tls-ca, -tls-cert and -tls-key")
@@ -1077,8 +1095,10 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-client", action="store_true")
     ag.add_argument("-name", default="")
     ag.add_argument("-dc", default="")
-    ag.add_argument("-bind", default="127.0.0.1")
-    ag.add_argument("-http-port", dest="http_port", type=int, default=4646)
+    ag.add_argument("-bind", default=None)
+    ag.add_argument("-http-port", dest="http_port", type=int, default=None)
+    ag.add_argument("-config", action="append", default=[],
+                    help="config file or directory (repeatable)")
     ag.add_argument("-tls-ca", dest="tls_ca", default="")
     ag.add_argument("-tls-cert", dest="tls_cert", default="")
     ag.add_argument("-tls-key", dest="tls_key", default="")

@@ -35,6 +35,7 @@ class AllocRunner:
         csi_manager=None,
         service_reg=None,
         secrets=None,
+        prev_lookup=None,
     ) -> None:
         self.alloc = alloc
         self.drivers = drivers
@@ -44,6 +45,9 @@ class AllocRunner:
         self.csi_manager = csi_manager
         self.service_reg = service_reg
         self.secrets = secrets
+        # resolves a previous alloc id to its local runner
+        # (allocwatcher; None for client-less/test topologies)
+        self.prev_lookup = prev_lookup
         # tasks whose services are currently registered
         self._registered_tasks: set = set()
         # volume name -> CSIMountInfo (csi_hook.go populates these for
@@ -53,6 +57,12 @@ class AllocRunner:
         self.task_runners: Dict[str, TaskRunner] = {}
         self._lock = threading.Lock()
         self._destroyed = False
+        self._stop_requested = False
+        # True once run()/restore() is past task-runner creation (or
+        # has decided it never will be); _await_previous keys on it so
+        # a same-batch predecessor isn't mistaken for "done" while its
+        # task_runners dict is still empty
+        self._tasks_started = False
         self._waiter: Optional[threading.Thread] = None
         self.task_states: Dict[str, TaskState] = {}
 
@@ -65,8 +75,18 @@ class AllocRunner:
         if tg is None:
             LOG.warning("alloc %s: unknown task group %s",
                         self.alloc.id, self.alloc.task_group)
+            self._tasks_started = True
             return
         os.makedirs(self.alloc_dir, exist_ok=True)
+        # upstream-alloc prerun hook (allocwatcher/alloc_watcher.go):
+        # wait out the previous allocation, then migrate its ephemeral
+        # disk when the group asks for it
+        self._await_previous(tg)
+        if self._destroyed or self._stop_requested:
+            # stopped/GC'd while waiting: never start tasks for a dead
+            # alloc (the wait returns early on both flags)
+            self._tasks_started = True
+            return
         # CSI prerun hook (allocrunner/csi_hook.go): claim + mount each
         # requested volume before any task starts; a claim failure fails
         # the whole alloc
@@ -84,6 +104,7 @@ class AllocRunner:
                         self._on_task_state(
                             task.name, TaskState(state=STATE_DEAD, failed=True)
                         )
+                    self._tasks_started = True
                     return
         # mount paths surface to tasks as env (the reference bind-mounts
         # them into the task via VolumeMounts; env is this build's
@@ -113,6 +134,7 @@ class AllocRunner:
             )
             self.task_runners[task.name] = tr
             tr.start()
+        self._tasks_started = True
         self._watch_done()
 
     def restore(self) -> None:
@@ -121,6 +143,7 @@ class AllocRunner:
         tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
             if self.alloc.job is not None else None
         if tg is None:
+            self._tasks_started = True
             return
         os.makedirs(self.alloc_dir, exist_ok=True)
         for task in tg.tasks:
@@ -160,6 +183,7 @@ class AllocRunner:
             elif local_state is None or local_state.state != STATE_DEAD:
                 # task wasn't running anymore: start fresh
                 tr.start()
+        self._tasks_started = True
         self._watch_done()
 
     def _watch_done(self) -> None:
@@ -323,18 +347,47 @@ class AllocRunner:
             raise PermissionError("secrets directories are not accessible")
         return full
 
+    def _await_previous(self, tg) -> None:
+        """allocwatcher prevAllocWaiter: a replacement alloc
+        (blue/green update, reschedule on the same node) must not start
+        until its predecessor's tasks have stopped; sticky/migrate
+        ephemeral disks then move the old alloc data dir over."""
+        prev_id = self.alloc.previous_allocation
+        if not prev_id or self.prev_lookup is None:
+            return
+        prev = self.prev_lookup(prev_id)
+        if prev is None:
+            return   # remote predecessor or already GC'd locally
+        while not (prev._tasks_started and prev.is_done()) \
+                and not self._destroyed and not self._stop_requested:
+            time.sleep(0.05)
+        if self._destroyed or self._stop_requested:
+            return
+        disk = getattr(tg, "ephemeral_disk", None)
+        if disk is None or not (disk.sticky or disk.migrate):
+            return
+        src = os.path.join(prev.alloc_dir, "alloc")
+        dst = os.path.join(self.alloc_dir, "alloc")
+        if not os.path.isdir(src):
+            return
+        try:
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+            LOG.info("alloc %s: migrated ephemeral disk from %s",
+                     self.alloc.id[:8], prev_id[:8])
+        except OSError as e:
+            LOG.warning("alloc %s: disk migration failed: %s",
+                        self.alloc.id[:8], e)
+
     def task_logs(self, task: str, logtype: str = "stdout",
                   offset: int = 0, limit: int = 0) -> str:
-        """fs_endpoint.go Logs (non-follow read)."""
-        path = self._safe_path(
-            os.path.join("alloc", "logs", f"{task}.{logtype}.0")
+        """fs_endpoint.go Logs (non-follow read): stitches the logmon
+        rotation chain <task>.<type>.N in index order."""
+        from nomad_tpu.client.logmon import read_rotated
+
+        base = self._safe_path(
+            os.path.join("alloc", "logs", f"{task}.{logtype}")
         )
-        if not os.path.exists(path):
-            return ""
-        with open(path, "rb") as f:
-            if offset:
-                f.seek(offset)
-            data = f.read(limit or -1)
+        data = read_rotated(base, offset=offset, limit=limit)
         return data.decode(errors="replace")
 
     def list_dir(self, rel: str = "/") -> List[Dict]:
@@ -424,6 +477,7 @@ class AllocRunner:
             self.stop("alloc stopped by server")
 
     def stop(self, reason: str = "") -> None:
+        self._stop_requested = True
         for tr in self.task_runners.values():
             tr.kill(reason)
 

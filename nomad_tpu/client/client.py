@@ -255,6 +255,11 @@ class Client:
         for ar in runners:
             ar.stop("client shutting down")
 
+    def _prev_runner(self, alloc_id: str):
+        """allocwatcher lookup: the previous alloc's local runner."""
+        with self._alloc_lock:
+            return self.allocs.get(alloc_id)
+
     # --- registration + heartbeat (client.go:1609) ----------------------
 
     def _register(self) -> None:
@@ -265,6 +270,7 @@ class Client:
         self.rpc.update_status(self.node_id, consts.NODE_STATUS_READY)
 
     def _run_heartbeat(self) -> None:
+        self.last_heartbeat_ok = time.time()
         while not self._shutdown.is_set():
             # heartbeat at a fraction of the TTL (client.go heartbeats
             # at intervals inside the server-granted TTL)
@@ -276,18 +282,44 @@ class Client:
                     self.node_id, consts.NODE_STATUS_READY
                 )
                 self.heartbeat_ttl = resp.get("heartbeat_ttl", self.heartbeat_ttl) or self.heartbeat_ttl
+                self.last_heartbeat_ok = time.time()
             except Exception as e:              # noqa: BLE001
                 LOG.warning("client %s: heartbeat failed: %s", self.node_id[:8], e)
+                self._heartbeat_stop_check()
                 # the server may have lost our node (restart, GC):
                 # re-register instead of retrying forever
                 # (client.go retryRegisterNode on "node not found")
                 try:
                     self._register()
+                    self.last_heartbeat_ok = time.time()
                 except Exception as re_err:     # noqa: BLE001
                     LOG.warning(
                         "client %s: re-register failed: %s",
                         self.node_id[:8], re_err,
                     )
+
+    def _heartbeat_stop_check(self) -> None:
+        """heartbeatstop.go: while disconnected from servers, stop any
+        alloc whose group sets stop_after_client_disconnect once the
+        disconnect outlives that duration (the client self-stops so
+        the replacement the server schedules can't double-run)."""
+        away = time.time() - self.last_heartbeat_ok
+        with self._alloc_lock:
+            runners = list(self.allocs.values())
+        for runner in runners:
+            tg = runner.alloc.job.lookup_task_group(runner.alloc.task_group) \
+                if runner.alloc.job is not None else None
+            stop_after = getattr(tg, "stop_after_client_disconnect_s", None) \
+                if tg is not None else None
+            if stop_after is None or away < stop_after:
+                continue
+            if runner.is_done():
+                continue
+            LOG.warning(
+                "client %s: heartbeat lost %.0fs > stop_after_client_"
+                "disconnect; stopping alloc %s",
+                self.node_id[:8], away, runner.alloc.id[:8])
+            runner.stop("heartbeat with servers lost")
 
     # --- allocation watching (client.go:2063, :2293) --------------------
 
@@ -343,6 +375,7 @@ class Client:
             csi_manager=self.csi_manager,
             service_reg=self.service_reg,
             secrets=self.secrets,
+            prev_lookup=self._prev_runner,
         )
         with self._alloc_lock:
             self.allocs[alloc.id] = runner
@@ -413,6 +446,7 @@ class Client:
                 csi_manager=self.csi_manager,
                 service_reg=self.service_reg,
                 secrets=self.secrets,
+                prev_lookup=self._prev_runner,
             )
             with self._alloc_lock:
                 self.allocs[alloc.id] = runner

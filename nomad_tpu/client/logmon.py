@@ -1,0 +1,168 @@
+"""Per-task log collection with rotation (the logmon analog).
+
+Reference behavior: client/logmon/logmon.go runs a separate process
+per task that reads the task's stdout/stderr through FIFOs and writes
+size-rotated files ``<task>.<stream>.N`` (rotator in
+client/lib/fifo + logmon/logging/rotator.go), honoring the task's
+LogConfig (max_files / max_file_size_mb). Here logmon is a thread in
+the client agent reading the same kind of FIFO: the driver (or the
+native executor, which open(2)s the path it is given) writes into the
+FIFO; the reader rotates on size and prunes old indexes. fs 'logs'
+reads concatenate the rotated chain in index order.
+"""
+
+from __future__ import annotations
+
+import errno
+import glob
+import logging
+import os
+import re
+import select
+import threading
+from typing import List, Optional, Tuple
+
+LOG = logging.getLogger(__name__)
+
+
+class LogMon:
+    """One rotating collector for one task stream.
+
+    ``base_path`` is the unsuffixed target (".../web.stdout"); output
+    files are ``base_path.N``. The write side is ``fifo_path`` —
+    hand it to the driver as the task's stdout/stderr path.
+    """
+
+    def __init__(self, base_path: str, max_files: int = 10,
+                 max_file_size_mb: int = 10) -> None:
+        self.base_path = base_path
+        self.fifo_path = base_path + ".fifo"
+        self.max_files = max(1, max_files)
+        self.max_bytes = max(1, max_file_size_mb) * 1024 * 1024
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fd: Optional[int] = None
+        self._idx = 0
+        self._out = None
+        self._written = 0
+
+    def start(self) -> None:
+        os.makedirs(os.path.dirname(self.base_path), exist_ok=True)
+        try:
+            os.mkfifo(self.fifo_path)
+        except FileExistsError:
+            pass
+        # O_RDWR keeps the read end open across writer restarts (task
+        # restarts reopen the FIFO) and makes this open non-blocking
+        self._fd = os.open(self.fifo_path, os.O_RDWR | os.O_NONBLOCK)
+        # resume at the highest existing index (agent restart must not
+        # interleave new output into already-rotated files)
+        existing = rotated_files(self.base_path)
+        if existing:
+            self._idx = int(existing[-1].rsplit(".", 1)[1])
+        self._open_current()
+        if self._written >= self.max_bytes:
+            self._rotate()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"logmon-{os.path.basename(self.base_path)}",
+        )
+        self._thread.start()
+
+    def _open_current(self) -> None:
+        path = f"{self.base_path}.{self._idx}"
+        self._out = open(path, "ab")
+        self._written = self._out.tell()
+
+    def _rotate(self) -> None:
+        self._out.close()
+        self._idx += 1
+        self._open_current()
+        # prune beyond max_files (rotator.go purgeOldFiles)
+        doomed = self._idx - self.max_files
+        if doomed >= 0:
+            try:
+                os.unlink(f"{self.base_path}.{doomed}")
+            except OSError:
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            r, _, _ = select.select([self._fd], [], [], 0.2)
+            if not r:
+                continue
+            try:
+                chunk = os.read(self._fd, 65536)
+            except OSError as e:
+                if e.errno == errno.EAGAIN:
+                    continue
+                break
+            if not chunk:
+                continue
+            self._out.write(chunk)
+            self._out.flush()
+            self._written += len(chunk)
+            if self._written >= self.max_bytes:
+                self._rotate()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        if self._fd is not None:
+            # drain what the writer flushed before it exited — a
+            # fast-exiting task's tail output is still in the FIFO
+            # buffer when the runner stops the collector
+            while True:
+                try:
+                    chunk = os.read(self._fd, 65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                self._out.write(chunk)
+            os.close(self._fd)
+            self._fd = None
+        if self._out is not None:
+            self._out.close()
+            self._out = None
+        try:
+            os.unlink(self.fifo_path)
+        except OSError:
+            pass
+
+
+def read_rotated(base_path: str, offset: int = 0, limit: int = 0) -> bytes:
+    """Concatenated read across the rotation chain ``base.N`` in index
+    order (fs_endpoint.go Logs stitches frames the same way)."""
+    out = []
+    remaining = limit if limit > 0 else None
+    skip = offset
+    for path in rotated_files(base_path):
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        if skip >= size:
+            skip -= size
+            continue
+        with open(path, "rb") as f:
+            if skip:
+                f.seek(skip)
+                skip = 0
+            data = f.read(remaining if remaining is not None else -1)
+        out.append(data)
+        if remaining is not None:
+            remaining -= len(data)
+            if remaining <= 0:
+                break
+    return b"".join(out)
+
+
+def rotated_files(base_path: str) -> List[str]:
+    found: List[Tuple[int, str]] = []
+    for path in glob.glob(base_path + ".*"):
+        m = re.fullmatch(re.escape(base_path) + r"\.(\d+)", path)
+        if m:
+            found.append((int(m.group(1)), path))
+    return [p for _i, p in sorted(found)]

@@ -99,6 +99,9 @@ class TaskRunner:
         self._vault_watch_stop = threading.Event()
         #: token-validity poll cadence (tests shrink this)
         self.vault_poll_interval_s = 5.0
+        # logmon collectors keyed by stream — started in prestart; a
+        # stream whose collector failed falls back to a plain file
+        self._logmons: Dict[str, object] = {}
         self.task_state = TaskState()
         self.handle: Optional[TaskHandle] = None
         policy = restart_policy or RestartPolicy()
@@ -164,6 +167,7 @@ class TaskRunner:
             if self._template_watcher is not None:
                 self._template_watcher.stop()
             self._vault_watch_stop.set()
+            self._stop_logmons()
             self._done.set()
 
     def _run_inner(self) -> None:
@@ -264,8 +268,36 @@ class TaskRunner:
         os.makedirs(os.path.join(task_dir, "secrets"), exist_ok=True)
         os.makedirs(os.path.join(self.alloc_dir, "alloc", "logs"), exist_ok=True)
         self._emit(EVENT_TASK_SETUP, "Building Task Directory")
+        self._logmon_hook()
         self._vault_hook(task_dir)
         self._template_hook(task_dir)
+
+    def _logmon_hook(self) -> None:
+        """logmon_hook.go: one rotating collector per stream; the
+        driver writes into the collector's FIFO."""
+        from nomad_tpu.client.logmon import LogMon
+
+        if self._logmons:
+            return
+        logs = os.path.join(self.alloc_dir, "alloc", "logs")
+        for stream in ("stdout", "stderr"):
+            lm = LogMon(
+                os.path.join(logs, f"{self.task.name}.{stream}"),
+                max_files=self.task.log_config.max_files,
+                max_file_size_mb=self.task.log_config.max_file_size_mb,
+            )
+            try:
+                lm.start()
+            except OSError as e:
+                LOG.warning("task %s: logmon %s failed (%s); driver "
+                            "writes a plain file", self.task_id, stream, e)
+                continue
+            self._logmons[stream] = lm
+
+    def _stop_logmons(self) -> None:
+        for lm in self._logmons.values():
+            lm.stop()
+        self._logmons = {}
 
     def _vault_hook(self, task_dir: str) -> None:
         """vault_hook.go: derive the task's token via the server
@@ -436,6 +468,14 @@ class TaskRunner:
         if self._vault_token and self.task.vault is not None \
                 and self.task.vault.env:
             env["VAULT_TOKEN"] = self._vault_token
+        def stream_path(stream: str) -> str:
+            lm = self._logmons.get(stream)
+            # collector's FIFO when running, plain file otherwise
+            return lm.fifo_path if lm is not None else \
+                os.path.join(logs, f"{self.task.name}.{stream}.0")
+
+        out_path = stream_path("stdout")
+        err_path = stream_path("stderr")
         return TaskConfig(
             id=self.task_id,
             name=self.task.name,
@@ -445,8 +485,8 @@ class TaskRunner:
             env=env,
             driver_config=dict(self.task.config),
             resources=self.task.resources,
-            std_out_path=os.path.join(logs, f"{self.task.name}.stdout.0"),
-            std_err_path=os.path.join(logs, f"{self.task.name}.stderr.0"),
+            std_out_path=out_path,
+            std_err_path=err_path,
             alloc_dir=self.alloc_dir,
         )
 
@@ -467,6 +507,13 @@ class TaskRunner:
         except Exception as e:                  # noqa: BLE001
             LOG.info("task %s: recover failed, restarting: %s", self.task_id, e)
             return False
+        # re-attach the log collectors: the surviving task process
+        # still holds the FIFO open; mkfifo is a no-op and the new
+        # reader resumes draining it
+        try:
+            self._logmon_hook()
+        except Exception:                       # noqa: BLE001
+            pass
         # resume waiting on the recovered task
         self._thread = threading.Thread(
             target=self._run_recovered, daemon=True, name=f"task-{self.task_id}"
@@ -486,6 +533,7 @@ class TaskRunner:
         elif result is not None:
             self._emit(EVENT_TERMINATED, f"exit code {result.exit_code}")
             self._set_state(STATE_DEAD, failed=not result.successful())
+        self._stop_logmons()
         self._done.set()
 
     def restart(self, reason: str = "") -> None:
