@@ -316,6 +316,37 @@ class Allocations(_Endpoint):
         resp = self.c.get(f"/v1/client/fs/logs/{_esc(alloc_id)}", q)
         return resp.get("Data", "")
 
+    def logs_follow(self, alloc_id: str, task: str,
+                    logtype: str = "stdout", offset: int = 0,
+                    timeout: float = 630.0) -> Iterator[bytes]:
+        """?follow=true tail: yields raw byte chunks as they arrive.
+        Byte chunks let callers resume with offset=bytes-seen."""
+        q = QueryOptions()
+        q.params.update({"task": task, "type": logtype, "follow": "true"})
+        if offset:
+            q.params["offset"] = str(offset)
+        # _url stamps region/namespace like every other request
+        url = self.c._url(f"/v1/client/fs/logs/{_esc(alloc_id)}", q)
+        req = urllib.request.Request(
+            url,
+            headers={"X-Nomad-Token": self.c.token} if self.c.token else {},
+        )
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout,
+                    context=self.c._ssl_context) as resp:
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        return
+                    yield chunk
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:                   # noqa: BLE001
+                msg = str(e)
+            raise APIError(e.code, msg) from None
+
     def fs_ls(self, alloc_id: str, path: str = "/",
               q: Optional[QueryOptions] = None) -> List[Dict]:
         q = q or QueryOptions()

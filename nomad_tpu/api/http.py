@@ -261,17 +261,19 @@ class HTTPAgent:
         wait = dict(pairs).get("wait", "")
         hold = parse_duration(wait) if wait else 300.0
         fwd_timeout = min(hold if hold is not None else 300.0, 600.0) + 10.0
-        if parsed.path in self._STREAMING_PATHS:
-            # infinite NDJSON: relay line by line instead of buffering
-            # an unbounded body
+        raw_stream = self._wants_stream(parsed)
+        if parsed.path in self._STREAMING_PATHS or raw_stream:
+            # infinite stream: relay incrementally instead of buffering
+            # an unbounded body (NDJSON line-wise, follow-logs raw);
+            # outlive the remote's 600s stream deadline
             req = urllib.request.Request(url, method=method)
             if token:
                 req.add_header("X-Nomad-Token", token)
             try:
                 with urllib.request.urlopen(
-                        req, timeout=fwd_timeout,
+                        req, timeout=660.0,
                         context=self._fwd_context) as resp:
-                    self._relay_stream(handler, resp)
+                    self._relay_body(handler, resp, raw=raw_stream)
             except (OSError, ValueError, urllib.error.HTTPError) as e:
                 self._send(handler, 502,
                            {"error": f"region {region} unreachable: {e}"})
@@ -354,23 +356,59 @@ class HTTPAgent:
         url = node.http_addr + parsed.path
         if parsed.query:
             url += "?" + parsed.query
+        if self._wants_stream(parsed):
+            req = urllib.request.Request(url, method=method)
+            if token:
+                req.add_header("X-Nomad-Token", token)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=660.0,
+                        context=self._fwd_context) as resp:
+                    self._relay_raw(handler, resp)
+            except (OSError, ValueError, urllib.error.HTTPError) as e:
+                self._send(handler, 502, {"error": f"node unreachable: {e}"})
+            return
         self._proxy(handler, method, url, token, raw_body,
                     unreachable="node")
 
-    def _relay_stream(self, handler, resp) -> None:
-        """Pipe a remote NDJSON stream to the client as it arrives."""
+    @staticmethod
+    def _wants_stream(parsed) -> bool:
+        """Endpoints whose responses never end mid-request: follow-mode
+        log tails (the exact-path streaming set is separate)."""
+        q = urllib.parse.parse_qs(parsed.query)
+        return parsed.path.startswith("/v1/client/fs/logs/") and \
+            (q.get("follow") or [""])[0] not in ("", "false", "0")
+
+    def _relay_raw(self, handler, resp) -> None:
+        self._relay_body(handler, resp, raw=True)
+
+    def _relay_body(self, handler, resp, raw: bool) -> None:
+        """Pipe a remote endless stream through as it arrives — raw
+        byte chunks (follow logs) or NDJSON line-wise (event stream,
+        monitor). Always terminates the chunked framing."""
         try:
             handler.send_response(resp.status)
-            handler.send_header("Content-Type", "application/json")
+            handler.send_header(
+                "Content-Type",
+                resp.headers.get("Content-Type", "application/json"))
             handler.send_header("Transfer-Encoding", "chunked")
             handler.end_headers()
-            for line in resp:
-                handler.wfile.write(f"{len(line):x}\r\n".encode())
-                handler.wfile.write(line + b"\r\n")
-                handler.wfile.flush()
-            handler.wfile.write(b"0\r\n\r\n")
+            if raw:
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    self._write_chunk(handler, chunk)
+            else:
+                for line in resp:
+                    self._write_chunk(handler, line)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
+        finally:
+            self._end_chunks(handler)
+
+    def _relay_stream(self, handler, resp) -> None:
+        self._relay_body(handler, resp, raw=False)
 
     def _send(self, handler, status: int, payload, index=None) -> None:
         """``index`` overrides the stamped X-Nomad-Index (forwarded
@@ -430,6 +468,8 @@ class HTTPAgent:
         add("PUT", r"/v1/jobs", self.job_register)
         add("POST", r"/v1/jobs", self.job_register)
         add("POST", r"/v1/jobs/parse", self.jobs_parse)
+        add("PUT", r"/v1/validate/job", self.job_validate)
+        add("POST", r"/v1/validate/job", self.job_validate)
         add("GET", r"/v1/job/(?P<id>[^/]+)", self.job_get)
         add("POST", r"/v1/job/(?P<id>[^/]+)", self.job_update)
         add("PUT", r"/v1/job/(?P<id>[^/]+)", self.job_update)
@@ -636,6 +676,23 @@ class HTTPAgent:
 
     def job_update(self, req: Request):
         return self.job_register(req)
+
+    def job_validate(self, req: Request):
+        """Job.Validate (job_endpoint.go Validate): structural check
+        without committing anything."""
+        from nomad_tpu.structs.job import Job
+
+        body = req.body or {}
+        if not isinstance(body, dict) or "Job" not in body:
+            raise HTTPError(400, "Job is required")
+        job = decode(body["Job"], Job)
+        errs = job.validate()
+        return {
+            "DriverConfigValidated": True,
+            "ValidationErrors": errs,
+            "Error": "; ".join(errs) if errs else "",
+            "Warnings": "",
+        }
 
     def jobs_parse(self, req: Request):
         from nomad_tpu.jobspec.parse import parse_hcl
@@ -1055,19 +1112,29 @@ class HTTPAgent:
         return StreamedResponse
 
     @staticmethod
-    def _begin_chunked(h):
-        """Start a chunked NDJSON response; returns the frame writer."""
+    def _write_chunk(h, payload: bytes) -> None:
+        h.wfile.write(f"{len(payload):x}\r\n".encode())
+        h.wfile.write(payload + b"\r\n")
+        h.wfile.flush()
+
+    @staticmethod
+    def _end_chunks(h) -> None:
+        """Best-effort terminal chunk so clients see a clean EOF even
+        after a mid-stream error."""
+        try:
+            h.wfile.write(b"0\r\n\r\n")
+            h.wfile.flush()
+        except OSError:
+            pass
+
+    @classmethod
+    def _begin_chunked(cls, h, content_type: str = "application/json"):
+        """Start a chunked response; returns the frame writer."""
         h.send_response(200)
-        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Type", content_type)
         h.send_header("Transfer-Encoding", "chunked")
         h.end_headers()
-
-        def write_chunk(payload: bytes) -> None:
-            h.wfile.write(f"{len(payload):x}\r\n".encode())
-            h.wfile.write(payload + b"\r\n")
-            h.wfile.flush()
-
-        return write_chunk
+        return lambda payload: cls._write_chunk(h, payload)
 
     def agent_monitor(self, req: Request):
         """GET /v1/agent/monitor?log_level=X: stream agent logs as
@@ -1088,11 +1155,11 @@ class HTTPAgent:
                     break
                 obj = {"Data": line} if line else {}
                 write_chunk(json.dumps(obj).encode() + b"\n")
-            h.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
             stop.set()
+            self._end_chunks(h)
         return StreamedResponse
 
     def pprof_goroutine(self, req: Request):
@@ -1519,6 +1586,7 @@ class HTTPAgent:
             pass
         finally:
             sub.close()
+            self._end_chunks(req.handler)
         return StreamedResponse
 
     # -- ACL handlers ----------------------------------------------------
@@ -1666,15 +1734,55 @@ class HTTPAgent:
         runner = self._runner(req, "read-logs")
         task = req.q("task")
         logtype = req.q("type", "stdout")
+        offset = int(req.q("offset", "0") or 0)
+        if req.flag("follow"):
+            return self._stream_fs_logs(req, runner, task, logtype, offset)
         try:
             logs = runner.task_logs(
                 task, logtype,
-                offset=int(req.q("offset", "0") or 0),
+                offset=offset,
                 limit=int(req.q("limit", "0") or 0),
             )
         except PermissionError as e:
             raise HTTPError(403, str(e))
         return {"Data": logs}
+
+    def _stream_fs_logs(self, req: Request, runner, task: str,
+                        logtype: str, offset: int):
+        """?follow=true: raw chunked text that tails the rotation
+        chain until the task is done (fs_endpoint.go Logs follow)."""
+        # probe before committing the 200: bad task names / escaping
+        # paths must 403 like the non-follow read does
+        try:
+            first = runner.task_logs_bytes(task, logtype, offset=offset)
+        except PermissionError as e:
+            raise HTTPError(403, str(e))
+        h = req.handler
+        deadline = time.time() + 600.0
+        try:
+            write_chunk = self._begin_chunked(
+                h, content_type="text/plain; charset=utf-8")
+            pos = offset
+            data = first
+            idle_after_done = 0
+            while time.time() < deadline:
+                if data:
+                    pos += len(data)
+                    write_chunk(data)
+                    idle_after_done = 0
+                else:
+                    if runner.is_done():
+                        # grace passes catch the logmon drain on stop
+                        idle_after_done += 1
+                        if idle_after_done > 2:
+                            break
+                    time.sleep(0.25)
+                data = runner.task_logs_bytes(task, logtype, offset=pos)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self._end_chunks(h)
+        return StreamedResponse
 
     def client_fs_ls(self, req: Request):
         try:

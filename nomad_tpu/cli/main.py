@@ -161,6 +161,24 @@ def cmd_job_plan(args) -> int:
     return 1 if diff.get("Type") not in (None, "", "None") else 0
 
 
+def cmd_job_validate(args) -> int:
+    """job_validate.go: parse + server-side structural validation."""
+    api = make_client(args)
+    try:
+        job = _load_jobfile(args.jobfile, _job_variables(args))
+    except Exception as e:
+        return _fail(f"parsing jobspec: {e}")
+    res = api.put("/v1/validate/job", {"Job": job})
+    errs = res.get("ValidationErrors") or []
+    if errs:
+        print("Job validation errors:")
+        for e in errs:
+            print(f"  * {e}")
+        return 1
+    print("Job validation successful")
+    return 0
+
+
 def cmd_job_status(args) -> int:
     api = make_client(args)
     if not args.job_id:
@@ -416,6 +434,24 @@ def cmd_alloc_stop(args) -> int:
 def cmd_alloc_logs(args) -> int:
     api = make_client(args)
     logtype = "stderr" if args.stderr else "stdout"
+    if args.follow:
+        # reconnect with offset when the server's stream deadline
+        # expires mid-task (command/alloc_logs.go follows until the
+        # task stops)
+        pos = 0
+        try:
+            while True:
+                for chunk in api.allocations.logs_follow(
+                        args.alloc_id, args.task, logtype, offset=pos):
+                    pos += len(chunk)
+                    print(chunk.decode(errors="replace"), end="",
+                          flush=True)
+                alloc = api.allocations.info(args.alloc_id)
+                if alloc.get("ClientStatus") not in ("pending", "running"):
+                    break
+        except (KeyboardInterrupt, APIError):
+            pass
+        return 0
     print(api.allocations.logs(args.alloc_id, args.task, logtype), end="")
     return 0
 
@@ -1118,6 +1154,10 @@ def build_parser() -> argparse.ArgumentParser:
     jp.add_argument("jobfile")
     jp.add_argument("-var", action="append", dest="var")
     jp.set_defaults(fn=cmd_job_plan)
+    jv = job.add_parser("validate")
+    jv.add_argument("jobfile")
+    jv.add_argument("-var", action="append", dest="var")
+    jv.set_defaults(fn=cmd_job_validate)
     js = job.add_parser("status")
     js.add_argument("job_id", nargs="?", default="")
     js.set_defaults(fn=cmd_job_status)
@@ -1204,6 +1244,7 @@ def build_parser() -> argparse.ArgumentParser:
     alog.add_argument("alloc_id")
     alog.add_argument("task")
     alog.add_argument("-stderr", action="store_true")
+    alog.add_argument("-f", dest="follow", action="store_true")
     alog.set_defaults(fn=cmd_alloc_logs)
     ares = alloc.add_parser("restart")
     ares.add_argument("alloc_id")

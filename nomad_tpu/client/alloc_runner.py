@@ -378,17 +378,23 @@ class AllocRunner:
             LOG.warning("alloc %s: disk migration failed: %s",
                         self.alloc.id[:8], e)
 
-    def task_logs(self, task: str, logtype: str = "stdout",
-                  offset: int = 0, limit: int = 0) -> str:
-        """fs_endpoint.go Logs (non-follow read): stitches the logmon
-        rotation chain <task>.<type>.N in index order."""
+    def task_logs_bytes(self, task: str, logtype: str = "stdout",
+                        offset: int = 0, limit: int = 0) -> bytes:
+        """Raw read across the logmon rotation chain
+        <task>.<type>.N in index order."""
         from nomad_tpu.client.logmon import read_rotated
 
         base = self._safe_path(
             os.path.join("alloc", "logs", f"{task}.{logtype}")
         )
-        data = read_rotated(base, offset=offset, limit=limit)
-        return data.decode(errors="replace")
+        return read_rotated(base, offset=offset, limit=limit)
+
+    def task_logs(self, task: str, logtype: str = "stdout",
+                  offset: int = 0, limit: int = 0) -> str:
+        """fs_endpoint.go Logs (non-follow read)."""
+        return self.task_logs_bytes(
+            task, logtype, offset=offset, limit=limit
+        ).decode(errors="replace")
 
     def list_dir(self, rel: str = "/") -> List[Dict]:
         """fs_endpoint.go List."""

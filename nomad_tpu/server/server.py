@@ -316,7 +316,11 @@ class Server:
 
     def job_register(self, job) -> Dict:
         """Job.Register: validate, commit, create+enqueue an eval."""
-        warnings = job.validate() if hasattr(job, "validate") else []
+        errs = job.validate()
+        if errs:
+            # job_endpoint.go Register rejects invalid jobs outright
+            raise ValueError("job validation failed: " + "; ".join(errs))
+        warnings: List[str] = []
         evals = []
         if job.type != consts.JOB_TYPE_CORE and not job.is_periodic() \
                 and not job.is_parameterized():

@@ -18,6 +18,7 @@ from nomad_tpu.structs.consts import (
     JOB_STATUS_PENDING,
     JOB_TYPE_BATCH,
     JOB_TYPE_SERVICE,
+    JOB_TYPE_SYSBATCH,
     JOB_TYPE_SYSTEM,
 )
 from nomad_tpu.structs.constraints import Affinity, Constraint, Spread
@@ -302,6 +303,73 @@ class Job:
     multiregion: Optional[Dict] = None
     consul_token: str = ""
     vault_token: str = ""
+
+    def validate(self) -> List[str]:
+        """structs.go Job.Validate: returns a list of validation error
+        strings (empty = valid). Mirrors the reference's checks: ids,
+        type, priority bounds, task-group/task structure and name
+        uniqueness, periodic/parameterized exclusivity."""
+        errs: List[str] = []
+        if not self.id:
+            errs.append("missing job ID")
+        elif " " in self.id:
+            errs.append("job ID contains a space")
+        if not self.name:
+            errs.append("missing job name")
+        if self.type not in (JOB_TYPE_SERVICE, JOB_TYPE_BATCH,
+                             JOB_TYPE_SYSTEM, JOB_TYPE_SYSBATCH):
+            errs.append(f"invalid job type: {self.type!r}")
+        if not 1 <= self.priority <= 100:
+            errs.append(f"job priority must be between 1 and 100, "
+                        f"got {self.priority}")
+        if not self.datacenters:
+            errs.append("job must specify at least one datacenter")
+        if not self.task_groups:
+            errs.append("missing job task groups")
+            return errs   # nested checks need groups (null-safe)
+        if self.periodic is not None and self.parameterized is not None:
+            errs.append("job can't be both periodic and parameterized")
+        seen = set()
+        for i, tg in enumerate(self.task_groups):
+            if tg is None:
+                errs.append(f"task group {i + 1} is null")
+                continue
+            label = tg.name or f"task group {i + 1}"
+            if not tg.name:
+                errs.append(f"task group {i + 1} missing name")
+            elif tg.name in seen:
+                errs.append(f"duplicate task group name {tg.name!r}")
+            seen.add(tg.name)
+            if tg.count < 0:
+                errs.append(f"group {label}: count must be >= 0")
+            if self.type == JOB_TYPE_SYSTEM and tg.count > 1:
+                errs.append(
+                    f"group {label}: system jobs can't have a count > 1")
+            if not tg.tasks:
+                errs.append(f"group {label}: missing tasks")
+            task_names = set()
+            for j, task in enumerate(tg.tasks or []):
+                if task is None:
+                    errs.append(f"group {label}: task {j + 1} is null")
+                    continue
+                tlabel = task.name or f"task {j + 1}"
+                if not task.name:
+                    errs.append(f"group {label}: task {j + 1} missing name")
+                elif task.name in task_names:
+                    errs.append(
+                        f"group {label}: duplicate task name {task.name!r}")
+                task_names.add(task.name)
+                if not task.driver:
+                    errs.append(f"group {label}, task {tlabel}: "
+                                "missing driver")
+                res = task.resources
+                if res is not None and (res.cpu < 0 or res.memory_mb < 0):
+                    errs.append(f"group {label}, task {tlabel}: "
+                                "resources must be non-negative")
+        for c in self.constraints or []:
+            if c is not None and not c.operand:
+                errs.append("constraint missing operand")
+        return errs
 
     def namespaced_id(self) -> str:
         return f"{self.namespace}@{self.id}"
