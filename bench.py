@@ -49,6 +49,32 @@ def run_baseline() -> dict:
     return json.loads(proc.stdout)
 
 
+def time_batches(step, shared, used_cpu, used_mem, asks, n_steps,
+                 n_batches: int, reps: int = 3):
+    """Shared timing harness (also used by bench/grid.py): best-of-N
+    reps of ``n_batches`` fused schedule+apply launches; fresh staging
+    each rep because the step donates the utilization planes.
+
+    Returns (best_dt_seconds, last_out).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    best_dt = float("inf")
+    out = None
+    for _rep in range(reps):
+        uc, um = jnp.asarray(used_cpu), jnp.asarray(used_mem)
+        out, uc, um = step(shared, uc, um, asks[0][0], asks[0][1], n_steps)
+        jax.block_until_ready((out, uc, um))
+        t0 = time.perf_counter()
+        for i in range(1, n_batches + 1):
+            out, uc, um = step(shared, uc, um, asks[i][0], asks[i][1],
+                               n_steps)
+        jax.block_until_ready((out, uc, um))
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    return best_dt, out
+
+
 def run_tpu() -> dict:
     import jax
     import jax.numpy as jnp
@@ -93,19 +119,8 @@ def run_tpu() -> dict:
         for _ in range(N_BATCHES + 1)
     ]
 
-    # best-of-N repetitions (first rep absorbs compile + cache warmup;
-    # later reps measure the steady-state the server actually runs in)
-    best_dt = float("inf")
-    for _rep in range(3):
-        # fresh staging each rep: the step donates these buffers
-        uc, um = jnp.asarray(used_cpu), jnp.asarray(used_mem)
-        out, uc, um = step(shared, uc, um, asks[0][0], asks[0][1], n_steps)
-        jax.block_until_ready((out, uc, um))
-        t0 = time.perf_counter()
-        for i in range(1, N_BATCHES + 1):
-            out, uc, um = step(shared, uc, um, asks[i][0], asks[i][1], n_steps)
-        jax.block_until_ready((out, uc, um))
-        best_dt = min(best_dt, time.perf_counter() - t0)
+    best_dt, out = time_batches(
+        step, shared, used_cpu, used_mem, asks, n_steps, N_BATCHES)
 
     found = np.asarray(out.found)
     scores = np.asarray(out.scores)

@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Scheduler throughput benchmark grid.
+
+Reference behavior: scheduler/benchmarks/benchmarks_test.go:71-124
+runs a grid of {1k,5k,10k nodes} x {10,25,50,75 racks} x
+{300..1200 allocs} x {spread, no-spread} and reports evals/sec per
+cell. Same grid against the TPU batched placement path: the allocs
+axis preloads that many existing allocations of cluster utilization
+(the reference's upsertAllocs step), racks set the spread-bucket
+cardinality, and the spread variants compile the spread-scoring
+kernel variant. Timing machinery is shared with the headline bench
+(bench.time_batches).
+
+Usage:  python bench/grid.py [--quick]
+Prints one JSON line per cell plus a summary line.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import time_batches  # noqa: E402
+
+PLACEMENTS_PER_EVAL = 10
+BATCH = 64
+TIMED_BATCHES = 30     # amortizes per-dispatch latency
+
+
+def run_cell(n_nodes: int, racks: int, n_allocs: int, spread: bool) -> dict:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nomad_tpu.ops.kernel import LEAN_FEATURES, build_kernel_in
+    from nomad_tpu.parallel.batching import (
+        device_put_shared, make_schedule_apply_step,
+    )
+    from nomad_tpu.parallel.synthetic import synthetic_cluster, synthetic_eval
+
+    rng = np.random.default_rng(11)
+    cluster = synthetic_cluster(n_nodes, cpu=3900.0, mem=7936.0,
+                                disk=98304.0, seed=11, n_racks=racks)
+    ev = synthetic_eval(cluster, desired_count=PLACEMENTS_PER_EVAL,
+                        with_spread=spread)
+    shared = device_put_shared(
+        build_kernel_in(cluster, ev, PLACEMENTS_PER_EVAL))
+    features = LEAN_FEATURES if not spread else \
+        LEAN_FEATURES._replace(n_spreads=1)
+    step = make_schedule_apply_step(PLACEMENTS_PER_EVAL, features)
+
+    npad = cluster.n_pad
+    n_steps = jnp.asarray(np.full(BATCH, PLACEMENTS_PER_EVAL, np.int32))
+    # the allocs axis: preload n_allocs existing 500MHz/256MB allocs
+    # onto random nodes (benchmarks_test.go upsertAllocs) so each cell
+    # schedules against a differently-packed cluster
+    used_cpu = np.zeros(npad, np.float32)
+    used_mem = np.zeros(npad, np.float32)
+    homes = rng.integers(0, n_nodes, size=n_allocs)
+    np.add.at(used_cpu, homes, 500.0)
+    np.add.at(used_mem, homes, 256.0)
+    asks = [
+        (jnp.asarray(rng.choice([250.0, 500.0, 750.0], BATCH)
+                     .astype(np.float32)),
+         jnp.asarray(rng.choice([128.0, 256.0, 512.0], BATCH)
+                     .astype(np.float32)))
+        for _ in range(TIMED_BATCHES + 1)
+    ]
+
+    best_dt, out = time_batches(
+        step, shared, used_cpu, used_mem, asks, n_steps,
+        TIMED_BATCHES, reps=2)
+    evals = BATCH * TIMED_BATCHES
+    return {
+        "nodes": n_nodes, "racks": racks, "allocs": n_allocs,
+        "spread": spread,
+        "evals_per_sec": round(evals / best_dt, 1),
+        "placed_last_batch": int(np.asarray(out.found).sum()),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="one small cell per variant")
+    args = ap.parse_args()
+
+    if args.quick:
+        grid = [(1000, 10, 300, False), (1000, 10, 300, True)]
+    else:
+        grid = [
+            (nodes, racks, allocs, spread)
+            for nodes in (1000, 5000, 10000)
+            for racks in (10, 25, 50, 75)
+            for allocs in (300, 600, 900, 1200)
+            for spread in (False, True)
+        ]
+    results = []
+    for nodes, racks, allocs, spread in grid:
+        cell = run_cell(nodes, racks, allocs, spread)
+        results.append(cell)
+        print(json.dumps(cell), flush=True)
+    print(json.dumps({
+        "metric": "bench grid summary",
+        "cells": len(results),
+        "min_evals_per_sec": min(r["evals_per_sec"] for r in results),
+        "max_evals_per_sec": max(r["evals_per_sec"] for r in results),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -906,11 +906,13 @@ class HTTPAgent:
         enable = spec is not None
         strategy = None
         if enable:
-            strategy = {
-                "deadline_s": float(spec.get("Deadline", 0)) / 1e9
-                if spec.get("Deadline") else 0.0,
-                "ignore_system_jobs": bool(spec.get("IgnoreSystemJobs", False)),
-            }
+            from nomad_tpu.server.drainer import DrainStrategy
+            strategy = DrainStrategy(
+                deadline_s=float(spec.get("Deadline", 0)) / 1e9
+                if spec.get("Deadline") else 3600.0,
+                ignore_system_jobs=bool(spec.get("IgnoreSystemJobs",
+                                                 False)),
+            )
         index = self._server.node_update_drain(req.params["id"], enable, strategy)
         return {"EvalIDs": [], "EvalCreateIndex": index, "NodeModifyIndex": index}
 

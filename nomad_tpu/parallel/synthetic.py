@@ -32,6 +32,7 @@ def synthetic_cluster(
     disk: float = 100 * 1024.0,
     seed: int = 0,
     n_pad: Optional[int] = None,
+    n_racks: int = 50,
 ) -> ClusterTensors:
     """Node planes without the structs round-trip (bench fast path).
 
@@ -57,7 +58,7 @@ def synthetic_cluster(
     free_dyn = np.zeros(npad, np.int32)
     free_dyn[:n_nodes] = 12001
     ids = [f"node-{i:06d}" for i in range(n_nodes)]
-    racks = rng.integers(0, 50, size=n_nodes)
+    racks = rng.integers(0, n_racks, size=n_nodes)
     return ClusterTensors(
         n_real=n_nodes,
         n_pad=npad,

@@ -24,18 +24,9 @@ from nomad_tpu.structs.eval_plan import Evaluation
 LOG = logging.getLogger(__name__)
 
 
-class DrainStrategy:
-    """structs.go DrainStrategy/DrainSpec."""
-
-    def __init__(self, deadline_s: float = 3600.0,
-                 ignore_system_jobs: bool = False) -> None:
-        self.deadline_s = deadline_s
-        self.ignore_system_jobs = ignore_system_jobs
-        self.started_at = time.time()
-
-    def deadline_passed(self) -> bool:
-        return self.deadline_s > 0 and \
-            time.time() > self.started_at + self.deadline_s
+# DrainStrategy lives with the node structs (wire shape); re-exported
+# here for existing importers
+from nomad_tpu.structs.node import DrainStrategy  # noqa: E402,F401
 
 
 class NodeDrainer:

@@ -62,7 +62,7 @@ class Node:
     status: str = NODE_STATUS_INIT
     scheduling_eligibility: str = NODE_SCHEDULING_ELIGIBLE
     drain: bool = False
-    drain_strategy: Optional[Dict] = None
+    drain_strategy: Optional["DrainStrategy"] = None
     status_description: str = ""
     http_addr: str = ""
     secret_id: str = ""
@@ -100,6 +100,9 @@ class Node:
         attributes (``unique.``-prefixed) are excluded.
         """
         h = hashlib.sha256()
+        # datacenter is class-relevant: ${node.datacenter} constraints
+        # are checked per class representative (node_class.go hashes it)
+        h.update(self.datacenter.encode())
         h.update(self.node_class.encode())
         h.update(self.node_pool.encode())
         for k in sorted(self.attributes):
@@ -137,3 +140,20 @@ class Node:
             "SchedulingEligibility": self.scheduling_eligibility,
             "Drain": self.drain,
         }
+
+
+class DrainStrategy:
+    """structs.go DrainStrategy/DrainSpec: how long a drain may take
+    and whether system jobs are left alone."""
+
+    def __init__(self, deadline_s: float = 3600.0,
+                 ignore_system_jobs: bool = False) -> None:
+        import time as _time
+        self.deadline_s = deadline_s
+        self.ignore_system_jobs = ignore_system_jobs
+        self.started_at = _time.time()
+
+    def deadline_passed(self) -> bool:
+        import time as _time
+        return self.deadline_s > 0 and \
+            _time.time() > self.started_at + self.deadline_s

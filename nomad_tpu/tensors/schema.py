@@ -27,7 +27,7 @@ import numpy as np
 MAX_RESERVED_PORT_ASKS = 16   # reserved-port asks per task group
 MAX_DEV_REQS = 4              # device requests per task group
 MAX_SPREADS = 4               # spread stanzas per task group (job+tg merged)
-SPREAD_BUCKETS = 64           # distinct attribute values per spread stanza
+SPREAD_BUCKETS = 128          # distinct attribute values per spread stanza
 PORT_WORDS = 65536 // 32      # u32 words covering the port space
 
 

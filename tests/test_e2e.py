@@ -1,0 +1,192 @@
+"""End-to-end scenario suite, driven through the public HTTP API.
+
+Reference behavior: e2e/ runs per-component scenario suites against a
+real cluster (affinities, spread, drain, rescheduling, deployments;
+e2e/framework). Here the cluster is one in-process agent
+(server+client) plus a second client node, and every action goes
+through the HTTP API the way an operator's CLI would.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.api.codec import encode
+from nomad_tpu.client.client import Client, ClientConfig, InProcessRPC
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.job import UpdateStrategy
+
+
+def _wait(fn, timeout=30.0, every=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if fn():
+                return True
+        except Exception:                       # noqa: BLE001
+            pass
+        time.sleep(every)
+    return False
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """server+client agent plus a second client node, HTTP in front."""
+    agent = Agent(AgentConfig(name="e2e", num_schedulers=1,
+                              client_enabled=True))
+    agent.client.config.data_dir = str(tmp_path / "c1")
+    agent.start()
+    c2 = Client(InProcessRPC(agent.server),
+                ClientConfig(data_dir=str(tmp_path / "c2"),
+                             datacenter="dc2"))
+    c2.start()
+    api = APIClient(agent.http_addr)
+    assert _wait(lambda: len(api.get("/v1/nodes")) == 2)
+    yield agent, c2, api
+    c2.shutdown()
+    agent.shutdown()
+
+
+def _service_job(count=2, run_for="120s"):
+    job = mock.job()
+    job.task_groups[0].count = count
+    task = job.task_groups[0].tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": run_for}
+    return job
+
+
+def _running(api, job_id):
+    return [a for a in api.get(f"/v1/job/{job_id}/allocations")
+            if a["ClientStatus"] == "running"]
+
+
+class TestE2ELifecycle:
+    def test_submit_scale_stop_via_http(self, cluster):
+        agent, c2, api = cluster
+        hcl = '''
+        job "http-e2e" {
+          datacenters = ["dc1", "dc2"]
+          group "app" {
+            count = 2
+            task "t" {
+              driver = "mock_driver"
+              config { run_for = "120s" }
+            }
+          }
+        }
+        '''
+        parsed = api.post("/v1/jobs/parse", {"JobHCL": hcl})
+        api.jobs.register(parsed)
+        assert _wait(lambda: len(_running(api, "http-e2e")) == 2)
+
+        # scale up through the API
+        api.post("/v1/job/http-e2e/scale",
+                 {"Target": {"Group": "app"}, "Count": 4})
+        assert _wait(lambda: len(_running(api, "http-e2e")) == 4)
+
+        # stop; allocs drain to complete
+        api.delete("/v1/job/http-e2e")
+        assert _wait(lambda: not _running(api, "http-e2e"))
+
+    def test_failed_task_rescheduled(self, cluster):
+        agent, c2, api = cluster
+        job = mock.job()
+        job.task_groups[0].count = 1
+        from nomad_tpu.structs.job import ReschedulePolicy, RestartPolicy
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=3, interval_s=300.0, delay_s=0.1,
+            delay_function="constant")
+        job.task_groups[0].restart_policy = RestartPolicy(
+            attempts=0, mode="fail")
+        task = job.task_groups[0].tasks[0]
+        task.driver = "mock_driver"
+        task.config = {"run_for": "0.1s", "exit_code": 1}
+        api.jobs.register(encode(job))
+        # a replacement allocation appears after the failure
+        assert _wait(lambda: len(
+            api.get(f"/v1/job/{job.id}/allocations")) >= 2, timeout=40)
+
+
+class TestE2EDrain:
+    def test_drain_migrates_allocs(self, cluster):
+        agent, c2, api = cluster
+        job = _service_job(count=4)
+        job.datacenters = ["dc1", "dc2"]
+        api.jobs.register(encode(job))
+        assert _wait(lambda: len(_running(api, job.id)) == 4)
+
+        # drain the agent's own node via the API
+        node_id = agent.client.node_id
+        before = {a["NodeID"] for a in _running(api, job.id)}
+        assert node_id in before, "expected allocs on the drained node"
+        api.post(f"/v1/node/{node_id}/drain",
+                 {"DrainSpec": {"Deadline": 60_000_000_000}})
+        # all four end up running on the other node
+        assert _wait(lambda: (
+            len(_running(api, job.id)) == 4
+            and {a["NodeID"] for a in _running(api, job.id)}
+            == {c2.node_id}
+        ), timeout=60), "drain did not migrate all allocs"
+
+
+class TestE2EDeployment:
+    def test_rolling_update_deployment_succeeds(self, cluster):
+        agent, c2, api = cluster
+        job = _service_job(count=2)
+        job.datacenters = ["dc1", "dc2"]
+        job.task_groups[0].update = UpdateStrategy(
+            max_parallel=1, min_healthy_time_s=0.1,
+            healthy_deadline_s=30.0, canary=0)
+        api.jobs.register(encode(job))
+        assert _wait(lambda: len(_running(api, job.id)) == 2)
+
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].env = {"V": "2"}
+        api.jobs.register(encode(job2))
+        # the v1 deployment rolls to successful and both running
+        # allocs are on the new version (v0's deployment also reads
+        # "successful"; key on JobVersion)
+        def rollout_done():
+            deps = api.get(f"/v1/job/{job.id}/deployments")
+            ok = any(d.get("Status") == "successful"
+                     and d.get("JobVersion") == 1 for d in deps)
+            allocs = _running(api, job.id)
+            return ok and len(allocs) == 2 and \
+                all(a["JobVersion"] == 1 for a in allocs)
+        assert _wait(rollout_done, timeout=60), (
+            api.get(f"/v1/job/{job.id}/deployments"),
+            _running(api, job.id))
+
+
+class TestE2EPlacement:
+    def test_datacenter_spread(self, cluster):
+        agent, c2, api = cluster
+        from nomad_tpu.structs.constraints import Spread, SpreadTarget
+        job = _service_job(count=4)
+        job.datacenters = ["dc1", "dc2"]
+        job.spreads = [Spread(
+            attribute="${node.datacenter}", weight=100,
+            spread_target=[SpreadTarget(value="dc1", percent=50),
+                           SpreadTarget(value="dc2", percent=50)])]
+        api.jobs.register(encode(job))
+        assert _wait(lambda: len(_running(api, job.id)) == 4)
+        by_node = {}
+        for a in _running(api, job.id):
+            by_node[a["NodeID"]] = by_node.get(a["NodeID"], 0) + 1
+        assert sorted(by_node.values()) == [2, 2], by_node
+
+    def test_constraint_pins_datacenter(self, cluster):
+        agent, c2, api = cluster
+        from nomad_tpu.structs.constraints import Constraint
+        job = _service_job(count=2)
+        job.datacenters = ["dc1", "dc2"]
+        job.constraints = [Constraint(
+            ltarget="${node.datacenter}", operand="=", rtarget="dc2")]
+        api.jobs.register(encode(job))
+        assert _wait(lambda: len(_running(api, job.id)) == 2)
+        assert all(a["NodeID"] == c2.node_id
+                   for a in _running(api, job.id))
