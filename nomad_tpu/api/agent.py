@@ -29,6 +29,14 @@ class AgentConfig:
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
     tls: Optional[object] = None   # utils.tlsutil.TLSConfig
+    # HA server mode (server.go setupRaft + serf-discovered peers; here
+    # a static peer set, the reference's server_join/retry_join shape):
+    # raft_peers lists every server's raft address host:port, this
+    # agent's included
+    raft_port: int = 0             # 0 = ephemeral
+    raft_peers: List[str] = field(default_factory=list)
+    #: address peers dial (host:port); required when binding 0.0.0.0
+    raft_advertise: str = ""
 
     @classmethod
     def dev(cls) -> "AgentConfig":
@@ -75,6 +83,39 @@ class Agent:
             name=self.config.name,
         )
         self.server = Server(cfg)
+        self.raft_transport = None
+        if self.config.raft_peers:
+            # HA: raft over TCP between server agents (server.go:1228
+            # setupRaft over the RaftLayer; peers here are static the
+            # way retry_join server addresses are)
+            from nomad_tpu.raft.node import RaftConfig
+            from nomad_tpu.raft.transport import TcpTransport
+
+            self.raft_transport = TcpTransport(
+                self.config.bind_addr, self.config.raft_port)
+            # the raft identity must be the address PEERS can dial;
+            # a wildcard bind needs an explicit advertise address or
+            # it would join as an undialable phantom member
+            self_addr = self.config.raft_advertise or self.raft_transport.addr
+            if self_addr.split(":")[0] in ("0.0.0.0", "::"):
+                raise ValueError(
+                    "raft over a wildcard bind needs raft_advertise "
+                    "set to the address peers dial")
+            peers = list(self.config.raft_peers)
+            if self_addr not in peers:
+                peers.append(self_addr)
+            self.server.setup_raft(
+                node_id=self_addr,
+                peers=peers,
+                transport=self.raft_transport,
+                # python control plane: generous timeouts so GIL-holding
+                # compiles don't churn elections (server/testing.py)
+                raft_config=RaftConfig(
+                    heartbeat_interval=0.05,
+                    election_timeout_min=0.30,
+                    election_timeout_max=0.60,
+                ),
+            )
         if self.config.acl_enabled:
             from nomad_tpu.acl.resolver import TokenResolver
 
@@ -120,6 +161,7 @@ class Agent:
             self.client.shutdown()
         if self.server is not None:
             self.server.shutdown()
+        # raft transport is closed by RaftNode.shutdown (one owner)
         if self.http is not None:
             self.http.shutdown()
 

@@ -100,6 +100,12 @@ def _apply_body(cfg, body: Body):
             cfg.server_enabled = bool(sa["enabled"])
         if "num_schedulers" in sa:
             cfg.num_schedulers = int(sa["num_schedulers"])
+        if "raft_port" in sa:
+            cfg.raft_port = int(sa["raft_port"])
+        if "raft_peers" in sa:
+            cfg.raft_peers = [str(p) for p in sa["raft_peers"]]
+        if "raft_advertise" in sa:
+            cfg.raft_advertise = str(sa["raft_advertise"])
 
     cli = body.first_block("client")
     if cli is not None:

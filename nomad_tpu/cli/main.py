@@ -1070,6 +1070,10 @@ def cmd_agent(args) -> int:
         cfg.http_port = args.http_port
     elif cfg.http_port == 0:
         cfg.http_port = 4646   # reference default port
+    if args.raft_peers:
+        cfg.raft_peers = list(args.raft_peers)
+    if args.raft_port is not None:
+        cfg.raft_port = args.raft_port
     if args.tls_cert or args.tls_key:
         if not (args.tls_cert and args.tls_key and args.tls_ca):
             return _fail("TLS needs -tls-ca, -tls-cert and -tls-key")
@@ -1135,6 +1139,10 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-http-port", dest="http_port", type=int, default=None)
     ag.add_argument("-config", action="append", default=[],
                     help="config file or directory (repeatable)")
+    ag.add_argument("-raft-port", dest="raft_port", type=int, default=None)
+    ag.add_argument("-raft-peer", dest="raft_peers", action="append",
+                    default=[], help="raft address of a server peer "
+                    "(repeatable; enables HA mode)")
     ag.add_argument("-tls-ca", dest="tls_ca", default="")
     ag.add_argument("-tls-cert", dest="tls_cert", default="")
     ag.add_argument("-tls-key", dest="tls_key", default="")

@@ -298,7 +298,21 @@ class Server:
                 return self.raft.apply(msg_type, req)
             except NotLeaderError:
                 pass   # lost leadership mid-apply: route to the new one
-        return self.raft.forward_apply(msg_type, req)
+        result = self.raft.forward_apply(msg_type, req)
+        if isinstance(result, int):
+            # read-your-writes: the reference forwards the WHOLE RPC so
+            # follow-up reads hit leader state; here the caller reads
+            # local state next, so wait for the local FSM to reach the
+            # committed index before returning — and fail loudly rather
+            # than hand back stale state
+            deadline = time.time() + 5.0
+            while self.state.latest_index() < result:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"local state lagging committed raft index "
+                        f"{result} after forward")
+                time.sleep(0.002)
+        return result
 
     def snapshot_min_index(self, index: int, timeout: float = 5.0):
         """worker.go:537 SnapshotMinIndex: wait for local state to reach
