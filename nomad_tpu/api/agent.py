@@ -170,12 +170,50 @@ class Agent:
         return self.http.addr
 
     def members(self) -> List[Dict]:
+        """serf.go Members: this server plus (in HA mode) its raft
+        peers — the static-peer analog of gossip membership. The Addr
+        column is the raft (server-to-server) address throughout; the
+        HTTP address rides in Tags like the reference's rpc_addr."""
+        import time as _time
+
         serf = getattr(self, "_serf", None)
         if serf is not None:
             return serf.members()
-        return [{
+        tags = {"region": self.config.region,
+                "dc": self.config.datacenter,
+                "http_addr": self.http.addr if self.http else ""}
+        raft = self.server.raft if self.server is not None else None
+        if raft is None:
+            return [{
+                "Name": self.config.name, "Status": "alive",
+                "Addr": self.http.addr if self.http else "",
+                "Leader": bool(self.server is not None
+                               and self.server.is_leader()),
+                "Tags": tags,
+            }]
+        leader = raft.leader_addr()
+        out = [{
             "Name": self.config.name, "Status": "alive",
-            "Addr": self.http.addr if self.http else "",
-            "Tags": {"region": self.config.region,
-                     "dc": self.config.datacenter},
+            "Addr": raft.id,
+            "Leader": raft.id == leader,
+            "Tags": tags,
         }]
+        now = _time.monotonic()
+        for peer in raft.peers:
+            # a peer is failed when it hasn't answered in several
+            # election timeouts (only the leader appends entries, so a
+            # follower's view of its peers may simply be unobserved)
+            seen = raft.peer_last_contact.get(peer)
+            if raft.is_leader():
+                status = "alive" if seen is not None \
+                    and now - seen < 3.0 else "failed"
+            else:
+                status = "alive" if peer == leader or (
+                    seen is not None and now - seen < 3.0) else "unknown"
+            out.append({
+                "Name": peer, "Status": status,
+                "Addr": peer,
+                "Leader": peer == leader,
+                "Tags": dict(tags, http_addr=""),
+            })
+        return out

@@ -1074,6 +1074,8 @@ def cmd_agent(args) -> int:
         cfg.raft_peers = list(args.raft_peers)
     if args.raft_port is not None:
         cfg.raft_port = args.raft_port
+    if args.raft_advertise:
+        cfg.raft_advertise = args.raft_advertise
     if args.tls_cert or args.tls_key:
         if not (args.tls_cert and args.tls_key and args.tls_ca):
             return _fail("TLS needs -tls-ca, -tls-cert and -tls-key")
@@ -1143,6 +1145,9 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-raft-peer", dest="raft_peers", action="append",
                     default=[], help="raft address of a server peer "
                     "(repeatable; enables HA mode)")
+    ag.add_argument("-raft-advertise", dest="raft_advertise", default="",
+                    help="address peers dial this server's raft on "
+                    "(required with a wildcard -bind)")
     ag.add_argument("-tls-ca", dest="tls_ca", default="")
     ag.add_argument("-tls-cert", dest="tls_cert", default="")
     ag.add_argument("-tls-key", dest="tls_key", default="")

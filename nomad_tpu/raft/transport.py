@@ -117,6 +117,7 @@ class TcpTransport:
         self._thread.start()
         self._conn_lock = threading.Lock()
         self._conns: Dict[str, socket.socket] = {}
+        self._closed = False
         # one in-flight request per target connection: concurrent sends
         # on a shared socket would interleave frames / cross responses
         self._target_locks: Dict[str, threading.Lock] = {}
@@ -125,6 +126,11 @@ class TcpTransport:
         self._handler = handler
 
     def _dispatch(self, method: str, req: Dict) -> Dict:
+        if self._closed:
+            # server.shutdown() only stops NEW connections; handler
+            # threads for established peer connections would keep
+            # answering and make a stopped node look alive
+            raise ConnectionError("transport closed")
         if self._handler is None:
             raise ConnectionError("handler not installed")
         return self._handler(method, req)
@@ -171,6 +177,7 @@ class TcpTransport:
         return buf
 
     def close(self) -> None:
+        self._closed = True
         self._server.shutdown()
         self._server.server_close()
         with self._conn_lock:
