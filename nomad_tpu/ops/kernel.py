@@ -226,7 +226,8 @@ def _feasible(kin: KernelIn, st, f: KernelFeatures) -> tuple:
     )
 
 
-def _score(kin: KernelIn, st, ask_cpu_total, penalty, f: KernelFeatures) -> tuple:
+def _score(kin: KernelIn, st, ask_cpu_total, penalty,
+           f: KernelFeatures, spread_onehot=None) -> tuple:
     """Score planes + appended-mask normalization (rank.go semantics)."""
     util_cpu = st["used_cpu"] + ask_cpu_total
     util_mem = st["used_mem"] + kin.ask_mem
@@ -270,7 +271,7 @@ def _score(kin: KernelIn, st, ask_cpu_total, penalty, f: KernelFeatures) -> tupl
 
     # spread (spread.go:116-245)
     if f.n_spreads > 0:
-        spread_total = _spread_score(kin, st, f.n_spreads)
+        spread_total = _spread_score(kin, st, spread_onehot, f.n_spreads)
         spread_on = spread_total != 0.0
         score_sum = score_sum + jnp.where(spread_on, spread_total, 0.0)
         nplanes = nplanes + spread_on.astype(jnp.float32)
@@ -278,43 +279,55 @@ def _score(kin: KernelIn, st, ask_cpu_total, penalty, f: KernelFeatures) -> tupl
     return score_sum / nplanes
 
 
-def _spread_score(kin: KernelIn, st, n_spreads: int) -> jnp.ndarray:
-    """Sum of per-stanza spread boosts for every node."""
+def _spread_score(kin: KernelIn, st, spread_onehot,
+                  n_spreads: int) -> jnp.ndarray:
+    """Sum of per-stanza spread boosts for every node.
+
+    TPU formulation: boosts are a function of the node's BUCKET, so
+    compute them over the tiny bucket axis (B=SPREAD_BUCKETS) and
+    scatter to nodes with one one-hot matmul per stanza — the MXU
+    replaces a 10k-wide gather (2x faster measured, and the
+    bucket-axis math is ~100x narrower than node-axis math)."""
     n = kin.cap_cpu.shape[0]
     total = jnp.zeros(n, jnp.float32)
     counts = st["spread_counts"]  # [S, B]
     for s in range(n_spreads):     # static unroll, S is tiny
-        bucket = kin.spread_bucket[s]            # i32[N], -1 missing
-        missing = bucket < 0
-        b_safe = jnp.clip(bucket, 0, SPREAD_BUCKETS - 1)
-        cnt = counts[s][b_safe]                  # f32[N]
+        counts_b = counts[s]                     # f32[B]
         # -- desired-count path (spread.go:158-183): usedCount+1 --
-        des = kin.spread_desired[s][b_safe]
-        desired_boost = jnp.where(
-            des > 0.0,
-            ((des - (cnt + 1.0)) / des) * kin.spread_weight[s],
+        des_b = kin.spread_desired[s]            # f32[B], -1 = even mode
+        desired_b = jnp.where(
+            des_b > 0.0,
+            ((des_b - (counts_b + 1.0)) / des_b) * kin.spread_weight[s],
             -1.0,
         )
         # -- even-spread path (spread.go evenSpreadScoreBoost :193) --
-        present = counts[s] > 0.0
+        present = counts_b > 0.0
         any_alloc = jnp.any(present)
-        minc = jnp.min(jnp.where(present, counts[s], jnp.inf))
-        maxc = jnp.max(jnp.where(present, counts[s], -jnp.inf))
-        cur = cnt
-        delta_boost = jnp.where(minc > 0, (minc - cur) / jnp.maximum(minc, 1.0), -1.0)
-        even_boost = jnp.where(
-            cur != minc,
-            delta_boost,
+        minc = jnp.min(jnp.where(present, counts_b, jnp.inf))
+        maxc = jnp.max(jnp.where(present, counts_b, -jnp.inf))
+        delta_b = jnp.where(
+            minc > 0, (minc - counts_b) / jnp.maximum(minc, 1.0), -1.0)
+        even_b = jnp.where(
+            counts_b != minc,
+            delta_b,
             jnp.where(
                 minc == maxc,
                 -1.0,
-                jnp.where(minc == 0, 1.0, (maxc - minc) / jnp.maximum(minc, 1.0)),
+                jnp.where(minc == 0, 1.0,
+                          (maxc - minc) / jnp.maximum(minc, 1.0)),
             ),
         )
-        even_boost = jnp.where(any_alloc, even_boost, 0.0)
-        stanza = jnp.where(
-            missing, -1.0, jnp.where(kin.spread_even[s], even_boost, desired_boost)
-        )
+        even_b = jnp.where(any_alloc, even_b, 0.0)
+        stanza_b = jnp.where(kin.spread_even[s], even_b, desired_b)
+        # bucket -> node: one-hot matmul (zero rows for bucket-less
+        # nodes, which score the missing penalty instead). HIGHEST
+        # precision: default TPU matmul rounds f32 through bf16 on the
+        # MXU, which would break Go-score parity on close boosts
+        node_boost = jnp.matmul(
+            spread_onehot[s], stanza_b,
+            precision=jax.lax.Precision.HIGHEST)            # f32[N]
+        missing = kin.spread_bucket[s] < 0
+        stanza = jnp.where(missing, -1.0, node_boost)
         total = total + jnp.where(kin.spread_active[s], stanza, 0.0)
     return total
 
@@ -350,6 +363,17 @@ def place_taskgroup(
         init["job_any_count"] = kin.job_any_count
     if f.n_spreads > 0:
         init["spread_counts"] = kin.spread_counts
+    # node->bucket one-hot derived on device once per launch (XLA
+    # keeps it live across the scan); 0/1 rows, zero for bucket-less
+    # nodes, so the MXU projections are exact where they must be
+    spread_onehot = None
+    if f.n_spreads > 0:
+        sb = kin.spread_bucket[:f.n_spreads]
+        spread_onehot = (
+            jax.nn.one_hot(jnp.clip(sb, 0, SPREAD_BUCKETS - 1),
+                           SPREAD_BUCKETS, dtype=jnp.float32)
+            * (sb >= 0)[..., None]
+        )
 
     # metrics from the initial state (one extra mask pass, outside scan)
     feas0, _, dims0 = _feasible(kin, init, f)
@@ -366,7 +390,7 @@ def place_taskgroup(
             pen_ids = kin.step_penalty[i]                   # i32[P]
             step_pen = jnp.any(iota[:, None] == pen_ids[None, :], axis=1)
             penalty = penalty | step_pen
-        final = _score(kin, st, ask_cpu_total, penalty, f)
+        final = _score(kin, st, ask_cpu_total, penalty, f, spread_onehot)
         active = i < kin.n_steps
         masked = jnp.where(feasible & active, final, NEG_INF)
         best = jnp.argmax(masked)
@@ -413,7 +437,7 @@ def place_taskgroup(
             st2["job_any_count"] = st["job_any_count"] + onei
         if f.n_spreads > 0:
             st2["spread_counts"] = _bump_spread(
-                kin, st["spread_counts"], idx, upd, f.n_spreads
+                kin, st["spread_counts"], one, spread_onehot, f.n_spreads
             )
         out = (
             jnp.where(found, idx, -1).astype(jnp.int32),
@@ -445,15 +469,19 @@ def place_taskgroup(
     )
 
 
-def _bump_spread(kin: KernelIn, counts, idx, upd, n_spreads: int = MAX_SPREADS):
-    """counts[s, bucket_of_chosen] += 1 for active stanzas."""
+def _bump_spread(kin: KernelIn, counts, one, spread_onehot,
+                 n_spreads: int = MAX_SPREADS):
+    """counts[s, bucket_of_chosen] += 1 for active stanzas.
+
+    ``one`` is the chosen node's one-hot plane (f32[N], zeros when
+    nothing placed); projecting it through the node->bucket one-hot
+    gives the chosen bucket row without a dynamic gather (zero row
+    when the chosen node has no bucket value)."""
     bump = jnp.zeros_like(counts)
     for s in range(n_spreads):
-        b = kin.spread_bucket[s][idx]
-        valid = (b >= 0) & kin.spread_active[s]
-        b_safe = jnp.clip(b, 0, SPREAD_BUCKETS - 1)
-        row = jax.nn.one_hot(b_safe, SPREAD_BUCKETS, dtype=counts.dtype)
-        bump = bump.at[s].add(jnp.where(valid, row * upd, 0.0))
+        row = one @ spread_onehot[s]              # f32[B]
+        bump = bump.at[s].add(
+            jnp.where(kin.spread_active[s], row, 0.0))
     return counts + bump
 
 
