@@ -27,6 +27,7 @@ class AgentConfig:
     acl_enabled: bool = False
     num_schedulers: int = 2
     node_class: str = ""
+    plugin_dir: str = ""           # external driver plugins (loader)
     meta: Dict[str, str] = field(default_factory=dict)
     tls: Optional[object] = None   # utils.tlsutil.TLSConfig
     # HA server mode (server.go setupRaft + serf-discovered peers; here
@@ -137,6 +138,7 @@ class Agent:
             )
         cfg = ClientConfig(
             node_class=self.config.node_class,
+            plugin_dir=self.config.plugin_dir,
         )
         self.client = Client(InProcessRPC(self.server), cfg)
 

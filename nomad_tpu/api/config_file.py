@@ -114,6 +114,8 @@ def _apply_body(cfg, body: Body):
             cfg.client_enabled = bool(ca["enabled"])
         if "node_class" in ca:
             cfg.node_class = str(ca["node_class"])
+        if "plugin_dir" in ca:
+            cfg.plugin_dir = str(ca["plugin_dir"])
         meta = cli[1].first_block("meta")
         if meta is not None:
             cfg.meta = {str(k): str(v) for k, v in meta[1].attrs.items()}

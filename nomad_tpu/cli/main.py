@@ -1076,6 +1076,8 @@ def cmd_agent(args) -> int:
         cfg.raft_port = args.raft_port
     if args.raft_advertise:
         cfg.raft_advertise = args.raft_advertise
+    if args.plugin_dir:
+        cfg.plugin_dir = args.plugin_dir
     if args.tls_cert or args.tls_key:
         if not (args.tls_cert and args.tls_key and args.tls_ca):
             return _fail("TLS needs -tls-ca, -tls-cert and -tls-key")
@@ -1148,6 +1150,8 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-raft-advertise", dest="raft_advertise", default="",
                     help="address peers dial this server's raft on "
                     "(required with a wildcard -bind)")
+    ag.add_argument("-plugin-dir", dest="plugin_dir", default="",
+                    help="directory of external driver plugins")
     ag.add_argument("-tls-ca", dest="tls_ca", default="")
     ag.add_argument("-tls-cert", dest="tls_cert", default="")
     ag.add_argument("-tls-key", dest="tls_key", default="")

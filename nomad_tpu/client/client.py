@@ -144,6 +144,7 @@ class ClientConfig:
         persistent_state: bool = False,
         update_batch_interval: float = 0.2,
         max_terminal_allocs: int = 50,
+        plugin_dir: str = "",
     ) -> None:
         self.data_dir = data_dir
         self.datacenter = datacenter
@@ -152,6 +153,7 @@ class ClientConfig:
         self.persistent_state = persistent_state
         self.update_batch_interval = update_batch_interval
         self.max_terminal_allocs = max_terminal_allocs
+        self.plugin_dir = plugin_dir
 
 
 class Client:
@@ -169,6 +171,13 @@ class Client:
         if drivers is None:
             from nomad_tpu.drivers import builtin_drivers
             drivers = builtin_drivers()
+        # external plugin subprocesses from plugin_dir merge over the
+        # built-ins (helper/pluginutils/catalog + loader semantics)
+        self.external_drivers: Dict[str, object] = {}
+        if self.config.plugin_dir:
+            from nomad_tpu.plugins.external import load_plugin_dir
+            self.external_drivers = load_plugin_dir(self.config.plugin_dir)
+            drivers = dict(drivers, **self.external_drivers)
         self.drivers = drivers
         self.device_plugins = device_plugins or []
         self.csi_clients = csi_clients or {}
@@ -246,6 +255,8 @@ class Client:
             t.join(timeout=2)
         self._threads.clear()
         self._flush_updates()
+        for drv in self.external_drivers.values():
+            drv.shutdown()
         self.state_db.close()
 
     def stop_allocs(self) -> None:
