@@ -36,6 +36,7 @@ class AllocRunner:
         service_reg=None,
         secrets=None,
         prev_lookup=None,
+        device_plugins=None,
     ) -> None:
         self.alloc = alloc
         self.drivers = drivers
@@ -48,6 +49,8 @@ class AllocRunner:
         # resolves a previous alloc id to its local runner
         # (allocwatcher; None for client-less/test topologies)
         self.prev_lookup = prev_lookup
+        # device plugins for Reserve (devicemanager; device.proto)
+        self.device_plugins = device_plugins or []
         # tasks whose services are currently registered
         self._registered_tasks: set = set()
         # volume name -> CSIMountInfo (csi_hook.go populates these for
@@ -121,6 +124,15 @@ class AllocRunner:
                 self._on_task_state(task.name, ts)
                 LOG.warning("alloc %s: no driver %s", self.alloc.id, task.driver)
                 continue
+            task_env = dict(volume_env)
+            try:
+                task_env.update(self._reserve_devices(task.name))
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("alloc %s: device reserve for %s failed: %s",
+                            self.alloc.id, task.name, e)
+                self._on_task_state(
+                    task.name, TaskState(state=STATE_DEAD, failed=True))
+                continue
             tr = TaskRunner(
                 alloc=self.alloc,
                 task=task,
@@ -129,7 +141,7 @@ class AllocRunner:
                 on_state_change=self._on_task_state,
                 state_db=self.state_db,
                 restart_policy=tg.restart_policy,
-                extra_env=volume_env,
+                extra_env=task_env,
                 secrets=self.secrets,
             )
             self.task_runners[task.name] = tr
@@ -150,6 +162,12 @@ class AllocRunner:
             driver = self.drivers.get(task.driver)
             if driver is None:
                 continue
+            try:
+                device_env = self._reserve_devices(task.name)
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("alloc %s: device reserve on restore: %s",
+                            self.alloc.id, e)
+                device_env = {}
             tr = TaskRunner(
                 alloc=self.alloc,
                 task=task,
@@ -158,6 +176,7 @@ class AllocRunner:
                 on_state_change=self._on_task_state,
                 state_db=self.state_db,
                 restart_policy=tg.restart_policy,
+                extra_env=device_env,
                 secrets=self.secrets,
             )
             local_state, handle = (None, None)
@@ -346,6 +365,44 @@ class AllocRunner:
         if "secrets" in parts:
             raise PermissionError("secrets directories are not accessible")
         return full
+
+    def _reserve_devices(self, task_name: str):
+        """devicemanager Reserve (device.proto Reserve -> container
+        env/mounts): for each device the scheduler assigned to this
+        task, ask the owning plugin how to expose it — e.g. the TPU
+        plugin returns TPU_VISIBLE_DEVICES. Raises when a reservation
+        fails: starting the task anyway would let the workload see
+        devices reserved by other allocs (device_hook prestart fails
+        the task in the reference)."""
+        env = {}
+        ar = self.alloc.allocated_resources
+        if ar is None or not self.device_plugins:
+            return env
+        task_res = ar.tasks.get(task_name)
+        if task_res is None or not task_res.devices:
+            return env
+        # enumerate each plugin once (fingerprint can be expensive:
+        # the TPU plugin talks to the runtime)
+        plugin_groups = []
+        for plugin in self.device_plugins:
+            try:
+                plugin_groups.append((plugin, plugin.fingerprint()))
+            except Exception:                   # noqa: BLE001
+                continue
+        for dev in task_res.devices:
+            owner = next(
+                (p for p, groups in plugin_groups
+                 if any(g.vendor == dev.vendor and g.type == dev.type
+                        and (not dev.name or g.name == dev.name)
+                        for g in groups)),
+                None,
+            )
+            if owner is None:
+                raise RuntimeError(
+                    f"no device plugin owns {dev.id_string()}")
+            resp = owner.reserve(dev.device_ids)
+            env.update(resp.container_res)
+        return env
 
     def _await_previous(self, tg) -> None:
         """allocwatcher prevAllocWaiter: a replacement alloc

@@ -387,6 +387,7 @@ class Client:
             service_reg=self.service_reg,
             secrets=self.secrets,
             prev_lookup=self._prev_runner,
+            device_plugins=self.device_plugins,
         )
         with self._alloc_lock:
             self.allocs[alloc.id] = runner
@@ -458,6 +459,7 @@ class Client:
                 service_reg=self.service_reg,
                 secrets=self.secrets,
                 prev_lookup=self._prev_runner,
+                device_plugins=self.device_plugins,
             )
             with self._alloc_lock:
                 self.allocs[alloc.id] = runner

@@ -1,0 +1,79 @@
+"""Device Reserve wiring: scheduler-assigned devices reach the task
+as plugin-provided env (device.proto Reserve -> container env), the
+path GPUs/TPUs use to become visible to workloads.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.client import Client, ClientConfig, InProcessRPC
+from nomad_tpu.plugins.base import PLUGIN_TYPE_DEVICE, PluginInfo
+from nomad_tpu.plugins.device import DevicePlugin, ReservationResponse
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.resources import NodeDeviceResource, RequestedDevice
+
+
+class FakeGpuPlugin(DevicePlugin):
+    def __init__(self):
+        self.reserved = []
+
+    def plugin_info(self) -> PluginInfo:
+        return PluginInfo(name="gpu", type=PLUGIN_TYPE_DEVICE)
+
+    def fingerprint(self):
+        return [NodeDeviceResource(
+            vendor="acme", type="gpu", name="a100",
+            instance_ids=["gpu-0", "gpu-1"],
+        )]
+
+    def reserve(self, device_ids):
+        self.reserved.append(list(device_ids))
+        visible = ",".join(i.split("-")[-1] for i in device_ids)
+        return ReservationResponse(
+            container_res={"ACME_VISIBLE_DEVICES": visible})
+
+
+def _wait(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestDeviceReserve:
+    def test_assigned_devices_surface_as_env(self, tmp_path):
+        plugin = FakeGpuPlugin()
+        server = Server(ServerConfig(num_workers=1))
+        server.start()
+        client = Client(
+            InProcessRPC(server),
+            ClientConfig(data_dir=str(tmp_path)),
+            device_plugins=[plugin],
+        )
+        client.start()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 1
+            task = job.task_groups[0].tasks[0]
+            task.driver = "mock_driver"
+            task.config = {"run_for": "30s"}
+            task.resources.devices = [
+                RequestedDevice(name="acme/gpu", count=1)]
+            server.job_register(job)
+            assert _wait(lambda: any(
+                tr.task_state.state == "running"
+                for ar in client.allocs.values()
+                for tr in ar.task_runners.values())), "task never ran"
+            assert plugin.reserved, "plugin.reserve never called"
+            tr = next(tr for ar in client.allocs.values()
+                      for tr in ar.task_runners.values())
+            env = tr._task_config().env
+            assert env.get("ACME_VISIBLE_DEVICES") in ("0", "1"), env
+        finally:
+            client.shutdown()
+            server.shutdown()
