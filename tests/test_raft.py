@@ -244,9 +244,12 @@ class TestReplicatedServer:
                 s.shutdown()
 
     def test_leader_failover_keeps_scheduling(self):
+        # generous timeouts: under a full-suite run, concurrent JAX
+        # compiles hold the GIL for long stretches and stall the
+        # Python control plane (scheduling + elections)
         servers, _ = make_cluster(3)
         try:
-            leader = wait_for_leader(servers)
+            leader = wait_for_leader(servers, timeout=30)
             for _ in range(3):
                 leader.node_register(mock.node())
             job1 = mock.job()
@@ -254,18 +257,18 @@ class TestReplicatedServer:
             wait_until(
                 lambda: len(leader.state.snapshot().allocs_by_job(
                     job1.namespace, job1.id)) == 10,
-                timeout=30,
+                timeout=90,
                 msg="first job placed",
             )
             leader.shutdown()
             rest = [s for s in servers if s is not leader]
-            new_leader = wait_for_leader(rest, timeout=10)
+            new_leader = wait_for_leader(rest, timeout=30)
             job2 = mock.job()
             new_leader.job_register(job2)
             wait_until(
                 lambda: len(new_leader.state.snapshot().allocs_by_job(
                     job2.namespace, job2.id)) == 10,
-                timeout=30,
+                timeout=90,
                 msg="second job placed by new leader",
             )
         finally:

@@ -1,0 +1,101 @@
+"""Eval-batching worker tests (the TPU-idiomatic throughput path).
+
+SURVEY.md §7 step 5: workers dequeue BATCHES of compatible evals and
+amortize kernel dispatch. Covers dequeue_batch semantics and a live
+server running with batch_size > 1.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Evaluation
+
+
+def _eval(job_id: str, sched: str = "service") -> Evaluation:
+    return Evaluation(
+        namespace="default", job_id=job_id, type=sched,
+        priority=50, status=consts.EVAL_STATUS_PENDING,
+        triggered_by=consts.EVAL_TRIGGER_JOB_REGISTER,
+    )
+
+
+class TestDequeueBatch:
+    def test_drains_up_to_batch(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        for i in range(5):
+            b.enqueue(_eval(f"job-{i}"))
+        batch = b.dequeue_batch(["service"], batch=3, timeout=0)
+        assert len(batch) == 3
+        # every dequeued eval has its own ack token
+        for ev, token in batch:
+            b.ack(ev.id, token)
+        rest = b.dequeue_batch(["service"], batch=10, timeout=0)
+        assert len(rest) == 2
+
+    def test_single_available_returns_one(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        b.enqueue(_eval("only"))
+        batch = b.dequeue_batch(["service"], batch=8, timeout=0)
+        assert len(batch) == 1
+
+    def test_empty_returns_empty(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        assert b.dequeue_batch(["service"], batch=4, timeout=0) == []
+
+    def test_nack_of_batch_member_requeues(self):
+        # zero nack delay: the default 1s delayed-requeue would race
+        # the dequeue deadline
+        b = EvalBroker(nack_timeout=60, initial_nack_delay=0)
+        b.set_enabled(True)
+        for i in range(2):
+            b.enqueue(_eval(f"j{i}"))
+        batch = b.dequeue_batch(["service"], batch=2, timeout=0)
+        ev0, tok0 = batch[0]
+        ev1, tok1 = batch[1]
+        b.ack(ev0.id, tok0)
+        b.nack(ev1.id, tok1)
+        redo = b.dequeue_batch(["service"], batch=2, timeout=5.0)
+        assert [e.id for e, _ in redo] == [ev1.id]
+
+
+class TestLiveBatchedWorkers:
+    def test_burst_of_jobs_all_schedule(self):
+        """A server whose single worker processes 8-eval batches must
+        place a burst of concurrently registered jobs correctly."""
+        server = Server(ServerConfig(num_workers=1, worker_batch_size=8))
+        server.start()
+        try:
+            for _ in range(4):
+                server.node_register(mock.node())
+            jobs = []
+            for i in range(12):
+                job = mock.job()
+                job.task_groups[0].count = 2
+                jobs.append(job)
+                server.job_register(job)
+            deadline = time.time() + 60
+            def placed():
+                snap = server.state.snapshot()
+                return all(
+                    len(snap.allocs_by_job(j.namespace, j.id)) == 2
+                    for j in jobs)
+            while time.time() < deadline and not placed():
+                time.sleep(0.2)
+            assert placed(), {
+                j.id: len(server.state.snapshot().allocs_by_job(
+                    j.namespace, j.id)) for j in jobs}
+            # every alloc landed on a real node row
+            snap = server.state.snapshot()
+            for j in jobs:
+                for a in snap.allocs_by_job(j.namespace, j.id):
+                    assert snap.node_by_id(a.node_id) is not None
+        finally:
+            server.shutdown()
