@@ -29,6 +29,8 @@ class AgentConfig:
     node_class: str = ""
     plugin_dir: str = ""           # external driver plugins (loader)
     meta: Dict[str, str] = field(default_factory=dict)
+    # client { options { "docker.volumes.enabled" = "true" } }
+    client_options: Dict[str, str] = field(default_factory=dict)
     tls: Optional[object] = None   # utils.tlsutil.TLSConfig
     # HA server mode (server.go setupRaft + serf-discovered peers; here
     # a static peer set, the reference's server_join/retry_join shape):
@@ -139,6 +141,7 @@ class Agent:
         cfg = ClientConfig(
             node_class=self.config.node_class,
             plugin_dir=self.config.plugin_dir,
+            options=self.config.client_options,
         )
         self.client = Client(InProcessRPC(self.server), cfg)
 

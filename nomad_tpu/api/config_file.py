@@ -121,6 +121,13 @@ def _apply_body(cfg, body: Body):
             cfg.meta = {str(k): str(v) for k, v in meta[1].attrs.items()}
         elif isinstance(ca.get("meta"), dict):
             cfg.meta = {str(k): str(v) for k, v in ca["meta"].items()}
+        opts = cli[1].first_block("options")
+        if opts is not None:
+            cfg.client_options = {
+                str(k): str(v) for k, v in opts[1].attrs.items()}
+        elif isinstance(ca.get("options"), dict):
+            cfg.client_options = {
+                str(k): str(v) for k, v in ca["options"].items()}
 
     acl = body.first_block("acl")
     if acl is not None and "enabled" in acl[1].attrs:

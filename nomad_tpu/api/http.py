@@ -1867,10 +1867,15 @@ class HTTPAgent:
 
     def client_fs_readat(self, req: Request):
         try:
+            offset = int(req.q("offset", "0") or 0)
+            limit = int(req.q("limit", "0") or 0)
+        except ValueError:
+            raise HTTPError(400, "offset and limit must be integers")
+        if offset < 0 or limit < 0:
+            raise HTTPError(400, "offset and limit must be >= 0")
+        try:
             data = self._runner(req, "read-fs").cat_file(
-                req.q("path", "/"),
-                offset=int(req.q("offset", "0") or 0),
-                limit=int(req.q("limit", "0") or 0),
+                req.q("path", "/"), offset=offset, limit=limit,
             )
         except FileNotFoundError:
             raise HTTPError(404, "file not found")
@@ -1879,7 +1884,7 @@ class HTTPAgent:
         except PermissionError as e:
             raise HTTPError(403, str(e))
         return {"Data": data.decode(errors="replace"),
-                "Offset": int(req.q("offset", "0") or 0)}
+                "Offset": offset}
 
 
 class StreamedResponse:

@@ -145,6 +145,7 @@ class ClientConfig:
         update_batch_interval: float = 0.2,
         max_terminal_allocs: int = 50,
         plugin_dir: str = "",
+        options: Optional[Dict[str, str]] = None,
     ) -> None:
         self.data_dir = data_dir
         self.datacenter = datacenter
@@ -154,6 +155,9 @@ class ClientConfig:
         self.update_batch_interval = update_batch_interval
         self.max_terminal_allocs = max_terminal_allocs
         self.plugin_dir = plugin_dir
+        # client { options { "docker.volumes.enabled" = "true" } }
+        # (agent config.go client options map, consumed by drivers)
+        self.options = options or {}
 
 
 class Client:
@@ -170,7 +174,7 @@ class Client:
         self.config = config or ClientConfig()
         if drivers is None:
             from nomad_tpu.drivers import builtin_drivers
-            drivers = builtin_drivers()
+            drivers = builtin_drivers(self.config.options)
         # external plugin subprocesses from plugin_dir merge over the
         # built-ins (helper/pluginutils/catalog + loader semantics)
         self.external_drivers: Dict[str, object] = {}

@@ -383,6 +383,21 @@ class TaskRunner:
             )
             self._template_watcher.start()
 
+    @staticmethod
+    def _sandboxed_path(task_dir: str, rel: str) -> str:
+        """Confine a jobspec-controlled template path to the allocation
+        dir (template.go:572-601 escapingfs sandbox; CVE-2022-24683
+        class: without this a submitted job reads/writes arbitrary host
+        paths as the agent user). Paths resolve relative to the task
+        dir but may reach the sibling shared ``alloc/`` dir, matching
+        the reference's alloc-dir sandbox root."""
+        full = os.path.realpath(os.path.join(task_dir, rel.lstrip("/")))
+        root = os.path.realpath(os.path.dirname(task_dir.rstrip(os.sep)))
+        if not (full == root or full.startswith(root + os.sep)):
+            raise PermissionError(
+                f"template path escapes task directory: {rel}")
+        return full
+
     def _template_sources(self, task_dir: str):
         """Resolve each template to its source text; file-backed
         sources (source_path) read from the task's local dir."""
@@ -390,7 +405,8 @@ class TaskRunner:
         for tmpl in self.task.templates:
             src = tmpl.embedded_tmpl
             if not src and tmpl.source_path:
-                path = os.path.join(task_dir, "local", tmpl.source_path)
+                path = self._sandboxed_path(
+                    task_dir, os.path.join("local", tmpl.source_path))
                 with open(path) as f:
                     src = f.read()
             out.append((tmpl, src))
@@ -415,7 +431,8 @@ class TaskRunner:
         changed = []
         for tmpl, src in self._template_sources(task_dir):
             out = render(src, ctx)
-            dest = os.path.join(task_dir, tmpl.dest_path or "local/rendered")
+            dest = self._sandboxed_path(
+                task_dir, tmpl.dest_path or "local/rendered")
             os.makedirs(os.path.dirname(dest), exist_ok=True)
             old = None
             try:

@@ -10,13 +10,19 @@ supervises children from a separate process so tasks survive agent
 restarts.
 """
 
-from typing import Dict
+from typing import Dict, Optional
 
 from nomad_tpu.plugins.drivers import DriverPlugin
 
 
-def builtin_drivers() -> Dict[str, DriverPlugin]:
-    """catalog/register.go: the in-process driver registry."""
+def builtin_drivers(
+    options: Optional[Dict[str, str]] = None,
+) -> Dict[str, DriverPlugin]:
+    """catalog/register.go: the in-process driver registry.
+
+    ``options`` is the agent's client-options map (config.go Options);
+    drivers read their knobs from it, e.g. ``docker.volumes.enabled``.
+    """
     from nomad_tpu.drivers.mock import MockDriver
     from nomad_tpu.drivers.rawexec import RawExecDriver
     from nomad_tpu.drivers.execdriver import ExecDriver
@@ -24,11 +30,12 @@ def builtin_drivers() -> Dict[str, DriverPlugin]:
     from nomad_tpu.drivers.qemu import QemuDriver
     from nomad_tpu.drivers.docker import DockerDriver
 
+    options = options or {}
     return {
         "mock_driver": MockDriver(),
         "raw_exec": RawExecDriver(),
         "exec": ExecDriver(),
         "java": JavaDriver(),
         "qemu": QemuDriver(),
-        "docker": DockerDriver(),
+        "docker": DockerDriver(options=options),
     }

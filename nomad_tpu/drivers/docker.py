@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import shutil
 import subprocess
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from nomad_tpu.drivers.rawexec import RawExecDriver
 from nomad_tpu.plugins.base import PLUGIN_TYPE_DRIVER, PluginInfo
@@ -35,6 +35,16 @@ def _container_name(config: TaskConfig) -> str:
 
 class DockerDriver(RawExecDriver):
     name = "docker"
+
+    def __init__(self, options: Optional[Dict[str, str]] = None) -> None:
+        super().__init__()
+        opts = options or {}
+        # Host bind mounts are host-root-equivalent for job submitters,
+        # so the reference disables them unless the operator opts in
+        # (drivers/docker config "volumes.enabled", default false).
+        self.volumes_enabled = str(
+            opts.get("docker.volumes.enabled", "false")).lower() in (
+                "1", "true", "yes")
 
     def plugin_info(self) -> PluginInfo:
         return PluginInfo(name=self.name, type=PLUGIN_TYPE_DRIVER)
@@ -102,8 +112,14 @@ class DockerDriver(RawExecDriver):
                         if p.label == label:
                             argv += ["-p",
                                      f"{assigned}:{p.to or assigned}"]
-        for bind in cfg.get("volumes") or []:
-            argv += ["-v", bind]
+        if cfg.get("volumes"):
+            if not self.volumes_enabled:
+                # reject, never silently drop binds the task depends on
+                raise ValueError(
+                    "docker volumes are disabled on this client; set "
+                    "client option docker.volumes.enabled=true")
+            for bind in cfg["volumes"]:
+                argv += ["-v", bind]
         argv.append(image)
         if cfg.get("command"):
             argv.append(cfg["command"])

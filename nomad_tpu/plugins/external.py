@@ -34,9 +34,11 @@ import dataclasses
 import json
 import logging
 import os
+import queue
 import subprocess
 import sys
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from nomad_tpu.plugins.base import PluginInfo
@@ -99,10 +101,14 @@ class ExternalDriver(DriverPlugin):
     """Agent-side proxy: a DriverPlugin whose methods run in the
     plugin subprocess."""
 
-    def __init__(self, argv: List[str], name_hint: str = "") -> None:
+    def __init__(self, argv: List[str], name_hint: str = "",
+                 call_timeout: float = 60.0) -> None:
         self.argv = list(argv)
         self._lock = threading.Lock()
         self._next_id = 0
+        # chatty plugins may print arbitrarily many stray lines between
+        # responses; bound the wait by time, not line count
+        self._call_timeout = call_timeout
         self._proc: Optional[subprocess.Popen] = None
         self.name = name_hint
         self._start_process()
@@ -143,6 +149,22 @@ class ExternalDriver(DriverPlugin):
             self.shutdown()
             raise
         self.name = hs.get("name", self.name)
+        # pump stdout on a thread so _call can wait with a timeout;
+        # readline() on the pipe directly cannot be time-bounded and
+        # select() misses lines already sitting in the text buffer
+        self._lines: "queue.Queue[Optional[str]]" = queue.Queue()
+        reader = threading.Thread(
+            target=self._pump_stdout, name=f"plugin-{self.name}-stdout",
+            daemon=True)
+        reader.start()
+
+    def _pump_stdout(self) -> None:
+        try:
+            for line in self._proc.stdout:
+                self._lines.put(line)
+        except (ValueError, OSError):
+            pass                                # stream closed
+        self._lines.put(None)                   # EOF sentinel
 
     def alive(self) -> bool:
         return self._proc is not None and self._proc.poll() is None
@@ -167,10 +189,23 @@ class ExternalDriver(DriverPlugin):
             try:
                 self._proc.stdin.write(json.dumps(frame) + "\n")
                 self._proc.stdin.flush()
+
                 resp = None
-                for _ in range(100):
-                    line = self._proc.stdout.readline()
-                    if not line:
+                deadline = time.monotonic() + self._call_timeout
+                while True:
+                    # the reader thread pumps stdout into _lines; a
+                    # plugin that goes silent mid-call must not wedge
+                    # the caller (and every later call, via
+                    # self._lock) forever, so bound the wait by time
+                    # rather than line count
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        line = self._lines.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    if line is None:            # reader hit EOF
                         raise PluginCrashed(
                             f"plugin {self.name} exited mid-call")
                     try:
@@ -186,7 +221,8 @@ class ExternalDriver(DriverPlugin):
                         break
                 if resp is None:
                     raise PluginCrashed(
-                        f"plugin {self.name}: response desync")
+                        f"plugin {self.name}: no response within "
+                        f"{self._call_timeout}s")
             except (BrokenPipeError, OSError) as e:
                 raise PluginCrashed(f"plugin {self.name}: {e}")
         if resp.get("error"):

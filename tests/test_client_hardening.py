@@ -291,3 +291,50 @@ class TestAgentConfigFile:
         assert cfg.tls is not None and cfg.tls.enabled
         assert cfg.tls.verify_https_client
         assert cfg.tls.cert_file == "cert.pem"
+
+
+class TestTemplateSandbox:
+    """template.go:572-601 escapingfs sandbox (CVE-2022-24683 class):
+    jobspec-controlled template paths must not escape the task dir."""
+
+    def test_dest_escape_rejected(self, tmp_path):
+        from nomad_tpu.client.task_runner import TaskRunner
+
+        task_dir = tmp_path / "task"
+        task_dir.mkdir()
+        with pytest.raises(PermissionError):
+            TaskRunner._sandboxed_path(str(task_dir), "../../etc/cron.d/x")
+
+    def test_symlink_escape_rejected(self, tmp_path):
+        from nomad_tpu.client.task_runner import TaskRunner
+
+        task_dir = tmp_path / "task"
+        (task_dir / "local").mkdir(parents=True)
+        (task_dir / "local" / "link").symlink_to("/etc")
+        with pytest.raises(PermissionError):
+            TaskRunner._sandboxed_path(str(task_dir), "local/link/passwd")
+
+    def test_normal_paths_allowed(self, tmp_path):
+        from nomad_tpu.client.task_runner import TaskRunner
+
+        task_dir = tmp_path / "task"
+        task_dir.mkdir()
+        got = TaskRunner._sandboxed_path(str(task_dir), "local/config.txt")
+        assert got == os.path.realpath(
+            os.path.join(str(task_dir), "local/config.txt"))
+        # absolute jobspec paths are re-rooted, not trusted
+        got = TaskRunner._sandboxed_path(str(task_dir), "/secrets/creds")
+        assert got.startswith(os.path.realpath(str(task_dir)))
+
+    def test_shared_alloc_dir_allowed(self, tmp_path):
+        """The sandbox root is the alloc dir, so templates may target
+        the shared ../alloc dir (reference alloc-dir escapingfs root)."""
+        from nomad_tpu.client.task_runner import TaskRunner
+
+        task_dir = tmp_path / "task"
+        (tmp_path / "alloc").mkdir()
+        task_dir.mkdir()
+        got = TaskRunner._sandboxed_path(
+            str(task_dir), "../alloc/data/config.json")
+        assert got == os.path.realpath(
+            os.path.join(str(tmp_path), "alloc/data/config.json"))

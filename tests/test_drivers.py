@@ -131,3 +131,23 @@ class TestDocker:
     def test_requires_image(self):
         with pytest.raises(ValueError):
             DockerDriver()._command(cfg({}))
+
+
+class TestDockerVolumesGate:
+    """Host bind mounts are host-root-equivalent; disabled unless the
+    operator sets docker.volumes.enabled (drivers/docker volumes gate)."""
+
+    def test_volumes_rejected_by_default(self):
+        with pytest.raises(ValueError, match="volumes are disabled"):
+            DockerDriver()._command(
+                cfg({"image": "nginx", "volumes": ["/:/host"]}))
+
+    def test_volumes_allowed_when_enabled(self):
+        drv = DockerDriver(options={"docker.volumes.enabled": "true"})
+        argv = drv._command(
+            cfg({"image": "nginx", "volumes": ["/data:/data"]}))
+        assert "-v" in argv and "/data:/data" in argv
+
+    def test_no_volumes_fine_without_flag(self):
+        argv = DockerDriver()._command(cfg({"image": "nginx"}))
+        assert "-v" not in argv
