@@ -78,6 +78,11 @@ class KernelFeatures(NamedTuple):
     with_distinct: bool = True    # distinct_hosts masks in the scan
     with_step_penalties: bool = True  # per-placement penalty node ids
     with_preferred: bool = True   # per-placement preferred-node pins
+    # per-eval node-order decorrelation (shuffleNodes util.go:464): the
+    # argmax runs over a seeded permutation, so concurrent evals break
+    # score TIES on different nodes instead of all piling onto row 0;
+    # scores and non-tied choices are unchanged
+    with_shuffle: bool = False
 
 
 FULL_FEATURES = KernelFeatures()
@@ -117,6 +122,7 @@ class KernelIn(NamedTuple):
     job_tg_count: jnp.ndarray        # i32[N]
     penalty: jnp.ndarray             # bool[N]
     aff_score: jnp.ndarray           # f32[N]
+    node_perm: jnp.ndarray           # i32[N]: seeded tie-break permutation
     # per-step planes (placement axis K): rescheduled allocs penalize
     # their previous node(s) (rank.go:630 SetPenaltyNodes is per-Select)
     # and sticky/preferred placements pin a node (stack.go:120-139)
@@ -393,7 +399,12 @@ def place_taskgroup(
         final = _score(kin, st, ask_cpu_total, penalty, f, spread_onehot)
         active = i < kin.n_steps
         masked = jnp.where(feasible & active, final, NEG_INF)
-        best = jnp.argmax(masked)
+        if f.with_shuffle:
+            # argmax over the permuted plane: equal-score candidates
+            # resolve in permutation order (shuffleNodes util.go:464)
+            best = kin.node_perm[jnp.argmax(masked[kin.node_perm])]
+        else:
+            best = jnp.argmax(masked)
         # preferred-node pin: take it when feasible (stack.go preferred-
         # source select), else fall back to the global argmax
         if f.with_preferred:
@@ -488,8 +499,231 @@ def _bump_spread(kin: KernelIn, counts, one, spread_onehot,
 place_taskgroup_jit = jax.jit(place_taskgroup, static_argnums=(1, 2))
 
 
+class JointOut(NamedTuple):
+    """Outputs of a joint wave: per-step placements + per-member metrics."""
+
+    chosen: jnp.ndarray          # i32[T]
+    scores: jnp.ndarray          # f32[T]
+    found: jnp.ndarray           # bool[T]
+    topk_idx: jnp.ndarray        # i32[T, TOPK]
+    topk_scores: jnp.ndarray     # f32[T, TOPK]
+    nodes_evaluated: jnp.ndarray     # i32[B]
+    nodes_feasible: jnp.ndarray      # i32[B]
+    exhausted_cpu: jnp.ndarray       # i32[B]
+    exhausted_mem: jnp.ndarray
+    exhausted_disk: jnp.ndarray
+    exhausted_ports: jnp.ndarray
+    exhausted_devices: jnp.ndarray
+    exhausted_cores: jnp.ndarray
+
+
+def place_taskgroups_joint(
+    kin: KernelIn,
+    step_member: jnp.ndarray,
+    step_local: jnp.ndarray,
+    t_steps: int,
+    features: KernelFeatures = FULL_FEATURES,
+) -> JointOut:
+    """Place a WAVE of task-group asks with a shared capacity carry.
+
+    ``kin`` is a stacked KernelIn (leading member axis B). The scan
+    runs ``t_steps`` placement steps; step t belongs to wave member
+    ``step_member[t]`` (-1 = padding) at member-local placement index
+    ``step_local[t]``.
+
+    This is the on-device form of the leader's serialized plan applier
+    (nomad/plan_apply.go:71): every step's feasibility and score see
+    the capacity consumed by ALL previous steps — including other
+    members' — via shared accumulation planes (cpu/mem/disk, cores,
+    bandwidth, dynamic-port counts, device counts). Job-local planes
+    (anti-affinity counts, distinct-hosts counts, spread counts, the
+    member's own reserved-port conflicts) stay per-member, because
+    they only constrain the member's own job. Concurrently scheduled
+    evaluations therefore cannot over-subscribe a node within a batch,
+    which is what keeps the optimistic plan re-validation
+    (plan_apply.go:644) from rejecting lockstep retries.
+
+    Cross-member *identity* conflicts (the same reserved port number
+    or the same reserved core id chosen by two members for one node)
+    are not modeled on device — exact port/core assignment stays
+    host-side and the applier's re-check catches the rare collision,
+    exactly as it does between reference scheduler workers.
+    """
+    n = kin.cap_cpu.shape[1]
+    b = kin.cap_cpu.shape[0]
+    f = features
+
+    zf = jnp.zeros(n, jnp.float32)
+    zi = jnp.zeros(n, jnp.int32)
+    init = dict(
+        a_cpu=zf, a_mem=zf, a_disk=zf,
+        job_tg_count=kin.job_tg_count,              # [B, N]
+    )
+    if f.with_cores:
+        init["a_cores"] = zi
+    if f.with_network:
+        init["a_mbits"] = zi
+    if f.with_ports:
+        init["a_dyn"] = zi
+        init["port_conflict"] = kin.port_conflict   # [B, N]
+    if f.with_devices:
+        init["a_dev"] = jnp.zeros((n, kin.dev_free.shape[2]), jnp.float32)
+    if f.with_distinct:
+        init["job_any_count"] = kin.job_any_count   # [B, N]
+    if f.n_spreads > 0:
+        init["spread_counts"] = kin.spread_counts   # [B, S, Bk]
+
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    def member_view(st, m):
+        """The member's single-problem (kin, st) as place_taskgroup sees it."""
+        kin_m = KernelIn(*[x[m] for x in kin])
+        st_m = dict(
+            used_cpu=kin_m.used_cpu + st["a_cpu"],
+            used_mem=kin_m.used_mem + st["a_mem"],
+            used_disk=kin_m.used_disk + st["a_disk"],
+            job_tg_count=st["job_tg_count"][m],
+        )
+        if f.with_cores:
+            st_m["used_cores"] = kin_m.used_cores + st["a_cores"]
+        if f.with_network:
+            st_m["used_mbits"] = kin_m.used_mbits + st["a_mbits"]
+        if f.with_ports:
+            st_m["free_dyn"] = kin_m.free_dyn - st["a_dyn"]
+            st_m["port_conflict"] = st["port_conflict"][m]
+        if f.with_devices:
+            st_m["dev_free"] = kin_m.dev_free - st["a_dev"]
+        if f.with_distinct:
+            st_m["job_any_count"] = st["job_any_count"][m]
+        if f.n_spreads > 0:
+            st_m["spread_counts"] = st["spread_counts"][m]
+        return kin_m, st_m
+
+    def step(st, t):
+        member = step_member[t]
+        active_step = member >= 0
+        m = jnp.clip(member, 0, b - 1)
+        j = step_local[t]
+        kin_m, st_m = member_view(st, m)
+
+        feasible, ask_cpu_total, _ = _feasible(kin_m, st_m, f)
+        penalty = kin_m.penalty
+        if f.with_step_penalties:
+            pen_ids = kin_m.step_penalty[j]
+            step_pen = jnp.any(iota[:, None] == pen_ids[None, :], axis=1)
+            penalty = penalty | step_pen
+        spread_onehot = None
+        if f.n_spreads > 0:
+            sb = kin_m.spread_bucket[:f.n_spreads]
+            spread_onehot = (
+                jax.nn.one_hot(jnp.clip(sb, 0, SPREAD_BUCKETS - 1),
+                               SPREAD_BUCKETS, dtype=jnp.float32)
+                * (sb >= 0)[..., None]
+            )
+        final = _score(kin_m, st_m, ask_cpu_total, penalty, f, spread_onehot)
+        active = active_step & (j < kin_m.n_steps)
+        masked = jnp.where(feasible & active, final, NEG_INF)
+        if f.with_shuffle:
+            best = kin_m.node_perm[jnp.argmax(masked[kin_m.node_perm])]
+        else:
+            best = jnp.argmax(masked)
+        if f.with_preferred:
+            pref = kin_m.step_preferred[j]
+            pref_ok = (pref >= 0) & feasible[jnp.clip(pref, 0, n - 1)] & active
+            idx = jnp.where(pref_ok, jnp.clip(pref, 0, n - 1), best)
+        else:
+            idx = best
+        found = masked[idx] > NEG_INF / 2
+
+        if f.with_topk:
+            topv, topi = jax.lax.top_k(masked, TOPK)
+        else:
+            topv = jnp.full(TOPK, NEG_INF)
+            topi = jnp.zeros(TOPK, jnp.int32)
+
+        upd = (found & active).astype(jnp.float32)
+        updi = (found & active).astype(jnp.int32)
+        one = jax.nn.one_hot(idx, n, dtype=jnp.float32) * upd
+        onei = jax.nn.one_hot(idx, n, dtype=jnp.int32) * updi
+        st2 = dict(
+            a_cpu=st["a_cpu"] + one * ask_cpu_total,
+            a_mem=st["a_mem"] + one * kin_m.ask_mem,
+            a_disk=st["a_disk"] + one * kin_m.ask_disk,
+            job_tg_count=st["job_tg_count"].at[m].add(onei),
+        )
+        if f.with_cores:
+            st2["a_cores"] = st["a_cores"] + onei * kin_m.ask_cores
+        if f.with_network:
+            st2["a_mbits"] = st["a_mbits"] + onei * kin_m.ask_mbits
+        if f.with_ports:
+            st2["a_dyn"] = st["a_dyn"] + onei * kin_m.ask_dyn_ports
+            st2["port_conflict"] = st["port_conflict"].at[m].set(
+                st["port_conflict"][m]
+                | ((one > 0) & kin_m.ask_has_reserved_ports)
+            )
+        if f.with_devices:
+            st2["a_dev"] = st["a_dev"] + one[:, None] * kin_m.ask_dev[None, :]
+        if f.with_distinct:
+            st2["job_any_count"] = st["job_any_count"].at[m].add(onei)
+        if f.n_spreads > 0:
+            st2["spread_counts"] = st["spread_counts"].at[m].set(
+                _bump_spread(kin_m, st["spread_counts"][m], one,
+                             spread_onehot, f.n_spreads)
+            )
+        out = (
+            jnp.where(found, idx, -1).astype(jnp.int32),
+            jnp.where(found, masked[idx], 0.0),
+            found & active,
+            topi.astype(jnp.int32),
+            topv,
+        )
+        return st2, out
+
+    _, (chosen, scores, found, topk_idx, topk_scores) = jax.lax.scan(
+        step, init, jnp.arange(t_steps)
+    )
+
+    # per-member first-step metrics (AllocMetric inputs), from the
+    # pre-wave state — identical to the single-problem kernel's
+    def member_metrics(kin_m: KernelIn):
+        st0 = dict(
+            used_cpu=kin_m.used_cpu, used_mem=kin_m.used_mem,
+            used_disk=kin_m.used_disk, job_tg_count=kin_m.job_tg_count,
+            used_cores=kin_m.used_cores, used_mbits=kin_m.used_mbits,
+            free_dyn=kin_m.free_dyn, port_conflict=kin_m.port_conflict,
+            dev_free=kin_m.dev_free, job_any_count=kin_m.job_any_count,
+            spread_counts=kin_m.spread_counts,
+        )
+        feas0, _, dims0 = _feasible(kin_m, st0, f)
+        base_i = kin_m.base_mask
+        ex = lambda fit: jnp.sum(base_i & ~fit).astype(jnp.int32)  # noqa: E731
+        return (
+            jnp.sum(base_i).astype(jnp.int32),
+            jnp.sum(feas0).astype(jnp.int32),
+            ex(dims0["fit_cpu"]), ex(dims0["fit_mem"]), ex(dims0["fit_disk"]),
+            ex(dims0["fit_ports"]), ex(dims0["fit_dev"]), ex(dims0["fit_cores"]),
+        )
+
+    (m_eval, m_feas, m_cpu, m_mem, m_disk, m_ports, m_dev, m_cores) = jax.vmap(
+        member_metrics)(kin)
+
+    return JointOut(
+        chosen=chosen, scores=scores, found=found,
+        topk_idx=topk_idx, topk_scores=topk_scores,
+        nodes_evaluated=m_eval, nodes_feasible=m_feas,
+        exhausted_cpu=m_cpu, exhausted_mem=m_mem, exhausted_disk=m_disk,
+        exhausted_ports=m_ports, exhausted_devices=m_dev,
+        exhausted_cores=m_cores,
+    )
+
+
+place_taskgroups_joint_jit = jax.jit(
+    place_taskgroups_joint, static_argnums=(3, 4)
+)
+
+
 def infer_features(ev, any_penalty: bool = True, any_preferred: bool = True,
-                   with_topk: bool = True) -> KernelFeatures:
+                   with_topk: bool = True, with_shuffle: bool = False) -> KernelFeatures:
     """Derive the lean static variant for one EvalTensors' ask."""
     ask = ev.ask
     return KernelFeatures(
@@ -502,6 +736,7 @@ def infer_features(ev, any_penalty: bool = True, any_preferred: bool = True,
         with_distinct=bool(ev.distinct_hosts_job or ev.distinct_hosts_tg),
         with_step_penalties=bool(any_penalty),
         with_preferred=bool(any_preferred),
+        with_shuffle=bool(with_shuffle),
     )
 
 
@@ -511,12 +746,14 @@ def build_kernel_in(
     n_steps: int,
     step_penalty: Optional[np.ndarray] = None,
     step_preferred: Optional[np.ndarray] = None,
+    node_perm: Optional[np.ndarray] = None,
 ) -> KernelIn:
     """Assemble device inputs from the host-side tensor schema.
 
     ``step_penalty``/``step_preferred`` are per-placement planes sized to
     the padded step count (``pad_steps(n_steps)``); None means no
-    penalties/preferences.
+    penalties/preferences. ``node_perm`` is the seeded tie-break
+    permutation (identity when shuffling is off).
     """
     from nomad_tpu.tensors.schema import AskLimitError
 
@@ -555,6 +792,8 @@ def build_kernel_in(
         step_penalty = np.full((k_pad, MAX_PENALTY_NODES), -1, np.int32)
     if step_preferred is None:
         step_preferred = np.full(k_pad, -1, np.int32)
+    if node_perm is None:
+        node_perm = np.arange(N, dtype=np.int32)
 
     return KernelIn(
         cap_cpu=jnp.asarray(cluster.cap_cpu),
@@ -577,6 +816,7 @@ def build_kernel_in(
         job_tg_count=jnp.asarray(ev.job_tg_count),
         penalty=jnp.asarray(ev.penalty),
         aff_score=jnp.asarray(ev.aff_score),
+        node_perm=jnp.asarray(node_perm, jnp.int32),
         step_penalty=jnp.asarray(step_penalty, jnp.int32),
         step_preferred=jnp.asarray(step_preferred, jnp.int32),
         job_any_count=jnp.asarray(ev.job_any_count),

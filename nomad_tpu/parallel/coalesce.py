@@ -1,0 +1,247 @@
+"""Cross-eval kernel-launch coalescing: one device launch per wave.
+
+The live half of the eval-batching design (SURVEY.md section 7 step 5).
+The broker hands a worker B compatible evaluations (`dequeue_batch`);
+the worker runs each eval's scheduler on its own thread against one
+shared snapshot (the reference's concurrency axis, nomad/worker.go:386,
+collapsed into one process). Every scheduler still thinks it owns the
+device: when it reaches a placement launch, the request parks here
+instead of dispatching. Once every still-running eval of the batch is
+parked (or finished), the wave fires as ONE ``jax.vmap``'d kernel call
+and each thread resumes with its slice of the output.
+
+Why this is exact: ``KernelIn`` always carries every plane —
+``KernelFeatures`` only selects which planes the *compiled program
+reads* (ops/kernel.py). A wave compiles the union of its members'
+feature sets; members that didn't ask for a feature provide neutral
+planes (zero asks, -1 ids, inactive stanzas), which the kernel defines
+to be no-ops. So batching changes arithmetic batching only, never
+placement semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nomad_tpu.ops.kernel import (
+    KernelFeatures,
+    KernelIn,
+    KernelOut,
+    pad_steps,
+    place_taskgroups_joint_jit,
+)
+
+#: B is bucketed to limit recompiles (same trick as pad_steps)
+_WAVE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+def pad_wave(b: int) -> int:
+    for w in _WAVE_BUCKETS:
+        if b <= w:
+            return w
+    return ((b + 255) // 256) * 256
+
+
+def union_features(features: List[KernelFeatures]) -> KernelFeatures:
+    """Smallest feature set that serves every member (see module doc)."""
+    return KernelFeatures(
+        n_spreads=max(f.n_spreads for f in features),
+        with_topk=any(f.with_topk for f in features),
+        with_devices=any(f.with_devices for f in features),
+        with_ports=any(f.with_ports for f in features),
+        with_cores=any(f.with_cores for f in features),
+        with_network=any(f.with_network for f in features),
+        with_distinct=any(f.with_distinct for f in features),
+        with_step_penalties=any(f.with_step_penalties for f in features),
+        with_preferred=any(f.with_preferred for f in features),
+        with_shuffle=any(f.with_shuffle for f in features),
+    )
+
+
+def _pad_kin_steps(kin: KernelIn, k_max: int) -> KernelIn:
+    """Pad the per-step planes to the wave's step count (neutral rows)."""
+    k = int(kin.step_penalty.shape[0])
+    if k == k_max:
+        return kin
+    pen = np.full((k_max, kin.step_penalty.shape[1]), -1, np.int32)
+    pen[:k] = np.asarray(kin.step_penalty)
+    pref = np.full(k_max, -1, np.int32)
+    pref[:k] = np.asarray(kin.step_preferred)
+    return kin._replace(step_penalty=jnp.asarray(pen),
+                        step_preferred=jnp.asarray(pref))
+
+
+def launch_wave(kins: List[KernelIn], k_steps: List[int],
+                features: List[KernelFeatures]) -> List[KernelOut]:
+    """Fire B launch requests as ONE joint device call; split results.
+
+    The wave runs the joint kernel (ops/kernel.place_taskgroups_joint):
+    members' placement steps execute in arrival order over a shared
+    capacity carry, so members see each other's placements — the
+    serialized plan applier's semantics, on device.
+    """
+    k_max = max(k_steps)
+    feats = union_features(features)
+    padded = [_pad_kin_steps(kin, k_max) for kin in kins]
+    b_pad = pad_wave(len(padded))
+    if b_pad > len(padded):
+        # inert filler rows: first member with zero active steps
+        filler = padded[0]._replace(n_steps=jnp.asarray(0, jnp.int32))
+        padded = padded + [filler] * (b_pad - len(padded))
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *padded)
+
+    # step layout: member 0's steps, then member 1's, ... (the applier's
+    # serialization order = plan arrival order); padded to a bucket
+    t_real = sum(k_steps)
+    t_pad = pad_steps(t_real)
+    step_member = np.full(t_pad, -1, np.int32)
+    step_local = np.zeros(t_pad, np.int32)
+    offsets = []
+    pos = 0
+    for i, k in enumerate(k_steps):
+        offsets.append(pos)
+        step_member[pos:pos + k] = i
+        step_local[pos:pos + k] = np.arange(k)
+        pos += k
+
+    out = place_taskgroups_joint_jit(
+        stacked, jnp.asarray(step_member), jnp.asarray(step_local),
+        t_pad, feats,
+    )
+    host = jax.tree_util.tree_map(np.asarray, out)
+    results = []
+    for i, k in enumerate(k_steps):
+        o = offsets[i]
+        results.append(KernelOut(
+            chosen=host.chosen[o:o + k],
+            scores=host.scores[o:o + k],
+            found=host.found[o:o + k],
+            topk_idx=host.topk_idx[o:o + k],
+            topk_scores=host.topk_scores[o:o + k],
+            nodes_evaluated=host.nodes_evaluated[i],
+            nodes_feasible=host.nodes_feasible[i],
+            exhausted_cpu=host.exhausted_cpu[i],
+            exhausted_mem=host.exhausted_mem[i],
+            exhausted_disk=host.exhausted_disk[i],
+            exhausted_ports=host.exhausted_ports[i],
+            exhausted_devices=host.exhausted_devices[i],
+            exhausted_cores=host.exhausted_cores[i],
+        ))
+    return results
+
+
+class _Request:
+    __slots__ = ("kin", "k_steps", "features", "out", "error", "event")
+
+    def __init__(self, kin, k_steps, features):
+        self.kin = kin
+        self.k_steps = k_steps
+        self.features = features
+        self.out: Optional[KernelOut] = None
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+
+
+class LaunchCoalescer:
+    """Rendezvous point for one batch of concurrently-scheduled evals.
+
+    Every participant must end with ``done()`` (use try/finally); a wave
+    fires whenever every not-yet-done participant is parked in
+    ``launch``. The observer that completes the rendezvous (a parking
+    launcher or a finishing participant) executes the device call
+    itself — there is no dispatcher thread.
+    """
+
+    def __init__(self, participants: int) -> None:
+        self._cv = threading.Condition()
+        self._active = participants
+        self._pending: List[_Request] = []
+        # stats (asserted by tests, reported by the worker)
+        self.launches = 0
+        self.requests = 0
+        self.max_wave = 0
+
+    def launch(self, kin: KernelIn, k_steps: int,
+               features: KernelFeatures) -> KernelOut:
+        req = _Request(kin, k_steps, features)
+        wave: Optional[List[_Request]] = None
+        with self._cv:
+            self.requests += 1
+            self._pending.append(req)
+            if len(self._pending) >= self._active:
+                wave = self._pending
+                self._pending = []
+        if wave is not None:
+            self._fire(wave)
+        else:
+            req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.out
+
+    def done(self) -> None:
+        wave: Optional[List[_Request]] = None
+        with self._cv:
+            self._active -= 1
+            if self._pending and len(self._pending) >= self._active:
+                wave = self._pending
+                self._pending = []
+        if wave is not None:
+            self._fire(wave)
+
+    def _fire(self, wave: List[_Request]) -> None:
+        # members that retried after a partial-commit snapshot refresh
+        # may have crossed a node-axis pad bucket; a joint launch needs
+        # one node axis, so split by shape (each group still coalesces)
+        groups: dict = {}
+        for r in wave:
+            groups.setdefault(int(r.kin.cap_cpu.shape[0]), []).append(r)
+        for grp in groups.values():
+            self.launches += 1
+            self.max_wave = max(self.max_wave, len(grp))
+            try:
+                outs = launch_wave(
+                    [r.kin for r in grp],
+                    [r.k_steps for r in grp],
+                    [r.features for r in grp],
+                )
+                for r, out in zip(grp, outs):
+                    r.out = out
+            except BaseException as e:              # noqa: BLE001
+                for r in grp:
+                    r.error = e
+            for r in grp:
+                r.event.set()
+
+
+class ClusterCache:
+    """Identity-keyed ClusterTensors memo shared by a batch's evals.
+
+    Evals scheduled against the same snapshot see the same node set, so
+    the flattened node planes build once per (snapshot, batch) instead
+    of once per eval. Partial-commit retries hand the scheduler a newer
+    snapshot — a different key — and rebuild naturally.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cache = {}
+
+    def get(self, state):
+        from nomad_tpu.tensors.schema import ClusterTensors
+
+        key = id(state)
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None and hit[0] is state:
+                return hit[1]
+        built = ClusterTensors.build(state.nodes())
+        with self._lock:
+            self._cache[key] = (state, built)
+        return built

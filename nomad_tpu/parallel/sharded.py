@@ -55,6 +55,9 @@ _IN_SPECS = dict(
     ask_cpu=P(_B), ask_mem=P(_B), ask_disk=P(_B), ask_cores=P(_B),
     ask_dyn_ports=P(_B), ask_has_reserved_ports=P(_B), ask_mbits=P(_B),
     desired_count=P(_B), algorithm_spread=P(_B), n_steps=P(_B),
+    # tie-break permutation [B, N] (replicated over nodes: it indexes
+    # the global node axis, so it cannot shard with it)
+    node_perm=P(_B, None),
     # per-step planes [B, K, ...]
     step_penalty=P(_B, None, None), step_preferred=P(_B, None),
     # spreads

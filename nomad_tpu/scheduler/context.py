@@ -101,13 +101,25 @@ class PortCollisionEvent:
 class EvalContext:
     """Per-evaluation context (context.go:127)."""
 
-    def __init__(self, state, plan: Plan, logger=None, events_cb=None) -> None:
+    def __init__(self, state, plan: Plan, logger=None, events_cb=None,
+                 kernel_launch=None) -> None:
         self.state = state
         self.plan = plan
         self.logger = logger
         self.events_cb = events_cb
         self.eligibility = EvalEligibility()
         self.metrics_obj = AllocMetric()
+        # per-eval decorrelation seed for stochastic dynamic-port
+        # assignment (network.go:598); None = precise selection
+        self.port_seed: Optional[int] = None
+        # the placement-kernel dispatch point: defaults to a direct
+        # device call; a batching worker injects a LaunchCoalescer so
+        # concurrent evals share one vmapped launch (parallel/coalesce.py)
+        if kernel_launch is None:
+            from nomad_tpu.ops.kernel import place_taskgroup_jit
+
+            kernel_launch = place_taskgroup_jit
+        self.kernel_launch = kernel_launch
 
     def metrics(self) -> AllocMetric:
         return self.metrics_obj

@@ -51,11 +51,13 @@ BLOCKED_EVAL_FAILED_PLACEMENTS = "created to place remaining allocations"
 
 class GenericScheduler(Scheduler):
     def __init__(self, state: SchedulerState, planner: Planner, batch: bool = False,
-                 events_cb=None) -> None:
+                 events_cb=None, kernel_launch=None, cluster_provider=None) -> None:
         self.state = state
         self.planner = planner
         self.batch = batch
         self.events_cb = events_cb
+        self.kernel_launch = kernel_launch
+        self.cluster_provider = cluster_provider
         self.eval: Optional[Evaluation] = None
         self.job = None
         self.plan: Optional[Plan] = None
@@ -127,9 +129,20 @@ class GenericScheduler(Scheduler):
                 self.eval.namespace, self.eval.job_id
             )
         self.failed_tg_allocs = {}
-        self.ctx = EvalContext(self.state, self.plan, events_cb=self.events_cb)
+        self.ctx = EvalContext(self.state, self.plan, events_cb=self.events_cb,
+                               kernel_launch=self.kernel_launch)
         self._cluster = self._build_cluster()
         self.stack = XLAGenericStack(self.batch, self.ctx, self._cluster)
+        # decorrelate concurrent evals' tie-breaking (shuffleNodes
+        # util.go:464: seeded by plan id + state index) and their
+        # dynamic-port picks (network.go:598 stochastic selection)
+        import zlib
+
+        seed = zlib.crc32(
+            f"{self.eval.id}:{self.state.latest_index()}".encode()
+        )
+        self.stack.shuffle_seed = seed
+        self.ctx.port_seed = seed
         if self.job is not None and not self.job.stopped():
             self.stack.set_job(self.job)
 
@@ -170,6 +183,8 @@ class GenericScheduler(Scheduler):
         return True, None
 
     def _build_cluster(self) -> ClusterTensors:
+        if self.cluster_provider is not None:
+            return self.cluster_provider(self.state)
         return ClusterTensors.build(self.state.nodes())
 
     # -- reconcile + placements (generic_sched.go:358,499) ---------------

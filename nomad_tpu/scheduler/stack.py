@@ -88,6 +88,9 @@ class XLAGenericStack:
         self.job = None
         self._feas = FeasibilityBuilder(cluster, ctx.state, ctx)
         self._affinity_cache: Dict[Tuple[str, str], float] = {}
+        # seeded node-order decorrelation (shuffleNodes util.go:464 --
+        # seeded by eval id + state index); None = deterministic argmax
+        self.shuffle_seed: Optional[int] = None
 
     # -- job/tg configuration (stack.go SetJob) --------------------------
 
@@ -108,6 +111,11 @@ class XLAGenericStack:
         snapshot = self.ctx.state
         k = len(requests)
         k_pad = pad_steps(k)
+
+        node_perm = None
+        if self.shuffle_seed is not None:
+            rng = np.random.default_rng(self.shuffle_seed)
+            node_perm = rng.permutation(c.n_pad).astype(np.int32)
 
         exclude = np.zeros(c.n_pad, bool)
         results: List[Optional[SelectedOption]] = [None] * k
@@ -133,13 +141,15 @@ class XLAGenericStack:
                 if req.preferred_node:
                     step_preferred[slot] = c.index.get(req.preferred_node, -1)
 
-            kin = build_kernel_in(c, ev, len(pending), step_penalty, step_preferred)
+            kin = build_kernel_in(c, ev, len(pending), step_penalty,
+                                  step_preferred, node_perm=node_perm)
             features = infer_features(
                 ev,
                 any_penalty=any(requests[ri].penalty_nodes for ri in pending),
                 any_preferred=any(requests[ri].preferred_node for ri in pending),
+                with_shuffle=node_perm is not None,
             )
-            out = place_taskgroup_jit(kin, k_pad, features)
+            out = self.ctx.kernel_launch(kin, k_pad, features)
             out = KernelOut(*[np.asarray(x) for x in out])
             self._merge_kernel_metrics(out)
 
@@ -678,6 +688,10 @@ class _NodeAssigner:
         if proposed is None:
             proposed = ctx.proposed_allocs(node.id)
         self.net_idx = NetworkIndex()
+        if ctx.port_seed is not None:
+            import zlib
+
+            self.net_idx.seed(ctx.port_seed ^ zlib.crc32(node.id.encode()))
         collide, reason = self.net_idx.set_node(node)
         self.ok = not collide
         if self.ok:

@@ -59,11 +59,14 @@ def _feasible_mask_jit(kin: KernelIn):
 
 class SystemScheduler(Scheduler):
     def __init__(self, state: SchedulerState, planner: Planner,
-                 sysbatch: bool = False, events_cb=None) -> None:
+                 sysbatch: bool = False, events_cb=None,
+                 kernel_launch=None, cluster_provider=None) -> None:
         self.state = state
         self.planner = planner
         self.sysbatch = sysbatch
         self.events_cb = events_cb
+        self.kernel_launch = kernel_launch
+        self.cluster_provider = cluster_provider
         self.eval: Optional[Evaluation] = None
         self.job = None
         self.plan = None
@@ -101,7 +104,8 @@ class SystemScheduler(Scheduler):
         self.plan = self.eval.make_plan(self.job)
         self.failed_tg_allocs = {}
         self.queued_allocs = {}
-        self.ctx = EvalContext(self.state, self.plan, events_cb=self.events_cb)
+        self.ctx = EvalContext(self.state, self.plan, events_cb=self.events_cb,
+                               kernel_launch=self.kernel_launch)
 
         allocs = self.state.allocs_by_job(self.eval.namespace, self.eval.job_id)
         tainted = tainted_nodes(self.state, allocs)
@@ -129,7 +133,10 @@ class SystemScheduler(Scheduler):
         return True, None
 
     def _compute_system_placements(self, live_allocs: List[Allocation], tainted) -> None:
-        cluster = ClusterTensors.build(self.state.nodes())
+        if self.cluster_provider is not None:
+            cluster = self.cluster_provider(self.state)
+        else:
+            cluster = ClusterTensors.build(self.state.nodes())
         stack = XLAGenericStack(False, self.ctx, cluster)
         stack.set_job(self.job)
         now = _time.time()

@@ -9,9 +9,15 @@ eval type against that immutable snapshot, and acts as the scheduler's
 refreshed snapshot on partial commit; Create/Update/ReblockEval route
 through the Raft boundary (here: the server's apply path).
 
-TPU-native addition: a worker can dequeue a *batch* of evals and
-process them back-to-back against one device-resident snapshot --
-the eval-batching throughput path (SURVEY.md section 7 step 5).
+TPU-native addition (SURVEY.md section 7 step 5): with batch_size > 1 a
+worker dequeues a *batch* of evals, runs each eval's scheduler on its
+own thread against ONE shared snapshot, and coalesces their placement
+launches into single vmapped device calls (parallel/coalesce.py). The
+reference gets eval concurrency from N workers x M servers; the TPU
+build gets it from one worker amortizing N evals per kernel launch.
+Plan submission stays per-eval and serialized through the leader's
+applier — optimistic concurrency semantics are identical to reference
+workers scheduling concurrently against a shared state index.
 """
 
 from __future__ import annotations
@@ -37,6 +43,48 @@ DEFAULT_SCHEDULERS = [
 ]
 
 
+class _EvalRun:
+    """Planner for one evaluation (worker.go:593 SubmitPlan etc.).
+
+    Thread-confined so a batching worker can schedule many evals
+    concurrently; the single-eval path uses it too.
+    """
+
+    def __init__(self, server, ev: Evaluation, token: str, snapshot) -> None:
+        self.server = server
+        self.eval = ev
+        self.token = token
+        self.snapshot = snapshot
+
+    # --- Planner interface ---------------------------------------------
+
+    def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
+        plan.eval_id = self.eval.id
+        plan.eval_token = self.token
+        plan.snapshot_index = self.snapshot.latest_index()
+        result = self.server.submit_plan(plan)
+        state = None
+        if result is not None and result.refresh_index > 0:
+            # partial commit: hand the scheduler a newer snapshot to
+            # retry against (worker.go:631-646)
+            state = self.server.snapshot_min_index(result.refresh_index)
+            self.snapshot = state
+        return result, state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.update_eval(ev, token=self.token)
+
+    def create_eval(self, ev: Evaluation) -> None:
+        ev.previous_eval = self.eval.id
+        self.server.create_eval(ev, token=self.token)
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.reblock_eval(ev, token=self.token)
+
+    def serve_rs_meet_minimum_version(self) -> bool:
+        return True
+
+
 class Worker:
     def __init__(
         self,
@@ -54,11 +102,10 @@ class Worker:
         self._pause = threading.Event()
         self.processed = 0
         self.last_error: Optional[str] = None
-
-        # current eval context (set while scheduling; used by Planner calls)
-        self._eval: Optional[Evaluation] = None
-        self._token: str = ""
-        self._snapshot = None
+        # cumulative coalescing stats from batch waves
+        self.batch_launches = 0
+        self.batch_requests = 0
+        self.max_wave = 0
 
     # --- lifecycle (worker.go run/pause) --------------------------------
 
@@ -98,28 +145,37 @@ class Worker:
         )
         if not batch:
             return False
-        for ev, token in batch:
+        if len(batch) == 1:
+            ev, token = batch[0]
             self._process(ev, token)
+        else:
+            self._process_batch(batch)
         return True
 
-    def _process(self, ev: Evaluation, token: str) -> None:
+    def _process(self, ev: Evaluation, token: str,
+                 snapshot=None, launcher=None, cluster_provider=None) -> None:
         try:
-            # SnapshotMinIndex: local raft must catch up to the eval
-            # before scheduling (worker.go:537)
-            wait_index = max(ev.modify_index, ev.snapshot_index)
-            self._snapshot = self.server.snapshot_min_index(wait_index)
+            if snapshot is None:
+                # SnapshotMinIndex: local raft must catch up to the eval
+                # before scheduling (worker.go:537)
+                wait_index = max(ev.modify_index, ev.snapshot_index)
+                snapshot = self.server.snapshot_min_index(wait_index)
             # stamp the snapshot the scheduler runs against on a copy --
             # the store's row must stay immutable (worker.go
             # updateEvalSnapshotIndex routes this through Raft); blocked
             # evals derived from this one inherit the stamp
             ev = ev.copy()
-            ev.snapshot_index = self._snapshot.latest_index()
-            self._eval = ev
-            self._token = token
+            ev.snapshot_index = snapshot.latest_index()
+            run = _EvalRun(self.server, ev, token, snapshot)
             if ev.type == consts.JOB_TYPE_CORE:
-                sched = self.server.new_core_scheduler(self._snapshot, self)
+                sched = self.server.new_core_scheduler(snapshot, run)
             else:
-                sched = new_scheduler(ev.type, self._snapshot, self)
+                kw = {}
+                if launcher is not None:
+                    kw["kernel_launch"] = launcher
+                if cluster_provider is not None:
+                    kw["cluster_provider"] = cluster_provider
+                sched = new_scheduler(ev.type, snapshot, run, **kw)
             sched.process(ev)
             self.server.eval_broker.ack(ev.id, token)
             self.processed += 1
@@ -131,37 +187,58 @@ class Worker:
                 self.server.eval_broker.nack(ev.id, token)
             except Exception:                       # noqa: BLE001
                 pass
-        finally:
-            self._eval = None
-            self._token = ""
-            self._snapshot = None
 
-    # --- Planner interface (worker.go:593 SubmitPlan etc.) --------------
+    def _process_batch(self, batch: List[Tuple[Evaluation, str]]) -> None:
+        """Schedule a batch of evals concurrently with coalesced launches.
 
-    def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
-        plan.eval_id = self._eval.id if self._eval is not None else plan.eval_id
-        plan.eval_token = self._token
-        plan.snapshot_index = (
-            self._snapshot.latest_index() if self._snapshot is not None else 0
+        All evals share one snapshot taken at the max of their wait
+        indexes (each still stamps its own copy); their placement
+        kernels fire as joint waves. Plans submit per-eval through the
+        normal applier path, so conflicting placements resolve exactly
+        as they do between reference workers: re-validation + partial
+        commit + retry against a refreshed snapshot.
+        """
+        from nomad_tpu.parallel.coalesce import ClusterCache, LaunchCoalescer
+
+        wait_index = max(
+            max(ev.modify_index, ev.snapshot_index) for ev, _ in batch
         )
-        result = self.server.submit_plan(plan)
-        state = None
-        if result is not None and result.refresh_index > 0:
-            # partial commit: hand the scheduler a newer snapshot to
-            # retry against (worker.go:631-646)
-            state = self.server.snapshot_min_index(result.refresh_index)
-        return result, state
+        try:
+            snapshot = self.server.snapshot_min_index(wait_index)
+        except Exception:                           # noqa: BLE001
+            # snapshot catch-up failed for the whole batch: nack all
+            for ev, token in batch:
+                try:
+                    self.server.eval_broker.nack(ev.id, token)
+                except Exception:                   # noqa: BLE001
+                    pass
+            return
 
-    def update_eval(self, ev: Evaluation) -> None:
-        self.server.update_eval(ev, token=self._token)
+        coalescer = LaunchCoalescer(len(batch))
+        clusters = ClusterCache()
 
-    def create_eval(self, ev: Evaluation) -> None:
-        if self._eval is not None:
-            ev.previous_eval = self._eval.id
-        self.server.create_eval(ev, token=self._token)
+        def one(ev: Evaluation, token: str) -> None:
+            try:
+                self._process(
+                    ev, token,
+                    snapshot=snapshot,
+                    launcher=coalescer.launch,
+                    cluster_provider=clusters.get,
+                )
+            finally:
+                coalescer.done()
 
-    def reblock_eval(self, ev: Evaluation) -> None:
-        self.server.reblock_eval(ev, token=self._token)
-
-    def serve_rs_meet_minimum_version(self) -> bool:
-        return True
+        threads = [
+            threading.Thread(
+                target=one, args=(ev, token),
+                daemon=True, name=f"worker-{self.id}-eval",
+            )
+            for ev, token in batch
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.batch_launches += coalescer.launches
+        self.batch_requests += coalescer.requests
+        self.max_wave = max(self.max_wave, coalescer.max_wave)

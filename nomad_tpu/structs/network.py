@@ -126,6 +126,14 @@ class NetworkIndex:
         self.used_bandwidth: Dict[str, int] = {}
         self.min_dynamic_port = DEFAULT_MIN_DYNAMIC_PORT
         self.max_dynamic_port = DEFAULT_MAX_DYNAMIC_PORT
+        self._rng = None
+
+    def seed(self, seed: int) -> None:
+        """Enable stochastic dynamic-port selection (network.go:598),
+        deterministically per seed."""
+        import random
+
+        self._rng = random.Random(seed)
 
     # -- setup ------------------------------------------------------------
 
@@ -234,15 +242,31 @@ class NetworkIndex:
         return False
 
     def _assign_dynamic(self, used: PortBitmap, reserved_asks: List[Port], count: int) -> Optional[List[int]]:
-        """Deterministic lowest-free dynamic port selection.
+        """Dynamic port selection: seeded-stochastic, then precise.
 
         The reference tries stochastic then precise selection
-        (network.go:598,640); we use the precise path (lowest free ports)
-        for determinism -- same feasibility, reproducible plans.
+        (network.go:598,640). The stochastic pass matters under
+        concurrency: schedulers picking ports for the same node from
+        the same snapshot must decorrelate, or every plan but the first
+        is rejected by the applier's collision re-check. ``seed()``
+        (per eval, like shuffleNodes util.go:464) keeps plans
+        reproducible; unseeded indexes use the precise path only.
         """
         if count == 0:
             return []
         taken = {p.value for p in reserved_asks}
+        if self._rng is not None:
+            span = self.max_dynamic_port - self.min_dynamic_port + 1
+            picked: List[int] = []
+            for _ in range(20 * count + 20):
+                if len(picked) == count:
+                    break
+                port = self.min_dynamic_port + self._rng.randrange(span)
+                if port in taken or port in picked or used.check(port):
+                    continue
+                picked.append(port)
+            if len(picked) == count:
+                return picked
         out: List[int] = []
         # Over-fetch by len(taken) so reserved asks in the range can't starve us.
         candidates = used.indexes_in_range(

@@ -69,7 +69,10 @@ class TestDequeueBatch:
 class TestLiveBatchedWorkers:
     def test_burst_of_jobs_all_schedule(self):
         """A server whose single worker processes 8-eval batches must
-        place a burst of concurrently registered jobs correctly."""
+        place a burst of concurrently registered jobs correctly — and
+        do it through COALESCED device launches (one joint kernel call
+        per wave of concurrently scheduled evals), not one launch per
+        eval."""
         server = Server(ServerConfig(num_workers=1, worker_batch_size=8))
         server.start()
         try:
@@ -97,5 +100,95 @@ class TestLiveBatchedWorkers:
             for j in jobs:
                 for a in snap.allocs_by_job(j.namespace, j.id):
                     assert snap.node_by_id(a.node_id) is not None
+            # the batching claim itself: 12 kernel requests served by
+            # far fewer joint launches, with a real multi-eval wave
+            w = server.workers[0]
+            assert w.batch_requests >= 12
+            assert w.batch_launches < w.batch_requests
+            assert w.max_wave >= 4
         finally:
             server.shutdown()
+
+
+class TestLaunchCoalescer:
+    def test_joint_wave_members_see_each_others_placements(self):
+        """The joint kernel runs wave members over a SHARED capacity
+        carry (the plan applier's serialization, on device): a later
+        member must not over-subscribe a node an earlier member filled."""
+        import numpy as np
+
+        from nomad_tpu.ops.kernel import (
+            build_kernel_in, infer_features, pad_steps,
+        )
+        from nomad_tpu.parallel.coalesce import launch_wave
+        from nomad_tpu.scheduler.context import EvalContext
+        from nomad_tpu.scheduler.stack import XLAGenericStack
+        from nomad_tpu.scheduler.testing import Harness
+        from nomad_tpu.structs.eval_plan import Plan
+        from nomad_tpu.tensors.schema import ClusterTensors
+
+        h = Harness()
+        # one node, capacity for exactly 2 allocs of the big ask
+        node = mock.node()
+        h.state.upsert_node(node)
+        job = mock.simple_job()
+        job.task_groups[0].tasks[0].resources.cpu = 1500
+        h.state.upsert_job(job)
+        snap = h.state.snapshot()
+        c = ClusterTensors.build(snap.nodes())
+        ctx = EvalContext(snap, Plan())
+        st = XLAGenericStack(False, ctx, c)
+        st.set_job(job)
+        tg = job.task_groups[0]
+        ev = st._build_eval_tensors(tg, np.zeros(c.n_pad, bool))
+        kin = build_kernel_in(c, ev, 2)
+        feats = infer_features(ev)
+        kp = pad_steps(2)
+
+        # three members, each asking 2 x 1500 MHz against one 3900 MHz
+        # node: joint accounting admits only the first 2 placements
+        outs = launch_wave([kin, kin, kin], [kp, kp, kp], [feats] * 3)
+        found = [bool(o.found[i]) for o in outs for i in range(2)]
+        assert sum(found) == 2, found
+        # and they are the FIRST members' placements (applier order)
+        assert outs[0].found[:2].all()
+        assert not outs[1].found[:2].any()
+        assert not outs[2].found[:2].any()
+
+    def test_wave_output_matches_single_launch_for_lone_member(self):
+        """A 1-member wave must equal the direct per-eval kernel."""
+        import numpy as np
+
+        from nomad_tpu.ops.kernel import (
+            build_kernel_in, infer_features, pad_steps, place_taskgroup_jit,
+        )
+        from nomad_tpu.parallel.coalesce import launch_wave
+        from nomad_tpu.scheduler.context import EvalContext
+        from nomad_tpu.scheduler.stack import XLAGenericStack
+        from nomad_tpu.scheduler.testing import Harness
+        from nomad_tpu.structs.eval_plan import Plan
+        from nomad_tpu.tensors.schema import ClusterTensors
+
+        h = Harness()
+        for _ in range(5):
+            h.state.upsert_node(mock.node())
+        job = mock.job()
+        h.state.upsert_job(job)
+        snap = h.state.snapshot()
+        c = ClusterTensors.build(snap.nodes())
+        ctx = EvalContext(snap, Plan())
+        st = XLAGenericStack(False, ctx, c)
+        st.set_job(job)
+        tg = job.task_groups[0]
+        ev = st._build_eval_tensors(tg, np.zeros(c.n_pad, bool))
+        kin = build_kernel_in(c, ev, 3)
+        feats = infer_features(ev)
+        kp = pad_steps(3)
+        direct = place_taskgroup_jit(kin, kp, feats)
+        import numpy as np  # noqa: F811
+
+        wave = launch_wave([kin], [kp], [feats])[0]
+        assert (np.asarray(direct.chosen) == wave.chosen).all()
+        assert (np.asarray(direct.found) == wave.found).all()
+        assert np.allclose(np.asarray(direct.scores), wave.scores, atol=1e-6)
+        assert int(direct.nodes_evaluated) == int(wave.nodes_evaluated)
