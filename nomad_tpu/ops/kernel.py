@@ -44,6 +44,37 @@ from nomad_tpu.tensors.schema import (
     EvalTensors,
 )
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (set up when the kernel module
+    loads, i.e. only for consumers that actually touch the device path).
+
+    The scheduler compiles one kernel variant per (wave size, step
+    bucket, feature set); on TPU a cold compile is tens of seconds.
+    The persistent cache makes every variant a one-time cost per
+    machine instead of per process — without it, a fresh server paying
+    full compiles mid-scheduling can outlive the eval broker's nack
+    timeout and thrash redeliveries. Respects an existing user-set
+    cache dir; disable with NOMAD_TPU_COMPILE_CACHE=0.
+    """
+    import os
+
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return
+        cache_dir = os.environ.get(
+            "NOMAD_TPU_COMPILE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "nomad_tpu_xla"),
+        )
+        if cache_dir and cache_dir != "0":
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
+
+
+_enable_compile_cache()
+
 NEG_INF = -1.0e30
 TOPK = 8          # top-K score metadata returned per placement (AllocMetric)
 MAX_PENALTY_NODES = 4   # previous nodes penalized per rescheduled placement
@@ -515,6 +546,11 @@ class JointOut(NamedTuple):
     exhausted_ports: jnp.ndarray
     exhausted_devices: jnp.ndarray
     exhausted_cores: jnp.ndarray
+    # final shared-capacity carry: total resources the wave consumed
+    # per node (lets a caller commit the wave as one scatter)
+    a_cpu: jnp.ndarray               # f32[N]
+    a_mem: jnp.ndarray               # f32[N]
+    a_disk: jnp.ndarray              # f32[N]
 
 
 def place_taskgroups_joint(
@@ -679,7 +715,7 @@ def place_taskgroups_joint(
         )
         return st2, out
 
-    _, (chosen, scores, found, topk_idx, topk_scores) = jax.lax.scan(
+    st_final, (chosen, scores, found, topk_idx, topk_scores) = jax.lax.scan(
         step, init, jnp.arange(t_steps)
     )
 
@@ -714,6 +750,8 @@ def place_taskgroups_joint(
         exhausted_cpu=m_cpu, exhausted_mem=m_mem, exhausted_disk=m_disk,
         exhausted_ports=m_ports, exhausted_devices=m_dev,
         exhausted_cores=m_cores,
+        a_cpu=st_final["a_cpu"], a_mem=st_final["a_mem"],
+        a_disk=st_final["a_disk"],
     )
 
 
@@ -795,48 +833,52 @@ def build_kernel_in(
     if node_perm is None:
         node_perm = np.arange(N, dtype=np.int32)
 
+    # leaves stay NUMPY: jit uploads each argument once at call time.
+    # Building device arrays here would mean one host->device transfer
+    # per field per evaluation (and per wave member when coalescing) —
+    # on a remote-device transport every transfer is a round trip.
     return KernelIn(
-        cap_cpu=jnp.asarray(cluster.cap_cpu),
-        cap_mem=jnp.asarray(cluster.cap_mem),
-        cap_disk=jnp.asarray(cluster.cap_disk),
-        free_cores=jnp.asarray(cluster.free_cores),
-        shares_per_core=jnp.asarray(cluster.shares_per_core),
-        free_dyn=jnp.asarray(cluster.free_dyn - ev.free_dyn_delta),
-        base_mask=jnp.asarray(ev.base_mask),
-        used_cpu=jnp.asarray(ev.used_cpu),
-        used_mem=jnp.asarray(ev.used_mem),
-        used_disk=jnp.asarray(ev.used_disk),
-        used_cores=jnp.asarray(ev.used_cores),
-        used_mbits=jnp.asarray(ev.used_mbits),
-        avail_mbits=jnp.asarray(ev.avail_mbits),
-        port_conflict=jnp.asarray(conflict),
-        dev_free=jnp.asarray(ev.dev_free),
-        dev_aff_score=jnp.asarray(ev.dev_aff_score),
-        has_dev_affinity=jnp.asarray(ev.has_dev_affinity),
-        job_tg_count=jnp.asarray(ev.job_tg_count),
-        penalty=jnp.asarray(ev.penalty),
-        aff_score=jnp.asarray(ev.aff_score),
-        node_perm=jnp.asarray(node_perm, jnp.int32),
-        step_penalty=jnp.asarray(step_penalty, jnp.int32),
-        step_preferred=jnp.asarray(step_preferred, jnp.int32),
-        job_any_count=jnp.asarray(ev.job_any_count),
-        distinct_hosts_job=jnp.asarray(ev.distinct_hosts_job),
-        distinct_hosts_tg=jnp.asarray(ev.distinct_hosts_tg),
-        spread_active=jnp.asarray(sp_active),
-        spread_even=jnp.asarray(sp_even),
-        spread_weight=jnp.asarray(sp_weight),
-        spread_bucket=jnp.asarray(sp_bucket),
-        spread_counts=jnp.asarray(sp_counts),
-        spread_desired=jnp.asarray(sp_desired),
-        ask_cpu=jnp.asarray(ev.ask.cpu, jnp.float32),
-        ask_mem=jnp.asarray(ev.ask.mem, jnp.float32),
-        ask_disk=jnp.asarray(ev.ask.disk, jnp.float32),
-        ask_cores=jnp.asarray(ev.ask.cores, jnp.int32),
-        ask_dyn_ports=jnp.asarray(ev.ask.n_dyn_ports, jnp.int32),
-        ask_has_reserved_ports=jnp.asarray(has_res),
-        ask_dev=jnp.asarray(ev.ask.dev_counts, jnp.float32),
-        ask_mbits=jnp.asarray(ev.ask.total_mbits, jnp.int32),
-        desired_count=jnp.asarray(ev.desired_count, jnp.int32),
-        algorithm_spread=jnp.asarray(ev.algorithm == "spread"),
-        n_steps=jnp.asarray(n_steps, jnp.int32),
+        cap_cpu=np.asarray(cluster.cap_cpu, np.float32),
+        cap_mem=np.asarray(cluster.cap_mem, np.float32),
+        cap_disk=np.asarray(cluster.cap_disk, np.float32),
+        free_cores=np.asarray(cluster.free_cores, np.int32),
+        shares_per_core=np.asarray(cluster.shares_per_core, np.float32),
+        free_dyn=np.asarray(cluster.free_dyn - ev.free_dyn_delta, np.int32),
+        base_mask=np.asarray(ev.base_mask, bool),
+        used_cpu=np.asarray(ev.used_cpu, np.float32),
+        used_mem=np.asarray(ev.used_mem, np.float32),
+        used_disk=np.asarray(ev.used_disk, np.float32),
+        used_cores=np.asarray(ev.used_cores, np.int32),
+        used_mbits=np.asarray(ev.used_mbits, np.int32),
+        avail_mbits=np.asarray(ev.avail_mbits, np.int32),
+        port_conflict=np.asarray(conflict, bool),
+        dev_free=np.asarray(ev.dev_free, np.float32),
+        dev_aff_score=np.asarray(ev.dev_aff_score, np.float32),
+        has_dev_affinity=np.asarray(ev.has_dev_affinity, bool),
+        job_tg_count=np.asarray(ev.job_tg_count, np.int32),
+        penalty=np.asarray(ev.penalty, bool),
+        aff_score=np.asarray(ev.aff_score, np.float32),
+        node_perm=np.asarray(node_perm, np.int32),
+        step_penalty=np.asarray(step_penalty, np.int32),
+        step_preferred=np.asarray(step_preferred, np.int32),
+        job_any_count=np.asarray(ev.job_any_count, np.int32),
+        distinct_hosts_job=np.asarray(ev.distinct_hosts_job, bool),
+        distinct_hosts_tg=np.asarray(ev.distinct_hosts_tg, bool),
+        spread_active=np.asarray(sp_active, bool),
+        spread_even=np.asarray(sp_even, bool),
+        spread_weight=np.asarray(sp_weight, np.float32),
+        spread_bucket=np.asarray(sp_bucket, np.int32),
+        spread_counts=np.asarray(sp_counts, np.float32),
+        spread_desired=np.asarray(sp_desired, np.float32),
+        ask_cpu=np.asarray(ev.ask.cpu, np.float32),
+        ask_mem=np.asarray(ev.ask.mem, np.float32),
+        ask_disk=np.asarray(ev.ask.disk, np.float32),
+        ask_cores=np.asarray(ev.ask.cores, np.int32),
+        ask_dyn_ports=np.asarray(ev.ask.n_dyn_ports, np.int32),
+        ask_has_reserved_ports=np.asarray(has_res, bool),
+        ask_dev=np.asarray(ev.ask.dev_counts, np.float32),
+        ask_mbits=np.asarray(ev.ask.total_mbits, np.int32),
+        desired_count=np.asarray(ev.desired_count, np.int32),
+        algorithm_spread=np.asarray(ev.algorithm == "spread", bool),
+        n_steps=np.asarray(n_steps, np.int32),
     )

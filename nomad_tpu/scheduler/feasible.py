@@ -164,6 +164,13 @@ class FeasibilityBuilder:
         mask = c.ready.copy()
         dcs = set(datacenters)
         wildcard = any("*" in dc for dc in dcs)
+        if not wildcard and hasattr(c, "dc_pool_arrays"):
+            # vectorized fast path (no glob patterns in the job's DCs)
+            dc_arr, pool_arr = c.dc_pool_arrays()
+            mask &= np.isin(dc_arr, list(dcs))
+            if node_pool and node_pool != "all":
+                mask &= pool_arr == node_pool
+            return mask
         for i in range(c.n_real):
             if c.datacenters[i] not in dcs:
                 if not (wildcard and _dc_glob_match(dcs, c.datacenters[i])):
@@ -185,7 +192,11 @@ class FeasibilityBuilder:
         drivers = required_drivers(tg)
         escaped = elig.has_escaped()
 
-        nodes_by_id = {nid: self.snapshot.node_by_id(nid) for nid in c.node_ids}
+        # node objects are immutable per snapshot; the cluster build's
+        # map avoids an O(N) dict rebuild per evaluation
+        nodes_by_id = c.nodes_by_id or {
+            nid: self.snapshot.node_by_id(nid) for nid in c.node_ids
+        }
 
         # class-memoized job + tg checks
         for cls, rows in self._classes().items():

@@ -185,7 +185,9 @@ class GenericScheduler(Scheduler):
     def _build_cluster(self) -> ClusterTensors:
         if self.cluster_provider is not None:
             return self.cluster_provider(self.state)
-        return ClusterTensors.build(self.state.nodes())
+        from nomad_tpu.parallel.coalesce import default_cluster_cache
+
+        return default_cluster_cache.get(self.state)
 
     # -- reconcile + placements (generic_sched.go:358,499) ---------------
 

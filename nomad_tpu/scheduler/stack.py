@@ -385,12 +385,8 @@ class XLAGenericStack:
             used_cpu, used_mem, used_disk, used_mbits, used_cores,
             job_tg_count, job_any_count, conflict_words, free_dyn_delta, tg, ask,
         )
-        for i in range(c.n_real):
-            node = snapshot.node_by_id(c.node_ids[i])
-            if node is not None:
-                avail_mbits[i] = sum(
-                    net.mbits for net in node.node_resources.networks
-                )
+        # node-static plane, shared from the cluster build (read-only)
+        avail_mbits = c.avail_mbits if c.avail_mbits is not None else avail_mbits
 
         # device planes
         dev_free = np.zeros((n, MAX_DEV_REQS), np.float32)
@@ -508,10 +504,45 @@ class XLAGenericStack:
                 if a.task_group == tg.name:
                     job_tg_count[row] += int(sign)
 
-        for a in snapshot.allocs_iter():
-            if a.terminal_status() or a.id in stopping or a.id in planned_ids:
-                continue
-            add_alloc(a, 1.0)
+        u = getattr(snapshot, "usage", None)
+        if u is not None:
+            # fast path: gather the store's live utilization planes
+            # (state/usage.py) instead of scanning every alloc, then
+            # correct for this plan's staged stops and in-plan updates
+            perm, valid = c.usage_perm(u)
+            np.copyto(used_cpu, np.where(valid, u.used_cpu[perm], 0.0))
+            np.copyto(used_mem, np.where(valid, u.used_mem[perm], 0.0))
+            np.copyto(used_disk, np.where(valid, u.used_disk[perm], 0.0))
+            np.copyto(used_cores, np.where(valid, u.used_cores[perm], 0))
+            np.copyto(used_mbits, np.where(valid, u.used_mbits[perm], 0))
+            for aid in stopping | planned_ids:
+                old = snapshot.alloc_by_id(aid)
+                if old is not None and not old.terminal_status():
+                    row = c.index.get(old.node_id)
+                    if row is None:
+                        continue
+                    cr = old.comparable_resources()
+                    used_cpu[row] -= cr.cpu_shares
+                    used_mem[row] -= cr.memory_mb
+                    used_disk[row] -= cr.disk_mb
+                    used_cores[row] -= len(cr.reserved_cores)
+                    for net in cr.networks:
+                        used_mbits[row] -= net.mbits
+            # job-local planes from the per-job index (small)
+            for a in snapshot.allocs_by_job(job.namespace, job.id):
+                if a.terminal_status() or a.id in stopping or a.id in planned_ids:
+                    continue
+                row = c.index.get(a.node_id)
+                if row is None or a.job_id != job.id:
+                    continue
+                job_any_count[row] += 1
+                if a.task_group == tg.name:
+                    job_tg_count[row] += 1
+        else:
+            for a in snapshot.allocs_iter():
+                if a.terminal_status() or a.id in stopping or a.id in planned_ids:
+                    continue
+                add_alloc(a, 1.0)
         for allocs in plan.node_allocation.values():
             for a in allocs:
                 add_alloc(a, 1.0)

@@ -106,6 +106,13 @@ class SystemScheduler(Scheduler):
         self.queued_allocs = {}
         self.ctx = EvalContext(self.state, self.plan, events_cb=self.events_cb,
                                kernel_launch=self.kernel_launch)
+        # decorrelate concurrent evals' dynamic-port picks, like the
+        # generic scheduler (network.go:598 stochastic selection)
+        import zlib
+
+        self.ctx.port_seed = zlib.crc32(
+            f"{self.eval.id}:{self.state.latest_index()}".encode()
+        )
 
         allocs = self.state.allocs_by_job(self.eval.namespace, self.eval.job_id)
         tainted = tainted_nodes(self.state, allocs)
@@ -136,7 +143,9 @@ class SystemScheduler(Scheduler):
         if self.cluster_provider is not None:
             cluster = self.cluster_provider(self.state)
         else:
-            cluster = ClusterTensors.build(self.state.nodes())
+            from nomad_tpu.parallel.coalesce import default_cluster_cache
+
+            cluster = default_cluster_cache.get(self.state)
         stack = XLAGenericStack(False, self.ctx, cluster)
         stack.set_job(self.job)
         now = _time.time()

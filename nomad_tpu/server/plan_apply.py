@@ -29,6 +29,93 @@ from nomad_tpu.structs.resources import allocs_fit
 from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
 
 
+class _PlanOverlay:
+    """Results of plans whose raft apply is still in flight.
+
+    The reference pipelines: while plan N's raft apply runs, plan N+1
+    is evaluated against an *optimistic* snapshot that already contains
+    N's results (plan_apply.go:159-184). This overlay is that optimism:
+    entries are added when an apply launches and removed once the store
+    commit is visible, and the evaluation view merges them by alloc id
+    (so the commit-then-remove window cannot double count).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._entries: Dict[int, "PlanResult"] = {}
+
+    def add(self, result: "PlanResult") -> int:
+        with self._lock:
+            self._seq += 1
+            self._entries[self._seq] = result
+            return self._seq
+
+    def remove(self, token: int) -> None:
+        with self._lock:
+            self._entries.pop(token, None)
+
+    def node_adjustment(self, node_id: str):
+        """(placements_by_id, removed_ids) for one node across entries."""
+        with self._lock:
+            entries = list(self._entries.values())
+        placed: Dict[str, Allocation] = {}
+        removed = set()
+        for r in entries:
+            for a in r.node_update.get(node_id, ()):
+                removed.add(a.id)
+            for a in r.node_preemptions.get(node_id, ()):
+                removed.add(a.id)
+            for a in r.node_allocation.get(node_id, ()):
+                placed[a.id] = a
+        return placed, removed
+
+
+class _LiveView:
+    """Store-lock read proxy for plan evaluation.
+
+    The reference evaluates plans against a go-memdb snapshot that is
+    free to take (immutable radix); this store's ``snapshot()`` copies
+    whole tables, O(cluster) per plan. The applier only reads the few
+    nodes a plan touches, so a locked live view keeps plan apply
+    O(plan). The read-then-apply window this opens is the same
+    optimistic window the reference already has between its snapshot
+    and the raft commit (plan_apply.go:209): client-side alloc updates
+    landing inside it never add resource usage, so a fit that passed
+    cannot become an over-commit.
+
+    ``overlay`` adds the in-flight plans' results on top (the
+    pipelining optimism, plan_apply.go:159).
+    """
+
+    def __init__(self, store, overlay: Optional[_PlanOverlay] = None) -> None:
+        self._store = store
+        self._overlay = overlay
+
+    def latest_index(self) -> int:
+        return self._store.latest_index()
+
+    def node_by_id(self, node_id: str):
+        with self._store._lock:
+            return self._store._nodes.get(node_id)
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        # overlay BEFORE store: an in-flight plan is either still in
+        # the overlay (merged in) or already committed (in the later
+        # store read); reading the store first would open a window
+        # where a commit-then-overlay-remove hides the plan entirely
+        if self._overlay is not None:
+            placed, removed = self._overlay.node_adjustment(node_id)
+        else:
+            placed, removed = {}, set()
+        with self._store._lock:
+            ids = self._store._allocs_by_node.get(node_id, ())
+            rows = [self._store._allocs[i] for i in ids]
+        by_id = {a.id: a for a in rows if a.id not in removed}
+        by_id.update(placed)
+        return list(by_id.values())
+
+
 class Planner:
     """The plan-apply loop (plan_apply.go:71 planApply)."""
 
@@ -82,23 +169,68 @@ class Planner:
             self._pool = None
 
     def _run(self) -> None:
+        """The pipelined applier loop (plan_apply.go:71,159-184).
+
+        Plan N+1's per-node re-validation runs while plan N's raft
+        apply is still in flight; N+1 evaluates against the live state
+        PLUS the overlay of N's yet-uncommitted results, and its own
+        apply starts only after N's completes (commit order is
+        preserved). Responses go to workers only after the apply
+        (asyncPlanWait, plan_apply.go:370).
+        """
+        overlay = _PlanOverlay()
+        in_flight: Optional[threading.Thread] = None
         while not self._stop.is_set():
             pending = self.queue.dequeue(timeout=0.2)
             if pending is None:
                 continue
             try:
-                result = self.apply_one(pending.plan)
-                pending.respond(result, None)
+                snapshot = _LiveView(self.state, overlay)
+                result = self.evaluate_plan(snapshot, pending.plan)
             except Exception as e:            # noqa: BLE001 - worker nacks
                 pending.respond(None, e)
+                continue
+            # serialize commits: wait for the previous apply before
+            # launching this one (evaluation above already overlapped)
+            if in_flight is not None:
+                in_flight.join()
+            token = overlay.add(result)
+            in_flight = threading.Thread(
+                target=self._apply_async,
+                args=(pending, result, overlay, token),
+                daemon=True, name="plan-commit",
+            )
+            in_flight.start()
+        if in_flight is not None:
+            in_flight.join()
+
+    def _apply_async(self, pending: PendingPlan, result: PlanResult,
+                     overlay: _PlanOverlay, token: int) -> None:
+        try:
+            index = self._commit(pending.plan, result)
+            result.alloc_index = index
+            if result.refresh_index > 0:
+                # the conflict the scheduler must refresh past may have
+                # been an overlaid (just-committed) plan; point the
+                # retry at the post-commit state
+                result.refresh_index = max(result.refresh_index, index)
+            overlay.remove(token)
+            pending.respond(result, None)
+        except Exception as e:                # noqa: BLE001
+            overlay.remove(token)
+            pending.respond(None, e)
 
     # --- single plan (dequeue -> evaluate -> commit) --------------------
 
     def apply_one(self, plan: Plan) -> PlanResult:
-        snapshot = self.state.snapshot()
+        snapshot = _LiveView(self.state)
         result = self.evaluate_plan(snapshot, plan)
+        result.alloc_index = self._commit(plan, result)
+        return result
+
+    def _commit(self, plan: Plan, result: PlanResult) -> int:
         req = {
-            "alloc_index": snapshot.latest_index(),
+            "alloc_index": self.state.latest_index(),
             "plan": plan,
             "node_allocation": result.node_allocation,
             "node_update": result.node_update,
@@ -109,16 +241,13 @@ class Planner:
         if self._raft_apply is not None:
             # fsm.go applyPlanResults: Raft commit + blocked-eval unblock
             from nomad_tpu.server.fsm import APPLY_PLAN_RESULTS
-            index = self._raft_apply(APPLY_PLAN_RESULTS, req)
-        else:
-            index = self.state.upsert_plan_results(
-                req["alloc_index"], plan,
-                result.node_allocation, result.node_update,
-                result.node_preemptions, result.deployment,
-                result.deployment_updates,
-            )
-        result.alloc_index = index
-        return result
+            return self._raft_apply(APPLY_PLAN_RESULTS, req)
+        return self.state.upsert_plan_results(
+            req["alloc_index"], plan,
+            result.node_allocation, result.node_update,
+            result.node_preemptions, result.deployment,
+            result.deployment_updates,
+        )
 
     # --- evaluation (plan_apply.go:403 evaluatePlan) --------------------
 

@@ -94,6 +94,10 @@ class Server:
             event_broker=self.event_broker,
         )
         self.plan_queue = PlanQueue()
+        from collections import deque
+
+        # rolling plan-latency observations (submit -> applied result)
+        self.plan_latencies = deque(maxlen=100_000)
         self.planner = Planner(
             self.state, self.plan_queue, self.config.plan_pool_workers,
             raft_apply=self.raft_apply,
@@ -606,11 +610,18 @@ class Server:
     # --- Plan endpoint (nomad/plan_endpoint.go) -------------------------
 
     def submit_plan(self, plan: Plan) -> PlanResult:
+        import time as _time
+
+        t0 = _time.perf_counter()
         if self.planner.running():
             pending = self.plan_queue.enqueue(plan)
-            return pending.wait(timeout=30.0)
-        # synchronous mode (tests without the applier thread)
-        return self.planner.apply_one(plan)
+            result = pending.wait(timeout=30.0)
+        else:
+            # synchronous mode (tests without the applier thread)
+            result = self.planner.apply_one(plan)
+        # plan latency observability (BASELINE.md p50/p99 plan latency)
+        self.plan_latencies.append(_time.perf_counter() - t0)
+        return result
 
     # --- federation (serf WAN + rpc.go:537 region forwarding) -----------
 

@@ -106,21 +106,37 @@ class Worker:
         self.batch_launches = 0
         self.batch_requests = 0
         self.max_wave = 0
+        # evals currently being scheduled, kept alive against the
+        # broker's nack timeout by one long-lived heartbeat thread
+        self._live: dict = {}
+        self._live_lock = threading.Lock()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
 
     # --- lifecycle (worker.go run/pause) --------------------------------
 
     def start(self) -> None:
         self._stop.clear()
+        self._hb_stop.clear()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"worker-{self.id}"
         )
         self._thread.start()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_outstanding, daemon=True,
+            name=f"worker-{self.id}-hb",
+        )
+        self._hb_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        self._hb_stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
 
     def set_pause(self, paused: bool) -> None:
         """Leadership-change pause (leader.go:496 handlePausableWorkers)."""
@@ -152,8 +168,25 @@ class Worker:
             self._process_batch(batch)
         return True
 
+    def _heartbeat_outstanding(self) -> None:
+        """OutstandingReset for every in-flight eval while scheduling
+        runs long (worker.go keeps dequeued evals alive past the nack
+        timeout; cold XLA compiles can take tens of seconds). One
+        long-lived thread per worker; evals register in _live."""
+        interval = max(self.server.eval_broker.nack_timeout / 3.0, 1.0)
+        while not self._hb_stop.wait(interval):
+            with self._live_lock:
+                items = list(self._live.items())
+            for eid, token in items:
+                try:
+                    self.server.eval_broker.outstanding_reset(eid, token)
+                except Exception:                   # noqa: BLE001
+                    pass
+
     def _process(self, ev: Evaluation, token: str,
                  snapshot=None, launcher=None, cluster_provider=None) -> None:
+        with self._live_lock:
+            self._live[ev.id] = token
         try:
             if snapshot is None:
                 # SnapshotMinIndex: local raft must catch up to the eval
@@ -187,6 +220,9 @@ class Worker:
                 self.server.eval_broker.nack(ev.id, token)
             except Exception:                       # noqa: BLE001
                 pass
+        finally:
+            with self._live_lock:
+                self._live.pop(ev.id, None)
 
     def _process_batch(self, batch: List[Tuple[Evaluation, str]]) -> None:
         """Schedule a batch of evals concurrently with coalesced launches.

@@ -70,6 +70,9 @@ class StateSnapshot:
             self._allocs_by_eval = {k: set(v) for k, v in store._allocs_by_eval.items()}
             self._csi_volumes = dict(store._csi_volumes)
             self.scheduler_config = store.scheduler_config
+            # live utilization planes for the scheduler fast path
+            # (state/usage.py); far cheaper than the dict copies above
+            self.usage = store.usage.planes_copy()
 
     # --- State interface (scheduler.go:67-141) ---
 
@@ -158,8 +161,13 @@ class StateStore:
     """The writable store. One per server; FSM applies Raft entries here."""
 
     def __init__(self) -> None:
+        from nomad_tpu.state.usage import UsageIndex
+
         self._lock = threading.RLock()
         self._index = 0
+        # incrementally-scattered per-node utilization planes; every
+        # alloc/node mutation below routes its transition through it
+        self.usage = UsageIndex()
         self._nodes: Dict[str, object] = {}
         self._jobs: Dict[Tuple[str, str], object] = {}
         self._job_versions: Dict[Tuple[str, str, int], object] = {}
@@ -645,6 +653,7 @@ class StateStore:
                 "autopilot_config", self.autopilot_config
             )
             self._regions = payload.get("regions", {})
+            self.usage.rebuild(self._nodes.values(), self._allocs.values())
         self._notify(
             ["nodes", "jobs", "evals", "allocs", "deployment",
              "scheduler_config", "csi_volumes", "services"],
@@ -662,6 +671,8 @@ class StateStore:
             if node.create_index == 0:
                 node.create_index = idx
             self._nodes[node.id] = node
+            self.usage.node_row(node.id)
+            self.usage.note_node_change()
         self._notify(["nodes"], idx)
         return idx
 
@@ -669,6 +680,7 @@ class StateStore:
         with self._lock:
             idx = self._next_index()
             self._nodes.pop(node_id, None)
+            self.usage.drop_node(node_id)
         self._notify(["nodes"], idx)
         return idx
 
@@ -681,6 +693,7 @@ class StateStore:
                 node.status = status
                 node.modify_index = idx
                 self._nodes[node_id] = node
+                self.usage.note_node_change()
         self._notify(["nodes"], idx)
         return idx
 
@@ -693,6 +706,7 @@ class StateStore:
                 node.scheduling_eligibility = eligibility
                 node.modify_index = idx
                 self._nodes[node_id] = node
+                self.usage.note_node_change()
         self._notify(["nodes"], idx)
         return idx
 
@@ -713,6 +727,7 @@ class StateStore:
                     node.scheduling_eligibility = consts.NODE_SCHEDULING_ELIGIBLE
                 node.modify_index = idx
                 self._nodes[node_id] = node
+                self.usage.note_node_change()
         self._notify(["nodes"], idx)
         return idx
 
@@ -792,6 +807,7 @@ class StateStore:
             a.create_index = idx
         a.modify_index = idx
         self._allocs[a.id] = a
+        self.usage.alloc_changed(existing, a)
         self._update_deployment_with_alloc_locked(existing, a, idx)
         self._allocs_by_job.setdefault((a.namespace, a.job_id), set()).add(a.id)
         self._allocs_by_node.setdefault(a.node_id, set()).add(a.id)
@@ -816,6 +832,7 @@ class StateStore:
                 new.modify_index = idx
                 new.modify_time_ns = update.modify_time_ns
                 self._allocs[new.id] = new
+                self.usage.alloc_changed(existing, new)
                 # health transitions roll up into the deployment
                 # (state_store.go updateDeploymentWithAlloc)
                 self._update_deployment_with_alloc_locked(existing, new, idx)
@@ -865,6 +882,7 @@ class StateStore:
                 new.desired_transition = transition
                 new.modify_index = idx
                 self._allocs[alloc_id] = new
+                self.usage.alloc_changed(existing, new)
             for e in evals:
                 e.modify_index = idx
                 if e.create_index == 0:
@@ -884,6 +902,7 @@ class StateStore:
                 new.desired_status = consts.ALLOC_DESIRED_STOP
                 new.modify_index = idx
                 self._allocs[alloc_id] = new
+                self.usage.alloc_changed(existing, new)
             for e in evals:
                 e.modify_index = idx
                 if e.create_index == 0:
@@ -925,6 +944,7 @@ class StateStore:
                 a = self._allocs.pop(aid, None)
                 if a is None:
                     continue
+                self.usage.alloc_changed(a, None)
                 self._allocs_by_job.get((a.namespace, a.job_id), set()).discard(aid)
                 self._allocs_by_node.get(a.node_id, set()).discard(aid)
                 self._allocs_by_eval.get(a.eval_id, set()).discard(aid)
@@ -975,6 +995,7 @@ class StateStore:
                     new.deployment_status = status
                     new.modify_index = idx
                     self._allocs[aid] = new
+                    self.usage.alloc_changed(a, new)
                     state = d.task_groups.get(new.task_group)
                     if state is not None and was != healthy:
                         if healthy:

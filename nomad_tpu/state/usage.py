@@ -1,0 +1,192 @@
+"""Incrementally-maintained cluster utilization planes.
+
+The scheduler's eval tensors need per-node proposed utilization
+(context.go:173 ProposedAllocs). Recomputing that by scanning every
+live allocation per evaluation is O(allocs) Python work — at C2M scale
+(100K allocs) that alone caps the whole system at a few evals/sec.
+
+This module keeps the planes *live* instead: the state store scatters
+±delta into fixed node rows on every allocation transition (the same
+scatter the fused device step applies on commit —
+parallel/batching.commit_placements), so a scheduling snapshot gets its
+utilization planes as one small memcpy. This is the host half of the
+"device-resident cluster state" design (SURVEY.md section 7 step 4-5);
+the reference's equivalent cost is hidden inside go-memdb's indexed
+reads, which Python dicts cannot match per-eval.
+
+Row discipline: rows are stable for a node's lifetime and recycled
+after removal; every plane (and ClusterTensors built against the same
+index) shares the axis. ``structure_version`` changes when the node
+set/rows change (add/remove/update), ``version`` on every mutation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from nomad_tpu.tensors.schema import pad_bucket
+
+
+@dataclass
+class UsagePlanes:
+    """An immutable point-in-time copy of the utilization planes."""
+
+    n: int                                   # row axis length (padded)
+    rows: Dict[str, int]                     # node id -> row (shared ref)
+    used_cpu: np.ndarray                     # f32[n]
+    used_mem: np.ndarray
+    used_disk: np.ndarray
+    used_cores: np.ndarray                   # i32[n]
+    used_mbits: np.ndarray                   # i32[n]
+    version: int = 0
+    structure_version: int = 0
+    uid: str = ""                            # owning store's identity
+
+
+class UsageIndex:
+    """Live planes owned by the state store; mutate under its lock."""
+
+    def __init__(self) -> None:
+        import uuid
+
+        self.uid = uuid.uuid4().hex
+        self.rows: Dict[str, int] = {}
+        self.ids: List[Optional[str]] = []
+        self._free: List[int] = []
+        self.cap = 0
+        self.used_cpu = np.zeros(0, np.float32)
+        self.used_mem = np.zeros(0, np.float32)
+        self.used_disk = np.zeros(0, np.float32)
+        self.used_cores = np.zeros(0, np.int32)
+        self.used_mbits = np.zeros(0, np.int32)
+        self.version = 0
+        self.structure_version = 0
+        # planes_copy cache: reused until the next mutation; guarded by
+        # the owning store's lock (all callers hold it)
+        self._copy: Optional[UsagePlanes] = None
+
+    # -- structure -------------------------------------------------------
+
+    def _grow(self, need: int) -> None:
+        new_cap = pad_bucket(max(need, 1))
+        if new_cap <= self.cap:
+            return
+        for name in ("used_cpu", "used_mem", "used_disk",
+                     "used_cores", "used_mbits"):
+            old = getattr(self, name)
+            grown = np.zeros(new_cap, old.dtype)
+            grown[: old.shape[0]] = old
+            setattr(self, name, grown)
+        self.cap = new_cap
+
+    def node_row(self, node_id: str) -> int:
+        row = self.rows.get(node_id)
+        if row is not None:
+            return row
+        if self._free:
+            row = self._free.pop()
+        else:
+            row = len(self.ids)
+            self.ids.append(None)
+            self._grow(len(self.ids))
+        self.ids[row] = node_id
+        self.rows[node_id] = row
+        self._touch(structural=True)
+        return row
+
+    def note_node_change(self) -> None:
+        """A node row was replaced in the store (status/resources may
+        differ): invalidate structure-keyed caches (ClusterTensors)."""
+        self._touch(structural=True)
+
+    def drop_node(self, node_id: str) -> None:
+        row = self.rows.pop(node_id, None)
+        if row is None:
+            return
+        self.ids[row] = None
+        self._free.append(row)
+        for name in ("used_cpu", "used_mem", "used_disk",
+                     "used_cores", "used_mbits"):
+            getattr(self, name)[row] = 0
+        self._touch(structural=True)
+
+    # -- alloc transitions ----------------------------------------------
+
+    def _alloc_delta(self, a, sign: int) -> None:
+        row = self.rows.get(a.node_id)
+        if row is None:
+            if sign < 0:
+                # the node's row was dropped (node deleted while its
+                # allocs lived); creating a row just to go negative
+                # would poison a future node with the same id
+                return
+            # allocs can land before their node registers in restore
+            # order; give the node a row so the usage is not lost
+            row = self.node_row(a.node_id)
+        cr = a.comparable_resources()
+        self.used_cpu[row] += sign * cr.cpu_shares
+        self.used_mem[row] += sign * cr.memory_mb
+        self.used_disk[row] += sign * cr.disk_mb
+        self.used_cores[row] += sign * len(cr.reserved_cores)
+        mbits = sum(net.mbits for net in cr.networks)
+        self.used_mbits[row] += sign * mbits
+
+    def alloc_changed(self, old, new) -> None:
+        """Apply one allocation transition (upsert/update/delete)."""
+        old_live = old is not None and not old.terminal_status()
+        new_live = new is not None and not new.terminal_status()
+        if old_live:
+            self._alloc_delta(old, -1)
+        if new_live:
+            self._alloc_delta(new, +1)
+        if old_live or new_live:
+            self._touch()
+
+    def rebuild(self, nodes, allocs) -> None:
+        """Full rebuild (snapshot restore / FSM restore)."""
+        self.rows.clear()
+        self.ids.clear()
+        self._free.clear()
+        self.cap = 0
+        for name in ("used_cpu", "used_mem", "used_disk",
+                     "used_cores", "used_mbits"):
+            setattr(self, name, np.zeros(0, getattr(self, name).dtype))
+        for node in nodes:
+            self.node_row(node.id)
+        for a in allocs:
+            if not a.terminal_status():
+                self._alloc_delta(a, +1)
+        self._touch(structural=True)
+
+    # -- reads -----------------------------------------------------------
+
+    def _touch(self, structural: bool = False) -> None:
+        self.version += 1
+        if structural:
+            self.structure_version += 1
+        self._copy = None
+
+    def planes_copy(self) -> UsagePlanes:
+        """Point-in-time copy; cached until the next mutation (bursts of
+        snapshots between writes share one copy). Call under the store
+        lock."""
+        if self._copy is not None:
+            return self._copy
+        n = pad_bucket(max(len(self.ids), 1))
+        self._grow(n)
+        self._copy = UsagePlanes(
+            n=n,
+            rows=dict(self.rows),
+            used_cpu=self.used_cpu[:n].copy(),
+            used_mem=self.used_mem[:n].copy(),
+            used_disk=self.used_disk[:n].copy(),
+            used_cores=self.used_cores[:n].copy(),
+            used_mbits=self.used_mbits[:n].copy(),
+            version=self.version,
+            structure_version=self.structure_version,
+            uid=self.uid,
+        )
+        return self._copy

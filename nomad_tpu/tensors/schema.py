@@ -16,7 +16,7 @@ bucket, not once per cluster size.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -74,6 +74,44 @@ class ClusterTensors:
     node_classes: List[str] = field(default_factory=list)
     computed_classes: List[str] = field(default_factory=list)
     node_pools: List[str] = field(default_factory=list)
+    # node-static planes + caches added for the per-eval fast path
+    avail_mbits: Optional[np.ndarray] = None      # i32[n_pad] total net mbits
+    nodes_by_id: Dict[str, object] = field(default_factory=dict)
+    _dc_arr: Optional[np.ndarray] = None          # U-dtype datacenter per row
+    _pool_arr: Optional[np.ndarray] = None
+    _usage_perm: Optional[Tuple[int, np.ndarray, np.ndarray]] = None
+
+    def usage_perm(self, usage) -> Tuple[np.ndarray, np.ndarray]:
+        """Map cluster rows -> usage-plane rows (gather index + validity).
+
+        Cached per usage ``structure_version``; the node set cannot
+        change within one version, so the mapping is stable.
+        """
+        cached = self._usage_perm
+        if cached is not None and cached[0] == usage.structure_version:
+            return cached[1], cached[2]
+        perm = np.zeros(self.n_pad, np.int32)
+        valid = np.zeros(self.n_pad, bool)
+        for i in range(self.n_real):
+            row = usage.rows.get(self.node_ids[i], -1)
+            if 0 <= row < usage.n:
+                perm[i] = row
+                valid[i] = True
+        object.__setattr__(
+            self, "_usage_perm", (usage.structure_version, perm, valid)
+        )
+        return perm, valid
+
+    def dc_pool_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized datacenter/pool companions (readyNodesInDCs mask)."""
+        if self._dc_arr is None:
+            dc = np.array(
+                self.datacenters + [""] * (self.n_pad - self.n_real))
+            pool = np.array(
+                list(self.node_pools) + [""] * (self.n_pad - self.n_real))
+            object.__setattr__(self, "_dc_arr", dc)
+            object.__setattr__(self, "_pool_arr", pool)
+        return self._dc_arr, self._pool_arr
 
     @classmethod
     def build(cls, nodes: Sequence) -> "ClusterTensors":
@@ -92,6 +130,7 @@ class ClusterTensors:
         free_dyn = np.zeros(npad, np.int32)
         free_cores = np.zeros(npad, np.int32)
         spc = np.zeros(npad, np.float32)
+        avail_mbits = np.zeros(npad, np.int32)
         ids, dcs, classes, cclasses, pools = [], [], [], [], []
 
         for i, node in enumerate(nodes):
@@ -110,6 +149,7 @@ class ClusterTensors:
                 set(res.cpu.reservable_cpu_cores) - set(rsv.reserved_cpu_cores)
             )
             spc[i] = res.cpu.shares_per_core()
+            avail_mbits[i] = sum(net.mbits for net in res.networks)
             ids.append(node.id)
             dcs.append(node.datacenter)
             classes.append(node.node_class)
@@ -124,6 +164,8 @@ class ClusterTensors:
             free_cores=free_cores, shares_per_core=spc,
             datacenters=dcs, node_classes=classes,
             computed_classes=cclasses, node_pools=pools,
+            avail_mbits=avail_mbits,
+            nodes_by_id={n.id: n for n in nodes},
         )
 
 

@@ -232,6 +232,62 @@ class TestPlanApply:
         assert not result.node_allocation
         assert result.refresh_index > 0
 
+    def test_pipelined_applier_overlaps_and_stays_correct(self):
+        """plan_apply.go:159-184: while plan N's raft apply is in
+        flight, plan N+1 is evaluated against an optimistic overlay of
+        N's results — so a conflicting N+1 is rejected even though N
+        hasn't committed yet, and wall-clock shows the overlap."""
+        import threading
+        import time as _time
+
+        server = Server(ServerConfig(num_workers=0))
+        node = mock.node()
+        server.state.upsert_node(node)
+        job = mock.job()
+
+        # slow down the commit path to force evaluation overlap
+        applied = []
+        orig = server.planner._commit
+        def slow_commit(plan, result):
+            _time.sleep(0.15)
+            applied.append(_time.perf_counter())
+            return orig(plan, result)
+        server.planner._commit = slow_commit
+        server.plan_queue.set_enabled(True)
+        server.planner.start()
+        try:
+            # plan A fills most of the node; conflicting plan B's ask
+            # only fits if A's placements are invisible
+            big = mock.alloc(node_id=node.id, job=job)
+            big.allocated_resources.tasks["web"].cpu.cpu_shares = 3000
+            conflict = mock.alloc(node_id=node.id, job=job)
+            conflict.allocated_resources.tasks["web"].cpu.cpu_shares = 3000
+            plan_a = Plan(priority=50, job=job,
+                          node_allocation={node.id: [big]})
+            plan_b = Plan(priority=50, job=job,
+                          node_allocation={node.id: [conflict]})
+
+            results = {}
+            def submit(name, plan):
+                results[name] = server.submit_plan(plan)
+            ta = threading.Thread(target=submit, args=("a", plan_a))
+            ta.start()
+            _time.sleep(0.03)  # let A start its slow commit
+            tb = threading.Thread(target=submit, args=("b", plan_b))
+            tb.start()
+            ta.join(5)
+            tb.join(5)
+            # A committed; B was rejected against the overlay (node
+            # can't fit both 3000 MHz asks)
+            assert node.id in results["a"].node_allocation
+            assert node.id not in results["b"].node_allocation
+            assert results["b"].refresh_index >= results["a"].alloc_index
+            snap = server.state.snapshot()
+            assert snap.alloc_by_id(big.id) is not None
+            assert snap.alloc_by_id(conflict.id) is None
+        finally:
+            server.planner.stop()
+
 
 class TestServerEndToEnd:
     def make_server(self, n_nodes=5, **cfg):

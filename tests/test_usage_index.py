@@ -1,0 +1,157 @@
+"""UsageIndex invariants + eval-tensor fast-path parity.
+
+The store's incrementally-scattered utilization planes (state/usage.py)
+must always equal a from-scratch scan of live allocations, and the
+scheduler's fast eval-tensor build (stack._accumulate_usage gather
+path) must produce byte-identical planes to the slow per-alloc scan.
+"""
+
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.stack import XLAGenericStack
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Plan
+from nomad_tpu.tensors.schema import ClusterTensors
+
+
+def _scan_usage(store):
+    """From-scratch expected planes keyed by node id."""
+    out = {}
+    for a in store.snapshot().allocs_iter():
+        if a.terminal_status():
+            continue
+        cr = a.comparable_resources()
+        cpu, mem = out.get(a.node_id, (0.0, 0.0))
+        out[a.node_id] = (cpu + cr.cpu_shares, mem + cr.memory_mb)
+    return out
+
+
+class TestUsageIndex:
+    def test_tracks_alloc_lifecycle(self):
+        store = StateStore()
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            store.upsert_node(n)
+        allocs = [mock.alloc(node_id=nodes[i % 3].id) for i in range(9)]
+        store.upsert_allocs(allocs)
+
+        # stop one (desired transition to stop makes it terminal)
+        store.stop_alloc(allocs[0].id, [])
+        # client completes another
+        done = allocs[1].copy_skip_job()
+        done.client_status = consts.ALLOC_CLIENT_COMPLETE
+        store.update_allocs_from_client([done])
+        # GC a third
+        store.delete_allocs([allocs[2].id])
+
+        expected = _scan_usage(store)
+        u = store.usage.planes_copy()
+        for nid, (cpu, mem) in expected.items():
+            row = u.rows[nid]
+            assert u.used_cpu[row] == np.float32(cpu), nid
+            assert u.used_mem[row] == np.float32(mem), nid
+        # rows of nodes with no live allocs are zero
+        for n in nodes:
+            if n.id not in expected:
+                row = u.rows[n.id]
+                assert u.used_cpu[row] == 0
+
+    def test_node_removal_zeroes_and_recycles_rows(self):
+        store = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        store.upsert_node(n1)
+        store.upsert_node(n2)
+        store.upsert_allocs([mock.alloc(node_id=n1.id)])
+        row1 = store.usage.rows[n1.id]
+        store.delete_node(n1.id)
+        assert store.usage.used_cpu[row1] == 0
+        n3 = mock.node()
+        store.upsert_node(n3)
+        assert store.usage.rows[n3.id] == row1  # recycled
+
+    def test_dropped_node_alloc_teardown_cannot_go_negative(self):
+        """A node deleted while its alloc lives must not get a
+        poisoned (negative) row when the alloc later terminates."""
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        a = mock.alloc(node_id=node.id)
+        store.upsert_allocs([a])
+        store.delete_node(node.id)
+        store.delete_allocs([a.id])         # -1 delta, row is gone
+        # re-register the same node id: fresh zeroed row
+        node2 = mock.node()
+        node2.id = node.id
+        store.upsert_node(node2)
+        u = store.usage.planes_copy()
+        assert u.used_cpu[u.rows[node.id]] == 0
+
+    def test_restore_rebuilds_planes(self):
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        store.upsert_allocs([mock.alloc(node_id=node.id) for _ in range(4)])
+        data = store.to_snapshot_bytes()
+        fresh = StateStore()
+        fresh.restore_from_bytes(data)
+        u0 = store.usage.planes_copy()
+        u1 = fresh.usage.planes_copy()
+        r0, r1 = u0.rows[node.id], u1.rows[node.id]
+        assert u0.used_cpu[r0] == u1.used_cpu[r1]
+        assert u0.used_mem[r0] == u1.used_mem[r1]
+
+
+class TestEvalTensorFastPathParity:
+    def test_fast_and_slow_paths_agree(self):
+        store = StateStore()
+        nodes = [mock.node() for _ in range(6)]
+        for n in nodes:
+            store.upsert_node(n)
+        job = mock.job()
+        store.upsert_job(job)
+        # background load from other jobs
+        other = mock.job()
+        store.upsert_job(other)
+        store.upsert_allocs(
+            [mock.alloc(node_id=nodes[i % 6].id, job_id=other.id,
+                        namespace=other.namespace, job=other)
+             for i in range(10)]
+        )
+        # live allocs of THIS job (feed job planes)
+        own = [
+            mock.alloc(node_id=nodes[i].id, job_id=job.id,
+                       namespace=job.namespace, job=job,
+                       task_group=job.task_groups[0].name)
+            for i in range(3)
+        ]
+        store.upsert_allocs(own)
+
+        snap = store.snapshot()
+        plan = Plan()
+        # stage one stop and one in-place update in the plan
+        plan.append_stopped_alloc(own[0], "test stop")
+        update = own[1].copy_skip_job()
+        plan.append_alloc(update, None)
+
+        tg = job.task_groups[0]
+
+        def build(with_usage: bool):
+            s = store.snapshot()
+            if not with_usage:
+                s.usage = None
+            cluster = ClusterTensors.build(s.nodes())
+            ctx = EvalContext(s, plan)
+            st = XLAGenericStack(False, ctx, cluster)
+            st.set_job(job)
+            return st._build_eval_tensors(tg, np.zeros(cluster.n_pad, bool))
+
+        fast = build(True)
+        slow = build(False)
+        for name in ("used_cpu", "used_mem", "used_disk", "used_cores",
+                     "used_mbits", "job_tg_count", "job_any_count",
+                     "base_mask", "avail_mbits", "free_dyn_delta"):
+            f, s = getattr(fast, name), getattr(slow, name)
+            assert np.array_equal(f, s), (name, f, s)

@@ -84,7 +84,10 @@ class TestLiveBatchedWorkers:
                 job.task_groups[0].count = 2
                 jobs.append(job)
                 server.job_register(job)
-            deadline = time.time() + 60
+            # generous: a cold CPU compile of the joint wave variant
+            # under full-suite load can take tens of seconds (warm runs
+            # finish in ~3s via the persistent compile cache)
+            deadline = time.time() + 150
             def placed():
                 snap = server.state.snapshot()
                 return all(
