@@ -530,6 +530,272 @@ def _bump_spread(kin: KernelIn, counts, one, spread_onehot,
 place_taskgroup_jit = jax.jit(place_taskgroup, static_argnums=(1, 2))
 
 
+def place_taskgroup_topk(
+    kin: KernelIn, k_steps: int, features: KernelFeatures = FULL_FEATURES,
+    n_candidates: int = 0,
+) -> tuple:
+    """Candidate-set placement: full-width scoring ONCE, sequential
+    deduction over a top-K candidate subset.
+
+    The full kernel recomputes feasibility + scores for every node at
+    every scan step — O(N * k). But with the binpack fit function
+    (funcs.go:259) a placement only changes the CHOSEN node's planes,
+    and every score-mutating plane (utilization, job anti-affinity
+    counts, penalties) moves non-chosen scores DOWN or not at all, so
+    the (K+1)-th initial score upper-bounds everything outside the
+    candidate set for the whole scan. One O(N log K) top_k then a
+    K-wide scan gives identical placements — the tensor formulation of
+    the reference's LimitIterator candidate bound (stack.go:84-91),
+    with exact top-K candidates instead of log2(n) random ones.
+
+    Validity: requires no spread stanzas (spread boosts can RAISE
+    non-candidate scores) — callers gate on features.n_spreads == 0.
+    The returned ``valid`` scalar is False when the bound was ever
+    breached mid-scan (candidate max fell below the rest bound, e.g.
+    under the cluster-wide spread fit function, or K exhausted); the
+    caller must re-run the full kernel then.
+
+    Returns (KernelOut, valid: bool scalar).
+    """
+    n = kin.cap_cpu.shape[0]
+    f = features
+    assert f.n_spreads == 0, "top-K path requires no spread stanzas"
+    k_cand = n_candidates or min(n, max(2 * k_steps, k_steps + 8, TOPK))
+
+    init = dict(
+        used_cpu=kin.used_cpu,
+        used_mem=kin.used_mem,
+        used_disk=kin.used_disk,
+        job_tg_count=kin.job_tg_count,
+    )
+    if f.with_cores:
+        init["used_cores"] = kin.used_cores
+    if f.with_network:
+        init["used_mbits"] = kin.used_mbits
+    if f.with_ports:
+        init["free_dyn"] = kin.free_dyn
+        init["port_conflict"] = kin.port_conflict
+    if f.with_devices:
+        init["dev_free"] = kin.dev_free
+    if f.with_distinct:
+        init["job_any_count"] = kin.job_any_count
+
+    # ---- one full-width pass: metrics + initial scores ----
+    feas0, ask_cpu_total0, dims0 = _feasible(kin, init, f)
+    final0 = _score(kin, init, ask_cpu_total0, kin.penalty, f, None)
+    masked0 = jnp.where(feas0, final0, NEG_INF)
+    base_i = kin.base_mask
+    exhausted = lambda fit: jnp.sum(base_i & ~fit).astype(jnp.int32)  # noqa: E731
+
+    # approx_max_k is the TPU-fast selection (lax.top_k is orders
+    # slower there); exactness is preserved by computing the rest
+    # bound EXACTLY below — a recall miss that would have mattered
+    # shows up as a bound breach and falls back to the full kernel
+    _, cand_idx = jax.lax.approx_max_k(
+        masked0, k_cand, recall_target=0.95)
+    rest_max = jnp.max(masked0.at[cand_idx].set(NEG_INF))
+
+    # preferred nodes must be selectable even when outside the top-K:
+    # union them into the candidate set (duplicates are harmless --
+    # duplicate rows share deductions via scatter-by-node below)
+    if f.with_preferred:
+        prefs = jnp.clip(kin.step_preferred[:k_steps], 0, n - 1)
+        pref_valid = kin.step_preferred[:k_steps] >= 0
+        cand_idx = jnp.concatenate([cand_idx, prefs])
+        k_all = k_cand + k_steps
+        cand_is_pref_pad = jnp.concatenate([
+            jnp.zeros(k_cand, bool), ~pref_valid])
+    else:
+        k_all = k_cand
+        cand_is_pref_pad = jnp.zeros(k_cand, bool)
+
+    # tie-break decorrelation within the candidate set: the eval's
+    # node permutation provides pseudo-random distinct keys per node,
+    # so argsort of the gathered keys is a per-eval random candidate
+    # order (shuffleNodes util.go:464, restricted to candidates)
+    if f.with_shuffle:
+        cand_perm = jnp.argsort(kin.node_perm[cand_idx]).astype(jnp.int32)
+    else:
+        cand_perm = jnp.arange(k_all, dtype=jnp.int32)
+
+    # ---- gather candidate-width planes ----
+    def g(x):
+        return x[cand_idx]
+
+    kin_c = KernelIn(
+        cap_cpu=g(kin.cap_cpu), cap_mem=g(kin.cap_mem),
+        cap_disk=g(kin.cap_disk), free_cores=g(kin.free_cores),
+        shares_per_core=g(kin.shares_per_core), free_dyn=g(kin.free_dyn),
+        base_mask=g(kin.base_mask) & ~cand_is_pref_pad,
+        used_cpu=g(kin.used_cpu), used_mem=g(kin.used_mem),
+        used_disk=g(kin.used_disk), used_cores=g(kin.used_cores),
+        used_mbits=g(kin.used_mbits), avail_mbits=g(kin.avail_mbits),
+        port_conflict=g(kin.port_conflict), dev_free=g(kin.dev_free),
+        dev_aff_score=g(kin.dev_aff_score),
+        has_dev_affinity=kin.has_dev_affinity,
+        job_tg_count=g(kin.job_tg_count), penalty=g(kin.penalty),
+        aff_score=g(kin.aff_score),
+        node_perm=cand_perm,
+        step_penalty=kin.step_penalty, step_preferred=kin.step_preferred,
+        job_any_count=g(kin.job_any_count),
+        distinct_hosts_job=kin.distinct_hosts_job,
+        distinct_hosts_tg=kin.distinct_hosts_tg,
+        spread_active=kin.spread_active, spread_even=kin.spread_even,
+        spread_weight=kin.spread_weight,
+        spread_bucket=kin.spread_bucket[:, :1],
+        spread_counts=kin.spread_counts,
+        spread_desired=kin.spread_desired,
+        ask_cpu=kin.ask_cpu, ask_mem=kin.ask_mem, ask_disk=kin.ask_disk,
+        ask_cores=kin.ask_cores, ask_dyn_ports=kin.ask_dyn_ports,
+        ask_has_reserved_ports=kin.ask_has_reserved_ports,
+        ask_dev=kin.ask_dev, ask_mbits=kin.ask_mbits,
+        desired_count=kin.desired_count,
+        algorithm_spread=kin.algorithm_spread,
+        n_steps=kin.n_steps,
+    )
+
+    # duplicate candidate rows (a preferred node also in the top-K)
+    # must share deductions: scatter per-step deltas by NODE id and
+    # re-gather. same_node[i, j] = cand i and cand j are one node.
+    same_node = cand_idx[:, None] == cand_idx[None, :]   # bool[K', K']
+    share = same_node.astype(jnp.float32)
+    sharei = same_node.astype(jnp.int32)
+
+    init_c = dict(
+        used_cpu=kin_c.used_cpu, used_mem=kin_c.used_mem,
+        used_disk=kin_c.used_disk, job_tg_count=kin_c.job_tg_count,
+    )
+    if f.with_cores:
+        init_c["used_cores"] = kin_c.used_cores
+    if f.with_network:
+        init_c["used_mbits"] = kin_c.used_mbits
+    if f.with_ports:
+        init_c["free_dyn"] = kin_c.free_dyn
+        init_c["port_conflict"] = kin_c.port_conflict
+    if f.with_devices:
+        init_c["dev_free"] = kin_c.dev_free
+    if f.with_distinct:
+        init_c["job_any_count"] = kin_c.job_any_count
+
+    iota_c = jnp.arange(k_all, dtype=jnp.int32)
+
+    def step(carry, i):
+        st, ok = carry
+        feasible, ask_cpu_total, _ = _feasible(kin_c, st, f)
+        penalty = kin_c.penalty
+        if f.with_step_penalties:
+            pen_ids = kin_c.step_penalty[i]
+            node_ids = cand_idx
+            step_pen = jnp.any(
+                node_ids[:, None] == pen_ids[None, :], axis=1)
+            penalty = penalty | step_pen
+        final = _score(kin_c, st, ask_cpu_total, penalty, f, None)
+        active = i < kin_c.n_steps
+        masked = jnp.where(feasible & active, final, NEG_INF)
+        if f.with_shuffle:
+            best = kin_c.node_perm[jnp.argmax(masked[kin_c.node_perm])]
+        else:
+            best = jnp.argmax(masked)
+        if f.with_preferred:
+            pref = kin_c.step_preferred[i]
+            # the preferred node's candidate row: k_cand + i by layout
+            pref_row = k_cand + i
+            pref_ok = (pref >= 0) & feasible[pref_row] & active
+            idx = jnp.where(pref_ok, pref_row, best)
+        else:
+            pref_ok = jnp.asarray(False)
+            idx = best
+        found = masked[idx] > NEG_INF / 2
+        # bound check: if the best candidate fell below what the rest
+        # of the cluster could offer, the candidate set is invalid.
+        # Preferred picks are exempt — they are taken regardless of
+        # score in the full kernel too, so the bound is irrelevant
+        ok = ok & (~active | ~found | pref_ok | (masked[idx] >= rest_max))
+
+        if f.with_topk:
+            topv, topi = jax.lax.top_k(masked, TOPK)
+            topi = cand_idx[topi]
+        else:
+            topv = jnp.full(TOPK, NEG_INF)
+            topi = jnp.zeros(TOPK, jnp.int32)
+
+        upd = (found & active).astype(jnp.float32)
+        updi = (found & active).astype(jnp.int32)
+        one = share[idx] * upd          # all rows of the chosen NODE
+        onei = sharei[idx] * updi
+        st2 = dict(
+            used_cpu=st["used_cpu"] + one * ask_cpu_total,
+            used_mem=st["used_mem"] + one * kin_c.ask_mem,
+            used_disk=st["used_disk"] + one * kin_c.ask_disk,
+            job_tg_count=st["job_tg_count"] + onei,
+        )
+        if f.with_cores:
+            st2["used_cores"] = st["used_cores"] + onei * kin_c.ask_cores
+        if f.with_network:
+            st2["used_mbits"] = st["used_mbits"] + onei * kin_c.ask_mbits
+        if f.with_ports:
+            st2["free_dyn"] = st["free_dyn"] - onei * kin_c.ask_dyn_ports
+            st2["port_conflict"] = st["port_conflict"] | (
+                (one > 0) & kin_c.ask_has_reserved_ports)
+        if f.with_devices:
+            st2["dev_free"] = st["dev_free"] - one[:, None] * kin_c.ask_dev[None, :]
+        if f.with_distinct:
+            st2["job_any_count"] = st["job_any_count"] + onei
+        out = (
+            jnp.where(found, cand_idx[idx], -1).astype(jnp.int32),
+            jnp.where(found, masked[idx], 0.0),
+            found & active,
+            topi.astype(jnp.int32),
+            topv,
+        )
+        return (st2, ok), out
+
+    # candidate-width steps are tiny; full unroll removes the scan's
+    # per-step sequencing overhead (the remaining cost driver)
+    (_, ok), (chosen, scores, found, topk_idx, topk_scores) = jax.lax.scan(
+        step, (init_c, jnp.asarray(True)), jnp.arange(k_steps),
+        unroll=True,
+    )
+
+    out = KernelOut(
+        chosen=chosen, scores=scores, found=found,
+        topk_idx=topk_idx, topk_scores=topk_scores,
+        nodes_evaluated=jnp.sum(base_i).astype(jnp.int32),
+        nodes_feasible=jnp.sum(feas0).astype(jnp.int32),
+        exhausted_cpu=exhausted(dims0["fit_cpu"]),
+        exhausted_mem=exhausted(dims0["fit_mem"]),
+        exhausted_disk=exhausted(dims0["fit_disk"]),
+        exhausted_ports=exhausted(dims0["fit_ports"]),
+        exhausted_devices=exhausted(dims0["fit_dev"]),
+        exhausted_cores=exhausted(dims0["fit_cores"]),
+    )
+    # a run that failed placements while rest_max was still beatable is
+    # also invalid (candidates exhausted but the wider cluster might
+    # fit); detect: any inactive-step-before-n_steps with rest feasible
+    missing = jnp.any(
+        (jnp.arange(k_steps) < kin.n_steps) & ~found)
+    ok = ok & (~missing | (rest_max <= NEG_INF / 2))
+    return out, ok
+
+
+place_taskgroup_topk_jit = jax.jit(
+    place_taskgroup_topk, static_argnums=(1, 2, 3)
+)
+
+
+
+def default_kernel_launch(kin: KernelIn, k_steps: int,
+                          features: KernelFeatures) -> KernelOut:
+    """The stack's direct (non-coalesced) dispatch: candidate-set fast
+    path when its preconditions hold, full-width kernel otherwise or on
+    a bound breach."""
+    if features.n_spreads == 0 and not bool(kin.algorithm_spread):
+        out, ok = place_taskgroup_topk_jit(kin, k_steps, features)
+        if bool(ok):
+            return out
+    return place_taskgroup_jit(kin, k_steps, features)
+
+
 class JointOut(NamedTuple):
     """Outputs of a joint wave: per-step placements + per-member metrics."""
 

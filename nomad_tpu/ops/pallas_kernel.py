@@ -6,12 +6,14 @@ placement loop (`fori_loop` over the K steps, masked global argmax and
 one-hot deduction as pure VPU work), so HBM sees each shared plane
 once per launch.
 
-**Measured status (10k nodes / 64-eval batches, single chip):** the
-default XLA formulation (ops/kernel.py under vmap) wins by a wide
-margin — XLA fuses the scan body and keeps the carry on-chip already,
-and it vectorizes the batch axis across the whole VPU, while this
-kernel's (B,)-grid serializes evals one program at a time. The kernel
-is kept as a correctness-proven seam for pallas-side evolution
+**Measured status (10k nodes, single chip, materialized timing):**
+this kernel's (B,)-grid serializes evals one program at a time, and
+the XLA candidate-set kernel (ops/kernel.place_taskgroup_topk: one
+full-width scoring pass + approx_max_k + K-wide deduction scan)
+measures ~3x faster at B=256 and ~10x at B=512. The kernel is kept as
+a correctness-proven seam for pallas-side evolution — the known next
+step is fusing the full-width pass and candidate scan into one VMEM-
+resident program with a 2D (batch-tile, node-tile) grid
 (tests/test_pallas_kernel.py pins exact parity); the scheduler and
 bench stay on the XLA path.
 

@@ -11,10 +11,18 @@ the reference's one-eval-at-a-time worker loop (nomad/worker.go:386).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
-from nomad_tpu.ops.kernel import FULL_FEATURES, KernelFeatures, KernelIn, place_taskgroup
+from nomad_tpu.ops.kernel import (
+    FULL_FEATURES,
+    KernelFeatures,
+    KernelIn,
+    place_taskgroup,
+    place_taskgroup_topk,
+)
 
 
 def device_put_shared(kin: KernelIn) -> KernelIn:
@@ -22,6 +30,7 @@ def device_put_shared(kin: KernelIn) -> KernelIn:
     return jax.tree_util.tree_map(jnp.asarray, kin)
 
 
+@functools.lru_cache(maxsize=32)
 def make_schedule_apply_step(k_steps: int, features: KernelFeatures = FULL_FEATURES):
     """Fused batch-schedule + plan-apply with device-resident state.
 
@@ -56,6 +65,60 @@ def make_schedule_apply_step(k_steps: int, features: KernelFeatures = FULL_FEATU
         return out, used_cpu2, used_mem2
 
     return jax.jit(step, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def make_schedule_apply_loop(k_steps: int,
+                             features: KernelFeatures = FULL_FEATURES,
+                             topk: bool = False):
+    """Multi-batch fused loop: T batches of B evals in ONE device call.
+
+    ``lax.scan`` over the batch axis keeps the utilization planes in
+    the carry, so a whole measurement burst (or a steady-state window
+    of the live system) is a single dispatch — on a remote-device
+    transport, per-dispatch round trips otherwise dominate and measure
+    the link instead of the scheduler (the round-1 grid pathology).
+
+    Returns fn(shared, used_cpu, used_mem, ask_cpu[T,B], ask_mem[T,B],
+    n_steps[B]) -> (score_sum, placed, invalid, used_cpu', used_mem').
+    ``invalid`` counts evals whose candidate-set bound broke (always 0
+    without ``topk``); the caller reschedules those via the full path.
+    """
+
+    def loop(shared: KernelIn, used_cpu, used_mem, ask_cpu, ask_mem, n_steps):
+        def one_batch(carry, asks):
+            uc, um = carry
+            a_cpu, a_mem = asks
+
+            def run_one(ac, am, ns):
+                kin = shared._replace(
+                    used_cpu=uc, used_mem=um,
+                    ask_cpu=ac, ask_mem=am, n_steps=ns,
+                )
+                if topk:
+                    out, ok = place_taskgroup_topk(kin, k_steps, features)
+                    return out, ok
+                return place_taskgroup(kin, k_steps, features), jnp.asarray(True)
+
+            out, ok = jax.vmap(run_one)(a_cpu, a_mem, n_steps)
+            # invalid evals (bound breach) are fully excluded: their
+            # placements neither commit nor count — the caller re-runs
+            # them via the full-width path
+            out = out._replace(found=out.found & ok[:, None])
+            uc2, um2 = commit_placements(uc, um, out, a_cpu, a_mem)
+            stats = (
+                jnp.sum(jnp.where(out.found, out.scores, 0.0)),
+                jnp.sum(out.found),
+                jnp.sum(~ok),
+            )
+            return (uc2, um2), stats
+
+        (uc, um), (scores, placed, invalid) = jax.lax.scan(
+            one_batch, (used_cpu, used_mem), (ask_cpu, ask_mem))
+        return (jnp.sum(scores), jnp.sum(placed), jnp.sum(invalid),
+                uc, um)
+
+    return jax.jit(loop, donate_argnums=(1, 2))
 
 
 def commit_placements(used_cpu, used_mem, out, ask_cpu, ask_mem):

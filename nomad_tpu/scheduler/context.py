@@ -112,13 +112,14 @@ class EvalContext:
         # per-eval decorrelation seed for stochastic dynamic-port
         # assignment (network.go:598); None = precise selection
         self.port_seed: Optional[int] = None
-        # the placement-kernel dispatch point: defaults to a direct
-        # device call; a batching worker injects a LaunchCoalescer so
-        # concurrent evals share one vmapped launch (parallel/coalesce.py)
+        # the placement-kernel dispatch point: defaults to the direct
+        # candidate-set/full dispatcher; a batching worker injects a
+        # LaunchCoalescer so concurrent evals share one joint launch
+        # (parallel/coalesce.py)
         if kernel_launch is None:
-            from nomad_tpu.ops.kernel import place_taskgroup_jit
+            from nomad_tpu.ops.kernel import default_kernel_launch
 
-            kernel_launch = place_taskgroup_jit
+            kernel_launch = default_kernel_launch
         self.kernel_launch = kernel_launch
 
     def metrics(self) -> AllocMetric:

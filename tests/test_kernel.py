@@ -508,3 +508,105 @@ class TestKernelFeatures:
         got = KernelOut(*[np.asarray(x) for x in place_taskgroup_jit(kin, 8, feats)])
         np.testing.assert_array_equal(got.chosen, full.chosen)
         np.testing.assert_allclose(got.scores, full.scores, rtol=1e-6)
+
+
+class TestCandidateKernel:
+    """place_taskgroup_topk: candidate-set placement must be exact.
+
+    The bound argument: every score-mutating plane moves non-chosen
+    nodes down or not at all (no spreads), so the max over
+    non-candidates is a standing upper bound; the kernel flags
+    ``valid=False`` whenever a step's choice falls below it.
+    """
+
+    def _kin(self, rng, n, with_extras=False):
+        import numpy as np
+
+        from nomad_tpu.ops.kernel import build_kernel_in
+        from nomad_tpu.parallel.synthetic import (
+            synthetic_cluster, synthetic_eval,
+        )
+
+        cluster = synthetic_cluster(
+            n, cpu=3900.0, mem=7936.0, disk=98304.0,
+            seed=int(rng.integers(0, 99)))
+        ev = synthetic_eval(cluster, desired_count=10)
+        kwargs = {}
+        if with_extras:
+            pen = np.full((16, 4), -1, np.int32)
+            pen[0, 0] = rng.integers(0, n)
+            pref = np.full(16, -1, np.int32)
+            pref[2] = rng.integers(0, n)
+            kwargs = dict(
+                step_penalty=pen, step_preferred=pref,
+                node_perm=rng.permutation(cluster.n_pad).astype(np.int32),
+            )
+        kin = build_kernel_in(cluster, ev, 10, **kwargs)
+        uc = (3900 * 0.7 * rng.random(cluster.n_pad)).astype(np.float32)
+        um = (7936 * 0.7 * rng.random(cluster.n_pad)).astype(np.float32)
+        return kin._replace(
+            used_cpu=uc, used_mem=um,
+            ask_cpu=np.float32(rng.choice([250, 500, 900])),
+            ask_mem=np.float32(rng.choice([128, 256, 700])),
+        )
+
+    def test_matches_full_kernel(self):
+        import numpy as np
+
+        from nomad_tpu.ops.kernel import (
+            LEAN_FEATURES, pad_steps, place_taskgroup_jit,
+            place_taskgroup_topk_jit,
+        )
+
+        rng = np.random.default_rng(17)
+        feats_variants = [
+            (LEAN_FEATURES, False),
+            (LEAN_FEATURES._replace(with_topk=True, with_distinct=True),
+             False),
+            (LEAN_FEATURES._replace(
+                with_step_penalties=True, with_preferred=True,
+                with_shuffle=True), True),
+        ]
+        k = pad_steps(10)
+        for trial in range(6):
+            feats, extras = feats_variants[trial % 3]
+            kin = self._kin(rng, int(rng.choice([60, 400])), extras)
+            full = place_taskgroup_jit(kin, k, feats)
+            topk, ok = place_taskgroup_topk_jit(kin, k, feats)
+            if not bool(ok):
+                continue  # bound breached: caller re-runs full kernel
+            assert np.array_equal(
+                np.asarray(full.chosen), np.asarray(topk.chosen)), trial
+            assert np.array_equal(
+                np.asarray(full.found), np.asarray(topk.found)), trial
+            assert np.allclose(
+                np.asarray(full.scores), np.asarray(topk.scores),
+                atol=1e-6), trial
+
+    def test_invalid_flag_on_tiny_feasible_set(self):
+        """When the cluster nearly saturates, candidates can exhaust;
+        the kernel must flag it rather than silently fail placements
+        the wider cluster could serve."""
+        import numpy as np
+
+        from nomad_tpu.ops.kernel import (
+            LEAN_FEATURES, pad_steps, place_taskgroup_jit,
+            place_taskgroup_topk_jit,
+        )
+
+        rng = np.random.default_rng(3)
+        kin = self._kin(rng, 400)
+        # leave only a sliver of cpu on every node: ask barely fits
+        kin = kin._replace(
+            used_cpu=np.full_like(kin.used_cpu, 3900.0 - 510.0),
+            ask_cpu=np.float32(500.0),
+        )
+        k = pad_steps(10)
+        full = place_taskgroup_jit(kin, k, LEAN_FEATURES)
+        topk, ok = place_taskgroup_topk_jit(kin, k, LEAN_FEATURES)
+        if bool(ok):
+            assert np.array_equal(
+                np.asarray(full.chosen), np.asarray(topk.chosen))
+        else:
+            # fallback path: full kernel remains the source of truth
+            assert np.asarray(full.found).sum() >= np.asarray(topk.found).sum()
