@@ -279,6 +279,71 @@ class Nodes(_Endpoint):
         return self.c.post(f"/v1/node/{_esc(node_id)}/purge", {}, q)
 
 
+class ExecSession:
+    """One interactive exec stream (the SDK half of alloc exec).
+
+    JSON-over-websocket frames mirror the reference's
+    drivers.ExecTaskStreaming messages: {"stdin": {"data": b64}},
+    {"stdin": {"close": true}}, {"tty_size": {...}} out;
+    {"stdout"/"stderr": {"data": b64}}, {"exited", "result"} in.
+    """
+
+    def __init__(self, conn) -> None:
+        self.conn = conn
+        self.exit_code: Optional[int] = None
+
+    def send_stdin(self, data: bytes) -> None:
+        import base64
+        import json as _json
+
+        self.conn.send(_json.dumps(
+            {"stdin": {"data": base64.b64encode(data).decode()}}).encode())
+
+    def close_stdin(self) -> None:
+        import json as _json
+
+        self.conn.send(_json.dumps({"stdin": {"close": True}}).encode())
+
+    def resize(self, height: int, width: int) -> None:
+        import json as _json
+
+        self.conn.send(_json.dumps(
+            {"tty_size": {"height": height, "width": width}}).encode())
+
+    def events(self) -> Iterator[Dict]:
+        """Yield decoded frames until the process exits or the peer
+        closes; sets ``exit_code`` when the exited frame arrives."""
+        import base64
+        import json as _json
+
+        from nomad_tpu.utils import ws as wslib
+
+        while True:
+            try:
+                op, payload = self.conn.recv()
+            except (ConnectionError, OSError):
+                return
+            if op == wslib.OP_CLOSE:
+                return
+            if op not in (wslib.OP_TEXT, wslib.OP_BINARY):
+                continue
+            try:
+                frame = _json.loads(payload)
+            except _json.JSONDecodeError:
+                continue
+            for name in ("stdout", "stderr"):
+                blob = frame.get(name) or {}
+                if blob.get("data"):
+                    frame[name]["bytes"] = base64.b64decode(blob["data"])
+            yield frame
+            if frame.get("exited"):
+                self.exit_code = (frame.get("result") or {}).get("exit_code")
+                return
+
+    def close(self) -> None:
+        self.conn.close()
+
+
 class Allocations(_Endpoint):
     def list(self, q: Optional[QueryOptions] = None) -> List[Dict]:
         return self.c.get("/v1/allocations", q)
@@ -303,6 +368,28 @@ class Allocations(_Endpoint):
              q: Optional[QueryOptions] = None) -> Dict:
         return self.c.post(f"/v1/client/allocation/{_esc(alloc_id)}/exec",
                            {"Task": task, "Cmd": cmd}, q)
+
+    def exec_stream(self, alloc_id: str, task: str, cmd: List[str],
+                    tty: bool = False,
+                    q: Optional[QueryOptions] = None) -> "ExecSession":
+        """Interactive exec over a websocket (api/allocations_exec.go).
+
+        Returns an ExecSession: write stdin bytes, iterate output
+        chunks, read the exit code."""
+        import json as _json
+
+        q = q or QueryOptions()
+        q.params.update({
+            "task": task,
+            "command": _json.dumps(cmd),
+            "tty": "true" if tty else "false",
+        })
+        url = self.c._url(f"/v1/client/allocation/{_esc(alloc_id)}/exec", q)
+        from nomad_tpu.utils import ws as wslib
+
+        conn = wslib.connect(url, token=self.c.token,
+                             tls_context=self.c._ssl_context)
+        return ExecSession(conn)
 
     def logs(self, alloc_id: str, task: str, logtype: str = "stdout",
              offset: int = 0, limit: int = 0,

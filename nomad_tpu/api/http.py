@@ -256,6 +256,9 @@ class HTTPAgent:
         url = addr + parsed.path
         if pairs:
             url += "?" + urllib.parse.urlencode(pairs)
+        if handler.headers.get("Upgrade", "").lower() == "websocket":
+            self._tunnel_websocket(handler, url, token)
+            return
         # outlive the remote's blocking-query hold (default 300s,
         # capped at 600s server-side) plus slack
         wait = dict(pairs).get("wait", "")
@@ -356,6 +359,11 @@ class HTTPAgent:
         url = node.http_addr + parsed.path
         if parsed.query:
             url += "?" + parsed.query
+        if handler.headers.get("Upgrade", "").lower() == "websocket":
+            # interactive exec: opaque byte tunnel to the node's agent
+            # (rpc.go:708 NodeStreamingRpc analog)
+            self._tunnel_websocket(handler, url, token)
+            return
         if self._wants_stream(parsed):
             req = urllib.request.Request(url, method=method)
             if token:
@@ -370,6 +378,87 @@ class HTTPAgent:
             return
         self._proxy(handler, method, url, token, raw_body,
                     unreachable="node")
+
+    def _tunnel_websocket(self, handler, url: str, token: str) -> None:
+        """Relay a websocket upgrade + both byte directions verbatim.
+
+        The tunnel re-issues the upgrade toward the node with the
+        caller's Sec-WebSocket-Key, writes the node's 101 response back,
+        then pumps raw bytes both ways — no frame parsing needed."""
+        import socket
+        import ssl as _ssl
+
+        parsed = urllib.parse.urlparse(url)
+        host = parsed.hostname
+        port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        try:
+            upstream = socket.create_connection((host, port), timeout=30)
+            if parsed.scheme == "https":
+                ctx = self._fwd_context or _ssl.create_default_context()
+                upstream = ctx.wrap_socket(upstream, server_hostname=host)
+            # connect timeout only; a quiet session must stay open
+            upstream.settimeout(None)
+        except OSError as e:
+            self._send(handler, 502, {"error": f"node unreachable: {e}"})
+            return
+        path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+        lines = [f"GET {path} HTTP/1.1", f"Host: {host}:{port}"]
+        for h in ("Upgrade", "Connection", "Sec-WebSocket-Key",
+                  "Sec-WebSocket-Version"):
+            v = handler.headers.get(h)
+            if v:
+                lines.append(f"{h}: {v}")
+        if token:
+            lines.append(f"X-Nomad-Token: {token}")
+        try:
+            upstream.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+        except OSError as e:
+            self._send(handler, 502, {"error": f"node unreachable: {e}"})
+            upstream.close()
+            return
+
+        handler.close_connection = True
+        down = handler.connection
+
+        def shut(*socks) -> None:
+            for s in socks:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+        def pump_up() -> None:
+            # downstream reads go through rfile: it may hold frames the
+            # header parser read ahead of
+            try:
+                while True:
+                    data = handler.rfile.read1(65536)
+                    if not data:
+                        break
+                    upstream.sendall(data)
+            except (OSError, ValueError):
+                pass
+            finally:
+                shut(down, upstream)
+
+        t = threading.Thread(target=pump_up, daemon=True,
+                             name="ws-tunnel-up")
+        t.start()
+        try:
+            while True:
+                data = upstream.recv(65536)
+                if not data:
+                    break
+                down.sendall(data)
+        except OSError:
+            pass
+        finally:
+            shut(down, upstream)
+        t.join(timeout=5)
+        try:
+            upstream.close()
+        except OSError:
+            pass
 
     @staticmethod
     def _wants_stream(parsed) -> bool:
@@ -638,6 +727,8 @@ class HTTPAgent:
         add("PUT", r"/v1/client/allocation/(?P<id>[^/]+)/signal", self.client_alloc_signal)
         add("POST", r"/v1/client/allocation/(?P<id>[^/]+)/exec", self.client_alloc_exec)
         add("PUT", r"/v1/client/allocation/(?P<id>[^/]+)/exec", self.client_alloc_exec)
+        # websocket upgrade (interactive exec, api/allocations_exec.go)
+        add("GET", r"/v1/client/allocation/(?P<id>[^/]+)/exec", self.client_alloc_exec)
         add("GET", r"/v1/client/fs/logs/(?P<id>[^/]+)", self.client_fs_logs)
         add("GET", r"/v1/client/fs/ls/(?P<id>[^/]+)", self.client_fs_ls)
         add("GET", r"/v1/client/fs/stat/(?P<id>[^/]+)", self.client_fs_stat)
@@ -1828,8 +1919,22 @@ class HTTPAgent:
         return {}
 
     def client_alloc_exec(self, req: Request):
-        """One-shot exec (the reference is an interactive websocket;
-        this returns captured output)."""
+        """Exec in a task. Two modes (reference api/allocations_exec.go):
+
+        - websocket upgrade: interactive bidirectional stream; JSON
+          frames {"stdin": {"data": b64}} / {"stdin": {"close": true}}
+          / {"tty_size": {"height", "width"}} inbound, {"stdout"/
+          "stderr": {"data": b64}} / {"exited", "result"} outbound.
+        - plain POST: one-shot captured output (kept for simple
+          clients; the reference CLI always streams).
+        """
+        handler = req.handler
+        if handler is not None and \
+                handler.headers.get("Upgrade", "").lower() == "websocket":
+            return self._exec_websocket(req)
+        if req.method == "GET":
+            raise HTTPError(400, "interactive exec requires a websocket "
+                                 "upgrade; use POST for one-shot exec")
         body = req.body or {}
         task = body.get("Task", "")
         cmd = body.get("Cmd") or []
@@ -1845,6 +1950,107 @@ class HTTPAgent:
             if isinstance(out.get(k), bytes):
                 out[k] = out[k].decode(errors="replace")
         return out
+
+    def _exec_websocket(self, req: Request):
+        """The interactive leg: ws frames <-> driver ExecStream."""
+        import base64
+
+        from nomad_tpu.utils import ws as wslib
+
+        handler = req.handler
+        task = req.q("task", "")
+        tty = req.q("tty", "") in ("true", "1")
+        try:
+            cmd = json.loads(req.q("command", "[]"))
+        except json.JSONDecodeError:
+            cmd = []
+        if not task or not cmd:
+            raise HTTPError(400, "task and command are required")
+        runner = self._runner(req, "alloc-exec")
+        try:
+            stream = runner.exec_stream_in_task(task, cmd, tty=tty)
+        except KeyError as e:
+            raise HTTPError(404, str(e))
+        except NotImplementedError as e:
+            raise HTTPError(400, str(e))
+
+        if not wslib.server_handshake(handler):
+            stream.terminate()
+            return StreamedResponse
+        handler.close_connection = True
+
+        stop = threading.Event()
+
+        def pump_in() -> None:
+            """ws -> process stdin / resize."""
+            try:
+                while not stop.is_set():
+                    op, payload = wslib.read_frame(handler.rfile)
+                    if op == wslib.OP_CLOSE:
+                        break
+                    if op == wslib.OP_PING:
+                        wslib.write_frame(handler.wfile, wslib.OP_PONG,
+                                          payload)
+                        continue
+                    if op not in (wslib.OP_TEXT, wslib.OP_BINARY):
+                        continue
+                    try:
+                        frame = json.loads(payload)
+                    except json.JSONDecodeError:
+                        continue
+                    stdin = frame.get("stdin") or {}
+                    if stdin.get("data"):
+                        stream.write_stdin(base64.b64decode(stdin["data"]))
+                    if stdin.get("close"):
+                        stream.close_stdin()
+                    size = frame.get("tty_size") or {}
+                    if size:
+                        stream.resize(int(size.get("height", 24)),
+                                      int(size.get("width", 80)))
+            except (ConnectionError, OSError, ValueError):
+                pass
+            finally:
+                stream.terminate()
+
+        t = threading.Thread(target=pump_in, daemon=True, name="exec-ws-in")
+        t.start()
+        try:
+            exit_code = None
+            while True:
+                # after the process exits, keep draining briefly: the
+                # output pumps race the waiter, and trailing pty bytes
+                # must not be lost behind the exited frame
+                item = stream.read_output(
+                    timeout=0.5 if exit_code is None else 0.2)
+                if item is None:
+                    if exit_code is not None:
+                        break
+                    continue
+                name, data = item
+                if name == "exited":
+                    exit_code = data
+                    continue
+                if data:
+                    wslib.write_frame(handler.wfile, wslib.OP_TEXT,
+                                      json.dumps({
+                                          name: {"data": base64.b64encode(
+                                              data).decode()},
+                                      }).encode())
+            wslib.write_frame(handler.wfile, wslib.OP_TEXT,
+                              json.dumps({
+                                  "exited": True,
+                                  "result": {"exit_code": exit_code},
+                              }).encode())
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            stop.set()
+            stream.terminate()
+            try:
+                wslib.write_frame(handler.wfile, wslib.OP_CLOSE, b"")
+            except OSError:
+                pass
+        return StreamedResponse
 
     def client_fs_stat(self, req: Request):
         try:

@@ -472,13 +472,81 @@ def cmd_alloc_signal(args) -> int:
 
 def cmd_alloc_exec(args) -> int:
     api = make_client(args)
-    out = api.allocations.exec(args.alloc_id, args.task, args.cmd)
-    if out.get("stdout"):
-        print(out["stdout"], end="")
-    if out.get("stderr"):
-        import sys as _sys
-        print(out["stderr"], end="", file=_sys.stderr)
-    return int(out.get("exit_code", 0) or 0)
+    if not (args.interactive or args.tty):
+        out = api.allocations.exec(args.alloc_id, args.task, args.cmd)
+        if out.get("stdout"):
+            print(out["stdout"], end="")
+        if out.get("stderr"):
+            import sys as _sys
+            print(out["stderr"], end="", file=_sys.stderr)
+        return int(out.get("exit_code", 0) or 0)
+    return _alloc_exec_interactive(api, args)
+
+
+def _alloc_exec_interactive(api, args) -> int:
+    """Streaming exec (`alloc exec -i [-t]`): websocket pty session
+    (reference api/allocations_exec.go + command/alloc_exec.go)."""
+    import sys as _sys
+    import threading as _threading
+
+    session = api.allocations.exec_stream(
+        args.alloc_id, args.task, args.cmd, tty=args.tty)
+
+    stdin_fd = _sys.stdin.fileno() if _sys.stdin.isatty() else None
+    restore = None
+    if args.tty and stdin_fd is not None:
+        import termios
+        import tty as _ttymod
+
+        restore = termios.tcgetattr(stdin_fd)
+        _ttymod.setraw(stdin_fd)
+        try:
+            import fcntl
+            import struct as _struct
+
+            import termios as _t
+
+            packed = fcntl.ioctl(1, _t.TIOCGWINSZ,
+                                 _struct.pack("HHHH", 0, 0, 0, 0))
+            rows, cols, _, _ = _struct.unpack("HHHH", packed)
+            session.resize(rows, cols)
+        except OSError:
+            pass
+
+    stop = _threading.Event()
+
+    def pump_stdin() -> None:
+        try:
+            while not stop.is_set():
+                data = _sys.stdin.buffer.read1(4096) \
+                    if hasattr(_sys.stdin.buffer, "read1") \
+                    else _sys.stdin.buffer.read(4096)
+                if not data:
+                    session.close_stdin()
+                    break
+                session.send_stdin(data)
+        except (OSError, ValueError, ConnectionError):
+            pass
+
+    t = _threading.Thread(target=pump_stdin, daemon=True)
+    t.start()
+    code = 1
+    try:
+        for frame in session.events():
+            for name, out in (("stdout", _sys.stdout), ("stderr", _sys.stderr)):
+                blob = frame.get(name) or {}
+                if blob.get("bytes"):
+                    out.buffer.write(blob["bytes"])
+                    out.flush()
+        code = session.exit_code if session.exit_code is not None else 1
+    finally:
+        stop.set()
+        session.close()
+        if restore is not None:
+            import termios
+
+            termios.tcsetattr(stdin_fd, termios.TCSADRAIN, restore)
+    return int(code)
 
 
 def cmd_alloc_fs(args) -> int:
@@ -1274,6 +1342,10 @@ def build_parser() -> argparse.ArgumentParser:
     asig.set_defaults(fn=cmd_alloc_signal)
     aex = alloc.add_parser("exec")
     aex.add_argument("-task", required=True)
+    aex.add_argument("-i", dest="interactive", action="store_true",
+                     help="stream stdin (websocket exec)")
+    aex.add_argument("-t", dest="tty", action="store_true",
+                     help="allocate a pty")
     aex.add_argument("alloc_id")
     aex.add_argument("cmd", nargs="+")
     aex.set_defaults(fn=cmd_alloc_exec)

@@ -531,6 +531,20 @@ class AllocRunner:
             raise KeyError(f"unknown task {task_name}")
         return tr.driver.exec_task(tr.task_id, cmd, timeout=timeout)
 
+    def exec_stream_in_task(self, task_name: str, cmd: List[str],
+                            tty: bool = False):
+        """Interactive exec (alloc exec; driver.proto:79
+        ExecTaskStreaming). Returns the driver's ExecStream."""
+        tr = self.task_runners.get(task_name)
+        if tr is None:
+            raise KeyError(f"unknown task {task_name}")
+        fn = getattr(tr.driver, "exec_task_streaming", None)
+        if fn is None:
+            raise NotImplementedError(
+                f"driver {tr.task.driver} does not support interactive exec"
+            )
+        return fn(tr.task_id, cmd, tty=tty)
+
     # --- updates / teardown ---------------------------------------------
 
     def update(self, alloc: Allocation) -> None:

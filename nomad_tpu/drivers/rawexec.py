@@ -298,6 +298,14 @@ class RawExecDriver(DriverPlugin):
             "exit_code": proc.returncode,
         }
 
+    def exec_task_streaming(self, task_id: str, cmd: List[str],
+                            tty: bool = False) -> "ExecStream":
+        """Interactive exec in the task's context (driver.proto:79
+        ExecTaskStreaming): a live process with bidirectional stdio,
+        optionally under a pty."""
+        task = self._get(task_id)
+        return ExecStream(cmd, cwd=task.config.alloc_dir or "/tmp", tty=tty)
+
     def task_stats(self, task_id: str) -> Dict:
         task = self._get(task_id)
         stats = {"cpu": {}, "memory": {}}
@@ -316,6 +324,144 @@ class RawExecDriver(DriverPlugin):
         if task is None:
             raise KeyError(f"unknown task {task_id}")
         return task
+
+
+class ExecStream:
+    """One interactive exec session (the driver half of
+    ExecTaskStreaming, driver.proto:79).
+
+    Output is pumped by a reader thread into a queue the transport
+    drains with ``read_output``; stdin writes go straight to the
+    process (pty master when ``tty``)."""
+
+    def __init__(self, cmd: List[str], cwd: str, tty: bool = False,
+                 env: Optional[Dict[str, str]] = None) -> None:
+        import queue as _queue
+
+        self.tty = tty
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._master: Optional[int] = None
+        if tty:
+            import pty
+
+            master, slave = pty.openpty()
+            self.proc = subprocess.Popen(
+                cmd, cwd=cwd, env=env,
+                stdin=slave, stdout=slave, stderr=slave,
+                start_new_session=True, close_fds=True,
+            )
+            os.close(slave)
+            self._master = master
+            threading.Thread(
+                target=self._pump_fd, args=(master, "stdout"),
+                daemon=True, name="exec-pty-pump",
+            ).start()
+        else:
+            self.proc = subprocess.Popen(
+                cmd, cwd=cwd, env=env,
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, start_new_session=True,
+            )
+            threading.Thread(
+                target=self._pump, args=(self.proc.stdout, "stdout"),
+                daemon=True, name="exec-stdout-pump",
+            ).start()
+            threading.Thread(
+                target=self._pump, args=(self.proc.stderr, "stderr"),
+                daemon=True, name="exec-stderr-pump",
+            ).start()
+        threading.Thread(
+            target=self._wait, daemon=True, name="exec-wait",
+        ).start()
+
+    def _pump(self, f, name: str) -> None:
+        try:
+            while True:
+                data = f.read1(65536) if hasattr(f, "read1") else f.read(65536)
+                if not data:
+                    break
+                self._q.put((name, data))
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._q.put((name, b""))            # stream EOF marker
+
+    def _pump_fd(self, fd: int, name: str) -> None:
+        try:
+            while True:
+                data = os.read(fd, 65536)
+                if not data:
+                    break
+                self._q.put((name, data))
+        except OSError:
+            pass
+        finally:
+            self._q.put((name, b""))
+
+    def _wait(self) -> None:
+        code = self.proc.wait()
+        self._q.put(("exited", code))
+
+    # -- transport-facing API -------------------------------------------
+
+    def write_stdin(self, data: bytes) -> None:
+        try:
+            if self._master is not None:
+                os.write(self._master, data)
+            elif self.proc.stdin is not None:
+                self.proc.stdin.write(data)
+                self.proc.stdin.flush()
+        except (OSError, ValueError, BrokenPipeError):
+            pass
+
+    def close_stdin(self) -> None:
+        try:
+            if self._master is not None:
+                # pty has no half-close; EOT tells line-disciplined
+                # programs to stop reading
+                os.write(self._master, b"\x04")
+            elif self.proc.stdin is not None:
+                self.proc.stdin.close()
+        except (OSError, ValueError):
+            pass
+
+    def resize(self, height: int, width: int) -> None:
+        if self._master is None:
+            return
+        try:
+            import fcntl
+            import struct as _struct
+            import termios
+
+            fcntl.ioctl(
+                self._master, termios.TIOCSWINSZ,
+                _struct.pack("HHHH", height, width, 0, 0),
+            )
+        except OSError:
+            pass
+
+    def read_output(self, timeout: float = 0.5):
+        """Next ('stdout'|'stderr', bytes) chunk, ('exited', code), or
+        None on timeout. A b'' chunk marks that stream's EOF."""
+        import queue as _queue
+
+        try:
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def terminate(self) -> None:
+        try:
+            if self.proc.poll() is None:
+                self.proc.kill()
+        except OSError:
+            pass
+        if self._master is not None:
+            try:
+                os.close(self._master)
+            except OSError:
+                pass
+            self._master = None
 
 
 def _pid_alive(pid: int) -> bool:

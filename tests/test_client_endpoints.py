@@ -159,3 +159,101 @@ class TestPassThrough:
         finally:
             dev.shutdown()
             srv.shutdown()
+
+
+class TestInteractiveExec:
+    """Streaming exec over the websocket (api/allocations_exec.go,
+    driver.proto:79 ExecTaskStreaming): stdin and stdout both ways."""
+
+    def setup_method(self):
+        self.agent = Agent(AgentConfig.dev())
+        self.agent.start()
+        self.api = APIClient(self.agent.http_addr)
+
+    def teardown_method(self):
+        self.agent.shutdown()
+
+    def _running_alloc(self):
+        job, alloc = run_job(
+            self.agent, self.api, driver="raw_exec",
+            config={"command": "/bin/sh", "args": ["-c", "sleep 30"]},
+        )
+        return alloc["ID"]
+
+    def test_bidirectional_stream(self):
+        aid = self._running_alloc()
+        session = self.api.allocations.exec_stream(aid, "web", ["cat"])
+        session.send_stdin(b"ping-1\n")
+        session.send_stdin(b"ping-2\n")
+        session.close_stdin()
+        got = b""
+        for frame in session.events():
+            blob = frame.get("stdout") or {}
+            if blob.get("bytes"):
+                got += blob["bytes"]
+        assert b"ping-1" in got and b"ping-2" in got
+        assert session.exit_code == 0
+
+    def test_exit_code_propagates(self):
+        aid = self._running_alloc()
+        session = self.api.allocations.exec_stream(
+            aid, "web", ["/bin/sh", "-c", "echo out; echo err >&2; exit 7"])
+        out, err = b"", b""
+        for frame in session.events():
+            if (frame.get("stdout") or {}).get("bytes"):
+                out += frame["stdout"]["bytes"]
+            if (frame.get("stderr") or {}).get("bytes"):
+                err += frame["stderr"]["bytes"]
+        assert b"out" in out
+        assert b"err" in err
+        assert session.exit_code == 7
+
+    def test_tty_session(self):
+        aid = self._running_alloc()
+        session = self.api.allocations.exec_stream(
+            aid, "web", ["/bin/sh"], tty=True)
+        session.resize(24, 80)
+        session.send_stdin(b"echo tty-$((40+2))\n")
+        session.send_stdin(b"exit\n")
+        got = b""
+        for frame in session.events():
+            blob = frame.get("stdout") or {}
+            if blob.get("bytes"):
+                got += blob["bytes"]
+        assert b"tty-42" in got
+        assert session.exit_code == 0
+
+    def test_server_forwards_exec_to_node(self):
+        """A server-only agent tunnels the exec websocket to the node
+        running the alloc (rpc.go:708 NodeStreamingRpc analog)."""
+        dev = Agent(AgentConfig.dev())
+        dev.start()
+        srv = Agent(AgentConfig(name="hub", num_schedulers=0))
+        srv.start()
+        try:
+            api_dev = APIClient(dev.http_addr)
+            job, alloc = run_job(
+                dev, api_dev, driver="raw_exec",
+                config={"command": "/bin/sh", "args": ["-c", "sleep 30"]},
+            )
+            # teach the hub about the node + alloc (multi-host
+            # registration would do this in a real deployment)
+            srv.server.state.upsert_node(dev.client.node.copy())
+            full = dev.server.state.snapshot().alloc_by_id(alloc["ID"])
+            srv.server.state.upsert_allocs([full.copy_skip_job()])
+
+            hub_api = APIClient(srv.http_addr)
+            session = hub_api.allocations.exec_stream(
+                alloc["ID"], "web", ["cat"])
+            session.send_stdin(b"through-the-tunnel\n")
+            session.close_stdin()
+            got = b""
+            for frame in session.events():
+                blob = frame.get("stdout") or {}
+                if blob.get("bytes"):
+                    got += blob["bytes"]
+            assert b"through-the-tunnel" in got
+            assert session.exit_code == 0
+        finally:
+            srv.shutdown()
+            dev.shutdown()
