@@ -1,16 +1,28 @@
 // Task executor: out-of-process supervisor for exec-family drivers.
 //
-// Reference behavior: drivers/shared/executor/executor.go:54 -- the
-// driver spawns a separate `nomad executor` process which launches and
-// supervises the workload, so the workload survives agent restarts and
-// the agent can reattach (RecoverTask) by talking to this supervisor's
-// on-disk state instead of holding the child directly.
+// Reference behavior: drivers/shared/executor/executor.go:54 and
+// executor_linux.go -- the driver spawns a separate `nomad executor`
+// process which launches and supervises the workload, so the workload
+// survives agent restarts and the agent can reattach (RecoverTask) by
+// reading this supervisor's on-disk state. The linux reference runs
+// the workload inside libcontainer namespaces + cgroups; this
+// implements the same isolation primitives directly:
+//
+//   -isolate        unshare PID + mount + IPC namespaces; the child is
+//                   pid 1 of its own pid namespace and /proc inside is
+//                   remounted so host processes are invisible
+//                   (executor_linux.go namespace configuration)
+//   -mem_mb N       cgroup memory limit (memory.max / .limit_in_bytes)
+//   -cpu_shares N   cgroup cpu weight (cpu.weight / cpu.shares)
+//   -cgroup NAME    cgroup leaf name (default nomad-exec-<pid>)
+//   -chroot DIR     chroot into DIR before exec (taskDir chroot)
 //
 // Protocol (file-based, the pipe/gRPC analog):
-//   argv: executor <status_path> <stdout_path> <stderr_path> <cwd> -- cmd [args...]
+//   argv: executor <status> <stdout> <stderr> <cwd> [opts] -- cmd [args...]
 //   status file lines, appended atomically:
 //     pid <child_pid> <child_pgid>
 //     exit <code> <signal>
+//     error <what>
 // The agent reads `pid` to learn the supervised process group, sends
 // signals to -pgid to stop, and polls for `exit`.
 
@@ -20,7 +32,9 @@
 #include <cstdlib>
 #include <cstring>
 #include <fcntl.h>
+#include <sched.h>
 #include <string>
+#include <sys/mount.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
@@ -37,21 +51,143 @@ static void append_status(const std::string &path, const std::string &line) {
   close(fd);
 }
 
+static bool write_file(const std::string &path, const std::string &val) {
+  int fd = open(path.c_str(), O_WRONLY);
+  if (fd < 0) return false;
+  ssize_t n = write(fd, val.c_str(), val.size());
+  close(fd);
+  return n == (ssize_t)val.size();
+}
+
+static bool file_exists(const char *path) {
+  struct stat st;
+  return stat(path, &st) == 0;
+}
+
+struct CgroupPaths {
+  std::vector<std::string> dirs;  // for pid placement + teardown
+};
+
+// Create cgroups and apply limits; returns the dirs whose tasks/
+// cgroup.procs file should receive the child pid. cgroup v2 (unified)
+// when /sys/fs/cgroup/cgroup.controllers exists, else v1 hierarchies.
+static CgroupPaths setup_cgroups(const std::string &name, long mem_mb,
+                                 long cpu_shares, std::string &err) {
+  CgroupPaths out;
+  if (file_exists("/sys/fs/cgroup/cgroup.controllers")) {
+    std::string dir = "/sys/fs/cgroup/" + name;
+    if (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) {
+      err = "mkdir " + dir;
+      return out;
+    }
+    if (mem_mb > 0 &&
+        !write_file(dir + "/memory.max",
+                    std::to_string(mem_mb * 1024 * 1024))) {
+      // an unenforced limit must be fatal, not silent: the scheduler
+      // placed this task assuming the limit holds
+      err = "write memory.max";
+      rmdir(dir.c_str());
+      return out;
+    }
+    if (cpu_shares > 0) {
+      // shares (2..262144) -> weight (1..10000), the systemd mapping
+      long weight = 1 + ((cpu_shares - 2) * 9999) / 262142;
+      if (weight < 1) weight = 1;
+      if (weight > 10000) weight = 10000;
+      if (!write_file(dir + "/cpu.weight", std::to_string(weight))) {
+        err = "write cpu.weight";
+        rmdir(dir.c_str());
+        return out;
+      }
+    }
+    out.dirs.push_back(dir);
+    return out;
+  }
+  if (mem_mb > 0) {
+    std::string dir = "/sys/fs/cgroup/memory/" + name;
+    if (!file_exists("/sys/fs/cgroup/memory") ||
+        (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) ||
+        !write_file(dir + "/memory.limit_in_bytes",
+                    std::to_string(mem_mb * 1024 * 1024))) {
+      err = "memory cgroup setup";
+      rmdir(dir.c_str());
+      return out;
+    }
+    out.dirs.push_back(dir);
+  }
+  if (cpu_shares > 0) {
+    std::string dir = "/sys/fs/cgroup/cpu/" + name;
+    if (!file_exists("/sys/fs/cgroup/cpu") ||
+        (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) ||
+        !write_file(dir + "/cpu.shares", std::to_string(cpu_shares))) {
+      err = "cpu cgroup setup";
+      rmdir(dir.c_str());
+      return out;
+    }
+    out.dirs.push_back(dir);
+  }
+  return out;
+}
+
+static void place_in_cgroups(const CgroupPaths &cg, pid_t pid) {
+  for (const auto &dir : cg.dirs) {
+    std::string procs = dir + "/cgroup.procs";
+    if (!file_exists(procs.c_str())) procs = dir + "/tasks";
+    write_file(procs, std::to_string(pid));
+  }
+}
+
+static void teardown_cgroups(const CgroupPaths &cg) {
+  // descendants of the direct child may still be alive (daemonized
+  // grandchildren): kill whatever remains in the cgroup, then retry
+  // the rmdir so directories don't leak one per task run
+  for (const auto &dir : cg.dirs) {
+    for (int attempt = 0; attempt < 20; attempt++) {
+      if (rmdir(dir.c_str()) == 0 || errno == ENOENT) break;
+      std::string procs = dir + "/cgroup.procs";
+      FILE *f = fopen(procs.c_str(), "r");
+      if (!f) f = fopen((dir + "/tasks").c_str(), "r");
+      if (f) {
+        long pid;
+        while (fscanf(f, "%ld", &pid) == 1)
+          kill((pid_t)pid, SIGKILL);
+        fclose(f);
+      }
+      usleep(50 * 1000);
+    }
+  }
+}
+
 int main(int argc, char **argv) {
   if (argc < 7) {
     fprintf(stderr,
-            "usage: executor <status> <stdout> <stderr> <cwd> -- cmd [args]\n");
+            "usage: executor <status> <stdout> <stderr> <cwd> "
+            "[-isolate] [-mem_mb N] [-cpu_shares N] [-cgroup NAME] "
+            "[-chroot DIR] -- cmd [args]\n");
     return 2;
   }
   std::string status_path = argv[1];
   std::string stdout_path = argv[2];
   std::string stderr_path = argv[3];
   std::string cwd = argv[4];
+  bool isolate = false;
+  long mem_mb = 0, cpu_shares = 0;
+  std::string cgroup_name, chroot_dir;
   int cmd_start = 0;
   for (int i = 5; i < argc; i++) {
     if (strcmp(argv[i], "--") == 0) {
       cmd_start = i + 1;
       break;
+    } else if (strcmp(argv[i], "-isolate") == 0) {
+      isolate = true;
+    } else if (strcmp(argv[i], "-mem_mb") == 0 && i + 1 < argc) {
+      mem_mb = atol(argv[++i]);
+    } else if (strcmp(argv[i], "-cpu_shares") == 0 && i + 1 < argc) {
+      cpu_shares = atol(argv[++i]);
+    } else if (strcmp(argv[i], "-cgroup") == 0 && i + 1 < argc) {
+      cgroup_name = argv[++i];
+    } else if (strcmp(argv[i], "-chroot") == 0 && i + 1 < argc) {
+      chroot_dir = argv[++i];
     }
   }
   if (cmd_start == 0 || cmd_start >= argc) {
@@ -66,6 +202,37 @@ int main(int argc, char **argv) {
   }
   signal(SIGHUP, SIG_IGN);
 
+  CgroupPaths cg;
+  if (mem_mb > 0 || cpu_shares > 0) {
+    if (cgroup_name.empty())
+      cgroup_name = "nomad-exec-" + std::to_string((long)getpid());
+    std::string cgerr;
+    cg = setup_cgroups(cgroup_name, mem_mb, cpu_shares, cgerr);
+    if (!cgerr.empty()) {
+      // requested limits that cannot be enforced fail the launch
+      append_status(status_path, "error cgroup " + cgerr);
+      append_status(status_path, "exit 125 0");
+      return 1;
+    }
+  }
+
+  if (isolate) {
+    // new pid+mount+ipc namespaces: the forked child becomes pid 1 of
+    // the pid namespace; mounts stay private to this subtree
+    if (unshare(CLONE_NEWPID | CLONE_NEWNS | CLONE_NEWIPC) != 0) {
+      append_status(status_path, std::string("error unshare ") +
+                                     strerror(errno));
+      append_status(status_path, "exit 125 0");
+      return 1;
+    }
+    mount(nullptr, "/", nullptr, MS_REC | MS_PRIVATE, nullptr);
+  }
+
+  // sync pipe: the child execs only after cgroup placement, so limits
+  // apply from the first instruction
+  int sync_fd[2] = {-1, -1};
+  if (pipe(sync_fd) != 0) sync_fd[0] = sync_fd[1] = -1;
+
   pid_t child = fork();
   if (child < 0) {
     append_status(status_path, "exit 127 0");
@@ -74,7 +241,29 @@ int main(int argc, char **argv) {
   if (child == 0) {
     // workload child: own process group so the whole tree is signalable
     setpgid(0, 0);
-    if (!cwd.empty()) {
+    if (sync_fd[1] >= 0) close(sync_fd[1]);
+    if (sync_fd[0] >= 0) {
+      char b;
+      ssize_t ignored = read(sync_fd[0], &b, 1);
+      (void)ignored;
+      close(sync_fd[0]);
+    }
+    if (isolate) {
+      // pid namespace view: /proc shows only this namespace. A fresh
+      // proc mount requires the child (pid-ns member) to do it.
+      if (!chroot_dir.empty()) {
+        std::string proc_dir = chroot_dir + "/proc";
+        mkdir(proc_dir.c_str(), 0555);
+        mount("proc", proc_dir.c_str(), "proc", 0, nullptr);
+      } else {
+        mount("proc", "/proc", "proc", 0, nullptr);
+      }
+    }
+    if (!chroot_dir.empty()) {
+      if (chroot(chroot_dir.c_str()) != 0) _exit(125);
+      if (chdir("/") != 0) _exit(125);
+    }
+    if (!cwd.empty() && chroot_dir.empty()) {
       if (chdir(cwd.c_str()) != 0) _exit(126);
     }
     int out = open(stdout_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
@@ -89,6 +278,13 @@ int main(int argc, char **argv) {
   }
 
   setpgid(child, child);
+  place_in_cgroups(cg, child);
+  if (sync_fd[0] >= 0) close(sync_fd[0]);
+  if (sync_fd[1] >= 0) {
+    ssize_t ignored = write(sync_fd[1], "x", 1);
+    (void)ignored;
+    close(sync_fd[1]);
+  }
   char buf[128];
   snprintf(buf, sizeof(buf), "pid %d %d", (int)child, (int)child);
   append_status(status_path, buf);
@@ -103,6 +299,7 @@ int main(int argc, char **argv) {
   if (WIFEXITED(wstatus)) code = WEXITSTATUS(wstatus);
   if (WIFSIGNALED(wstatus)) sig = WTERMSIG(wstatus);
   snprintf(buf, sizeof(buf), "exit %d %d", code, sig);
+  teardown_cgroups(cg);
   append_status(status_path, buf);
   return 0;
 }

@@ -1980,6 +1980,13 @@ class HTTPAgent:
         handler.close_connection = True
 
         stop = threading.Event()
+        # both threads write frames on the same buffered wfile; a lock
+        # keeps a PONG from landing inside a half-flushed TEXT frame
+        wlock = threading.Lock()
+
+        def send_frame(op, payload: bytes) -> None:
+            with wlock:
+                wslib.write_frame(handler.wfile, op, payload)
 
         def pump_in() -> None:
             """ws -> process stdin / resize."""
@@ -1989,8 +1996,7 @@ class HTTPAgent:
                     if op == wslib.OP_CLOSE:
                         break
                     if op == wslib.OP_PING:
-                        wslib.write_frame(handler.wfile, wslib.OP_PONG,
-                                          payload)
+                        send_frame(wslib.OP_PONG, payload)
                         continue
                     if op not in (wslib.OP_TEXT, wslib.OP_BINARY):
                         continue
@@ -2031,23 +2037,20 @@ class HTTPAgent:
                     exit_code = data
                     continue
                 if data:
-                    wslib.write_frame(handler.wfile, wslib.OP_TEXT,
-                                      json.dumps({
-                                          name: {"data": base64.b64encode(
-                                              data).decode()},
-                                      }).encode())
-            wslib.write_frame(handler.wfile, wslib.OP_TEXT,
-                              json.dumps({
-                                  "exited": True,
-                                  "result": {"exit_code": exit_code},
-                              }).encode())
+                    send_frame(wslib.OP_TEXT, json.dumps({
+                        name: {"data": base64.b64encode(data).decode()},
+                    }).encode())
+            send_frame(wslib.OP_TEXT, json.dumps({
+                "exited": True,
+                "result": {"exit_code": exit_code},
+            }).encode())
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
             stop.set()
             stream.terminate()
             try:
-                wslib.write_frame(handler.wfile, wslib.OP_CLOSE, b"")
+                send_frame(wslib.OP_CLOSE, b"")
             except OSError:
                 pass
         return StreamedResponse

@@ -131,7 +131,8 @@ class RawExecDriver(DriverPlugin):
             # the executor detaches (setsid) and supervises; we only
             # keep its status file
             subprocess.Popen(
-                [exe, task.status_path, stdout, stderr, workdir, "--"] + argv,
+                [exe, task.status_path, stdout, stderr, workdir]
+                + self._executor_opts(config) + ["--"] + argv,
                 env=env,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
@@ -168,16 +169,30 @@ class RawExecDriver(DriverPlugin):
             },
         )
 
+    def _executor_opts(self, config: TaskConfig) -> List[str]:
+        """Extra executor flags (raw_exec runs without isolation; the
+        exec driver overrides with namespaces + cgroup limits)."""
+        return []
+
     @staticmethod
     def _wait_for_pid(status_path: str, timeout: float = 10.0):
         deadline = time.time() + timeout
         while time.time() < deadline:
+            errors = []
             try:
                 with open(status_path) as f:
                     for line in f:
                         if line.startswith("pid "):
                             _, pid, pgid = line.split()
                             return int(pid), int(pgid)
+                        if line.startswith("error "):
+                            errors.append(line[6:].strip())
+                        elif line.startswith("exit "):
+                            # executor failed before launching the task
+                            detail = "; ".join(errors) or line.strip()
+                            raise RuntimeError(
+                                f"executor failed to launch task: {detail}"
+                            )
             except FileNotFoundError:
                 pass
             time.sleep(0.01)
@@ -287,11 +302,19 @@ class RawExecDriver(DriverPlugin):
         if task.pgid is not None and not task.done.is_set():
             _kill_group(task.pgid, getattr(_signal, signal, _signal.SIGTERM))
 
+    def _exec_context(self, task: _RawTask) -> tuple:
+        """(argv_prefix, env) an exec session must run under so it
+        shares the task's isolation context. raw_exec has none; the
+        exec driver enters the task's namespaces (the reference execs
+        inside the container, executor_linux.go Exec)."""
+        return [], self._build_env(task.config)
+
     def exec_task(self, task_id: str, cmd: List[str], timeout: float = 30.0) -> Dict:
         task = self._get(task_id)
+        prefix, env = self._exec_context(task)
         proc = subprocess.run(
-            cmd, cwd=task.config.alloc_dir or "/tmp",
-            capture_output=True, timeout=timeout,
+            prefix + cmd, cwd=task.config.alloc_dir or "/tmp",
+            env=env, capture_output=True, timeout=timeout,
         )
         return {
             "stdout": proc.stdout, "stderr": proc.stderr,
@@ -304,7 +327,9 @@ class RawExecDriver(DriverPlugin):
         ExecTaskStreaming): a live process with bidirectional stdio,
         optionally under a pty."""
         task = self._get(task_id)
-        return ExecStream(cmd, cwd=task.config.alloc_dir or "/tmp", tty=tty)
+        prefix, env = self._exec_context(task)
+        return ExecStream(prefix + cmd, cwd=task.config.alloc_dir or "/tmp",
+                          tty=tty, env=env)
 
     def task_stats(self, task_id: str) -> Dict:
         task = self._get(task_id)

@@ -35,6 +35,17 @@ def accept_key(key: str) -> str:
     return base64.b64encode(digest).decode()
 
 
+def _xor_mask(payload: bytes, key: bytes) -> bytes:
+    """Mask/unmask via one big-int XOR (a per-byte Python loop on the
+    stdio hot path caps exec throughput at a few MB/s)."""
+    n = len(payload)
+    if n == 0:
+        return payload
+    full = key * (n // 4) + key[: n % 4]
+    return (int.from_bytes(payload, "big")
+            ^ int.from_bytes(full, "big")).to_bytes(n, "big")
+
+
 def write_frame(wfile, opcode: int, payload: bytes, mask: bool = False) -> None:
     """One unfragmented frame. Clients MUST mask (RFC 6455 5.3)."""
     head = bytes([0x80 | opcode])
@@ -48,8 +59,7 @@ def write_frame(wfile, opcode: int, payload: bytes, mask: bool = False) -> None:
         head += bytes([mask_bit | 127]) + struct.pack(">Q", n)
     if mask:
         key = os.urandom(4)
-        masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
-        wfile.write(head + key + masked)
+        wfile.write(head + key + _xor_mask(payload, key))
     else:
         wfile.write(head + payload)
     wfile.flush()
@@ -82,7 +92,7 @@ def read_frame(rfile) -> Tuple[int, bytes]:
         key = _read_exact(rfile, 4) if masked else b""
         data = _read_exact(rfile, n) if n else b""
         if masked:
-            data = bytes(c ^ key[i % 4] for i, c in enumerate(data))
+            data = _xor_mask(data, key)
         if op in (OP_CLOSE, OP_PING, OP_PONG):
             return op, data            # control frames are never fragmented
         if opcode is None:
@@ -161,20 +171,30 @@ def connect(url: str, token: str = "",
     ]
     if token:
         lines.append(f"X-Nomad-Token: {token}")
-    sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
-    conn = WSConn(sock)
-    status_line = conn.rfile.readline().decode(errors="replace")
-    if " 101 " not in status_line and not status_line.rstrip().endswith("101"):
-        parts = status_line.split(None, 2)
-        code = parts[1] if len(parts) > 1 else "?"
-        # drain headers + any body snippet for the error message
+    try:
+        sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+        conn = WSConn(sock)
+        status_line = conn.rfile.readline().decode(errors="replace")
+        if " 101 " not in status_line and \
+                not status_line.rstrip().endswith("101"):
+            parts = status_line.split(None, 2)
+            code = parts[1] if len(parts) > 1 else "?"
+            # drain headers + any body snippet for the error message
+            while True:
+                line = conn.rfile.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+            raise ConnectionError(f"websocket upgrade refused: HTTP {code}")
         while True:
             line = conn.rfile.readline()
             if not line or line in (b"\r\n", b"\n"):
                 break
-        raise ConnectionError(f"websocket upgrade refused: HTTP {code}")
-    while True:
-        line = conn.rfile.readline()
-        if not line or line in (b"\r\n", b"\n"):
-            break
-    return conn
+        return conn
+    except BaseException:
+        # a refused/failed upgrade must not leak the socket (retrying
+        # SDKs would accumulate fds to EMFILE)
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise

@@ -1,0 +1,117 @@
+"""exec-driver isolation: namespaces + cgroup limits via the native
+executor (reference drivers/shared/executor/executor_linux.go).
+
+Tests skip on hosts without the corresponding privilege (the reference
+exec driver likewise refuses to fingerprint there).
+"""
+
+import os
+import time
+import uuid
+
+import pytest
+
+from nomad_tpu import structs
+from nomad_tpu.drivers.execdriver import ExecDriver, isolation_support
+from nomad_tpu.plugins.drivers import TaskConfig
+
+
+def _task_config(tmp_path, name, command, args, resources=None):
+    return TaskConfig(
+        id=f"{uuid.uuid4()}-{name}",
+        name=name,
+        alloc_id=str(uuid.uuid4()),
+        driver_config={"command": command, "args": args},
+        resources=resources,
+        alloc_dir=str(tmp_path),
+    )
+
+
+def _wait_exit(driver, task_id, timeout=20.0):
+    result = driver.wait_task(task_id, timeout=timeout)
+    assert result is not None, "task did not exit"
+    return result
+
+
+@pytest.mark.skipif(not isolation_support()["namespaces"],
+                    reason="host cannot unshare namespaces")
+class TestNamespaces:
+    def test_task_is_pid_1_and_cannot_see_host_pids(self, tmp_path):
+        driver = ExecDriver()
+        cfg = _task_config(
+            tmp_path, "ns", "/bin/sh",
+            ["-c", "echo mypid=$$; ls /proc | grep -c '^[0-9][0-9]*$'"],
+        )
+        driver.start_task(cfg)
+        result = _wait_exit(driver, cfg.id)
+        assert result.exit_code == 0
+        time.sleep(0.2)
+        out = open(os.path.join(str(tmp_path), "stdout")).read()
+        # pid 1 of its own pid namespace...
+        assert "mypid=1" in out, out
+        # ...and /proc (remounted inside) shows only the task's tree
+        n_procs = int(out.strip().splitlines()[-1])
+        assert n_procs <= 5, out
+        driver.destroy_task(cfg.id, force=True)
+
+
+@pytest.mark.skipif(not isolation_support()["cgroups"],
+                    reason="host cgroups not writable")
+class TestCgroupLimits:
+    def test_memory_limit_kills_overallocation(self, tmp_path):
+        driver = ExecDriver()
+        cfg = _task_config(
+            tmp_path, "oom", "/usr/bin/env",
+            ["python3", "-c",
+             "x = bytearray(256 * 1024 * 1024); print('survived')"],
+            resources=structs.Resources(cpu=100, memory_mb=32),
+        )
+        driver.start_task(cfg)
+        result = _wait_exit(driver, cfg.id)
+        time.sleep(0.2)
+        out = open(os.path.join(str(tmp_path), "stdout")).read()
+        assert "survived" not in out
+        # killed by the OOM killer (SIGKILL), not a clean exit
+        assert (result.signal == 9) or (result.exit_code != 0), (
+            result.exit_code, result.signal)
+        driver.destroy_task(cfg.id, force=True)
+
+    def test_within_limit_runs_fine(self, tmp_path):
+        driver = ExecDriver()
+        cfg = _task_config(
+            tmp_path, "ok", "/usr/bin/env",
+            ["python3", "-c", "x = bytearray(8 * 1024 * 1024); print('ok')"],
+            resources=structs.Resources(cpu=100, memory_mb=512),
+        )
+        driver.start_task(cfg)
+        result = _wait_exit(driver, cfg.id)
+        assert result.exit_code == 0
+        time.sleep(0.2)
+        out = open(os.path.join(str(tmp_path), "stdout")).read()
+        assert "ok" in out
+        driver.destroy_task(cfg.id, force=True)
+
+
+@pytest.mark.skipif(not isolation_support()["namespaces"],
+                    reason="host cannot unshare namespaces")
+class TestExecSessionsShareIsolation:
+    def test_exec_enters_task_namespaces(self, tmp_path):
+        """Exec sessions must run INSIDE the task's namespaces (the
+        reference execs inside the container), not on the host."""
+        driver = ExecDriver()
+        cfg = _task_config(
+            tmp_path, "iso", "/bin/sh", ["-c", "sleep 30"],
+        )
+        driver.start_task(cfg)
+        try:
+            out = driver.exec_task(
+                cfg.id,
+                ["/bin/sh", "-c", "ls /proc | grep -c '^[0-9][0-9]*$'"],
+            )
+            assert out["exit_code"] == 0, out
+            n_procs = int(out["stdout"].strip().splitlines()[-1])
+            # inside the pid namespace only the task tree is visible
+            assert n_procs <= 6, out
+        finally:
+            driver.stop_task(cfg.id, timeout=2)
+            driver.destroy_task(cfg.id, force=True)
