@@ -42,9 +42,10 @@ class AgentConfig:
     raft_advertise: str = ""
 
     @classmethod
-    def dev(cls) -> "AgentConfig":
+    def dev(cls, **overrides) -> "AgentConfig":
         """-dev preset: server + client in one process."""
-        return cls(server_enabled=True, client_enabled=True, dev_mode=True)
+        return cls(server_enabled=True, client_enabled=True, dev_mode=True,
+                   **overrides)
 
 
 class Agent:

@@ -569,6 +569,9 @@ class HTTPAgent:
         add("GET", r"/v1/job/(?P<id>[^/]+)/evaluations", self.job_evals)
         add("GET", r"/v1/job/(?P<id>[^/]+)/deployments", self.job_deployments)
         add("GET", r"/v1/job/(?P<id>[^/]+)/deployment", self.job_latest_deployment)
+        # multiregion gate release (Deployment.Unblock analog)
+        add("POST", r"/v1/job/(?P<id>[^/]+)/deployment/unblock",
+            self.job_deployment_unblock)
         add("GET", r"/v1/job/(?P<id>[^/]+)/summary", self.job_summary)
         add("GET", r"/v1/job/(?P<id>[^/]+)/versions", self.job_versions)
         add("POST", r"/v1/job/(?P<id>[^/]+)/revert", self.job_revert)
@@ -761,7 +764,7 @@ class HTTPAgent:
     def job_register(self, req: Request):
         job = self._decode_job(req.body)
         self._acl(req, "allow_ns_op", job.namespace, "submit-job")
-        res = self._server.job_register(job)
+        res = self._server.job_register(job, token=req.token)
         return {"EvalID": res["eval_id"], "EvalCreateIndex": res["index"],
                 "JobModifyIndex": res["index"], "Warnings": "; ".join(res["warnings"])}
 
@@ -845,6 +848,14 @@ class HTTPAgent:
         self._block(req, ["deployment"])
         snap = self._server.state.snapshot()
         return snap.latest_deployment_by_job_id(req.namespace, req.params["id"])
+
+    def job_deployment_unblock(self, req: Request):
+        """Multiregion gate release: an earlier region succeeded
+        (Deployment.Unblock; deployment watcher cross-region kick)."""
+        self._acl(req, "allow_ns_op", req.namespace, "submit-job")
+        index, unblocked = self._server.unblock_job_deployment(
+            req.namespace, req.params["id"])
+        return {"Index": index, "Unblocked": unblocked}
 
     def job_summary(self, req: Request):
         self._block(req, ["allocs"])

@@ -172,12 +172,23 @@ class TaskRunner:
 
     def _run_inner(self) -> None:
         self._emit(EVENT_RECEIVED)
-        try:
-            self._prestart()
-        except Exception as e:                  # noqa: BLE001
-            self._emit(EVENT_TASK_SETUP, f"prestart failed: {e}")
-            self._set_state(STATE_DEAD, failed=True)
-            return
+        # transient setup failures (artifact downloads) are recoverable
+        # and retry under the restart policy (artifact_hook.go wraps as
+        # recoverable); config errors (bad template, missing vault
+        # block) kill the task immediately, as in the reference
+        while True:
+            try:
+                self._prestart()
+                break
+            except Exception as e:              # noqa: BLE001
+                self._emit(EVENT_TASK_SETUP, f"prestart failed: {e}")
+                if not getattr(e, "recoverable", False):
+                    self._set_state(STATE_DEAD, failed=True)
+                    return
+                decision, delay = self.restart_tracker.next_restart(False)
+                if decision != "restart" or self._kill.wait(delay):
+                    self._set_state(STATE_DEAD, failed=True)
+                    return
         while not self._kill.is_set():
             try:
                 self.handle = self.driver.start_task(self._task_config())
@@ -269,8 +280,27 @@ class TaskRunner:
         os.makedirs(os.path.join(self.alloc_dir, "alloc", "logs"), exist_ok=True)
         self._emit(EVENT_TASK_SETUP, "Building Task Directory")
         self._logmon_hook()
+        self._artifact_hook(task_dir)
         self._vault_hook(task_dir)
         self._template_hook(task_dir)
+
+    def _artifact_hook(self, task_dir: str) -> None:
+        """artifact_hook.go: download each artifact stanza into the
+        task dir before the driver starts; failure is a task setup
+        failure (Failed Artifact Download event), retried under the
+        restart policy like the reference's recoverable wrap."""
+        if not self.task.artifacts:
+            return
+        from nomad_tpu.client.getter import ArtifactError, fetch_artifact
+
+        self._emit(EVENT_TASK_SETUP, "Downloading Artifacts")
+        for artifact in self.task.artifacts:
+            try:
+                fetch_artifact(artifact, task_dir)
+            except ArtifactError as e:
+                self._emit(EVENT_TASK_SETUP,
+                           f"Failed Artifact Download: {e}")
+                raise
 
     def _logmon_hook(self) -> None:
         """logmon_hook.go: one rotating collector per stream; the

@@ -388,6 +388,27 @@ def _map_job(job_id: str, jb: Body) -> Job:
             prohibit_overlap=bool(pa.get("prohibit_overlap", False)),
             timezone=str(pa.get("time_zone", "UTC")),
         )
+    mr = jb.first_block("multiregion")
+    if mr is not None:
+        mrb = mr[1]
+        multiregion: Dict = {"strategy": {}, "regions": []}
+        strat = mrb.first_block("strategy")
+        if strat is not None:
+            sa = strat[1].attrs
+            multiregion["strategy"] = {
+                "max_parallel": int(sa.get("max_parallel", 0) or 0),
+                "on_failure": str(sa.get("on_failure", "")),
+            }
+        for labels, rb in mrb.get_blocks("region"):
+            ra = rb.attrs
+            multiregion["regions"].append({
+                "name": labels[0] if labels else "",
+                "count": int(ra.get("count", 0) or 0),
+                "datacenters": [str(d) for d in ra.get("datacenters", [])],
+                "meta": {k: str(v) for k, v in (ra.get("meta") or {}).items()}
+                if isinstance(ra.get("meta"), dict) else {},
+            })
+        job.multiregion = multiregion
     par = jb.first_block("parameterized")
     if par is not None:
         pa = par[1].attrs

@@ -11,7 +11,11 @@ Round-1 scope notes (each tracked for later rounds):
   with timeout follow-up evals and lost handling; the score-based
   keep-reconnecting-vs-replacement tiebreak (computeStopByReconnecting)
   prefers the replacement unless the reconnecting alloc is same-version.
-- multiregion deployment blocking is not implemented.
+- multiregion: regions beyond the strategy's first max_parallel wave
+  create their deployment in the 'blocked' state and make no rollout
+  progress until an earlier region's success unblocks them
+  (structs.go:4133; the deployment watcher performs the cross-region
+  kick over the federation layer).
 """
 
 from __future__ import annotations
@@ -521,9 +525,22 @@ class AllocReconciler:
                 d.status_description = "Deployment is running but requires manual promotion"
 
     def _compute_deployment_paused(self) -> None:
+        if self.deployment is None and self.job.multiregion \
+                and self.job.multiregion_starts_blocked():
+            # a gated region's FIRST eval: there is no deployment row
+            # yet, but initial placements must still wait for the
+            # earlier region — treat as paused from the start (the
+            # blocked deployment row is created below so the unblock
+            # kick has something to release)
+            self.deployment_paused = True
+            return
         if self.deployment is not None:
+            # blocked multiregion deployments behave like paused ones:
+            # no rollout progress until an earlier region unblocks them
             self.deployment_paused = self.deployment.status in (
-                consts.DEPLOYMENT_STATUS_PAUSED, consts.DEPLOYMENT_STATUS_PENDING
+                consts.DEPLOYMENT_STATUS_PAUSED,
+                consts.DEPLOYMENT_STATUS_PENDING,
+                consts.DEPLOYMENT_STATUS_BLOCKED,
             )
             self.deployment_failed = (
                 self.deployment.status == consts.DEPLOYMENT_STATUS_FAILED
@@ -1170,6 +1187,16 @@ class AllocReconciler:
             return
         if self.deployment is None:
             self.deployment = new_deployment(self.job)
+            # multiregion gating (structs.go:4133): regions beyond the
+            # first max_parallel wave deploy blocked until an earlier
+            # region's success unblocks them
+            if self.job.multiregion:
+                self.deployment.is_multiregion = True
+                if self.job.multiregion_starts_blocked():
+                    self.deployment.status = consts.DEPLOYMENT_STATUS_BLOCKED
+                    self.deployment.status_description = (
+                        "Deployment is blocked on an earlier region"
+                    )
             self.result.deployment = self.deployment
         self.deployment.task_groups[group_name] = dstate
 

@@ -53,6 +53,11 @@ class _Watcher:
             d = snap.deployment_by_id(self.deployment_id)
             if d is None or not d.active():
                 break
+            if d.status == consts.DEPLOYMENT_STATUS_BLOCKED:
+                # multiregion gate: wait for an earlier region's kick;
+                # the progress deadline starts when we unblock
+                deadline = None
+                continue
             if deadline is None:
                 deadline = time.time() + max(
                     (s.progress_deadline_s for s in d.task_groups.values()),
@@ -106,6 +111,8 @@ class _Watcher:
                     "description": "Deployment completed successfully",
                 },
             )
+            if d.is_multiregion:
+                self._kick_next_regions(d)
             return True, last_healthy, promoted
 
         # progress: newly healthy allocs unblock the next rolling batch
@@ -126,6 +133,75 @@ class _Watcher:
             deployment_id=d.id,
             status=consts.EVAL_STATUS_PENDING,
         )
+
+    def _kick_next_regions(self, d) -> None:
+        """Multiregion rollout: this region succeeded, so unblock the
+        region max_parallel positions later in the order (with
+        max_parallel=m, regions 0..m-1 start running and each success
+        admits one more). Remote regions are kicked over the
+        federation HTTP; the local region (single-region tests /
+        same-server federations) unblocks directly."""
+        import urllib.parse
+        import urllib.request
+
+        snap = self.server.state.snapshot()
+        job = snap.job_by_id(d.namespace, d.job_id)
+        if job is None or not job.multiregion:
+            return
+        mp = job.multiregion_max_parallel()
+        if mp <= 0:
+            return
+        idx = job.multiregion_region_index()
+        regions = job.multiregion_regions()
+        nxt = idx + mp
+        if idx < 0 or nxt >= len(regions):
+            return
+        target = str(regions[nxt].get("name", ""))
+        if not target:
+            return
+        if target == self.server.config.region:
+            # local target may not have its blocked row yet; retry
+            for _ in range(10):
+                _, unblocked = self.server.unblock_job_deployment(
+                    d.namespace, d.job_id)
+                if unblocked:
+                    return
+                time.sleep(0.5)
+            return
+        url_path = (f"/v1/job/{urllib.parse.quote(d.job_id, safe='')}"
+                    f"/deployment/unblock?region={target}"
+                    f"&namespace={d.namespace}")
+        # retried with backoff: the kick races the target region's
+        # scheduler creating its blocked row, and transient federation
+        # errors must not leave the region gated forever (the operator
+        # escape hatch is the unblock endpoint/CLI)
+        delay = 0.5
+        for attempt in range(6):
+            addr = self.server.region_addr(target)
+            if addr is None:
+                LOG.warning("multiregion: no path to region %s to "
+                            "unblock %s", target, d.job_id)
+                return
+            try:
+                import json as _json
+
+                req = urllib.request.Request(
+                    addr + url_path, data=b"{}", method="POST")
+                token = getattr(self.server.config, "replication_token", "")
+                if token:
+                    req.add_header("X-Nomad-Token", token)
+                with urllib.request.urlopen(req, timeout=15) as resp:
+                    body = _json.loads(resp.read() or b"{}")
+                if body.get("Unblocked"):
+                    return
+                # nothing blocked there yet: the target's scheduler is
+                # still creating the row — retry
+                raise OSError("target region had no blocked deployment")
+            except OSError as e:
+                LOG.warning("multiregion: unblock kick to %s failed "
+                            "(attempt %d): %s", target, attempt + 1, e)
+                time.sleep(delay)
+                delay = min(delay * 2, 8.0)
 
     def _fail(self, d, reason: str) -> None:
         LOG.info("deployment %s failed: %s", d.id, reason)

@@ -332,12 +332,19 @@ class Server:
 
     # --- Job endpoint (nomad/job_endpoint.go) ---------------------------
 
-    def job_register(self, job) -> Dict:
-        """Job.Register: validate, commit, create+enqueue an eval."""
+    def job_register(self, job, token: str = "") -> Dict:
+        """Job.Register: validate, commit, create+enqueue an eval.
+        ``token`` is forwarded on multiregion fan-out registrations."""
         errs = job.validate()
         if errs:
             # job_endpoint.go Register rejects invalid jobs outright
             raise ValueError("job validation failed: " + "; ".join(errs))
+        # multiregion fan-out (structs.go:4133; the reference's
+        # multiregion register hook): a job submitted with region
+        # "global" and a multiregion block becomes one per-region copy,
+        # each registered in its region over the federation layer
+        if job.multiregion and job.region in ("", "global"):
+            return self._register_multiregion(job, token=token)
         warnings: List[str] = []
         evals = []
         if job.type != consts.JOB_TYPE_CORE and not job.is_periodic() \
@@ -360,6 +367,112 @@ class Server:
             "index": index,
             "warnings": warnings,
         }
+
+    def _register_multiregion(self, job, token: str = "") -> Dict:
+        """Fan one multiregion job out into per-region copies.
+
+        Per-region overrides: a region stanza's ``count`` replaces the
+        task groups' counts, ``datacenters`` replaces the job's. The
+        local region registers directly; remote regions register over
+        the federation HTTP (serf WAN analog) carrying the submitter's
+        ACL token. Copies carry concrete region names so remote
+        servers do not re-fan them. Region reachability is verified
+        up front so a late failure can't leave a silently partial
+        rollout; mid-flight HTTP failures surface the partial state in
+        the error.
+        """
+        specs = [(str(r.get("name", "")), r)
+                 for r in job.multiregion_regions() if r.get("name")]
+        # pre-flight: every remote region must be reachable
+        for name, _ in specs:
+            if name != self.config.region and self.region_addr(name) is None:
+                raise ValueError(f"multiregion: no path to region {name}")
+        results: Dict = {}
+        local_result: Optional[Dict] = None
+        for name, region_spec in specs:
+            copy = job.copy()
+            copy.region = name
+            count = int(region_spec.get("count", 0) or 0)
+            if count > 0:
+                for tg in copy.task_groups:
+                    tg.count = count
+            dcs = region_spec.get("datacenters") or []
+            if dcs:
+                copy.datacenters = list(dcs)
+            try:
+                if name == self.config.region:
+                    local_result = self.job_register(copy, token=token)
+                    results[name] = local_result
+                else:
+                    results[name] = self._remote_job_register(
+                        self.region_addr(name), copy, name, token)
+            except (ValueError, OSError) as e:
+                done = sorted(results)
+                raise ValueError(
+                    f"multiregion register in {name} failed after "
+                    f"registering in {done or 'no regions'}: {e}"
+                )
+        if local_result is None:
+            # submitted to a server whose region isn't in the list:
+            # still forward everywhere, answer with the first result
+            local_result = next(iter(results.values()), {"eval_id": "",
+                                                         "index": 0})
+        out = dict(local_result)
+        out["regions"] = sorted(results)
+        return out
+
+    def _remote_job_register(self, addr: str, job, region: str,
+                             token: str = "") -> Dict:
+        import json as _json
+        import urllib.request
+
+        from nomad_tpu.api.codec import encode
+
+        payload = _json.dumps({"Job": encode(job)}).encode()
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["X-Nomad-Token"] = token
+        req = urllib.request.Request(
+            f"{addr}/v1/jobs?region={region}", data=payload,
+            method="POST", headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return _json.loads(resp.read() or b"{}")
+        except OSError as e:
+            raise ValueError(f"multiregion register in {region}: {e}")
+
+    def unblock_deployment(self, deployment_id: str) -> int:
+        """Deployment.Unblock (the multiregion gate release): a blocked
+        deployment resumes running and gets a follow-up eval."""
+        snap = self.state.snapshot()
+        d = snap.deployment_by_id(deployment_id)
+        if d is None:
+            raise KeyError(f"deployment '{deployment_id}' not found")
+        if d.status != consts.DEPLOYMENT_STATUS_BLOCKED:
+            return self.state.latest_index()
+        from nomad_tpu.server.deployment_watcher import _operator_eval
+
+        return self.raft_apply(
+            fsm_msgs.DEPLOYMENT_STATUS_UPDATE,
+            {
+                "deployment_id": d.id,
+                "status": consts.DEPLOYMENT_STATUS_RUNNING,
+                "description": "Deployment unblocked",
+                "evals": [_operator_eval(d)],
+            },
+        )
+
+    def unblock_job_deployment(self, namespace: str, job_id: str):
+        """Unblock the latest blocked deployment of a job (the target
+        of a cross-region kick). Returns (index, unblocked) — callers
+        retry while nothing was there to unblock (the kick can race
+        the target's scheduler creating the blocked row)."""
+        snap = self.state.snapshot()
+        d = snap.latest_deployment_by_job_id(namespace, job_id)
+        if d is None or d.status != consts.DEPLOYMENT_STATUS_BLOCKED:
+            return self.state.latest_index(), False
+        return self.unblock_deployment(d.id), True
 
     def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> Dict:
         snap = self.state.snapshot()

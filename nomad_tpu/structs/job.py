@@ -300,9 +300,42 @@ class Job:
     job_modify_index: int = 0
     parent_id: str = ""
     dispatched: bool = False
+    # {"strategy": {"max_parallel": N, "on_failure": "..."},
+    #  "regions": [{"name", "count", "datacenters", "meta"}, ...]}
+    # (structs.go:4133 Multiregion)
     multiregion: Optional[Dict] = None
     consul_token: str = ""
     vault_token: str = ""
+
+    # -- multiregion helpers (structs.go Multiregion) --------------------
+
+    def multiregion_regions(self) -> List[Dict]:
+        if not self.multiregion:
+            return []
+        return list(self.multiregion.get("regions") or [])
+
+    def multiregion_max_parallel(self) -> int:
+        """0 means every region deploys at once (reference default)."""
+        if not self.multiregion:
+            return 0
+        strategy = self.multiregion.get("strategy") or {}
+        return int(strategy.get("max_parallel", 0) or 0)
+
+    def multiregion_region_index(self) -> int:
+        """This job copy's position in the region rollout order."""
+        for i, r in enumerate(self.multiregion_regions()):
+            if str(r.get("name", "")) == self.region:
+                return i
+        return -1
+
+    def multiregion_starts_blocked(self) -> bool:
+        """Regions past the first max_parallel wave deploy blocked and
+        wait for an earlier region's success to unblock them."""
+        mp = self.multiregion_max_parallel()
+        if mp <= 0:
+            return False
+        idx = self.multiregion_region_index()
+        return idx >= mp
 
     def validate(self) -> List[str]:
         """structs.go Job.Validate: returns a list of validation error

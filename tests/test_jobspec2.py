@@ -260,3 +260,43 @@ class TestReviewRegressions:
             eval_expr("var.l[5]", scope)
         with pytest.raises(EvalError):
             eval_expr('"a" + 1', scope)
+
+
+def test_multiregion_block_parses():
+    hcl = '''
+    job "mr" {
+      datacenters = ["dc1"]
+      multiregion {
+        strategy {
+          max_parallel = 1
+          on_failure   = "fail_all"
+        }
+        region "east" {
+          count       = 3
+          datacenters = ["east-1"]
+        }
+        region "west" {
+          count = 2
+        }
+      }
+      group "web" {
+        task "t" {
+          driver = "raw_exec"
+          config { command = "/bin/true" }
+        }
+      }
+    }
+    '''
+    job = parse_hcl(hcl)
+    assert job.multiregion["strategy"]["max_parallel"] == 1
+    assert job.multiregion["strategy"]["on_failure"] == "fail_all"
+    regions = job.multiregion["regions"]
+    assert [r["name"] for r in regions] == ["east", "west"]
+    assert regions[0]["count"] == 3
+    assert regions[0]["datacenters"] == ["east-1"]
+    # helper semantics used by the scheduler gate
+    job.region = "west"
+    assert job.multiregion_region_index() == 1
+    assert job.multiregion_starts_blocked()
+    job.region = "east"
+    assert not job.multiregion_starts_blocked()

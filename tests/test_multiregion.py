@@ -1,0 +1,132 @@
+"""Multiregion job deployments (structs.go:4133 Multiregion).
+
+A job with a multiregion block fans out into per-region copies over
+the federation layer; deployments in regions beyond the strategy's
+first max_parallel wave start blocked and unblock only when an
+earlier region's deployment succeeds (the deployment watcher's
+cross-region kick).
+"""
+
+import time
+
+from nomad_tpu import mock, structs
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.structs import consts
+
+
+def wait_for(fn, timeout=25.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def make_mr_job(max_parallel=1):
+    job = mock.job()
+    job.region = "global"
+    job.task_groups[0].count = 2
+    task = job.task_groups[0].tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": 60}
+    job.task_groups[0].update = structs.UpdateStrategy(
+        max_parallel=2,
+        min_healthy_time_s=0.1,
+        healthy_deadline_s=10.0,
+        progress_deadline_s=60.0,
+    )
+    job.multiregion = {
+        "strategy": {"max_parallel": max_parallel, "on_failure": ""},
+        "regions": [
+            {"name": "east", "count": 1, "datacenters": []},
+            {"name": "west", "count": 1, "datacenters": []},
+        ],
+    }
+    return job
+
+
+class TestMultiregion:
+    def test_two_region_rollout_gates_on_first_region(self):
+        east = Agent(AgentConfig.dev(name="east-1", region="east"))
+        west = Agent(AgentConfig.dev(name="west-1", region="west"))
+        east.start()
+        west.start()
+        try:
+            east.server.join_region("west", west.http.addr)
+            west.server.join_region("east", east.http.addr)
+
+            job = make_mr_job(max_parallel=1)
+            out = east.server.job_register(job)
+            assert sorted(out["regions"]) == ["east", "west"]
+
+            # both regions got their copy, with the per-region count
+            e_job = wait_for(
+                lambda: east.server.state.snapshot().job_by_id(
+                    job.namespace, job.id), msg="east job")
+            w_job = wait_for(
+                lambda: west.server.state.snapshot().job_by_id(
+                    job.namespace, job.id), msg="west job")
+            assert e_job.region == "east" and w_job.region == "west"
+            assert e_job.task_groups[0].count == 1
+            assert w_job.task_groups[0].count == 1
+
+            # west's deployment starts blocked; east's runs
+            w_dep = wait_for(
+                lambda: west.server.state.snapshot()
+                .latest_deployment_by_job_id(job.namespace, job.id),
+                msg="west deployment")
+            assert w_dep.status == consts.DEPLOYMENT_STATUS_BLOCKED
+            # the gate is real: while blocked, west placed NOTHING
+            assert west.server.state.snapshot().allocs_by_job(
+                job.namespace, job.id) == []
+
+            # while east is still rolling, west must not place allocs
+            # beyond the gate (its reconciler treats blocked as paused)
+            e_dep = wait_for(
+                lambda: east.server.state.snapshot()
+                .latest_deployment_by_job_id(job.namespace, job.id),
+                msg="east deployment")
+            assert e_dep.status != consts.DEPLOYMENT_STATUS_BLOCKED
+
+            # east succeeds -> watcher kicks west's gate open
+            wait_for(
+                lambda: east.server.state.snapshot()
+                .latest_deployment_by_job_id(job.namespace, job.id).status
+                == consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+                timeout=40, msg="east deployment successful")
+            wait_for(
+                lambda: west.server.state.snapshot()
+                .latest_deployment_by_job_id(job.namespace, job.id).status
+                != consts.DEPLOYMENT_STATUS_BLOCKED,
+                timeout=40, msg="west deployment unblocked")
+            # and west then completes its own rollout
+            wait_for(
+                lambda: west.server.state.snapshot()
+                .latest_deployment_by_job_id(job.namespace, job.id).status
+                == consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+                timeout=40, msg="west deployment successful")
+        finally:
+            east.shutdown()
+            west.shutdown()
+
+    def test_max_parallel_zero_runs_all_regions(self):
+        east = Agent(AgentConfig.dev(name="east-2", region="east"))
+        west = Agent(AgentConfig.dev(name="west-2", region="west"))
+        east.start()
+        west.start()
+        try:
+            east.server.join_region("west", west.http.addr)
+            west.server.join_region("east", east.http.addr)
+            job = make_mr_job(max_parallel=0)
+            east.server.job_register(job)
+            for agent in (east, west):
+                dep = wait_for(
+                    lambda a=agent: a.server.state.snapshot()
+                    .latest_deployment_by_job_id(job.namespace, job.id),
+                    msg="deployment")
+                assert dep.status != consts.DEPLOYMENT_STATUS_BLOCKED
+        finally:
+            east.shutdown()
+            west.shutdown()
