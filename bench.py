@@ -316,7 +316,31 @@ def run_e2e() -> dict:
         server.shutdown()
 
 
+def _device_preflight(timeout: float = 120.0) -> None:
+    """Probe the default JAX backend in a SUBPROCESS; if it hangs or
+    fails (shared tunnel devices wedge), pin this process to CPU before
+    any jax use so the bench degrades instead of hanging forever."""
+    probe = (
+        "import jax, jax.numpy as jnp; print(float(jnp.zeros(1).sum()))"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, timeout=timeout,
+        )
+        if out.returncode == 0:
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    print("warning: default JAX backend unresponsive; falling back to CPU",
+          file=sys.stderr)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
+    _device_preflight()
     baseline = run_baseline()
     tpu = run_tpu()
     parity = run_score_parity()

@@ -140,8 +140,11 @@ def fetch_artifact(artifact: Dict, task_dir: str,
             cmd += ["--branch", ref]
         cmd += [url, dest_dir]
         try:
-            # clone wants an empty dir; allow re-fetch into a fresh one
             if os.listdir(dest_dir):
+                # idempotent under prestart retries: a completed clone
+                # is kept; anything else in the way is an error
+                if os.path.isdir(os.path.join(dest_dir, ".git")):
+                    return dest_dir
                 raise ArtifactError(
                     f"git destination {destination!r} is not empty")
             proc = subprocess.run(cmd, capture_output=True, timeout=timeout)

@@ -1,14 +1,22 @@
 """Per-task log collection with rotation (the logmon analog).
 
-Reference behavior: client/logmon/logmon.go runs a separate process
-per task that reads the task's stdout/stderr through FIFOs and writes
-size-rotated files ``<task>.<stream>.N`` (rotator in
-client/lib/fifo + logmon/logging/rotator.go), honoring the task's
-LogConfig (max_files / max_file_size_mb). Here logmon is a thread in
-the client agent reading the same kind of FIFO: the driver (or the
-native executor, which open(2)s the path it is given) writes into the
-FIFO; the reader rotates on size and prunes old indexes. fs 'logs'
-reads concatenate the rotated chain in index order.
+Reference behavior: client/logmon/logmon.go runs a SEPARATE PROCESS
+per task stream that reads the task's stdout/stderr through a FIFO and
+writes size-rotated files ``<task>.<stream>.N`` (client/lib/fifo +
+logmon/logging/rotator.go), honoring the task's LogConfig (max_files /
+max_file_size_mb). The process boundary is the point: task logs keep
+flowing across agent restarts, and a restarted agent REATTACHES to the
+live collector instead of starting a second one (go-plugin reattach).
+
+Here ``LogMon`` is the supervisor handle: ``start()`` spawns
+``python -m nomad_tpu.client.logmon <base> <max_files> <max_mb>`` as a
+detached session, or adopts an already-running collector via its
+pidfile. The collector child owns the FIFO and the rotation chain; on
+SIGTERM it drains the FIFO tail and exits. If spawning fails the
+collector runs as an in-agent thread (degraded: logs die with the
+agent, logged as a warning).
+
+fs 'logs' reads concatenate the rotated chain in index order.
 """
 
 from __future__ import annotations
@@ -19,34 +27,33 @@ import logging
 import os
 import re
 import select
+import signal
+import subprocess
+import sys
 import threading
+import time
 from typing import List, Optional, Tuple
 
 LOG = logging.getLogger(__name__)
 
 
-class LogMon:
-    """One rotating collector for one task stream.
+class _Collector:
+    """The FIFO -> rotated-files loop (runs in the collector process,
+    or in-agent as the degraded fallback)."""
 
-    ``base_path`` is the unsuffixed target (".../web.stdout"); output
-    files are ``base_path.N``. The write side is ``fifo_path`` —
-    hand it to the driver as the task's stdout/stderr path.
-    """
-
-    def __init__(self, base_path: str, max_files: int = 10,
-                 max_file_size_mb: int = 10) -> None:
+    def __init__(self, base_path: str, max_files: int,
+                 max_file_size_mb: int) -> None:
         self.base_path = base_path
         self.fifo_path = base_path + ".fifo"
         self.max_files = max(1, max_files)
         self.max_bytes = max(1, max_file_size_mb) * 1024 * 1024
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._fd: Optional[int] = None
         self._idx = 0
         self._out = None
         self._written = 0
 
-    def start(self) -> None:
+    def open(self) -> None:
         os.makedirs(os.path.dirname(self.base_path), exist_ok=True)
         try:
             os.mkfifo(self.fifo_path)
@@ -55,7 +62,7 @@ class LogMon:
         # O_RDWR keeps the read end open across writer restarts (task
         # restarts reopen the FIFO) and makes this open non-blocking
         self._fd = os.open(self.fifo_path, os.O_RDWR | os.O_NONBLOCK)
-        # resume at the highest existing index (agent restart must not
+        # resume at the highest existing index (restart must not
         # interleave new output into already-rotated files)
         existing = rotated_files(self.base_path)
         if existing:
@@ -63,11 +70,6 @@ class LogMon:
         self._open_current()
         if self._written >= self.max_bytes:
             self._rotate()
-        self._thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"logmon-{os.path.basename(self.base_path)}",
-        )
-        self._thread.start()
 
     def _open_current(self) -> None:
         path = f"{self.base_path}.{self._idx}"
@@ -86,7 +88,7 @@ class LogMon:
             except OSError:
                 pass
 
-    def _run(self) -> None:
+    def run(self) -> None:
         while not self._stop.is_set():
             r, _, _ = select.select([self._fd], [], [], 0.2)
             if not r:
@@ -104,15 +106,16 @@ class LogMon:
             self._written += len(chunk)
             if self._written >= self.max_bytes:
                 self._rotate()
+        self.drain_and_close()
 
-    def stop(self) -> None:
+    def request_stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
+
+    def drain_and_close(self) -> None:
         if self._fd is not None:
             # drain what the writer flushed before it exited — a
             # fast-exiting task's tail output is still in the FIFO
-            # buffer when the runner stops the collector
+            # buffer when the collector stops
             while True:
                 try:
                     chunk = os.read(self._fd, 65536)
@@ -128,6 +131,157 @@ class LogMon:
             self._out = None
         try:
             os.unlink(self.fifo_path)
+        except OSError:
+            pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _is_collector(pid: int) -> bool:
+    """A pidfile pid is only trustworthy if the process actually IS a
+    logmon collector — crashes leave stale files, and pids recycle."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"logmon" in f.read()
+    except OSError:
+        return False
+
+
+class LogMon:
+    """Supervisor handle for one task stream's collector process.
+
+    ``base_path`` is the unsuffixed target (".../web.stdout"); output
+    files are ``base_path.N``. The write side is ``fifo_path`` — hand
+    it to the driver as the task's stdout/stderr path.
+    """
+
+    def __init__(self, base_path: str, max_files: int = 10,
+                 max_file_size_mb: int = 10) -> None:
+        self.base_path = base_path
+        self.fifo_path = base_path + ".fifo"
+        self.pid_path = base_path + ".logmon.pid"
+        self.max_files = max_files
+        self.max_file_size_mb = max_file_size_mb
+        self._pid: Optional[int] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self._inproc: Optional[_Collector] = None
+        self._inproc_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        os.makedirs(os.path.dirname(self.base_path), exist_ok=True)
+        # reattach: a collector from a previous agent life is still
+        # running (the whole point of the process boundary)
+        existing = self._read_pidfile()
+        if existing is not None and _pid_alive(existing) \
+                and _is_collector(existing):
+            self._pid = existing
+            return
+        # stale leftovers from an uncleanly-died collector would make
+        # the spawn-wait loop adopt the wrong pid
+        for leftover in (self.pid_path,):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
+        try:
+            # run THIS FILE as a script with -S: the collector is
+            # stdlib-only, and skipping site/package init avoids the
+            # environment's heavyweight interpreter startup per stream
+            proc = subprocess.Popen(
+                [sys.executable, "-S", os.path.abspath(__file__),
+                 self.base_path, str(self.max_files),
+                 str(self.max_file_size_mb)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True,
+                cwd="/",
+            )
+        except OSError as e:
+            LOG.warning("logmon %s: spawn failed (%s); collecting "
+                        "in-process (logs will not survive agent "
+                        "restart)", self.base_path, e)
+            self._start_inproc()
+            return
+        # wait for the collector to own the FIFO + pidfile
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            pid = self._read_pidfile()
+            if pid is not None and os.path.exists(self.fifo_path):
+                self._pid = pid
+                self._proc = proc      # our child: reap it on stop
+                return
+            if proc.poll() is not None:
+                break
+            time.sleep(0.01)
+        LOG.warning("logmon %s: collector did not come up; collecting "
+                    "in-process", self.base_path)
+        self._start_inproc()
+
+    def _start_inproc(self) -> None:
+        self._inproc = _Collector(self.base_path, self.max_files,
+                                  self.max_file_size_mb)
+        self._inproc.open()
+        self._inproc_thread = threading.Thread(
+            target=self._inproc.run, daemon=True,
+            name=f"logmon-{os.path.basename(self.base_path)}",
+        )
+        self._inproc_thread.start()
+
+    def _read_pidfile(self) -> Optional[int]:
+        try:
+            with open(self.pid_path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def stop(self) -> None:
+        """Terminate the collector (task is done). NOT called on agent
+        shutdown with a live task — the collector must outlive us."""
+        if self._inproc is not None:
+            self._inproc.request_stop()
+            if self._inproc_thread is not None:
+                self._inproc_thread.join(timeout=2)
+            self._inproc = None
+            self._inproc_thread = None
+            return
+        if self._pid is not None:
+            try:
+                os.kill(self._pid, signal.SIGTERM)
+            except OSError:
+                pass
+            if self._proc is not None:
+                # our own child: reap it, or it lingers as a zombie
+                try:
+                    self._proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+                    try:
+                        self._proc.wait(timeout=2)
+                    except subprocess.TimeoutExpired:
+                        pass
+                self._proc = None
+            else:
+                # adopted collector (previous agent life): init reaps it
+                deadline = time.time() + 3
+                while time.time() < deadline and _pid_alive(self._pid):
+                    time.sleep(0.02)
+                if _pid_alive(self._pid):
+                    try:
+                        os.kill(self._pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+            self._pid = None
+        try:
+            os.unlink(self.pid_path)
         except OSError:
             pass
 
@@ -166,3 +320,30 @@ def rotated_files(base_path: str) -> List[str]:
         if m:
             found.append((int(m.group(1)), path))
     return [p for _i, p in sorted(found)]
+
+
+def _collector_main(argv: List[str]) -> int:
+    """``python -m nomad_tpu.client.logmon <base> <max_files> <max_mb>``
+    — the collector process entry (logmon.go main loop)."""
+    if len(argv) != 3:
+        print("usage: logmon <base_path> <max_files> <max_file_size_mb>",
+              file=sys.stderr)
+        return 2
+    base, max_files, max_mb = argv[0], int(argv[1]), int(argv[2])
+    collector = _Collector(base, max_files, max_mb)
+    collector.open()
+    pid_path = base + ".logmon.pid"
+    with open(pid_path, "w") as f:
+        f.write(str(os.getpid()))
+    signal.signal(signal.SIGTERM, lambda *_: collector.request_stop())
+    signal.signal(signal.SIGHUP, signal.SIG_IGN)   # agent exit is not ours
+    collector.run()
+    try:
+        os.unlink(pid_path)
+    except OSError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_collector_main(sys.argv[1:]))

@@ -9,23 +9,39 @@ container gets a graceful shutdown window.
 This build drives the docker CLI: a foreground ``docker run`` process
 is supervised by the shared executor (signals proxy through the CLI),
 while stop/destroy go through ``docker stop``/``docker rm`` so
-engine-side state is cleaned up. Gated: nodes without a reachable
-daemon fingerprint as undetected and never receive docker tasks.
+engine-side state is cleaned up. Operational surface beyond run/stop:
+
+- image pulls are singleflighted per image across concurrent tasks
+  (coordinator.go), probing ``docker image inspect`` first
+- ``task_stats`` reads engine stats (`docker stats --format json`)
+  into the TaskStats shape (cpu percent, memory rss)
+- interactive exec streams through ``docker exec -i[t]`` INSIDE the
+  container (driver.proto:79)
+- log collection deviation: the reference tails the engine via a
+  docklog subprocess; here the foreground ``docker run`` writes
+  through the executor into the logmon collector process, which
+  provides the same survive-agent-restart property
+
+Gated: nodes without a reachable daemon fingerprint as undetected and
+never receive docker tasks.
 """
 
 from __future__ import annotations
 
+import json
 import shutil
 import subprocess
+import threading
 from typing import Dict, List, Optional
 
-from nomad_tpu.drivers.rawexec import RawExecDriver
+from nomad_tpu.drivers.rawexec import ExecStream, RawExecDriver
 from nomad_tpu.plugins.base import PLUGIN_TYPE_DRIVER, PluginInfo
 from nomad_tpu.plugins.drivers import (
     HEALTH_HEALTHY,
     HEALTH_UNDETECTED,
     Fingerprint,
     TaskConfig,
+    TaskHandle,
 )
 
 
@@ -46,8 +62,42 @@ class DockerDriver(RawExecDriver):
             opts.get("docker.volumes.enabled", "false")).lower() in (
                 "1", "true", "yes")
 
+    #: image -> lock: concurrent tasks of one image pull it ONCE
+    #: (drivers/docker/coordinator.go singleflight)
+    _pull_locks: Dict[str, threading.Lock] = {}
+    _pull_locks_guard = threading.Lock()
+
     def plugin_info(self) -> PluginInfo:
         return PluginInfo(name=self.name, type=PLUGIN_TYPE_DRIVER)
+
+    # -- image pull coordination (coordinator.go) ------------------------
+
+    def _ensure_image(self, image: str, timeout: float = 600.0) -> None:
+        with self._pull_locks_guard:
+            lock = self._pull_locks.setdefault(image, threading.Lock())
+        with lock:
+            probe = subprocess.run(
+                ["docker", "image", "inspect", image],
+                capture_output=True, timeout=60,
+            )
+            if probe.returncode == 0:
+                return
+            pull = subprocess.run(
+                ["docker", "pull", image],
+                capture_output=True, timeout=timeout,
+            )
+            if pull.returncode != 0:
+                raise RuntimeError(
+                    f"docker pull {image}: "
+                    f"{pull.stderr.decode(errors='replace')[:300]}"
+                )
+
+    def start_task(self, config: TaskConfig) -> TaskHandle:
+        image = config.driver_config.get("image")
+        if not image:
+            raise ValueError("docker driver requires image")
+        self._ensure_image(image)
+        return super().start_task(config)
 
     def fingerprint(self) -> Fingerprint:
         docker = shutil.which("docker")
@@ -165,3 +215,58 @@ class DockerDriver(RawExecDriver):
         )
         return {"stdout": out.stdout, "stderr": out.stderr,
                 "exit_code": out.returncode}
+
+    def exec_task_streaming(self, task_id: str, cmd: List[str],
+                            tty: bool = False) -> ExecStream:
+        """Interactive exec INSIDE the container (driver.proto:79 via
+        `docker exec -i[t]`)."""
+        task = self._get(task_id)
+        flags = ["-it" if tty else "-i"]
+        return ExecStream(
+            ["docker", "exec", *flags, _container_name(task.config)] + cmd,
+            cwd=task.config.alloc_dir or "/tmp", tty=tty,
+            env=self._build_env(task.config),
+        )
+
+    def task_stats(self, task_id: str) -> Dict:
+        """Container stats from the engine (drivers/docker stats
+        collection) -> the TaskStats shape the API serves."""
+        task = self._get(task_id)
+        out = subprocess.run(
+            ["docker", "stats", "--no-stream", "--format", "{{json .}}",
+             _container_name(task.config)],
+            capture_output=True, text=True, timeout=30,
+        )
+        stats: Dict = {"cpu": {}, "memory": {}}
+        if out.returncode != 0 or not out.stdout.strip():
+            return super().task_stats(task_id)
+        try:
+            row = json.loads(out.stdout.strip().splitlines()[0])
+        except json.JSONDecodeError:
+            return super().task_stats(task_id)
+        cpu = str(row.get("CPUPerc", "")).rstrip("%")
+        try:
+            stats["cpu"]["percent"] = float(cpu)
+        except ValueError:
+            pass
+        mem = str(row.get("MemUsage", "")).split("/")[0].strip()
+        stats["memory"]["rss"] = _parse_size(mem)
+        return stats
+
+
+_SIZE_UNITS = {"b": 1, "kb": 1000, "kib": 1024, "mb": 1000 ** 2,
+               "mib": 1024 ** 2, "gb": 1000 ** 3, "gib": 1024 ** 3}
+
+
+def _parse_size(text: str) -> int:
+    """'21.48MiB' -> bytes (docker stats human units)."""
+    import re
+
+    m = re.fullmatch(r"([\d.]+)\s*([A-Za-z]+)", text.strip())
+    if not m:
+        return 0
+    try:
+        value = float(m.group(1))
+    except ValueError:
+        return 0
+    return int(value * _SIZE_UNITS.get(m.group(2).lower(), 1))

@@ -525,13 +525,17 @@ class AllocReconciler:
                 d.status_description = "Deployment is running but requires manual promotion"
 
     def _compute_deployment_paused(self) -> None:
-        if self.deployment is None and self.job.multiregion \
+        if self.deployment is None \
+                and not getattr(self, "_version_deployed", False) \
+                and self.job.multiregion \
                 and self.job.multiregion_starts_blocked():
-            # a gated region's FIRST eval: there is no deployment row
-            # yet, but initial placements must still wait for the
-            # earlier region — treat as paused from the start (the
-            # blocked deployment row is created below so the unblock
-            # kick has something to release)
+            # a gated region's FIRST eval for this job version: there
+            # is no deployment row yet, but initial placements must
+            # wait for the earlier region — treat as paused from the
+            # start (the blocked deployment row is created below so
+            # the unblock kick has something to release). Once this
+            # version has a successful deployment here, replacement
+            # evals must NOT re-engage the gate.
             self.deployment_paused = True
             return
         if self.deployment is not None:
@@ -576,6 +580,9 @@ class AllocReconciler:
         elif d.status == consts.DEPLOYMENT_STATUS_SUCCESSFUL:
             self.old_deployment = d
             self.deployment = None
+            # this job version already rolled out here: the multiregion
+            # gate must not re-engage for replacement evals
+            self._version_deployed = True
 
     def _handle_stop(self, m: Dict[str, AllocSet]) -> None:
         for group, allocs in m.items():

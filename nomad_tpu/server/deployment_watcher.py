@@ -71,6 +71,17 @@ class _Watcher:
                     break
             except Exception as e:              # noqa: BLE001
                 LOG.warning("deployment %s watcher: %s", self.deployment_id, e)
+        # terminal: if this region's rollout succeeded (whether the
+        # watcher or the scheduler marked it — reconcile can too), the
+        # multiregion kick opens the next region's gate exactly once
+        try:
+            final = self.server.state.snapshot().deployment_by_id(
+                self.deployment_id)
+            if final is not None and final.is_multiregion and \
+                    final.status == consts.DEPLOYMENT_STATUS_SUCCESSFUL:
+                self._kick_next_regions(final)
+        except Exception as e:                  # noqa: BLE001
+            LOG.warning("multiregion kick: %s", e)
         self.parent._forget(self.deployment_id)
 
     def _tick(self, d, deadline: float, last_healthy: int, promoted: bool):
@@ -111,8 +122,8 @@ class _Watcher:
                     "description": "Deployment completed successfully",
                 },
             )
-            if d.is_multiregion:
-                self._kick_next_regions(d)
+            # the multiregion kick fires from the run loop's terminal
+            # check, which also covers scheduler-marked successes
             return True, last_healthy, promoted
 
         # progress: newly healthy allocs unblock the next rolling batch
@@ -142,7 +153,6 @@ class _Watcher:
         federation HTTP; the local region (single-region tests /
         same-server federations) unblocks directly."""
         import urllib.parse
-        import urllib.request
 
         snap = self.server.state.snapshot()
         job = snap.job_by_id(d.namespace, d.job_id)
@@ -169,12 +179,16 @@ class _Watcher:
                 time.sleep(0.5)
             return
         url_path = (f"/v1/job/{urllib.parse.quote(d.job_id, safe='')}"
-                    f"/deployment/unblock?region={target}"
-                    f"&namespace={d.namespace}")
+                    "/deployment/unblock")
         # retried with backoff: the kick races the target region's
         # scheduler creating its blocked row, and transient federation
         # errors must not leave the region gated forever (the operator
-        # escape hatch is the unblock endpoint/CLI)
+        # escape hatch is the unblock endpoint/CLI). APIClient carries
+        # the cluster TLS config, like ACL replication does.
+        from nomad_tpu.api.client import APIClient, APIError, QueryOptions
+
+        tls = getattr(self.server, "tls_api", None) or {}
+        token = getattr(self.server.config, "replication_token", "")
         delay = 0.5
         for attempt in range(6):
             addr = self.server.region_addr(target)
@@ -183,21 +197,16 @@ class _Watcher:
                             "unblock %s", target, d.job_id)
                 return
             try:
-                import json as _json
-
-                req = urllib.request.Request(
-                    addr + url_path, data=b"{}", method="POST")
-                token = getattr(self.server.config, "replication_token", "")
-                if token:
-                    req.add_header("X-Nomad-Token", token)
-                with urllib.request.urlopen(req, timeout=15) as resp:
-                    body = _json.loads(resp.read() or b"{}")
+                api = APIClient(addr, token=token, **tls)
+                body = api.post(
+                    url_path, {},
+                    QueryOptions(region=target, namespace=d.namespace))
                 if body.get("Unblocked"):
                     return
                 # nothing blocked there yet: the target's scheduler is
                 # still creating the row — retry
                 raise OSError("target region had no blocked deployment")
-            except OSError as e:
+            except (APIError, OSError) as e:
                 LOG.warning("multiregion: unblock kick to %s failed "
                             "(attempt %d): %s", target, attempt + 1, e)
                 time.sleep(delay)

@@ -416,31 +416,35 @@ class Server:
             # submitted to a server whose region isn't in the list:
             # still forward everywhere, answer with the first result
             local_result = next(iter(results.values()), {"eval_id": "",
-                                                         "index": 0})
+                                                         "index": 0,
+                                                         "warnings": []})
         out = dict(local_result)
+        out.setdefault("eval_id", "")
+        out.setdefault("index", 0)
+        out.setdefault("warnings", [])
         out["regions"] = sorted(results)
         return out
 
     def _remote_job_register(self, addr: str, job, region: str,
                              token: str = "") -> Dict:
-        import json as _json
-        import urllib.request
-
+        """Register a per-region copy on the target region's server,
+        through APIClient so the cluster's TLS config applies (same
+        path ACL replication uses). Returns the server-shape result."""
+        from nomad_tpu.api.client import APIClient, APIError, QueryOptions
         from nomad_tpu.api.codec import encode
 
-        payload = _json.dumps({"Job": encode(job)}).encode()
-        headers = {"Content-Type": "application/json"}
-        if token:
-            headers["X-Nomad-Token"] = token
-        req = urllib.request.Request(
-            f"{addr}/v1/jobs?region={region}", data=payload,
-            method="POST", headers=headers,
-        )
+        tls = getattr(self, "tls_api", None) or {}
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                return _json.loads(resp.read() or b"{}")
-        except OSError as e:
+            api = APIClient(addr, token=token, **tls)
+            resp = api.jobs.register(encode(job),
+                                     QueryOptions(region=region))
+        except (APIError, OSError) as e:
             raise ValueError(f"multiregion register in {region}: {e}")
+        return {
+            "eval_id": resp.get("EvalID", ""),
+            "index": resp.get("JobModifyIndex", 0),
+            "warnings": [resp["Warnings"]] if resp.get("Warnings") else [],
+        }
 
     def unblock_deployment(self, deployment_id: str) -> int:
         """Deployment.Unblock (the multiregion gate release): a blocked

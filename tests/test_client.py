@@ -445,3 +445,89 @@ class TestClientEndToEnd:
             c1.shutdown()
             c2.shutdown()
             server.shutdown()
+
+
+class TestLogmonSurvival:
+    def test_logs_written_while_agent_down_are_collected(self, tmp_path):
+        """logmon runs as its own process (logmon.go:46): output a task
+        writes while the agent is down still lands in the rotated
+        files, and the restarted agent reattaches to the SAME collector
+        instead of spawning a second one."""
+        server = Server(ServerConfig(num_workers=2, heartbeat_ttl=30.0))
+        server.start()
+        client = Client(
+            InProcessRPC(server),
+            ClientConfig(data_dir=str(tmp_path), persistent_state=True),
+        )
+        client.start()
+        try:
+            wait_for(
+                lambda: server.state.snapshot().node_by_id(client.node_id)
+                is not None
+                and server.state.snapshot().node_by_id(client.node_id).ready(),
+                msg="node ready",
+            )
+            job = mock.simple_job(type=consts.JOB_TYPE_BATCH)
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].driver = "raw_exec"
+            # slow ticker: emits one line per 0.3s for ~6s
+            job.task_groups[0].tasks[0].config = {
+                "command": "/bin/sh",
+                "args": ["-c",
+                         "for i in $(seq 1 20); do echo tick-$i; "
+                         "sleep 0.3; done"],
+            }
+            server.job_register(job)
+            wait_for(
+                lambda: any(
+                    a.client_status == consts.ALLOC_CLIENT_RUNNING
+                    for a in server.state.snapshot().allocs_by_job(
+                        job.namespace, job.id)
+                ),
+                timeout=30, msg="alloc running",
+            )
+            alloc = server.state.snapshot().allocs_by_job(
+                job.namespace, job.id)[0]
+            base = os.path.join(str(tmp_path), "allocs", alloc.id,
+                                "alloc", "logs", "web.stdout")
+            pid_path = base + ".logmon.pid"
+            wait_for(lambda: os.path.exists(pid_path),
+                     msg="collector pidfile")
+            collector_pid = int(open(pid_path).read())
+
+            # hard-stop the agent WITHOUT stopping tasks or collectors
+            client._shutdown.set()
+            for t in client._threads:
+                t.join(timeout=2)
+            client.state_db.close()
+
+            def logged():
+                from nomad_tpu.client.logmon import read_rotated
+                return read_rotated(base).decode(errors="replace")
+
+            # ticks keep landing while no agent exists
+            before = logged()
+            wait_for(lambda: logged() != before and "tick-" in logged(),
+                     timeout=10, msg="logs flowing while agent down")
+
+            # restarted agent reattaches to the same collector
+            client2 = Client(
+                InProcessRPC(server),
+                ClientConfig(data_dir=str(tmp_path), persistent_state=True),
+            )
+            client2.start()
+            wait_for(
+                lambda: any(
+                    a.client_status == consts.ALLOC_CLIENT_COMPLETE
+                    for a in server.state.snapshot().allocs_by_job(
+                        job.namespace, job.id)
+                ),
+                timeout=30, msg="task completes after restart",
+            )
+            assert int(open(pid_path).read()) == collector_pid \
+                if os.path.exists(pid_path) else True
+            final = logged()
+            assert "tick-1" in final and "tick-20" in final
+            client2.shutdown()
+        finally:
+            server.shutdown()

@@ -32,18 +32,25 @@ def _wait(fn, timeout=15.0):
 
 class TestLogMon:
     def test_collects_and_rotates(self, tmp_path):
+        import threading
+
+        from nomad_tpu.client.logmon import _Collector
+
         base = str(tmp_path / "web.stdout")
-        lm = LogMon(base, max_files=3, max_file_size_mb=1)
-        lm.max_bytes = 100   # tiny rotation threshold for the test
-        lm.start()
+        collector = _Collector(base, max_files=3, max_file_size_mb=1)
+        collector.max_bytes = 100   # tiny rotation threshold for the test
+        collector.open()
+        t = threading.Thread(target=collector.run, daemon=True)
+        t.start()
         try:
-            fd = os.open(lm.fifo_path, os.O_WRONLY)
+            fd = os.open(collector.fifo_path, os.O_WRONLY)
             for i in range(20):
                 os.write(fd, f"line-{i:04d} ".encode() * 4)
             os.close(fd)
             assert _wait(lambda: len(rotated_files(base)) >= 2)
         finally:
-            lm.stop()
+            collector.request_stop()
+            t.join(timeout=3)
         files = rotated_files(base)
         assert 2 <= len(files) <= 3          # pruned to max_files
         data = read_rotated(base)
@@ -179,25 +186,32 @@ class TestAllocWatcher:
 class TestLogMonResume:
     def test_resumes_at_highest_index(self, tmp_path):
         """Agent restart must not interleave new output into old
-        rotated files."""
+        rotated files (rotation logic lives in the collector)."""
+        import threading
+
+        from nomad_tpu.client.logmon import _Collector
+
         base = str(tmp_path / "t.stdout")
         with open(f"{base}.0", "wb") as f:
             f.write(b"x" * 200)
         with open(f"{base}.1", "wb") as f:
             f.write(b"y" * 200)
-        lm = LogMon(base, max_files=5, max_file_size_mb=1)
-        lm.max_bytes = 100
-        lm.start()
+        collector = _Collector(base, max_files=5, max_file_size_mb=1)
+        collector.max_bytes = 100
+        collector.open()
+        t = threading.Thread(target=collector.run, daemon=True)
+        t.start()
         try:
             # .1 is already over the threshold -> resumed at .2
-            assert lm._idx == 2
-            fd = os.open(lm.fifo_path, os.O_WRONLY)
+            assert collector._idx == 2
+            fd = os.open(collector.fifo_path, os.O_WRONLY)
             os.write(fd, b"fresh")
             os.close(fd)
             assert _wait(lambda: os.path.exists(f"{base}.2")
                          and b"fresh" in open(f"{base}.2", "rb").read())
         finally:
-            lm.stop()
+            collector.request_stop()
+            t.join(timeout=3)
         assert open(f"{base}.0", "rb").read() == b"x" * 200
         assert open(f"{base}.1", "rb").read() == b"y" * 200
 

@@ -1,0 +1,171 @@
+"""Docker driver depth, exercised against a FAKE docker CLI.
+
+The environment has no docker daemon; a PATH-injected stub records
+every invocation and simulates the engine, which lets the driver's
+operational surface (pull coordination, stats, streaming exec, stop/rm
+plumbing) run for real without one. Reference: drivers/docker/
+(driver.go, coordinator.go, stats.go).
+"""
+
+import json
+import os
+import stat
+import threading
+import time
+import uuid
+
+import pytest
+
+from nomad_tpu import structs
+from nomad_tpu.drivers.docker import DockerDriver, _parse_size
+from nomad_tpu.plugins.drivers import TaskConfig
+
+FAKE_DOCKER = r"""#!/bin/sh
+# env does not flow through the scrubbed task env: self-locate state
+HERE=$(dirname "$0")
+LOG="${FAKE_DOCKER_LOG:-$HERE/../invocations.log}"
+FAKE_DOCKER_STATE="${FAKE_DOCKER_STATE:-$HERE/../state}"
+echo "$@" >> "$LOG"
+cmd="$1"
+case "$cmd" in
+  version) echo "24.0.7"; exit 0 ;;
+  image)
+    # inspect: image exists only after a pull marker appears
+    img="$3"
+    if [ -f "$FAKE_DOCKER_STATE/pulled-$(echo "$img" | tr '/:' '__')" ]; then
+      exit 0
+    fi
+    exit 1 ;;
+  pull)
+    img="$2"
+    sleep "${FAKE_DOCKER_PULL_DELAY:-0.2}"
+    touch "$FAKE_DOCKER_STATE/pulled-$(echo "$img" | tr '/:' '__')"
+    exit 0 ;;
+  run) exec sleep 30 ;;
+  stats) echo '{"CPUPerc":"12.5%","MemUsage":"21.48MiB / 1GiB"}'; exit 0 ;;
+  exec)
+    shift
+    while [ "${1#-}" != "$1" ]; do shift; done   # drop -i/-it flags
+    shift                                        # container name
+    exec "$@" ;;
+  stop|rm) exit 0 ;;
+  *) exit 0 ;;
+esac
+"""
+
+
+@pytest.fixture()
+def fake_docker(tmp_path, monkeypatch):
+    bin_dir = tmp_path / "bin"
+    state = tmp_path / "state"
+    bin_dir.mkdir()
+    state.mkdir()
+    stub = bin_dir / "docker"
+    stub.write_text(FAKE_DOCKER)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / "invocations.log"
+    log.touch()
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_DOCKER_LOG", str(log))
+    monkeypatch.setenv("FAKE_DOCKER_STATE", str(state))
+    return log
+
+
+def _cfg(tmp_path, image="busybox:1.36", name="web"):
+    return TaskConfig(
+        id=f"{uuid.uuid4()}-{name}",
+        name=name,
+        alloc_id=str(uuid.uuid4()),
+        driver_config={"image": image},
+        resources=structs.Resources(cpu=200, memory_mb=128),
+        alloc_dir=str(tmp_path),
+    )
+
+
+def _calls(log, verb):
+    return [line for line in log.read_text().splitlines()
+            if line.startswith(verb + " ")]
+
+
+class TestDockerDriver:
+    def test_fingerprint_healthy_with_cli(self, fake_docker):
+        fp = DockerDriver().fingerprint()
+        assert fp.attributes.get("driver.docker.version") == "24.0.7"
+
+    def test_pull_coordination_single_pull(self, fake_docker, tmp_path,
+                                           monkeypatch):
+        """N concurrent tasks of one image trigger exactly ONE pull
+        (coordinator.go singleflight)."""
+        monkeypatch.setenv("FAKE_DOCKER_PULL_DELAY", "0.5")
+        driver = DockerDriver()
+        DockerDriver._pull_locks.clear()
+        errors = []
+
+        def start_one(i):
+            try:
+                driver._ensure_image("busybox:1.36")
+            except Exception as e:                 # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=start_one, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(_calls(fake_docker, "pull")) == 1
+        # already-present image: no further pulls
+        driver._ensure_image("busybox:1.36")
+        assert len(_calls(fake_docker, "pull")) == 1
+
+    def test_run_with_stats_and_stop(self, fake_docker, tmp_path):
+        driver = DockerDriver()
+        DockerDriver._pull_locks.clear()
+        cfg = _cfg(tmp_path)
+        driver.start_task(cfg)
+        try:
+            stats = driver.task_stats(cfg.id)
+            assert stats["cpu"]["percent"] == 12.5
+            assert stats["memory"]["rss"] == int(21.48 * 1024 * 1024)
+            run_calls = _calls(fake_docker, "run")
+            assert run_calls and "--memory 128m" in run_calls[0]
+            assert "--cpu-shares 200" in run_calls[0]
+        finally:
+            driver.stop_task(cfg.id, timeout=2)
+            driver.destroy_task(cfg.id, force=True)
+        assert _calls(fake_docker, "stop")
+        assert _calls(fake_docker, "rm")
+
+    def test_streaming_exec_enters_container(self, fake_docker, tmp_path):
+        driver = DockerDriver()
+        DockerDriver._pull_locks.clear()
+        cfg = _cfg(tmp_path)
+        driver.start_task(cfg)
+        try:
+            stream = driver.exec_task_streaming(cfg.id, ["cat"])
+            stream.write_stdin(b"through-docker-exec\n")
+            stream.close_stdin()
+            got = b""
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                item = stream.read_output(timeout=0.5)
+                if item is None:
+                    continue
+                name, data = item
+                if name == "exited":
+                    break
+                got += data
+            assert b"through-docker-exec" in got
+            assert any(line.startswith("exec -i ")
+                       for line in fake_docker.read_text().splitlines())
+        finally:
+            driver.stop_task(cfg.id, timeout=2)
+            driver.destroy_task(cfg.id, force=True)
+
+
+def test_parse_size_units():
+    assert _parse_size("21.48MiB") == int(21.48 * 1024 * 1024)
+    assert _parse_size("1.5GiB") == int(1.5 * 1024 ** 3)
+    assert _parse_size("512kB") == 512 * 1000
+    assert _parse_size("") == 0
