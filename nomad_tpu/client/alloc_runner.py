@@ -37,6 +37,7 @@ class AllocRunner:
         secrets=None,
         prev_lookup=None,
         device_plugins=None,
+        network_manager=None,
     ) -> None:
         self.alloc = alloc
         self.drivers = drivers
@@ -51,6 +52,9 @@ class AllocRunner:
         self.prev_lookup = prev_lookup
         # device plugins for Reserve (devicemanager; device.proto)
         self.device_plugins = device_plugins or []
+        # bridge networking (network_hook.go); None when unsupported
+        self.network_manager = network_manager
+        self.alloc_network = None
         # tasks whose services are currently registered
         self._registered_tasks: set = set()
         # volume name -> CSIMountInfo (csi_hook.go populates these for
@@ -109,6 +113,43 @@ class AllocRunner:
                         )
                     self._tasks_started = True
                     return
+        # bridge-network prerun hook (network_hook.go): a bridge-mode
+        # group gets its own netns + veth before any task starts; the
+        # scheduler's host ports relay to the alloc's namespace IP
+        netns_name = ""
+        net_env: Dict[str, str] = {}
+        wants_bridge = any(
+            getattr(n, "mode", "host") == "bridge" for n in tg.networks
+        )
+        if wants_bridge and self.network_manager is not None:
+            # one mapping per host port: group ports appear both in
+            # shared.ports and inside shared.networks
+            by_host: Dict[int, int] = {}
+            res = self.alloc.allocated_resources
+            if res is not None:
+                for p in res.shared.ports:
+                    by_host[p.value] = p.to or p.value
+                for net in res.shared.networks:
+                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                        by_host.setdefault(p.value, p.to or p.value)
+            mappings = sorted(by_host.items())
+            try:
+                self.alloc_network = self.network_manager.create(
+                    self.alloc.id, mappings)
+                netns_name = self.alloc_network.ns_name
+                net_env["NOMAD_ALLOC_IP"] = self.alloc_network.ip
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("alloc %s: bridge network setup failed: %s",
+                            self.alloc.id, e)
+                for task in tg.tasks:
+                    self._on_task_state(
+                        task.name, TaskState(state=STATE_DEAD, failed=True))
+                self._tasks_started = True
+                return
+        elif wants_bridge:
+            LOG.warning("alloc %s: bridge networking requested but "
+                        "unsupported on this client; tasks run in the "
+                        "host network", self.alloc.id)
         # mount paths surface to tasks as env (the reference bind-mounts
         # them into the task via VolumeMounts; env is this build's
         # equivalent until drivers gain mount plumbing)
@@ -125,6 +166,7 @@ class AllocRunner:
                 LOG.warning("alloc %s: no driver %s", self.alloc.id, task.driver)
                 continue
             task_env = dict(volume_env)
+            task_env.update(net_env)
             try:
                 task_env.update(self._reserve_devices(task.name))
             except Exception as e:              # noqa: BLE001
@@ -143,6 +185,7 @@ class AllocRunner:
                 restart_policy=tg.restart_policy,
                 extra_env=task_env,
                 secrets=self.secrets,
+                netns=netns_name,
             )
             self.task_runners[task.name] = tr
             tr.start()
@@ -566,6 +609,13 @@ class AllocRunner:
                 tr.driver.destroy_task(tr.task_id, force=True)
             except Exception:                   # noqa: BLE001
                 pass
+        # bridge-network postrun (network_hook.go Postrun)
+        if self.network_manager is not None and self.alloc_network is not None:
+            try:
+                self.network_manager.destroy(self.alloc.id)
+            except Exception:                   # noqa: BLE001
+                pass
+            self.alloc_network = None
         # CSI postrun: unpublish this alloc's mounts (csi_hook.go
         # Postrun); the server-side watcher releases the claim itself
         if self.csi_manager is not None:

@@ -223,6 +223,14 @@ class Client:
         self.csi_manager = CSIManager(
             rpc, self.csi_clients, self.node_id, self.config.data_dir
         ) if hasattr(rpc, "csi_claim") else None
+        # bridge-mode alloc networking (networking_bridge_linux.go);
+        # probed once, None on hosts without netns/veth privileges
+        from nomad_tpu.client.network_manager import (
+            BridgeNetworkManager, bridge_supported,
+        )
+
+        self.network_manager = BridgeNetworkManager() \
+            if bridge_supported() else None
         from nomad_tpu.client.servicereg import ServiceRegWrapper
 
         self.service_reg = ServiceRegWrapper(rpc, self.node) \
@@ -392,6 +400,7 @@ class Client:
             secrets=self.secrets,
             prev_lookup=self._prev_runner,
             device_plugins=self.device_plugins,
+            network_manager=self.network_manager,
         )
         with self._alloc_lock:
             self.allocs[alloc.id] = runner
@@ -464,6 +473,7 @@ class Client:
                 secrets=self.secrets,
                 prev_lookup=self._prev_runner,
                 device_plugins=self.device_plugins,
+                network_manager=self.network_manager,
             )
             with self._alloc_lock:
                 self.allocs[alloc.id] = runner

@@ -1,0 +1,261 @@
+"""Bridge-mode allocation networking: per-alloc netns + veth + ports.
+
+Reference behavior: client/allocrunner/networking_bridge_linux.go +
+network_hook.go — every bridge-mode allocation gets its own network
+namespace joined to a shared client bridge through a veth pair, so two
+allocations on one node can bind the SAME container port without
+conflict, and the scheduler's host-port assignments (NetworkIndex)
+map onto each alloc's namespace IP.
+
+Deviations from the reference, both documented:
+- the reference wires port maps with iptables DNAT via CNI; this
+  environment has no netfilter NAT, so host-port -> alloc-port
+  mappings run as a userspace TCP relay per mapping (same observable
+  contract: connect to the node's host port, reach the alloc's
+  container port)
+- DNS/config files are inherited from the host (no per-ns resolv.conf)
+
+Capability-gated: ``bridge_supported()`` probes netns/veth privileges
+once; clients without them skip the hook (the reference equally
+requires CNI plugins + root).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import socket
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+LOG = logging.getLogger(__name__)
+
+DEFAULT_BRIDGE = "nomadtpu0"
+DEFAULT_SUBNET_PREFIX = "172.26.64"     # /20 like the reference default
+GATEWAY_HOST = 1
+
+
+def _run(argv: List[str], timeout: float = 15.0) -> subprocess.CompletedProcess:
+    return subprocess.run(argv, capture_output=True, timeout=timeout)
+
+
+@functools.lru_cache(maxsize=1)
+def bridge_supported() -> bool:
+    """Can this host create netns + veth? (probe once)"""
+    ns = "nomadtpu-probe"
+    try:
+        if _run(["ip", "netns", "add", ns]).returncode != 0:
+            return False
+        ok = _run(["ip", "link", "add", "nomadtpu-pr0", "type", "veth",
+                   "peer", "name", "nomadtpu-pr1"]).returncode == 0
+        _run(["ip", "link", "del", "nomadtpu-pr0"])
+        return ok
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        try:
+            _run(["ip", "netns", "del", ns])
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+
+class _PortForward:
+    """Userspace host-port -> (alloc_ip, port) TCP relay (the DNAT
+    deviation). One listener thread; a pump thread pair per conn."""
+
+    def __init__(self, host_port: int, target_ip: str, target_port: int) -> None:
+        self.host_port = host_port
+        self.target = (target_ip, target_port)
+        self._stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", self.host_port))
+        self._listener.listen(16)
+        self._listener.settimeout(0.5)
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"portmap-{self.host_port}",
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(
+                target=self._relay, args=(conn,), daemon=True,
+            ).start()
+
+    def _relay(self, conn: socket.socket) -> None:
+        try:
+            upstream = socket.create_connection(self.target, timeout=10)
+        except OSError:
+            conn.close()
+            return
+
+        def pump(src, dst):
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        t = threading.Thread(target=pump, args=(conn, upstream), daemon=True)
+        t.start()
+        pump(upstream, conn)
+        t.join(timeout=2)
+        for s in (conn, upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class AllocNetwork:
+    """One allocation's namespace + relays (network_hook state)."""
+
+    def __init__(self, alloc_id: str, ns_name: str, ip: str,
+                 veth_host: str, forwards: List[_PortForward]) -> None:
+        self.alloc_id = alloc_id
+        self.ns_name = ns_name
+        self.ip = ip
+        self.veth_host = veth_host
+        self.forwards = forwards
+
+
+class BridgeNetworkManager:
+    """Client-wide bridge + per-alloc namespace lifecycle
+    (networking_bridge_linux.go bridgeNetworkConfigurator)."""
+
+    def __init__(self, bridge: str = DEFAULT_BRIDGE,
+                 subnet_prefix: str = DEFAULT_SUBNET_PREFIX) -> None:
+        self.bridge = bridge
+        self.subnet_prefix = subnet_prefix
+        self._lock = threading.Lock()
+        self._used_hosts: set = set()
+        self._allocs: Dict[str, AllocNetwork] = {}
+        self._bridge_ready = False
+
+    # -- bridge ----------------------------------------------------------
+
+    def _ensure_bridge(self) -> None:
+        if self._bridge_ready:
+            return
+        if _run(["ip", "link", "show", self.bridge]).returncode != 0:
+            out = _run(["ip", "link", "add", "name", self.bridge,
+                        "type", "bridge"])
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"bridge create: {out.stderr.decode(errors='replace')}")
+            _run(["ip", "addr", "add",
+                  f"{self.subnet_prefix}.{GATEWAY_HOST}/20",
+                  "dev", self.bridge])
+        _run(["ip", "link", "set", self.bridge, "up"])
+        self._bridge_ready = True
+
+    def _alloc_ip(self) -> str:
+        # hosts .2..254 in the third+fourth octet space; in-memory
+        # allocation is enough because namespaces die with their allocs
+        with self._lock:
+            for host in range(2, 255):
+                if host not in self._used_hosts:
+                    self._used_hosts.add(host)
+                    return f"{self.subnet_prefix}.{host}"
+        raise RuntimeError("bridge subnet exhausted")
+
+    # -- alloc lifecycle -------------------------------------------------
+
+    def create(self, alloc_id: str,
+               port_mappings: List[Tuple[int, int]]) -> AllocNetwork:
+        """netns + veth + relays. ``port_mappings`` is
+        [(host_port, container_port)] from the scheduler's assignment
+        (AllocatedSharedResources.ports)."""
+        self._ensure_bridge()
+        short = alloc_id.replace("-", "")[:10]
+        ns = f"nomad-{short}"
+        veth_h, veth_c = f"nv{short[:8]}h", f"nv{short[:8]}c"
+        ip = self._alloc_ip()
+
+        steps = [
+            ["ip", "netns", "add", ns],
+            ["ip", "link", "add", veth_h, "type", "veth",
+             "peer", "name", veth_c],
+            ["ip", "link", "set", veth_c, "netns", ns],
+            ["ip", "link", "set", veth_h, "master", self.bridge],
+            ["ip", "link", "set", veth_h, "up"],
+            ["ip", "netns", "exec", ns, "ip", "addr", "add",
+             f"{ip}/20", "dev", veth_c],
+            ["ip", "netns", "exec", ns, "ip", "link", "set", veth_c, "up"],
+            ["ip", "netns", "exec", ns, "ip", "link", "set", "lo", "up"],
+            ["ip", "netns", "exec", ns, "ip", "route", "add", "default",
+             "via", f"{self.subnet_prefix}.{GATEWAY_HOST}"],
+        ]
+        forwards: List[_PortForward] = []
+        try:
+            for argv in steps:
+                out = _run(argv)
+                if out.returncode != 0:
+                    raise RuntimeError(
+                        f"{' '.join(argv)}: "
+                        f"{out.stderr.decode(errors='replace').strip()}")
+            for host_port, container_port in port_mappings:
+                fwd = _PortForward(host_port, ip, container_port)
+                fwd.start()
+                forwards.append(fwd)
+        except Exception:
+            self._teardown(ns, veth_h, ip, forwards)
+            raise
+        net = AllocNetwork(alloc_id, ns, ip, veth_h, forwards)
+        with self._lock:
+            self._allocs[alloc_id] = net
+        return net
+
+    def destroy(self, alloc_id: str) -> None:
+        with self._lock:
+            net = self._allocs.pop(alloc_id, None)
+        if net is None:
+            return
+        self._teardown(net.ns_name, net.veth_host, net.ip, net.forwards)
+
+    def _teardown(self, ns: str, veth_h: str, ip: str,
+                  forwards: List[_PortForward]) -> None:
+        for fwd in forwards:
+            fwd.stop()
+        _run(["ip", "netns", "del", ns])
+        _run(["ip", "link", "del", veth_h])
+        try:
+            host = int(ip.rsplit(".", 1)[1])
+            with self._lock:
+                self._used_hosts.discard(host)
+        except (ValueError, IndexError):
+            pass
+
+    def network_of(self, alloc_id: str) -> Optional[AllocNetwork]:
+        with self._lock:
+            return self._allocs.get(alloc_id)

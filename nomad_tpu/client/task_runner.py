@@ -82,6 +82,7 @@ class TaskRunner:
         restart_policy: Optional[RestartPolicy] = None,
         extra_env: Optional[Dict[str, str]] = None,
         secrets=None,
+        netns: str = "",
     ) -> None:
         self.alloc = alloc
         self.task = task
@@ -91,6 +92,8 @@ class TaskRunner:
         self.state_db = state_db
         # alloc-level env contributions (e.g. CSI volume mount paths)
         self.extra_env = extra_env or {}
+        # bridge-mode network namespace the task must join (network_hook)
+        self.netns = netns
         # Vault/Consul data plane (vault_hook + template_hook sources)
         self.secrets = secrets
         self._vault_token = ""
@@ -535,6 +538,7 @@ class TaskRunner:
             std_out_path=out_path,
             std_err_path=err_path,
             alloc_dir=self.alloc_dir,
+            netns=self.netns,
         )
 
     def restore(self, task_state: TaskState, handle: Optional[TaskHandle]) -> bool:

@@ -121,6 +121,10 @@ class RawExecDriver(DriverPlugin):
         stdout = config.std_out_path or os.path.join(workdir, "stdout")
         stderr = config.std_err_path or os.path.join(workdir, "stderr")
         argv = self._command(config)
+        if config.netns:
+            # join the alloc's network namespace (network_hook.go);
+            # applies to executor and direct paths alike
+            argv = ["ip", "netns", "exec", config.netns] + argv
         env = self._build_env(config)
 
         exe = executor_path() if self.use_executor else None

@@ -62,6 +62,8 @@ class TaskConfig:
     std_out_path: str = ""
     std_err_path: str = ""
     alloc_dir: str = ""
+    # bridge-mode network namespace to join (networking_bridge_linux)
+    netns: str = ""
 
 
 @dataclass

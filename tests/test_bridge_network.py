@@ -1,0 +1,132 @@
+"""Bridge-mode allocation networking (networking_bridge_linux.go).
+
+Capability-gated like the reference (needs netns/veth privileges).
+The headline property: two allocations on ONE node bind the SAME
+container port without conflict, each reachable through its own
+scheduler-assigned host port.
+"""
+
+import socket
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.client.network_manager import (
+    BridgeNetworkManager,
+    bridge_supported,
+)
+
+pytestmark = pytest.mark.skipif(
+    not bridge_supported(), reason="host cannot create netns/veth")
+
+
+def wait_for(fn, timeout=30.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(0.1)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+class TestManager:
+    def test_create_destroy_roundtrip(self):
+        mgr = BridgeNetworkManager()
+        net = mgr.create("11112222-3333-4444-5555-666677778888", [])
+        try:
+            assert net.ip.startswith("172.26.")
+            assert mgr.network_of("11112222-3333-4444-5555-666677778888")
+        finally:
+            mgr.destroy("11112222-3333-4444-5555-666677778888")
+        assert mgr.network_of("11112222-3333-4444-5555-666677778888") is None
+
+
+class TestSameContainerPort:
+    def test_two_allocs_bind_same_container_port(self):
+        """Both allocs run a listener on container port 8080 inside
+        their own namespace; each is reached via its own host port."""
+        agent = Agent(AgentConfig.dev())
+        agent.start()
+        try:
+            api = APIClient(agent.http_addr)
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 2
+            # group-level bridge network with a dynamic port mapping to
+            # container port 8080 (the jobspec `port "http" { to = 8080 }`)
+            tg.networks = [structs.NetworkResource(
+                mode="bridge",
+                dynamic_ports=[structs.Port(label="http", to=8080)],
+            )]
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            # a tiny stdlib server inside the netns answering with the
+            # alloc id on container port 8080
+            task.config = {
+                "command": "/usr/local/bin/python3",
+                "args": ["-S", "-c", (
+                    "import os, socket\n"
+                    "s = socket.socket()\n"
+                    "s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+                    "s.bind((\"0.0.0.0\", 8080))\n"
+                    "s.listen(4)\n"
+                    "while True:\n"
+                    "    c, _ = s.accept()\n"
+                    "    c.sendall(os.environ[\"NOMAD_ALLOC_ID\"].encode())\n"
+                    "    c.close()\n"
+                )],
+            }
+            agent.server.job_register(job)
+            allocs = wait_for(
+                lambda: [a for a in api.jobs.allocations(job.id)
+                         if a["ClientStatus"] == "running"] or None,
+                msg="allocs running")
+            wait_for(lambda: len([
+                a for a in api.jobs.allocations(job.id)
+                if a["ClientStatus"] == "running"]) == 2,
+                msg="both allocs running")
+            allocs = [a for a in api.jobs.allocations(job.id)
+                      if a["ClientStatus"] == "running"]
+
+            def host_port(alloc_summary):
+                info = api.allocations.info(alloc_summary["ID"])
+                res = info.get("AllocatedResources") or {}
+                shared = res.get("Shared") or {}
+                ports = []
+                for net in shared.get("Networks") or []:
+                    ports += (net.get("DynamicPorts") or [])
+                for p in shared.get("Ports") or []:
+                    ports.append(p)
+                for p in ports:
+                    if p.get("Label") == "http":
+                        return p.get("Value")
+                return None
+
+            ports = {a["ID"]: host_port(a) for a in allocs}
+            assert all(ports.values()), ports
+            assert len(set(ports.values())) == 2, ports
+
+            def read_alloc_id(port):
+                deadline = time.time() + 20
+                last = None
+                while time.time() < deadline:
+                    try:
+                        c = socket.create_connection(
+                            ("127.0.0.1", port), timeout=3)
+                        data = c.recv(200).decode()
+                        c.close()
+                        if data:
+                            return data
+                    except OSError as e:
+                        last = e
+                    time.sleep(0.3)
+                raise AssertionError(f"no answer on host port {port}: {last}")
+
+            for alloc_id, port in ports.items():
+                assert read_alloc_id(port) == alloc_id
+        finally:
+            agent.shutdown()
