@@ -84,16 +84,21 @@ def _xorshift_fill(n: int, seed: int = 42):
     return used_cpu, used_mem
 
 
-def run_baseline() -> dict:
-    """Compile (once) and run the native sequential baseline."""
+def _baseline_bin() -> str:
     src = os.path.join(REPO, "bench", "baseline_binpack.cc")
     out = os.path.join(REPO, "bench", "baseline_binpack")
     if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
         subprocess.run(
             ["g++", "-O2", "-o", out, src], check=True, capture_output=True
         )
+    return out
+
+
+def run_baseline() -> dict:
+    """Compile (once) and run the native sequential baseline."""
     proc = subprocess.run(
-        [out, str(N_NODES), str(PLACEMENTS_PER_EVAL), str(BASELINE_EVALS)],
+        [_baseline_bin(), str(N_NODES), str(PLACEMENTS_PER_EVAL),
+         str(BASELINE_EVALS)],
         check=True, capture_output=True, text=True,
     )
     return json.loads(proc.stdout)
@@ -156,9 +161,20 @@ def run_tpu() -> dict:
     # lean variant: the baseline's asks are cpu/mem/disk binpack only,
     # so compile without port/device/core/spread/top-k planes (the same
     # static specialization the real stack infers per ask); topk=True
-    # engages the candidate-set kernel (exact, bound-checked)
-    loop = make_schedule_apply_loop(PLACEMENTS_PER_EVAL, LEAN_FEATURES,
-                                    topk=True)
+    # engages the candidate-set kernel (exact, bound-checked). On TPU
+    # the fused pallas candidate scan competes with the XLA scan; a
+    # short calibration burst picks the faster per machine.
+    backend = jax.default_backend()
+    candidates = [("xla_topk", make_schedule_apply_loop(
+        PLACEMENTS_PER_EVAL, LEAN_FEATURES, topk=True))]
+    if backend not in ("cpu",):
+        try:
+            candidates.append(("pallas_topk", make_schedule_apply_loop(
+                PLACEMENTS_PER_EVAL, LEAN_FEATURES, topk=True,
+                backend="pallas_topk")))
+        except Exception as e:                   # noqa: BLE001
+            print(f"warning: pallas backend unavailable: {e}",
+                  file=sys.stderr)
 
     npad = cluster.n_pad
     n_steps = jnp.asarray(np.full(BATCH, PLACEMENTS_PER_EVAL, np.int32))
@@ -179,6 +195,25 @@ def run_tpu() -> dict:
         rng.choice([128.0, 256.0, 512.0], (N_BATCHES, BATCH))
         .astype(np.float32))
 
+    # calibration: time a short burst per candidate loop, keep the best
+    cal_steps = min(20, N_BATCHES)
+    picked, best_cal, pick_err = None, float("inf"), None
+    for name, loop in candidates:
+        try:
+            dt, _ = time_batches(loop, shared, used_cpu, used_mem,
+                                 asks_cpu[:cal_steps], asks_mem[:cal_steps],
+                                 n_steps, reps=1)
+        except Exception as e:                   # noqa: BLE001
+            pick_err = e
+            print(f"warning: {name} loop failed calibration: {e}",
+                  file=sys.stderr)
+            continue
+        if dt < best_cal:
+            picked, best_cal = (name, loop), dt
+    if picked is None:
+        raise RuntimeError(f"no usable kernel backend: {pick_err}")
+    kernel_name, loop = picked
+
     best_dt, (score_sum, placed, invalid) = time_batches(
         loop, shared, used_cpu, used_mem, asks_cpu, asks_mem, n_steps)
 
@@ -187,7 +222,8 @@ def run_tpu() -> dict:
         "evals_per_sec": evals / best_dt,
         "mean_score": score_sum / max(placed, 1),
         "invalid": invalid,
-        "backend": jax.default_backend(),
+        "backend": backend,
+        "kernel": kernel_name,
     }
 
 
@@ -316,40 +352,259 @@ def run_e2e() -> dict:
         server.shutdown()
 
 
-def _device_preflight(timeout: float = 120.0) -> None:
+def _replay_planes(path: str):
+    """Load the C2M replay through the real state store and flatten it
+    to kernel planes + an ask stream drawn from the replay's job mix."""
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(REPO, "bench"))
+    import c2m
+    from nomad_tpu.tensors.schema import ClusterTensors
+
+    store = c2m.load(path)
+    snap = store.snapshot()
+    cluster = ClusterTensors.build(snap.nodes())
+    u = snap.usage
+    perm, valid = cluster.usage_perm(u)
+    used_cpu = np.where(valid, u.used_cpu[perm], 0.0).astype(np.float32)
+    used_mem = np.where(valid, u.used_mem[perm], 0.0).astype(np.float32)
+    used_disk = np.where(valid, u.used_disk[perm], 0.0).astype(np.float32)
+
+    # lean ask stream: the replay's service/batch shapes (device asks
+    # go through the full kernel in the live system, not this loop)
+    lean = [
+        (float(tg.tasks[0].resources.cpu),
+         float(tg.tasks[0].resources.memory_mb))
+        for j in snap.jobs() for tg in j.task_groups
+        if not any(t.resources.devices for t in tg.tasks)
+    ]
+    rng = np.random.default_rng(11)
+    arr = np.asarray(lean, np.float32)[
+        rng.integers(0, len(lean), N_BATCHES * BATCH)]
+    stats = {
+        "replay_nodes": cluster.n_real,
+        "replay_allocs": sum(
+            1 for a in snap.allocs_iter() if not a.terminal_status()),
+        "replay_jobs": len(snap.jobs()),
+    }
+    return cluster, used_cpu, used_mem, used_disk, arr, stats
+
+
+def _write_planes_file(cluster, used_cpu, used_mem, used_disk,
+                       asks, evals: int, k: int) -> str:
+    """Export the replay planes for the native baseline (--planes)."""
+    import struct as pystruct
+    import tempfile
+
+    import numpy as np
+
+    n = cluster.n_real
+    fd, path = tempfile.mkstemp(suffix=".c2mp")
+    with os.fdopen(fd, "wb") as f:
+        f.write(b"C2MP")
+        f.write(pystruct.pack("<iii", n, evals, k))
+        for plane in (cluster.cap_cpu, cluster.cap_mem, cluster.cap_disk,
+                      used_cpu, used_mem, used_disk):
+            f.write(np.asarray(plane[:n], "<f4").tobytes())
+        f.write(np.asarray(asks[:evals, 0], "<f4").tobytes())
+        f.write(np.asarray(asks[:evals, 1], "<f4").tobytes())
+        f.write(np.full(evals, 150.0, "<f4").tobytes())
+    return path
+
+
+def run_replay(path: str) -> dict:
+    """The C2M replay headline: fused loop vs native baseline on the
+    SAME persisted cluster planes and the SAME ask stream."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nomad_tpu.ops.kernel import LEAN_FEATURES, build_kernel_in
+    from nomad_tpu.parallel.batching import (
+        device_put_shared,
+        make_schedule_apply_loop,
+    )
+    from nomad_tpu.parallel.synthetic import synthetic_eval
+
+    cluster, used_cpu, used_mem, used_disk, asks, stats = \
+        _replay_planes(path)
+
+    # native baseline on the identical planes + ask prefix
+    planes_file = _write_planes_file(
+        cluster, used_cpu, used_mem, used_disk, asks,
+        BASELINE_EVALS, PLACEMENTS_PER_EVAL)
+    try:
+        proc = subprocess.run(
+            [_baseline_bin(), "--planes", planes_file],
+            check=True, capture_output=True, text=True)
+        baseline = json.loads(proc.stdout)
+    finally:
+        os.unlink(planes_file)
+
+    ev0 = synthetic_eval(cluster, desired_count=PLACEMENTS_PER_EVAL)
+    shared = build_kernel_in(cluster, ev0, PLACEMENTS_PER_EVAL)
+    shared = device_put_shared(shared._replace(
+        used_disk=used_disk,
+        ask_disk=np.asarray(150.0, np.float32),
+    ))
+
+    # reset_every=1: every batch schedules against the PERSISTED replay
+    # utilization (the baseline's own 200-eval reset cadence), so the
+    # burst measures eval throughput on the replay state rather than a
+    # saturating cluster, and mean scores are comparable
+    backend = jax.default_backend()
+    candidates = [("xla_topk", make_schedule_apply_loop(
+        PLACEMENTS_PER_EVAL, LEAN_FEATURES, topk=True, reset_every=1))]
+    if backend not in ("cpu",):
+        try:
+            candidates.append(("pallas_topk", make_schedule_apply_loop(
+                PLACEMENTS_PER_EVAL, LEAN_FEATURES, topk=True,
+                backend="pallas_topk", reset_every=1)))
+        except Exception as e:                   # noqa: BLE001
+            print(f"warning: pallas backend unavailable: {e}",
+                  file=sys.stderr)
+
+    n_steps = jnp.asarray(
+        np.full(BATCH, PLACEMENTS_PER_EVAL, np.int32))
+    asks_cpu = jnp.asarray(asks[:, 0].reshape(N_BATCHES, BATCH))
+    asks_mem = jnp.asarray(asks[:, 1].reshape(N_BATCHES, BATCH))
+
+    cal = min(20, N_BATCHES)
+    picked, best_cal = None, float("inf")
+    for name, loop in candidates:
+        try:
+            dt, _ = time_batches(loop, shared, used_cpu, used_mem,
+                                 asks_cpu[:cal], asks_mem[:cal],
+                                 n_steps, reps=1)
+        except Exception as e:                   # noqa: BLE001
+            print(f"warning: {name} failed replay calibration: {e}",
+                  file=sys.stderr)
+            continue
+        if dt < best_cal:
+            picked, best_cal = (name, loop), dt
+    if picked is None:
+        raise RuntimeError("no usable kernel backend for replay")
+    kernel_name, loop = picked
+
+    best_dt, (score_sum, placed, invalid) = time_batches(
+        loop, shared, used_cpu, used_mem, asks_cpu, asks_mem, n_steps)
+    evals = BATCH * N_BATCHES
+    return {
+        "evals_per_sec": evals / best_dt,
+        "vs_baseline": evals / best_dt / baseline["evals_per_sec"],
+        "baseline_evals_per_sec": baseline["evals_per_sec"],
+        "baseline_mean_score": baseline["mean_score"],
+        "mean_score": score_sum / max(placed, 1),
+        "invalid": invalid,
+        "backend": backend,
+        "kernel": kernel_name,
+        **stats,
+    }
+
+
+def _device_preflight(probe_timeout: float = 120.0,
+                      total_budget: float = None) -> None:
     """Probe the default JAX backend in a SUBPROCESS; if it hangs or
-    fails (shared tunnel devices wedge), pin this process to CPU before
-    any jax use so the bench degrades instead of hanging forever."""
+    fails (shared tunnel devices wedge), retry with backoff for several
+    minutes — a wedged transport often recovers — and only then pin
+    this process to CPU so the bench degrades instead of hanging
+    forever. The capture's JSON line carries the surviving backend
+    name, so a CPU fallback can never masquerade as a TPU number."""
+    if total_budget is None:
+        total_budget = float(os.environ.get(
+            "NOMAD_TPU_PREFLIGHT_BUDGET", "420"))
     probe = (
         "import jax, jax.numpy as jnp; print(float(jnp.zeros(1).sum()))"
     )
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", probe],
-            capture_output=True, timeout=timeout,
-        )
-        if out.returncode == 0:
-            return
-    except subprocess.TimeoutExpired:
-        pass
-    print("warning: default JAX backend unresponsive; falling back to CPU",
-          file=sys.stderr)
+    deadline = time.monotonic() + total_budget
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                timeout=min(probe_timeout, max(deadline - time.monotonic(), 10.0)),
+            )
+            if out.returncode == 0:
+                return
+            detail = out.stderr.decode(errors="replace")[-200:]
+        except subprocess.TimeoutExpired:
+            detail = "probe timed out"
+        if time.monotonic() >= deadline:
+            break
+        print(f"warning: backend probe attempt {attempt} failed "
+              f"({detail}); retrying", file=sys.stderr)
+        time.sleep(min(15.0, 2.0 * attempt))
+    print("warning: default JAX backend unresponsive after "
+          f"{attempt} attempts; falling back to CPU", file=sys.stderr)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replay", nargs="?", const="", default=None,
+                    help="C2M replay snapshot path (default: generate/"
+                         "cache bench/c2m_replay.snap)")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="skip the replay; bench the synthetic cluster only")
+    args = ap.parse_args()
+
     _device_preflight()
     baseline = run_baseline()
     tpu = run_tpu()
     parity = run_score_parity()
     e2e = run_e2e()
-    line = {
-        "metric": "scheduler evals/sec (10k nodes, 10 placements/eval, binpack)",
-        "value": round(tpu["evals_per_sec"], 2),
-        "unit": "evals/s",
-        "vs_baseline": round(tpu["evals_per_sec"] / baseline["evals_per_sec"], 2),
+
+    replay = None
+    if not args.synthetic:
+        sys.path.insert(0, os.path.join(REPO, "bench"))
+        import c2m
+
+        replay_path = args.replay or c2m.DEFAULT_PATH
+        try:
+            replay = run_replay(replay_path)
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: replay bench failed ({e}); "
+                  "reporting synthetic only", file=sys.stderr)
+
+    if replay is not None:
+        # headline: the C2M replay (BASELINE.md's metric definition —
+        # heterogeneous persisted cluster through the real state store)
+        line = {
+            "metric": ("scheduler evals/sec (C2M replay: 10k heterogeneous "
+                       "nodes / 100k allocs, 10 placements/eval, binpack)"),
+            "value": round(replay["evals_per_sec"], 2),
+            "unit": "evals/s",
+            "backend": replay["backend"],
+            "kernel": replay["kernel"],
+            "vs_baseline": round(replay["vs_baseline"], 2),
+            "replay_nodes": replay["replay_nodes"],
+            "replay_allocs": replay["replay_allocs"],
+            "replay_jobs": replay["replay_jobs"],
+            "replay_invalid": replay["invalid"],
+            "synthetic_evals_per_sec": round(tpu["evals_per_sec"], 2),
+            "synthetic_vs_baseline": round(
+                tpu["evals_per_sec"] / baseline["evals_per_sec"], 2),
+        }
+    else:
+        line = {
+            "metric": ("scheduler evals/sec (10k nodes, 10 placements/eval, "
+                       "binpack)"),
+            "value": round(tpu["evals_per_sec"], 2),
+            "unit": "evals/s",
+            "backend": tpu["backend"],
+            "kernel": tpu["kernel"],
+            "vs_baseline": round(
+                tpu["evals_per_sec"] / baseline["evals_per_sec"], 2),
+        }
+    line.update({
         "score_tpu_sequential": round(parity["mean_score"], 6),
         "score_baseline": round(baseline["mean_score"], 6),
         "score_parity": round(
@@ -361,7 +616,7 @@ def main() -> None:
         "plan_latency_p99_ms": round(e2e["plan_latency_p99_ms"], 3),
         "e2e_kernel_waves": e2e["kernel_waves"],
         "e2e_kernel_requests": e2e["kernel_requests"],
-    }
+    })
     print(json.dumps(line))
 
 

@@ -15,13 +15,22 @@
 //                                      (scheduler/rank.go proposed-alloc flow)
 //
 // Usage: baseline_binpack <n_nodes> <placements_per_eval> <n_evals> [seed]
+//        baseline_binpack --planes <file> [seed]
 // Prints: {"evals_per_sec": X, "mean_score": Y}
+//
+// --planes runs the identical sequential loop against an
+// operator-supplied cluster (the C2M replay: bench/c2m.py persists the
+// state-store snapshot; bench.py exports the planes). Binary layout,
+// all little-endian: "C2MP", i32 n, i32 evals, i32 k, then f32[n]
+// cap_cpu, cap_mem, cap_disk, used_cpu, used_mem, used_disk, then
+// f32[evals] ask_cpu, ask_mem, ask_disk.
 
 #include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <vector>
 
 struct Node {
@@ -36,24 +45,65 @@ static inline uint64_t xorshift(uint64_t &s) {
   return s;
 }
 
-int main(int argc, char **argv) {
-  int n = argc > 1 ? atoi(argv[1]) : 10000;
-  int k = argc > 2 ? atoi(argv[2]) : 10;
-  int evals = argc > 3 ? atoi(argv[3]) : 2000;
-  uint64_t seed = argc > 4 ? strtoull(argv[4], nullptr, 10) : 42;
+static bool read_f32(FILE *f, float *dst, size_t cnt) {
+  return fread(dst, sizeof(float), cnt, f) == cnt;
+}
 
-  // mock.Node defaults net of reserved (4000-100 MHz, 8192-256 MB,
-  // (100-4) GB), preloaded to a C2M-style partially packed cluster
-  std::vector<Node> base(n);
-  for (int i = 0; i < n; i++) {
-    base[i].cap_cpu = 3900.0f;
-    base[i].cap_mem = 7936.0f;
-    base[i].cap_disk = 98304.0f;
-    double r1 = (double)(xorshift(seed) % 1000) / 1000.0;
-    double r2 = (double)(xorshift(seed) % 1000) / 1000.0;
-    base[i].used_cpu = (float)(base[i].cap_cpu * 0.6 * r1);
-    base[i].used_mem = (float)(base[i].cap_mem * 0.6 * r2);
-    base[i].used_disk = 150.0f;
+int main(int argc, char **argv) {
+  int n, k, evals;
+  uint64_t seed = 42;
+  std::vector<Node> base;
+  std::vector<float> ask_cpu_v, ask_mem_v, ask_disk_v;
+  bool planes_mode = argc > 2 && strcmp(argv[1], "--planes") == 0;
+
+  if (planes_mode) {
+    if (argc > 3) seed = strtoull(argv[3], nullptr, 10);
+    FILE *f = fopen(argv[2], "rb");
+    if (!f) { fprintf(stderr, "open %s failed\n", argv[2]); return 2; }
+    char magic[4];
+    int32_t hdr[3];
+    if (fread(magic, 1, 4, f) != 4 || memcmp(magic, "C2MP", 4) != 0 ||
+        fread(hdr, sizeof(int32_t), 3, f) != 3) {
+      fprintf(stderr, "bad planes header\n");
+      return 2;
+    }
+    n = hdr[0]; evals = hdr[1]; k = hdr[2];
+    base.resize(n);
+    std::vector<float> tmp(n);
+    float Node::*fields[6] = {&Node::cap_cpu, &Node::cap_mem,
+                              &Node::cap_disk, &Node::used_cpu,
+                              &Node::used_mem, &Node::used_disk};
+    for (auto fld : fields) {
+      if (!read_f32(f, tmp.data(), n)) { fprintf(stderr, "short planes\n"); return 2; }
+      for (int i = 0; i < n; i++) base[i].*fld = tmp[i];
+    }
+    ask_cpu_v.resize(evals); ask_mem_v.resize(evals); ask_disk_v.resize(evals);
+    if (!read_f32(f, ask_cpu_v.data(), evals) ||
+        !read_f32(f, ask_mem_v.data(), evals) ||
+        !read_f32(f, ask_disk_v.data(), evals)) {
+      fprintf(stderr, "short asks\n");
+      return 2;
+    }
+    fclose(f);
+  } else {
+    n = argc > 1 ? atoi(argv[1]) : 10000;
+    k = argc > 2 ? atoi(argv[2]) : 10;
+    evals = argc > 3 ? atoi(argv[3]) : 2000;
+    seed = argc > 4 ? strtoull(argv[4], nullptr, 10) : 42;
+
+    // mock.Node defaults net of reserved (4000-100 MHz, 8192-256 MB,
+    // (100-4) GB), preloaded to a C2M-style partially packed cluster
+    base.resize(n);
+    for (int i = 0; i < n; i++) {
+      base[i].cap_cpu = 3900.0f;
+      base[i].cap_mem = 7936.0f;
+      base[i].cap_disk = 98304.0f;
+      double r1 = (double)(xorshift(seed) % 1000) / 1000.0;
+      double r2 = (double)(xorshift(seed) % 1000) / 1000.0;
+      base[i].used_cpu = (float)(base[i].cap_cpu * 0.6 * r1);
+      base[i].used_mem = (float)(base[i].cap_mem * 0.6 * r2);
+      base[i].used_disk = 150.0f;
+    }
   }
 
   const float ask_cpu = 500.0f, ask_mem = 256.0f, ask_disk = 150.0f;
@@ -73,6 +123,9 @@ int main(int argc, char **argv) {
     // prior evals persist, like the applied plans in the Go bench);
     // reset utilization periodically so the cluster never saturates
     if (e % 200 == 0) nodes = base;
+    float a_cpu = planes_mode ? ask_cpu_v[e] : ask_cpu;
+    float a_mem = planes_mode ? ask_mem_v[e] : ask_mem;
+    float a_disk = planes_mode ? ask_disk_v[e] : ask_disk;
 
     // shuffleNodes (util.go:464): Fisher-Yates over the full node list
     for (int i = n - 1; i > 0; i--) {
@@ -89,13 +142,13 @@ int main(int argc, char **argv) {
       for (int oi = 0; oi < n && visited_feasible < limit; oi++) {
         Node &nd = nodes[order[oi]];
         // feasibility chain (AllocsFit funcs.go:166)
-        if (nd.used_cpu + ask_cpu > nd.cap_cpu) continue;
-        if (nd.used_mem + ask_mem > nd.cap_mem) continue;
-        if (nd.used_disk + ask_disk > nd.cap_disk) continue;
+        if (nd.used_cpu + a_cpu > nd.cap_cpu) continue;
+        if (nd.used_mem + a_mem > nd.cap_mem) continue;
+        if (nd.used_disk + a_disk > nd.cap_disk) continue;
         visited_feasible++;
         // ScoreFitBinPack (funcs.go:235,259)
-        float free_cpu = 1.0f - (nd.used_cpu + ask_cpu) / nd.cap_cpu;
-        float free_mem = 1.0f - (nd.used_mem + ask_mem) / nd.cap_mem;
+        float free_cpu = 1.0f - (nd.used_cpu + a_cpu) / nd.cap_cpu;
+        float free_mem = 1.0f - (nd.used_mem + a_mem) / nd.cap_mem;
         float total = powf(10.0f, free_cpu) + powf(10.0f, free_mem);
         float score = 20.0f - total;
         if (score > 18.0f) score = 18.0f;
@@ -107,9 +160,9 @@ int main(int argc, char **argv) {
         }
       }
       if (best >= 0) {
-        nodes[best].used_cpu += ask_cpu;
-        nodes[best].used_mem += ask_mem;
-        nodes[best].used_disk += ask_disk;
+        nodes[best].used_cpu += a_cpu;
+        nodes[best].used_mem += a_mem;
+        nodes[best].used_disk += a_disk;
         score_sum += best_score;
         placed++;
       }

@@ -1,0 +1,260 @@
+"""C2M-style replay cluster: generate + persist a realistic 10k-node /
+100k-alloc state, the analog of the reference's real-cluster replay
+bench (scheduler/benchmarks/benchmarks_test.go:16-24, which loads a
+raft snapshot via NOMAD_BENCHMARK_SNAPSHOT and benches the scheduler
+against it).
+
+The generated cluster is deliberately heterogeneous, shaped like the
+C2M write-ups describe (mixed instance classes, many DCs/racks, a mix
+of service/batch workloads with constraints, spreads, and device asks):
+
+- node classes: standard (4 core/8G), large (16 core/32G), compute
+  (32 core/64G), gpu (16 core/64G + 4 nvidia/gpu devices), spread over
+  10 datacenters and ~64 racks (``platform.aws.placement.rack`` attr).
+- jobs: service jobs (counts 5..50) with kernel constraints, some with
+  rack/dc spread stanzas and distinct_hosts; batch jobs (counts
+  10..100); a slice of gpu service jobs asking for devices.
+- allocations: placed feasibly (capacity-checked deduction against
+  each node's resources) until the target count is live; alloc rows
+  carry real AllocatedResources so the store's UsageIndex planes
+  reproduce the exact utilization the scheduler would see.
+
+Persisted with the state store's own snapshot codec
+(``StateStore.to_snapshot_bytes``), restored through
+``restore_from_bytes`` — the same path an operator snapshot restore
+takes, so the replay bench exercises the real state layer.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_PATH = os.path.join(REPO, "bench", "c2m_replay.snap")
+
+N_NODES = 10_000
+N_ALLOCS = 100_000
+SEED = 20260730
+
+NODE_CLASSES = (
+    # (share, cpu_shares, cores, mem_mb, disk_mb, gpus)
+    ("standard", 0.60, 4_000, 4, 8_192, 100 * 1024, 0),
+    ("large", 0.25, 16_000, 16, 32_768, 200 * 1024, 0),
+    ("compute", 0.10, 32_000, 32, 65_536, 400 * 1024, 0),
+    ("gpu", 0.05, 16_000, 16, 65_536, 400 * 1024, 4),
+)
+
+# (share, cpu, mem, count_range, kind)
+JOB_SHAPES = (
+    (0.35, 250, 128, (5, 20), "service"),
+    (0.25, 500, 256, (5, 30), "service"),
+    (0.15, 1_000, 1_024, (3, 15), "service-spread"),
+    (0.15, 500, 512, (10, 60), "batch"),
+    (0.07, 2_000, 4_096, (2, 8), "service-distinct"),
+    (0.03, 4_000, 8_192, (1, 4), "gpu"),
+)
+
+
+def _make_node(rng, i: int, cls) -> "structs.Node":
+    from nomad_tpu import mock, structs
+
+    name, _share, cpu, cores, mem, disk, gpus = cls
+    dc = f"dc{int(rng.integers(1, 11))}"
+    rack = f"r{int(rng.integers(0, 64))}"
+    n = mock.node(
+        name=f"c2m-{name}-{i}",
+        datacenter=dc,
+        node_class=name,
+    )
+    n.attributes = dict(n.attributes)
+    n.attributes["platform.aws.placement.rack"] = rack
+    n.attributes["cpu.numcores"] = str(cores)
+    n.node_resources = structs.NodeResources(
+        cpu=structs.NodeCpuResources(
+            cpu_shares=cpu, total_core_count=cores,
+            reservable_cpu_cores=list(range(cores)),
+        ),
+        memory=structs.NodeMemoryResources(memory_mb=mem),
+        disk=structs.NodeDiskResources(disk_mb=disk),
+        networks=[structs.NetworkResource(
+            device="eth0", cidr=f"10.{i >> 16}.{(i >> 8) & 255}.{i & 255}/32",
+            ip=f"10.{i >> 16}.{(i >> 8) & 255}.{i & 255}", mbits=10_000,
+        )],
+    )
+    if gpus:
+        n.node_resources.devices = [structs.NodeDeviceResource(
+            vendor="nvidia", type="gpu", name="A100",
+            instance_ids=[f"gpu-{i}-{g}" for g in range(gpus)],
+        )]
+    n.compute_class()
+    return n
+
+
+def _make_job(rng, i: int, shape) -> "structs.Job":
+    from nomad_tpu import mock, structs
+    from nomad_tpu.structs import consts
+
+    _share, cpu, mem, count_range, kind = shape
+    count = int(rng.integers(count_range[0], count_range[1] + 1))
+    job = mock.simple_job(id=f"c2m-{kind}-{i}")
+    job.datacenters = [f"dc{d}" for d in range(1, 11)]
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources = structs.Resources(cpu=cpu, memory_mb=mem)
+    if kind == "batch":
+        job.type = consts.JOB_TYPE_BATCH
+        job.priority = int(rng.integers(20, 60))
+    elif kind == "service-spread":
+        attr = ("${node.datacenter}" if rng.random() < 0.5
+                else "${attr.platform.aws.placement.rack}")
+        tg.spreads = [structs.Spread(attribute=attr, weight=50)]
+    elif kind == "service-distinct":
+        tg.constraints = list(tg.constraints) + [
+            structs.Constraint(operand=consts.CONSTRAINT_DISTINCT_HOSTS)]
+    elif kind == "gpu":
+        job.constraints = list(job.constraints) + [structs.Constraint(
+            ltarget="${node.class}", rtarget="gpu", operand="=")]
+        tg.tasks[0].resources.devices = [
+            structs.RequestedDevice(name="nvidia/gpu", count=1)]
+    return job
+
+
+def generate(path: str = DEFAULT_PATH, n_nodes: int = N_NODES,
+             n_allocs: int = N_ALLOCS, seed: int = SEED,
+             verbose: bool = True) -> str:
+    """Build and persist the replay cluster; returns the path."""
+    from nomad_tpu import structs
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import consts
+
+    t0 = time.time()
+    rng = np.random.default_rng(seed)
+    store = StateStore()
+
+    # -- nodes ----------------------------------------------------------
+    shares = np.array([c[1] for c in NODE_CLASSES])
+    cls_idx = rng.choice(len(NODE_CLASSES), n_nodes, p=shares / shares.sum())
+    nodes = [_make_node(rng, i, NODE_CLASSES[cls_idx[i]])
+             for i in range(n_nodes)]
+    for n in nodes:
+        store.upsert_node(n)
+
+    # free capacity tracker for feasible alloc placement
+    free_cpu = np.array([n.node_resources.cpu.cpu_shares
+                         - n.reserved_resources.cpu_shares
+                         for n in nodes], np.float64)
+    free_mem = np.array([n.node_resources.memory.memory_mb
+                         - n.reserved_resources.memory_mb
+                         for n in nodes], np.float64)
+    gpu_free = np.array([sum(len(d.instance_ids)
+                             for d in n.node_resources.devices)
+                         for n in nodes], np.float64)
+    is_gpu = gpu_free > 0
+
+    # -- jobs + allocations --------------------------------------------
+    jshares = np.array([s[0] for s in JOB_SHAPES])
+    jobs, allocs = [], []
+    ji = 0
+    no_fit_streak = 0
+    while len(allocs) < n_allocs:
+        shape = JOB_SHAPES[int(rng.choice(len(JOB_SHAPES),
+                                          p=jshares / jshares.sum()))]
+        job = _make_job(rng, ji, shape)
+        ji += 1
+        tg = job.task_groups[0]
+        cpu = float(tg.tasks[0].resources.cpu)
+        mem = float(tg.tasks[0].resources.memory_mb)
+        needs_gpu = bool(tg.tasks[0].resources.devices)
+        fits = (free_cpu >= cpu) & (free_mem >= mem)
+        if needs_gpu:
+            fits &= gpu_free >= 1
+        rows = np.nonzero(fits)[0]
+        if rows.size == 0:
+            # cluster saturated for this shape; if NO shape has fit for
+            # a while, stop at whatever count the capacity allowed
+            no_fit_streak += 1
+            if no_fit_streak >= 10 * len(JOB_SHAPES):
+                print(f"c2m: capacity exhausted at {len(allocs)} allocs "
+                      f"(wanted {n_allocs})", file=sys.stderr)
+                break
+            continue
+        no_fit_streak = 0
+        take = min(tg.count, rows.size, n_allocs - len(allocs))
+        # binpack-flavored placement: prefer fuller nodes with noise so
+        # utilization spreads realistically instead of packing rank 0
+        # (distinct_hosts is satisfied inherently: `rows` are unique)
+        util = 1.0 - free_cpu[rows] / np.maximum(free_cpu[rows].max(), 1.0)
+        pick = rows[np.argsort(-(util + rng.random(rows.size)))[:take]]
+        job.status = consts.JOB_STATUS_RUNNING
+        jobs.append(job)
+        store.upsert_job(job)
+        for slot, row in enumerate(pick):
+            node = nodes[row]
+            free_cpu[row] -= cpu
+            free_mem[row] -= mem
+            tr = structs.AllocatedTaskResources(
+                cpu=structs.AllocatedCpuResources(cpu_shares=int(cpu)),
+                memory=structs.AllocatedMemoryResources(memory_mb=int(mem)),
+            )
+            if needs_gpu:
+                gpu_free[row] -= 1
+                dev = node.node_resources.devices[0]
+                tr.devices = [structs.AllocatedDeviceResource(
+                    vendor="nvidia", type="gpu", name=dev.name,
+                    device_ids=[dev.instance_ids[int(gpu_free[row])]],
+                )]
+            allocs.append(structs.Allocation(
+                id=f"c2m-a-{len(allocs)}",
+                eval_id=f"c2m-e-{ji}",
+                node_id=node.id,
+                namespace=job.namespace,
+                job_id=job.id,
+                job=job,
+                task_group=tg.name,
+                name=f"{job.id}.{tg.name}[{slot}]",
+                desired_status=consts.ALLOC_DESIRED_RUN,
+                client_status=consts.ALLOC_CLIENT_RUNNING,
+                allocated_resources=structs.AllocatedResources(
+                    tasks={tg.tasks[0].name: tr},
+                    shared=structs.AllocatedSharedResources(
+                        disk_mb=tg.ephemeral_disk.size_mb),
+                ),
+            ))
+    BULK = 5_000
+    for i in range(0, len(allocs), BULK):
+        store.upsert_allocs(allocs[i:i + BULK])
+
+    data = store.to_snapshot_bytes()
+    with open(path, "wb") as f:
+        f.write(data)
+    if verbose:
+        print(f"c2m replay: {n_nodes} nodes / {len(allocs)} allocs / "
+              f"{len(jobs)} jobs -> {path} "
+              f"({len(data) / 1e6:.0f} MB, {time.time() - t0:.1f}s)",
+              file=sys.stderr)
+    return path
+
+
+def load(path: str = DEFAULT_PATH, generate_if_missing: bool = True):
+    """Restore the replay state through the real state store."""
+    from nomad_tpu.state.store import StateStore
+
+    if not os.path.exists(path):
+        if not generate_if_missing:
+            raise FileNotFoundError(path)
+        generate(path)
+    store = StateStore()
+    with open(path, "rb") as f:
+        store.restore_from_bytes(f.read())
+    return store
+
+
+if __name__ == "__main__":
+    generate(sys.argv[1] if len(sys.argv) > 1 else DEFAULT_PATH)

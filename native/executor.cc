@@ -259,6 +259,10 @@ int main(int argc, char **argv) {
         mount("proc", "/proc", "proc", 0, nullptr);
       }
     }
+    // stdout/stderr live outside the chroot (logmon FIFOs under the
+    // alloc dir); open them BEFORE chroot(2) — open fds survive it
+    int out = open(stdout_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    int err = open(stderr_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
     if (!chroot_dir.empty()) {
       if (chroot(chroot_dir.c_str()) != 0) _exit(125);
       if (chdir("/") != 0) _exit(125);
@@ -266,8 +270,6 @@ int main(int argc, char **argv) {
     if (!cwd.empty() && chroot_dir.empty()) {
       if (chdir(cwd.c_str()) != 0) _exit(126);
     }
-    int out = open(stdout_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
-    int err = open(stderr_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
     if (out >= 0) dup2(out, STDOUT_FILENO);
     if (err >= 0) dup2(err, STDERR_FILENO);
     std::vector<char *> args;

@@ -569,9 +569,12 @@ class HTTPAgent:
         add("GET", r"/v1/job/(?P<id>[^/]+)/evaluations", self.job_evals)
         add("GET", r"/v1/job/(?P<id>[^/]+)/deployments", self.job_deployments)
         add("GET", r"/v1/job/(?P<id>[^/]+)/deployment", self.job_latest_deployment)
-        # multiregion gate release (Deployment.Unblock analog)
+        # multiregion gate release + failure propagation
+        # (Deployment.Unblock / Deployment.Fail analogs, by job)
         add("POST", r"/v1/job/(?P<id>[^/]+)/deployment/unblock",
             self.job_deployment_unblock)
+        add("POST", r"/v1/job/(?P<id>[^/]+)/deployment/fail",
+            self.job_deployment_fail)
         add("GET", r"/v1/job/(?P<id>[^/]+)/summary", self.job_summary)
         add("GET", r"/v1/job/(?P<id>[^/]+)/versions", self.job_versions)
         add("POST", r"/v1/job/(?P<id>[^/]+)/revert", self.job_revert)
@@ -856,6 +859,16 @@ class HTTPAgent:
         index, unblocked = self._server.unblock_job_deployment(
             req.namespace, req.params["id"])
         return {"Index": index, "Unblocked": unblocked}
+
+    def job_deployment_fail(self, req: Request):
+        """Multiregion failure propagation: an earlier/peer region
+        failed and the job's on_failure strategy fails this one too."""
+        self._acl(req, "allow_ns_op", req.namespace, "submit-job")
+        index, failed = self._server.fail_job_deployment(
+            req.namespace, req.params["id"],
+            "Failed because of an unsuccessful deployment in a "
+            "federated region")
+        return {"Index": index, "Failed": failed}
 
     def job_summary(self, req: Request):
         self._block(req, ["allocs"])

@@ -173,6 +173,14 @@ def fetch_artifact(artifact: Dict, task_dir: str,
         if not os.path.exists(src_path):
             raise ArtifactError(f"artifact source not found: {src_path}")
         if os.path.isdir(src_path):
+            if checksum:
+                # a declared checksum cannot be verified against a
+                # directory tree; hard-error like the git-source path
+                # rather than silently skipping verification
+                raise ArtifactError(
+                    "checksum verification is not supported for "
+                    f"directory sources: {src_path}"
+                )
             shutil.copytree(src_path, dest_dir, dirs_exist_ok=True)
             return dest_dir
         shutil.copy2(src_path, fetched)

@@ -226,6 +226,256 @@ def pallas_place_batch(cap_cpu, cap_mem, cap_disk,
     )
 
 
+# ---------------------------------------------------------------------------
+# Fused candidate-set scan: the hybrid hot path.
+#
+# The XLA candidate-set kernel (ops/kernel.place_taskgroup_topk) is one
+# full-width scoring pass + approx_max_k + a K-wide deduction scan. The
+# scan is tiny compute ([B, ~32] tensors) but unrolls to ~30 XLA ops per
+# placement step — per-op overhead dominates it. This kernel keeps the
+# full-width pass + approx_max_k in XLA (one fused elementwise pass over
+# [B, N] + the TPU-optimized selection) and runs the ENTIRE deduction
+# scan as one pallas program: candidate planes live in VMEM registers,
+# each step is pure VPU work on a (TB, 128) tile, and the bound check
+# (place_taskgroup_topk's `valid`) is tracked in-register. Exactness is
+# inherited from the same rest-max bound: when `valid` is False the
+# caller re-runs the full-width kernel.
+# ---------------------------------------------------------------------------
+
+C_LANES = 128           # candidate axis, one lane row
+_SCAL_LANES = 8         # per-eval scalars packed into lanes of one row
+
+
+def _cand_scan_kernel(scal, cap_cpu, cap_mem, cap_disk,
+                      used_cpu, used_mem, used_disk,
+                      base, jobtg, penalty, aff, node_id,
+                      chosen_ref, score_ref, found_ref, valid_ref,
+                      *, k_steps: int, tb: int):
+    cols = jax.lax.broadcasted_iota(jnp.int32, (tb, C_LANES), 1)
+
+    def lane(j):
+        return jnp.sum(jnp.where(cols == j, scal[:], 0.0), axis=1,
+                       keepdims=True)
+
+    a_cpu = lane(0)
+    a_mem = lane(1)
+    a_disk = lane(2)
+    algo_spread = lane(3)
+    n_steps = lane(4)
+    desired = lane(5)
+    rest_max = lane(6)
+
+    cc = cap_cpu[:]
+    cm = cap_mem[:]
+    cd = cap_disk[:]
+    base_m = base[:] > 0.0
+    pen = penalty[:] > 0.0
+    affs = aff[:]
+    nid = node_id[:]
+
+    denom = jnp.maximum(desired, 1.0)
+    aff_on = affs != 0.0
+    pen_f = jnp.where(pen, -1.0, 0.0)
+    extra_planes = pen.astype(jnp.float32) + aff_on.astype(jnp.float32)
+    aff_sum = jnp.where(aff_on, affs, 0.0) + pen_f
+
+    def body(i, carry):
+        uc, um, ud, utg, ch, sc, fo, ok = carry
+        feas = (
+            base_m
+            & ((cc - uc) >= a_cpu)
+            & ((cm - um) >= a_mem)
+            & ((cd - ud) >= a_disk)
+        )
+        fc = jnp.where(cc > 0, 1.0 - (uc + a_cpu) / cc, 0.0)
+        fm = jnp.where(cm > 0, 1.0 - (um + a_mem) / cm, 0.0)
+        total = jnp.power(10.0, fc) + jnp.power(10.0, fm)
+        binpack = jnp.clip(20.0 - total, 0.0, 18.0)
+        spreadfit = jnp.clip(total - 2.0, 0.0, 18.0)
+        fit = jnp.where(algo_spread > 0, spreadfit, binpack) / 18.0
+
+        coll = utg
+        anti_on = coll > 0
+        ssum = fit + jnp.where(anti_on, -(coll + 1.0) / denom, 0.0) + aff_sum
+        nplanes = 1.0 + anti_on.astype(jnp.float32) + extra_planes
+        final = ssum / nplanes
+
+        active = i.astype(jnp.float32) < n_steps            # [TB, 1]
+        masked = jnp.where(feas & active, final, NEG_INF)
+        rowmax = jnp.max(masked, axis=1, keepdims=True)      # [TB, 1]
+        # first-max lane (argmax parity with the XLA candidate order)
+        at_max = masked == rowmax
+        lane_idx = jnp.min(
+            jnp.where(at_max, cols, jnp.int32(2 ** 30)), axis=1,
+            keepdims=True)
+        fnd = rowmax > NEG_INF / 2
+        # chosen NODE id: duplicate candidate rows of one node share
+        # deductions (preferred-pin duplicates in the XLA path)
+        chosen_id = jnp.sum(
+            jnp.where(cols == lane_idx, nid, 0.0), axis=1, keepdims=True)
+        share = (nid == chosen_id) & fnd & (active > 0)
+        upd = share.astype(jnp.float32)
+        uc = uc + upd * a_cpu
+        um = um + upd * a_mem
+        ud = ud + upd * a_disk
+        utg = utg + upd
+        # bound check: best candidate must still beat the rest of the
+        # cluster (place_taskgroup_topk's ok accumulation)
+        ok = ok & ((active <= 0) | ~fnd | (rowmax >= rest_max))
+
+        at_i = cols == i
+        placed = fnd & (active > 0)
+        ch = jnp.where(at_i, jnp.where(placed, chosen_id, -1.0), ch)
+        sc = jnp.where(at_i, jnp.where(placed, rowmax, 0.0), sc)
+        fo = jnp.where(at_i, placed.astype(jnp.float32), fo)
+        return uc, um, ud, utg, ch, sc, fo, ok
+
+    init = (
+        used_cpu[:], used_mem[:], used_disk[:], jobtg[:],
+        jnp.full((tb, C_LANES), -1.0, jnp.float32),
+        jnp.zeros((tb, C_LANES), jnp.float32),
+        jnp.zeros((tb, C_LANES), jnp.float32),
+        jnp.ones((tb, 1), bool),
+    )
+    _, _, _, _, ch, sc, fo, ok = jax.lax.fori_loop(0, k_steps, body, init)
+
+    # a missing placement while the rest of the cluster might still fit
+    # also invalidates the run (candidates exhausted, full kernel could
+    # place) — place_taskgroup_topk's `missing` check
+    want = (cols < k_steps) & (cols.astype(jnp.float32) < n_steps)
+    missing = jnp.any(want & (fo <= 0.0), axis=1, keepdims=True)
+    rest_bad = rest_max <= NEG_INF / 2
+    valid = ok & (~missing | rest_bad)
+
+    chosen_ref[:] = ch.astype(jnp.int32)
+    score_ref[:] = sc
+    found_ref[:] = (fo > 0.0).astype(jnp.int32)
+    valid_ref[:] = jnp.broadcast_to(
+        valid.astype(jnp.int32), (tb, C_LANES))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k_steps", "k_cand", "interpret"),
+)
+def pallas_topk_place_batch(cap_cpu, cap_mem, cap_disk,
+                            used_cpu, used_mem, used_disk,
+                            base_mask, job_tg_count, penalty, aff_score,
+                            ask_cpu, ask_mem, ask_disk,
+                            n_steps, desired_count, algorithm_spread,
+                            k_steps: int, k_cand: int = 64,
+                            interpret: bool = False):
+    """Candidate-set placement for a batch of B lean evals, pallas scan.
+
+    Shared planes are f32/bool[N] (the wave's common snapshot); asks are
+    per-eval [B]. Returns (chosen i32[B,K] node rows, scores f32[B,K],
+    found bool[B,K], valid bool[B]) — `valid=False` members must re-run
+    via the full-width kernel, exactly like place_taskgroup_topk.
+    """
+    n = cap_cpu.shape[0]
+    real_b = ask_cpu.shape[0]
+    k_cand = min(k_cand, n, C_LANES)
+    assert 0 < k_steps <= C_LANES
+
+    f32 = lambda x: jnp.asarray(x, jnp.float32)          # noqa: E731
+    bcast = lambda x: jnp.broadcast_to(jnp.asarray(x), (real_b,))  # noqa: E731
+    cc, cm, cd = f32(cap_cpu), f32(cap_mem), f32(cap_disk)
+    uc, um, ud = f32(used_cpu), f32(used_mem), f32(used_disk)
+    base = jnp.asarray(base_mask, bool)
+    utg = f32(job_tg_count)
+    pen = jnp.asarray(penalty, bool)
+    aff = f32(aff_score)
+    a_cpu = f32(ask_cpu)[:, None]
+    a_mem = f32(ask_mem)[:, None]
+    a_disk = f32(bcast(ask_disk))[:, None]
+    algo = f32(bcast(algorithm_spread))[:, None]
+    desired = f32(bcast(desired_count))[:, None]
+
+    # ---- full-width pass (XLA fuses this into one HBM sweep) ----
+    feas = (
+        base[None, :]
+        & ((cc - uc)[None, :] >= a_cpu)
+        & ((cm - um)[None, :] >= a_mem)
+        & ((cd - ud)[None, :] >= a_disk)
+    )
+    fc = jnp.where(cc[None, :] > 0, 1.0 - (uc[None, :] + a_cpu) / cc[None, :], 0.0)
+    fm = jnp.where(cm[None, :] > 0, 1.0 - (um[None, :] + a_mem) / cm[None, :], 0.0)
+    total = jnp.power(10.0, fc) + jnp.power(10.0, fm)
+    binpack = jnp.clip(20.0 - total, 0.0, 18.0)
+    spreadfit = jnp.clip(total - 2.0, 0.0, 18.0)
+    fit = jnp.where(algo > 0, spreadfit, binpack) / 18.0
+    coll = utg[None, :]
+    anti_on = coll > 0
+    pen_f = jnp.where(pen, -1.0, 0.0)[None, :]
+    aff_on = (aff != 0.0)[None, :]
+    ssum = (fit + jnp.where(anti_on, -(coll + 1.0) / jnp.maximum(desired, 1.0),
+                            0.0)
+            + jnp.where(aff_on, aff[None, :], 0.0) + pen_f)
+    nplanes = (1.0 + anti_on.astype(jnp.float32) + aff_on.astype(jnp.float32)
+               + pen.astype(jnp.float32)[None, :])
+    final0 = ssum / nplanes
+    masked0 = jnp.where(feas, final0, NEG_INF)           # [B, N]
+
+    _, cand_idx = jax.lax.approx_max_k(masked0, k_cand, recall_target=0.95)
+    rows = jnp.arange(real_b)[:, None]
+    rest_max = jnp.max(masked0.at[rows, cand_idx].set(NEG_INF), axis=1)
+
+    # ---- gather candidate planes, pad to the lane width ----
+    pad_c = C_LANES - k_cand
+
+    def gpad(x, fill):
+        g = x[cand_idx].astype(jnp.float32)              # [B, k_cand]
+        return jnp.pad(g, ((0, 0), (0, pad_c)), constant_values=fill)
+
+    planes = [
+        gpad(cc, 0.0), gpad(cm, 0.0), gpad(cd, 0.0),
+        gpad(uc, 0.0), gpad(um, 0.0), gpad(ud, 0.0),
+        gpad(base, 0.0),                                  # pad infeasible
+        gpad(utg, 0.0), gpad(pen, 0.0), gpad(aff, 0.0),
+        jnp.pad(cand_idx.astype(jnp.float32), ((0, 0), (0, pad_c)),
+                constant_values=-1.0),                    # node ids
+    ]
+
+    scal = jnp.zeros((real_b, _SCAL_LANES), jnp.float32)
+    scal = scal.at[:, 0].set(a_cpu[:, 0])
+    scal = scal.at[:, 1].set(a_mem[:, 0])
+    scal = scal.at[:, 2].set(a_disk[:, 0])
+    scal = scal.at[:, 3].set(algo[:, 0])
+    scal = scal.at[:, 4].set(jnp.asarray(n_steps, jnp.float32))
+    scal = scal.at[:, 5].set(desired[:, 0])
+    scal = scal.at[:, 6].set(rest_max)
+    scal = jnp.pad(scal, ((0, 0), (0, C_LANES - _SCAL_LANES)))
+
+    tb = 8
+    b_pad = (-real_b) % tb
+    if b_pad:
+        planes = [jnp.pad(p, ((0, b_pad), (0, 0))) for p in planes]
+        scal = jnp.pad(scal, ((0, b_pad), (0, 0)))       # n_steps=0 pad
+    B = real_b + b_pad
+
+    blk = pl.BlockSpec((tb, C_LANES), lambda i: (i, 0),
+                       memory_space=pltpu.VMEM)
+    chosen, scores, found, valid = pl.pallas_call(
+        functools.partial(_cand_scan_kernel, k_steps=k_steps, tb=tb),
+        grid=(B // tb,),
+        in_specs=[blk] * 12,
+        out_specs=[blk] * 4,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, C_LANES), jnp.int32),
+            jax.ShapeDtypeStruct((B, C_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, C_LANES), jnp.int32),
+            jax.ShapeDtypeStruct((B, C_LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(scal, *planes)
+    return (
+        chosen[:real_b, :k_steps],
+        scores[:real_b, :k_steps],
+        found[:real_b, :k_steps] > 0,
+        valid[:real_b, 0] > 0,
+    )
+
+
 def make_schedule_apply_step_pallas(k_steps: int, interpret: bool = False):
     """Drop-in replacement for batching.make_schedule_apply_step's lean
     variant: same signature, same optimistic-batch + scatter-commit

@@ -61,7 +61,7 @@ def make_schedule_apply_step(k_steps: int, features: KernelFeatures = FULL_FEATU
 
         out = jax.vmap(run_one)(ask_cpu, ask_mem, n_steps)
         used_cpu2, used_mem2 = commit_placements(
-            used_cpu, used_mem, out, ask_cpu, ask_mem)
+            used_cpu, used_mem, out.chosen, out.found, ask_cpu, ask_mem)
         return out, used_cpu2, used_mem2
 
     return jax.jit(step, donate_argnums=(1, 2))
@@ -70,7 +70,10 @@ def make_schedule_apply_step(k_steps: int, features: KernelFeatures = FULL_FEATU
 @functools.lru_cache(maxsize=32)
 def make_schedule_apply_loop(k_steps: int,
                              features: KernelFeatures = FULL_FEATURES,
-                             topk: bool = False):
+                             topk: bool = False,
+                             backend: str = "xla",
+                             interpret: bool = False,
+                             reset_every: int = 0):
     """Multi-batch fused loop: T batches of B evals in ONE device call.
 
     ``lax.scan`` over the batch axis keeps the utilization planes in
@@ -79,11 +82,88 @@ def make_schedule_apply_loop(k_steps: int,
     transport, per-dispatch round trips otherwise dominate and measure
     the link instead of the scheduler (the round-1 grid pathology).
 
+    ``backend``: "xla" uses the vmapped XLA kernels (full-width, or
+    candidate-set when ``topk``); "pallas_topk" uses the fused pallas
+    candidate scan (ops/pallas_kernel.pallas_topk_place_batch) — the
+    full-width pass and approx_max_k stay XLA, the K-step deduction
+    scan runs as one pallas program instead of ~30 XLA ops per step.
+
+    ``reset_every``: restore the INITIAL utilization planes every that
+    many batches (0 = never) — the native baseline's periodic reset
+    (bench/baseline_binpack.cc), so a long measurement burst schedules
+    against the persisted cluster state instead of saturating it.
+
     Returns fn(shared, used_cpu, used_mem, ask_cpu[T,B], ask_mem[T,B],
     n_steps[B]) -> (score_sum, placed, invalid, used_cpu', used_mem').
     ``invalid`` counts evals whose candidate-set bound broke (always 0
     without ``topk``); the caller reschedules those via the full path.
     """
+    def with_reset(one_batch):
+        if not reset_every:
+            return lambda carry, asks, uc0, um0: one_batch(
+                carry[:2], asks)
+
+        def wrapped(carry, asks, uc0, um0):
+            uc, um, t = carry
+            hit = (t % reset_every) == 0
+            uc = jnp.where(hit, uc0, uc)
+            um = jnp.where(hit, um0, um)
+            (uc2, um2), stats = one_batch((uc, um), asks)
+            return (uc2, um2, t + 1), stats
+
+        return wrapped
+
+    def scan_loop(one_batch, used_cpu, used_mem, ask_cpu, ask_mem):
+        body = with_reset(one_batch)
+        if reset_every:
+            # reset needs the pristine planes as scan constants; the
+            # carry planes are donated working copies
+            uc0 = used_cpu + 0.0
+            um0 = used_mem + 0.0
+            init = (used_cpu, used_mem, jnp.asarray(0, jnp.int32))
+            (uc, um, _), stats = jax.lax.scan(
+                lambda c, a: body(c, a, uc0, um0),
+                init, (ask_cpu, ask_mem))
+        else:
+            (uc, um), stats = jax.lax.scan(
+                lambda c, a: body(c, a, None, None),
+                (used_cpu, used_mem), (ask_cpu, ask_mem))
+        scores, placed, invalid = stats
+        return (jnp.sum(scores), jnp.sum(placed), jnp.sum(invalid),
+                uc, um)
+
+    if backend == "pallas_topk":
+        from nomad_tpu.ops.pallas_kernel import pallas_topk_place_batch
+
+        def loop(shared: KernelIn, used_cpu, used_mem,
+                 ask_cpu, ask_mem, n_steps):
+            def one_batch(carry, asks):
+                uc, um = carry
+                a_cpu, a_mem = asks
+                chosen, scores, found, valid = pallas_topk_place_batch(
+                    shared.cap_cpu, shared.cap_mem, shared.cap_disk,
+                    uc, um, shared.used_disk,
+                    shared.base_mask, shared.job_tg_count,
+                    shared.penalty, shared.aff_score,
+                    a_cpu, a_mem, shared.ask_disk,
+                    n_steps, shared.desired_count,
+                    shared.algorithm_spread,
+                    k_steps=k_steps, interpret=interpret,
+                )
+                found = found & valid[:, None]
+                uc2, um2 = commit_placements(
+                    uc, um, chosen, found, a_cpu, a_mem)
+                stats = (
+                    jnp.sum(jnp.where(found, scores, 0.0)),
+                    jnp.sum(found),
+                    jnp.sum(~valid),
+                )
+                return (uc2, um2), stats
+
+            return scan_loop(one_batch, used_cpu, used_mem,
+                             ask_cpu, ask_mem)
+
+        return jax.jit(loop, donate_argnums=(1, 2))
 
     def loop(shared: KernelIn, used_cpu, used_mem, ask_cpu, ask_mem, n_steps):
         def one_batch(carry, asks):
@@ -104,33 +184,31 @@ def make_schedule_apply_loop(k_steps: int,
             # invalid evals (bound breach) are fully excluded: their
             # placements neither commit nor count — the caller re-runs
             # them via the full-width path
-            out = out._replace(found=out.found & ok[:, None])
-            uc2, um2 = commit_placements(uc, um, out, a_cpu, a_mem)
+            found = out.found & ok[:, None]
+            uc2, um2 = commit_placements(
+                uc, um, out.chosen, found, a_cpu, a_mem)
             stats = (
-                jnp.sum(jnp.where(out.found, out.scores, 0.0)),
-                jnp.sum(out.found),
+                jnp.sum(jnp.where(found, out.scores, 0.0)),
+                jnp.sum(found),
                 jnp.sum(~ok),
             )
             return (uc2, um2), stats
 
-        (uc, um), (scores, placed, invalid) = jax.lax.scan(
-            one_batch, (used_cpu, used_mem), (ask_cpu, ask_mem))
-        return (jnp.sum(scores), jnp.sum(placed), jnp.sum(invalid),
-                uc, um)
+        return scan_loop(one_batch, used_cpu, used_mem, ask_cpu, ask_mem)
 
     return jax.jit(loop, donate_argnums=(1, 2))
 
 
-def commit_placements(used_cpu, used_mem, out, ask_cpu, ask_mem):
+def commit_placements(used_cpu, used_mem, chosen, found, ask_cpu, ask_mem):
     """The plan applier's state update as on-device algebra
     (nomad/plan_apply.go:209): scatter every accepted placement's ask
     into the cluster utilization planes. Shared by the XLA and pallas
-    step builders."""
-    rows = out.chosen.reshape(-1)                       # i32[B*K]
-    ok = out.found.reshape(-1)
-    w_cpu = (jnp.broadcast_to(ask_cpu[:, None], out.chosen.shape)
+    step builders. ``chosen`` i32[B,K] node rows, ``found`` bool[B,K]."""
+    rows = chosen.reshape(-1)                           # i32[B*K]
+    ok = found.reshape(-1)
+    w_cpu = (jnp.broadcast_to(ask_cpu[:, None], chosen.shape)
              .reshape(-1) * ok)
-    w_mem = (jnp.broadcast_to(ask_mem[:, None], out.chosen.shape)
+    w_mem = (jnp.broadcast_to(ask_mem[:, None], chosen.shape)
              .reshape(-1) * ok)
     safe = jnp.where(ok, rows, 0)
     used_cpu2 = used_cpu.at[safe].add(jnp.where(ok, w_cpu, 0.0))

@@ -71,17 +71,9 @@ class _Watcher:
                     break
             except Exception as e:              # noqa: BLE001
                 LOG.warning("deployment %s watcher: %s", self.deployment_id, e)
-        # terminal: if this region's rollout succeeded (whether the
-        # watcher or the scheduler marked it — reconcile can too), the
-        # multiregion kick opens the next region's gate exactly once
-        try:
-            final = self.server.state.snapshot().deployment_by_id(
-                self.deployment_id)
-            if final is not None and final.is_multiregion and \
-                    final.status == consts.DEPLOYMENT_STATUS_SUCCESSFUL:
-                self._kick_next_regions(final)
-        except Exception as e:                  # noqa: BLE001
-            LOG.warning("multiregion kick: %s", e)
+        # terminal multiregion transitions (success kick / failure
+        # propagation) are handled by the parent's state-derived scan,
+        # which also re-derives pending kicks after a leader restart
         self.parent._forget(self.deployment_id)
 
     def _tick(self, d, deadline: float, last_healthy: int, promoted: bool):
@@ -145,73 +137,6 @@ class _Watcher:
             status=consts.EVAL_STATUS_PENDING,
         )
 
-    def _kick_next_regions(self, d) -> None:
-        """Multiregion rollout: this region succeeded, so unblock the
-        region max_parallel positions later in the order (with
-        max_parallel=m, regions 0..m-1 start running and each success
-        admits one more). Remote regions are kicked over the
-        federation HTTP; the local region (single-region tests /
-        same-server federations) unblocks directly."""
-        import urllib.parse
-
-        snap = self.server.state.snapshot()
-        job = snap.job_by_id(d.namespace, d.job_id)
-        if job is None or not job.multiregion:
-            return
-        mp = job.multiregion_max_parallel()
-        if mp <= 0:
-            return
-        idx = job.multiregion_region_index()
-        regions = job.multiregion_regions()
-        nxt = idx + mp
-        if idx < 0 or nxt >= len(regions):
-            return
-        target = str(regions[nxt].get("name", ""))
-        if not target:
-            return
-        if target == self.server.config.region:
-            # local target may not have its blocked row yet; retry
-            for _ in range(10):
-                _, unblocked = self.server.unblock_job_deployment(
-                    d.namespace, d.job_id)
-                if unblocked:
-                    return
-                time.sleep(0.5)
-            return
-        url_path = (f"/v1/job/{urllib.parse.quote(d.job_id, safe='')}"
-                    "/deployment/unblock")
-        # retried with backoff: the kick races the target region's
-        # scheduler creating its blocked row, and transient federation
-        # errors must not leave the region gated forever (the operator
-        # escape hatch is the unblock endpoint/CLI). APIClient carries
-        # the cluster TLS config, like ACL replication does.
-        from nomad_tpu.api.client import APIClient, APIError, QueryOptions
-
-        tls = getattr(self.server, "tls_api", None) or {}
-        token = getattr(self.server.config, "replication_token", "")
-        delay = 0.5
-        for attempt in range(6):
-            addr = self.server.region_addr(target)
-            if addr is None:
-                LOG.warning("multiregion: no path to region %s to "
-                            "unblock %s", target, d.job_id)
-                return
-            try:
-                api = APIClient(addr, token=token, **tls)
-                body = api.post(
-                    url_path, {},
-                    QueryOptions(region=target, namespace=d.namespace))
-                if body.get("Unblocked"):
-                    return
-                # nothing blocked there yet: the target's scheduler is
-                # still creating the row — retry
-                raise OSError("target region had no blocked deployment")
-            except (APIError, OSError) as e:
-                LOG.warning("multiregion: unblock kick to %s failed "
-                            "(attempt %d): %s", target, attempt + 1, e)
-                time.sleep(delay)
-                delay = min(delay * 2, 8.0)
-
     def _fail(self, d, reason: str) -> None:
         LOG.info("deployment %s failed: %s", d.id, reason)
         auto_revert = any(s.auto_revert for s in d.task_groups.values())
@@ -262,6 +187,13 @@ class DeploymentsWatcher:
         self._health_seen: Dict[str, Dict[str, bool]] = {}
         self._enabled = False
         self._thread: Optional[threading.Thread] = None
+        # multiregion terminal-transition work, derived from the
+        # deployments table (NOT from watcher lifecycles): survives
+        # leader restarts and retry exhaustion. deployment id ->
+        # (next_attempt_monotonic, backoff_s); _mr_done holds ids whose
+        # transition was delivered or proven unnecessary.
+        self._mr_pending: Dict[str, List[float]] = {}
+        self._mr_done: set = set()
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -271,6 +203,9 @@ class DeploymentsWatcher:
                     w.stop()
                 self._watchers.clear()
                 self._health_seen.clear()
+                # pending kicks re-derive from state on the next
+                # leadership; _mr_done persists only as a memo
+                self._mr_pending.clear()
         if enabled and not prev:
             self._thread = threading.Thread(
                 target=self._run, daemon=True, name="deployments-watcher"
@@ -290,6 +225,169 @@ class DeploymentsWatcher:
                 for d in snap.deployments_iter():
                     if d.active() and d.id not in self._watchers:
                         self._watchers[d.id] = _Watcher(self, d.id)
+            try:
+                self._scan_multiregion(snap)
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("multiregion scan: %s", e)
+
+    # -- multiregion terminal transitions (state-derived, persistent) ----
+
+    def _scan_multiregion(self, snap) -> None:
+        """Derive pending cross-region work from the deployments table.
+
+        Reference behavior: nomad/deploymentwatcher multiregion kicks
+        (enterprise). A SUCCESSFUL multiregion deployment admits the
+        region max_parallel positions later; a FAILED one propagates
+        per the job's on_failure strategy. Deriving from state (rather
+        than from the in-memory watcher that observed the transition)
+        means a leader restart or transient federation outage cannot
+        strand a downstream region: the work item is re-created from
+        the table and retried with capped backoff until the target
+        region acknowledges or proves the kick unnecessary."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._enabled:
+                return
+            for d in snap.deployments_iter():
+                if not d.is_multiregion or d.id in self._mr_done:
+                    continue
+                if d.status not in (consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+                                    consts.DEPLOYMENT_STATUS_FAILED):
+                    continue
+                if d.id not in self._mr_pending:
+                    self._mr_pending[d.id] = [0.0, 0.5]
+            due = [did for did, e in self._mr_pending.items()
+                   if e[0] <= now]
+        for did in due:
+            d = snap.deployment_by_id(did)
+            if d is None:                        # GC'd: drop the work
+                with self._lock:
+                    self._mr_pending.pop(did, None)
+                continue
+            try:
+                done = self._mr_transition(snap, d)
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("multiregion transition %s: %s", did, e)
+                done = False
+            with self._lock:
+                if not self._enabled:
+                    return
+                entry = self._mr_pending.get(did)
+                if entry is None:
+                    continue
+                if done:
+                    del self._mr_pending[did]
+                    self._mr_done.add(did)
+                else:
+                    entry[0] = time.monotonic() + entry[1]
+                    entry[1] = min(entry[1] * 2, 30.0)
+
+    def _mr_transition(self, snap, d) -> bool:
+        """Deliver one multiregion terminal transition; True when done."""
+        job = snap.job_by_id(d.namespace, d.job_id)
+        if job is None or not job.multiregion:
+            return True
+        if job.version != d.job_version:
+            return True                          # superseded rollout
+        regions = [str(r.get("name", "")) for r in job.multiregion_regions()]
+        idx = job.multiregion_region_index()
+        if idx < 0:
+            return True
+        if d.status == consts.DEPLOYMENT_STATUS_SUCCESSFUL:
+            mp = job.multiregion_max_parallel()
+            if mp <= 0:
+                return True
+            nxt = idx + mp
+            if nxt >= len(regions):
+                return True
+            return self._kick_region(d, regions[nxt], "unblock")
+        # FAILED: propagate per strategy (structs.go:4133 on_failure)
+        on_failure = job.multiregion_on_failure()
+        if on_failure == "fail_local":
+            return True                          # others stay as they are
+        targets = regions if on_failure == "fail_all" else regions[idx + 1:]
+        ok = True
+        for region in targets:
+            if region == regions[idx]:
+                continue
+            if not self._kick_region(d, region, "fail"):
+                ok = False
+        return ok
+
+    def _kick_region(self, d, target: str, verb: str) -> bool:
+        """Deliver unblock/fail for the job's deployment in `target`.
+
+        True when the target acknowledged, or its deployment state
+        proves the kick unnecessary (already past the gate / already
+        terminal); False asks the caller to retry."""
+        import urllib.parse
+
+        if target == self.server.config.region:
+            if verb == "unblock":
+                _, unblocked = self.server.unblock_job_deployment(
+                    d.namespace, d.job_id)
+                if unblocked:
+                    return True
+            else:
+                _, failed = self.server.fail_job_deployment(
+                    d.namespace, d.job_id,
+                    "Failed because of an unsuccessful deployment in a "
+                    "federated region")
+                if failed:
+                    return True
+            local = self.server.state.snapshot().latest_deployment_by_job_id(
+                d.namespace, d.job_id)
+            # nothing to act on AND a row FOR THIS ROLLOUT exists in a
+            # state that cannot need the kick any more -> done; no row
+            # yet, or only a stale prior-version row (the kick raced
+            # the target's scheduler creating it) -> retry
+            return local is not None and self._kick_moot(
+                local, verb, d.job_version)
+
+        from nomad_tpu.api.client import APIClient, APIError, QueryOptions
+
+        addr = self.server.region_addr(target)
+        if addr is None:
+            LOG.warning("multiregion: no path to region %s for %s %s",
+                        target, verb, d.job_id)
+            return False
+        tls = getattr(self.server, "tls_api", None) or {}
+        token = getattr(self.server.config, "replication_token", "")
+        job_q = urllib.parse.quote(d.job_id, safe="")
+        opts = QueryOptions(region=target, namespace=d.namespace)
+        api = APIClient(addr, token=token, **tls)
+        try:
+            body = api.post(f"/v1/job/{job_q}/deployment/{verb}", {}, opts)
+            if body.get("Unblocked") or body.get("Failed"):
+                return True
+            remote = api.get(f"/v1/job/{job_q}/deployment", opts)
+            return bool(remote) and self._kick_moot_json(
+                remote, verb, d.job_version)
+        except (APIError, OSError) as e:
+            LOG.warning("multiregion: %s kick to %s failed: %s",
+                        verb, target, e)
+            return False
+
+    @staticmethod
+    def _kick_moot(dep, verb: str, job_version: int) -> bool:
+        # a row from a DIFFERENT job version is not this rollout's:
+        # the target's scheduler hasn't created its row yet -> retry
+        if dep.job_version != job_version:
+            return False
+        if verb == "unblock":
+            return dep.status != consts.DEPLOYMENT_STATUS_BLOCKED
+        return not dep.active()
+
+    @staticmethod
+    def _kick_moot_json(dep: Dict, verb: str, job_version: int) -> bool:
+        if int(dep.get("JobVersion", -1)) != job_version:
+            return False
+        status = str(dep.get("Status", ""))
+        if verb == "unblock":
+            return status != consts.DEPLOYMENT_STATUS_BLOCKED
+        return status in (consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+                          consts.DEPLOYMENT_STATUS_FAILED,
+                          consts.DEPLOYMENT_STATUS_CANCELLED)
 
     def _forget(self, deployment_id: str) -> None:
         with self._lock:

@@ -478,6 +478,28 @@ class Server:
             return self.state.latest_index(), False
         return self.unblock_deployment(d.id), True
 
+    def fail_job_deployment(self, namespace: str, job_id: str,
+                            description: str = "Deployment marked as failed"):
+        """Fail the latest active deployment of a job: the target of a
+        cross-region failure propagation (multiregion on_failure).
+        Returns (index, failed)."""
+        snap = self.state.snapshot()
+        d = snap.latest_deployment_by_job_id(namespace, job_id)
+        if d is None or not d.active():
+            return self.state.latest_index(), False
+        from nomad_tpu.server.deployment_watcher import _operator_eval
+
+        index = self.raft_apply(
+            fsm_msgs.DEPLOYMENT_STATUS_UPDATE,
+            {
+                "deployment_id": d.id,
+                "status": consts.DEPLOYMENT_STATUS_FAILED,
+                "description": description,
+                "evals": [_operator_eval(d)],
+            },
+        )
+        return index, True
+
     def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> Dict:
         snap = self.state.snapshot()
         job = snap.job_by_id(namespace, job_id)

@@ -173,7 +173,10 @@ class Worker:
         runs long (worker.go keeps dequeued evals alive past the nack
         timeout; cold XLA compiles can take tens of seconds). One
         long-lived thread per worker; evals register in _live."""
-        interval = max(self.server.eval_broker.nack_timeout / 3.0, 1.0)
+        # cadence must stay below the nack timer even when the timeout
+        # is configured very small, or long evals get spuriously nacked
+        nack = self.server.eval_broker.nack_timeout
+        interval = min(max(nack / 3.0, 1.0), max(nack / 2.0, 0.1))
         while not self._hb_stop.wait(interval):
             with self._live_lock:
                 items = list(self._live.items())

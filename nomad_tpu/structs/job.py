@@ -321,6 +321,13 @@ class Job:
         strategy = self.multiregion.get("strategy") or {}
         return int(strategy.get("max_parallel", 0) or 0)
 
+    def multiregion_on_failure(self) -> str:
+        """'' (downstream regions fail), 'fail_all', or 'fail_local'."""
+        if not self.multiregion:
+            return ""
+        strategy = self.multiregion.get("strategy") or {}
+        return str(strategy.get("on_failure", "") or "")
+
     def multiregion_region_index(self) -> int:
         """This job copy's position in the region rollout order."""
         for i, r in enumerate(self.multiregion_regions()):

@@ -22,6 +22,10 @@ from typing import Optional, Tuple
 
 _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
+# Largest message a peer may send; frames above this are rejected
+# before allocation (exec stdio and API payloads sit far below this).
+MAX_FRAME_BYTES = 16 * 1024 * 1024
+
 OP_CONT = 0x0
 OP_TEXT = 0x1
 OP_BINARY = 0x2
@@ -89,6 +93,10 @@ def read_frame(rfile) -> Tuple[int, bytes]:
             n = struct.unpack(">H", _read_exact(rfile, 2))[0]
         elif n == 127:
             n = struct.unpack(">Q", _read_exact(rfile, 8))[0]
+        if n > MAX_FRAME_BYTES or len(payload) + n > MAX_FRAME_BYTES:
+            # peer-supplied 64-bit length: cap before allocating so a
+            # hostile client can't drive unbounded memory growth (1009)
+            raise ConnectionError(f"websocket frame too large: {n}")
         key = _read_exact(rfile, 4) if masked else b""
         data = _read_exact(rfile, n) if n else b""
         if masked:

@@ -1,0 +1,129 @@
+"""C2M replay bench harness: generation, persistence, plane export.
+
+Reference behavior: scheduler/benchmarks/benchmarks_test.go:16-24 — the
+replay bench loads a persisted cluster state (raft snapshot) and runs
+the scheduler against it. Here the persisted form is the state store's
+own snapshot codec, and the bench flattens the restored state to the
+kernel's planes.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench"))
+
+import c2m  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def replay_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("c2m") / "replay.snap"
+    c2m.generate(str(p), n_nodes=300, n_allocs=1500, seed=7, verbose=False)
+    return str(p)
+
+
+class TestGenerate:
+    def test_persists_and_restores_through_state_store(self, replay_path):
+        store = c2m.load(replay_path, generate_if_missing=False)
+        snap = store.snapshot()
+        nodes = snap.nodes()
+        allocs = [a for a in snap.allocs_iter()]
+        assert len(nodes) == 300
+        assert len(allocs) == 1500
+        assert len(snap.jobs()) > 10
+
+    def test_cluster_is_heterogeneous(self, replay_path):
+        store = c2m.load(replay_path, generate_if_missing=False)
+        snap = store.snapshot()
+        nodes = snap.nodes()
+        classes = {n.node_class for n in nodes}
+        assert {"standard", "large"} <= classes
+        dcs = {n.datacenter for n in nodes}
+        assert len(dcs) >= 5
+        racks = {n.attributes.get("platform.aws.placement.rack")
+                 for n in nodes}
+        assert len(racks) >= 10
+
+    def test_workload_is_heterogeneous(self, replay_path):
+        from nomad_tpu.structs import consts
+
+        store = c2m.load(replay_path, generate_if_missing=False)
+        snap = store.snapshot()
+        jobs = snap.jobs()
+        kinds = {j.type for j in jobs}
+        assert consts.JOB_TYPE_SERVICE in kinds
+        assert any(tg.spreads for j in jobs for tg in j.task_groups)
+        assert any(
+            c.operand == consts.CONSTRAINT_DISTINCT_HOSTS
+            for j in jobs for tg in j.task_groups for c in tg.constraints)
+
+    def test_allocations_fit_node_capacity(self, replay_path):
+        """Generated placements must be feasible: per-node allocated
+        cpu/mem cannot exceed the node's unreserved capacity."""
+        store = c2m.load(replay_path, generate_if_missing=False)
+        snap = store.snapshot()
+        for node in snap.nodes():
+            cap_cpu = (node.node_resources.cpu.cpu_shares
+                       - node.reserved_resources.cpu_shares)
+            cap_mem = (node.node_resources.memory.memory_mb
+                       - node.reserved_resources.memory_mb)
+            used_cpu = used_mem = 0
+            for a in snap.allocs_by_node(node.id):
+                cr = a.comparable_resources()
+                used_cpu += cr.cpu_shares
+                used_mem += cr.memory_mb
+            assert used_cpu <= cap_cpu, node.id
+            assert used_mem <= cap_mem, node.id
+
+    def test_usage_planes_match_allocs(self, replay_path):
+        store = c2m.load(replay_path, generate_if_missing=False)
+        snap = store.snapshot()
+        u = snap.usage
+        want = {}
+        for a in snap.allocs_iter():
+            if a.terminal_status():
+                continue
+            cr = a.comparable_resources()
+            want[a.node_id] = want.get(a.node_id, 0) + cr.cpu_shares
+        for nid, cpu in want.items():
+            row = u.rows[nid]
+            assert u.used_cpu[row] == pytest.approx(cpu)
+
+
+class TestReplayPlanes:
+    def test_planes_flatten_and_feed_the_kernel(self, replay_path):
+        import bench
+
+        cluster, used_cpu, used_mem, used_disk, asks, stats = \
+            bench._replay_planes(replay_path)
+        assert stats["replay_nodes"] == 300
+        assert stats["replay_allocs"] == 1500
+        assert used_cpu[:cluster.n_real].sum() > 0
+        assert asks.shape[1] == 2 and len(asks) > 0
+        # capacity planes are heterogeneous (several distinct classes)
+        caps = set(np.unique(cluster.cap_cpu[:cluster.n_real]).tolist())
+        assert len(caps) >= 3
+
+    def test_planes_file_roundtrip_via_baseline(self, replay_path):
+        import json
+        import subprocess
+
+        import bench
+
+        cluster, used_cpu, used_mem, used_disk, asks, _ = \
+            bench._replay_planes(replay_path)
+        path = bench._write_planes_file(
+            cluster, used_cpu, used_mem, used_disk, asks, 50, 5)
+        try:
+            proc = subprocess.run(
+                [bench._baseline_bin(), "--planes", path],
+                check=True, capture_output=True, text=True)
+            out = json.loads(proc.stdout)
+        finally:
+            os.unlink(path)
+        assert out["evals_per_sec"] > 0
+        assert out["placed"] > 0

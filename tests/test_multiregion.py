@@ -111,6 +111,94 @@ class TestMultiregion:
             east.shutdown()
             west.shutdown()
 
+    def test_region_failure_fails_downstream_regions(self):
+        """Default on_failure (''): a region's deployment failure fails
+        every region after it in the rollout order; regions before it
+        keep their result (structs.go:4133 on_failure semantics).
+
+        West is starved (datacenters override no node matches), so its
+        deployment blows the progress deadline after east's success
+        unblocks it; central — still gated behind west — must then be
+        failed by the cross-region propagation, not left blocked."""
+        east = Agent(AgentConfig.dev(name="east-3", region="east"))
+        west = Agent(AgentConfig.dev(name="west-3", region="west"))
+        central = Agent(AgentConfig.dev(name="central-3", region="central"))
+        agents = [east, west, central]
+        for a in agents:
+            a.start()
+        try:
+            for a in agents:
+                for b in agents:
+                    if a is not b:
+                        a.server.join_region(b.config.region, b.http.addr)
+            job = make_mr_job(max_parallel=1)
+            job.task_groups[0].update.progress_deadline_s = 2.0
+            job.multiregion["regions"] = [
+                {"name": "east", "count": 1, "datacenters": []},
+                {"name": "west", "count": 1, "datacenters": ["nowhere"]},
+                {"name": "central", "count": 1, "datacenters": []},
+            ]
+            east.server.job_register(job)
+
+            def dep_status(agent):
+                d = agent.server.state.snapshot() \
+                    .latest_deployment_by_job_id(job.namespace, job.id)
+                return d.status if d else None
+
+            wait_for(lambda: dep_status(east)
+                     == consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+                     timeout=40, msg="east successful")
+            wait_for(lambda: dep_status(west)
+                     == consts.DEPLOYMENT_STATUS_FAILED,
+                     timeout=40, msg="west failed")
+            wait_for(lambda: dep_status(central)
+                     == consts.DEPLOYMENT_STATUS_FAILED,
+                     timeout=40, msg="central failed by propagation")
+            # east keeps its success — default on_failure only fails
+            # DOWNSTREAM regions
+            assert dep_status(east) == consts.DEPLOYMENT_STATUS_SUCCESSFUL
+        finally:
+            for a in agents:
+                a.shutdown()
+
+    def test_region_failure_fail_local_leaves_others_blocked(self):
+        """on_failure='fail_local': only the failing region fails; the
+        downstream region stays blocked awaiting operator action."""
+        east = Agent(AgentConfig.dev(name="east-4", region="east"))
+        west = Agent(AgentConfig.dev(name="west-4", region="west"))
+        central = Agent(AgentConfig.dev(name="central-4", region="central"))
+        agents = [east, west, central]
+        for a in agents:
+            a.start()
+        try:
+            for a in agents:
+                for b in agents:
+                    if a is not b:
+                        a.server.join_region(b.config.region, b.http.addr)
+            job = make_mr_job(max_parallel=1)
+            job.task_groups[0].update.progress_deadline_s = 2.0
+            job.multiregion["strategy"]["on_failure"] = "fail_local"
+            job.multiregion["regions"] = [
+                {"name": "east", "count": 1, "datacenters": []},
+                {"name": "west", "count": 1, "datacenters": ["nowhere"]},
+                {"name": "central", "count": 1, "datacenters": []},
+            ]
+            east.server.job_register(job)
+
+            def dep_status(agent):
+                d = agent.server.state.snapshot() \
+                    .latest_deployment_by_job_id(job.namespace, job.id)
+                return d.status if d else None
+
+            wait_for(lambda: dep_status(west)
+                     == consts.DEPLOYMENT_STATUS_FAILED,
+                     timeout=40, msg="west failed")
+            time.sleep(2.0)   # propagation would have landed by now
+            assert dep_status(central) == consts.DEPLOYMENT_STATUS_BLOCKED
+        finally:
+            for a in agents:
+                a.shutdown()
+
     def test_max_parallel_zero_runs_all_regions(self):
         east = Agent(AgentConfig.dev(name="east-2", region="east"))
         west = Agent(AgentConfig.dev(name="west-2", region="west"))

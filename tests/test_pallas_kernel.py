@@ -115,3 +115,106 @@ class TestParity:
             shared.algorithm_spread, k_steps=K, interpret=True)
         assert not np.asarray(out.found).any()
         assert (np.asarray(out.chosen) == -1).all()
+
+
+class TestCandidateScanParity:
+    """The fused candidate scan (pallas_topk_place_batch): XLA
+    full-width pass + approx_max_k + ONE pallas program for the K-step
+    deduction scan. Whenever `valid` holds, results must be identical
+    to the full-width XLA kernel."""
+
+    def _run(self, shared, used, usedm, ask_cpu, ask_mem, n_steps):
+        from nomad_tpu.ops.pallas_kernel import pallas_topk_place_batch
+
+        return pallas_topk_place_batch(
+            shared.cap_cpu, shared.cap_mem, shared.cap_disk,
+            jnp.asarray(used), jnp.asarray(usedm), shared.used_disk,
+            shared.base_mask, shared.job_tg_count, shared.penalty,
+            shared.aff_score,
+            ask_cpu, ask_mem, shared.ask_disk,
+            n_steps, shared.desired_count, shared.algorithm_spread,
+            k_steps=K, interpret=True)
+
+    def test_matches_full_width_kernel_when_valid(self, shared):
+        from nomad_tpu.ops.kernel import place_taskgroup
+
+        npad = shared.cap_cpu.shape[0]
+        rng = np.random.default_rng(5)
+        used = np.zeros(npad, np.float32)
+        used[:N_NODES] = 2000.0 * 0.6 * rng.random(N_NODES, np.float32)
+        usedm = np.zeros(npad, np.float32)
+        usedm[:N_NODES] = 4096.0 * 0.6 * rng.random(N_NODES, np.float32)
+        ask_cpu, ask_mem, n_steps = _batch_inputs(seed=5)
+
+        chosen, scores, found, valid = self._run(
+            shared, used, usedm, ask_cpu, ask_mem, n_steps)
+        assert np.asarray(valid).any(), "calibration workload all invalid"
+        for b in range(B):
+            if not bool(valid[b]):
+                continue
+            kin = shared._replace(
+                used_cpu=jnp.asarray(used), used_mem=jnp.asarray(usedm),
+                ask_cpu=ask_cpu[b], ask_mem=ask_mem[b],
+                n_steps=jnp.asarray(K, jnp.int32))
+            ref = place_taskgroup(kin, K, LEAN)
+            np.testing.assert_array_equal(np.asarray(ref.chosen),
+                                          np.asarray(chosen[b]))
+            np.testing.assert_array_equal(np.asarray(ref.found),
+                                          np.asarray(found[b]))
+            np.testing.assert_allclose(np.asarray(ref.scores),
+                                       np.asarray(scores[b]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_loop_backend_matches_xla_topk(self, shared):
+        from nomad_tpu.parallel.batching import make_schedule_apply_loop
+
+        npad = shared.cap_cpu.shape[0]
+        rng = np.random.default_rng(9)
+        used = np.zeros(npad, np.float32)
+        used[:N_NODES] = 2000.0 * 0.5 * rng.random(N_NODES, np.float32)
+        usedm = np.zeros(npad, np.float32)
+        usedm[:N_NODES] = 4096.0 * 0.5 * rng.random(N_NODES, np.float32)
+        T = 3
+        asks_cpu = jnp.asarray(
+            rng.choice([100.0, 250.0, 500.0], (T, B)).astype(np.float32))
+        asks_mem = jnp.asarray(
+            rng.choice([64.0, 128.0, 256.0], (T, B)).astype(np.float32))
+        n_steps = jnp.asarray(np.full(B, K, np.int32))
+
+        xla = make_schedule_apply_loop(K, LEAN, topk=True)
+        pls = make_schedule_apply_loop(K, LEAN, topk=True,
+                                       backend="pallas_topk",
+                                       interpret=True)
+        sx = xla(shared, jnp.asarray(used), jnp.asarray(usedm),
+                 asks_cpu, asks_mem, n_steps)
+        sp = pls(shared, jnp.asarray(used), jnp.asarray(usedm),
+                 asks_cpu, asks_mem, n_steps)
+        # same placements committed -> same final utilization planes,
+        # same totals (invalid counts may differ: different k_cand)
+        assert int(sx[1]) > 0
+        np.testing.assert_allclose(float(sx[0]), float(sp[0]), rtol=1e-5)
+        assert int(sx[1]) == int(sp[1])
+        np.testing.assert_allclose(np.asarray(sx[3]), np.asarray(sp[3]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(sx[4]), np.asarray(sp[4]),
+                                   rtol=1e-5)
+
+    def test_invalid_members_excluded(self, shared):
+        """An eval that cannot place all K steps on the candidate set
+        while the wider cluster could must come back valid=False."""
+        npad = shared.cap_cpu.shape[0]
+        used = np.zeros(npad, np.float32)
+        usedm = np.zeros(npad, np.float32)
+        # ask sized so each node fits exactly one placement and K
+        # placements exceed the candidate count is impossible here
+        # (k_cand >= K), so instead starve: only K-1 nodes feasible
+        # via base_mask is not reachable from this seam — use a huge
+        # ask that fits nowhere: found=False everywhere, which is a
+        # VALID outcome (rest_max is -inf too)
+        ask_cpu = jnp.full(1, 1e9, jnp.float32)
+        ask_mem = jnp.full(1, 64.0, jnp.float32)
+        chosen, scores, found, valid = self._run(
+            shared, used, usedm, ask_cpu, ask_mem,
+            jnp.full(1, K, jnp.int32))
+        assert not np.asarray(found).any()
+        assert bool(valid[0])
