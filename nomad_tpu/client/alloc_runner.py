@@ -38,6 +38,7 @@ class AllocRunner:
         prev_lookup=None,
         device_plugins=None,
         network_manager=None,
+        connect_mgr=None,
     ) -> None:
         self.alloc = alloc
         self.drivers = drivers
@@ -55,6 +56,10 @@ class AllocRunner:
         # bridge networking (network_hook.go); None when unsupported
         self.network_manager = network_manager
         self.alloc_network = None
+        # Connect hook (envoy_bootstrap_hook analog); None without the
+        # mesh RPC verbs
+        self.connect_mgr = connect_mgr
+        self.alloc_connect = None
         # tasks whose services are currently registered
         self._registered_tasks: set = set()
         # volume name -> CSIMountInfo (csi_hook.go populates these for
@@ -150,6 +155,23 @@ class AllocRunner:
             LOG.warning("alloc %s: bridge networking requested but "
                         "unsupported on this client; tasks run in the "
                         "host network", self.alloc.id)
+        # connect hook (envoy_bootstrap_hook/connect_native_hook): mesh
+        # sidecar + upstream proxies before any task starts, so a
+        # task's first upstream dial finds its local listener
+        if self.connect_mgr is not None:
+            try:
+                self.alloc_connect = self.connect_mgr.setup(
+                    self.alloc, tg, self.alloc_network)
+                if self.alloc_connect is not None:
+                    net_env.update(self.alloc_connect.env)
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("alloc %s: connect setup failed: %s",
+                            self.alloc.id, e)
+                for task in tg.tasks:
+                    self._on_task_state(
+                        task.name, TaskState(state=STATE_DEAD, failed=True))
+                self._tasks_started = True
+                return
         # mount paths surface to tasks as env (the reference bind-mounts
         # them into the task via VolumeMounts; env is this build's
         # equivalent until drivers gain mount plumbing)
@@ -348,7 +370,13 @@ class AllocRunner:
                 fresh = task_name not in self._registered_tasks
                 self._registered_tasks.add(task_name)
             if first:
-                self.service_reg.register(self.alloc, tg.services)
+                group_services = list(tg.services)
+                if self.alloc_connect is not None:
+                    # the sidecar's own registration is the mesh entry
+                    # point other allocs' upstreams discover (the
+                    # Consul sidecar service Nomad registers)
+                    group_services += self.alloc_connect.sidecar_services
+                self.service_reg.register(self.alloc, group_services)
             if fresh:
                 task = tg.lookup_task(task_name)
                 if task is not None:
@@ -609,6 +637,13 @@ class AllocRunner:
                 tr.driver.destroy_task(tr.task_id, force=True)
             except Exception:                   # noqa: BLE001
                 pass
+        # connect postrun: sidecar/upstream proxies die with the alloc
+        if self.alloc_connect is not None:
+            try:
+                self.alloc_connect.destroy()
+            except Exception:                   # noqa: BLE001
+                pass
+            self.alloc_connect = None
         # bridge-network postrun (network_hook.go Postrun)
         if self.network_manager is not None and self.alloc_network is not None:
             try:

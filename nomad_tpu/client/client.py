@@ -90,6 +90,14 @@ class InProcessRPC:
         wrapper -> NomadServiceProvider)."""
         return self.server.service_register(regs)
 
+    def mesh_identity_token(self, namespace: str, service: str) -> str:
+        """Connect mesh credential (consul.go DeriveSITokens analog)."""
+        return self.server.mesh_identity_token(namespace, service)
+
+    def services_by_name(self, namespace: str, name: str):
+        """ServiceRegistration.GetService (connect upstream discovery)."""
+        return self.server.services_by_name(namespace, name)
+
     def deregister_services_by_alloc(self, alloc_ids) -> int:
         return self.server.service_deregister_by_alloc(alloc_ids)
 
@@ -235,6 +243,12 @@ class Client:
 
         self.service_reg = ServiceRegWrapper(rpc, self.node) \
             if hasattr(rpc, "register_services") else None
+        # Connect hook manager (envoy_bootstrap_hook analog); needs the
+        # mesh-token + discovery RPC verbs
+        from nomad_tpu.client.connect import ConnectManager
+
+        self.connect_mgr = ConnectManager(rpc) \
+            if hasattr(rpc, "mesh_identity_token") else None
         self.secrets = SecretsClient(rpc, self.node) \
             if hasattr(rpc, "derive_vault_tokens") else None
         self.allocs: Dict[str, AllocRunner] = {}
@@ -400,6 +414,7 @@ class Client:
             secrets=self.secrets,
             prev_lookup=self._prev_runner,
             device_plugins=self.device_plugins,
+            connect_mgr=self.connect_mgr,
             network_manager=self.network_manager,
         )
         with self._alloc_lock:

@@ -141,12 +141,16 @@ class AllocNetwork:
     """One allocation's namespace + relays (network_hook state)."""
 
     def __init__(self, alloc_id: str, ns_name: str, ip: str,
-                 veth_host: str, forwards: List[_PortForward]) -> None:
+                 veth_host: str, forwards: List[_PortForward],
+                 gateway: str = "") -> None:
         self.alloc_id = alloc_id
         self.ns_name = ns_name
         self.ip = ip
         self.veth_host = veth_host
         self.forwards = forwards
+        # the bridge address: how processes INSIDE the namespace reach
+        # host-bound listeners (port relays, other allocs' host ports)
+        self.gateway = gateway
 
 
 class BridgeNetworkManager:
@@ -177,11 +181,43 @@ class BridgeNetworkManager:
                   f"{self.subnet_prefix}.{GATEWAY_HOST}/20",
                   "dev", self.bridge])
         _run(["ip", "link", "set", self.bridge, "up"])
+        self._adopt_existing()
         self._bridge_ready = True
 
+    def _adopt_existing(self) -> None:
+        """Mark IPs held by pre-existing nomad netns as used.
+
+        Namespaces outlive the agent process by design (tasks keep
+        running across restarts for reattach, like the reference's
+        executor); a fresh in-memory allocator would hand their IPs to
+        new allocations and the shared bridge would route new traffic
+        into the old namespace. The reference gets this from CNI's
+        host-local IPAM lease files; here the running namespaces ARE
+        the lease state."""
+        out = _run(["ip", "netns", "list"])
+        if out.returncode != 0:
+            return
+        for line in out.stdout.decode(errors="replace").splitlines():
+            name = line.split()[0] if line.split() else ""
+            if not name.startswith("nomad-"):
+                continue
+            addrs = _run(["ip", "netns", "exec", name,
+                          "ip", "-4", "-o", "addr", "show"])
+            for al in addrs.stdout.decode(errors="replace").splitlines():
+                if "inet " not in al:
+                    continue
+                ip = al.split("inet ", 1)[1].split("/", 1)[0]
+                if ip.startswith(self.subnet_prefix + "."):
+                    try:
+                        with self._lock:
+                            self._used_hosts.add(int(ip.rsplit(".", 1)[1]))
+                    except ValueError:
+                        pass
+
     def _alloc_ip(self) -> str:
-        # hosts .2..254 in the third+fourth octet space; in-memory
-        # allocation is enough because namespaces die with their allocs
+        # hosts .2..254 in the third+fourth octet space; _adopt_existing
+        # seeds the set with IPs still held by namespaces from previous
+        # agent processes
         with self._lock:
             for host in range(2, 255):
                 if host not in self._used_hosts:
@@ -231,7 +267,8 @@ class BridgeNetworkManager:
         except Exception:
             self._teardown(ns, veth_h, ip, forwards)
             raise
-        net = AllocNetwork(alloc_id, ns, ip, veth_h, forwards)
+        net = AllocNetwork(alloc_id, ns, ip, veth_h, forwards,
+                           gateway=f"{self.subnet_prefix}.{GATEWAY_HOST}")
         with self._lock:
             self._allocs[alloc_id] = net
         return net

@@ -13,14 +13,18 @@ engine-side state is cleaned up. Operational surface beyond run/stop:
 
 - image pulls are singleflighted per image across concurrent tasks
   (coordinator.go), probing ``docker image inspect`` first
-- ``task_stats`` reads engine stats (`docker stats --format json`)
-  into the TaskStats shape (cpu percent, memory rss)
+- ``task_stats`` reads RAW stats from the engine API over the unix
+  socket (drivers/docker/stats.go semantics: cpu-delta math over
+  precpu, memory usage net of reclaimable cache; docker_api.py) with
+  CLI and process-stats fallbacks
+- a detached ``docklog`` subprocess follows the container's log
+  stream from the ENGINE into the task log files
+  (docklog/docklog.go): output keeps flowing across agent restarts
+  independent of the CLI attachment, and recover_task respawns a dead
+  docklog; without a live engine socket the foreground ``docker run``
+  still writes through the executor into the logmon collector
 - interactive exec streams through ``docker exec -i[t]`` INSIDE the
   container (driver.proto:79)
-- log collection deviation: the reference tails the engine via a
-  docklog subprocess; here the foreground ``docker run`` writes
-  through the executor into the logmon collector process, which
-  provides the same survive-agent-restart property
 
 Gated: nodes without a reachable daemon fingerprint as undetected and
 never receive docker tasks.
@@ -92,12 +96,91 @@ class DockerDriver(RawExecDriver):
                     f"{pull.stderr.decode(errors='replace')[:300]}"
                 )
 
+    #: engine socket; overridable for tests (fake engine)
+    engine_socket = "/var/run/docker.sock"
+
+    def _engine(self):
+        """Engine API client when the daemon socket answers, else
+        None (CLI fallbacks remain)."""
+        import os
+
+        from nomad_tpu.drivers.docker_api import DockerEngine
+
+        if not os.path.exists(self.engine_socket):
+            return None
+        engine = DockerEngine(self.engine_socket)
+        return engine if engine.ping() else None
+
     def start_task(self, config: TaskConfig) -> TaskHandle:
+        import os
+
         image = config.driver_config.get("image")
         if not image:
             raise ValueError("docker driver requires image")
         self._ensure_image(image)
-        return super().start_task(config)
+        engine_live = self._engine() is not None
+        real_out, real_err = config.std_out_path, config.std_err_path
+        if engine_live:
+            # docklog is the log path (the reference never attaches
+            # `docker run` output either); the CLI attachment would
+            # write every container line a second time
+            config.std_out_path = os.devnull
+            config.std_err_path = os.devnull
+        try:
+            handle = super().start_task(config)
+        finally:
+            config.std_out_path, config.std_err_path = real_out, real_err
+        if engine_live:
+            self._start_docklog(config, handle)
+        return handle
+
+    # -- docklog (drivers/docker/docklog/docklog.go) ---------------------
+
+    def _start_docklog(self, config: TaskConfig, handle: TaskHandle,
+                       since: int = 0) -> None:
+        """Detached engine-log follower: task output keeps flowing
+        across agent restarts independent of the CLI attachment. Only
+        when the engine socket is live (CLI-attached logs still work
+        through the executor/logmon path otherwise). ``since`` bounds
+        a respawned follower so history is not re-appended."""
+        import os
+        import sys as _sys
+
+        if self._engine() is None:
+            return
+        workdir = config.alloc_dir or "/tmp"
+        stdout = config.std_out_path or os.path.join(workdir, "stdout")
+        stderr = config.std_err_path or os.path.join(workdir, "stderr")
+        script = os.path.join(os.path.dirname(__file__), "docklog.py")
+        proc = subprocess.Popen(
+            [_sys.executable, "-S", script, self.engine_socket,
+             _container_name(config), stdout, stderr, str(since)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        handle.driver_state["docklog_pid"] = proc.pid
+
+    def recover_task(self, handle: TaskHandle) -> None:
+        super().recover_task(handle)
+        # docklog survives with the task; respawn only when it died
+        # (docklog.go reattach-or-restart on recover)
+        import os
+
+        pid = int(handle.driver_state.get("docklog_pid") or 0)
+        alive = False
+        if pid > 0:
+            try:
+                os.kill(pid, 0)
+                alive = True
+            except OSError:
+                alive = False
+        if not alive:
+            # resume from now: history is already in the files (the
+            # reference docklog resumes from a saved timestamp)
+            import time as _time
+
+            self._start_docklog(handle.config, handle,
+                                since=int(_time.time()))
 
     def fingerprint(self) -> Fingerprint:
         docker = shutil.which("docker")
@@ -202,6 +285,9 @@ class DockerDriver(RawExecDriver):
                 ["docker", "rm", "-f", _container_name(task.config)],
                 capture_output=True, timeout=30,
             )
+            # the engine closes the log stream when the container goes;
+            # docklog exits on its own — nothing to reap here beyond
+            # the normal child cleanup
         super().destroy_task(task_id, force=force)
 
     def exec_task(self, task_id: str, cmd: List[str],
@@ -229,9 +315,26 @@ class DockerDriver(RawExecDriver):
         )
 
     def task_stats(self, task_id: str) -> Dict:
-        """Container stats from the engine (drivers/docker stats
-        collection) -> the TaskStats shape the API serves."""
+        """Container stats from the engine API (drivers/docker/stats.go:
+        raw cgroup counters + cpu-delta math), falling back to the CLI
+        then to process stats."""
         task = self._get(task_id)
+        engine = self._engine()
+        if engine is not None:
+            from nomad_tpu.drivers.docker_api import (
+                EngineError,
+                compute_cpu_percent,
+                memory_rss,
+            )
+
+            try:
+                raw = engine.stats(_container_name(task.config))
+                return {
+                    "cpu": {"percent": compute_cpu_percent(raw)},
+                    "memory": {"rss": memory_rss(raw)},
+                }
+            except (OSError, EngineError):
+                pass
         out = subprocess.run(
             ["docker", "stats", "--no-stream", "--format", "{{json .}}",
              _container_name(task.config)],

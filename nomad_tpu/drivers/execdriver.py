@@ -24,6 +24,27 @@ from nomad_tpu.plugins.drivers import DriverCapabilities, TaskConfig
 from nomad_tpu.drivers.rawexec import RawExecDriver
 
 
+def resource_executor_opts(config, cgroup_prefix: str) -> List[str]:
+    """Namespace + cgroup flags for the native executor from a task's
+    resources (executor_linux.go resource/namespace wiring) — shared
+    by every isolating driver (exec, java)."""
+    support = isolation_support()
+    opts: List[str] = []
+    if support["namespaces"]:
+        opts.append("-isolate")
+    if support["cgroups"]:
+        res = config.resources
+        mem = int(getattr(res, "memory_mb", 0) or 0) if res else 0
+        cpu = int(getattr(res, "cpu", 0) or 0) if res else 0
+        if mem > 0:
+            opts += ["-mem_mb", str(mem)]
+        if cpu > 0:
+            opts += ["-cpu_shares", str(cpu)]
+        if mem > 0 or cpu > 0:
+            opts += ["-cgroup", f"{cgroup_prefix}-{config.id[:16]}"]
+    return opts
+
+
 @functools.lru_cache(maxsize=1)
 def isolation_support() -> Dict[str, bool]:
     """Probe once: can this host unshare namespaces / write cgroups?"""
@@ -62,20 +83,7 @@ class ExecDriver(RawExecDriver):
     def _executor_opts(self, config: TaskConfig) -> List[str]:
         """Namespace + cgroup flags for the native executor
         (executor_linux.go resource/namespace wiring)."""
-        support = isolation_support()
-        opts: List[str] = []
-        if support["namespaces"]:
-            opts.append("-isolate")
-        if support["cgroups"]:
-            res = config.resources
-            mem = int(getattr(res, "memory_mb", 0) or 0) if res else 0
-            cpu = int(getattr(res, "cpu", 0) or 0) if res else 0
-            if mem > 0:
-                opts += ["-mem_mb", str(mem)]
-            if cpu > 0:
-                opts += ["-cpu_shares", str(cpu)]
-            if mem > 0 or cpu > 0:
-                opts += ["-cgroup", f"nomad-{config.id[:16]}"]
+        opts = resource_executor_opts(config, cgroup_prefix="nomad")
         chroot = (config.driver_config or {}).get("chroot")
         if chroot:
             opts += ["-chroot", str(chroot)]

@@ -264,6 +264,28 @@ def _service(labels: List[str], sb: Body) -> Service:
             if dur in check:
                 check[dur] = duration_s(check[dur])
         svc.checks.append(check)
+    # connect stanza (services.go ConsulConnect): sidecar_service with
+    # optional proxy { upstreams { ... } local_service_port }, or
+    # native = true
+    for _l, conb in sb.get_blocks("connect"):
+        if conb.attrs.get("native"):
+            svc.connect["native"] = True
+        for _sl, scb in conb.get_blocks("sidecar_service"):
+            sidecar: dict = {}
+            for _pl, pb in scb.get_blocks("proxy"):
+                proxy = {"upstreams": []}
+                if "local_service_port" in pb.attrs:
+                    proxy["local_service_port"] = int(
+                        pb.attrs["local_service_port"])
+                for _ul, ub in pb.get_blocks("upstreams"):
+                    proxy["upstreams"].append({
+                        "destination_name": str(
+                            ub.attrs.get("destination_name", "")),
+                        "local_bind_port": int(
+                            ub.attrs.get("local_bind_port", 0)),
+                    })
+                sidecar["proxy"] = proxy
+            svc.connect["sidecar_service"] = sidecar
     return svc
 
 

@@ -42,6 +42,26 @@ from nomad_tpu.ops.kernel import (
 #: inert filler members per wave is far cheaper than another variant.
 _WAVE_BUCKETS = (1, 4, 16, 64, 256)
 
+#: When set (configure_wave_mesh), waves run the SAME joint program
+#: with the node axis sharded over this mesh's devices — per-step
+#: argmax/top-k become ICI collectives (SURVEY.md section 2.10). None
+#: = single-device dispatch. Results are identical either way.
+_WAVE_MESH = None
+#: waves dispatched through the sharded path (asserted by tests)
+sharded_wave_launches = 0
+
+
+def configure_wave_mesh(mesh) -> None:
+    """Route subsequent waves over ``mesh`` (None restores
+    single-device dispatch). Server.start() calls this when multiple
+    devices are visible (ServerConfig.use_device_mesh)."""
+    global _WAVE_MESH
+    _WAVE_MESH = mesh
+
+
+def wave_mesh_active() -> bool:
+    return _WAVE_MESH is not None
+
 
 def pad_wave(b: int) -> int:
     for w in _WAVE_BUCKETS:
@@ -117,10 +137,20 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
         step_local[pos:pos + k] = np.arange(k)
         pos += k
 
-    out = place_taskgroups_joint_jit(
-        stacked, jnp.asarray(step_member), jnp.asarray(step_local),
-        t_pad, feats,
-    )
+    if _WAVE_MESH is not None:
+        from nomad_tpu.parallel.sharded import make_joint_sharded
+
+        global sharded_wave_launches
+        sharded_wave_launches += 1
+        out = make_joint_sharded(_WAVE_MESH)(
+            stacked, jnp.asarray(step_member), jnp.asarray(step_local),
+            t_pad, feats,
+        )
+    else:
+        out = place_taskgroups_joint_jit(
+            stacked, jnp.asarray(step_member), jnp.asarray(step_local),
+            t_pad, feats,
+        )
     host = jax.tree_util.tree_map(np.asarray, out)
     results = []
     for i, k in enumerate(k_steps):

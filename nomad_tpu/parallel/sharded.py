@@ -110,6 +110,87 @@ def make_place_batch(
     )
 
 
+# ---------------------------------------------------------------------------
+# Sharded JOINT waves: the live coalescer's multi-chip path.
+#
+# The joint wave kernel (ops/kernel.place_taskgroups_joint) is the live
+# server's launch shape: a stacked member axis + one serialized step
+# axis with a shared capacity carry. Sharding its NODE axis over the
+# mesh runs the same program across the slice — each step's masked
+# argmax/top-k lowers to a per-shard reduce + cross-shard all-reduce
+# riding ICI (the reference's MaxScore iterator as a collective;
+# SURVEY.md section 2.10) — so results are bit-identical to the
+# single-device path by construction.
+# ---------------------------------------------------------------------------
+
+# PartitionSpec per stacked KernelIn field ([B, ...] member axis
+# replicated, node axis sharded).
+_JOINT_SPECS = dict(
+    cap_cpu=P(None, _N), cap_mem=P(None, _N), cap_disk=P(None, _N),
+    free_cores=P(None, _N), shares_per_core=P(None, _N),
+    free_dyn=P(None, _N), base_mask=P(None, _N), used_cpu=P(None, _N),
+    used_mem=P(None, _N), used_disk=P(None, _N), used_cores=P(None, _N),
+    used_mbits=P(None, _N), avail_mbits=P(None, _N),
+    port_conflict=P(None, _N), dev_aff_score=P(None, _N),
+    job_tg_count=P(None, _N), penalty=P(None, _N), aff_score=P(None, _N),
+    job_any_count=P(None, _N),
+    dev_free=P(None, _N, None),
+    has_dev_affinity=P(None), distinct_hosts_job=P(None),
+    distinct_hosts_tg=P(None),
+    ask_cpu=P(None), ask_mem=P(None), ask_disk=P(None), ask_cores=P(None),
+    ask_dyn_ports=P(None), ask_has_reserved_ports=P(None),
+    ask_mbits=P(None), desired_count=P(None), algorithm_spread=P(None),
+    n_steps=P(None),
+    node_perm=P(None, None),        # indexes the GLOBAL node axis
+    step_penalty=P(None, None, None), step_preferred=P(None, None),
+    spread_active=P(None, None), spread_even=P(None, None),
+    spread_weight=P(None, None),
+    spread_bucket=P(None, None, _N),
+    spread_counts=P(None, None, None), spread_desired=P(None, None, None),
+    ask_dev=P(None, None),
+)
+
+assert set(_JOINT_SPECS) == set(KernelIn._fields)
+
+_joint_sharded_cache: dict = {}
+
+
+def make_joint_sharded(mesh: Mesh):
+    """jit of place_taskgroups_joint with the node axis sharded over
+    ``mesh``'s nodes axis. Cached per mesh; the (t_steps, features)
+    variants are cached by jit itself (static args)."""
+    from nomad_tpu.ops.kernel import place_taskgroups_joint
+
+    key = id(mesh)
+    hit = _joint_sharded_cache.get(key)
+    if hit is not None:
+        return hit
+    kin_shardings = KernelIn(
+        **{f: NamedSharding(mesh, s) for f, s in _JOINT_SPECS.items()}
+    )
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(
+        place_taskgroups_joint,
+        static_argnums=(3, 4),
+        in_shardings=(kin_shardings, repl, repl),
+        out_shardings=repl,      # outputs are small per-step rows
+    )
+    _joint_sharded_cache[key] = fn
+    return fn
+
+
+def wave_mesh(n_devices: int = 0, devices=None) -> Mesh:
+    """A 1D nodes-axis mesh for live waves (the coalescer's multi-chip
+    routing; evals parallelism comes from wave batching, so the whole
+    slice goes to the node axis)."""
+    import numpy as np
+
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (_N,))
+
+
 def unstack_kernel_outs(out: KernelOut) -> List[KernelOut]:
     """Split a batched KernelOut back into per-problem results."""
     b = out.chosen.shape[0]

@@ -117,9 +117,11 @@ class NomadFSM:
             for a in req.get("allocs", []):
                 ev(stream.TOPIC_ALLOC, "AllocationUpdated", a.id, a, a.namespace)
         elif msg_type == APPLY_PLAN_RESULTS:
-            for allocs in req.get("node_allocation", {}).values():
-                for a in allocs:
-                    ev(stream.TOPIC_ALLOC, "PlanResult", a.id, a, a.namespace)
+            for p in req.get("plans") or [req]:
+                for allocs in p.get("node_allocation", {}).values():
+                    for a in allocs:
+                        ev(stream.TOPIC_ALLOC, "PlanResult", a.id, a,
+                           a.namespace)
         elif msg_type in (DEPLOYMENT_STATUS_UPDATE, DEPLOYMENT_ALLOC_HEALTH,
                           DEPLOYMENT_PROMOTE):
             ev(stream.TOPIC_DEPLOYMENT, "DeploymentUpdate",
@@ -252,22 +254,24 @@ class NomadFSM:
     # --- plan results ---------------------------------------------------
 
     def _apply_plan_results(self, req: Dict) -> int:
-        index = self.state.upsert_plan_results(
-            req.get("alloc_index", 0),
-            req["plan"],
-            req["node_allocation"],
-            req["node_update"],
-            req["node_preemptions"],
-            req.get("deployment"),
-            req.get("deployment_updates"),
-        )
+        # batched form ({"plans": [...]}, one raft entry per applier
+        # pass); a bare single-plan request (older raft log entries)
+        # is normalized into a batch of one
+        plans = req.get("plans")
+        if plans is None:
+            plans = [req]
+        index = self.state.upsert_plan_results_batch(
+            req.get("alloc_index", 0), plans)
         # preempted/stopped allocs free capacity
-        if self.blocked_evals is not None and (
-            req["node_update"] or req["node_preemptions"]
-        ):
+        freed_nodes = {
+            nid
+            for p in plans
+            for nid in list(p["node_update"]) + list(p["node_preemptions"])
+        }
+        if self.blocked_evals is not None and freed_nodes:
             snap = self.state.snapshot()
             classes = set()
-            for nid in list(req["node_update"]) + list(req["node_preemptions"]):
+            for nid in freed_nodes:
                 node = snap.node_by_id(nid)
                 if node is not None:
                     classes.add(node.computed_class)

@@ -168,57 +168,80 @@ class Planner:
             self._pool.shutdown(wait=False)
             self._pool = None
 
+    #: Plans merged into one raft entry per applier pass. A burst of
+    #: batched evals lands ~wave-size plans at once; committing them
+    #: one raft entry at a time made per-plan commit overhead the p99
+    #: driver at bench batch sizes.
+    MAX_COMMIT_BATCH = 128
+
     def _run(self) -> None:
         """The pipelined applier loop (plan_apply.go:71,159-184).
 
-        Plan N+1's per-node re-validation runs while plan N's raft
+        Batch N+1's per-node re-validation runs while batch N's raft
         apply is still in flight; N+1 evaluates against the live state
         PLUS the overlay of N's yet-uncommitted results, and its own
         apply starts only after N's completes (commit order is
-        preserved). Responses go to workers only after the apply
-        (asyncPlanWait, plan_apply.go:370).
+        preserved). Within a batch, plan k's evaluation sees plans
+        1..k-1 through the same overlay — the exact serial-applier
+        semantics, with ONE raft entry and one store commit per batch.
+        Responses go to workers only after the apply (asyncPlanWait,
+        plan_apply.go:370).
         """
         overlay = _PlanOverlay()
         in_flight: Optional[threading.Thread] = None
         while not self._stop.is_set():
-            pending = self.queue.dequeue(timeout=0.2)
-            if pending is None:
+            batch = self.queue.dequeue_batch(self.MAX_COMMIT_BATCH,
+                                             timeout=0.2)
+            if not batch:
                 continue
-            try:
-                snapshot = _LiveView(self.state, overlay)
-                result = self.evaluate_plan(snapshot, pending.plan)
-            except Exception as e:            # noqa: BLE001 - worker nacks
-                pending.respond(None, e)
+            evaluated: List[Tuple[PendingPlan, PlanResult, int]] = []
+            snapshot = _LiveView(self.state, overlay)
+            for pending in batch:
+                try:
+                    result = self.evaluate_plan(snapshot, pending.plan)
+                except Exception as e:        # noqa: BLE001 - worker nacks
+                    pending.respond(None, e)
+                    continue
+                # later plans in this batch (and the next batch's
+                # evaluation) see this plan through the overlay
+                token = overlay.add(result)
+                evaluated.append((pending, result, token))
+            if not evaluated:
                 continue
             # serialize commits: wait for the previous apply before
             # launching this one (evaluation above already overlapped)
             if in_flight is not None:
                 in_flight.join()
-            token = overlay.add(result)
             in_flight = threading.Thread(
-                target=self._apply_async,
-                args=(pending, result, overlay, token),
+                target=self._apply_batch_async,
+                args=(evaluated, overlay),
                 daemon=True, name="plan-commit",
             )
             in_flight.start()
         if in_flight is not None:
             in_flight.join()
 
-    def _apply_async(self, pending: PendingPlan, result: PlanResult,
-                     overlay: _PlanOverlay, token: int) -> None:
+    def _apply_batch_async(
+        self,
+        evaluated: List[Tuple[PendingPlan, PlanResult, int]],
+        overlay: _PlanOverlay,
+    ) -> None:
         try:
-            index = self._commit(pending.plan, result)
-            result.alloc_index = index
-            if result.refresh_index > 0:
-                # the conflict the scheduler must refresh past may have
-                # been an overlaid (just-committed) plan; point the
-                # retry at the post-commit state
-                result.refresh_index = max(result.refresh_index, index)
-            overlay.remove(token)
-            pending.respond(result, None)
+            index = self._commit_batch(
+                [(p.plan, r) for p, r, _ in evaluated])
+            for pending, result, token in evaluated:
+                result.alloc_index = index
+                if result.refresh_index > 0:
+                    # the conflict the scheduler must refresh past may
+                    # have been an overlaid (just-committed) plan; point
+                    # the retry at the post-commit state
+                    result.refresh_index = max(result.refresh_index, index)
+                overlay.remove(token)
+                pending.respond(result, None)
         except Exception as e:                # noqa: BLE001
-            overlay.remove(token)
-            pending.respond(None, e)
+            for pending, _result, token in evaluated:
+                overlay.remove(token)
+                pending.respond(None, e)
 
     # --- single plan (dequeue -> evaluate -> commit) --------------------
 
@@ -229,25 +252,29 @@ class Planner:
         return result
 
     def _commit(self, plan: Plan, result: PlanResult) -> int:
-        req = {
-            "alloc_index": self.state.latest_index(),
-            "plan": plan,
-            "node_allocation": result.node_allocation,
-            "node_update": result.node_update,
-            "node_preemptions": result.node_preemptions,
-            "deployment": result.deployment,
-            "deployment_updates": result.deployment_updates,
-        }
+        return self._commit_batch([(plan, result)])
+
+    def _commit_batch(self, items: List[Tuple[Plan, PlanResult]]) -> int:
+        """One raft entry / one store commit for a batch of evaluated
+        plans (fsm.go applyPlanResults, batched)."""
+        reqs = [
+            {
+                "plan": plan,
+                "node_allocation": result.node_allocation,
+                "node_update": result.node_update,
+                "node_preemptions": result.node_preemptions,
+                "deployment": result.deployment,
+                "deployment_updates": result.deployment_updates,
+            }
+            for plan, result in items
+        ]
+        req = {"alloc_index": self.state.latest_index(), "plans": reqs}
         if self._raft_apply is not None:
             # fsm.go applyPlanResults: Raft commit + blocked-eval unblock
             from nomad_tpu.server.fsm import APPLY_PLAN_RESULTS
             return self._raft_apply(APPLY_PLAN_RESULTS, req)
-        return self.state.upsert_plan_results(
-            req["alloc_index"], plan,
-            result.node_allocation, result.node_update,
-            result.node_preemptions, result.deployment,
-            result.deployment_updates,
-        )
+        return self.state.upsert_plan_results_batch(
+            req["alloc_index"], reqs)
 
     # --- evaluation (plan_apply.go:403 evaluatePlan) --------------------
 

@@ -82,6 +82,24 @@ class PlanQueue:
                 return None
             return heapq.heappop(self._heap)[2]
 
+    def dequeue_batch(self, max_n: int,
+                      timeout: Optional[float] = None) -> List[PendingPlan]:
+        """Pop up to ``max_n`` plans in priority order.
+
+        A burst of optimistically-scheduled evals lands a burst of
+        plans; draining them together lets the applier evaluate the
+        whole burst against one view and commit it as ONE raft entry
+        (the TPU build's plan-side analog of eval batching). An empty
+        list means the timeout passed with nothing queued.
+        """
+        with self._lock:
+            if not self._heap:
+                self._cond.wait(timeout)
+            out = []
+            while self._heap and len(out) < max_n:
+                out.append(heapq.heappop(self._heap)[2])
+            return out
+
     def stats(self) -> Dict:
         with self._lock:
             return {"depth": len(self._heap)}

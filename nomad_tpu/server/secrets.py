@@ -178,6 +178,12 @@ class ConsulProvider:
         (consul.go DeriveSITokens)."""
         raise NotImplementedError
 
+    def mesh_identity_token(self, namespace: str, service: str) -> str:
+        """The per-service mesh credential both sides of a Connect
+        pair present/verify — the SI-token-backed stand-in for Envoy
+        mTLS certificates + intentions (allow-by-shared-identity)."""
+        raise NotImplementedError
+
 
 class DevConsulProvider(ConsulProvider):
     """In-memory Consul KV + SI tokens (`consul agent -dev` analog)."""
@@ -211,6 +217,13 @@ class DevConsulProvider(ConsulProvider):
     def derive_si_token(self, alloc_id, task, service) -> str:
         with self._lock:
             key = (alloc_id, task)
+            if key not in self._si_tokens:
+                self._si_tokens[key] = _secrets.token_urlsafe(16)
+            return self._si_tokens[key]
+
+    def mesh_identity_token(self, namespace: str, service: str) -> str:
+        with self._lock:
+            key = ("mesh", namespace, service)
             if key not in self._si_tokens:
                 self._si_tokens[key] = _secrets.token_urlsafe(16)
             return self._si_tokens[key]

@@ -51,6 +51,7 @@ class ServerConfig:
         job_gc_threshold: float = 4 * 3600.0,
         node_gc_threshold: float = 24 * 3600.0,
         deployment_gc_threshold: float = 3600.0,
+        use_device_mesh: Optional[bool] = None,
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -70,6 +71,11 @@ class ServerConfig:
         self.job_gc_threshold = job_gc_threshold
         self.node_gc_threshold = node_gc_threshold
         self.deployment_gc_threshold = deployment_gc_threshold
+        # route placement waves over a device mesh (node axis over ICI,
+        # SURVEY.md section 2.10). None = auto: on when an accelerator
+        # backend exposes >1 device; tests opt in explicitly on the
+        # virtual CPU mesh
+        self.use_device_mesh = use_device_mesh
 
 
 class Server:
@@ -148,6 +154,10 @@ class Server:
         # loops from a previous term notice and exit
         self._leadership_lock = threading.Lock()
         self._leader_gen = 0
+        # True when THIS server configured the process-global wave
+        # mesh; shutdown then resets it so later servers (tests) start
+        # from their own config
+        self._wave_mesh_owner = False
 
     # --- lifecycle ------------------------------------------------------
 
@@ -171,6 +181,7 @@ class Server:
         """Start workers; leadership comes from raft when attached,
         otherwise immediately (single-process authority)."""
         self._shutdown.clear()
+        self._maybe_configure_wave_mesh()
         self.vault.start()
         if self.raft is not None:
             self.raft.start()
@@ -179,8 +190,42 @@ class Server:
         for w in self.workers:
             w.start()
 
+    def _maybe_configure_wave_mesh(self) -> None:
+        """Wire live placement waves onto the device mesh (the §2.10
+        node-axis-over-ICI mapping) when the environment has one.
+
+        use_device_mesh=True forces it (tests use the 8-virtual-CPU
+        mesh), False disables, None enables only when an accelerator
+        backend exposes more than one device."""
+        use = self.config.use_device_mesh
+        if use is False:
+            return
+        try:
+            import jax
+
+            from nomad_tpu.parallel import coalesce
+            from nomad_tpu.parallel.sharded import wave_mesh
+
+            devs = jax.devices()
+            if use is None and (len(devs) < 2
+                                or jax.default_backend() == "cpu"):
+                return
+            if len(devs) < 2:
+                return
+            coalesce.configure_wave_mesh(wave_mesh(devices=devs))
+            self._wave_mesh_owner = True
+            LOG.info("placement waves sharded over %d %s devices",
+                     len(devs), devs[0].platform)
+        except Exception as e:                  # noqa: BLE001
+            LOG.warning("device mesh unavailable: %s", e)
+
     def shutdown(self) -> None:
         self._shutdown.set()
+        if self._wave_mesh_owner:
+            from nomad_tpu.parallel import coalesce
+
+            coalesce.configure_wave_mesh(None)
+            self._wave_mesh_owner = False
         self.vault.stop()
         for w in self.workers:
             w.stop()
@@ -339,6 +384,9 @@ class Server:
         if errs:
             # job_endpoint.go Register rejects invalid jobs outright
             raise ValueError("job validation failed: " + "; ".join(errs))
+        # connect admission (job_endpoint_hook_connect.go): every
+        # sidecar service gets a scheduler-assigned mesh port
+        _connect_admission(job)
         # multiregion fan-out (structs.go:4133; the reference's
         # multiregion register hook): a job submitted with region
         # "global" and a multiregion block becomes one per-region copy,
@@ -904,6 +952,17 @@ class Server:
 
     # --- service registrations (service_registration_endpoint.go) ------
 
+    def mesh_identity_token(self, namespace: str, service: str) -> str:
+        """Mesh identity credential for a Connect service pair
+        (consul.go DeriveSITokens analog; see DevConsulProvider)."""
+        return self.consul.mesh_identity_token(namespace, service)
+
+    def services_by_name(self, namespace: str, name: str) -> List[Dict]:
+        """ServiceRegistration.GetService: live instances by name (the
+        connect upstream resolver's discovery query)."""
+        return [r.stub() for r in
+                self.state.service_registrations_by_name(namespace, name)]
+
     def service_register(self, regs: List) -> int:
         """ServiceRegistration.Upsert: clients report their running
         service instances."""
@@ -1102,3 +1161,39 @@ class Server:
             "workers": len(self.workers),
             "state_index": self.state.latest_index(),
         }
+
+
+def _connect_admission(job) -> None:
+    """Inject scheduler-visible mesh plumbing for Connect services
+    (job_endpoint_hook_connect.go groupConnectHook):
+
+    - every group service with a sidecar gets a dynamic port labeled
+      ``connect-proxy-<service>`` on the group's bridge network, so
+      the NetworkIndex assigns the sidecar's public mesh port like any
+      other port;
+    - a sidecar requires a bridge-mode group network (reference
+      validation: Connect requires network mode "bridge").
+    """
+    from nomad_tpu.structs.network import Port
+
+    for tg in job.task_groups:
+        sidecars = [s for s in (tg.services or []) if s.has_sidecar()]
+        if not sidecars:
+            continue
+        bridge = None
+        for net in tg.networks:
+            if getattr(net, "mode", "host") == "bridge":
+                bridge = net
+                break
+        if bridge is None:
+            raise ValueError(
+                f"group {tg.name}: Consul Connect sidecars require a "
+                "bridge-mode group network")
+        for svc in sidecars:
+            label = svc.mesh_port_label()
+            have = any(
+                p.label == label
+                for p in list(bridge.dynamic_ports)
+                + list(bridge.reserved_ports))
+            if not have:
+                bridge.dynamic_ports.append(Port(label=label))

@@ -227,6 +227,12 @@ class Worker:
             with self._live_lock:
                 self._live.pop(ev.id, None)
 
+    #: Concurrent eval threads per wave. Bounds thread count for large
+    #: batches (one Python thread per eval would collapse under GIL
+    #: contention at bench batch sizes) and matches the largest
+    #: pre-compiled wave bucket so waves never hit a fresh XLA shape.
+    MAX_WAVE = 64
+
     def _process_batch(self, batch: List[Tuple[Evaluation, str]]) -> None:
         """Schedule a batch of evals concurrently with coalesced launches.
 
@@ -236,6 +242,11 @@ class Worker:
         normal applier path, so conflicting placements resolve exactly
         as they do between reference workers: re-validation + partial
         commit + retry against a refreshed snapshot.
+
+        Batches larger than MAX_WAVE run as consecutive chunks, each
+        with its own rendezvous; the chunks still share the one
+        snapshot (reference workers routinely schedule against state
+        that other workers' plans are landing on).
         """
         from nomad_tpu.parallel.coalesce import ClusterCache, LaunchCoalescer
 
@@ -253,31 +264,34 @@ class Worker:
                     pass
             return
 
-        coalescer = LaunchCoalescer(len(batch))
         clusters = ClusterCache()
+        for start in range(0, len(batch), self.MAX_WAVE):
+            chunk = batch[start:start + self.MAX_WAVE]
+            coalescer = LaunchCoalescer(len(chunk))
 
-        def one(ev: Evaluation, token: str) -> None:
-            try:
-                self._process(
-                    ev, token,
-                    snapshot=snapshot,
-                    launcher=coalescer.launch,
-                    cluster_provider=clusters.get,
+            def one(ev: Evaluation, token: str,
+                    coalescer=coalescer) -> None:
+                try:
+                    self._process(
+                        ev, token,
+                        snapshot=snapshot,
+                        launcher=coalescer.launch,
+                        cluster_provider=clusters.get,
+                    )
+                finally:
+                    coalescer.done()
+
+            threads = [
+                threading.Thread(
+                    target=one, args=(ev, token),
+                    daemon=True, name=f"worker-{self.id}-eval",
                 )
-            finally:
-                coalescer.done()
-
-        threads = [
-            threading.Thread(
-                target=one, args=(ev, token),
-                daemon=True, name=f"worker-{self.id}-eval",
-            )
-            for ev, token in batch
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        self.batch_launches += coalescer.launches
-        self.batch_requests += coalescer.requests
-        self.max_wave = max(self.max_wave, coalescer.max_wave)
+                for ev, token in chunk
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            self.batch_launches += coalescer.launches
+            self.batch_requests += coalescer.requests
+            self.max_wave = max(self.max_wave, coalescer.max_wave)

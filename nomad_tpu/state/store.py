@@ -49,29 +49,46 @@ class SchedulerConfiguration:
         }.get(scheduler_type, False)
 
 
+#: tables a snapshot shares copy-on-write with the store. Index tables
+#: (allocs_by_*) hold immutable frozenset values so sharing the dict is
+#: enough; every mutator replaces values instead of mutating them.
+_COW_TABLES = (
+    "nodes", "jobs", "job_versions", "evals", "allocs", "deployments",
+    "allocs_by_job", "allocs_by_node", "allocs_by_eval", "csi_volumes",
+)
+
+
 class StateSnapshot:
     """A point-in-time read view (memdb Snapshot analog).
 
     Implements the scheduler's ``State`` interface
     (reference scheduler/scheduler.go:67-141).
+
+    Construction is O(1): the snapshot takes REFERENCES to the store's
+    tables and marks them shared; the first mutation of a shared table
+    copies that table (``StateStore._own``). This is the dict analog of
+    go-memdb's immutable-radix snapshots — the reference's snapshots
+    are free (state_store.go Snapshot), and at C2M scale (100k allocs)
+    eager per-snapshot table copies were the next scaling wall.
     """
 
     def __init__(self, store: "StateStore") -> None:
         with store._lock:
             self.index = store._index
-            self._nodes = dict(store._nodes)
-            self._jobs = dict(store._jobs)
-            self._job_versions = dict(store._job_versions)
-            self._evals = dict(store._evals)
-            self._allocs = dict(store._allocs)
-            self._deployments = dict(store._deployments)
-            self._allocs_by_job = {k: set(v) for k, v in store._allocs_by_job.items()}
-            self._allocs_by_node = {k: set(v) for k, v in store._allocs_by_node.items()}
-            self._allocs_by_eval = {k: set(v) for k, v in store._allocs_by_eval.items()}
-            self._csi_volumes = dict(store._csi_volumes)
+            store._shared.update(_COW_TABLES)
+            self._nodes = store._nodes
+            self._jobs = store._jobs
+            self._job_versions = store._job_versions
+            self._evals = store._evals
+            self._allocs = store._allocs
+            self._deployments = store._deployments
+            self._allocs_by_job = store._allocs_by_job
+            self._allocs_by_node = store._allocs_by_node
+            self._allocs_by_eval = store._allocs_by_eval
+            self._csi_volumes = store._csi_volumes
             self.scheduler_config = store.scheduler_config
             # live utilization planes for the scheduler fast path
-            # (state/usage.py); far cheaper than the dict copies above
+            # (state/usage.py); cached until the next mutation
             self.usage = store.usage.planes_copy()
 
     # --- State interface (scheduler.go:67-141) ---
@@ -174,9 +191,15 @@ class StateStore:
         self._evals: Dict[str, Evaluation] = {}
         self._allocs: Dict[str, Allocation] = {}
         self._deployments: Dict[str, Deployment] = {}
-        self._allocs_by_job: Dict[Tuple[str, str], set] = {}
-        self._allocs_by_node: Dict[str, set] = {}
-        self._allocs_by_eval: Dict[str, set] = {}
+        # index tables hold FROZENSET values (immutable): updates
+        # replace the value, so snapshots can share the dict by
+        # reference (see _COW_TABLES)
+        self._allocs_by_job: Dict[Tuple[str, str], frozenset] = {}
+        self._allocs_by_node: Dict[str, frozenset] = {}
+        self._allocs_by_eval: Dict[str, frozenset] = {}
+        # tables currently shared by-reference with >=1 snapshot; a
+        # mutator copies the table first (_own) — copy-on-write
+        self._shared: set = set()
         # aux tables (schema.go:50-72: namespaces, scaling_event,
         # scaling_policy, acl_policy, acl_token)
         self._namespaces: Dict[str, object] = {}
@@ -251,6 +274,14 @@ class StateStore:
     def _next_index(self) -> int:
         self._index += 1
         return self._index
+
+    def _own(self, *tables: str) -> None:
+        """Copy-on-write: detach the named tables from any snapshots
+        sharing them. Call under the lock BEFORE mutating a table."""
+        for name in tables:
+            if name in self._shared:
+                setattr(self, "_" + name, dict(getattr(self, "_" + name)))
+                self._shared.discard(name)
 
     def block_until(self, tables: List[str], min_index: int, timeout: float) -> int:
         """Block until one of `tables` commits past min_index or the
@@ -409,6 +440,7 @@ class StateStore:
     def upsert_csi_volumes(self, volumes: List) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("csi_volumes")
             for v in volumes:
                 existing = self._csi_volumes.get((v.namespace, v.id))
                 if existing is not None:
@@ -434,6 +466,7 @@ class StateStore:
             if vol.in_use() and not force:
                 raise ValueError(f"volume in use: {volume_id}")
             idx = self._next_index()
+            self._own("csi_volumes")
             del self._csi_volumes[(namespace, volume_id)]
         self._notify(["csi_volumes"], idx)
         return idx
@@ -448,6 +481,7 @@ class StateStore:
             vol = vol.copy()
             vol.claim(claim)
             idx = self._next_index()
+            self._own("csi_volumes")
             vol.modify_index = idx
             self._csi_volumes[(namespace, volume_id)] = vol
         self._notify(["csi_volumes"], idx)
@@ -637,9 +671,14 @@ class StateStore:
             self._evals = payload["evals"]
             self._allocs = payload["allocs"]
             self._deployments = payload["deployments"]
-            self._allocs_by_job = payload["allocs_by_job"]
-            self._allocs_by_node = payload["allocs_by_node"]
-            self._allocs_by_eval = payload["allocs_by_eval"]
+            self._allocs_by_job = {
+                k: frozenset(v) for k, v in payload["allocs_by_job"].items()}
+            self._allocs_by_node = {
+                k: frozenset(v) for k, v in payload["allocs_by_node"].items()}
+            self._allocs_by_eval = {
+                k: frozenset(v) for k, v in payload["allocs_by_eval"].items()}
+            # replaced wholesale: nothing is shared with snapshots now
+            self._shared.clear()
             self.scheduler_config = payload["scheduler_config"]
             self._namespaces = payload.get("namespaces", {})
             self._scaling_events = payload.get("scaling_events", {})
@@ -665,6 +704,7 @@ class StateStore:
     def upsert_node(self, node) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("nodes")
             if not node.computed_class:
                 node.compute_class()
             node.modify_index = idx
@@ -679,6 +719,7 @@ class StateStore:
     def delete_node(self, node_id: str) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("nodes")
             self._nodes.pop(node_id, None)
             self.usage.drop_node(node_id)
         self._notify(["nodes"], idx)
@@ -687,6 +728,7 @@ class StateStore:
     def update_node_status(self, node_id: str, status: str) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("nodes")
             node = self._nodes.get(node_id)
             if node is not None:
                 node = node.copy()
@@ -700,6 +742,7 @@ class StateStore:
     def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("nodes")
             node = self._nodes.get(node_id)
             if node is not None:
                 node = node.copy()
@@ -714,6 +757,7 @@ class StateStore:
                           mark_eligible: bool = True) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("nodes")
             node = self._nodes.get(node_id)
             if node is not None:
                 node = node.copy()
@@ -736,6 +780,7 @@ class StateStore:
         (state_store.go upsertJobImpl semantics)."""
         with self._lock:
             idx = self._next_index()
+            self._own("jobs", "job_versions")
             key = (job.namespace, job.id)
             existing = self._jobs.get(key)
             if existing is not None:
@@ -758,6 +803,7 @@ class StateStore:
     def delete_job(self, namespace: str, job_id: str) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("jobs", "job_versions")
             self._jobs.pop((namespace, job_id), None)
             # purge version history too (state_store.go DeleteJobTxn
             # deletes from the job_version table)
@@ -772,6 +818,7 @@ class StateStore:
     def upsert_evals(self, evals: List[Evaluation]) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("evals")
             for e in evals:
                 e.modify_index = idx
                 if e.create_index == 0:
@@ -783,6 +830,7 @@ class StateStore:
     def delete_evals(self, eval_ids: List[str]) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("evals")
             for eid in eval_ids:
                 self._evals.pop(eid, None)
         self._notify(["evals"], idx)
@@ -797,6 +845,8 @@ class StateStore:
         return idx
 
     def _upsert_alloc_locked(self, a: Allocation, idx: int) -> None:
+        self._own("allocs", "allocs_by_job", "allocs_by_node",
+                  "allocs_by_eval")
         existing = self._allocs.get(a.id)
         if existing is not None:
             # merge client-only fields if this is a server-side update
@@ -809,14 +859,21 @@ class StateStore:
         self._allocs[a.id] = a
         self.usage.alloc_changed(existing, a)
         self._update_deployment_with_alloc_locked(existing, a, idx)
-        self._allocs_by_job.setdefault((a.namespace, a.job_id), set()).add(a.id)
-        self._allocs_by_node.setdefault(a.node_id, set()).add(a.id)
-        self._allocs_by_eval.setdefault(a.eval_id, set()).add(a.id)
+        for table, key in (
+            (self._allocs_by_job, (a.namespace, a.job_id)),
+            (self._allocs_by_node, a.node_id),
+            (self._allocs_by_eval, a.eval_id),
+        ):
+            ids = table.get(key)
+            if ids is None or a.id not in ids:
+                # frozenset replacement, never in-place (snapshots share)
+                table[key] = (ids or frozenset()) | {a.id}
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
         """Client status updates (state_store.go UpdateAllocsFromClient)."""
         with self._lock:
             idx = self._next_index()
+            self._own("allocs")
             for update in allocs:
                 existing = self._allocs.get(update.id)
                 if existing is None:
@@ -861,6 +918,7 @@ class StateStore:
         d_unhealthy = (1 if new_h is False else 0) - (1 if old_h is False else 0)
         if not (placed or d_healthy or d_unhealthy):
             return
+        self._own("deployments")
         d = d.copy()
         state = d.task_groups[new.task_group]
         state.placed_allocs += placed
@@ -874,6 +932,7 @@ class StateStore:
         requests (state_store.go UpdateAllocsDesiredTransitions)."""
         with self._lock:
             idx = self._next_index()
+            self._own("allocs", "evals")
             for alloc_id, transition in transitions.items():
                 existing = self._allocs.get(alloc_id)
                 if existing is None:
@@ -896,6 +955,7 @@ class StateStore:
         state_store.go UpdateAllocDesiredTransition + stop)."""
         with self._lock:
             idx = self._next_index()
+            self._own("allocs", "evals")
             existing = self._allocs.get(alloc_id)
             if existing is not None:
                 new = existing.copy_skip_job()
@@ -914,6 +974,7 @@ class StateStore:
     def upsert_deployment(self, d: Deployment) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("deployments")
             d.modify_index = idx
             if d.create_index == 0:
                 d.create_index = idx
@@ -924,6 +985,7 @@ class StateStore:
     def update_deployment_status(self, deployment_id: str, status: str, description: str = "") -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("deployments")
             d = self._deployments.get(deployment_id)
             if d is not None:
                 d = d.copy()
@@ -939,15 +1001,26 @@ class StateStore:
         registrations of reaped allocs go with them)."""
         with self._lock:
             idx = self._next_index()
+            self._own("allocs", "allocs_by_job", "allocs_by_node",
+                      "allocs_by_eval")
             doomed = set(alloc_ids)
             for aid in alloc_ids:
                 a = self._allocs.pop(aid, None)
                 if a is None:
                     continue
                 self.usage.alloc_changed(a, None)
-                self._allocs_by_job.get((a.namespace, a.job_id), set()).discard(aid)
-                self._allocs_by_node.get(a.node_id, set()).discard(aid)
-                self._allocs_by_eval.get(a.eval_id, set()).discard(aid)
+                for table, key in (
+                    (self._allocs_by_job, (a.namespace, a.job_id)),
+                    (self._allocs_by_node, a.node_id),
+                    (self._allocs_by_eval, a.eval_id),
+                ):
+                    ids = table.get(key)
+                    if ids and aid in ids:
+                        remaining = ids - {aid}
+                        if remaining:
+                            table[key] = remaining
+                        else:
+                            del table[key]
             stale_regs = [r.id for r in self._services.values()
                           if r.alloc_id in doomed]
             for rid in stale_regs:
@@ -958,6 +1031,7 @@ class StateStore:
     def delete_deployments(self, deployment_ids: List[str]) -> int:
         with self._lock:
             idx = self._next_index()
+            self._own("deployments")
             for did in deployment_ids:
                 self._deployments.pop(did, None)
         self._notify(["deployment"], idx)
@@ -977,6 +1051,7 @@ class StateStore:
 
         with self._lock:
             idx = self._next_index()
+            self._own("deployments", "allocs", "evals")
             d = self._deployments.get(deployment_id)
             if d is not None:
                 d = d.copy()
@@ -1029,6 +1104,7 @@ class StateStore:
         promoted for all (or the given) groups."""
         with self._lock:
             idx = self._next_index()
+            self._own("deployments", "evals")
             d = self._deployments.get(deployment_id)
             if d is not None:
                 d = d.copy()
@@ -1064,33 +1140,53 @@ class StateStore:
         deployment: Optional[Deployment] = None,
         deployment_updates: Optional[List[Dict]] = None,
     ) -> int:
-        """Commit the (possibly partial) plan the applier validated."""
+        """Commit one (possibly partial) plan the applier validated."""
+        return self.upsert_plan_results_batch(alloc_index, [{
+            "plan": plan,
+            "node_allocation": node_allocation,
+            "node_update": node_update,
+            "node_preemptions": node_preemptions,
+            "deployment": deployment,
+            "deployment_updates": deployment_updates,
+        }])
+
+    def upsert_plan_results_batch(self, alloc_index: int,
+                                  plans: List[Dict]) -> int:
+        """Commit a batch of evaluated plans as ONE index bump / one
+        watcher notification (the applier merges a burst of plans into
+        one raft entry; fsm.go applyPlanResults semantics per plan,
+        applied in batch order)."""
         with self._lock:
             idx = self._next_index()
-            for allocs in node_update.values():
-                for a in allocs:
-                    self._upsert_alloc_locked(a, idx)
-            for allocs in node_preemptions.values():
-                for a in allocs:
-                    self._upsert_alloc_locked(a, idx)
-            for allocs in node_allocation.values():
-                for a in allocs:
-                    if a.job is None:
-                        a.job = plan.job
-                    self._upsert_alloc_locked(a, idx)
-            if deployment is not None:
-                deployment.modify_index = idx
-                if deployment.create_index == 0:
-                    deployment.create_index = idx
-                self._deployments[deployment.id] = deployment
-            for du in deployment_updates or []:
-                d = self._deployments.get(du.get("deployment_id"))
-                if d is not None:
-                    d = d.copy()
-                    d.status = du.get("status", d.status)
-                    d.status_description = du.get("status_description", d.status_description)
-                    d.modify_index = idx
-                    self._deployments[d.id] = d
+            self._own("deployments")
+            for p in plans:
+                plan = p["plan"]
+                for allocs in p["node_update"].values():
+                    for a in allocs:
+                        self._upsert_alloc_locked(a, idx)
+                for allocs in p["node_preemptions"].values():
+                    for a in allocs:
+                        self._upsert_alloc_locked(a, idx)
+                for allocs in p["node_allocation"].values():
+                    for a in allocs:
+                        if a.job is None:
+                            a.job = plan.job
+                        self._upsert_alloc_locked(a, idx)
+                deployment = p.get("deployment")
+                if deployment is not None:
+                    deployment.modify_index = idx
+                    if deployment.create_index == 0:
+                        deployment.create_index = idx
+                    self._deployments[deployment.id] = deployment
+                for du in p.get("deployment_updates") or []:
+                    d = self._deployments.get(du.get("deployment_id"))
+                    if d is not None:
+                        d = d.copy()
+                        d.status = du.get("status", d.status)
+                        d.status_description = du.get(
+                            "status_description", d.status_description)
+                        d.modify_index = idx
+                        self._deployments[d.id] = d
         self._notify(["allocs", "deployment"], idx)
         return idx
 

@@ -187,13 +187,41 @@ class Vault:
 
 @dataclass
 class Service:
-    """Service registration + health checks (structs/services.go)."""
+    """Service registration + health checks (structs/services.go).
+
+    ``connect`` is the service-mesh stanza (structs/services.go
+    ConsulConnect): ``{"sidecar_service": {"proxy": {"upstreams":
+    [{"destination_name": ..., "local_bind_port": ...}],
+    "local_service_port": N}}}`` for sidecar-proxied services, or
+    ``{"native": true}`` for connect-native workloads.
+    """
 
     name: str = ""
     port_label: str = ""
     provider: str = "builtin"
     tags: List[str] = field(default_factory=list)
     checks: List[Dict] = field(default_factory=list)
+    connect: Dict = field(default_factory=dict)
+
+    # -- connect helpers (services.go ConsulConnect methods) -------------
+
+    def has_sidecar(self) -> bool:
+        return bool(self.connect.get("sidecar_service") is not None)
+
+    def is_connect_native(self) -> bool:
+        return bool(self.connect.get("native"))
+
+    def sidecar_proxy(self) -> Dict:
+        sc = self.connect.get("sidecar_service") or {}
+        return sc.get("proxy") or {}
+
+    def upstreams(self) -> List[Dict]:
+        return list(self.sidecar_proxy().get("upstreams") or [])
+
+    def mesh_port_label(self) -> str:
+        """The dynamic port the scheduler assigns for the sidecar's
+        public (mesh) listener (jobConnectHook's injected port)."""
+        return f"connect-proxy-{self.name}"
 
 
 @dataclass

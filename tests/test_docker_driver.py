@@ -169,3 +169,147 @@ def test_parse_size_units():
     assert _parse_size("1.5GiB") == int(1.5 * 1024 ** 3)
     assert _parse_size("512kB") == 512 * 1000
     assert _parse_size("") == 0
+
+
+class TestEngineAPI:
+    """Engine-API stats + docklog against a scripted unix-socket
+    daemon (drivers/docker/stats.go math; docklog/docklog.go flow)."""
+
+    RAW_STATS = {
+        "cpu_stats": {
+            "cpu_usage": {"total_usage": 400_000_000},
+            "system_cpu_usage": 2_000_000_000,
+            "online_cpus": 4,
+        },
+        "precpu_stats": {
+            "cpu_usage": {"total_usage": 200_000_000},
+            "system_cpu_usage": 1_000_000_000,
+        },
+        "memory_stats": {
+            "usage": 104_857_600,
+            "stats": {"total_inactive_file": 4_857_600},
+        },
+    }
+
+    def _fake_engine(self, path):
+        import http.server
+        import json
+        import socket
+        import socketserver
+        import struct
+        import threading
+
+        raw = self.RAW_STATS
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path.endswith("/_ping"):
+                    body = b"OK"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif "/stats" in self.path:
+                    body = json.dumps(raw).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif "/logs" in self.path:
+                    self.send_response(200)
+                    self.end_headers()
+                    for stream, data in ((1, b"out-line-1\n"),
+                                         (2, b"err-line-1\n"),
+                                         (1, b"out-line-2\n")):
+                        self.wfile.write(
+                            struct.pack(">BBBBI", stream, 0, 0, 0,
+                                        len(data)) + data)
+                    # close ends the follow
+                elif "/version" in self.path:
+                    body = json.dumps({"Version": "24.0.0"}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+        class UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+            def get_request(self):
+                request, _ = self.socket.accept()
+                return request, ("", 0)
+
+        srv = UnixHTTPServer(path, Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def test_stats_math(self, tmp_path):
+        from nomad_tpu.drivers.docker_api import (
+            DockerEngine,
+            compute_cpu_percent,
+            memory_rss,
+        )
+
+        path = str(tmp_path / "docker.sock")
+        srv = self._fake_engine(path)
+        try:
+            engine = DockerEngine(path)
+            assert engine.ping()
+            raw = engine.stats("c1")
+        finally:
+            srv.shutdown()
+        # delta 0.2e9 over 1e9 across 4 cpus -> 80%
+        assert compute_cpu_percent(raw) == pytest.approx(80.0)
+        # usage minus reclaimable cache
+        assert memory_rss(raw) == 100_000_000
+
+    def test_driver_task_stats_via_engine(self, tmp_path):
+        from nomad_tpu.drivers.rawexec import _RawTask
+
+        path = str(tmp_path / "docker.sock")
+        srv = self._fake_engine(path)
+        drv = DockerDriver()
+        drv.engine_socket = path
+        c = TaskConfig(id="t1", name="web", alloc_id="a1-xyz",
+                       driver_config={"image": "busybox"},
+                       resources=structs.Resources())
+        task = _RawTask(c)
+        drv._tasks[c.id] = task
+        try:
+            stats = drv.task_stats(c.id)
+        finally:
+            srv.shutdown()
+        assert stats["cpu"]["percent"] == pytest.approx(80.0)
+        assert stats["memory"]["rss"] == 100_000_000
+
+    def test_docklog_streams_engine_logs_to_files(self, tmp_path):
+        import subprocess
+        import sys
+        import time
+
+        from nomad_tpu.drivers import docklog as docklog_mod
+
+        path = str(tmp_path / "docker.sock")
+        srv = self._fake_engine(path)
+        out_file = tmp_path / "stdout"
+        err_file = tmp_path / "stderr"
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-S", docklog_mod.__file__, path, "c1",
+                 str(out_file), str(err_file)],
+                start_new_session=True)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.1)
+        finally:
+            srv.shutdown()
+        assert out_file.read_bytes() == b"out-line-1\nout-line-2\n"
+        assert err_file.read_bytes() == b"err-line-1\n"

@@ -151,3 +151,149 @@ class TestDockerVolumesGate:
     def test_no_volumes_fine_without_flag(self):
         argv = DockerDriver()._command(cfg({"image": "nginx"}))
         assert "-v" not in argv
+
+
+class TestJavaFingerprintDepth:
+    """driver.java.version/runtime/vm attributes from `java -version`
+    (drivers/java/utils.go parse semantics), via a fake JVM binary."""
+
+    FAKE = (
+        "#!/bin/sh\n"
+        "echo 'openjdk version \"17.0.2\" 2022-01-18' >&2\n"
+        "echo 'OpenJDK Runtime Environment (build 17.0.2+8-86)' >&2\n"
+        "echo 'OpenJDK 64-Bit Server VM (build 17.0.2+8-86, mixed mode)'"
+        " >&2\n"
+    )
+
+    def test_version_runtime_vm_attributes(self, tmp_path):
+        import os
+        import stat
+
+        fake = tmp_path / "java"
+        fake.write_text(self.FAKE)
+        os.chmod(fake, stat.S_IRWXU)
+        drv = JavaDriver()
+        drv.java_bin = str(fake)
+        fp = drv.fingerprint()
+        assert fp.attributes["driver.java.version"] == "17.0.2"
+        assert "Runtime Environment" in fp.attributes["driver.java.runtime"]
+        assert "VM" in fp.attributes["driver.java.vm"]
+
+    def test_parse_helper(self):
+        from nomad_tpu.drivers.java import parse_java_version
+
+        v, rt, vm = parse_java_version(
+            'java version "1.8.0_292"\n'
+            "Java(TM) SE Runtime Environment (build 1.8.0_292-b10)\n"
+            "Java HotSpot(TM) 64-Bit Server VM (build 25.292-b10)\n")
+        assert v == "1.8.0_292"
+        assert "Runtime Environment" in rt
+        assert "VM" in vm
+
+    def test_executor_resource_opts(self):
+        """The JVM runs under the isolating executor with cgroup
+        limits from the task resources (driver.go StartTask)."""
+        from nomad_tpu.drivers.execdriver import isolation_support
+
+        drv = JavaDriver()
+        res = structs.Resources(cpu=750, memory_mb=640)
+        opts = drv._executor_opts(cfg({"jar_path": "/a.jar"},
+                                      resources=res))
+        support = isolation_support()
+        if support["cgroups"]:
+            assert "-mem_mb" in opts and "640" in opts
+            assert "-cpu_shares" in opts and "750" in opts
+        if support["namespaces"]:
+            assert "-isolate" in opts
+
+
+class TestQemuGracefulShutdown:
+    """QMP monitor-socket shutdown (drivers/qemu/driver.go StopTask's
+    graceful path), against a scripted QMP endpoint."""
+
+    def _fake_qmp(self, path, received):
+        import json
+        import socket
+        import threading
+
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(1)
+
+        def serve():
+            conn, _ = srv.accept()
+            f = conn.makefile("rwb")
+            f.write(json.dumps(
+                {"QMP": {"version": {}, "capabilities": []}}).encode()
+                + b"\n")
+            f.flush()
+            for line in f:
+                msg = json.loads(line)
+                received.append(msg.get("execute"))
+                f.write(b'{"return": {}}\n')
+                f.flush()
+                if msg.get("execute") == "system_powerdown":
+                    break
+            conn.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        return srv
+
+    def test_monitor_arg_in_command(self):
+        res = structs.Resources(memory_mb=512)
+        c = cfg({"image_path": "/img/linux.img"}, resources=res)
+        drv = QemuDriver()
+        argv = drv._command(c)
+        qmp = argv[argv.index("-qmp") + 1]
+        assert qmp.startswith("unix:") and qmp.endswith(",server,nowait")
+        assert drv.monitor_path(c) in qmp
+
+    def test_graceful_shutdown_disabled_drops_monitor(self):
+        res = structs.Resources(memory_mb=512)
+        argv = QemuDriver()._command(cfg({
+            "image_path": "/img/linux.img", "graceful_shutdown": False,
+        }, resources=res))
+        assert "-qmp" not in argv
+
+    def test_qmp_system_powerdown_handshake(self, tmp_path):
+        received = []
+        path = str(tmp_path / "qmp.sock")
+        srv = self._fake_qmp(path, received)
+        try:
+            ok = QemuDriver.qmp_system_powerdown(path, timeout=5.0)
+        finally:
+            srv.close()
+        assert ok
+        assert received == ["qmp_capabilities", "system_powerdown"]
+
+    def test_stop_task_prefers_graceful(self, tmp_path):
+        """stop_task sends system_powerdown and waits for the VM to
+        exit on its own before any signal."""
+        import threading
+
+        drv = QemuDriver()
+        c = cfg({"image_path": "/img/linux.img"})
+        c.alloc_dir = str(tmp_path)
+        # a fake running task whose monitor socket is our scripted QMP
+        from nomad_tpu.drivers.rawexec import _RawTask
+
+        task = _RawTask(c)
+        task.pid = task.pgid = 999999999        # never signalled
+        drv._tasks[c.id] = task
+        received = []
+        srv = self._fake_qmp(drv.monitor_path(c), received)
+
+        def guest_exits():
+            # the guest "powers down" shortly after the QMP command
+            while "system_powerdown" not in received:
+                pass
+            task.done.set()
+
+        threading.Thread(target=guest_exits, daemon=True).start()
+        try:
+            drv.stop_task(c.id, timeout=5.0)
+        finally:
+            srv.close()
+        assert task.done.is_set()
+        assert received == ["qmp_capabilities", "system_powerdown"]

@@ -1,0 +1,117 @@
+"""Live waves over the device mesh (VERDICT r2 missing #2).
+
+The coalescer's joint wave kernel runs with its node axis sharded over
+the mesh (parallel/sharded.make_joint_sharded): the SAME program, so
+placements must be identical to single-device dispatch — per-step
+argmax/top-k lower to per-shard reductions + cross-shard collectives
+(SURVEY.md §2.10 node-axis-over-ICI mapping). Tests run on the
+8-virtual-CPU mesh (conftest forces the device count).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from nomad_tpu import mock
+from nomad_tpu.parallel import coalesce
+
+
+@pytest.fixture
+def wave_mesh():
+    from nomad_tpu.parallel.sharded import wave_mesh as make
+
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return make(8)
+
+
+class TestShardedWaveParity:
+    def test_launch_wave_identical_to_single_device(self, wave_mesh):
+        from nomad_tpu.ops.kernel import (
+            LEAN_FEATURES,
+            build_kernel_in,
+            infer_features,
+        )
+        from nomad_tpu.parallel.synthetic import (
+            synthetic_cluster,
+            synthetic_eval,
+        )
+
+        cluster = synthetic_cluster(200, cpu=2000.0, mem=4096.0,
+                                    disk=50000.0, seed=5)
+        rng = np.random.default_rng(3)
+        kins, steps, feats = [], [], []
+        for i in range(5):
+            ev = synthetic_eval(cluster, desired_count=4)
+            kin = build_kernel_in(cluster, ev, 4)
+            kin = kin._replace(
+                ask_cpu=np.asarray(float(rng.choice([100, 300, 500])),
+                                   np.float32))
+            kins.append(kin)
+            steps.append(4)
+            feats.append(LEAN_FEATURES._replace(with_topk=True))
+
+        coalesce.configure_wave_mesh(None)
+        single = coalesce.launch_wave(kins, steps, feats)
+
+        before = coalesce.sharded_wave_launches
+        coalesce.configure_wave_mesh(wave_mesh)
+        try:
+            sharded = coalesce.launch_wave(kins, steps, feats)
+        finally:
+            coalesce.configure_wave_mesh(None)
+        assert coalesce.sharded_wave_launches == before + 1
+
+        for s, m in zip(single, sharded):
+            np.testing.assert_array_equal(np.asarray(s.chosen),
+                                          np.asarray(m.chosen))
+            np.testing.assert_array_equal(np.asarray(s.found),
+                                          np.asarray(m.found))
+            np.testing.assert_allclose(np.asarray(s.scores),
+                                       np.asarray(m.scores),
+                                       rtol=1e-6, atol=1e-7)
+        assert any(np.asarray(s.found).any() for s in single)
+
+
+class TestServerOverMesh:
+    def test_server_places_through_sharded_waves(self, wave_mesh):
+        """A live server with use_device_mesh=True places a batched
+        job's allocations through shard_map-style sharded waves."""
+        import time
+
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        before = coalesce.sharded_wave_launches
+        server = Server(ServerConfig(
+            num_workers=1, worker_batch_size=8, heartbeat_ttl=3600.0,
+            use_device_mesh=True,
+        ))
+        server.start()
+        try:
+            assert coalesce.wave_mesh_active()
+            for _ in range(30):
+                server.node_register(mock.node())
+            jobs = []
+            for _ in range(8):
+                job = mock.simple_job()
+                job.task_groups[0].count = 3
+                jobs.append(job)
+                server.job_register(job)
+            deadline = time.time() + 120
+            placed = 0
+            while time.time() < deadline:
+                snap = server.state.snapshot()
+                placed = sum(len(snap.allocs_by_job(j.namespace, j.id))
+                             for j in jobs)
+                if placed >= 24:
+                    break
+                time.sleep(0.1)
+            assert placed >= 24, placed
+            assert coalesce.sharded_wave_launches > before
+            # placements are real: every alloc row maps to a node with
+            # capacity accounting in the usage planes
+            u = server.state.snapshot().usage
+            assert float(u.used_cpu.sum()) >= 24 * 500
+        finally:
+            server.shutdown()
+            coalesce.configure_wave_mesh(None)
