@@ -7,9 +7,12 @@ merged config, then NewHTTPServers (http.go:86) exposes /v1.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+LOG = logging.getLogger(__name__)
 
 
 @dataclass
@@ -40,6 +43,13 @@ class AgentConfig:
     raft_peers: List[str] = field(default_factory=list)
     #: address peers dial (host:port); required when binding 0.0.0.0
     raft_advertise: str = ""
+    # WAN federation auto-join (serf retry_join analog, agent.go
+    # retryJoin/command server_join stanza): entries "region@http_url";
+    # retried with backoff until every entry has joined. 0 attempts =
+    # retry forever.
+    retry_join: List[str] = field(default_factory=list)
+    retry_join_interval: float = 5.0
+    retry_join_max_attempts: int = 0
 
     @classmethod
     def dev(cls, **overrides) -> "AgentConfig":
@@ -154,6 +164,8 @@ class Agent:
             if self.server.raft is None:
                 # standalone server is immediately the authority
                 self.server.establish_leadership()
+            if self.config.retry_join:
+                self._start_retry_join()
         if self.client is not None:
             # advertise this agent's HTTP address on the node so
             # servers can pass /v1/client/* requests through
@@ -161,6 +173,58 @@ class Agent:
             self.client.node.http_addr = self.http.addr
             self.client.start()
         self.http.start()
+
+    def _start_retry_join(self) -> None:
+        """Background WAN auto-join (serf retry_join / agent.go
+        retryJoin): keep attempting each configured region join with
+        backoff until it lands; an unreachable peer at boot must not
+        fail the agent, and a later-started peer is joined as soon as
+        it answers. The join is recorded through raft (join_region),
+        so a success survives failover."""
+        import threading
+
+        def run() -> None:
+            import time as _time
+
+            pending = {}
+            for entry in self.config.retry_join:
+                region, _, addr = str(entry).partition("@")
+                if not region or not addr:
+                    LOG.warning("retry_join: malformed entry %r "
+                                "(want region@http_url)", entry)
+                    continue
+                if region == self.config.region:
+                    continue
+                pending[region] = addr
+            attempt = 0
+            delay = self.config.retry_join_interval
+            while pending and not self.server._shutdown.is_set():
+                attempt += 1
+                for region, addr in list(pending.items()):
+                    try:
+                        # verify the peer answers before recording it
+                        from nomad_tpu.api.client import APIClient
+
+                        tls = getattr(self.server, "tls_api", None) or {}
+                        APIClient(addr, **tls).get("/v1/agent/self")
+                        self.server.join_region(region, addr)
+                        del pending[region]
+                        LOG.info("retry_join: joined region %s at %s",
+                                 region, addr)
+                    except Exception as e:      # noqa: BLE001
+                        LOG.debug("retry_join %s (%s): %s",
+                                  region, addr, e)
+                maxa = self.config.retry_join_max_attempts
+                if pending and maxa and attempt >= maxa:
+                    LOG.warning("retry_join: giving up on %s after %d "
+                                "attempts", sorted(pending), attempt)
+                    return
+                if pending:
+                    self.server._shutdown.wait(delay)
+                    delay = min(delay * 1.5, 60.0)
+
+        threading.Thread(target=run, daemon=True,
+                         name="retry-join").start()
 
     def shutdown(self) -> None:
         if self.client is not None:

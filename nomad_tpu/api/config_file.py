@@ -106,6 +106,19 @@ def _apply_body(cfg, body: Body):
             cfg.raft_peers = [str(p) for p in sa["raft_peers"]]
         if "raft_advertise" in sa:
             cfg.raft_advertise = str(sa["raft_advertise"])
+        # server_join stanza (agent config server_join/retry_join):
+        # retry_join entries are "region@http_url" for WAN federation
+        sj = srv[1].first_block("server_join")
+        if sj is not None:
+            ja = sj[1].attrs
+            if "retry_join" in ja:
+                cfg.retry_join = [str(x) for x in ja["retry_join"]]
+            if "retry_max" in ja:
+                cfg.retry_join_max_attempts = int(ja["retry_max"])
+            if "retry_interval" in ja:
+                from nomad_tpu.jobspec.hcl import duration_s
+
+                cfg.retry_join_interval = duration_s(ja["retry_interval"])
 
     cli = body.first_block("client")
     if cli is not None:

@@ -178,6 +178,11 @@ class HTTPAgent:
             auth = handler.headers.get("Authorization", "")
             if auth.startswith("Bearer "):
                 token = auth[7:]
+        if not token:
+            # browsers cannot set headers on WebSocket upgrades; the
+            # UI's exec terminal passes the token as a query param
+            # (the reference UI does the same, ui/app/services/token.js)
+            token = (query.get("x_nomad_token") or [""])[0]
 
         # cross-region forwarding (rpc.go:537 forward/forwardRegion):
         # a request naming another region proxies to a server there

@@ -63,14 +63,16 @@ class AllocConnect:
         # thread can never spawn into an already-reaped state
         self._lock = threading.Lock()
 
-    def add_proxy(self, proc: subprocess.Popen, desc: str) -> bool:
-        """Track a spawned proxy; False (caller must kill it) when
+    def add_proxy(self, proc: subprocess.Popen,
+                  desc: str) -> Optional[_Proxy]:
+        """Track a spawned proxy; None (caller must kill it) when
         the alloc was already destroyed."""
         with self._lock:
             if self._stop.is_set():
-                return False
-            self.proxies.append(_Proxy(proc, desc))
-            return True
+                return None
+            p = _Proxy(proc, desc)
+            self.proxies.append(p)
+            return p
 
     def destroy(self) -> None:
         with self._lock:
@@ -188,23 +190,25 @@ class ConnectManager:
             f"resolvable port label '{svc.port_label}'")
 
     def _spawn(self, state: AllocConnect, netns: str, cfg: Dict,
-               desc: str) -> None:
+               desc: str) -> Optional[_Proxy]:
         argv = ["ip", "netns", "exec", netns, sys.executable, "-S",
                 PROXY_PROGRAM, json.dumps(cfg)]
         proc = subprocess.Popen(
             argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             start_new_session=True,
         )
-        if not state.add_proxy(proc, desc):
+        tracked = state.add_proxy(proc, desc)
+        if tracked is None:
             # destroy() won between spawn decision and tracking: the
             # alloc is gone, reap the orphan immediately
             try:
                 proc.kill()
             except OSError:
                 pass
-            return
+            return None
         LOG.info("connect %s: %s (pid %d)", state.alloc_id[:8], desc,
                  proc.pid)
+        return tracked
 
     def _start_sidecar(self, state, alloc, svc, net, token: str) -> None:
         _host_port, ns_port = self._mesh_ports(alloc, svc)
@@ -231,44 +235,63 @@ class ConnectManager:
         # (the intentions-allow analog)
         token = self.rpc.mesh_identity_token(alloc.namespace, dest)
 
-        def resolve_and_start() -> None:
+        def resolve(delay: float):
+            try:
+                regs = self.rpc.services_by_name(
+                    alloc.namespace, f"{dest}-sidecar-proxy")
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("connect upstream %s: resolve: %s", dest, e)
+                return None
+            if not regs:
+                return None
+            addr = str(regs[0]["Address"])
+            # host-local destinations: inside the namespace, 127.0.0.1
+            # is the netns loopback — the node's listeners (port
+            # relays) live at the bridge gateway address
+            if addr in ("127.0.0.1", "localhost", "0.0.0.0") \
+                    and net.gateway:
+                addr = net.gateway
+            return (addr, int(regs[0]["Port"]))
+
+        def watch() -> None:
             import time as _time
 
+            current = None      # (addr, port) the live proxy targets
+            proxy = None
             delay = 0.2
             while not state._stop.is_set():
-                try:
-                    regs = self.rpc.services_by_name(
-                        alloc.namespace, f"{dest}-sidecar-proxy")
-                except Exception as e:          # noqa: BLE001
-                    LOG.warning("connect upstream %s: resolve: %s",
-                                dest, e)
-                    regs = []
-                if regs:
-                    addr = str(regs[0]["Address"])
-                    # host-local destinations: inside the namespace,
-                    # 127.0.0.1 is the netns loopback — the node's
-                    # listeners (port relays) live at the bridge
-                    # gateway address
-                    if addr in ("127.0.0.1", "localhost", "0.0.0.0") \
-                            and net.gateway:
-                        addr = net.gateway
-                    target = [addr, int(regs[0]["Port"])]
+                target = resolve(delay)
+                if target is not None and target != current:
+                    # destination appeared or MOVED (rescheduled alloc
+                    # gets a new node/mesh port): point the upstream at
+                    # the new sidecar — envoy's cluster discovery keeps
+                    # endpoints current the same way
+                    if proxy is not None:
+                        try:
+                            proxy.proc.terminate()
+                        except OSError:
+                            pass
                     cfg = {
                         "mode": "upstream",
                         "listen": ["127.0.0.1", bind],
-                        "target": target,
+                        "target": list(target),
                         "token": token,
                     }
-                    self._spawn(
+                    proxy = self._spawn(
                         state, net.ns_name, cfg,
                         f"upstream {dest} 127.0.0.1:{bind} -> "
                         f"{target[0]}:{target[1]}")
-                    return
-                _time.sleep(delay)
-                delay = min(delay * 1.5, 3.0)
+                    if proxy is None:
+                        return          # alloc destroyed mid-spawn
+                    current = target
+                    delay = 5.0         # steady-state watch cadence
+                elif current is None:
+                    _time.sleep(delay)
+                    delay = min(delay * 1.5, 3.0)
+                    continue
+                state._stop.wait(delay)
 
         # the destination may not be registered yet (its alloc is still
-        # starting); resolve in the background like the reference's
-        # envoy cluster discovery keeps retrying
-        threading.Thread(target=resolve_and_start, daemon=True,
+        # starting) and may move later; watch in the background
+        threading.Thread(target=watch, daemon=True,
                          name=f"connect-resolve-{dest}").start()

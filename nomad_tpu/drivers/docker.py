@@ -99,17 +99,23 @@ class DockerDriver(RawExecDriver):
     #: engine socket; overridable for tests (fake engine)
     engine_socket = "/var/run/docker.sock"
 
-    def _engine(self):
+    def _engine(self, ping: bool = True):
         """Engine API client when the daemon socket answers, else
-        None (CLI fallbacks remain)."""
+        None (CLI fallbacks remain). ``ping=False`` skips the probe
+        roundtrip for callers that already handle call failure."""
         import os
 
         from nomad_tpu.drivers.docker_api import DockerEngine
 
         if not os.path.exists(self.engine_socket):
             return None
-        engine = DockerEngine(self.engine_socket)
-        return engine if engine.ping() else None
+        try:
+            engine = DockerEngine(self.engine_socket)
+            if ping and not engine.ping():
+                return None
+            return engine
+        except Exception:                       # noqa: BLE001
+            return None
 
     def start_task(self, config: TaskConfig) -> TaskHandle:
         import os
@@ -131,13 +137,13 @@ class DockerDriver(RawExecDriver):
         finally:
             config.std_out_path, config.std_err_path = real_out, real_err
         if engine_live:
-            self._start_docklog(config, handle)
+            self._start_docklog(config, handle, engine_checked=True)
         return handle
 
     # -- docklog (drivers/docker/docklog/docklog.go) ---------------------
 
     def _start_docklog(self, config: TaskConfig, handle: TaskHandle,
-                       since: int = 0) -> None:
+                       since: int = 0, engine_checked: bool = False) -> None:
         """Detached engine-log follower: task output keeps flowing
         across agent restarts independent of the CLI attachment. Only
         when the engine socket is live (CLI-attached logs still work
@@ -146,7 +152,7 @@ class DockerDriver(RawExecDriver):
         import os
         import sys as _sys
 
-        if self._engine() is None:
+        if not engine_checked and self._engine() is None:
             return
         workdir = config.alloc_dir or "/tmp"
         stdout = config.std_out_path or os.path.join(workdir, "stdout")
@@ -319,9 +325,13 @@ class DockerDriver(RawExecDriver):
         raw cgroup counters + cpu-delta math), falling back to the CLI
         then to process stats."""
         task = self._get(task_id)
-        engine = self._engine()
+        # no ping: the stats call itself is the probe (halves socket
+        # traffic on the collection hot path); any transport flake
+        # falls back to the CLI below
+        engine = self._engine(ping=False)
         if engine is not None:
             from nomad_tpu.drivers.docker_api import (
+                TRANSPORT_ERRORS,
                 EngineError,
                 compute_cpu_percent,
                 memory_rss,
@@ -333,7 +343,7 @@ class DockerDriver(RawExecDriver):
                     "cpu": {"percent": compute_cpu_percent(raw)},
                     "memory": {"rss": memory_rss(raw)},
                 }
-            except (OSError, EngineError):
+            except TRANSPORT_ERRORS + (EngineError,):
                 pass
         out = subprocess.run(
             ["docker", "stats", "--no-stream", "--format", "{{json .}}",

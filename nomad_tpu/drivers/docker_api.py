@@ -18,7 +18,12 @@ import http.client
 import json
 import socket
 import struct
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Tuple
+
+#: everything a flaky daemon/socket can throw at a caller that wants
+#: to fall back rather than fail (half-up proxies raise HTTPException
+#: subclasses; truncated bodies raise ValueError via json)
+TRANSPORT_ERRORS: Tuple = (OSError, http.client.HTTPException, ValueError)
 
 DEFAULT_SOCKET = "/var/run/docker.sock"
 API_VERSION = "v1.40"
@@ -75,7 +80,7 @@ class DockerEngine:
             ok = resp.read() == b"OK"
             resp.close()
             return ok
-        except (OSError, EngineError):
+        except TRANSPORT_ERRORS + (EngineError,):
             return False
 
     def version(self) -> Dict:
@@ -96,14 +101,31 @@ class DockerEngine:
              f"&stdout={'1' if stdout else '0'}"
              f"&stderr={'1' if stderr else '0'}&since={since}")
         resp = self._request("GET", q, timeout=None if follow else 30.0)
+
+        def read_exact(n: int) -> bytes:
+            # resp.read(n) may return short on connection hiccups; a
+            # short frame must end the stream, never misalign the next
+            # header
+            buf = b""
+            while len(buf) < n:
+                chunk = resp.read(n - len(buf))
+                if not chunk:
+                    break
+                buf += chunk
+            return buf
+
         try:
             while True:
-                head = resp.read(8)
+                head = read_exact(8)
                 if len(head) < 8:
                     return
                 stream, _, _, _, size = struct.unpack(">BBBBI", head)
-                data = resp.read(size)
-                if not data:
+                if size == 0:
+                    continue        # empty frame is not end-of-stream
+                data = read_exact(size)
+                if len(data) < size:
+                    if data:
+                        yield stream, data
                     return
                 yield stream, data
         finally:

@@ -47,6 +47,7 @@ _WAVE_BUCKETS = (1, 4, 16, 64, 256)
 #: argmax/top-k become ICI collectives (SURVEY.md section 2.10). None
 #: = single-device dispatch. Results are identical either way.
 _WAVE_MESH = None
+_WAVE_MESH_REFS = 0
 #: waves dispatched through the sharded path (asserted by tests)
 sharded_wave_launches = 0
 
@@ -54,9 +55,28 @@ sharded_wave_launches = 0
 def configure_wave_mesh(mesh) -> None:
     """Route subsequent waves over ``mesh`` (None restores
     single-device dispatch). Server.start() calls this when multiple
-    devices are visible (ServerConfig.use_device_mesh)."""
-    global _WAVE_MESH
+    devices are visible (ServerConfig.use_device_mesh). Prefer
+    acquire/release_wave_mesh for lifecycle-scoped users (multiple
+    servers in one process share the global)."""
+    global _WAVE_MESH, _WAVE_MESH_REFS
     _WAVE_MESH = mesh
+    _WAVE_MESH_REFS = 0 if mesh is None else max(_WAVE_MESH_REFS, 1)
+
+
+def acquire_wave_mesh(mesh) -> None:
+    """Refcounted adoption: the mesh stays active until every owner
+    released it (two in-process servers must not disable each other's
+    sharded dispatch on shutdown)."""
+    global _WAVE_MESH, _WAVE_MESH_REFS
+    _WAVE_MESH = mesh
+    _WAVE_MESH_REFS += 1
+
+
+def release_wave_mesh() -> None:
+    global _WAVE_MESH, _WAVE_MESH_REFS
+    _WAVE_MESH_REFS = max(_WAVE_MESH_REFS - 1, 0)
+    if _WAVE_MESH_REFS == 0:
+        _WAVE_MESH = None
 
 
 def wave_mesh_active() -> bool:

@@ -152,7 +152,13 @@ _JOINT_SPECS = dict(
 
 assert set(_JOINT_SPECS) == set(KernelIn._fields)
 
-_joint_sharded_cache: dict = {}
+import weakref
+
+# keyed by the live mesh OBJECT (weakly): a freed mesh's entry
+# evicts itself, and an unrelated mesh allocated at the same address
+# can never collide with a stale jit bound to dead devices
+_joint_sharded_cache: "weakref.WeakKeyDictionary" = \
+    weakref.WeakKeyDictionary()
 
 
 def make_joint_sharded(mesh: Mesh):
@@ -161,7 +167,7 @@ def make_joint_sharded(mesh: Mesh):
     variants are cached by jit itself (static args)."""
     from nomad_tpu.ops.kernel import place_taskgroups_joint
 
-    key = id(mesh)
+    key = mesh
     hit = _joint_sharded_cache.get(key)
     if hit is not None:
         return hit

@@ -131,7 +131,10 @@ class ExternalDriver(DriverPlugin):
         )
         try:
             import select
-            r, _, _ = select.select([self._proc.stdout], [], [], 5.0)
+            # generous: a python plugin's interpreter+SDK import can
+            # take seconds on a loaded machine; a crashed plugin still
+            # fails fast via the EOF/readline path below
+            r, _, _ = select.select([self._proc.stdout], [], [], 30.0)
             if not r:
                 raise PluginCrashed(
                     f"plugin {self.argv}: handshake timeout")

@@ -212,7 +212,7 @@ class Server:
                 return
             if len(devs) < 2:
                 return
-            coalesce.configure_wave_mesh(wave_mesh(devices=devs))
+            coalesce.acquire_wave_mesh(wave_mesh(devices=devs))
             self._wave_mesh_owner = True
             LOG.info("placement waves sharded over %d %s devices",
                      len(devs), devs[0].platform)
@@ -224,7 +224,7 @@ class Server:
         if self._wave_mesh_owner:
             from nomad_tpu.parallel import coalesce
 
-            coalesce.configure_wave_mesh(None)
+            coalesce.release_wave_mesh()
             self._wave_mesh_owner = False
         self.vault.stop()
         for w in self.workers:

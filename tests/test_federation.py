@@ -154,3 +154,68 @@ class TestACLReplication:
             assert fresh.region_addr("west") == "http://west:4646"
         finally:
             server.shutdown()
+
+
+class TestRetryJoin:
+    """WAN auto-join (serf retry_join analog, agent.go retryJoin): an
+    agent configured with region@url entries keeps retrying until the
+    peer answers — including peers that start AFTER it."""
+
+    def test_joins_peer_that_starts_later(self):
+        import socket
+        import time
+
+        from nomad_tpu.api.agent import Agent, AgentConfig
+
+        # reserve the west agent's port before it exists
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        west_port = probe.getsockname()[1]
+        probe.close()
+
+        east = Agent(AgentConfig(
+            name="rj-east", region="east",
+            retry_join=[f"west@http://127.0.0.1:{west_port}"],
+            retry_join_interval=0.2,
+        ))
+        east.start()
+        west = None
+        try:
+            # east is up; west does not exist yet -> no join recorded
+            time.sleep(0.6)
+            assert east.server.region_addr("west") is None
+
+            west = Agent(AgentConfig(
+                name="rj-west", region="west", http_port=west_port))
+            west.start()
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if east.server.region_addr("west"):
+                    break
+                time.sleep(0.1)
+            assert east.server.region_addr("west") == \
+                f"http://127.0.0.1:{west_port}"
+        finally:
+            east.shutdown()
+            if west is not None:
+                west.shutdown()
+
+    def test_config_file_server_join_stanza(self, tmp_path):
+        from nomad_tpu.api.config_file import load_config_files
+
+        p = tmp_path / "agent.hcl"
+        p.write_text("""
+server {
+  enabled = true
+  server_join {
+    retry_join     = ["west@http://h2:4646", "eu@https://h3:4646"]
+    retry_max      = 12
+    retry_interval = "30s"
+  }
+}
+""")
+        cfg = load_config_files([str(p)])
+        assert cfg.retry_join == ["west@http://h2:4646",
+                                  "eu@https://h3:4646"]
+        assert cfg.retry_join_max_attempts == 12
+        assert cfg.retry_join_interval == 30.0

@@ -87,3 +87,83 @@ class TestAllocStubResources:
         assert nodes and nodes[0]["NodeResources"]["CPU"] > 0
         assert nodes[0]["NodeResources"]["MemoryMB"] > 0
         assert "NodeResources" not in api.get("/v1/nodes")[0]
+
+
+class TestUIExecTerminal:
+    """The exec terminal's code path: the UI builds
+    /v1/client/allocation/<id>/exec?task&tty&command&x_nomad_token and
+    speaks the JSON-frame protocol over a websocket. This drives the
+    EXACT request shape the SPA constructs (viewExec)."""
+
+    def test_ui_document_has_exec_view_and_event_stream(self, agent):
+        body = _get(agent, "/ui").read().decode()
+        assert "viewExec" in body
+        assert "/exec/" in body
+        assert "startEventStream" in body
+        assert "/v1/event/stream" in body
+        assert "x_nomad_token" in body
+
+    def test_exec_websocket_via_ui_url_shape(self):
+        import base64
+        import json as _json
+        import urllib.parse
+
+        from nomad_tpu.utils import ws as wslib
+
+        # a dev agent: the exec session needs a real client + driver
+        agent = Agent(AgentConfig.dev(name="ui-exec-agent"))
+        agent.start()
+        try:
+            self._drive_exec(agent, base64, _json, urllib.parse, wslib)
+        finally:
+            agent.shutdown()
+
+    def _drive_exec(self, agent, base64, _json, urlparse, wslib):
+        job = mock.job()
+        job.constraints = []
+        tg = job.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c", "sleep 120"]}
+        agent.server.job_register(job)
+        deadline = time.time() + 30
+        alloc = None
+        while time.time() < deadline:
+            allocs = agent.server.state.snapshot().allocs_by_job(
+                job.namespace, job.id)
+            alloc = next((a for a in allocs
+                          if a.client_status == "running"), None)
+            if alloc:
+                break
+            time.sleep(0.2)
+        assert alloc is not None, "task never ran"
+
+        # the SPA's URL shape: query-string token + JSON command
+        qs = urlparse.urlencode({
+            "task": task.name, "tty": "false",
+            "command": _json.dumps(["/bin/sh"]),
+            "x_nomad_token": "",
+        })
+        url = (f"{agent.http_addr}/v1/client/allocation/"
+               f"{alloc.id}/exec?{qs}")
+        conn = wslib.connect(url)
+        try:
+            line = b"echo ui-exec-$((40+2))\n"
+            conn.send(_json.dumps(
+                {"stdin": {"data":
+                           base64.b64encode(line).decode()}}).encode())
+            got = b""
+            deadline = time.time() + 20
+            while b"ui-exec-42" not in got and time.time() < deadline:
+                op, data = conn.recv()
+                if op == wslib.OP_TEXT:
+                    frame = _json.loads(data)
+                    for k in ("stdout", "stderr"):
+                        d = (frame.get(k) or {}).get("data")
+                        if d:
+                            got += base64.b64decode(d)
+            assert b"ui-exec-42" in got
+        finally:
+            conn.close()
