@@ -10,9 +10,11 @@ map onto each alloc's namespace IP.
 Deviations from the reference, both documented:
 - the reference wires port maps with iptables DNAT via CNI; this
   environment has no netfilter NAT, so host-port -> alloc-port
-  mappings run as a userspace TCP relay per mapping (same observable
-  contract: connect to the node's host port, reach the alloc's
-  container port)
+  mappings run through the NATIVE splice(2) relay (native/relay.cc):
+  one detached epoll process per allocation moving bytes in kernel
+  space, surviving agent restarts the way DNAT rules do (pid persisted
+  under /tmp/nomad-tpu-relays for teardown). A per-connection Python
+  relay remains as the fallback when the binary cannot build.
 - DNS/config files are inherited from the host (no per-ns resolv.conf)
 
 Capability-gated: ``bridge_supported()`` probes netns/veth privileges
@@ -137,17 +139,115 @@ class _PortForward:
                 pass
 
 
+RELAY_STATE_DIR = "/tmp/nomad-tpu-relays"
+
+
+class _NativeRelay:
+    """Detached native/relay.cc process carrying every port map of one
+    allocation (the DNAT analog: kernel-space splice, survives agent
+    restarts; the pid is persisted for teardown)."""
+
+    def __init__(self, alloc_id: str, pid: int, status_path: str) -> None:
+        self.alloc_id = alloc_id
+        self.pid = pid
+        self.status_path = status_path
+
+    def stop(self) -> None:
+        import os
+        import signal as _signal
+
+        try:
+            os.kill(self.pid, _signal.SIGTERM)
+        except OSError:
+            pass
+        try:
+            os.unlink(self.status_path)
+        except OSError:
+            pass
+
+    @classmethod
+    def spawn(cls, alloc_id: str,
+              mappings: List[Tuple[int, int]], target_ip: str,
+              timeout: float = 5.0) -> "_NativeRelay":
+        import os
+        import time
+
+        from nomad_tpu.drivers.rawexec import executor_path
+
+        # the relay builds with the executor (same Makefile)
+        if executor_path() is None:
+            raise RuntimeError("native toolchain unavailable")
+        binary = os.path.join(
+            os.path.dirname(executor_path()), "relay")
+        if not os.path.exists(binary):
+            raise RuntimeError("native relay binary missing")
+        os.makedirs(RELAY_STATE_DIR, exist_ok=True)
+        status = os.path.join(RELAY_STATE_DIR, f"{alloc_id}.status")
+        try:
+            os.unlink(status)
+        except OSError:
+            pass
+        specs = [f"{host}:{target_ip}:{cont}"
+                 for host, cont in mappings]
+        proc = subprocess.Popen(
+            [binary, status] + specs,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        deadline = time.time() + timeout
+        pid = 0
+        while time.time() < deadline:
+            try:
+                with open(status) as f:
+                    content = f.read()
+            except FileNotFoundError:
+                content = ""
+            for line in content.splitlines():
+                if line.startswith("pid "):
+                    pid = int(line.split()[1])
+                if line.startswith("error "):
+                    raise RuntimeError(f"relay: {line[6:]}")
+                if line.startswith("ready "):
+                    return cls(alloc_id, pid, status)
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"relay exited rc={proc.returncode} before ready")
+            time.sleep(0.01)
+        raise RuntimeError("relay did not report ready")
+
+    @staticmethod
+    def kill_persisted(alloc_id: str) -> None:
+        """Teardown after an agent restart: the live process is found
+        through the persisted status file, not agent memory."""
+        import os
+        import signal as _signal
+
+        status = os.path.join(RELAY_STATE_DIR, f"{alloc_id}.status")
+        try:
+            with open(status) as f:
+                for line in f:
+                    if line.startswith("pid "):
+                        try:
+                            os.kill(int(line.split()[1]), _signal.SIGTERM)
+                        except OSError:
+                            pass
+            os.unlink(status)
+        except OSError:
+            pass
+
+
 class AllocNetwork:
     """One allocation's namespace + relays (network_hook state)."""
 
     def __init__(self, alloc_id: str, ns_name: str, ip: str,
                  veth_host: str, forwards: List[_PortForward],
-                 gateway: str = "") -> None:
+                 gateway: str = "", native_relay=None) -> None:
         self.alloc_id = alloc_id
         self.ns_name = ns_name
         self.ip = ip
         self.veth_host = veth_host
         self.forwards = forwards
+        self.native_relay = native_relay
         # the bridge address: how processes INSIDE the namespace reach
         # host-bound listeners (port relays, other allocs' host ports)
         self.gateway = gateway
@@ -253,6 +353,7 @@ class BridgeNetworkManager:
              "via", f"{self.subnet_prefix}.{GATEWAY_HOST}"],
         ]
         forwards: List[_PortForward] = []
+        native_relay = None
         try:
             for argv in steps:
                 out = _run(argv)
@@ -260,15 +361,23 @@ class BridgeNetworkManager:
                     raise RuntimeError(
                         f"{' '.join(argv)}: "
                         f"{out.stderr.decode(errors='replace').strip()}")
-            for host_port, container_port in port_mappings:
-                fwd = _PortForward(host_port, ip, container_port)
-                fwd.start()
-                forwards.append(fwd)
+            if port_mappings:
+                try:
+                    native_relay = _NativeRelay.spawn(
+                        alloc_id, port_mappings, ip)
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("native relay unavailable (%s); using "
+                                "in-process port relays", e)
+                    for host_port, container_port in port_mappings:
+                        fwd = _PortForward(host_port, ip, container_port)
+                        fwd.start()
+                        forwards.append(fwd)
         except Exception:
-            self._teardown(ns, veth_h, ip, forwards)
+            self._teardown(ns, veth_h, ip, forwards, native_relay)
             raise
         net = AllocNetwork(alloc_id, ns, ip, veth_h, forwards,
-                           gateway=f"{self.subnet_prefix}.{GATEWAY_HOST}")
+                           gateway=f"{self.subnet_prefix}.{GATEWAY_HOST}",
+                           native_relay=native_relay)
         with self._lock:
             self._allocs[alloc_id] = net
         return net
@@ -277,13 +386,19 @@ class BridgeNetworkManager:
         with self._lock:
             net = self._allocs.pop(alloc_id, None)
         if net is None:
+            # an alloc from a previous agent process may still have a
+            # live detached relay; the persisted pid file finds it
+            _NativeRelay.kill_persisted(alloc_id)
             return
-        self._teardown(net.ns_name, net.veth_host, net.ip, net.forwards)
+        self._teardown(net.ns_name, net.veth_host, net.ip, net.forwards,
+                       net.native_relay)
 
     def _teardown(self, ns: str, veth_h: str, ip: str,
-                  forwards: List[_PortForward]) -> None:
+                  forwards: List[_PortForward], native_relay=None) -> None:
         for fwd in forwards:
             fwd.stop()
+        if native_relay is not None:
+            native_relay.stop()
         _run(["ip", "netns", "del", ns])
         _run(["ip", "link", "del", veth_h])
         try:

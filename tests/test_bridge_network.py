@@ -130,3 +130,101 @@ class TestSameContainerPort:
                 assert read_alloc_id(port) == alloc_id
         finally:
             agent.shutdown()
+
+
+class TestNativeRelay:
+    """native/relay.cc: the DNAT-analog splice relay — detached from
+    the agent, restart-survivable, torn down via the persisted pid."""
+
+    def _echo_server(self):
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        import threading
+
+        def serve():
+            while True:
+                try:
+                    c, _ = srv.accept()
+                except OSError:
+                    return
+
+                def h(c=c):
+                    try:
+                        while True:
+                            d = c.recv(65536)
+                            if not d:
+                                break
+                            c.sendall(d)
+                    except OSError:
+                        pass
+                    finally:
+                        c.close()
+
+                threading.Thread(target=h, daemon=True).start()
+
+        threading.Thread(target=serve, daemon=True).start()
+        return srv, srv.getsockname()[1]
+
+    def test_spawn_relay_and_teardown_by_persisted_pid(self):
+        import os
+
+        from nomad_tpu.client.network_manager import _NativeRelay
+
+        srv, tport = self._echo_server()
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        lport = probe.getsockname()[1]
+        probe.close()
+        relay = _NativeRelay.spawn(
+            "test-relay-alloc", [(lport, tport)], "127.0.0.1")
+        try:
+            c = socket.create_connection(("127.0.0.1", lport), timeout=5)
+            c.sendall(b"relay-roundtrip")
+            c.shutdown(socket.SHUT_WR)
+            got = b""
+            while True:
+                d = c.recv(65536)
+                if not d:
+                    break
+                got += d
+            assert got == b"relay-roundtrip"
+            # the relay is NOT a child the agent must wait on: it has
+            # its own session (survives agent exit, like DNAT rules)
+            assert os.getsid(relay.pid) != os.getsid(os.getpid())
+        finally:
+            # teardown via the persisted status file, the path an
+            # agent that restarted (lost the pid from memory) takes
+            _NativeRelay.kill_persisted("test-relay-alloc")
+            srv.close()
+        def gone(pid):
+            # kill(pid, 0) succeeds on zombies (the relay is our
+            # unreaped child here); /proc state tells the truth
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    return f.read().split(")")[1].split()[0] == "Z"
+            except OSError:
+                return True
+
+        deadline = time.time() + 5
+        while time.time() < deadline and not gone(relay.pid):
+            time.sleep(0.05)
+        assert gone(relay.pid), "relay survived persisted-pid teardown"
+
+    def test_bridge_alloc_uses_native_relay(self):
+        from nomad_tpu.client.network_manager import BridgeNetworkManager
+
+        mgr = BridgeNetworkManager()
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        hport = probe.getsockname()[1]
+        probe.close()
+        net = mgr.create("relaytest-1111-2222-3333-444455556666",
+                         [(hport, 8080)])
+        try:
+            assert net.native_relay is not None, \
+                "bridge alloc should carry ports via the native relay"
+            assert not net.forwards
+        finally:
+            mgr.destroy("relaytest-1111-2222-3333-444455556666")
