@@ -181,8 +181,14 @@ class HTTPAgent:
         if not token:
             # browsers cannot set headers on WebSocket upgrades; the
             # UI's exec terminal passes the token as a query param
-            # (the reference UI does the same, ui/app/services/token.js)
-            token = (query.get("x_nomad_token") or [""])[0]
+            # (the reference UI does the same, ui/app/services/token.js).
+            # Accepted ONLY for upgrade/stream requests — on plain
+            # requests a query token would leak into access logs,
+            # proxies, and browser history.
+            is_upgrade = "upgrade" in (
+                handler.headers.get("Connection", "").lower())
+            if is_upgrade or path == "/v1/event/stream":
+                token = (query.get("x_nomad_token") or [""])[0]
 
         # cross-region forwarding (rpc.go:537 forward/forwardRegion):
         # a request naming another region proxies to a server there

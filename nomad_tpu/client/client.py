@@ -90,9 +90,13 @@ class InProcessRPC:
         wrapper -> NomadServiceProvider)."""
         return self.server.service_register(regs)
 
-    def mesh_identity_token(self, namespace: str, service: str) -> str:
-        """Connect mesh credential (consul.go DeriveSITokens analog)."""
-        return self.server.mesh_identity_token(namespace, service)
+    def mesh_identity_token(self, namespace: str, service: str,
+                            alloc_id: str = "") -> str:
+        """Connect mesh credential (consul.go DeriveSITokens analog).
+        ``alloc_id`` scopes derivation to the alloc's declared
+        services/upstreams server-side."""
+        return self.server.mesh_identity_token(namespace, service,
+                                               alloc_id=alloc_id)
 
     def services_by_name(self, namespace: str, name: str):
         """ServiceRegistration.GetService (connect upstream discovery)."""

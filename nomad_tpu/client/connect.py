@@ -147,7 +147,8 @@ class ConnectManager:
 
     def _mesh_token(self, alloc, svc: Service) -> str:
         try:
-            return self.rpc.mesh_identity_token(alloc.namespace, svc.name)
+            return self.rpc.mesh_identity_token(alloc.namespace, svc.name,
+                                                alloc_id=alloc.id)
         except Exception as e:                  # noqa: BLE001
             raise RuntimeError(
                 f"mesh identity token for {svc.name}: {e}") from e
@@ -233,7 +234,8 @@ class ConnectManager:
         # the preamble presents the DESTINATION service's identity —
         # its inbound gate verifies against the same derived credential
         # (the intentions-allow analog)
-        token = self.rpc.mesh_identity_token(alloc.namespace, dest)
+        token = self.rpc.mesh_identity_token(alloc.namespace, dest,
+                                             alloc_id=alloc.id)
 
         def resolve(delay: float):
             try:

@@ -194,6 +194,21 @@ class _NativeRelay:
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             start_new_session=True,
         )
+        def _abort(msg: str) -> RuntimeError:
+            # Kill the spawn before raising: a half-started detached
+            # relay would otherwise hold the alloc's host ports so the
+            # Python fallback (and any future alloc) could never bind
+            # them, and normal destroy() never sees this process.
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+            try:
+                os.unlink(status)
+            except OSError:
+                pass
+            return RuntimeError(msg)
+
         deadline = time.time() + timeout
         pid = 0
         while time.time() < deadline:
@@ -206,14 +221,14 @@ class _NativeRelay:
                 if line.startswith("pid "):
                     pid = int(line.split()[1])
                 if line.startswith("error "):
-                    raise RuntimeError(f"relay: {line[6:]}")
+                    raise _abort(f"relay: {line[6:]}")
                 if line.startswith("ready "):
                     return cls(alloc_id, pid, status)
             if proc.poll() is not None:
-                raise RuntimeError(
+                raise _abort(
                     f"relay exited rc={proc.returncode} before ready")
             time.sleep(0.01)
-        raise RuntimeError("relay did not report ready")
+        raise _abort("relay did not report ready")
 
     @staticmethod
     def kill_persisted(alloc_id: str) -> None:

@@ -248,6 +248,11 @@ class DeploymentsWatcher:
         with self._lock:
             if not self._enabled:
                 return
+            # the memo only matters while the deployment row exists;
+            # prune GC'd ids so a long-lived leader doesn't accumulate
+            # every terminal multiregion deployment forever
+            live = {d.id for d in snap.deployments_iter()}
+            self._mr_done &= live
             for d in snap.deployments_iter():
                 if not d.is_multiregion or d.id in self._mr_done:
                     continue

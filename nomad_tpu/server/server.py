@@ -952,10 +952,50 @@ class Server:
 
     # --- service registrations (service_registration_endpoint.go) ------
 
-    def mesh_identity_token(self, namespace: str, service: str) -> str:
+    def mesh_identity_token(self, namespace: str, service: str,
+                            alloc_id: str = "") -> str:
         """Mesh identity credential for a Connect service pair
-        (consul.go DeriveSITokens analog; see DevConsulProvider)."""
+        (consul.go DeriveSITokens analog; see DevConsulProvider).
+
+        When ``alloc_id`` is given (every client RPC passes it), the
+        derivation is scoped the way the reference scopes SI tokens to
+        the requesting alloc's services (consul.go DeriveSITokens):
+        ``service`` must be declared by the alloc's job — as one of its
+        own connect services or as a sidecar upstream destination —
+        otherwise any workload could mint any destination's identity
+        and the token gate would only exclude external traffic."""
+        if alloc_id:
+            snap = self.state.snapshot()
+            alloc = snap.alloc_by_id(alloc_id)
+            if alloc is None:
+                raise PermissionError(
+                    f"mesh identity: unknown alloc {alloc_id}")
+            # check the alloc's PLACEMENT-TIME job (alloc.job): after a
+            # job update removes a connect stanza, still-running
+            # old-version allocs remain entitled to the services their
+            # own version declared
+            job = alloc.job or snap.job_by_id(alloc.namespace, alloc.job_id)
+            if (job is None or alloc.namespace != namespace
+                    or not self._job_declares_mesh_service(job, service)):
+                raise PermissionError(
+                    f"mesh identity: alloc {alloc_id[:8]}'s job does not "
+                    f"declare connect service or upstream '{service}'")
         return self.consul.mesh_identity_token(namespace, service)
+
+    @staticmethod
+    def _job_declares_mesh_service(job, service: str) -> bool:
+        for tg in job.task_groups:
+            for svc in list(getattr(tg, "services", [])) + [
+                    s for t in getattr(tg, "tasks", [])
+                    for s in getattr(t, "services", [])]:
+                if not svc.connect:
+                    continue
+                if svc.name == service:
+                    return True
+                for up in svc.upstreams():
+                    if str(up.get("destination_name", "")) == service:
+                        return True
+        return False
 
     def services_by_name(self, namespace: str, name: str) -> List[Dict]:
         """ServiceRegistration.GetService: live instances by name (the

@@ -180,6 +180,22 @@ class TestServiceMesh:
             got = c.recv(100)
             c.close()
             assert got == b"count-api-response"
+
+            # 4) derivation is SCOPED to the alloc's declared
+            # services/upstreams (consul.go DeriveSITokens): web's
+            # alloc may derive its own service and its declared
+            # upstream, but not an arbitrary destination
+            assert agent.server.mesh_identity_token(
+                "default", "count-api", alloc_id=web_alloc.id)
+            assert agent.server.mesh_identity_token(
+                "default", "count-dashboard", alloc_id=web_alloc.id)
+            with pytest.raises(PermissionError):
+                agent.server.mesh_identity_token(
+                    "default", "some-other-service",
+                    alloc_id=web_alloc.id)
+            with pytest.raises(PermissionError):
+                agent.server.mesh_identity_token(
+                    "default", "count-api", alloc_id="no-such-alloc")
         finally:
             agent.shutdown()
 
