@@ -1,0 +1,41 @@
+#!/bin/bash
+# Opportunistic TPU capture (VERDICT r3 next-round #1): probe the
+# shared tunnel device in a loop; the moment it answers, run the full
+# bench on it and save the artifact. The device wedges for long
+# stretches — rounds 2 and 3 both missed their end-of-round capture —
+# so this runs all round and grabs whatever window appears.
+set -u
+cd /root/repo
+LOG=bench/tpu_watch.log
+OUT=bench/TPU_CAPTURE_r04.json
+probe_timeout=${PROBE_TIMEOUT:-120}
+sleep_between=${SLEEP_BETWEEN:-180}
+
+echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  if timeout "$probe_timeout" python -c \
+      "import jax, jax.numpy as jnp; assert jax.default_backend() != 'cpu'; print(float(jnp.zeros(1).sum()), jax.default_backend())" \
+      >> "$LOG" 2>&1; then
+    echo "$(date -u +%FT%TZ) probe $attempt OK - running bench" >> "$LOG"
+    # device is answering: capture with a generous budget; bench's own
+    # preflight re-probes and records the surviving backend honestly
+    if NOMAD_TPU_PREFLIGHT_BUDGET=900 timeout 5400 python bench.py \
+        > "$OUT.tmp" 2>> "$LOG"; then
+      tail -1 "$OUT.tmp" > "$OUT"; rm -f "$OUT.tmp"
+      echo "$(date -u +%FT%TZ) bench done: $(cat "$OUT")" >> "$LOG"
+      backend=$(python -c "import json;print(json.load(open('$OUT'))['backend'])" 2>/dev/null)
+      if [ "$backend" != "cpu" ] && [ -n "$backend" ]; then
+        echo "$(date -u +%FT%TZ) TPU capture landed (backend=$backend)" >> "$LOG"
+        exit 0
+      fi
+      echo "$(date -u +%FT%TZ) capture fell back to cpu; keep watching" >> "$LOG"
+    else
+      echo "$(date -u +%FT%TZ) bench run failed/timed out" >> "$LOG"
+    fi
+  else
+    echo "$(date -u +%FT%TZ) probe $attempt no device" >> "$LOG"
+  fi
+  sleep "$sleep_between"
+done

@@ -76,6 +76,15 @@ class ACL:
         caps = self._caps_for(namespace)
         return bool(caps) and NS_DENY not in caps
 
+    def allow_any_ns_op(self, capability: str) -> bool:
+        """Does ANY namespace rule grant this capability? (the
+        subscribe-time gate for cross-namespace streams: a token with
+        no read grant anywhere has no business holding one open)"""
+        if self.management:
+            return True
+        return any(capability in caps and NS_DENY not in caps
+                   for caps in self._ns_caps.values())
+
     # -- coarse scopes ---------------------------------------------------
 
     def _allow(self, disposition: str, write: bool) -> bool:

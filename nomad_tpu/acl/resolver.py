@@ -23,7 +23,10 @@ class ACLDeniedError(Exception):
 class TokenResolver:
     def __init__(self, server) -> None:
         self.server = server
-        self._cache: Dict[Tuple[str, ...], ACL] = {}
+        # policy-name tuple -> (acl_policy table index at compile, ACL);
+        # an entry is valid only while the table index matches, so a
+        # compile racing a policy edit can never poison the cache
+        self._cache: Dict[Tuple[str, ...], Tuple[int, ACL]] = {}
         self._lock = threading.Lock()
         self._bootstrapped = False
 
@@ -61,10 +64,15 @@ class TokenResolver:
         if token.is_management():
             return MANAGEMENT_ACL
         key = tuple(sorted(token.policies))
+        # a policy edit must invalidate compiled ACLs (the reference
+        # recompiles on ACL-table changes); the acl_policy table index
+        # read BEFORE compiling tags the entry, so a policy edited
+        # mid-compile yields an entry that is already stale on arrival
+        policy_index = self.server.state.table_index(["acl_policy"])
         with self._lock:
-            cached = self._cache.get(key)
-        if cached is not None:
-            return cached
+            entry = self._cache.get(key)
+            if entry is not None and entry[0] == policy_index:
+                return entry[1]
         parsed = []
         for name in token.policies:
             p = self.server.state.acl_policy_by_name(name)
@@ -72,7 +80,9 @@ class TokenResolver:
                 parsed.append(p.parsed())
         acl = ACL.compile(parsed)
         with self._lock:
-            self._cache[key] = acl
+            cur = self._cache.get(key)
+            if cur is None or cur[0] <= policy_index:
+                self._cache[key] = (policy_index, acl)
         return acl
 
     def invalidate(self) -> None:

@@ -1687,6 +1687,41 @@ class HTTPAgent:
 
     def event_stream(self, req: Request):
         broker = self._server.event_broker
+        resolver = getattr(self.agent, "acl_resolver", None)
+
+        # subscribe-time ACL (event_broker.go:55 SubscribeWithACLCheck):
+        # the token must resolve NOW, and is re-resolved every poll so a
+        # revocation drops the stream (handleACLUpdates analog) instead
+        # of a dead token riding a live subscription forever
+        def _resolve():
+            if resolver is None:
+                return None
+            try:
+                acl = resolver.resolve(req.token)
+            except PermissionError:
+                raise HTTPError(403, "Permission denied")
+            # SubscribeWithACLCheck rejects tokens with no relevant
+            # read capability at all (incl. anonymous) outright rather
+            # than letting them hold a 600s heartbeat-only stream
+            if not (acl.is_management() or acl.allow_node_read()
+                    or acl.allow_any_ns_op("read-job")):
+                raise HTTPError(403, "Permission denied")
+            return acl
+
+        acl = _resolve()
+
+        def _visible(ev) -> bool:
+            """Namespace/topic capability filter (aclAllowsSubscription):
+            Node/ACL topics need node:read / management; namespaced
+            topics need read-job capability on the event's namespace."""
+            if acl is None or acl.is_management():
+                return True
+            if ev.topic in ("ACLToken", "ACLPolicy"):
+                return False
+            if ev.topic == "Node":
+                return acl.allow_node_read()
+            return acl.allow_ns_op(ev.namespace or "default", "read-job")
+
         topics: Dict[str, List[str]] = {}
         for t in req.query.get("topic", []):
             if ":" in t:
@@ -1700,16 +1735,28 @@ class HTTPAgent:
         try:
             write_chunk = self._begin_chunked(h)
             deadline = time.time() + 600
+            last_write = time.time()
             while time.time() < deadline:
                 events = sub.next_events(timeout=5.0)
-                if not events:
-                    write_chunk(b"{}\n")  # heartbeat newline frame
-                    continue
-                batch = {
-                    "Index": events[-1].index,
-                    "Events": [encode(e) for e in events],
-                }
-                write_chunk((json.dumps(batch) + "\n").encode())
+                try:
+                    acl = _resolve()
+                except HTTPError:
+                    break               # token revoked: drop the stream
+                events = [e for e in events if _visible(e)]
+                if events:
+                    batch = {
+                        "Index": events[-1].index,
+                        "Events": [encode(e) for e in events],
+                    }
+                    write_chunk((json.dumps(batch) + "\n").encode())
+                    last_write = time.time()
+                elif time.time() - last_write >= 5.0:
+                    # heartbeat on ELAPSED TIME, not on queue state:
+                    # an instant {} per filtered batch would leak
+                    # hidden-namespace activity timing, and pure
+                    # silence would trip client/proxy idle timeouts
+                    write_chunk(b"{}\n")
+                    last_write = time.time()
         except (BrokenPipeError, ConnectionResetError):
             pass
         finally:

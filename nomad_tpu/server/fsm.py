@@ -124,8 +124,13 @@ class NomadFSM:
                            a.namespace)
         elif msg_type in (DEPLOYMENT_STATUS_UPDATE, DEPLOYMENT_ALLOC_HEALTH,
                           DEPLOYMENT_PROMOTE):
-            ev(stream.TOPIC_DEPLOYMENT, "DeploymentUpdate",
-               req["deployment_id"])
+            d = self.state.deployment_by_id(req["deployment_id"])
+            # deployment already gone (racing GC): skip rather than
+            # publish a namespace-less event the ACL filter would
+            # misroute to default-scoped subscribers
+            if d is not None:
+                ev(stream.TOPIC_DEPLOYMENT, "DeploymentUpdate",
+                   req["deployment_id"], d, d.namespace or "")
         if events:
             self.event_broker.publish(events)
 

@@ -406,6 +406,13 @@ class StateStore:
         with self._lock:
             return self._acl_policies.get(name)
 
+    def deployment_by_id(self, deployment_id: str):
+        """Direct locked read (no COW snapshot): for hot paths that
+        need one row — a snapshot here would mark every table shared
+        and force whole-table copies on the next mutation."""
+        with self._lock:
+            return self._deployments.get(deployment_id)
+
     def upsert_acl_token(self, token) -> int:
         with self._lock:
             idx = self._next_index()
@@ -695,7 +702,11 @@ class StateStore:
             self.usage.rebuild(self._nodes.values(), self._allocs.values())
         self._notify(
             ["nodes", "jobs", "evals", "allocs", "deployment",
-             "scheduler_config", "csi_volumes", "services"],
+             "scheduler_config", "csi_volumes", "services",
+             # restored ACLs must bump their table indexes, or the
+             # token resolver's index-keyed compiled-ACL cache keeps
+             # serving pre-restore policies
+             "acl_policy", "acl_token"],
             payload["index"],
         )
 

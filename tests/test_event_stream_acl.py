@@ -1,0 +1,195 @@
+"""Event-stream ACL enforcement.
+
+Reference behavior: nomad/stream/event_broker.go:55-111 —
+``SubscribeWithACLCheck`` resolves the token at subscribe time and
+``handleACLUpdates`` re-validates on ACL changes, closing subscriptions
+whose token disappears; events are filtered by the token's namespace
+capabilities. Without this, ``/v1/event/stream`` leaks every
+namespace's change feed to any holder of any token.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.acl.policy import ACLPolicy, ACLToken
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.server import fsm as fsm_msgs
+from nomad_tpu.structs.namespace import Namespace
+
+
+def _open_stream(addr: str, token: str):
+    """Raw chunked NDJSON reader over the event stream endpoint;
+    returns (socket, line-iterator)."""
+    host, port = addr.rsplit(":", 1)
+    host = host.replace("http://", "")
+    s = socket.create_connection((host, int(port)), timeout=30)
+    s.sendall((
+        "GET /v1/event/stream HTTP/1.1\r\n"
+        f"Host: {host}\r\nX-Nomad-Token: {token}\r\n\r\n"
+    ).encode())
+    f = s.makefile("rb")
+    status = f.readline().decode()
+    while f.readline().strip():      # drain headers
+        pass
+
+    def lines():
+        while True:
+            size = f.readline().strip()          # chunk size
+            if not size:
+                return
+            try:
+                n = int(size, 16)
+            except ValueError:
+                return
+            if n == 0:
+                return
+            data = f.read(n)
+            f.read(2)                            # trailing CRLF
+            for ln in data.splitlines():
+                if ln.strip():
+                    yield ln
+
+    return s, status, lines()
+
+
+@pytest.fixture()
+def acl_agent():
+    cfg = AgentConfig.dev()
+    cfg.acl_enabled = True
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        yield agent
+    finally:
+        agent.shutdown()
+
+
+class TestEventStreamACL:
+    def test_namespace_scoped_token_sees_only_its_namespace(self, acl_agent):
+        server = acl_agent.server
+        server.raft_apply(fsm_msgs.NAMESPACE_UPSERT, {
+            "namespaces": [Namespace(name="secret")]})
+        policy = ACLPolicy(name="default-read",
+                          rules='namespace "default" { policy = "read" }')
+        server.raft_apply(fsm_msgs.ACL_POLICY_UPSERT,
+                          {"policies": [policy]})
+        tok = ACLToken.create(name="scoped", type="client",
+                              policies=["default-read"])
+        server.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [tok]})
+
+        s, status, lines = _open_stream(acl_agent.http.addr, tok.secret_id)
+        assert " 200 " in status
+        got = []
+        stop = threading.Event()
+
+        def reader():
+            for ln in lines:
+                batch = json.loads(ln)
+                got.extend(batch.get("Events") or [])
+                if stop.is_set():
+                    return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        try:
+            visible = mock.job()
+            visible.id = "visible-job"
+            server.job_register(visible)
+            hidden = mock.job()
+            hidden.id = "hidden-job"
+            hidden.namespace = "secret"
+            server.job_register(hidden)
+
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if any(e.get("Key") == "visible-job" for e in got):
+                    break
+                time.sleep(0.2)
+            keys = {e.get("Key") for e in got}
+            assert "visible-job" in keys, f"saw only {keys}"
+            # the secret-namespace job never crosses this stream
+            time.sleep(1.0)
+            namespaces = {e.get("Namespace", "") for e in got}
+            assert "secret" not in namespaces
+            assert not any(e.get("Key") == "hidden-job" for e in got)
+        finally:
+            stop.set()
+            s.close()
+
+    def test_revoked_token_loses_stream(self, acl_agent):
+        server = acl_agent.server
+        policy = ACLPolicy(name="default-read",
+                          rules='namespace "default" { policy = "read" }')
+        server.raft_apply(fsm_msgs.ACL_POLICY_UPSERT,
+                          {"policies": [policy]})
+        tok = ACLToken.create(name="doomed", type="client",
+                              policies=["default-read"])
+        server.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [tok]})
+
+        s, status, lines = _open_stream(acl_agent.http.addr, tok.secret_id)
+        assert " 200 " in status
+        ended = threading.Event()
+
+        def reader():
+            for _ in lines:
+                pass
+            ended.set()
+
+        threading.Thread(target=reader, daemon=True).start()
+        try:
+            server.raft_apply(fsm_msgs.ACL_TOKEN_DELETE,
+                              {"accessor_ids": [tok.accessor_id]})
+            # next poll re-resolves the token and drops the stream
+            assert ended.wait(timeout=12), \
+                "stream survived token revocation"
+        finally:
+            s.close()
+
+    def test_bad_token_rejected_at_subscribe(self, acl_agent):
+        s, status, _ = _open_stream(acl_agent.http.addr, "no-such-token")
+        s.close()
+        assert " 403 " in status
+
+    def test_anonymous_rejected_at_subscribe(self, acl_agent):
+        # anonymous resolves but holds no read capability anywhere:
+        # no 600s heartbeat-only stream for unauthenticated clients
+        s, status, _ = _open_stream(acl_agent.http.addr, "")
+        s.close()
+        assert " 403 " in status
+
+    def test_policy_narrowed_to_deny_drops_stream(self, acl_agent):
+        server = acl_agent.server
+        policy = ACLPolicy(name="flip",
+                          rules='namespace "default" { policy = "read" }')
+        server.raft_apply(fsm_msgs.ACL_POLICY_UPSERT,
+                          {"policies": [policy]})
+        tok = ACLToken.create(name="flipped", type="client",
+                              policies=["flip"])
+        server.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [tok]})
+
+        s, status, lines = _open_stream(acl_agent.http.addr, tok.secret_id)
+        assert " 200 " in status
+        ended = threading.Event()
+
+        def reader():
+            for _ in lines:
+                pass
+            ended.set()
+
+        threading.Thread(target=reader, daemon=True).start()
+        try:
+            # the EDIT (not deletion) of the policy must reach the
+            # stream: compiled-ACL caches are invalidated by the
+            # acl_policy table index
+            server.raft_apply(fsm_msgs.ACL_POLICY_UPSERT, {"policies": [
+                ACLPolicy(name="flip",
+                          rules='namespace "default" { policy = "deny" }')]})
+            assert ended.wait(timeout=12), \
+                "stream survived policy narrowing to deny"
+        finally:
+            s.close()
