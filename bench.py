@@ -61,7 +61,10 @@ E2E_ALLOCS_PER_JOB = 10
 # batch 32 keeps the last-plan-in-wave latency under the p99 target
 E2E_WORKERS = 1
 E2E_BATCH_SIZE = 32
-E2E_WARMUP_JOBS = 8
+# warmup must exercise the SAME wave bucket as the timed burst (a
+# 32-eval wave pads to the 64 bucket); 8 warm jobs only compiled the
+# 16 bucket and the burst then paid a cold compile inside the window
+E2E_WARMUP_JOBS = 40
 
 _M64 = (1 << 64) - 1
 
@@ -412,7 +415,141 @@ def _replay_planes(path: str):
             1 for a in snap.allocs_iter() if not a.terminal_status()),
         "replay_jobs": len(snap.jobs()),
     }
-    return cluster, used_cpu, used_mem, used_disk, arr, stats
+    return cluster, snap, used_cpu, used_mem, used_disk, arr, stats
+
+
+# the non-headline timed cells (BASELINE.md:22-25 config list)
+CELL_BATCHES = 100
+PREEMPTION_PRIORITY = 90    # placing priority for the preemption cell
+
+
+def _gpu_free_plane(cluster, snap):
+    """f32[n_pad]: free nvidia/gpu instances per node at the replay
+    snapshot (capacity from NodeDeviceResource minus instances held by
+    live allocs' AllocatedDeviceResource rows)."""
+    import numpy as np
+
+    free = np.zeros(cluster.n_pad, np.float32)
+    for i in range(cluster.n_real):
+        node = snap.node_by_id(cluster.node_ids[i])
+        if node is None or not node.node_resources.devices:
+            continue
+        free[i] = sum(len(d.instance_ids)
+                      for d in node.node_resources.devices
+                      if d.type == "gpu")
+    for a in snap.allocs_iter():
+        if a.terminal_status() or a.allocated_resources is None:
+            continue
+        row = cluster.index.get(a.node_id)
+        if row is None:
+            continue
+        for tr in a.allocated_resources.tasks.values():
+            for d in tr.devices:
+                if d.type == "gpu":
+                    free[row] -= len(d.device_ids)
+    return np.maximum(free, 0.0)
+
+
+def run_replay_device(cluster, snap, used_cpu, used_mem, used_disk) -> dict:
+    """GPU device-ask cell: the replay's gpu job shape (1 nvidia/gpu +
+    cpu/mem) scheduled against the replay's actual free device capacity
+    through the device-carrying fused loop."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nomad_tpu.ops.kernel import build_kernel_in
+    from nomad_tpu.parallel.batching import (
+        device_put_shared,
+        make_device_apply_loop,
+    )
+    from nomad_tpu.parallel.synthetic import synthetic_eval
+
+    gpu_free = _gpu_free_plane(cluster, snap)
+    ev0 = synthetic_eval(cluster, desired_count=PLACEMENTS_PER_EVAL)
+    shared = device_put_shared(
+        build_kernel_in(cluster, ev0, PLACEMENTS_PER_EVAL)._replace(
+            used_disk=used_disk, ask_disk=np.asarray(150.0, np.float32)))
+    loop = make_device_apply_loop(PLACEMENTS_PER_EVAL, reset_every=1)
+
+    # the replay's gpu shape (bench/c2m.py JOB_SHAPES "gpu")
+    shape = (4000.0, 8192.0, 1.0)
+    T, B = CELL_BATCHES, BATCH
+    a_cpu = jnp.full((T, B), shape[0], jnp.float32)
+    a_mem = jnp.full((T, B), shape[1], jnp.float32)
+    a_gpu = jnp.full((T, B), shape[2], jnp.float32)
+    n_steps = jnp.asarray(np.full(B, PLACEMENTS_PER_EVAL, np.int32))
+    df0 = np.zeros((cluster.n_pad, shared.dev_free.shape[1]), np.float32)
+    df0[:, 0] = gpu_free
+
+    best_dt, placed = float("inf"), 0
+    for _rep in range(2):
+        args = (jnp.asarray(used_cpu), jnp.asarray(used_mem),
+                jnp.asarray(df0))
+        warm = loop(shared, *args, a_cpu, a_mem, a_gpu, n_steps)
+        float(warm[0])
+        args = (jnp.asarray(used_cpu), jnp.asarray(used_mem),
+                jnp.asarray(df0))
+        t0 = time.perf_counter()
+        out = loop(shared, *args, a_cpu, a_mem, a_gpu, n_steps)
+        placed = int(out[1])
+        dt = time.perf_counter() - t0
+        best_dt = min(best_dt, dt)
+    return {
+        "device_evals_per_sec": T * B / best_dt,
+        "device_placed": placed,
+        "device_free_gpus": float(gpu_free.sum()),
+    }
+
+
+def run_replay_preemption(cluster, snap, used_cpu, used_mem, asks) -> dict:
+    """Preemption-enabled cell: a priority-90 eval stream over the
+    replay state; placements that do not fit free capacity preempt
+    lower-priority work (vectorized select_preempting scoring)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nomad_tpu.ops.kernel import build_kernel_in
+    from nomad_tpu.parallel.batching import (
+        device_put_shared,
+        make_preemption_apply_loop,
+    )
+    from nomad_tpu.parallel.synthetic import synthetic_eval
+    from nomad_tpu.scheduler.preemption import preemptible_planes
+
+    pre_cpu, pre_mem, _pre_disk, pre_score = preemptible_planes(
+        cluster, snap, None, PREEMPTION_PRIORITY,
+        "default", "bench-preemption-job")
+
+    ev0 = synthetic_eval(cluster, desired_count=PLACEMENTS_PER_EVAL)
+    shared = device_put_shared(
+        build_kernel_in(cluster, ev0, PLACEMENTS_PER_EVAL))
+    loop = make_preemption_apply_loop(PLACEMENTS_PER_EVAL, reset_every=1)
+
+    T, B = CELL_BATCHES, BATCH
+    a_cpu = jnp.asarray(asks[:T * B, 0].reshape(T, B))
+    a_mem = jnp.asarray(asks[:T * B, 1].reshape(T, B))
+    n_steps = jnp.asarray(np.full(B, PLACEMENTS_PER_EVAL, np.int32))
+
+    best_dt, placed, preempted = float("inf"), 0, 0
+    for _rep in range(2):
+        args = (jnp.asarray(used_cpu), jnp.asarray(used_mem),
+                jnp.asarray(pre_cpu), jnp.asarray(pre_mem))
+        warm = loop(shared, *args, jnp.asarray(pre_score),
+                    a_cpu, a_mem, n_steps)
+        float(warm[0])
+        args = (jnp.asarray(used_cpu), jnp.asarray(used_mem),
+                jnp.asarray(pre_cpu), jnp.asarray(pre_mem))
+        t0 = time.perf_counter()
+        out = loop(shared, *args, jnp.asarray(pre_score),
+                   a_cpu, a_mem, n_steps)
+        placed, preempted = int(out[1]), int(out[2])
+        dt = time.perf_counter() - t0
+        best_dt = min(best_dt, dt)
+    return {
+        "preemption_evals_per_sec": T * B / best_dt,
+        "preemption_placed": placed,
+        "preemption_preempted": preempted,
+    }
 
 
 def _write_planes_file(cluster, used_cpu, used_mem, used_disk,
@@ -437,7 +574,7 @@ def _write_planes_file(cluster, used_cpu, used_mem, used_disk,
     return path
 
 
-def run_replay(path: str) -> dict:
+def run_replay(planes) -> dict:
     """The C2M replay headline: fused loop vs native baseline on the
     SAME persisted cluster planes and the SAME ask stream."""
     import jax
@@ -451,8 +588,7 @@ def run_replay(path: str) -> dict:
     )
     from nomad_tpu.parallel.synthetic import synthetic_eval
 
-    cluster, used_cpu, used_mem, used_disk, asks, stats = \
-        _replay_planes(path)
+    cluster, _snap, used_cpu, used_mem, used_disk, asks, stats = planes
 
     # native baseline on the identical planes + ask prefix
     planes_file = _write_planes_file(
@@ -586,18 +722,34 @@ def main() -> None:
     e2e = run_e2e()
 
     replay = None
+    cells = {}
     if not args.synthetic:
         sys.path.insert(0, os.path.join(REPO, "bench"))
         import c2m
 
         replay_path = args.replay or c2m.DEFAULT_PATH
         try:
-            replay = run_replay(replay_path)
+            planes = _replay_planes(replay_path)
+            replay = run_replay(planes)
         except Exception as e:                   # noqa: BLE001
             import traceback
             traceback.print_exc()
             print(f"warning: replay bench failed ({e}); "
                   "reporting synthetic only", file=sys.stderr)
+        if replay is not None:
+            # the remaining BASELINE.md timed configs: device + preemption
+            cluster, snap, used_cpu, used_mem, used_disk, asks, _ = planes
+            try:
+                cells.update(run_replay_device(
+                    cluster, snap, used_cpu, used_mem, used_disk))
+            except Exception as e:               # noqa: BLE001
+                print(f"warning: device cell failed: {e}", file=sys.stderr)
+            try:
+                cells.update(run_replay_preemption(
+                    cluster, snap, used_cpu, used_mem, asks))
+            except Exception as e:               # noqa: BLE001
+                print(f"warning: preemption cell failed: {e}",
+                      file=sys.stderr)
 
     if replay is not None:
         # headline: the C2M replay (BASELINE.md's metric definition —
@@ -618,6 +770,8 @@ def main() -> None:
             "synthetic_vs_baseline": round(
                 tpu["evals_per_sec"] / baseline["evals_per_sec"], 2),
         }
+        for key, val in cells.items():
+            line[key] = round(val, 2) if isinstance(val, float) else val
     else:
         line = {
             "metric": ("scheduler evals/sec (10k nodes, 10 placements/eval, "

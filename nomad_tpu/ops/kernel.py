@@ -186,6 +186,28 @@ class KernelIn(NamedTuple):
     n_steps: jnp.ndarray             # i32 scalar: real placements wanted
 
 
+#: rank of each KernelIn leaf in the SINGLE-problem (unbatched) layout.
+#: The joint wave kernel accepts leaves either unbatched (shared by
+#: every member — e.g. the cluster capacity planes and a wave's common
+#: snapshot utilization) or stacked with a leading member axis; a leaf
+#: whose rank equals the entry here +1 is batched. Shipping shared
+#: planes once instead of B times is what keeps wave upload bytes flat
+#: in wave size on a remote-device transport.
+KIN_UNBATCHED_RANKS = KernelIn(
+    cap_cpu=1, cap_mem=1, cap_disk=1, free_cores=1, shares_per_core=1,
+    free_dyn=1, base_mask=1, used_cpu=1, used_mem=1, used_disk=1,
+    used_cores=1, used_mbits=1, avail_mbits=1, port_conflict=1,
+    dev_free=2, dev_aff_score=1, has_dev_affinity=0, job_tg_count=1,
+    penalty=1, aff_score=1, node_perm=1, step_penalty=2,
+    step_preferred=1, job_any_count=1, distinct_hosts_job=0,
+    distinct_hosts_tg=0, spread_active=1, spread_even=1, spread_weight=1,
+    spread_bucket=2, spread_counts=2, spread_desired=2, ask_cpu=0,
+    ask_mem=0, ask_disk=0, ask_cores=0, ask_dyn_ports=0,
+    ask_has_reserved_ports=0, ask_dev=1, ask_mbits=0, desired_count=0,
+    algorithm_spread=0, n_steps=0,
+)
+
+
 class KernelOut(NamedTuple):
     chosen: jnp.ndarray          # i32[K]: node row per placement (-1 none)
     scores: jnp.ndarray          # f32[K]: final normalized score
@@ -851,15 +873,23 @@ def place_taskgroups_joint(
     host-side and the applier's re-check catches the rare collision,
     exactly as it does between reference scheduler workers.
     """
-    n = kin.cap_cpu.shape[1]
-    b = kin.cap_cpu.shape[0]
+    n = kin.cap_cpu.shape[-1]
+    b = kin.n_steps.shape[0]       # n_steps is always member-stacked
     f = features
+
+    def _bat(x, rank):
+        """Ensure a leading member axis (carried leaves need one even
+        when the wave shipped the leaf shared/unbatched — the broadcast
+        happens ON DEVICE, costing HBM, not transport)."""
+        if jnp.ndim(x) == rank + 1:
+            return x
+        return jnp.broadcast_to(x, (b,) + jnp.shape(x))
 
     zf = jnp.zeros(n, jnp.float32)
     zi = jnp.zeros(n, jnp.int32)
     init = dict(
         a_cpu=zf, a_mem=zf, a_disk=zf,
-        job_tg_count=kin.job_tg_count,              # [B, N]
+        job_tg_count=_bat(kin.job_tg_count, 1),     # [B, N]
     )
     if f.with_cores:
         init["a_cores"] = zi
@@ -867,19 +897,24 @@ def place_taskgroups_joint(
         init["a_mbits"] = zi
     if f.with_ports:
         init["a_dyn"] = zi
-        init["port_conflict"] = kin.port_conflict   # [B, N]
+        init["port_conflict"] = _bat(kin.port_conflict, 1)   # [B, N]
     if f.with_devices:
-        init["a_dev"] = jnp.zeros((n, kin.dev_free.shape[2]), jnp.float32)
+        init["a_dev"] = jnp.zeros((n, kin.dev_free.shape[-1]), jnp.float32)
     if f.with_distinct:
-        init["job_any_count"] = kin.job_any_count   # [B, N]
+        init["job_any_count"] = _bat(kin.job_any_count, 1)   # [B, N]
     if f.n_spreads > 0:
-        init["spread_counts"] = kin.spread_counts   # [B, S, Bk]
+        init["spread_counts"] = _bat(kin.spread_counts, 2)   # [B, S, Bk]
 
     iota = jnp.arange(n, dtype=jnp.int32)
 
     def member_view(st, m):
-        """The member's single-problem (kin, st) as place_taskgroup sees it."""
-        kin_m = KernelIn(*[x[m] for x in kin])
+        """The member's single-problem (kin, st) as place_taskgroup
+        sees it. Leaves shipped unbatched (shared by every member) are
+        used as-is; stacked leaves index the member axis."""
+        kin_m = KernelIn(*[
+            x[m] if jnp.ndim(x) == r + 1 else x
+            for x, r in zip(kin, KIN_UNBATCHED_RANKS)
+        ])
         st_m = dict(
             used_cpu=kin_m.used_cpu + st["a_cpu"],
             used_mem=kin_m.used_mem + st["a_mem"],
@@ -1006,8 +1041,12 @@ def place_taskgroups_joint(
             ex(dims0["fit_ports"]), ex(dims0["fit_dev"]), ex(dims0["fit_cores"]),
         )
 
+    in_axes = KernelIn(*[
+        0 if jnp.ndim(x) == r + 1 else None
+        for x, r in zip(kin, KIN_UNBATCHED_RANKS)
+    ])
     (m_eval, m_feas, m_cpu, m_mem, m_disk, m_ports, m_dev, m_cores) = jax.vmap(
-        member_metrics)(kin)
+        member_metrics, in_axes=(in_axes,))(kin)
 
     return JointOut(
         chosen=chosen, scores=scores, found=found,
@@ -1109,7 +1148,12 @@ def build_kernel_in(
         cap_disk=np.asarray(cluster.cap_disk, np.float32),
         free_cores=np.asarray(cluster.free_cores, np.int32),
         shares_per_core=np.asarray(cluster.shares_per_core, np.float32),
-        free_dyn=np.asarray(cluster.free_dyn - ev.free_dyn_delta, np.int32),
+        # identity-preserving when no in-plan dyn ports: wave members
+        # then share the cluster's plane (shipped once per wave)
+        free_dyn=(np.asarray(cluster.free_dyn, np.int32)
+                  if not ev.free_dyn_delta.any()
+                  else np.asarray(cluster.free_dyn - ev.free_dyn_delta,
+                                  np.int32)),
         base_mask=np.asarray(ev.base_mask, bool),
         used_cpu=np.asarray(ev.used_cpu, np.float32),
         used_mem=np.asarray(ev.used_mem, np.float32),

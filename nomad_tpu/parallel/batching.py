@@ -199,6 +199,218 @@ def make_schedule_apply_loop(k_steps: int,
     return jax.jit(loop, donate_argnums=(1, 2))
 
 
+def _scan_with_reset(one_batch, planes, asks, reset_every: int):
+    """Shared multi-batch scan harness for the timed cell loops:
+    ``planes`` is the carried plane tuple, ``asks`` the tuple of
+    [T, ...] per-batch inputs. With ``reset_every``, the pristine
+    planes re-enter the carry every that many batches (the replay
+    benches' baseline-matching reset cadence)."""
+    if reset_every:
+        init_planes = tuple(p + 0 for p in planes)
+
+        def body(carry, a):
+            *ps, t = carry
+            hit = (t % reset_every) == 0
+            ps = tuple(jnp.where(hit, i, p)
+                       for p, i in zip(ps, init_planes))
+            ps2, stats = one_batch(tuple(ps), a)
+            return (*ps2, t + 1), stats
+
+        (*out, _t), stats = jax.lax.scan(
+            body, (*planes, jnp.asarray(0, jnp.int32)), asks)
+        return tuple(out), stats
+    out, stats = jax.lax.scan(one_batch, planes, asks)
+    return tuple(out), stats
+
+
+@functools.lru_cache(maxsize=8)
+def make_device_apply_loop(k_steps: int, reset_every: int = 0):
+    """Timed GPU-device cell: BASELINE.md's "GPU device-plugin jobs on
+    a heterogeneous pool" config as a fused multi-batch loop.
+
+    Same shape as ``make_schedule_apply_loop`` but the carry includes
+    the per-node free-device plane (``dev_free``): the kernel deducts
+    device asks between its K steps (rank.go AssignDevice semantics,
+    device.go:32) and accepted placements commit their device ask
+    across batches with the same scatter algebra as cpu/mem.
+
+    Returns fn(shared, used_cpu, used_mem, dev_free, ask_cpu[T,B],
+    ask_mem[T,B], ask_gpu[T,B], n_steps[B]) ->
+    (score_sum, placed, used_cpu', used_mem', dev_free').
+    """
+    from nomad_tpu.ops.kernel import MAX_DEV_REQS
+
+    features = KernelFeatures(
+        n_spreads=0, with_topk=False, with_devices=True,
+        with_ports=False, with_cores=False, with_network=False,
+        with_distinct=False, with_step_penalties=False,
+        with_preferred=False,
+    )
+
+    def loop(shared: KernelIn, used_cpu, used_mem, dev_free,
+             ask_cpu, ask_mem, ask_gpu, n_steps):
+        def one_batch(carry, asks):
+            uc, um, df = carry
+            a_cpu, a_mem, a_gpu = asks
+
+            def run_one(ac, am, ag, ns):
+                ad = jnp.zeros((MAX_DEV_REQS,), jnp.float32).at[0].set(ag)
+                kin = shared._replace(
+                    used_cpu=uc, used_mem=um, dev_free=df,
+                    ask_cpu=ac, ask_mem=am, ask_dev=ad, n_steps=ns,
+                )
+                return place_taskgroup(kin, k_steps, features)
+
+            out = jax.vmap(run_one)(a_cpu, a_mem, a_gpu, n_steps)
+            uc2, um2 = commit_placements(
+                uc, um, out.chosen, out.found, a_cpu, a_mem)
+            rows = out.chosen.reshape(-1)
+            ok = out.found.reshape(-1)
+            w_gpu = (jnp.broadcast_to(a_gpu[:, None], out.chosen.shape)
+                     .reshape(-1) * ok)
+            safe = jnp.where(ok, rows, 0)
+            df2 = df.at[safe, 0].add(-jnp.where(ok, w_gpu, 0.0))
+            stats = (
+                jnp.sum(jnp.where(out.found, out.scores, 0.0)),
+                jnp.sum(out.found),
+            )
+            return (uc2, um2, df2), stats
+
+        (uc, um, df), stats = _scan_with_reset(
+            one_batch, (used_cpu, used_mem, dev_free),
+            (ask_cpu, ask_mem, ask_gpu), reset_every)
+        scores, placed = stats
+        return jnp.sum(scores), jnp.sum(placed), uc, um, df
+
+    return jax.jit(loop, donate_argnums=(1, 2, 3))
+
+
+@functools.lru_cache(maxsize=8)
+def make_preemption_apply_loop(k_steps: int, reset_every: int = 0):
+    """Timed preemption cell: BASELINE.md's "preemption-enabled service
+    jobs at 10K nodes" config as a fused multi-batch loop.
+
+    Each placement first tries a normal binpack fit; when NO node has
+    free capacity, eligible nodes (those with preemptible lower-
+    priority capacity, preemption.go:96 Preemptor eligibility) are
+    scored ``(binpack_fit_after_evict + preemption_score) / 2`` — the
+    exact device-wide scoring the live path's ``select_preempting``
+    computes (scheduler/stack.py, mirroring rank.go:799
+    PreemptionScoringIterator) — and the chosen node's preemptible
+    capacity is freed (full-eviction upper bound; the live system's
+    host-side greedy pass evicts a subset, never more).
+
+    ``pre_cpu/pre_mem`` are per-node planes of capacity held by allocs
+    whose priority is more than PRIORITY_DELTA below the placing job's
+    (scheduler/preemption.preemptible_planes); ``pre_score`` is the
+    net-priority-derived plane (rank.go:858 preemptionScore).
+
+    Returns fn(shared, used_cpu, used_mem, pre_cpu, pre_mem, pre_score,
+    ask_cpu[T,B], ask_mem[T,B], n_steps[B]) ->
+    (score_sum, placed, preempted, used_cpu', used_mem').
+    """
+    from nomad_tpu.ops.kernel import NEG_INF
+
+    def loop(shared: KernelIn, used_cpu, used_mem,
+             pre_cpu, pre_mem, pre_score,
+             ask_cpu, ask_mem, n_steps):
+        def one_eval(uc, um, pc, pm, ps, a_cpu, a_mem, ns):
+            """K sequential placements with deduction; preemption is
+            the per-step fallback (generic_sched.go:800 second pass)."""
+            def step(st, i):
+                uc, um, pc, pm = st
+                free_cpu = shared.cap_cpu - uc
+                free_mem = shared.cap_mem - um
+                normal = (shared.base_mask
+                          & (free_cpu >= a_cpu) & (free_mem >= a_mem))
+                # binpack fit (funcs.go:259), normalized like the kernel
+                fc = jnp.where(shared.cap_cpu > 0,
+                               1.0 - (uc + a_cpu) / shared.cap_cpu, 0.0)
+                fm = jnp.where(shared.cap_mem > 0,
+                               1.0 - (um + a_mem) / shared.cap_mem, 0.0)
+                fit = jnp.clip(
+                    20.0 - (jnp.power(10.0, fc) + jnp.power(10.0, fm)),
+                    0.0, 18.0) / 18.0
+                active = i < ns
+                normal_masked = jnp.where(normal & active, fit, NEG_INF)
+                best_n = jnp.argmax(normal_masked)
+                found_n = normal_masked[best_n] > NEG_INF / 2
+
+                # preemption fallback plane (stack.py select_preempting)
+                evictable = (pc > 0) | (pm > 0)
+                pre_ok = (shared.base_mask & evictable & ~normal
+                          & ((free_cpu + pc) >= a_cpu)
+                          & ((free_mem + pm) >= a_mem))
+                uce = uc - pc + a_cpu
+                ume = um - pm + a_mem
+                fce = jnp.where(shared.cap_cpu > 0,
+                                1.0 - uce / shared.cap_cpu, 0.0)
+                fme = jnp.where(shared.cap_mem > 0,
+                                1.0 - ume / shared.cap_mem, 0.0)
+                fite = jnp.clip(
+                    20.0 - (jnp.power(10.0, fce) + jnp.power(10.0, fme)),
+                    0.0, 18.0) / 18.0
+                pre_masked = jnp.where(
+                    pre_ok & active, (fite + ps) / 2.0, NEG_INF)
+                best_p = jnp.argmax(pre_masked)
+                found_p = pre_masked[best_p] > NEG_INF / 2
+
+                idx = jnp.where(found_n, best_n, best_p)
+                found = found_n | found_p
+                preempted = found_p & ~found_n
+                score = jnp.where(
+                    found_n, normal_masked[best_n],
+                    jnp.where(found_p, pre_masked[best_p], 0.0))
+
+                one = jax.nn.one_hot(
+                    idx, shared.cap_cpu.shape[0], dtype=jnp.float32
+                ) * found.astype(jnp.float32)
+                evict = one * preempted.astype(jnp.float32)
+                uc2 = uc + one * a_cpu - evict * pc[idx]
+                um2 = um + one * a_mem - evict * pm[idx]
+                pc2 = pc * (1.0 - evict)
+                pm2 = pm * (1.0 - evict)
+                return (uc2, um2, pc2, pm2), (score * found, found,
+                                              preempted)
+
+            (uc2, um2, pc2, pm2), (scores, found, preempted) = \
+                jax.lax.scan(step, (uc, um, pc, pm),
+                             jnp.arange(k_steps))
+            return (jnp.sum(scores), jnp.sum(found), jnp.sum(preempted),
+                    uc2, um2, pc2, pm2)
+
+        def one_batch(carry, asks):
+            uc, um, pc, pm = carry
+            a_cpu, a_mem = asks
+            # batch members schedule against the SAME snapshot
+            # (optimistic concurrency, like the lean loop)
+            score, placed, preempted, uc2, um2, pc2, pm2 = jax.vmap(
+                one_eval, in_axes=(None, None, None, None, None, 0, 0, 0)
+            )(uc, um, pc, pm, pre_score, a_cpu, a_mem, n_steps)
+            # commit = sum of PLACEMENT adds, but each node's evicted
+            # capacity is credited ONCE (two members evicting the same
+            # node free it once, not twice). A member's placement adds
+            # are its used delta plus whatever it evicted.
+            add_uc = jnp.sum(uc2 - uc[None, :] + (pc[None, :] - pc2),
+                             axis=0)
+            add_um = jnp.sum(um2 - um[None, :] + (pm[None, :] - pm2),
+                             axis=0)
+            pc3 = jnp.min(pc2, axis=0)
+            pm3 = jnp.min(pm2, axis=0)
+            stats = (jnp.sum(score), jnp.sum(placed), jnp.sum(preempted))
+            return (uc + add_uc - (pc - pc3),
+                    um + add_um - (pm - pm3), pc3, pm3), stats
+
+        (uc, um, _pc, _pm), stats = _scan_with_reset(
+            one_batch, (used_cpu, used_mem, pre_cpu, pre_mem),
+            (ask_cpu, ask_mem), reset_every)
+        scores, placed, preempted = stats
+        return (jnp.sum(scores), jnp.sum(placed), jnp.sum(preempted),
+                uc, um)
+
+    return jax.jit(loop, donate_argnums=(1, 2, 3, 4))
+
+
 def commit_placements(used_cpu, used_mem, chosen, found, ask_cpu, ask_mem):
     """The plan applier's state update as on-device algebra
     (nomad/plan_apply.go:209): scatter every accepted placement's ask

@@ -51,6 +51,15 @@ _WAVE_MESH_REFS = 0
 #: waves dispatched through the sharded path (asserted by tests)
 sharded_wave_launches = 0
 
+#: node planes shipped once per wave (unbatched) when every member
+#: shares them by identity: the cluster-static planes plus the wave
+#: snapshot's gathered utilization (stack.py wave-shared build)
+_SHAREABLE_FIELDS = (
+    "cap_cpu", "cap_mem", "cap_disk", "free_cores", "shares_per_core",
+    "avail_mbits", "free_dyn",
+    "used_cpu", "used_mem", "used_disk", "used_cores", "used_mbits",
+)
+
 
 def configure_wave_mesh(mesh) -> None:
     """Route subsequent waves over ``mesh`` (None restores
@@ -137,9 +146,28 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
         padded = padded + [filler] * (b_pad - len(padded))
     # stack on HOST (numpy): the jit call below uploads each stacked
     # leaf once; stacking device arrays would dispatch per leaf per
-    # member — thousands of round trips on a remote-device transport
-    stacked = jax.tree_util.tree_map(
-        lambda *xs: np.stack([np.asarray(x) for x in xs]), *padded)
+    # member — thousands of round trips on a remote-device transport.
+    # The big node planes (cluster capacity + the wave snapshot's
+    # utilization) are usually IDENTICAL across members; when every one
+    # of _SHAREABLE_FIELDS is identity-shared, they ship UNBATCHED (the
+    # joint kernel broadcasts on device) so wave upload bytes stay flat
+    # in wave size instead of B-fold. Exactly TWO layouts exist —
+    # all-shared or all-stacked — so each (bucket, features) pair costs
+    # at most two XLA variants, not one per sharing pattern.
+    shareable = _WAVE_MESH is None and all(
+        all(getattr(k, f) is getattr(padded[0], f) for k in padded[1:])
+        for f in _SHAREABLE_FIELDS
+    )
+
+    def _stack_field(f, xs):
+        if shareable and f in _SHAREABLE_FIELDS:
+            return np.asarray(xs[0])
+        return np.stack([np.asarray(x) for x in xs])
+
+    stacked = KernelIn(*[
+        _stack_field(f, [getattr(k, f) for k in padded])
+        for f in KernelIn._fields
+    ])
 
     # step layout: member 0's steps, then member 1's, ... (the applier's
     # serialization order = plan arrival order). The step axis is sized

@@ -150,10 +150,7 @@ class FeasibilityBuilder:
 
     def _classes(self) -> Dict[str, List[int]]:
         if self._class_rows is None:
-            rows: Dict[str, List[int]] = {}
-            for i, cc in enumerate(self.cluster.computed_classes):
-                rows.setdefault(cc, []).append(i)
-            self._class_rows = rows
+            self._class_rows = self.cluster.class_rows()
         return self._class_rows
 
     def eligible_in_dcs(self, datacenters: List[str], node_pool: str = "default") -> np.ndarray:

@@ -186,6 +186,14 @@ class XLAGenericStack:
     def _apply_accepted(self, ev: EvalTensors, row: int) -> None:
         """Re-apply one already-accepted placement's resources to freshly
         rebuilt eval tensors (retry attempts must not double-book)."""
+        if not ev.used_cpu.flags.writeable:
+            # the build shared the cluster's read-only gathered usage
+            # planes; this eval now diverges — copy-on-write
+            ev.used_cpu = ev.used_cpu.copy()
+            ev.used_mem = ev.used_mem.copy()
+            ev.used_disk = ev.used_disk.copy()
+            ev.used_cores = ev.used_cores.copy()
+            ev.used_mbits = ev.used_mbits.copy()
         ask = ev.ask
         ev.used_cpu[row] += ask.cpu
         ev.used_mem[row] += ask.mem
@@ -366,12 +374,6 @@ class XLAGenericStack:
         base = self._feas.base_mask(job, tg, job_allocs_by_node)
         base &= ~exclude
 
-        used_cpu = np.zeros(n, np.float32)
-        used_mem = np.zeros(n, np.float32)
-        used_disk = np.zeros(n, np.float32)
-        used_mbits = np.zeros(n, np.int32)
-        avail_mbits = np.zeros(n, np.int32)
-        used_cores = np.zeros(n, np.int32)
         job_tg_count = np.zeros(n, np.int32)
         job_any_count = np.zeros(n, np.int32)
         conflict_words = np.zeros((n, c.port_words.shape[1]), np.uint32)
@@ -379,12 +381,38 @@ class XLAGenericStack:
 
         ask = AskTensor.build(tg)
 
-        # proposed utilization per node (context.go ProposedAllocs over
-        # every node)
-        self._accumulate_usage(
-            used_cpu, used_mem, used_disk, used_mbits, used_cores,
-            job_tg_count, job_any_count, conflict_words, free_dyn_delta, tg, ask,
-        )
+        u = getattr(snapshot, "usage", None)
+        if (u is not None and not plan.node_update
+                and not plan.node_preemptions and not plan.node_allocation):
+            # empty plan (first placements of the eval): the proposed
+            # utilization IS the snapshot's — share the cluster's
+            # read-only gathered planes BY IDENTITY, so every eval of a
+            # wave ships one copy to the device instead of one each
+            used_cpu, used_mem, used_disk, used_cores, used_mbits = \
+                c.gathered_usage(u)
+            for a in job_allocs:
+                if a.terminal_status():
+                    continue
+                row = c.index.get(a.node_id)
+                if row is None:
+                    continue
+                job_any_count[row] += 1
+                if a.task_group == tg.name:
+                    job_tg_count[row] += 1
+        else:
+            used_cpu = np.zeros(n, np.float32)
+            used_mem = np.zeros(n, np.float32)
+            used_disk = np.zeros(n, np.float32)
+            used_mbits = np.zeros(n, np.int32)
+            used_cores = np.zeros(n, np.int32)
+            # proposed utilization per node (context.go ProposedAllocs
+            # over every node)
+            self._accumulate_usage(
+                used_cpu, used_mem, used_disk, used_mbits, used_cores,
+                job_tg_count, job_any_count, conflict_words,
+                free_dyn_delta, tg, ask,
+            )
+        avail_mbits = np.zeros(n, np.int32)
         # node-static plane, shared from the cluster build (read-only)
         avail_mbits = c.avail_mbits if c.avail_mbits is not None else avail_mbits
 
@@ -716,31 +744,70 @@ class _NodeAssigner:
     def __init__(self, node, ctx: EvalContext, proposed=None) -> None:
         self.node = node
         self.ctx = ctx
-        if proposed is None:
-            proposed = ctx.proposed_allocs(node.id)
-        self.net_idx = NetworkIndex()
-        if ctx.port_seed is not None:
-            import zlib
+        # every sub-assigner is built LAZILY on the first ask that needs
+        # it: a lean cpu/mem placement (the common case) pays for none
+        # of the port/device/core indexing, which otherwise dominated
+        # the per-placement host profile (reference equally only enters
+        # these branches for non-empty asks, rank.go:270-492)
+        self._proposed = proposed
+        self._net_idx: Optional[NetworkIndex] = None
+        self._net_ok = True
+        self._dev_alloc: Optional[DeviceAllocator] = None
+        self._used_cores: Optional[set] = None
 
-            self.net_idx.seed(ctx.port_seed ^ zlib.crc32(node.id.encode()))
-        collide, reason = self.net_idx.set_node(node)
-        self.ok = not collide
-        if self.ok:
-            collide, reason = self.net_idx.add_allocs(proposed)
-            self.ok = not collide
-        if not self.ok:
-            from nomad_tpu.scheduler.context import PortCollisionEvent
+    def _get_proposed(self):
+        if self._proposed is None:
+            self._proposed = self.ctx.proposed_allocs(self.node.id)
+        return self._proposed
 
-            ctx.send_event(PortCollisionEvent(reason, node=node))
-        self.dev_alloc = DeviceAllocator(node)
-        self.dev_alloc.add_allocs(proposed)
-        self.used_cores = set()
-        for a in proposed:
-            self.used_cores |= set(a.comparable_resources().reserved_cores)
+    @property
+    def net_idx(self) -> NetworkIndex:
+        if self._net_idx is None:
+            self._net_idx = NetworkIndex()
+            if self.ctx.port_seed is not None:
+                import zlib
+
+                self._net_idx.seed(
+                    self.ctx.port_seed ^ zlib.crc32(self.node.id.encode()))
+            collide, reason = self._net_idx.set_node(self.node)
+            if not collide:
+                collide, reason = self._net_idx.add_allocs(
+                    self._get_proposed())
+            self._net_ok = not collide
+            if collide:
+                from nomad_tpu.scheduler.context import PortCollisionEvent
+
+                self.ctx.send_event(
+                    PortCollisionEvent(reason, node=self.node))
+        return self._net_idx
+
+    @property
+    def dev_alloc(self) -> DeviceAllocator:
+        if self._dev_alloc is None:
+            self._dev_alloc = DeviceAllocator(self.node)
+            self._dev_alloc.add_allocs(self._get_proposed())
+        return self._dev_alloc
+
+    @property
+    def used_cores(self) -> set:
+        if self._used_cores is None:
+            self._used_cores = set()
+            for a in self._get_proposed():
+                self._used_cores |= set(
+                    a.comparable_resources().reserved_cores)
+        return self._used_cores
+
+    @used_cores.setter
+    def used_cores(self, value: set) -> None:
+        self._used_cores = value
 
     def assign(self, tg, final_score: float) -> Optional[SelectedOption]:
-        if not self.ok:
-            return None
+        needs_net = bool(tg.networks) or any(
+            t.resources.networks for t in tg.tasks)
+        if needs_net:
+            self.net_idx          # build + validate
+            if not self._net_ok:
+                return None
         task_resources: Dict[str, AllocatedTaskResources] = {}
         task_lifecycles: Dict[str, Optional[object]] = {}
         alloc_resources = None

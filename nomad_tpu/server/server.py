@@ -78,6 +78,27 @@ class ServerConfig:
         self.use_device_mesh = use_device_mesh
 
 
+class _EvalCommitBatch:
+    """One group-committed EVAL_UPDATE raft entry's future."""
+
+    def __init__(self) -> None:
+        self.evals: List[Evaluation] = []
+        self._done = threading.Event()
+        self._index = 0
+        self._error: Optional[Exception] = None
+
+    def resolve(self, index: int, error: Optional[Exception]) -> None:
+        self._index, self._error = index, error
+        self._done.set()
+
+    def wait(self, timeout: float = 30.0) -> int:
+        if not self._done.wait(timeout):
+            raise TimeoutError("eval update group commit timed out")
+        if self._error is not None:
+            raise self._error
+        return self._index
+
+
 class Server:
     """``raft`` is optional: without it the server is a single-process
     authority (raft_apply goes straight to the FSM); with it, applies
@@ -86,6 +107,9 @@ class Server:
 
     def __init__(self, config: Optional[ServerConfig] = None) -> None:
         self.config = config or ServerConfig()
+        self._eval_commit_lock = threading.Lock()
+        self._eval_commit_batch: Optional[_EvalCommitBatch] = None
+        self._eval_commit_busy = False
         self.raft = None
         self.state = StateStore()
         self.eval_broker = EvalBroker(
@@ -780,10 +804,46 @@ class Server:
     # --- Eval endpoint (worker-facing; nomad/eval_endpoint.go) ----------
 
     def update_eval(self, ev: Evaluation, token: str = "") -> int:
-        return self.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": [ev]})
+        return self._eval_update_group_commit(ev)
 
     def create_eval(self, ev: Evaluation, token: str = "") -> int:
-        return self.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": [ev]})
+        return self._eval_update_group_commit(ev)
+
+    def _eval_update_group_commit(self, ev: Evaluation) -> int:
+        """Group-commit EVAL_UPDATE: a wave of batched workers finishes
+        ~wave-size evals nearly at once; one raft entry per drain
+        instead of one per eval (the deploymentwatcher-batcher idea,
+        deployments_watcher.go:36, but latency-free — whatever arrives
+        while the previous apply is in flight rides the next entry).
+
+        The first arriver becomes the committer and drains successive
+        batches until none are pending; everyone else waits on their
+        batch's future."""
+        with self._eval_commit_lock:
+            my_batch = self._eval_commit_batch
+            if my_batch is None:
+                my_batch = self._eval_commit_batch = _EvalCommitBatch()
+            my_batch.evals.append(ev)
+            if self._eval_commit_busy:
+                leader = False
+            else:
+                self._eval_commit_busy = True
+                leader = True
+        if not leader:
+            return my_batch.wait()
+        while True:
+            with self._eval_commit_lock:
+                batch = self._eval_commit_batch
+                self._eval_commit_batch = None
+                if batch is None:
+                    self._eval_commit_busy = False
+                    break
+            try:
+                batch.resolve(self.raft_apply(
+                    fsm_msgs.EVAL_UPDATE, {"evals": batch.evals}), None)
+            except Exception as e:               # noqa: BLE001
+                batch.resolve(0, e)
+        return my_batch.wait()
 
     def reblock_eval(self, ev: Evaluation, token: str = "") -> int:
         """Eval.Reblock: the worker re-blocks an eval it still holds."""

@@ -214,9 +214,44 @@ class Allocation:
         return self.client_status == ALLOC_CLIENT_UNKNOWN
 
     def comparable_resources(self) -> ComparableResources:
-        if self.allocated_resources is not None:
-            return self.allocated_resources.comparable()
-        return ComparableResources()
+        return self.fit_meta()[0]
+
+    def fit_meta(self):
+        """(comparable, uses_ports, uses_devices), memoized against the
+        ``allocated_resources`` object.
+
+        The applier's per-node re-check (plan_apply.go:644) re-flattens
+        every alloc on every touched node on every plan; the flattening
+        dominated that path's profile. Resources are replaced (never
+        mutated in place) when an alloc changes — the same convention
+        the state store's usage planes rely on — so identity of the
+        AllocatedResources object is a sound cache key. Callers must
+        treat the returned ComparableResources as read-only (all
+        in-tree callers do: they ``add`` it into an accumulator).
+        """
+        ar = self.allocated_resources
+        cached = getattr(self, "_fit_meta_cache", None)
+        if cached is not None and cached[0] is ar:
+            return cached[1]
+        if ar is None:
+            meta = (ComparableResources(), False, False)
+        else:
+            cr = ar.comparable()
+            meta = (
+                cr,
+                bool(cr.networks) or bool(ar.shared.ports),
+                any(tr.devices for tr in ar.tasks.values()),
+            )
+        self._fit_meta_cache = (ar, meta)
+        return meta
+
+    def __getstate__(self):
+        """Allocs ride raft entries, snapshots, and the client state DB
+        (pickle); derived scratch (the fit_meta memo) must not bloat
+        those wire/disk payloads."""
+        state = dict(self.__dict__)
+        state.pop("_fit_meta_cache", None)
+        return state
 
     def index(self) -> int:
         """Alloc index parsed from Name "job.group[idx]" (structs.go)."""

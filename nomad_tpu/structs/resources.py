@@ -381,12 +381,16 @@ def allocs_fit(
     used = ComparableResources()
     reserved_cores = set()
     core_overlap = False
+    any_ports = False
+    any_devices = False
 
     for alloc in allocs:
         if alloc.terminal_status():
             continue
-        cr = alloc.comparable_resources()
+        cr, uses_ports, uses_devices = alloc.fit_meta()
         used.add(cr)
+        any_ports |= uses_ports
+        any_devices |= uses_devices
         for core in cr.reserved_cores:
             if core in reserved_cores:
                 core_overlap = True
@@ -401,7 +405,11 @@ def allocs_fit(
     if not ok:
         return False, dim, used
 
-    if net_idx is None:
+    if net_idx is None and any_ports:
+        # only build the port/bandwidth index when some proposed alloc
+        # actually declares networks or ports — for port-less sets no
+        # collision or bandwidth use is possible, and building the
+        # index per node per plan dominated the applier's profile
         net_idx = NetworkIndex()
         collide, reason = net_idx.set_node(node)
         if collide:
@@ -410,10 +418,10 @@ def allocs_fit(
         if collide:
             return False, f"reserved alloc port collision: {reason}", used
 
-    if net_idx.overcommitted():
+    if net_idx is not None and net_idx.overcommitted():
         return False, "bandwidth exceeded", used
 
-    if check_devices:
+    if check_devices and any_devices:
         accounter = DeviceAccounter(node)
         if accounter.add_allocs(allocs):
             return False, "device oversubscribed", used

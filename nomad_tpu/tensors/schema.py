@@ -80,6 +80,44 @@ class ClusterTensors:
     _dc_arr: Optional[np.ndarray] = None          # U-dtype datacenter per row
     _pool_arr: Optional[np.ndarray] = None
     _usage_perm: Optional[Tuple[int, np.ndarray, np.ndarray]] = None
+    _class_rows: Optional[Dict[str, List[int]]] = None
+
+    _gathered_usage: Optional[Tuple[int, tuple]] = None
+
+    def gathered_usage(self, usage) -> tuple:
+        """(used_cpu, used_mem, used_disk, used_cores, used_mbits)
+        gathered to cluster rows — READ-ONLY arrays cached per usage
+        ``version`` and shared by identity across every eval scheduled
+        against that snapshot. The wave launcher ships identity-shared
+        planes to the device ONCE per wave instead of once per member;
+        mutators (retry bookkeeping) must copy-on-write."""
+        cached = self._gathered_usage
+        if cached is not None and cached[0] == usage.version:
+            return cached[1]
+        version = usage.version
+        perm, valid = self.usage_perm(usage)
+        planes = (
+            np.where(valid, usage.used_cpu[perm], 0.0).astype(np.float32),
+            np.where(valid, usage.used_mem[perm], 0.0).astype(np.float32),
+            np.where(valid, usage.used_disk[perm], 0.0).astype(np.float32),
+            np.where(valid, usage.used_cores[perm], 0).astype(np.int32),
+            np.where(valid, usage.used_mbits[perm], 0).astype(np.int32),
+        )
+        for p in planes:
+            p.setflags(write=False)
+        object.__setattr__(self, "_gathered_usage", (version, planes))
+        return planes
+
+    def class_rows(self) -> Dict[str, List[int]]:
+        """computed class -> real-node rows, cached on the cluster build
+        (the class-eligibility walk needs it once per EVAL; rebuilding
+        the O(N) grouping per eval showed in the wave profile)."""
+        if self._class_rows is None:
+            rows: Dict[str, List[int]] = {}
+            for i, cc in enumerate(self.computed_classes):
+                rows.setdefault(cc, []).append(i)
+            object.__setattr__(self, "_class_rows", rows)
+        return self._class_rows
 
     def usage_perm(self, usage) -> Tuple[np.ndarray, np.ndarray]:
         """Map cluster rows -> usage-plane rows (gather index + validity).

@@ -98,7 +98,7 @@ class TestReplayPlanes:
     def test_planes_flatten_and_feed_the_kernel(self, replay_path):
         import bench
 
-        cluster, used_cpu, used_mem, used_disk, asks, stats = \
+        cluster, _snap, used_cpu, used_mem, used_disk, asks, stats = \
             bench._replay_planes(replay_path)
         assert stats["replay_nodes"] == 300
         assert stats["replay_allocs"] == 1500
@@ -114,7 +114,7 @@ class TestReplayPlanes:
 
         import bench
 
-        cluster, used_cpu, used_mem, used_disk, asks, _ = \
+        cluster, _snap, used_cpu, used_mem, used_disk, asks, _ = \
             bench._replay_planes(replay_path)
         path = bench._write_planes_file(
             cluster, used_cpu, used_mem, used_disk, asks, 50, 5)
