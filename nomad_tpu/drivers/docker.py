@@ -53,6 +53,79 @@ def _container_name(config: TaskConfig) -> str:
     return f"nomad-{config.name}-{config.alloc_id[:8] or config.id[:8]}"
 
 
+def _registry_of(image: str) -> str:
+    """Registry host of an image reference (driver.go repository
+    parsing): 'gcr.io/proj/app:v1' -> 'gcr.io'; bare names -> the
+    default index."""
+    first = image.split("/", 1)[0]
+    if "/" in image and ("." in first or ":" in first
+                        or first == "localhost"):
+        return first
+    return "https://index.docker.io/v1/"
+
+
+class ImageCoordinator:
+    """Reference-counted image lifecycle (drivers/docker/coordinator.go):
+    every running task holds a reference on its image; when the last
+    reference drops, removal is scheduled after ``remove_delay`` so a
+    rescheduled task can reuse the layer cache; a new reference before
+    the deadline cancels the removal."""
+
+    def __init__(self, remove_delay: float = 180.0,
+                 cleanup: bool = True) -> None:
+        self.remove_delay = remove_delay
+        self.cleanup = cleanup
+        self._lock = threading.Lock()
+        self._refs: Dict[str, set] = {}
+        self._timers: Dict[str, threading.Timer] = {}
+
+    def use(self, image: str, task_id: str) -> None:
+        with self._lock:
+            self._refs.setdefault(image, set()).add(task_id)
+            timer = self._timers.pop(image, None)
+        if timer is not None:
+            timer.cancel()
+
+    def release(self, image: str, task_id: str) -> None:
+        with self._lock:
+            refs = self._refs.get(image)
+            if refs is None:
+                return
+            refs.discard(task_id)
+            if refs or not self.cleanup:
+                return
+            del self._refs[image]
+            old = self._timers.pop(image, None)
+            timer = threading.Timer(
+                self.remove_delay, self._remove, args=(image,))
+            timer.daemon = True
+            self._timers[image] = timer
+        if old is not None:
+            old.cancel()
+        timer.start()
+
+    def _remove(self, image: str) -> None:
+        with self._lock:
+            self._timers.pop(image, None)
+            # last-instant re-check: a use() racing the timer fire must
+            # win (the rmi below runs unlocked, so the residual window
+            # is the subprocess itself — microseconds vs the delay)
+            if self._refs.get(image):
+                return
+        try:
+            subprocess.run(["docker", "rmi", image],
+                           capture_output=True, timeout=120)
+        except Exception:               # noqa: BLE001
+            pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            timers = list(self._timers.values())
+            self._timers.clear()
+        for t in timers:
+            t.cancel()
+
+
 class DockerDriver(RawExecDriver):
     name = "docker"
 
@@ -65,6 +138,20 @@ class DockerDriver(RawExecDriver):
         self.volumes_enabled = str(
             opts.get("docker.volumes.enabled", "false")).lower() in (
                 "1", "true", "yes")
+        # registry auth backends (driver.go:604
+        # resolveRegistryAuthentication): a docker config FILE and/or a
+        # credential HELPER configured by the operator; the task's own
+        # auth block is checked first
+        self.auth_config_file = opts.get("docker.auth.config", "")
+        self.auth_helper = opts.get("docker.auth.helper", "")
+        # image refcount GC (coordinator.go): delayed removal after the
+        # last task using an image stops
+        self.images = ImageCoordinator(
+            remove_delay=float(opts.get("docker.cleanup.image.delay",
+                                        "180")),
+            cleanup=str(opts.get("docker.cleanup.image", "true")).lower()
+            in ("1", "true", "yes"),
+        )
 
     #: image -> lock: concurrent tasks of one image pull it ONCE
     #: (drivers/docker/coordinator.go singleflight)
@@ -74,9 +161,73 @@ class DockerDriver(RawExecDriver):
     def plugin_info(self) -> PluginInfo:
         return PluginInfo(name=self.name, type=PLUGIN_TYPE_DRIVER)
 
+    # -- registry authentication (driver.go:604) -------------------------
+
+    def _resolve_registry_auth(self, image: str,
+                               task_auth: Optional[Dict] = None
+                               ) -> Optional[Dict[str, str]]:
+        """Backend chain, first hit wins: the task's own ``auth`` block,
+        the operator's docker config file (auths + credHelpers), then
+        the operator's credential helper
+        (``docker-credential-<helper> get``)."""
+        import base64
+
+        registry = _registry_of(image)
+        if task_auth and task_auth.get("username"):
+            return {"username": str(task_auth["username"]),
+                    "password": str(task_auth.get("password", "")),
+                    "server": str(task_auth.get("server_address")
+                                  or registry)}
+        if self.auth_config_file:
+            try:
+                with open(self.auth_config_file) as f:
+                    cfg = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                cfg = {}
+            entry = (cfg.get("auths") or {}).get(registry)
+            if entry is None and registry.startswith("https://"):
+                entry = (cfg.get("auths") or {}).get(
+                    registry.removeprefix("https://"))
+            if entry and entry.get("auth"):
+                try:
+                    user, _, pw = base64.b64decode(
+                        entry["auth"]).decode().partition(":")
+                    return {"username": user, "password": pw,
+                            "server": registry}
+                except Exception:       # noqa: BLE001
+                    pass
+            helper = (cfg.get("credHelpers") or {}).get(registry)
+            if helper:
+                got = self._run_cred_helper(helper, registry)
+                if got:
+                    return got
+        if self.auth_helper:
+            return self._run_cred_helper(self.auth_helper, registry)
+        return None
+
+    @staticmethod
+    def _run_cred_helper(helper: str, registry: str
+                         ) -> Optional[Dict[str, str]]:
+        """`docker-credential-<helper> get` speaking the credential
+        helper protocol (docker-credential-helpers wire shape)."""
+        try:
+            out = subprocess.run(
+                [f"docker-credential-{helper}", "get"],
+                input=registry.encode(), capture_output=True, timeout=30,
+            )
+            if out.returncode != 0:
+                return None
+            got = json.loads(out.stdout.decode())
+            return {"username": str(got.get("Username", "")),
+                    "password": str(got.get("Secret", "")),
+                    "server": str(got.get("ServerURL") or registry)}
+        except Exception:               # noqa: BLE001
+            return None
+
     # -- image pull coordination (coordinator.go) ------------------------
 
-    def _ensure_image(self, image: str, timeout: float = 600.0) -> None:
+    def _ensure_image(self, image: str, timeout: float = 600.0,
+                      task_auth: Optional[Dict] = None) -> None:
         with self._pull_locks_guard:
             lock = self._pull_locks.setdefault(image, threading.Lock())
         with lock:
@@ -86,10 +237,31 @@ class DockerDriver(RawExecDriver):
             )
             if probe.returncode == 0:
                 return
-            pull = subprocess.run(
-                ["docker", "pull", image],
-                capture_output=True, timeout=timeout,
-            )
+            auth = self._resolve_registry_auth(image, task_auth)
+            argv, cfg_dir = ["docker"], None
+            if auth is not None:
+                # an ephemeral --config dir carries the credentials to
+                # THIS pull only (the API-path X-Registry-Auth analog)
+                # without touching the operator's docker login state
+                import base64
+                import tempfile
+
+                cfg_dir = tempfile.mkdtemp(prefix="nomad-docker-auth-")
+                token = base64.b64encode(
+                    f"{auth['username']}:{auth['password']}".encode()
+                ).decode()
+                with open(f"{cfg_dir}/config.json", "w") as f:
+                    json.dump(
+                        {"auths": {auth["server"]: {"auth": token}}}, f)
+                argv += ["--config", cfg_dir]
+            try:
+                pull = subprocess.run(
+                    argv + ["pull", image],
+                    capture_output=True, timeout=timeout,
+                )
+            finally:
+                if cfg_dir is not None:
+                    shutil.rmtree(cfg_dir, ignore_errors=True)
             if pull.returncode != 0:
                 raise RuntimeError(
                     f"docker pull {image}: "
@@ -123,19 +295,31 @@ class DockerDriver(RawExecDriver):
         image = config.driver_config.get("image")
         if not image:
             raise ValueError("docker driver requires image")
-        self._ensure_image(image)
-        engine_live = self._engine() is not None
-        real_out, real_err = config.std_out_path, config.std_err_path
-        if engine_live:
-            # docklog is the log path (the reference never attaches
-            # `docker run` output either); the CLI attachment would
-            # write every container line a second time
-            config.std_out_path = os.devnull
-            config.std_err_path = os.devnull
+        # reference BEFORE the pull (coordinator.go registers inside
+        # PullImage): a pending removal timer is cancelled before the
+        # inspect probe can be invalidated by it
+        self.images.use(image, config.id)
         try:
-            handle = super().start_task(config)
-        finally:
-            config.std_out_path, config.std_err_path = real_out, real_err
+            self._ensure_image(image,
+                               task_auth=config.driver_config.get("auth"))
+            engine_live = self._engine() is not None
+            real_out, real_err = config.std_out_path, config.std_err_path
+            if engine_live:
+                # docklog is the log path (the reference never attaches
+                # `docker run` output either); the CLI attachment would
+                # write every container line a second time
+                config.std_out_path = os.devnull
+                config.std_err_path = os.devnull
+            try:
+                handle = super().start_task(config)
+            finally:
+                config.std_out_path, config.std_err_path = \
+                    real_out, real_err
+        except BaseException:
+            # a failed start must not strand the reference (the image
+            # would be exempt from GC forever)
+            self.images.release(image, config.id)
+            raise
         if engine_live:
             self._start_docklog(config, handle, engine_checked=True)
         return handle
@@ -168,6 +352,11 @@ class DockerDriver(RawExecDriver):
 
     def recover_task(self, handle: TaskHandle) -> None:
         super().recover_task(handle)
+        # the recovered task holds its image reference again
+        # (coordinator.go re-registers on recovery)
+        image = handle.config.driver_config.get("image")
+        if image:
+            self.images.use(image, handle.config.id)
         # docklog survives with the task; respawn only when it died
         # (docklog.go reattach-or-restart on recover)
         import os
@@ -286,6 +475,10 @@ class DockerDriver(RawExecDriver):
 
     def destroy_task(self, task_id: str, force: bool = False) -> None:
         task = self._tasks.get(task_id)
+        # super() validates first (a live task without force raises):
+        # the container removal and the image-reference drop happen
+        # only when the destroy actually goes through
+        super().destroy_task(task_id, force=force)
         if task is not None:
             subprocess.run(
                 ["docker", "rm", "-f", _container_name(task.config)],
@@ -294,7 +487,9 @@ class DockerDriver(RawExecDriver):
             # the engine closes the log stream when the container goes;
             # docklog exits on its own — nothing to reap here beyond
             # the normal child cleanup
-        super().destroy_task(task_id, force=force)
+            image = task.config.driver_config.get("image")
+            if image:
+                self.images.release(image, task.config.id)
 
     def exec_task(self, task_id: str, cmd: List[str],
                   timeout: float = 30.0) -> Dict:

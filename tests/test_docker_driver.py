@@ -26,6 +26,8 @@ HERE=$(dirname "$0")
 LOG="${FAKE_DOCKER_LOG:-$HERE/../invocations.log}"
 FAKE_DOCKER_STATE="${FAKE_DOCKER_STATE:-$HERE/../state}"
 echo "$@" >> "$LOG"
+CONFDIR=""
+if [ "$1" = "--config" ]; then CONFDIR="$2"; shift 2; fi
 cmd="$1"
 case "$cmd" in
   version) echo "24.0.7"; exit 0 ;;
@@ -40,6 +42,13 @@ case "$cmd" in
     img="$2"
     sleep "${FAKE_DOCKER_PULL_DELAY:-0.2}"
     touch "$FAKE_DOCKER_STATE/pulled-$(echo "$img" | tr '/:' '__')"
+    if [ -n "$CONFDIR" ] && [ -f "$CONFDIR/config.json" ]; then
+      cp "$CONFDIR/config.json" \
+        "$FAKE_DOCKER_STATE/auth-$(echo "$img" | tr '/:' '__')"
+    fi
+    exit 0 ;;
+  rmi)
+    touch "$FAKE_DOCKER_STATE/removed-$(echo "$2" | tr '/:' '__')"
     exit 0 ;;
   run) exec sleep 30 ;;
   stats) echo '{"CPUPerc":"12.5%","MemUsage":"21.48MiB / 1GiB"}'; exit 0 ;;
@@ -120,7 +129,9 @@ class TestDockerDriver:
         assert len(_calls(fake_docker, "pull")) == 1
 
     def test_run_with_stats_and_stop(self, fake_docker, tmp_path):
-        driver = DockerDriver()
+        # image GC off: a default 180s removal timer would outlive the
+        # PATH monkeypatch and run `docker rmi` against the REAL host
+        driver = DockerDriver(options={"docker.cleanup.image": "false"})
         DockerDriver._pull_locks.clear()
         cfg = _cfg(tmp_path)
         driver.start_task(cfg)
@@ -138,7 +149,7 @@ class TestDockerDriver:
         assert _calls(fake_docker, "rm")
 
     def test_streaming_exec_enters_container(self, fake_docker, tmp_path):
-        driver = DockerDriver()
+        driver = DockerDriver(options={"docker.cleanup.image": "false"})
         DockerDriver._pull_locks.clear()
         cfg = _cfg(tmp_path)
         driver.start_task(cfg)
@@ -313,3 +324,111 @@ class TestEngineAPI:
             srv.shutdown()
         assert out_file.read_bytes() == b"out-line-1\nout-line-2\n"
         assert err_file.read_bytes() == b"err-line-1\n"
+
+
+class TestImageLifecycle:
+    """Registry auth chain + refcounted image GC
+    (drivers/docker/driver.go:604, coordinator.go:16)."""
+
+    def test_two_tasks_share_image_removed_after_both_stop(
+            self, fake_docker, tmp_path):
+        state = os.environ["FAKE_DOCKER_STATE"]
+        driver = DockerDriver(options={
+            "docker.cleanup.image.delay": "0.3"})
+        c1 = _cfg(tmp_path, name="a")
+        c2 = _cfg(tmp_path, name="b")
+        h1 = driver.start_task(c1)
+        h2 = driver.start_task(c2)
+        removed = os.path.join(state, "removed-busybox_1.36")
+        try:
+            driver.destroy_task(c1.id, force=True)
+            time.sleep(0.6)
+            # second task still holds the reference: no removal
+            assert not os.path.exists(removed)
+            driver.destroy_task(c2.id, force=True)
+            deadline = time.time() + 5
+            while time.time() < deadline and not os.path.exists(removed):
+                time.sleep(0.05)
+            assert os.path.exists(removed), \
+                "image not removed after last reference dropped"
+        finally:
+            driver.images.shutdown()
+            for h in (h1, h2):
+                try:
+                    driver.destroy_task(h.config.id, force=True)
+                except Exception:
+                    pass
+
+    def test_new_reference_cancels_scheduled_removal(
+            self, fake_docker, tmp_path):
+        state = os.environ["FAKE_DOCKER_STATE"]
+        driver = DockerDriver(options={
+            "docker.cleanup.image.delay": "0.4"})
+        c1 = _cfg(tmp_path, name="a")
+        driver.start_task(c1)
+        driver.destroy_task(c1.id, force=True)
+        # re-reference inside the removal window
+        c2 = _cfg(tmp_path, name="b")
+        driver.start_task(c2)
+        time.sleep(0.8)
+        try:
+            assert not os.path.exists(
+                os.path.join(state, "removed-busybox_1.36"))
+        finally:
+            driver.destroy_task(c2.id, force=True)
+            driver.images.shutdown()
+
+    def test_pull_uses_task_auth_credentials(self, fake_docker, tmp_path):
+        state = os.environ["FAKE_DOCKER_STATE"]
+        driver = DockerDriver()
+        cfg = _cfg(tmp_path, image="registry.example.com/priv/app:1")
+        cfg.driver_config["auth"] = {
+            "username": "bob", "password": "hunter2"}
+        h = driver.start_task(cfg)
+        try:
+            auth_file = os.path.join(
+                state, "auth-registry.example.com_priv_app_1")
+            assert os.path.exists(auth_file), \
+                "pull did not carry credentials via --config"
+            import base64
+            with open(auth_file) as f:
+                auths = json.load(f)["auths"]
+            token = auths["registry.example.com"]["auth"]
+            assert base64.b64decode(token).decode() == "bob:hunter2"
+        finally:
+            driver.destroy_task(cfg.id, force=True)
+            driver.images.shutdown()
+
+    def test_auth_chain_falls_back_to_config_file_then_helper(
+            self, fake_docker, tmp_path):
+        import base64
+
+        # config-file backend
+        cfg_file = tmp_path / "dockercfg.json"
+        cfg_file.write_text(json.dumps({"auths": {
+            "reg1.example.com": {
+                "auth": base64.b64encode(b"alice:pw1").decode()}}}))
+        driver = DockerDriver(options={
+            "docker.auth.config": str(cfg_file),
+            "docker.auth.helper": "test",
+        })
+        got = driver._resolve_registry_auth("reg1.example.com/app:1")
+        assert got == {"username": "alice", "password": "pw1",
+                       "server": "reg1.example.com"}
+
+        # helper backend (no config-file entry for this registry)
+        helper = tmp_path / "bin" / "docker-credential-test"
+        helper.write_text(
+            "#!/bin/sh\nread REG\n"
+            "echo '{\"Username\":\"carol\",\"Secret\":\"pw2\","
+            "\"ServerURL\":\"'$REG'\"}'\n")
+        helper.chmod(helper.stat().st_mode | stat.S_IEXEC)
+        got = driver._resolve_registry_auth("reg2.example.com/app:1")
+        assert got == {"username": "carol", "password": "pw2",
+                       "server": "reg2.example.com"}
+
+        # task auth outranks both
+        got = driver._resolve_registry_auth(
+            "reg1.example.com/app:1", {"username": "dave",
+                                       "password": "pw3"})
+        assert got["username"] == "dave"
