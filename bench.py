@@ -526,8 +526,17 @@ def run_replay_preemption(cluster, snap, used_cpu, used_mem, asks) -> dict:
     loop = make_preemption_apply_loop(PLACEMENTS_PER_EVAL, reset_every=1)
 
     T, B = CELL_BATCHES, BATCH
-    a_cpu = jnp.asarray(asks[:T * B, 0].reshape(T, B))
-    a_mem = jnp.asarray(asks[:T * B, 1].reshape(T, B))
+    # the replay's LARGEST service shape (bench/c2m.py "service-
+    # distinct"): big asks against the saturated replay state are what
+    # actually drive placements through the eviction path — the lean
+    # mix mostly fits free capacity and would measure preemption-
+    # enabled scoring that never preempts
+    rng = np.random.default_rng(17)
+    big = rng.random((T, B)) < 0.5
+    a_cpu = jnp.asarray(np.where(
+        big, 4000.0, asks[:T * B, 0].reshape(T, B)).astype(np.float32))
+    a_mem = jnp.asarray(np.where(
+        big, 8192.0, asks[:T * B, 1].reshape(T, B)).astype(np.float32))
     n_steps = jnp.asarray(np.full(B, PLACEMENTS_PER_EVAL, np.int32))
 
     best_dt, placed, preempted = float("inf"), 0, 0

@@ -56,6 +56,9 @@ class AllocRunner:
         # bridge networking (network_hook.go); None when unsupported
         self.network_manager = network_manager
         self.alloc_network = None
+        # (driver, NetworkIsolationSpec) when the group's driver built
+        # the namespace itself (DriverNetworkManager)
+        self.driver_network = None
         # Connect hook (envoy_bootstrap_hook analog); None without the
         # mesh RPC verbs
         self.connect_mgr = connect_mgr
@@ -112,11 +115,7 @@ class AllocRunner:
                 except Exception as e:          # noqa: BLE001
                     LOG.warning("alloc %s: csi mount %s: %s",
                                 self.alloc.id, name, e)
-                    for task in tg.tasks:
-                        self._on_task_state(
-                            task.name, TaskState(state=STATE_DEAD, failed=True)
-                        )
-                    self._tasks_started = True
+                    self._fail_alloc(tg)
                     return
         # bridge-network prerun hook (network_hook.go): a bridge-mode
         # group gets its own netns + veth before any task starts; the
@@ -126,18 +125,33 @@ class AllocRunner:
         wants_bridge = any(
             getattr(n, "mode", "host") == "bridge" for n in tg.networks
         )
-        if wants_bridge and self.network_manager is not None:
-            # one mapping per host port: group ports appear both in
-            # shared.ports and inside shared.networks
-            by_host: Dict[int, int] = {}
-            res = self.alloc.allocated_resources
-            if res is not None:
-                for p in res.shared.ports:
-                    by_host[p.value] = p.to or p.value
-                for net in res.shared.networks:
-                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
-                        by_host.setdefault(p.value, p.to or p.value)
-            mappings = sorted(by_host.items())
+        mappings = self._port_mappings() if wants_bridge else []
+        # driver-managed group network (drivers/driver.go:92
+        # DriverNetworkManager): when the group's (single) driver MUST
+        # own the namespace — docker's pause container — the client
+        # delegates instead of building its own netns. Connect sidecar
+        # groups stay on the client netns: the mesh proxies enter the
+        # namespace via `ip netns exec`, which a driver-owned sandbox
+        # does not expose (documented deviation).
+        net_driver = self._group_network_driver(tg)
+        if net_driver is not None and not any(
+                svc.has_sidecar() for svc in tg.services):
+            try:
+                spec = net_driver.create_network(self.alloc.id, mappings)
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("alloc %s: driver network setup failed: %s",
+                            self.alloc.id, e)
+                self._fail_alloc(tg)
+                return
+            if spec is not None:
+                self.driver_network = (net_driver, spec)
+                netns_name = spec.netns
+                if spec.ip:
+                    net_env["NOMAD_ALLOC_IP"] = spec.ip
+            # spec None = the driver declined: the client path below
+            # owns bridge networking after all
+        if wants_bridge and self.driver_network is None \
+                and self.network_manager is not None:
             try:
                 self.alloc_network = self.network_manager.create(
                     self.alloc.id, mappings)
@@ -146,12 +160,9 @@ class AllocRunner:
             except Exception as e:              # noqa: BLE001
                 LOG.warning("alloc %s: bridge network setup failed: %s",
                             self.alloc.id, e)
-                for task in tg.tasks:
-                    self._on_task_state(
-                        task.name, TaskState(state=STATE_DEAD, failed=True))
-                self._tasks_started = True
+                self._fail_alloc(tg)
                 return
-        elif wants_bridge:
+        elif wants_bridge and self.driver_network is None:
             LOG.warning("alloc %s: bridge networking requested but "
                         "unsupported on this client; tasks run in the "
                         "host network", self.alloc.id)
@@ -167,10 +178,7 @@ class AllocRunner:
             except Exception as e:              # noqa: BLE001
                 LOG.warning("alloc %s: connect setup failed: %s",
                             self.alloc.id, e)
-                for task in tg.tasks:
-                    self._on_task_state(
-                        task.name, TaskState(state=STATE_DEAD, failed=True))
-                self._tasks_started = True
+                self._fail_alloc(tg)
                 return
         # mount paths surface to tasks as env (the reference bind-mounts
         # them into the task via VolumeMounts; env is this build's
@@ -208,11 +216,50 @@ class AllocRunner:
                 extra_env=task_env,
                 secrets=self.secrets,
                 netns=netns_name,
+                network_isolation=(self.driver_network[1]
+                                   if self.driver_network else None),
             )
             self.task_runners[task.name] = tr
             tr.start()
         self._tasks_started = True
         self._watch_done()
+
+    def _fail_alloc(self, tg) -> None:
+        """A prerun hook failed: every task is dead-failed and the
+        runner reads as started (so is_done/GC proceed)."""
+        for task in tg.tasks:
+            self._on_task_state(
+                task.name, TaskState(state=STATE_DEAD, failed=True))
+        self._tasks_started = True
+
+    def _port_mappings(self) -> List:
+        """[(host_port, container_port)] from the scheduler's
+        assignment; group ports appear both in shared.ports and inside
+        shared.networks."""
+        by_host: Dict[int, int] = {}
+        res = self.alloc.allocated_resources
+        if res is not None:
+            for p in res.shared.ports:
+                by_host[p.value] = p.to or p.value
+            for net in res.shared.networks:
+                for p in (list(net.reserved_ports)
+                          + list(net.dynamic_ports)):
+                    by_host.setdefault(p.value, p.to or p.value)
+        return sorted(by_host.items())
+
+    def _group_network_driver(self, tg):
+        """The single driver that must own this bridge group's network
+        (DriverNetworkManager + MustInitiateNetwork), or None."""
+        if not any(getattr(n, "mode", "host") == "bridge"
+                   for n in tg.networks):
+            return None
+        names = {task.driver for task in tg.tasks}
+        if len(names) != 1:
+            return None
+        cand = self.drivers.get(next(iter(names)))
+        if cand is not None and cand.capabilities().must_create_network:
+            return cand
+        return None
 
     def restore(self) -> None:
         """Rebuild task runners after agent restart, reattaching to live
@@ -223,6 +270,29 @@ class AllocRunner:
             self._tasks_started = True
             return
         os.makedirs(self.alloc_dir, exist_ok=True)
+        # re-adopt a live driver-created network (the pause container
+        # outlived the agent with its tasks): destroy() must tear it
+        # down and restarted tasks must rejoin it, not the host net.
+        # A transiently unreachable engine (boot ordering) is retried —
+        # adopting None by mistake would silently split the group's
+        # network AND leak the sandbox
+        net_driver = self._group_network_driver(tg)
+        net_env: Dict[str, str] = {}
+        if net_driver is not None:
+            spec = None
+            for attempt in range(3):
+                try:
+                    spec = net_driver.recover_network(
+                        self.alloc.id, self._port_mappings())
+                    break
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("alloc %s: network recover attempt %d: %s",
+                                self.alloc.id, attempt + 1, e)
+                    time.sleep(1.0 + attempt)
+            if spec is not None:
+                self.driver_network = (net_driver, spec)
+                if spec.ip:
+                    net_env["NOMAD_ALLOC_IP"] = spec.ip
         for task in tg.tasks:
             driver = self.drivers.get(task.driver)
             if driver is None:
@@ -241,8 +311,10 @@ class AllocRunner:
                 on_state_change=self._on_task_state,
                 state_db=self.state_db,
                 restart_policy=tg.restart_policy,
-                extra_env=device_env,
+                extra_env=dict(device_env, **net_env),
                 secrets=self.secrets,
+                network_isolation=(self.driver_network[1]
+                                   if self.driver_network else None),
             )
             local_state, handle = (None, None)
             if self.state_db is not None:
@@ -651,6 +723,26 @@ class AllocRunner:
             except Exception:                   # noqa: BLE001
                 pass
             self.alloc_network = None
+        if self.driver_network is not None:
+            drv, spec = self.driver_network
+            try:
+                drv.destroy_network(self.alloc.id, spec)
+            except Exception:                   # noqa: BLE001
+                pass
+            self.driver_network = None
+        else:
+            # safety net: even when recover/setup never adopted a spec
+            # (engine down during restore), a sandbox may exist for
+            # this alloc — best-effort teardown by name so it cannot
+            # leak past the alloc's life
+            tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+                if self.alloc.job is not None else None
+            drv = self._group_network_driver(tg) if tg is not None else None
+            if drv is not None:
+                try:
+                    drv.destroy_network(self.alloc.id, None)
+                except Exception:               # noqa: BLE001
+                    pass
         # CSI postrun: unpublish this alloc's mounts (csi_hook.go
         # Postrun); the server-side watcher releases the claim itself
         if self.csi_manager is not None:

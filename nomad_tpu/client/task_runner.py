@@ -83,6 +83,7 @@ class TaskRunner:
         extra_env: Optional[Dict[str, str]] = None,
         secrets=None,
         netns: str = "",
+        network_isolation=None,
     ) -> None:
         self.alloc = alloc
         self.task = task
@@ -94,6 +95,8 @@ class TaskRunner:
         self.extra_env = extra_env or {}
         # bridge-mode network namespace the task must join (network_hook)
         self.netns = netns
+        # driver-created group network (DriverNetworkManager spec)
+        self.network_isolation = network_isolation
         # Vault/Consul data plane (vault_hook + template_hook sources)
         self.secrets = secrets
         self._vault_token = ""
@@ -539,6 +542,7 @@ class TaskRunner:
             std_err_path=err_path,
             alloc_dir=self.alloc_dir,
             netns=self.netns,
+            network_isolation=self.network_isolation,
         )
 
     def restore(self, task_state: TaskState, handle: Optional[TaskHandle]) -> bool:

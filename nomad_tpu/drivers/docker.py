@@ -43,7 +43,9 @@ from nomad_tpu.plugins.base import PLUGIN_TYPE_DRIVER, PluginInfo
 from nomad_tpu.plugins.drivers import (
     HEALTH_HEALTHY,
     HEALTH_UNDETECTED,
+    DriverCapabilities,
     Fingerprint,
+    NetworkIsolationSpec,
     TaskConfig,
     TaskHandle,
 )
@@ -144,6 +146,10 @@ class DockerDriver(RawExecDriver):
         # auth block is checked first
         self.auth_config_file = opts.get("docker.auth.config", "")
         self.auth_helper = opts.get("docker.auth.helper", "")
+        # pause/infra container image for driver-created group networks
+        # (drivers/docker/network.go, config "infra_image")
+        self.infra_image = opts.get(
+            "docker.infra_image", "gcr.io/google_containers/pause-amd64:3.3")
         # image refcount GC (coordinator.go): delayed removal after the
         # last task using an image stops
         self.images = ImageCoordinator(
@@ -160,6 +166,81 @@ class DockerDriver(RawExecDriver):
 
     def plugin_info(self) -> PluginInfo:
         return PluginInfo(name=self.name, type=PLUGIN_TYPE_DRIVER)
+
+    def capabilities(self) -> DriverCapabilities:
+        caps = super().capabilities()
+        # containers cannot join a client-made netns: docker builds the
+        # group sandbox itself (network.go MustInitiateNetwork)
+        caps.must_create_network = True
+        return caps
+
+    # -- DriverNetworkManager (drivers/docker/network.go) ----------------
+
+    @staticmethod
+    def _pause_name(alloc_id: str) -> str:
+        return f"nomad-pause-{alloc_id[:8]}"
+
+    def create_network(self, alloc_id: str,
+                       port_mappings=None) -> NetworkIsolationSpec:
+        """Start the allocation's pause container: every task container
+        joins ITS network namespace (``--network container:<pause>``),
+        so group tasks share localhost; the scheduler's host-port
+        assignments publish on the pause container (the namespace
+        owner), exactly like the reference's infra container."""
+        name = self._pause_name(alloc_id)
+        self._ensure_image(self.infra_image)
+        # idempotent: a stale pause container from a crashed prior
+        # attempt (or a destroy the agent never ran) would make --name
+        # conflict permanently
+        subprocess.run(["docker", "rm", "-f", name],
+                       capture_output=True, timeout=30)
+        argv = ["docker", "run", "-d", "--name", name]
+        for host, container in port_mappings or []:
+            argv += ["-p", f"{host}:{container}"]
+        argv.append(self.infra_image)
+        out = subprocess.run(argv, capture_output=True, timeout=120)
+        if out.returncode != 0:
+            subprocess.run(["docker", "rm", "-f", name],
+                           capture_output=True, timeout=30)
+            raise RuntimeError(
+                f"pause container: "
+                f"{out.stderr.decode(errors='replace')[:300]}")
+        return NetworkIsolationSpec(
+            mode="group", ip=self._sandbox_ip(name),
+            labels={"docker_sandbox_container": name})
+
+    def _sandbox_ip(self, name: str) -> str:
+        out = subprocess.run(
+            ["docker", "inspect", "-f",
+             "{{range .NetworkSettings.Networks}}{{.IPAddress}}{{end}}",
+             name],
+            capture_output=True, text=True, timeout=30)
+        return out.stdout.strip() if out.returncode == 0 else ""
+
+    def recover_network(self, alloc_id: str, port_mappings=None
+                        ) -> Optional[NetworkIsolationSpec]:
+        """Re-adopt a pause container that outlived the agent. The
+        container must be RUNNING: containers cannot join the network
+        of an exited one, so a stopped sandbox (host reboot) is removed
+        and recreated with its original port mappings."""
+        name = self._pause_name(alloc_id)
+        probe = subprocess.run(
+            ["docker", "inspect", "-f", "{{.State.Running}}", name],
+            capture_output=True, text=True, timeout=30)
+        if probe.returncode != 0:
+            return None
+        if probe.stdout.strip() != "true":
+            return self.create_network(alloc_id, port_mappings)
+        return NetworkIsolationSpec(
+            mode="group", ip=self._sandbox_ip(name),
+            labels={"docker_sandbox_container": name})
+
+    def destroy_network(self, alloc_id: str,
+                        spec: NetworkIsolationSpec) -> None:
+        name = ((spec.labels or {}).get("docker_sandbox_container")
+                if spec is not None else "") or self._pause_name(alloc_id)
+        subprocess.run(["docker", "rm", "-f", name],
+                       capture_output=True, timeout=30)
 
     # -- registry authentication (driver.go:604) -------------------------
 
@@ -430,16 +511,27 @@ class DockerDriver(RawExecDriver):
             argv += ["--cpu-shares", str(config.resources.cpu)]
         for key, value in config.env.items():
             argv += ["-e", f"{key}={value}"]
-        if cfg.get("network_mode"):
+        sandbox = ""
+        if config.network_isolation is not None:
+            sandbox = (config.network_isolation.labels or {}).get(
+                "docker_sandbox_container", "")
+        if sandbox:
+            # join the driver-created group namespace; ports publish on
+            # the pause container (the namespace owner), so per-task
+            # -p flags are invalid here (network.go)
+            argv += ["--network", f"container:{sandbox}"]
+        elif cfg.get("network_mode"):
             argv += ["--network", cfg["network_mode"]]
-        for label in cfg.get("ports") or []:
-            for net in config.resources.networks:
-                assigned = net.port_for_label(label)
-                if assigned:
-                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
-                        if p.label == label:
-                            argv += ["-p",
-                                     f"{assigned}:{p.to or assigned}"]
+        if not sandbox:
+            for label in cfg.get("ports") or []:
+                for net in config.resources.networks:
+                    assigned = net.port_for_label(label)
+                    if assigned:
+                        for p in (list(net.reserved_ports)
+                                  + list(net.dynamic_ports)):
+                            if p.label == label:
+                                argv += ["-p",
+                                         f"{assigned}:{p.to or assigned}"]
         if cfg.get("volumes"):
             if not self.volumes_enabled:
                 # reject, never silently drop binds the task depends on

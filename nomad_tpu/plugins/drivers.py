@@ -44,6 +44,24 @@ class DriverCapabilities:
     exec_: bool = False
     fs_isolation: str = "none"       # none | chroot | image
     remote_tasks: bool = False
+    # the driver owns group-network creation (drivers/driver.go:92
+    # DriverNetworkManager + MustInitiateNetwork): docker containers
+    # cannot join a client-made namespace, so the driver builds the
+    # shared sandbox (pause container) and tasks attach to IT
+    must_create_network: bool = False
+
+
+@dataclass
+class NetworkIsolationSpec:
+    """drivers/driver.go NetworkIsolationSpec: how a task joins its
+    group's shared network namespace — a named netns for exec-family
+    drivers, or driver-private labels (the docker sandbox/pause
+    container) for drivers that own the namespace."""
+
+    mode: str = "group"
+    netns: str = ""
+    ip: str = ""                     # sandbox address (NOMAD_ALLOC_IP)
+    labels: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -64,6 +82,8 @@ class TaskConfig:
     alloc_dir: str = ""
     # bridge-mode network namespace to join (networking_bridge_linux)
     netns: str = ""
+    # driver-created group network to attach to (DriverNetworkManager)
+    network_isolation: Optional[NetworkIsolationSpec] = None
 
 
 @dataclass
@@ -144,3 +164,26 @@ class DriverPlugin(BasePlugin):
     def task_events(self) -> List[Dict]:
         """Drain buffered task events (driver.proto TaskEvents stream)."""
         return []
+
+    # -- DriverNetworkManager (drivers/driver.go:92) ---------------------
+
+    def create_network(self, alloc_id: str,
+                       port_mappings: Optional[List] = None
+                       ) -> Optional["NetworkIsolationSpec"]:
+        """Create the allocation's shared network sandbox. Only drivers
+        with ``capabilities().must_create_network`` implement this
+        (docker's pause container); None means the CLIENT owns bridge
+        networking for this driver."""
+        return None
+
+    def destroy_network(self, alloc_id: str,
+                        spec: "NetworkIsolationSpec") -> None:
+        """Tear down a sandbox created by ``create_network``."""
+
+    def recover_network(self, alloc_id: str,
+                        port_mappings: Optional[List] = None
+                        ) -> Optional["NetworkIsolationSpec"]:
+        """Re-adopt a sandbox that outlived an agent restart; None when
+        no live sandbox exists for the alloc. ``port_mappings`` lets an
+        unhealthy sandbox be recreated with its original ports."""
+        return None

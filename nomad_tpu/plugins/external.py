@@ -48,6 +48,7 @@ from nomad_tpu.plugins.drivers import (
     DriverPlugin,
     ExitResult,
     Fingerprint,
+    NetworkIsolationSpec,
     TaskConfig,
     TaskHandle,
     TaskStatus,
@@ -74,7 +75,7 @@ def _to_wire(obj: Any) -> Any:
 _DC_TYPES = {
     c.__name__: c for c in (
         Fingerprint, DriverCapabilities, TaskConfig, TaskHandle,
-        ExitResult, TaskStatus, PluginInfo,
+        ExitResult, TaskStatus, PluginInfo, NetworkIsolationSpec,
     )
 }
 
@@ -287,6 +288,20 @@ class ExternalDriver(DriverPlugin):
                   timeout: float = 30.0) -> Dict:
         return self._call("exec_task", task_id=task_id, cmd=cmd,
                           timeout=timeout)
+
+    # DriverNetworkManager proxying: an external driver advertising
+    # must_create_network must actually be ASKED (the base-class stub
+    # would silently decline on the proxy's behalf)
+    def create_network(self, alloc_id: str, port_mappings=None):
+        return self._call("create_network", alloc_id=alloc_id,
+                          port_mappings=list(port_mappings or []))
+
+    def destroy_network(self, alloc_id: str, spec) -> None:
+        self._call("destroy_network", alloc_id=alloc_id, spec=spec)
+
+    def recover_network(self, alloc_id: str, port_mappings=None):
+        return self._call("recover_network", alloc_id=alloc_id,
+                          port_mappings=list(port_mappings or []))
 
 
 def serve_driver(driver: DriverPlugin, name: str) -> None:

@@ -1,0 +1,264 @@
+"""Driver-managed group networks (DriverNetworkManager).
+
+Reference behavior: plugins/drivers/driver.go:92 (CreateNetwork/
+DestroyNetwork + MustInitiateNetwork) and drivers/docker/network.go —
+docker builds the allocation's shared namespace itself as a "pause"
+container; task containers join IT (``--network container:<pause>``),
+so a group's tasks share localhost the way the client's bridge netns
+gives that to exec tasks.
+
+The docker CLI is faked (as in test_docker_driver) but the pause
+semantics are REAL: the stub backs each pause container with an actual
+network namespace and runs joined containers inside it, so the
+two-tasks-reach-each-other-over-localhost property is genuinely
+exercised end to end through AllocRunner -> DockerDriver.
+"""
+
+import os
+import stat
+import sys
+import time
+import uuid
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.alloc_runner import AllocRunner
+from nomad_tpu.client.network_manager import bridge_supported
+from nomad_tpu.drivers.docker import DockerDriver
+
+pytestmark = pytest.mark.skipif(
+    not bridge_supported(), reason="host cannot create netns")
+
+FAKE_DOCKER_NS = r'''#!/usr/bin/env python3
+"""Fake docker CLI whose pause containers are real netns."""
+import os, subprocess, sys
+
+STATE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "state")
+ARGS = sys.argv[1:]
+if ARGS[:1] == ["--config"]:
+    ARGS = ARGS[2:]
+CMD = ARGS[0] if ARGS else ""
+with open(os.path.join(STATE, "invocations.log"), "a") as f:
+    f.write(" ".join(sys.argv[1:]) + "\n")
+
+
+def slug(img):
+    return img.replace("/", "_").replace(":", "_")
+
+
+def nsname(container):
+    return "fdkns-" + container.replace("nomad-pause-", "")[:10]
+
+
+if CMD == "version":
+    print("24.0.7"); sys.exit(0)
+if CMD == "image":
+    sys.exit(0 if os.path.exists(
+        os.path.join(STATE, "pulled-" + slug(ARGS[2]))) else 1)
+if CMD == "pull":
+    open(os.path.join(STATE, "pulled-" + slug(ARGS[1])), "w").close()
+    sys.exit(0)
+if CMD in ("rm", "stop"):
+    name = ARGS[-1]
+    if name.startswith("nomad-pause-"):
+        subprocess.run(["ip", "netns", "del", nsname(name)],
+                       capture_output=True)
+    sys.exit(0)
+if CMD == "rmi":
+    sys.exit(0)
+if CMD == "inspect":
+    name = ARGS[-1]
+    ns = nsname(name)
+    if os.path.exists("/var/run/netns/" + ns):
+        print("172.26.99.2" if "IPAddress" in " ".join(ARGS) else "ok")
+        sys.exit(0)
+    sys.exit(1)
+if CMD == "run":
+    rest, detach, name, network = ARGS[1:], False, "", ""
+    image, command, i = None, [], 0
+    VALFLAGS = {"--name", "--memory", "--cpu-shares", "-e",
+                "--network", "-p"}
+    while i < len(rest):
+        a = rest[i]
+        if a in ("--rm", "--init"):
+            i += 1; continue
+        if a == "-d":
+            detach = True; i += 1; continue
+        if a in VALFLAGS:
+            if a == "--name":
+                name = rest[i + 1]
+            if a == "--network":
+                network = rest[i + 1]
+            i += 2; continue
+        image = a; command = rest[i + 1:]; break
+    if detach and name.startswith("nomad-pause-"):
+        ns = nsname(name)
+        subprocess.run(["ip", "netns", "add", ns], check=True)
+        subprocess.run(["ip", "netns", "exec", ns,
+                        "ip", "link", "set", "lo", "up"], check=True)
+        print("deadbeef" + ns); sys.exit(0)
+    if network.startswith("container:"):
+        ns = nsname(network.split(":", 1)[1])
+        os.execvp("ip", ["ip", "netns", "exec", ns] + command)
+    if command:
+        os.execvp(command[0], command)
+    sys.exit(0)
+sys.exit(0)
+'''
+
+
+@pytest.fixture()
+def fake_docker_ns(tmp_path, monkeypatch):
+    bin_dir = tmp_path / "bin"
+    state = tmp_path / "state"
+    bin_dir.mkdir()
+    state.mkdir()
+    stub = bin_dir / "docker"
+    stub.write_text(FAKE_DOCKER_NS)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    (state / "invocations.log").touch()
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    return state / "invocations.log"
+
+
+def _mesh_job(tmp_path):
+    """Two docker tasks in ONE bridge-mode group: 'srv' binds loopback
+    inside the driver-created namespace, 'cli' reaches it there."""
+    result = tmp_path / "result.out"
+    job = mock.job()
+    job.constraints = []
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.networks = [structs.NetworkResource(mode="bridge")]
+    srv = tg.tasks[0]
+    srv.name = "srv"
+    srv.driver = "docker"
+    srv.config = {
+        "image": "busybox:1.36",
+        "command": sys.executable,
+        "args": ["-S", "-c", (
+            "import socket\n"
+            "s = socket.socket()\n"
+            "s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+            "s.bind((\"127.0.0.1\", 9107))\n"
+            "s.listen(2)\n"
+            "while True:\n"
+            "    c, _ = s.accept()\n"
+            "    c.sendall(b\"pause-netns-hello\")\n"
+            "    c.close()\n"
+        )],
+    }
+    cli = srv.copy()
+    cli.name = "cli"
+    cli.config = {
+        "image": "busybox:1.36",
+        "command": sys.executable,
+        "args": ["-S", "-c", (
+            "import socket, time\n"
+            "for _ in range(100):\n"
+            "    try:\n"
+            "        c = socket.create_connection((\"127.0.0.1\", 9107),"
+            " timeout=2)\n"
+            "        break\n"
+            "    except OSError:\n"
+            "        time.sleep(0.2)\n"
+            "data = c.recv(100)\n"
+            f"open({str(result)!r}, \"wb\").write(data)\n"
+        )],
+    }
+    tg.tasks = [srv, cli]
+    return job, result
+
+
+class TestDriverNetwork:
+    def test_group_tasks_share_driver_created_namespace(
+            self, fake_docker_ns, tmp_path):
+        job, result = _mesh_job(tmp_path)
+        alloc = mock.alloc(job=job)
+        alloc.id = str(uuid.uuid4())
+        driver = DockerDriver(options={"docker.cleanup.image": "false"})
+        runner = AllocRunner(
+            alloc=alloc, drivers={"docker": driver},
+            data_dir=str(tmp_path / "data"),
+            on_alloc_update=lambda a: None)
+        try:
+            runner.run()
+            assert runner.driver_network is not None, \
+                "driver network manager not engaged for bridge group"
+            spec = runner.driver_network[1]
+            sandbox = spec.labels["docker_sandbox_container"]
+            assert sandbox == f"nomad-pause-{alloc.id[:8]}"
+
+            deadline = time.time() + 30
+            while time.time() < deadline and not result.exists():
+                time.sleep(0.2)
+            assert result.exists(), "cli never reached srv over localhost"
+            assert result.read_bytes() == b"pause-netns-hello"
+
+            # both task containers joined the pause namespace
+            log = fake_docker_ns.read_text()
+            joins = [ln for ln in log.splitlines()
+                     if f"--network container:{sandbox}" in ln]
+            assert len(joins) == 2
+
+            # the srv port is NOT reachable from the host loopback:
+            # it lives inside the driver-created namespace
+            import socket
+            with pytest.raises(OSError):
+                socket.create_connection(("127.0.0.1", 9107), timeout=1)
+        finally:
+            runner.stop("test done")
+            runner.destroy()
+        # pause namespace torn down with the alloc
+        import subprocess
+        out = subprocess.run(["ip", "netns", "list"],
+                             capture_output=True, text=True)
+        assert f"fdkns-{alloc.id[:8]}" not in out.stdout
+
+    def test_restore_readopts_pause_network_and_destroy_reaps_it(
+            self, fake_docker_ns, tmp_path):
+        """Agent restart: the pause container outlives the agent; the
+        restored runner re-adopts it (restarted tasks rejoin, destroy
+        tears it down) instead of leaking it forever."""
+        import subprocess
+
+        driver = DockerDriver(options={"docker.cleanup.image": "false"})
+        alloc_id = str(uuid.uuid4())
+        spec = driver.create_network(alloc_id, [(25090, 9090)])
+        assert spec.ip == "172.26.99.2"
+        ns = f"fdkns-{alloc_id[:8]}"
+        assert ns in subprocess.run(["ip", "netns", "list"],
+                                    capture_output=True,
+                                    text=True).stdout
+
+        job, _ = _mesh_job(tmp_path)
+        alloc = mock.alloc(job=job)
+        alloc.id = alloc_id
+        restored = AllocRunner(
+            alloc=alloc, drivers={"docker": driver},
+            data_dir=str(tmp_path / "data2"),
+            on_alloc_update=lambda a: None)
+        restored.restore()
+        assert restored.driver_network is not None
+        got = restored.driver_network[1]
+        assert got.labels["docker_sandbox_container"] == \
+            f"nomad-pause-{alloc_id[:8]}"
+        assert got.ip == "172.26.99.2"
+        restored.stop("test")
+        restored.destroy()
+        assert ns not in subprocess.run(["ip", "netns", "list"],
+                                        capture_output=True,
+                                        text=True).stdout
+
+    def test_stale_pause_container_does_not_wedge_create(
+            self, fake_docker_ns, tmp_path):
+        """create_network is idempotent: a leftover pause sandbox from
+        a crashed attempt is replaced, not a permanent name conflict."""
+        driver = DockerDriver(options={"docker.cleanup.image": "false"})
+        alloc_id = str(uuid.uuid4())
+        s1 = driver.create_network(alloc_id, [])
+        s2 = driver.create_network(alloc_id, [])   # stale survivor
+        assert s2.labels == s1.labels
+        driver.destroy_network(alloc_id, s2)
