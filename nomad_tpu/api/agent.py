@@ -50,6 +50,11 @@ class AgentConfig:
     retry_join: List[str] = field(default_factory=list)
     retry_join_interval: float = 5.0
     retry_join_max_attempts: int = 0
+    # real Vault server (agent config vault stanza; empty = dev
+    # in-memory provider)
+    vault_addr: str = ""
+    vault_token: str = ""
+    vault_token_role: str = ""
 
     @classmethod
     def dev(cls, **overrides) -> "AgentConfig":
@@ -95,6 +100,9 @@ class Agent:
             region=self.config.region,
             datacenter=self.config.datacenter,
             name=self.config.name,
+            vault_addr=self.config.vault_addr,
+            vault_token=self.config.vault_token,
+            vault_token_role=self.config.vault_token_role,
         )
         self.server = Server(cfg)
         self.raft_transport = None

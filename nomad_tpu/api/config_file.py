@@ -146,6 +146,15 @@ def _apply_body(cfg, body: Body):
     if acl is not None and "enabled" in acl[1].attrs:
         cfg.acl_enabled = bool(acl[1].attrs["enabled"])
 
+    # vault { address = "http://..." token = "..." create_from_role = "" }
+    # (command/agent/config.go Vault stanza)
+    vault = body.first_block("vault")
+    if vault is not None:
+        va = vault[1].attrs
+        cfg.vault_addr = str(va.get("address", ""))
+        cfg.vault_token = str(va.get("token", ""))
+        cfg.vault_token_role = str(va.get("create_from_role", ""))
+
     tls = body.first_block("tls")
     if tls is not None:
         ta = tls[1].attrs

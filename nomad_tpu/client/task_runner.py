@@ -371,9 +371,12 @@ class TaskRunner:
                     continue
                 self._derive_and_write_token(task_dir)
             except Exception as e:              # noqa: BLE001
-                LOG.warning("task %s: vault token re-derive failed: %s",
-                            self.task_id, e)
-                return
+                # transient (Vault unreachable, server blip): keep the
+                # watch alive and retry next poll — exiting here would
+                # silently end rotation for the task's lifetime
+                LOG.warning("task %s: vault token check/re-derive "
+                            "failed (retrying): %s", self.task_id, e)
+                continue
             mode = self.task.vault.change_mode
             if mode == "restart":
                 self._emit(EVENT_RESTARTING, "Vault token rotated")

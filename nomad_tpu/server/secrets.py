@@ -158,6 +158,165 @@ class DevVaultProvider(VaultProvider):
             return self._index
 
 
+class VaultHTTPError(RuntimeError):
+    """Non-auth HTTP failure from Vault, carrying the status code."""
+
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+class HTTPVaultProvider(VaultProvider):
+    """Vault over its real HTTP API (nomad/vault.go vaultClient).
+
+    Speaks the live wire shapes:
+    - token derivation: ``POST /v1/auth/token/create[/<role>]``
+      (vault.go derives against a token role when configured)
+    - renewal: ``POST /v1/auth/token/renew-accessor``
+    - revocation: ``POST /v1/auth/token/revoke-accessor``
+    - KV reads: ``GET /v1/<path>`` with the task's ``X-Vault-Token``,
+      handling both KV v2 (``data.data``) and v1 (``data``) response
+      shapes; 403 maps to PermissionError (policy enforcement is
+      Vault's), 404 to None
+
+    Deviation: Vault exposes no global modify index, so
+    ``secrets_index`` ticks once per ``index_interval_s`` — template
+    watchers re-check their secrets at that cadence instead of on an
+    exact-change signal (consul-template's lease watching analog).
+    """
+
+    def __init__(self, addr: str, token: str, token_role: str = "",
+                 namespace: str = "", timeout_s: float = 10.0,
+                 index_interval_s: float = 15.0) -> None:
+        self.addr = addr.rstrip("/")
+        self.token = token
+        self.token_role = token_role
+        self.namespace = namespace
+        self.timeout_s = timeout_s
+        self.index_interval_s = index_interval_s
+
+    # -- wire ------------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: Optional[Dict] = None,
+                 token: Optional[str] = None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.addr}/v1/{path.lstrip('/')}",
+            data=_json.dumps(body).encode() if body is not None else None,
+            method=method,
+        )
+        req.add_header("X-Vault-Token",
+                       token if token is not None else self.token)
+        if self.namespace:
+            req.add_header("X-Vault-Namespace", self.namespace)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                raw = r.read()
+                return _json.loads(raw) if raw.strip() else {}
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            if e.code in (401, 403):
+                raise PermissionError(
+                    f"vault: {method} {path}: HTTP {e.code}") from e
+            detail = e.read().decode(errors="replace")[:200]
+            raise VaultHTTPError(e.code,
+                                 f"vault: {method} {path}: HTTP {e.code} "
+                                 f"{detail}") from e
+
+    # -- VaultProvider ---------------------------------------------------
+
+    def create_token(self, policies, ttl_s, meta=None) -> VaultTokenInfo:
+        path = "auth/token/create"
+        if self.token_role:
+            path += f"/{self.token_role}"
+        resp = self._request("POST", path, {
+            "policies": list(policies),
+            "ttl": f"{int(ttl_s)}s",
+            "renewable": True,
+            "meta": dict(meta or {}),
+        })
+        if resp is None:
+            raise RuntimeError(
+                f"vault: token create endpoint /v1/{path} not found — "
+                "check the vault address"
+                + (f" and token role {self.token_role!r}"
+                   if self.token_role else ""))
+        if "auth" not in resp:
+            raise RuntimeError("vault: token create returned no auth block")
+        auth = resp["auth"]
+        now = time.time()
+        lease = float(auth.get("lease_duration") or ttl_s)
+        return VaultTokenInfo(
+            token=auth["client_token"],
+            accessor=auth["accessor"],
+            ttl_s=lease,
+            policies=list(auth.get("token_policies")
+                          or auth.get("policies") or policies),
+            renewable=bool(auth.get("renewable", True)),
+            created_at=now,
+            expires_at=now + lease,
+        )
+
+    def renew(self, accessor: str) -> float:
+        try:
+            resp = self._request("POST", "auth/token/renew-accessor",
+                                 {"accessor": accessor})
+        except VaultHTTPError as e:
+            # real Vault answers 400 "invalid accessor" for a revoked/
+            # unknown accessor; the manager's renew loop treats
+            # KeyError as "revoked out from under us"
+            if e.code == 400:
+                raise KeyError(f"unknown accessor {accessor}") from e
+            raise
+        if resp is None:
+            raise KeyError(f"unknown accessor {accessor}")
+        lease = float((resp.get("auth") or {}).get("lease_duration") or 0)
+        return time.time() + lease
+
+    def revoke(self, accessor: str) -> None:
+        self._request("POST", "auth/token/revoke-accessor",
+                      {"accessor": accessor})
+
+    def token_valid(self, token: str) -> bool:
+        """False ONLY when Vault says the token is invalid; transport
+        and server errors propagate — reporting an unreachable Vault as
+        'token revoked' would rotate live tokens (and restart tasks)
+        on every network blip."""
+        try:
+            resp = self._request("GET", "auth/token/lookup-self",
+                                 token=token)
+        except PermissionError:
+            return False
+        return resp is not None
+
+    def read_secret(self, path: str,
+                    token: str = "") -> Optional[Dict[str, str]]:
+        if not token:
+            # never fall back to the manager's own privileged token:
+            # reads are policy-checked against the TASK's credential
+            # (the Dev provider raises the same way)
+            raise PermissionError("vault: read requires the task token")
+        resp = self._request("GET", path, token=token)
+        if resp is None:
+            return None
+        data = resp.get("data") or {}
+        if "metadata" in data and "data" in data:
+            # KV v2 envelope; a soft-deleted/destroyed version has
+            # data: null and must read as absent, not as the wrapper
+            inner = data["data"]
+            return dict(inner) if isinstance(inner, dict) else None
+        return dict(data)                       # KV v1 shape
+
+    def secrets_index(self) -> int:
+        return int(time.time() // self.index_interval_s)
+
+
 class ConsulProvider:
     """Wire contract to a Consul agent (nomad/consul.go + template KV)."""
 

@@ -52,6 +52,9 @@ class ServerConfig:
         node_gc_threshold: float = 24 * 3600.0,
         deployment_gc_threshold: float = 3600.0,
         use_device_mesh: Optional[bool] = None,
+        vault_addr: str = "",
+        vault_token: str = "",
+        vault_token_role: str = "",
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -76,6 +79,11 @@ class ServerConfig:
         # backend exposes >1 device; tests opt in explicitly on the
         # virtual CPU mesh
         self.use_device_mesh = use_device_mesh
+        # real Vault server (nomad/vault.go config); empty addr = the
+        # in-memory dev provider
+        self.vault_addr = vault_addr
+        self.vault_token = vault_token
+        self.vault_token_role = vault_token_role
 
 
 class _EvalCommitBatch:
@@ -153,8 +161,18 @@ class Server:
         # Consul/Vault integration (nomad/vault.go, consul.go): dev
         # in-memory providers by default; real HTTP providers slot in
         # via config without touching derivation/revocation paths
-        from nomad_tpu.server.secrets import DevConsulProvider, VaultManager
-        self.vault = VaultManager()
+        from nomad_tpu.server.secrets import (
+            DevConsulProvider,
+            HTTPVaultProvider,
+            VaultManager,
+        )
+        provider = None
+        if self.config.vault_addr:
+            provider = HTTPVaultProvider(
+                self.config.vault_addr, self.config.vault_token,
+                token_role=self.config.vault_token_role,
+            )
+        self.vault = VaultManager(provider=provider)
         self.consul = DevConsulProvider()
 
         self.autopilot = Autopilot(self)

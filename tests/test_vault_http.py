@@ -1,0 +1,214 @@
+"""HTTP Vault provider against a stub server speaking the REAL Vault
+wire shapes.
+
+Reference behavior: nomad/vault.go vaultClient — token derivation via
+the token(-role) create API, renewal via renew-accessor, revocation
+via revoke-accessor, all under X-Vault-Token. The stub implements the
+actual endpoint paths and response JSON (auth block with client_token/
+accessor/lease_duration; KV v2 data.data envelope), so the provider is
+exercised against the protocol, not a lookalike.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.server.secrets import HTTPVaultProvider, VaultManager
+
+
+class _FakeVault:
+    """Minimal Vault HTTP server: real paths, real JSON shapes."""
+
+    ROOT = "root-token"
+
+    def __init__(self) -> None:
+        self.tokens = {}         # accessor -> {token, ttl, policies}
+        self.by_token = {}
+        self.secrets = {
+            "secret/data/db": {"data": {
+                "data": {"password": "hunter2"},
+                "metadata": {"version": 1},
+            }},
+            "kv1/legacy": {"data": {"value": "old-school"}},
+        }
+        self.create_calls = []
+        self.renew_calls = []
+
+    def _auth_block(self, entry):
+        return {"auth": {
+            "client_token": entry["token"],
+            "accessor": entry["accessor"],
+            "lease_duration": int(entry["ttl"]),
+            "renewable": True,
+            "token_policies": list(entry["policies"]),
+        }}
+
+    def handle(self, method, path, body, token):
+        import secrets as _s
+
+        if path.startswith("auth/token/create"):
+            if token != self.ROOT:
+                return 403, {}
+            role = path.split("/", 3)[3] if path.count("/") >= 3 else ""
+            self.create_calls.append(role)
+            entry = {
+                "token": f"hvs.{_s.token_urlsafe(18)}",
+                "accessor": _s.token_urlsafe(12),
+                "ttl": int(str(body.get("ttl", "3600s")).rstrip("s")),
+                "policies": body.get("policies") or [],
+            }
+            self.tokens[entry["accessor"]] = entry
+            self.by_token[entry["token"]] = entry
+            return 200, self._auth_block(entry)
+        if path == "auth/token/renew-accessor":
+            acc = body.get("accessor", "")
+            entry = self.tokens.get(acc)
+            if entry is None:
+                # real Vault wire behavior: 400 "invalid accessor"
+                return 400, {"errors": ["invalid accessor"]}
+            self.renew_calls.append(acc)
+            return 200, self._auth_block(entry)
+        if path == "auth/token/revoke-accessor":
+            entry = self.tokens.pop(body.get("accessor", ""), None)
+            if entry is not None:
+                self.by_token.pop(entry["token"], None)
+            return 200, {}
+        if path == "auth/token/lookup-self":
+            if token in self.by_token or token == self.ROOT:
+                return 200, {"data": {"id": token}}
+            return 403, {}
+        # KV reads: policy-checked against the presented token
+        if token != self.ROOT and token not in self.by_token:
+            return 403, {}
+        if path in self.secrets:
+            return 200, self.secrets[path]
+        return 404, {}
+
+
+@pytest.fixture()
+def fake_vault():
+    import http.server
+    import socketserver
+
+    fake = _FakeVault()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: N802
+            pass
+
+        def _serve(self, method):
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}") \
+                if length else {}
+            token = self.headers.get("X-Vault-Token", "")
+            assert self.path.startswith("/v1/")
+            code, resp = fake.handle(method, self.path[4:], body, token)
+            data = json.dumps(resp).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802
+            self._serve("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._serve("POST")
+
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    fake.addr = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        yield fake
+    finally:
+        srv.shutdown()
+
+
+class TestHTTPVaultProvider:
+    def _provider(self, fake, **kw):
+        return HTTPVaultProvider(fake.addr, _FakeVault.ROOT, **kw)
+
+    def test_manager_lifecycle_with_http_provider(self, fake_vault):
+        """The existing manager lifecycle (derive -> renew -> revoke)
+        runs unchanged with the HTTP provider slotted in."""
+        mgr = VaultManager(provider=self._provider(fake_vault),
+                           renew_interval_s=0.2)
+        mgr.start()
+        try:
+            tokens = mgr.derive_tokens(
+                "alloc-1", {"web": ["web-read"], "db": ["db-rw"]})
+            assert set(tokens) == {"web", "db"}
+            assert all(t.token.startswith("hvs.") for t in tokens.values())
+            assert tokens["web"].policies == ["web-read"]
+            # background renewal reaches the real renew-accessor path
+            deadline = time.time() + 5
+            while time.time() < deadline and not fake_vault.renew_calls:
+                time.sleep(0.05)
+            assert tokens["web"].accessor in fake_vault.renew_calls \
+                or tokens["db"].accessor in fake_vault.renew_calls
+            # terminal alloc: both accessors revoked server-side
+            assert mgr.revoke_for_alloc("alloc-1") == 2
+            assert fake_vault.tokens == {}
+        finally:
+            mgr.stop()
+
+    def test_token_role_derivation_path(self, fake_vault):
+        p = self._provider(fake_vault, token_role="nomad-cluster")
+        p.create_token(["p1"], 600)
+        assert fake_vault.create_calls == ["nomad-cluster"]
+
+    def test_kv2_and_kv1_read_shapes(self, fake_vault):
+        p = self._provider(fake_vault)
+        task = p.create_token(["any"], 600)
+        assert p.read_secret("secret/data/db", token=task.token) == \
+            {"password": "hunter2"}
+        assert p.read_secret("kv1/legacy", token=task.token) == \
+            {"value": "old-school"}
+        assert p.read_secret("secret/data/missing",
+                             token=task.token) is None
+
+    def test_bad_token_read_is_permission_error(self, fake_vault):
+        p = self._provider(fake_vault)
+        with pytest.raises(PermissionError):
+            p.read_secret("secret/data/db", token="garbage")
+        # an EMPTY task token must never fall back to the manager's
+        # privileged token
+        with pytest.raises(PermissionError):
+            p.read_secret("secret/data/db", token="")
+        assert not p.token_valid("garbage")
+        good = p.create_token([], 600)
+        assert p.token_valid(good.token)
+
+    def test_unreachable_vault_is_an_error_not_invalid_token(self):
+        p = HTTPVaultProvider("http://127.0.0.1:9", "tok", timeout_s=1.0)
+        # conflating transport failure with revocation would rotate
+        # live tokens on every network blip
+        with pytest.raises(OSError):
+            p.token_valid("hvs.something")
+
+    def test_kv2_deleted_version_reads_as_absent(self, fake_vault):
+        fake_vault.secrets["secret/data/gone"] = {"data": {
+            "data": None, "metadata": {"deletion_time": "2026-01-01"}}}
+        p = self._provider(fake_vault)
+        task = p.create_token([], 600)
+        assert p.read_secret("secret/data/gone", token=task.token) is None
+
+    def test_revoked_accessor_renew_raises_keyerror(self, fake_vault):
+        p = self._provider(fake_vault)
+        info = p.create_token([], 600)
+        p.revoke(info.accessor)
+        with pytest.raises(KeyError):
+            p.renew(info.accessor)
+
+    def test_server_config_slots_http_provider(self, fake_vault):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(
+            num_workers=0, vault_addr=fake_vault.addr,
+            vault_token=_FakeVault.ROOT))
+        assert isinstance(server.vault.provider, HTTPVaultProvider)
+        info = server.vault.provider.create_token(["x"], 60)
+        assert info.accessor in fake_vault.tokens
