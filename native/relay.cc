@@ -20,11 +20,19 @@
 // Status file gets "pid <pid>" then "ready <n_listeners>" (the agent
 // waits for "ready" so scheduler-assigned ports are actually bound
 // before tasks start), or "error ..." lines.
+//
+// Every mapping forwards BOTH protocols — the reference's CNI portmap
+// programs tcp and udp DNAT rules for each mapped port
+// (networking_bridge_linux.go). UDP uses a NAT-style session table:
+// a datagram from a new client address opens a connected socket to
+// the target so replies route back to that client; sessions idle
+// longer than kUdpIdleSecs are swept.
 
 #include <arpa/inet.h>
 #include <cerrno>
 #include <csignal>
 #include <cstdio>
+#include <ctime>
 #include <cstdlib>
 #include <cstring>
 #include <fcntl.h>
@@ -41,11 +49,32 @@ namespace {
 
 constexpr int kMaxEvents = 64;
 constexpr size_t kPipeSize = 256 * 1024;
+constexpr int kUdpIdleSecs = 120;
+constexpr int kSweepMs = 30000;
 
 struct Listener {
   int fd;
   sockaddr_in target;
 };
+
+struct UdpListener {
+  int fd;
+  sockaddr_in target;
+  // client address -> session socket fd
+  std::unordered_map<uint64_t, int> sessions;
+};
+
+struct UdpSession {
+  int fd;
+  UdpListener *owner;
+  sockaddr_in client;
+  uint64_t key;
+  time_t last;
+};
+
+uint64_t addr_key(const sockaddr_in &a) {
+  return ((uint64_t)a.sin_addr.s_addr << 16) | a.sin_port;
+}
 
 // One direction of a proxied connection: src -> pipe -> dst.
 struct Flow {
@@ -159,6 +188,8 @@ int main(int argc, char **argv) {
   // (no dangling pointers).
   std::unordered_map<int, Listener *> listeners;
   std::unordered_map<int, Conn *> conns;
+  std::unordered_map<int, UdpListener *> udp_listeners;
+  std::unordered_map<int, UdpSession *> udp_sessions;
 
   for (int i = 2; i < argc; i++) {
     int lport, tport;
@@ -192,6 +223,24 @@ int main(int argc, char **argv) {
     ev.data.fd = l->fd;
     epoll_ctl(ep, EPOLL_CTL_ADD, l->fd, &ev);
     listeners[l->fd] = l;
+
+    // the same mapping on UDP (CNI portmap programs both protocols)
+    auto *u = new UdpListener();
+    u->fd = socket(AF_INET, SOCK_DGRAM, 0);
+    setsockopt(u->fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (bind(u->fd, (sockaddr *)&addr, sizeof(addr)) != 0) {
+      append_status(status_path,
+                    std::string("error bind udp ") + argv[i] + ": " +
+                        strerror(errno));
+      return 1;
+    }
+    set_nonblock(u->fd);
+    u->target = l->target;
+    epoll_event uev{};
+    uev.events = EPOLLIN;
+    uev.data.fd = u->fd;
+    epoll_ctl(ep, EPOLL_CTL_ADD, u->fd, &uev);
+    udp_listeners[u->fd] = u;
   }
   char buf[64];
   snprintf(buf, sizeof(buf), "pid %d", (int)getpid());
@@ -220,15 +269,88 @@ int main(int argc, char **argv) {
     if (c->fwd.done && c->rev.done) close_conn(c);
   };
 
+  auto close_udp_session = [&](UdpSession *s) {
+    epoll_ctl(ep, EPOLL_CTL_DEL, s->fd, nullptr);
+    udp_sessions.erase(s->fd);
+    s->owner->sessions.erase(s->key);
+    close(s->fd);
+    delete s;
+  };
+
+  char dgram[65536];
   epoll_event events[kMaxEvents];
+  time_t last_sweep = time(nullptr);
   for (;;) {
-    int n = epoll_wait(ep, events, kMaxEvents, -1);
+    int n = epoll_wait(ep, events, kMaxEvents, kSweepMs);
     if (n < 0) {
       if (errno == EINTR) continue;
       break;
     }
+    time_t now = time(nullptr);
+    if (now - last_sweep >= kSweepMs / 1000) {
+      last_sweep = now;
+      std::vector<UdpSession *> idle;
+      for (auto &it : udp_sessions)
+        if (now - it.second->last > kUdpIdleSecs) idle.push_back(it.second);
+      for (auto *s : idle) close_udp_session(s);
+    }
     for (int i = 0; i < n; i++) {
       int fd = events[i].data.fd;
+      auto uit = udp_listeners.find(fd);
+      if (uit != udp_listeners.end()) {
+        UdpListener *u = uit->second;
+        for (;;) {
+          sockaddr_in from{};
+          socklen_t flen = sizeof(from);
+          ssize_t got = recvfrom(u->fd, dgram, sizeof(dgram), 0,
+                                 (sockaddr *)&from, &flen);
+          if (got < 0) break;
+          uint64_t key = addr_key(from);
+          auto sit = u->sessions.find(key);
+          UdpSession *s;
+          if (sit == u->sessions.end()) {
+            int sfd = socket(AF_INET, SOCK_DGRAM, 0);
+            if (sfd < 0) continue;
+            set_nonblock(sfd);
+            if (connect(sfd, (sockaddr *)&u->target,
+                        sizeof(u->target)) != 0) {
+              close(sfd);
+              continue;
+            }
+            s = new UdpSession();
+            s->fd = sfd;
+            s->owner = u;
+            s->client = from;
+            s->key = key;
+            u->sessions[key] = sfd;
+            udp_sessions[sfd] = s;
+            epoll_event sev{};
+            sev.events = EPOLLIN;
+            sev.data.fd = sfd;
+            epoll_ctl(ep, EPOLL_CTL_ADD, sfd, &sev);
+          } else {
+            s = udp_sessions[sit->second];
+          }
+          s->last = now;
+          ssize_t ignored = send(s->fd, dgram, (size_t)got, 0);
+          (void)ignored;
+        }
+        continue;
+      }
+      auto sit = udp_sessions.find(fd);
+      if (sit != udp_sessions.end()) {
+        UdpSession *s = sit->second;
+        for (;;) {
+          ssize_t got = recv(s->fd, dgram, sizeof(dgram), 0);
+          if (got < 0) break;
+          s->last = now;
+          ssize_t ignored =
+              sendto(s->owner->fd, dgram, (size_t)got, 0,
+                     (sockaddr *)&s->client, sizeof(s->client));
+          (void)ignored;
+        }
+        continue;
+      }
       auto lit = listeners.find(fd);
       if (lit != listeners.end()) {
         Listener *l = lit->second;

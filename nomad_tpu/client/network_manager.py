@@ -139,6 +139,105 @@ class _PortForward:
                 pass
 
 
+class _UdpForward:
+    """Userspace UDP host-port -> (alloc_ip, port) relay (the CNI
+    portmap udp rule analog; fallback when the native relay cannot
+    build). NAT-style sessions: a datagram from a new client address
+    opens a connected socket to the target so replies route back."""
+
+    IDLE_SECS = 120.0
+
+    def __init__(self, host_port: int, target_ip: str, target_port: int) -> None:
+        self.host_port = host_port
+        self.target = (target_ip, target_port)
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        # client addr -> [session socket, last_active, client addr];
+        # _by_sock mirrors it keyed by the session socket so replies
+        # avoid an O(sessions) scan per datagram
+        self._sessions: Dict[tuple, list] = {}
+        self._by_sock: Dict[socket.socket, list] = {}
+
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", self.host_port))
+        self._sock.settimeout(0.5)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"udpmap-{self.host_port}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import select
+        import time as _time
+
+        while not self._stop.is_set():
+            socks = [self._sock] + [e[0] for e in self._sessions.values()]
+            try:
+                ready, _, _ = select.select(socks, [], [], 0.5)
+            except OSError:
+                break
+            now = _time.monotonic()
+            for s in ready:
+                if s is self._sock:
+                    try:
+                        data, addr = self._sock.recvfrom(65536)
+                    except OSError:
+                        continue
+                    entry = self._sessions.get(addr)
+                    if entry is None:
+                        sess = socket.socket(socket.AF_INET,
+                                             socket.SOCK_DGRAM)
+                        sess.connect(self.target)
+                        sess.setblocking(False)
+                        entry = [sess, now, addr]
+                        self._sessions[addr] = entry
+                        self._by_sock[sess] = entry
+                    entry[1] = now
+                    try:
+                        entry[0].send(data)
+                    except OSError:
+                        pass
+                else:
+                    entry = self._by_sock.get(s)
+                    if entry is None:
+                        continue
+                    try:
+                        data = s.recv(65536)
+                    except OSError:
+                        continue
+                    entry[1] = now
+                    try:
+                        self._sock.sendto(data, entry[2])
+                    except OSError:
+                        pass
+            for addr in [a for a, e in self._sessions.items()
+                         if now - e[1] > self.IDLE_SECS]:
+                entry = self._sessions.pop(addr)
+                self._by_sock.pop(entry[0], None)
+                try:
+                    entry[0].close()
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        # snapshot: the loop thread may mutate the dict until it
+        # notices the stop flag
+        for entry in list(self._sessions.values()):
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+
 RELAY_STATE_DIR = "/tmp/nomad-tpu-relays"
 
 
@@ -256,13 +355,18 @@ class AllocNetwork:
 
     def __init__(self, alloc_id: str, ns_name: str, ip: str,
                  veth_host: str, forwards: List[_PortForward],
-                 gateway: str = "", native_relay=None) -> None:
+                 gateway: str = "", native_relay=None,
+                 port_mappings: Optional[List[Tuple[int, int]]] = None
+                 ) -> None:
         self.alloc_id = alloc_id
         self.ns_name = ns_name
         self.ip = ip
         self.veth_host = veth_host
         self.forwards = forwards
         self.native_relay = native_relay
+        # kept for the watchdog's respawn (iptables rules can't crash;
+        # a relay process can)
+        self.port_mappings = list(port_mappings or [])
         # the bridge address: how processes INSIDE the namespace reach
         # host-bound listeners (port relays, other allocs' host ports)
         self.gateway = gateway
@@ -272,6 +376,10 @@ class BridgeNetworkManager:
     """Client-wide bridge + per-alloc namespace lifecycle
     (networking_bridge_linux.go bridgeNetworkConfigurator)."""
 
+    #: seconds between relay liveness checks (the "heartbeat" a dead
+    #: relay is respawned within)
+    WATCHDOG_INTERVAL = 3.0
+
     def __init__(self, bridge: str = DEFAULT_BRIDGE,
                  subnet_prefix: str = DEFAULT_SUBNET_PREFIX) -> None:
         self.bridge = bridge
@@ -280,6 +388,69 @@ class BridgeNetworkManager:
         self._used_hosts: set = set()
         self._allocs: Dict[str, AllocNetwork] = {}
         self._bridge_ready = False
+        self._watchdog: Optional[threading.Thread] = None
+        self._watchdog_stop = threading.Event()
+
+    # -- relay supervision ----------------------------------------------
+
+    def _ensure_watchdog(self) -> None:
+        """Supervise native relays: iptables DNAT rules (the reference
+        analog) cannot crash, but a relay process can — port maps would
+        silently go dead. A dead relay is respawned from the alloc's
+        recorded mappings within WATCHDOG_INTERVAL."""
+        with self._lock:
+            if self._watchdog is not None and self._watchdog.is_alive():
+                return
+            self._watchdog_stop.clear()
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="relay-watchdog")
+            self._watchdog.start()
+
+    def stop_watchdog(self) -> None:
+        self._watchdog_stop.set()
+
+    @staticmethod
+    def _relay_alive(pid: int) -> bool:
+        # kill(pid, 0) succeeds on zombies (a relay killed while the
+        # agent lives is our unreaped child); /proc tells the truth
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return f.read().split(")")[1].split()[0] != "Z"
+        except OSError:
+            return False
+
+    def _watchdog_loop(self) -> None:
+        while not self._watchdog_stop.wait(self.WATCHDOG_INTERVAL):
+            with self._lock:
+                nets = [n for n in self._allocs.values()
+                        if n.native_relay is not None]
+            for net in nets:
+                if self._relay_alive(net.native_relay.pid):
+                    continue
+                with self._lock:
+                    # teardown may have raced the check
+                    if self._allocs.get(net.alloc_id) is not net:
+                        continue
+                LOG.warning("alloc %s: native relay pid %d died; "
+                            "respawning", net.alloc_id[:8],
+                            net.native_relay.pid)
+                try:
+                    fresh = _NativeRelay.spawn(
+                        net.alloc_id, net.port_mappings, net.ip)
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("alloc %s: relay respawn failed: %s",
+                                net.alloc_id[:8], e)
+                    continue
+                with self._lock:
+                    if self._allocs.get(net.alloc_id) is net:
+                        net.native_relay = fresh
+                        fresh = None
+                if fresh is not None:
+                    # destroy() completed while we were spawning: the
+                    # fresh relay belongs to a dead alloc — reap it or
+                    # it holds the host ports forever
+                    fresh.stop()
 
     # -- bridge ----------------------------------------------------------
 
@@ -384,17 +555,25 @@ class BridgeNetworkManager:
                     LOG.warning("native relay unavailable (%s); using "
                                 "in-process port relays", e)
                     for host_port, container_port in port_mappings:
+                        # both protocols per mapping (CNI portmap
+                        # programs tcp AND udp DNAT rules)
                         fwd = _PortForward(host_port, ip, container_port)
                         fwd.start()
                         forwards.append(fwd)
+                        ufwd = _UdpForward(host_port, ip, container_port)
+                        ufwd.start()
+                        forwards.append(ufwd)
         except Exception:
             self._teardown(ns, veth_h, ip, forwards, native_relay)
             raise
         net = AllocNetwork(alloc_id, ns, ip, veth_h, forwards,
                            gateway=f"{self.subnet_prefix}.{GATEWAY_HOST}",
-                           native_relay=native_relay)
+                           native_relay=native_relay,
+                           port_mappings=port_mappings)
         with self._lock:
             self._allocs[alloc_id] = net
+        if native_relay is not None:
+            self._ensure_watchdog()
         return net
 
     def destroy(self, alloc_id: str) -> None:

@@ -306,10 +306,14 @@ class HTTPVaultProvider(VaultProvider):
         if resp is None:
             return None
         data = resp.get("data") or {}
-        if "metadata" in data and "data" in data:
-            # KV v2 envelope; a soft-deleted/destroyed version has
-            # data: null and must read as absent, not as the wrapper
-            inner = data["data"]
+        inner = data.get("data")
+        meta = data.get("metadata")
+        # KV v2 envelope: metadata is a dict carrying version/created
+        # fields (a v1 secret that merely HAS 'data'/'metadata' string
+        # fields must not match); a soft-deleted/destroyed version has
+        # data: null and must read as absent, not as the wrapper
+        if "data" in data and isinstance(meta, dict) \
+                and ("version" in meta or "created_time" in meta):
             return dict(inner) if isinstance(inner, dict) else None
         return dict(data)                       # KV v1 shape
 

@@ -228,3 +228,127 @@ class TestNativeRelay:
             assert not net.forwards
         finally:
             mgr.destroy("relaytest-1111-2222-3333-444455556666")
+
+    def test_udp_datagrams_relay_both_ways(self):
+        """Every mapping forwards UDP too (the CNI portmap programs
+        tcp AND udp rules per port)."""
+        from nomad_tpu.client.network_manager import _NativeRelay
+
+        usrv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        usrv.bind(("127.0.0.1", 0))
+        tport = usrv.getsockname()[1]
+        import threading
+
+        def echo():
+            while True:
+                try:
+                    d, a = usrv.recvfrom(65536)
+                except OSError:
+                    return
+                usrv.sendto(b"udp-ack:" + d, a)
+
+        threading.Thread(target=echo, daemon=True).start()
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        lport = probe.getsockname()[1]
+        probe.close()
+        relay = _NativeRelay.spawn(
+            "test-udp-alloc", [(lport, tport)], "127.0.0.1")
+        try:
+            c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            c.settimeout(5)
+            c.sendto(b"ping-1", ("127.0.0.1", lport))
+            data, _ = c.recvfrom(65536)
+            assert data == b"udp-ack:ping-1"
+            # replies keep routing to the RIGHT client per session
+            c2 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            c2.settimeout(5)
+            c2.sendto(b"ping-2", ("127.0.0.1", lport))
+            assert c2.recvfrom(65536)[0] == b"udp-ack:ping-2"
+            c.sendto(b"ping-3", ("127.0.0.1", lport))
+            assert c.recvfrom(65536)[0] == b"udp-ack:ping-3"
+            c.close()
+            c2.close()
+        finally:
+            _NativeRelay.kill_persisted("test-udp-alloc")
+            usrv.close()
+
+    def test_udp_fallback_forward(self):
+        """The in-process UDP relay (native binary unavailable)."""
+        from nomad_tpu.client.network_manager import _UdpForward
+
+        usrv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        usrv.bind(("127.0.0.1", 0))
+        tport = usrv.getsockname()[1]
+        import threading
+
+        def echo():
+            while True:
+                try:
+                    d, a = usrv.recvfrom(65536)
+                except OSError:
+                    return
+                usrv.sendto(b"fb:" + d, a)
+
+        threading.Thread(target=echo, daemon=True).start()
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        lport = probe.getsockname()[1]
+        probe.close()
+        fwd = _UdpForward(lport, "127.0.0.1", tport)
+        fwd.start()
+        try:
+            c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            c.settimeout(5)
+            c.sendto(b"hello", ("127.0.0.1", lport))
+            assert c.recvfrom(65536)[0] == b"fb:hello"
+            c.close()
+        finally:
+            fwd.stop()
+            usrv.close()
+
+    def test_watchdog_respawns_dead_relay(self):
+        """A killed relay is respawned within a heartbeat and the port
+        map carries traffic again (iptables rules cannot crash; a
+        relay process can)."""
+        import os
+        import signal
+
+        from nomad_tpu.client.network_manager import BridgeNetworkManager
+
+        srv, tport = self._echo_server()
+        mgr = BridgeNetworkManager()
+        mgr.WATCHDOG_INTERVAL = 0.3
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        hport = probe.getsockname()[1]
+        probe.close()
+        alloc_id = "watchdog-1111-2222-3333-444455556666"
+        net = mgr.create(alloc_id, [(hport, tport)])
+        try:
+            assert net.native_relay is not None
+            # the relay targets the alloc IP; rewire the recorded
+            # mappings at the echo server for a host-level roundtrip
+            net.ip = "127.0.0.1"
+            old_pid = net.native_relay.pid
+            os.kill(old_pid, signal.SIGKILL)
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    net.native_relay.pid == old_pid:
+                time.sleep(0.05)
+            assert net.native_relay.pid != old_pid, \
+                "watchdog never respawned the relay"
+            c = socket.create_connection(("127.0.0.1", hport), timeout=5)
+            c.sendall(b"after-respawn")
+            c.shutdown(socket.SHUT_WR)
+            got = b""
+            while True:
+                d = c.recv(65536)
+                if not d:
+                    break
+                got += d
+            assert got == b"after-respawn"
+        finally:
+            mgr.stop_watchdog()
+            mgr.destroy(alloc_id)
+            srv.close()

@@ -190,8 +190,11 @@ class TestHTTPVaultProvider:
             p.token_valid("hvs.something")
 
     def test_kv2_deleted_version_reads_as_absent(self, fake_vault):
+        # real KV v2 deleted-version shape: metadata keeps version and
+        # gains deletion_time; data is null
         fake_vault.secrets["secret/data/gone"] = {"data": {
-            "data": None, "metadata": {"deletion_time": "2026-01-01"}}}
+            "data": None, "metadata": {
+                "version": 2, "deletion_time": "2026-01-01"}}}
         p = self._provider(fake_vault)
         task = p.create_token([], 600)
         assert p.read_secret("secret/data/gone", token=task.token) is None
