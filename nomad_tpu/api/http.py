@@ -561,6 +561,7 @@ class HTTPAgent:
 
         # web UI (reference serves the Ember app at /ui; http.go:318)
         add("GET", r"/", self.ui_redirect)
+        add("GET", r"/ui/app\.js", self.ui_app_js)
         add("GET", r"/ui(?:/.*)?", self.ui_index)
 
         # jobs
@@ -1222,22 +1223,39 @@ class HTTPAgent:
         h.end_headers()
         return StreamedResponse
 
-    def ui_index(self, req: Request):
-        """Serve the single-file SPA; every /ui/* path gets the same
-        document (hash routing client-side)."""
+    def _serve_static(self, req: Request, cache_attr: str, relpath: str,
+                      content_type: str):
+        """Lazily-cached static asset from the ui/ directory."""
         cls = type(self)
-        if cls._UI_HTML is None:
+        body = getattr(cls, cache_attr, None)
+        if body is None:
             path = os.path.join(os.path.dirname(__file__), "..", "ui",
-                                "index.html")
+                                relpath)
             with open(path, "rb") as f:
-                cls._UI_HTML = f.read()
+                body = f.read()
+            setattr(cls, cache_attr, body)
         h = req.handler
         h.send_response(200)
-        h.send_header("Content-Type", "text/html; charset=utf-8")
-        h.send_header("Content-Length", str(len(cls._UI_HTML)))
+        h.send_header("Content-Type", content_type)
+        h.send_header("Content-Length", str(len(body)))
         h.end_headers()
-        h.wfile.write(cls._UI_HTML)
+        h.wfile.write(body)
         return StreamedResponse
+
+    def ui_index(self, req: Request):
+        """Serve the SPA shell; every /ui/* path gets the same document
+        (hash routing client-side)."""
+        return self._serve_static(req, "_UI_HTML", "index.html",
+                                  "text/html; charset=utf-8")
+
+    _UI_JS = None
+
+    def ui_app_js(self, req: Request):
+        """The SPA's application module (extracted from the document so
+        tests and tooling can read it standalone)."""
+        return self._serve_static(
+            req, "_UI_JS", "app.js",
+            "application/javascript; charset=utf-8")
 
     @staticmethod
     def _write_chunk(h, payload: bytes) -> None:
