@@ -42,12 +42,18 @@ from nomad_tpu.ops.kernel import (
 #: inert filler members per wave is far cheaper than another variant.
 _WAVE_BUCKETS = (1, 4, 16, 64, 256)
 
-#: When set (configure_wave_mesh), waves run the SAME joint program
-#: with the node axis sharded over this mesh's devices — per-step
-#: argmax/top-k become ICI collectives (SURVEY.md section 2.10). None
-#: = single-device dispatch. Results are identical either way.
+#: When set (configure_wave_mesh), DIRECT launch_wave calls run the
+#: joint program with the node axis sharded over this mesh's devices —
+#: per-step argmax/top-k become ICI collectives (SURVEY.md section
+#: 2.10). None = single-device dispatch. Results are identical either
+#: way. Live servers do NOT use this global: each threads its OWN
+#: ``Server.wave_mesh`` through its workers' coalescers, so
+#: co-resident servers (with different meshes, or one opted out)
+#: cannot affect each other.
 _WAVE_MESH = None
-_WAVE_MESH_REFS = 0
+#: sentinel: "caller did not choose" — fall back to the global; a
+#: coalescer always chooses (its server's mesh, possibly None=unsharded)
+_USE_GLOBAL = object()
 #: waves dispatched through the sharded path (asserted by tests)
 sharded_wave_launches = 0
 
@@ -62,34 +68,11 @@ _SHAREABLE_FIELDS = (
 
 
 def configure_wave_mesh(mesh) -> None:
-    """Route subsequent waves over ``mesh`` (None restores
-    single-device dispatch). Server.start() calls this when multiple
-    devices are visible (ServerConfig.use_device_mesh). Prefer
-    acquire/release_wave_mesh for lifecycle-scoped users (multiple
-    servers in one process share the global)."""
-    global _WAVE_MESH, _WAVE_MESH_REFS
+    """Route DIRECT launch_wave calls over ``mesh`` (None restores
+    single-device dispatch). Live servers ignore this: they pass their
+    own ``Server.wave_mesh`` through their coalescers."""
+    global _WAVE_MESH
     _WAVE_MESH = mesh
-    _WAVE_MESH_REFS = 0 if mesh is None else max(_WAVE_MESH_REFS, 1)
-
-
-def acquire_wave_mesh(mesh) -> None:
-    """Refcounted adoption: the mesh stays active until every owner
-    released it (two in-process servers must not disable each other's
-    sharded dispatch on shutdown)."""
-    global _WAVE_MESH, _WAVE_MESH_REFS
-    _WAVE_MESH = mesh
-    _WAVE_MESH_REFS += 1
-
-
-def release_wave_mesh() -> None:
-    global _WAVE_MESH, _WAVE_MESH_REFS
-    _WAVE_MESH_REFS = max(_WAVE_MESH_REFS - 1, 0)
-    if _WAVE_MESH_REFS == 0:
-        _WAVE_MESH = None
-
-
-def wave_mesh_active() -> bool:
-    return _WAVE_MESH is not None
 
 
 def pad_wave(b: int) -> int:
@@ -128,14 +111,23 @@ def _pad_kin_steps(kin: KernelIn, k_max: int) -> KernelIn:
 
 
 def launch_wave(kins: List[KernelIn], k_steps: List[int],
-                features: List[KernelFeatures]) -> List[KernelOut]:
+                features: List[KernelFeatures],
+                mesh=_USE_GLOBAL) -> List[KernelOut]:
     """Fire B launch requests as ONE joint device call; split results.
 
     The wave runs the joint kernel (ops/kernel.place_taskgroups_joint):
     members' placement steps execute in arrival order over a shared
     capacity carry, so members see each other's placements — the
     serialized plan applier's semantics, on device.
+
+    ``mesh``: shard the node axis over this mesh. A coalescer always
+    passes its server's choice explicitly — including None for "this
+    server opted out" — so co-resident servers never fight over the
+    module global; only DIRECT calls (no mesh argument) fall back to
+    ``configure_wave_mesh``'s global.
     """
+    if mesh is _USE_GLOBAL:
+        mesh = _WAVE_MESH
     k_max = max(k_steps)
     feats = union_features(features)
     padded = [_pad_kin_steps(kin, k_max) for kin in kins]
@@ -154,7 +146,7 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
     # in wave size instead of B-fold. Exactly TWO layouts exist —
     # all-shared or all-stacked — so each (bucket, features) pair costs
     # at most two XLA variants, not one per sharing pattern.
-    shareable = _WAVE_MESH is None and all(
+    shareable = mesh is None and all(
         all(getattr(k, f) is getattr(padded[0], f) for k in padded[1:])
         for f in _SHAREABLE_FIELDS
     )
@@ -185,12 +177,12 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
         step_local[pos:pos + k] = np.arange(k)
         pos += k
 
-    if _WAVE_MESH is not None:
+    if mesh is not None:
         from nomad_tpu.parallel.sharded import make_joint_sharded
 
         global sharded_wave_launches
         sharded_wave_launches += 1
-        out = make_joint_sharded(_WAVE_MESH)(
+        out = make_joint_sharded(mesh)(
             stacked, jnp.asarray(step_member), jnp.asarray(step_local),
             t_pad, feats,
         )
@@ -243,9 +235,11 @@ class LaunchCoalescer:
     itself — there is no dispatcher thread.
     """
 
-    def __init__(self, participants: int) -> None:
+    def __init__(self, participants: int, mesh=None) -> None:
         self._cv = threading.Condition()
         self._active = participants
+        # the owning server's device mesh (None = module default)
+        self.mesh = mesh
         self._pending: List[_Request] = []
         # stats (asserted by tests, reported by the worker)
         self.launches = 0
@@ -295,6 +289,7 @@ class LaunchCoalescer:
                     [r.kin for r in grp],
                     [r.k_steps for r in grp],
                     [r.features for r in grp],
+                    mesh=self.mesh,
                 )
                 for r, out in zip(grp, outs):
                     r.out = out

@@ -196,10 +196,10 @@ class Server:
         # loops from a previous term notice and exit
         self._leadership_lock = threading.Lock()
         self._leader_gen = 0
-        # True when THIS server configured the process-global wave
-        # mesh; shutdown then resets it so later servers (tests) start
-        # from their own config
-        self._wave_mesh_owner = False
+        # this server's device mesh for placement waves (None = no
+        # sharding); per-server, so co-resident servers with different
+        # meshes cannot clobber each other
+        self.wave_mesh = None
 
     # --- lifecycle ------------------------------------------------------
 
@@ -245,7 +245,6 @@ class Server:
         try:
             import jax
 
-            from nomad_tpu.parallel import coalesce
             from nomad_tpu.parallel.sharded import wave_mesh
 
             devs = jax.devices()
@@ -254,8 +253,10 @@ class Server:
                 return
             if len(devs) < 2:
                 return
-            coalesce.acquire_wave_mesh(wave_mesh(devices=devs))
-            self._wave_mesh_owner = True
+            # the mesh is THIS server's (threaded through its workers'
+            # coalescers): co-resident servers with different meshes
+            # never overwrite each other through a module global
+            self.wave_mesh = wave_mesh(devices=devs)
             LOG.info("placement waves sharded over %d %s devices",
                      len(devs), devs[0].platform)
         except Exception as e:                  # noqa: BLE001
@@ -263,11 +264,7 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
-        if self._wave_mesh_owner:
-            from nomad_tpu.parallel import coalesce
-
-            coalesce.release_wave_mesh()
-            self._wave_mesh_owner = False
+        self.wave_mesh = None
         self.vault.stop()
         for w in self.workers:
             w.stop()

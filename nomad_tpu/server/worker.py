@@ -267,7 +267,8 @@ class Worker:
         clusters = ClusterCache()
         for start in range(0, len(batch), self.MAX_WAVE):
             chunk = batch[start:start + self.MAX_WAVE]
-            coalescer = LaunchCoalescer(len(chunk))
+            coalescer = LaunchCoalescer(
+                len(chunk), mesh=getattr(self.server, "wave_mesh", None))
 
             def one(ev: Evaluation, token: str,
                     coalescer=coalescer) -> None:

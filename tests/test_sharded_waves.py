@@ -88,7 +88,7 @@ class TestServerOverMesh:
         ))
         server.start()
         try:
-            assert coalesce.wave_mesh_active()
+            assert server.wave_mesh is not None
             for _ in range(30):
                 server.node_register(mock.node())
             jobs = []
@@ -114,4 +114,3 @@ class TestServerOverMesh:
             assert float(u.used_cpu.sum()) >= 24 * 500
         finally:
             server.shutdown()
-            coalesce.configure_wave_mesh(None)
