@@ -75,6 +75,14 @@ class InProcessRPC:
     def consul_kv_index(self) -> int:
         return self.server.consul.kv_index()
 
+    def consul_kv_list(self, prefix: str):
+        return self.server.consul.kv_list(prefix)
+
+    def services_index(self) -> int:
+        """Service-registration table index (templates ranging over
+        ``service`` re-render when instances come and go)."""
+        return self.server.state.table_index(["services"])
+
     def vault_read_secret(self, path: str, token: str = ""):
         """Policy-checked against the task's derived token."""
         return self.server.vault.provider.read_secret(path, token=token)
@@ -131,13 +139,23 @@ class SecretsClient:
     def kv_get(self, key: str):
         return self.rpc.consul_kv_get(key)
 
+    def kv_ls(self, prefix: str):
+        return self.rpc.consul_kv_list(prefix)
+
+    def services(self, namespace: str, name: str):
+        """Live service instances for template ``service`` blocks."""
+        return self.rpc.services_by_name(namespace, name)
+
     def read_secret(self, path: str, token: str = ""):
         return self.rpc.vault_read_secret(path, token)
 
     def live_data_index(self) -> int:
         """Combined monotonic index over every live template source
-        (Consul KV + Vault secrets); watchers poll this."""
-        return self.rpc.consul_kv_index() + self.rpc.vault_secrets_index()
+        (Consul KV + Vault secrets + service registrations); watchers
+        poll this."""
+        return (self.rpc.consul_kv_index()
+                + self.rpc.vault_secrets_index()
+                + self.rpc.services_index())
 
     def vault_token_valid(self, token: str) -> bool:
         return self.rpc.vault_token_valid(token)

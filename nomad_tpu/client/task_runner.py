@@ -466,6 +466,9 @@ class TaskRunner:
             # self._vault_token at call time picks up re-derivations
             secret_get=(lambda p: self.secrets.read_secret(
                 p, self._vault_token)) if self.secrets else None,
+            kv_ls=self.secrets.kv_ls if self.secrets else None,
+            services_get=(lambda n: self.secrets.services(
+                self.alloc.namespace, n)) if self.secrets else None,
         )
         changed = []
         for tmpl, src in self._template_sources(task_dir):

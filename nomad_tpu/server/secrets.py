@@ -330,6 +330,11 @@ class ConsulProvider:
     def kv_get(self, key: str) -> Optional[str]:
         raise NotImplementedError
 
+    def kv_list(self, prefix: str) -> List[Tuple[str, str]]:
+        """Sorted (key, value) pairs under a prefix on a path boundary
+        (the ``ls``/``tree`` template data source)."""
+        raise NotImplementedError
+
     def kv_index(self) -> int:
         """Monotonic modify index over the KV store (blocking-query
         analog; template watchers poll this)."""
@@ -372,6 +377,18 @@ class DevConsulProvider(ConsulProvider):
     def kv_get(self, key: str) -> Optional[str]:
         with self._lock:
             return self._kv.get(key)
+
+    def kv_list(self, prefix: str) -> List[Tuple[str, str]]:
+        """Sorted (key, value) pairs UNDER a prefix on a path boundary
+        (consul-template's ls/tree data source: 'app' must not match
+        'apple')."""
+        prefix = prefix.rstrip("/")
+        with self._lock:
+            if not prefix:
+                return sorted(self._kv.items())
+            return sorted(
+                (k, v) for k, v in self._kv.items()
+                if k == prefix or k.startswith(prefix + "/"))
 
     def kv_index(self) -> int:
         with self._lock:
