@@ -66,16 +66,6 @@ function badge(status) {
   const cls = STATUS[String(status || "").toLowerCase()] || "neutral";
   return `<span class="badge ${cls}"><span class="dot"></span>${esc(status || "—")}</span>`;
 }
-function meterRow(label, used, total, unitFmt) {
-  const pct = total > 0 ? Math.min(100, 100 * used / total) : 0;
-  const f = unitFmt || ((x) => x);
-  return `<div class="meter-row"><span class="lab">${esc(label)}</span>
-    <div class="meter" style="flex:1" role="meter" aria-valuenow="${pct.toFixed(0)}"
-         aria-valuemin="0" aria-valuemax="100" aria-label="${esc(label)} utilization">
-      <div style="width:${pct.toFixed(1)}%"></div></div>
-    <span class="val">${f(used)} / ${f(total)}</span></div>`;
-}
-
 function render(html) { $view.innerHTML = html; }
 function renderError(e) {
   $view.innerHTML += `<div class="error-banner">request failed: ${esc(e.message || e)}</div>`;
@@ -466,21 +456,57 @@ async function viewTopology() {
     const agg = byNode[a.NodeID] || (byNode[a.NodeID] = { cpu: 0, mem: 0, n: 0 });
     agg.cpu += r.CPU || 0; agg.mem += r.MemoryMB || 0; agg.n += 1;
   }
+  /* topo-viz analog (ui/app/components/topo-viz): one cell per node,
+     grouped by datacenter, area ∝ memory capacity, fill height =
+     allocated memory share, fill hue = allocated cpu share; hover for
+     exact numbers, click through to the client. Scales to thousands
+     of nodes where per-node meter cards cannot. */
+  const byDC = {};
+  for (const n of nodes) (byDC[n.Datacenter] || (byDC[n.Datacenter] = [])).push(n);
+  const maxMem = Math.max(1, ...nodes.map(n => (n.NodeResources || {}).MemoryMB || 0));
+  const cell = (node) => {
+    const nr = node.NodeResources || {};
+    const used = byNode[node.ID] || { cpu: 0, mem: 0, n: 0 };
+    const memPct = nr.MemoryMB ? Math.min(100, 100 * used.mem / nr.MemoryMB) : 0;
+    const cpuPct = nr.CPU ? Math.min(100, 100 * used.cpu / nr.CPU) : 0;
+    /* green (idle) -> amber -> red (cpu-saturated) */
+    const hue = Math.round(120 - 1.2 * cpuPct);
+    const side = Math.round(22 + 26 * Math.sqrt((nr.MemoryMB || 0) / maxMem));
+    const down = node.Status !== "ready";
+    const title = `${node.Name} · ${node.Status}${node.Drain ? " draining" : ""}
+cpu ${used.cpu}/${nr.CPU || 0} MHz (${cpuPct.toFixed(0)}%)
+mem ${fmtMB(used.mem)}/${fmtMB(nr.MemoryMB || 0)} (${memPct.toFixed(0)}%)
+${used.n} alloc(s)`;
+    return `<div class="topo-cell${down ? " down" : ""}" title="${esc(title)}"
+      onclick="location.hash='#/clients/${jsArg(node.ID)}'"
+      style="width:${side}px;height:${side}px">
+      <div class="fill" style="height:${memPct.toFixed(0)}%;background:hsl(${hue},65%,45%)"></div>
+      ${node.Drain ? '<div class="drainmark">◢</div>' : ""}
+    </div>`;
+  };
   render(`
     <h1>Topology</h1>
-    <p class="sub">${nodes.length} node(s) · ${allocs.length} allocation(s); meters show scheduled (allocated) share of capacity</p>
-    <div class="cards">
-    ${nodes.map(node => {
-      const nr = node.NodeResources || {};
-      const used = byNode[node.ID] || { cpu: 0, mem: 0, n: 0 };
-      return `<div class="card" onclick="location.hash='#/clients/${jsArg(node.ID)}'">
-        <div class="name">${esc(node.Name)}</div>
-        <div class="muted" style="font-size:11.5px">${esc(node.Datacenter)} · ${used.n} alloc(s) ${node.Drain ? "· draining" : ""}</div>
-        ${meterRow("cpu", used.cpu, nr.CPU || 0, (x) => x)}
-        ${meterRow("mem", used.mem, nr.MemoryMB || 0, fmtMB)}
-      </div>`;
-    }).join("")}
-    </div>`);
+    <p class="sub">${nodes.length} node(s) · ${allocs.length} allocation(s) —
+      cell area ∝ memory capacity, fill = allocated memory, color = allocated cpu
+      (green idle → red saturated); hatched = down, ◢ = draining</p>
+    <style>
+      .topo-dc { margin: 14px 0; }
+      .topo-grid { display: flex; flex-wrap: wrap; gap: 4px; align-items: flex-end; }
+      .topo-cell { position: relative; border: 1px solid var(--border,#444);
+        border-radius: 3px; overflow: hidden; cursor: pointer;
+        background: var(--panel,#1a1a1a); }
+      .topo-cell .fill { position: absolute; bottom: 0; left: 0; right: 0; }
+      .topo-cell.down { background: repeating-linear-gradient(45deg,
+        transparent, transparent 3px, rgba(255,80,80,.45) 3px,
+        rgba(255,80,80,.45) 6px); }
+      .topo-cell .drainmark { position: absolute; top: 0; right: 2px;
+        font-size: 9px; color: #fff; text-shadow: 0 0 2px #000; }
+    </style>
+    ${Object.keys(byDC).sort().map(dc => `
+      <div class="topo-dc">
+        <h2>${esc(dc)} <span class="muted" style="font-size:12px">${byDC[dc].length} node(s)</span></h2>
+        <div class="topo-grid">${byDC[dc].map(cell).join("")}</div>
+      </div>`).join("")}`);
 }
 
 async function viewServers() {
