@@ -14,6 +14,16 @@ sleep_between=${SLEEP_BETWEEN:-180}
 echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
 attempt=0
 while true; do
+  # stand down while ANY bench.py runs (ours or the driver's): probe
+  # subprocesses import jax and would contaminate timed phases.
+  # Anchored pattern: harness processes carry "bench.py" in their
+  # PROMPT text and must not match
+  if pgrep -f '^(timeout [0-9]+ )?python[0-9.]* [^ ]*bench\.py' \
+      > /dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) bench running; probe deferred" >> "$LOG"
+    sleep "$sleep_between"
+    continue
+  fi
   attempt=$((attempt + 1))
   if timeout "$probe_timeout" python -c \
       "import jax, jax.numpy as jnp; assert jax.default_backend() != 'cpu'; print(float(jnp.zeros(1).sum()), jax.default_backend())" \
