@@ -240,3 +240,68 @@ class TestReviewEdges:
                        for s in secrets.services("default", "api"))
         finally:
             agent.shutdown()
+
+    def test_service_template_rerenders_on_registration(self):
+        """End to end: a template ranging over service() re-renders
+        (through the live watcher) when a new instance registers."""
+        import os
+        import sys
+        import time
+
+        from nomad_tpu import mock
+        from nomad_tpu.api.agent import Agent, AgentConfig
+        from nomad_tpu.structs.job import Template
+        from nomad_tpu.structs.services import ServiceRegistration
+
+        agent = Agent(AgentConfig.dev())
+        agent.start()
+        try:
+            agent.server.service_register([ServiceRegistration(
+                id="svc-tmpl-0", service_name="backend",
+                namespace="default", node_id="n1", alloc_id="a0",
+                address="10.0.0.1", port=8080)])
+            job = mock.simple_job(id="svc-tmpl-job")
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": sys.executable,
+                           "args": ["-S", "-c",
+                                    "import time; time.sleep(300)"]}
+            task.templates = [Template(
+                embedded_tmpl=('{{ range service "backend" }}'
+                               "up {{ .Address }}:{{ .Port }}\n"
+                               "{{ end }}"),
+                dest_path="local/upstreams.conf", change_mode="noop")]
+            agent.server.job_register(job)
+
+            def rendered():
+                snap = agent.server.state.snapshot()
+                allocs = snap.allocs_by_job(job.namespace, job.id)
+                if not allocs:
+                    return None
+                ar = agent.client.allocs.get(allocs[0].id)
+                if not ar:
+                    return None
+                p = os.path.join(ar.alloc_dir, task.name, "local",
+                                 "upstreams.conf")
+                return open(p).read() if os.path.exists(p) else None
+
+            deadline = time.time() + 60
+            while time.time() < deadline and rendered() is None:
+                time.sleep(0.2)
+            assert rendered() == "up 10.0.0.1:8080\n"
+
+            # a NEW instance registers: the watcher re-renders
+            agent.server.service_register([ServiceRegistration(
+                id="svc-tmpl-1", service_name="backend",
+                namespace="default", node_id="n2", alloc_id="a1",
+                address="10.0.0.2", port=8081)])
+            deadline = time.time() + 30
+            while time.time() < deadline and \
+                    (rendered() or "").count("up ") < 2:
+                time.sleep(0.2)
+            assert rendered() == ("up 10.0.0.1:8080\n"
+                                  "up 10.0.0.2:8081\n")
+        finally:
+            agent.shutdown()
