@@ -243,22 +243,48 @@ class Server:
         if use is False:
             return
         try:
+            # device enumeration can HANG FOREVER on a wedged
+            # remote-device transport (the shared tunnel does this for
+            # hours) and can take a minute of legitimate init on a
+            # cold TPU slice. A server must come up and serve
+            # regardless, so the probe runs on a daemon thread and the
+            # mesh is adopted WHENEVER it completes — workers read
+            # self.wave_mesh per batch, so late adoption just means
+            # the first waves run single-device. jax itself is
+            # imported HERE (fast, backends stay uninitialized) so a
+            # hung probe cannot strand the module import lock that
+            # workers' lazy imports need.
             import jax
 
-            from nomad_tpu.parallel.sharded import wave_mesh
+            def _probe() -> None:
+                try:
+                    devs = jax.devices()
+                    backend = jax.default_backend()
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("device mesh unavailable: %s", e)
+                    return
+                if len(devs) < 2 or (use is None and backend == "cpu"):
+                    return
+                try:
+                    from nomad_tpu.parallel.sharded import wave_mesh
 
-            devs = jax.devices()
-            if use is None and (len(devs) < 2
-                                or jax.default_backend() == "cpu"):
-                return
-            if len(devs) < 2:
-                return
-            # the mesh is THIS server's (threaded through its workers'
-            # coalescers): co-resident servers with different meshes
-            # never overwrite each other through a module global
-            self.wave_mesh = wave_mesh(devices=devs)
-            LOG.info("placement waves sharded over %d %s devices",
-                     len(devs), devs[0].platform)
+                    # the mesh is THIS server's (threaded through its
+                    # workers' coalescers): co-resident servers with
+                    # different meshes never overwrite each other
+                    # through a module global
+                    self.wave_mesh = wave_mesh(devices=devs)
+                    LOG.info("placement waves sharded over %d %s "
+                             "devices", len(devs), backend)
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("device mesh unavailable: %s", e)
+
+            t = threading.Thread(target=_probe, daemon=True,
+                                 name="device-mesh-probe")
+            t.start()
+            if use is True:
+                # explicit opt-in (tests on the virtual CPU mesh):
+                # deterministic availability is worth a bounded wait
+                t.join(120.0)
         except Exception as e:                  # noqa: BLE001
             LOG.warning("device mesh unavailable: %s", e)
 
