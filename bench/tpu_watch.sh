@@ -7,7 +7,7 @@
 set -u
 cd /root/repo
 LOG=bench/tpu_watch.log
-OUT=bench/TPU_CAPTURE_r04.json
+OUT=bench/TPU_CAPTURE_r05.json
 probe_timeout=${PROBE_TIMEOUT:-120}
 sleep_between=${SLEEP_BETWEEN:-180}
 
@@ -31,7 +31,11 @@ while true; do
     echo "$(date -u +%FT%TZ) probe $attempt OK - running bench" >> "$LOG"
     # device is answering: capture with a generous budget; bench's own
     # preflight re-probes and records the surviving backend honestly
-    if NOMAD_TPU_PREFLIGHT_BUDGET=900 timeout 5400 python bench.py \
+    # full-budget capture: the watcher's window is generous, so lift
+    # bench.py's self-imposed wall-clock ceiling to match (else the one
+    # TPU run would self-truncate at the 21-min harness default)
+    if NOMAD_TPU_PREFLIGHT_BUDGET=900 NOMAD_TPU_BENCH_BUDGET=5100 \
+        timeout 5400 python bench.py \
         > "$OUT.tmp" 2>> "$LOG"; then
       tail -1 "$OUT.tmp" > "$OUT"; rm -f "$OUT.tmp"
       echo "$(date -u +%FT%TZ) bench done: $(cat "$OUT")" >> "$LOG"
@@ -43,6 +47,31 @@ while true; do
       echo "$(date -u +%FT%TZ) capture fell back to cpu; keep watching" >> "$LOG"
     else
       echo "$(date -u +%FT%TZ) bench run failed/timed out" >> "$LOG"
+      # salvage: bench.py flushes a cumulative partial JSON line after
+      # every phase, so even a SIGTERM'd run leaves usable numbers
+      if [ -s "$OUT.tmp" ]; then
+        tail -1 "$OUT.tmp" > "$OUT.partial"
+        echo "$(date -u +%FT%TZ) salvaged partial: $(cat "$OUT.partial")" >> "$LOG"
+        # land + stop ONLY for a partial that carries both a non-cpu
+        # backend AND an actual measurement (value) — a numbers-free
+        # line (wedged during first compile) must keep the watcher alive
+        verdict=$(python - "$OUT.partial" <<'PY' 2>/dev/null
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    print("invalid"); raise SystemExit
+b, v = d.get("backend"), d.get("value")
+print("land" if b and b != "cpu" and v is not None else "keep-watching")
+PY
+)
+        if [ "$verdict" = "land" ]; then
+          mv "$OUT.partial" "$OUT"
+          echo "$(date -u +%FT%TZ) partial TPU capture landed" >> "$LOG"
+          exit 0
+        fi
+        echo "$(date -u +%FT%TZ) partial not landable ($verdict); keep watching" >> "$LOG"
+      fi
     fi
   else
     echo "$(date -u +%FT%TZ) probe $attempt no device" >> "$LOG"

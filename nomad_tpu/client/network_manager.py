@@ -397,18 +397,27 @@ class BridgeNetworkManager:
         """Supervise native relays: iptables DNAT rules (the reference
         analog) cannot crash, but a relay process can — port maps would
         silently go dead. A dead relay is respawned from the alloc's
-        recorded mappings within WATCHDOG_INTERVAL."""
+        recorded mappings within WATCHDOG_INTERVAL.
+
+        Each watchdog generation carries its OWN stop event: a stopped
+        thread keeps its (set) event and exits on its next check, while
+        the replacement starts with a fresh event — the stop flag can
+        never be cleared out from under a dying loop, so two live loops
+        cannot coexist past the ownership check in _watchdog_loop."""
         with self._lock:
-            if self._watchdog is not None and self._watchdog.is_alive():
+            prev = self._watchdog
+            if (prev is not None and prev.is_alive()
+                    and not self._watchdog_stop.is_set()):
                 return
-            self._watchdog_stop.clear()
+            self._watchdog_stop = stop = threading.Event()
             self._watchdog = threading.Thread(
-                target=self._watchdog_loop, daemon=True,
+                target=self._watchdog_loop, args=(stop,), daemon=True,
                 name="relay-watchdog")
             self._watchdog.start()
 
     def stop_watchdog(self) -> None:
-        self._watchdog_stop.set()
+        with self._lock:
+            self._watchdog_stop.set()
 
     @staticmethod
     def _relay_alive(pid: int) -> bool:
@@ -420,9 +429,15 @@ class BridgeNetworkManager:
         except OSError:
             return False
 
-    def _watchdog_loop(self) -> None:
-        while not self._watchdog_stop.wait(self.WATCHDOG_INTERVAL):
+    def _watchdog_loop(self, stop: threading.Event) -> None:
+        me = threading.current_thread()
+        while not stop.wait(self.WATCHDOG_INTERVAL):
             with self._lock:
+                # replaced generations stand down: only the CURRENT
+                # watchdog holds respawn duty, so a straggling old loop
+                # can never double-spawn a relay alongside the new one
+                if self._watchdog is not me:
+                    return
                 nets = [n for n in self._allocs.values()
                         if n.native_relay is not None]
             for net in nets:
@@ -443,13 +458,14 @@ class BridgeNetworkManager:
                                 net.alloc_id[:8], e)
                     continue
                 with self._lock:
-                    if self._allocs.get(net.alloc_id) is net:
+                    if (self._allocs.get(net.alloc_id) is net
+                            and self._watchdog is me):
                         net.native_relay = fresh
                         fresh = None
                 if fresh is not None:
-                    # destroy() completed while we were spawning: the
-                    # fresh relay belongs to a dead alloc — reap it or
-                    # it holds the host ports forever
+                    # destroy() completed (or this generation was
+                    # replaced) while we were spawning: the fresh relay
+                    # would leak and hold the host ports forever
                     fresh.stop()
 
     # -- bridge ----------------------------------------------------------
@@ -579,6 +595,12 @@ class BridgeNetworkManager:
     def destroy(self, alloc_id: str) -> None:
         with self._lock:
             net = self._allocs.pop(alloc_id, None)
+            # stop the watchdog with the last relay-bearing network:
+            # without this the daemon thread polls every 3s for the
+            # life of the process after all alloc networks are gone
+            if not any(n.native_relay is not None
+                       for n in self._allocs.values()):
+                self._watchdog_stop.set()
         if net is None:
             # an alloc from a previous agent process may still have a
             # live detached relay; the persisted pid file finds it

@@ -202,16 +202,20 @@ def _parse(tmpl: str):
             if head == "range":
                 rest = words[1:]
                 ivar = vvar = None
+                # a leading $var is a loop-variable declaration ONLY
+                # when followed by "," or ":=" — `{{ range $x }}` after
+                # `{{ $x := service "a" }}` (valid Go text/template)
+                # iterates the variable itself
                 if rest and rest[0].startswith("$"):
                     if len(rest) > 2 and rest[1] == "," \
                             and rest[2].startswith("$"):
                         ivar, vvar = rest[0][1:], rest[2][1:]
                         rest = rest[3:]
-                    else:
+                        if rest[:1] == [":="]:
+                            rest = rest[1:]
+                    elif rest[1:2] == [":="]:
                         vvar = rest[0][1:]
-                        rest = rest[1:]
-                    if rest[:1] == [":="]:
-                        rest = rest[1:]
+                        rest = rest[2:]
                 pipe = _parse_pipe(rest)
                 inner, i = parse_body(i + 1, ("end", "else"))
                 else_body = []

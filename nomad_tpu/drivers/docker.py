@@ -74,12 +74,22 @@ class ImageCoordinator:
     the deadline cancels the removal."""
 
     def __init__(self, remove_delay: float = 180.0,
-                 cleanup: bool = True) -> None:
+                 cleanup: bool = True, lock_for=None) -> None:
         self.remove_delay = remove_delay
         self.cleanup = cleanup
         self._lock = threading.Lock()
         self._refs: Dict[str, set] = {}
         self._timers: Dict[str, threading.Timer] = {}
+        # per-image serialization with the driver's pull/probe path:
+        # rmi takes the same lock _ensure_image pulls under, so a
+        # concurrent probe can never see the image mid-removal, skip
+        # the pull, and then fail its container start
+        self._own_locks: Dict[str, threading.Lock] = {}
+        self._lock_for = lock_for or self._default_lock_for
+
+    def _default_lock_for(self, image: str) -> threading.Lock:
+        with self._lock:
+            return self._own_locks.setdefault(image, threading.Lock())
 
     def use(self, image: str, task_id: str) -> None:
         with self._lock:
@@ -107,18 +117,21 @@ class ImageCoordinator:
         timer.start()
 
     def _remove(self, image: str) -> None:
-        with self._lock:
-            self._timers.pop(image, None)
-            # last-instant re-check: a use() racing the timer fire must
-            # win (the rmi below runs unlocked, so the residual window
-            # is the subprocess itself — microseconds vs the delay)
-            if self._refs.get(image):
-                return
-        try:
-            subprocess.run(["docker", "rmi", image],
-                           capture_output=True, timeout=120)
-        except Exception:               # noqa: BLE001
-            pass
+        # the pull lock serializes rmi against _ensure_image's
+        # probe+pull, closing the window where a probe sees the image
+        # present mid-rmi (the rmi subprocess can take up to 120s)
+        with self._lock_for(image):
+            with self._lock:
+                self._timers.pop(image, None)
+                # last-instant re-check: a use() racing the timer fire
+                # must win
+                if self._refs.get(image):
+                    return
+            try:
+                subprocess.run(["docker", "rmi", image],
+                               capture_output=True, timeout=120)
+            except Exception:               # noqa: BLE001
+                pass
 
     def shutdown(self) -> None:
         with self._lock:
@@ -157,6 +170,7 @@ class DockerDriver(RawExecDriver):
                                         "180")),
             cleanup=str(opts.get("docker.cleanup.image", "true")).lower()
             in ("1", "true", "yes"),
+            lock_for=self._pull_lock_for,
         )
 
     #: image -> lock: concurrent tasks of one image pull it ONCE
@@ -307,11 +321,14 @@ class DockerDriver(RawExecDriver):
 
     # -- image pull coordination (coordinator.go) ------------------------
 
+    @classmethod
+    def _pull_lock_for(cls, image: str) -> threading.Lock:
+        with cls._pull_locks_guard:
+            return cls._pull_locks.setdefault(image, threading.Lock())
+
     def _ensure_image(self, image: str, timeout: float = 600.0,
                       task_auth: Optional[Dict] = None) -> None:
-        with self._pull_locks_guard:
-            lock = self._pull_locks.setdefault(image, threading.Lock())
-        with lock:
+        with self._pull_lock_for(image):
             probe = subprocess.run(
                 ["docker", "image", "inspect", image],
                 capture_output=True, timeout=60,

@@ -96,6 +96,10 @@ class _EvalCommitBatch:
         self._error: Optional[Exception] = None
 
     def resolve(self, index: int, error: Optional[Exception]) -> None:
+        # idempotent: the abnormal-unwind cleanup may re-resolve a batch
+        # whose result was already delivered; first writer wins
+        if self._done.is_set():
+            return
         self._index, self._error = index, error
         self._done.set()
 
@@ -872,18 +876,45 @@ class Server:
                 leader = True
         if not leader:
             return my_batch.wait()
-        while True:
-            with self._eval_commit_lock:
-                batch = self._eval_commit_batch
-                self._eval_commit_batch = None
-                if batch is None:
+        # try/finally covers BaseException too (KeyboardInterrupt /
+        # SystemExit inside raft_apply): a committer dying abnormally
+        # must never leave busy=True with no drainer — that would wedge
+        # every later create/update_eval behind a batch nobody commits
+        completed = False
+        batch: Optional[_EvalCommitBatch] = None
+        try:
+            while True:
+                with self._eval_commit_lock:
+                    batch = self._eval_commit_batch
+                    self._eval_commit_batch = None
+                    if batch is None:
+                        # normal handoff: clear busy atomically with the
+                        # empty check so the next arriver becomes leader
+                        self._eval_commit_busy = False
+                        break
+                try:
+                    batch.resolve(self.raft_apply(
+                        fsm_msgs.EVAL_UPDATE, {"evals": batch.evals}), None)
+                except Exception as e:               # noqa: BLE001
+                    batch.resolve(0, e)
+            completed = True
+        finally:
+            if not completed:
+                # abnormal unwind (BaseException past the except above —
+                # KeyboardInterrupt/SystemExit inside raft_apply): busy
+                # is still True and no new leader can arise. Fail BOTH
+                # the popped in-flight batch (its waiters would
+                # otherwise hit the blind 30s TimeoutError) and any
+                # batch queued behind the dead committer, then reset.
+                err = RuntimeError("eval group-commit leader aborted")
+                if batch is not None:
+                    batch.resolve(0, err)
+                with self._eval_commit_lock:
                     self._eval_commit_busy = False
-                    break
-            try:
-                batch.resolve(self.raft_apply(
-                    fsm_msgs.EVAL_UPDATE, {"evals": batch.evals}), None)
-            except Exception as e:               # noqa: BLE001
-                batch.resolve(0, e)
+                    orphan = self._eval_commit_batch
+                    self._eval_commit_batch = None
+                if orphan is not None and orphan is not batch:
+                    orphan.resolve(0, err)
         return my_batch.wait()
 
     def reblock_eval(self, ev: Evaluation, token: str = "") -> int:
