@@ -50,6 +50,17 @@ class AgentConfig:
     retry_join: List[str] = field(default_factory=list)
     retry_join_interval: float = 5.0
     retry_join_max_attempts: int = 0
+    # Server gossip membership (nomad/serf.go over hashicorp/serf;
+    # here server/membership.py): liveness-probed `server members`,
+    # member events feeding raft peer add/remove on the leader, and
+    # join-by-DNS. server_join entries are "host:port" membership
+    # addresses (a DNS name expands to every A record).
+    serf_enabled: bool = True
+    serf_port: int = 0             # 0 = ephemeral
+    server_join: List[str] = field(default_factory=list)
+    #: probe cadence; tests shrink these for fast convergence
+    serf_probe_interval: float = 1.0
+    serf_suspect_timeout: float = 3.0
     # real Vault server (agent config vault stanza; empty = dev
     # in-memory provider)
     vault_addr: str = ""
@@ -174,6 +185,8 @@ class Agent:
                 self.server.establish_leadership()
             if self.config.retry_join:
                 self._start_retry_join()
+            if self.config.serf_enabled:
+                self._start_membership()
         if self.client is not None:
             # advertise this agent's HTTP address on the node so
             # servers can pass /v1/client/* requests through
@@ -234,7 +247,107 @@ class Agent:
         threading.Thread(target=run, daemon=True,
                          name="retry-join").start()
 
+    def _start_membership(self) -> None:
+        """Server gossip membership (serf.go:1). Events drive the raft
+        voter set on the leader — the reference's nomadJoin adds the
+        peer, nomadFailed/reap removes it (leader.go:1182-1345) — so a
+        dead server leaves the peer set without operator action and a
+        booted one joins without a config edit."""
+        from nomad_tpu.server.membership import (
+            MEMBER_ALIVE, MEMBER_FAILED, MEMBER_JOIN, MEMBER_LEAVE,
+            Membership, expand_join_addrs,
+        )
+
+        tags = {
+            "region": self.config.region,
+            "dc": self.config.datacenter,
+            "http_addr": self.http.addr if self.http else "",
+        }
+        raft = self.server.raft
+        if raft is not None:
+            tags["raft_addr"] = raft.id
+        self._serf = Membership(
+            name=self.config.name,
+            bind=self.config.bind_addr,
+            port=self.config.serf_port,
+            tags=tags,
+            region=self.config.region,
+            probe_interval=self.config.serf_probe_interval,
+            suspect_timeout=self.config.serf_suspect_timeout,
+        )
+
+        def reconcile(kind: str, member: dict) -> None:
+            raft = self.server.raft if self.server is not None else None
+            if raft is None or not raft.is_leader():
+                return
+            peer = (member.get("Tags") or {}).get("raft_addr", "")
+            if not peer or peer == raft.id:
+                return
+            try:
+                if kind in (MEMBER_JOIN, MEMBER_ALIVE):
+                    if peer not in raft.peers:
+                        raft.add_peer(peer)
+                        LOG.info("membership: added raft peer %s (%s)",
+                                 peer, member.get("Name"))
+                elif kind in (MEMBER_FAILED, MEMBER_LEAVE):
+                    if peer not in raft.peers:
+                        return
+                    # quorum guard (autopilot pruneDeadServers): never
+                    # remove below a functioning majority. Judged from
+                    # the MEMBERSHIP view — the failure detector that
+                    # just fired — not raft last-contact, whose 10s
+                    # horizon lags the 3-4s gossip verdict and would
+                    # wave through a quorum-breaking removal.
+                    dead_addrs = {
+                        (m.get("Tags") or {}).get("raft_addr", "")
+                        for m in self._serf.members()
+                        if m["Status"] in ("failed", "left")
+                    }
+                    n_total = len(raft.peers) + 1
+                    n_dead = sum(1 for p in raft.peers
+                                 if p in dead_addrs)
+                    if kind == MEMBER_FAILED \
+                            and n_total - n_dead <= n_total // 2:
+                        LOG.warning("membership: not removing %s: would "
+                                    "break quorum", peer)
+                        return
+                    raft.remove_peer(peer)
+                    LOG.info("membership: removed raft peer %s (%s, %s)",
+                             peer, member.get("Name"), kind)
+            except Exception as e:               # noqa: BLE001
+                LOG.warning("membership raft reconcile (%s %s): %s",
+                            kind, member.get("Name"), e)
+
+        def on_event(kind: str, member: dict) -> None:
+            # raft applies block up to 10s on an impaired quorum --
+            # exactly when failure events fire. Never stall the gossip
+            # rx/prober threads on them.
+            threading.Thread(target=reconcile, args=(kind, member),
+                             daemon=True,
+                             name="membership-reconcile").start()
+
+        self._serf.on_event(on_event)
+        self._serf.start()
+        if self.config.server_join:
+            targets = expand_join_addrs(self.config.server_join)
+            joined = self._serf.join(targets)
+            if not joined and targets:
+                # seeds not up yet: keep trying in the background the
+                # way serf's retry_join does
+                def retry() -> None:
+                    while not self.server._shutdown.is_set():
+                        if self._serf.join(expand_join_addrs(
+                                self.config.server_join)):
+                            return
+                        self.server._shutdown.wait(2.0)
+
+                threading.Thread(target=retry, daemon=True,
+                                 name="membership-join").start()
+
     def shutdown(self) -> None:
+        serf = getattr(self, "_serf", None)
+        if serf is not None:
+            serf.shutdown(leave=True)
         if self.client is not None:
             self.client.shutdown()
         if self.server is not None:
@@ -256,7 +369,19 @@ class Agent:
 
         serf = getattr(self, "_serf", None)
         if serf is not None:
-            return serf.members()
+            rows = serf.members()
+            raft = self.server.raft if self.server is not None else None
+            leader = raft.leader_addr() if raft is not None else None
+            for r in rows:
+                tags = r.get("Tags") or {}
+                if raft is not None:
+                    r["Leader"] = bool(leader) and \
+                        tags.get("raft_addr", "") == leader
+                else:
+                    r["Leader"] = (r["Name"] == self.config.name
+                                   and self.server is not None
+                                   and self.server.is_leader())
+            return rows
         tags = {"region": self.config.region,
                 "dc": self.config.datacenter,
                 "http_addr": self.http.addr if self.http else ""}

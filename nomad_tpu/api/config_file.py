@@ -92,6 +92,8 @@ def _apply_body(cfg, body: Body):
     ports = body.first_block("ports")
     if ports is not None and "http" in ports[1].attrs:
         cfg.http_port = int(ports[1].attrs["http"])
+    if ports is not None and "serf" in ports[1].attrs:
+        cfg.serf_port = int(ports[1].attrs["serf"])
 
     srv = body.first_block("server")
     if srv is not None:
@@ -106,6 +108,14 @@ def _apply_body(cfg, body: Body):
             cfg.raft_peers = [str(p) for p in sa["raft_peers"]]
         if "raft_advertise" in sa:
             cfg.raft_advertise = str(sa["raft_advertise"])
+        if "serf_enabled" in sa:
+            cfg.serf_enabled = bool(sa["serf_enabled"])
+        if "serf_port" in sa:
+            cfg.serf_port = int(sa["serf_port"])
+        # gossip membership seeds ("host:port"; DNS names expand to
+        # every A record — join-by-DNS)
+        if "server_join" in sa and isinstance(sa["server_join"], list):
+            cfg.server_join = [str(x) for x in sa["server_join"]]
         # server_join stanza (agent config server_join/retry_join):
         # retry_join entries are "region@http_url" for WAN federation
         sj = srv[1].first_block("server_join")

@@ -20,9 +20,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 from nomad_tpu.raft.log import LOG_COMMAND, LOG_NOOP, LogEntry, LogStore
 
-# reserved msg_type for replicated membership changes, handled by the
-# raft layer itself instead of the FSM (hashicorp/raft RemoveServer)
+# reserved msg_types for replicated membership changes, handled by the
+# raft layer itself instead of the FSM (hashicorp/raft
+# RemoveServer/AddVoter)
 RAFT_REMOVE_PEER = "__RaftRemovePeerConfigChange__"
+RAFT_ADD_PEER = "__RaftAddPeerConfigChange__"
 
 LOG = logging.getLogger(__name__)
 
@@ -336,7 +338,12 @@ class RaftNode:
     # --- replication (leader) -------------------------------------------
 
     def _wake_replicators(self) -> None:
-        for ev in self._peer_wakes.values():
+        # snapshot under the lock: membership changes (gossip-driven
+        # add/remove_peer) mutate the dict concurrently with the
+        # ticker's iteration
+        with self._lock:
+            wakes = list(self._peer_wakes.values())
+        for ev in wakes:
             ev.set()
 
     def _run_peer_replicator(self, peer: str) -> None:
@@ -484,6 +491,9 @@ class RaftNode:
                             # replicated membership change: applied on
                             # every replica at the same log position
                             self._apply_remove_peer(req["peer"])
+                            result = index
+                        elif msg_type == RAFT_ADD_PEER:
+                            self._apply_add_peer(req["peer"])
                             result = index
                         else:
                             result = self.fsm_apply(msg_type, req)
@@ -697,6 +707,43 @@ class RaftNode:
             }
 
     # --- membership + health (autopilot's raft surface) -----------------
+
+    def add_peer(self, peer: str) -> None:
+        """Replicated membership addition (raft AddVoter; the serf
+        member-join -> addRaftPeer flow, reference leader.go:1182):
+        commits a config-change entry so every replica starts
+        replicating to the new server at the same log position. The
+        new server itself boots with the full peer set in its static
+        config (agent server_join) and catches up via AppendEntries
+        or InstallSnapshot. Same restart caveat as remove_peer:
+        membership is re-derived from static config + gossip on
+        process restart (a compaction past this entry does not replay
+        it); the entry protects against failover amnesia within a
+        process lifetime, and the membership layer re-adds live peers
+        on its first gossip exchange after a restart."""
+        self.apply(RAFT_ADD_PEER, {"peer": peer})
+
+    def _apply_add_peer(self, peer: str) -> None:
+        if peer == self.id:
+            with self._lock:
+                self._removed = False   # re-added after a removal
+            return
+        with self._lock:
+            if peer in self.peers:
+                return
+            self.peers.append(peer)
+            self.next_index[peer] = self.log.last_index() + 1
+            self.match_index[peer] = 0
+            self._peer_wakes[peer] = threading.Event()
+            running = bool(self._threads) and not self._shutdown.is_set()
+        if running:
+            t = threading.Thread(
+                target=self._run_peer_replicator, args=(peer,),
+                daemon=True, name=f"raft-repl-{self.id}-{peer}",
+            )
+            self._threads.append(t)
+            t.start()
+        LOG.info("%s: added raft peer %s", self.id, peer)
 
     def remove_peer(self, peer: str) -> None:
         """Replicated membership change (raft RemoveServer; autopilot

@@ -1,0 +1,427 @@
+"""Server membership: SWIM-style liveness + gossip over UDP.
+
+Reference behavior: nomad/serf.go (membership event handling — a
+member-join adds the peer to raft, a member-failed/reap removes it,
+leader.go:1182-1345 nomadJoin/nomadFailed) on top of hashicorp/serf's
+SWIM gossip. This is a from-scratch redesign for the server tier:
+
+- Every server runs one small UDP endpoint. A prober pings one member
+  per interval; a missed ack marks the member *suspect*, and an
+  unrefuted suspicion becomes *failed* after a timeout — the SWIM
+  failure-detection ladder.
+- Dissemination is anti-entropy push-pull: every ping and ack carries
+  the sender's full member table, and receivers merge by
+  (incarnation, status) precedence. Server clusters are 3-11 processes
+  (the reference points serf's WAN mode at the same scale), so full
+  state per datagram is a deliberate simplification over serf's
+  randomized partial piggyback — O(members) bytes instead of O(1),
+  irrelevant at this fan-in, with strictly faster convergence.
+- Refutation: a member that hears itself called suspect/failed bumps
+  its incarnation and gossips alive again (SWIM's alive-message
+  override), so a one-off dropped ack heals instead of cascading.
+- A graceful ``leave()`` broadcasts intent so peers record *left*
+  (no failure event) — serf's Leave vs Failed distinction, which the
+  reference uses to decide whether autopilot should clean the peer.
+
+The agent wires events to the raft layer (serf.go:1): member-join with
+a ``raft_addr`` tag -> leader adds the voter; member-failed/left ->
+leader removes it (quorum-guarded), so a dead server disappears from
+the peer set without operator action.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+LOG = logging.getLogger(__name__)
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+FAILED = "failed"
+LEFT = "left"
+
+#: precedence of statuses at EQUAL incarnation: later entries override
+#: earlier ones. A higher incarnation always wins regardless of status.
+_STATUS_RANK = {ALIVE: 0, SUSPECT: 1, FAILED: 2, LEFT: 3}
+
+MEMBER_JOIN = "member-join"
+MEMBER_ALIVE = "member-alive"      # refuted / rejoined
+MEMBER_SUSPECT = "member-suspect"
+MEMBER_FAILED = "member-failed"
+MEMBER_LEAVE = "member-leave"
+MEMBER_UPDATE = "member-update"    # tags changed
+
+
+class Member:
+    __slots__ = ("name", "host", "port", "inc", "status", "tags",
+                 "status_at")
+
+    def __init__(self, name: str, host: str, port: int, inc: int = 0,
+                 status: str = ALIVE, tags: Optional[Dict] = None) -> None:
+        self.name = name
+        self.host = host
+        self.port = port
+        self.inc = inc
+        self.status = status
+        self.tags = dict(tags or {})
+        self.status_at = time.monotonic()
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def to_wire(self) -> List:
+        return [self.name, self.host, self.port, self.inc, self.status,
+                self.tags]
+
+    def to_api(self) -> Dict:
+        """The serf.Member shape the members endpoint serves."""
+        return {
+            "Name": self.name,
+            "Addr": f"{self.host}:{self.port}",
+            "Status": self.status,
+            "Tags": dict(self.tags),
+        }
+
+
+def expand_join_addrs(entries: List[str],
+                      default_port: int = 4648) -> List[Tuple[str, int]]:
+    """Resolve join entries to concrete (ip, port) targets.
+
+    A hostname expands to EVERY A/AAAA record — join-by-DNS, the
+    reference's ``retry_join`` cloud auto-join analog
+    (command/agent's go-netaddrs + provider=dns usage): pointing a
+    DNS name at the server set is enough to bootstrap membership.
+    """
+    out: List[Tuple[str, int]] = []
+    seen = set()
+    for entry in entries:
+        host, _, port_s = str(entry).rpartition(":")
+        if not host:
+            host, port_s = port_s, ""
+        try:
+            port = int(port_s) if port_s else default_port
+        except ValueError:
+            host, port = str(entry), default_port
+        try:
+            infos = socket.getaddrinfo(host, port, proto=socket.IPPROTO_UDP)
+        except OSError as e:
+            LOG.warning("membership join: cannot resolve %r: %s", entry, e)
+            continue
+        for info in infos:
+            addr = (info[4][0], info[4][1])
+            if addr not in seen:
+                seen.add(addr)
+                out.append(addr)
+    return out
+
+
+class Membership:
+    """One server's membership endpoint (serf agent analog)."""
+
+    def __init__(
+        self,
+        name: str,
+        bind: str = "127.0.0.1",
+        port: int = 0,
+        tags: Optional[Dict] = None,
+        region: str = "global",
+        probe_interval: float = 1.0,
+        probe_timeout: float = 0.5,
+        suspect_timeout: float = 3.0,
+        on_event: Optional[Callable[[str, Dict], None]] = None,
+    ) -> None:
+        self.name = name
+        self.region = region
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.suspect_timeout = suspect_timeout
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((bind, port))
+        self._sock.settimeout(0.2)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._lock = threading.Lock()
+        self._self = Member(name, self.host, self.port, inc=1, tags=tags)
+        #: name -> Member (never includes self)
+        self._members: Dict[str, Member] = {}
+        #: name -> when we started suspecting it (our own detector; a
+        #: gossiped suspicion also starts the clock)
+        self._suspect_since: Dict[str, float] = {}
+        self._acks: Dict[int, threading.Event] = {}
+        self._seq = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._handlers: List[Callable[[str, Dict], None]] = []
+        if on_event is not None:
+            self._handlers.append(on_event)
+        self._rr: List[str] = []   # round-robin probe order
+
+    # --- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        for name, target in (("membership-rx", self._run_rx),
+                             ("membership-probe", self._run_prober)):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"{name}-{self.name}")
+            self._threads.append(t)
+            t.start()
+
+    def shutdown(self, leave: bool = True) -> None:
+        if leave:
+            try:
+                self.leave()
+            except Exception:                    # noqa: BLE001
+                pass
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _abort(self) -> None:
+        """Test hook: die without a leave (a crashed server)."""
+        self.shutdown(leave=False)
+
+    # --- public surface -------------------------------------------------
+
+    def on_event(self, fn: Callable[[str, Dict], None]) -> None:
+        self._handlers.append(fn)
+
+    def join(self, addrs: List[Tuple[str, int]]) -> int:
+        """Push-pull with seed endpoints; returns contacted count."""
+        n = 0
+        for addr in addrs:
+            if addr == (self.host, self.port):
+                continue
+            if self._probe_addr(addr):
+                n += 1
+        return n
+
+    def set_tags(self, tags: Dict) -> None:
+        with self._lock:
+            self._self.tags.update(tags)
+            self._self.inc += 1   # re-gossips with the new tags
+
+    def leave(self) -> None:
+        with self._lock:
+            self._self.inc += 1
+            self._self.status = LEFT
+            targets = [m.addr for m in self._members.values()
+                       if m.status in (ALIVE, SUSPECT)]
+            msg = self._encode({"t": "leave"})
+        for addr in targets:
+            self._send(msg, addr)
+
+    def members(self, include_left: bool = True) -> List[Dict]:
+        with self._lock:
+            rows = [self._self.to_api()]
+            rows += [m.to_api() for m in self._members.values()
+                     if include_left or m.status not in (LEFT,)]
+        rows.sort(key=lambda r: r["Name"])
+        return rows
+
+    def member_status(self, name: str) -> Optional[str]:
+        with self._lock:
+            if name == self.name:
+                return self._self.status
+            m = self._members.get(name)
+            return m.status if m is not None else None
+
+    # --- wire helpers ---------------------------------------------------
+
+    def _encode(self, msg: Dict) -> bytes:
+        msg["from"] = self.name
+        msg["region"] = self.region
+        msg["mem"] = [self._self.to_wire()] + [
+            m.to_wire() for m in self._members.values()
+        ]
+        return json.dumps(msg, separators=(",", ":")).encode()
+
+    def _send(self, payload: bytes, addr: Tuple[str, int]) -> None:
+        try:
+            self._sock.sendto(payload, addr)
+        except OSError:
+            pass
+
+    # --- receive path ---------------------------------------------------
+
+    def _run_rx(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, addr = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg = json.loads(data.decode())
+            except ValueError:
+                continue
+            if msg.get("region") != self.region:
+                continue   # cross-region datagrams are not membership
+            kind = msg.get("t")
+            events = []
+            with self._lock:
+                for row in msg.get("mem", ()):
+                    events.extend(self._merge_locked(row))
+                if kind == "leave":
+                    events.extend(self._merge_locked(
+                        [msg.get("from"), addr[0], addr[1], 1 << 30, LEFT,
+                         {}], direct_leave=True))
+            self._emit(events)
+            if kind == "ping":
+                ack = self._encode({"t": "ack", "seq": msg.get("seq")})
+                self._send(ack, addr)
+            elif kind == "ack":
+                ev = self._acks.get(msg.get("seq"))
+                if ev is not None:
+                    ev.set()
+
+    def _merge_locked(self, row, direct_leave: bool = False) -> List:
+        """Merge one gossiped member record; returns events to emit."""
+        try:
+            name, host, port, inc, status, tags = row
+            port = int(port)
+            inc = int(inc)
+        except (ValueError, TypeError):
+            return []
+        if status not in _STATUS_RANK:
+            return []
+        if name == self.name:
+            # refutation: someone thinks we're suspect/failed/left --
+            # assert aliveness with a higher incarnation (SWIM alive)
+            if status != ALIVE and not direct_leave \
+                    and self._self.status == ALIVE \
+                    and inc >= self._self.inc:
+                self._self.inc = inc + 1
+            return []
+        cur = self._members.get(name)
+        if cur is None:
+            m = Member(name, host, port, inc, status, tags)
+            self._members[name] = m
+            self._rr.append(name)
+            if status == ALIVE:
+                return [(MEMBER_JOIN, m.to_api())]
+            if status == SUSPECT:
+                # a member first learned AS suspect still needs our
+                # suspicion ladder running, or it could stay suspect
+                # forever if the original suspecter dies
+                self._suspect_since.setdefault(name, time.monotonic())
+            return []
+        if direct_leave:
+            # a first-person leave always takes effect (serf: intent
+            # messages carry the member's own word)
+            inc = max(inc, cur.inc + 1)
+        accept = inc > cur.inc or (
+            inc == cur.inc
+            and _STATUS_RANK[status] > _STATUS_RANK[cur.status]
+        )
+        if not accept:
+            return []
+        prev = cur.status
+        cur.inc = inc
+        events = []
+        if tags and tags != cur.tags:
+            cur.tags = dict(tags)
+            events.append((MEMBER_UPDATE, cur.to_api()))
+        if status != prev:
+            cur.status = status
+            cur.status_at = time.monotonic()
+            if status == ALIVE:
+                self._suspect_since.pop(name, None)
+                events.append((MEMBER_ALIVE, cur.to_api()))
+            elif status == SUSPECT:
+                self._suspect_since.setdefault(name, time.monotonic())
+                events.append((MEMBER_SUSPECT, cur.to_api()))
+            elif status == FAILED:
+                self._suspect_since.pop(name, None)
+                events.append((MEMBER_FAILED, cur.to_api()))
+            elif status == LEFT:
+                self._suspect_since.pop(name, None)
+                events.append((MEMBER_LEAVE, cur.to_api()))
+        return events
+
+    def _emit(self, events) -> None:
+        for kind, member in events:
+            for fn in list(self._handlers):
+                try:
+                    fn(kind, member)
+                except Exception:                # noqa: BLE001
+                    LOG.exception("membership handler failed")
+
+    # --- probing --------------------------------------------------------
+
+    def _probe_addr(self, addr: Tuple[str, int]) -> bool:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            msg = self._encode({"t": "ping", "seq": seq})
+        ev = threading.Event()
+        self._acks[seq] = ev
+        try:
+            self._send(msg, addr)
+            return ev.wait(self.probe_timeout)
+        finally:
+            self._acks.pop(seq, None)
+
+    def _next_probe_target(self) -> Optional[Member]:
+        with self._lock:
+            live = [n for n in self._rr
+                    if n in self._members
+                    and self._members[n].status in (ALIVE, SUSPECT)]
+            if not live:
+                return None
+            # rotate; shuffle each full cycle like SWIM's randomized
+            # round-robin so two probers don't sync up
+            name = live[0]
+            self._rr.remove(name)
+            self._rr.append(name)
+            if name == live[-1] and len(live) > 2:
+                random.shuffle(self._rr)
+            return self._members[name]
+
+    def _run_prober(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            target = self._next_probe_target()
+            if target is not None:
+                ok = self._probe_addr(target.addr)
+                events = []
+                with self._lock:
+                    cur = self._members.get(target.name)
+                    if cur is not None and cur.status in (ALIVE, SUSPECT):
+                        if ok and cur.status == SUSPECT:
+                            # direct evidence beats gossip: alive again
+                            cur.inc += 1
+                            cur.status = ALIVE
+                            self._suspect_since.pop(cur.name, None)
+                            events.append((MEMBER_ALIVE, cur.to_api()))
+                        elif not ok and cur.status == ALIVE:
+                            cur.status = SUSPECT
+                            cur.status_at = time.monotonic()
+                            self._suspect_since[cur.name] = time.monotonic()
+                            events.append((MEMBER_SUSPECT, cur.to_api()))
+                self._emit(events)
+            # suspicion ladder: unrefuted suspects become failed
+            now = time.monotonic()
+            events = []
+            with self._lock:
+                for name, since in list(self._suspect_since.items()):
+                    m = self._members.get(name)
+                    if m is None or m.status != SUSPECT:
+                        self._suspect_since.pop(name, None)
+                        continue
+                    if now - since >= self.suspect_timeout:
+                        m.status = FAILED
+                        m.status_at = now
+                        self._suspect_since.pop(name, None)
+                        events.append((MEMBER_FAILED, m.to_api()))
+            self._emit(events)
