@@ -211,7 +211,10 @@ def time_batches(loop, shared, used_cpu, used_mem, asks_cpu, asks_mem,
     execution completes, which silently turns a throughput bench into
     a dispatch bench (this exact artifact inflated earlier captures).
 
-    Returns (best_dt_seconds, (score_sum, placed, invalid)).
+    Returns (best_dt_seconds, (score_sum, placed, fallback)) --
+    ``fallback`` = evals served by the in-loop full-width re-run
+    after a candidate-bound breach (no eval is dropped; see
+    parallel/batching.make_schedule_apply_loop).
     """
     import jax.numpy as jnp
 
@@ -223,9 +226,9 @@ def time_batches(loop, shared, used_cpu, used_mem, asks_cpu, asks_mem,
         float(warm[0])
         uc2, um2 = jnp.asarray(used_cpu), jnp.asarray(used_mem)
         t0 = time.perf_counter()
-        scores, placed, invalid, uc2, um2 = loop(
+        scores, placed, fallback, uc2, um2 = loop(
             shared, uc2, um2, asks_cpu, asks_mem, n_steps)
-        stats = (float(scores), int(placed), int(invalid))
+        stats = (float(scores), int(placed), int(fallback))
         dt = time.perf_counter() - t0
         if dt < best_dt:
             best_dt = dt
@@ -334,7 +337,7 @@ def run_tpu(budget_s: float = None) -> dict:
         candidates, shared, used_cpu, used_mem, asks_cpu, asks_mem,
         n_steps, budget_s, N_BATCHES)
 
-    best_dt, (score_sum, placed, invalid) = time_batches(
+    best_dt, (score_sum, placed, fallback) = time_batches(
         loop, shared, used_cpu, used_mem, asks_cpu[:n_b], asks_mem[:n_b],
         n_steps, reps=reps)
 
@@ -342,7 +345,8 @@ def run_tpu(budget_s: float = None) -> dict:
     return {
         "evals_per_sec": evals / best_dt,
         "mean_score": score_sum / max(placed, 1),
-        "invalid": invalid,
+        "invalid": 0,          # no eval is dropped: breaches fall back
+        "fallback": fallback,  # ...to the full-width kernel in-loop
         "backend": backend,
         "kernel": kernel_name,
     }
@@ -828,7 +832,7 @@ def run_replay(planes, budget_s: float = None) -> dict:
         candidates, shared, used_cpu, used_mem, asks_cpu, asks_mem,
         n_steps, budget_s, N_BATCHES)
 
-    best_dt, (score_sum, placed, invalid) = time_batches(
+    best_dt, (score_sum, placed, fallback) = time_batches(
         loop, shared, used_cpu, used_mem, asks_cpu[:n_b], asks_mem[:n_b],
         n_steps, reps=reps)
     evals = BATCH * n_b
@@ -838,7 +842,8 @@ def run_replay(planes, budget_s: float = None) -> dict:
         "baseline_evals_per_sec": baseline["evals_per_sec"],
         "baseline_mean_score": baseline["mean_score"],
         "mean_score": score_sum / max(placed, 1),
-        "invalid": invalid,
+        "invalid": 0,
+        "fallback": fallback,
         "backend": backend,
         "kernel": kernel_name,
         **stats,
@@ -1060,6 +1065,7 @@ def main() -> None:
                 replay_allocs=replay["replay_allocs"],
                 replay_jobs=replay["replay_jobs"],
                 replay_invalid=replay["invalid"],
+                replay_fallback=replay["fallback"],
             )
         # the remaining BASELINE.md timed configs: device + preemption
         cluster, snap, used_cpu, used_mem, used_disk, asks, _ = planes

