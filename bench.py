@@ -495,6 +495,10 @@ def run_e2e(budget_s: float = None) -> dict:
             waves0 = sum(w.batch_launches for w in server.workers)
             reqs0 = sum(w.batch_requests for w in server.workers)
             jobs = []
+            # poll cheap worker counters, NOT state.snapshot(): a
+            # whole-state copy every tick is O(allocs) of GIL the
+            # system under test doesn't owe the monitor
+            done0 = sum(w.processed for w in server.workers)
             t0 = time.perf_counter()
             for _ in range(E2E_JOBS):
                 job = mock.simple_job()
@@ -504,16 +508,36 @@ def run_e2e(budget_s: float = None) -> dict:
             want = E2E_JOBS * E2E_ALLOCS_PER_JOB
             deadline = time.time() + min(600.0, max(left(), 30.0))
             placed = 0
+            # background evals (core GC) also bump `processed`, so the
+            # counter is a trigger for the exact placement check, not
+            # the verdict; dt is stamped before the O(state) check
+            target = E2E_JOBS
+            dt = None
             while time.time() < deadline:
+                if sum(w.processed for w in server.workers) - done0 \
+                        >= target:
+                    t_done = time.perf_counter()
+                    snap = server.state.snapshot()
+                    placed = sum(
+                        len(snap.allocs_by_job(j.namespace, j.id))
+                        for j in jobs
+                    )
+                    if placed >= want:
+                        dt = t_done - t0
+                        break
+                    target += max(
+                        1, (want - placed) // E2E_ALLOCS_PER_JOB)
+                time.sleep(0.02)
+            if dt is None:
+                # deadline exit: the counter trigger can misfire (it is
+                # a hint, not the verdict) — take the authoritative
+                # placement count before reporting
+                dt = time.perf_counter() - t0
                 snap = server.state.snapshot()
                 placed = sum(
                     len(snap.allocs_by_job(j.namespace, j.id))
                     for j in jobs
                 )
-                if placed >= want:
-                    break
-                time.sleep(0.25)
-            dt = time.perf_counter() - t0
             lat = sorted(server.plan_latencies)
             p50 = lat[len(lat) // 2] if lat else 0.0
             p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] \

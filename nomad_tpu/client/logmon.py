@@ -88,8 +88,24 @@ class _Collector:
             except OSError:
                 pass
 
-    def run(self) -> None:
+    #: run-loop iterations between liveness checks (~10s at the 0.2s
+    #: select timeout)
+    _CHECK_EVERY = 50
+
+    def run(self, should_exit=None) -> None:
+        ticks = 0
         while not self._stop.is_set():
+            ticks += 1
+            if ticks % self._CHECK_EVERY == 0:
+                # the alloc's log dir being deleted means the alloc was
+                # garbage-collected (or a test's tmp tree was removed):
+                # nothing will ever reattach — exit instead of leaking
+                # a poller forever (this exact leak class degraded a
+                # whole round's benchmarks once)
+                if not os.path.isdir(os.path.dirname(self.base_path)):
+                    break
+                if should_exit is not None and should_exit():
+                    break
             r, _, _ = select.select([self._fd], [], [], 0.2)
             if not r:
                 continue
@@ -337,7 +353,15 @@ def _collector_main(argv: List[str]) -> int:
         f.write(str(os.getpid()))
     signal.signal(signal.SIGTERM, lambda *_: collector.request_stop())
     signal.signal(signal.SIGHUP, signal.SIG_IGN)   # agent exit is not ours
-    collector.run()
+    # Reattach semantics want the collector to OUTLIVE the agent; test
+    # harnesses want the opposite (a suite spawning hundreds of agents
+    # must not leak hundreds of pollers). With the env toggle set, the
+    # collector also exits once its spawning agent is gone.
+    should_exit = None
+    if os.environ.get("NOMAD_TPU_LOGMON_ORPHAN_EXIT") == "1":
+        parent = os.getppid()
+        should_exit = (lambda: parent <= 1 or not _pid_alive(parent))
+    collector.run(should_exit)
     try:
         os.unlink(pid_path)
     except OSError:

@@ -88,6 +88,89 @@ def pad_steps(k: int) -> int:
     return ((k + 4095) // 4096) * 4096
 
 
+class NeutralPlanes(NamedTuple):
+    """Read-only neutral planes shared BY IDENTITY across evaluations.
+
+    The per-eval tensor build allocates a dozen O(nodes) planes that
+    stay all-neutral for the common ask (no devices, no affinities, no
+    in-plan ports, fresh job): allocating them per eval was the
+    dominant host cost of the live path, and distinct-but-equal arrays
+    also defeat the wave coalescer's identity-based sharing (every
+    member would ship its own copy of the same zeros). One frozen
+    singleton per padded node size serves every eval; writers must
+    copy-on-write (the arrays are non-writeable, so a missed copy
+    raises instead of corrupting a neighbor eval).
+    """
+
+    zeros_f32: np.ndarray       # [N]
+    zeros_i32: np.ndarray       # [N]
+    zeros_bool: np.ndarray      # [N]
+    zeros_dev: np.ndarray       # [N, MAX_DEV_REQS] f32
+    neg1_spread_bucket: np.ndarray   # [S, N] i32
+    zeros_spread_counts: np.ndarray  # [S, SPREAD_BUCKETS] f32
+    neg1_spread_desired: np.ndarray  # [S, SPREAD_BUCKETS] f32
+    zeros_spread_flags: np.ndarray   # [S] bool
+    zeros_spread_weight: np.ndarray  # [S] f32
+    arange_i32: np.ndarray      # [N] identity node_perm
+
+
+def _frozen(a: np.ndarray) -> np.ndarray:
+    a.flags.writeable = False
+    return a
+
+
+_NEUTRAL_CACHE: dict = {}
+
+
+def neutral_planes(n: int) -> NeutralPlanes:
+    got = _NEUTRAL_CACHE.get(n)
+    if got is None:
+        got = NeutralPlanes(
+            zeros_f32=_frozen(np.zeros(n, np.float32)),
+            zeros_i32=_frozen(np.zeros(n, np.int32)),
+            zeros_bool=_frozen(np.zeros(n, bool)),
+            zeros_dev=_frozen(np.zeros((n, MAX_DEV_REQS), np.float32)),
+            neg1_spread_bucket=_frozen(
+                np.full((MAX_SPREADS, n), -1, np.int32)),
+            zeros_spread_counts=_frozen(
+                np.zeros((MAX_SPREADS, SPREAD_BUCKETS), np.float32)),
+            neg1_spread_desired=_frozen(
+                np.full((MAX_SPREADS, SPREAD_BUCKETS), -1.0, np.float32)),
+            zeros_spread_flags=_frozen(np.zeros(MAX_SPREADS, bool)),
+            zeros_spread_weight=_frozen(np.zeros(MAX_SPREADS, np.float32)),
+            arange_i32=_frozen(np.arange(n, dtype=np.int32)),
+        )
+        _NEUTRAL_CACHE[n] = got
+    return got
+
+
+_NEUTRAL_WORDS_CACHE: dict = {}
+
+
+def neutral_port_words(n: int, w: int) -> np.ndarray:
+    """Frozen all-zero [N, W] u32 port-conflict words."""
+    got = _NEUTRAL_WORDS_CACHE.get((n, w))
+    if got is None:
+        got = _frozen(np.zeros((n, w), np.uint32))
+        _NEUTRAL_WORDS_CACHE[(n, w)] = got
+    return got
+
+
+_NEUTRAL_STEP_CACHE: dict = {}
+
+
+def neutral_step_planes(k_pad: int):
+    """(step_penalty[k,P]=-1, step_preferred[k]=-1) singletons."""
+    got = _NEUTRAL_STEP_CACHE.get(k_pad)
+    if got is None:
+        got = (
+            _frozen(np.full((k_pad, MAX_PENALTY_NODES), -1, np.int32)),
+            _frozen(np.full(k_pad, -1, np.int32)),
+        )
+        _NEUTRAL_STEP_CACHE[k_pad] = got
+    return got
+
+
 class KernelFeatures(NamedTuple):
     """Static specialization flags (hashable; a jit static argument).
 
@@ -1106,19 +1189,28 @@ def build_kernel_in(
             f"task group has {len(ev.spreads)} spread stanzas; kernel "
             f"supports {S}"
         )
-    sp_active = np.zeros(S, bool)
-    sp_even = np.zeros(S, bool)
-    sp_weight = np.zeros(S, np.float32)
-    sp_bucket = np.full((S, N), -1, np.int32)
-    sp_counts = np.zeros((S, SPREAD_BUCKETS), np.float32)
-    sp_desired = np.full((S, SPREAD_BUCKETS), -1.0, np.float32)
-    for s, sp in enumerate(ev.spreads[:S]):
-        sp_active[s] = True
-        sp_even[s] = sp.even
-        sp_weight[s] = sp.weight_frac
-        sp_bucket[s] = sp.bucket_id
-        sp_counts[s] = sp.counts
-        sp_desired[s] = sp.desired
+    neutral = neutral_planes(N)
+    if ev.spreads:
+        sp_active = np.zeros(S, bool)
+        sp_even = np.zeros(S, bool)
+        sp_weight = np.zeros(S, np.float32)
+        sp_bucket = np.full((S, N), -1, np.int32)
+        sp_counts = np.zeros((S, SPREAD_BUCKETS), np.float32)
+        sp_desired = np.full((S, SPREAD_BUCKETS), -1.0, np.float32)
+        for s, sp in enumerate(ev.spreads[:S]):
+            sp_active[s] = True
+            sp_even[s] = sp.even
+            sp_weight[s] = sp.weight_frac
+            sp_bucket[s] = sp.bucket_id
+            sp_counts[s] = sp.counts
+            sp_desired[s] = sp.desired
+    else:
+        # frozen singletons: identity-shared across wave members
+        sp_active = sp_even = neutral.zeros_spread_flags
+        sp_weight = neutral.zeros_spread_weight
+        sp_bucket = neutral.neg1_spread_bucket
+        sp_counts = neutral.zeros_spread_counts
+        sp_desired = neutral.neg1_spread_desired
 
     # reserved-port conflict: ask bits already set in node planes or the
     # in-plan conflict words
@@ -1127,16 +1219,18 @@ def build_kernel_in(
         conflict = np.any(words & ev.ask.port_mask[None, :], axis=1)
         has_res = True
     else:
-        conflict = np.zeros(N, bool)
+        conflict = neutral.zeros_bool
         has_res = False
 
     k_pad = pad_steps(n_steps)
-    if step_penalty is None:
-        step_penalty = np.full((k_pad, MAX_PENALTY_NODES), -1, np.int32)
-    if step_preferred is None:
-        step_preferred = np.full(k_pad, -1, np.int32)
+    if step_penalty is None or step_preferred is None:
+        np_pen, np_pref = neutral_step_planes(k_pad)
+        if step_penalty is None:
+            step_penalty = np_pen
+        if step_preferred is None:
+            step_preferred = np_pref
     if node_perm is None:
-        node_perm = np.arange(N, dtype=np.int32)
+        node_perm = neutral.arange_i32
 
     # leaves stay NUMPY: jit uploads each argument once at call time.
     # Building device arrays here would mean one host->device transfer

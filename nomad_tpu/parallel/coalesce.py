@@ -40,7 +40,10 @@ from nomad_tpu.ops.kernel import (
 #: (wave bucket, step bucket, features) combination is a separate XLA
 #: compile, and a cold TPU compile is tens of seconds — paying a few
 #: inert filler members per wave is far cheaper than another variant.
-_WAVE_BUCKETS = (1, 4, 16, 64, 256)
+#: 32 earns its slot: it is the default worker batch size, and the
+#: joint kernel's step scan is O(wave x steps) — padding 32 to 64
+#: doubled the live path's per-wave device time for nothing.
+_WAVE_BUCKETS = (1, 4, 16, 32, 64, 256)
 
 #: When set (configure_wave_mesh), DIRECT launch_wave calls run the
 #: joint program with the node axis sharded over this mesh's devices —
@@ -64,6 +67,22 @@ _SHAREABLE_FIELDS = (
     "cap_cpu", "cap_mem", "cap_disk", "free_cores", "shares_per_core",
     "avail_mbits", "free_dyn",
     "used_cpu", "used_mem", "used_disk", "used_cores", "used_mbits",
+)
+
+#: second sharing group: the per-eval planes that stay NEUTRAL for the
+#: common ask (no devices/affinities/spreads/penalties, fresh job) are
+#: frozen singletons (ops/kernel.neutral_planes), so members share
+#: them by identity too. Each group is all-or-nothing, so a
+#: (bucket, step, features) triple compiles at most FOUR layout
+#: variants (2 groups x shared/stacked), keeping the variant count
+#: bounded while the common wave ships O(nodes) bytes instead of
+#: O(members x nodes).
+_NEUTRAL_SHAREABLE_FIELDS = (
+    "port_conflict", "dev_free", "dev_aff_score",
+    "job_tg_count", "job_any_count", "penalty", "aff_score",
+    "node_perm", "step_penalty", "step_preferred",
+    "spread_active", "spread_even", "spread_weight",
+    "spread_bucket", "spread_counts", "spread_desired",
 )
 
 
@@ -100,9 +119,17 @@ def union_features(features: List[KernelFeatures]) -> KernelFeatures:
 
 def _pad_kin_steps(kin: KernelIn, k_max: int) -> KernelIn:
     """Pad the per-step planes to the wave's step count (neutral rows)."""
+    from nomad_tpu.ops.kernel import neutral_step_planes
+
     k = int(kin.step_penalty.shape[0])
     if k == k_max:
         return kin
+    n_pen, n_pref = neutral_step_planes(k)
+    if kin.step_penalty is n_pen and kin.step_preferred is n_pref:
+        # neutral singletons pad to the neutral singleton of the wave's
+        # step count — identity (and so wave sharing) survives padding
+        pen, pref = neutral_step_planes(k_max)
+        return kin._replace(step_penalty=pen, step_preferred=pref)
     pen = np.full((k_max, kin.step_penalty.shape[1]), -1, np.int32)
     pen[:k] = np.asarray(kin.step_penalty)
     pref = np.full(k_max, -1, np.int32)
@@ -146,13 +173,18 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
     # in wave size instead of B-fold. Exactly TWO layouts exist —
     # all-shared or all-stacked — so each (bucket, features) pair costs
     # at most two XLA variants, not one per sharing pattern.
-    shareable = mesh is None and all(
-        all(getattr(k, f) is getattr(padded[0], f) for k in padded[1:])
-        for f in _SHAREABLE_FIELDS
-    )
+    def _group_shared(fields) -> bool:
+        return mesh is None and all(
+            all(getattr(k, f) is getattr(padded[0], f) for k in padded[1:])
+            for f in fields
+        )
+
+    shareable = _group_shared(_SHAREABLE_FIELDS)
+    neutral_shareable = _group_shared(_NEUTRAL_SHAREABLE_FIELDS)
 
     def _stack_field(f, xs):
-        if shareable and f in _SHAREABLE_FIELDS:
+        if (shareable and f in _SHAREABLE_FIELDS) or (
+                neutral_shareable and f in _NEUTRAL_SHAREABLE_FIELDS):
             return np.asarray(xs[0])
         return np.stack([np.asarray(x) for x in xs])
 

@@ -27,6 +27,9 @@ from nomad_tpu.ops.kernel import (
     KernelOut,
     build_kernel_in,
     infer_features,
+    neutral_planes,
+    neutral_port_words,
+    neutral_step_planes,
     pad_steps,
     place_taskgroup_jit,
 )
@@ -52,6 +55,15 @@ from nomad_tpu.tensors.schema import (
     EvalTensors,
     SpreadTensor,
 )
+
+
+import threading as _threading
+
+#: process-wide hot-path observability (read by /v1/metrics and the
+#: bench): how often exact host-side assignment disagreed with the
+#: kernel and forced a masked re-run
+_STATS_LOCK = _threading.Lock()
+STATS = {"assign_retry_launches": 0}
 
 
 @dataclass
@@ -131,15 +143,25 @@ class XLAGenericStack:
             ev = self._build_eval_tensors(tg, exclude)
             for row in accepted_rows:
                 self._apply_accepted(ev, row)
-            step_penalty = np.full((k_pad, MAX_PENALTY_NODES), -1, np.int32)
-            step_preferred = np.full(k_pad, -1, np.int32)
-            for slot, ri in enumerate(pending):
-                req = requests[ri]
-                for j, nid in enumerate(req.penalty_nodes[:MAX_PENALTY_NODES]):
-                    row = c.index.get(nid, -1)
-                    step_penalty[slot, j] = row
-                if req.preferred_node:
-                    step_preferred[slot] = c.index.get(req.preferred_node, -1)
+            if any(requests[ri].penalty_nodes or requests[ri].preferred_node
+                   for ri in pending):
+                step_penalty = np.full(
+                    (k_pad, MAX_PENALTY_NODES), -1, np.int32)
+                step_preferred = np.full(k_pad, -1, np.int32)
+                for slot, ri in enumerate(pending):
+                    req = requests[ri]
+                    for j, nid in enumerate(
+                            req.penalty_nodes[:MAX_PENALTY_NODES]):
+                        row = c.index.get(nid, -1)
+                        step_penalty[slot, j] = row
+                    if req.preferred_node:
+                        step_preferred[slot] = c.index.get(
+                            req.preferred_node, -1)
+            else:
+                # the common ask has no penalties/preferences: ship the
+                # frozen singletons so wave members share them by
+                # identity (one upload per wave, not per member)
+                step_penalty, step_preferred = neutral_step_planes(k_pad)
 
             kin = build_kernel_in(c, ev, len(pending), step_penalty,
                                   step_preferred, node_perm=node_perm)
@@ -152,14 +174,21 @@ class XLAGenericStack:
             out = self.ctx.kernel_launch(kin, k_pad, features)
             out = KernelOut(*[np.asarray(x) for x in out])
             self._merge_kernel_metrics(out)
+            if _attempt > 0:
+                with _STATS_LOCK:
+                    STATS["assign_retry_launches"] += 1
 
             # exact host-side assignment per chosen node
+            proto = self._metrics_proto(out)
+            found_l = out.found.tolist()
+            chosen_l = out.chosen.tolist()
+            scores_l = out.scores.tolist()
             retry: List[int] = []
             for slot, ri in enumerate(pending):
-                if not out.found[slot]:
+                if not found_l[slot]:
                     results[ri] = None
                     continue
-                row = int(out.chosen[slot])
+                row = chosen_l[slot]
                 node = snapshot.node_by_id(c.node_ids[row])
                 if node is None:
                     exclude[row] = True
@@ -169,13 +198,13 @@ class XLAGenericStack:
                 if asg is None:
                     asg = _NodeAssigner(node, self.ctx)
                     assigners[row] = asg
-                option = asg.assign(tg, float(out.scores[slot]))
+                option = asg.assign(tg, scores_l[slot])
                 if option is None:
                     # exact assignment failed: mask node, re-run this slot
                     exclude[row] = True
                     retry.append(ri)
                     continue
-                option.metrics = self._metrics_for(out, slot)
+                option.metrics = self._metrics_for(proto, slot)
                 results[ri] = option
                 accepted_rows.append(row)
             if not retry:
@@ -194,6 +223,13 @@ class XLAGenericStack:
             ev.used_disk = ev.used_disk.copy()
             ev.used_cores = ev.used_cores.copy()
             ev.used_mbits = ev.used_mbits.copy()
+        # same COW for the neutral singletons the build shares by
+        # identity (frozen: a missed copy raises, never corrupts)
+        for f in ("free_dyn_delta", "job_tg_count", "job_any_count",
+                  "dev_free", "port_conflict_words"):
+            plane = getattr(ev, f)
+            if not plane.flags.writeable:
+                setattr(ev, f, plane.copy())
         ask = ev.ask
         ev.used_cpu[row] += ask.cpu
         ev.used_mem[row] += ask.mem
@@ -374,10 +410,14 @@ class XLAGenericStack:
         base = self._feas.base_mask(job, tg, job_allocs_by_node)
         base &= ~exclude
 
-        job_tg_count = np.zeros(n, np.int32)
-        job_any_count = np.zeros(n, np.int32)
-        conflict_words = np.zeros((n, c.port_words.shape[1]), np.uint32)
-        free_dyn_delta = np.zeros(n, np.int32)
+        # neutral O(n) planes are frozen singletons shared BY IDENTITY
+        # across evals (and so shipped once per coalesced wave); any
+        # path that actually writes one allocates its own copy
+        neutral = neutral_planes(n)
+        job_tg_count = neutral.zeros_i32
+        job_any_count = neutral.zeros_i32
+        conflict_words = neutral_port_words(n, c.port_words.shape[1])
+        free_dyn_delta = neutral.zeros_i32
 
         ask = AskTensor.build(tg)
 
@@ -390,21 +430,28 @@ class XLAGenericStack:
             # wave ships one copy to the device instead of one each
             used_cpu, used_mem, used_disk, used_cores, used_mbits = \
                 c.gathered_usage(u)
-            for a in job_allocs:
-                if a.terminal_status():
-                    continue
-                row = c.index.get(a.node_id)
-                if row is None:
-                    continue
-                job_any_count[row] += 1
-                if a.task_group == tg.name:
-                    job_tg_count[row] += 1
+            live_job_allocs = [a for a in job_allocs
+                               if not a.terminal_status()]
+            if live_job_allocs:
+                job_tg_count = np.zeros(n, np.int32)
+                job_any_count = np.zeros(n, np.int32)
+                for a in live_job_allocs:
+                    row = c.index.get(a.node_id)
+                    if row is None:
+                        continue
+                    job_any_count[row] += 1
+                    if a.task_group == tg.name:
+                        job_tg_count[row] += 1
         else:
             used_cpu = np.zeros(n, np.float32)
             used_mem = np.zeros(n, np.float32)
             used_disk = np.zeros(n, np.float32)
             used_mbits = np.zeros(n, np.int32)
             used_cores = np.zeros(n, np.int32)
+            job_tg_count = np.zeros(n, np.int32)
+            job_any_count = np.zeros(n, np.int32)
+            conflict_words = np.zeros((n, c.port_words.shape[1]), np.uint32)
+            free_dyn_delta = np.zeros(n, np.int32)
             # proposed utilization per node (context.go ProposedAllocs
             # over every node)
             self._accumulate_usage(
@@ -412,16 +459,18 @@ class XLAGenericStack:
                 job_tg_count, job_any_count, conflict_words,
                 free_dyn_delta, tg, ask,
             )
-        avail_mbits = np.zeros(n, np.int32)
         # node-static plane, shared from the cluster build (read-only)
-        avail_mbits = c.avail_mbits if c.avail_mbits is not None else avail_mbits
+        avail_mbits = (c.avail_mbits if c.avail_mbits is not None
+                       else neutral.zeros_i32)
 
         # device planes
-        dev_free = np.zeros((n, MAX_DEV_REQS), np.float32)
-        dev_aff = np.zeros(n, np.float32)
+        dev_free = neutral.zeros_dev
+        dev_aff = neutral.zeros_f32
         has_dev_aff = False
         dev_reqs = [d for task in tg.tasks for d in task.resources.devices]
         if dev_reqs:
+            dev_free = np.zeros((n, MAX_DEV_REQS), np.float32)
+            dev_aff = np.zeros(n, np.float32)
             for i in range(c.n_real):
                 if not base[i]:
                     continue
@@ -439,8 +488,9 @@ class XLAGenericStack:
         affinities = list(job.affinities) + list(tg.affinities)
         for task in tg.tasks:
             affinities.extend(task.affinities)
-        aff_score = np.zeros(n, np.float32)
+        aff_score = neutral.zeros_f32
         if affinities:
+            aff_score = np.zeros(n, np.float32)
             sum_weight = sum(abs(float(a.weight)) for a in affinities)
             cache: Dict[str, float] = {}
             for i in range(c.n_real):
@@ -485,7 +535,7 @@ class XLAGenericStack:
                 con.operand == consts.CONSTRAINT_DISTINCT_HOSTS
                 for con in tg.constraints
             ),
-            penalty=np.zeros(n, bool),
+            penalty=neutral.zeros_bool,
             aff_score=aff_score,
             has_affinities=bool(affinities),
             spreads=spreads,
@@ -694,12 +744,15 @@ class XLAGenericStack:
             if int(cnt) > 0:
                 m.dimension_exhausted[dim] = int(cnt)
 
-    def _metrics_for(self, out: KernelOut, slot: int) -> AllocMetric:
-        m = AllocMetric()
-        m.nodes_evaluated = int(out.nodes_evaluated)
-        m.nodes_filtered = self.ctx.metrics().nodes_filtered
-        m.constraint_filtered = dict(self.ctx.metrics().constraint_filtered)
-        m.nodes_exhausted = int(out.nodes_evaluated - out.nodes_feasible)
+    def _metrics_proto(self, out: KernelOut):
+        """Per-launch precomputation for ``_metrics_for``: the header
+        counts are identical for every slot, and bulk ``tolist()`` is
+        ~10x cheaper than per-element numpy scalar conversion (the
+        per-slot metrics build was a top-3 host cost of the live
+        path)."""
+        nodes_evaluated = int(out.nodes_evaluated)
+        nodes_exhausted = int(out.nodes_evaluated - out.nodes_feasible)
+        dim_exhausted = {}
         for dim, cnt in (
             ("cpu", out.exhausted_cpu),
             ("memory", out.exhausted_mem),
@@ -709,13 +762,24 @@ class XLAGenericStack:
             ("cores", out.exhausted_cores),
         ):
             if int(cnt) > 0:
-                m.dimension_exhausted[dim] = int(cnt)
+                dim_exhausted[dim] = int(cnt)
+        return (nodes_evaluated, nodes_exhausted, dim_exhausted,
+                out.topk_idx.tolist(), out.topk_scores.tolist())
+
+    def _metrics_for(self, proto, slot: int) -> AllocMetric:
+        nodes_evaluated, nodes_exhausted, dim_exhausted, \
+            topk_idx, topk_scores = proto
+        m = AllocMetric()
+        m.nodes_evaluated = nodes_evaluated
+        m.nodes_filtered = self.ctx.metrics().nodes_filtered
+        m.constraint_filtered = dict(self.ctx.metrics().constraint_filtered)
+        m.nodes_exhausted = nodes_exhausted
+        if dim_exhausted:
+            m.dimension_exhausted.update(dim_exhausted)
         c = self.cluster
-        for j in range(out.topk_idx.shape[1]):
-            score = float(out.topk_scores[slot, j])
+        for row, score in zip(topk_idx[slot], topk_scores[slot]):
             if score <= NEG_INF / 2:
                 continue
-            row = int(out.topk_idx[slot, j])
             if row < c.n_real:
                 m.score_meta.append(
                     (c.node_ids[row], {"normalized-score": score}, score)

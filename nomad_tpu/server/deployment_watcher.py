@@ -9,6 +9,15 @@ the next batch, marks the deployment successful when every group hits
 its desired healthy count, fails it on unhealthy allocs or a blown
 progress deadline, and rolls the job back to the latest stable version
 when auto_revert is set.
+
+Deliberate redesign vs the reference: the reference runs one goroutine
+per deployment; goroutines are cheap, Python threads are not. Here ONE
+loop blocks on alloc/deployment state changes and ticks every active
+deployment's rollout state machine from direct locked row reads — no
+per-deployment thread, no per-tick whole-state snapshot. At bench
+burst rates (hundreds of live deployments) the thread-per-deployment
+design made the watcher tier the leader's dominant GIL load: every
+plan commit woke every watcher thread and each copied the full state.
 """
 
 from __future__ import annotations
@@ -25,71 +34,116 @@ from nomad_tpu.structs.eval_plan import Evaluation
 LOG = logging.getLogger(__name__)
 
 
-class _Watcher:
-    def __init__(self, parent: "DeploymentsWatcher", deployment_id: str) -> None:
-        self.parent = parent
-        self.server = parent.server
-        self.deployment_id = deployment_id
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"deploy-{deployment_id[:8]}",
-        )
-        self._thread.start()
+class _TrackedDeployment:
+    """One deployment's rollout-tracking state between ticks."""
 
-    def stop(self) -> None:
-        self._stop.set()
+    __slots__ = ("deadline", "last_healthy", "promoted")
+
+    def __init__(self) -> None:
+        self.deadline: Optional[float] = None
+        self.last_healthy = -1
+        self.promoted = False
+
+
+class DeploymentsWatcher:
+    """Tracks active deployments, all ticked by one loop
+    (deployments_watcher.go Watcher)."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+        self._lock = threading.Lock()
+        self._tracked: Dict[str, _TrackedDeployment] = {}
+        self._health_seen: Dict[str, Dict[str, bool]] = {}
+        self._enabled = False
+        self._thread: Optional[threading.Thread] = None
+        # multiregion terminal-transition work, derived from the
+        # deployments table (NOT from watcher lifecycles): survives
+        # leader restarts and retry exhaustion. deployment id ->
+        # (next_attempt_monotonic, backoff_s); _mr_done holds ids whose
+        # transition was delivered or proven unnecessary.
+        self._mr_pending: Dict[str, List[float]] = {}
+        self._mr_done: set = set()
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            prev, self._enabled = self._enabled, enabled
+            if not enabled:
+                self._tracked.clear()
+                self._health_seen.clear()
+                # pending kicks re-derive from state on the next
+                # leadership; _mr_done persists only as a memo
+                self._mr_pending.clear()
+        if enabled and not prev:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="deployments-watcher"
+            )
+            self._thread.start()
 
     def _run(self) -> None:
         index = 0
-        deadline = None
-        last_healthy = -1
-        promoted = False
-        while not self._stop.is_set():
+        while self._enabled:
+            # health reports land on allocs; rollout counters on the
+            # deployment rows — either should wake a tick
             index = self.server.state.block_until(
                 ["allocs", "deployment"], index, timeout=0.5
             )
-            snap = self.server.state.snapshot()
-            d = snap.deployment_by_id(self.deployment_id)
-            if d is None or not d.active():
-                break
+            if not self._enabled:
+                return
+            try:
+                self._tick_all()
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("deployments tick: %s", e)
+            try:
+                self._scan_multiregion()
+            except Exception as e:              # noqa: BLE001
+                LOG.warning("multiregion scan: %s", e)
+
+    def _tick_all(self) -> None:
+        active = self.server.state.active_deployments()
+        active_ids = {d.id for d in active}
+        with self._lock:
+            if not self._enabled:
+                return
+            for did in list(self._tracked):
+                if did not in active_ids:
+                    # terminal or GC'd: multiregion follow-ups are the
+                    # state-derived scan's job, nothing else to keep
+                    self._tracked.pop(did, None)
+                    self._health_seen.pop(did, None)
+            work = [(d, self._tracked.setdefault(d.id, _TrackedDeployment()))
+                    for d in active]
+        for d, st in work:
             if d.status == consts.DEPLOYMENT_STATUS_BLOCKED:
                 # multiregion gate: wait for an earlier region's kick;
                 # the progress deadline starts when we unblock
-                deadline = None
+                st.deadline = None
                 continue
-            if deadline is None:
-                deadline = time.time() + max(
+            if st.deadline is None:
+                st.deadline = time.time() + max(
                     (s.progress_deadline_s for s in d.task_groups.values()),
                     default=600.0,
                 )
             try:
-                done, last_healthy, promoted = self._tick(
-                    d, deadline, last_healthy, promoted
-                )
-                if done:
-                    break
+                self._tick_one(d, st)
             except Exception as e:              # noqa: BLE001
-                LOG.warning("deployment %s watcher: %s", self.deployment_id, e)
-        # terminal multiregion transitions (success kick / failure
-        # propagation) are handled by the parent's state-derived scan,
-        # which also re-derives pending kicks after a leader restart
-        self.parent._forget(self.deployment_id)
+                LOG.warning("deployment %s watcher: %s", d.id, e)
 
-    def _tick(self, d, deadline: float, last_healthy: int, promoted: bool):
+    def _tick_one(self, d, st: _TrackedDeployment) -> None:
         """One pass over the deployment's rolled-up counters (the store
         maintains them from client health reports,
-        updateDeploymentWithAlloc). Returns (done, last_healthy,
-        promoted)."""
+        updateDeploymentWithAlloc). Terminal transitions change the
+        row's status, so the next ``_tick_all`` pass drops it from the
+        tracked set on its own."""
         if any(s.unhealthy_allocs > 0 for s in d.task_groups.values()):
             self._fail(d, "Failed due to unhealthy allocations")
-            return True, last_healthy, promoted
-        if time.time() > deadline:
+            return
+        if time.time() > st.deadline:
             self._fail(d, "Failed due to progress deadline")
-            return True, last_healthy, promoted
+            return
 
         # auto-promote canaries once they are all healthy
-        if not promoted and d.requires_promotion() and d.has_auto_promote():
+        if not st.promoted and d.requires_promotion() \
+                and d.has_auto_promote():
             if all(
                 s.healthy_allocs >= s.desired_canaries
                 for s in d.task_groups.values() if s.desired_canaries > 0
@@ -99,7 +153,8 @@ class _Watcher:
                     {"deployment_id": d.id, "groups": None,
                      "evals": [self._new_eval(d)]},
                 )
-                return False, last_healthy, True
+                st.promoted = True
+                return
 
         # success when every group hit its target
         if d.task_groups and all(
@@ -114,17 +169,16 @@ class _Watcher:
                     "description": "Deployment completed successfully",
                 },
             )
-            # the multiregion kick fires from the run loop's terminal
-            # check, which also covers scheduler-marked successes
-            return True, last_healthy, promoted
+            # the multiregion kick fires from the state-derived scan,
+            # which also covers scheduler-marked successes
+            return
 
         # progress: newly healthy allocs unblock the next rolling batch
         healthy_now = sum(s.healthy_allocs for s in d.task_groups.values())
-        if healthy_now > last_healthy:
-            if last_healthy >= 0:
+        if healthy_now > st.last_healthy:
+            if st.last_healthy >= 0:
                 self.server.update_eval(self._new_eval(d))
-            last_healthy = healthy_now
-        return False, last_healthy, promoted
+            st.last_healthy = healthy_now
 
     def _new_eval(self, d) -> Evaluation:
         return Evaluation(
@@ -140,15 +194,13 @@ class _Watcher:
     def _fail(self, d, reason: str) -> None:
         LOG.info("deployment %s failed: %s", d.id, reason)
         auto_revert = any(s.auto_revert for s in d.task_groups.values())
-        desc = reason
-        evals = [self._new_eval(d)]
         self.server.raft_apply(
             fsm_msgs.DEPLOYMENT_STATUS_UPDATE,
             {
                 "deployment_id": d.id,
                 "status": consts.DEPLOYMENT_STATUS_FAILED,
-                "description": desc,
-                "evals": evals,
+                "description": reason,
+                "evals": [self._new_eval(d)],
             },
         )
         if auto_revert:
@@ -175,64 +227,9 @@ class _Watcher:
                  d.id, d.job_id, target.version)
         self.server.job_register(reverted)
 
-
-class DeploymentsWatcher:
-    """Tracks active deployments, one watcher each
-    (deployments_watcher.go Watcher)."""
-
-    def __init__(self, server) -> None:
-        self.server = server
-        self._lock = threading.Lock()
-        self._watchers: Dict[str, _Watcher] = {}
-        self._health_seen: Dict[str, Dict[str, bool]] = {}
-        self._enabled = False
-        self._thread: Optional[threading.Thread] = None
-        # multiregion terminal-transition work, derived from the
-        # deployments table (NOT from watcher lifecycles): survives
-        # leader restarts and retry exhaustion. deployment id ->
-        # (next_attempt_monotonic, backoff_s); _mr_done holds ids whose
-        # transition was delivered or proven unnecessary.
-        self._mr_pending: Dict[str, List[float]] = {}
-        self._mr_done: set = set()
-
-    def set_enabled(self, enabled: bool) -> None:
-        with self._lock:
-            prev, self._enabled = self._enabled, enabled
-            if not enabled:
-                for w in self._watchers.values():
-                    w.stop()
-                self._watchers.clear()
-                self._health_seen.clear()
-                # pending kicks re-derive from state on the next
-                # leadership; _mr_done persists only as a memo
-                self._mr_pending.clear()
-        if enabled and not prev:
-            self._thread = threading.Thread(
-                target=self._run, daemon=True, name="deployments-watcher"
-            )
-            self._thread.start()
-
-    def _run(self) -> None:
-        index = 0
-        while self._enabled:
-            index = self.server.state.block_until(
-                ["deployment"], index, timeout=0.5
-            )
-            snap = self.server.state.snapshot()
-            with self._lock:
-                if not self._enabled:
-                    return
-                for d in snap.deployments_iter():
-                    if d.active() and d.id not in self._watchers:
-                        self._watchers[d.id] = _Watcher(self, d.id)
-            try:
-                self._scan_multiregion(snap)
-            except Exception as e:              # noqa: BLE001
-                LOG.warning("multiregion scan: %s", e)
-
     # -- multiregion terminal transitions (state-derived, persistent) ----
 
-    def _scan_multiregion(self, snap) -> None:
+    def _scan_multiregion(self) -> None:
         """Derive pending cross-region work from the deployments table.
 
         Reference behavior: nomad/deploymentwatcher multiregion kicks
@@ -245,24 +242,29 @@ class DeploymentsWatcher:
         the table and retried with capped backoff until the target
         region acknowledges or proves the kick unnecessary."""
         now = time.monotonic()
+        # cheap gate first: zero multiregion candidates (the common
+        # single-region cluster) must not cost a whole-state snapshot
+        # on every state change
+        candidates = self.server.state.multiregion_terminal_deployment_ids()
         with self._lock:
             if not self._enabled:
+                return
+            if not candidates and not self._mr_pending \
+                    and not self._mr_done:
                 return
             # the memo only matters while the deployment row exists;
             # prune GC'd ids so a long-lived leader doesn't accumulate
             # every terminal multiregion deployment forever
-            live = {d.id for d in snap.deployments_iter()}
-            self._mr_done &= live
-            for d in snap.deployments_iter():
-                if not d.is_multiregion or d.id in self._mr_done:
-                    continue
-                if d.status not in (consts.DEPLOYMENT_STATUS_SUCCESSFUL,
-                                    consts.DEPLOYMENT_STATUS_FAILED):
-                    continue
-                if d.id not in self._mr_pending:
-                    self._mr_pending[d.id] = [0.0, 0.5]
+            self._mr_done &= set(candidates)
+            for did in candidates:
+                if did not in self._mr_done \
+                        and did not in self._mr_pending:
+                    self._mr_pending[did] = [0.0, 0.5]
             due = [did for did, e in self._mr_pending.items()
                    if e[0] <= now]
+        if not due:
+            return
+        snap = self.server.state.snapshot()
         for did in due:
             d = snap.deployment_by_id(did)
             if d is None:                        # GC'd: drop the work
@@ -394,11 +396,6 @@ class DeploymentsWatcher:
                           consts.DEPLOYMENT_STATUS_FAILED,
                           consts.DEPLOYMENT_STATUS_CANCELLED)
 
-    def _forget(self, deployment_id: str) -> None:
-        with self._lock:
-            self._watchers.pop(deployment_id, None)
-            self._health_seen.pop(deployment_id, None)
-
     def _record(self, deployment_id: str, healthy: List[str], unhealthy: List[str]) -> None:
         with self._lock:
             seen = self._health_seen.setdefault(deployment_id, {})
@@ -413,7 +410,7 @@ class DeploymentsWatcher:
 
     def num_watchers(self) -> int:
         with self._lock:
-            return len(self._watchers)
+            return len(self._tracked)
 
     # -- operator RPCs (deployment_endpoint.go Fail/Pause/Promote) -------
 

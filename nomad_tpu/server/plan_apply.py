@@ -19,6 +19,7 @@ vectorized comparison) which is the TPU-native equivalent.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -135,6 +136,12 @@ class Planner:
         self._raft_apply = raft_apply
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # observability: full vs partial commits (a partial sends the
+        # scheduler back for a refreshed-snapshot retry) and cumulative
+        # seconds per applier stage (where plan latency actually goes)
+        self.plans_full = 0
+        self.plans_partial = 0
+        self.stage_s = {"queue_wait": 0.0, "evaluate": 0.0, "commit": 0.0}
         # persistent re-check pool (plan_apply_pool.go:18 EvaluatePool)
         self._pool = (
             ThreadPoolExecutor(
@@ -194,6 +201,10 @@ class Planner:
                                              timeout=0.2)
             if not batch:
                 continue
+            now = time.monotonic()
+            for pending in batch:
+                self.stage_s["queue_wait"] += now - pending.enqueued_at
+            t_eval = time.perf_counter()
             evaluated: List[Tuple[PendingPlan, PlanResult, int]] = []
             snapshot = _LiveView(self.state, overlay)
             for pending in batch:
@@ -206,6 +217,7 @@ class Planner:
                 # evaluation) see this plan through the overlay
                 token = overlay.add(result)
                 evaluated.append((pending, result, token))
+            self.stage_s["evaluate"] += time.perf_counter() - t_eval
             if not evaluated:
                 continue
             # serialize commits: wait for the previous apply before
@@ -227,8 +239,10 @@ class Planner:
         overlay: _PlanOverlay,
     ) -> None:
         try:
+            t0 = time.perf_counter()
             index = self._commit_batch(
                 [(p.plan, r) for p, r, _ in evaluated])
+            self.stage_s["commit"] += time.perf_counter() - t0
             for pending, result, token in evaluated:
                 result.alloc_index = index
                 if result.refresh_index > 0:
@@ -287,7 +301,10 @@ class Planner:
             deployment_updates=list(plan.deployment_updates),
         )
         node_ids = list(plan.node_allocation.keys())
-        if len(node_ids) > 1 and self._pool is not None:
+        # the pool pays off only when a plan touches MANY nodes (system
+        # jobs, mass drains): executor dispatch costs more than the
+        # whole fit re-check for the common 10-node service plan
+        if len(node_ids) > 16 and self._pool is not None:
             fits = list(
                 self._pool.map(
                     lambda nid: self._evaluate_node_plan(snapshot, plan, nid),
@@ -312,6 +329,9 @@ class Planner:
                 # nothing placed: drop the new deployment (the retry will
                 # recreate it against fresh state)
                 result.deployment = None
+            self.plans_partial += 1
+        else:
+            self.plans_full += 1
         return result
 
     def _evaluate_node_plan(

@@ -12,6 +12,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs.eval_plan import Plan, PlanResult
@@ -20,6 +21,7 @@ from nomad_tpu.structs.eval_plan import Plan, PlanResult
 class PendingPlan:
     def __init__(self, plan: Plan) -> None:
         self.plan = plan
+        self.enqueued_at = time.monotonic()   # applier stage timing
         self._done = threading.Event()
         self._result: Optional[PlanResult] = None
         self._error: Optional[Exception] = None

@@ -214,7 +214,10 @@ class Worker:
                 sched = new_scheduler(ev.type, snapshot, run, **kw)
             sched.process(ev)
             self.server.eval_broker.ack(ev.id, token)
-            self.processed += 1
+            with self._live_lock:
+                # += from up to MAX_WAVE concurrent eval threads is a
+                # read-modify-write race; monitors poll this counter
+                self.processed += 1
         except Exception as e:                      # noqa: BLE001
             import traceback
             self.last_error = traceback.format_exc()
@@ -243,10 +246,15 @@ class Worker:
         as they do between reference workers: re-validation + partial
         commit + retry against a refreshed snapshot.
 
-        Batches larger than MAX_WAVE run as consecutive chunks, each
-        with its own rendezvous; the chunks still share the one
-        snapshot (reference workers routinely schedule against state
-        that other workers' plans are landing on).
+        Batches larger than MAX_WAVE split into chunks, each with its
+        own rendezvous — started CONCURRENTLY, because a wave's device
+        execution releases the GIL while every one of its participants
+        is parked: with a second chunk in flight, its threads do their
+        host-side tensor builds exactly inside that window, so device
+        time and Python time overlap instead of strictly alternating.
+        All chunks share the one snapshot (reference workers routinely
+        schedule against state that other workers' plans are landing
+        on).
         """
         from nomad_tpu.parallel.coalesce import ClusterCache, LaunchCoalescer
 
@@ -265,7 +273,23 @@ class Worker:
             return
 
         clusters = ClusterCache()
+        in_flight: List[Tuple[List[threading.Thread], "LaunchCoalescer"]] = []
+
+        def reap(group) -> None:
+            threads, coalescer = group
+            for t in threads:
+                t.join()
+            self.batch_launches += coalescer.launches
+            self.batch_requests += coalescer.requests
+            self.max_wave = max(self.max_wave, coalescer.max_wave)
+
         for start in range(0, len(batch), self.MAX_WAVE):
+            # 2-deep pipeline: chunk N+1 builds while chunk N's wave
+            # executes, but total live threads stay <= 2 x MAX_WAVE
+            # (unbounded fan-out is the GIL collapse MAX_WAVE exists
+            # to prevent)
+            if len(in_flight) >= 2:
+                reap(in_flight.pop(0))
             chunk = batch[start:start + self.MAX_WAVE]
             coalescer = LaunchCoalescer(
                 len(chunk), mesh=getattr(self.server, "wave_mesh", None))
@@ -291,8 +315,6 @@ class Worker:
             ]
             for t in threads:
                 t.start()
-            for t in threads:
-                t.join()
-            self.batch_launches += coalescer.launches
-            self.batch_requests += coalescer.requests
-            self.max_wave = max(self.max_wave, coalescer.max_wave)
+            in_flight.append((threads, coalescer))
+        for group in in_flight:
+            reap(group)

@@ -413,6 +413,27 @@ class StateStore:
         with self._lock:
             return self._deployments.get(deployment_id)
 
+    def active_deployments(self) -> List[Deployment]:
+        """Direct locked read of the active deployment rows (no COW
+        snapshot): the deployments watcher polls this on every state
+        change, and rows are replaced (never mutated) on update, so
+        handing them out is safe."""
+        with self._lock:
+            return [d for d in self._deployments.values() if d.active()]
+
+    def multiregion_terminal_deployment_ids(self) -> List[str]:
+        """Ids of terminal multiregion deployments (the candidates for
+        cross-region kicks) — the cheap gate that lets the watcher skip
+        whole-state snapshots when there is no multiregion work."""
+        with self._lock:
+            return [
+                d.id for d in self._deployments.values()
+                if d.is_multiregion and d.status in (
+                    consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+                    consts.DEPLOYMENT_STATUS_FAILED,
+                )
+            ]
+
     def upsert_acl_token(self, token) -> int:
         with self._lock:
             idx = self._next_index()

@@ -20,6 +20,17 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Collector processes (logmon) normally OUTLIVE the agent so a
+# restarted agent can reattach; a test suite spawning hundreds of
+# short-lived agents must not leak hundreds of pollers (a past round's
+# benchmarks degraded under exactly that load). With this set, a
+# collector also exits once its spawning agent is gone.
+os.environ["NOMAD_TPU_LOGMON_ORPHAN_EXIT"] = "1"
+# Server.start() tunes the interpreter's cyclic GC for long-running
+# processes (deferred full passes). A suite starting hundreds of
+# short-lived servers in ONE process must keep normal GC behavior or
+# cyclic garbage accumulates across tests.
+os.environ["NOMAD_TPU_GC_TUNING"] = "0"
 
 import jax  # noqa: E402
 
