@@ -359,8 +359,14 @@ def _collector_main(argv: List[str]) -> int:
     # collector also exits once its spawning agent is gone.
     should_exit = None
     if os.environ.get("NOMAD_TPU_LOGMON_ORPHAN_EXIT") == "1":
+        # orphaning is detected as REPARENTING (ppid changed away from
+        # the spawning agent), not as "parent is pid 1" — the agent
+        # itself may legitimately BE pid 1 (container entrypoint), in
+        # which case this signal never fires and the alloc-dir check
+        # remains the only exit path
         parent = os.getppid()
-        should_exit = (lambda: parent <= 1 or not _pid_alive(parent))
+        should_exit = (lambda: os.getppid() != parent
+                       or not _pid_alive(parent))
     collector.run(should_exit)
     try:
         os.unlink(pid_path)

@@ -6,16 +6,28 @@ placement loop (`fori_loop` over the K steps, masked global argmax and
 one-hot deduction as pure VPU work), so HBM sees each shared plane
 once per launch.
 
-**Measured status (10k nodes, single chip, materialized timing):**
-this kernel's (B,)-grid serializes evals one program at a time, and
-the XLA candidate-set kernel (ops/kernel.place_taskgroup_topk: one
-full-width scoring pass + approx_max_k + K-wide deduction scan)
-measures ~3x faster at B=256 and ~10x at B=512. The kernel is kept as
-a correctness-proven seam for pallas-side evolution — the known next
-step is fusing the full-width pass and candidate scan into one VMEM-
-resident program with a 2D (batch-tile, node-tile) grid
-(tests/test_pallas_kernel.py pins exact parity); the scheduler and
-bench stay on the XLA path.
+**Measured status (round 5, REAL TPU v5e chip, 10k nodes, B=512,
+best-of-3 materialized timing):** `pallas_topk_place_batch` (full-
+width pass + approx_max_k in XLA, the K-step candidate deduction scan
+as one VMEM-resident pallas program, 256-row batch tiles) runs at
+**98.9k evals/s vs the all-XLA candidate kernel's 119.8k — 82% —
+at exact score parity** (same 170,607 score sum / 204,800 placements
+on the same ask stream). Two findings from getting it on-chip:
+(1) a loop-carried bool vector trips a Mosaic layout-inference bug
+(scf.yield on vector<8x128xi1>); the validity flag is carried as f32.
+(2) per-program grid overhead dominates small batch tiles — tb=8
+measured ~10% slower than tb=256.
+
+The remaining gap is NOT the scan (it is a small fraction of launch
+time): it is the full-width scoring sweep + top-k, where XLA's fused
+sweep and hardware-tuned approx_max_k are already near the HBM
+roofline. Fusing them into this program would mean re-implementing
+approx_max_k's bucketed selection in VPU ops to save one [B,N]
+intermediate round-trip — measured headroom under 20%, so the
+scheduler and bench stay on the XLA path via per-machine calibration
+(bench.py `_calibrate_and_size` times both and picks the winner; on
+this chip it correctly picks XLA). The kernel remains the pallas-side
+evolution seam, now proven on hardware end to end.
 
 Feature coverage is the **lean binpack variant** (the common service/
 batch ask: cpu/mem/disk feasibility + binpack/spread fit + job
@@ -320,8 +332,12 @@ def _cand_scan_kernel(scal, cap_cpu, cap_mem, cap_disk,
         ud = ud + upd * a_disk
         utg = utg + upd
         # bound check: best candidate must still beat the rest of the
-        # cluster (place_taskgroup_topk's ok accumulation)
-        ok = ok & ((active <= 0) | ~fnd | (rowmax >= rest_max))
+        # cluster (place_taskgroup_topk's ok accumulation). Carried as
+        # f32 0/1: a loop-carried bool vector trips a Mosaic layout-
+        # inference bug (scf.yield on vector<8x128xi1> with vpad
+        # mismatch) on current TPU toolchains
+        ok = ok * ((active <= 0) | ~fnd
+                   | (rowmax >= rest_max)).astype(jnp.float32)
 
         at_i = cols == i
         placed = fnd & (active > 0)
@@ -335,7 +351,7 @@ def _cand_scan_kernel(scal, cap_cpu, cap_mem, cap_disk,
         jnp.full((tb, C_LANES), -1.0, jnp.float32),
         jnp.zeros((tb, C_LANES), jnp.float32),
         jnp.zeros((tb, C_LANES), jnp.float32),
-        jnp.ones((tb, 1), bool),
+        jnp.ones((tb, 1), jnp.float32),
     )
     _, _, _, _, ch, sc, fo, ok = jax.lax.fori_loop(0, k_steps, body, init)
 
@@ -345,7 +361,7 @@ def _cand_scan_kernel(scal, cap_cpu, cap_mem, cap_disk,
     want = (cols < k_steps) & (cols.astype(jnp.float32) < n_steps)
     missing = jnp.any(want & (fo <= 0.0), axis=1, keepdims=True)
     rest_bad = rest_max <= NEG_INF / 2
-    valid = ok & (~missing | rest_bad)
+    valid = (ok > 0.0) & (~missing | rest_bad)
 
     chosen_ref[:] = ch.astype(jnp.int32)
     score_ref[:] = sc
@@ -446,7 +462,11 @@ def pallas_topk_place_batch(cap_cpu, cap_mem, cap_disk,
     scal = scal.at[:, 6].set(rest_max)
     scal = jnp.pad(scal, ((0, 0), (0, C_LANES - _SCAL_LANES)))
 
-    tb = 8
+    # batch-tile: large tiles amortize per-program grid overhead (the
+    # whole working set is ~12 x tb x 128 x 4B — ~1.5MiB at tb=256,
+    # comfortably VMEM-resident); tiny batches still round to the
+    # native 8-sublane tile
+    tb = max(8, min(256, 1 << (real_b - 1).bit_length()))
     b_pad = (-real_b) % tb
     if b_pad:
         planes = [jnp.pad(p, ((0, b_pad), (0, 0))) for p in planes]

@@ -59,9 +59,9 @@ from nomad_tpu.tensors.schema import (
 
 import threading as _threading
 
-#: process-wide hot-path observability (read by /v1/metrics and the
-#: bench): how often exact host-side assignment disagreed with the
-#: kernel and forced a masked re-run
+#: process-wide hot-path observability (surfaced via Server.stats()
+#: -> /v1/agent/self): how often exact host-side assignment disagreed
+#: with the kernel and forced a masked re-run
 _STATS_LOCK = _threading.Lock()
 STATS = {"assign_retry_launches": 0}
 

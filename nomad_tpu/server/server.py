@@ -30,6 +30,10 @@ from nomad_tpu.structs.eval_plan import Evaluation, Plan, PlanResult
 
 LOG = logging.getLogger(__name__)
 
+#: gc.freeze() must run at most once per PROCESS (see
+#: Server._tune_interpreter_gc)
+_GC_FROZEN = False
+
 
 class ServerConfig:
     def __init__(
@@ -254,13 +258,23 @@ class Server:
         on a dedicated maintenance thread between bursts. Refcounts
         still reclaim everything acyclic immediately; opt out with
         gc_tuning=False."""
+        t = getattr(self, "_gc_thread", None)
+        if t is not None and t.is_alive():
+            return   # stop()/start() cycle: maintenance already live
         self._gc_tuned = False
         if not self.config.gc_tuning \
                 or os.environ.get("NOMAD_TPU_GC_TUNING") == "0":
             return
         import gc
 
-        gc.freeze()
+        global _GC_FROZEN
+        if not _GC_FROZEN:
+            # freeze only BOOT-TIME objects, once per process — calling
+            # freeze() again on a restarted server would move its
+            # accumulated cluster state into the permanent generation
+            # and leak its cycles for the process lifetime
+            gc.freeze()
+            _GC_FROZEN = True
         # gen0 at 50k keeps young-object sweeps cheap and infrequent;
         # the enormous gen1/gen2 multipliers mean full passes happen in
         # the maintenance thread, not under a wave
@@ -282,8 +296,9 @@ class Server:
                         return
                 gc.collect()
 
-        threading.Thread(target=maintain, daemon=True,
-                         name="interpreter-gc").start()
+        self._gc_thread = threading.Thread(
+            target=maintain, daemon=True, name="interpreter-gc")
+        self._gc_thread.start()
 
     def _maybe_configure_wave_mesh(self) -> None:
         """Wire live placement waves onto the device mesh (the §2.10
@@ -1373,11 +1388,27 @@ class Server:
     # --- introspection --------------------------------------------------
 
     def stats(self) -> Dict:
+        from nomad_tpu.scheduler import stack as _stack
+
         return {
             "leader": self._leader,
             "broker": self.eval_broker.stats(),
             "blocked": self.blocked_evals.stats(),
             "plan_queue": self.plan_queue.stats(),
+            # applier health: full vs partial commits and where plan
+            # latency goes (queue wait / evaluate / raft commit)
+            "plan_apply": {
+                "plans_full": self.planner.plans_full,
+                "plans_partial": self.planner.plans_partial,
+                "stage_seconds": {
+                    k: round(v, 4)
+                    for k, v in self.planner.stage_s.items()
+                },
+            },
+            # exact host-side assignment disagreed with the kernel and
+            # forced a masked re-run (should stay near zero)
+            "assign_retry_launches":
+                _stack.STATS["assign_retry_launches"],
             "heartbeats": self.heartbeats.count(),
             "workers": len(self.workers),
             "state_index": self.state.latest_index(),
