@@ -49,6 +49,23 @@ BATCH = 512
 N_BATCHES = 400
 BASELINE_EVALS = 2_000
 
+
+def _bench_batch(backend: str):
+    """(batch, n_batches) for the timed kernel cells.
+
+    Evals in a batch are vmapped-independent (same snapshot, optimistic
+    concurrency), so batch width is a pure throughput knob — per-eval
+    inputs and placement quality are identical at any width. On an
+    accelerator, wide batches amortize dispatch/scan fixed costs
+    (measured on the round-5 chip: 512 -> 8192 gained ~2.4x); the CPU
+    fallback keeps the narrow batch, whose [B, nodes] intermediates
+    fit host caches and the harness window."""
+    if backend == "cpu":
+        return BATCH, N_BATCHES
+    wide = 8192
+    total = BATCH * N_BATCHES
+    return wide, total // wide
+
 # matched-workload score-parity run (mirrors baseline_binpack.cc)
 PARITY_EVALS = 1_000
 PARITY_BATCH = 50           # joint-kernel members per launch
@@ -188,14 +205,31 @@ def _baseline_bin() -> str:
     return out
 
 
+def _run_baseline_best(argv: list, reps: int = 3) -> dict:
+    """Run the native baseline ``reps`` times and keep the FASTEST.
+
+    The denominator must be the baseline at its best: host noise (a
+    shared VM's steal time, a stray background process) that lands in
+    a single-shot baseline run inflates vs_baseline — round-5 captures
+    showed the same replay baseline varying 2.3x between runs while
+    the device-side number held steady. Best-of-N mirrors the
+    best-of-N the TPU side already gets and biases the comparison
+    AGAINST this framework."""
+    best = None
+    for _ in range(reps):
+        proc = subprocess.run(argv, check=True, capture_output=True,
+                              text=True)
+        out = json.loads(proc.stdout)
+        if best is None or out["evals_per_sec"] > best["evals_per_sec"]:
+            best = out
+    return best
+
+
 def run_baseline() -> dict:
     """Compile (once) and run the native sequential baseline."""
-    proc = subprocess.run(
+    return _run_baseline_best(
         [_baseline_bin(), str(N_NODES), str(PLACEMENTS_PER_EVAL),
-         str(BASELINE_EVALS)],
-        check=True, capture_output=True, text=True,
-    )
-    return json.loads(proc.stdout)
+         str(BASELINE_EVALS)])
 
 
 def time_batches(loop, shared, used_cpu, used_mem, asks_cpu, asks_mem,
@@ -244,7 +278,11 @@ def _calibrate_and_size(candidates, shared, used_cpu, used_mem,
     reps x (warmup + timed) full bursts plus one compile of the
     full-size variant (approximated by a 1.4x safety factor on the
     steady-state estimate). Returns (name, loop, n_batches, reps)."""
-    cal_steps = min(20, n_batches_max)
+    # calibration must stay a small FRACTION of the real burst: with
+    # wide accelerator batches n_batches_max is small (25), and a
+    # 20-batch calibration would be 80% of the measurement (and the
+    # n_b floor below would defeat budget shrinking entirely)
+    cal_steps = min(max(2, n_batches_max // 10), 20, n_batches_max)
     picked, best_cal, pick_err = None, float("inf"), None
     for name, loop in candidates:
         try:
@@ -315,7 +353,8 @@ def run_tpu(budget_s: float = None) -> dict:
                   file=sys.stderr)
 
     npad = cluster.n_pad
-    n_steps = jnp.asarray(np.full(BATCH, PLACEMENTS_PER_EVAL, np.int32))
+    batch, n_batches = _bench_batch(backend)
+    n_steps = jnp.asarray(np.full(batch, PLACEMENTS_PER_EVAL, np.int32))
 
     # device-resident cluster utilization (C2M-style partially packed;
     # in the live system the plan applier maintains these planes with
@@ -327,21 +366,21 @@ def run_tpu(budget_s: float = None) -> dict:
 
     # per-batch ask scalars vary per eval (the only per-eval upload)
     asks_cpu = jnp.asarray(
-        rng.choice([250.0, 500.0, 750.0], (N_BATCHES, BATCH))
+        rng.choice([250.0, 500.0, 750.0], (n_batches, batch))
         .astype(np.float32))
     asks_mem = jnp.asarray(
-        rng.choice([128.0, 256.0, 512.0], (N_BATCHES, BATCH))
+        rng.choice([128.0, 256.0, 512.0], (n_batches, batch))
         .astype(np.float32))
 
     kernel_name, loop, n_b, reps = _calibrate_and_size(
         candidates, shared, used_cpu, used_mem, asks_cpu, asks_mem,
-        n_steps, budget_s, N_BATCHES)
+        n_steps, budget_s, n_batches)
 
     best_dt, (score_sum, placed, fallback) = time_batches(
         loop, shared, used_cpu, used_mem, asks_cpu[:n_b], asks_mem[:n_b],
         n_steps, reps=reps)
 
-    evals = BATCH * n_b
+    evals = batch * n_b
     return {
         "evals_per_sec": evals / best_dt,
         "mean_score": score_sum / max(placed, 1),
@@ -817,10 +856,8 @@ def run_replay(planes, budget_s: float = None) -> dict:
         cluster, used_cpu, used_mem, used_disk, asks,
         BASELINE_EVALS, PLACEMENTS_PER_EVAL)
     try:
-        proc = subprocess.run(
-            [_baseline_bin(), "--planes", planes_file],
-            check=True, capture_output=True, text=True)
-        baseline = json.loads(proc.stdout)
+        baseline = _run_baseline_best(
+            [_baseline_bin(), "--planes", planes_file])
     finally:
         os.unlink(planes_file)
 
@@ -847,19 +884,20 @@ def run_replay(planes, budget_s: float = None) -> dict:
             print(f"warning: pallas backend unavailable: {e}",
                   file=sys.stderr)
 
+    batch, n_batches = _bench_batch(backend)
     n_steps = jnp.asarray(
-        np.full(BATCH, PLACEMENTS_PER_EVAL, np.int32))
-    asks_cpu = jnp.asarray(asks[:, 0].reshape(N_BATCHES, BATCH))
-    asks_mem = jnp.asarray(asks[:, 1].reshape(N_BATCHES, BATCH))
+        np.full(batch, PLACEMENTS_PER_EVAL, np.int32))
+    asks_cpu = jnp.asarray(asks[:, 0].reshape(n_batches, batch))
+    asks_mem = jnp.asarray(asks[:, 1].reshape(n_batches, batch))
 
     kernel_name, loop, n_b, reps = _calibrate_and_size(
         candidates, shared, used_cpu, used_mem, asks_cpu, asks_mem,
-        n_steps, budget_s, N_BATCHES)
+        n_steps, budget_s, n_batches)
 
     best_dt, (score_sum, placed, fallback) = time_batches(
         loop, shared, used_cpu, used_mem, asks_cpu[:n_b], asks_mem[:n_b],
         n_steps, reps=reps)
-    evals = BATCH * n_b
+    evals = batch * n_b
     return {
         "evals_per_sec": evals / best_dt,
         "vs_baseline": evals / best_dt / baseline["evals_per_sec"],

@@ -258,9 +258,6 @@ class Server:
         on a dedicated maintenance thread between bursts. Refcounts
         still reclaim everything acyclic immediately; opt out with
         gc_tuning=False."""
-        t = getattr(self, "_gc_thread", None)
-        if t is not None and t.is_alive():
-            return   # stop()/start() cycle: maintenance already live
         self._gc_tuned = False
         if not self.config.gc_tuning \
                 or os.environ.get("NOMAD_TPU_GC_TUNING") == "0":
@@ -283,9 +280,17 @@ class Server:
 
         # the full-collection debt is paid on EVERY server for the
         # process lifetime — leadership-gated loops would leave a
-        # follower (or a deposed leader) accumulating cycles forever
+        # follower (or a deposed leader) accumulating cycles forever.
+        # A generation token supersedes the previous start()'s thread
+        # (checking is_alive() instead would race a stop()/start()
+        # cycle into having NO maintenance thread at all).
+        self._gc_gen = getattr(self, "_gc_gen", 0) + 1
+        gen = self._gc_gen
+
         def maintain() -> None:
             while not self._shutdown.wait(self.config.gc_interval):
+                if self._gc_gen != gen:
+                    return               # superseded by a restart
                 # prefer an idle moment (empty plan queue), but never
                 # defer more than ~10s: a bounded, explicitly-placed
                 # pause beats an unbounded implicit one
@@ -296,9 +301,8 @@ class Server:
                         return
                 gc.collect()
 
-        self._gc_thread = threading.Thread(
-            target=maintain, daemon=True, name="interpreter-gc")
-        self._gc_thread.start()
+        threading.Thread(target=maintain, daemon=True,
+                         name="interpreter-gc").start()
 
     def _maybe_configure_wave_mesh(self) -> None:
         """Wire live placement waves onto the device mesh (the §2.10
