@@ -1388,18 +1388,21 @@ class HTTPAgent:
         return self._server.known_regions()
 
     def raft_config(self, req: Request):
+        """operator_endpoint.go RaftGetConfiguration: ID/Node/Address/
+        Leader/Voter per server — THIS server included (raft.peers
+        excludes self). The UI and `operator raft list-peers` both
+        render Address; the contract walk caught it missing."""
+        self._acl(req, "allow_operator_read")
         s = self._server
         if s.raft is None:
             return {"Servers": [{"ID": s.config.name, "Node": s.config.name,
+                                 "Address": s.config.name,
                                  "Leader": True, "Voter": True}], "Index": 0}
-        return {
-            "Servers": [
-                {"ID": p, "Node": p, "Leader": p == s.raft.leader_id,
-                 "Voter": True}
-                for p in s.raft.peers
-            ],
-            "Index": s.raft.commit_index,
-        }
+        leader = s.raft.leader_addr()
+        rows = [{"ID": rid, "Node": rid, "Address": rid,
+                 "Leader": rid == leader, "Voter": True}
+                for rid in [s.raft.id, *s.raft.peers]]
+        return {"Servers": rows, "Index": s.raft.commit_index}
 
     def autopilot_config_get(self, req: Request):
         self._acl(req, "allow_operator_read")

@@ -35,11 +35,17 @@ function esc(s) {
   return String(s ?? "").replace(/[&<>"']/g,
     (c) => ({ "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;" }[c]));
 }
-/* for values inside inline-handler JS string literals: percent-encoding
-   leaves no quotes/backslashes to break out of the literal (the HTML
-   parser entity-decodes attribute values BEFORE the JS engine sees
-   them, so esc() alone is not enough there); handlers decode via arg() */
-function jsArg(s) { return encodeURIComponent(String(s ?? "")); }
+/* for values inside inline-handler JS string literals: percent-encode,
+   INCLUDING the characters encodeURIComponent leaves alone that could
+   terminate a single-quoted literal or call a function — ' ( ) ! * —
+   (the HTML parser entity-decodes attribute values BEFORE the JS
+   engine sees them, so esc() alone is not enough there); handlers
+   decode via arg(). A CSI volume id is attacker-controlled free text,
+   so this is a stored-XSS boundary, not cosmetics. */
+function jsArg(s) {
+  return encodeURIComponent(String(s ?? "")).replace(/[!'()*]/g,
+    (c) => "%" + c.charCodeAt(0).toString(16).padStart(2, "0"));
+}
 /* fs path -> hash-route segment: encode everything except the
    directory separators the router splits on */
 function hashPath(p) { return encodeURIComponent(p).replace(/%2F/g, "/"); }
@@ -76,6 +82,84 @@ function autoRefresh(fn, ms = 4000) {
   clearInterval(refreshTimer);
   refreshTimer = setInterval(() => fn().catch(() => {}), ms);
 }
+
+/* ---------- view contract ----------
+The machine-checked route -> endpoint -> field manifest. The Python
+harness (ui/harness.py) extracts this JSON and (a) walks every
+declared field path against the REAL seeded API — a field the API
+does not return fails the suite — and (b) cross-checks that every
+PascalCase member access in each view function below is declared
+here (API fields are PascalCase, JS locals are camelCase), so a view
+cannot silently read an undeclared — and therefore unwalked — field.
+Path DSL: "." descends; leading "[]" = response is a list (check the
+first element); "KEY[]" = list-valued field; "*" = every dict value;
+a "?" prefix marks a field the API may legitimately omit.
+__VIEW_CONTRACT_START__
+{
+  "viewOverview": {"endpoints": {"jobs": "/v1/jobs", "nodes": "/v1/nodes", "allocs": "/v1/allocations", "evals": "/v1/evaluations", "leader": "/v1/status/leader"},
+    "uses": ["evalTable"],
+    "walk": {"jobs": ["[].Status"], "nodes": ["[].Status"], "allocs": ["[].ClientStatus"], "evals": ["@evalTable"]}},
+  "viewJobs": {"endpoints": {"jobs": "/v1/jobs"},
+    "walk": {"jobs": ["[].ID", "[].Name", "[].Priority", "[].Status", "[].Stop", "[].Type", "[].Version"]}},
+  "viewJobDetail": {"endpoints": {"job": "/v1/job/{job}", "summary": "/v1/job/{job}/summary", "allocs": "/v1/job/{job}/allocations", "evals": "/v1/job/{job}/evaluations", "deploys": "/v1/job/{job}/deployments", "versions": "/v1/job/{job}/versions"},
+    "uses": ["allocTable", "evalTable", "deployTable", "versionsTable"],
+    "walk": {"job": ["ID", "Name", "Type", "Priority", "Status", "Stop", "Version", "Datacenters", "TaskGroups[].Name", "TaskGroups[].Count"],
+             "summary": ["Summary.*.Queued", "Summary.*.Starting", "Summary.*.Running", "Summary.*.Failed", "Summary.*.Complete", "Summary.*.Lost"],
+             "allocs": ["@allocTable"], "evals": ["@evalTable"], "deploys": ["@deployTable"],
+             "versions": ["Versions[].Version", "Versions[].Stable", "Versions[].Stop", "Versions[].Status"]}},
+  "viewClients": {"endpoints": {"nodes": "/v1/nodes"},
+    "walk": {"nodes": ["[].ID", "[].Name", "[].Datacenter", "?[].NodeClass", "?[].NodePool", "[].Status", "[].SchedulingEligibility", "[].Drain"]}},
+  "viewClientDetail": {"endpoints": {"node": "/v1/node/{node}", "allocs": "/v1/node/{node}/allocations"},
+    "uses": ["allocTable"],
+    "walk": {"node": ["ID", "Name", "Datacenter", "?NodePool", "Status", "SchedulingEligibility", "Drain", "NodeResources.CPU.CPUShares", "NodeResources.Memory.MemoryMB", "NodeResources.Disk.DiskMB", "Attributes", "Drivers.*.Detected", "Drivers.*.Healthy"],
+             "allocs": ["@allocTable"]}},
+  "viewAllocs": {"endpoints": {"allocs": "/v1/allocations"},
+    "walk": {"allocs": ["[].ID", "[].JobID", "[].TaskGroup", "[].NodeID", "?[].NodeName", "[].DesiredStatus", "[].ClientStatus", "[].ModifyTime"]}},
+  "viewAllocDetail": {"endpoints": {"alloc": "/v1/allocation/{alloc}"},
+    "uses": ["placementMetrics"],
+    "walk": {"alloc": ["ID", "Name", "JobID", "NodeID", "?NodeName", "ClientStatus", "DesiredStatus", "TaskGroup", "?EvalID", "?DeploymentID", "?CreateTime", "?CreateTimeNs", "TaskStates.*.State", "TaskStates.*.Events[].Type", "?TaskStates.*.Events[].Time", "?TaskStates.*.Events[].TimeNs", "?TaskStates.*.Events[].DisplayMessage", "?TaskStates.*.Events[].Message", "Metrics.NodesEvaluated", "Metrics.NodesFiltered", "Metrics.NodesExhausted", "?Metrics.ScoreMeta"]}},
+  "viewEvals": {"endpoints": {"evals": "/v1/evaluations"}, "uses": ["evalTable"],
+    "walk": {"evals": ["@evalTable"]}},
+  "viewDeployments": {"endpoints": {"deploys": "/v1/deployments"}, "uses": ["deployTable"],
+    "walk": {"deploys": ["@deployTable"]}},
+  "viewServices": {"endpoints": {"groups": "/v1/services", "insts": "/v1/service/{service}"},
+    "walk": {"groups": ["[].Namespace", "[].Services[].ServiceName", "?[].Services[].Tags"],
+             "insts": ["[].ID", "?[].AllocID", "[].NodeID", "?[].Address", "?[].Port"]}},
+  "viewVolumes": {"endpoints": {"vols": "/v1/volumes", "plugins": "/v1/plugins"},
+    "walk": {"vols": ["[].ID", "?[].Name", "[].PluginID", "[].Schedulable", "?[].AccessMode", "?[].CurrentReaders", "?[].CurrentWriters"],
+             "plugins": ["[].ID", "?[].Provider", "?[].ControllersHealthy", "?[].ControllersExpected", "?[].NodesHealthy", "?[].NodesExpected"]}},
+  "viewVolumeDetail": {"endpoints": {"vol": "/v1/volume/csi/{volume}"},
+    "walk": {"vol": ["ID", "?Name", "?Namespace", "PluginID", "Schedulable", "?AccessMode", "?AttachmentMode", "?CurrentReaders", "?CurrentWriters", "?ReadAllocs[].ID", "?ReadAllocs[].ClientStatus", "?WriteAllocs[].ID", "?WriteAllocs[].ClientStatus"]}},
+  "viewPluginDetail": {"endpoints": {"plugin": "/v1/plugin/csi/{plugin}"},
+    "walk": {"plugin": ["ID", "?Provider", "?Version", "?ControllersHealthy", "?ControllersExpected", "?NodesHealthy", "?NodesExpected"]}},
+  "viewACL": {"endpoints": {"policies": "/v1/acl/policies", "tokens": "/v1/acl/tokens"},
+    "walk": {"policies": ["[].Name", "?[].Description"],
+             "tokens": ["[].Name", "[].Type", "[].AccessorID", "?[].Policies", "?[].Global"]}},
+  "viewACLPolicy": {"endpoints": {"policy": "/v1/acl/policy/{policy}"},
+    "walk": {"policy": ["Name", "?Description", "Rules"]}},
+  "viewTopology": {"endpoints": {"nodes": "/v1/nodes?resources=true", "allocs": "/v1/allocations?resources=true"},
+    "walk": {"nodes": ["[].ID", "[].Name", "[].Datacenter", "[].Status", "[].Drain", "[].NodeResources.CPU", "[].NodeResources.MemoryMB"],
+             "allocs": ["[].ClientStatus", "[].NodeID", "[].AllocatedResources.CPU", "[].AllocatedResources.MemoryMB"]}},
+  "viewServers": {"endpoints": {"members": "/v1/agent/members", "raft": "/v1/operator/raft/configuration", "health": "/v1/operator/autopilot/health"},
+    "walk": {"members": ["ServerRegion", "Members[].Name", "Members[].Addr", "Members[].Status", "?Members[].Tags"],
+             "raft": ["Servers[].ID", "Servers[].Address", "Servers[].Leader", "Servers[].Voter"],
+             "health": ["Healthy", "FailureTolerance"]}},
+  "viewSettings": {"endpoints": {"self": "/v1/agent/self"}, "walk": {"self": []}},
+  "viewAllocFs": {"endpoints": {"ls": "/v1/client/fs/ls/{alloc}?path=/"},
+    "walk": {"ls": ["[].Name", "[].IsDir", "[].Size", "[].ModTime"]}},
+  "viewAllocFile": {"endpoints": {"stat": "/v1/client/fs/stat/{alloc}?path={file}", "read": "/v1/client/fs/readat/{alloc}?path={file}&offset=0&limit=64"},
+    "walk": {"stat": ["Size", "Name", "IsDir", "ModTime"], "read": ["Data"]}},
+  "viewAllocLogs": {"endpoints": {"logs": "/v1/client/fs/logs/{alloc}?task={task}&type=stdout"},
+    "walk": {"logs": ["Data"]}},
+  "helpers": {
+    "allocTable": ["[].ID", "[].TaskGroup", "[].NodeID", "?[].NodeName", "[].DesiredStatus", "[].ClientStatus", "?[].CreateTime", "?[].CreateTimeNs"],
+    "evalTable": ["[].ID", "[].JobID", "[].Type", "[].TriggeredBy", "[].Status", "?[].StatusDescription"],
+    "deployTable": ["[].ID", "[].JobID", "[].Status", "?[].StatusDescription"],
+    "versionsTable": ["[].Version", "[].Stable", "[].Stop", "[].Status"],
+    "placementMetrics": ["NodesEvaluated", "NodesFiltered", "NodesExhausted", "?ScoreMeta"]
+  }
+}
+__VIEW_CONTRACT_END__ */
 
 /* ---------- views ---------- */
 
@@ -427,20 +511,129 @@ async function viewVolumes() {
     <h1>Volumes</h1>
     <p class="sub">${vols.length} CSI volume(s)</p>
     ${vols.length ? `<table><thead><tr><th>ID</th><th>Name</th><th>Plugin</th><th>Schedulable</th><th>Access</th><th>Allocs</th></tr></thead><tbody>
-    ${vols.map(v => `<tr>
-      <td class="mono">${esc(v.ID)}</td><td>${esc(v.Name || "")}</td>
-      <td class="mono">${esc(v.PluginID || "")}</td>
+    ${vols.map(v => `<tr class="rowlink" onclick="location.hash='#/volumes/${jsArg(v.ID)}'">
+      <td class="mono"><a href="#/volumes/${jsArg(v.ID)}">${esc(v.ID)}</a></td><td>${esc(v.Name || "")}</td>
+      <td class="mono"><a href="#/plugins/${jsArg(v.PluginID || "")}">${esc(v.PluginID || "")}</a></td>
       <td>${badge(v.Schedulable ? "ready" : "unavailable")}</td>
       <td class="muted">${esc(v.AccessMode || "")}</td>
       <td>${(v.CurrentReaders ?? 0) + (v.CurrentWriters ?? 0)}</td></tr>`).join("")}
     </tbody></table>` : `<p class="muted">none</p>`}
     <h2>Plugins</h2>
     ${plugins.length ? `<table><thead><tr><th>ID</th><th>Provider</th><th>Controllers</th><th>Nodes</th></tr></thead><tbody>
-    ${plugins.map(p => `<tr><td class="mono">${esc(p.ID)}</td><td>${esc(p.Provider || "")}</td>
+    ${plugins.map(p => `<tr class="rowlink" onclick="location.hash='#/plugins/${jsArg(p.ID)}'">
+      <td class="mono"><a href="#/plugins/${jsArg(p.ID)}">${esc(p.ID)}</a></td><td>${esc(p.Provider || "")}</td>
       <td>${p.ControllersHealthy ?? 0}/${p.ControllersExpected ?? 0}</td>
       <td>${p.NodesHealthy ?? 0}/${p.NodesExpected ?? 0}</td></tr>`).join("")}
     </tbody></table>` : `<p class="muted">none</p>`}
   `);
+}
+
+async function viewVolumeDetail(id) {
+  const v = await get(`/v1/volume/csi/${encodeURIComponent(id)}`);
+  const allocRow = (a, mode) => `<tr class="rowlink"
+      onclick="location.hash='#/allocations/${jsArg(a.ID)}'">
+    <td class="mono"><a href="#/allocations/${jsArg(a.ID)}">${shortId(a.ID)}</a></td>
+    <td>${esc(mode)}</td><td>${badge(a.ClientStatus)}</td></tr>`;
+  render(`
+    <h1>${esc(v.Name || v.ID)}</h1>
+    <p class="sub mono">${esc(v.ID)}</p>
+    <div class="tiles">
+      <div class="tile"><div class="v">${badge(v.Schedulable ? "ready" : "unavailable")}</div><div class="k">schedulable</div></div>
+      <div class="tile"><div class="v">${esc(v.AccessMode || "—")}</div><div class="k">access mode</div></div>
+      <div class="tile"><div class="v">${esc(v.AttachmentMode || "—")}</div><div class="k">attachment</div></div>
+      <div class="tile"><div class="v">${(v.CurrentReaders ?? 0)}/${(v.CurrentWriters ?? 0)}</div><div class="k">readers/writers</div></div>
+    </div>
+    <p class="sub">plugin <a class="mono" href="#/plugins/${jsArg(v.PluginID || "")}">${esc(v.PluginID || "—")}</a>
+       · namespace ${esc(v.Namespace || "default")}</p>
+    <h2>Claims</h2>
+    <table><thead><tr><th>Alloc</th><th>Mode</th><th>Status</th></tr></thead><tbody>
+      ${(v.ReadAllocs || []).map(a => allocRow(a, "read")).join("")}
+      ${(v.WriteAllocs || []).map(a => allocRow(a, "write")).join("")}
+    </tbody></table>
+    <button class="danger" onclick="detachVolume('${jsArg(v.ID)}')">Detach all</button>
+  `);
+}
+async function detachVolume(id) {
+  try {
+    await post(`/v1/volume/csi/${encodeURIComponent(arg(id))}/detach`, {});
+    route();
+  } catch (e) { renderError(e); }
+}
+
+async function viewPluginDetail(id) {
+  const p = await get(`/v1/plugin/csi/${encodeURIComponent(id)}`);
+  render(`
+    <h1>Plugin ${esc(p.ID)}</h1>
+    <p class="sub">provider ${esc(p.Provider || "—")} ${esc(p.Version || "")}</p>
+    <div class="tiles">
+      <div class="tile"><div class="v">${p.ControllersHealthy ?? 0}/${p.ControllersExpected ?? 0}</div><div class="k">controllers healthy</div></div>
+      <div class="tile"><div class="v">${p.NodesHealthy ?? 0}/${p.NodesExpected ?? 0}</div><div class="k">nodes healthy</div></div>
+    </div>
+  `);
+}
+
+/* ---------- ACL management (reference ui/ policies + tokens) ---------- */
+
+async function viewACL() {
+  const [policies, tokens] = await Promise.all([
+    get("/v1/acl/policies").catch(() => []),
+    get("/v1/acl/tokens").catch(() => []),
+  ]);
+  render(`
+    <div class="toolbar"><div><h1>Access control</h1>
+    <p class="sub">${policies.length} policy(ies), ${tokens.length} token(s)</p></div>
+    <button onclick="location.hash='#/acl/policies/_new'">New policy</button></div>
+    <h2>Policies</h2>
+    ${policies.length ? `<table><thead><tr><th>Name</th><th>Description</th></tr></thead><tbody>
+    ${policies.map(p => `<tr class="rowlink" onclick="location.hash='#/acl/policies/${jsArg(p.Name)}'">
+      <td><a href="#/acl/policies/${jsArg(p.Name)}">${esc(p.Name)}</a></td>
+      <td class="muted">${esc(p.Description || "")}</td></tr>`).join("")}
+    </tbody></table>` : `<p class="muted">no policies (ACLs may be disabled)</p>`}
+    <h2>Tokens</h2>
+    ${tokens.length ? `<table><thead><tr><th>Name</th><th>Type</th><th>Accessor</th><th>Policies</th><th>Global</th></tr></thead><tbody>
+    ${tokens.map(t => `<tr>
+      <td>${esc(t.Name || "")}</td><td>${esc(t.Type)}</td>
+      <td class="mono">${shortId(t.AccessorID)}</td>
+      <td class="mono">${esc((t.Policies || []).join(", "))}</td>
+      <td>${t.Global ? "yes" : ""}</td></tr>`).join("")}
+    </tbody></table>` : `<p class="muted">no tokens visible</p>`}
+  `);
+}
+
+async function viewACLPolicy(name) {
+  const fresh = name === "_new";
+  let p = { Name: "", Description: "", Rules: "" };
+  if (!fresh) p = await get(`/v1/acl/policy/${encodeURIComponent(name)}`);
+  render(`
+    <h1>${fresh ? "New policy" : `Policy ${esc(p.Name)}`}</h1>
+    <div class="form">
+      <label>Name <input id="pol-name" value="${esc(p.Name)}" ${fresh ? "" : "readonly"}></label>
+      <label>Description <input id="pol-desc" value="${esc(p.Description || "")}"></label>
+      <label>Rules (HCL)<textarea id="pol-rules" rows="14" class="mono">${esc(p.Rules || "")}</textarea></label>
+      <div class="toolbar">
+        <button onclick="savePolicy()">Save</button>
+        ${fresh ? "" : `<button class="danger" onclick="deletePolicy('${jsArg(p.Name)}')">Delete</button>`}
+      </div>
+    </div>
+  `);
+}
+async function savePolicy() {
+  const name = document.getElementById("pol-name").value.trim();
+  if (!name) { renderError(new Error("policy name required")); return; }
+  try {
+    await post(`/v1/acl/policy/${encodeURIComponent(name)}`, {
+      Name: name,
+      Description: document.getElementById("pol-desc").value,
+      Rules: document.getElementById("pol-rules").value,
+    });
+    location.hash = "#/acl";
+  } catch (e) { renderError(e); }
+}
+async function deletePolicy(name) {
+  try {
+    await del(`/v1/acl/policy/${encodeURIComponent(arg(name))}`);
+    location.hash = "#/acl";
+  } catch (e) { renderError(e); }
 }
 
 async function viewTopology() {
@@ -816,6 +1009,10 @@ const routes = [
   [/^#\/deployments$/, viewDeployments],
   [/^#\/services$/, viewServices],
   [/^#\/volumes$/, viewVolumes],
+  [/^#\/volumes\/(.+)$/, (m) => viewVolumeDetail(decodeURIComponent(m[1]))],
+  [/^#\/plugins\/(.+)$/, (m) => viewPluginDetail(decodeURIComponent(m[1]))],
+  [/^#\/acl$/, viewACL],
+  [/^#\/acl\/policies\/(.+)$/, (m) => viewACLPolicy(decodeURIComponent(m[1]))],
   [/^#\/topology$/, viewTopology],
   [/^#\/servers$/, viewServers],
   [/^#\/settings$/, viewSettings],

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import re
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 def seed_cluster(agent, n_service_jobs: int = 2,
@@ -287,6 +287,191 @@ def referenced_api_paths(app_js: str) -> List[str]:
 def route_table_patterns(http_agent) -> List:
     return [(method, pattern) for method, pattern, _fn
             in http_agent._routes]
+
+
+def extract_view_contract(app_js: str) -> Dict:
+    """The machine-checked route -> endpoint -> field manifest embedded
+    in app.js (between __VIEW_CONTRACT_START__/__VIEW_CONTRACT_END__).
+    Raises if missing or not strict JSON — the contract IS the test
+    surface, so a parse failure must fail loudly."""
+    import json
+
+    m = re.search(r"__VIEW_CONTRACT_START__\n(.*?)\n__VIEW_CONTRACT_END__",
+                  app_js, re.S)
+    if m is None:
+        raise AssertionError("app.js has no __VIEW_CONTRACT__ block")
+    return json.loads(m.group(1))
+
+
+def function_field_accesses(app_js: str) -> Dict[str, List[str]]:
+    """PascalCase member accesses per top-level function.
+
+    API response fields are PascalCase while JS locals/methods are
+    camelCase, so `.Foo` inside a view function is, by construction,
+    a read of an API field — the set the view CONSUMES. The harness
+    requires every one of them to be declared in the view contract,
+    which in turn is walked against the live API: a view can therefore
+    not read a field the API does not return without a test failing."""
+    out: Dict[str, List[str]] = {}
+    parts = re.split(r"(?=^(?:async )?function \w+)", app_js, flags=re.M)
+    for p in parts:
+        m = re.match(r"(?:async )?function (\w+)", p)
+        if m is None:
+            continue
+        fields = sorted(set(re.findall(r"\.([A-Z][A-Za-z0-9]*)\b", p)))
+        if fields:
+            out[m.group(1)] = fields
+    return out
+
+
+def _path_field_names(paths, helpers=None) -> set:
+    """Field NAMES a set of walk paths mention (expanding @helper
+    refs) — the one segment parser both declaration checks share."""
+    helpers = helpers or {}
+    names: set = set()
+    for path in paths:
+        if path.startswith("@"):
+            names |= _path_field_names(helpers.get(path[1:], ()), helpers)
+            continue
+        for seg in path.lstrip("?").split("."):
+            seg = seg.replace("[]", "")
+            if seg and seg != "*":
+                names.add(seg)
+    return names
+
+
+def _contract_fields(contract: Dict, view: str) -> set:
+    """Flat set of field NAMES a view's walk paths (plus its helpers')
+    mention — the declared consumption set."""
+    helpers = contract.get("helpers", {})
+    spec = contract.get(view, {})
+    names: set = set()
+    for paths in spec.get("walk", {}).values():
+        names |= _path_field_names(paths, helpers)
+    return names
+
+
+def resolve_path(data, path: str):
+    """Walk one contract path; returns (ok, reason).
+
+    DSL: "." descends dicts; a leading "[]" means the response is a
+    list (first element is checked); "KEY[]" means KEY holds a list;
+    "*" fans out over every dict value; a "?" prefix marks the field
+    as omittable (absence passes, a non-dict parent still fails)."""
+    optional = path.startswith("?")
+    segs = path.lstrip("?").split(".")
+
+    def walk(cur, i) -> Tuple[bool, str]:
+        if i == len(segs):
+            return True, ""
+        seg = segs[i]
+        if seg == "[]" or seg == "":
+            if not isinstance(cur, list):
+                return False, f"expected list at {'.'.join(segs[:i])!r}"
+            if not cur:
+                return optional, "empty list"
+            return walk(cur[0], i + 1)
+        if seg == "*":
+            if not isinstance(cur, dict):
+                return False, f"expected dict at {'.'.join(segs[:i])!r}"
+            if not cur:
+                return optional, "empty dict"
+            for v in cur.values():
+                ok, why = walk(v, i + 1)
+                if not ok:
+                    return ok, why
+            return True, ""
+        is_list = seg.endswith("[]")
+        key = seg[:-2] if is_list else seg
+        if not isinstance(cur, dict):
+            return False, f"expected object before {key!r}"
+        if key not in cur:
+            return optional, f"missing field {key!r}"
+        nxt = cur[key]
+        if is_list:
+            if nxt is None or not isinstance(nxt, list):
+                return optional, f"{key!r} is not a list"
+            if not nxt:
+                return optional, f"{key!r} empty"
+            nxt = nxt[0]
+        elif nxt is None and i + 1 < len(segs):
+            return optional, f"{key!r} is null"
+        return walk(nxt, i + 1)
+
+    return walk(data, 0)
+
+
+def walk_view_contract(ui: "UIClient", contract: Dict,
+                       params: Dict[str, str]) -> List[str]:
+    """Fetch every view's endpoints against the REAL API and resolve
+    every declared field path. Returns failures (empty = pass).
+
+    ``params`` substitutes the {job}/{node}/{alloc}/... placeholders
+    with ids from the seeded cluster; a view whose placeholder has no
+    param is reported as unexercised (a missing seed is a harness bug,
+    not a pass)."""
+    from urllib.parse import quote
+
+    helpers = contract.get("helpers", {})
+    failures: List[str] = []
+    for view, spec in contract.items():
+        if view == "helpers":
+            continue
+        for key, path in spec.get("endpoints", {}).items():
+            tmpl = path
+            missing_param = None
+            for ph in re.findall(r"\{(\w+)\}", path):
+                if ph not in params:
+                    missing_param = ph
+                    break
+                tmpl = tmpl.replace("{" + ph + "}",
+                                    quote(str(params[ph]), safe=""))
+            if missing_param is not None:
+                failures.append(
+                    f"{view}.{key}: no seed param {missing_param!r}")
+                continue
+            try:
+                resp = ui.get(tmpl)
+            except Exception as e:               # noqa: BLE001
+                failures.append(f"{view}.{key}: GET {tmpl} -> {e}")
+                continue
+            paths = list(spec.get("walk", {}).get(key, ()))
+            expanded: List[str] = []
+            for p in paths:
+                if p.startswith("@"):
+                    expanded.extend(helpers.get(p[1:], ()))
+                else:
+                    expanded.append(p)
+            for p in expanded:
+                ok, why = resolve_path(resp, p)
+                if not ok:
+                    failures.append(f"{view}.{key}: {p} ({why})")
+    return failures
+
+
+def undeclared_field_reads(app_js: str) -> Dict[str, List[str]]:
+    """view/helper function -> PascalCase reads NOT declared in its
+    contract entry (merged with its "uses" helpers'). Non-empty means
+    a renderer consumes an API field the walk never checks — the gap
+    this harness exists to close."""
+    contract = extract_view_contract(app_js)
+    accesses = function_field_accesses(app_js)
+    helpers = contract.get("helpers", {})
+
+    out: Dict[str, List[str]] = {}
+    for fn, fields in accesses.items():
+        if fn in contract:
+            allowed = _contract_fields(contract, fn)
+            for h in contract[fn].get("uses", ()):
+                allowed |= _path_field_names(helpers.get(h, ()), helpers)
+        elif fn in helpers:
+            allowed = _path_field_names(helpers.get(fn, ()), helpers)
+        else:
+            continue   # non-view plumbing (actions, router, streams)
+        extra = [f for f in fields if f not in allowed]
+        if extra:
+            out[fn] = extra
+    return out
 
 
 def unrouted_paths(app_js: str, http_agent,
