@@ -336,12 +336,25 @@ class Client:
             wait = max(self.heartbeat_ttl * 0.4, 0.05)
             if self._shutdown.wait(wait):
                 return
+            if getattr(self, "partition_heartbeats", False):
+                # test hook: a network partition — tasks keep running,
+                # heartbeats stop reaching the servers (the
+                # disconnected-clients e2e scenarios flip this)
+                self._heartbeat_stop_check()
+                continue
             try:
+                away = time.time() - self.last_heartbeat_ok
                 resp = self.rpc.update_status(
                     self.node_id, consts.NODE_STATUS_READY
                 )
                 self.heartbeat_ttl = resp.get("heartbeat_ttl", self.heartbeat_ttl) or self.heartbeat_ttl
                 self.last_heartbeat_ok = time.time()
+                if away > max(self.heartbeat_ttl, 1.0):
+                    # reconnect after a real gap: the servers may have
+                    # marked our allocs 'unknown' — re-push every live
+                    # runner's actual status (client.go marks allocs
+                    # dirty on reconnect so the server's view heals)
+                    self._resync_alloc_states()
             except Exception as e:              # noqa: BLE001
                 LOG.warning("client %s: heartbeat failed: %s", self.node_id[:8], e)
                 self._heartbeat_stop_check()
@@ -463,6 +476,35 @@ class Client:
             ar.destroy()
 
     # --- status updates (client.go allocSync batching) ------------------
+
+    def _resync_alloc_states(self) -> None:
+        """Queue a status update for every live runner — used after a
+        reconnect, when the servers' view (possibly 'unknown'/'lost')
+        must converge back to the client's ground truth."""
+        import copy as _copy
+
+        from nomad_tpu.structs.alloc import TaskEvent
+
+        now_ns = time.time_ns()
+        with self._alloc_lock:
+            runners = list(self.allocs.values())
+        for runner in runners:
+            try:
+                updated = runner.alloc.copy_skip_job()
+                with runner._lock:
+                    updated.task_states = _copy.deepcopy(
+                        dict(runner.task_states))
+                # the reconnect stamp the reconciler compares against
+                # the server's 'Disconnected' mark (structs.go
+                # Allocation.Reconnected)
+                for ts in updated.task_states.values():
+                    ts.events.append(TaskEvent(
+                        type="Reconnected", time_ns=now_ns,
+                        message="client reconnected"))
+                updated.client_status = runner.client_status()
+                self._queue_update(updated)
+            except Exception:                   # noqa: BLE001
+                pass
 
     def _queue_update(self, alloc: Allocation) -> None:
         with self._update_lock:

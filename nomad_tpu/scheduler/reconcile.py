@@ -1163,6 +1163,15 @@ class AllocReconciler:
             update.client_status = consts.ALLOC_CLIENT_UNKNOWN
             update.client_description = "alloc is lost since its node is disconnected"
             update.follow_up_eval_id = ev.id
+            # stamp the disconnect on every task state (structs.go
+            # appends the unknown AllocState; Reconnected() compares it
+            # against the client's later 'Reconnected' event)
+            from nomad_tpu.structs.alloc import TaskEvent
+            now_ns = int(self.now * 1e9)
+            for ts in update.task_states.values():
+                ts.events.append(TaskEvent(
+                    type="Disconnected", time_ns=now_ns,
+                    message="client missed heartbeats"))
             self.result.disconnect_updates[aid] = update
         return out
 

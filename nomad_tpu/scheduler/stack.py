@@ -897,11 +897,21 @@ class _NodeAssigner:
                 ports=offer,
             )
 
+        # memory oversubscription (structs.go MemoryMaxMB): the burst
+        # ceiling rides on the allocation ONLY when the operator enabled
+        # it (SchedulerConfiguration.MemoryOversubscriptionEnabled);
+        # scheduling always counts the reserve (memory_mb)
+        oversub = getattr(self.ctx.state.scheduler_config,
+                          "memory_oversubscription_enabled", False)
         for task in tg.tasks:
             r = task.resources
             tr = AllocatedTaskResources(
                 cpu=AllocatedCpuResources(cpu_shares=int(r.cpu)),
-                memory=AllocatedMemoryResources(memory_mb=int(r.memory_mb)),
+                memory=AllocatedMemoryResources(
+                    memory_mb=int(r.memory_mb),
+                    memory_max_mb=(int(r.memory_max_mb)
+                                   if oversub else 0),
+                ),
             )
             # task-level legacy networks (rank.go:363-410)
             if r.networks:

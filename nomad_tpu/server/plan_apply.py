@@ -344,6 +344,20 @@ class Planner:
         node = snapshot.node_by_id(node_id)
         if node is None:
             return False, "node does not exist"
+        if node.status == consts.NODE_STATUS_DISCONNECTED:
+            # disconnect handling (plan_apply.go): a plan may touch a
+            # disconnected node ONLY to mark its allocs unknown
+            if all(a.client_status == consts.ALLOC_CLIENT_UNKNOWN
+                   for a in placements):
+                return True, ""
+            return False, "node is disconnected and contains invalid updates"
+        if node.status == consts.NODE_STATUS_DOWN:
+            # a down node accepts only lost/unknown transitions
+            if all(a.client_status in (consts.ALLOC_CLIENT_LOST,
+                                       consts.ALLOC_CLIENT_UNKNOWN)
+                   for a in placements):
+                return True, ""
+            return False, "node is down"
         if node.status != consts.NODE_STATUS_READY:
             return False, f"node is {node.status}"
         if node.drain:

@@ -773,16 +773,37 @@ class Server:
         return self.node_update_status(node_id, status)
 
     def _on_heartbeat_expire(self, node_id: str) -> None:
-        """heartbeat.go invalidateHeartbeat: TTL missed => node down."""
-        LOG.info("heartbeat missed for node %s: marking down", node_id)
+        """heartbeat.go invalidateHeartbeat: TTL missed => node down —
+        UNLESS the node is running an alloc whose group grants a
+        reconnect window (max_client_disconnect), in which case the
+        node enters DISCONNECTED (node_endpoint.go disconnect
+        handling): its allocs go 'unknown' and are not replaced until
+        the window lapses, and a reconnecting client resumes them."""
+        has_window = False
+        try:
+            for alloc in self.state.snapshot().allocs_by_node(node_id):
+                if alloc.terminal_status() or alloc.job is None:
+                    continue
+                tg = alloc.job.lookup_task_group(alloc.task_group)
+                if tg is not None and \
+                        getattr(tg, "max_client_disconnect_s", None):
+                    has_window = True
+                    break
+        except Exception:                       # noqa: BLE001
+            pass
+        status = (consts.NODE_STATUS_DISCONNECTED if has_window
+                  else consts.NODE_STATUS_DOWN)
+        LOG.info("heartbeat missed for node %s: marking %s",
+                 node_id, status)
         try:
             index = self.raft_apply(
                 fsm_msgs.NODE_UPDATE_STATUS,
-                {"node_id": node_id, "status": consts.NODE_STATUS_DOWN},
+                {"node_id": node_id, "status": status},
             )
             self._create_node_evals(node_id, index)
-            self.raft_apply(fsm_msgs.SERVICE_REG_DELETE_BY_NODE,
-                            {"node_id": node_id})
+            if status == consts.NODE_STATUS_DOWN:
+                self.raft_apply(fsm_msgs.SERVICE_REG_DELETE_BY_NODE,
+                                {"node_id": node_id})
         except Exception as e:                  # noqa: BLE001
             LOG.warning("failed to invalidate heartbeat for %s: %s", node_id, e)
 
@@ -853,7 +874,15 @@ class Server:
                 # (vault.go RevokeTokens via the FSM alloc-update path)
                 self.vault.revoke_for_alloc(a.id)
             failed = a.client_status == consts.ALLOC_CLIENT_FAILED
-            if not failed:
+            # a client reporting RUNNING over a server-side UNKNOWN is a
+            # reconnect: the reconciler must pick between this alloc and
+            # any replacement it scheduled (node_endpoint.go UpdateAlloc
+            # creates an eval for reconnected allocs)
+            reconnected = (
+                existing.client_status == consts.ALLOC_CLIENT_UNKNOWN
+                and a.client_status == consts.ALLOC_CLIENT_RUNNING
+            )
+            if not failed and not reconnected:
                 continue
             key = (existing.namespace, existing.job_id)
             if key in seen:
@@ -864,7 +893,9 @@ class Server:
                     namespace=existing.namespace,
                     priority=existing.job.priority,
                     type=existing.job.type,
-                    triggered_by=consts.EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+                    triggered_by=(consts.EVAL_TRIGGER_RECONNECT
+                                  if reconnected else
+                                  consts.EVAL_TRIGGER_RETRY_FAILED_ALLOC),
                     job_id=existing.job_id,
                     status=consts.EVAL_STATUS_PENDING,
                 )
