@@ -1099,6 +1099,38 @@ def main() -> None:
         e2e_kernel_requests=e2e["kernel_requests"],
     )
 
+    # stage decomposition of the live path (the ISSUE-1 telemetry
+    # subsystem): where the per-eval milliseconds actually go. This is
+    # the artifact that decides whether the TPU live-path gap is
+    # transfer, dispatch, recompilation, or plan-apply serialization.
+    if budget.remaining() > 90:
+        try:
+            _phase("trace decomposition")
+            sys.path.insert(0, os.path.join(REPO, "bench"))
+            import trace_report
+
+            decomp = trace_report.run_traced_burst(
+                deadline_s=min(budget.share(0.2), 240.0), bursts=2)
+            out_path = os.path.join(REPO, "TRACE_DECOMP.json")
+            with open(out_path, "w") as f:
+                json.dump(decomp, f, indent=2)
+                f.write("\n")
+            top = list(decomp["stages"].items())[:3]
+            em.update(
+                trace_attributed_share=decomp["attributed_share"],
+                trace_per_eval_ms=decomp["per_eval_ms"],
+                trace_top_stages={k: v["per_eval_ms"] for k, v in top},
+                trace_jit_cache_misses=decomp["kernel"]["JitCacheMisses"],
+            )
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: trace decomposition failed ({e})",
+                  file=sys.stderr)
+    else:
+        print("bench budget: skipping trace decomposition "
+              f"({budget.remaining():.0f}s left)", file=sys.stderr)
+
     replay = None
     if planes is not None and budget.remaining() <= 60:
         print("bench budget: skipping C2M replay headline "

@@ -1,0 +1,313 @@
+#!/usr/bin/env python3
+"""Traced live-path burst -> TRACE_DECOMP.json stage decomposition.
+
+BENCH_r05's central unexplained fact: the live server places at ~13
+evals/s on the TPU backend vs 355 evals/s on the CPU fallback. Nothing
+in the repo could say where the ~77ms/eval goes. This report runs the
+SAME live path as bench.py's e2e phase (jobs -> broker -> batched
+worker -> coalesced kernel waves -> plan applier -> FSM) with the
+telemetry subsystem on, and emits the decomposition that makes the gap
+a measurement instead of a mystery: per-eval milliseconds attributed
+to dequeue / snapshot / host scheduling / wave assembly / h2d /
+compile / dispatch / execute / d2h / plan apply / fsm, plus jit
+cache-miss accounting per bucket shape.
+
+Attribution method (concurrency-aware, see telemetry/trace.py):
+
+- Host stages (scheduling, assembly, plan evaluate/commit, fsm) are
+  summed by per-thread CPU time — under the GIL, B concurrent eval
+  threads each see ~the whole phase as wall time, but their CPU times
+  sum to the work actually executed.
+- Device-blocking stages (h2d, compile, dispatch, execute, d2h) are
+  summed by wall time on the one thread that fires each wave — that IS
+  their critical-path cost.
+- Pure waits that overlap other attributed work (a member parked at
+  the wave rendezvous, a worker blocked on the applier) are reported
+  under "overlapped" and never summed into the attribution.
+
+Coverage = attributed seconds / burst wall seconds. Pipelining can
+push it past 1.0 (overlapped device + host work is the point of the
+pipeline); far below 1.0 means un-instrumented time — the report
+prints it either way rather than pretending.
+
+Usage:
+    python bench/trace_report.py [out.json]
+    (or from bench.py's trace phase / tests via run_traced_burst)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: span name -> (stage name, clock) for attributed stages.
+#: wave.launch counts by WALL (its children — assemble/h2d/compile/
+#: execute/d2h — subtract as wall children): XLA compiles burn C++ CPU
+#: on the firing thread, so a CPU accounting would double-count the
+#: compile stage.
+_ATTRIBUTED = {
+    "bench.submit": ("submit", "cpu"),
+    "bench.monitor": ("monitor", "cpu"),
+    "broker.dequeue": ("dequeue", "wall"),
+    "worker.snapshot": ("snapshot", "wall"),
+    "worker.batch": ("worker-fanout", "cpu"),
+    "eval.schedule": ("sched-host", "cpu"),
+    "wave.assemble": ("wave-assembly", "cpu"),
+    "wave.launch": ("wave-other", "wall"),
+    "kernel.h2d": ("h2d", "wall"),
+    "kernel.compile": ("compile", "wall"),
+    "kernel.dispatch": ("dispatch", "wall"),
+    "kernel.execute": ("execute", "wall"),
+    "kernel.d2h": ("d2h", "wall"),
+    "plan.evaluate": ("plan-apply", "cpu"),
+    "plan.commit": ("plan-apply", "cpu"),
+    "fsm.apply": ("fsm", "cpu"),
+}
+
+#: waits that overlap attributed work; reported, never summed
+_OVERLAPPED = {
+    "plan.wait": "plan-submit",
+    "plan.queue_wait": "plan-queue-wait",
+    "wave.park": "wave-park",
+    "broker.wait": "dequeue-wait",
+}
+
+
+def decompose(stage_totals: Dict, wall_s: float, n_evals: int,
+              profiler_summary: Optional[Dict] = None) -> Dict:
+    """Fold tracer aggregates into the TRACE_DECOMP stage table."""
+    stages: Dict[str, Dict] = {}
+    for span_name, agg in stage_totals.items():
+        target = _ATTRIBUTED.get(span_name)
+        if target is None:
+            continue
+        stage, clock = target
+        secs = (agg["exclusive_cpu_s"] if clock == "cpu"
+                else agg["exclusive_s"])
+        row = stages.setdefault(
+            stage, {"total_s": 0.0, "count": 0, "clock": clock})
+        row["total_s"] += secs
+        row["count"] += agg["count"]
+    attributed_s = sum(r["total_s"] for r in stages.values())
+    for row in stages.values():
+        row["per_eval_ms"] = round(row["total_s"] * 1e3 / max(n_evals, 1), 4)
+        row["share_of_wall"] = round(row["total_s"] / wall_s, 4) \
+            if wall_s > 0 else 0.0
+        row["total_s"] = round(row["total_s"], 6)
+
+    overlapped = {}
+    for span_name, label in _OVERLAPPED.items():
+        agg = stage_totals.get(span_name)
+        if agg is None:
+            continue
+        overlapped[label] = {
+            "total_s": round(agg["total_s"], 6),
+            "count": agg["count"],
+            "per_eval_ms": round(agg["total_s"] * 1e3 / max(n_evals, 1), 4),
+        }
+
+    out = {
+        "wall_s": round(wall_s, 4),
+        "n_evals": n_evals,
+        "evals_per_sec": round(n_evals / wall_s, 2) if wall_s > 0 else 0.0,
+        "per_eval_ms": round(wall_s * 1e3 / max(n_evals, 1), 4),
+        "attributed_s": round(attributed_s, 6),
+        "attributed_share": round(attributed_s / wall_s, 4)
+        if wall_s > 0 else 0.0,
+        "stages": dict(sorted(stages.items(),
+                              key=lambda kv: -kv[1]["total_s"])),
+        "overlapped": overlapped,
+    }
+    if profiler_summary is not None:
+        out["kernel"] = profiler_summary
+    return out
+
+
+def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
+                     allocs_per_job: int = 10, batch_size: int = 32,
+                     warmup_jobs: int = 20,
+                     deadline_s: float = 300.0,
+                     bursts: int = 1) -> Dict:
+    """The bench e2e shape with telemetry on; returns the decomposition.
+
+    Warmup compiles the wave buckets OUTSIDE the traced window (the
+    steady state is what the metric is defined on — bench.py's e2e
+    phase makes the same choice), then telemetry is reset so the
+    decomposition covers exactly the timed burst.
+
+    ``bursts > 1`` re-runs the traced burst (telemetry reset between)
+    and reports the LAST one: burst 1 often still compiles tail-wave
+    bucket variants warmup never hits (its decomposition says so —
+    honestly — but the steady state is the number the TPU/CPU gap
+    question is about). Each burst's decomposition is kept under
+    ``all_bursts`` so the compile-transient story stays visible.
+    """
+    import jax
+
+    from nomad_tpu import mock, telemetry
+    from nomad_tpu.server.server import Server, ServerConfig
+    from nomad_tpu.telemetry.kernel_profile import profiler
+    from nomad_tpu.telemetry.trace import tracer
+
+    server = Server(ServerConfig(
+        num_workers=1,
+        worker_batch_size=batch_size,
+        heartbeat_ttl=3600.0,
+    ))
+    server.start()
+    was_enabled = telemetry.enabled()
+    try:
+        for _ in range(n_nodes):
+            server.node_register(mock.node())
+
+        def submit(count: int):
+            jobs = []
+            with tracer.span("bench.submit"):
+                for _ in range(count):
+                    job = mock.simple_job()
+                    job.task_groups[0].count = allocs_per_job
+                    jobs.append(job)
+                    server.job_register(job)
+            return jobs
+
+        def wait_placed(jobs, deadline: float, done0: int = 0):
+            """(placed, t_done): t_done is stamped the instant the
+            check succeeded, so the monitor's poll sleep never inflates
+            the burst wall it decomposes.
+
+            Polls cheap worker counters, NOT state.snapshot(): a full
+            state copy every tick is O(allocs) of GIL the system under
+            test doesn't owe the monitor (bench.py run_e2e makes the
+            same choice) — and here it would surface as un-attributed
+            main-thread CPU poisoning the decomposition's coverage.
+            The snapshots that DO run are spanned as bench.monitor.
+
+            ``done0`` MUST be read before the jobs are submitted: the
+            worker schedules concurrently with submission, so a count
+            taken afterwards already contains burst evals and the
+            trigger would never reach its target.
+            """
+            want = len(jobs) * allocs_per_job
+            placed = 0
+            t_done = time.perf_counter()
+            target = len(jobs)
+            while time.time() < deadline:
+                if sum(w.processed for w in server.workers) - done0 \
+                        >= target:
+                    with tracer.span("bench.monitor"):
+                        snap = server.state.snapshot()
+                        placed = sum(
+                            len(snap.allocs_by_job(j.namespace, j.id))
+                            for j in jobs)
+                    t_done = time.perf_counter()
+                    if placed >= want:
+                        break
+                    target += max(1, (want - placed) // allocs_per_job)
+                time.sleep(0.005)
+            if placed < want:
+                # deadline exit: the counter trigger is a hint, not the
+                # verdict — take the authoritative count before reporting
+                with tracer.span("bench.monitor"):
+                    snap = server.state.snapshot()
+                    placed = sum(
+                        len(snap.allocs_by_job(j.namespace, j.id))
+                        for j in jobs)
+                t_done = time.perf_counter()
+            return placed, t_done
+
+        done0 = sum(w.processed for w in server.workers)
+        warm = submit(warmup_jobs)
+        wait_placed(warm, time.time() + min(deadline_s * 0.5, 120.0),
+                    done0=done0)
+
+        telemetry.enable()
+        history = []
+        for _ in range(max(bursts, 1)):
+            telemetry.reset()
+            done0 = sum(w.processed for w in server.workers)
+            cpu0 = time.process_time()
+            t0 = time.perf_counter()
+            jobs = submit(n_jobs)
+            placed, t_done = wait_placed(jobs, time.time() + deadline_s,
+                                         done0=done0)
+            wall = t_done - t0
+            process_cpu = time.process_time() - cpu0
+            decomp = decompose(tracer.stage_totals(), wall, n_jobs,
+                               profiler_summary=profiler.summary())
+            # steal-invariant companion: attributed work over the CPU
+            # this process actually got. On a contended host (CI
+            # neighbors, a parent test suite's leaked threads) wall
+            # stretches with time the system never had — the wall
+            # share honestly drops, while this ratio stays a property
+            # of the system itself.
+            decomp["process_cpu_s"] = round(process_cpu, 4)
+            decomp["attributed_share_busy"] = round(
+                decomp["attributed_s"] / process_cpu, 4) \
+                if process_cpu > 0 else 0.0
+            decomp["backend"] = jax.default_backend()
+            decomp["n_nodes"] = n_nodes
+            decomp["allocs_placed"] = placed
+            decomp["allocs_wanted"] = n_jobs * allocs_per_job
+            decomp["batch_size"] = batch_size
+            history.append(decomp)
+        decomp = history[-1]
+        if len(history) > 1:
+            decomp["all_bursts"] = [
+                {"evals_per_sec": h["evals_per_sec"],
+                 "per_eval_ms": h["per_eval_ms"],
+                 "attributed_share": h["attributed_share"],
+                 "attributed_share_busy": h["attributed_share_busy"],
+                 "compile_s": h["stages"].get("compile", {})
+                 .get("total_s", 0.0),
+                 "jit_cache_misses": h["kernel"]["JitCacheMisses"]}
+                for h in history
+            ]
+        return decomp
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+        server.shutdown()
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out", nargs="?",
+                    default=os.path.join(REPO, "TRACE_DECOMP.json"))
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--jobs", type=int, default=100)
+    ap.add_argument("--allocs-per-job", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--warmup-jobs", type=int, default=20)
+    ap.add_argument("--bursts", type=int, default=2)
+    args = ap.parse_args()
+    out_path = args.out
+    decomp = run_traced_burst(
+        n_nodes=args.nodes, n_jobs=args.jobs,
+        allocs_per_job=args.allocs_per_job, batch_size=args.batch,
+        warmup_jobs=args.warmup_jobs, bursts=args.bursts)
+    with open(out_path, "w") as f:
+        json.dump(decomp, f, indent=2)
+        f.write("\n")
+    top = list(decomp["stages"].items())[:4]
+    print(json.dumps({
+        "metric": "trace_decomposition",
+        "out": out_path,
+        "evals_per_sec": decomp["evals_per_sec"],
+        "per_eval_ms": decomp["per_eval_ms"],
+        "attributed_share": decomp["attributed_share"],
+        "top_stages": {k: v["per_eval_ms"] for k, v in top},
+        "jit_cache_misses": decomp["kernel"]["JitCacheMisses"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

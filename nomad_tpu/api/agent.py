@@ -8,11 +8,54 @@ merged config, then NewHTTPServers (http.go:86) exposes /v1.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 LOG = logging.getLogger(__name__)
+
+
+class SerialEventWorker:
+    """One ordered worker for gossip-event side effects.
+
+    Membership events MUST apply in arrival order: a thread-per-event
+    dispatch let a MEMBER_FAILED land after the MEMBER_ALIVE that
+    refuted it (the OS scheduler decided raft membership during
+    failure flaps). Events enqueue without blocking the gossip rx /
+    prober threads — which is the property the thread-per-event design
+    existed for (raft applies can stall up to 10s on an impaired
+    quorum) — and one daemon thread drains them in FIFO order.
+    """
+
+    def __init__(self, handler: Callable[[str, Dict], None],
+                 name: str = "membership-reconcile") -> None:
+        self._handler = handler
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=name)
+        self._thread.start()
+
+    def submit(self, kind: str, member: Dict) -> None:
+        self._q.put((kind, member))
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        self._q.put(None)            # wake the drain loop
+        self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None or self._stop.is_set():
+                return
+            kind, member = item
+            try:
+                self._handler(kind, member)
+            except Exception:                    # noqa: BLE001
+                LOG.exception("membership event handler failed (%s %s)",
+                              kind, member.get("Name"))
 
 
 @dataclass
@@ -61,6 +104,10 @@ class AgentConfig:
     #: probe cadence; tests shrink these for fast convergence
     serf_probe_interval: float = 1.0
     serf_suspect_timeout: float = 3.0
+    # shared gossip key (agent `encrypt` config, serf keyring analog):
+    # when set, membership datagrams are HMAC-authenticated and
+    # unsigned/mismatched packets are rejected
+    encrypt: str = ""
     # real Vault server (agent config vault stanza; empty = dev
     # in-memory provider)
     vault_addr: str = ""
@@ -274,6 +321,7 @@ class Agent:
             region=self.config.region,
             probe_interval=self.config.serf_probe_interval,
             suspect_timeout=self.config.serf_suspect_timeout,
+            encrypt=self.config.encrypt,
         )
 
         def reconcile(kind: str, member: dict) -> None:
@@ -318,15 +366,13 @@ class Agent:
                 LOG.warning("membership raft reconcile (%s %s): %s",
                             kind, member.get("Name"), e)
 
-        def on_event(kind: str, member: dict) -> None:
-            # raft applies block up to 10s on an impaired quorum --
-            # exactly when failure events fire. Never stall the gossip
-            # rx/prober threads on them.
-            threading.Thread(target=reconcile, args=(kind, member),
-                             daemon=True,
-                             name="membership-reconcile").start()
-
-        self._serf.on_event(on_event)
+        # ONE ordered worker: raft applies may block up to 10s on an
+        # impaired quorum — exactly when failure events fire — so the
+        # gossip rx/prober threads never run reconciles inline; but a
+        # thread PER event let MEMBER_FAILED/MEMBER_ALIVE flap pairs
+        # race each other, and the loser decided the raft voter set
+        self._reconcile_worker = SerialEventWorker(reconcile)
+        self._serf.on_event(self._reconcile_worker.submit)
         self._serf.start()
         if self.config.server_join:
             targets = expand_join_addrs(self.config.server_join)
@@ -348,6 +394,9 @@ class Agent:
         serf = getattr(self, "_serf", None)
         if serf is not None:
             serf.shutdown(leave=True)
+        worker = getattr(self, "_reconcile_worker", None)
+        if worker is not None:
+            worker.shutdown()
         if self.client is not None:
             self.client.shutdown()
         if self.server is not None:

@@ -88,6 +88,10 @@ def _apply_body(cfg, body: Body):
         cfg.datacenter = str(a["datacenter"])
     if "bind_addr" in a:
         cfg.bind_addr = str(a["bind_addr"])
+    # gossip authentication key (reference agent config `encrypt`,
+    # a top-level attribute)
+    if "encrypt" in a:
+        cfg.encrypt = str(a["encrypt"])
 
     ports = body.first_block("ports")
     if ports is not None and "http" in ports[1].attrs:

@@ -337,6 +337,13 @@ class HTTPAgent:
         try:
             payload = json.loads(raw) if raw else None
         except json.JSONDecodeError:
+            # non-JSON upstream body (e.g. /v1/metrics?format=prometheus
+            # raw text exposition): relay it verbatim with the remote's
+            # content type instead of mangling it into a 502
+            if 200 <= status < 300:
+                self._send_text(
+                    handler, raw.decode("utf-8", "replace"), status=status)
+                return
             status, payload = 502, {"error": "bad upstream response"}
         self._send(handler, status, payload, index=remote_index)
 
@@ -528,6 +535,19 @@ class HTTPAgent:
         except (BrokenPipeError, ConnectionResetError):
             pass
 
+    def _send_text(self, handler, body: str, status: int = 200,
+                   content_type: str = "text/plain; version=0.0.4") -> None:
+        """Raw text response (Prometheus exposition is not JSON)."""
+        try:
+            data = body.encode()
+            handler.send_response(status)
+            handler.send_header("Content-Type", content_type)
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     def _block(self, req: Request, tables: List[str]) -> None:
         """Blocking query: wait until any listed table passes ?index."""
         min_index, timeout = req.wait_params()
@@ -651,6 +671,9 @@ class HTTPAgent:
         add("GET", r"/v1/agent/pprof/heap", self.pprof_heap)
         add("GET", r"/v1/agent/servers", self.agent_servers)
         add("GET", r"/v1/metrics", self.metrics)
+        add("GET", r"/v1/operator/traces", self.operator_traces)
+        add("PUT", r"/v1/operator/traces", self.operator_traces_put)
+        add("POST", r"/v1/operator/traces", self.operator_traces_put)
         add("GET", r"/v1/operator/scheduler/configuration", self.sched_config_get)
         add("PUT", r"/v1/operator/scheduler/configuration", self.sched_config_put)
         add("POST", r"/v1/operator/scheduler/configuration", self.sched_config_put)
@@ -1340,12 +1363,45 @@ class HTTPAgent:
         return [self.addr]
 
     def metrics(self, req: Request):
+        from nomad_tpu.telemetry import exporter
         from nomad_tpu.utils import metrics as m
 
         if req.q("format") == "prometheus":
-            body = m.global_registry.prometheus_text()
-            return body
+            # real text exposition (text/plain), not a JSON-quoted
+            # string: Prometheus scrapers parse the raw body
+            self._send_text(req.handler,
+                            exporter.prometheus_text(m.global_registry))
+            return StreamedResponse
         return m.global_registry.summary()
+
+    def operator_traces(self, req: Request):
+        """Operator trace dump (gated like the event stream: the token
+        must hold a real capability — operator:read — or the request
+        is rejected outright)."""
+        from nomad_tpu.telemetry import exporter
+
+        self._acl(req, "allow_operator_read")
+        try:
+            limit = int(req.q("limit", "2000") or 2000)
+        except ValueError:
+            limit = 2000
+        return exporter.traces_json(limit=limit)
+
+    def operator_traces_put(self, req: Request):
+        """Toggle tracing at runtime: {"Enable": true|false}, optional
+        {"Reset": true} to clear collected spans first."""
+        from nomad_tpu import telemetry
+
+        self._acl(req, "allow_operator_write")
+        body = req.body if isinstance(req.body, dict) else {}
+        if body.get("Reset"):
+            telemetry.reset()
+        if "Enable" in body:
+            if body["Enable"]:
+                telemetry.enable()
+            else:
+                telemetry.disable()
+        return {"Enabled": telemetry.enabled()}
 
     def sched_config_get(self, req: Request):
         cfg = self._server.state.scheduler_config

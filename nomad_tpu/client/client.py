@@ -26,6 +26,8 @@ from nomad_tpu.client.alloc_runner import AllocRunner
 from nomad_tpu.client.fingerprint import fingerprint_node
 from nomad_tpu.client.state_db import MemStateDB, StateDB
 from nomad_tpu.structs import consts
+from nomad_tpu.telemetry.trace import tracer
+from nomad_tpu.utils.metrics import global_registry
 from nomad_tpu.structs.alloc import Allocation
 
 LOG = logging.getLogger(__name__)
@@ -344,8 +346,18 @@ class Client:
                 continue
             try:
                 away = time.time() - self.last_heartbeat_ok
-                resp = self.rpc.update_status(
-                    self.node_id, consts.NODE_STATUS_READY
+                # heartbeat round-trip telemetry (client.go emits
+                # client.heartbeat latency the same way): a server whose
+                # applier or GIL is saturated shows up HERE first, as
+                # heartbeat latency creeping toward the TTL
+                t_hb = time.perf_counter()
+                with tracer.span("client.heartbeat", trace_id=self.node_id):
+                    resp = self.rpc.update_status(
+                        self.node_id, consts.NODE_STATUS_READY
+                    )
+                global_registry.add_sample(
+                    "nomad.client.heartbeat",
+                    (time.perf_counter() - t_hb) * 1000.0,
                 )
                 self.heartbeat_ttl = resp.get("heartbeat_ttl", self.heartbeat_ttl) or self.heartbeat_ttl
                 self.last_heartbeat_ok = time.time()

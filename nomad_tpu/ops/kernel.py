@@ -893,12 +893,25 @@ def default_kernel_launch(kin: KernelIn, k_steps: int,
                           features: KernelFeatures) -> KernelOut:
     """The stack's direct (non-coalesced) dispatch: candidate-set fast
     path when its preconditions hold, full-width kernel otherwise or on
-    a bound breach."""
+    a bound breach.
+
+    Profiled like coalesced waves (telemetry/kernel_profile.py): the
+    single-eval path compiles its own (node-pad, step-bucket, features)
+    variants, and an un-instrumented fallback here would let recompiles
+    hide outside the wave accounting."""
+    from nomad_tpu.telemetry.kernel_profile import profiler
+
+    n_pad = int(np.asarray(kin.cap_cpu).shape[0])
+    key = (n_pad, k_steps, features)
     if features.n_spreads == 0 and not bool(kin.algorithm_spread):
-        out, ok = place_taskgroup_topk_jit(kin, k_steps, features)
+        out, ok = profiler.call(
+            "single_topk", place_taskgroup_topk_jit, (kin,),
+            (k_steps, features), key, jit_fn=place_taskgroup_topk_jit)
         if bool(ok):
             return out
-    return place_taskgroup_jit(kin, k_steps, features)
+    return profiler.call(
+        "single_full", place_taskgroup_jit, (kin,),
+        (k_steps, features), key, jit_fn=place_taskgroup_jit)
 
 
 class JointOut(NamedTuple):

@@ -35,6 +35,8 @@ from nomad_tpu.ops.kernel import (
     pad_steps,
     place_taskgroups_joint_jit,
 )
+from nomad_tpu.telemetry.kernel_profile import profiler
+from nomad_tpu.telemetry.trace import tracer
 
 #: B is bucketed to limit recompiles. Coarse on purpose: every
 #: (wave bucket, step bucket, features) combination is a separate XLA
@@ -155,75 +157,89 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
     """
     if mesh is _USE_GLOBAL:
         mesh = _WAVE_MESH
-    k_max = max(k_steps)
-    feats = union_features(features)
-    padded = [_pad_kin_steps(kin, k_max) for kin in kins]
-    b_pad = pad_wave(len(padded))
-    if b_pad > len(padded):
-        # inert filler rows: first member with zero active steps
-        filler = padded[0]._replace(n_steps=np.asarray(0, np.int32))
-        padded = padded + [filler] * (b_pad - len(padded))
-    # stack on HOST (numpy): the jit call below uploads each stacked
-    # leaf once; stacking device arrays would dispatch per leaf per
-    # member — thousands of round trips on a remote-device transport.
-    # The big node planes (cluster capacity + the wave snapshot's
-    # utilization) are usually IDENTICAL across members; when every one
-    # of _SHAREABLE_FIELDS is identity-shared, they ship UNBATCHED (the
-    # joint kernel broadcasts on device) so wave upload bytes stay flat
-    # in wave size instead of B-fold. Exactly TWO layouts exist —
-    # all-shared or all-stacked — so each (bucket, features) pair costs
-    # at most two XLA variants, not one per sharing pattern.
-    def _group_shared(fields) -> bool:
-        return mesh is None and all(
-            all(getattr(k, f) is getattr(padded[0], f) for k in padded[1:])
-            for f in fields
-        )
+    with tracer.span("wave.assemble"):
+        k_max = max(k_steps)
+        feats = union_features(features)
+        padded = [_pad_kin_steps(kin, k_max) for kin in kins]
+        b_pad = pad_wave(len(padded))
+        if b_pad > len(padded):
+            # inert filler rows: first member with zero active steps
+            filler = padded[0]._replace(n_steps=np.asarray(0, np.int32))
+            padded = padded + [filler] * (b_pad - len(padded))
+        # stack on HOST (numpy): the jit call below uploads each stacked
+        # leaf once; stacking device arrays would dispatch per leaf per
+        # member — thousands of round trips on a remote-device
+        # transport. The big node planes (cluster capacity + the wave
+        # snapshot's utilization) are usually IDENTICAL across members;
+        # when every one of _SHAREABLE_FIELDS is identity-shared, they
+        # ship UNBATCHED (the joint kernel broadcasts on device) so wave
+        # upload bytes stay flat in wave size instead of B-fold. Exactly
+        # TWO layouts exist — all-shared or all-stacked — so each
+        # (bucket, features) pair costs at most two XLA variants, not
+        # one per sharing pattern.
+        def _group_shared(fields) -> bool:
+            return mesh is None and all(
+                all(getattr(k, f) is getattr(padded[0], f)
+                    for k in padded[1:])
+                for f in fields
+            )
 
-    shareable = _group_shared(_SHAREABLE_FIELDS)
-    neutral_shareable = _group_shared(_NEUTRAL_SHAREABLE_FIELDS)
+        shareable = _group_shared(_SHAREABLE_FIELDS)
+        neutral_shareable = _group_shared(_NEUTRAL_SHAREABLE_FIELDS)
 
-    def _stack_field(f, xs):
-        if (shareable and f in _SHAREABLE_FIELDS) or (
-                neutral_shareable and f in _NEUTRAL_SHAREABLE_FIELDS):
-            return np.asarray(xs[0])
-        return np.stack([np.asarray(x) for x in xs])
+        def _stack_field(f, xs):
+            if (shareable and f in _SHAREABLE_FIELDS) or (
+                    neutral_shareable and f in _NEUTRAL_SHAREABLE_FIELDS):
+                return np.asarray(xs[0])
+            return np.stack([np.asarray(x) for x in xs])
 
-    stacked = KernelIn(*[
-        _stack_field(f, [getattr(k, f) for k in padded])
-        for f in KernelIn._fields
-    ])
+        stacked = KernelIn(*[
+            _stack_field(f, [getattr(k, f) for k in padded])
+            for f in KernelIn._fields
+        ])
 
-    # step layout: member 0's steps, then member 1's, ... (the applier's
-    # serialization order = plan arrival order). The step axis is sized
-    # from the PADDED wave (b_pad * k_max) so the compiled shape depends
-    # only on (wave bucket, step bucket, features) — retry waves of any
-    # real size reuse it; inert steps are microseconds of device time
-    t_pad = pad_steps(b_pad * k_max)
-    step_member = np.full(t_pad, -1, np.int32)
-    step_local = np.zeros(t_pad, np.int32)
-    offsets = []
-    pos = 0
-    for i, k in enumerate(k_steps):
-        offsets.append(pos)
-        step_member[pos:pos + k] = i
-        step_local[pos:pos + k] = np.arange(k)
-        pos += k
+        # step layout: member 0's steps, then member 1's, ... (the
+        # applier's serialization order = plan arrival order). The step
+        # axis is sized from the PADDED wave (b_pad * k_max) so the
+        # compiled shape depends only on (wave bucket, step bucket,
+        # features) — retry waves of any real size reuse it; inert
+        # steps are microseconds of device time
+        t_pad = pad_steps(b_pad * k_max)
+        step_member = np.full(t_pad, -1, np.int32)
+        step_local = np.zeros(t_pad, np.int32)
+        offsets = []
+        pos = 0
+        for i, k in enumerate(k_steps):
+            offsets.append(pos)
+            step_member[pos:pos + k] = i
+            step_local[pos:pos + k] = np.arange(k)
+            pos += k
 
+    # the jit-cache identity the bucketing scheme promises: a repeat of
+    # this key must NOT recompile (the profiler counts violations)
+    n_nodes = int(np.asarray(stacked.cap_cpu).shape[-1])
+    wave_key = (b_pad, t_pad, n_nodes, shareable, neutral_shareable, feats)
     if mesh is not None:
         from nomad_tpu.parallel.sharded import make_joint_sharded
 
         global sharded_wave_launches
         sharded_wave_launches += 1
-        out = make_joint_sharded(mesh)(
-            stacked, jnp.asarray(step_member), jnp.asarray(step_local),
-            t_pad, feats,
+        fn = make_joint_sharded(mesh)
+        out = profiler.call(
+            "joint_sharded", fn,
+            (stacked, jnp.asarray(step_member), jnp.asarray(step_local)),
+            (t_pad, feats),
+            wave_key + (tuple(mesh.devices.flat),), jit_fn=fn,
         )
     else:
-        out = place_taskgroups_joint_jit(
-            stacked, jnp.asarray(step_member), jnp.asarray(step_local),
-            t_pad, feats,
+        out = profiler.call(
+            "joint", place_taskgroups_joint_jit,
+            (stacked, jnp.asarray(step_member), jnp.asarray(step_local)),
+            (t_pad, feats),
+            wave_key, jit_fn=place_taskgroups_joint_jit,
         )
-    host = jax.tree_util.tree_map(np.asarray, out)
+    with tracer.span("kernel.d2h"):
+        host = jax.tree_util.tree_map(np.asarray, out)
     results = []
     for i, k in enumerate(k_steps):
         o = offsets[i]
@@ -291,7 +307,12 @@ class LaunchCoalescer:
         if wave is not None:
             self._fire(wave)
         else:
-            req.event.wait()
+            # parked: another member completes the rendezvous and runs
+            # the device call. Park time OVERLAPS the firing member's
+            # wave stages — the decomposition reports it separately and
+            # must not sum it with them
+            with tracer.span("wave.park"):
+                req.event.wait()
         if req.error is not None:
             raise req.error
         return req.out
@@ -317,12 +338,13 @@ class LaunchCoalescer:
             self.launches += 1
             self.max_wave = max(self.max_wave, len(grp))
             try:
-                outs = launch_wave(
-                    [r.kin for r in grp],
-                    [r.k_steps for r in grp],
-                    [r.features for r in grp],
-                    mesh=self.mesh,
-                )
+                with tracer.span("wave.launch"):
+                    outs = launch_wave(
+                        [r.kin for r in grp],
+                        [r.k_steps for r in grp],
+                        [r.features for r in grp],
+                        mesh=self.mesh,
+                    )
                 for r, out in zip(grp, outs):
                     r.out = out
             except BaseException as e:              # noqa: BLE001

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.eval_plan import Evaluation, generate_uuid
+from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.utils.delayheap import DelayHeap
 
 # Queue that unackable evals land on after the delivery limit
@@ -215,15 +216,26 @@ class EvalBroker:
     ) -> List[Tuple[Evaluation, str]]:
         """Dequeue up to ``batch`` evals: one blocking dequeue then a
         non-blocking drain. Batched-kernel feed path."""
+        t0 = time.monotonic() if tracer.enabled else 0.0
         first, token = self.dequeue(schedulers, timeout)
         if first is None:
             return []
+        t1 = time.monotonic() if t0 else 0.0
         out = [(first, token)]
         while len(out) < batch:
             ev, tok = self.dequeue(schedulers, timeout=0)
             if ev is None:
                 break
             out.append((ev, tok))
+        if t0:
+            # two spans, recorded only when work was handed out: the
+            # blocking wait for the first eval (idle/backpressure —
+            # overlaps producers, so the decomposition reports it
+            # without attributing it) and the drain that actually
+            # hands the batch out
+            tracer.record("broker.wait", t1 - t0, trace_id=first.id)
+            tracer.record("broker.dequeue", time.monotonic() - t1,
+                          trace_id=first.id)
         return out
 
     def _dequeue_locked(self, schedulers: List[str]) -> Optional[Evaluation]:

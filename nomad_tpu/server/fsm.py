@@ -76,12 +76,15 @@ class NomadFSM:
         self._lock = threading.Lock()
 
     def apply(self, msg_type: str, req: Dict) -> int:
+        from nomad_tpu.telemetry.trace import tracer
+
         handler = self._DISPATCH.get(msg_type)
         if handler is None:
             raise ValueError(f"unknown FSM message type {msg_type}")
-        with self._lock:
-            index = handler(self, req)
-        self._publish_events(msg_type, req, index)
+        with tracer.span("fsm.apply"):
+            with self._lock:
+                index = handler(self, req)
+            self._publish_events(msg_type, req, index)
         return index
 
     def _publish_events(self, msg_type: str, req: Dict, index: int) -> None:

@@ -31,6 +31,8 @@ the peer set without operator action.
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
 import json
 import logging
 import random
@@ -40,6 +42,15 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 LOG = logging.getLogger(__name__)
+
+#: wire prefix for authenticated datagrams: 1 version byte + 32-byte
+#: HMAC-SHA256 over the JSON payload (serf's keyring encrypts; this
+#: closes the same forged-member-leave takedown vector with
+#: authentication — membership tables are not secret, but accepting an
+#: unauthenticated "X left" from anyone on the network segment let one
+#: spoofed datagram remove a live server from the raft voter set)
+_HMAC_VERSION = b"\x01"
+_HMAC_LEN = 32
 
 ALIVE = "alive"
 SUSPECT = "suspect"
@@ -90,27 +101,59 @@ class Member:
         }
 
 
+def parse_join_entry(entry: str,
+                     default_port: int = 4648) -> Tuple[str, int]:
+    """Split one join entry into (host, port).
+
+    Handles the three shapes ``host``, ``host:port``, and bracketed
+    IPv6 ``[::1]:4648`` / ``[::1]``. A BARE IPv6 literal (``fe80::1``)
+    is a host with no port — the old ``rpartition(":")`` split turned
+    it into host ``fe80:`` port ``1``.
+    """
+    entry = str(entry).strip()
+    if entry.startswith("["):
+        # bracketed IPv6: [addr] or [addr]:port
+        close = entry.find("]")
+        if close < 0:
+            return entry, default_port
+        host = entry[1:close]
+        rest = entry[close + 1:]
+        if rest.startswith(":") and rest[1:].isdigit():
+            return host, int(rest[1:])
+        return host, default_port
+    if entry.count(":") >= 2:
+        # bare IPv6 literal: every colon belongs to the address
+        return entry, default_port
+    host, _, port_s = entry.rpartition(":")
+    if not host:
+        return entry, default_port
+    try:
+        return host, int(port_s) if port_s else default_port
+    except ValueError:
+        return entry, default_port
+
+
 def expand_join_addrs(entries: List[str],
-                      default_port: int = 4648) -> List[Tuple[str, int]]:
+                      default_port: int = 4648,
+                      family: int = socket.AF_INET) -> List[Tuple[str, int]]:
     """Resolve join entries to concrete (ip, port) targets.
 
-    A hostname expands to EVERY A/AAAA record — join-by-DNS, the
+    A hostname expands to EVERY A record — join-by-DNS, the
     reference's ``retry_join`` cloud auto-join analog
     (command/agent's go-netaddrs + provider=dns usage): pointing a
     DNS name at the server set is enough to bootstrap membership.
+
+    ``family`` defaults to AF_INET because the membership socket is an
+    IPv4 UDP socket: a AAAA record handed to it would EHOSTUNREACH on
+    every probe and read as a permanently-failed member.
     """
     out: List[Tuple[str, int]] = []
     seen = set()
     for entry in entries:
-        host, _, port_s = str(entry).rpartition(":")
-        if not host:
-            host, port_s = port_s, ""
+        host, port = parse_join_entry(entry, default_port)
         try:
-            port = int(port_s) if port_s else default_port
-        except ValueError:
-            host, port = str(entry), default_port
-        try:
-            infos = socket.getaddrinfo(host, port, proto=socket.IPPROTO_UDP)
+            infos = socket.getaddrinfo(host, port, family=family,
+                                       proto=socket.IPPROTO_UDP)
         except OSError as e:
             LOG.warning("membership join: cannot resolve %r: %s", entry, e)
             continue
@@ -136,12 +179,19 @@ class Membership:
         probe_timeout: float = 0.5,
         suspect_timeout: float = 3.0,
         on_event: Optional[Callable[[str, Dict], None]] = None,
+        encrypt: str = "",
     ) -> None:
         self.name = name
         self.region = region
         self.probe_interval = probe_interval
         self.probe_timeout = probe_timeout
         self.suspect_timeout = suspect_timeout
+        # shared-key datagram authentication (agent `encrypt` config,
+        # serf keyring analog): when set, every datagram carries an
+        # HMAC and unsigned/mismatched packets are dropped
+        self._key = encrypt.encode() if encrypt else b""
+        #: datagrams dropped by authentication (tests + operators)
+        self.rx_rejected = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((bind, port))
         self._sock.settimeout(0.2)
@@ -244,7 +294,31 @@ class Membership:
         msg["mem"] = [self._self.to_wire()] + [
             m.to_wire() for m in self._members.values()
         ]
-        return json.dumps(msg, separators=(",", ":")).encode()
+        payload = json.dumps(msg, separators=(",", ":")).encode()
+        if self._key:
+            sig = _hmac.new(self._key, payload, hashlib.sha256).digest()
+            return _HMAC_VERSION + sig + payload
+        return payload
+
+    def _authenticate(self, data: bytes) -> Optional[bytes]:
+        """Strip + verify the HMAC envelope; None = reject.
+
+        With a key configured, BOTH unsigned and mis-signed datagrams
+        are rejected — the forged member-leave takedown (one spoofed
+        UDP packet removing a live server from the raft voter set)
+        requires the cluster key once this is on. Without a key,
+        signed packets are rejected too (json parse would fail anyway):
+        mixed configurations fail loudly instead of half-merging.
+        """
+        if not self._key:
+            return data
+        if len(data) < 1 + _HMAC_LEN or data[:1] != _HMAC_VERSION:
+            return None
+        sig, payload = data[1:1 + _HMAC_LEN], data[1 + _HMAC_LEN:]
+        want = _hmac.new(self._key, payload, hashlib.sha256).digest()
+        if not _hmac.compare_digest(sig, want):
+            return None
+        return payload
 
     def _send(self, payload: bytes, addr: Tuple[str, int]) -> None:
         try:
@@ -262,6 +336,10 @@ class Membership:
                 continue
             except OSError:
                 return
+            data = self._authenticate(data)
+            if data is None:
+                self.rx_rejected += 1
+                continue
             try:
                 msg = json.loads(data.decode())
             except ValueError:

@@ -28,6 +28,7 @@ from nomad_tpu.structs.alloc import Allocation
 from nomad_tpu.structs.eval_plan import Plan, PlanResult
 from nomad_tpu.structs.resources import allocs_fit
 from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
+from nomad_tpu.telemetry.trace import tracer
 
 
 class _PlanOverlay:
@@ -203,20 +204,24 @@ class Planner:
                 continue
             now = time.monotonic()
             for pending in batch:
-                self.stage_s["queue_wait"] += now - pending.enqueued_at
+                wait = now - pending.enqueued_at
+                self.stage_s["queue_wait"] += wait
+                tracer.record("plan.queue_wait", wait,
+                              trace_id=pending.plan.eval_id)
             t_eval = time.perf_counter()
             evaluated: List[Tuple[PendingPlan, PlanResult, int]] = []
             snapshot = _LiveView(self.state, overlay)
-            for pending in batch:
-                try:
-                    result = self.evaluate_plan(snapshot, pending.plan)
-                except Exception as e:        # noqa: BLE001 - worker nacks
-                    pending.respond(None, e)
-                    continue
-                # later plans in this batch (and the next batch's
-                # evaluation) see this plan through the overlay
-                token = overlay.add(result)
-                evaluated.append((pending, result, token))
+            with tracer.span("plan.evaluate"):
+                for pending in batch:
+                    try:
+                        result = self.evaluate_plan(snapshot, pending.plan)
+                    except Exception as e:    # noqa: BLE001 - worker nacks
+                        pending.respond(None, e)
+                        continue
+                    # later plans in this batch (and the next batch's
+                    # evaluation) see this plan through the overlay
+                    token = overlay.add(result)
+                    evaluated.append((pending, result, token))
             self.stage_s["evaluate"] += time.perf_counter() - t_eval
             if not evaluated:
                 continue
@@ -240,8 +245,9 @@ class Planner:
     ) -> None:
         try:
             t0 = time.perf_counter()
-            index = self._commit_batch(
-                [(p.plan, r) for p, r, _ in evaluated])
+            with tracer.span("plan.commit"):
+                index = self._commit_batch(
+                    [(p.plan, r) for p, r, _ in evaluated])
             self.stage_s["commit"] += time.perf_counter() - t0
             for pending, result, token in evaluated:
                 result.alloc_index = index

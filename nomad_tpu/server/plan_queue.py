@@ -16,6 +16,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs.eval_plan import Plan, PlanResult
+from nomad_tpu.utils.metrics import global_registry
 
 
 class PendingPlan:
@@ -60,10 +61,19 @@ class PlanQueue:
                 self._flush_locked()
             self._cond.notify_all()
 
+    def _update_depth_gauge(self) -> None:
+        # nomad.plan.queue_depth (plan_queue.go Stats/EmitStats):
+        # sustained depth means the serialized applier is the
+        # bottleneck. Updated on every transition — a gauge set only
+        # on enqueue would report the last burst's depth forever.
+        global_registry.set_gauge(
+            "nomad.plan.queue_depth", float(len(self._heap)))
+
     def _flush_locked(self) -> None:
         for _, _, pending in self._heap:
             pending.respond(None, RuntimeError("plan queue flushed"))
         self._heap.clear()
+        self._update_depth_gauge()
 
     def enqueue(self, plan: Plan) -> PendingPlan:
         with self._lock:
@@ -73,6 +83,7 @@ class PlanQueue:
             heapq.heappush(
                 self._heap, (-plan.priority, next(self._seq), pending)
             )
+            self._update_depth_gauge()
             self._cond.notify_all()
             return pending
 
@@ -82,7 +93,9 @@ class PlanQueue:
                 self._cond.wait(timeout)
             if not self._heap:
                 return None
-            return heapq.heappop(self._heap)[2]
+            out = heapq.heappop(self._heap)[2]
+            self._update_depth_gauge()
+            return out
 
     def dequeue_batch(self, max_n: int,
                       timeout: Optional[float] = None) -> List[PendingPlan]:
@@ -100,6 +113,8 @@ class PlanQueue:
             out = []
             while self._heap and len(out) < max_n:
                 out.append(heapq.heappop(self._heap)[2])
+            if out:
+                self._update_depth_gauge()
             return out
 
     def stats(self) -> Dict:

@@ -1030,13 +1030,20 @@ class Server:
     def submit_plan(self, plan: Plan) -> PlanResult:
         import time as _time
 
+        from nomad_tpu.telemetry.trace import tracer
+
         t0 = _time.perf_counter()
-        if self.planner.running():
-            pending = self.plan_queue.enqueue(plan)
-            result = pending.wait(timeout=30.0)
-        else:
-            # synchronous mode (tests without the applier thread)
-            result = self.planner.apply_one(plan)
+        # plan.wait overlaps the applier's own evaluate/commit spans
+        # (the worker blocks while the applier thread works); the trace
+        # decomposition attributes the applier side and reports this
+        # wait as overlapped
+        with tracer.span("plan.wait", trace_id=plan.eval_id):
+            if self.planner.running():
+                pending = self.plan_queue.enqueue(plan)
+                result = pending.wait(timeout=30.0)
+            else:
+                # synchronous mode (tests without the applier thread)
+                result = self.planner.apply_one(plan)
         # plan latency observability (BASELINE.md p50/p99 plan latency)
         self.plan_latencies.append(_time.perf_counter() - t0)
         return result

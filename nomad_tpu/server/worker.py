@@ -29,6 +29,7 @@ from typing import List, Optional, Tuple
 from nomad_tpu.scheduler.scheduler import SetStatusError, new_scheduler
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.eval_plan import Evaluation, Plan, PlanResult
+from nomad_tpu.telemetry.trace import tracer
 
 LOG = logging.getLogger(__name__)
 
@@ -165,7 +166,10 @@ class Worker:
             ev, token = batch[0]
             self._process(ev, token)
         else:
-            self._process_batch(batch)
+            # the envelope span: its exclusive CPU is the fan-out cost
+            # (thread spawn/reap) the per-eval spans can't see
+            with tracer.span("worker.batch", trace_id=batch[0][0].id):
+                self._process_batch(batch)
         return True
 
     def _heartbeat_outstanding(self) -> None:
@@ -191,29 +195,31 @@ class Worker:
         with self._live_lock:
             self._live[ev.id] = token
         try:
-            if snapshot is None:
-                # SnapshotMinIndex: local raft must catch up to the eval
-                # before scheduling (worker.go:537)
-                wait_index = max(ev.modify_index, ev.snapshot_index)
-                snapshot = self.server.snapshot_min_index(wait_index)
-            # stamp the snapshot the scheduler runs against on a copy --
-            # the store's row must stay immutable (worker.go
-            # updateEvalSnapshotIndex routes this through Raft); blocked
-            # evals derived from this one inherit the stamp
-            ev = ev.copy()
-            ev.snapshot_index = snapshot.latest_index()
-            run = _EvalRun(self.server, ev, token, snapshot)
-            if ev.type == consts.JOB_TYPE_CORE:
-                sched = self.server.new_core_scheduler(snapshot, run)
-            else:
-                kw = {}
-                if launcher is not None:
-                    kw["kernel_launch"] = launcher
-                if cluster_provider is not None:
-                    kw["cluster_provider"] = cluster_provider
-                sched = new_scheduler(ev.type, snapshot, run, **kw)
-            sched.process(ev)
-            self.server.eval_broker.ack(ev.id, token)
+            with tracer.span("eval.schedule", trace_id=ev.id):
+                if snapshot is None:
+                    # SnapshotMinIndex: local raft must catch up to the
+                    # eval before scheduling (worker.go:537)
+                    wait_index = max(ev.modify_index, ev.snapshot_index)
+                    with tracer.span("worker.snapshot"):
+                        snapshot = self.server.snapshot_min_index(wait_index)
+                # stamp the snapshot the scheduler runs against on a
+                # copy -- the store's row must stay immutable (worker.go
+                # updateEvalSnapshotIndex routes this through Raft);
+                # blocked evals derived from this one inherit the stamp
+                ev = ev.copy()
+                ev.snapshot_index = snapshot.latest_index()
+                run = _EvalRun(self.server, ev, token, snapshot)
+                if ev.type == consts.JOB_TYPE_CORE:
+                    sched = self.server.new_core_scheduler(snapshot, run)
+                else:
+                    kw = {}
+                    if launcher is not None:
+                        kw["kernel_launch"] = launcher
+                    if cluster_provider is not None:
+                        kw["cluster_provider"] = cluster_provider
+                    sched = new_scheduler(ev.type, snapshot, run, **kw)
+                sched.process(ev)
+                self.server.eval_broker.ack(ev.id, token)
             with self._live_lock:
                 # += from up to MAX_WAVE concurrent eval threads is a
                 # read-modify-write race; monitors poll this counter
@@ -262,7 +268,8 @@ class Worker:
             max(ev.modify_index, ev.snapshot_index) for ev, _ in batch
         )
         try:
-            snapshot = self.server.snapshot_min_index(wait_index)
+            with tracer.span("worker.snapshot", trace_id=batch[0][0].id):
+                snapshot = self.server.snapshot_min_index(wait_index)
         except Exception:                           # noqa: BLE001
             # snapshot catch-up failed for the whole batch: nack all
             for ev, token in batch:
@@ -271,6 +278,9 @@ class Worker:
                 except Exception:                   # noqa: BLE001
                     pass
             return
+        # eval threads re-parent their spans under this batch's trace
+        trace_ctx = tracer.context() or (
+            (batch[0][0].id, 0) if tracer.enabled else None)
 
         clusters = ClusterCache()
         in_flight: List[Tuple[List[threading.Thread], "LaunchCoalescer"]] = []
@@ -297,12 +307,13 @@ class Worker:
             def one(ev: Evaluation, token: str,
                     coalescer=coalescer) -> None:
                 try:
-                    self._process(
-                        ev, token,
-                        snapshot=snapshot,
-                        launcher=coalescer.launch,
-                        cluster_provider=clusters.get,
-                    )
+                    with tracer.attach(trace_ctx):
+                        self._process(
+                            ev, token,
+                            snapshot=snapshot,
+                            launcher=coalescer.launch,
+                            cluster_provider=clusters.get,
+                        )
                 finally:
                     coalescer.done()
 

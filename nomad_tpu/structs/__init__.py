@@ -49,6 +49,7 @@ from nomad_tpu.structs.job import (  # noqa: F401
     ReschedulePolicy,
     RestartPolicy,
     ScalingPolicy,
+    Service,
     Task,
     TaskGroup,
     TaskLifecycleConfig,

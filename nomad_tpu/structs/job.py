@@ -22,6 +22,7 @@ from nomad_tpu.structs.consts import (
     JOB_TYPE_SYSTEM,
 )
 from nomad_tpu.structs.constraints import Affinity, Constraint, Spread
+from nomad_tpu.structs.network import NetworkResource
 from nomad_tpu.structs.resources import Resources
 
 
@@ -274,7 +275,10 @@ class TaskGroup:
     constraints: List[Constraint] = field(default_factory=list)
     affinities: List[Affinity] = field(default_factory=list)
     spreads: List[Spread] = field(default_factory=list)
-    networks: List = field(default_factory=list)  # List[NetworkResource] group nets
+    # typed so the API codec decodes group networks into real
+    # NetworkResource rows (an untyped List left them as wire dicts,
+    # and connect admission then saw no bridge-mode network)
+    networks: List[NetworkResource] = field(default_factory=list)
     volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
     services: List[Service] = field(default_factory=list)
     restart_policy: RestartPolicy = field(default_factory=RestartPolicy)

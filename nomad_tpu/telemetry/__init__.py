@@ -1,0 +1,54 @@
+"""Eval-lifecycle tracing + TPU kernel profiling.
+
+The subsystem the BENCH_r05 gap analysis was missing: spans across the
+full eval hot path (broker dequeue -> worker batch -> snapshot -> wave
+assembly -> kernel launch -> plan submit -> plan apply -> FSM), a
+JAX-level wave profiler (h2d / compile / dispatch / execute / d2h, jit
+cache-miss accounting per bucket shape), and exposition through
+``/v1/metrics`` + ``/v1/operator/traces``.
+
+Disabled by default; ``telemetry.enable()`` (or
+``NOMAD_TPU_TRACE=1`` in the environment) turns both the tracer and
+the kernel profiler on. Disabled-mode cost on the hot path is one
+attribute check per span site.
+"""
+
+from __future__ import annotations
+
+import os
+
+from nomad_tpu.telemetry.kernel_profile import (  # noqa: F401
+    KernelProfiler,
+    profiled_call,
+    profiler,
+)
+from nomad_tpu.telemetry.trace import Span, Tracer, tracer  # noqa: F401
+
+__all__ = [
+    "Span", "Tracer", "tracer",
+    "KernelProfiler", "profiler", "profiled_call",
+    "enable", "disable", "enabled", "reset",
+]
+
+
+def enable() -> None:
+    tracer.enable()
+    profiler.enable()
+
+
+def disable() -> None:
+    tracer.disable()
+    profiler.disable()
+
+
+def enabled() -> bool:
+    return tracer.enabled
+
+
+def reset() -> None:
+    tracer.reset()
+    profiler.reset()
+
+
+if os.environ.get("NOMAD_TPU_TRACE", "") not in ("", "0"):
+    enable()

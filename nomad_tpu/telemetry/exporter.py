@@ -1,0 +1,103 @@
+"""Exposition: Prometheus text + JSON trace dump.
+
+The reference serves go-metrics through ``/v1/metrics`` with
+``?format=prometheus`` rendering the Prometheus text exposition
+(command/agent/http.go:383). This exporter extends that surface with
+the telemetry subsystem's series:
+
+- ``nomad_tpu_trace_span_seconds_total{span=...}`` /
+  ``..._exclusive_seconds_total`` / ``..._count`` — per-span-name
+  aggregates from the tracer (full-fidelity; survives ring wrap).
+- ``nomad_tpu_kernel_stage_seconds_total{stage=...}`` — the wave
+  pipeline decomposition (h2d / compile / dispatch / execute).
+- ``nomad_tpu_kernel_jit_cache_misses_total{kernel=...,key=...}`` and
+  ``..._launches_total`` — the recompile accounting per bucket shape.
+
+``traces_json`` is the ``/v1/operator/traces`` body: the raw span ring
+(newest spans, bounded) plus the aggregates, so an operator can pull a
+decomposition from a live server without restarting it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from nomad_tpu.telemetry.kernel_profile import profiler
+from nomad_tpu.telemetry.trace import tracer
+from nomad_tpu.utils import metrics as _metrics
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def prometheus_text(registry=None) -> str:
+    """The full exposition: metrics registry + telemetry series."""
+    reg = registry if registry is not None else _metrics.global_registry
+    base = reg.prometheus_text().strip("\n")
+    lines: List[str] = [base] if base else []
+
+    stages = tracer.stage_totals()
+    if stages:
+        lines.append("# TYPE nomad_tpu_trace_span_seconds_total counter")
+        for name, agg in stages.items():
+            lines.append(
+                f'nomad_tpu_trace_span_seconds_total{{span="{_esc(name)}"}} '
+                f"{agg['total_s']:.6f}")
+        lines.append(
+            "# TYPE nomad_tpu_trace_span_exclusive_seconds_total counter")
+        for name, agg in stages.items():
+            lines.append(
+                f'nomad_tpu_trace_span_exclusive_seconds_total'
+                f'{{span="{_esc(name)}"}} '
+                f"{agg['exclusive_s']:.6f}")
+        lines.append("# TYPE nomad_tpu_trace_span_count counter")
+        for name, agg in stages.items():
+            lines.append(
+                f'nomad_tpu_trace_span_count{{span="{_esc(name)}"}} '
+                f"{agg['count']}")
+
+    prof = profiler.summary()
+    lines.append("# TYPE nomad_tpu_kernel_stage_seconds_total counter")
+    for stage, secs in sorted(prof["StageSeconds"].items()):
+        lines.append(
+            f'nomad_tpu_kernel_stage_seconds_total{{stage="{stage}"}} '
+            f"{secs}")
+    if prof["PerKey"]:
+        lines.append(
+            "# TYPE nomad_tpu_kernel_jit_cache_misses_total counter")
+        lines.append("# TYPE nomad_tpu_kernel_launches_total counter")
+        for row in prof["PerKey"]:
+            labels = (f'kernel="{_esc(row["Kernel"])}",'
+                      f'key="{_esc(row["Key"])}"')
+            lines.append(
+                f"nomad_tpu_kernel_jit_cache_misses_total{{{labels}}} "
+                f"{row['Misses']}")
+            lines.append(
+                f"nomad_tpu_kernel_launches_total{{{labels}}} "
+                f"{row['Launches']}")
+    lines.append(
+        "# TYPE nomad_tpu_telemetry_enabled gauge")
+    lines.append(
+        f"nomad_tpu_telemetry_enabled {1 if tracer.enabled else 0}")
+    return "\n".join(lines) + "\n"
+
+
+def traces_json(limit: int = 2000) -> Dict:
+    """The /v1/operator/traces body."""
+    spans = tracer.spans()
+    if limit and len(spans) > limit:
+        spans = spans[-limit:]
+    return {
+        "Enabled": tracer.enabled,
+        "Spans": [s.to_api() for s in spans],
+        "Stages": {
+            name: {
+                "Count": agg["count"],
+                "TotalMs": round(agg["total_s"] * 1e3, 4),
+                "ExclusiveMs": round(agg["exclusive_s"] * 1e3, 4),
+            }
+            for name, agg in tracer.stage_totals().items()
+        },
+        "Kernel": profiler.summary(),
+    }

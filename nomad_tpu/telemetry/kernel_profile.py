@@ -1,0 +1,186 @@
+"""JAX-level instrumentation of placement kernel waves.
+
+Decomposes every coalesced wave launch into the stages that actually
+cost wall time on an accelerator backend:
+
+- ``kernel.h2d``     host->device upload of the stacked wave planes
+- ``kernel.compile`` jit trace + XLA compile (first call per
+                     (kernel, bucket-shape) key — a cold TPU compile is
+                     tens of seconds and MUST be visible, not smeared)
+- ``kernel.dispatch``the async dispatch of an already-compiled program
+- ``kernel.execute`` device execution (``block_until_ready``)
+
+(``kernel.d2h`` — the device->host fetch of the wave result — is
+recorded by the caller around its result unpacking.)
+
+The profiler also counts jit cache misses per (kernel, key): the live
+path is bucketed precisely so that repeated waves REUSE compiled
+programs, and a miss counter per bucket shape is the direct test of
+that claim (BENCH_r05's open question: is the TPU live-path gap
+recompilation?). A miss is classified first by the profiler's own seen
+set and cross-checked against the jit function's cache size when the
+runtime exposes it (``_cache_size``), so bucket-key bugs (two keys
+mapping to one program, or one key recompiling) show up as
+``misses != cache_growth``.
+
+When disabled, ``profiled_call`` runs the plain call — same arguments,
+same upload behavior (jit uploads host numpy leaves once at call time),
+zero added device synchronization.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from nomad_tpu.telemetry.trace import tracer
+
+__all__ = ["KernelProfiler", "profiler", "profiled_call"]
+
+
+class KernelProfiler:
+    def __init__(self) -> None:
+        self._enabled = False
+        self._lock = threading.Lock()
+        #: (kernel, key) ever launched -> launch count
+        self._launches: Dict[Tuple[str, tuple], int] = {}
+        #: (kernel, key) -> compile (cache-miss) count
+        self._misses: Dict[Tuple[str, tuple], int] = {}
+        #: per-stage cumulative seconds
+        self.stage_s: Dict[str, float] = {
+            "h2d": 0.0, "compile": 0.0, "dispatch": 0.0, "execute": 0.0,
+        }
+        #: cross-check: observed jit cache growth (when introspectable)
+        self.cache_growth = 0
+
+    # --- control --------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._launches.clear()
+            self._misses.clear()
+            for k in self.stage_s:
+                self.stage_s[k] = 0.0
+            self.cache_growth = 0
+
+    # --- accounting -----------------------------------------------------
+
+    def summary(self) -> Dict:
+        with self._lock:
+            per_key = [
+                {
+                    "Kernel": kernel,
+                    "Key": "/".join(str(p) for p in key),
+                    "Launches": n,
+                    "Misses": self._misses.get((kernel, key), 0),
+                }
+                for (kernel, key), n in sorted(self._launches.items())
+            ]
+            return {
+                "Launches": sum(self._launches.values()),
+                "JitCacheMisses": sum(self._misses.values()),
+                "JitCacheGrowth": self.cache_growth,
+                "StageSeconds": {k: round(v, 6)
+                                 for k, v in self.stage_s.items()},
+                "PerKey": per_key,
+            }
+
+    def misses_for(self, kernel: str) -> int:
+        with self._lock:
+            return sum(n for (k, _), n in self._misses.items()
+                       if k == kernel)
+
+    # --- the profiled launch -------------------------------------------
+
+    def call(self, kernel: str, fn: Callable, dev_args: tuple,
+             static_args: tuple, key: tuple, jit_fn=None):
+        """Run ``fn(*dev_args, *static_args)`` decomposed into h2d /
+        compile-or-dispatch / execute stages. ``dev_args`` is the array
+        pytree uploaded to the device; ``static_args`` (jit static
+        argnums — step bucket, feature set) pass through untouched.
+        ``key`` is the bucket-shape identity the compile cache SHOULD
+        be keyed by; ``jit_fn`` (when it differs from ``fn``, e.g. a
+        sharded wrapper) is the object whose ``_cache_size`` is
+        consulted for the cross-check."""
+        if not self._enabled:
+            return fn(*dev_args, *static_args)
+        import time
+
+        import jax
+
+        probe = jit_fn if jit_fn is not None else fn
+        size_fn = getattr(probe, "_cache_size", None)
+        size0 = None
+        if callable(size_fn):
+            try:
+                size0 = size_fn()
+            except Exception:           # noqa: BLE001 - introspection only
+                size0 = None
+
+        # explicit upload: jit would upload the host numpy leaves
+        # transparently inside the call; splitting it out is what makes
+        # "is it transfer?" answerable
+        with tracer.span("kernel.h2d"):
+            t0 = time.perf_counter()
+            dev_args = jax.device_put(dev_args)
+            jax.block_until_ready(dev_args)
+            self._bump_stage("h2d", time.perf_counter() - t0)
+
+        full_key = (kernel, key)
+        with self._lock:
+            seen = full_key in self._launches
+            self._launches[full_key] = self._launches.get(full_key, 0) + 1
+        t0 = time.perf_counter()
+        out = fn(*dev_args, *static_args)
+        call_s = time.perf_counter() - t0
+
+        grew = 0
+        if size0 is not None:
+            try:
+                grew = max(size_fn() - size0, 0)
+            except Exception:           # noqa: BLE001
+                grew = 0
+        # a miss is OBSERVED cache growth when the runtime exposes it
+        # (survives profiler resets against a warm jit cache); the seen
+        # set is the fallback. A key we bucketed as "seen" that grows
+        # the cache anyway is the exact bug class this counter exists
+        # to expose (two shapes under one bucket key).
+        miss = bool(grew) if size0 is not None else not seen
+        stage = "compile" if miss else "dispatch"
+        tracer.record(f"kernel.{stage}", call_s)
+        self._bump_stage(stage, call_s)
+        with self._lock:
+            if miss:
+                self._misses[full_key] = self._misses.get(full_key, 0) + 1
+            self.cache_growth += grew
+
+        with tracer.span("kernel.execute"):
+            t0 = time.perf_counter()
+            jax.block_until_ready(out)
+            self._bump_stage("execute", time.perf_counter() - t0)
+        return out
+
+    def _bump_stage(self, stage: str, dur_s: float) -> None:
+        with self._lock:
+            self.stage_s[stage] += dur_s
+
+
+#: process-wide profiler; enabled together with the tracer by
+#: telemetry.enable()
+profiler = KernelProfiler()
+
+
+def profiled_call(kernel: str, fn: Callable, dev_args: tuple,
+                  static_args: tuple, key: tuple, jit_fn=None):
+    return profiler.call(kernel, fn, dev_args, static_args, key,
+                         jit_fn=jit_fn)

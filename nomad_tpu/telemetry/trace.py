@@ -1,0 +1,307 @@
+"""Span tracing for the eval lifecycle.
+
+The reference instruments every hot component with go-metrics timers
+(eval_broker.go, plan_apply.go, worker.go all carry
+``defer metrics.MeasureSince(...)``); this subsystem goes one step
+further and records *spans* — named, nested, per-thread intervals on a
+monotonic clock — so the live path's per-eval wall time can be
+decomposed stage by stage (BENCH_r05's unexplained 25x TPU/CPU gap is
+exactly a missing decomposition).
+
+Design constraints, in order:
+
+- **~zero cost when disabled.** ``span()`` is one attribute check and
+  returns a shared no-op context manager; no allocation, no lock, no
+  clock read. The live path stays within noise of the uninstrumented
+  build when tracing is off.
+- **Thread-safe.** Spans nest per-thread via ``threading.local`` stacks
+  (no cross-thread mutation); completed spans land in a bounded ring
+  buffer plus per-name aggregates under one short lock.
+- **Bounded.** The ring holds the newest ``capacity`` spans; aggregates
+  (count / total / exclusive seconds per name) never lose data, so a
+  long burst still decomposes exactly even after the ring wraps.
+- **Exclusive time is first-class.** A span's *exclusive* duration is
+  its wall duration minus its same-thread children — the quantity a
+  stage decomposition can sum without double counting (a scheduler span
+  that parks inside a kernel wave must not claim the wave's time).
+
+Cross-thread propagation: a thread that fans work out captures
+``tracer.context()`` and workers re-parent under it with
+``tracer.attach(ctx)`` — the worker's spans then carry the originating
+trace id (threads do not inherit ``threading.local`` state).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Span", "Tracer", "tracer"]
+
+_ids = itertools.count(1)
+
+
+class Span:
+    """One completed interval. Attributes are kept flat and small —
+    spans are recorded on the hot path.
+
+    Each span carries TWO clocks: wall (monotonic) and the owning
+    thread's CPU time (``time.thread_time``). Wall answers "how long
+    did this stage hold the critical path"; CPU answers "how much work
+    did this stage execute". The distinction matters under the GIL: B
+    concurrently-scheduled eval threads each see ~the whole phase as
+    wall time, but their CPU times sum to the work actually done — the
+    stage decomposition sums CPU for host stages and wall for
+    device-blocking stages, so neither is double counted."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_s",
+                 "dur_s", "child_s", "cpu_s", "child_cpu_s", "thread")
+
+    def __init__(self, name: str, trace_id: str, span_id: int,
+                 parent_id: int, start_s: float, dur_s: float,
+                 child_s: float, cpu_s: float, child_cpu_s: float,
+                 thread: str) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_s = start_s
+        self.dur_s = dur_s
+        self.child_s = child_s
+        self.cpu_s = cpu_s
+        self.child_cpu_s = child_cpu_s
+        self.thread = thread
+
+    @property
+    def exclusive_s(self) -> float:
+        return max(self.dur_s - self.child_s, 0.0)
+
+    @property
+    def exclusive_cpu_s(self) -> float:
+        return max(self.cpu_s - self.child_cpu_s, 0.0)
+
+    def to_api(self) -> Dict:
+        """The wire shape /v1/operator/traces serves."""
+        return {
+            "Name": self.name,
+            "TraceID": self.trace_id,
+            "SpanID": self.span_id,
+            "ParentID": self.parent_id,
+            "Start": round(self.start_s, 6),
+            "DurationMs": round(self.dur_s * 1e3, 4),
+            "ExclusiveMs": round(self.exclusive_s * 1e3, 4),
+            "CpuMs": round(self.cpu_s * 1e3, 4),
+            "ExclusiveCpuMs": round(self.exclusive_cpu_s * 1e3, 4),
+            "Thread": self.thread,
+        }
+
+
+class _NoopSpan:
+    """Shared disabled-mode context manager: no state, no clock."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    """An open span on one thread's stack."""
+
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
+                 "t0", "c0", "child_s", "child_cpu_s")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: int) -> None:
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = next(_ids)
+        self.parent_id = parent_id
+        self.child_s = 0.0
+        self.child_cpu_s = 0.0
+        self.t0 = 0.0
+        self.c0 = 0.0
+
+    def __enter__(self) -> "_LiveSpan":
+        self.tracer._tls_stack().append(self)
+        self.t0 = time.monotonic()
+        self.c0 = time.thread_time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        cpu = time.thread_time() - self.c0
+        dur = time.monotonic() - self.t0
+        stack = self.tracer._tls_stack()
+        # unwind to self: an exception may have skipped children's exits
+        while stack and stack[-1] is not self:
+            stack.pop()
+        if stack:
+            stack.pop()
+        if stack:
+            stack[-1].child_s += dur
+            stack[-1].child_cpu_s += cpu
+        self.tracer._record(self, dur, cpu)
+
+
+class _Attach:
+    __slots__ = ("tracer", "ctx", "prev")
+
+    def __init__(self, tracer: "Tracer", ctx) -> None:
+        self.tracer = tracer
+        self.ctx = ctx
+
+    def __enter__(self) -> "_Attach":
+        tls = self.tracer._tls
+        self.prev = getattr(tls, "inherit", None)
+        tls.inherit = self.ctx
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.tracer._tls.inherit = self.prev
+
+
+class Tracer:
+    def __init__(self, capacity: int = 16384) -> None:
+        self._enabled = False
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        # name -> [count, total_s, exclusive_s, cpu_s, exclusive_cpu_s]
+        self._agg: Dict[str, List[float]] = {}
+        self._tls = threading.local()
+        self.enabled_at: Optional[float] = None
+
+    # --- control --------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self) -> None:
+        self.enabled_at = time.monotonic()
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._agg.clear()
+        if self._enabled:
+            self.enabled_at = time.monotonic()
+
+    # --- recording ------------------------------------------------------
+
+    def _tls_stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def span(self, name: str, trace_id: str = ""):
+        """Open a span. The ONLY hot-path entry point: when disabled it
+        returns a shared no-op without reading the clock."""
+        if not self._enabled:
+            return _NOOP
+        stack = self._tls_stack()
+        if stack:
+            parent = stack[-1]
+            return _LiveSpan(self, name, trace_id or parent.trace_id,
+                             parent.span_id)
+        inherit = getattr(self._tls, "inherit", None)
+        if inherit is not None:
+            return _LiveSpan(self, name, trace_id or inherit[0], inherit[1])
+        return _LiveSpan(self, name, trace_id, 0)
+
+    def record(self, name: str, dur_s: float, trace_id: str = "") -> None:
+        """Record an already-measured interval as a leaf span (for
+        sites that must decide retroactively, e.g. a blocking dequeue
+        that only counts when it returned work)."""
+        if not self._enabled:
+            return
+        stack = self._tls_stack()
+        parent_id = stack[-1].span_id if stack else 0
+        if stack:
+            stack[-1].child_s += dur_s
+            trace_id = trace_id or stack[-1].trace_id
+        # after-the-fact records carry no CPU reading (they are mostly
+        # blocking waits); cpu_s=0 keeps them out of CPU attributions
+        sp = Span(name, trace_id, next(_ids), parent_id,
+                  time.monotonic() - dur_s, dur_s, 0.0, 0.0, 0.0,
+                  threading.current_thread().name)
+        self._append(sp)
+
+    def _record(self, live: _LiveSpan, dur_s: float, cpu_s: float) -> None:
+        sp = Span(live.name, live.trace_id, live.span_id, live.parent_id,
+                  live.t0, dur_s, live.child_s, cpu_s, live.child_cpu_s,
+                  threading.current_thread().name)
+        self._append(sp)
+
+    def _append(self, sp: Span) -> None:
+        with self._lock:
+            self._ring.append(sp)
+            agg = self._agg.get(sp.name)
+            if agg is None:
+                self._agg[sp.name] = [1, sp.dur_s, sp.exclusive_s,
+                                      sp.cpu_s, sp.exclusive_cpu_s]
+            else:
+                agg[0] += 1
+                agg[1] += sp.dur_s
+                agg[2] += sp.exclusive_s
+                agg[3] += sp.cpu_s
+                agg[4] += sp.exclusive_cpu_s
+
+    # --- propagation ----------------------------------------------------
+
+    def context(self) -> Optional[Tuple[str, int]]:
+        """(trace_id, span_id) of the calling thread's open span, for
+        hand-off to worker threads via ``attach``."""
+        if not self._enabled:
+            return None
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            return (stack[-1].trace_id, stack[-1].span_id)
+        return None
+
+    def attach(self, ctx: Optional[Tuple[str, int]]):
+        """Adopt ``ctx`` as the parent for this thread's root spans."""
+        if ctx is None:
+            return _NOOP
+        return _Attach(self, ctx)
+
+    # --- introspection --------------------------------------------------
+
+    def spans(self, name: Optional[str] = None,
+              trace_id: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._ring)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        return out
+
+    def stage_totals(self) -> Dict[str, Dict[str, float]]:
+        """Per-name aggregates since enable/reset: full-fidelity even
+        after the ring wraps."""
+        with self._lock:
+            return {
+                name: {"count": int(c), "total_s": t, "exclusive_s": e,
+                       "cpu_s": cp, "exclusive_cpu_s": ecp}
+                for name, (c, t, e, cp, ecp) in sorted(self._agg.items())
+            }
+
+
+#: process-wide tracer, analogous to utils.metrics.global_registry
+tracer = Tracer()

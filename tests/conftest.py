@@ -35,3 +35,18 @@ os.environ["NOMAD_TPU_GC_TUNING"] = "0"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier conventions (ROADMAP.md tier-1 runs `-m 'not slow'`):
+    #   slow   -- excluded from tier-1
+    #   stress -- the contention-repetition tier (`pytest -m stress`,
+    #             N-rerun loops over broker/coalescer/membership
+    #             contention); stress tests are ALSO marked slow so
+    #             tier-1 never pays for repetition
+    config.addinivalue_line(
+        "markers", "slow: excluded from tier-1 (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "stress: contention-repetition tier (pytest -m stress); "
+        "always paired with slow")

@@ -197,3 +197,104 @@ class TestAgentMembership:
         # the cluster still has a functioning leader
         assert _wait(lambda: any(
             a is not victim and a.server.is_leader() for a in ha_trio))
+
+
+class TestGossipAuth:
+    """HMAC-authenticated gossip (agent `encrypt` config; serf keyring
+    analog). Closes the forged member-leave takedown: without a key,
+    one spoofed UDP datagram removed a live server from the cluster
+    view (and, via reconcile, the raft voter set)."""
+
+    def test_keyed_cluster_converges(self):
+        a = _mk("auth-a", encrypt="cluster-secret")
+        b = _mk("auth-b", encrypt="cluster-secret")
+        try:
+            b.join([(a.host, a.port)])
+            assert _wait(lambda: a.member_status("auth-b") == ALIVE)
+            assert _wait(lambda: b.member_status("auth-a") == ALIVE)
+        finally:
+            a.shutdown(leave=False)
+            b.shutdown(leave=False)
+
+    def test_forged_leave_rejected_without_key(self):
+        """An attacker on the segment (no key) cannot make a keyed
+        member believe its peer left."""
+        import json as _json
+
+        a = _mk("auth-a", encrypt="cluster-secret")
+        b = _mk("auth-b", encrypt="cluster-secret")
+        try:
+            b.join([(a.host, a.port)])
+            assert _wait(lambda: a.member_status("auth-b") == ALIVE)
+            # forge an unsigned leave claiming to be b
+            forged = _json.dumps({
+                "t": "leave", "from": "auth-b", "region": a.region,
+                "mem": [["auth-b", b.host, b.port, 1 << 31, LEFT, {}]],
+            }).encode()
+            attacker = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            before = a.rx_rejected
+            for _ in range(3):
+                attacker.sendto(forged, (a.host, a.port))
+            attacker.close()
+            assert _wait(lambda: a.rx_rejected >= before + 3)
+            # b is still alive in a's view: the takedown failed
+            assert a.member_status("auth-b") == ALIVE
+        finally:
+            a.shutdown(leave=False)
+            b.shutdown(leave=False)
+
+    def test_wrong_key_rejected(self):
+        a = _mk("auth-a", encrypt="right-key")
+        c = _mk("auth-c", encrypt="wrong-key")
+        try:
+            c.join([(a.host, a.port)])
+            time.sleep(0.5)
+            assert a.member_status("auth-c") is None
+            assert a.rx_rejected > 0
+        finally:
+            a.shutdown(leave=False)
+            c.shutdown(leave=False)
+
+    def test_unkeyed_cluster_still_accepts_plain(self):
+        a = _mk("plain-a")
+        b = _mk("plain-b")
+        try:
+            b.join([(a.host, a.port)])
+            assert _wait(lambda: a.member_status("plain-b") == ALIVE)
+            assert a.rx_rejected == 0
+        finally:
+            a.shutdown(leave=False)
+            b.shutdown(leave=False)
+
+
+class TestJoinAddrParsing:
+    """expand_join_addrs IPv6 handling: bracketed [addr]:port, bare
+    IPv6 literals, and AF_INET-restricted resolution (the membership
+    socket is IPv4; AAAA records would probe into a black hole)."""
+
+    def test_parse_entry_shapes(self):
+        from nomad_tpu.server.membership import parse_join_entry
+
+        assert parse_join_entry("10.0.0.1:4700") == ("10.0.0.1", 4700)
+        assert parse_join_entry("10.0.0.1") == ("10.0.0.1", 4648)
+        assert parse_join_entry("srv.example:9000") == ("srv.example", 9000)
+        assert parse_join_entry("[::1]:4700") == ("::1", 4700)
+        assert parse_join_entry("[fe80::1]") == ("fe80::1", 4648)
+        # bare IPv6 literal: NOT split at the last colon
+        assert parse_join_entry("fe80::1") == ("fe80::1", 4648)
+        assert parse_join_entry("2001:db8::2:1") == ("2001:db8::2:1", 4648)
+
+    def test_ipv4_entries_resolve(self):
+        out = expand_join_addrs(["127.0.0.1:4701", "127.0.0.1"])
+        assert ("127.0.0.1", 4701) in out
+        assert ("127.0.0.1", 4648) in out
+
+    def test_ipv6_literal_skipped_on_ipv4_socket(self):
+        # an AF_INET lookup cannot yield a dialable target for ::1 —
+        # the entry is skipped with a warning, not mis-resolved
+        out = expand_join_addrs(["[::1]:4700", "fe80::1"])
+        assert out == []
+
+    def test_ipv6_family_opt_in(self):
+        out = expand_join_addrs(["[::1]:4700"], family=socket.AF_INET6)
+        assert ("::1", 4700) in [a[:2] for a in out]

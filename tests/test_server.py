@@ -326,8 +326,15 @@ class TestServerEndToEnd:
                 ]) == 10,
                 msg="10 allocs placed",
             )
-            ev = server.state.snapshot().eval_by_id(resp["eval_id"])
-            assert ev.status == consts.EVAL_STATUS_COMPLETE
+            # the eval's COMPLETE status lands via a separate raft
+            # apply moments after the plan commit that made the allocs
+            # visible — wait for it rather than racing it
+            self.wait_for(
+                lambda: server.state.snapshot().eval_by_id(
+                    resp["eval_id"]).status == consts.EVAL_STATUS_COMPLETE,
+                msg="eval marked complete",
+                server=server,
+            )
         finally:
             server.shutdown()
 
@@ -340,6 +347,9 @@ class TestServerEndToEnd:
             server.job_register(job)
             self.wait_for(
                 lambda: server.blocked_evals.stats()["total_blocked"] == 1,
+                # a loaded suite process can stretch one scheduling
+                # pass past the default 10s
+                timeout=30.0,
                 msg="blocked eval created",
                 server=server,
             )
