@@ -1116,11 +1116,22 @@ def main() -> None:
                 json.dump(decomp, f, indent=2)
                 f.write("\n")
             top = list(decomp["stages"].items())[:3]
+            steady = decomp.get("steady_state", {})
             em.update(
                 trace_attributed_share=decomp["attributed_share"],
                 trace_per_eval_ms=decomp["per_eval_ms"],
                 trace_top_stages={k: v["per_eval_ms"] for k, v in top},
                 trace_jit_cache_misses=decomp["kernel"]["JitCacheMisses"],
+                # the second (steady-state) burst is the compile-share
+                # regression artifact: with AOT warmup these must hold
+                # at 0 misses / <10% compile share
+                trace_steady_jit_cache_misses=steady.get(
+                    "jit_cache_misses"),
+                trace_steady_compile_share=steady.get("compile_share"),
+                trace_wave_fill_ratio=decomp.get("wave", {}).get(
+                    "fill_ratio"),
+                trace_park_latency_p99_ms=decomp.get("wave", {}).get(
+                    "park_latency_p99_ms"),
             )
         except Exception as e:                   # noqa: BLE001
             import traceback

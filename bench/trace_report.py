@@ -80,9 +80,39 @@ _OVERLAPPED = {
 }
 
 
+def _interval_union_s(intervals) -> float:
+    """Total length of the union of (start, end) intervals."""
+    total = 0.0
+    cur_start = cur_end = None
+    for start, end in sorted(intervals):
+        if cur_end is None or start > cur_end:
+            if cur_end is not None:
+                total += cur_end - cur_start
+            cur_start, cur_end = start, end
+        else:
+            cur_end = max(cur_end, end)
+    if cur_end is not None:
+        total += cur_end - cur_start
+    return total
+
+
 def decompose(stage_totals: Dict, wall_s: float, n_evals: int,
-              profiler_summary: Optional[Dict] = None) -> Dict:
-    """Fold tracer aggregates into the TRACE_DECOMP stage table."""
+              profiler_summary: Optional[Dict] = None,
+              spans=None) -> Dict:
+    """Fold tracer aggregates into the TRACE_DECOMP stage table.
+
+    Shares are computed from DEDUPED time (this fixed the seed
+    artifact's attributed_share of 1.0267): device-blocking wall
+    stages are merged over their actual intervals (two pipelined
+    waves' compiles/executes overlapping on the clock count once),
+    and host CPU executed DURING those device intervals — under the
+    GIL released by an XLA compile, eval threads really do run — is
+    not credited a second time against the same wall second. The raw
+    per-stage sums stay in the table (they are the honest work
+    totals); ``parallel_overlap_s`` reports how much of that work
+    overlapped, so pipelining is visible instead of inflating the
+    share past 1.0.
+    """
     stages: Dict[str, Dict] = {}
     for span_name, agg in stage_totals.items():
         target = _ATTRIBUTED.get(span_name)
@@ -95,10 +125,35 @@ def decompose(stage_totals: Dict, wall_s: float, n_evals: int,
             stage, {"total_s": 0.0, "count": 0, "clock": clock})
         row["total_s"] += secs
         row["count"] += agg["count"]
-    attributed_s = sum(r["total_s"] for r in stages.values())
+    raw_wall_s = sum(r["total_s"] for r in stages.values()
+                     if r["clock"] == "wall")
+    cpu_sum_s = sum(r["total_s"] for r in stages.values()
+                    if r["clock"] == "cpu")
+    attributed_raw_s = raw_wall_s + cpu_sum_s
+
+    # dedupe pass 1: overlapping device-stage WALL intervals (from the
+    # span ring) count once
+    union_wall_s = raw_wall_s
+    if spans is not None:
+        wall_names = {name for name, (_, clock) in _ATTRIBUTED.items()
+                      if clock == "wall"}
+        intervals = [(s.start_s, s.start_s + s.dur_s)
+                     for s in spans if s.name in wall_names]
+        if intervals:
+            union_wall_s = _interval_union_s(intervals)
+    wall_scale = (union_wall_s / raw_wall_s
+                  if raw_wall_s > union_wall_s > 0 else 1.0)
+    # dedupe pass 2: host CPU beyond the wall the device stages left
+    # over ran DURING them — real work (reported raw) but not a second
+    # claim on the same wall second
+    cpu_cap_s = max(wall_s - min(union_wall_s, wall_s), 0.0)
+    cpu_scale = (min(1.0, cpu_cap_s / cpu_sum_s)
+                 if cpu_sum_s > 0 else 1.0)
+    attributed_s = min(raw_wall_s, union_wall_s) + cpu_sum_s * cpu_scale
     for row in stages.values():
+        scale = wall_scale if row["clock"] == "wall" else cpu_scale
         row["per_eval_ms"] = round(row["total_s"] * 1e3 / max(n_evals, 1), 4)
-        row["share_of_wall"] = round(row["total_s"] / wall_s, 4) \
+        row["share_of_wall"] = round(row["total_s"] * scale / wall_s, 4) \
             if wall_s > 0 else 0.0
         row["total_s"] = round(row["total_s"], 6)
 
@@ -121,6 +176,12 @@ def decompose(stage_totals: Dict, wall_s: float, n_evals: int,
         "attributed_s": round(attributed_s, 6),
         "attributed_share": round(attributed_s / wall_s, 4)
         if wall_s > 0 else 0.0,
+        # the honest raw sums the dedupe started from: raw - attributed
+        # is the work that OVERLAPPED other attributed work (the
+        # pipeline doing its job), not extra wall
+        "attributed_raw_s": round(attributed_raw_s, 6),
+        "parallel_overlap_s": round(
+            max(attributed_raw_s - attributed_s, 0.0), 6),
         "stages": dict(sorted(stages.items(),
                               key=lambda kv: -kv[1]["total_s"])),
         "overlapped": overlapped,
@@ -222,14 +283,43 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                 t_done = time.perf_counter()
             return placed, t_done
 
+        # telemetry on BEFORE warmup: the profiler records the warmup
+        # waves' bucket keys, and the AOT pass below precompiles the
+        # rest of their lattice (tail/partial wave buckets the warmup
+        # burst never hit) so the timed bursts are compile-free — the
+        # warmup-manifest flow a live server runs at startup
+        # (ops/warmup.py), exercised here end to end
+        telemetry.enable()
         done0 = sum(w.processed for w in server.workers)
         warm = submit(warmup_jobs)
         wait_placed(warm, time.time() + min(deadline_s * 0.5, 120.0),
                     done0=done0)
+        from nomad_tpu.ops import warmup as kernel_warmup
 
-        telemetry.enable()
+        observed = kernel_warmup.manifest_from_profiler(profiler)
+        entries = kernel_warmup.expand_lattice(observed,
+                                               max_wave=batch_size)
+        compiled, failed = kernel_warmup.warmup_entries(entries)
+        warmed = {"entries": len(entries), "compiled": compiled,
+                  "failed": failed}
+
         history = []
-        for _ in range(max(bursts, 1)):
+        for burst_i in range(max(bursts, 1)):
+            if burst_i > 0:
+                # the persisted-manifest flow between bursts: union the
+                # previous burst's observed bucket keys (follow-up
+                # evals surface small step buckets warmup jobs never
+                # hit) and AOT-warm them, so the LAST burst is the
+                # compile-free steady state a warmed production server
+                # runs at. Already-compiled entries are cache hits.
+                observed = kernel_warmup._dedupe(
+                    observed + kernel_warmup.manifest_from_profiler(
+                        profiler))
+                expanded = kernel_warmup.expand_lattice(
+                    observed, max_wave=batch_size)
+                c2, f2 = kernel_warmup.warmup_entries(expanded)
+                warmed = {"entries": len(expanded), "compiled": c2,
+                          "failed": f2}
             telemetry.reset()
             done0 = sum(w.processed for w in server.workers)
             cpu0 = time.process_time()
@@ -239,8 +329,16 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                                          done0=done0)
             wall = t_done - t0
             process_cpu = time.process_time() - cpu0
+            # interval dedupe needs the COMPLETE span set: a wrapped
+            # ring would shrink the wall-interval union while the
+            # aggregate sums stay whole, under-scaling shares. On
+            # wrap, fall back to raw attribution (spans=None).
+            spans = tracer.spans()
+            if len(spans) >= tracer.capacity:
+                spans = None
             decomp = decompose(tracer.stage_totals(), wall, n_jobs,
-                               profiler_summary=profiler.summary())
+                               profiler_summary=profiler.summary(),
+                               spans=spans)
             # steal-invariant companion: attributed work over the CPU
             # this process actually got. On a contended host (CI
             # neighbors, a parent test suite's leaked threads) wall
@@ -248,14 +346,22 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
             # share honestly drops, while this ratio stays a property
             # of the system itself.
             decomp["process_cpu_s"] = round(process_cpu, 4)
+            # busy share stays on the RAW attribution: it answers "of
+            # the CPU this process received, how much was named work"
+            # — overlap with device stages is exactly what it wants to
+            # count
             decomp["attributed_share_busy"] = round(
-                decomp["attributed_s"] / process_cpu, 4) \
+                decomp["attributed_raw_s"] / process_cpu, 4) \
                 if process_cpu > 0 else 0.0
             decomp["backend"] = jax.default_backend()
             decomp["n_nodes"] = n_nodes
             decomp["allocs_placed"] = placed
             decomp["allocs_wanted"] = n_jobs * allocs_per_job
             decomp["batch_size"] = batch_size
+            decomp["warmup"] = warmed
+            from nomad_tpu.parallel.coalesce import wave_stats
+
+            decomp["wave"] = wave_stats.snapshot()
             history.append(decomp)
         decomp = history[-1]
         if len(history) > 1:
@@ -266,9 +372,20 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                  "attributed_share_busy": h["attributed_share_busy"],
                  "compile_s": h["stages"].get("compile", {})
                  .get("total_s", 0.0),
+                 "compile_share": h["stages"].get("compile", {})
+                 .get("share_of_wall", 0.0),
                  "jit_cache_misses": h["kernel"]["JitCacheMisses"]}
                 for h in history
             ]
+        # the SECOND burst is the steady-state regression artifact:
+        # with AOT warmup in front, it must report zero jit cache
+        # misses and a compile share under 10% (CI-gated in
+        # tests/test_warmup.py; bench.py emits these fields)
+        decomp["steady_state"] = {
+            "jit_cache_misses": decomp["kernel"]["JitCacheMisses"],
+            "compile_share": decomp["stages"].get("compile", {})
+            .get("share_of_wall", 0.0),
+        }
         return decomp
     finally:
         if not was_enabled:

@@ -113,6 +113,16 @@ class AgentConfig:
     vault_addr: str = ""
     vault_token: str = ""
     vault_token_role: str = ""
+    # AOT placement-kernel warmup (ops/warmup.py): None = auto (warm
+    # when a manifest exists), plus the manifest path ("" = default
+    # ~/.cache location)
+    kernel_warmup: Optional[bool] = None
+    warmup_manifest: str = ""
+    # adaptive wave-coalescer knobs (server block: coalesce_adaptive
+    # + coalesce_window_min_ms / coalesce_window_max_ms)
+    coalesce_adaptive: bool = True
+    coalesce_window_min_ms: float = 1.0
+    coalesce_window_max_ms: float = 50.0
 
     @classmethod
     def dev(cls, **overrides) -> "AgentConfig":
@@ -161,6 +171,11 @@ class Agent:
             vault_addr=self.config.vault_addr,
             vault_token=self.config.vault_token,
             vault_token_role=self.config.vault_token_role,
+            kernel_warmup=self.config.kernel_warmup,
+            warmup_manifest_path=self.config.warmup_manifest,
+            coalesce_adaptive=self.config.coalesce_adaptive,
+            coalesce_window_min_ms=self.config.coalesce_window_min_ms,
+            coalesce_window_max_ms=self.config.coalesce_window_max_ms,
         )
         self.server = Server(cfg)
         self.raft_transport = None

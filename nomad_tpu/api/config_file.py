@@ -116,6 +116,18 @@ def _apply_body(cfg, body: Body):
             cfg.serf_enabled = bool(sa["serf_enabled"])
         if "serf_port" in sa:
             cfg.serf_port = int(sa["serf_port"])
+        # AOT placement-kernel warmup + adaptive wave-coalescer window
+        # (ops/warmup.py, parallel/coalesce.py; see docs/PERF.md)
+        if "kernel_warmup" in sa:
+            cfg.kernel_warmup = bool(sa["kernel_warmup"])
+        if "warmup_manifest" in sa:
+            cfg.warmup_manifest = str(sa["warmup_manifest"])
+        if "coalesce_adaptive" in sa:
+            cfg.coalesce_adaptive = bool(sa["coalesce_adaptive"])
+        if "coalesce_window_min_ms" in sa:
+            cfg.coalesce_window_min_ms = float(sa["coalesce_window_min_ms"])
+        if "coalesce_window_max_ms" in sa:
+            cfg.coalesce_window_max_ms = float(sa["coalesce_window_max_ms"])
         # gossip membership seeds ("host:port"; DNS names expand to
         # every A record — join-by-DNS)
         if "server_join" in sa and isinstance(sa["server_join"], list):

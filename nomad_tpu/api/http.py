@@ -120,7 +120,27 @@ class HTTPAgent:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
-        self.httpd = ThreadingHTTPServer((bind, port), _Handler)
+        class _QuietServer(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # socketserver's default prints a raw traceback to
+                # stderr; route it through logging instead so stderr
+                # stays clean for the process's own consumers. A
+                # client dropping mid-response is routine (debug);
+                # anything else is a real handler failure and must
+                # stay visible at default log levels
+                import logging
+                import sys
+
+                exc = sys.exc_info()[1]
+                log = logging.getLogger(__name__)
+                if isinstance(exc, (ConnectionError, TimeoutError)):
+                    log.debug("http: client %s dropped: %s",
+                              client_address, exc)
+                else:
+                    log.warning("http: error serving %s",
+                                client_address, exc_info=True)
+
+        self.httpd = _QuietServer((bind, port), _Handler)
         self.httpd.daemon_threads = True
         scheme = "http"
         # outbound SSL context for intra-cluster forwarding (region +

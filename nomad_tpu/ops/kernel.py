@@ -88,6 +88,19 @@ def pad_steps(k: int) -> int:
     return ((k + 4095) // 4096) * 4096
 
 
+#: live-path floor for the placement-axis bucket: a follow-up eval
+#: placing 1-2 leftover allocs used to compile its own tiny step
+#: variant per (wave, k) pair — padding every live launch to at least
+#: 8 steps collapses those onto the primary evals' programs (inactive
+#: steps are a few microseconds of device scan; a cold compile is tens
+#: of seconds)
+MIN_STEP_BUCKET = 8
+
+
+def pad_steps_live(k: int) -> int:
+    return pad_steps(max(k, MIN_STEP_BUCKET))
+
+
 class NeutralPlanes(NamedTuple):
     """Read-only neutral planes shared BY IDENTITY across evaluations.
 
@@ -200,6 +213,38 @@ class KernelFeatures(NamedTuple):
 
 
 FULL_FEATURES = KernelFeatures()
+
+
+def canonical_features(f: KernelFeatures) -> KernelFeatures:
+    """Collapse near-identical feature sets onto one compiled variant.
+
+    Every distinct ``KernelFeatures`` value is a separate XLA compile
+    (tens of seconds cold on TPU), and the live path was forking
+    variants on axes that don't pay for their slot: a job with 2
+    spread stanzas compiled a different program than one with 3, and a
+    wave whose single rescheduled member enabled ``with_step_penalties``
+    compiled apart from the identical wave that also pinned a
+    preferred node. Canonicalization rounds UP onto a coarser lattice:
+
+    - ``n_spreads`` is 0 or MAX_SPREADS (inactive stanzas are no-ops
+      by kernel definition, so extra spread slots only cost device
+      time on a tiny [S] axis);
+    - ``with_step_penalties``/``with_preferred`` travel together (both
+      read tiny per-step planes whose neutral rows -1 are no-ops).
+
+    Enabling a feature for an ask that ships neutral planes never
+    changes placements — that is the coalescer's existing union
+    contract — so this only trades a sliver of device time for a
+    bounded variant count. Axes that change semantics (``with_shuffle``)
+    or materially change program cost (ports/devices/network/cores
+    over the wide node axis) are left alone.
+    """
+    aux = f.with_step_penalties or f.with_preferred
+    return f._replace(
+        n_spreads=0 if f.n_spreads == 0 else MAX_SPREADS,
+        with_step_penalties=aux,
+        with_preferred=aux,
+    )
 
 #: the lean cpu/mem/disk binpack envelope — what a plain service/batch
 #: ask compiles to, and the exact feature set the pallas backend
@@ -901,6 +946,7 @@ def default_kernel_launch(kin: KernelIn, k_steps: int,
     hide outside the wave accounting."""
     from nomad_tpu.telemetry.kernel_profile import profiler
 
+    features = canonical_features(features)
     n_pad = int(np.asarray(kin.cap_cpu).shape[0])
     key = (n_pad, k_steps, features)
     if features.n_spreads == 0 and not bool(kin.algorithm_spread):

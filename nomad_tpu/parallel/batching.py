@@ -446,7 +446,12 @@ def make_preemption_apply_loop(k_steps: int, reset_every: int = 0):
         return (jnp.sum(scores), jnp.sum(placed), jnp.sum(preempted),
                 uc, um)
 
-    return jax.jit(loop, donate_argnums=(1, 2, 3, 4))
+    # donate ONLY used_cpu/used_mem: they alias the uc/um outputs.
+    # pre_cpu/pre_mem never leave the loop, so donating them has no
+    # output to alias — XLA warns "Some donated buffers were not
+    # usable" and the donation buys nothing (the warning is promoted
+    # to an error in tests so this cannot regress)
+    return jax.jit(loop, donate_argnums=(1, 2))
 
 
 def commit_placements(used_cpu, used_mem, chosen, found, ask_cpu, ask_mem):

@@ -22,6 +22,8 @@ placement semantics.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import List, Optional
 
 import jax
@@ -32,6 +34,7 @@ from nomad_tpu.ops.kernel import (
     KernelFeatures,
     KernelIn,
     KernelOut,
+    canonical_features,
     pad_steps,
     place_taskgroups_joint_jit,
 )
@@ -88,6 +91,17 @@ _NEUTRAL_SHAREABLE_FIELDS = (
 )
 
 
+def wave_field_is_shared(field: str, shared: bool,
+                         neutral_shared: bool) -> bool:
+    """Whether a KernelIn field ships UNBATCHED under the given wave
+    layout flags. The single source of truth for the two sharing
+    groups — the live launcher (``launch_wave``) and the AOT warmup's
+    dummy-wave builder (ops/warmup.py) must agree EXACTLY, or warmup
+    compiles programs the live path never hits."""
+    return (shared and field in _SHAREABLE_FIELDS) or (
+        neutral_shared and field in _NEUTRAL_SHAREABLE_FIELDS)
+
+
 def configure_wave_mesh(mesh) -> None:
     """Route DIRECT launch_wave calls over ``mesh`` (None restores
     single-device dispatch). Live servers ignore this: they pass their
@@ -104,8 +118,11 @@ def pad_wave(b: int) -> int:
 
 
 def union_features(features: List[KernelFeatures]) -> KernelFeatures:
-    """Smallest feature set that serves every member (see module doc)."""
-    return KernelFeatures(
+    """Smallest feature set that serves every member (see module doc),
+    canonicalized (ops/kernel.canonical_features) so near-identical
+    waves land on one compiled variant instead of forking the jit
+    cache per incidental feature combination."""
+    return canonical_features(KernelFeatures(
         n_spreads=max(f.n_spreads for f in features),
         with_topk=any(f.with_topk for f in features),
         with_devices=any(f.with_devices for f in features),
@@ -116,7 +133,7 @@ def union_features(features: List[KernelFeatures]) -> KernelFeatures:
         with_step_penalties=any(f.with_step_penalties for f in features),
         with_preferred=any(f.with_preferred for f in features),
         with_shuffle=any(f.with_shuffle for f in features),
-    )
+    ))
 
 
 def _pad_kin_steps(kin: KernelIn, k_max: int) -> KernelIn:
@@ -137,6 +154,120 @@ def _pad_kin_steps(kin: KernelIn, k_max: int) -> KernelIn:
     pref = np.full(k_max, -1, np.int32)
     pref[:k] = np.asarray(kin.step_preferred)
     return kin._replace(step_penalty=pen, step_preferred=pref)
+
+
+class WaveStats:
+    """Process-wide wave-shape observability (exported as Prometheus
+    gauges by telemetry/exporter.py; reset with telemetry.reset()).
+
+    ``fill_ratio`` = real members / padded wave slots — low fill means
+    the coalescer fires before waves fill (deadline pressure) or the
+    broker hands out ragged batches. ``park_latency`` percentiles are
+    the rendezvous cost an eval thread pays waiting for its wave; the
+    adaptive deadline exists to bound exactly this number."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.launches = 0
+        self.full_launches = 0
+        self.deadline_launches = 0
+        self.members_sum = 0
+        self.slots_sum = 0
+        self._park_s: deque = deque(maxlen=4096)
+
+    def observe_wave(self, members: int, deadline_fired: bool) -> None:
+        with self._lock:
+            self.launches += 1
+            self.members_sum += members
+            self.slots_sum += pad_wave(members)
+            if deadline_fired:
+                self.deadline_launches += 1
+            else:
+                self.full_launches += 1
+
+    def observe_park(self, seconds: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self._park_s.append(seconds)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.requests = 0
+            self.launches = 0
+            self.full_launches = 0
+            self.deadline_launches = 0
+            self.members_sum = 0
+            self.slots_sum = 0
+            self._park_s.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            park = sorted(self._park_s)
+            p50 = park[len(park) // 2] if park else 0.0
+            p99 = park[min(len(park) - 1, int(len(park) * 0.99))] \
+                if park else 0.0
+            return {
+                "requests": self.requests,
+                "launches": self.launches,
+                "full_launches": self.full_launches,
+                "deadline_launches": self.deadline_launches,
+                "fill_ratio": (self.members_sum / self.slots_sum
+                               if self.slots_sum else 0.0),
+                "park_latency_p50_ms": p50 * 1e3,
+                "park_latency_p99_ms": p99 * 1e3,
+            }
+
+
+#: process-wide wave stats (all coalescers feed it; they are per-chunk
+#: and too short-lived to carry their own history)
+wave_stats = WaveStats()
+
+
+class _LatencyEWMA:
+    """Exponentially-weighted wave latency: the adaptive coalescer's
+    deadline is a fraction of what a launch actually costs, so parking
+    never dominates the device time it tries to amortize."""
+
+    def __init__(self, alpha: float = 0.2) -> None:
+        self._lock = threading.Lock()
+        self._alpha = alpha
+        self._value: Optional[float] = None
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            if self._value is None:
+                self._value = seconds
+            else:
+                self._value += self._alpha * (seconds - self._value)
+
+    @property
+    def value(self) -> Optional[float]:
+        with self._lock:
+            return self._value
+
+
+#: EWMA of launch_wave wall seconds (compile transients included on
+#: purpose: while variants still compile, waiting longer for fuller
+#: waves is the right call)
+wave_latency_ewma = _LatencyEWMA()
+
+#: launches currently executing, token -> perf_counter start. A
+#: long-running in-flight launch (a cold XLA compile) disarms the
+#: adaptive deadline process-wide: the EWMA only learns about a slow
+#: variant AFTER it finishes, but parked members must stop firing
+#: partial waves INTO the transient (each would cold-compile its own
+#: wave bucket).
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT_STARTS: dict = {}
+
+
+def _oldest_inflight_age_s() -> float:
+    with _INFLIGHT_LOCK:
+        if not _INFLIGHT_STARTS:
+            return 0.0
+        oldest = min(_INFLIGHT_STARTS.values())
+    return time.perf_counter() - oldest
 
 
 def launch_wave(kins: List[KernelIn], k_steps: List[int],
@@ -188,8 +319,7 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
         neutral_shareable = _group_shared(_NEUTRAL_SHAREABLE_FIELDS)
 
         def _stack_field(f, xs):
-            if (shareable and f in _SHAREABLE_FIELDS) or (
-                    neutral_shareable and f in _NEUTRAL_SHAREABLE_FIELDS):
+            if wave_field_is_shared(f, shareable, neutral_shareable):
                 return np.asarray(xs[0])
             return np.stack([np.asarray(x) for x in xs])
 
@@ -219,27 +349,38 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
     # this key must NOT recompile (the profiler counts violations)
     n_nodes = int(np.asarray(stacked.cap_cpu).shape[-1])
     wave_key = (b_pad, t_pad, n_nodes, shareable, neutral_shareable, feats)
-    if mesh is not None:
-        from nomad_tpu.parallel.sharded import make_joint_sharded
+    t_launch = time.perf_counter()
+    token = object()
+    with _INFLIGHT_LOCK:
+        _INFLIGHT_STARTS[token] = t_launch
+    try:
+        if mesh is not None:
+            from nomad_tpu.parallel.sharded import make_joint_sharded
 
-        global sharded_wave_launches
-        sharded_wave_launches += 1
-        fn = make_joint_sharded(mesh)
-        out = profiler.call(
-            "joint_sharded", fn,
-            (stacked, jnp.asarray(step_member), jnp.asarray(step_local)),
-            (t_pad, feats),
-            wave_key + (tuple(mesh.devices.flat),), jit_fn=fn,
-        )
-    else:
-        out = profiler.call(
-            "joint", place_taskgroups_joint_jit,
-            (stacked, jnp.asarray(step_member), jnp.asarray(step_local)),
-            (t_pad, feats),
-            wave_key, jit_fn=place_taskgroups_joint_jit,
-        )
-    with tracer.span("kernel.d2h"):
-        host = jax.tree_util.tree_map(np.asarray, out)
+            global sharded_wave_launches
+            sharded_wave_launches += 1
+            fn = make_joint_sharded(mesh)
+            out = profiler.call(
+                "joint_sharded", fn,
+                (stacked, jnp.asarray(step_member),
+                 jnp.asarray(step_local)),
+                (t_pad, feats),
+                wave_key + (tuple(mesh.devices.flat),), jit_fn=fn,
+            )
+        else:
+            out = profiler.call(
+                "joint", place_taskgroups_joint_jit,
+                (stacked, jnp.asarray(step_member),
+                 jnp.asarray(step_local)),
+                (t_pad, feats),
+                wave_key, jit_fn=place_taskgroups_joint_jit,
+            )
+        with tracer.span("kernel.d2h"):
+            host = jax.tree_util.tree_map(np.asarray, out)
+    finally:
+        with _INFLIGHT_LOCK:
+            _INFLIGHT_STARTS.pop(token, None)
+    wave_latency_ewma.update(time.perf_counter() - t_launch)
     results = []
     for i, k in enumerate(k_steps):
         o = offsets[i]
@@ -273,26 +414,88 @@ class _Request:
         self.event = threading.Event()
 
 
+class _PlanWindow:
+    """Context manager a batching worker wraps around plan submission:
+    the participant yields its rendezvous slot while it blocks on the
+    serialized applier, so the NEXT wave fires without waiting for it
+    (plan submission pipelines behind wave N instead of serializing
+    wave N+1)."""
+
+    __slots__ = ("_coalescer",)
+
+    def __init__(self, coalescer: "LaunchCoalescer") -> None:
+        self._coalescer = coalescer
+
+    def __enter__(self) -> "_PlanWindow":
+        self._coalescer.suspend()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._coalescer.resume()
+
+
 class LaunchCoalescer:
     """Rendezvous point for one batch of concurrently-scheduled evals.
 
-    Every participant must end with ``done()`` (use try/finally); a wave
-    fires whenever every not-yet-done participant is parked in
-    ``launch``. The observer that completes the rendezvous (a parking
-    launcher or a finishing participant) executes the device call
-    itself — there is no dispatcher thread.
+    Every participant must end with ``done()`` (use try/finally). A
+    wave fires when every not-yet-done (and not suspended) participant
+    is parked in ``launch`` — OR when a parked request's adaptive
+    deadline expires, in which case whatever is pending fires as a
+    partial wave and later arrivals form the next one. The deadline is
+    a fraction of the EWMA wave latency clamped to
+    ``[window_min_s, window_max_s]``: parking is only worth paying
+    while it stays small against the device call it amortizes. The
+    observer that completes the rendezvous (a parking launcher, a
+    finishing participant, or the deadline owner itself) executes the
+    device call — there is no dispatcher thread.
     """
 
-    def __init__(self, participants: int, mesh=None) -> None:
+    #: deadline = EWMA wave latency x this fraction (clamped)
+    WINDOW_FRACTION = 0.5
+
+    def __init__(self, participants: int, mesh=None,
+                 window_min_s: float = 0.001,
+                 window_max_s: float = 0.050,
+                 adaptive: bool = True) -> None:
         self._cv = threading.Condition()
         self._active = participants
         # the owning server's device mesh (None = module default)
         self.mesh = mesh
         self._pending: List[_Request] = []
+        self.window_min_s = window_min_s
+        self.window_max_s = window_max_s
+        self.adaptive = adaptive
         # stats (asserted by tests, reported by the worker)
         self.launches = 0
         self.requests = 0
         self.max_wave = 0
+        self.deadline_launches = 0
+
+    #: deadlines disarm while EWMA x fraction exceeds this multiple of
+    #: window_max: the device is grossly slower than the cap (cold
+    #: compiles in flight), and firing partial waves then SPRAYS more
+    #: cold compiles across fresh wave buckets instead of amortizing
+    #: one full-wave compile
+    TRANSIENT_FACTOR = 4.0
+
+    def _window_s(self) -> Optional[float]:
+        """Deadline for a parked request, or None to park until the
+        rendezvous completes (no latency sample yet, or the compile
+        transient is still running — both cases where fragmenting
+        waves costs far more than parking)."""
+        ewma = wave_latency_ewma.value
+        if ewma is None:
+            return None
+        target = ewma * self.WINDOW_FRACTION
+        if target > self.window_max_s * self.TRANSIENT_FACTOR:
+            return None
+        # an in-flight launch already running far past the cap is a
+        # cold compile the EWMA hasn't learned about yet — disarm
+        # before firing more partial waves into it
+        if _oldest_inflight_age_s() > \
+                self.window_max_s * self.TRANSIENT_FACTOR:
+            return None
+        return min(max(target, self.window_min_s), self.window_max_s)
 
     def launch(self, kin: KernelIn, k_steps: int,
                features: KernelFeatures) -> KernelOut:
@@ -308,11 +511,49 @@ class LaunchCoalescer:
             self._fire(wave)
         else:
             # parked: another member completes the rendezvous and runs
-            # the device call. Park time OVERLAPS the firing member's
-            # wave stages — the decomposition reports it separately and
-            # must not sum it with them
+            # the device call, or this member's deadline expires and it
+            # fires the partial wave itself. Park time OVERLAPS the
+            # firing member's wave stages — the decomposition reports
+            # it separately and must not sum it with them. The park
+            # span and the park-latency stat cover ONLY the waiting:
+            # a deadline owner's own launch work is attributed under
+            # wave.launch, never double-reported as parking.
+            t0 = time.perf_counter()
             with tracer.span("wave.park"):
-                req.event.wait()
+                if self.adaptive:
+                    fired = claimed = False
+                    while not (fired or claimed):
+                        window = self._window_s()
+                        if window is None:
+                            # disarmed (no latency sample yet, or a
+                            # compile transient in flight): park, and
+                            # poll at a coarse cadence so the deadline
+                            # re-arms once the transient clears
+                            fired = req.event.wait(0.05)
+                            continue
+                        fired = req.event.wait(window)
+                        if fired:
+                            break
+                        if self._window_s() is None:
+                            # a transient STARTED during the window
+                            # (e.g. another wave hit a cold compile):
+                            # do not fire a partial wave into it
+                            continue
+                        with self._cv:
+                            if req in self._pending:
+                                wave = self._pending
+                                self._pending = []
+                                self.deadline_launches += 1
+                        claimed = True
+                    if wave is None and not fired:
+                        # claimed by another member mid-timeout: wait
+                        # for its launch like any parked member
+                        req.event.wait()
+                else:
+                    req.event.wait()
+            wave_stats.observe_park(time.perf_counter() - t0)
+            if wave is not None:
+                self._fire(wave, deadline_fired=True)
         if req.error is not None:
             raise req.error
         return req.out
@@ -327,7 +568,28 @@ class LaunchCoalescer:
         if wave is not None:
             self._fire(wave)
 
-    def _fire(self, wave: List[_Request]) -> None:
+    def suspend(self) -> None:
+        """Temporarily yield this participant's rendezvous slot (it is
+        about to block outside the scheduling hot path, e.g. on the
+        plan applier). Pending requests stop waiting for it."""
+        wave: Optional[List[_Request]] = None
+        with self._cv:
+            self._active -= 1
+            if self._pending and len(self._pending) >= self._active:
+                wave = self._pending
+                self._pending = []
+        if wave is not None:
+            self._fire(wave)
+
+    def resume(self) -> None:
+        """Re-take the slot released by ``suspend``."""
+        with self._cv:
+            self._active += 1
+
+    def plan_window(self) -> _PlanWindow:
+        return _PlanWindow(self)
+
+    def _fire(self, wave: List[_Request], deadline_fired: bool = False) -> None:
         # members that retried after a partial-commit snapshot refresh
         # may have crossed a node-axis pad bucket; a joint launch needs
         # one node axis, so split by shape (each group still coalesces)
@@ -337,6 +599,7 @@ class LaunchCoalescer:
         for grp in groups.values():
             self.launches += 1
             self.max_wave = max(self.max_wave, len(grp))
+            wave_stats.observe_wave(len(grp), deadline_fired)
             try:
                 with tracer.span("wave.launch"):
                     outs = launch_wave(
@@ -354,44 +617,33 @@ class LaunchCoalescer:
                 r.event.set()
 
 
-# (store uid, usage structure version) -> ClusterTensors. The node
-# planes are node-static, so any snapshot whose node table hasn't
-# changed reuses the build across batches; a bounded LRU keeps at most
-# a handful of (store, version) entries alive (tests run many stores).
-_CLUSTER_LRU: "dict" = {}
 _CLUSTER_LRU_MAX = 8
-_CLUSTER_LOCK = threading.Lock()
 
 
 class ClusterCache:
     """ClusterTensors memo shared by a batch's evals.
 
-    Keyed by the snapshot's usage ``(uid, structure_version)`` when the
-    store publishes usage planes (any node add/remove/update bumps the
-    version), falling back to snapshot identity. Partial-commit retries
-    against an unchanged node table therefore reuse the same build.
-    """
+    When the store publishes usage planes, the process-wide
+    incremental cache serves the build: unchanged ``structure_version``
+    is an identity hit, a bumped one applies dirty-node deltas from
+    the store's change log instead of the full O(nodes) Python rebuild
+    every batch used to pay (tensors/schema.IncrementalClusterCache).
+    Snapshot-identity keying is the fallback for states without usage
+    planes (bare test harnesses)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._cache = {}
 
     def get(self, state):
-        from nomad_tpu.tensors.schema import ClusterTensors
+        from nomad_tpu.tensors.schema import (
+            ClusterTensors,
+            default_incremental_cluster_cache,
+        )
 
         u = getattr(state, "usage", None)
         if u is not None and u.uid:
-            key = (u.uid, u.structure_version)
-            with _CLUSTER_LOCK:
-                hit = _CLUSTER_LRU.get(key)
-                if hit is not None:
-                    return hit
-            built = ClusterTensors.build(state.nodes())
-            with _CLUSTER_LOCK:
-                _CLUSTER_LRU[key] = built
-                while len(_CLUSTER_LRU) > _CLUSTER_LRU_MAX:
-                    _CLUSTER_LRU.pop(next(iter(_CLUSTER_LRU)))
-            return built
+            return default_incremental_cluster_cache.get(state)
         key = id(state)
         with self._lock:
             hit = self._cache.get(key)

@@ -31,6 +31,7 @@ from nomad_tpu.ops.kernel import (
     neutral_port_words,
     neutral_step_planes,
     pad_steps,
+    pad_steps_live,
     place_taskgroup_jit,
 )
 from nomad_tpu.scheduler.context import EvalContext
@@ -122,7 +123,11 @@ class XLAGenericStack:
         c = self.cluster
         snapshot = self.ctx.state
         k = len(requests)
-        k_pad = pad_steps(k)
+        # live launches floor the step bucket (ops/kernel.pad_steps_live)
+        # so follow-up evals placing a couple of leftover allocs reuse
+        # the primary evals' compiled programs instead of forking tiny
+        # per-k variants
+        k_pad = pad_steps_live(k)
 
         node_perm = None
         if self.shuffle_seed is not None:

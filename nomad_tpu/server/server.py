@@ -61,6 +61,11 @@ class ServerConfig:
         vault_token: str = "",
         vault_token_role: str = "",
         gc_tuning: bool = True,
+        kernel_warmup: Optional[bool] = None,
+        warmup_manifest_path: str = "",
+        coalesce_window_min_ms: float = 1.0,
+        coalesce_window_max_ms: float = 50.0,
+        coalesce_adaptive: bool = True,
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -93,6 +98,19 @@ class ServerConfig:
         # interpreter-GC treatment for long-running servers (see
         # Server._tune_interpreter_gc); tests and embedders can opt out
         self.gc_tuning = gc_tuning
+        # AOT kernel warmup (ops/warmup.py): None = auto (warm when a
+        # manifest exists), True forces, False disables. The manifest
+        # is persisted from the kernel profiler's observed bucket keys
+        # on shutdown when telemetry ran.
+        self.kernel_warmup = kernel_warmup
+        self.warmup_manifest_path = warmup_manifest_path
+        # adaptive wave-coalescer window bounds (seconds derive from
+        # ms knobs; parallel/coalesce.LaunchCoalescer): the rendezvous
+        # fires a partial wave once a parked eval has waited
+        # clamp(EWMA_wave_latency/2, min, max)
+        self.coalesce_window_min_ms = coalesce_window_min_ms
+        self.coalesce_window_max_ms = coalesce_window_max_ms
+        self.coalesce_adaptive = coalesce_adaptive
 
 
 class _EvalCommitBatch:
@@ -238,6 +256,7 @@ class Server:
         self._shutdown.clear()
         self._tune_interpreter_gc()
         self._maybe_configure_wave_mesh()
+        self._maybe_start_kernel_warmup()
         self.vault.start()
         if self.raft is not None:
             self.raft.start()
@@ -304,6 +323,69 @@ class Server:
         threading.Thread(target=maintain, daemon=True,
                          name="interpreter-gc").start()
 
+    def _maybe_start_kernel_warmup(self) -> None:
+        """AOT-precompile the placement-kernel bucket lattice recorded
+        in the warmup manifest (ops/warmup.py) on a background thread,
+        so steady-state evals never hit a cold XLA compile. kernel
+        warmup=None (auto) warms whenever a manifest exists; True
+        forces (a missing manifest is then just zero entries); False
+        disables."""
+        self._warmup_thread = None
+        if self.config.kernel_warmup is False:
+            return
+        path = self.config.warmup_manifest_path
+        if not path:
+            from nomad_tpu.ops.warmup import DEFAULT_MANIFEST_PATH
+
+            path = DEFAULT_MANIFEST_PATH
+        if self.config.kernel_warmup is None and not os.path.exists(path):
+            return
+        try:
+            from nomad_tpu.ops.warmup import start_background_warmup
+            from nomad_tpu.server.worker import Worker
+
+            # expand up to this server's own LAUNCHABLE wave ceiling: a
+            # manifest recorded under partial waves still covers the
+            # full waves these workers fire. Batches above MAX_WAVE
+            # split into MAX_WAVE chunks, so bigger buckets are
+            # unreachable and not worth tens of seconds of compile
+            self._warmup_thread = start_background_warmup(
+                path, max_wave=max(
+                    min(self.config.worker_batch_size, Worker.MAX_WAVE),
+                    1))
+        except Exception as e:                  # noqa: BLE001
+            LOG.warning("kernel warmup unavailable: %s", e)
+
+    def _maybe_persist_warmup_manifest(self) -> None:
+        """Union the profiler's observed bucket keys into the warmup
+        manifest so the NEXT server start precompiles what this one
+        actually launched. Only when kernel profiling ran (the profiler
+        records keys only while enabled) and a manifest path is
+        configured — or warmup is forced on, which falls back to the
+        default path (auto mode never writes the default path: test
+        suites start hundreds of short-lived servers and must not
+        seed a machine-global manifest as a side effect)."""
+        if self.config.kernel_warmup is False:
+            return
+        path = self.config.warmup_manifest_path
+        if not path:
+            if self.config.kernel_warmup is not True:
+                return
+            from nomad_tpu.ops.warmup import DEFAULT_MANIFEST_PATH
+
+            path = DEFAULT_MANIFEST_PATH
+        try:
+            from nomad_tpu.ops.warmup import (
+                manifest_from_profiler,
+                save_manifest,
+            )
+
+            entries = manifest_from_profiler()
+            if entries:
+                save_manifest(entries, path, merge=True)
+        except Exception as e:                  # noqa: BLE001
+            LOG.warning("warmup manifest persist failed: %s", e)
+
     def _maybe_configure_wave_mesh(self) -> None:
         """Wire live placement waves onto the device mesh (the §2.10
         node-axis-over-ICI mapping) when the environment has one.
@@ -363,6 +445,7 @@ class Server:
     def shutdown(self) -> None:
         self._shutdown.set()
         self.wave_mesh = None
+        self._maybe_persist_warmup_manifest()
         self.vault.stop()
         for w in self.workers:
             w.stop()

@@ -51,11 +51,18 @@ class _EvalRun:
     concurrently; the single-eval path uses it too.
     """
 
-    def __init__(self, server, ev: Evaluation, token: str, snapshot) -> None:
+    def __init__(self, server, ev: Evaluation, token: str, snapshot,
+                 plan_window=None) -> None:
         self.server = server
         self.eval = ev
         self.token = token
         self.snapshot = snapshot
+        # batching workers install the coalescer's plan window here:
+        # while this eval blocks on the serialized applier it yields
+        # its wave-rendezvous slot, so the NEXT wave fires without
+        # waiting for plan submission (plan submit pipelines behind
+        # wave N instead of serializing wave N+1)
+        self.plan_window = plan_window
 
     # --- Planner interface ---------------------------------------------
 
@@ -63,7 +70,11 @@ class _EvalRun:
         plan.eval_id = self.eval.id
         plan.eval_token = self.token
         plan.snapshot_index = self.snapshot.latest_index()
-        result = self.server.submit_plan(plan)
+        if self.plan_window is not None:
+            with self.plan_window:
+                result = self.server.submit_plan(plan)
+        else:
+            result = self.server.submit_plan(plan)
         state = None
         if result is not None and result.refresh_index > 0:
             # partial commit: hand the scheduler a newer snapshot to
@@ -191,7 +202,8 @@ class Worker:
                     pass
 
     def _process(self, ev: Evaluation, token: str,
-                 snapshot=None, launcher=None, cluster_provider=None) -> None:
+                 snapshot=None, launcher=None, cluster_provider=None,
+                 plan_window=None) -> None:
         with self._live_lock:
             self._live[ev.id] = token
         try:
@@ -208,7 +220,8 @@ class Worker:
                 # blocked evals derived from this one inherit the stamp
                 ev = ev.copy()
                 ev.snapshot_index = snapshot.latest_index()
-                run = _EvalRun(self.server, ev, token, snapshot)
+                run = _EvalRun(self.server, ev, token, snapshot,
+                               plan_window=plan_window)
                 if ev.type == consts.JOB_TYPE_CORE:
                     sched = self.server.new_core_scheduler(snapshot, run)
                 else:
@@ -301,8 +314,13 @@ class Worker:
             if len(in_flight) >= 2:
                 reap(in_flight.pop(0))
             chunk = batch[start:start + self.MAX_WAVE]
+            cfg = self.server.config
             coalescer = LaunchCoalescer(
-                len(chunk), mesh=getattr(self.server, "wave_mesh", None))
+                len(chunk), mesh=getattr(self.server, "wave_mesh", None),
+                window_min_s=cfg.coalesce_window_min_ms / 1e3,
+                window_max_s=cfg.coalesce_window_max_ms / 1e3,
+                adaptive=cfg.coalesce_adaptive,
+            )
 
             def one(ev: Evaluation, token: str,
                     coalescer=coalescer) -> None:
@@ -313,6 +331,7 @@ class Worker:
                             snapshot=snapshot,
                             launcher=coalescer.launch,
                             cluster_provider=clusters.get,
+                            plan_window=coalescer.plan_window(),
                         )
                 finally:
                     coalescer.done()

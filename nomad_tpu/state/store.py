@@ -744,7 +744,7 @@ class StateStore:
                 node.create_index = idx
             self._nodes[node.id] = node
             self.usage.node_row(node.id)
-            self.usage.note_node_change()
+            self.usage.note_node_change(node.id)
         self._notify(["nodes"], idx)
         return idx
 
@@ -767,7 +767,7 @@ class StateStore:
                 node.status = status
                 node.modify_index = idx
                 self._nodes[node_id] = node
-                self.usage.note_node_change()
+                self.usage.note_node_change(node_id)
         self._notify(["nodes"], idx)
         return idx
 
@@ -781,7 +781,7 @@ class StateStore:
                 node.scheduling_eligibility = eligibility
                 node.modify_index = idx
                 self._nodes[node_id] = node
-                self.usage.note_node_change()
+                self.usage.note_node_change(node_id)
         self._notify(["nodes"], idx)
         return idx
 
@@ -803,7 +803,7 @@ class StateStore:
                     node.scheduling_eligibility = consts.NODE_SCHEDULING_ELIGIBLE
                 node.modify_index = idx
                 self._nodes[node_id] = node
-                self.usage.note_node_change()
+                self.usage.note_node_change(node_id)
         self._notify(["nodes"], idx)
         return idx
 

@@ -22,12 +22,19 @@ set/rows change (add/remove/update), ``version`` on every mutation.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, List, Optional
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from nomad_tpu.tensors.schema import pad_bucket
+
+#: node-change log length. Long enough to span the structural churn
+#: between two scheduling batches (heartbeat status flaps, a rolling
+#: node update); a consumer that finds its last-seen version older
+#: than the log's tail falls back to a full rebuild.
+NODE_LOG_MAX = 1024
 
 
 @dataclass
@@ -44,6 +51,12 @@ class UsagePlanes:
     version: int = 0
     structure_version: int = 0
     uid: str = ""                            # owning store's identity
+    #: (structure_version, node_id) per structural change, oldest
+    #: first; node_id None poisons the log (full rebuild required —
+    #: restore/rebuild paths). Consumed by the incremental
+    #: ClusterTensors cache (tensors/schema.py) to re-flatten only
+    #: dirty node rows on snapshot refresh.
+    node_events: Tuple = field(default=())
 
 
 class UsageIndex:
@@ -64,6 +77,8 @@ class UsageIndex:
         self.used_mbits = np.zeros(0, np.int32)
         self.version = 0
         self.structure_version = 0
+        # structural change log: (structure_version, node_id or None)
+        self.node_log: deque = deque(maxlen=NODE_LOG_MAX)
         # planes_copy cache: reused until the next mutation; guarded by
         # the owning store's lock (all callers hold it)
         self._copy: Optional[UsagePlanes] = None
@@ -94,13 +109,16 @@ class UsageIndex:
             self._grow(len(self.ids))
         self.ids[row] = node_id
         self.rows[node_id] = row
-        self._touch(structural=True)
+        self._touch(structural=True, node_id=node_id)
         return row
 
-    def note_node_change(self) -> None:
+    def note_node_change(self, node_id: Optional[str] = None) -> None:
         """A node row was replaced in the store (status/resources may
-        differ): invalidate structure-keyed caches (ClusterTensors)."""
-        self._touch(structural=True)
+        differ): invalidate structure-keyed caches (ClusterTensors).
+        ``node_id`` feeds the change log so those caches can re-flatten
+        just the dirty row; None (unknown provenance) poisons the log
+        and forces the next consumer to rebuild fully."""
+        self._touch(structural=True, node_id=node_id)
 
     def drop_node(self, node_id: str) -> None:
         row = self.rows.pop(node_id, None)
@@ -111,7 +129,7 @@ class UsageIndex:
         for name in ("used_cpu", "used_mem", "used_disk",
                      "used_cores", "used_mbits"):
             getattr(self, name)[row] = 0
-        self._touch(structural=True)
+        self._touch(structural=True, node_id=node_id)
 
     # -- alloc transitions ----------------------------------------------
 
@@ -163,10 +181,12 @@ class UsageIndex:
 
     # -- reads -----------------------------------------------------------
 
-    def _touch(self, structural: bool = False) -> None:
+    def _touch(self, structural: bool = False,
+               node_id: Optional[str] = None) -> None:
         self.version += 1
         if structural:
             self.structure_version += 1
+            self.node_log.append((self.structure_version, node_id))
         self._copy = None
 
     def planes_copy(self) -> UsagePlanes:
@@ -188,5 +208,6 @@ class UsageIndex:
             version=self.version,
             structure_version=self.structure_version,
             uid=self.uid,
+            node_events=tuple(self.node_log),
         )
         return self._copy

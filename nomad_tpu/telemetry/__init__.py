@@ -48,6 +48,16 @@ def enabled() -> bool:
 def reset() -> None:
     tracer.reset()
     profiler.reset()
+    try:
+        # wave-shape stats (fill ratio, park latency) live with the
+        # coalescer; reset them with the rest so burst decompositions
+        # cover exactly their window. Import is lazy/guarded: telemetry
+        # must stay importable without jax.
+        from nomad_tpu.parallel.coalesce import wave_stats
+
+        wave_stats.reset()
+    except Exception:                           # noqa: BLE001
+        pass
 
 
 if os.environ.get("NOMAD_TPU_TRACE", "") not in ("", "0"):

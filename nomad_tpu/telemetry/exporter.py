@@ -76,6 +76,31 @@ def prometheus_text(registry=None) -> str:
             lines.append(
                 f"nomad_tpu_kernel_launches_total{{{labels}}} "
                 f"{row['Launches']}")
+    # wave-shape series (parallel/coalesce.wave_stats): fill ratio says
+    # whether the adaptive coalescer fires full or starved waves; park
+    # latency percentiles are the rendezvous cost its deadline bounds
+    try:
+        from nomad_tpu.parallel.coalesce import wave_stats
+
+        w = wave_stats.snapshot()
+        lines.append("# TYPE nomad_tpu_wave_fill_ratio gauge")
+        lines.append(f"nomad_tpu_wave_fill_ratio {w['fill_ratio']:.4f}")
+        lines.append("# TYPE nomad_tpu_wave_park_latency_seconds gauge")
+        lines.append(
+            'nomad_tpu_wave_park_latency_seconds{quantile="0.5"} '
+            f"{w['park_latency_p50_ms'] / 1e3:.6f}")
+        lines.append(
+            'nomad_tpu_wave_park_latency_seconds{quantile="0.99"} '
+            f"{w['park_latency_p99_ms'] / 1e3:.6f}")
+        lines.append("# TYPE nomad_tpu_wave_launches_total counter")
+        lines.append(
+            'nomad_tpu_wave_launches_total{fired="full"} '
+            f"{w['full_launches']}")
+        lines.append(
+            'nomad_tpu_wave_launches_total{fired="deadline"} '
+            f"{w['deadline_launches']}")
+    except Exception:                           # noqa: BLE001
+        pass                # coalescer (jax) unavailable: skip series
     lines.append(
         "# TYPE nomad_tpu_telemetry_enabled gauge")
     lines.append(

@@ -100,6 +100,12 @@ class KernelProfiler:
             return sum(n for (k, _), n in self._misses.items()
                        if k == kernel)
 
+    def keys(self) -> list:
+        """Every (kernel, bucket-key) ever launched since reset — the
+        raw material of the AOT warmup manifest (ops/warmup.py)."""
+        with self._lock:
+            return list(self._launches)
+
     # --- the profiled launch -------------------------------------------
 
     def call(self, kernel: str, fn: Callable, dev_args: tuple,

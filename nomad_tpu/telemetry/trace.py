@@ -174,6 +174,7 @@ class Tracer:
     def __init__(self, capacity: int = 16384) -> None:
         self._enabled = False
         self._lock = threading.Lock()
+        self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
         # name -> [count, total_s, exclusive_s, cpu_s, exclusive_cpu_s]
         self._agg: Dict[str, List[float]] = {}

@@ -36,6 +36,13 @@ class AskLimitError(ValueError):
     spread stanzas). The reference has no such limits (iterators are
     unbounded); the tensor formulation trades that for static shapes."""
 
+
+import threading as _threading  # noqa: E402
+
+#: guards ClusterTensors' identity-shared lazy caches (gathered usage
+#: planes): identity sharing is load-bearing for wave upload layout
+_GATHER_LOCK = _threading.Lock()
+
 _MIN_BUCKET = 64
 
 
@@ -83,6 +90,9 @@ class ClusterTensors:
     _class_rows: Optional[Dict[str, List[int]]] = None
 
     _gathered_usage: Optional[Tuple[int, tuple]] = None
+    #: guards _gathered_usage recomputes (see gathered_usage); set by
+    #: the builders, None falls back to the module-wide _GATHER_LOCK
+    _gather_lock: Optional[object] = None
 
     def gathered_usage(self, usage) -> tuple:
         """(used_cpu, used_mem, used_disk, used_cores, used_mbits)
@@ -90,23 +100,36 @@ class ClusterTensors:
         ``version`` and shared by identity across every eval scheduled
         against that snapshot. The wave launcher ships identity-shared
         planes to the device ONCE per wave instead of once per member;
-        mutators (retry bookkeeping) must copy-on-write."""
+        mutators (retry bookkeeping) must copy-on-write.
+
+        The recompute is double-checked under a lock: identity IS the
+        contract here — two eval threads racing a version bump used to
+        each build their own (equal) tuples, the wave launcher saw
+        distinct objects, fell back to the stacked layout, and
+        compiled a whole extra XLA variant for one batch. The lock is
+        per-instance where the builders install one (the race is
+        per-instance); the module lock is only the fallback for
+        directly-constructed instances (bench synthetics)."""
         cached = self._gathered_usage
         if cached is not None and cached[0] == usage.version:
             return cached[1]
-        version = usage.version
-        perm, valid = self.usage_perm(usage)
-        planes = (
-            np.where(valid, usage.used_cpu[perm], 0.0).astype(np.float32),
-            np.where(valid, usage.used_mem[perm], 0.0).astype(np.float32),
-            np.where(valid, usage.used_disk[perm], 0.0).astype(np.float32),
-            np.where(valid, usage.used_cores[perm], 0).astype(np.int32),
-            np.where(valid, usage.used_mbits[perm], 0).astype(np.int32),
-        )
-        for p in planes:
-            p.setflags(write=False)
-        object.__setattr__(self, "_gathered_usage", (version, planes))
-        return planes
+        with (self._gather_lock or _GATHER_LOCK):
+            cached = self._gathered_usage
+            if cached is not None and cached[0] == usage.version:
+                return cached[1]
+            version = usage.version
+            perm, valid = self.usage_perm(usage)
+            planes = (
+                np.where(valid, usage.used_cpu[perm], 0.0).astype(np.float32),
+                np.where(valid, usage.used_mem[perm], 0.0).astype(np.float32),
+                np.where(valid, usage.used_disk[perm], 0.0).astype(np.float32),
+                np.where(valid, usage.used_cores[perm], 0).astype(np.int32),
+                np.where(valid, usage.used_mbits[perm], 0).astype(np.int32),
+            )
+            for p in planes:
+                p.setflags(write=False)
+            object.__setattr__(self, "_gathered_usage", (version, planes))
+            return planes
 
     def class_rows(self) -> Dict[str, List[int]]:
         """computed class -> real-node rows, cached on the cluster build
@@ -151,60 +174,120 @@ class ClusterTensors:
             object.__setattr__(self, "_pool_arr", pool)
         return self._dc_arr, self._pool_arr
 
+    def _flatten_row(self, i: int, node) -> None:
+        """Flatten one structs.Node into row ``i`` of the plane arrays
+        (shared by the full build and the dirty-row delta path). The
+        NetworkIndex port scan here is the dominant per-node cost of a
+        cluster build — exactly what the delta path avoids paying for
+        unchanged nodes."""
+        from nomad_tpu.structs.network import NetworkIndex
+
+        res = node.node_resources
+        rsv = node.reserved_resources
+        self.cap_cpu[i] = max(res.cpu.cpu_shares - rsv.cpu_shares, 0)
+        self.cap_mem[i] = max(res.memory.memory_mb - rsv.memory_mb, 0)
+        self.cap_disk[i] = max(res.disk.disk_mb - rsv.disk_mb, 0)
+        self.ready[i] = node.ready()
+        idx = NetworkIndex()
+        idx.set_node(node)
+        w64 = idx.port_words()            # u64[1024]
+        self.port_words[i] = w64.view(np.uint32)
+        self.free_dyn[i] = idx.free_dynamic_count()
+        self.free_cores[i] = len(
+            set(res.cpu.reservable_cpu_cores) - set(rsv.reserved_cpu_cores)
+        )
+        self.shares_per_core[i] = res.cpu.shares_per_core()
+        self.avail_mbits[i] = sum(net.mbits for net in res.networks)
+        self.node_ids[i] = node.id
+        self.datacenters[i] = node.datacenter
+        self.node_classes[i] = node.node_class
+        self.computed_classes[i] = node.computed_class or node.compute_class()
+        self.node_pools[i] = node.node_pool
+
+    @classmethod
+    def _empty(cls, n: int, npad: int) -> "ClusterTensors":
+        return cls(
+            n_real=n, n_pad=npad,
+            node_ids=[""] * n, index={},
+            cap_cpu=np.zeros(npad, np.float32),
+            cap_mem=np.zeros(npad, np.float32),
+            cap_disk=np.zeros(npad, np.float32),
+            ready=np.zeros(npad, bool),
+            port_words=np.zeros((npad, PORT_WORDS), np.uint32),
+            free_dyn=np.zeros(npad, np.int32),
+            free_cores=np.zeros(npad, np.int32),
+            shares_per_core=np.zeros(npad, np.float32),
+            datacenters=[""] * n, node_classes=[""] * n,
+            computed_classes=[""] * n, node_pools=[""] * n,
+            avail_mbits=np.zeros(npad, np.int32),
+            _gather_lock=_threading.Lock(),
+        )
+
     @classmethod
     def build(cls, nodes: Sequence) -> "ClusterTensors":
         """Flatten structs.Node rows. Nodes keep their given order; the
         caller owns any shuffling (reference util.go:464 shuffleNodes is
         unnecessary under global argmax selection)."""
-        from nomad_tpu.structs.network import NetworkIndex
+        n = len(nodes)
+        out = cls._empty(n, pad_bucket(n))
+        for i, node in enumerate(nodes):
+            out._flatten_row(i, node)
+        out.index = {nid: i for i, nid in enumerate(out.node_ids)}
+        out.nodes_by_id = {nd.id: nd for nd in nodes}
+        return out
 
+    _PLANE_FIELDS = ("cap_cpu", "cap_mem", "cap_disk", "ready",
+                     "port_words", "free_dyn", "free_cores",
+                     "shares_per_core", "avail_mbits")
+    _RAGGED_FIELDS = ("node_ids", "datacenters", "node_classes",
+                      "computed_classes", "node_pools")
+
+    def rebuild_delta(self, nodes: Sequence,
+                      changed_ids) -> Optional["ClusterTensors"]:
+        """A fresh ClusterTensors for the new node table, re-flattening
+        ONLY the rows in ``changed_ids`` (plus additions); every other
+        row is gathered from this build by numpy memcpy. Returns None
+        when a delta is not worth it or not possible (pad-bucket
+        change, or more than half the rows dirty) — the caller falls
+        back to ``build``.
+
+        The result is bit-identical to ``ClusterTensors.build(nodes)``:
+        unchanged rows were computed from the same node objects (the
+        store's change log guarantees untouched ids kept their rows'
+        inputs), additions/removals reproduce the store's dict-order
+        compaction, and dirty rows run the same flatten."""
         n = len(nodes)
         npad = pad_bucket(n)
-        cap_cpu = np.zeros(npad, np.float32)
-        cap_mem = np.zeros(npad, np.float32)
-        cap_disk = np.zeros(npad, np.float32)
-        ready = np.zeros(npad, bool)
-        port_words = np.zeros((npad, PORT_WORDS), np.uint32)
-        free_dyn = np.zeros(npad, np.int32)
-        free_cores = np.zeros(npad, np.int32)
-        spc = np.zeros(npad, np.float32)
-        avail_mbits = np.zeros(npad, np.int32)
-        ids, dcs, classes, cclasses, pools = [], [], [], [], []
-
-        for i, node in enumerate(nodes):
-            res = node.node_resources
-            rsv = node.reserved_resources
-            cap_cpu[i] = max(res.cpu.cpu_shares - rsv.cpu_shares, 0)
-            cap_mem[i] = max(res.memory.memory_mb - rsv.memory_mb, 0)
-            cap_disk[i] = max(res.disk.disk_mb - rsv.disk_mb, 0)
-            ready[i] = node.ready()
-            idx = NetworkIndex()
-            idx.set_node(node)
-            w64 = idx.port_words()            # u64[1024]
-            port_words[i] = w64.view(np.uint32)
-            free_dyn[i] = idx.free_dynamic_count()
-            free_cores[i] = len(
-                set(res.cpu.reservable_cpu_cores) - set(rsv.reserved_cpu_cores)
-            )
-            spc[i] = res.cpu.shares_per_core()
-            avail_mbits[i] = sum(net.mbits for net in res.networks)
-            ids.append(node.id)
-            dcs.append(node.datacenter)
-            classes.append(node.node_class)
-            cclasses.append(node.computed_class or node.compute_class())
-            pools.append(node.node_pool)
-
-        return cls(
-            n_real=n, n_pad=npad, node_ids=ids,
-            index={nid: i for i, nid in enumerate(ids)},
-            cap_cpu=cap_cpu, cap_mem=cap_mem, cap_disk=cap_disk,
-            ready=ready, port_words=port_words, free_dyn=free_dyn,
-            free_cores=free_cores, shares_per_core=spc,
-            datacenters=dcs, node_classes=classes,
-            computed_classes=cclasses, node_pools=pools,
-            avail_mbits=avail_mbits,
-            nodes_by_id={n.id: n for n in nodes},
-        )
+        if npad != self.n_pad:
+            return None
+        if self.n_real == 0:
+            # nothing to gather from (the ragged lists are empty, so
+            # even placeholder row indices for stale rows would be out
+            # of range); a fresh build of a tiny cluster is cheap
+            return None
+        stale: List[int] = []
+        perm = np.zeros(n, np.int64)
+        for j, node in enumerate(nodes):
+            i = self.index.get(node.id, -1)
+            if i < 0 or node.id in changed_ids:
+                stale.append(j)
+            else:
+                perm[j] = i
+        if len(stale) > max(n // 2, 8):
+            return None
+        out = ClusterTensors._empty(n, npad)
+        for f in self._PLANE_FIELDS:
+            old = getattr(self, f)
+            new = getattr(out, f)
+            new[:n] = old[perm]
+        for f in self._RAGGED_FIELDS:
+            old = getattr(self, f)
+            setattr(out, f, [old[i] for i in perm])
+        for j in stale:
+            out._flatten_row(j, nodes[j])
+        out.index = {nid: i for i, nid in enumerate(out.node_ids)}
+        out.nodes_by_id = {nd.id: nd for nd in nodes}
+        return out
 
 
 @dataclass
@@ -317,3 +400,106 @@ class EvalTensors:
     ask: AskTensor
     desired_count: int               # tg.count (anti-affinity denominator)
     algorithm: str = "binpack"       # binpack | spread (cluster config)
+
+
+class IncrementalClusterCache:
+    """ClusterTensors cache keyed on the state store's identity, with
+    dirty-node delta refresh.
+
+    The batching worker used to pay a full O(nodes) Python rebuild
+    (NetworkIndex port scan per node) every batch whose snapshot's
+    ``structure_version`` moved — and on a live cluster it moves every
+    heartbeat-driven status write. This cache replays the usage
+    index's node-change log (state/usage.py ``node_events``) between
+    the cached build's version and the snapshot's, re-flattening only
+    the logged rows (``ClusterTensors.rebuild_delta``). A poisoned or
+    trimmed log, a pad-bucket change, or majority churn falls back to
+    the full build. Delta results are bit-identical to a fresh build
+    and keyed per (uid, structure_version), so wave members keep
+    sharing one object by identity."""
+
+    def __init__(self, max_entries: int = 8) -> None:
+        self._lock = _threading.Lock()
+        #: (uid, structure_version) -> ClusterTensors. Versioned keys
+        #: matter: a batch still scheduling against an OLDER snapshot
+        #: than the newest cached one must keep getting one identical
+        #: object per call (identity sharing is the wave launcher's
+        #: upload layout), not a fresh rebuild per eval.
+        self._entries: Dict[Tuple[str, int], ClusterTensors] = {}
+        #: uid -> newest cached structure_version (the delta base)
+        self._latest: Dict[str, int] = {}
+        self.max_entries = max_entries
+        # observability (asserted by tests, handy under a profiler)
+        self.hits = 0
+        self.delta_builds = 0
+        self.full_builds = 0
+
+    def get(self, state) -> ClusterTensors:
+        u = getattr(state, "usage", None)
+        if u is None or not u.uid:
+            self.full_builds += 1
+            return ClusterTensors.build(state.nodes())
+        key = (u.uid, u.structure_version)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self.hits += 1
+                return hit
+            base_sv = self._latest.get(u.uid)
+            base = (self._entries.get((u.uid, base_sv))
+                    if base_sv is not None else None)
+        nodes = state.nodes()
+        built: Optional[ClusterTensors] = None
+        if base is not None and base_sv < u.structure_version:
+            changed = self._changed_since(
+                getattr(u, "node_events", ()), base_sv)
+            if changed is not None:
+                built = base.rebuild_delta(nodes, changed)
+        if built is not None:
+            self.delta_builds += 1
+        else:
+            built = ClusterTensors.build(nodes)
+            self.full_builds += 1
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                # a racing thread cached this exact version first: keep
+                # ITS object so every caller of the version shares one
+                return hit
+            self._entries[key] = built
+            if u.structure_version >= self._latest.get(u.uid, -1):
+                self._latest[u.uid] = u.structure_version
+            while len(self._entries) > self.max_entries:
+                old_key = next(iter(self._entries))
+                self._entries.pop(old_key)
+                if self._latest.get(old_key[0]) == old_key[1]:
+                    self._latest.pop(old_key[0], None)
+        return built
+
+    @staticmethod
+    def _changed_since(events, since_sv: int):
+        """Node ids changed after ``since_sv`` per the log, or None
+        when the log cannot prove completeness (poison entry, trimmed
+        tail, or no events despite a version bump)."""
+        if not events:
+            return None
+        changed = set()
+        seen_floor = None
+        for sv, nid in events:
+            if seen_floor is None:
+                seen_floor = sv
+            if sv <= since_sv:
+                continue
+            if nid is None:
+                return None
+            changed.add(nid)
+        # the log's oldest entry must not postdate the gap start, or
+        # trimmed entries may hide changes
+        if seen_floor is None or seen_floor > since_sv + 1:
+            return None
+        return changed
+
+
+#: process-wide incremental cache (the batching worker's
+#: cluster_provider and the direct scheduler path both consult it)
+default_incremental_cluster_cache = IncrementalClusterCache()

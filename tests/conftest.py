@@ -50,3 +50,10 @@ def pytest_configure(config):
         "markers",
         "stress: contention-repetition tier (pytest -m stress); "
         "always paired with slow")
+    # buffer-donation misalignment is silent perf debt (XLA ignores the
+    # donation and warns); promote it to an error so a donate_argnums
+    # edit that can't alias its outputs fails the suite instead of
+    # regressing quietly (ISSUE 2 satellite)
+    config.addinivalue_line(
+        "filterwarnings",
+        "error:Some donated buffers were not usable")

@@ -1,0 +1,190 @@
+"""Incremental ClusterTensors (ISSUE 2 tentpole part 2): the
+dirty-node delta path must be bit-identical to a fresh build after any
+sequence of node add / drain / resource-change / status / delete, and
+the cache must actually serve hits and deltas instead of full rebuilds.
+"""
+
+import numpy as np
+import numpy.testing as npt
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.tensors.schema import (
+    ClusterTensors,
+    IncrementalClusterCache,
+)
+
+
+def assert_cluster_equal(got: ClusterTensors, want: ClusterTensors):
+    assert got.n_real == want.n_real
+    assert got.n_pad == want.n_pad
+    for f in ClusterTensors._PLANE_FIELDS:
+        npt.assert_array_equal(getattr(got, f), getattr(want, f),
+                               err_msg=f)
+    for f in ClusterTensors._RAGGED_FIELDS:
+        assert getattr(got, f) == getattr(want, f), f
+    assert got.index == want.index
+    assert set(got.nodes_by_id) == set(want.nodes_by_id)
+
+
+@pytest.fixture()
+def store():
+    s = StateStore()
+    for _ in range(24):
+        s.upsert_node(mock.node())
+    return s
+
+
+class TestDeltaParity:
+    def test_resource_change_delta_matches_fresh_build(self, store):
+        cache = IncrementalClusterCache()
+        cache.get(store.snapshot())
+        node = store.snapshot().nodes()[5].copy()
+        node.node_resources.cpu.cpu_shares = 12345
+        node.node_resources.memory.memory_mb = 4096
+        store.upsert_node(node)
+        snap = store.snapshot()
+        got = cache.get(snap)
+        assert cache.delta_builds == 1
+        assert_cluster_equal(got, ClusterTensors.build(snap.nodes()))
+
+    def test_drain_and_status_delta(self, store):
+        cache = IncrementalClusterCache()
+        cache.get(store.snapshot())
+        nodes = store.snapshot().nodes()
+        store.update_node_drain(nodes[2].id, True)
+        store.update_node_status(nodes[9].id, "down")
+        snap = store.snapshot()
+        got = cache.get(snap)
+        assert cache.delta_builds == 1
+        fresh = ClusterTensors.build(snap.nodes())
+        assert_cluster_equal(got, fresh)
+        # the drained/down rows really flipped
+        assert not got.ready[2]
+        assert not got.ready[9]
+
+    def test_add_and_delete_delta(self, store):
+        cache = IncrementalClusterCache()
+        cache.get(store.snapshot())
+        nodes = store.snapshot().nodes()
+        store.delete_node(nodes[7].id)
+        store.upsert_node(mock.node())
+        store.upsert_node(mock.node())
+        snap = store.snapshot()
+        got = cache.get(snap)
+        assert cache.delta_builds == 1
+        assert_cluster_equal(got, ClusterTensors.build(snap.nodes()))
+
+    def test_random_mutation_sequences(self, store):
+        """Property-style: random interleavings of add / drain /
+        resource-change / status / delete, parity after every batch."""
+        rng = np.random.default_rng(11)
+        cache = IncrementalClusterCache()
+        cache.get(store.snapshot())
+        for _round in range(6):
+            for _ in range(int(rng.integers(1, 4))):
+                nodes = store.snapshot().nodes()
+                op = rng.integers(0, 5)
+                pick = nodes[int(rng.integers(0, len(nodes)))]
+                if op == 0:
+                    store.upsert_node(mock.node())
+                elif op == 1 and len(nodes) > 4:
+                    store.delete_node(pick.id)
+                elif op == 2:
+                    n = pick.copy()
+                    n.node_resources.cpu.cpu_shares = int(
+                        rng.integers(1000, 9000))
+                    store.upsert_node(n)
+                elif op == 3:
+                    store.update_node_drain(pick.id,
+                                            bool(rng.integers(0, 2)))
+                else:
+                    store.update_node_status(
+                        pick.id, "down" if rng.integers(0, 2) else "ready")
+            snap = store.snapshot()
+            got = cache.get(snap)
+            assert_cluster_equal(got, ClusterTensors.build(snap.nodes()))
+        assert cache.delta_builds >= 4
+
+    def test_empty_base_falls_back_to_full_build(self):
+        """A cluster snapshotted before any node registers caches an
+        empty build; the first nodes arriving must take the full-build
+        path (there are no rows to gather from)."""
+        s = StateStore()
+        cache = IncrementalClusterCache()
+        empty = cache.get(s.snapshot())
+        assert empty.n_real == 0
+        for _ in range(4):
+            s.upsert_node(mock.node())
+        snap = s.snapshot()
+        got = cache.get(snap)
+        assert got.n_real == 4
+        assert_cluster_equal(got, ClusterTensors.build(snap.nodes()))
+
+    def test_pad_bucket_growth_falls_back_to_full_build(self):
+        s = StateStore()
+        for _ in range(60):
+            s.upsert_node(mock.node())
+        cache = IncrementalClusterCache()
+        cache.get(s.snapshot())        # n_pad 64
+        for _ in range(10):            # crosses into the 128 bucket
+            s.upsert_node(mock.node())
+        snap = s.snapshot()
+        got = cache.get(snap)
+        assert cache.full_builds == 2
+        assert_cluster_equal(got, ClusterTensors.build(snap.nodes()))
+
+
+class TestCacheBehavior:
+    def test_same_version_is_identity_hit(self, store):
+        cache = IncrementalClusterCache()
+        snap = store.snapshot()
+        c1 = cache.get(snap)
+        assert cache.get(store.snapshot()) is c1
+        assert cache.hits == 1
+
+    def test_alloc_churn_does_not_invalidate(self, store):
+        """Allocation transitions bump usage.version but not the node
+        structure: the node planes must stay cached."""
+        cache = IncrementalClusterCache()
+        c1 = cache.get(store.snapshot())
+        node = store.snapshot().nodes()[0]
+        a = mock.alloc(node_id=node.id)
+        store.upsert_allocs([a])
+        assert cache.get(store.snapshot()) is c1
+
+    def test_older_snapshot_stays_cached_alongside_newer(self, store):
+        """A batch still scheduling against an older snapshot must keep
+        getting ONE identical object per call (identity sharing is the
+        wave launcher's upload layout), even after a newer structure
+        version was cached."""
+        cache = IncrementalClusterCache()
+        old_snap = store.snapshot()
+        c_old = cache.get(old_snap)
+        store.upsert_node(mock.node())
+        new_snap = store.snapshot()
+        c_new = cache.get(new_snap)
+        assert c_new is not c_old
+        # the older version is still served by identity, not rebuilt
+        builds_before = cache.full_builds + cache.delta_builds
+        assert cache.get(old_snap) is c_old
+        assert cache.get(old_snap) is c_old
+        assert cache.full_builds + cache.delta_builds == builds_before
+        # and the newer one too
+        assert cache.get(new_snap) is c_new
+
+    def test_trimmed_log_falls_back_to_full_build(self, store):
+        from nomad_tpu.state import usage as usage_mod
+
+        cache = IncrementalClusterCache()
+        cache.get(store.snapshot())
+        # more structural events than the log holds
+        for _ in range(usage_mod.NODE_LOG_MAX // 2 + 4):
+            store.upsert_node(mock.node())
+            store.delete_node(store.snapshot().nodes()[-1].id)
+        snap = store.snapshot()
+        got = cache.get(snap)
+        assert cache.full_builds == 2
+        assert cache.delta_builds == 0
+        assert_cluster_equal(got, ClusterTensors.build(snap.nodes()))
