@@ -1128,6 +1128,12 @@ def main() -> None:
                 trace_steady_jit_cache_misses=steady.get(
                     "jit_cache_misses"),
                 trace_steady_compile_share=steady.get("compile_share"),
+                # ISSUE 3 steady gates: h2d share of wall with the
+                # device-resident cluster state, and the dirty-row
+                # upload ratio (delta bytes / full-re-upload bytes)
+                trace_steady_h2d_share=steady.get("h2d_share"),
+                trace_dirty_row_ratio=steady.get(
+                    "dirty_row_upload_ratio"),
                 trace_wave_fill_ratio=decomp.get("wave", {}).get(
                     "fill_ratio"),
                 trace_park_latency_p99_ms=decomp.get("wave", {}).get(

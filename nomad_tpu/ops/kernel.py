@@ -934,6 +934,36 @@ place_taskgroup_topk_jit = jax.jit(
 
 
 
+def _resident_kin(kin: KernelIn) -> KernelIn:
+    """Swap shared-plane leaves for their device-resident twins
+    (tensors/device_state.py) so the dispatch uploads only genuinely
+    per-eval planes. Substitution is ALL-OR-NOTHING across every
+    sharing group: the unprofiled path's jit-cache signature is then
+    exactly one of TWO layouts — all-host, or all-shared-resident —
+    both populated by the AOT warmup (ops/warmup._call_both_
+    placements). A partially-resident eval (say, forked job planes)
+    falls back to the all-host signature instead of compiling an
+    unwarmed commitment combination on the steady hot path."""
+    from nomad_tpu.parallel.coalesce import (
+        _JOB_SHAREABLE_FIELDS,
+        _NEUTRAL_SHAREABLE_FIELDS,
+        _SHAREABLE_FIELDS,
+    )
+    from nomad_tpu.tensors.device_state import default_device_state
+
+    subs = {}
+    for group in (_SHAREABLE_FIELDS, _NEUTRAL_SHAREABLE_FIELDS,
+                  _JOB_SHAREABLE_FIELDS):
+        for f in group:
+            dev = default_device_state.lookup(
+                getattr(kin, f),
+                frozen_ok=group is not _SHAREABLE_FIELDS)
+            if dev is None:
+                return kin
+            subs[f] = dev
+    return kin._replace(**subs)
+
+
 def default_kernel_launch(kin: KernelIn, k_steps: int,
                           features: KernelFeatures) -> KernelOut:
     """The stack's direct (non-coalesced) dispatch: candidate-set fast
@@ -948,6 +978,7 @@ def default_kernel_launch(kin: KernelIn, k_steps: int,
 
     features = canonical_features(features)
     n_pad = int(np.asarray(kin.cap_cpu).shape[0])
+    kin = _resident_kin(kin)
     key = (n_pad, k_steps, features)
     if features.n_spreads == 0 and not bool(kin.algorithm_spread):
         out, ok = profiler.call(

@@ -66,14 +66,19 @@ def manifest_from_profiler(profiler=None) -> List[Dict]:
     entries: List[Dict] = []
     for kernel, key in profiler.keys():
         try:
-            if kernel == "joint" and len(key) == 6:
-                b_pad, t_pad, n_nodes, shared, neutral_shared, feats = key
+            if kernel == "joint" and len(key) in (6, 7):
+                # len 6: pre-job-group keys from persisted manifests
+                # (job_shared defaults True, the common layout)
+                b_pad, t_pad, n_nodes, shared, neutral_shared = key[:5]
+                job_shared = key[5] if len(key) == 7 else True
+                feats = key[-1]
                 entries.append({
                     "kernel": "joint",
                     "wave": int(b_pad), "steps": int(t_pad),
                     "nodes": int(n_nodes),
                     "shared": bool(shared),
                     "neutral_shared": bool(neutral_shared),
+                    "job_shared": bool(job_shared),
                     "features": _features_to_dict(feats),
                 })
             elif kernel in ("single_topk", "single_full") and len(key) == 3:
@@ -91,6 +96,7 @@ def manifest_from_profiler(profiler=None) -> List[Dict]:
 def _entry_key(e: Dict) -> Tuple:
     return (e.get("kernel"), e.get("wave"), e.get("steps"),
             e.get("nodes"), e.get("shared"), e.get("neutral_shared"),
+            e.get("job_shared", True),
             tuple(sorted((e.get("features") or {}).items())))
 
 
@@ -146,8 +152,11 @@ def expand_lattice(entries: List[Dict],
     - step axis: every step bucket from the live floor
       (MIN_STEP_BUCKET) up to the observed one — follow-up evals
       placing a job's leftovers launch with fewer steps;
-    - layout axis: the all-stacked retry layout for multi-member
-      waves and the fully-shared layout for 1-waves;
+    - layout axis: ALL four sharing layouts for multi-member waves
+      (shared x neutral-shared; a retry member forks either group
+      independently — a refreshed snapshot stacks the cluster planes,
+      a follow-up eval's live-alloc counts stack the neutral group)
+      and the fully-shared layout for 1-waves;
     - feature axis: the rescheduling variant (step penalties +
       preferred pins travel together post-canonicalization) —
       follow-up evals for failed allocs carry penalty nodes;
@@ -186,13 +195,24 @@ def expand_lattice(entries: List[Dict],
                         # itself: 1-waves ALWAYS take the fully-shared
                         # layout
                         out.append({**base, "shared": True,
-                                    "neutral_shared": True})
+                                    "neutral_shared": True,
+                                    "job_shared": True})
                     else:
-                        out.append(base)
-                        # retry waves (partial-commit members carry a
-                        # non-empty plan) stack every plane
-                        out.append({**base, "shared": False,
-                                    "neutral_shared": False})
+                        # every sharing layout: a member with a
+                        # refreshed snapshot stacks the cluster group,
+                        # a follow-up eval's live-alloc counts stack
+                        # the job group, a device/spread ask stacks
+                        # the wide neutral group, a partial-commit
+                        # retry stacks them all — each combination is
+                        # its own compiled variant the steady state
+                        # can hit
+                        for sh in (True, False):
+                            for ns in (True, False):
+                                for js in (True, False):
+                                    out.append({
+                                        **base, "shared": sh,
+                                        "neutral_shared": ns,
+                                        "job_shared": js})
                 # an eval in a 1-eval batch dispatches DIRECTLY
                 # (ops/kernel.default_kernel_launch) with the same
                 # shapes and features a wave member would ship
@@ -268,18 +288,34 @@ def _dummy_kin(n: int, k_pad: int):
     )
 
 
-def _call_both_placements(fn, arrays: tuple, statics: tuple) -> None:
-    """Populate BOTH jit-cache entries a live launch can hit: the
+def _call_both_placements(fn, arrays: tuple, statics: tuple,
+                          mixed=None) -> None:
+    """Populate EVERY jit-cache entry a live launch can hit: the
     kernel profiler device_puts its arguments (committed arrays) while
     the unprofiled path passes host numpy (uncommitted) — jax keys its
     jit cache on commitment, so these are distinct entries over one
-    XLA program (the second trace re-hits the compilation cache)."""
+    XLA program (the second trace re-hits the compilation cache).
+
+    ``mixed`` (a KernelIn of bools, or None) warms a THIRD signature:
+    the unprofiled path with the device-resident cluster state active
+    (tensors/device_state.py) passes committed device arrays for the
+    shared leaves and host numpy for the rest — commitment follows the
+    wave layout flags exactly, so one extra variant per entry covers
+    it."""
     import jax
 
     out = fn(*jax.device_put(arrays), *statics)
     jax.block_until_ready(out)
     out = fn(*arrays, *statics)
     jax.block_until_ready(out)
+    if mixed is not None and any(mixed):
+        kin = arrays[0]
+        kin = kin._replace(**{
+            f: jax.device_put(getattr(kin, f))
+            for f, m in zip(kin._fields, mixed) if m
+        })
+        out = fn(kin, *arrays[1:], *statics)
+        jax.block_until_ready(out)
 
 
 def _warm_joint(e: Dict) -> bool:
@@ -293,6 +329,7 @@ def _warm_joint(e: Dict) -> bool:
     n = int(e["nodes"])
     shared = bool(e.get("shared", True))
     neutral_shared = bool(e.get("neutral_shared", True))
+    job_shared = bool(e.get("job_shared", True))
     feats = _features_from_dict(e["features"])
     k_max = max(t_pad // max(b_pad, 1), 1)
     kin = _dummy_kin(n, k_max)
@@ -301,7 +338,7 @@ def _warm_joint(e: Dict) -> bool:
         # the layout predicate is SHARED with launch_wave: the jit
         # cache keys on shapes, so warmup must reproduce the live
         # stacking exactly
-        if wave_field_is_shared(f, shared, neutral_shared):
+        if wave_field_is_shared(f, shared, neutral_shared, job_shared):
             return np.asarray(x)
         return np.stack([np.asarray(x)] * b_pad)
 
@@ -315,31 +352,42 @@ def _warm_joint(e: Dict) -> bool:
         step_member[pos:pos + k_max] = i
         step_local[pos:pos + k_max] = np.arange(k_max)
         pos += k_max
+    # the resident-state signature: shared leaves committed, the rest
+    # host — exactly the leaves the live launcher swaps for device
+    # twins when the cluster state is resident
+    mixed = [wave_field_is_shared(f, shared, neutral_shared, job_shared)
+             for f in KernelIn._fields]
     _call_both_placements(
         place_taskgroups_joint_jit,
         (stacked, jnp.asarray(step_member), jnp.asarray(step_local)),
-        (t_pad, feats))
+        (t_pad, feats), mixed=mixed)
     return True
 
 
 def _warm_single(e: Dict) -> bool:
     from nomad_tpu.ops.kernel import (
+        KernelIn,
         place_taskgroup_jit,
         place_taskgroup_topk_jit,
     )
+    from nomad_tpu.parallel.coalesce import wave_field_is_shared
 
     n = int(e["nodes"])
     k_steps = int(e["steps"])
     feats = _features_from_dict(e["features"])
     kin = _dummy_kin(n, k_steps)
+    # the direct dispatch substitutes BOTH sharing groups when the
+    # cluster state is resident (ops/kernel._resident_kin)
+    mixed = [wave_field_is_shared(f, True, True, True)
+             for f in KernelIn._fields]
     if e["kernel"] == "single_topk":
         if feats.n_spreads != 0:
             return False                # topk path never compiles these
         _call_both_placements(place_taskgroup_topk_jit, (kin,),
-                              (k_steps, feats))
+                              (k_steps, feats), mixed=mixed)
     else:
         _call_both_placements(place_taskgroup_jit, (kin,),
-                              (k_steps, feats))
+                              (k_steps, feats), mixed=mixed)
     return True
 
 

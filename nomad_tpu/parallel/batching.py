@@ -153,6 +153,14 @@ def make_schedule_apply_loop(k_steps: int,
         return (jnp.sum(scores), jnp.sum(placed), jnp.sum(invalid),
                 uc, um)
 
+    # donation is only usable when the donated planes' buffers can
+    # alias the returned carry. With ``reset_every`` the body swaps the
+    # carry for the pristine copies (``p + 0``) on the very first
+    # batch, so the ORIGINAL donated buffers never reach an output and
+    # device backends warn "Some donated buffers were not usable"
+    # (promoted to an error in tests) — donate nothing then.
+    donate = () if reset_every else (1, 2)
+
     if backend == "pallas_topk":
         from nomad_tpu.ops.pallas_kernel import pallas_topk_place_batch
 
@@ -194,7 +202,7 @@ def make_schedule_apply_loop(k_steps: int,
             return scan_loop(one_batch, used_cpu, used_mem,
                              ask_cpu, ask_mem)
 
-        return jax.jit(loop, donate_argnums=(1, 2))
+        return jax.jit(loop, donate_argnums=donate)
 
     def loop(shared: KernelIn, used_cpu, used_mem, ask_cpu, ask_mem, n_steps):
         def one_batch(carry, asks):
@@ -234,7 +242,7 @@ def make_schedule_apply_loop(k_steps: int,
 
         return scan_loop(one_batch, used_cpu, used_mem, ask_cpu, ask_mem)
 
-    return jax.jit(loop, donate_argnums=(1, 2))
+    return jax.jit(loop, donate_argnums=donate)
 
 
 def _scan_with_reset(one_batch, planes, asks, reset_every: int):
@@ -320,7 +328,13 @@ def make_device_apply_loop(k_steps: int, reset_every: int = 0):
         scores, placed = stats
         return jnp.sum(scores), jnp.sum(placed), uc, um, df
 
-    return jax.jit(loop, donate_argnums=(1, 2, 3))
+    # with reset_every, _scan_with_reset consumes COPIES of the planes
+    # (``p + 0``) and the originals never reach an output — donation
+    # would be unusable (device backends warn; tests error). Donate
+    # only in the no-reset steady loop, where carry in aliases carry
+    # out (BENCH_r05's "donated buffers were not usable" tail came
+    # from exactly this misalignment).
+    return jax.jit(loop, donate_argnums=() if reset_every else (1, 2, 3))
 
 
 @functools.lru_cache(maxsize=8)
@@ -450,8 +464,11 @@ def make_preemption_apply_loop(k_steps: int, reset_every: int = 0):
     # pre_cpu/pre_mem never leave the loop, so donating them has no
     # output to alias — XLA warns "Some donated buffers were not
     # usable" and the donation buys nothing (the warning is promoted
-    # to an error in tests so this cannot regress)
-    return jax.jit(loop, donate_argnums=(1, 2))
+    # to an error in tests so this cannot regress). With reset_every
+    # even uc/um are unusable: _scan_with_reset hands the scan COPIES
+    # (``p + 0``) and the donated originals never reach an output
+    # (the BENCH_r05 device/preemption-path warning) — donate nothing.
+    return jax.jit(loop, donate_argnums=() if reset_every else (1, 2))
 
 
 def commit_placements(used_cpu, used_mem, chosen, found, ask_cpu, ask_mem):

@@ -40,6 +40,7 @@ from nomad_tpu.ops.kernel import (
 )
 from nomad_tpu.telemetry.kernel_profile import profiler
 from nomad_tpu.telemetry.trace import tracer
+from nomad_tpu.tensors.device_state import default_device_state
 
 #: B is bucketed to limit recompiles. Coarse on purpose: every
 #: (wave bucket, step bucket, features) combination is a separate XLA
@@ -65,6 +66,15 @@ _USE_GLOBAL = object()
 #: waves dispatched through the sharded path (asserted by tests)
 sharded_wave_launches = 0
 
+#: JointOut fields the launcher actually fetches to host per wave (the
+#: d2h payload); everything else stays device-side
+_JOINT_FETCH_FIELDS = (
+    "chosen", "scores", "found", "topk_idx", "topk_scores",
+    "nodes_evaluated", "nodes_feasible",
+    "exhausted_cpu", "exhausted_mem", "exhausted_disk",
+    "exhausted_ports", "exhausted_devices", "exhausted_cores",
+)
+
 #: node planes shipped once per wave (unbatched) when every member
 #: shares them by identity: the cluster-static planes plus the wave
 #: snapshot's gathered utilization (stack.py wave-shared build)
@@ -74,32 +84,54 @@ _SHAREABLE_FIELDS = (
     "used_cpu", "used_mem", "used_disk", "used_cores", "used_mbits",
 )
 
-#: second sharing group: the per-eval planes that stay NEUTRAL for the
-#: common ask (no devices/affinities/spreads/penalties, fresh job) are
-#: frozen singletons (ops/kernel.neutral_planes), so members share
-#: them by identity too. Each group is all-or-nothing, so a
-#: (bucket, step, features) triple compiles at most FOUR layout
-#: variants (2 groups x shared/stacked), keeping the variant count
-#: bounded while the common wave ships O(nodes) bytes instead of
-#: O(members x nodes).
+#: second sharing group: the WIDE ask planes (devices, spreads,
+#: reserved-port conflicts, per-step penalty/preference pins) that
+#: stay NEUTRAL for the common ask are frozen singletons
+#: (ops/kernel.neutral_planes), so members share them by identity too.
+#: They fork only when a member actually asks for devices/spreads/
+#: rescheduling — rare in steady traffic, and they are the BULKIEST
+#: per-member planes ([N, MAX_DEV_REQS], [S, N]).
+#:
+#: ``node_perm`` is deliberately NOT here: the shuffle permutation is
+#: seeded per eval, so with shuffling on it is never identity-shared —
+#: keeping it in this group forced EVERY live multi-member wave onto
+#: the all-stacked layout, shipping B copies of dev_free/spread/count
+#: planes that were in fact neutral singletons (the bulk of PR 2's
+#: 30% h2d share). It ships always-stacked instead (one [B, N] i32
+#: plane), which keeps the layout-variant count bounded.
 _NEUTRAL_SHAREABLE_FIELDS = (
     "port_conflict", "dev_free", "dev_aff_score",
-    "job_tg_count", "job_any_count", "penalty", "aff_score",
-    "node_perm", "step_penalty", "step_preferred",
+    "step_penalty", "step_preferred",
     "spread_active", "spread_even", "spread_weight",
     "spread_bucket", "spread_counts", "spread_desired",
 )
 
+#: third sharing group: the JOB-LOCAL [N] planes. A follow-up eval of
+#: a job with live allocations forks job_tg_count/job_any_count (and a
+#: rescheduled one the penalty plane) — common in steady traffic — and
+#: used to drag the whole neutral group onto the stacked layout,
+#: uploading B copies of the wide device/spread planes for a handful
+#: of dirty members. Splitting the job planes into their own group
+#: bounds that wave's extra upload to 4 x [B, N] instead of ~1MB.
+#: Three all-or-nothing groups -> at most EIGHT layout variants per
+#: (bucket, step, features) triple, all enumerable by the AOT warmup
+#: lattice.
+_JOB_SHAREABLE_FIELDS = (
+    "job_tg_count", "job_any_count", "penalty", "aff_score",
+)
+
 
 def wave_field_is_shared(field: str, shared: bool,
-                         neutral_shared: bool) -> bool:
+                         neutral_shared: bool,
+                         job_shared: bool = True) -> bool:
     """Whether a KernelIn field ships UNBATCHED under the given wave
-    layout flags. The single source of truth for the two sharing
+    layout flags. The single source of truth for the three sharing
     groups — the live launcher (``launch_wave``) and the AOT warmup's
     dummy-wave builder (ops/warmup.py) must agree EXACTLY, or warmup
     compiles programs the live path never hits."""
     return (shared and field in _SHAREABLE_FIELDS) or (
-        neutral_shared and field in _NEUTRAL_SHAREABLE_FIELDS)
+        neutral_shared and field in _NEUTRAL_SHAREABLE_FIELDS) or (
+        job_shared and field in _JOB_SHAREABLE_FIELDS)
 
 
 def configure_wave_mesh(mesh) -> None:
@@ -252,6 +284,17 @@ class _LatencyEWMA:
 #: waves is the right call)
 wave_latency_ewma = _LatencyEWMA()
 
+#: EWMA of "this launch was deadline-fired" (0/1 per launch). The
+#: adaptive window is a fraction of the (device) wave latency — but
+#: the device-resident cluster state made launches several times
+#: cheaper, and a window that keeps shrinking with launch cost drops
+#: below the members' host-prep spread and FRAGMENTS waves: partial
+#: fire -> more launches -> lower fill -> more per-launch overhead
+#: than the parking it saved. When deadline fires dominate, this
+#: signal widens the window back toward the cap, so the coalescer
+#: self-corrects instead of feeding back.
+wave_deadline_ewma = _LatencyEWMA(alpha=0.25)
+
 #: launches currently executing, token -> perf_counter start. A
 #: long-running in-flight launch (a cold XLA compile) disarms the
 #: adaptive deadline process-wide: the EWMA only learns about a slow
@@ -317,9 +360,22 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
 
         shareable = _group_shared(_SHAREABLE_FIELDS)
         neutral_shareable = _group_shared(_NEUTRAL_SHAREABLE_FIELDS)
+        job_shareable = _group_shared(_JOB_SHAREABLE_FIELDS)
 
         def _stack_field(f, xs):
-            if wave_field_is_shared(f, shareable, neutral_shareable):
+            if wave_field_is_shared(f, shareable, neutral_shareable,
+                                    job_shareable):
+                # device-resident twin when one exists (the cluster
+                # state advanced at snapshot time, frozen neutral
+                # singletons uploaded once): jit's device_put then
+                # moves ZERO bytes for this leaf. The snapshot group
+                # is registry-only (frozen_ok=False): a STALE
+                # snapshot's read-only gathered planes must ship as
+                # host numpy, not masquerade as singletons.
+                dev = default_device_state.lookup(
+                    xs[0], frozen_ok=f not in _SHAREABLE_FIELDS)
+                if dev is not None:
+                    return dev
                 return np.asarray(xs[0])
             return np.stack([np.asarray(x) for x in xs])
 
@@ -333,22 +389,25 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
         # axis is sized from the PADDED wave (b_pad * k_max) so the
         # compiled shape depends only on (wave bucket, step bucket,
         # features) — retry waves of any real size reuse it; inert
-        # steps are microseconds of device time
+        # steps are microseconds of device time. Built vectorized:
+        # the per-member python loop showed up at bench wave sizes.
         t_pad = pad_steps(b_pad * k_max)
+        ks = np.asarray(k_steps, np.int64)
+        starts = np.concatenate(([0], np.cumsum(ks)[:-1]))
+        offsets = starts.tolist()
+        total = int(ks.sum())
         step_member = np.full(t_pad, -1, np.int32)
         step_local = np.zeros(t_pad, np.int32)
-        offsets = []
-        pos = 0
-        for i, k in enumerate(k_steps):
-            offsets.append(pos)
-            step_member[pos:pos + k] = i
-            step_local[pos:pos + k] = np.arange(k)
-            pos += k
+        member_of_step = np.repeat(np.arange(len(ks)), ks)
+        step_member[:total] = member_of_step
+        step_local[:total] = (np.arange(total)
+                              - np.repeat(starts, ks))
 
     # the jit-cache identity the bucketing scheme promises: a repeat of
     # this key must NOT recompile (the profiler counts violations)
-    n_nodes = int(np.asarray(stacked.cap_cpu).shape[-1])
-    wave_key = (b_pad, t_pad, n_nodes, shareable, neutral_shareable, feats)
+    n_nodes = int(stacked.cap_cpu.shape[-1])
+    wave_key = (b_pad, t_pad, n_nodes, shareable, neutral_shareable,
+                job_shareable, feats)
     t_launch = time.perf_counter()
     token = object()
     with _INFLIGHT_LOCK:
@@ -376,7 +435,17 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
                 wave_key, jit_fn=place_taskgroups_joint_jit,
             )
         with tracer.span("kernel.d2h"):
-            host = jax.tree_util.tree_map(np.asarray, out)
+            # fetch ONLY the planes members consume: the per-step
+            # placements + top-k metadata and the per-member metric
+            # scalars. The joint kernel's final capacity carry
+            # (a_cpu/a_mem/a_disk — full node planes) stays on device;
+            # the live path commits through plans, never through it.
+            host = {
+                f: np.asarray(getattr(out, f))
+                for f in _JOINT_FETCH_FIELDS
+            }
+        profiler.add_bytes(
+            "d2h", sum(a.nbytes for a in host.values()))
     finally:
         with _INFLIGHT_LOCK:
             _INFLIGHT_STARTS.pop(token, None)
@@ -385,19 +454,19 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
     for i, k in enumerate(k_steps):
         o = offsets[i]
         results.append(KernelOut(
-            chosen=host.chosen[o:o + k],
-            scores=host.scores[o:o + k],
-            found=host.found[o:o + k],
-            topk_idx=host.topk_idx[o:o + k],
-            topk_scores=host.topk_scores[o:o + k],
-            nodes_evaluated=host.nodes_evaluated[i],
-            nodes_feasible=host.nodes_feasible[i],
-            exhausted_cpu=host.exhausted_cpu[i],
-            exhausted_mem=host.exhausted_mem[i],
-            exhausted_disk=host.exhausted_disk[i],
-            exhausted_ports=host.exhausted_ports[i],
-            exhausted_devices=host.exhausted_devices[i],
-            exhausted_cores=host.exhausted_cores[i],
+            chosen=host["chosen"][o:o + k],
+            scores=host["scores"][o:o + k],
+            found=host["found"][o:o + k],
+            topk_idx=host["topk_idx"][o:o + k],
+            topk_scores=host["topk_scores"][o:o + k],
+            nodes_evaluated=host["nodes_evaluated"][i],
+            nodes_feasible=host["nodes_feasible"][i],
+            exhausted_cpu=host["exhausted_cpu"][i],
+            exhausted_mem=host["exhausted_mem"][i],
+            exhausted_disk=host["exhausted_disk"][i],
+            exhausted_ports=host["exhausted_ports"][i],
+            exhausted_devices=host["exhausted_devices"][i],
+            exhausted_cores=host["exhausted_cores"][i],
         ))
     return results
 
@@ -495,6 +564,11 @@ class LaunchCoalescer:
         if _oldest_inflight_age_s() > \
                 self.window_max_s * self.TRANSIENT_FACTOR:
             return None
+        # fragmentation feedback: widen (up to 4x, still capped) while
+        # recent launches keep firing by deadline instead of by full
+        # rendezvous
+        frag = wave_deadline_ewma.value or 0.0
+        target *= 1.0 + 3.0 * frag
         return min(max(target, self.window_min_s), self.window_max_s)
 
     def launch(self, kin: KernelIn, k_steps: int,
@@ -596,6 +670,7 @@ class LaunchCoalescer:
         groups: dict = {}
         for r in wave:
             groups.setdefault(int(r.kin.cap_cpu.shape[0]), []).append(r)
+        wave_deadline_ewma.update(1.0 if deadline_fired else 0.0)
         for grp in groups.values():
             self.launches += 1
             self.max_wave = max(self.max_wave, len(grp))
@@ -643,7 +718,19 @@ class ClusterCache:
 
         u = getattr(state, "usage", None)
         if u is not None and u.uid:
-            return default_incremental_cluster_cache.get(state)
+            built = default_incremental_cluster_cache.get(state)
+            # advance the device-resident wave planes HERE, on an eval
+            # thread at snapshot time: the dirty-row h2d of the next
+            # wave runs while the previous wave's execute holds the
+            # device (the functional scatter double-buffers — in-
+            # flight waves keep their own generation's arrays). The
+            # wave launcher then finds every shared leaf resident and
+            # uploads nothing for it.
+            try:
+                default_device_state.ensure(built, u)
+            except Exception:                   # noqa: BLE001
+                pass        # residency is an optimization, never a dep
+            return built
         key = id(state)
         with self._lock:
             hit = self._cache.get(key)

@@ -36,6 +36,15 @@ from nomad_tpu.tensors.schema import pad_bucket
 #: than the log's tail falls back to a full rebuild.
 NODE_LOG_MAX = 1024
 
+#: usage-row change log length. Every alloc transition logs the node
+#: whose utilization row it moved, so the device-resident cluster
+#: state (tensors/device_state.py) can advance its resident planes by
+#: scattering ONLY those rows instead of re-uploading full planes per
+#: wave. One scheduling batch commits at most batch x placements rows;
+#: 4096 spans many batches of slack before the floor forces a full
+#: re-upload.
+ROW_LOG_MAX = 4096
+
 
 @dataclass
 class UsagePlanes:
@@ -57,6 +66,14 @@ class UsagePlanes:
     #: ClusterTensors cache (tensors/schema.py) to re-flatten only
     #: dirty node rows on snapshot refresh.
     node_events: Tuple = field(default=())
+    #: (version, node_id) per utilization-row mutation (alloc
+    #: transitions, node drops), oldest first. Complete for every
+    #: version > row_events_floor; a consumer whose last-seen version
+    #: is at or below the floor must fall back to a full plane upload.
+    #: Consumed by tensors/device_state.DeviceClusterState to advance
+    #: device-resident utilization planes by dirty-row scatter.
+    row_events: Tuple = field(default=())
+    row_events_floor: int = 0
 
 
 class UsageIndex:
@@ -79,6 +96,11 @@ class UsageIndex:
         self.structure_version = 0
         # structural change log: (structure_version, node_id or None)
         self.node_log: deque = deque(maxlen=NODE_LOG_MAX)
+        # usage-row change log: (version, node_id); complete for every
+        # version > row_log_floor (the floor advances when entries are
+        # trimmed, and jumps to the current version on rebuild)
+        self.row_log: deque = deque()
+        self.row_log_floor = 0
         # planes_copy cache: reused until the next mutation; guarded by
         # the owning store's lock (all callers hold it)
         self._copy: Optional[UsagePlanes] = None
@@ -130,6 +152,7 @@ class UsageIndex:
                      "used_cores", "used_mbits"):
             getattr(self, name)[row] = 0
         self._touch(structural=True, node_id=node_id)
+        self._log_row(node_id)
 
     # -- alloc transitions ----------------------------------------------
 
@@ -162,6 +185,12 @@ class UsageIndex:
             self._alloc_delta(new, +1)
         if old_live or new_live:
             self._touch()
+            # log AFTER the version bump so the entries carry the
+            # version at which the rows became dirty
+            if old_live:
+                self._log_row(old.node_id)
+            if new_live and (not old_live or new.node_id != old.node_id):
+                self._log_row(new.node_id)
 
     def rebuild(self, nodes, allocs) -> None:
         """Full rebuild (snapshot restore / FSM restore)."""
@@ -178,6 +207,10 @@ class UsageIndex:
             if not a.terminal_status():
                 self._alloc_delta(a, +1)
         self._touch(structural=True)
+        # a rebuild rewrites rows wholesale: nothing before it is
+        # provable from the log
+        self.row_log.clear()
+        self.row_log_floor = self.version
 
     # -- reads -----------------------------------------------------------
 
@@ -188,6 +221,16 @@ class UsageIndex:
             self.structure_version += 1
             self.node_log.append((self.structure_version, node_id))
         self._copy = None
+
+    def _log_row(self, node_id: str) -> None:
+        """Record that ``node_id``'s utilization row changed at the
+        CURRENT version (call after ``_touch``). Trimming advances the
+        floor so completeness stays provable."""
+        self.row_log.append((self.version, node_id))
+        while len(self.row_log) > ROW_LOG_MAX:
+            v, _ = self.row_log.popleft()
+            if v > self.row_log_floor:
+                self.row_log_floor = v
 
     def planes_copy(self) -> UsagePlanes:
         """Point-in-time copy; cached until the next mutation (bursts of
@@ -209,5 +252,7 @@ class UsageIndex:
             structure_version=self.structure_version,
             uid=self.uid,
             node_events=tuple(self.node_log),
+            row_events=tuple(self.row_log),
+            row_events_floor=self.row_log_floor,
         )
         return self._copy

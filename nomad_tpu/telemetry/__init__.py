@@ -58,6 +58,14 @@ def reset() -> None:
         wave_stats.reset()
     except Exception:                           # noqa: BLE001
         pass
+    try:
+        # device-residency counters (dirty-row upload ratio etc.)
+        # follow the same window; the resident arrays themselves stay
+        from nomad_tpu.tensors.device_state import default_device_state
+
+        default_device_state.reset_stats()
+    except Exception:                           # noqa: BLE001
+        pass
 
 
 if os.environ.get("NOMAD_TPU_TRACE", "") not in ("", "0"):

@@ -63,6 +63,14 @@ def prometheus_text(registry=None) -> str:
         lines.append(
             f'nomad_tpu_kernel_stage_seconds_total{{stage="{stage}"}} '
             f"{secs}")
+    # transfer BYTES per direction (ISSUE 3): seconds say how long the
+    # PCIe stages took, bytes say whether the payload shrank — the
+    # device-resident cluster state's success metric
+    lines.append("# TYPE nomad_tpu_kernel_transfer_bytes_total counter")
+    for direction, n in sorted(prof.get("TransferBytes", {}).items()):
+        lines.append(
+            f'nomad_tpu_kernel_transfer_bytes_total'
+            f'{{direction="{direction}"}} {n}')
     if prof["PerKey"]:
         lines.append(
             "# TYPE nomad_tpu_kernel_jit_cache_misses_total counter")
@@ -101,6 +109,46 @@ def prometheus_text(registry=None) -> str:
             f"{w['deadline_launches']}")
     except Exception:                           # noqa: BLE001
         pass                # coalescer (jax) unavailable: skip series
+    # device-resident cluster state (tensors/device_state.py): how the
+    # shared wave planes advanced — row-scatter deltas vs full uploads,
+    # and the dirty-row upload ratio (delta bytes / full-re-upload
+    # bytes; low = the h2d tax is gone)
+    try:
+        from nomad_tpu.tensors.device_state import default_device_state
+
+        d = default_device_state.snapshot()
+        lines.append(
+            "# TYPE nomad_tpu_device_state_advances_total counter")
+        for kind, key in (("hit", "hits"),
+                          ("delta", "delta_advances"),
+                          ("fork_delta", "fork_deltas"),
+                          ("full", "full_uploads"),
+                          ("usage_full", "usage_full_uploads")):
+            lines.append(
+                f'nomad_tpu_device_state_advances_total'
+                f'{{kind="{kind}"}} {d[key]}')
+        lines.append(
+            "# TYPE nomad_tpu_device_state_rows_uploaded_total counter")
+        lines.append(
+            f"nomad_tpu_device_state_rows_uploaded_total "
+            f"{d['rows_uploaded']}")
+        lines.append(
+            "# TYPE nomad_tpu_device_state_upload_bytes_total counter")
+        lines.append(
+            f"nomad_tpu_device_state_upload_bytes_total "
+            f"{d['bytes_uploaded']}")
+        lines.append(
+            "# TYPE nomad_tpu_device_state_dirty_row_upload_ratio gauge")
+        lines.append(
+            f"nomad_tpu_device_state_dirty_row_upload_ratio "
+            f"{d['dirty_row_upload_ratio']}")
+        lines.append(
+            "# TYPE nomad_tpu_device_state_resident_generations gauge")
+        lines.append(
+            f"nomad_tpu_device_state_resident_generations "
+            f"{d['resident_generations']}")
+    except Exception:                           # noqa: BLE001
+        pass                # device state (jax) unavailable: skip
     lines.append(
         "# TYPE nomad_tpu_telemetry_enabled gauge")
     lines.append(

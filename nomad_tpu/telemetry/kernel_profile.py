@@ -50,6 +50,14 @@ class KernelProfiler:
         self.stage_s: Dict[str, float] = {
             "h2d": 0.0, "compile": 0.0, "dispatch": 0.0, "execute": 0.0,
         }
+        #: cumulative transfer BYTES per direction — seconds say how
+        #: long the PCIe stages took, bytes say whether the payload
+        #: shrank (the device-resident cluster state's whole point).
+        #: h2d counts host numpy leaves actually uploaded (resident
+        #: device arrays cost nothing and are not counted) plus the
+        #: dirty-row uploads device_state performs; d2h counts the
+        #: result planes the wave launcher fetches.
+        self.transfer_bytes: Dict[str, int] = {"h2d": 0, "d2h": 0}
         #: cross-check: observed jit cache growth (when introspectable)
         self.cache_growth = 0
 
@@ -71,6 +79,8 @@ class KernelProfiler:
             self._misses.clear()
             for k in self.stage_s:
                 self.stage_s[k] = 0.0
+            for k in self.transfer_bytes:
+                self.transfer_bytes[k] = 0
             self.cache_growth = 0
 
     # --- accounting -----------------------------------------------------
@@ -92,6 +102,7 @@ class KernelProfiler:
                 "JitCacheGrowth": self.cache_growth,
                 "StageSeconds": {k: round(v, 6)
                                  for k, v in self.stage_s.items()},
+                "TransferBytes": dict(self.transfer_bytes),
                 "PerKey": per_key,
             }
 
@@ -99,6 +110,17 @@ class KernelProfiler:
         with self._lock:
             return sum(n for (k, _), n in self._misses.items()
                        if k == kernel)
+
+    def add_bytes(self, direction: str, n: int) -> None:
+        """Account ``n`` transfer bytes under ``direction`` ("h2d" or
+        "d2h"). No-op when disabled — callers outside ``call`` (the
+        wave launcher's d2h fetch, device_state's dirty-row uploads)
+        report through this."""
+        if not self._enabled or n <= 0:
+            return
+        with self._lock:
+            self.transfer_bytes[direction] = \
+                self.transfer_bytes.get(direction, 0) + int(n)
 
     def keys(self) -> list:
         """Every (kernel, bucket-key) ever launched since reset — the
@@ -135,12 +157,32 @@ class KernelProfiler:
 
         # explicit upload: jit would upload the host numpy leaves
         # transparently inside the call; splitting it out is what makes
-        # "is it transfer?" answerable
+        # "is it transfer?" answerable. Leaves that are already device
+        # arrays (the resident cluster state) skip device_put entirely
+        # — only host leaves pay PCIe, so only they are uploaded,
+        # blocked on, and byte-metered. One flatten + ONE batched
+        # device_put: on a firing thread racing B eval threads for the
+        # GIL, every extra per-leaf python round trip is a potential
+        # 5ms switch-interval stall inside this span.
+        leaves, treedef = jax.tree_util.tree_flatten(dev_args)
+        host_idx = [i for i, x in enumerate(leaves)
+                    if not isinstance(x, jax.Array)]
+        host_leaves = [leaves[i] for i in host_idx]
+        up_bytes = sum(getattr(x, "nbytes", 0) for x in host_leaves)
         with tracer.span("kernel.h2d"):
             t0 = time.perf_counter()
-            dev_args = jax.device_put(dev_args)
-            jax.block_until_ready(dev_args)
+            if host_leaves:
+                # ONE batched device_put + ONE block: handing the jit
+                # call arrays with in-flight transfers makes the
+                # dispatch itself stall holding the GIL, which
+                # serializes every eval thread behind this launch
+                put = jax.device_put(host_leaves)
+                jax.block_until_ready(put)
+                for i, v in zip(host_idx, put):
+                    leaves[i] = v
             self._bump_stage("h2d", time.perf_counter() - t0)
+        dev_args = jax.tree_util.tree_unflatten(treedef, leaves)
+        self.add_bytes("h2d", up_bytes)
 
         full_key = (kernel, key)
         with self._lock:

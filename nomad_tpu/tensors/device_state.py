@@ -1,0 +1,411 @@
+"""Device-resident cluster state: kill the per-wave h2d tax.
+
+PR 2's steady-state TRACE_DECOMP made h2d the dominant cost (30.4% of
+wall, 4.48 ms/eval): every coalesced wave re-uploaded the full
+node x resource shared planes even though the host side already knew
+exactly which rows changed (the incremental ClusterTensors cache and
+the usage index's change logs). This module is the device half of that
+design: the wave-shared planes — the cluster-static capacity planes
+plus the snapshot's gathered utilization (``ClusterTensors.
+wave_shared_planes``) — live ON the accelerator as committed arrays,
+keyed by ``(uid, structure_version)`` generations, and advance between
+waves by uploading only the dirty rows and applying them with a jit'd
+scatter (``plane.at[rows].set(vals)``).
+
+Advancement is **functional**: a scatter produces new device arrays
+while the previous generation's buffers stay untouched, so a wave
+still executing against version N never races version N+1's upload —
+the double-buffering that lets the (tiny) h2d of the next wave overlap
+the current wave's execute. Resident generations are LRU-bounded;
+every miss (unprovable log, permuted rows, pad-bucket change, evicted
+base) falls back to a full plane upload, which is bit-identical by
+construction and property-tested against a fresh
+``ClusterTensors.build`` + upload (tests/test_device_state.py, the
+device mirror of tests/test_cluster_delta.py).
+
+Dirty-row provenance:
+
+- utilization planes: ``UsagePlanes.row_events`` (state/usage.py), the
+  per-version log of nodes whose rows an alloc transition moved,
+  complete above ``row_events_floor``;
+- cluster-static planes across a ``structure_version`` fork:
+  ``UsagePlanes.node_events``, the same log the host-side
+  ``IncrementalClusterCache`` replays — usable on device only when the
+  surviving rows kept their positions (additions/updates); a
+  compaction that permutes rows falls back to a full upload.
+
+The registry maps *host array identity* -> committed device array, the
+same identity contract the wave coalescer's sharing layout is built
+on: ``launch_wave`` (and ``default_kernel_launch``) swap a shared host
+leaf for its resident device twin, making ``jax.device_put`` a no-op
+for every plane that didn't change. Frozen neutral singletons
+(ops/kernel.neutral_planes etc.) ride the same registry via a bounded
+resident cache — they upload once per process, ever.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from nomad_tpu.tensors.schema import (
+    ClusterTensors,
+    IncrementalClusterCache,
+)
+
+__all__ = ["DeviceClusterState", "default_device_state"]
+
+#: dirty-row scatter batches are bucketed so the jit cache holds a
+#: handful of (n_pad, rows-bucket, dtype) programs, not one per count
+_MIN_ROW_BUCKET = 8
+
+
+def _row_bucket(r: int) -> int:
+    b = _MIN_ROW_BUCKET
+    while b < r:
+        b *= 2
+    return b
+
+
+@jax.jit
+def _scatter_rows(plane, rows, vals):
+    """``plane.at[rows].set(vals)``; padding rows are out of bounds on
+    purpose — scatter drops OOB updates, so a bucketed row batch never
+    touches rows it wasn't given."""
+    return plane.at[rows].set(vals)
+
+
+class _Generation:
+    """One resident (uid, structure_version) generation."""
+
+    __slots__ = ("key", "cluster", "version", "planes", "host_ids")
+
+    def __init__(self, key, cluster, version, planes):
+        self.key = key
+        self.cluster = cluster          # host build (identity anchor)
+        self.version = version          # usage version of the planes
+        self.planes: Dict[str, object] = planes   # field -> device array
+        self.host_ids: Tuple[int, ...] = ()
+
+
+class DeviceClusterState:
+    """LRU of device-resident wave-shared plane generations."""
+
+    def __init__(self, max_generations: int = 4,
+                 max_frozen: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._gens: "OrderedDict[tuple, _Generation]" = OrderedDict()
+        #: uid -> newest resident structure_version (the fork base)
+        self._latest: Dict[str, int] = {}
+        #: id(host array) -> (host array, device array). Strong host
+        #: refs pin ids against reuse; entries leave with their
+        #: generation (or the frozen LRU).
+        self._registry: Dict[int, tuple] = {}
+        self._frozen: "OrderedDict[int, tuple]" = OrderedDict()
+        self.max_generations = max_generations
+        self.max_frozen = max_frozen
+        self.reset_stats()
+
+    # --- stats ----------------------------------------------------------
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.hits = 0
+            self.full_uploads = 0        # generations built by full upload
+            self.delta_advances = 0      # usage advances by row scatter
+            self.fork_deltas = 0         # structure forks by row scatter
+            self.usage_full_uploads = 0  # unprovable row log fallbacks
+            self.rows_uploaded = 0
+            self.bytes_uploaded = 0      # actual h2d bytes (delta + full)
+            self.bytes_full_equiv = 0    # what full re-uploads would cost
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "full_uploads": self.full_uploads,
+                "delta_advances": self.delta_advances,
+                "fork_deltas": self.fork_deltas,
+                "usage_full_uploads": self.usage_full_uploads,
+                "rows_uploaded": self.rows_uploaded,
+                "bytes_uploaded": self.bytes_uploaded,
+                "bytes_full_equiv": self.bytes_full_equiv,
+                "dirty_row_upload_ratio": (
+                    round(self.bytes_uploaded / self.bytes_full_equiv, 4)
+                    if self.bytes_full_equiv else 0.0),
+                "resident_generations": len(self._gens),
+            }
+
+    # --- registry -------------------------------------------------------
+
+    def lookup(self, arr, frozen_ok: bool = True) -> Optional[object]:
+        """Committed device twin of ``arr``, or None. With
+        ``frozen_ok``, frozen host arrays (read-only singletons) are
+        made resident on first sight; mutable arrays are served only
+        when a generation registered them.
+
+        Callers pass ``frozen_ok=False`` for the snapshot-plane group:
+        gathered utilization planes are ALSO read-only, and a stale
+        snapshot's planes (deregistered by a newer advance) must miss
+        — not get full-uploaded on the firing thread and pinned into
+        the frozen LRU as if they were process-lifetime singletons."""
+        if not isinstance(arr, np.ndarray):
+            return None
+        ent = self._registry.get(id(arr))
+        if ent is not None and ent[0] is arr:
+            return ent[1]
+        if frozen_ok and not arr.flags.writeable:
+            return self._frozen_resident(arr)
+        return None
+
+    def _frozen_resident(self, arr: np.ndarray):
+        with self._lock:
+            ent = self._frozen.get(id(arr))
+            if ent is not None and ent[0] is arr:
+                self._frozen.move_to_end(id(arr))
+                return ent[1]
+            dev = self._upload({"_frozen": arr})["_frozen"]
+            self._frozen[id(arr)] = (arr, dev)
+            self._registry[id(arr)] = (arr, dev)
+            while len(self._frozen) > self.max_frozen:
+                old_id, (old_arr, _) = self._frozen.popitem(last=False)
+                ent = self._registry.get(old_id)
+                if ent is not None and ent[0] is old_arr:
+                    self._registry.pop(old_id, None)
+            return dev
+
+    def _register(self, gen: _Generation,
+                  host_planes: Dict[str, np.ndarray]) -> None:
+        for hid in gen.host_ids:
+            self._registry.pop(hid, None)
+        ids = []
+        for f, host in host_planes.items():
+            self._registry[id(host)] = (host, gen.planes[f])
+            ids.append(id(host))
+        gen.host_ids = tuple(ids)
+
+    def _evict(self, gen: _Generation) -> None:
+        for hid in gen.host_ids:
+            self._registry.pop(hid, None)
+        uid, sv = gen.key
+        if self._latest.get(uid) == sv:
+            self._latest.pop(uid, None)
+
+    # --- uploads --------------------------------------------------------
+
+    def _upload(self, host_planes: Dict[str, np.ndarray]) -> Dict:
+        """Full upload of ``host_planes``; spans + byte-counts the real
+        h2d it performs (the kernel profiler's transfer accounting)."""
+        from nomad_tpu.telemetry.kernel_profile import profiler
+        from nomad_tpu.telemetry.trace import tracer
+
+        n_bytes = sum(a.nbytes for a in host_planes.values())
+        # own span name: this upload runs on an EVAL thread at
+        # snapshot time, overlapping the in-flight wave — the trace
+        # decomposition must not sum it into the wave-critical-path
+        # kernel.h2d wall stage
+        with tracer.span("state.h2d"):
+            dev = {f: jax.device_put(a) for f, a in host_planes.items()}
+            if tracer.enabled:
+                jax.block_until_ready(list(dev.values()))
+        profiler.add_bytes("h2d", n_bytes)
+        self.bytes_uploaded += n_bytes
+        return dev
+
+    def _scatter(self, planes: Dict, host_planes: Dict[str, np.ndarray],
+                 rows) -> Dict:
+        """Advance ``planes`` to match ``host_planes`` given that only
+        ``rows`` differ: upload rows + per-plane values, scatter on
+        device. Row indices are bucketed with out-of-bounds padding
+        (dropped by the scatter)."""
+        from nomad_tpu.telemetry.kernel_profile import profiler
+        from nomad_tpu.telemetry.trace import tracer
+
+        rows = np.asarray(sorted(rows), np.int32)
+        any_plane = next(iter(host_planes.values()))
+        n_pad = any_plane.shape[0]
+        rb = _row_bucket(len(rows))
+        rows_p = np.full(rb, n_pad, np.int32)
+        rows_p[:len(rows)] = rows
+        n_bytes = rows_p.nbytes
+        with tracer.span("state.h2d"):
+            rows_dev = jax.device_put(rows_p)
+            out = dict(planes)
+            for f, host in host_planes.items():
+                vals = np.zeros(rb, host.dtype)
+                vals[:len(rows)] = host[rows]
+                n_bytes += vals.nbytes
+                out[f] = _scatter_rows(planes[f], rows_dev,
+                                       jax.device_put(vals))
+            if tracer.enabled:
+                jax.block_until_ready(list(out.values()))
+        profiler.add_bytes("h2d", n_bytes)
+        self.bytes_uploaded += n_bytes
+        self.rows_uploaded += int(len(rows)) * len(host_planes)
+        return out
+
+    # --- the ensure entry point ----------------------------------------
+
+    def ensure(self, cluster: ClusterTensors, usage) -> Optional[_Generation]:
+        """Make the wave-shared planes of (cluster, usage) resident and
+        registered; called once per eval at snapshot time (cheap
+        version-compare on the hot path), so the next wave's h2d —
+        now just the dirty rows — runs on an eval thread while the
+        previous wave executes."""
+        if usage is None or not getattr(usage, "uid", ""):
+            return None
+        key = (usage.uid, usage.structure_version)
+        # lock-free fast path: dict reads are atomic in CPython and a
+        # generation's (cluster, version) pair only moves forward, so
+        # a racing advance at worst sends us to the locked path. The
+        # hits += 1 is a tolerated read-modify-write race (a stats
+        # counter, like worker.processed).
+        gen = self._gens.get(key)
+        if gen is not None and gen.version == usage.version \
+                and gen.cluster is cluster:
+            self.hits += 1
+            return gen
+        if gen is not None and gen.cluster is cluster \
+                and gen.version > usage.version:
+            # an eval still scheduling against an OLDER usage snapshot
+            # (pipelined batches, a neighbor's refreshed retry): its
+            # wave simply ships host planes. Demoting the generation
+            # here would full-upload per interleave and ping-pong the
+            # registry between versions.
+            return None
+        with self._lock:
+            gen = self._gens.get(key)
+            if gen is not None and gen.version == usage.version \
+                    and gen.cluster is cluster:
+                self._gens.move_to_end(key)
+                self.hits += 1
+                return gen
+            if gen is not None and gen.cluster is cluster \
+                    and gen.version > usage.version:
+                return None
+            host = cluster.wave_shared_planes(usage)
+            full_bytes = sum(a.nbytes for a in host.values())
+            self.bytes_full_equiv += full_bytes
+            if gen is not None and gen.cluster is cluster \
+                    and gen.version < usage.version:
+                self._advance_usage(gen, host, usage)
+            else:
+                if gen is not None:
+                    # the key is being re-built from a different host
+                    # cluster object: retire the old registrations
+                    self._evict(gen)
+                gen = self._fork_or_build(key, cluster, host, usage)
+            self._register(gen, host)
+            gen.version = usage.version
+            self._gens[key] = gen
+            self._gens.move_to_end(key)
+            if usage.structure_version >= self._latest.get(usage.uid, -1):
+                self._latest[usage.uid] = usage.structure_version
+            while len(self._gens) > self.max_generations:
+                _, old = self._gens.popitem(last=False)
+                self._evict(old)
+            return gen
+
+    # --- advance paths --------------------------------------------------
+
+    @staticmethod
+    def _usage_rows_changed(usage, since_version: int):
+        """Node ids whose utilization rows changed after
+        ``since_version``, or None when the row log cannot prove
+        completeness (trimmed past the gap, or poisoned by rebuild)."""
+        if since_version < getattr(usage, "row_events_floor", 0):
+            return None
+        return {nid for v, nid in getattr(usage, "row_events", ())
+                if v > since_version}
+
+    def _advance_usage(self, gen: _Generation,
+                       host: Dict[str, np.ndarray], usage) -> None:
+        """Same (uid, structure_version), newer usage version: only
+        utilization rows can have moved."""
+        changed = self._usage_rows_changed(usage, gen.version)
+        usage_host = {f: host[f]
+                      for f in ClusterTensors.WAVE_USAGE_FIELDS}
+        if changed is None:
+            self.usage_full_uploads += 1
+            gen.planes.update(self._upload(usage_host))
+            return
+        rows = {gen.cluster.index[nid] for nid in changed
+                if nid in gen.cluster.index}
+        if rows:
+            gen.planes = self._scatter(gen.planes, usage_host, rows)
+        self.delta_advances += 1
+
+    def _fork_or_build(self, key, cluster: ClusterTensors,
+                       host: Dict[str, np.ndarray], usage) -> _Generation:
+        """A structure_version this state has no generation for: fork
+        from the newest resident generation of the same store by
+        dirty-row scatter when the node-change log proves the dirty
+        set AND surviving rows kept their positions; otherwise a full
+        upload."""
+        uid, sv = key
+        base_sv = self._latest.get(uid)
+        base = (self._gens.get((uid, base_sv))
+                if base_sv is not None else None)
+        if base is not None and base_sv < sv \
+                and base.cluster.n_pad == cluster.n_pad:
+            forked = self._try_fork(base, cluster, host, usage)
+            if forked is not None:
+                self.fork_deltas += 1
+                return _Generation(key, cluster, usage.version, forked)
+        self.full_uploads += 1
+        return _Generation(key, cluster, usage.version,
+                           self._upload(host))
+
+    def _try_fork(self, base: _Generation, cluster: ClusterTensors,
+                  host: Dict[str, np.ndarray], usage) -> Optional[Dict]:
+        changed = IncrementalClusterCache._changed_since(
+            getattr(usage, "node_events", ()), base.key[1])
+        if changed is None:
+            return None
+        n = cluster.n_real
+        stale = []
+        for j, nid in enumerate(cluster.node_ids):
+            if nid in changed or nid not in base.cluster.index:
+                stale.append(j)
+            elif base.cluster.index[nid] != j:
+                # compaction permuted surviving rows: the device-side
+                # scatter cannot express a gather; full upload
+                return None
+        if len(stale) > max(n // 2, 8):
+            return None
+        # rows the new build leaves as padding but the base had real
+        # nodes in: their new host values are zeros by construction
+        rows = set(stale) | set(range(n, base.cluster.n_real))
+        dirty_usage = self._usage_rows_changed(usage, base.version)
+        if dirty_usage is None:
+            static_host = {f: host[f]
+                           for f in ClusterTensors.WAVE_STATIC_FIELDS}
+            usage_host = {f: host[f]
+                          for f in ClusterTensors.WAVE_USAGE_FIELDS}
+            planes = dict(base.planes)
+            if rows:
+                planes = self._scatter(planes, static_host, rows)
+            self.usage_full_uploads += 1
+            planes.update(self._upload(usage_host))
+            return planes
+        rows_usage = rows | {cluster.index[nid] for nid in dirty_usage
+                             if nid in cluster.index}
+        planes = dict(base.planes)
+        static_host = {f: host[f]
+                       for f in ClusterTensors.WAVE_STATIC_FIELDS}
+        usage_host = {f: host[f]
+                      for f in ClusterTensors.WAVE_USAGE_FIELDS}
+        if rows:
+            planes = self._scatter(planes, static_host, rows)
+        if rows_usage:
+            planes = self._scatter(planes, usage_host, rows_usage)
+        return planes
+
+
+#: process-wide resident state (the batching worker's snapshot path
+#: and the wave launcher both consult it)
+default_device_state = DeviceClusterState()

@@ -131,6 +131,36 @@ class ClusterTensors:
             object.__setattr__(self, "_gathered_usage", (version, planes))
             return planes
 
+    #: KernelIn field -> ClusterTensors plane for the cluster-static
+    #: half of the wave-shared group (parallel/coalesce._SHAREABLE_
+    #: FIELDS). Single source of truth for the device-resident state
+    #: (tensors/device_state.py) and its property tests: these arrays
+    #: reach build_kernel_in identity-preserved (np.asarray with a
+    #: matching dtype is a no-op), so a device-resident copy keyed by
+    #: host identity serves every wave of the snapshot.
+    WAVE_STATIC_FIELDS = {
+        "cap_cpu": "cap_cpu", "cap_mem": "cap_mem",
+        "cap_disk": "cap_disk", "free_cores": "free_cores",
+        "shares_per_core": "shares_per_core",
+        "avail_mbits": "avail_mbits", "free_dyn": "free_dyn",
+    }
+    #: KernelIn field order of the gathered_usage tuple (the dynamic
+    #: half of the wave-shared group)
+    WAVE_USAGE_FIELDS = ("used_cpu", "used_mem", "used_disk",
+                         "used_cores", "used_mbits")
+
+    def wave_shared_planes(self, usage) -> Dict[str, np.ndarray]:
+        """KernelIn field -> host plane for every wave-shared leaf of
+        this (cluster build, usage snapshot) pair — exactly the arrays
+        an eval's ``build_kernel_in`` ships by identity when its plan
+        is empty (stack.py wave-shared build)."""
+        planes = {f: getattr(self, c)
+                  for f, c in self.WAVE_STATIC_FIELDS.items()}
+        for f, arr in zip(self.WAVE_USAGE_FIELDS,
+                          self.gathered_usage(usage)):
+            planes[f] = arr
+        return planes
+
     def class_rows(self) -> Dict[str, List[int]]:
         """computed class -> real-node rows, cached on the cluster build
         (the class-eligibility walk needs it once per EVAL; rebuilding

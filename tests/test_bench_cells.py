@@ -166,6 +166,65 @@ class TestPreemptionLoop:
         assert np.asarray(uc2)[3] == pytest.approx(900.0)
 
 
+class TestDonationAlignment:
+    """The reset-loop variants must not donate their plane arguments:
+    with ``reset_every`` the scan consumes ``p + 0`` copies and the
+    originals never alias an output — device backends then warn "Some
+    donated buffers were not usable" (promoted to an error suite-wide
+    in conftest, which is what this class feeds: the BENCH_r05
+    device/preemption bench path ran exactly these shapes). The loops
+    here re-use their input planes across two calls — donation, if it
+    ever came back, would invalidate the buffers and fail loudly."""
+
+    def test_device_loop_reset_inputs_survive(self):
+        cluster, shared = _shared()
+        n_pad = cluster.n_pad
+        df0 = jnp.zeros((n_pad, shared.dev_free.shape[1]))
+        uc = jnp.zeros(n_pad)
+        um = jnp.zeros(n_pad)
+        loop = make_device_apply_loop(K, reset_every=1)
+        T, B = 2, 1
+        a = jnp.full((T, B), 100.0)
+        a_gpu = jnp.zeros((T, B))
+        n_steps = jnp.full((B,), 1, jnp.int32)
+        out1 = loop(shared, uc, um, df0, a, a, a_gpu, n_steps)
+        out2 = loop(shared, uc, um, df0, a, a, a_gpu, n_steps)
+        assert int(out1[1]) == int(out2[1]) == 2
+
+    def test_preemption_loop_reset_inputs_survive(self):
+        cluster, shared = _shared(n=4, cpu=1000.0, mem=1000.0)
+        n_pad = cluster.n_pad
+        uc = jnp.zeros(n_pad)
+        um = jnp.zeros(n_pad)
+        pc = jnp.zeros(n_pad)
+        pm = jnp.zeros(n_pad)
+        ps = jnp.zeros(n_pad)
+        loop = make_preemption_apply_loop(K, reset_every=1)
+        T, B = 2, 1
+        a = jnp.full((T, B), 100.0)
+        n_steps = jnp.full((B,), 1, jnp.int32)
+        out1 = loop(shared, uc, um, pc, pm, ps, a, a, n_steps)
+        out2 = loop(shared, uc, um, pc, pm, ps, a, a, n_steps)
+        assert int(out1[1]) == int(out2[1]) == 2
+
+    def test_schedule_loop_reset_inputs_survive(self):
+        from nomad_tpu.ops.kernel import LEAN_FEATURES
+        from nomad_tpu.parallel.batching import make_schedule_apply_loop
+
+        cluster, shared = _shared()
+        n_pad = cluster.n_pad
+        uc = jnp.zeros(n_pad)
+        um = jnp.zeros(n_pad)
+        loop = make_schedule_apply_loop(K, LEAN_FEATURES, topk=True,
+                                        reset_every=1)
+        T, B = 2, 2
+        a = jnp.full((T, B), 100.0)
+        n_steps = jnp.full((B,), 1, jnp.int32)
+        out1 = loop(shared, uc, um, a, a, n_steps)
+        out2 = loop(shared, uc, um, a, a, n_steps)
+        assert int(out1[1]) == int(out2[1]) == 4
+
+
 class TestReplayCells:
     """Integration: the bench cells run end-to-end on a small replay."""
 

@@ -1,0 +1,240 @@
+"""Device-resident cluster state (ISSUE 3 tentpole): the dirty-row
+scatter advance must be bit-identical to a fresh ``ClusterTensors.
+build`` + full device upload after any sequence of alloc transitions
+and node mutations — the device mirror of tests/test_cluster_delta.py
+— including the eviction/miss and structure_version-fork fallback
+paths, and the host-identity registry the wave launcher's resident
+substitution rides on.
+"""
+
+import numpy as np
+import numpy.testing as npt
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from nomad_tpu import mock  # noqa: E402
+from nomad_tpu.state.store import StateStore  # noqa: E402
+from nomad_tpu.tensors.device_state import DeviceClusterState  # noqa: E402
+from nomad_tpu.tensors.schema import (  # noqa: E402
+    ClusterTensors,
+    IncrementalClusterCache,
+)
+
+
+def assert_resident_matches_fresh(ds: DeviceClusterState, snap) -> None:
+    """The resident generation for ``snap`` must be bit-identical to a
+    fresh build of its node table uploaded whole."""
+    u = snap.usage
+    fresh = ClusterTensors.build(snap.nodes())
+    want = fresh.wave_shared_planes(u)
+    gen = ds._gens[(u.uid, u.structure_version)]
+    for f, host in want.items():
+        got = np.asarray(gen.planes[f])
+        assert got.dtype == host.dtype, f
+        npt.assert_array_equal(got, host, err_msg=f)
+
+
+@pytest.fixture()
+def store():
+    s = StateStore()
+    for _ in range(24):
+        s.upsert_node(mock.node())
+    return s
+
+
+def _ensure(ds, cache, store):
+    snap = store.snapshot()
+    ds.ensure(cache.get(snap), snap.usage)
+    return snap
+
+
+class TestDeviceDeltaParity:
+    def test_alloc_churn_advances_by_scatter(self, store):
+        ds = DeviceClusterState()
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        nodes = store.snapshot().nodes()
+        store.upsert_allocs(
+            [mock.alloc(node_id=nodes[i % 8].id) for i in range(20)])
+        snap = _ensure(ds, cache, store)
+        assert ds.delta_advances == 1
+        assert ds.full_uploads == 1          # only the initial build
+        assert ds.rows_uploaded > 0
+        assert_resident_matches_fresh(ds, snap)
+
+    def test_structural_update_is_fork_delta(self, store):
+        ds = DeviceClusterState()
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        node = store.snapshot().nodes()[5].copy()
+        node.node_resources.cpu.cpu_shares = 12345
+        store.upsert_node(node)
+        snap = _ensure(ds, cache, store)
+        assert ds.fork_deltas == 1
+        assert_resident_matches_fresh(ds, snap)
+
+    def test_delete_permutes_rows_and_falls_back_to_full(self, store):
+        ds = DeviceClusterState()
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        store.delete_node(store.snapshot().nodes()[0].id)
+        snap = _ensure(ds, cache, store)
+        # compaction moved surviving rows: no device-side gather, so
+        # this MUST be a full upload — and still bit-identical
+        assert ds.fork_deltas == 0
+        assert ds.full_uploads == 2
+        assert_resident_matches_fresh(ds, snap)
+
+    def test_random_scatter_sequences(self, store):
+        """Property-style: random interleavings of alloc transitions,
+        node adds/updates/drains/deletes; device-vs-fresh parity after
+        every round."""
+        rng = np.random.default_rng(23)
+        ds = DeviceClusterState()
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        live_allocs = []
+        for _round in range(8):
+            for _ in range(int(rng.integers(1, 5))):
+                nodes = store.snapshot().nodes()
+                pick = nodes[int(rng.integers(0, len(nodes)))]
+                op = rng.integers(0, 6)
+                if op == 0:
+                    a = mock.alloc(node_id=pick.id)
+                    live_allocs.append(a)
+                    store.upsert_allocs([a])
+                elif op == 1 and live_allocs:
+                    a = live_allocs.pop(
+                        int(rng.integers(0, len(live_allocs))))
+                    store.stop_alloc(a.id, [])
+                elif op == 2:
+                    store.upsert_node(mock.node())
+                elif op == 3:
+                    n = pick.copy()
+                    n.node_resources.cpu.cpu_shares = int(
+                        rng.integers(1000, 9000))
+                    store.upsert_node(n)
+                elif op == 4:
+                    store.update_node_drain(pick.id,
+                                            bool(rng.integers(0, 2)))
+                elif len(nodes) > 4:
+                    store.delete_node(pick.id)
+            snap = _ensure(ds, cache, store)
+            assert_resident_matches_fresh(ds, snap)
+        # the scatter paths actually ran (not everything fell back)
+        assert ds.delta_advances + ds.fork_deltas >= 2
+
+    def test_trimmed_row_log_falls_back_to_full_usage_upload(self, store):
+        from nomad_tpu.state import usage as usage_mod
+
+        ds = DeviceClusterState()
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        nodes = store.snapshot().nodes()
+        for i in range(usage_mod.ROW_LOG_MAX + 8):
+            store.upsert_allocs(
+                [mock.alloc(node_id=nodes[i % 8].id)])
+        snap = _ensure(ds, cache, store)
+        assert ds.usage_full_uploads == 1
+        assert ds.delta_advances == 0
+        assert_resident_matches_fresh(ds, snap)
+
+
+class TestGenerationCache:
+    def test_same_version_is_hit(self, store):
+        ds = DeviceClusterState()
+        cache = IncrementalClusterCache()
+        snap = store.snapshot()
+        g1 = ds.ensure(cache.get(snap), snap.usage)
+        g2 = ds.ensure(cache.get(store.snapshot()), snap.usage)
+        assert g1 is g2
+        assert ds.hits == 1
+
+    def test_structure_fork_keeps_both_generations(self, store):
+        """An in-flight wave still executing against the OLD structure
+        version must keep its resident planes while the new version's
+        generation advances — the double-buffer contract."""
+        ds = DeviceClusterState()
+        cache = IncrementalClusterCache()
+        old_snap = store.snapshot()
+        old_cluster = cache.get(old_snap)
+        ds.ensure(old_cluster, old_snap.usage)
+        old_host = old_cluster.wave_shared_planes(old_snap.usage)
+        old_dev = {f: ds.lookup(h) for f, h in old_host.items()}
+        store.upsert_node(mock.node())
+        new_snap = _ensure(ds, cache, store)
+        assert_resident_matches_fresh(ds, new_snap)
+        # the old generation's arrays are still resident and untouched
+        for f, host in old_host.items():
+            dev = ds.lookup(host)
+            assert dev is old_dev[f], f
+            npt.assert_array_equal(np.asarray(dev), host, err_msg=f)
+
+    def test_older_snapshot_does_not_demote_generation(self, store):
+        """A pipelined eval still on an older usage snapshot must MISS
+        (its wave ships host planes) without demoting the advanced
+        generation — demotion would full-upload per interleave and
+        ping-pong the registry between versions."""
+        ds = DeviceClusterState()
+        cache = IncrementalClusterCache()
+        old_snap = store.snapshot()
+        cluster = cache.get(old_snap)
+        ds.ensure(cluster, old_snap.usage)
+        store.upsert_allocs(
+            [mock.alloc(node_id=store.snapshot().nodes()[0].id)])
+        new_snap = _ensure(ds, cache, store)
+        uploads = ds.full_uploads + ds.usage_full_uploads
+        gen_new = ds._gens[(new_snap.usage.uid,
+                            new_snap.usage.structure_version)]
+        assert ds.ensure(cache.get(old_snap), old_snap.usage) is None
+        assert ds.full_uploads + ds.usage_full_uploads == uploads
+        assert ds._gens[(new_snap.usage.uid,
+                         new_snap.usage.structure_version)] is gen_new
+        assert gen_new.version == new_snap.usage.version
+        # the stale snapshot's read-only gathered planes must not
+        # sneak in through the frozen-singleton path either
+        stale_used = cluster.gathered_usage(old_snap.usage)[0]
+        assert ds.lookup(stale_used, frozen_ok=False) is None
+        assert len(ds._frozen) == 0
+
+    def test_eviction_unregisters_and_miss_rebuilds(self, store):
+        ds = DeviceClusterState(max_generations=2)
+        cache = IncrementalClusterCache()
+        first = store.snapshot()
+        first_cluster = cache.get(first)
+        ds.ensure(first_cluster, first.usage)
+        first_host = first_cluster.wave_shared_planes(first.usage)
+        for _ in range(3):
+            store.upsert_node(mock.node())
+            _ensure(ds, cache, store)
+        assert len(ds._gens) == 2
+        # the first generation was evicted: its host arrays no longer
+        # resolve (mutable arrays need a live registration)
+        assert ds.lookup(first_host["cap_cpu"]) is None
+        # an ensure for the evicted version is a miss -> full upload,
+        # bit-identical by construction
+        full_before = ds.full_uploads
+        ds.ensure(first_cluster, first.usage)
+        assert ds.full_uploads == full_before + 1
+        gen = ds._gens[(first.usage.uid, first.usage.structure_version)]
+        for f, host in first_host.items():
+            npt.assert_array_equal(np.asarray(gen.planes[f]), host,
+                                   err_msg=f)
+
+
+class TestRegistry:
+    def test_frozen_singletons_become_resident(self):
+        from nomad_tpu.ops.kernel import neutral_planes
+
+        ds = DeviceClusterState()
+        host = neutral_planes(64).zeros_f32
+        dev1 = ds.lookup(host)
+        dev2 = ds.lookup(host)
+        assert dev1 is not None and dev1 is dev2
+        npt.assert_array_equal(np.asarray(dev1), host)
+
+    def test_mutable_unregistered_array_is_not_resident(self):
+        ds = DeviceClusterState()
+        assert ds.lookup(np.zeros(8, np.float32)) is None
+        assert ds.lookup(3.5) is None

@@ -222,6 +222,12 @@ class TestExposition:
         assert 'nomad_tpu_trace_span_seconds_total{span="unit.test.span"}' \
             in text
         assert "nomad_tpu_telemetry_enabled 1" in text
+        # transfer byte counters + device-residency series (ISSUE 3)
+        assert 'nomad_tpu_kernel_transfer_bytes_total{direction="h2d"}' \
+            in text
+        assert 'nomad_tpu_kernel_transfer_bytes_total{direction="d2h"}' \
+            in text
+        assert "nomad_tpu_device_state_dirty_row_upload_ratio" in text
 
     def test_traces_json_shape(self, clean_telemetry):
         with tracer.span("a", trace_id="t"):
@@ -382,8 +388,12 @@ class TestTraceDecomposition:
             )
             assert proc.returncode == 0, proc.stderr.decode()[-2000:]
             decomp = json.loads(out.read_text())
+            ss = decomp["steady_state"]
             if raw_share(decomp) >= 0.9 \
-                    and decomp["steady_state"]["jit_cache_misses"] == 0:
+                    and ss["jit_cache_misses"] == 0 \
+                    and decomp["allocs_placed"] == decomp["allocs_wanted"] \
+                    and (ss["h2d_share"] <= 0.10 or ss["h2d_bytes"]
+                         <= 50_000 * decomp["n_evals"]):
                 break
         assert decomp["allocs_placed"] == decomp["allocs_wanted"]
         # raw wall coverage on a quiet host; the steal-invariant busy
@@ -407,10 +417,30 @@ class TestTraceDecomposition:
         assert decomp["steady_state"]["jit_cache_misses"] == 0, \
             decomp["kernel"]["PerKey"]
         assert decomp["steady_state"]["compile_share"] < 0.10
+        # ISSUE 3 steady gate: with the device-resident cluster state
+        # in front of the wave launcher, per-wave h2d is dirty rows +
+        # genuinely per-eval planes — its share of steady wall must
+        # stay under 10% (was 30.4% when every wave re-uploaded the
+        # full shared planes). The share is wall-clocked, so a
+        # contended host (GIL theft stretching the firing thread's
+        # spans) can inflate it with time the transfer never used; the
+        # steal-invariant fallback is the BYTE meter — re-uploading
+        # full planes per wave costs >100KB/eval, residency ~10-40KB —
+        # which is a property of the system, not of the CI neighbors.
+        ss = decomp["steady_state"]
+        assert ss["h2d_share"] <= 0.10 \
+            or ss["h2d_bytes"] <= 50_000 * decomp["n_evals"], ss
+        # and the transfer byte meters actually metered
+        assert ss["h2d_bytes"] > 0
+        assert ss["d2h_bytes"] > 0
         assert decomp["attributed_share"] <= 1.0
         # wave-shape telemetry rides the artifact
         assert decomp["wave"]["launches"] >= 1
         assert 0.0 < decomp["wave"]["fill_ratio"] <= 1.0
+        # device-residency accounting rides it too: the steady burst
+        # must be advancing by dirty-row scatter, not full re-uploads
+        assert decomp["device_state"]["delta_advances"] >= 1, \
+            decomp["device_state"]
 
     def test_disabled_tracing_leaves_no_spans(self):
         """The disabled live path must record nothing (the <5%
