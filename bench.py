@@ -1138,6 +1138,13 @@ def main() -> None:
                     "fill_ratio"),
                 trace_park_latency_p99_ms=decomp.get("wave", {}).get(
                     "park_latency_p99_ms"),
+                # ISSUE 5 steady gates: total Python-scheduling share
+                # (sched-host + its sub-decomposed slices) and the
+                # feasibility mask-program cache hit ratio
+                trace_steady_sched_host_share=steady.get(
+                    "sched_host_share"),
+                trace_feasibility_hit_ratio=steady.get(
+                    "feasibility_hit_ratio"),
             )
         except Exception as e:                   # noqa: BLE001
             import traceback

@@ -396,6 +396,7 @@ def warmup_entries(entries: List[Dict]) -> Tuple[int, int]:
     Failures are logged and skipped — warmup is an optimization, never
     a liveness dependency."""
     compiled = failed = 0
+    node_sizes = set()
     for e in _dedupe(entries):
         try:
             if e.get("kernel") == "joint":
@@ -406,9 +407,21 @@ def warmup_entries(entries: List[Dict]) -> Tuple[int, int]:
                 continue
             if did:
                 compiled += 1
+                node_sizes.add(int(e["nodes"]))
         except Exception as err:                # noqa: BLE001
             failed += 1
             LOG.warning("kernel warmup entry failed (%s): %s", e, err)
+    # the device-resident state's dirty-row scatter rides the same
+    # node shapes: precompile its (row-bucket, dtype) programs so the
+    # first burst whose dirty set crosses a fresh bucket doesn't pay a
+    # cold compile inside an eval's snapshot phase
+    for n in sorted(node_sizes):
+        try:
+            from nomad_tpu.tensors.device_state import default_device_state
+
+            default_device_state.warm_scatter(n)
+        except Exception as err:                # noqa: BLE001
+            LOG.warning("scatter warmup failed (n=%d): %s", n, err)
     return compiled, failed
 
 

@@ -116,8 +116,19 @@ _NEUTRAL_SHAREABLE_FIELDS = (
 #: Three all-or-nothing groups -> at most EIGHT layout variants per
 #: (bucket, step, features) triple, all enumerable by the AOT warmup
 #: lattice.
+#:
+#: ``base_mask`` joined the group with the feasibility compiler
+#: (nomad_tpu/feasibility/): evals with no dynamic feasibility state
+#: carry the mask-program cache's FROZEN array — members of equal job
+#: specs (and, via content dedup, of any specs whose masks come out
+#: equal) share it by identity, so the wave ships ONE base-mask plane
+#: and the device broadcasts it to every member: the whole wave's base
+#: masks from one dispatch. The frozen array rides the device-resident
+#: frozen registry (frozen_ok lookup below), uploading once per
+#: (node structure, constraint tree) ever.
 _JOB_SHAREABLE_FIELDS = (
     "job_tg_count", "job_any_count", "penalty", "aff_score",
+    "base_mask",
 )
 
 

@@ -138,6 +138,35 @@ def devices_exist(node, tg) -> bool:
     return True
 
 
+def eligible_in_dcs(c: ClusterTensors, datacenters: List[str],
+                    node_pool: str = "default") -> np.ndarray:
+    """readyNodesInDCs (util.go:351) as a mask; a job's node_pool
+    restricts to matching nodes ('all' is the match-everything pool).
+
+    Module-level so the feasibility compiler's evaluation engine
+    (nomad_tpu/feasibility/runtime.py) runs EXACTLY this code for its
+    cached masks — bit-identity with the per-eval builder holds by
+    construction, not by reimplementation."""
+    mask = c.ready.copy()
+    dcs = set(datacenters)
+    wildcard = any("*" in dc for dc in dcs)
+    if not wildcard and hasattr(c, "dc_pool_arrays"):
+        # vectorized fast path (no glob patterns in the job's DCs)
+        dc_arr, pool_arr = c.dc_pool_arrays()
+        mask &= np.isin(dc_arr, list(dcs))
+        if node_pool and node_pool != "all":
+            mask &= pool_arr == node_pool
+        return mask
+    for i in range(c.n_real):
+        if c.datacenters[i] not in dcs:
+            if not (wildcard and _dc_glob_match(dcs, c.datacenters[i])):
+                mask[i] = False
+                continue
+        if node_pool and node_pool != "all" and c.node_pools[i] != node_pool:
+            mask[i] = False
+    return mask
+
+
 class FeasibilityBuilder:
     """Builds base_mask[n_pad] for one (job, task group)."""
 
@@ -154,28 +183,7 @@ class FeasibilityBuilder:
         return self._class_rows
 
     def eligible_in_dcs(self, datacenters: List[str], node_pool: str = "default") -> np.ndarray:
-        """readyNodesInDCs (util.go:351) as a mask; a job's node_pool
-        restricts to matching nodes ('all' is the match-everything
-        pool)."""
-        c = self.cluster
-        mask = c.ready.copy()
-        dcs = set(datacenters)
-        wildcard = any("*" in dc for dc in dcs)
-        if not wildcard and hasattr(c, "dc_pool_arrays"):
-            # vectorized fast path (no glob patterns in the job's DCs)
-            dc_arr, pool_arr = c.dc_pool_arrays()
-            mask &= np.isin(dc_arr, list(dcs))
-            if node_pool and node_pool != "all":
-                mask &= pool_arr == node_pool
-            return mask
-        for i in range(c.n_real):
-            if c.datacenters[i] not in dcs:
-                if not (wildcard and _dc_glob_match(dcs, c.datacenters[i])):
-                    mask[i] = False
-                    continue
-            if node_pool and node_pool != "all" and c.node_pools[i] != node_pool:
-                mask[i] = False
-        return mask
+        return eligible_in_dcs(self.cluster, datacenters, node_pool)
 
     def base_mask(self, job, tg, job_allocs_by_node: Dict[str, List]) -> np.ndarray:
         """The full host-side feasibility plane."""

@@ -13,7 +13,6 @@ group, carrying per-placement penalty/preferred planes.
 
 from __future__ import annotations
 
-import uuid
 from typing import Dict, List, Optional
 
 from nomad_tpu.scheduler.context import EvalContext
@@ -40,11 +39,23 @@ from nomad_tpu.scheduler.util import (
 )
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.alloc import AllocMetric, Allocation, RescheduleEvent, RescheduleTracker
-from nomad_tpu.structs.eval_plan import Evaluation, Plan
+from nomad_tpu.structs.eval_plan import Evaluation, Plan, generate_uuid
+from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.tensors.schema import AskLimitError, ClusterTensors
 
 MAX_SERVICE_ATTEMPTS = 5    # generic_sched.go:16
 MAX_BATCH_ATTEMPTS = 2      # generic_sched.go:20
+
+_VALID_TRIGGERS = frozenset({
+    consts.EVAL_TRIGGER_JOB_REGISTER, consts.EVAL_TRIGGER_JOB_DEREGISTER,
+    consts.EVAL_TRIGGER_NODE_DRAIN, consts.EVAL_TRIGGER_NODE_UPDATE,
+    consts.EVAL_TRIGGER_ALLOC_STOP, consts.EVAL_TRIGGER_ROLLING_UPDATE,
+    consts.EVAL_TRIGGER_QUEUED_ALLOCS, consts.EVAL_TRIGGER_PERIODIC_JOB,
+    consts.EVAL_TRIGGER_MAX_PLAN_ATTEMPTS, consts.EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+    consts.EVAL_TRIGGER_RETRY_FAILED_ALLOC, consts.EVAL_TRIGGER_FAILED_FOLLOW_UP,
+    consts.EVAL_TRIGGER_PREEMPTION, consts.EVAL_TRIGGER_SCALING,
+    consts.EVAL_TRIGGER_MAX_DISCONNECT_TIMEOUT, consts.EVAL_TRIGGER_RECONNECT,
+})
 BLOCKED_EVAL_MAX_PLAN = "created due to placement conflicts"
 BLOCKED_EVAL_FAILED_PLACEMENTS = "created to place remaining allocations"
 
@@ -75,17 +86,7 @@ class GenericScheduler(Scheduler):
 
     def process(self, evaluation: Evaluation) -> None:
         self.eval = evaluation
-        valid_triggers = {
-            consts.EVAL_TRIGGER_JOB_REGISTER, consts.EVAL_TRIGGER_JOB_DEREGISTER,
-            consts.EVAL_TRIGGER_NODE_DRAIN, consts.EVAL_TRIGGER_NODE_UPDATE,
-            consts.EVAL_TRIGGER_ALLOC_STOP, consts.EVAL_TRIGGER_ROLLING_UPDATE,
-            consts.EVAL_TRIGGER_QUEUED_ALLOCS, consts.EVAL_TRIGGER_PERIODIC_JOB,
-            consts.EVAL_TRIGGER_MAX_PLAN_ATTEMPTS, consts.EVAL_TRIGGER_DEPLOYMENT_WATCHER,
-            consts.EVAL_TRIGGER_RETRY_FAILED_ALLOC, consts.EVAL_TRIGGER_FAILED_FOLLOW_UP,
-            consts.EVAL_TRIGGER_PREEMPTION, consts.EVAL_TRIGGER_SCALING,
-            consts.EVAL_TRIGGER_MAX_DISCONNECT_TIMEOUT, consts.EVAL_TRIGGER_RECONNECT,
-        }
-        if evaluation.triggered_by not in valid_triggers:
+        if evaluation.triggered_by not in _VALID_TRIGGERS:
             self._set_status(
                 consts.EVAL_STATUS_FAILED,
                 f"scheduler cannot handle '{evaluation.triggered_by}' evaluation reason",
@@ -305,92 +306,109 @@ class GenericScheduler(Scheduler):
             options = self.stack.select_many(tg, requests)
             preempt_ok = self._preemption_enabled()
 
-            for missing, req, option in zip(missings, requests, options):
-                prev = req.prev_alloc
-                if option is None and preempt_ok:
-                    # preemption second pass (generic_sched.go:800-819
-                    # selectNextOption), one slot at a time INSIDE the
-                    # placement loop: each call sees the plan with the
-                    # previous slots' placements and staged evictions,
-                    # so freed capacity and victims are never counted
-                    # twice across slots
-                    option = self.stack.select_preempting(tg, req)
-                if option is None:
-                    if tg_name not in self.failed_tg_allocs:
-                        m = self.ctx.metrics().copy()
-                        m.nodes_in_pool = self._cluster.n_real
-                        self.failed_tg_allocs[tg_name] = m
-                    else:
-                        self.failed_tg_allocs[tg_name].coalesced_failures += 1
-                    # back out the staged stop of the previous alloc
-                    stop_prev, _ = missing.stop_previous_alloc()
-                    if stop_prev and prev is not None:
-                        updates = self.plan.node_update.get(prev.node_id, [])
-                        for i in range(len(updates) - 1, -1, -1):
-                            if updates[i].id == prev.id:
-                                updates.pop(i)
-                                break
-                    continue
-
-                from nomad_tpu.structs.resources import (
-                    AllocatedResources,
-                    AllocatedSharedResources,
-                )
-
-                resources = AllocatedResources(
-                    tasks=option.task_resources,
-                    task_lifecycles=option.task_lifecycles,
-                    shared=AllocatedSharedResources(
-                        disk_mb=tg.ephemeral_disk.size_mb
-                    ),
-                )
-                if option.alloc_resources is not None:
-                    resources.shared.networks = option.alloc_resources.networks
-                    resources.shared.ports = option.alloc_resources.ports
-
-                alloc = Allocation(
-                    id=str(uuid.uuid4()),
-                    namespace=self.job.namespace,
-                    eval_id=self.eval.id,
-                    name=missing.name if not hasattr(missing, "place_name") else missing.place_name,
-                    job_id=self.job.id,
-                    job_version=self.job.version,
-                    task_group=tg.name,
-                    metrics=option.metrics,
-                    node_id=option.node_id,
-                    node_name=option.node.name,
-                    deployment_id=deployment_id,
-                    allocated_resources=resources,
-                    desired_status=consts.ALLOC_DESIRED_RUN,
-                    client_status=consts.ALLOC_CLIENT_PENDING,
-                    create_time_ns=int(now * 1e9),
-                    modify_time_ns=int(now * 1e9),
-                )
-                if prev is not None:
-                    alloc.previous_allocation = prev.id
-                    if getattr(missing, "reschedule", False):
-                        _update_reschedule_tracker(alloc, prev, now)
-                # handlePreemptions (generic_sched.go:821-843)
-                if option.preempted_allocs:
-                    preempted_ids = []
-                    for stop in option.preempted_allocs:
-                        self.plan.append_preempted_alloc(stop, alloc.id)
-                        preempted_ids.append(stop.id)
-                        if self.eval.annotate_plan and self.plan.annotations is not None:
-                            desired = self.plan.annotations.desired_tg_updates.get(tg.name)
-                            if desired is not None:
-                                desired.preemptions += 1
-                    alloc.preempted_allocations = preempted_ids
-                if getattr(missing, "canary", False) and self.deployment is not None:
-                    from nomad_tpu.structs.alloc import AllocDeploymentStatus
-
-                    alloc.deployment_status = AllocDeploymentStatus(canary=True)
-                    dstate = self.deployment.task_groups.get(tg.name)
-                    if dstate is not None:
-                        dstate.placed_canaries.append(alloc.id)
-
-                self.plan.append_alloc(alloc, None)
+            # the alloc-construction tail is the "plan build" slice of
+            # the sched-host decomposition (bench/trace_report.py)
+            self._append_placements(
+                tg, tg_name, missings, requests, options, preempt_ok,
+                deployment_id, now)
         return None
+
+    def _append_placements(self, tg, tg_name, missings, requests,
+                           options, preempt_ok, deployment_id,
+                           now) -> None:
+        with tracer.span("sched.planbuild"):
+            self._append_placements_inner(
+                tg, tg_name, missings, requests, options, preempt_ok,
+                deployment_id, now)
+
+    def _append_placements_inner(self, tg, tg_name, missings, requests,
+                                 options, preempt_ok, deployment_id,
+                                 now) -> None:
+        for missing, req, option in zip(missings, requests, options):
+            prev = req.prev_alloc
+            if option is None and preempt_ok:
+                # preemption second pass (generic_sched.go:800-819
+                # selectNextOption), one slot at a time INSIDE the
+                # placement loop: each call sees the plan with the
+                # previous slots' placements and staged evictions,
+                # so freed capacity and victims are never counted
+                # twice across slots
+                option = self.stack.select_preempting(tg, req)
+            if option is None:
+                if tg_name not in self.failed_tg_allocs:
+                    m = self.ctx.metrics().copy()
+                    m.nodes_in_pool = self._cluster.n_real
+                    self.failed_tg_allocs[tg_name] = m
+                else:
+                    self.failed_tg_allocs[tg_name].coalesced_failures += 1
+                # back out the staged stop of the previous alloc
+                stop_prev, _ = missing.stop_previous_alloc()
+                if stop_prev and prev is not None:
+                    updates = self.plan.node_update.get(prev.node_id, [])
+                    for i in range(len(updates) - 1, -1, -1):
+                        if updates[i].id == prev.id:
+                            updates.pop(i)
+                            break
+                continue
+
+            from nomad_tpu.structs.resources import (
+                AllocatedResources,
+                AllocatedSharedResources,
+            )
+
+            resources = AllocatedResources(
+                tasks=option.task_resources,
+                task_lifecycles=option.task_lifecycles,
+                shared=AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb
+                ),
+            )
+            if option.alloc_resources is not None:
+                resources.shared.networks = option.alloc_resources.networks
+                resources.shared.ports = option.alloc_resources.ports
+
+            alloc = Allocation(
+                id=generate_uuid(),
+                namespace=self.job.namespace,
+                eval_id=self.eval.id,
+                name=missing.name if not hasattr(missing, "place_name") else missing.place_name,
+                job_id=self.job.id,
+                job_version=self.job.version,
+                task_group=tg.name,
+                metrics=option.metrics,
+                node_id=option.node_id,
+                node_name=option.node.name,
+                deployment_id=deployment_id,
+                allocated_resources=resources,
+                desired_status=consts.ALLOC_DESIRED_RUN,
+                client_status=consts.ALLOC_CLIENT_PENDING,
+                create_time_ns=int(now * 1e9),
+                modify_time_ns=int(now * 1e9),
+            )
+            if prev is not None:
+                alloc.previous_allocation = prev.id
+                if getattr(missing, "reschedule", False):
+                    _update_reschedule_tracker(alloc, prev, now)
+            # handlePreemptions (generic_sched.go:821-843)
+            if option.preempted_allocs:
+                preempted_ids = []
+                for stop in option.preempted_allocs:
+                    self.plan.append_preempted_alloc(stop, alloc.id)
+                    preempted_ids.append(stop.id)
+                    if self.eval.annotate_plan and self.plan.annotations is not None:
+                        desired = self.plan.annotations.desired_tg_updates.get(tg.name)
+                        if desired is not None:
+                            desired.preemptions += 1
+                alloc.preempted_allocations = preempted_ids
+            if getattr(missing, "canary", False) and self.deployment is not None:
+                from nomad_tpu.structs.alloc import AllocDeploymentStatus
+
+                alloc.deployment_status = AllocDeploymentStatus(canary=True)
+                dstate = self.deployment.task_groups.get(tg.name)
+                if dstate is not None:
+                    dstate.placed_canaries.append(alloc.id)
+
+            self.plan.append_alloc(alloc, None)
 
     def _preemption_enabled(self) -> bool:
         """Scheduler-config preemption toggle for this job type

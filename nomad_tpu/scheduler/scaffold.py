@@ -1,0 +1,87 @@
+"""Plan-skeleton cache: the per-(job, tg) scaffold evals rebuild.
+
+Every evaluation (and every retry attempt inside one) re-derives the
+same job/tg-shaped state before it can launch a kernel: the flattened
+``AskTensor``, the merged constraint list, the affinity list, the
+distinct-hosts flags, and (post-feasibility-compiler) the compiled
+mask program. None of it depends on the evaluation — only on the job
+spec — so a wave of 32 members re-deriving it 32 times is pure
+sched-host overhead (ROADMAP lever #1, "cache plan skeletons").
+
+Two-level lookup:
+
+- identity fast path: scaffolds are memoized per TaskGroup OBJECT
+  (state-store job rows are immutable and shared by every eval of the
+  job, so the tg's identity is stable across wave members, retry
+  attempts, and follow-up evals); entries pin the tg and re-check
+  identity, so a recycled ``id()`` can never alias a dead group;
+- spec-shared slow path: scaffolds key the compiled mask program by
+  the structural signature, so DIFFERENT jobs with equal constraint
+  trees still share one program and one cached mask.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+from nomad_tpu.tensors.schema import AskTensor
+
+__all__ = ["TGScaffold", "scaffold_for"]
+
+_LOCK = threading.Lock()
+_CACHE: "OrderedDict[int, Tuple[object, TGScaffold]]" = OrderedDict()
+_CACHE_MAX = 512
+
+
+class TGScaffold:
+    """Spec-derived, eval-independent state for one (job, tg)."""
+
+    __slots__ = ("ask", "affinities", "distinct_hosts_job",
+                 "distinct_hosts_tg", "has_devices", "program",
+                 "program_compiled")
+
+    def __init__(self, job, tg) -> None:
+        from nomad_tpu.structs import consts
+
+        self.ask: AskTensor = AskTensor.build(tg)
+        affinities = list(job.affinities) + list(tg.affinities)
+        for task in tg.tasks:
+            affinities.extend(task.affinities)
+        self.affinities: List = affinities
+        self.distinct_hosts_job = any(
+            con.operand == consts.CONSTRAINT_DISTINCT_HOSTS
+            for con in job.constraints)
+        self.distinct_hosts_tg = any(
+            con.operand == consts.CONSTRAINT_DISTINCT_HOSTS
+            for con in tg.constraints)
+        self.has_devices = any(t.resources.devices for t in tg.tasks)
+        # compiled mask program (None = Python-builder fallback); the
+        # program cache dedupes by signature across jobs
+        from nomad_tpu.feasibility import default_mask_cache
+
+        self.program = default_mask_cache.program_for(job, tg)
+        self.program_compiled = self.program is not None
+
+
+def scaffold_for(job, tg) -> TGScaffold:
+    """The (job, tg) scaffold, memoized per tg object.
+
+    AskTensor.build can raise AskLimitError — it happens before the
+    cache insert, so the limit error surfaces per eval exactly as
+    before and never caches a half-built scaffold."""
+    key = id(tg)
+    ent = _CACHE.get(key)
+    if ent is not None and ent[0] is tg:
+        return ent[1]
+    built = TGScaffold(job, tg)
+    with _LOCK:
+        ent = _CACHE.get(key)
+        if ent is not None and ent[0] is tg:
+            return ent[1]
+        _CACHE[key] = (tg, built)
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > _CACHE_MAX:
+            _CACHE.popitem(last=False)
+    return built

@@ -37,7 +37,9 @@ from nomad_tpu.ops.kernel import (
 from nomad_tpu.scheduler.context import EvalContext
 from nomad_tpu.scheduler.device import DeviceAllocator, device_planes_for_node
 from nomad_tpu.scheduler.feasible import FeasibilityBuilder
+from nomad_tpu.scheduler.scaffold import scaffold_for
 from nomad_tpu.structs import consts
+from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.structs.alloc import AllocMetric
 from nomad_tpu.structs.constraints import matches_affinity, resolve_target
 from nomad_tpu.structs.network import NetworkIndex, NetworkResource
@@ -382,11 +384,46 @@ class XLAGenericStack:
 
     # -- tensor builders -------------------------------------------------
 
+    def _base_mask(self, scaffold, job, tg, job_allocs_by_node,
+                   exclude: np.ndarray) -> np.ndarray:
+        """Compiled-mask fast path with Python-builder fallback.
+
+        The compiled path returns the mask-program cache's FROZEN
+        array when the eval carries no dynamic state — wave members of
+        equal job specs then share one base-mask plane by identity
+        (shipped once per wave, resident on device once ever). Any
+        uncompilable tree, and any compiled-path error, falls back to
+        ``FeasibilityBuilder.base_mask``, which is the semantics
+        definition the compiler is property-tested against."""
+        from nomad_tpu.feasibility import apply_program, default_mask_cache
+
+        if scaffold.program is not None:
+            try:
+                return apply_program(
+                    scaffold.program, self.cluster, self.ctx.state,
+                    self.ctx, job, tg, job_allocs_by_node, exclude,
+                    self._feas)
+            except Exception:                   # noqa: BLE001
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "feasibility compiler failed; falling back",
+                    exc_info=True)
+        default_mask_cache.note_fallback()
+        base = self._feas.base_mask(job, tg, job_allocs_by_node)
+        base &= ~exclude
+        return base
+
     def _build_eval_tensors(self, tg, exclude: np.ndarray) -> EvalTensors:
+        with tracer.span("sched.assembly"):
+            return self._build_eval_tensors_inner(tg, exclude)
+
+    def _build_eval_tensors_inner(self, tg, exclude: np.ndarray) -> EvalTensors:
         c = self.cluster
         snapshot = self.ctx.state
         job = self.job
         n = c.n_pad
+        scaffold = scaffold_for(job, tg)
 
         job_allocs = snapshot.allocs_by_job(job.namespace, job.id)
         # distinct_hosts/property masks see PROPOSED allocs (feasible.go
@@ -412,8 +449,9 @@ class XLAGenericStack:
                 if a.job_id == job.id:
                     job_allocs_by_node.setdefault(a.node_id, []).append(a)
 
-        base = self._feas.base_mask(job, tg, job_allocs_by_node)
-        base &= ~exclude
+        with tracer.span("sched.feasibility"):
+            base = self._base_mask(scaffold, job, tg,
+                                   job_allocs_by_node, exclude)
 
         # neutral O(n) planes are frozen singletons shared BY IDENTITY
         # across evals (and so shipped once per coalesced wave); any
@@ -424,7 +462,9 @@ class XLAGenericStack:
         conflict_words = neutral_port_words(n, c.port_words.shape[1])
         free_dyn_delta = neutral.zeros_i32
 
-        ask = AskTensor.build(tg)
+        # plan-skeleton cache: the flattened ask is spec-derived and
+        # shared across wave members / retry attempts of the job
+        ask = scaffold.ask
 
         u = getattr(snapshot, "usage", None)
         if (u is not None and not plan.node_update
@@ -490,9 +530,7 @@ class XLAGenericStack:
                 has_dev_aff = has_dev_aff or has_aff
 
         # affinity plane (NodeAffinityIterator rank.go:674)
-        affinities = list(job.affinities) + list(tg.affinities)
-        for task in tg.tasks:
-            affinities.extend(task.affinities)
+        affinities = scaffold.affinities
         aff_score = neutral.zeros_f32
         if affinities:
             aff_score = np.zeros(n, np.float32)
@@ -532,14 +570,8 @@ class XLAGenericStack:
             has_dev_affinity=has_dev_aff,
             job_tg_count=job_tg_count,
             job_any_count=job_any_count,
-            distinct_hosts_job=any(
-                con.operand == consts.CONSTRAINT_DISTINCT_HOSTS
-                for con in job.constraints
-            ),
-            distinct_hosts_tg=any(
-                con.operand == consts.CONSTRAINT_DISTINCT_HOSTS
-                for con in tg.constraints
-            ),
+            distinct_hosts_job=scaffold.distinct_hosts_job,
+            distinct_hosts_tg=scaffold.distinct_hosts_tg,
             penalty=neutral.zeros_bool,
             aff_score=aff_score,
             has_affinities=bool(affinities),
@@ -751,10 +783,12 @@ class XLAGenericStack:
 
     def _metrics_proto(self, out: KernelOut):
         """Per-launch precomputation for ``_metrics_for``: the header
-        counts are identical for every slot, and bulk ``tolist()`` is
-        ~10x cheaper than per-element numpy scalar conversion (the
-        per-slot metrics build was a top-3 host cost of the live
-        path)."""
+        counts are identical for every slot. The top-k planes stay
+        numpy — their tolist + score_meta materialization is DEFERRED
+        onto the plan's post-processing queue (plan.deferred_work), so
+        it runs inside the batching worker's plan window — overlapping
+        the next wave's execute — instead of on the wave-critical eval
+        path."""
         nodes_evaluated = int(out.nodes_evaluated)
         nodes_exhausted = int(out.nodes_evaluated - out.nodes_feasible)
         dim_exhausted = {}
@@ -769,7 +803,7 @@ class XLAGenericStack:
             if int(cnt) > 0:
                 dim_exhausted[dim] = int(cnt)
         return (nodes_evaluated, nodes_exhausted, dim_exhausted,
-                out.topk_idx.tolist(), out.topk_scores.tolist())
+                out.topk_idx, out.topk_scores)
 
     def _metrics_for(self, proto, slot: int) -> AllocMetric:
         nodes_evaluated, nodes_exhausted, dim_exhausted, \
@@ -781,15 +815,22 @@ class XLAGenericStack:
         m.nodes_exhausted = nodes_exhausted
         if dim_exhausted:
             m.dimension_exhausted.update(dim_exhausted)
+        # score_meta fills in place before the plan applies (the
+        # Allocation holds this same AllocMetric object by reference)
+        self.ctx.plan.deferred_work.append(
+            lambda m=m, slot=slot: self._fill_score_meta(
+                m, topk_idx[slot], topk_scores[slot]))
+        return m
+
+    def _fill_score_meta(self, m: AllocMetric, rows, scores) -> None:
         c = self.cluster
-        for row, score in zip(topk_idx[slot], topk_scores[slot]):
+        for row, score in zip(rows.tolist(), scores.tolist()):
             if score <= NEG_INF / 2:
                 continue
             if row < c.n_real:
                 m.score_meta.append(
                     (c.node_ids[row], {"normalized-score": score}, score)
                 )
-        return m
 
 
 def _tg_comparable_ask(tg) -> "ComparableResources":

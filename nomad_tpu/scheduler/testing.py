@@ -30,6 +30,7 @@ class Harness:
     # -- Planner interface (testing.go:90 SubmitPlan) --------------------
 
     def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
+        plan.run_deferred()
         with self._lock:
             self.plans.append(plan)
             if self.reject_plan:

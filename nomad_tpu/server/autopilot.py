@@ -47,13 +47,16 @@ class Autopilot:
             self._unhealthy_since.clear()
 
     def _run(self, gen: int) -> None:
+        from nomad_tpu.telemetry.trace import tracer
+
         while True:
             time.sleep(self.interval)
             with self._lock:
                 if not self._enabled or self._gen != gen:
                     return
             try:
-                self.evaluate_once()
+                with tracer.span("bg.autopilot"):
+                    self.evaluate_once()
             except Exception as e:              # noqa: BLE001
                 LOG.warning("autopilot: %s", e)
 

@@ -89,14 +89,17 @@ class DeploymentsWatcher:
             )
             if not self._enabled:
                 return
-            try:
-                self._tick_all()
-            except Exception as e:              # noqa: BLE001
-                LOG.warning("deployments tick: %s", e)
-            try:
-                self._scan_multiregion()
-            except Exception as e:              # noqa: BLE001
-                LOG.warning("multiregion scan: %s", e)
+            from nomad_tpu.telemetry.trace import tracer
+
+            with tracer.span("bg.deployments"):
+                try:
+                    self._tick_all()
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("deployments tick: %s", e)
+                try:
+                    self._scan_multiregion()
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("multiregion scan: %s", e)
 
     def _tick_all(self) -> None:
         active = self.server.state.active_deployments()

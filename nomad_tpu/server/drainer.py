@@ -36,6 +36,9 @@ class NodeDrainer:
         self._enabled = False
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        #: nodes-table index at which "no node is draining" was last
+        #: proven; -1 = unproven (see _tick)
+        self._no_drain_idx = -1
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -47,18 +50,34 @@ class NodeDrainer:
             self._thread.start()
 
     def _run(self) -> None:
+        from nomad_tpu.telemetry.trace import tracer
+
         index = 0
         while self._enabled:
             index = self.server.state.block_until(
                 ["nodes", "allocs"], index, timeout=self.poll_interval
             )
             try:
-                self._tick()
+                with tracer.span("bg.drainer"):
+                    self._tick()
             except Exception as e:              # noqa: BLE001
                 LOG.warning("drainer: %s", e)
 
     def _tick(self) -> None:
-        snap = self.server.state.snapshot()
+        # every plan commit wakes this loop (the allocs watch drives
+        # migrating-alloc progress); with no node draining, building a
+        # snapshot per commit is pure overhead. The no-drain proof is
+        # cached against the nodes table index: alloc commits then
+        # return here without scanning, and only a node write re-checks.
+        state = self.server.state
+        nodes_idx = state.table_index(["nodes"])
+        if nodes_idx == self._no_drain_idx:
+            return
+        if not state.has_draining_nodes():
+            self._no_drain_idx = nodes_idx
+            return
+        self._no_drain_idx = -1
+        snap = state.snapshot()
         for node in snap.nodes():
             if not node.drain:
                 continue

@@ -61,7 +61,13 @@ class _UnackedEval:
     def __init__(self, ev: Evaluation, token: str) -> None:
         self.eval = ev
         self.token = token
-        self.nack_timer: Optional[threading.Timer] = None
+        # wall-clock deadline for the auto-nack (0 = no timeout). One
+        # shared watcher thread enforces deadlines for ALL unacked
+        # evals; the per-dequeue ``threading.Timer`` this replaces
+        # spawned a whole OS thread per handed-out eval — at batch-32
+        # dequeues that was 32 thread spawns per wave on the dequeue
+        # hot path (ROADMAP lever #5).
+        self.nack_deadline: float = 0.0
 
 
 class EvalBroker:
@@ -100,6 +106,12 @@ class EvalBroker:
         self._delayed = DelayHeap()
         self._delay_thread: Optional[threading.Thread] = None
         self._delay_wake = threading.Event()
+        # auto-nack deadlines: (deadline, eval_id, token) entries for
+        # the shared watcher; stale entries (acked, or reset to a later
+        # deadline) are skipped against _unack at fire time
+        self._nack_heap: List[Tuple[float, str, str]] = []
+        self._nack_thread: Optional[threading.Thread] = None
+        self._nack_wake = threading.Event()
         self.stats_lock = threading.Lock()
 
     # --- lifecycle (eval_broker.go SetEnabled/Flush) --------------------
@@ -119,12 +131,15 @@ class EvalBroker:
                 target=self._run_delayed, daemon=True, name="broker-delayed"
             )
             self._delay_thread.start()
+            self._nack_wake.clear()
+            self._nack_thread = threading.Thread(
+                target=self._run_nack_watch, daemon=True,
+                name="broker-nack",
+            )
+            self._nack_thread.start()
 
     def flush(self) -> None:
         with self._lock:
-            for un in self._unack.values():
-                if un.nack_timer is not None:
-                    un.nack_timer.cancel()
             self._ready.clear()
             self._unack.clear()
             self._job_evals.clear()
@@ -132,8 +147,10 @@ class EvalBroker:
             self._delivery.clear()
             self._requeue_on_ack.clear()
             self._delayed = DelayHeap()
+            self._nack_heap.clear()
             self._cond.notify_all()
         self._delay_wake.set()
+        self._nack_wake.set()
 
     # --- enqueue (eval_broker.go:182 Enqueue, :214 processEnqueue) ------
 
@@ -186,56 +203,69 @@ class EvalBroker:
 
     # --- dequeue (eval_broker.go:335 Dequeue) ---------------------------
 
+    def _track_unacked_locked(self, ev: Evaluation) -> str:
+        """Register a handed-out eval: token + auto-nack deadline (one
+        heap push; the shared watcher enforces it)."""
+        token = generate_uuid()
+        un = _UnackedEval(ev, token)
+        self._unack[ev.id] = un
+        if self.nack_timeout > 0:
+            un.nack_deadline = time.time() + self.nack_timeout
+            heapq.heappush(self._nack_heap,
+                           (un.nack_deadline, ev.id, token))
+        return token
+
     def dequeue(
         self, schedulers: List[str], timeout: Optional[float] = None
     ) -> Tuple[Optional[Evaluation], str]:
-        deadline = None if timeout is None else time.time() + timeout
-        with self._lock:
-            while True:
-                ev = self._dequeue_locked(schedulers)
-                if ev is not None:
-                    token = generate_uuid()
-                    un = _UnackedEval(ev, token)
-                    self._unack[ev.id] = un
-                    if self.nack_timeout > 0:
-                        un.nack_timer = threading.Timer(
-                            self.nack_timeout, self.nack, args=(ev.id, token)
-                        )
-                        un.nack_timer.daemon = True
-                        un.nack_timer.start()
-                    return ev, token
-                if not self._enabled:
-                    return None, ""
-                wait = None if deadline is None else deadline - time.time()
-                if wait is not None and wait <= 0:
-                    return None, ""
-                self._cond.wait(wait)
+        batch = self.dequeue_batch(schedulers, 1, timeout)
+        if not batch:
+            return None, ""
+        return batch[0]
 
     def dequeue_batch(
         self, schedulers: List[str], batch: int, timeout: Optional[float] = None
     ) -> List[Tuple[Evaluation, str]]:
-        """Dequeue up to ``batch`` evals: one blocking dequeue then a
-        non-blocking drain. Batched-kernel feed path."""
+        """Dequeue up to ``batch`` evals in ONE lock acquisition: a
+        blocking wait for the first, then a drain of whatever else is
+        ready. Batched-kernel feed path — the per-eval re-lock /
+        re-wakeup of the old loop cost a lock round-trip and a
+        condition touch per member per wave."""
+        deadline = None if timeout is None else time.time() + timeout
         t0 = time.monotonic() if tracer.enabled else 0.0
-        first, token = self.dequeue(schedulers, timeout)
-        if first is None:
-            return []
-        t1 = time.monotonic() if t0 else 0.0
-        out = [(first, token)]
-        while len(out) < batch:
-            ev, tok = self.dequeue(schedulers, timeout=0)
-            if ev is None:
-                break
-            out.append((ev, tok))
-        if t0:
+        t1 = 0.0
+        out: List[Tuple[Evaluation, str]] = []
+        notify_nack = False
+        with self._lock:
+            while True:
+                ev = self._dequeue_locked(schedulers)
+                if ev is not None:
+                    if t0 and not out:
+                        t1 = time.monotonic()
+                    out.append((ev, self._track_unacked_locked(ev)))
+                    if len(out) >= batch:
+                        break
+                    continue
+                if out or not self._enabled:
+                    break
+                wait = None if deadline is None else deadline - time.time()
+                if wait is not None and wait <= 0:
+                    break
+                self._cond.wait(wait)
+            notify_nack = bool(out) and self.nack_timeout > 0
+        if notify_nack:
+            # ONE watcher wakeup per handed-out batch (not per eval):
+            # the watcher re-reads the heap head and re-arms
+            self._nack_wake.set()
+        if t0 and out:
             # two spans, recorded only when work was handed out: the
             # blocking wait for the first eval (idle/backpressure —
             # overlaps producers, so the decomposition reports it
             # without attributing it) and the drain that actually
             # hands the batch out
-            tracer.record("broker.wait", t1 - t0, trace_id=first.id)
+            tracer.record("broker.wait", t1 - t0, trace_id=out[0][0].id)
             tracer.record("broker.dequeue", time.monotonic() - t1,
-                          trace_id=first.id)
+                          trace_id=out[0][0].id)
         return out
 
     def _dequeue_locked(self, schedulers: List[str]) -> Optional[Evaluation]:
@@ -262,19 +292,19 @@ class EvalBroker:
             return un.token if un is not None else None
 
     def outstanding_reset(self, eval_id: str, token: str) -> None:
-        """Reset the nack timer (worker heartbeat during long
-        scheduling; eval_broker.go OutstandingReset)."""
+        """Reset the nack deadline (worker heartbeat during long
+        scheduling; eval_broker.go OutstandingReset). The old heap
+        entry goes stale in place — the watcher re-checks the live
+        deadline before firing."""
+        if self.nack_timeout <= 0:
+            return
         with self._lock:
             un = self._unack.get(eval_id)
             if un is None or un.token != token:
                 return
-            if un.nack_timer is not None:
-                un.nack_timer.cancel()
-                un.nack_timer = threading.Timer(
-                    self.nack_timeout, self.nack, args=(eval_id, token)
-                )
-                un.nack_timer.daemon = True
-                un.nack_timer.start()
+            un.nack_deadline = time.time() + self.nack_timeout
+            heapq.heappush(self._nack_heap,
+                           (un.nack_deadline, eval_id, token))
 
     def ack(self, eval_id: str, token: str) -> None:
         with self._lock:
@@ -287,8 +317,6 @@ class EvalBroker:
 
     def _ack_locked(self, eval_id: str) -> None:
         un = self._unack.pop(eval_id)
-        if un.nack_timer is not None:
-            un.nack_timer.cancel()
         self._delivery.pop(eval_id, None)
         ns_job = (un.eval.namespace, un.eval.job_id)
         if self._job_evals.get(ns_job) == eval_id:
@@ -329,6 +357,32 @@ class EvalBroker:
                 self._delay_wake.set()
             else:
                 self._enqueue_locked(ev, ev.type)
+
+    # --- auto-nack watcher (replaces per-dequeue threading.Timer) -------
+
+    def _run_nack_watch(self) -> None:
+        while True:
+            due: List[Tuple[str, str]] = []
+            with self._lock:
+                if not self._enabled:
+                    return
+                now = time.time()
+                while self._nack_heap and self._nack_heap[0][0] <= now:
+                    _, eid, token = heapq.heappop(self._nack_heap)
+                    un = self._unack.get(eid)
+                    # stale entries: acked/re-delivered (token moved) or
+                    # heartbeat-reset to a later deadline
+                    if un is None or un.token != token:
+                        continue
+                    if un.nack_deadline > now:
+                        continue
+                    due.append((eid, token))
+                head = self._nack_heap[0][0] if self._nack_heap else None
+            for eid, token in due:
+                self.nack(eid, token)
+            wait = max(head - time.time(), 0.01) if head else 1.0
+            self._nack_wake.wait(wait)
+            self._nack_wake.clear()
 
     # --- delayed eval loop (eval_broker.go:758 runDelayedEvalsWatcher) --
 

@@ -526,13 +526,17 @@ class Server:
             self._leader_threads.clear()
 
     def _leader_loop(self, fn, interval: float, gen: int) -> None:
+        from nomad_tpu.telemetry.trace import tracer
+
+        span_name = "bg." + fn.__name__
         while (
             self._leader
             and self._leader_gen == gen
             and not self._shutdown.is_set()
         ):
             try:
-                fn()
+                with tracer.span(span_name):
+                    fn()
             except Exception as e:              # noqa: BLE001
                 LOG.warning("leader loop %s: %s", fn.__name__, e)
             self._shutdown.wait(interval)
@@ -1115,6 +1119,9 @@ class Server:
 
         from nomad_tpu.telemetry.trace import tracer
 
+        # safety net for planners that didn't drain the deferred
+        # post-processing in their own (overlapped) window; idempotent
+        plan.run_deferred()
         t0 = _time.perf_counter()
         # plan.wait overlaps the applier's own evaluate/commit spans
         # (the worker blocks while the applier thread works); the trace

@@ -47,19 +47,27 @@ class VolumesWatcher:
             self._thread.start()
 
     def _run(self) -> None:
+        from nomad_tpu.telemetry.trace import tracer
+
         index = 0
         while self._enabled:
             index = self.server.state.block_until(
                 ["allocs", "csi_volumes"], index, timeout=self.poll_interval
             )
             try:
-                self.reap_once()
+                with tracer.span("bg.volumes"):
+                    self.reap_once()
             except Exception as e:              # noqa: BLE001
                 LOG.warning("volumewatcher: %s", e)
 
     def reap_once(self) -> int:
         """One pass over all volumes; returns number of claim
         transitions applied (volume_watcher.go volumeReapImpl)."""
+        # every alloc commit wakes this loop; with no CSI volumes
+        # registered a per-commit snapshot (usage-plane copy) is pure
+        # overhead
+        if self.server.state.csi_volume_count() == 0:
+            return 0
         snap = self.server.state.snapshot()
         transitions = 0
         for vol in snap.csi_volumes_iter():

@@ -72,8 +72,14 @@ class _EvalRun:
         plan.snapshot_index = self.snapshot.latest_index()
         if self.plan_window is not None:
             with self.plan_window:
+                # deferred host post-processing (AllocMetric top-k
+                # materialization) runs HERE: the wave-rendezvous slot
+                # is yielded, so this work overlaps the next wave's
+                # execute instead of the eval's own wave window
+                plan.run_deferred()
                 result = self.server.submit_plan(plan)
         else:
+            plan.run_deferred()
             result = self.server.submit_plan(plan)
         state = None
         if result is not None and result.refresh_index > 0:

@@ -275,6 +275,21 @@ class StateStore:
         self._index += 1
         return self._index
 
+    def has_draining_nodes(self) -> bool:
+        """Cheap pre-check for the drainer: whether ANY node is
+        draining, without constructing a snapshot (snapshot
+        construction copies the usage planes — too expensive to pay
+        on every alloc commit just to discover there is no drain)."""
+        with self._lock:
+            return any(getattr(n, "drain", False)
+                       for n in self._nodes.values())
+
+    def csi_volume_count(self) -> int:
+        """Cheap pre-check for the volume watcher (same rationale as
+        has_draining_nodes)."""
+        with self._lock:
+            return len(self._csi_volumes)
+
     def _own(self, *tables: str) -> None:
         """Copy-on-write: detach the named tables from any snapshots
         sharing them. Call under the lock BEFORE mutating a table."""

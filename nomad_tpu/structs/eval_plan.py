@@ -24,8 +24,25 @@ from nomad_tpu.structs.consts import (
 )
 
 
+import random as _random
+import threading as _threading
+
+#: process-local RNG seeded from real entropy ONCE. ``uuid.uuid4``
+#: reads os.urandom per call — an entropy syscall that costs ~0.5ms on
+#: common container kernels, and the scheduling hot path mints an id
+#: per allocation, per dequeue token, and per eval copy: at bench
+#: batch sizes that was several milliseconds of wall per evaluation
+#: spent in getrandom(2). These ids are resource NAMES — they need
+#: uniqueness, not unpredictability; a 128-bit Mersenne draw seeded
+#: from urandom keeps the collision odds identical in practice.
+_UUID_RNG = _random.Random(_uuid.uuid4().int)
+_UUID_LOCK = _threading.Lock()
+
+
 def generate_uuid() -> str:
-    return str(_uuid.uuid4())
+    with _UUID_LOCK:
+        bits = _UUID_RNG.getrandbits(128)
+    return str(_uuid.UUID(int=bits, version=4))
 
 
 @dataclass
@@ -84,7 +101,20 @@ class Evaluation:
         )
 
     def copy(self) -> "Evaluation":
-        return _copy.deepcopy(self)
+        # targeted copy instead of deepcopy: the worker copies every
+        # dequeued eval before mutating status (worker.py), so this
+        # runs once per eval on the hot path. Scalars ride a shallow
+        # copy; the four mutable containers are rebuilt; only
+        # failed_tg_allocs holds nested mutable state (AllocMetric)
+        # and is usually empty outside blocked evals.
+        new = _copy.copy(self)
+        new.related_evals = list(self.related_evals)
+        new.class_eligibility = dict(self.class_eligibility)
+        new.queued_allocations = dict(self.queued_allocations)
+        new.failed_tg_allocs = {
+            tg: _copy.deepcopy(m) for tg, m in self.failed_tg_allocs.items()
+        }
+        return new
 
     def create_blocked_eval(self, class_eligibility, escaped, quota_reached, failed_tg_allocs) -> "Evaluation":
         """structs.go Evaluation.CreateBlockedEval."""
@@ -145,6 +175,29 @@ class Plan:
     # deployment id -> status update
     deployment_updates: List[Dict] = field(default_factory=list)
     snapshot_index: int = 0
+    #: deferred host-side post-processing (AllocMetric top-k
+    #: materialization, scheduler/stack.py): thunks that must run
+    #: before the plan is applied but NOT on the wave-critical eval
+    #: path — the batching worker runs them inside its plan window,
+    #: overlapping the next wave's execute. Never serialized.
+    deferred_work: List = field(default_factory=list, repr=False,
+                                compare=False)
+
+    def run_deferred(self) -> None:
+        """Run + drain the deferred post-processing (idempotent; every
+        submit_plan entry point calls it, first caller does the
+        work). Own span: this CPU runs inside the batching worker's
+        plan window — overlapping the next wave's execute — so the
+        decomposition attributes it as plan post-processing, not
+        wave-critical scheduling."""
+        if not self.deferred_work:
+            return
+        from nomad_tpu.telemetry.trace import tracer
+
+        with tracer.span("plan.deferred"):
+            while self.deferred_work:
+                fn = self.deferred_work.pop()
+                fn()
 
     def append_stopped_alloc(self, alloc: Allocation, desired_desc: str, client_status: str = "", follow_up_eval_id: str = "") -> None:
         """structs.go Plan.AppendStoppedAlloc."""

@@ -66,6 +66,14 @@ def reset() -> None:
         default_device_state.reset_stats()
     except Exception:                           # noqa: BLE001
         pass
+    try:
+        # feasibility mask-cache counters follow the same window; the
+        # cached programs/masks themselves stay resident
+        from nomad_tpu.feasibility import default_mask_cache
+
+        default_mask_cache.reset_stats()
+    except Exception:                           # noqa: BLE001
+        pass
 
 
 if os.environ.get("NOMAD_TPU_TRACE", "") not in ("", "0"):

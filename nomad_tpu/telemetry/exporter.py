@@ -149,6 +149,40 @@ def prometheus_text(registry=None) -> str:
             f"{d['resident_generations']}")
     except Exception:                           # noqa: BLE001
         pass                # device state (jax) unavailable: skip
+    # feasibility compiler (nomad_tpu/feasibility/): mask-program cache
+    # effectiveness — a steady cluster should sit near hit_ratio 1.0,
+    # with misses only on node-structure forks and novel job specs
+    try:
+        from nomad_tpu.feasibility import default_mask_cache
+
+        f = default_mask_cache.snapshot()
+        lines.append(
+            "# TYPE nomad_tpu_feasibility_mask_lookups_total counter")
+        for kind, key in (("hit", "hits"), ("miss", "misses"),
+                          ("fallback", "fallbacks")):
+            lines.append(
+                f'nomad_tpu_feasibility_mask_lookups_total'
+                f'{{kind="{kind}"}} {f[key]}')
+        lines.append(
+            "# TYPE nomad_tpu_feasibility_program_compiles_total counter")
+        lines.append(
+            f"nomad_tpu_feasibility_program_compiles_total "
+            f"{f['program_compiles']}")
+        lines.append(
+            "# TYPE nomad_tpu_feasibility_dynamic_applies_total counter")
+        lines.append(
+            f"nomad_tpu_feasibility_dynamic_applies_total "
+            f"{f['dynamic_applies']}")
+        lines.append(
+            "# TYPE nomad_tpu_feasibility_mask_hit_ratio gauge")
+        lines.append(
+            f"nomad_tpu_feasibility_mask_hit_ratio {f['hit_ratio']}")
+        lines.append(
+            "# TYPE nomad_tpu_feasibility_cached_masks gauge")
+        lines.append(
+            f"nomad_tpu_feasibility_cached_masks {f['cached_masks']}")
+    except Exception:                           # noqa: BLE001
+        pass                # feasibility subsystem unavailable: skip
     lines.append(
         "# TYPE nomad_tpu_telemetry_enabled gauge")
     lines.append(

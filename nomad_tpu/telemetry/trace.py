@@ -118,10 +118,10 @@ class _LiveSpan:
     """An open span on one thread's stack."""
 
     __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
-                 "t0", "c0", "child_s", "child_cpu_s")
+                 "t0", "c0", "child_s", "child_cpu_s", "sampled")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
-                 parent_id: int) -> None:
+                 parent_id: int, sampled: bool) -> None:
         self.tracer = tracer
         self.name = name
         self.trace_id = trace_id
@@ -131,15 +131,26 @@ class _LiveSpan:
         self.child_cpu_s = 0.0
         self.t0 = 0.0
         self.c0 = 0.0
+        self.sampled = sampled
 
     def __enter__(self) -> "_LiveSpan":
         self.tracer._tls_stack().append(self)
         self.t0 = time.monotonic()
-        self.c0 = time.thread_time()
+        # the CPU clock is read AFTER the wall clock and only on
+        # sampled trees: on kernels where CLOCK_THREAD_CPUTIME_ID is a
+        # real syscall (no vDSO) each read costs tens of µs — see
+        # Tracer._calibrate
+        self.c0 = time.thread_time() if self.sampled else 0.0
         return self
 
     def __exit__(self, *exc) -> None:
-        cpu = time.thread_time() - self.c0
+        # clock geometry on a sampled span: t0 is captured BEFORE the
+        # enter CPU read and dur after the exit CPU read, so both
+        # expensive reads' WALL lands inside this span's own window —
+        # while their CPU is excluded from this span's cpu (c0 is
+        # captured at the END of the enter read, the exit value before
+        # its cost) and lands in the PARENT's CPU window instead
+        cpu = (time.thread_time() - self.c0) if self.sampled else 0.0
         dur = time.monotonic() - self.t0
         stack = self.tracer._tls_stack()
         # unwind to self: an exception may have skipped children's exits
@@ -147,9 +158,23 @@ class _LiveSpan:
             stack.pop()
         if stack:
             stack.pop()
+        comp = self.tracer._cpu_read_cost * 2.0 if self.sampled else 0.0
+        if comp:
+            # shed the two reads' wall from this span's own duration —
+            # the recorded span measures the system, not the tracer
+            dur = max(dur - comp, 0.0)
         if stack:
-            stack[-1].child_s += dur
-            stack[-1].child_cpu_s += cpu
+            parent = stack[-1]
+            if self.sampled:
+                # the parent still lost the FULL window (adjusted dur
+                # + the reads' wall) and the reads' syscall CPU; credit
+                # both to child time so the parent's EXCLUSIVE stage —
+                # the quantity the decomposition gates on — stays
+                # unbiased
+                parent.child_s += dur + comp
+                parent.child_cpu_s += cpu + comp
+            else:
+                parent.child_s += dur
         self.tracer._record(self, dur, cpu)
 
 
@@ -180,6 +205,14 @@ class Tracer:
         self._agg: Dict[str, List[float]] = {}
         self._tls = threading.local()
         self.enabled_at: Optional[float] = None
+        #: CPU-clock sampling: 1 = read thread_time on every span
+        #: (exact; the normal case). On kernels where the clock is an
+        #: un-vDSO'd syscall, whole span TREES are sampled 1-in-K and
+        #: their CPU contributions scaled by K — unbiased aggregates
+        #: at a bounded instrumentation cost (see _calibrate).
+        self.cpu_sample_every = 1
+        self._cpu_read_cost = 0.0
+        self._root_seq = itertools.count()
 
     # --- control --------------------------------------------------------
 
@@ -188,8 +221,34 @@ class Tracer:
         return self._enabled
 
     def enable(self) -> None:
+        self._calibrate()
         self.enabled_at = time.monotonic()
         self._enabled = True
+
+    def _calibrate(self) -> None:
+        """Measure the CPU clock's read cost and pick the tree-sampling
+        rate. ``time.thread_time`` is ~0.1µs through the vDSO on
+        production kernels (every span reads it: exact attribution),
+        but tens of µs as a real syscall under sandboxed/older kernels
+        — at 4 reads per span site an instrumented eval would owe more
+        CPU to the tracer than to scheduling, and the decomposition
+        would gate on the instrument instead of the system. Sampling
+        1-in-K span trees (scaled by K) keeps aggregates unbiased and
+        the overhead bounded; per-span compensation (_LiveSpan.__exit__)
+        removes the residual bias from the sampled trees themselves."""
+        reads = 64
+        t0 = time.perf_counter()
+        for _ in range(reads):
+            time.thread_time()
+        cost = (time.perf_counter() - t0) / reads
+        self._cpu_read_cost = cost
+        if cost < 2e-6:
+            self.cpu_sample_every = 1
+        else:
+            # cap at 4: the variance of the scaled estimate grows with
+            # K, and host stages gate CI — a 4x overhead cut already
+            # brings the syscall tax under the stage costs it measures
+            self.cpu_sample_every = min(4, max(2, int(cost / 5e-6)))
 
     def disable(self) -> None:
         self._enabled = False
@@ -217,13 +276,19 @@ class Tracer:
             return _NOOP
         stack = self._tls_stack()
         if stack:
+            # children inherit the root's CPU-sampling decision so the
+            # parent/child exclusive arithmetic stays consistent
+            # within one tree
             parent = stack[-1]
             return _LiveSpan(self, name, trace_id or parent.trace_id,
-                             parent.span_id)
+                             parent.span_id, parent.sampled)
+        sampled = self.cpu_sample_every == 1 or (
+            next(self._root_seq) % self.cpu_sample_every == 0)
         inherit = getattr(self._tls, "inherit", None)
         if inherit is not None:
-            return _LiveSpan(self, name, trace_id or inherit[0], inherit[1])
-        return _LiveSpan(self, name, trace_id, 0)
+            return _LiveSpan(self, name, trace_id or inherit[0],
+                             inherit[1], sampled)
+        return _LiveSpan(self, name, trace_id, 0, sampled)
 
     def record(self, name: str, dur_s: float, trace_id: str = "") -> None:
         """Record an already-measured interval as a leaf span (for
@@ -241,27 +306,31 @@ class Tracer:
         sp = Span(name, trace_id, next(_ids), parent_id,
                   time.monotonic() - dur_s, dur_s, 0.0, 0.0, 0.0,
                   threading.current_thread().name)
-        self._append(sp)
+        self._append(sp, 0)
 
     def _record(self, live: _LiveSpan, dur_s: float, cpu_s: float) -> None:
         sp = Span(live.name, live.trace_id, live.span_id, live.parent_id,
                   live.t0, dur_s, live.child_s, cpu_s, live.child_cpu_s,
                   threading.current_thread().name)
-        self._append(sp)
+        self._append(sp, self.cpu_sample_every if live.sampled else 0)
 
-    def _append(self, sp: Span) -> None:
+    def _append(self, sp: Span, cpu_scale: int = 1) -> None:
+        # ring entries keep the raw per-span reading (0 on unsampled
+        # trees); AGGREGATES scale sampled CPU by the sampling rate so
+        # stage_totals stays an unbiased estimate of work executed
         with self._lock:
             self._ring.append(sp)
             agg = self._agg.get(sp.name)
             if agg is None:
                 self._agg[sp.name] = [1, sp.dur_s, sp.exclusive_s,
-                                      sp.cpu_s, sp.exclusive_cpu_s]
+                                      sp.cpu_s * cpu_scale,
+                                      sp.exclusive_cpu_s * cpu_scale]
             else:
                 agg[0] += 1
                 agg[1] += sp.dur_s
                 agg[2] += sp.exclusive_s
-                agg[3] += sp.cpu_s
-                agg[4] += sp.exclusive_cpu_s
+                agg[3] += sp.cpu_s * cpu_scale
+                agg[4] += sp.exclusive_cpu_s * cpu_scale
 
     # --- propagation ----------------------------------------------------
 

@@ -248,6 +248,28 @@ class DeviceClusterState:
         self.rows_uploaded += int(len(rows)) * len(host_planes)
         return out
 
+    def warm_scatter(self, n_pad: int) -> int:
+        """AOT-compile the dirty-row scatter for every row bucket and
+        plane dtype of a node size (ops/warmup.py calls this with the
+        manifest's node shapes). The scatter is raw ``jax.jit`` — its
+        compiles never show in the profiler's miss accounting, but a
+        steady burst whose dirty-row count crosses into a fresh bucket
+        used to pay a cold compile INSIDE an eval's snapshot phase.
+        Returns the number of (bucket, dtype) programs touched."""
+        done = 0
+        b = _MIN_ROW_BUCKET
+        while b <= max(n_pad, _MIN_ROW_BUCKET):
+            rows = jax.device_put(np.full(b, n_pad, np.int32))
+            for dtype in (np.float32, np.int32):
+                plane = jax.device_put(np.zeros(n_pad, dtype))
+                vals = jax.device_put(np.zeros(b, dtype))
+                jax.block_until_ready(_scatter_rows(plane, rows, vals))
+                done += 1
+            if b >= n_pad:
+                break
+            b *= 2
+        return done
+
     # --- the ensure entry point ----------------------------------------
 
     def ensure(self, cluster: ClusterTensors, usage) -> Optional[_Generation]:

@@ -1,0 +1,434 @@
+"""Feasibility compiler (ISSUE 5): compiled mask programs must be
+bit-identical to the Python ``FeasibilityBuilder.base_mask`` — over
+randomized constraint trees (regex / version / semver / set_contains /
+is_set / distinct / DC globs / drivers / volumes), randomized node
+populations, node-structure forks, evicted cache generations, and the
+escaped-constraint fallback. Metrics tallies and class-eligibility
+memoization must replay identically too, because blocked evals and
+AllocMetric surface them to operators.
+"""
+
+import random
+import types
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.feasibility import (
+    apply_program,
+    compile_program,
+    default_attr_plane_cache,
+    default_mask_cache,
+)
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import FeasibilityBuilder
+from nomad_tpu import structs
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.node import HostVolumeConfig
+from nomad_tpu.structs.constraints import Constraint
+from nomad_tpu.structs.eval_plan import Plan
+from nomad_tpu.tensors.schema import ClusterTensors
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    """Each test starts from empty feasibility caches (they are
+    process-wide by design)."""
+    default_mask_cache._programs.clear()
+    default_mask_cache._masks.clear()
+    default_mask_cache._canonical.clear()
+    default_mask_cache.reset_stats()
+    default_attr_plane_cache._entries.clear()
+    default_attr_plane_cache._latest.clear()
+    yield
+
+
+class _Snap:
+    """Minimal scheduler snapshot: node lookups only."""
+
+    def __init__(self, nodes):
+        self._nodes = {n.id: n for n in nodes}
+        self.usage = None
+
+    def node_by_id(self, nid):
+        return self._nodes.get(nid)
+
+
+def _usage_stub(uid="u1", sv=1, node_events=()):
+    return types.SimpleNamespace(
+        uid=uid, structure_version=sv, version=sv,
+        node_events=tuple(node_events), row_events=(),
+        row_events_floor=0)
+
+
+_DCS = ["dc1", "dc2", "east-1", "east-2", "west-1"]
+_KERNELS = ["linux", "windows", "freebsd"]
+_VERSIONS = ["1.2.3", "1.10.0", "2.0.0-beta.1", "0.9", "3.4.5+build7",
+             "not-a-version"]
+_RACKS = ["r1", "r2", "r3", None]
+
+
+def _rand_node(rng):
+    n = mock.node()
+    n.datacenter = rng.choice(_DCS)
+    n.node_class = rng.choice(["", "c1", "c2"])
+    n.node_pool = rng.choice(["default", "gpu"])
+    n.attributes = dict(n.attributes)
+    n.attributes["kernel.name"] = rng.choice(_KERNELS)
+    n.attributes["nomad.version"] = rng.choice(_VERSIONS)
+    n.attributes["cpu.features"] = rng.choice(
+        ["sse4,avx", "sse4,avx,avx2", "sse4"])
+    rack = rng.choice(_RACKS)
+    n.meta = dict(n.meta or {})
+    if rack is not None:
+        n.meta["rack"] = rack
+    if rng.random() < 0.3:
+        n.attributes["unique.hostname"] = f"host-{rng.randrange(1000)}"
+    # driver health varies (part of the computed class hash)
+    if rng.random() < 0.2:
+        n.drivers = dict(n.drivers)
+        n.drivers["mock_driver"] = structs.DriverInfo(
+            detected=True, healthy=False)
+    if rng.random() < 0.3:
+        n.host_volumes = {
+            "fast-disk": HostVolumeConfig(
+                name="fast-disk", path="/mnt/fast",
+                read_only=rng.random() < 0.5),
+        }
+    if rng.random() < 0.2:
+        n.csi_node_plugins = {"ebs0": {"healthy": True}}
+    if rng.random() < 0.2:
+        n.status = consts.NODE_STATUS_DOWN
+    n.compute_class()
+    return n
+
+
+def _rand_constraints(rng, allow_escaped=True):
+    pool = [
+        Constraint("${attr.kernel.name}", rng.choice(_KERNELS), "="),
+        Constraint("${attr.kernel.name}", rng.choice(_KERNELS), "!="),
+        Constraint("${attr.nomad.version}", ">= 1.0, < 3.0",
+                   consts.CONSTRAINT_VERSION),
+        Constraint("${attr.nomad.version}", ">= 1.2.0",
+                   consts.CONSTRAINT_SEMVER),
+        Constraint("${attr.kernel.name}", "lin.*",
+                   consts.CONSTRAINT_REGEX),
+        Constraint("${attr.cpu.features}", "avx",
+                   consts.CONSTRAINT_SET_CONTAINS),
+        Constraint("${meta.rack}", "", consts.CONSTRAINT_ATTRIBUTE_IS_SET),
+        Constraint("${meta.rack}", "",
+                   consts.CONSTRAINT_ATTRIBUTE_IS_NOT_SET),
+        Constraint("${node.datacenter}", rng.choice(_DCS), "="),
+        Constraint("${node.class}", "c1", "!="),
+        Constraint("${meta.rack}", "r2", "<="),
+    ]
+    if allow_escaped:
+        pool.append(Constraint("${attr.unique.hostname}", "host-1", "!="))
+        pool.append(Constraint("${node.unique.name}", "foo.*",
+                               consts.CONSTRAINT_REGEX))
+    k = rng.randrange(0, 4)
+    return [rng.choice(pool).copy() for _ in range(k)]
+
+
+def _rand_job(rng, allow_escaped=True):
+    job = mock.job()
+    job.datacenters = rng.choice([
+        ["dc1"], ["dc1", "dc2"], ["east-*"], ["*"], _DCS,
+    ])
+    job.node_pool = rng.choice(["default", "all", "gpu"])
+    job.constraints = _rand_constraints(rng, allow_escaped)
+    tg = job.task_groups[0]
+    tg.constraints = _rand_constraints(rng, allow_escaped)
+    tg.tasks[0].constraints = _rand_constraints(rng, allow_escaped)
+    tg.tasks[0].driver = rng.choice(["exec", "mock_driver"])
+    if rng.random() < 0.3:
+        job.constraints.append(
+            Constraint("", "", consts.CONSTRAINT_DISTINCT_HOSTS))
+    if rng.random() < 0.3:
+        tg.constraints.append(
+            Constraint("${meta.rack}", rng.choice(["", "2"]),
+                       consts.CONSTRAINT_DISTINCT_PROPERTY))
+    if rng.random() < 0.3:
+        tg.volumes = {"v0": structs.VolumeRequest(
+            name="v0", type="host", source="fast-disk",
+            read_only=rng.random() < 0.5)}
+    elif rng.random() < 0.2:
+        tg.volumes = {"v0": structs.VolumeRequest(
+            name="v0", type="csi", source="ebs0", read_only=True)}
+    return job, tg
+
+
+def _rand_allocs_by_node(rng, job, tg, nodes):
+    out = {}
+    for n in nodes:
+        if rng.random() < 0.15:
+            a = mock.alloc(job=job, node=n) if hasattr(mock, "alloc") \
+                else None
+            if a is None:
+                a = structs_alloc(job, tg, n)
+            out.setdefault(n.id, []).append(a)
+    return out
+
+
+def structs_alloc(job, tg, node):
+    from nomad_tpu.structs.alloc import Allocation
+
+    return Allocation(
+        id=f"a-{node.id[:8]}-{random.randrange(1 << 30)}",
+        namespace=job.namespace, job_id=job.id, job=job,
+        task_group=tg.name, node_id=node.id,
+        desired_status=consts.ALLOC_DESIRED_RUN,
+        client_status=consts.ALLOC_CLIENT_RUNNING,
+    )
+
+
+def _python_mask(cluster, snap, job, tg, allocs_by_node):
+    ctx = EvalContext(snap, Plan(job=job))
+    ctx.eligibility.set_job(job)
+    feas = FeasibilityBuilder(cluster, snap, ctx)
+    mask = feas.base_mask(job, tg, allocs_by_node)
+    return mask, ctx
+
+
+def _compiled_mask(cluster, snap, job, tg, allocs_by_node,
+                   exclude=None):
+    ctx = EvalContext(snap, Plan(job=job))
+    ctx.eligibility.set_job(job)
+    feas = FeasibilityBuilder(cluster, snap, ctx)
+    program = compile_program(job, tg)
+    if program is None:
+        return None, ctx
+    if exclude is None:
+        exclude = np.zeros(cluster.n_pad, bool)
+    mask = apply_program(program, cluster, snap, ctx, job, tg,
+                         allocs_by_node, exclude, feas)
+    return mask, ctx
+
+
+def _assert_identical(cluster, snap, job, tg, allocs_by_node, seed):
+    py_mask, py_ctx = _python_mask(cluster, snap, job, tg,
+                                   allocs_by_node)
+    cp_mask, cp_ctx = _compiled_mask(cluster, snap, job, tg,
+                                     allocs_by_node)
+    if cp_mask is None:
+        # uncompilable tree: the live path falls back to the builder —
+        # nothing to compare, but the fallback must be well-formed
+        assert compile_program(job, tg) is None
+        return False
+    assert np.array_equal(py_mask, cp_mask), (
+        f"seed={seed}: mask mismatch at rows "
+        f"{np.nonzero(py_mask != cp_mask)[0][:8]}")
+    pm, cm = py_ctx.metrics_obj, cp_ctx.metrics_obj
+    assert pm.nodes_filtered == cm.nodes_filtered, seed
+    assert pm.class_filtered == cm.class_filtered, seed
+    assert pm.constraint_filtered == cm.constraint_filtered, seed
+    assert py_ctx.eligibility.job == cp_ctx.eligibility.job, seed
+    assert py_ctx.eligibility.tgs == cp_ctx.eligibility.tgs, seed
+    return True
+
+
+class TestBitIdentity:
+    def test_randomized_trees(self):
+        compared = 0
+        for seed in range(40):
+            rng = random.Random(seed)
+            nodes = [_rand_node(rng) for _ in range(rng.randrange(5, 40))]
+            cluster = ClusterTensors.build(nodes)
+            snap = _Snap(nodes)
+            job, tg = _rand_job(rng)
+            allocs = _rand_allocs_by_node(rng, job, tg, nodes)
+            if _assert_identical(cluster, snap, job, tg, allocs, seed):
+                compared += 1
+        # the sweep must actually exercise the compiled path
+        assert compared >= 25
+
+    def test_escaped_trees_stay_identical(self):
+        """Unique-property constraints escape the class cache; the
+        compiled escaped path (vocabulary LUT per node) must match the
+        per-node Python walk."""
+        for seed in range(20):
+            rng = random.Random(1000 + seed)
+            nodes = [_rand_node(rng) for _ in range(20)]
+            cluster = ClusterTensors.build(nodes)
+            snap = _Snap(nodes)
+            job, tg = _rand_job(rng)
+            job.constraints.append(
+                Constraint("${attr.unique.hostname}", "host-.*",
+                           consts.CONSTRAINT_REGEX))
+            program = compile_program(job, tg)
+            assert program is not None and program.escaped
+            _assert_identical(cluster, snap, job, tg, {}, seed)
+
+    def test_pair_rtarget_escape_falls_back(self):
+        """An escaped tree whose RIGHT target is a node interpolation
+        is the declared fallback case: compile refuses, the live path
+        keeps the Python builder."""
+        rng = random.Random(7)
+        job, tg = _rand_job(rng, allow_escaped=False)
+        job.constraints = [
+            Constraint("${attr.unique.hostname}", "${node.datacenter}",
+                       "!=")]
+        assert compile_program(job, tg) is None
+
+    def test_exclude_and_distinct_dynamic_path(self):
+        """exclude rows + distinct_hosts force the dynamic epilogue
+        (a copy, never the frozen cached array) and stay identical to
+        builder + manual exclude."""
+        rng = random.Random(11)
+        nodes = [_rand_node(rng) for _ in range(24)]
+        cluster = ClusterTensors.build(nodes)
+        snap = _Snap(nodes)
+        job, tg = _rand_job(rng, allow_escaped=False)
+        job.constraints = [
+            Constraint("", "", consts.CONSTRAINT_DISTINCT_HOSTS)]
+        allocs = {nodes[0].id: [structs_alloc(job, tg, nodes[0])]}
+        exclude = np.zeros(cluster.n_pad, bool)
+        exclude[1] = True
+        py_mask, _ = _python_mask(cluster, snap, job, tg, allocs)
+        py_mask &= ~exclude
+        cp_mask, _ = _compiled_mask(cluster, snap, job, tg, allocs,
+                                    exclude=exclude)
+        assert cp_mask is not None
+        assert cp_mask.flags.writeable       # dynamic path copies
+        assert np.array_equal(py_mask, cp_mask)
+
+    def test_static_path_returns_frozen_shared_identity(self):
+        """No dynamic state: repeated evals get the SAME frozen array
+        (the wave-sharing and device-residency contract)."""
+        rng = random.Random(13)
+        nodes = [_rand_node(rng) for _ in range(16)]
+        cluster = ClusterTensors.build(nodes)
+        snap = _Snap(nodes)
+        job, tg = _rand_job(rng, allow_escaped=False)
+        job.constraints = [Constraint("${attr.kernel.name}", "linux", "=")]
+        tg.constraints = []
+        tg.tasks[0].constraints = []
+        tg.volumes = {}
+        m1, _ = _compiled_mask(cluster, snap, job, tg, {})
+        m2, _ = _compiled_mask(cluster, snap, job, tg, {})
+        assert m1 is not None
+        assert m1 is m2
+        assert not m1.flags.writeable
+        stats = default_mask_cache.snapshot()
+        assert stats["hits"] >= 1 and stats["misses"] == 1
+
+    def test_content_dedup_across_equal_specs(self):
+        """Two different jobs with equal constraint trees share one
+        canonical mask by identity."""
+        rng = random.Random(17)
+        nodes = [_rand_node(rng) for _ in range(16)]
+        cluster = ClusterTensors.build(nodes)
+        snap = _Snap(nodes)
+        job_a, tg_a = _rand_job(rng, allow_escaped=False)
+        job_a.constraints = [Constraint("${attr.kernel.name}", "linux", "=")]
+        tg_a.constraints = []
+        tg_a.tasks[0].constraints = []
+        tg_a.volumes = {}
+        job_b = mock.job()
+        job_b.datacenters = list(job_a.datacenters)
+        job_b.node_pool = job_a.node_pool
+        job_b.constraints = [c.copy() for c in job_a.constraints]
+        tg_b = job_b.task_groups[0]
+        tg_b.constraints = []
+        tg_b.tasks[0].constraints = []
+        tg_b.tasks[0].driver = tg_a.tasks[0].driver
+        tg_b.volumes = {}
+        m_a, _ = _compiled_mask(cluster, snap, job_a, tg_a, {})
+        m_b, _ = _compiled_mask(cluster, snap, job_b, tg_b, {})
+        assert m_a is not None and m_a is m_b
+
+
+class TestStructureForks:
+    def test_fork_reevaluates_and_attr_planes_advance(self):
+        """A structure_version bump with a node-change log: masks
+        re-evaluate against the new rows; the attr-plane cache
+        advances by fork instead of a full rebuild."""
+        rng = random.Random(23)
+        nodes = [_rand_node(rng) for _ in range(20)]
+        cluster = ClusterTensors.build(nodes)
+        snap = _Snap(nodes)
+        usage = _usage_stub(sv=1)
+        snap.usage = usage
+        job, tg = _rand_job(rng)
+        job.constraints.append(
+            Constraint("${attr.unique.hostname}", "host-.*",
+                       consts.CONSTRAINT_REGEX))   # force escaped/vocab
+        program = compile_program(job, tg)
+        if program is None:
+            pytest.skip("rolled an uncompilable tree")
+        _assert_identical(cluster, snap, job, tg, {}, 23)
+
+        # fork: flip one node's attribute, log it, bump the version
+        changed = nodes[3]
+        changed.attributes = dict(changed.attributes)
+        changed.attributes["kernel.name"] = "windows"
+        changed.attributes["unique.hostname"] = "host-777"
+        changed.compute_class()
+        cluster2 = ClusterTensors.build(nodes)
+        snap2 = _Snap(nodes)
+        snap2.usage = _usage_stub(sv=2, node_events=((2, changed.id),))
+        forks0 = default_attr_plane_cache.forks
+        _assert_identical(cluster2, snap2, job, tg, {}, 232)
+        assert default_attr_plane_cache.forks == forks0 + 1
+        # forked column reflects the new value
+        planes = default_attr_plane_cache.get(cluster2, snap2.usage)
+        col = planes.column("${attr.kernel.name}")
+        row = cluster2.index[changed.id]
+        assert col.values[col.codes[row]] == "windows"
+
+    def test_poisoned_log_full_rebuild_still_identical(self):
+        rng = random.Random(29)
+        nodes = [_rand_node(rng) for _ in range(12)]
+        snap = _Snap(nodes)
+        snap.usage = _usage_stub(sv=5, node_events=((5, None),))
+        cluster = ClusterTensors.build(nodes)
+        job, tg = _rand_job(rng)
+        _assert_identical(cluster, snap, job, tg, {}, 29)
+
+
+class TestEviction:
+    def test_evicted_mask_generations_reevaluate_identically(self):
+        """An LRU-evicted mask entry must re-evaluate bit-identically
+        (the 'evicted attr-plane generations' acceptance case)."""
+        old_max = default_mask_cache.max_masks
+        default_mask_cache.max_masks = 2
+        try:
+            rng = random.Random(31)
+            nodes = [_rand_node(rng) for _ in range(16)]
+            cluster = ClusterTensors.build(nodes)
+            snap = _Snap(nodes)
+            jobs = []
+            for i in range(4):
+                job, tg = _rand_job(rng, allow_escaped=False)
+                job.constraints = [Constraint(
+                    "${attr.kernel.name}", _KERNELS[i % 3], "=")]
+                tg.constraints = []
+                tg.tasks[0].constraints = []
+                tg.volumes = {}
+                jobs.append((job, tg))
+            for job, tg in jobs:
+                _compiled_mask(cluster, snap, job, tg, {})
+            assert len(default_mask_cache._masks) <= 2
+            # the first spec was evicted: a fresh evaluation must match
+            # the Python builder exactly
+            job, tg = jobs[0]
+            _assert_identical(cluster, snap, job, tg, {}, 31)
+        finally:
+            default_mask_cache.max_masks = old_max
+
+
+class TestHitRatioAccounting:
+    def test_steady_repeat_hits(self):
+        rng = random.Random(37)
+        nodes = [_rand_node(rng) for _ in range(16)]
+        cluster = ClusterTensors.build(nodes)
+        snap = _Snap(nodes)
+        job, tg = _rand_job(rng, allow_escaped=False)
+        job.constraints = [Constraint("${attr.kernel.name}", "linux", "=")]
+        tg.constraints = []
+        tg.tasks[0].constraints = []
+        tg.volumes = {}
+        for _ in range(30):
+            _compiled_mask(cluster, snap, job, tg, {})
+        assert default_mask_cache.hit_ratio() >= 0.95

@@ -389,9 +389,14 @@ class TestTraceDecomposition:
             assert proc.returncode == 0, proc.stderr.decode()[-2000:]
             decomp = json.loads(out.read_text())
             ss = decomp["steady_state"]
+            sched_ok = (ss["sched_host_share"] <= 0.45 or sum(
+                decomp["stages"].get(s, {}).get("per_eval_ms", 0.0)
+                for s in ("sched-host", "sched-feasibility",
+                          "sched-assembly", "sched-planbuild")) <= 3.0)
             if raw_share(decomp) >= 0.9 \
                     and ss["jit_cache_misses"] == 0 \
                     and decomp["allocs_placed"] == decomp["allocs_wanted"] \
+                    and sched_ok \
                     and (ss["h2d_share"] <= 0.10 or ss["h2d_bytes"]
                          <= 50_000 * decomp["n_evals"]):
                 break
@@ -441,6 +446,30 @@ class TestTraceDecomposition:
         # must be advancing by dirty-row scatter, not full re-uploads
         assert decomp["device_state"]["delta_advances"] >= 1, \
             decomp["device_state"]
+        # ISSUE 5 steady gates. sched_host_share sums the
+        # eval.schedule residue + the feasibility/assembly/plan-build
+        # sub-slices. Post-compiler, the feasibility slice itself is
+        # a cache lookup (hit ratio gated below); what remains is the
+        # GIL-bound floor of the Go-parity scheduler Python (~2.4
+        # ms/eval: reconcile, option/assign, plan build) — on the CPU
+        # backend, where wall per eval IS that Python, the share
+        # bottoms out near 0.30 at 150+ evals/s (it was 0.52 before
+        # the compiler + the tracer's clock-syscall bias fix; docs/
+        # PERF.md "The feasibility compiler"). The share's numerator
+        # is thread CPU, so host contention stretches the wall
+        # denominator and can only shrink it — the steal-invariant
+        # fallback bound is the per-eval CPU milliseconds of the same
+        # four slices.
+        sched_ms = sum(
+            decomp["stages"].get(s, {}).get("per_eval_ms", 0.0)
+            for s in ("sched-host", "sched-feasibility",
+                      "sched-assembly", "sched-planbuild"))
+        assert ss["sched_host_share"] <= 0.45 or sched_ms <= 3.0, \
+            (ss["sched_host_share"], sched_ms)
+        # steady traffic re-uses compiled masks: misses only on node
+        # structure forks and novel job specs, never per eval
+        assert ss["feasibility_hit_ratio"] >= 0.95, \
+            decomp.get("feasibility")
 
     def test_disabled_tracing_leaves_no_spans(self):
         """The disabled live path must record nothing (the <5%
