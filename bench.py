@@ -1145,6 +1145,21 @@ def main() -> None:
                     "sched_host_share"),
                 trace_feasibility_hit_ratio=steady.get(
                     "feasibility_hit_ratio"),
+                # ISSUE 6 steady gates: plan-path share of steady wall
+                # (applier + deferred post + fsm), the average plans
+                # per batched raft entry, the group-commit fallback
+                # count (must be 0 on the lean burst), and the steady
+                # burst throughput vs the ISSUE 6 floor (>= 200
+                # evals/s on the CPU backend, ~1.5x the PR5 range) —
+                # the floor gates only where it is defined
+                trace_steady_plan_share=steady.get("plan_share"),
+                trace_plan_group_size=steady.get("plan_group_size"),
+                trace_plan_group_fallbacks=steady.get(
+                    "plan_group_fallbacks"),
+                trace_steady_evals_per_sec=decomp.get("evals_per_sec"),
+                trace_steady_floor_ok=(
+                    decomp.get("evals_per_sec", 0.0) >= 200.0
+                    if decomp.get("backend") == "cpu" else None),
             )
         except Exception as e:                   # noqa: BLE001
             import traceback

@@ -80,6 +80,10 @@ _ATTRIBUTED = {
     "kernel.d2h": ("d2h", "wall"),
     "plan.evaluate": ("plan-apply", "cpu"),
     "plan.commit": ("plan-apply", "cpu"),
+    # the group-commit pass (ISSUE 6): one planes snapshot + vectorized
+    # re-validation for a whole wave of plans; child of plan.evaluate,
+    # same stage — the split keeps the span visible on its own
+    "plan.group_commit": ("plan-apply", "cpu"),
     # deferred AllocMetric/top-k materialization: runs in the batching
     # worker's plan window (its rendezvous slot yielded), overlapping
     # the next wave's execute — a pipelined follow-up stage, not part
@@ -383,6 +387,7 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
             decomp["warmup"] = warmed
             from nomad_tpu.feasibility import default_mask_cache
             from nomad_tpu.parallel.coalesce import wave_stats
+            from nomad_tpu.server.plan_apply import plan_group_stats
             from nomad_tpu.tensors.device_state import (
                 default_device_state,
             )
@@ -390,6 +395,7 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
             decomp["wave"] = wave_stats.snapshot()
             decomp["device_state"] = default_device_state.snapshot()
             decomp["feasibility"] = default_mask_cache.snapshot()
+            decomp["plan_group"] = plan_group_stats.snapshot()
             history.append(decomp)
         decomp = history[-1]
         if len(history) > 1:
@@ -434,6 +440,19 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                           "sched-assembly", "sched-planbuild")), 4),
             "feasibility_hit_ratio": decomp.get(
                 "feasibility", {}).get("hit_ratio", 0.0),
+            # ISSUE 6 steady gates: total plan-path share (applier
+            # re-validation + deferred post-processing + FSM apply) and
+            # the group-commit health — fallbacks must be ZERO on the
+            # lean steady burst (every plan provable by the vectorized
+            # check) and the batched raft entries should carry more
+            # than one plan each
+            "plan_share": round(sum(
+                decomp["stages"].get(s, {}).get("share_of_wall", 0.0)
+                for s in ("plan-apply", "plan-post", "fsm")), 4),
+            "plan_group_fallbacks": decomp.get(
+                "plan_group", {}).get("fallback_plans", 0),
+            "plan_group_size": round(decomp.get(
+                "plan_group", {}).get("group_size_avg", 0.0), 4),
         }
         return decomp
     finally:

@@ -66,14 +66,81 @@ _USE_GLOBAL = object()
 #: waves dispatched through the sharded path (asserted by tests)
 sharded_wave_launches = 0
 
-#: JointOut fields the launcher actually fetches to host per wave (the
-#: d2h payload); everything else stays device-side
+#: JointOut fields the launcher fetches to host EAGERLY per wave (the
+#: wave-critical d2h payload): the per-step placements the scheduler
+#: walks immediately plus the per-member metric scalars. The top-k
+#: score planes — the bulk of the old payload, [T, TOPK] x 2 — stay ON
+#: DEVICE as lazy slices (``_WaveTopK``): they feed only AllocMetric
+#: score_meta, whose materialization is deferred onto the plan window
+#: (scheduler/stack.py), so their d2h overlaps the next wave's execute
+#: instead of riding the wave-critical path.
 _JOINT_FETCH_FIELDS = (
-    "chosen", "scores", "found", "topk_idx", "topk_scores",
+    "chosen", "scores", "found",
     "nodes_evaluated", "nodes_feasible",
     "exhausted_cpu", "exhausted_mem", "exhausted_disk",
     "exhausted_ports", "exhausted_devices", "exhausted_cores",
 )
+
+
+class _WaveTopK:
+    """One wave's top-k planes, resident on device until first use.
+
+    All members share the holder; the first score_meta materialization
+    (inside the batching worker's plan window) fetches BOTH planes with
+    one transfer each and caches the host copy for every other member.
+    Bytes are metered at fetch time like any other d2h.
+    """
+
+    __slots__ = ("_idx", "_scores", "_host", "_lock")
+
+    def __init__(self, idx_dev, scores_dev) -> None:
+        self._idx = idx_dev
+        self._scores = scores_dev
+        self._host = None
+        self._lock = threading.Lock()
+
+    def host(self):
+        if self._host is None:
+            with self._lock:
+                if self._host is None:
+                    idx = np.asarray(self._idx)
+                    scores = np.asarray(self._scores)
+                    profiler.add_bytes("d2h", idx.nbytes + scores.nbytes)
+                    self._host = (idx, scores)
+                    # release the device buffers
+                    self._idx = self._scores = None
+        return self._host
+
+
+class _TopKSlice:
+    """A member's lazy [k, TOPK] view of the wave's top-k plane.
+
+    Quacks enough like an array for the scheduler's deferred
+    score_meta fill: ``np.asarray`` (via ``__array__``) and row
+    indexing both resolve through the shared wave fetch.
+    """
+
+    __slots__ = ("_wave", "_field", "_start", "_stop")
+
+    def __init__(self, wave: _WaveTopK, field: int, start: int,
+                 stop: int) -> None:
+        self._wave = wave
+        self._field = field          # 0 = idx, 1 = scores
+        self._start = start
+        self._stop = stop
+
+    def _resolve(self):
+        return self._wave.host()[self._field][self._start:self._stop]
+
+    def __array__(self, dtype=None, copy=None):
+        a = self._resolve()
+        return a if dtype is None else a.astype(dtype)
+
+    def __getitem__(self, item):
+        return self._resolve()[item]
+
+    def __len__(self) -> int:
+        return self._stop - self._start
 
 #: node planes shipped once per wave (unbatched) when every member
 #: shares them by identity: the cluster-static planes plus the wave
@@ -446,17 +513,20 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
                 wave_key, jit_fn=place_taskgroups_joint_jit,
             )
         with tracer.span("kernel.d2h"):
-            # fetch ONLY the planes members consume: the per-step
-            # placements + top-k metadata and the per-member metric
-            # scalars. The joint kernel's final capacity carry
-            # (a_cpu/a_mem/a_disk — full node planes) stays on device;
-            # the live path commits through plans, never through it.
+            # fetch ONLY the planes members consume immediately: the
+            # per-step placements and the per-member metric scalars.
+            # The joint kernel's final capacity carry (a_cpu/a_mem/
+            # a_disk — full node planes) stays on device (the live
+            # path commits through plans, never through it), and the
+            # top-k planes stay on device too — handed back as lazy
+            # slices whose one shared fetch runs in the plan window.
             host = {
                 f: np.asarray(getattr(out, f))
                 for f in _JOINT_FETCH_FIELDS
             }
         profiler.add_bytes(
             "d2h", sum(a.nbytes for a in host.values()))
+        wave_topk = _WaveTopK(out.topk_idx, out.topk_scores)
     finally:
         with _INFLIGHT_LOCK:
             _INFLIGHT_STARTS.pop(token, None)
@@ -468,8 +538,8 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
             chosen=host["chosen"][o:o + k],
             scores=host["scores"][o:o + k],
             found=host["found"][o:o + k],
-            topk_idx=host["topk_idx"][o:o + k],
-            topk_scores=host["topk_scores"][o:o + k],
+            topk_idx=_TopKSlice(wave_topk, 0, o, o + k),
+            topk_scores=_TopKSlice(wave_topk, 1, o, o + k),
             nodes_evaluated=host["nodes_evaluated"][i],
             nodes_feasible=host["nodes_feasible"][i],
             exhausted_cpu=host["exhausted_cpu"][i],

@@ -351,21 +351,27 @@ class GenericScheduler(Scheduler):
                             break
                 continue
 
-            from nomad_tpu.structs.resources import (
-                AllocatedResources,
-                AllocatedSharedResources,
-            )
+            if option.resources is not None:
+                # lean fast path: the (job, tg)-shared frozen skeleton
+                # (scheduler/scaffold.py) — no per-slot struct builds
+                resources = option.resources
+            else:
+                from nomad_tpu.structs.resources import (
+                    AllocatedResources,
+                    AllocatedSharedResources,
+                )
 
-            resources = AllocatedResources(
-                tasks=option.task_resources,
-                task_lifecycles=option.task_lifecycles,
-                shared=AllocatedSharedResources(
-                    disk_mb=tg.ephemeral_disk.size_mb
-                ),
-            )
-            if option.alloc_resources is not None:
-                resources.shared.networks = option.alloc_resources.networks
-                resources.shared.ports = option.alloc_resources.ports
+                resources = AllocatedResources(
+                    tasks=option.task_resources,
+                    task_lifecycles=option.task_lifecycles,
+                    shared=AllocatedSharedResources(
+                        disk_mb=tg.ephemeral_disk.size_mb
+                    ),
+                )
+                if option.alloc_resources is not None:
+                    resources.shared.networks = \
+                        option.alloc_resources.networks
+                    resources.shared.ports = option.alloc_resources.ports
 
             alloc = Allocation(
                 id=generate_uuid(),

@@ -24,11 +24,11 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.tensors.schema import AskTensor
 
-__all__ = ["TGScaffold", "scaffold_for"]
+__all__ = ["TGScaffold", "scaffold_for", "MetricsSkeleton"]
 
 _LOCK = threading.Lock()
 _CACHE: "OrderedDict[int, Tuple[object, TGScaffold]]" = OrderedDict()
@@ -40,7 +40,8 @@ class TGScaffold:
 
     __slots__ = ("ask", "affinities", "distinct_hosts_job",
                  "distinct_hosts_tg", "has_devices", "program",
-                 "program_compiled")
+                 "program_compiled", "lean_assign", "_tg", "_lean_res",
+                 "_lean_lock")
 
     def __init__(self, job, tg) -> None:
         from nomad_tpu.structs import consts
@@ -57,12 +58,129 @@ class TGScaffold:
             con.operand == consts.CONSTRAINT_DISTINCT_HOSTS
             for con in tg.constraints)
         self.has_devices = any(t.resources.devices for t in tg.tasks)
+        # lean assignment: no group/task networks, devices, or reserved
+        # cores anywhere in the group. For such asks the exact per-node
+        # assignment (_NodeAssigner.assign) is PURE struct building —
+        # it reads no node state and cannot fail — so placement
+        # materialization shares ONE frozen resources skeleton per
+        # (job, tg) instead of rebuilding the same structs per slot
+        # (the vectorized-assembly move, ISSUE 6).
+        self.lean_assign = (
+            not tg.networks
+            and not any(t.resources.networks for t in tg.tasks)
+            and not any(t.resources.devices for t in tg.tasks)
+            and not any(t.resources.cores > 0 for t in tg.tasks)
+        )
+        self._tg = tg
+        self._lean_res: Dict[bool, Tuple] = {}
+        self._lean_lock = threading.Lock()
         # compiled mask program (None = Python-builder fallback); the
         # program cache dedupes by signature across jobs
         from nomad_tpu.feasibility import default_mask_cache
 
         self.program = default_mask_cache.program_for(job, tg)
         self.program_compiled = self.program is not None
+
+    def lean_planes(self, oversub: bool) -> Tuple:
+        """(task_resources, task_lifecycles, AllocatedResources) for a
+        lean placement, built once per (job, tg, oversub) and shared BY
+        REFERENCE across every slot, wave member, and retry attempt.
+
+        Sound because allocated resources are replaced, never mutated
+        in place, repo-wide (the convention ``Allocation.fit_meta``'s
+        identity-keyed cache already relies on); the non-lean paths
+        (networks/devices/cores) keep building per-slot structs."""
+        ent = self._lean_res.get(bool(oversub))
+        if ent is not None:
+            return ent
+        from nomad_tpu.structs.resources import (
+            AllocatedCpuResources,
+            AllocatedMemoryResources,
+            AllocatedResources,
+            AllocatedSharedResources,
+            AllocatedTaskResources,
+        )
+
+        tg = self._tg
+        task_resources = {}
+        task_lifecycles = {}
+        for task in tg.tasks:
+            r = task.resources
+            task_resources[task.name] = AllocatedTaskResources(
+                cpu=AllocatedCpuResources(cpu_shares=int(r.cpu)),
+                memory=AllocatedMemoryResources(
+                    memory_mb=int(r.memory_mb),
+                    memory_max_mb=(int(r.memory_max_mb)
+                                   if oversub else 0),
+                ),
+            )
+            task_lifecycles[task.name] = task.lifecycle
+        resources = AllocatedResources(
+            tasks=task_resources,
+            task_lifecycles=task_lifecycles,
+            shared=AllocatedSharedResources(
+                disk_mb=tg.ephemeral_disk.size_mb),
+        )
+        with self._lean_lock:
+            return self._lean_res.setdefault(
+                bool(oversub),
+                (task_resources, task_lifecycles, resources))
+
+
+class MetricsSkeleton:
+    """One kernel launch's shared AllocMetric header + lazy top-k.
+
+    Every slot of a ``select_many`` call reports the same header counts
+    (nodes evaluated/filtered/exhausted — they come from one mask
+    reduction); only score_meta differs per slot. The skeleton holds
+    the header ONCE plus the launch's top-k planes (possibly still
+    device-resident — coalesce._TopKSlice), and materializes per-slot
+    ``AllocMetric``s cheaply: dicts are copied only when non-empty, and
+    the top-k -> score_meta fill is deferred onto the plan window
+    (Plan.deferred_work), where the first slot's access triggers the
+    wave's single shared d2h fetch.
+    """
+
+    __slots__ = ("nodes_evaluated", "nodes_filtered", "nodes_exhausted",
+                 "constraint_filtered", "dimension_exhausted",
+                 "topk_idx", "topk_scores", "_host")
+
+    def __init__(self, nodes_evaluated: int, nodes_filtered: int,
+                 nodes_exhausted: int, constraint_filtered: Dict,
+                 dimension_exhausted: Dict, topk_idx, topk_scores) -> None:
+        self.nodes_evaluated = nodes_evaluated
+        self.nodes_filtered = nodes_filtered
+        self.nodes_exhausted = nodes_exhausted
+        self.constraint_filtered = constraint_filtered
+        self.dimension_exhausted = dimension_exhausted
+        self.topk_idx = topk_idx
+        self.topk_scores = topk_scores
+        self._host = None
+
+    def materialize(self):
+        """A per-slot AllocMetric carrying the shared header."""
+        from nomad_tpu.structs.alloc import AllocMetric
+
+        m = AllocMetric()
+        m.nodes_evaluated = self.nodes_evaluated
+        m.nodes_filtered = self.nodes_filtered
+        m.nodes_exhausted = self.nodes_exhausted
+        if self.constraint_filtered:
+            m.constraint_filtered = dict(self.constraint_filtered)
+        if self.dimension_exhausted:
+            m.dimension_exhausted.update(self.dimension_exhausted)
+        return m
+
+    def slot_topk(self, slot: int):
+        """(rows, scores) numpy for one slot; resolves the launch's
+        top-k planes to host ONCE for all slots (runs inside the plan
+        window's deferred drain, off the wave-critical path)."""
+        if self._host is None:
+            import numpy as np
+
+            self._host = (np.asarray(self.topk_idx),
+                          np.asarray(self.topk_scores))
+        return self._host[0][slot], self._host[1][slot]
 
 
 def scaffold_for(job, tg) -> TGScaffold:

@@ -37,7 +37,7 @@ from nomad_tpu.ops.kernel import (
 from nomad_tpu.scheduler.context import EvalContext
 from nomad_tpu.scheduler.device import DeviceAllocator, device_planes_for_node
 from nomad_tpu.scheduler.feasible import FeasibilityBuilder
-from nomad_tpu.scheduler.scaffold import scaffold_for
+from nomad_tpu.scheduler.scaffold import MetricsSkeleton, scaffold_for
 from nomad_tpu.structs import consts
 from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.structs.alloc import AllocMetric
@@ -91,6 +91,12 @@ class SelectedOption:
     alloc_resources: Optional[AllocatedSharedResources]
     metrics: AllocMetric
     preempted_allocs: List = field(default_factory=list)
+    #: lean fast path: the (job, tg)-shared frozen AllocatedResources
+    #: skeleton (scheduler/scaffold.py). When set, the alloc builder
+    #: rides it BY REFERENCE instead of assembling per-slot structs;
+    #: None = the exact assigner built per-slot resources (networks/
+    #: devices/cores)
+    resources: Optional[object] = None
 
 
 class XLAGenericStack:
@@ -179,38 +185,81 @@ class XLAGenericStack:
                 with_shuffle=node_perm is not None,
             )
             out = self.ctx.kernel_launch(kin, k_pad, features)
-            out = KernelOut(*[np.asarray(x) for x in out])
+            # selective host fetch: the planes the walk reads NOW come
+            # to host (tiny [K] vectors — one transfer each); the
+            # top-k score planes stay as the launcher handed them
+            # (device arrays / lazy wave slices) until the plan
+            # window's deferred score_meta drain resolves them
+            out = KernelOut(*[
+                x if f in ("topk_idx", "topk_scores") else np.asarray(x)
+                for f, x in zip(KernelOut._fields, out)
+            ])
             self._merge_kernel_metrics(out)
             if _attempt > 0:
                 with _STATS_LOCK:
                     STATS["assign_retry_launches"] += 1
 
-            # exact host-side assignment per chosen node
+            # placement assembly: one shared metrics skeleton per
+            # launch; lean asks (no networks/devices/cores — the
+            # steady-traffic shape) take the vectorized path, sharing
+            # one frozen resources skeleton per (job, tg) and skipping
+            # the per-slot assigner entirely (it reads no node state
+            # and cannot fail for them). Exact assignment survives for
+            # every non-lean ask.
+            scaffold = scaffold_for(self.job, tg)
+            lean = scaffold.lean_assign
+            oversub = getattr(self.ctx.state.scheduler_config,
+                              "memory_oversubscription_enabled", False)
             proto = self._metrics_proto(out)
             found_l = out.found.tolist()
             chosen_l = out.chosen.tolist()
             scores_l = out.scores.tolist()
+            node_cache: Dict[int, object] = {}
+            dead_rows: set = set()
             retry: List[int] = []
             for slot, ri in enumerate(pending):
                 if not found_l[slot]:
                     results[ri] = None
                     continue
                 row = chosen_l[slot]
-                node = snapshot.node_by_id(c.node_ids[row])
+                if row in dead_rows:
+                    retry.append(ri)
+                    continue
+                node = node_cache.get(row)
                 if node is None:
-                    exclude[row] = True
-                    retry.append(ri)
-                    continue
-                asg = assigners.get(row)
-                if asg is None:
-                    asg = _NodeAssigner(node, self.ctx)
-                    assigners[row] = asg
-                option = asg.assign(tg, scores_l[slot])
-                if option is None:
-                    # exact assignment failed: mask node, re-run this slot
-                    exclude[row] = True
-                    retry.append(ri)
-                    continue
+                    node = snapshot.node_by_id(c.node_ids[row])
+                    if node is None:
+                        exclude[row] = True
+                        dead_rows.add(row)
+                        retry.append(ri)
+                        continue
+                    node_cache[row] = node
+                if lean:
+                    task_res, lifecycles, res = \
+                        scaffold.lean_planes(oversub)
+                    option = SelectedOption(
+                        node_id=node.id,
+                        node=node,
+                        final_score=scores_l[slot],
+                        task_resources=task_res,
+                        task_lifecycles=lifecycles,
+                        alloc_resources=None,
+                        metrics=None,
+                        resources=res,
+                    )
+                else:
+                    asg = assigners.get(row)
+                    if asg is None:
+                        asg = _NodeAssigner(node, self.ctx)
+                        assigners[row] = asg
+                    option = asg.assign(tg, scores_l[slot])
+                    if option is None:
+                        # exact assignment failed: mask node, re-run
+                        # this slot
+                        exclude[row] = True
+                        dead_rows.add(row)
+                        retry.append(ri)
+                        continue
                 option.metrics = self._metrics_for(proto, slot)
                 results[ri] = option
                 accepted_rows.append(row)
@@ -781,16 +830,15 @@ class XLAGenericStack:
             if int(cnt) > 0:
                 m.dimension_exhausted[dim] = int(cnt)
 
-    def _metrics_proto(self, out: KernelOut):
-        """Per-launch precomputation for ``_metrics_for``: the header
-        counts are identical for every slot. The top-k planes stay
-        numpy — their tolist + score_meta materialization is DEFERRED
-        onto the plan's post-processing queue (plan.deferred_work), so
-        it runs inside the batching worker's plan window — overlapping
-        the next wave's execute — instead of on the wave-critical eval
-        path."""
-        nodes_evaluated = int(out.nodes_evaluated)
-        nodes_exhausted = int(out.nodes_evaluated - out.nodes_feasible)
+    def _metrics_proto(self, out: KernelOut) -> MetricsSkeleton:
+        """Per-launch MetricsSkeleton (scheduler/scaffold.py): the
+        header counts are identical for every slot, captured once; the
+        top-k planes ride the skeleton UNRESOLVED (device arrays or
+        the coalescer's lazy wave slices) — their single d2h fetch and
+        the score_meta materialization are DEFERRED onto the plan's
+        post-processing queue (plan.deferred_work), so they run inside
+        the batching worker's plan window — overlapping the next
+        wave's execute — instead of on the wave-critical eval path."""
         dim_exhausted = {}
         for dim, cnt in (
             ("cpu", out.exhausted_cpu),
@@ -802,28 +850,30 @@ class XLAGenericStack:
         ):
             if int(cnt) > 0:
                 dim_exhausted[dim] = int(cnt)
-        return (nodes_evaluated, nodes_exhausted, dim_exhausted,
-                out.topk_idx, out.topk_scores)
+        m = self.ctx.metrics()
+        return MetricsSkeleton(
+            nodes_evaluated=int(out.nodes_evaluated),
+            nodes_filtered=m.nodes_filtered,
+            nodes_exhausted=int(out.nodes_evaluated - out.nodes_feasible),
+            constraint_filtered=dict(m.constraint_filtered),
+            dimension_exhausted=dim_exhausted,
+            topk_idx=out.topk_idx,
+            topk_scores=out.topk_scores,
+        )
 
-    def _metrics_for(self, proto, slot: int) -> AllocMetric:
-        nodes_evaluated, nodes_exhausted, dim_exhausted, \
-            topk_idx, topk_scores = proto
-        m = AllocMetric()
-        m.nodes_evaluated = nodes_evaluated
-        m.nodes_filtered = self.ctx.metrics().nodes_filtered
-        m.constraint_filtered = dict(self.ctx.metrics().constraint_filtered)
-        m.nodes_exhausted = nodes_exhausted
-        if dim_exhausted:
-            m.dimension_exhausted.update(dim_exhausted)
+    def _metrics_for(self, proto: MetricsSkeleton, slot: int) -> AllocMetric:
+        m = proto.materialize()
         # score_meta fills in place before the plan applies (the
         # Allocation holds this same AllocMetric object by reference)
         self.ctx.plan.deferred_work.append(
-            lambda m=m, slot=slot: self._fill_score_meta(
-                m, topk_idx[slot], topk_scores[slot]))
+            lambda m=m, proto=proto, slot=slot: self._fill_score_meta(
+                m, proto, slot))
         return m
 
-    def _fill_score_meta(self, m: AllocMetric, rows, scores) -> None:
+    def _fill_score_meta(self, m: AllocMetric, proto: MetricsSkeleton,
+                         slot: int) -> None:
         c = self.cluster
+        rows, scores = proto.slot_topk(slot)
         for row, score in zip(rows.tolist(), scores.tolist()):
             if score <= NEG_INF / 2:
                 continue

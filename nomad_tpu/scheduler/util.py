@@ -146,6 +146,7 @@ def generic_alloc_update_fn(ctx, stack, eval_id: str):
         # the alloc being updated -- networks/devices/ports carry over
         # unchanged (guarded by tasks_updated), so cpu/mem/disk/cores
         # arithmetic is the entire question.
+        from nomad_tpu.scheduler.scaffold import scaffold_for
         from nomad_tpu.structs.alloc import Allocation as _Alloc
         from nomad_tpu.structs.resources import (
             AllocatedCpuResources,
@@ -156,30 +157,45 @@ def generic_alloc_update_fn(ctx, stack, eval_id: str):
             allocs_fit,
         )
 
-        new_resources = AllocatedResources(
-            tasks={},
-            task_lifecycles={},
-            shared=AllocatedSharedResources(disk_mb=new_tg.ephemeral_disk.size_mb),
-        )
-        for task in new_tg.tasks:
-            r = task.resources
-            tr = AllocatedTaskResources(
-                cpu=AllocatedCpuResources(cpu_shares=int(r.cpu)),
-                memory=AllocatedMemoryResources(memory_mb=int(r.memory_mb)),
+        try:
+            scaffold = scaffold_for(new_job, new_tg)
+        except Exception:                       # noqa: BLE001
+            # ask-limit overruns surface on the placement path, not
+            # here — an in-place update stays possible without one
+            scaffold = None
+        ecr, euses_ports, euses_devices = existing.fit_meta()
+        if scaffold is not None and scaffold.lean_assign \
+                and not euses_ports \
+                and not euses_devices and not ecr.reserved_cores:
+            # lean in-place update (no networks/ports/devices/cores to
+            # carry over): ride the (job, tg)-shared frozen skeleton —
+            # this path runs once per updated alloc per eval
+            _, _, new_resources = scaffold.lean_planes(False)
+        else:
+            new_resources = AllocatedResources(
+                tasks={},
+                task_lifecycles={},
+                shared=AllocatedSharedResources(disk_mb=new_tg.ephemeral_disk.size_mb),
             )
-            new_resources.tasks[task.name] = tr
-            new_resources.task_lifecycles[task.name] = task.lifecycle
-        if existing.allocated_resources is not None:
-            for task_name, tr in new_resources.tasks.items():
-                old_tr = existing.allocated_resources.tasks.get(task_name)
-                if old_tr is not None:
-                    tr.networks = [n.copy() for n in old_tr.networks]
-                    tr.devices = list(old_tr.devices)
-                    tr.cpu.reserved_cores = list(old_tr.cpu.reserved_cores)
-            new_resources.shared.networks = list(
-                existing.allocated_resources.shared.networks
-            )
-            new_resources.shared.ports = list(existing.allocated_resources.shared.ports)
+            for task in new_tg.tasks:
+                r = task.resources
+                tr = AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(cpu_shares=int(r.cpu)),
+                    memory=AllocatedMemoryResources(memory_mb=int(r.memory_mb)),
+                )
+                new_resources.tasks[task.name] = tr
+                new_resources.task_lifecycles[task.name] = task.lifecycle
+            if existing.allocated_resources is not None:
+                for task_name, tr in new_resources.tasks.items():
+                    old_tr = existing.allocated_resources.tasks.get(task_name)
+                    if old_tr is not None:
+                        tr.networks = [n.copy() for n in old_tr.networks]
+                        tr.devices = list(old_tr.devices)
+                        tr.cpu.reserved_cores = list(old_tr.cpu.reserved_cores)
+                new_resources.shared.networks = list(
+                    existing.allocated_resources.shared.networks
+                )
+                new_resources.shared.ports = list(existing.allocated_resources.shared.ports)
 
         proposed = [
             a for a in ctx.proposed_allocs(existing.node_id) if a.id != existing.id

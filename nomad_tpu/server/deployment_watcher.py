@@ -56,6 +56,15 @@ class DeploymentsWatcher:
         self._health_seen: Dict[str, Dict[str, bool]] = {}
         self._enabled = False
         self._thread: Optional[threading.Thread] = None
+        # idle-tick gates, mirroring the drainer/volume-watcher fix:
+        # every alloc commit wakes the loop (the allocs watch drives
+        # health progress), but with nothing tracked and no active
+        # deployments the tick must not re-scan the deployments table.
+        # The no-work proof is cached against the deployment table
+        # index — alloc commits then return immediately, and only a
+        # deployment write re-checks. -1 = unproven.
+        self._idle_idx = -1
+        self._mr_idle_idx = -1
         # multiregion terminal-transition work, derived from the
         # deployments table (NOT from watcher lifecycles): survives
         # leader restarts and retry exhaustion. deployment id ->
@@ -67,6 +76,8 @@ class DeploymentsWatcher:
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
             prev, self._enabled = self._enabled, enabled
+            self._idle_idx = -1
+            self._mr_idle_idx = -1
             if not enabled:
                 self._tracked.clear()
                 self._health_seen.clear()
@@ -102,11 +113,26 @@ class DeploymentsWatcher:
                     LOG.warning("multiregion scan: %s", e)
 
     def _tick_all(self) -> None:
-        active = self.server.state.active_deployments()
+        # indexed early-out: with nothing tracked, an unchanged
+        # deployments table proves there is still nothing to do — the
+        # alloc-commit wakeups of a placement burst return here
+        # without the active_deployments() table scan. Tracked
+        # deployments always tick (progress deadlines fire on wall
+        # time, not on state changes).
+        state = self.server.state
+        dep_idx = state.table_index(["deployment"])
+        with self._lock:
+            if not self._tracked and dep_idx == self._idle_idx:
+                return
+        active = state.active_deployments()
         active_ids = {d.id for d in active}
         with self._lock:
             if not self._enabled:
                 return
+            if not active and not self._tracked:
+                self._idle_idx = dep_idx
+            else:
+                self._idle_idx = -1
             for did in list(self._tracked):
                 if did not in active_ids:
                     # terminal or GC'd: multiregion follow-ups are the
@@ -245,16 +271,28 @@ class DeploymentsWatcher:
         the table and retried with capped backoff until the target
         region acknowledges or proves the kick unnecessary."""
         now = time.monotonic()
-        # cheap gate first: zero multiregion candidates (the common
+        # indexed early-out first (same discipline as _tick_all): with
+        # no pending/memoized multiregion work, an unchanged
+        # deployments table proves the candidate scan would come back
+        # empty — skip it entirely on alloc-commit wakeups
+        state = self.server.state
+        dep_idx = state.table_index(["deployment"])
+        with self._lock:
+            if not self._mr_pending and not self._mr_done \
+                    and dep_idx == self._mr_idle_idx:
+                return
+        # cheap gate second: zero multiregion candidates (the common
         # single-region cluster) must not cost a whole-state snapshot
         # on every state change
-        candidates = self.server.state.multiregion_terminal_deployment_ids()
+        candidates = state.multiregion_terminal_deployment_ids()
         with self._lock:
             if not self._enabled:
                 return
             if not candidates and not self._mr_pending \
                     and not self._mr_done:
+                self._mr_idle_idx = dep_idx
                 return
+            self._mr_idle_idx = -1
             # the memo only matters while the deployment row exists;
             # prune GC'd ids so a long-lived leader doesn't accumulate
             # every terminal multiregion deployment forever

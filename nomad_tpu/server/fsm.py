@@ -235,12 +235,13 @@ class NomadFSM:
         for ev in req.get("evals", []):
             self._upsert_eval(ev, index)
         # terminal client status frees capacity: unblock by node class
-        # (fsm.go applyAllocClientUpdate -> blockedEvals.Unblock)
+        # (fsm.go applyAllocClientUpdate -> blockedEvals.Unblock).
+        # Direct locked node reads — a full snapshot per heartbeat
+        # batch forced whole-table COW copies on the next write.
         if self.blocked_evals is not None:
-            snap = self.state.snapshot()
             for a in allocs:
                 if a.client_terminal_status():
-                    node = snap.node_by_id(a.node_id)
+                    node = self.state.node_by_id_direct(a.node_id)
                     if node is not None:
                         self.blocked_evals.unblock(node.computed_class, index)
         return index
@@ -277,10 +278,11 @@ class NomadFSM:
             for nid in list(p["node_update"]) + list(p["node_preemptions"])
         }
         if self.blocked_evals is not None and freed_nodes:
-            snap = self.state.snapshot()
+            # direct locked reads: one batched plan apply is the FSM's
+            # hottest entry — a snapshot here taxed every wave commit
             classes = set()
             for nid in freed_nodes:
-                node = snap.node_by_id(nid)
+                node = self.state.node_by_id_direct(nid)
                 if node is not None:
                     classes.add(node.computed_class)
             for cls in classes:

@@ -14,6 +14,19 @@ across nodes (plan_apply_pool.go:18). Here the pool is a thread pool
 for host-path checks; for large plans the same check runs as a batched
 tensor op (all nodes' proposed utilization vs capacity in one
 vectorized comparison) which is the TPU-native equivalent.
+
+Group commit (the plan-on-device wave window): a burst of
+optimistically-scheduled evals lands a burst of plans. Instead of
+re-walking every touched node's alloc list per plan, the applier takes
+ONE snapshot of the store's live utilization planes (state/usage.py)
+plus the in-flight overlay, re-validates the whole wave with per-node
+float arithmetic (``_GroupFitChecker``), and commits every surviving
+plan as ONE raft entry and one FSM apply (``_commit_batch``). Any node
+the planes cannot prove (ports, devices, reserved cores, stale rows)
+falls back to the exact ``evaluateNodePlan`` walk — counted in
+``plan_group_stats.fallback_plans``, which the steady-state CI gate
+requires to be zero. Bit-identity of the group pass against serialized
+``apply_one`` is property-tested (tests/test_plan_group_commit.py).
 """
 
 from __future__ import annotations
@@ -29,6 +42,80 @@ from nomad_tpu.structs.eval_plan import Plan, PlanResult
 from nomad_tpu.structs.resources import allocs_fit
 from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
 from nomad_tpu.telemetry.trace import tracer
+
+
+class PlanGroupStats:
+    """Process-wide group-commit observability.
+
+    Exported as ``nomad_tpu_plan_group_*`` Prometheus series
+    (telemetry/exporter.py) and folded into TRACE_DECOMP's steady-state
+    table (bench/trace_report.py). ``fallback_plans`` is the load-bearing
+    number: the steady-state CI gate requires it to be ZERO — every plan
+    of a lean steady burst must be provable by the vectorized check, so
+    any regression that silently de-leans the hot path (a new field the
+    checker can't see, a usage-plane drift) turns the gate red instead
+    of quietly serializing the applier again.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            self.plans = 0              # plans through the group pass
+            self.vector_plans = 0       # fully proven by the vector check
+            self.fallback_plans = 0     # >=1 node took the exact walk
+            self.vector_nodes = 0
+            self.fallback_nodes = 0
+            self.rejected_node_plans = 0
+            self.commit_batches = 0
+            self.committed_plans = 0
+            self.batch_bytes = 0
+
+    def note_plan(self, vector_nodes: int, fallback_nodes: int,
+                  rejected: int) -> None:
+        with self._lock:
+            self.plans += 1
+            self.vector_nodes += vector_nodes
+            self.fallback_nodes += fallback_nodes
+            self.rejected_node_plans += rejected
+            if fallback_nodes:
+                self.fallback_plans += 1
+            else:
+                self.vector_plans += 1
+
+    def note_commit(self, n_plans: int, n_bytes: int = 0) -> None:
+        with self._lock:
+            self.commit_batches += 1
+            self.committed_plans += n_plans
+            self.batch_bytes += n_bytes
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "plans": self.plans,
+                "vector_plans": self.vector_plans,
+                "fallback_plans": self.fallback_plans,
+                "vector_nodes": self.vector_nodes,
+                "fallback_nodes": self.fallback_nodes,
+                "rejected_node_plans": self.rejected_node_plans,
+                "commit_batches": self.commit_batches,
+                "committed_plans": self.committed_plans,
+                "batch_bytes": self.batch_bytes,
+                "group_size_avg": (
+                    self.committed_plans / self.commit_batches
+                    if self.commit_batches else 0.0),
+            }
+
+
+#: process-wide (all Planners feed it; reset with telemetry.reset())
+plan_group_stats = PlanGroupStats()
+
+#: usage planes are float32: integer sums stay exact only below 2**24.
+#: A node dimension beyond that cannot be re-validated bit-identically
+#: from the planes, so the checker falls back to the exact walk.
+_F32_EXACT_MAX = float(1 << 24)
 
 
 class _PlanOverlay:
@@ -57,8 +144,21 @@ class _PlanOverlay:
         with self._lock:
             self._entries.pop(token, None)
 
+    def entries(self) -> List["PlanResult"]:
+        """All in-flight results, oldest first (the group checker folds
+        them into its per-node deltas at batch start)."""
+        with self._lock:
+            return [self._entries[k] for k in sorted(self._entries)]
+
     def node_adjustment(self, node_id: str):
-        """(placements_by_id, removed_ids) for one node across entries."""
+        """(placements_by_id, removed_ids) for one node across entries.
+
+        Entries replay in commit order with serialized-apply semantics:
+        a removal drops an earlier entry's in-flight placement of the
+        same id (exactly what the store would show had the earlier
+        entry already committed), and a later placement re-adds the id.
+        Within one entry removals apply before placements, matching
+        ``upsert_plan_results_batch``'s upsert order."""
         with self._lock:
             entries = list(self._entries.values())
         placed: Dict[str, Allocation] = {}
@@ -66,8 +166,10 @@ class _PlanOverlay:
         for r in entries:
             for a in r.node_update.get(node_id, ()):
                 removed.add(a.id)
+                placed.pop(a.id, None)
             for a in r.node_preemptions.get(node_id, ()):
                 removed.add(a.id)
+                placed.pop(a.id, None)
             for a in r.node_allocation.get(node_id, ()):
                 placed[a.id] = a
         return placed, removed
@@ -116,6 +218,273 @@ class _LiveView:
         by_id = {a.id: a for a in rows if a.id not in removed}
         by_id.update(placed)
         return list(by_id.values())
+
+
+def _lean_usage(alloc: Allocation):
+    """(cpu, mem, disk) when the alloc is lean (no ports/networks,
+    devices, or reserved cores), else None. Lean allocs are the only
+    ones the vectorized group check may re-validate: every other
+    dimension needs the exact per-node walk (NetworkIndex /
+    DeviceAccounter / core-overlap sets)."""
+    cr, uses_ports, uses_devices = alloc.fit_meta()
+    if uses_ports or uses_devices or cr.reserved_cores:
+        return None
+    return cr.cpu_shares, cr.memory_mb, cr.disk_mb
+
+
+class _GroupFitChecker:
+    """Vectorized wave re-validation state for one applier pass.
+
+    One snapshot of the store's live utilization planes (state/usage.py
+    — the SAME aggregates the scheduler's eval tensors gather from)
+    plus per-node float deltas folded from the in-flight overlay and
+    from each plan of this batch as it is accepted. A node plan whose
+    placements are lean, whose node carries no special (ports/devices)
+    or reserved-core usage, and whose dimensions stay inside float32's
+    exact-integer range is then re-validated with three comparisons —
+    no per-alloc walk, no NetworkIndex, no ComparableResources sums.
+
+    Exactness: the merge rules mirror ``_LiveView.allocs_by_node`` +
+    ``evaluate_plan`` bit for bit (entries replay in commit order —
+    a removal drops an earlier in-flight placement of the same id,
+    a later placement re-adds it; placements with an id live on the
+    same node double-count, exactly as the serial proposed-list append
+    does). Anything the planes cannot prove returns None and the
+    caller runs the exact per-node walk — semantics never depend on
+    the fast path.
+    """
+
+    def __init__(self, store, overlay: Optional[_PlanOverlay]) -> None:
+        self._store = store
+        self.ok = (getattr(store, "usage", None) is not None
+                   and hasattr(store, "with_usage_view"))
+        if not self.ok:
+            return
+        self._delta: Dict[str, List[float]] = {}
+        self._removed: Dict[str, set] = {}
+        self._placed: Dict[str, Dict[str, Tuple]] = {}
+        self._tainted: set = set()
+        self._caps: Dict[str, Tuple] = {}
+        # entries read BEFORE the planes snapshot: an entry that
+        # commits in between is deduped by the fold's committed-row
+        # check (`prev is a` for placements; terminal rows for
+        # removals), so it can never double-count against planes that
+        # already include it
+        entries = overlay.entries() if overlay is not None else []
+
+        def _init(planes, allocs):
+            self._rows = planes.rows
+            self._cpu = planes.used_cpu
+            self._mem = planes.used_mem
+            self._disk = planes.used_disk
+            self._cores = planes.used_cores
+            self._special = planes.used_special
+            for r in entries:
+                self._fold_result(r, allocs)
+
+        # planes copy + overlay fold under ONE store-lock hold
+        # (StateStore.with_usage_view): the fold checks store-row
+        # liveness, which must be consistent with the copied planes.
+        # An init failure degrades to the exact walk for the batch —
+        # it must never take the applier thread down.
+        try:
+            store.with_usage_view(_init)
+        except Exception:                       # noqa: BLE001
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "group-commit checker init failed; exact walk for "
+                "this batch", exc_info=True)
+            self.ok = False
+
+    # -- delta accounting -------------------------------------------------
+
+    def note_result(self, result: "PlanResult") -> None:
+        """Fold an accepted plan's result so later plans of the batch
+        see it (the overlay semantics, in delta form). Only the alloc
+        table is needed here — the planes snapshot stays the batch's.
+
+        A fold failure must not escape: the result itself is already
+        valid, and this runs on the applier thread whose death would
+        hang every worker's plan future. Instead the checker DISABLES
+        itself — a half-applied delta is unsound, so the rest of the
+        batch takes the exact walk (which reads the overlay, not these
+        deltas)."""
+        if not self.ok:
+            return
+        try:
+            self._store.with_allocs(
+                lambda allocs: self._fold_result(result, allocs))
+        except Exception:                       # noqa: BLE001
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "group-commit fold failed; exact walk for the rest "
+                "of the batch", exc_info=True)
+            self.ok = False
+
+    def _bump(self, node_id: str, sign: float, usage: Tuple) -> None:
+        d = self._delta.get(node_id)
+        if d is None:
+            d = self._delta[node_id] = [0.0, 0.0, 0.0]
+        d[0] += sign * usage[0]
+        d[1] += sign * usage[1]
+        d[2] += sign * usage[2]
+
+    def _fold_result(self, r: "PlanResult", store_allocs) -> None:
+        """Fold one result's deltas; call under the store lock via
+        ``with_usage_view`` (``store_allocs`` is the live table)."""
+        for src in (r.node_update, r.node_preemptions):
+            for nid, allocs in src.items():
+                rm = self._removed.setdefault(nid, set())
+                pl = self._placed.get(nid)
+                for a in allocs:
+                    old = pl.pop(a.id, None) if pl else None
+                    if old is not None:
+                        # removes an earlier in-flight placement of the
+                        # same id (serialized-commit semantics); the
+                        # store row — if one exists — was already
+                        # subtracted by the placed handler
+                        self._bump(nid, -1.0, old)
+                        rm.add(a.id)
+                        continue
+                    if a.id in rm:
+                        continue
+                    rm.add(a.id)
+                    prev = store_allocs.get(a.id)
+                    if (prev is None or prev.terminal_status()
+                            or prev.node_id != nid):
+                        continue
+                    lean = _lean_usage(prev)
+                    if lean is None:
+                        self._tainted.add(nid)
+                        continue
+                    self._bump(nid, -1.0, lean)
+        for nid, allocs in r.node_allocation.items():
+            pl = self._placed.setdefault(nid, {})
+            for a in allocs:
+                prev = store_allocs.get(a.id)
+                if prev is a:
+                    # already committed: the planes copy includes it
+                    continue
+                if a.terminal_status():
+                    # terminal placements (lost/unknown transitions)
+                    # contribute NOTHING to the exact walk — allocs_fit
+                    # skips terminal allocs, and the merged by_id view
+                    # filters them — but the merge still replaces a
+                    # live store row of the same id, so the fold
+                    # records a ZERO-usage entry after backing that
+                    # row out
+                    lean = (0, 0, 0)
+                else:
+                    lean = _lean_usage(a)
+                    if lean is None:
+                        self._tainted.add(nid)
+                        continue
+                old = pl.get(a.id)
+                if old is not None:
+                    # last placement wins the by_id merge
+                    self._bump(nid, -1.0, old)
+                elif (prev is not None and not prev.terminal_status()
+                        and prev.node_id == nid
+                        and a.id not in self._removed.get(nid, set())):
+                    # in-place update: the merged view replaces the
+                    # store row with the placed version
+                    plean = _lean_usage(prev)
+                    if plean is None:
+                        self._tainted.add(nid)
+                        continue
+                    self._bump(nid, -1.0, plean)
+                pl[a.id] = lean
+                self._bump(nid, 1.0, lean)
+
+    # -- the vector check -------------------------------------------------
+
+    def _node_cap(self, node) -> Tuple:
+        cap = self._caps.get(node.id)
+        if cap is None:
+            avail = node.comparable_resources()
+            avail.subtract(node.comparable_reserved_resources())
+            cap = (float(avail.cpu_shares), float(avail.memory_mb),
+                   float(avail.disk_mb))
+            self._caps[node.id] = cap
+        return cap
+
+    def node_fit(self, plan: Plan, node_id: str, node) -> Optional[bool]:
+        """True/False when provable from the planes, None to fall back
+        to the exact per-node walk. Caller has already run the node
+        status gates (shared with the exact path)."""
+        if not self.ok or node_id in self._tainted:
+            return None
+        row = self._rows.get(node_id)
+        if row is None:
+            return None
+        if self._special[row] or self._cores[row]:
+            return None
+        placements = plan.node_allocation.get(node_id) or ()
+        cpu = float(self._cpu[row])
+        mem = float(self._mem[row])
+        disk = float(self._disk[row])
+        d = self._delta.get(node_id)
+        if d is not None:
+            cpu += d[0]
+            mem += d[1]
+            disk += d[2]
+        # this plan's own staged stops/preemptions on the node: their
+        # store rows leave the proposed set (dedup against ids already
+        # removed or overlaid by earlier plans)
+        removals = ((plan.node_update.get(node_id) or [])
+                    + (plan.node_preemptions.get(node_id) or []))
+        if removals:
+            rm_seen = self._removed.get(node_id, ())
+            placed = self._placed.get(node_id, {})
+            seen_here: set = set()
+            for a in removals:
+                if a.id in seen_here:
+                    continue
+                seen_here.add(a.id)
+                pl_usage = placed.get(a.id)
+                if pl_usage is not None:
+                    # this plan stops an in-flight placement: the
+                    # merged view drops the placed version
+                    cpu -= pl_usage[0]
+                    mem -= pl_usage[1]
+                    disk -= pl_usage[2]
+                    continue
+                if a.id in rm_seen:
+                    continue
+                prev = self._store.alloc_by_id_direct(a.id)
+                if (prev is None or prev.terminal_status()
+                        or prev.node_id != node_id):
+                    continue
+                lean = _lean_usage(prev)
+                if lean is None:
+                    # a live special alloc would have shown in the
+                    # planes; a cored one likewise — unreachable
+                    # unless the planes drifted: fall back
+                    return None
+                cpu -= lean[0]
+                mem -= lean[1]
+                disk -= lean[2]
+        for p in placements:
+            if p.terminal_status():
+                # allocs_fit skips terminal allocs entirely (neither
+                # usage nor ports/devices), so a lost/unknown
+                # transition costs nothing and needs no lean proof
+                continue
+            lean = _lean_usage(p)
+            if lean is None:
+                return None
+            # NOTE: no dedup against a live same-id store row — the
+            # exact walk appends placements to the proposed list
+            # without one, and bit-identity tracks the exact walk
+            cpu += lean[0]
+            mem += lean[1]
+            disk += lean[2]
+        cap = self._node_cap(node)
+        if max(cap[0], cap[1], cap[2], cpu, mem, disk) >= _F32_EXACT_MAX:
+            return None
+        return cpu <= cap[0] and mem <= cap[1] and disk <= cap[2]
 
 
 class Planner:
@@ -211,16 +580,25 @@ class Planner:
             t_eval = time.perf_counter()
             evaluated: List[Tuple[PendingPlan, PlanResult, int]] = []
             snapshot = _LiveView(self.state, overlay)
-            with tracer.span("plan.evaluate"):
+            with tracer.span("plan.evaluate"), \
+                    tracer.span("plan.group_commit"):
+                # ONE planes snapshot + overlay fold re-validates the
+                # whole wave; per-node exact walks survive only as the
+                # unprovable-case fallback (counted, CI-gated to 0 on
+                # the lean steady burst)
+                checker = _GroupFitChecker(self.state, overlay)
                 for pending in batch:
                     try:
-                        result = self.evaluate_plan(snapshot, pending.plan)
+                        result = self.evaluate_plan_group(
+                            checker, snapshot, pending.plan)
                     except Exception as e:    # noqa: BLE001 - worker nacks
                         pending.respond(None, e)
                         continue
                     # later plans in this batch (and the next batch's
-                    # evaluation) see this plan through the overlay
+                    # evaluation) see this plan through the overlay;
+                    # the checker folds it into its deltas
                     token = overlay.add(result)
+                    checker.note_result(result)
                     evaluated.append((pending, result, token))
             self.stage_s["evaluate"] += time.perf_counter() - t_eval
             if not evaluated:
@@ -271,6 +649,30 @@ class Planner:
         result.alloc_index = self._commit(plan, result)
         return result
 
+    def apply_batch(self, plans: List[Plan]) -> List[PlanResult]:
+        """Synchronous group apply: evaluate ``plans`` as ONE group
+        pass (vector checks + exact fallback) and commit them as one
+        raft entry / store index bump. The applier thread's batch loop
+        with the pipelining removed — used by tests and synchronous
+        callers; bit-identical to ``apply_one`` over the same plans in
+        order (property-tested)."""
+        overlay = _PlanOverlay()
+        snapshot = _LiveView(self.state, overlay)
+        checker = _GroupFitChecker(self.state, overlay)
+        results: List[PlanResult] = []
+        with tracer.span("plan.group_commit"):
+            for plan in plans:
+                result = self.evaluate_plan_group(checker, snapshot, plan)
+                overlay.add(result)
+                checker.note_result(result)
+                results.append(result)
+        index = self._commit_batch(list(zip(plans, results)))
+        for result in results:
+            result.alloc_index = index
+            if result.refresh_index > 0:
+                result.refresh_index = max(result.refresh_index, index)
+        return results
+
     def _commit(self, plan: Plan, result: PlanResult) -> int:
         return self._commit_batch([(plan, result)])
 
@@ -289,6 +691,21 @@ class Planner:
             for plan, result in items
         ]
         req = {"alloc_index": self.state.latest_index(), "plans": reqs}
+        n_bytes = 0
+        if tracer.enabled:
+            # the wire weight of the batched raft entry (its alloc
+            # payload — what a real log would ship); measured only with
+            # telemetry on, off the wave-critical path (commit thread)
+            try:
+                import pickle
+
+                n_bytes = len(pickle.dumps(
+                    [(r["node_allocation"], r["node_update"],
+                      r["node_preemptions"]) for r in reqs],
+                    protocol=4))
+            except Exception:               # noqa: BLE001 - metric only
+                n_bytes = 0
+        plan_group_stats.note_commit(len(items), n_bytes)
         if self._raft_apply is not None:
             # fsm.go applyPlanResults: Raft commit + blocked-eval unblock
             from nomad_tpu.server.fsm import APPLY_PLAN_RESULTS
@@ -296,9 +713,77 @@ class Planner:
         return self.state.upsert_plan_results_batch(
             req["alloc_index"], reqs)
 
+    # --- group evaluation (the wave-window fast path) -------------------
+
+    def evaluate_plan_group(self, checker: _GroupFitChecker, snapshot,
+                            plan: Plan) -> PlanResult:
+        """One plan's re-validation inside a group pass: vector check
+        per node where provable, the exact walk otherwise. Identical
+        results to ``evaluate_plan`` by construction (property-tested
+        in tests/test_plan_group_commit.py)."""
+        vector_nodes = 0
+        fits: Dict[str, bool] = {}
+        pending_exact: List[str] = []
+        for node_id in plan.node_allocation:
+            placements = plan.node_allocation[node_id]
+            if not placements:
+                fits[node_id] = True
+                continue
+            node = snapshot.node_by_id(node_id)
+            verdict = self._node_status_gates(node, placements)
+            if verdict is not None:
+                fits[node_id] = verdict[0]
+                vector_nodes += 1
+                continue
+            fit = checker.node_fit(plan, node_id, node)
+            if fit is None:
+                pending_exact.append(node_id)
+            else:
+                fits[node_id] = fit
+                vector_nodes += 1
+        fallback_nodes = len(pending_exact)
+        if pending_exact:
+            # exact-walk fallback keeps evaluate_plan's fan-out: a
+            # system-job / mass-drain plan touching many non-lean
+            # nodes re-checks them on the pool, not serially
+            for node_id, fit in self._exact_node_fits(
+                    snapshot, plan, pending_exact).items():
+                fits[node_id] = fit
+        rejected = sum(1 for f in fits.values() if not f)
+        plan_group_stats.note_plan(vector_nodes, fallback_nodes, rejected)
+        return self._assemble_result(snapshot, plan, fits)
+
     # --- evaluation (plan_apply.go:403 evaluatePlan) --------------------
 
     def evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
+        fits = self._exact_node_fits(
+            snapshot, plan, list(plan.node_allocation.keys()))
+        return self._assemble_result(snapshot, plan, fits)
+
+    def _exact_node_fits(self, snapshot, plan: Plan,
+                         node_ids: List[str]) -> Dict[str, bool]:
+        """The exact per-node walk for a set of nodes. The pool pays
+        off only when a plan touches MANY nodes (system jobs, mass
+        drains): executor dispatch costs more than the whole fit
+        re-check for the common 10-node service plan."""
+        if len(node_ids) > 16 and self._pool is not None:
+            verdicts = list(
+                self._pool.map(
+                    lambda nid: self._evaluate_node_plan(snapshot, plan, nid),
+                    node_ids,
+                )
+            )
+        else:
+            verdicts = [self._evaluate_node_plan(snapshot, plan, n)
+                        for n in node_ids]
+        return {nid: fit for nid, (fit, _reason) in zip(node_ids, verdicts)}
+
+    def _assemble_result(self, snapshot, plan: Plan,
+                         fits: Dict[str, bool]) -> PlanResult:
+        """Shared accept/reject tail of ``evaluate_plan`` and
+        ``evaluate_plan_group`` (one implementation so the two paths
+        cannot drift): fold per-node verdicts into the PlanResult plus
+        the partial/refresh bookkeeping."""
         result = PlanResult(
             node_update=dict(plan.node_update),
             node_allocation={},
@@ -306,23 +791,9 @@ class Planner:
             deployment=plan.deployment,
             deployment_updates=list(plan.deployment_updates),
         )
-        node_ids = list(plan.node_allocation.keys())
-        # the pool pays off only when a plan touches MANY nodes (system
-        # jobs, mass drains): executor dispatch costs more than the
-        # whole fit re-check for the common 10-node service plan
-        if len(node_ids) > 16 and self._pool is not None:
-            fits = list(
-                self._pool.map(
-                    lambda nid: self._evaluate_node_plan(snapshot, plan, nid),
-                    node_ids,
-                )
-            )
-        else:
-            fits = [self._evaluate_node_plan(snapshot, plan, n) for n in node_ids]
-
         partial = False
-        for node_id, (fit, _reason) in zip(node_ids, fits):
-            if fit:
+        for node_id in plan.node_allocation:
+            if fits[node_id]:
                 result.node_allocation[node_id] = plan.node_allocation[node_id]
                 if node_id in plan.node_preemptions:
                     result.node_preemptions[node_id] = plan.node_preemptions[node_id]
@@ -340,14 +811,12 @@ class Planner:
             self.plans_full += 1
         return result
 
-    def _evaluate_node_plan(
-        self, snapshot, plan: Plan, node_id: str
-    ) -> Tuple[bool, str]:
-        """plan_apply.go:644 evaluateNodePlan."""
-        placements = plan.node_allocation.get(node_id, [])
-        if not placements:
-            return True, ""
-        node = snapshot.node_by_id(node_id)
+    @staticmethod
+    def _node_status_gates(node, placements) -> Optional[Tuple[bool, str]]:
+        """The node-level gates of evaluateNodePlan, shared VERBATIM by
+        the exact walk and the vectorized group check (so the two paths
+        cannot drift). Returns a (fit, reason) verdict, or None when
+        the gates pass and the resource fit check decides."""
         if node is None:
             return False, "node does not exist"
         if node.status == consts.NODE_STATUS_DISCONNECTED:
@@ -370,6 +839,19 @@ class Planner:
             return False, "node is draining"
         if node.scheduling_eligibility == consts.NODE_SCHEDULING_INELIGIBLE:
             return False, "node is not eligible"
+        return None
+
+    def _evaluate_node_plan(
+        self, snapshot, plan: Plan, node_id: str
+    ) -> Tuple[bool, str]:
+        """plan_apply.go:644 evaluateNodePlan."""
+        placements = plan.node_allocation.get(node_id, [])
+        if not placements:
+            return True, ""
+        node = snapshot.node_by_id(node_id)
+        verdict = self._node_status_gates(node, placements)
+        if verdict is not None:
+            return verdict
 
         # proposed = existing (non-terminal) - updated - preempted + planned
         existing = [

@@ -21,6 +21,7 @@ from nomad_tpu.server.blocked_evals import BlockedEvals
 from nomad_tpu.server.eval_broker import FAILED_QUEUE, EvalBroker
 from nomad_tpu.server.fsm import NomadFSM
 from nomad_tpu.server.heartbeat import HeartbeatTimers
+from nomad_tpu.server import plan_apply as _plan_apply
 from nomad_tpu.server.plan_apply import Planner
 from nomad_tpu.server.plan_queue import PlanQueue
 from nomad_tpu.server.worker import Worker
@@ -1537,6 +1538,9 @@ class Server:
                     for k, v in self.planner.stage_s.items()
                 },
             },
+            # group commit: vector-proven vs exact-fallback plan
+            # re-validation + batched raft entry shape
+            "plan_group": _plan_apply.plan_group_stats.snapshot(),
             # exact host-side assignment disagreed with the kernel and
             # forced a masked re-run (should stay near zero)
             "assign_retry_launches":

@@ -23,6 +23,7 @@ workers scheduling concurrently against a shared state index.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 from typing import List, Optional, Tuple
 
@@ -42,6 +43,95 @@ DEFAULT_SCHEDULERS = [
     consts.JOB_TYPE_SYSBATCH,
     consts.JOB_TYPE_CORE,
 ]
+
+
+class _EvalTask:
+    """One pool task: completion event + confined exceptions."""
+
+    __slots__ = ("fn", "args", "_done")
+
+    def __init__(self, fn, args) -> None:
+        self.fn = fn
+        self.args = args
+        self._done = threading.Event()
+
+    def run(self) -> None:
+        try:
+            self.fn(*self.args)
+        except Exception:                       # noqa: BLE001
+            # confined like the old per-batch daemon threads: the task
+            # (an eval wrapper) already acks/nacks its own eval; an
+            # escaped exception must not kill the worker loop
+            LOG.warning("worker eval task failed", exc_info=True)
+        finally:
+            self._done.set()
+
+    def wait(self) -> None:
+        self._done.wait()
+
+
+class _EvalPool:
+    """Persistent DAEMON-thread pool for batch eval fan-out.
+
+    Deliberately not ``ThreadPoolExecutor``: its threads are non-daemon
+    and joined by concurrent.futures' atexit hook, so an eval blocked
+    in a cold XLA compile would hold interpreter exit for tens of
+    seconds — and a future's re-raised exception in the reap would kill
+    the worker's run loop where the old per-batch daemon threads
+    confined it. This pool keeps both semantics while making the
+    threads PERSISTENT (the point of the change: no spawn/reap per
+    eval per batch): threads spawn lazily up to ``max_threads`` and
+    are always >= outstanding tasks — a queued-but-not-running eval
+    would stall its wave's rendezvous until the coalescer deadline.
+    """
+
+    def __init__(self, max_threads: int, name: str) -> None:
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._max = max_threads
+        self._name = name
+        self._lock = threading.Lock()
+        self._spawned = 0
+        self._active = 0
+
+    def submit(self, fn, *args) -> _EvalTask:
+        task = _EvalTask(fn, args)
+        spawn = 0
+        with self._lock:
+            self._active += 1
+            if self._spawned < min(self._active, self._max):
+                self._spawned += 1
+                spawn = self._spawned
+        self._q.put(task)
+        if spawn:
+            threading.Thread(
+                target=self._run, daemon=True,
+                name=f"{self._name}-{spawn}",
+            ).start()
+        return task
+
+    def _run(self) -> None:
+        while True:
+            task = self._q.get()
+            if task is None:
+                return
+            try:
+                task.run()
+            finally:
+                with self._lock:
+                    self._active -= 1
+
+    def shutdown(self) -> None:
+        """Retire the current threads; in-flight tasks finish on their
+        own (daemon threads never block interpreter exit). The pool
+        stays USABLE: a batch still running past its worker's stop()
+        join timeout may submit more chunks — resetting the spawn
+        count lets those submits spawn fresh threads instead of
+        queueing tasks no thread will ever serve (which would hang the
+        batch's reap forever)."""
+        with self._lock:
+            n, self._spawned = self._spawned, 0
+        for _ in range(n):
+            self._q.put(None)
 
 
 class _EvalRun:
@@ -130,6 +220,14 @@ class Worker:
         self._live_lock = threading.Lock()
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        # persistent eval-thread pool for batch scheduling: created
+        # lazily on the first batch (single-eval workers never pay for
+        # it), sized to the 2-deep chunk pipeline so every submitted
+        # eval runs concurrently — the coalescer's rendezvous counts
+        # it as a participant and a queued (not running) eval would
+        # stall the wave until its deadline
+        self._pool: Optional[_EvalPool] = None
+        self._pool_lock = threading.Lock()
 
     # --- lifecycle (worker.go run/pause) --------------------------------
 
@@ -155,6 +253,19 @@ class Worker:
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)
             self._hb_thread = None
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            # in-flight evals finish (they ack/nack on their own);
+            # idle pool threads exit
+            pool.shutdown()
+
+    def _eval_pool(self) -> _EvalPool:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = _EvalPool(
+                    2 * self.MAX_WAVE, f"worker-{self.id}-eval")
+            return self._pool
 
     def set_pause(self, paused: bool) -> None:
         """Leadership-change pause (leader.go:496 handlePausableWorkers)."""
@@ -184,7 +295,7 @@ class Worker:
             self._process(ev, token)
         else:
             # the envelope span: its exclusive CPU is the fan-out cost
-            # (thread spawn/reap) the per-eval spans can't see
+            # (pool submit/reap) the per-eval spans can't see
             with tracer.span("worker.batch", trace_id=batch[0][0].id):
                 self._process_batch(batch)
         return True
@@ -302,12 +413,18 @@ class Worker:
             (batch[0][0].id, 0) if tracer.enabled else None)
 
         clusters = ClusterCache()
-        in_flight: List[Tuple[List[threading.Thread], "LaunchCoalescer"]] = []
+        # the persistent pool replaces a thread spawn/reap per eval per
+        # batch (TRACE_DECOMP: ~0.5-1 ms/eval of worker fanout): chunk
+        # tasks are SUBMITTED to long-lived daemon threads and reaped
+        # via completion events; tracer context still attaches per
+        # task inside one()
+        pool = self._eval_pool()
+        in_flight: List[Tuple[List, "LaunchCoalescer"]] = []
 
         def reap(group) -> None:
-            threads, coalescer = group
-            for t in threads:
-                t.join()
+            tasks, coalescer = group
+            for t in tasks:
+                t.wait()
             self.batch_launches += coalescer.launches
             self.batch_requests += coalescer.requests
             self.max_wave = max(self.max_wave, coalescer.max_wave)
@@ -342,15 +459,9 @@ class Worker:
                 finally:
                     coalescer.done()
 
-            threads = [
-                threading.Thread(
-                    target=one, args=(ev, token),
-                    daemon=True, name=f"worker-{self.id}-eval",
-                )
-                for ev, token in chunk
+            tasks = [
+                pool.submit(one, ev, token) for ev, token in chunk
             ]
-            for t in threads:
-                t.start()
-            in_flight.append((threads, coalescer))
+            in_flight.append((tasks, coalescer))
         for group in in_flight:
             reap(group)

@@ -290,6 +290,41 @@ class StateStore:
         with self._lock:
             return len(self._csi_volumes)
 
+    def node_by_id_direct(self, node_id: str):
+        """Direct locked read of one node row (no COW snapshot): for
+        hot paths that need a single node — building a snapshot marks
+        every table shared and forces whole-table copies on the next
+        mutation. Rows are replaced (never mutated) on update, so
+        handing one out is safe."""
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def alloc_by_id_direct(self, alloc_id: str):
+        """Direct locked read of one alloc row (same rationale as
+        node_by_id_direct)."""
+        with self._lock:
+            return self._allocs.get(alloc_id)
+
+    def with_usage_view(self, fn):
+        """Run ``fn(planes, allocs)`` under the store lock: ``planes``
+        is the cached utilization planes copy (state/usage.py),
+        ``allocs`` the live alloc table — both READ-ONLY to the
+        callee. The plan applier's group checker uses this to fold
+        in-flight plan results against a planes snapshot that is
+        CONSISTENT with its per-alloc liveness reads: a commit landing
+        between the two reads would otherwise double-count its
+        allocs (server/plan_apply._GroupFitChecker)."""
+        with self._lock:
+            return fn(self.usage.planes_copy(), self._allocs)
+
+    def with_allocs(self, fn):
+        """Run ``fn(allocs)`` under the store lock with the live alloc
+        table (READ-ONLY to the callee) — ``with_usage_view`` without
+        the planes copy, for callers that only need consistent
+        per-alloc liveness reads."""
+        with self._lock:
+            return fn(self._allocs)
+
     def _own(self, *tables: str) -> None:
         """Copy-on-write: detach the named tables from any snapshots
         sharing them. Call under the lock BEFORE mutating a table."""
@@ -884,14 +919,17 @@ class StateStore:
         return idx
 
     def upsert_allocs(self, allocs: List[Allocation]) -> int:
+        dep_touched = False
         with self._lock:
             idx = self._next_index()
             for a in allocs:
-                self._upsert_alloc_locked(a, idx)
-        self._notify(["allocs"], idx)
+                dep_touched |= self._upsert_alloc_locked(a, idx)
+        self._notify(["allocs", "deployment"] if dep_touched
+                     else ["allocs"], idx)
         return idx
 
-    def _upsert_alloc_locked(self, a: Allocation, idx: int) -> None:
+    def _upsert_alloc_locked(self, a: Allocation, idx: int) -> bool:
+        """Returns True when the upsert also wrote a deployment row."""
         self._own("allocs", "allocs_by_job", "allocs_by_node",
                   "allocs_by_eval")
         existing = self._allocs.get(a.id)
@@ -905,7 +943,8 @@ class StateStore:
         a.modify_index = idx
         self._allocs[a.id] = a
         self.usage.alloc_changed(existing, a)
-        self._update_deployment_with_alloc_locked(existing, a, idx)
+        dep_touched = self._update_deployment_with_alloc_locked(
+            existing, a, idx)
         for table, key in (
             (self._allocs_by_job, (a.namespace, a.job_id)),
             (self._allocs_by_node, a.node_id),
@@ -915,9 +954,11 @@ class StateStore:
             if ids is None or a.id not in ids:
                 # frozenset replacement, never in-place (snapshots share)
                 table[key] = (ids or frozenset()) | {a.id}
+        return dep_touched
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
         """Client status updates (state_store.go UpdateAllocsFromClient)."""
+        dep_touched = False
         with self._lock:
             idx = self._next_index()
             self._own("allocs")
@@ -939,23 +980,29 @@ class StateStore:
                 self.usage.alloc_changed(existing, new)
                 # health transitions roll up into the deployment
                 # (state_store.go updateDeploymentWithAlloc)
-                self._update_deployment_with_alloc_locked(existing, new, idx)
-        self._notify(["allocs", "deployment"], idx)
+                dep_touched |= self._update_deployment_with_alloc_locked(
+                    existing, new, idx)
+        self._notify(["allocs", "deployment"] if dep_touched
+                     else ["allocs"], idx)
         return idx
 
     def _update_deployment_with_alloc_locked(
         self, old: Optional[Allocation], new: Allocation, idx: int
-    ) -> None:
+    ) -> bool:
         """Bump DeploymentState counters on placement/health changes
-        (state_store.go updateDeploymentWithAlloc)."""
+        (state_store.go updateDeploymentWithAlloc). Returns True when a
+        deployment row was actually written — callers notify the
+        "deployment" table only then, so the deployments watcher's
+        index-gated early-out actually fires on deployment-less
+        placement bursts (the common case)."""
         if not new.deployment_id:
-            return
+            return False
         d = self._deployments.get(new.deployment_id)
         if d is None or not d.active():
-            return
+            return False
         state = d.task_groups.get(new.task_group)
         if state is None:
-            return
+            return False
         placed = 1 if old is None else 0
         old_h = old.deployment_status.healthy \
             if old is not None and old.deployment_status is not None else None
@@ -964,7 +1011,7 @@ class StateStore:
         d_healthy = (1 if new_h is True else 0) - (1 if old_h is True else 0)
         d_unhealthy = (1 if new_h is False else 0) - (1 if old_h is False else 0)
         if not (placed or d_healthy or d_unhealthy):
-            return
+            return False
         self._own("deployments")
         d = d.copy()
         state = d.task_groups[new.task_group]
@@ -973,6 +1020,7 @@ class StateStore:
         state.unhealthy_allocs += d_unhealthy
         d.modify_index = idx
         self._deployments[d.id] = d
+        return True
 
     def update_allocs_desired_transition(self, transitions: Dict[str, object], evals: List[Evaluation]) -> int:
         """{alloc_id: DesiredTransition} -- drainer/operator migrate
@@ -1203,6 +1251,7 @@ class StateStore:
         watcher notification (the applier merges a burst of plans into
         one raft entry; fsm.go applyPlanResults semantics per plan,
         applied in batch order)."""
+        dep_touched = False
         with self._lock:
             idx = self._next_index()
             self._own("deployments")
@@ -1210,21 +1259,22 @@ class StateStore:
                 plan = p["plan"]
                 for allocs in p["node_update"].values():
                     for a in allocs:
-                        self._upsert_alloc_locked(a, idx)
+                        dep_touched |= self._upsert_alloc_locked(a, idx)
                 for allocs in p["node_preemptions"].values():
                     for a in allocs:
-                        self._upsert_alloc_locked(a, idx)
+                        dep_touched |= self._upsert_alloc_locked(a, idx)
                 for allocs in p["node_allocation"].values():
                     for a in allocs:
                         if a.job is None:
                             a.job = plan.job
-                        self._upsert_alloc_locked(a, idx)
+                        dep_touched |= self._upsert_alloc_locked(a, idx)
                 deployment = p.get("deployment")
                 if deployment is not None:
                     deployment.modify_index = idx
                     if deployment.create_index == 0:
                         deployment.create_index = idx
                     self._deployments[deployment.id] = deployment
+                    dep_touched = True
                 for du in p.get("deployment_updates") or []:
                     d = self._deployments.get(du.get("deployment_id"))
                     if d is not None:
@@ -1234,7 +1284,13 @@ class StateStore:
                             "status_description", d.status_description)
                         d.modify_index = idx
                         self._deployments[d.id] = d
-        self._notify(["allocs", "deployment"], idx)
+                        dep_touched = True
+        # notify "deployment" only when a row actually changed: the
+        # deployments watcher's idle gate keys on this index, and a
+        # deployment-less placement burst (the common case) must not
+        # defeat it by bumping the index on every plan commit
+        self._notify(["allocs", "deployment"] if dep_touched
+                     else ["allocs"], idx)
         return idx
 
 

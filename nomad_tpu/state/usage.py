@@ -57,6 +57,13 @@ class UsagePlanes:
     used_disk: np.ndarray
     used_cores: np.ndarray                   # i32[n]
     used_mbits: np.ndarray                   # i32[n]
+    #: count of live allocs on the node that use ports/networks or
+    #: devices. Zero (together with used_cores == 0) proves the node's
+    #: fit re-check is pure cpu/mem/disk arithmetic — the plan
+    #: applier's vectorized group-commit check is only sound on such
+    #: nodes and falls back to the exact walk otherwise
+    #: (server/plan_apply.py).
+    used_special: np.ndarray                 # i32[n]
     version: int = 0
     structure_version: int = 0
     uid: str = ""                            # owning store's identity
@@ -92,6 +99,7 @@ class UsageIndex:
         self.used_disk = np.zeros(0, np.float32)
         self.used_cores = np.zeros(0, np.int32)
         self.used_mbits = np.zeros(0, np.int32)
+        self.used_special = np.zeros(0, np.int32)
         self.version = 0
         self.structure_version = 0
         # structural change log: (structure_version, node_id or None)
@@ -112,7 +120,7 @@ class UsageIndex:
         if new_cap <= self.cap:
             return
         for name in ("used_cpu", "used_mem", "used_disk",
-                     "used_cores", "used_mbits"):
+                     "used_cores", "used_mbits", "used_special"):
             old = getattr(self, name)
             grown = np.zeros(new_cap, old.dtype)
             grown[: old.shape[0]] = old
@@ -149,7 +157,7 @@ class UsageIndex:
         self.ids[row] = None
         self._free.append(row)
         for name in ("used_cpu", "used_mem", "used_disk",
-                     "used_cores", "used_mbits"):
+                     "used_cores", "used_mbits", "used_special"):
             getattr(self, name)[row] = 0
         self._touch(structural=True, node_id=node_id)
         self._log_row(node_id)
@@ -167,13 +175,15 @@ class UsageIndex:
             # allocs can land before their node registers in restore
             # order; give the node a row so the usage is not lost
             row = self.node_row(a.node_id)
-        cr = a.comparable_resources()
+        cr, uses_ports, uses_devices = a.fit_meta()
         self.used_cpu[row] += sign * cr.cpu_shares
         self.used_mem[row] += sign * cr.memory_mb
         self.used_disk[row] += sign * cr.disk_mb
         self.used_cores[row] += sign * len(cr.reserved_cores)
         mbits = sum(net.mbits for net in cr.networks)
         self.used_mbits[row] += sign * mbits
+        if uses_ports or uses_devices:
+            self.used_special[row] += sign
 
     def alloc_changed(self, old, new) -> None:
         """Apply one allocation transition (upsert/update/delete)."""
@@ -199,7 +209,7 @@ class UsageIndex:
         self._free.clear()
         self.cap = 0
         for name in ("used_cpu", "used_mem", "used_disk",
-                     "used_cores", "used_mbits"):
+                     "used_cores", "used_mbits", "used_special"):
             setattr(self, name, np.zeros(0, getattr(self, name).dtype))
         for node in nodes:
             self.node_row(node.id)
@@ -248,6 +258,7 @@ class UsageIndex:
             used_disk=self.used_disk[:n].copy(),
             used_cores=self.used_cores[:n].copy(),
             used_mbits=self.used_mbits[:n].copy(),
+            used_special=self.used_special[:n].copy(),
             version=self.version,
             structure_version=self.structure_version,
             uid=self.uid,

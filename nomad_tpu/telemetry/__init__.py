@@ -74,6 +74,14 @@ def reset() -> None:
         default_mask_cache.reset_stats()
     except Exception:                           # noqa: BLE001
         pass
+    try:
+        # plan group-commit counters (vector vs fallback re-validation,
+        # batched raft entries) cover the same burst window
+        from nomad_tpu.server.plan_apply import plan_group_stats
+
+        plan_group_stats.reset()
+    except Exception:                           # noqa: BLE001
+        pass
 
 
 if os.environ.get("NOMAD_TPU_TRACE", "") not in ("", "0"):

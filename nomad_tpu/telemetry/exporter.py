@@ -183,6 +183,42 @@ def prometheus_text(registry=None) -> str:
             f"nomad_tpu_feasibility_cached_masks {f['cached_masks']}")
     except Exception:                           # noqa: BLE001
         pass                # feasibility subsystem unavailable: skip
+    # plan group commit (server/plan_apply.py): wave-window plan
+    # re-validation — vector-proven vs exact-walk fallback plans,
+    # rejected node plans, and the batched raft entries' plan counts
+    # and payload bytes. fallback > 0 on a lean burst is a regression
+    # (the steady-state gate requires 0).
+    try:
+        from nomad_tpu.server.plan_apply import plan_group_stats
+
+        g = plan_group_stats.snapshot()
+        lines.append("# TYPE nomad_tpu_plan_group_plans_total counter")
+        for kind, key in (("vector", "vector_plans"),
+                          ("fallback", "fallback_plans")):
+            lines.append(
+                f'nomad_tpu_plan_group_plans_total{{kind="{kind}"}} '
+                f'{g[key]}')
+        lines.append("# TYPE nomad_tpu_plan_group_rejects_total counter")
+        lines.append(
+            f"nomad_tpu_plan_group_rejects_total "
+            f"{g['rejected_node_plans']}")
+        lines.append("# TYPE nomad_tpu_plan_group_commits_total counter")
+        lines.append(
+            f"nomad_tpu_plan_group_commits_total {g['commit_batches']}")
+        lines.append(
+            "# TYPE nomad_tpu_plan_group_committed_plans_total counter")
+        lines.append(
+            f"nomad_tpu_plan_group_committed_plans_total "
+            f"{g['committed_plans']}")
+        lines.append("# TYPE nomad_tpu_plan_group_bytes_total counter")
+        lines.append(
+            f"nomad_tpu_plan_group_bytes_total {g['batch_bytes']}")
+        lines.append("# TYPE nomad_tpu_plan_group_size_avg gauge")
+        lines.append(
+            f"nomad_tpu_plan_group_size_avg "
+            f"{round(g['group_size_avg'], 4)}")
+    except Exception:                           # noqa: BLE001
+        pass                # plan applier unavailable: skip
     lines.append(
         "# TYPE nomad_tpu_telemetry_enabled gauge")
     lines.append(

@@ -299,6 +299,14 @@ class DeviceClusterState:
             # here would full-upload per interleave and ping-pong the
             # registry between versions.
             return None
+        # BLOCKING acquire on purpose: a batch's eval threads all
+        # reach here with the same snapshot; the first advances, the
+        # rest wait and then hit the double-checked fast path. Waiting
+        # is cheaper than it looks — these threads would otherwise
+        # park at the wave rendezvous, and a follower that skipped
+        # ahead without residency would make its wave ship FULL host
+        # planes (measured: h2d share exploded 17x with a try-lock
+        # here on the CPU backend).
         with self._lock:
             gen = self._gens.get(key)
             if gen is not None and gen.version == usage.version \

@@ -1,0 +1,290 @@
+"""Group-commit bit-identity: the plan applier's vectorized wave pass
+(server/plan_apply.py `_GroupFitChecker` + `apply_batch`) must produce
+results identical to serialized `apply_one` over the same plans in the
+same order — including node-plan conflicts, overcommit rejection,
+in-place updates, staged stops, non-lean (exact-walk fallback) members,
+and partial-wave failures (a rejected plan must not poison siblings).
+
+The property test builds TWO identical universes from one randomized
+scenario description, applies the plans serially in one and as a group
+in the other, and compares per-plan results and final store state.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.plan_apply import Planner, plan_group_stats
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import Allocation
+from nomad_tpu.structs.eval_plan import Plan
+from nomad_tpu.structs.network import Port
+from nomad_tpu.structs.resources import (
+    AllocatedCpuResources,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+)
+
+N_NODES = 8
+
+
+def _make_alloc(spec: dict) -> Allocation:
+    """Instantiate one alloc from a plain-data spec (each universe gets
+    its own object graph; ids are shared so results compare)."""
+    shared = AllocatedSharedResources(disk_mb=spec["disk"])
+    if spec.get("port"):
+        shared.ports = [Port(label="p", value=spec["port"])]
+    return Allocation(
+        id=spec["id"],
+        eval_id="eval-" + spec["id"],
+        node_id=spec["node_id"],
+        namespace="default",
+        job_id=spec.get("job_id", "job-" + spec["id"]),
+        task_group="web",
+        name="job.web[0]",
+        desired_status=spec.get("desired_status", consts.ALLOC_DESIRED_RUN),
+        client_status=spec.get("client_status", consts.ALLOC_CLIENT_PENDING),
+        allocated_resources=AllocatedResources(
+            tasks={
+                "web": AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(cpu_shares=spec["cpu"]),
+                    memory=AllocatedMemoryResources(memory_mb=spec["mem"]),
+                )
+            },
+            shared=shared,
+        ),
+    )
+
+
+def _scenario(seed: int) -> dict:
+    """One randomized scenario as plain data: node ids, pre-existing
+    allocs, and a mix of plans."""
+    rng = random.Random(seed)
+    nodes = [f"node-{seed}-{i}" for i in range(N_NODES)]
+    port_counter = [20000]
+
+    def alloc_spec(i: str, node_id: str, big: bool = False,
+                   port: bool = False) -> dict:
+        spec = {
+            "id": f"alloc-{seed}-{i}",
+            "node_id": node_id,
+            # big asks force overcommit interplay on 3900-MHz nodes
+            "cpu": rng.choice([500, 1200, 2000, 3000]
+                              if not big else [2500, 3500, 3900]),
+            "mem": rng.choice([256, 1024, 4096]),
+            "disk": rng.choice([100, 1000]),
+        }
+        if port:
+            port_counter[0] += 1
+            spec["port"] = port_counter[0]
+        return spec
+
+    existing = [
+        alloc_spec(f"pre-{i}", rng.choice(nodes))
+        for i in range(rng.randint(0, 10))
+    ]
+    plans = []
+    for p in range(rng.randint(2, 8)):
+        placements = []
+        stops = []
+        preempts = []
+        for s in range(rng.randint(1, 4)):
+            roll = rng.random()
+            node_id = rng.choice(nodes)
+            if roll < 0.08:
+                # node-plan conflict: a node that does not exist
+                node_id = f"missing-{seed}-{p}-{s}"
+            spec = alloc_spec(
+                f"{p}-{s}", node_id,
+                big=rng.random() < 0.5,
+                port=rng.random() < 0.15,   # non-lean -> exact fallback
+            )
+            if existing and rng.random() < 0.15:
+                # in-place update: placement re-uses a live alloc id
+                prev = rng.choice(existing)
+                spec["id"] = prev["id"]
+                spec["node_id"] = prev["node_id"]
+            if rng.random() < 0.12:
+                # terminal transition rides node_allocation (lost
+                # marks): contributes NOTHING to the fit walk —
+                # allocs_fit skips terminal allocs — and the group
+                # fold must agree
+                spec["client_status"] = consts.ALLOC_CLIENT_LOST
+            placements.append(spec)
+        if existing and rng.random() < 0.4:
+            stops.append(rng.choice(existing)["id"])
+        if existing and rng.random() < 0.2:
+            preempts.append(rng.choice(existing)["id"])
+        plans.append({"placements": placements, "stops": stops,
+                      "preempts": preempts})
+    return {"seed": seed, "nodes": nodes, "existing": existing,
+            "plans": plans}
+
+
+def _build_universe(scenario: dict):
+    """(store, plans) instantiated fresh from the scenario data."""
+    store = StateStore()
+    for nid in scenario["nodes"]:
+        store.upsert_node(mock.node(id=nid))
+    pre = {}
+    for spec in scenario["existing"]:
+        a = _make_alloc(spec)
+        a.client_status = consts.ALLOC_CLIENT_RUNNING
+        pre[a.id] = a
+    if pre:
+        store.upsert_allocs(list(pre.values()))
+    plans = []
+    for pd in scenario["plans"]:
+        plan = Plan(priority=50)
+        for spec in pd["placements"]:
+            a = _make_alloc(spec)
+            plan.node_allocation.setdefault(a.node_id, []).append(a)
+        for aid in pd["stops"]:
+            prev = store.snapshot().alloc_by_id(aid)
+            if prev is not None:
+                plan.append_stopped_alloc(prev, "stopped by test")
+        for aid in pd["preempts"]:
+            prev = store.snapshot().alloc_by_id(aid)
+            if prev is not None:
+                plan.append_preempted_alloc(prev, "preemptor")
+        plans.append(plan)
+    return store, plans
+
+
+def _result_fingerprint(result) -> tuple:
+    return (
+        tuple(sorted(
+            (nid, tuple(a.id for a in allocs))
+            for nid, allocs in result.node_allocation.items())),
+        tuple(sorted(
+            (nid, tuple(a.id for a in allocs))
+            for nid, allocs in result.node_preemptions.items())),
+        tuple(sorted(
+            (nid, tuple(a.id for a in allocs))
+            for nid, allocs in result.node_update.items())),
+        result.refresh_index > 0,
+    )
+
+
+def _store_fingerprint(store) -> tuple:
+    snap = store.snapshot()
+    rows = tuple(sorted(
+        (a.id, a.node_id, a.desired_status, a.client_status)
+        for a in snap.allocs_iter()))
+    u = snap.usage
+    usage = tuple(sorted(
+        (nid, float(u.used_cpu[row]), float(u.used_mem[row]),
+         float(u.used_disk[row]), int(u.used_special[row]))
+        for nid, row in u.rows.items()))
+    return rows, usage
+
+
+class TestGroupCommitBitIdentity:
+    @pytest.mark.parametrize("seed", range(25))
+    def test_group_apply_matches_serialized_apply_one(self, seed):
+        scenario = _scenario(seed)
+
+        store_a, plans_a = _build_universe(scenario)
+        planner_a = Planner(store_a, PlanQueue(), pool_workers=1)
+        serial = [planner_a.apply_one(p) for p in plans_a]
+
+        store_b, plans_b = _build_universe(scenario)
+        planner_b = Planner(store_b, PlanQueue(), pool_workers=1)
+        group = planner_b.apply_batch(plans_b)
+
+        assert len(serial) == len(group)
+        for i, (ra, rb) in enumerate(zip(serial, group)):
+            assert _result_fingerprint(ra) == _result_fingerprint(rb), \
+                f"seed {seed} plan {i} diverged"
+        assert _store_fingerprint(store_a) == _store_fingerprint(store_b), \
+            f"seed {seed} final state diverged"
+
+    def test_rejected_plan_does_not_poison_siblings(self):
+        """Partial-wave failure: an overcommitting plan's rejection must
+        leave its siblings' placements committed exactly as the serial
+        applier would."""
+        store, _ = _build_universe(
+            {"seed": 0, "nodes": ["node-s-0"], "existing": [],
+             "plans": []})
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        ok1 = _make_alloc({"id": "a-1", "node_id": "node-s-0",
+                           "cpu": 2000, "mem": 256, "disk": 100})
+        hog = _make_alloc({"id": "a-2", "node_id": "node-s-0",
+                           "cpu": 3000, "mem": 256, "disk": 100})
+        ok2 = _make_alloc({"id": "a-3", "node_id": "node-s-0",
+                           "cpu": 1000, "mem": 256, "disk": 100})
+        plans = [
+            Plan(priority=50, node_allocation={"node-s-0": [ok1]}),
+            Plan(priority=50, node_allocation={"node-s-0": [hog]}),
+            Plan(priority=50, node_allocation={"node-s-0": [ok2]}),
+        ]
+        results = planner.apply_batch(plans)
+        assert results[0].node_allocation    # fits (2000 <= 3900)
+        assert not results[1].node_allocation  # 2000+3000 > 3900
+        assert results[1].refresh_index > 0
+        assert results[2].node_allocation    # 2000+1000 <= 3900
+        snap = store.snapshot()
+        assert snap.alloc_by_id("a-1") is not None
+        assert snap.alloc_by_id("a-2") is None
+        assert snap.alloc_by_id("a-3") is not None
+
+    def test_overcommit_rejected_by_vector_check(self):
+        plan_group_stats.reset()
+        store, _ = _build_universe(
+            {"seed": 1, "nodes": ["node-v-0"], "existing": [],
+             "plans": []})
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        hog = _make_alloc({"id": "v-1", "node_id": "node-v-0",
+                           "cpu": 3900, "mem": 256, "disk": 100})
+        hog2 = _make_alloc({"id": "v-2", "node_id": "node-v-0",
+                            "cpu": 100, "mem": 256, "disk": 100})
+        results = planner.apply_batch([
+            Plan(priority=50, node_allocation={"node-v-0": [hog]}),
+            Plan(priority=50, node_allocation={"node-v-0": [hog2]}),
+        ])
+        assert results[0].node_allocation
+        assert not results[1].node_allocation
+        g = plan_group_stats.snapshot()
+        assert g["fallback_nodes"] == 0      # both proven by the planes
+        assert g["rejected_node_plans"] == 1
+
+    def test_non_lean_plan_counts_as_fallback(self):
+        plan_group_stats.reset()
+        store, _ = _build_universe(
+            {"seed": 2, "nodes": ["node-f-0"], "existing": [],
+             "plans": []})
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        ported = _make_alloc({"id": "f-1", "node_id": "node-f-0",
+                              "cpu": 500, "mem": 256, "disk": 100,
+                              "port": 23456})
+        results = planner.apply_batch(
+            [Plan(priority=50, node_allocation={"node-f-0": [ported]})])
+        assert results[0].node_allocation    # fits via the exact walk
+        g = plan_group_stats.snapshot()
+        assert g["fallback_plans"] == 1
+        assert g["vector_plans"] == 0
+
+    def test_group_commit_is_one_index_bump(self):
+        """The whole wave lands as ONE store commit (one raft entry /
+        one FSM apply in the live server)."""
+        store, _ = _build_universe(
+            {"seed": 3, "nodes": ["node-i-0", "node-i-1"],
+             "existing": [], "plans": []})
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        before = store.latest_index()
+        plans = [
+            Plan(priority=50, node_allocation={"node-i-0": [_make_alloc(
+                {"id": f"i-{k}", "node_id": "node-i-0", "cpu": 100,
+                 "mem": 64, "disk": 10})]})
+            for k in range(4)
+        ]
+        results = planner.apply_batch(plans)
+        assert store.latest_index() == before + 1
+        assert all(r.alloc_index == before + 1 for r in results)

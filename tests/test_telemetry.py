@@ -202,7 +202,7 @@ class TestKernelProfiler:
             for name in ("broker.dequeue", "worker.snapshot",
                          "eval.schedule", "wave.assemble", "kernel.h2d",
                          "kernel.execute", "kernel.d2h", "plan.evaluate",
-                         "plan.commit", "fsm.apply"):
+                         "plan.group_commit", "plan.commit", "fsm.apply"):
                 assert name in stages, f"missing span {name}"
             prof = profiler.summary()
             assert prof["Launches"] >= 1
@@ -228,6 +228,12 @@ class TestExposition:
         assert 'nomad_tpu_kernel_transfer_bytes_total{direction="d2h"}' \
             in text
         assert "nomad_tpu_device_state_dirty_row_upload_ratio" in text
+        # plan group-commit series (ISSUE 6)
+        assert 'nomad_tpu_plan_group_plans_total{kind="vector"}' in text
+        assert 'nomad_tpu_plan_group_plans_total{kind="fallback"}' in text
+        assert "nomad_tpu_plan_group_commits_total" in text
+        assert "nomad_tpu_plan_group_rejects_total" in text
+        assert "nomad_tpu_plan_group_bytes_total" in text
 
     def test_traces_json_shape(self, clean_telemetry):
         with tracer.span("a", trace_id="t"):
@@ -470,6 +476,20 @@ class TestTraceDecomposition:
         # structure forks and novel job specs, never per eval
         assert ss["feasibility_hit_ratio"] >= 0.95, \
             decomp.get("feasibility")
+        # ISSUE 6 steady gates: the group-commit pass must prove EVERY
+        # plan of the lean burst from the utilization planes — a
+        # fallback means the vectorized check silently lost coverage
+        # (the exact walk is bit-identical, so only this counter ever
+        # reveals the regression) — and the plan-path share is
+        # surfaced so the next re-anchor has a trajectory line
+        assert ss["plan_group_fallbacks"] == 0, decomp.get("plan_group")
+        assert decomp.get("plan_group", {}).get("plans", 0) > 0, \
+            decomp.get("plan_group")
+        assert "plan_share" in ss
+        # batched raft entries actually batch when plans queue up; a
+        # serialized applier would pin this at exactly 1.0 (tolerate
+        # a trickle-paced burst, but the counter must exist and move)
+        assert decomp.get("plan_group", {}).get("commit_batches", 0) > 0
 
     def test_disabled_tracing_leaves_no_spans(self):
         """The disabled live path must record nothing (the <5%

@@ -355,3 +355,45 @@ class TestDeployments:
         finally:
             client.shutdown()
             server.shutdown()
+
+    def test_idle_watcher_caches_against_deployment_table_index(self):
+        """Alloc commits wake the deployments watcher on every plan; with
+        nothing tracked and no active deployments, the tick must early-out
+        against the deployment table index WITHOUT re-scanning the
+        deployments table (the PR5 drainer/volume-watcher discipline). A
+        deployment write re-arms the scan."""
+        server = Server(ServerConfig(num_workers=0))
+        server.start()
+        try:
+            node = mock.node()
+            server.node_register(node)
+            calls = []
+            orig = server.state.active_deployments
+            server.state.active_deployments = \
+                lambda: (calls.append(1), orig())[1]
+            # let the watcher prove idleness once
+            deadline = time.time() + 10
+            while time.time() < deadline and not calls:
+                time.sleep(0.05)
+            time.sleep(0.3)
+            baseline = len(calls)
+            assert baseline >= 1
+            # alloc-table churn: each upsert wakes the watcher loop,
+            # but the deployment index is unchanged -> no re-scan
+            for _ in range(15):
+                a = mock.alloc(node_id=node.id)
+                server.state.upsert_allocs([a])
+                time.sleep(0.02)
+            time.sleep(0.5)
+            assert len(calls) <= baseline + 1, (baseline, len(calls))
+            # a deployment write bumps the index and re-arms the scan
+            from nomad_tpu.structs.eval_plan import Deployment
+
+            server.state.upsert_deployment(
+                Deployment(job_id="j", namespace="default"))
+            deadline = time.time() + 10
+            while time.time() < deadline and len(calls) <= baseline + 1:
+                time.sleep(0.05)
+            assert len(calls) > baseline
+        finally:
+            server.shutdown()

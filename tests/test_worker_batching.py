@@ -111,8 +111,24 @@ class TestLiveBatchedWorkers:
             assert w.batch_requests >= 10
             assert w.batch_launches < w.batch_requests
             assert w.max_wave >= 4
+            # the batch fan-out rode the PERSISTENT eval pool (one
+            # executor for the worker's lifetime, not a thread spawn
+            # per eval per batch) and survives across batches
+            assert w._pool is not None
+            pool = w._pool
+            job = mock.job()
+            job.task_groups[0].count = 2
+            server.job_register(job)
+            deadline = time.time() + 60
+            while time.time() < deadline and len(
+                    server.state.snapshot().allocs_by_job(
+                        job.namespace, job.id)) < 2:
+                time.sleep(0.2)
+            assert w._pool is pool
         finally:
             server.shutdown()
+        # stop() retires the pool
+        assert w._pool is None
 
 
 class TestLaunchCoalescer:
