@@ -85,6 +85,26 @@ E2E_BATCH_SIZE = 32
 # 16 bucket and the burst then paid a cold compile inside the window
 E2E_WARMUP_JOBS = 40
 
+# box-relative steady-throughput floor (replaces the absolute 200
+# evals/s literal, which was calibrated on a box ~2x faster than the
+# next one and therefore meaningless there — CHANGES PR 6). The floor
+# scales with trace_report.host_speed_score(), a single-thread Python
+# proxy for the GIL-bound scheduler residue that dominates the steady
+# burst: floor = EVALS_PER_SEC * (this box's score / REF_HOST_SCORE).
+# Reference pair measured together on the PR 8 container, where PR 6
+# ran a 106 evals/s median (floor at ~0.8x of it leaves noise margin).
+STEADY_FLOOR_REF_HOST_SCORE = 8.7e6
+STEADY_FLOOR_EVALS_PER_SEC = 85.0
+
+
+def _tail_top(segments: dict, n: int = 3) -> dict:
+    """Top-N tail segments by p99 share — the 'what makes the tail
+    slow' headline emitted for both the steady burst and the
+    contention cell."""
+    return {seg: row["p99_share"]
+            for seg, row in sorted(segments.items(),
+                                   key=lambda kv: -kv[1]["p99_share"])[:n]}
+
 _M64 = (1 << 64) - 1
 
 
@@ -577,10 +597,13 @@ def run_e2e(budget_s: float = None) -> dict:
                     len(snap.allocs_by_job(j.namespace, j.id))
                     for j in jobs
                 )
-            lat = sorted(server.plan_latencies)
-            p50 = lat[len(lat) // 2] if lat else 0.0
-            p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] \
-                if lat else 0.0
+            # shared nearest-rank helper (telemetry/histogram.py): the
+            # old int(len*0.99) indexing reported the MAX as "p99"
+            from nomad_tpu.telemetry.histogram import percentile
+
+            lat = list(server.plan_latencies)
+            p50 = percentile(lat, 0.5)
+            p99 = percentile(lat, 0.99)
             waves = sum(w.batch_launches for w in server.workers) - waves0
             reqs = sum(w.batch_requests for w in server.workers) - reqs0
             out = {
@@ -1157,9 +1180,28 @@ def main() -> None:
                 trace_plan_group_fallbacks=steady.get(
                     "plan_group_fallbacks"),
                 trace_steady_evals_per_sec=decomp.get("evals_per_sec"),
+            )
+            # ISSUE 8: the steady burst's e2e latency distribution +
+            # tail attribution (TRACE_DECOMP gains the "tail" section;
+            # these are its headline lines), and the BOX-RELATIVE
+            # steady floor — the absolute 200 evals/s literal gated on
+            # host speed, not on the system (see STEADY_FLOOR_* above)
+            host_score = trace_report.host_speed_score()
+            floor = STEADY_FLOOR_EVALS_PER_SEC * (
+                host_score / STEADY_FLOOR_REF_HOST_SCORE)
+            tail = decomp.get("tail", {})
+            tail_segments = tail.get("segments", {})
+            em.update(
+                trace_host_speed_score=round(host_score),
+                trace_steady_floor=round(floor, 1),
                 trace_steady_floor_ok=(
-                    decomp.get("evals_per_sec", 0.0) >= 200.0
+                    decomp.get("evals_per_sec", 0.0) >= floor
                     if decomp.get("backend") == "cpu" else None),
+                trace_steady_e2e_p50_ms=steady.get("e2e_p50_ms"),
+                trace_steady_e2e_p99_ms=steady.get("e2e_p99_ms"),
+                trace_tail_p50_coverage=tail.get("p50_coverage"),
+                trace_tail_p99_coverage=tail.get("p99_coverage"),
+                trace_tail_p99_top=_tail_top(tail_segments),
             )
         except Exception as e:                   # noqa: BLE001
             import traceback
@@ -1168,6 +1210,42 @@ def main() -> None:
                   file=sys.stderr)
     else:
         print("bench budget: skipping trace decomposition "
+              f"({budget.remaining():.0f}s left)", file=sys.stderr)
+
+    # ISSUE 8 / ROADMAP open item 4: the standing contention cell —
+    # sustained eval ingest under a heartbeat storm, judged by the e2e
+    # latency distribution. trace_e2e_p99_ms is the number the
+    # scheduler-worker horizontal-scale work gates on; the flight
+    # recorder must capture >= 1 slow-eval tree (the tail is being
+    # recorded, not just counted).
+    if budget.remaining() > 120:
+        try:
+            _phase("tail contention cell")
+            sys.path.insert(0, os.path.join(REPO, "bench"))
+            import trace_report
+
+            cell = trace_report.run_contention_burst(
+                deadline_s=min(budget.share(0.25), 150.0))
+            tail = cell.get("tail", {})
+            em.update(
+                contention_evals_per_sec=cell["evals_per_sec"],
+                contention_allocs=(f"{cell['allocs_placed']}/"
+                                   f"{cell['allocs_wanted']}"),
+                contention_heartbeats_per_sec=cell[
+                    "heartbeats_per_sec"],
+                trace_e2e_p50_ms=cell["e2e_p50_ms"],
+                trace_e2e_p99_ms=cell["e2e_p99_ms"],
+                trace_tail_slow_captures=cell["slow_trees_captured"],
+                trace_tail_contention_p99_top=_tail_top(
+                    tail.get("segments", {})),
+            )
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: contention cell failed ({e})",
+                  file=sys.stderr)
+    else:
+        print("bench budget: skipping contention cell "
               f"({budget.remaining():.0f}s left)", file=sys.stderr)
 
     replay = None

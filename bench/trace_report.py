@@ -40,6 +40,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from typing import Dict, Optional
 
@@ -217,6 +218,26 @@ def decompose(stage_totals: Dict, wall_s: float, n_evals: int,
     return out
 
 
+def _settle_committed(server, done0: int, timeout_s: float = 5.0) -> int:
+    """Processed-counter delta once the counter stops moving.
+
+    The last wave's stragglers (allocs already placed and counted,
+    acks — and therefore e2e histogram samples — still in flight) must
+    land before a measurement window closes or opens, or the tail
+    section's count-equality gate races. Waits until the counter holds
+    still for one 50ms tick; settle time never touches burst walls
+    (those are stamped at placement)."""
+    committed = sum(w.processed for w in server.workers) - done0
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        time.sleep(0.05)
+        now_done = sum(w.processed for w in server.workers) - done0
+        if now_done == committed:
+            break
+        committed = now_done
+    return committed
+
+
 def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                      allocs_per_job: int = 10, batch_size: int = 32,
                      warmup_jobs: int = 20,
@@ -346,6 +367,11 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                 c2, f2 = kernel_warmup.warmup_entries(expanded)
                 warmed = {"entries": len(expanded), "compiled": c2,
                           "failed": f2}
+            # drain straggler acks from the previous phase (warmup or
+            # burst N-1) BEFORE the reset: an eval recording its e2e
+            # sample on one side of the reset and bumping `processed`
+            # on the other would break the count-equality gate
+            _settle_committed(server, 0)
             telemetry.reset()
             done0 = sum(w.processed for w in server.workers)
             cpu0 = time.process_time()
@@ -355,6 +381,7 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                                          done0=done0)
             wall = t_done - t0
             process_cpu = time.process_time() - cpu0
+            committed = _settle_committed(server, done0)
             # interval dedupe needs the COMPLETE span set: a wrapped
             # ring would shrink the wall-interval union while the
             # aggregate sums stay whole, under-scaling shares. On
@@ -396,6 +423,31 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
             decomp["device_state"] = default_device_state.snapshot()
             decomp["feasibility"] = default_mask_cache.snapshot()
             decomp["plan_group"] = plan_group_stats.snapshot()
+            # the tail section (ISSUE 8): per-eval critical-path
+            # waterfalls aggregated into per-segment latency share at
+            # p50 vs p99, the e2e streaming histogram, and the slow-
+            # eval flight recorder's health. Built from the COMPLETE
+            # span ring; on wrap the waterfalls cover only the evals
+            # whose trees survived (flagged, never silently partial).
+            from nomad_tpu.telemetry.histogram import histograms
+            from nomad_tpu.telemetry.trace import flight_recorder
+            from nomad_tpu.telemetry.waterfall import (
+                aggregate_tail,
+                build_waterfalls,
+            )
+
+            tail_spans = spans if spans is not None else tracer.spans()
+            tail = aggregate_tail(build_waterfalls(tail_spans))
+            e2e_hist = histograms.get("e2e")
+            tail["histogram"] = e2e_hist.snapshot()
+            tail["latency"] = histograms.snapshot()
+            tail["committed_evals"] = committed
+            tail["ring_wrapped"] = spans is None
+            tail["flight_recorder"] = flight_recorder.snapshot()
+            tail["flight_recorder"]["slowest_captured_ms"] = max(
+                (t["E2eMs"] for t in flight_recorder.trees()),
+                default=0.0)
+            decomp["tail"] = tail
             history.append(decomp)
         decomp = history[-1]
         if len(history) > 1:
@@ -453,9 +505,222 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                 "plan_group", {}).get("fallback_plans", 0),
             "plan_group_size": round(decomp.get(
                 "plan_group", {}).get("group_size_avg", 0.0), 4),
+            # ISSUE 8 steady gates: the e2e latency DISTRIBUTION of the
+            # steady burst (from the streaming histogram — the same
+            # series /v1/metrics exposes) and the tail section's
+            # coverage: how much of the median eval's latency the named
+            # waterfall segments explain (CI holds >= 0.90)
+            "e2e_p50_ms": decomp["tail"]["histogram"]["p50_ms"],
+            "e2e_p99_ms": decomp["tail"]["histogram"]["p99_ms"],
+            "tail_p50_coverage": decomp["tail"].get(
+                "p50_coverage", 0.0),
+            "tail_p99_coverage": decomp["tail"].get(
+                "p99_coverage", 0.0),
         }
         return decomp
     finally:
+        if not was_enabled:
+            telemetry.disable()
+        server.shutdown()
+
+
+def host_speed_score(reps: int = 3) -> float:
+    """Single-threaded Python throughput proxy (iterations/second,
+    best-of-N) for box-relative gating.
+
+    The steady-burst residue is GIL-bound Go-parity scheduler Python
+    (ROADMAP "Where we are"), so an absolute evals/s floor calibrated
+    on one box is meaningless on another (CHANGES PR 6: the 200
+    evals/s floor was set where PR5 ran 110-150; the next container
+    ran PR5 at 72-89). This microbench — a fixed count of dict/list/
+    arithmetic iterations, the op mix of that residue — measures THIS
+    box's single-thread Python speed; bench.py scales the floor by it.
+    Best-of-N for the same reason the native baseline is best-of-N:
+    host noise must not flatter the ratio.
+    """
+    iters = 200_000
+    best = 0.0
+    for _ in range(reps):
+        acc: Dict[int, int] = {}
+        x = 0
+        t0 = time.perf_counter()
+        for i in range(iters):
+            acc[i & 255] = x
+            x += i
+            if not i & 7:
+                row = [i, x, i ^ x]
+                x += len(row)
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            best = max(best, iters / dt)
+    return best
+
+
+def run_contention_burst(n_nodes: int = 400, n_jobs: int = 80,
+                         allocs_per_job: int = 5, batch_size: int = 16,
+                         warmup_jobs: int = 12,
+                         heartbeat_threads: int = 8,
+                         submit_group: int = 4,
+                         submit_pace_s: float = 0.08,
+                         spike_s: float = 1.0,
+                         deadline_s: float = 180.0) -> Dict:
+    """The open-item-4 contention gate cell: sustained eval ingest
+    under a heartbeat storm, judged by the e2e latency DISTRIBUTION.
+
+    ``heartbeat_threads`` client threads hammer ``node_heartbeat``
+    (each heartbeat takes a state snapshot + TTL reset on the server —
+    real GIL and lock pressure against the eval path) while jobs are
+    submitted at a steady pace instead of one spike. Halfway through
+    the ingest the storm INTENSIFIES for ``spike_s`` seconds (the
+    threads drop their pacing sleep) — a deliberate contention
+    transient, so the burst always contains the tail event the flight
+    recorder exists to capture: the spiked waves land beyond the
+    EWMA-of-p99 threshold while it still reflects the calm phase. The
+    cell returns the e2e p50/p99 from the streaming histogram, the
+    waterfall tail table (which segments grew between p50 and p99
+    under contention), and the flight recorder's captures — the
+    standing signals every scheduler-worker scale PR is judged
+    against.
+    """
+    from nomad_tpu import mock, telemetry
+    from nomad_tpu.server.server import Server, ServerConfig
+    from nomad_tpu.telemetry.histogram import histograms
+    from nomad_tpu.telemetry.trace import flight_recorder, tracer
+    from nomad_tpu.telemetry.waterfall import (
+        aggregate_tail,
+        build_waterfalls,
+    )
+
+    server = Server(ServerConfig(
+        num_workers=1,
+        worker_batch_size=batch_size,
+        heartbeat_ttl=3600.0,
+    ))
+    server.start()
+    was_enabled = telemetry.enabled()
+    stop = threading.Event()
+    hb_counts = [0] * heartbeat_threads
+    storm_threads = []
+    try:
+        node_ids = []
+        for _ in range(n_nodes):
+            node = mock.node()
+            node_ids.append(node.id)
+            server.node_register(node)
+        telemetry.enable()
+
+        def submit(count):
+            jobs = []
+            for _ in range(count):
+                job = mock.simple_job()
+                job.task_groups[0].count = allocs_per_job
+                jobs.append(job)
+                server.job_register(job)
+            return jobs
+
+        def wait_placed(jobs, deadline, done0=0):
+            """Counter-trigger monitor (same discipline as the steady
+            burst's): polls cheap worker counters and takes the
+            O(allocs) state snapshot only when the trigger fires — a
+            full state copy per 50ms tick is monitor-owned GIL load
+            that would inflate the very e2e tail this cell measures."""
+            want = len(jobs) * allocs_per_job
+            placed = 0
+            t_done = time.perf_counter()
+            target = len(jobs)
+            while time.time() < deadline:
+                if sum(w.processed for w in server.workers) - done0 \
+                        >= target:
+                    snap = server.state.snapshot()
+                    placed = sum(
+                        len(snap.allocs_by_job(j.namespace, j.id))
+                        for j in jobs)
+                    t_done = time.perf_counter()
+                    if placed >= want:
+                        break
+                    target += max(1, (want - placed) // allocs_per_job)
+                time.sleep(0.02)
+            if placed < want:
+                snap = server.state.snapshot()
+                placed = sum(len(snap.allocs_by_job(j.namespace, j.id))
+                             for j in jobs)
+                t_done = time.perf_counter()
+            return placed, t_done
+
+        warm_done0 = sum(w.processed for w in server.workers)
+        warm = submit(warmup_jobs)
+        wait_placed(warm, time.time() + min(deadline_s * 0.5, 90.0),
+                    done0=warm_done0)
+        # drain warm-eval acks BEFORE the reset below: a warm eval
+        # acking after it would land warm-phase e2e samples and spans
+        # inside the cell's measurement window
+        _settle_committed(server, 0)
+
+        spike_until = [0.0]
+
+        def storm(k: int) -> None:
+            ids = node_ids[k::heartbeat_threads]
+            i = 0
+            while not stop.is_set():
+                try:
+                    server.node_heartbeat(ids[i % len(ids)], "ready")
+                    hb_counts[k] += 1
+                except Exception:               # noqa: BLE001
+                    pass
+                i += 1
+                if time.monotonic() >= spike_until[0]:
+                    time.sleep(0.001)
+
+        telemetry.reset()
+        done0 = sum(w.processed for w in server.workers)
+        for k in range(heartbeat_threads):
+            th = threading.Thread(target=storm, args=(k,), daemon=True,
+                                  name=f"hb-storm-{k}")
+            th.start()
+            storm_threads.append(th)
+        t0 = time.perf_counter()
+        jobs = []
+        for start in range(0, n_jobs, submit_group):
+            jobs.extend(submit(min(submit_group, n_jobs - start)))
+            if spike_s > 0 and start <= n_jobs // 2 \
+                    < start + submit_group:
+                # the deliberate mid-ingest contention transient
+                spike_until[0] = time.monotonic() + spike_s
+            time.sleep(submit_pace_s)
+        placed, t_done = wait_placed(jobs, time.time() + deadline_s,
+                                     done0=done0)
+        wall = t_done - t0
+        stop.set()
+        for th in storm_threads:
+            th.join(timeout=2.0)
+        committed = _settle_committed(server, done0)
+
+        e2e = histograms.get("e2e").snapshot()
+        tail = aggregate_tail(build_waterfalls(tracer.spans()))
+        fr = flight_recorder.snapshot()
+        heartbeats = sum(hb_counts)
+        return {
+            "wall_s": round(wall, 3),
+            "n_evals": n_jobs,
+            "evals_per_sec": round(n_jobs / wall, 2) if wall else 0.0,
+            "allocs_placed": placed,
+            "allocs_wanted": n_jobs * allocs_per_job,
+            "committed_evals": committed,
+            "heartbeats": heartbeats,
+            "heartbeats_per_sec": round(heartbeats / wall, 1)
+            if wall else 0.0,
+            "e2e_p50_ms": e2e["p50_ms"],
+            "e2e_p99_ms": e2e["p99_ms"],
+            "e2e_count": e2e["count"],
+            "tail": tail,
+            "flight_recorder": fr,
+            "slow_trees_captured": fr["captured"],
+            "latency": histograms.snapshot(),
+        }
+    finally:
+        stop.set()
+        for th in storm_threads:
+            th.join(timeout=2.0)
         if not was_enabled:
             telemetry.disable()
         server.shutdown()
@@ -483,6 +748,7 @@ def main() -> None:
         json.dump(decomp, f, indent=2)
         f.write("\n")
     top = list(decomp["stages"].items())[:4]
+    tail = decomp.get("tail", {})
     print(json.dumps({
         "metric": "trace_decomposition",
         "out": out_path,
@@ -491,6 +757,11 @@ def main() -> None:
         "attributed_share": decomp["attributed_share"],
         "top_stages": {k: v["per_eval_ms"] for k, v in top},
         "jit_cache_misses": decomp["kernel"]["JitCacheMisses"],
+        "e2e_p50_ms": tail.get("histogram", {}).get("p50_ms"),
+        "e2e_p99_ms": tail.get("histogram", {}).get("p99_ms"),
+        "tail_p50_coverage": tail.get("p50_coverage"),
+        "slow_evals_captured": tail.get(
+            "flight_recorder", {}).get("captured"),
     }))
 
 

@@ -694,6 +694,7 @@ class HTTPAgent:
         add("GET", r"/v1/operator/traces", self.operator_traces)
         add("PUT", r"/v1/operator/traces", self.operator_traces_put)
         add("POST", r"/v1/operator/traces", self.operator_traces_put)
+        add("GET", r"/v1/operator/slow-evals", self.operator_slow_evals)
         add("GET", r"/v1/operator/scheduler/configuration", self.sched_config_get)
         add("PUT", r"/v1/operator/scheduler/configuration", self.sched_config_put)
         add("POST", r"/v1/operator/scheduler/configuration", self.sched_config_put)
@@ -1405,7 +1406,24 @@ class HTTPAgent:
             limit = int(req.q("limit", "2000") or 2000)
         except ValueError:
             limit = 2000
-        return exporter.traces_json(limit=limit)
+        # ?trace_id= narrows the dump to one eval's span tree
+        # (Tracer.spans already filters; this is the HTTP plumbing)
+        return exporter.traces_json(limit=limit,
+                                    trace_id=req.q("trace_id", ""))
+
+    def operator_slow_evals(self, req: Request):
+        """Slow-eval flight recorder dump: complete span trees of the
+        evals that crossed the adaptive e2e-p99 threshold, plus the
+        streaming latency histogram summaries. Same ACL as the trace
+        dump (operator:read)."""
+        from nomad_tpu.telemetry import exporter
+
+        self._acl(req, "allow_operator_read")
+        try:
+            limit = int(req.q("limit", "0") or 0)
+        except ValueError:
+            limit = 0
+        return exporter.slow_evals_json(limit=limit)
 
     def operator_traces_put(self, req: Request):
         """Toggle tracing at runtime: {"Enable": true|false}, optional

@@ -38,6 +38,7 @@ from nomad_tpu.ops.kernel import (
     pad_steps,
     place_taskgroups_joint_jit,
 )
+from nomad_tpu.telemetry.histogram import histograms, percentile
 from nomad_tpu.telemetry.kernel_profile import profiler
 from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.tensors.device_state import default_device_state
@@ -300,6 +301,9 @@ class WaveStats:
         with self._lock:
             self.requests += 1
             self._park_s.append(seconds)
+        # the streaming histogram keeps the FULL distribution (the
+        # deque above is a bounded recent window for the gauges)
+        histograms.get("wave_park").record(seconds)
 
     def reset(self) -> None:
         with self._lock:
@@ -313,10 +317,11 @@ class WaveStats:
 
     def snapshot(self) -> dict:
         with self._lock:
-            park = sorted(self._park_s)
-            p50 = park[len(park) // 2] if park else 0.0
-            p99 = park[min(len(park) - 1, int(len(park) * 0.99))] \
-                if park else 0.0
+            # shared nearest-rank helper (telemetry/histogram.py): the
+            # old int(len*0.99) indexing returned the MAX of a
+            # 100-sample window as "p99"
+            p50 = percentile(self._park_s, 0.5)
+            p99 = percentile(self._park_s, 0.99)
             return {
                 "requests": self.requests,
                 "launches": self.launches,

@@ -106,6 +106,15 @@ class EvalBroker:
         self._delayed = DelayHeap()
         self._delay_thread: Optional[threading.Thread] = None
         self._delay_wake = threading.Event()
+        # broker-enqueue stamps on the MONOTONIC clock, keyed by eval
+        # id: the e2e latency origin (enqueue → plan commit/ack). A
+        # broker-LOCAL map, never a field on the Evaluation — the
+        # enqueued object is the state store's row and must stay
+        # immutable (the same discipline that makes workers copy
+        # before stamping snapshot_index). Set once per broker pass
+        # (nack redeliveries keep the ORIGINAL stamp so the histogram
+        # tail includes retry latency); dropped at ack/flush.
+        self._enqueue_stamps: Dict[str, float] = {}
         # auto-nack deadlines: (deadline, eval_id, token) entries for
         # the shared watcher; stale entries (acked, or reset to a later
         # deadline) are skipped against _unack at fire time
@@ -146,6 +155,7 @@ class EvalBroker:
             self._pending.clear()
             self._delivery.clear()
             self._requeue_on_ack.clear()
+            self._enqueue_stamps.clear()
             self._delayed = DelayHeap()
             self._nack_heap.clear()
             self._cond.notify_all()
@@ -183,6 +193,12 @@ class EvalBroker:
         self._enqueue_locked(ev, ev.type)
 
     def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        # e2e latency origin, stamped the moment the eval becomes
+        # RUNNABLE (so a WaitUntil eval's intentional delay never
+        # counts). setdefault = stamp-once. One clock read; runs
+        # whether or not tracing is enabled — the streaming
+        # histograms are always-on.
+        self._enqueue_stamps.setdefault(ev.id, time.monotonic())
         if queue == FAILED_QUEUE:
             # failed evals bypass per-job dedup entirely: the job may
             # legitimately have another live eval outstanding
@@ -291,6 +307,14 @@ class EvalBroker:
             un = self._unack.get(eval_id)
             return un.token if un is not None else None
 
+    def enqueue_stamp(self, eval_id: str) -> float:
+        """Monotonic broker-enqueue time of an eval still in the
+        broker's hands (0.0 = unknown). Workers read it BEFORE acking
+        — the ack drops the stamp — to record the e2e latency
+        histogram sample."""
+        with self._lock:
+            return self._enqueue_stamps.get(eval_id, 0.0)
+
     def outstanding_reset(self, eval_id: str, token: str) -> None:
         """Reset the nack deadline (worker heartbeat during long
         scheduling; eval_broker.go OutstandingReset). The old heap
@@ -318,6 +342,7 @@ class EvalBroker:
     def _ack_locked(self, eval_id: str) -> None:
         un = self._unack.pop(eval_id)
         self._delivery.pop(eval_id, None)
+        self._enqueue_stamps.pop(eval_id, None)
         ns_job = (un.eval.namespace, un.eval.job_id)
         if self._job_evals.get(ns_job) == eval_id:
             del self._job_evals[ns_job]
@@ -339,9 +364,15 @@ class EvalBroker:
             if un is None or un.token != token:
                 return
             count = self._delivery.get(eval_id, 0) + 1
+            stamp = self._enqueue_stamps.get(eval_id, 0.0)
             self._ack_locked(eval_id)   # clears delivery tracking too
             ev = un.eval
             self._delivery[eval_id] = count
+            if stamp:
+                # a nacked eval is NOT done: the redelivery keeps the
+                # original enqueue stamp so its eventual e2e sample
+                # includes the retry latency (the tail's honest shape)
+                self._enqueue_stamps[eval_id] = stamp
             if count >= self.delivery_limit:
                 # terminal: route to the failed queue for the leader's
                 # reapFailedEvaluations loop (leader.go:759)

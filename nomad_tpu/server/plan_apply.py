@@ -41,6 +41,7 @@ from nomad_tpu.structs.alloc import Allocation
 from nomad_tpu.structs.eval_plan import Plan, PlanResult
 from nomad_tpu.structs.resources import allocs_fit
 from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
+from nomad_tpu.telemetry.histogram import histograms
 from nomad_tpu.telemetry.trace import tracer
 
 
@@ -572,9 +573,11 @@ class Planner:
             if not batch:
                 continue
             now = time.monotonic()
+            plan_queue_hist = histograms.get("plan_queue")
             for pending in batch:
                 wait = now - pending.enqueued_at
                 self.stage_s["queue_wait"] += wait
+                plan_queue_hist.record(wait)
                 tracer.record("plan.queue_wait", wait,
                               trace_id=pending.plan.eval_id)
             t_eval = time.perf_counter()
@@ -600,7 +603,11 @@ class Planner:
                     token = overlay.add(result)
                     checker.note_result(result)
                     evaluated.append((pending, result, token))
-            self.stage_s["evaluate"] += time.perf_counter() - t_eval
+            eval_dur = time.perf_counter() - t_eval
+            self.stage_s["evaluate"] += eval_dur
+            # one sample per applier pass: the group evaluation latency
+            # every plan in the batch waited through
+            histograms.get("plan_evaluate").record(eval_dur)
             if not evaluated:
                 continue
             # serialize commits: wait for the previous apply before
@@ -626,7 +633,9 @@ class Planner:
             with tracer.span("plan.commit"):
                 index = self._commit_batch(
                     [(p.plan, r) for p, r, _ in evaluated])
-            self.stage_s["commit"] += time.perf_counter() - t0
+            commit_dur = time.perf_counter() - t0
+            self.stage_s["commit"] += commit_dur
+            histograms.get("plan_commit").record(commit_dur)
             for pending, result, token in evaluated:
                 result.alloc_index = index
                 if result.refresh_index > 0:

@@ -25,12 +25,14 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from nomad_tpu.scheduler.scheduler import SetStatusError, new_scheduler
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.eval_plan import Evaluation, Plan, PlanResult
-from nomad_tpu.telemetry.trace import tracer
+from nomad_tpu.telemetry.histogram import histograms
+from nomad_tpu.telemetry.trace import flight_recorder, tracer
 
 LOG = logging.getLogger(__name__)
 
@@ -321,6 +323,11 @@ class Worker:
     def _process(self, ev: Evaluation, token: str,
                  snapshot=None, launcher=None, cluster_provider=None,
                  plan_window=None) -> None:
+        eval_id = ev.id
+        # read the broker's enqueue stamp BEFORE processing: the ack
+        # inside the span below drops it (the stamp lives in a
+        # broker-local map, never on the store's immutable eval row)
+        t_enq = self.server.eval_broker.enqueue_stamp(eval_id)
         with self._live_lock:
             self._live[ev.id] = token
         try:
@@ -329,8 +336,11 @@ class Worker:
                     # SnapshotMinIndex: local raft must catch up to the
                     # eval before scheduling (worker.go:537)
                     wait_index = max(ev.modify_index, ev.snapshot_index)
+                    t_snap = time.monotonic()
                     with tracer.span("worker.snapshot"):
                         snapshot = self.server.snapshot_min_index(wait_index)
+                    histograms.get("snapshot_wait").record(
+                        time.monotonic() - t_snap)
                 # stamp the snapshot the scheduler runs against on a
                 # copy -- the store's row must stay immutable (worker.go
                 # updateEvalSnapshotIndex routes this through Raft);
@@ -350,6 +360,24 @@ class Worker:
                     sched = new_scheduler(ev.type, snapshot, run, **kw)
                 sched.process(ev)
                 self.server.eval_broker.ack(ev.id, token)
+            if t_enq:
+                # e2e latency: broker-enqueue → committed (the ack
+                # above follows the eval's final plan commit). The
+                # histogram is always-on (one log + one short lock);
+                # the e2e marker span and the slow-eval flight
+                # recorder ride only when tracing is enabled — the
+                # marker is what anchors this eval's critical-path
+                # waterfall, the recorder what captures its tree if
+                # it lands beyond the adaptive p99 threshold.
+                # Recorded BEFORE the processed bump: monitors settle
+                # on that counter, so the sample must already be in
+                # the histogram when the counter moves (the tail
+                # section's count-equality gate).
+                e2e_s = time.monotonic() - t_enq
+                histograms.get("e2e").record(e2e_s)
+                if tracer.enabled:
+                    tracer.record("eval.e2e", e2e_s, trace_id=eval_id)
+                    flight_recorder.observe(eval_id, e2e_s)
             with self._live_lock:
                 # += from up to MAX_WAVE concurrent eval threads is a
                 # read-modify-write race; monitors poll this counter
@@ -398,8 +426,11 @@ class Worker:
             max(ev.modify_index, ev.snapshot_index) for ev, _ in batch
         )
         try:
+            t_snap = time.monotonic()
             with tracer.span("worker.snapshot", trace_id=batch[0][0].id):
                 snapshot = self.server.snapshot_min_index(wait_index)
+            histograms.get("snapshot_wait").record(
+                time.monotonic() - t_snap)
         except Exception:                           # noqa: BLE001
             # snapshot catch-up failed for the whole batch: nack all
             for ev, token in batch:

@@ -17,16 +17,30 @@ from __future__ import annotations
 
 import os
 
+from nomad_tpu.telemetry.histogram import (  # noqa: F401
+    HistogramRegistry,
+    LatencyHistogram,
+    histograms,
+    percentile,
+)
 from nomad_tpu.telemetry.kernel_profile import (  # noqa: F401
     KernelProfiler,
     profiled_call,
     profiler,
 )
-from nomad_tpu.telemetry.trace import Span, Tracer, tracer  # noqa: F401
+from nomad_tpu.telemetry.trace import (  # noqa: F401
+    FlightRecorder,
+    Span,
+    Tracer,
+    flight_recorder,
+    tracer,
+)
 
 __all__ = [
     "Span", "Tracer", "tracer",
     "KernelProfiler", "profiler", "profiled_call",
+    "LatencyHistogram", "HistogramRegistry", "histograms", "percentile",
+    "FlightRecorder", "flight_recorder",
     "enable", "disable", "enabled", "reset",
 ]
 
@@ -48,6 +62,10 @@ def enabled() -> bool:
 def reset() -> None:
     tracer.reset()
     profiler.reset()
+    # latency histograms + the slow-eval flight recorder cover the
+    # same burst window as the tracer aggregates
+    histograms.reset()
+    flight_recorder.reset()
     try:
         # wave-shape stats (fill ratio, park latency) live with the
         # coalescer; reset them with the rest so burst decompositions

@@ -22,8 +22,9 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+from nomad_tpu.telemetry.histogram import histograms
 from nomad_tpu.telemetry.kernel_profile import profiler
-from nomad_tpu.telemetry.trace import tracer
+from nomad_tpu.telemetry.trace import flight_recorder, tracer
 from nomad_tpu.utils import metrics as _metrics
 
 
@@ -219,6 +220,29 @@ def prometheus_text(registry=None) -> str:
             f"{round(g['group_size_avg'], 4)}")
     except Exception:                           # noqa: BLE001
         pass                # plan applier unavailable: skip
+    # streaming latency histograms (telemetry/histogram.py): the real
+    # Prometheus histogram type — log-bucketed cumulative _bucket
+    # series per op (e2e eval latency, plan queue/evaluate/commit,
+    # wave park, snapshot wait), the distribution substrate behind the
+    # TRACE_DECOMP tail table and the flight recorder's threshold
+    hist_items = [(name, h) for name, h in histograms.items()
+                  if h.count > 0]
+    if hist_items:
+        lines.append("# TYPE nomad_tpu_latency_seconds histogram")
+        for name, h in hist_items:
+            lines.extend(h.prometheus_lines(
+                "nomad_tpu_latency_seconds", f'op="{_esc(name)}"'))
+    # slow-eval flight recorder health: captures say the tail is being
+    # recorded, threshold says where the adaptive p99 bar sits
+    fr = flight_recorder.snapshot()
+    lines.append(
+        "# TYPE nomad_tpu_slow_evals_captured_total counter")
+    lines.append(
+        f"nomad_tpu_slow_evals_captured_total {fr['captured']}")
+    lines.append("# TYPE nomad_tpu_slow_eval_threshold_seconds gauge")
+    lines.append(
+        f"nomad_tpu_slow_eval_threshold_seconds "
+        f"{fr['threshold_ms'] / 1e3:.6f}")
     lines.append(
         "# TYPE nomad_tpu_telemetry_enabled gauge")
     lines.append(
@@ -226,13 +250,16 @@ def prometheus_text(registry=None) -> str:
     return "\n".join(lines) + "\n"
 
 
-def traces_json(limit: int = 2000) -> Dict:
-    """The /v1/operator/traces body."""
-    spans = tracer.spans()
+def traces_json(limit: int = 2000, trace_id: str = "") -> Dict:
+    """The /v1/operator/traces body. ``trace_id`` narrows the span dump
+    to one eval's tree (the ``?trace_id=`` query param — the operator's
+    "show me THIS slow eval" handle; aggregates stay global)."""
+    spans = tracer.spans(trace_id=trace_id or None)
     if limit and len(spans) > limit:
         spans = spans[-limit:]
     return {
         "Enabled": tracer.enabled,
+        "TraceID": trace_id,
         "Spans": [s.to_api() for s in spans],
         "Stages": {
             name: {
@@ -243,4 +270,26 @@ def traces_json(limit: int = 2000) -> Dict:
             for name, agg in tracer.stage_totals().items()
         },
         "Kernel": profiler.summary(),
+    }
+
+
+def slow_evals_json(limit: int = 0) -> Dict:
+    """The /v1/operator/slow-evals body: the flight recorder's ring of
+    captured slow-eval span trees, newest last, plus its health
+    counters and the adaptive threshold."""
+    fr = flight_recorder.snapshot()
+    trees = flight_recorder.trees()
+    if limit and len(trees) > limit:
+        trees = trees[-limit:]
+    return {
+        "Enabled": tracer.enabled,
+        "Observed": fr["observed"],
+        "Captured": fr["captured"],
+        "Retained": fr["retained"],
+        "ThresholdMs": fr["threshold_ms"],
+        "Histogram": {
+            name: h.snapshot()
+            for name, h in histograms.items() if h.count > 0
+        },
+        "Trees": trees,
     }

@@ -39,7 +39,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Span", "Tracer", "tracer"]
+__all__ = ["Span", "Tracer", "tracer", "FlightRecorder", "flight_recorder"]
 
 _ids = itertools.count(1)
 
@@ -362,6 +362,19 @@ class Tracer:
             out = [s for s in out if s.trace_id == trace_id]
         return out
 
+    def recent_spans(self, trace_id: str, scan: int = 2048) -> List[Span]:
+        """Spans of one trace among the newest ``scan`` ring entries,
+        oldest first. Hot-path-safe companion to ``spans``: the copy
+        under the lock is bounded by ``scan`` (reversed-deque steps are
+        O(1)), so a caller on an eval thread — the flight recorder
+        capturing a just-finished slow eval, whose spans are by
+        definition the newest — never stalls concurrent recording
+        behind a full 16k-entry ring copy."""
+        with self._lock:
+            newest = list(itertools.islice(reversed(self._ring), scan))
+        newest.reverse()
+        return [s for s in newest if s.trace_id == trace_id]
+
     def stage_totals(self) -> Dict[str, Dict[str, float]]:
         """Per-name aggregates since enable/reset: full-fidelity even
         after the ring wraps."""
@@ -375,3 +388,155 @@ class Tracer:
 
 #: process-wide tracer, analogous to utils.metrics.global_registry
 tracer = Tracer()
+
+
+class FlightRecorder:
+    """Slow-eval flight recorder: a bounded ring of COMPLETE span trees
+    for evals whose e2e latency crossed an adaptive threshold.
+
+    Aggregates (TRACE_DECOMP, histograms) say *how much* tail there is;
+    a tail investigation needs the span tree of an actual slow eval —
+    which, at p99, has usually already fallen off the span ring by the
+    time anyone looks. The recorder captures trees at completion time
+    (the Canopy pattern: always-on, sampled by slowness), so
+    ``GET /v1/operator/slow-evals`` can serve "the last N slow evals,
+    fully decomposed" from a live server.
+
+    Threshold adaptation: an EWMA of the e2e histogram's p99. Tracking
+    p99 (rather than a fixed cutoff) keeps the capture rate near the
+    top ~1% whatever the workload's absolute speed — a fixed cutoff
+    either floods the ring on a slow box or never fires on a fast one.
+    The EWMA smooths the estimate so one captured outlier doesn't
+    instantly raise the bar past its successors. Disarmed until
+    ``MIN_SAMPLES`` observations exist (an empty distribution has no
+    tail to speak of).
+
+    Memory is doubly bounded: at most ``capacity`` trees, each at most
+    ``MAX_SPANS_PER_TREE`` spans. Capture cost is bounded too — the
+    recorder runs ON the eval threads it measures, so it must not
+    become the tail it records: captures are rate-limited to one per
+    ``min_capture_interval_s`` (the ring only keeps the newest trees
+    anyway — capturing every tail eval of a burst would overwrite
+    itself while charging the burst for the serialization), the ring
+    scan is bounded (``Tracer.recent_spans``), and captured trees hold
+    raw Span references — the API-dict conversion happens at serve
+    time, not on the hot path.
+    """
+
+    #: trees retained (newest win)
+    CAPACITY = 32
+    #: per-tree span cap (a runaway instrumented loop must not make
+    #: one tree unbounded)
+    MAX_SPANS_PER_TREE = 256
+    #: observations before the threshold arms
+    MIN_SAMPLES = 32
+    #: EWMA smoothing for the p99 estimate
+    ALPHA = 0.25
+    #: p99 re-estimation cadence (bucket walks are cheap but not free)
+    REFRESH_EVERY = 16
+    #: capture rate limit (seconds between captures)
+    MIN_CAPTURE_INTERVAL_S = 0.05
+
+    def __init__(self, capacity: int = CAPACITY) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._threshold_s: Optional[float] = None
+        self._observed = 0
+        self._last_capture_mono = 0.0
+        self.min_capture_interval_s = self.MIN_CAPTURE_INTERVAL_S
+        self.captured = 0
+
+    def observe(self, trace_id: str, e2e_s: float) -> bool:
+        """Called once per committed eval with its e2e latency; captures
+        the eval's span tree when it lands beyond the adaptive
+        threshold. Returns True when a tree was captured."""
+        from nomad_tpu.telemetry.histogram import histograms
+
+        with self._lock:
+            self._observed += 1
+            refresh = (self._threshold_s is None
+                       or self._observed % self.REFRESH_EVERY == 0)
+            armed = self._observed >= self.MIN_SAMPLES
+        if refresh:
+            p99 = histograms.get("e2e").quantile(0.99)
+            if p99 > 0.0:
+                with self._lock:
+                    if self._threshold_s is None:
+                        self._threshold_s = p99
+                    else:
+                        self._threshold_s += self.ALPHA * (
+                            p99 - self._threshold_s)
+        with self._lock:
+            thr = self._threshold_s
+        if not armed or thr is None or e2e_s < thr:
+            return False
+        if not tracer.enabled or not trace_id:
+            return False
+        with self._lock:
+            if time.monotonic() - self._last_capture_mono \
+                    < self.min_capture_interval_s:
+                return False
+        # bounded scan of the NEWEST ring entries: the slow eval just
+        # finished, so its tree is at the ring's tail — a full-ring
+        # copy under the tracer lock would stall every concurrent
+        # span-recording thread (an observer effect in the very
+        # instrument that measures tail latency)
+        spans = tracer.recent_spans(trace_id)
+        if not spans:
+            return False
+        tree = {
+            "TraceID": trace_id,
+            "E2eMs": round(e2e_s * 1e3, 3),
+            "ThresholdMs": round(thr * 1e3, 3),
+            "CapturedAtS": round(time.time(), 3),
+            # raw Span refs; to_api conversion deferred to trees()
+            "_spans": spans[:self.MAX_SPANS_PER_TREE],
+        }
+        with self._lock:
+            # the interval is re-checked at append: a racing capture
+            # may have landed while this one scanned (both are valid
+            # trees; the limit is a cost bound, not a semantic one)
+            if time.monotonic() - self._last_capture_mono \
+                    < self.min_capture_interval_s:
+                return False
+            self._last_capture_mono = time.monotonic()
+            self._ring.append(tree)
+            self.captured += 1
+        return True
+
+    def threshold_s(self) -> Optional[float]:
+        with self._lock:
+            return self._threshold_s
+
+    def trees(self) -> List[Dict]:
+        """Captured trees in API shape (span dicts rendered here, at
+        serve time — never on the eval thread that captured)."""
+        with self._lock:
+            raw = list(self._ring)
+        return [
+            {**{k: v for k, v in t.items() if k != "_spans"},
+             "Spans": [s.to_api() for s in t["_spans"]]}
+            for t in raw
+        ]
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "observed": self._observed,
+                "captured": self.captured,
+                "retained": len(self._ring),
+                "threshold_ms": round((self._threshold_s or 0.0) * 1e3,
+                                      3),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._threshold_s = None
+            self._observed = 0
+            self._last_capture_mono = 0.0
+            self.captured = 0
+
+
+#: process-wide slow-eval recorder; reset via telemetry.reset()
+flight_recorder = FlightRecorder()

@@ -1,0 +1,480 @@
+"""Tail-latency observability (ISSUE 8): streaming histograms,
+per-eval critical-path waterfalls, the slow-eval flight recorder, and
+the span-name drift guard.
+
+Covers the acceptance surface directly:
+- histogram quantile estimates vs numpy.percentile within the bucket
+  relative-error bound; merge associativity; concurrent-record thread
+  safety; bounded memory
+- the shared nearest-rank ``percentile`` helper (the unified p50/p99
+  math — including the ``int(len*0.99)`` off-by-one it fixes)
+- flight recorder: bounded ring, adaptive (EWMA-of-p99) threshold,
+  no captures when tracing is disabled
+- waterfall reduction: segment claims, applier-envelope overlap,
+  coverage accounting, p50-vs-p99 aggregation
+- the drift guard: every literal span name the instrumented code
+  emits appears in docs/TELEMETRY.md's span table, and vice versa
+"""
+
+import math
+import os
+import random
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from nomad_tpu import telemetry
+from nomad_tpu.telemetry.histogram import (
+    BOUNDS,
+    GROWTH,
+    N_BUCKETS,
+    LatencyHistogram,
+    histograms,
+    percentile,
+)
+from nomad_tpu.telemetry.trace import FlightRecorder, Span, tracer
+from nomad_tpu.telemetry.waterfall import (
+    aggregate_tail,
+    build_waterfall,
+    build_waterfalls,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def clean_telemetry():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+class TestPercentile:
+    def test_nearest_rank_semantics(self):
+        vals = list(range(1, 101))           # 1..100
+        random.Random(3).shuffle(vals)
+        assert percentile(vals, 0.5) == 50
+        # the off-by-one the shared helper fixes: int(100*0.99) == 99
+        # indexed the MAX; nearest-rank p99 of 1..100 is the 99th value
+        assert percentile(vals, 0.99) == 99
+        assert percentile(vals, 1.0) == 100
+        assert percentile(vals, 0.0) == 1
+        assert percentile(vals, 0.01) == 1
+
+    def test_empty_and_single(self):
+        assert percentile([], 0.5) == 0.0
+        assert percentile([7.0], 0.99) == 7.0
+
+    def test_matches_numpy_nearest_on_random_samples(self):
+        rng = random.Random(11)
+        for n in (3, 10, 97, 500):
+            vals = [rng.lognormvariate(0, 1) for _ in range(n)]
+            for q in (0.1, 0.5, 0.9, 0.99):
+                exact = percentile(vals, q)
+                lo = float(np.percentile(vals, q * 100, method="lower"))
+                hi = float(np.percentile(vals, q * 100,
+                                         method="higher"))
+                assert lo <= exact <= hi
+
+
+class TestHistogram:
+    def test_quantiles_within_bucket_error_bound_vs_numpy(self):
+        """Property: estimates land within the bucket geometry's
+        relative-error bound of numpy.percentile, across shapes."""
+        rng = random.Random(1234)
+        cases = [
+            [rng.lognormvariate(-4, 1.2) for _ in range(4000)],
+            [rng.uniform(1e-4, 2.0) for _ in range(3000)],
+            [rng.expovariate(10.0) + 1e-5 for _ in range(2500)],
+        ]
+        for vals in cases:
+            h = LatencyHistogram("t")
+            for v in vals:
+                h.record(v)
+            for q in (0.5, 0.9, 0.99):
+                est = h.quantile(q)
+                ref = float(np.percentile(vals, q * 100))
+                # bucket midpoint error ≤ sqrt(G)-1; allow the full
+                # bucket width for rank-definition differences
+                assert abs(est - ref) / ref <= GROWTH - 1.0, \
+                    (q, est, ref)
+
+    def test_exact_error_bound_vs_nearest_rank(self):
+        """Against the histogram's own rank definition the bound is
+        the tight one: sqrt(GROWTH) - 1."""
+        rng = random.Random(7)
+        vals = [rng.lognormvariate(-3, 1.5) for _ in range(5000)]
+        h = LatencyHistogram("t")
+        for v in vals:
+            h.record(v)
+        for q in (0.25, 0.5, 0.75, 0.9, 0.99):
+            est = h.quantile(q)
+            exact = percentile(vals, q)
+            assert abs(est - exact) / exact \
+                <= math.sqrt(GROWTH) - 1.0 + 1e-9, (q, est, exact)
+
+    def test_merge_is_associative_and_commutative(self):
+        rng = random.Random(5)
+        parts = []
+        for _ in range(3):
+            h = LatencyHistogram("p")
+            for _ in range(500):
+                h.record(rng.expovariate(100.0))
+            parts.append(h)
+
+        def fold(order):
+            acc = LatencyHistogram("acc")
+            for i in order:
+                acc.merge(parts[i])
+            return acc
+
+        a = fold([0, 1, 2])
+        b = fold([2, 0, 1])
+        c = fold([1, 2, 0])
+        assert a._counts == b._counts == c._counts
+        assert a.count == b.count == c.count == 1500
+        assert abs(a.sum_s - b.sum_s) < 1e-9
+        assert a.quantile(0.99) == b.quantile(0.99) == c.quantile(0.99)
+
+    def test_concurrent_record_is_thread_safe(self):
+        h = LatencyHistogram("c")
+        n_threads, per_thread = 8, 5000
+
+        def work(k):
+            rng = random.Random(k)
+            for _ in range(per_thread):
+                h.record(rng.uniform(1e-4, 1e-1))
+
+        threads = [threading.Thread(target=work, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.count == n_threads * per_thread
+        assert sum(h._counts) == n_threads * per_thread
+
+    def test_bounded_memory_and_overflow(self):
+        h = LatencyHistogram("b")
+        for v in (0.0, 1e-9, 1e-6, 1.0, 1e5, 1e9):
+            h.record(v)
+        assert len(h._counts) == N_BUCKETS + 1
+        # extremes land in the edge buckets, never grow the table
+        assert h._counts[0] >= 3          # 0, 1e-9, 1e-6
+        assert h._counts[N_BUCKETS] >= 1  # 1e9 overflow
+        assert h.quantile(1.0) == 1e9     # overflow reports the max
+
+    def test_prometheus_lines_shape(self):
+        h = LatencyHistogram("e")
+        for v in (0.001, 0.002, 0.004, 0.5):
+            h.record(v)
+        lines = h.prometheus_lines("m", 'op="x"')
+        assert lines[-1] == 'm_count{op="x"} 4'
+        assert lines[-2].startswith('m_sum{op="x"} 0.507')
+        assert lines[-3] == 'm_bucket{op="x",le="+Inf"} 4'
+        # cumulative counts are non-decreasing
+        cums = [int(ln.rsplit(" ", 1)[1]) for ln in lines[:-2]]
+        assert cums == sorted(cums)
+        # le bounds parse and are increasing
+        les = [float(re.search(r'le="([^"]+)"', ln).group(1))
+               for ln in lines[:-3]]
+        assert les == sorted(les)
+        assert all(le in [round(b, 12) or b for b in BOUNDS] or True
+                   for le in les)
+
+    def test_registry_get_reset(self):
+        telemetry.reset()
+        h = histograms.get("unit_reg")
+        h.record(0.5)
+        assert histograms.get("unit_reg") is h
+        assert histograms.snapshot()["unit_reg"]["count"] == 1
+        telemetry.reset()                 # telemetry.reset clears it
+        assert h.count == 0
+
+
+class TestFlightRecorder:
+    def _feed(self, fr, e2e_hist, value, trace_id="t"):
+        e2e_hist.record(value)
+        return fr.observe(trace_id, value)
+
+    def test_bounded_ring_and_span_cap(self, clean_telemetry):
+        fr = FlightRecorder(capacity=4)
+        fr.min_capture_interval_s = 0.0   # rapid-fire in-test captures
+        e2e = histograms.get("e2e")
+        # arm: uniform fast traffic
+        for i in range(fr.MIN_SAMPLES):
+            self._feed(fr, e2e, 0.010, f"warm-{i}")
+        # slow evals with real span trees
+        captured = 0
+        for i in range(12):
+            tid = f"slow-{i}"
+            with tracer.span("eval.schedule", trace_id=tid):
+                pass
+            if self._feed(fr, e2e, 1.0 + i, tid):
+                captured += 1
+        assert captured >= 1
+        assert fr.captured == captured
+        trees = fr.trees()
+        assert len(trees) <= 4            # ring bound
+        for tree in trees:
+            assert tree["Spans"]
+            assert len(tree["Spans"]) <= fr.MAX_SPANS_PER_TREE
+            assert tree["E2eMs"] >= tree["ThresholdMs"]
+
+    def test_threshold_tracks_p99_ewma(self, clean_telemetry):
+        fr = FlightRecorder()
+        e2e = histograms.get("e2e")
+        for i in range(64):
+            self._feed(fr, e2e, 0.010, f"a-{i}")
+        thr_fast = fr.threshold_s()
+        assert thr_fast is not None
+        # ~10ms p99 (within bucket error)
+        assert 0.005 <= thr_fast <= 0.02
+        # the workload slows 20x: the EWMA follows the new p99 up
+        for i in range(400):
+            self._feed(fr, e2e, 0.200, f"b-{i}")
+        assert fr.threshold_s() > thr_fast * 2
+
+    def test_no_capture_when_disarmed_or_disabled(self, clean_telemetry):
+        fr = FlightRecorder()
+        e2e = histograms.get("e2e")
+        # disarmed: below MIN_SAMPLES nothing captures, however slow
+        assert not self._feed(fr, e2e, 10.0, "early")
+        telemetry.disable()
+        for i in range(fr.MIN_SAMPLES + 8):
+            self._feed(fr, e2e, 0.01, f"w-{i}")
+        # tracing off: no span trees exist, observe must not capture
+        assert not self._feed(fr, e2e, 50.0, "slow-no-trace")
+        assert fr.captured == 0
+
+    def test_capture_rate_limit(self, clean_telemetry):
+        """Captures are throttled: the recorder runs on the eval
+        threads it measures and must not become the tail it records
+        (burst of threshold-crossers -> one capture per interval)."""
+        fr = FlightRecorder()
+        fr.min_capture_interval_s = 10.0
+        e2e = histograms.get("e2e")
+        for i in range(fr.MIN_SAMPLES):
+            self._feed(fr, e2e, 0.01, f"w-{i}")
+        for i in range(8):
+            tid = f"s-{i}"
+            with tracer.span("eval.schedule", trace_id=tid):
+                pass
+            self._feed(fr, e2e, 2.0 + i, tid)
+        assert fr.captured == 1
+
+    def test_reset_clears_everything(self, clean_telemetry):
+        fr = FlightRecorder()
+        e2e = histograms.get("e2e")
+        for i in range(fr.MIN_SAMPLES + 4):
+            self._feed(fr, e2e, 0.01, f"x-{i}")
+        assert fr.snapshot()["observed"] > 0
+        fr.reset()
+        snap = fr.snapshot()
+        assert snap == {"observed": 0, "captured": 0, "retained": 0,
+                        "threshold_ms": 0.0}
+
+
+def _span(name, trace_id, start, dur, span_id=0, parent=0):
+    return Span(name, trace_id, span_id, parent, start, dur,
+                0.0, 0.0, 0.0, "t")
+
+
+class TestWaterfall:
+    def _spans(self, tid="ev1", base=0.0):
+        return [
+            _span("eval.e2e", tid, base + 0.000, 0.100),
+            _span("eval.schedule", tid, base + 0.010, 0.080),
+            _span("wave.park", tid, base + 0.020, 0.030),
+            _span("wave.launch", tid, base + 0.050, 0.020),
+            _span("plan.wait", tid, base + 0.070, 0.020),
+            _span("plan.queue_wait", tid, base + 0.070, 0.004),
+        ]
+
+    def _globals(self, base=0.0):
+        return [
+            _span("plan.evaluate", "", base + 0.074, 0.006),
+            _span("plan.commit", "", base + 0.080, 0.008),
+            _span("fsm.apply", "", base + 0.082, 0.004),
+        ]
+
+    def test_segment_claims(self):
+        wf = build_waterfall(self._spans(), self._globals())
+        assert wf is not None
+        segs = wf["segments"]
+        approx = lambda a, b: abs(a - b) < 1e-9     # noqa: E731
+        assert approx(wf["e2e_s"], 0.100)
+        assert approx(segs["dequeue-wait"], 0.010)
+        # schedule = envelope minus park/launch/plan-wait-window claims
+        assert approx(segs["schedule"], 0.010)
+        assert approx(segs["park"], 0.030)
+        assert approx(segs["launch"], 0.020)
+        assert approx(segs["plan-queue"], 0.004)
+        assert approx(segs["evaluate"], 0.006)
+        # fsm claims inside the commit envelope first
+        assert approx(segs["fsm"], 0.004)
+        assert approx(segs["commit"], 0.004)
+        # plan.wait residue after queue/evaluate/commit/fsm claims
+        assert approx(segs["plan-wait"], 0.002)
+        # 0.090..0.100 (after schedule, before commit stamp) unclaimed
+        assert approx(segs["other"], 0.010)
+        assert approx(wf["covered_s"], 0.090)
+        assert approx(wf["coverage"], 0.90)
+        # claims partition the window: segments sum to e2e exactly
+        assert approx(sum(segs.values()), wf["e2e_s"])
+
+    def test_applier_envelopes_only_claim_inside_plan_wait(self):
+        # a commit from ANOTHER batch, outside this eval's plan.wait
+        # window, must not be attributed to this eval
+        glob = self._globals() + [_span("plan.commit", "", 0.010, 0.030)]
+        wf = build_waterfall(self._spans(), glob)
+        assert abs(wf["segments"]["commit"] - 0.004) < 1e-9
+
+    def test_missing_e2e_marker_returns_none(self):
+        spans = [s for s in self._spans() if s.name != "eval.e2e"]
+        assert build_waterfall(spans, self._globals()) is None
+
+    def test_build_waterfalls_groups_by_trace(self):
+        spans = (self._spans("a", 0.0) + self._spans("b", 1.0)
+                 + self._globals(0.0) + self._globals(1.0))
+        wfs = build_waterfalls(spans)
+        assert {w["trace_id"] for w in wfs} == {"a", "b"}
+
+    def test_aggregate_tail_p50_vs_p99(self):
+        rng = random.Random(2)
+        wfs = []
+        # 99 fast evals dominated by schedule, 1 slow eval dominated
+        # by dequeue-wait: the tail table must show dequeue-wait's
+        # share GROWING at p99 — the "what makes the tail slow" signal
+        for i in range(99):
+            e2e = 0.010 + rng.uniform(0, 0.002)
+            wfs.append({
+                "trace_id": f"f{i}", "e2e_s": e2e,
+                "segments": {"schedule": e2e * 0.7, "park": e2e * 0.3},
+                "covered_s": e2e, "coverage": 1.0,
+            })
+        wfs.append({
+            "trace_id": "slow", "e2e_s": 0.5,
+            "segments": {"dequeue-wait": 0.45, "schedule": 0.05},
+            "covered_s": 0.5, "coverage": 1.0,
+        })
+        tail = aggregate_tail(wfs)
+        assert tail["e2e_count"] == 100
+        assert tail["p50_coverage"] >= 0.99
+        segs = tail["segments"]
+        assert segs["schedule"]["p50_share"] > 0.6
+        assert segs["dequeue-wait"]["p99_share"] > 0.8
+        assert segs["dequeue-wait"].get("p50_share", 0.0) < 0.05
+        # nearest-rank p99 of 100 samples is the 99th value (a fast
+        # eval) — NOT the max, which is exactly the off-by-one the
+        # shared helper exists to fix
+        assert 10.0 <= tail["e2e_p99_ms"] <= 13.0
+        assert tail["slowest"][0]["trace_id"] == "slow"
+        assert tail["slowest"][0]["e2e_ms"] == 500.0
+
+    def test_aggregate_tail_empty(self):
+        tail = aggregate_tail([])
+        assert tail["e2e_count"] == 0
+        assert tail["segments"] == {}
+
+
+@pytest.mark.slow
+class TestContentionCell:
+    """The open-item-4 standing gate cell, scaled down: sustained eval
+    ingest under a heartbeat storm must report the e2e distribution
+    and capture at least one slow-eval tree. Excluded from tier-1
+    (slow); bench.py runs the full-size cell."""
+
+    def test_contention_burst_emits_tail_and_captures(self):
+        import sys
+        sys.path.insert(0, os.path.join(REPO, "bench"))
+        from trace_report import run_contention_burst
+
+        cell = None
+        for _attempt in range(2):       # one retry for CI-neighbor luck
+            cell = run_contention_burst(
+                n_nodes=60, n_jobs=64, allocs_per_job=3, batch_size=8,
+                warmup_jobs=8, heartbeat_threads=4, submit_group=4,
+                submit_pace_s=0.05, spike_s=1.0, deadline_s=120.0)
+            if cell["slow_trees_captured"] >= 1 \
+                    and cell["allocs_placed"] == cell["allocs_wanted"]:
+                break
+        assert cell["allocs_placed"] == cell["allocs_wanted"]
+        assert cell["e2e_p99_ms"] >= cell["e2e_p50_ms"] > 0.0
+        assert cell["e2e_count"] == cell["committed_evals"]
+        assert cell["heartbeats"] > 0
+        # the acceptance criterion: the cell captures >= 1 complete
+        # slow-eval span tree through the adaptive threshold
+        assert cell["slow_trees_captured"] >= 1, cell["flight_recorder"]
+        assert cell["tail"]["p50_coverage"] >= 0.85, cell["tail"]
+
+
+class TestSpanNameDriftGuard:
+    """Instrumentation and docs cannot silently diverge: every literal
+    span name emitted under nomad_tpu/ must appear in
+    docs/TELEMETRY.md's span table, and every documented span must
+    still exist in code. ``bg.*`` loop spans are named dynamically
+    after their loop functions and are covered as a prefix."""
+
+    #: the only dynamic span-name sites allowed, and what they expand
+    #: to (a new f-string site must either be added here with its
+    #: value set, or use a literal)
+    DYNAMIC = {
+        "kernel.{stage}": ("kernel.compile", "kernel.dispatch"),
+    }
+
+    def _emitted_names(self):
+        pat = re.compile(
+            r'tracer\.(?:span|record)\(\s*f?"([a-z0-9_.{}]+)"')
+        names = set()
+        src_root = os.path.join(REPO, "nomad_tpu")
+        for dirpath, _dirs, files in os.walk(src_root):
+            if "__pycache__" in dirpath:
+                continue
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                with open(os.path.join(dirpath, fn)) as f:
+                    for m in pat.finditer(f.read()):
+                        names.add(m.group(1))
+        expanded = set()
+        for name in names:
+            if "{" in name:
+                assert name in self.DYNAMIC, (
+                    f"dynamic span name {name!r} is not registered in "
+                    "TestSpanNameDriftGuard.DYNAMIC — register its "
+                    "expansion or use a literal")
+                expanded.update(self.DYNAMIC[name])
+            elif not name.startswith("bg."):
+                expanded.add(name)
+        return expanded
+
+    def _documented_names(self):
+        doc = open(os.path.join(REPO, "docs", "TELEMETRY.md")).read()
+        section = doc.split("## Instrumented spans", 1)[1]
+        block = section.split("```", 2)[1]
+        names = set()
+        for line in block.splitlines():
+            tok = line.strip().split(" ", 1)[0]
+            if re.fullmatch(r"[a-z][a-z0-9_]*\.[a-z0-9_.]+", tok):
+                names.add(tok)
+        return names
+
+    def test_emitted_and_documented_span_names_agree(self):
+        emitted = self._emitted_names()
+        documented = self._documented_names()
+        # sanity: the scan actually found the hot path
+        assert "eval.schedule" in emitted
+        assert "eval.e2e" in emitted
+        undocumented = emitted - documented
+        assert not undocumented, (
+            f"spans emitted but missing from docs/TELEMETRY.md's "
+            f"span table: {sorted(undocumented)}")
+        stale = documented - emitted
+        assert not stale, (
+            f"spans documented in docs/TELEMETRY.md but no longer "
+            f"emitted: {sorted(stale)}")

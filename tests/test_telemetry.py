@@ -235,6 +235,32 @@ class TestExposition:
         assert "nomad_tpu_plan_group_rejects_total" in text
         assert "nomad_tpu_plan_group_bytes_total" in text
 
+    def test_prometheus_latency_histograms(self, clean_telemetry):
+        """ISSUE 8: streaming latency histograms export as the real
+        Prometheus histogram type — cumulative _bucket/_sum/_count."""
+        from nomad_tpu.telemetry.histogram import histograms
+
+        for v in (0.002, 0.004, 0.050):
+            histograms.get("e2e").record(v)
+        histograms.get("wave_park").record(0.001)
+        text = prometheus_text()
+        assert "# TYPE nomad_tpu_latency_seconds histogram" in text
+        assert 'nomad_tpu_latency_seconds_bucket{op="e2e",le="' in text
+        assert 'nomad_tpu_latency_seconds_bucket{op="e2e",le="+Inf"} 3' \
+            in text
+        assert 'nomad_tpu_latency_seconds_count{op="e2e"} 3' in text
+        assert 'nomad_tpu_latency_seconds_sum{op="e2e"} 0.056' in text
+        assert 'nomad_tpu_latency_seconds_count{op="wave_park"} 1' \
+            in text
+        # flight-recorder health series ride along
+        assert "nomad_tpu_slow_evals_captured_total" in text
+        assert "nomad_tpu_slow_eval_threshold_seconds" in text
+        # cumulative bucket counts are non-decreasing per op
+        cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+                if line.startswith(
+                    'nomad_tpu_latency_seconds_bucket{op="e2e"')]
+        assert cums == sorted(cums)
+
     def test_traces_json_shape(self, clean_telemetry):
         with tracer.span("a", trace_id="t"):
             pass
@@ -293,6 +319,52 @@ class TestHTTPEndpoints:
         assert data["Enabled"] is True
         assert any(s["Name"] == "op.span" for s in data["Spans"])
 
+    def test_operator_traces_trace_id_filter(self, agent,
+                                             clean_telemetry):
+        """?trace_id= narrows the span dump to one eval's tree
+        (Tracer.spans already filters; this is the HTTP plumbing)."""
+        with tracer.span("filter.a", trace_id="trace-a"):
+            pass
+        with tracer.span("filter.b", trace_id="trace-b"):
+            pass
+        status, _, body = _get(
+            agent.http.addr, "/v1/operator/traces?trace_id=trace-a")
+        assert status == 200
+        data = json.loads(body)
+        assert data["TraceID"] == "trace-a"
+        assert data["Spans"]
+        assert all(s["TraceID"] == "trace-a" for s in data["Spans"])
+        assert not any(s["Name"] == "filter.b" for s in data["Spans"])
+
+    def test_operator_slow_evals_roundtrip(self, agent,
+                                           clean_telemetry):
+        """GET /v1/operator/slow-evals serves the flight recorder's
+        captured trees + threshold + histogram summaries."""
+        from nomad_tpu.telemetry.histogram import histograms
+        from nomad_tpu.telemetry.trace import flight_recorder
+
+        e2e = histograms.get("e2e")
+        for i in range(flight_recorder.MIN_SAMPLES):
+            e2e.record(0.01)
+            flight_recorder.observe(f"fast-{i}", 0.01)
+        with tracer.span("eval.schedule", trace_id="slow-1"):
+            pass
+        e2e.record(5.0)
+        assert flight_recorder.observe("slow-1", 5.0)
+        status, _, body = _get(agent.http.addr,
+                               "/v1/operator/slow-evals")
+        assert status == 200
+        data = json.loads(body)
+        assert data["Captured"] >= 1
+        assert data["ThresholdMs"] > 0
+        assert data["Trees"]
+        tree = data["Trees"][-1]
+        assert tree["TraceID"] == "slow-1"
+        assert any(s["Name"] == "eval.schedule"
+                   for s in tree["Spans"])
+        assert data["Histogram"]["e2e"]["count"] == \
+            flight_recorder.MIN_SAMPLES + 1
+
 
 class TestTracesACL:
     """/v1/operator/traces is gated like the event stream: a token
@@ -326,9 +398,19 @@ class TestTracesACL:
     def test_anonymous_and_weak_tokens_rejected(self, acl_agent):
         agent, _mgmt, weak = acl_agent
         for token in ("", weak):
-            with pytest.raises(urllib.error.HTTPError) as ei:
-                _get(agent.http.addr, "/v1/operator/traces", token=token)
-            assert ei.value.code == 403
+            for path in ("/v1/operator/traces",
+                         "/v1/operator/slow-evals"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(agent.http.addr, path, token=token)
+                assert ei.value.code == 403
+
+    def test_management_token_reads_slow_evals(self, acl_agent):
+        agent, mgmt, _weak = acl_agent
+        status, _, body = _get(agent.http.addr,
+                               "/v1/operator/slow-evals", token=mgmt)
+        assert status == 200
+        data = json.loads(body)
+        assert "Trees" in data and "ThresholdMs" in data
 
     def test_management_token_allowed_and_can_toggle(self, acl_agent):
         agent, mgmt, weak = acl_agent
@@ -383,11 +465,20 @@ class TestTraceDecomposition:
                 / max(d["wall_s"], 1e-9)
 
         for _attempt in range(2):
+            # 300 jobs x 3 allocs (not 100 x 5): the share gates divide
+            # NAMED work by burst wall/CPU, and on a fast box a
+            # 100-eval burst is over in ~0.15s — fixed per-burst
+            # overheads (thread wakeups, GC, monitor) then eat >10% of
+            # the denominator and the gate measures the box, not the
+            # instrumentation. Tripling the eval count at comparable
+            # total allocs (900, still inside the 300-node capacity —
+            # 5 allocs/job at 300 jobs saturates it and blocks evals)
+            # amortizes those fixed costs to noise level.
             proc = subprocess.run(
                 [sys.executable, os.path.join(repo, "bench",
                                               "trace_report.py"),
-                 str(out), "--nodes", "300", "--jobs", "100",
-                 "--allocs-per-job", "5", "--batch", "32",
+                 str(out), "--nodes", "300", "--jobs", "300",
+                 "--allocs-per-job", "3", "--batch", "32",
                  "--warmup-jobs", "16", "--bursts", "2"],
                 capture_output=True, timeout=360,
                 env={**os.environ, "JAX_PLATFORMS": "cpu"},
@@ -399,10 +490,16 @@ class TestTraceDecomposition:
                 decomp["stages"].get(s, {}).get("per_eval_ms", 0.0)
                 for s in ("sched-host", "sched-feasibility",
                           "sched-assembly", "sched-planbuild")) <= 3.0)
+            tail = decomp.get("tail", {})
+            tail_ok = (
+                tail.get("histogram", {}).get("count")
+                == tail.get("committed_evals")
+                and tail.get("p50_coverage", 0.0) >= 0.90)
             if raw_share(decomp) >= 0.9 \
                     and ss["jit_cache_misses"] == 0 \
                     and decomp["allocs_placed"] == decomp["allocs_wanted"] \
                     and sched_ok \
+                    and tail_ok \
                     and (ss["h2d_share"] <= 0.10 or ss["h2d_bytes"]
                          <= 50_000 * decomp["n_evals"]):
                 break
@@ -490,6 +587,33 @@ class TestTraceDecomposition:
         # serialized applier would pin this at exactly 1.0 (tolerate
         # a trickle-paced burst, but the counter must exist and move)
         assert decomp.get("plan_group", {}).get("commit_batches", 0) > 0
+        # ISSUE 8 tail gates: the tail section exists; every committed
+        # eval of the burst landed in the e2e histogram (count
+        # equality — no eval escapes the distribution); and the named
+        # waterfall segments explain >= 90% of the median cohort's
+        # e2e latency (dequeue-wait/snapshot/schedule/park/launch/
+        # plan-queue/evaluate/commit/fsm — "other" never counts
+        # toward coverage)
+        tail = decomp["tail"]
+        assert tail["committed_evals"] > 0
+        assert tail["histogram"]["count"] == tail["committed_evals"], \
+            (tail["histogram"], tail["committed_evals"])
+        assert not tail["ring_wrapped"]
+        # every committed eval also produced a waterfall (the e2e
+        # marker span anchors it)
+        assert tail["e2e_count"] == tail["committed_evals"]
+        assert tail["p50_coverage"] >= 0.90, tail
+        assert tail["segments"], tail
+        # the p50-vs-p99 table carries both cohorts for each segment
+        for seg, row in tail["segments"].items():
+            assert {"p50_ms", "p50_share", "p99_ms", "p99_share"} \
+                <= set(row), (seg, row)
+        # the distribution rides into steady_state for bench emission
+        assert ss["e2e_p99_ms"] >= ss["e2e_p50_ms"] > 0.0
+        # the flight recorder observed the burst (captures depend on
+        # the distribution's shape; observation must not)
+        assert tail["flight_recorder"]["observed"] == \
+            tail["committed_evals"]
 
     def test_disabled_tracing_leaves_no_spans(self):
         """The disabled live path must record nothing (the <5%
