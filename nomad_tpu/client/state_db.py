@@ -48,10 +48,13 @@ class StateDB:
     # --- allocations ----------------------------------------------------
 
     def put_allocation(self, alloc) -> None:
+        # serialize before taking the connection lock (graftcheck R2):
+        # the lock only needs to cover the sqlite write
+        data = pickle.dumps(alloc)
         with self._lock:
             self._conn.execute(
                 "INSERT OR REPLACE INTO allocations (alloc_id, data) VALUES (?, ?)",
-                (alloc.id, pickle.dumps(alloc)),
+                (alloc.id, data),
             )
             self._conn.commit()
 
@@ -76,16 +79,14 @@ class StateDB:
 
     def put_task_state(self, alloc_id: str, task_name: str,
                        local_state=None, task_handle=None) -> None:
+        local = pickle.dumps(local_state) if local_state is not None else None
+        handle = pickle.dumps(task_handle) if task_handle is not None else None
         with self._lock:
             self._conn.execute(
                 "INSERT OR REPLACE INTO task_state "
                 "(alloc_id, task_name, local_state, task_handle) "
                 "VALUES (?, ?, ?, ?)",
-                (
-                    alloc_id, task_name,
-                    pickle.dumps(local_state) if local_state is not None else None,
-                    pickle.dumps(task_handle) if task_handle is not None else None,
-                ),
+                (alloc_id, task_name, local, handle),
             )
             self._conn.commit()
 
@@ -105,10 +106,11 @@ class StateDB:
     # --- node meta (client ID persistence etc.) -------------------------
 
     def put_meta(self, key: str, value) -> None:
+        data = pickle.dumps(value)
         with self._lock:
             self._conn.execute(
                 "INSERT OR REPLACE INTO node_meta (key, value) VALUES (?, ?)",
-                (key, pickle.dumps(value)),
+                (key, data),
             )
             self._conn.commit()
 

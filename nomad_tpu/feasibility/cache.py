@@ -183,6 +183,7 @@ class MaskProgramCache:
                 self._masks.popitem(last=False)
             return entry
 
+    # graft: frozen
     def _dedupe_locked(self, key: Tuple,
                        mask: np.ndarray) -> np.ndarray:
         """Canonicalize equal masks of one node structure onto one

@@ -127,7 +127,7 @@ class NeutralPlanes(NamedTuple):
     arange_i32: np.ndarray      # [N] identity node_perm
 
 
-def _frozen(a: np.ndarray) -> np.ndarray:
+def _frozen(a: np.ndarray) -> np.ndarray:  # graft: frozen
     a.flags.writeable = False
     return a
 
@@ -135,7 +135,7 @@ def _frozen(a: np.ndarray) -> np.ndarray:
 _NEUTRAL_CACHE: dict = {}
 
 
-def neutral_planes(n: int) -> NeutralPlanes:
+def neutral_planes(n: int) -> NeutralPlanes:  # graft: frozen
     got = _NEUTRAL_CACHE.get(n)
     if got is None:
         got = NeutralPlanes(
@@ -160,7 +160,7 @@ def neutral_planes(n: int) -> NeutralPlanes:
 _NEUTRAL_WORDS_CACHE: dict = {}
 
 
-def neutral_port_words(n: int, w: int) -> np.ndarray:
+def neutral_port_words(n: int, w: int) -> np.ndarray:  # graft: frozen
     """Frozen all-zero [N, W] u32 port-conflict words."""
     got = _NEUTRAL_WORDS_CACHE.get((n, w))
     if got is None:
@@ -172,7 +172,7 @@ def neutral_port_words(n: int, w: int) -> np.ndarray:
 _NEUTRAL_STEP_CACHE: dict = {}
 
 
-def neutral_step_planes(k_pad: int):
+def neutral_step_planes(k_pad: int):  # graft: frozen
     """(step_penalty[k,P]=-1, step_preferred[k]=-1) singletons."""
     got = _NEUTRAL_STEP_CACHE.get(k_pad)
     if got is None:

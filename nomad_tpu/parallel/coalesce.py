@@ -42,6 +42,7 @@ from nomad_tpu.telemetry.histogram import histograms, percentile
 from nomad_tpu.telemetry.kernel_profile import profiler
 from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.tensors.device_state import default_device_state
+from nomad_tpu.utils.witness import witness_lock
 
 #: B is bucketed to limit recompiles. Coarse on purpose: every
 #: (wave bucket, step bucket, features) combination is a separate XLA
@@ -92,24 +93,48 @@ class _WaveTopK:
     Bytes are metered at fetch time like any other d2h.
     """
 
-    __slots__ = ("_idx", "_scores", "_host", "_lock")
+    __slots__ = ("_idx", "_scores", "_host", "_lock", "_fetching",
+                 "_done")
 
     def __init__(self, idx_dev, scores_dev) -> None:
         self._idx = idx_dev
         self._scores = scores_dev
         self._host = None
-        self._lock = threading.Lock()
+        self._lock = witness_lock("WaveTopK._lock")
+        self._fetching = False
+        self._done = threading.Event()
 
     def host(self):
-        if self._host is None:
+        # claim-then-fetch: the lock only arbitrates WHO fetches; the
+        # d2h transfer itself runs unlocked (graftcheck R2 — a device
+        # fetch under a lock stalls every other member's deferred
+        # score_meta drain behind the PCIe transfer instead of letting
+        # them park on the event). Losers wait on the claim's event
+        # and read the cached host copy. Each claim gets a FRESH
+        # event (captured under the lock): a failed fetch's set() then
+        # cannot leave a stale-set event that would busy-spin waiters
+        # through the retry claim's whole transfer.
+        while True:
             with self._lock:
-                if self._host is None:
-                    idx = np.asarray(self._idx)
-                    scores = np.asarray(self._scores)
-                    profiler.add_bytes("d2h", idx.nbytes + scores.nbytes)
-                    self._host = (idx, scores)
-                    # release the device buffers
-                    self._idx = self._scores = None
+                if self._host is not None:
+                    return self._host
+                if not self._fetching:
+                    self._fetching = True
+                    done = self._done = threading.Event()
+                    break
+                done = self._done
+            done.wait()
+        try:
+            idx = np.asarray(self._idx)
+            scores = np.asarray(self._scores)
+            profiler.add_bytes("d2h", idx.nbytes + scores.nbytes)
+            self._host = (idx, scores)
+            # release the device buffers
+            self._idx = self._scores = None
+        finally:
+            with self._lock:
+                self._fetching = False
+            done.set()
         return self._host
 
 
@@ -278,7 +303,7 @@ class WaveStats:
     adaptive deadline exists to bound exactly this number."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness_lock("WaveStats._lock")
         self.requests = 0
         self.launches = 0
         self.full_launches = 0
@@ -345,7 +370,7 @@ class _LatencyEWMA:
     never dominates the device time it tries to amortize."""
 
     def __init__(self, alpha: float = 0.2) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness_lock("LatencyEWMA._lock")
         self._alpha = alpha
         self._value: Optional[float] = None
 
@@ -384,7 +409,7 @@ wave_deadline_ewma = _LatencyEWMA(alpha=0.25)
 #: variant AFTER it finishes, but parked members must stop firing
 #: partial waves INTO the transient (each would cold-compile its own
 #: wave bucket).
-_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT_LOCK = witness_lock("coalesce._INFLIGHT_LOCK")
 _INFLIGHT_STARTS: dict = {}
 
 
@@ -612,7 +637,8 @@ class LaunchCoalescer:
                  window_min_s: float = 0.001,
                  window_max_s: float = 0.050,
                  adaptive: bool = True) -> None:
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(
+            witness_lock("LaunchCoalescer._lock"))
         self._active = participants
         # the owning server's device mesh (None = module default)
         self.mesh = mesh
@@ -793,7 +819,7 @@ class ClusterCache:
     planes (bare test harnesses)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness_lock("ClusterCache._lock")
         self._cache = {}
 
     def get(self, state):

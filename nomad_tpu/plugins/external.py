@@ -191,6 +191,7 @@ class ExternalDriver(DriverPlugin):
             frame = {"id": self._next_id, "method": method,
                      "params": _to_wire(params)}
             try:
+                # graft: ok R2 - the lock IS the RPC framing: it pairs this request with its response on one pipe; frames are tiny and plugin calls are cold-path
                 self._proc.stdin.write(json.dumps(frame) + "\n")
                 self._proc.stdin.flush()
 
@@ -213,6 +214,7 @@ class ExternalDriver(DriverPlugin):
                         raise PluginCrashed(
                             f"plugin {self.name} exited mid-call")
                     try:
+                        # graft: ok R2 - response parse belongs to the same framed exchange the lock serializes
                         candidate = json.loads(line)
                     except json.JSONDecodeError:
                         # stray print() from the plugin: skip, stay

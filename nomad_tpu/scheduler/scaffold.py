@@ -27,10 +27,11 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.tensors.schema import AskTensor
+from nomad_tpu.utils.witness import witness_lock
 
 __all__ = ["TGScaffold", "scaffold_for", "MetricsSkeleton"]
 
-_LOCK = threading.Lock()
+_LOCK = witness_lock("scaffold._LOCK")
 _CACHE: "OrderedDict[int, Tuple[object, TGScaffold]]" = OrderedDict()
 _CACHE_MAX = 512
 
@@ -73,7 +74,7 @@ class TGScaffold:
         )
         self._tg = tg
         self._lean_res: Dict[bool, Tuple] = {}
-        self._lean_lock = threading.Lock()
+        self._lean_lock = witness_lock("TGScaffold._lean_lock")
         # compiled mask program (None = Python-builder fallback); the
         # program cache dedupes by signature across jobs
         from nomad_tpu.feasibility import default_mask_cache
@@ -81,7 +82,7 @@ class TGScaffold:
         self.program = default_mask_cache.program_for(job, tg)
         self.program_compiled = self.program is not None
 
-    def lean_planes(self, oversub: bool) -> Tuple:
+    def lean_planes(self, oversub: bool) -> Tuple:  # graft: frozen
         """(task_resources, task_lifecycles, AllocatedResources) for a
         lean placement, built once per (job, tg, oversub) and shared BY
         REFERENCE across every slot, wave member, and retry attempt.

@@ -24,6 +24,7 @@ from nomad_tpu.structs import consts
 from nomad_tpu.structs.eval_plan import Evaluation, generate_uuid
 from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.utils.delayheap import DelayHeap
+from nomad_tpu.utils.witness import witness_lock
 
 # Queue that unackable evals land on after the delivery limit
 # (eval_broker.go:21 failedQueue).
@@ -83,7 +84,7 @@ class EvalBroker:
         self.initial_nack_delay = initial_nack_delay
         self.subsequent_nack_delay = subsequent_nack_delay
 
-        self._lock = threading.Lock()
+        self._lock = witness_lock("EvalBroker._lock")
         self._cond = threading.Condition(self._lock)
         self._enabled = False
         # scheduler type -> ready queue (eval_broker.go `ready`)
@@ -121,7 +122,16 @@ class EvalBroker:
         self._nack_heap: List[Tuple[float, str, str]] = []
         self._nack_thread: Optional[threading.Thread] = None
         self._nack_wake = threading.Event()
-        self.stats_lock = threading.Lock()
+        # delivery-token factory: ONE uuid per broker at construction,
+        # then an atomic counter. Tokens are opaque correlation handles
+        # (only ever compared for equality against what this broker
+        # handed out), and generate_uuid() serializes every caller
+        # through the process-wide RNG lock — calling it per eval
+        # inside dequeue_batch's lock hold (graftcheck R2) put a
+        # cross-module lock acquisition + uuid formatting on the hot
+        # dequeue path, once per wave member.
+        self._token_prefix = generate_uuid()
+        self._token_seq = itertools.count(1)
 
     # --- lifecycle (eval_broker.go SetEnabled/Flush) --------------------
 
@@ -222,7 +232,9 @@ class EvalBroker:
     def _track_unacked_locked(self, ev: Evaluation) -> str:
         """Register a handed-out eval: token + auto-nack deadline (one
         heap push; the shared watcher enforces it)."""
-        token = generate_uuid()
+        # next() on itertools.count is atomic — no RNG lock, no
+        # formatting beyond one f-string, under the broker lock
+        token = f"{self._token_prefix}-{next(self._token_seq)}"
         un = _UnackedEval(ev, token)
         self._unack[ev.id] = un
         if self.nack_timeout > 0:

@@ -41,6 +41,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from nomad_tpu.utils.witness import witness_lock
+
 LOG = logging.getLogger(__name__)
 
 #: wire prefix for authenticated datagrams: 1 version byte + 32-byte
@@ -88,8 +90,12 @@ class Member:
         return (self.host, self.port)
 
     def to_wire(self) -> List:
+        # copy the tags dict: the wire row outlives the membership
+        # lock (datagrams are now sealed OFF-lock), and set_tags()
+        # mutates self tags in place — serializing the live reference
+        # would race json.dumps against the update
         return [self.name, self.host, self.port, self.inc, self.status,
-                self.tags]
+                dict(self.tags)]
 
     def to_api(self) -> Dict:
         """The serf.Member shape the members endpoint serves."""
@@ -196,7 +202,7 @@ class Membership:
         self._sock.bind((bind, port))
         self._sock.settimeout(0.2)
         self.host, self.port = self._sock.getsockname()[:2]
-        self._lock = threading.Lock()
+        self._lock = witness_lock("Membership._lock")
         self._self = Member(name, self.host, self.port, inc=1, tags=tags)
         #: name -> Member (never includes self)
         self._members: Dict[str, Member] = {}
@@ -267,7 +273,8 @@ class Membership:
             self._self.status = LEFT
             targets = [m.addr for m in self._members.values()
                        if m.status in (ALIVE, SUSPECT)]
-            msg = self._encode({"t": "leave"})
+            wire = self._wire_msg_locked({"t": "leave"})
+        msg = self._seal(wire)
         for addr in targets:
             self._send(msg, addr)
 
@@ -288,17 +295,32 @@ class Membership:
 
     # --- wire helpers ---------------------------------------------------
 
-    def _encode(self, msg: Dict) -> bytes:
+    def _wire_msg_locked(self, msg: Dict) -> Dict:
+        """Fill the gossip envelope from the member table. Caller MUST
+        hold ``self._lock`` (the table read is the racy part)."""
         msg["from"] = self.name
         msg["region"] = self.region
         msg["mem"] = [self._self.to_wire()] + [
             m.to_wire() for m in self._members.values()
         ]
+        return msg
+
+    def _seal(self, msg: Dict) -> bytes:
+        """Serialize + HMAC-sign a wire message. Lock-free on purpose
+        (graftcheck R2): json/hmac over the whole member list is the
+        expensive half of datagram assembly, and holding the
+        membership lock through it stalled the rx-merge path on every
+        leave/probe."""
         payload = json.dumps(msg, separators=(",", ":")).encode()
         if self._key:
             sig = _hmac.new(self._key, payload, hashlib.sha256).digest()
             return _HMAC_VERSION + sig + payload
         return payload
+
+    def _encode(self, msg: Dict) -> bytes:
+        with self._lock:
+            msg = self._wire_msg_locked(msg)
+        return self._seal(msg)
 
     def _authenticate(self, data: bytes) -> Optional[bytes]:
         """Strip + verify the HMAC envelope; None = reject.
@@ -442,7 +464,8 @@ class Membership:
         with self._lock:
             self._seq += 1
             seq = self._seq
-            msg = self._encode({"t": "ping", "seq": seq})
+            wire = self._wire_msg_locked({"t": "ping", "seq": seq})
+        msg = self._seal(wire)
         ev = threading.Event()
         self._acks[seq] = ev
         try:

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs.eval_plan import Plan, PlanResult
 from nomad_tpu.utils.metrics import global_registry
+from nomad_tpu.utils.witness import witness_lock
 
 
 class PendingPlan:
@@ -44,7 +45,7 @@ class PendingPlan:
 
 class PlanQueue:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness_lock("PlanQueue._lock")
         self._cond = threading.Condition(self._lock)
         self._enabled = False
         self._heap: List[Tuple[int, int, PendingPlan]] = []

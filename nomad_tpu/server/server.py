@@ -221,6 +221,11 @@ class Server:
 
         self._leader = False
         self._ott_lock = threading.Lock()
+        # secrets mid-exchange: claimed under _ott_lock so the raft
+        # delete can run OUTSIDE it (graftcheck R2 — raft_apply blocks
+        # on the commit barrier and may sleep-retry; holding the lock
+        # through it serialized every concurrent exchange behind raft)
+        self._ott_claims: set = set()
         self._shutdown = threading.Event()
         self._leader_threads: List[threading.Thread] = []
         # serializes establish/revoke (raft fires them from separate
@@ -1262,12 +1267,23 @@ class Server:
         makes check-then-delete atomic against concurrent exchanges on
         this server (the HTTP agent is threaded)."""
         with self._ott_lock:
+            if secret in self._ott_claims:
+                # a concurrent exchange already claimed it: single use
+                raise ValueError("one-time token expired or not found")
             ott = self.state.one_time_token_by_secret(secret)
             if ott is None or ott["expires_at"] <= time.time():
                 raise ValueError("one-time token expired or not found")
             token = self.state.acl_token_by_accessor(ott["accessor_id"])
+            self._ott_claims.add(secret)
+        # the raft delete runs off the lock; the claim set keeps
+        # check-then-delete atomic against concurrent exchanges until
+        # the commit lands (after which the store row is gone)
+        try:
             self.raft_apply(fsm_msgs.ONE_TIME_TOKEN_DELETE,
                             {"secrets": [secret]})
+        finally:
+            with self._ott_lock:
+                self._ott_claims.discard(secret)
         if token is None:
             raise ValueError("one-time token's ACL token no longer exists")
         return token

@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.alloc import Allocation
 from nomad_tpu.structs.eval_plan import Deployment, Evaluation, Plan, PlanResult
+from nomad_tpu.utils.witness import witness_lock
 
 
 class SchedulerConfiguration:
@@ -180,7 +181,7 @@ class StateStore:
     def __init__(self) -> None:
         from nomad_tpu.state.usage import UsageIndex
 
-        self._lock = threading.RLock()
+        self._lock = witness_lock("StateStore._lock", rlock=True)
         self._index = 0
         # incrementally-scattered per-node utilization planes; every
         # alloc/node mutation below routes its transition through it
@@ -304,6 +305,16 @@ class StateStore:
         node_by_id_direct)."""
         with self._lock:
             return self._allocs.get(alloc_id)
+
+    def allocs_by_node_direct(self, node_id: str) -> List:
+        """Direct locked read of one node's alloc rows (no COW
+        snapshot) — the plan applier's per-plan view reads exactly one
+        node's list; rows are replaced, never mutated, so handing them
+        out is safe (graftcheck R4: this accessor replaces raw
+        ``_allocs_by_node`` reaching from server/plan_apply.py)."""
+        with self._lock:
+            ids = self._allocs_by_node.get(node_id, ())
+            return [self._allocs[i] for i in ids]
 
     def with_usage_view(self, fn):
         """Run ``fn(planes, allocs)`` under the store lock: ``planes``
@@ -737,7 +748,12 @@ class StateStore:
                 "autopilot_config": dict(self.autopilot_config),
                 "regions": dict(self._regions),
             }
-            return pickle.dumps(payload)
+        # serialize OUTSIDE the lock (graftcheck R2): the payload holds
+        # shallow table copies and rows are replaced, never mutated, so
+        # pickling them unlocked reads a consistent snapshot — and a
+        # large cluster's dump no longer stalls every store reader for
+        # the whole serialization
+        return pickle.dumps(payload)
 
     def restore_from_bytes(self, data: bytes) -> None:
         payload = pickle.loads(data)

@@ -106,6 +106,11 @@ class DeviceClusterState:
         #: generation (or the frozen LRU).
         self._registry: Dict[int, tuple] = {}
         self._frozen: "OrderedDict[int, tuple]" = OrderedDict()
+        #: id(arr) -> Event for frozen uploads in flight: the upload
+        #: itself runs OUTSIDE self._lock (graftcheck R2 — a first-
+        #: sight frozen upload under the registry lock stalled every
+        #: concurrent snapshot-time advance behind one h2d transfer)
+        self._frozen_inflight: Dict[int, threading.Event] = {}
         self.max_generations = max_generations
         self.max_frozen = max_frozen
         self.reset_stats()
@@ -163,20 +168,42 @@ class DeviceClusterState:
         return None
 
     def _frozen_resident(self, arr: np.ndarray):
-        with self._lock:
-            ent = self._frozen.get(id(arr))
-            if ent is not None and ent[0] is arr:
-                self._frozen.move_to_end(id(arr))
-                return ent[1]
+        # claim under the lock, upload outside it: the device_put of a
+        # first-sight frozen singleton must not hold the registry lock
+        # (it is shared with the dirty-row advance path every eval
+        # thread runs at snapshot time — graftcheck R2). Concurrent
+        # callers for the same array wait on the claim's event; a
+        # caller who finds the upload failed just misses (residency is
+        # an optimization, the host array still works).
+        key = id(arr)
+        while True:
+            with self._lock:
+                ent = self._frozen.get(key)
+                if ent is not None and ent[0] is arr:
+                    self._frozen.move_to_end(key)
+                    return ent[1]
+                ev = self._frozen_inflight.get(key)
+                if ev is None:
+                    ev = self._frozen_inflight[key] = threading.Event()
+                    break       # this thread owns the upload
+            if not ev.wait(timeout=30.0):
+                return None     # uploader wedged: serve the host array
+        dev = None
+        try:
             dev = self._upload({"_frozen": arr})["_frozen"]
-            self._frozen[id(arr)] = (arr, dev)
-            self._registry[id(arr)] = (arr, dev)
-            while len(self._frozen) > self.max_frozen:
-                old_id, (old_arr, _) = self._frozen.popitem(last=False)
-                ent = self._registry.get(old_id)
-                if ent is not None and ent[0] is old_arr:
-                    self._registry.pop(old_id, None)
-            return dev
+            with self._lock:
+                self._frozen[key] = (arr, dev)
+                self._registry[key] = (arr, dev)
+                while len(self._frozen) > self.max_frozen:
+                    old_id, (old_arr, _) = self._frozen.popitem(last=False)
+                    ent = self._registry.get(old_id)
+                    if ent is not None and ent[0] is old_arr:
+                        self._registry.pop(old_id, None)
+        finally:
+            with self._lock:
+                self._frozen_inflight.pop(key, None)
+            ev.set()
+        return dev
 
     def _register(self, gen: _Generation,
                   host_planes: Dict[str, np.ndarray]) -> None:

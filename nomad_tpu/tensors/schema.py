@@ -94,6 +94,7 @@ class ClusterTensors:
     #: the builders, None falls back to the module-wide _GATHER_LOCK
     _gather_lock: Optional[object] = None
 
+    # graft: frozen
     def gathered_usage(self, usage) -> tuple:
         """(used_cpu, used_mem, used_disk, used_cores, used_mbits)
         gathered to cluster rows — READ-ONLY arrays cached per usage

@@ -1,0 +1,941 @@
+"""graftcheck (ISSUE 9): the AST rule engine, its rules' fixture
+self-tests (positive + negative per rule), the tier-1 baseline gate,
+and the runtime lock witness.
+
+The gate test at the bottom is the enforcement point: graftcheck over
+``nomad_tpu/`` must produce NO finding that is not in the committed
+baseline (which ships empty), and no stale baseline entries — the
+baseline may only shrink. The fix-regression tests pin the specific
+lock-discipline repairs the initial sweep produced.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tools.graftcheck.engine import (
+    Engine,
+    default_engine,
+    load_baseline,
+    repo_root,
+)
+from tools.graftcheck.rules_frozen import FrozenPlaneRule
+from tools.graftcheck.rules_hygiene import (
+    BareExceptRule,
+    DeadLockRule,
+    MutableDefaultRule,
+    NonDaemonThreadRule,
+)
+from tools.graftcheck.rules_jit import JitHygieneRule
+from tools.graftcheck.rules_locks import LockDisciplineRule
+from tools.graftcheck.rules_store import StoreAccessRule
+from tools.graftcheck.rules_telemetry import TelemetryDriftRule
+
+REPO = repo_root()
+
+
+def run_rule(rule, texts, extra=None):
+    return Engine([rule]).run_texts(texts, extra_texts=extra)
+
+
+def rules_of(findings):
+    return [(f.rule, f.slug) for f in findings if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics
+
+
+class TestEngine:
+    def test_suppression_with_justification(self):
+        src = (
+            "import time, threading\n"
+            "LOCK = threading.Lock()\n"
+            "def f():\n"
+            "    with LOCK:\n"
+            "        time.sleep(1)  # graft: ok R2 - test fixture\n"
+        )
+        out = run_rule(LockDisciplineRule(), {"m.py": src})
+        assert len(out) == 1
+        assert out[0].suppressed
+        assert out[0].justification == "test fixture"
+
+    def test_suppression_without_justification_is_a_finding(self):
+        src = (
+            "import time, threading\n"
+            "LOCK = threading.Lock()\n"
+            "def f():\n"
+            "    with LOCK:\n"
+            "        time.sleep(1)  # graft: ok R2\n"
+        )
+        out = run_rule(LockDisciplineRule(), {"m.py": src})
+        assert any("unjustified" in f.slug for f in out)
+        assert not any(f.suppressed for f in out)
+
+    def test_fingerprint_is_line_free(self):
+        src = "LOCK = __import__('threading').Lock()\n" \
+              "def f():\n    with LOCK:\n        import time\n" \
+              "        time.sleep(1)\n"
+        shifted = "\n\n\n" + src
+        a = run_rule(LockDisciplineRule(), {"m.py": src})
+        b = run_rule(LockDisciplineRule(), {"m.py": shifted})
+        assert [f.fingerprint for f in a] == [f.fingerprint for f in b]
+        assert a[0].line != b[0].line
+
+
+# ---------------------------------------------------------------------------
+# R1 frozen-plane mutation
+
+
+R1_PRODUCER = (
+    "import numpy as np\n"
+    "def make_plane(n):  # graft: frozen\n"
+    "    return np.zeros(n)\n"
+    "def make_pair(n):  # graft: frozen\n"
+    "    return np.zeros(n), np.zeros(n)\n"
+)
+
+
+class TestR1FrozenPlane:
+    def _run(self, body):
+        return rules_of(run_rule(FrozenPlaneRule(),
+                                 {"m.py": R1_PRODUCER + body}))
+
+    def test_subscript_assignment_flagged(self):
+        out = self._run("def use(n):\n"
+                        "    p = make_plane(n)\n"
+                        "    p[0] = 1\n")
+        assert out == [("R1", "mutate:p")]
+
+    def test_augassign_and_fill_flagged(self):
+        out = self._run("def use(n):\n"
+                        "    p = make_plane(n)\n"
+                        "    p += 1\n"
+                        "    p.fill(0)\n")
+        assert ("R1", "mutate:p") in out and len(out) == 2
+
+    def test_copyto_and_tuple_unpack_flagged(self):
+        out = self._run("def use(n):\n"
+                        "    a, b = make_pair(n)\n"
+                        "    np.copyto(b, a)\n")
+        assert out == [("R1", "mutate:b")]
+
+    def test_attribute_of_tainted_flagged(self):
+        out = self._run("def use(n):\n"
+                        "    planes = make_plane(n)\n"
+                        "    planes.zeros[2] = 1\n")
+        assert out == [("R1", "mutate:planes.zeros")]
+
+    def test_rebinding_untaints_and_copy_is_fine(self):
+        out = self._run("def use(n):\n"
+                        "    p = make_plane(n)\n"
+                        "    p = np.array(p)\n"     # copy-on-write
+                        "    p[0] = 1\n"
+                        "    q = make_plane(n).copy()\n")
+        assert out == []
+
+    def test_unannotated_producer_not_tracked(self):
+        src = ("import numpy as np\n"
+               "def plain(n):\n    return np.zeros(n)\n"
+               "def use(n):\n    p = plain(n)\n    p[0] = 1\n")
+        assert rules_of(run_rule(FrozenPlaneRule(), {"m.py": src})) == []
+
+    def test_real_producers_annotated(self):
+        """The live producer sites carry the annotation (the rule is
+        only as good as its seeds)."""
+        for rel, name in [
+            ("nomad_tpu/ops/kernel.py", "def neutral_planes"),
+            ("nomad_tpu/ops/kernel.py", "def neutral_step_planes"),
+            ("nomad_tpu/scheduler/scaffold.py", "def lean_planes"),
+        ]:
+            text = open(os.path.join(REPO, rel)).read()
+            i = text.index(name)
+            line = text[i:text.index("\n", i)]
+            prev = text[:i].rsplit("\n", 2)[-2]
+            assert "graft: frozen" in line or "graft: frozen" in prev, \
+                (rel, name)
+
+
+# ---------------------------------------------------------------------------
+# R2 lock discipline
+
+
+class TestR2LockDiscipline:
+    def _run(self, src):
+        return rules_of(run_rule(LockDisciplineRule(), {"m.py": src}))
+
+    def test_device_and_sleep_under_lock_flagged(self):
+        src = ("import threading, time, jax\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def f(self, x):\n"
+               "        with self._lock:\n"
+               "            jax.device_put(x)\n"
+               "            time.sleep(0.1)\n")
+        out = self._run(src)
+        assert ("R2", "blocking:jax.device_put") in out
+        assert ("R2", "blocking:time.sleep") in out
+
+    def test_one_level_method_resolution(self):
+        src = ("import threading, pickle\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def helper(self, x):\n"
+               "        return pickle.dumps(x)\n"
+               "    def f(self, x):\n"
+               "        with self._lock:\n"
+               "            return self.helper(x)\n")
+        out = self._run(src)
+        assert any(s.startswith("blocking-via:helper") for _, s in out)
+
+    def test_same_lock_condition_wait_ok(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._cond = threading.Condition(self._lock)\n"
+               "    def f(self):\n"
+               "        with self._lock:\n"
+               "            self._cond.wait(1.0)\n")
+        assert self._run(src) == []
+
+    def test_foreign_wait_under_lock_flagged(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._done = threading.Event()\n"
+               "    def f(self):\n"
+               "        with self._lock:\n"
+               "            self._done.wait()\n")
+        out = self._run(src)
+        assert out and out[0][1].startswith("blocking:self._done.wait")
+
+    def test_work_outside_lock_ok(self):
+        src = ("import threading, pickle\n"
+               "LOCK = threading.Lock()\n"
+               "def f(x):\n"
+               "    data = pickle.dumps(x)\n"
+               "    with LOCK:\n"
+               "        return data\n")
+        assert self._run(src) == []
+
+    def test_lock_order_cycle_detected(self):
+        src = ("import threading\n"
+               "A_LOCK = threading.Lock()\n"
+               "B_LOCK = threading.Lock()\n"
+               "def f():\n"
+               "    with A_LOCK:\n"
+               "        with B_LOCK:\n"
+               "            pass\n"
+               "def g():\n"
+               "    with B_LOCK:\n"
+               "        with A_LOCK:\n"
+               "            pass\n")
+        out = self._run(src)
+        assert any(s.startswith("lock-cycle:") for _, s in out)
+
+    def test_consistent_order_no_cycle(self):
+        src = ("import threading\n"
+               "A_LOCK = threading.Lock()\n"
+               "B_LOCK = threading.Lock()\n"
+               "def f():\n"
+               "    with A_LOCK:\n"
+               "        with B_LOCK:\n"
+               "            pass\n"
+               "def g():\n"
+               "    with A_LOCK:\n"
+               "        with B_LOCK:\n"
+               "            pass\n")
+        assert self._run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# R3 jit-boundary hygiene
+
+
+class TestR3JitHygiene:
+    def _run(self, src):
+        return rules_of(run_rule(JitHygieneRule(), {"m.py": src}))
+
+    def test_impure_call_in_jitted_fn_flagged(self):
+        src = ("import jax, time\n"
+               "def kernel(x):\n"
+               "    time.monotonic()\n"
+               "    return x\n"
+               "kernel_jit = jax.jit(kernel)\n")
+        out = self._run(src)
+        assert ("R3", "impure:time.monotonic") in out
+
+    def test_transitive_callee_checked(self):
+        src = ("import jax, random\n"
+               "def helper(x):\n"
+               "    return x + random.random()\n"
+               "def kernel(x):\n"
+               "    return helper(x)\n"
+               "kernel_jit = jax.jit(kernel)\n")
+        out = self._run(src)
+        assert ("R3", "impure:random.random") in out
+
+    def test_mutable_global_read_flagged(self):
+        src = ("import jax\n"
+               "COUNTER = 0\n"
+               "def bump():\n"
+               "    global COUNTER\n"
+               "    COUNTER += 1\n"
+               "@jax.jit\n"
+               "def kernel(x):\n"
+               "    return x + COUNTER\n")
+        out = self._run(src)
+        assert ("R3", "mutable-global:COUNTER") in out
+
+    def test_constant_global_and_unjitted_fn_ok(self):
+        src = ("import jax, time\n"
+               "SCALE = 4\n"
+               "def host_helper():\n"
+               "    return time.monotonic()\n"   # not jit-reachable
+               "@jax.jit\n"
+               "def kernel(x):\n"
+               "    return x * SCALE\n")
+        assert self._run(src) == []
+
+    def test_real_kernels_clean(self):
+        """The live jit roots (ops/kernel.py, tensors/device_state.py,
+        parallel/*) pass R3 — the steady-state no-recompile promise."""
+        texts = {}
+        for rel in ("nomad_tpu/ops/kernel.py",
+                    "nomad_tpu/tensors/device_state.py",
+                    "nomad_tpu/parallel/batching.py",
+                    "nomad_tpu/parallel/sharded.py"):
+            texts[rel] = open(os.path.join(REPO, rel)).read()
+        assert rules_of(run_rule(JitHygieneRule(), texts)) == []
+
+
+# ---------------------------------------------------------------------------
+# R4 store access
+
+
+class TestR4StoreAccess:
+    def _run(self, src, rel="nomad_tpu/server/x.py"):
+        return rules_of(run_rule(StoreAccessRule(), {rel: src}))
+
+    def test_raw_internal_flagged(self):
+        src = ("class V:\n"
+               "    def __init__(self, store):\n"
+               "        self._store = store\n"
+               "    def f(self, nid):\n"
+               "        with self._store._lock:\n"
+               "            return self._store._nodes.get(nid)\n")
+        out = self._run(src)
+        assert ("R4", "internal:_store._lock") in out
+        assert ("R4", "internal:_store._nodes") in out
+
+    def test_accessors_ok(self):
+        src = ("def f(store, nid):\n"
+               "    return store.node_by_id_direct(nid)\n")
+        assert self._run(src) == []
+
+    def test_store_module_itself_exempt(self):
+        src = ("class StateStore:\n"
+               "    def f(self, state_store):\n"
+               "        return state_store._nodes\n")
+        assert self._run(src, rel="nomad_tpu/state/store.py") == []
+
+
+# ---------------------------------------------------------------------------
+# R5 telemetry drift
+
+
+R5_DOC = """# T
+## Instrumented spans
+```
+eval.schedule   one eval
+wave.launch     firing member
+```
+## Prometheus series
+```
+nomad_tpu_latency_seconds   histogram
+```
+## Bench emission keys
+```
+trace_per_eval_ms   per-eval ms
+```
+"""
+
+R5_SRC = (
+    "from nomad_tpu.telemetry.trace import tracer\n"
+    "def f():\n"
+    "    with tracer.span('eval.schedule'):\n"
+    "        tracer.record(\"wave.launch\", 1.0)\n"
+    "    x = 'nomad_tpu_latency_seconds'\n"
+)
+
+R5_BENCH = "def emit(**kw): pass\nemit(trace_per_eval_ms=1.0)\n"
+
+
+class TestR5TelemetryDrift:
+    def _run(self, src=R5_SRC, doc=R5_DOC, bench=R5_BENCH):
+        return rules_of(run_rule(
+            TelemetryDriftRule(), {"nomad_tpu/x.py": src},
+            extra={"docs/TELEMETRY.md": doc, "bench.py": bench}))
+
+    def test_in_sync_passes(self):
+        assert self._run() == []
+
+    def test_undocumented_span_flagged(self):
+        src = R5_SRC.replace("wave.launch", "wave.newstage")
+        out = self._run(src=src)
+        assert ("R5", "span-undocumented:wave.newstage") in out
+        assert ("R5", "span-stale:wave.launch") in out
+
+    def test_stale_prom_series_flagged(self):
+        src = R5_SRC.replace("nomad_tpu_latency_seconds", "plain")
+        out = self._run(src=src)
+        assert ("R5", "span-stale:nomad_tpu_latency_seconds") not in out
+        assert ("R5", "prom-stale:nomad_tpu_latency_seconds") in out
+
+    def test_undocumented_prom_series_flagged(self):
+        src = R5_SRC + "y = 'nomad_tpu_new_series_total'\n"
+        out = self._run(src=src)
+        assert ("R5", "prom-undocumented:nomad_tpu_new_series_total") in out
+
+    def test_bench_key_drift_both_directions(self):
+        out = self._run(bench="def emit(**kw): pass\n"
+                              "emit(trace_new_key=1)\n")
+        assert ("R5", "bench-undocumented:trace_new_key") in out
+        assert ("R5", "bench-stale:trace_per_eval_ms") in out
+
+    def test_unregistered_dynamic_span_flagged(self):
+        src = ("from nomad_tpu.telemetry.trace import tracer\n"
+               "def f(stage):\n"
+               "    tracer.record(f'custom.{stage}', 1.0)\n")
+        out = self._run(src=R5_SRC + src)
+        assert any(s.startswith("span-dynamic:custom.{}") for _, s in out)
+
+    def test_bg_prefix_exempt(self):
+        src = R5_SRC + ("def g(name):\n"
+                        "    tracer.record(f'bg.{name}', 1.0)\n")
+        assert self._run(src=src) == []
+
+    def test_real_repo_in_sync(self):
+        """The replacement for PR 8's TestSpanNameDriftGuard: the live
+        tree vs the live docs, spans + Prometheus series + bench keys,
+        both directions."""
+        texts = {}
+        for dirpath, dirs, files in os.walk(os.path.join(REPO,
+                                                         "nomad_tpu")):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fn in files:
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    texts[os.path.relpath(p, REPO)] = open(p).read()
+        out = run_rule(TelemetryDriftRule(), texts)
+        assert rules_of(out) == [], [f.render() for f in out]
+        # sanity: the scan actually saw the hot path
+        from tools.graftcheck.engine import Context, SourceFile
+        ctx = Context([SourceFile(rel, t) for rel, t in texts.items()],
+                      REPO)
+        emitted, _ = TelemetryDriftRule()._emitted_spans(ctx)
+        assert "eval.schedule" in emitted and "eval.e2e" in emitted
+
+
+# ---------------------------------------------------------------------------
+# stock hygiene
+
+
+class TestHygiene:
+    def test_mutable_default_flagged_and_none_ok(self):
+        src = ("def f(a, b=[], c={}):\n    pass\n"
+               "def g(a, b=None, c=()):\n    pass\n")
+        out = rules_of(run_rule(MutableDefaultRule(), {"m.py": src}))
+        assert len(out) == 2 and all(r == "H1" for r, _ in out)
+
+    def test_bare_except_flagged_typed_ok(self):
+        src = ("def f():\n"
+               "    try:\n        pass\n"
+               "    except:\n        pass\n"
+               "def g():\n"
+               "    try:\n        pass\n"
+               "    except Exception:\n        pass\n")
+        out = rules_of(run_rule(BareExceptRule(), {"m.py": src}))
+        assert out == [("H2", "bare-except")]
+
+    def test_non_daemon_thread_flagged(self):
+        src = ("import threading\n"
+               "def f():\n"
+               "    t = threading.Thread(target=f)\n"
+               "    t.start()\n")
+        out = rules_of(run_rule(NonDaemonThreadRule(), {"m.py": src}))
+        assert out == [("H3", "non-daemon-thread")]
+
+    def test_daemon_kw_or_attr_ok(self):
+        src = ("import threading\n"
+               "def f():\n"
+               "    a = threading.Thread(target=f, daemon=True)\n"
+               "    b = threading.Thread(target=f)\n"
+               "    b.daemon = True\n")
+        assert rules_of(run_rule(NonDaemonThreadRule(),
+                                 {"m.py": src})) == []
+
+    def test_dead_lock_flagged_used_ok(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.dead = threading.Lock()\n"
+               "        self.live = threading.Lock()\n"
+               "    def f(self):\n"
+               "        with self.live:\n"
+               "            pass\n")
+        out = rules_of(run_rule(DeadLockRule(), {"m.py": src}))
+        assert out == [("H4", "dead-lock:C.dead")]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+
+
+class TestGate:
+    def test_nomad_tpu_clean_against_baseline(self):
+        """THE gate: graftcheck over nomad_tpu/ has no finding outside
+        the committed baseline, and the baseline carries no stale
+        entries (it may only shrink)."""
+        findings = default_engine().run_paths(["nomad_tpu"], REPO)
+        active = {f.fingerprint: f for f in findings if not f.suppressed}
+        baseline = load_baseline(
+            os.path.join(REPO, "tools", "graftcheck", "baseline.txt"))
+        new = [f.render() for fp, f in sorted(active.items())
+               if fp not in baseline]
+        assert not new, "\n".join(
+            ["graftcheck found NEW findings (fix them or justify an "
+             "inline suppression; see docs/ANALYSIS.md):"] + new)
+        stale = sorted(baseline - set(active))
+        assert not stale, (
+            f"baseline entries whose findings no longer exist — the "
+            f"baseline may only shrink, delete them: {stale}")
+
+    def test_suppressions_all_justified(self):
+        findings = default_engine().run_paths(["nomad_tpu"], REPO)
+        for f in findings:
+            if f.suppressed:
+                assert f.justification, f.render()
+
+    def test_cli_exits_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftcheck", "nomad_tpu"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# the runtime lock witness
+
+
+class TestLockWitness:
+    @pytest.fixture(autouse=True)
+    def _clean_witness(self):
+        from nomad_tpu.utils import witness
+        witness.reset()
+        witness.enable()
+        yield witness
+        witness.disable()
+        witness.reset()
+
+    def test_inversion_detected(self):
+        """The acceptance self-test: an injected A→B / B→A inversion
+        is detected and reported."""
+        from nomad_tpu.utils import witness
+        A = witness.witness_lock("selftest.A")
+        B = witness.witness_lock("selftest.B")
+        with A:
+            with B:
+                pass
+        with B:
+            with A:
+                pass
+        v = witness.violations()
+        assert len(v) == 1
+        held, acquiring, path, _thread = v[0]
+        assert (held, acquiring) == ("selftest.B", "selftest.A")
+        assert path[0] == "selftest.A" and path[-1] == "selftest.A"
+
+    def test_transitive_inversion_detected(self):
+        from nomad_tpu.utils import witness
+        A = witness.witness_lock("t.A")
+        B = witness.witness_lock("t.B")
+        C = witness.witness_lock("t.C")
+        with A:
+            with B:
+                pass
+        with B:
+            with C:
+                pass
+        with C:
+            with A:
+                pass
+        assert len(witness.violations()) == 1
+
+    def test_same_name_cross_instance_nesting_flagged(self):
+        """Two DIFFERENT instances under one witness name cannot hide
+        behind the reentrancy skip: nesting them is flagged
+        (DUPOK-style) unless the name is sanctioned."""
+        from nomad_tpu.utils import witness
+        A1 = witness.witness_lock("dup.L")
+        A2 = witness.witness_lock("dup.L")
+        with A1:
+            with A2:
+                pass
+        v = witness.violations()
+        assert v and v[0][2] == ("DUPOK", "dup.L")
+
+    def test_consistent_order_clean_across_threads(self):
+        from nomad_tpu.utils import witness
+        A = witness.witness_lock("c.A")
+        B = witness.witness_lock("c.B")
+
+        def worker():
+            for _ in range(50):
+                with A:
+                    with B:
+                        pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert witness.violations() == []
+        assert "c.B" in witness.order_edges().get("c.A", set())
+
+    def test_hold_times_feed_histograms(self):
+        from nomad_tpu.telemetry.histogram import histograms
+        from nomad_tpu.utils import witness
+        L = witness.witness_lock("held.L")
+        before = histograms.get("lock_hold_held.L").count
+        with L:
+            time.sleep(0.001)
+        h = histograms.get("lock_hold_held.L")
+        assert h.count == before + 1
+
+    def test_disabled_returns_plain_lock(self):
+        from nomad_tpu.utils import witness
+        witness.disable()
+        lk = witness.witness_lock("plain.L")
+        assert type(lk) is type(threading.Lock())
+        witness.enable()
+
+    def test_condition_wait_keeps_bookkeeping(self):
+        from nomad_tpu.utils import witness
+        L = witness.witness_lock("cond.L")
+        cond = threading.Condition(L)
+        hit = []
+
+        def waiter():
+            with cond:
+                cond.wait(5.0)
+                hit.append(1)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+        t.join(5)
+        assert hit == [1]
+        assert witness.violations() == []
+
+    def test_raise_mode(self, monkeypatch):
+        from nomad_tpu.utils import witness
+        monkeypatch.setattr(witness, "_RAISE", True)
+        A = witness.witness_lock("r.A")
+        B = witness.witness_lock("r.B")
+        with A:
+            with B:
+                pass
+        with pytest.raises(witness.WitnessInversion):
+            with B:
+                with A:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the R2/R4 fixes the initial sweep produced
+
+
+class TestR2FixRegressions:
+    def test_broker_tokens_unique_without_rng(self):
+        """eval_broker fix: delivery tokens come from a per-broker
+        counter, not per-eval generate_uuid() under the broker lock."""
+        from nomad_tpu import mock
+        from nomad_tpu.server.eval_broker import EvalBroker
+        import nomad_tpu.structs.eval_plan as ep
+
+        broker = EvalBroker(nack_timeout=0)
+        broker.set_enabled(True)
+        try:
+            for i in range(20):
+                ev = mock.eval()
+                ev.job_id = f"job-{i}"
+                broker.enqueue(ev)
+            import nomad_tpu.server.eval_broker as broker_mod
+
+            calls = []
+            orig = ep.generate_uuid
+
+            def counting_uuid():
+                calls.append(1)
+                return orig()
+
+            ep.generate_uuid = counting_uuid
+            broker_mod.generate_uuid = counting_uuid
+            try:
+                batch = broker.dequeue_batch(["service"], 20,
+                                             timeout=5.0)
+            finally:
+                ep.generate_uuid = orig
+                broker_mod.generate_uuid = orig
+            tokens = [tok for _, tok in batch]
+            assert len(batch) == 20
+            assert len(set(tokens)) == 20
+            assert not calls, "dequeue still generates uuids per eval"
+            for ev, tok in batch:
+                broker.ack(ev.id, tok)      # tokens still correlate
+        finally:
+            broker.set_enabled(False)
+
+    def test_wavetopk_fetch_runs_off_lock_and_once(self):
+        """coalesce fix: the d2h fetch happens outside _WaveTopK._lock
+        and exactly once for any number of concurrent readers."""
+        from nomad_tpu.parallel.coalesce import _WaveTopK
+
+        fetches = []
+        holder = {}
+
+        class SlowDev:
+            def __init__(self, val):
+                self.val = val
+
+            def __array__(self, dtype=None, copy=None):
+                import numpy as np
+                assert not holder["wt"]._lock.locked(), \
+                    "device fetch ran under the lock"
+                fetches.append(1)
+                time.sleep(0.02)
+                return np.full(4, self.val)
+
+        wt = _WaveTopK(SlowDev(1), SlowDev(2))
+        holder["wt"] = wt
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(wt.host()))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert len(results) == 8
+        assert all(r is results[0] for r in results)
+        assert len(fetches) == 2        # idx + scores, fetched once
+
+    def test_store_snapshot_bytes_pickles_off_lock(self):
+        """store fix: to_snapshot_bytes serializes outside the store
+        lock (readers keep flowing during a big dump)."""
+        import nomad_tpu.state.store as store_mod
+        from nomad_tpu.state.store import StateStore
+
+        store = StateStore()
+        seen = []
+        orig = store_mod.pickle.dumps
+
+        def checking_dumps(obj, *a, **kw):
+            seen.append(store._lock._is_owned())
+            return orig(obj, *a, **kw)
+
+        store_mod.pickle = type("P", (), {
+            "dumps": staticmethod(checking_dumps),
+            "loads": staticmethod(store_mod.pickle.loads)})
+        try:
+            data = store.to_snapshot_bytes()
+        finally:
+            import pickle
+            store_mod.pickle = pickle
+        assert data and seen == [False]
+
+    def test_group_checker_folds_off_store_lock(self):
+        """plan_apply fix: _GroupFitChecker folds overlay entries
+        OUTSIDE the store lock (O(result) row prefetch under it)."""
+        from nomad_tpu import mock
+        from nomad_tpu.server.plan_apply import (
+            _GroupFitChecker,
+            _PlanOverlay,
+        )
+        from nomad_tpu.state.store import StateStore
+        from nomad_tpu.structs.eval_plan import PlanResult
+
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        alloc = mock.alloc(node_id=node.id)
+        store.upsert_allocs([alloc])
+        overlay = _PlanOverlay()
+        overlay.add(PlanResult(
+            node_update={node.id: [alloc]}, node_allocation={},
+            node_preemptions={}))
+        owned_during_fold = []
+        orig = _GroupFitChecker._fold_result
+
+        def checking_fold(self, r, rows):
+            owned_during_fold.append(store._lock._is_owned())
+            return orig(self, r, rows)
+
+        _GroupFitChecker._fold_result = checking_fold
+        try:
+            checker = _GroupFitChecker(store, overlay)
+        finally:
+            _GroupFitChecker._fold_result = orig
+        assert checker.ok
+        assert owned_during_fold == [False]
+
+    def test_liveview_uses_store_accessors(self):
+        """plan_apply R4 fix: _LiveView reads through the *_direct
+        accessors; functionally, a node's rows and the overlay merge
+        still come back right."""
+        from nomad_tpu import mock
+        from nomad_tpu.server.plan_apply import _LiveView
+        from nomad_tpu.state.store import StateStore
+
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        alloc = mock.alloc(node_id=node.id)
+        store.upsert_allocs([alloc])
+        view = _LiveView(store)
+        assert view.node_by_id(node.id) is store.node_by_id_direct(node.id)
+        got = view.allocs_by_node(node.id)
+        assert [a.id for a in got] == [alloc.id]
+
+    def test_ott_exchange_raft_delete_off_lock(self):
+        """server fix: the raft delete runs outside _ott_lock while the
+        claim set keeps the exchange single-use (functional single-use
+        coverage lives in tests/test_operator.py)."""
+        from nomad_tpu.acl.policy import ACLToken
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_workers=0))
+        srv.start()
+        try:
+            token = ACLToken.create(name="ops", type="management")
+            srv.raft_apply("ACLTokenUpsertRequestType",
+                           {"tokens": [token]})
+            ott = srv.create_one_time_token(token.accessor_id)
+            locked_during_delete = []
+            orig = srv.raft_apply
+
+            def checking_apply(kind, req):
+                if "OneTimeTokenDelete" in str(kind):
+                    locked_during_delete.append(
+                        srv._ott_lock.locked())
+                return orig(kind, req)
+
+            srv.raft_apply = checking_apply
+            try:
+                got = srv.exchange_one_time_token(
+                    ott["one_time_secret_id"])
+            finally:
+                srv.raft_apply = orig
+            assert got.accessor_id == token.accessor_id
+            assert locked_during_delete == [False]
+            with pytest.raises(ValueError):
+                srv.exchange_one_time_token(ott["one_time_secret_id"])
+        finally:
+            srv.shutdown()
+
+    def test_frozen_upload_off_registry_lock(self):
+        """device_state fix: a first-sight frozen upload runs outside
+        the registry lock; concurrent lookups upload once."""
+        import numpy as np
+        from nomad_tpu.tensors.device_state import DeviceClusterState
+
+        ds = DeviceClusterState()
+        arr = np.zeros(16, np.float32)
+        arr.setflags(write=False)
+        uploads = []
+        orig = DeviceClusterState._upload
+
+        def checking_upload(self, planes):
+            assert not self._lock.locked(), "upload ran under the lock"
+            uploads.append(1)
+            time.sleep(0.01)
+            return orig(self, planes)
+
+        DeviceClusterState._upload = checking_upload
+        try:
+            out = []
+            threads = [threading.Thread(
+                target=lambda: out.append(ds.lookup(arr)))
+                for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+        finally:
+            DeviceClusterState._upload = orig
+        assert len(uploads) == 1
+        assert all(o is out[0] and o is not None for o in out)
+
+    def test_state_db_pickles_before_lock(self, tmp_path):
+        """client StateDB fix: row serialization happens before the
+        sqlite connection lock is taken."""
+        import nomad_tpu.client.state_db as sdb
+        from nomad_tpu import mock
+
+        db = sdb.StateDB(str(tmp_path / "state.db"))
+        alloc = mock.alloc()
+        locked = []
+        orig = sdb.pickle.dumps
+
+        def checking_dumps(obj, *a, **kw):
+            locked.append(db._lock.locked())
+            return orig(obj, *a, **kw)
+
+        real_pickle = sdb.pickle
+        sdb.pickle = type("P", (), {
+            "dumps": staticmethod(checking_dumps),
+            "loads": staticmethod(real_pickle.loads)})
+        try:
+            db.put_allocation(alloc)
+            db.put_meta("k", {"v": 1})
+        finally:
+            sdb.pickle = real_pickle
+        assert locked and not any(locked)
+        assert [a.id for a in db.get_allocations()] == [alloc.id]
+        assert db.get_meta("k") == {"v": 1}
+
+    def test_membership_seal_off_lock(self):
+        """membership fix: datagram serialization happens outside the
+        membership lock."""
+        from nomad_tpu.server.membership import Membership
+
+        m = Membership(name="w1", probe_interval=60.0)
+        try:
+            sealed_locked = []
+            orig = Membership._seal
+
+            def checking_seal(self, msg):
+                sealed_locked.append(self._lock.locked())
+                return orig(self, msg)
+
+            Membership._seal = checking_seal
+            try:
+                m.leave()
+            finally:
+                Membership._seal = orig
+            assert sealed_locked == [False]
+        finally:
+            m.shutdown(leave=False)

@@ -18,6 +18,52 @@ pytestmark = [pytest.mark.stress, pytest.mark.slow]
 N_REPS = 20
 
 
+@pytest.fixture(autouse=True)
+def lock_witness():
+    """Every stress cell runs under the runtime lock witness (ISSUE 9):
+    brokers/coalescers/membership constructed inside the test get
+    order-checked, hold-timed locks, and a cell that executes an
+    acquisition-order inversion FAILS even if the interleaving never
+    actually deadlocked. Hold-time distributions land in the
+    ``lock_hold_*`` histograms (telemetry/histogram.py) as a side
+    effect — pull them when a cell's p99 regresses.
+
+    ``witness.enable()`` only instruments locks created AFTER it, and
+    the module-level singletons (coalesce's inflight/stat locks,
+    scaffold's cache lock) were created at import time as plain locks
+    — so the fixture swaps witnessed locks into them for the tier and
+    restores the originals after (no test may hold them across the
+    fixture boundary; pytest guarantees that)."""
+    import nomad_tpu.parallel.coalesce as co
+    import nomad_tpu.scheduler.scaffold as sc
+    from nomad_tpu.utils import witness
+
+    witness.reset()
+    witness.enable()
+    swapped = [
+        (co, "_INFLIGHT_LOCK", "coalesce._INFLIGHT_LOCK"),
+        (sc, "_LOCK", "scaffold._LOCK"),
+        (co.wave_stats, "_lock", "WaveStats._lock"),
+        (co.wave_latency_ewma, "_lock", "LatencyEWMA._lock"),
+        (co.wave_deadline_ewma, "_lock", "LatencyEWMA._lock"),
+        (co.default_cluster_cache, "_lock", "ClusterCache._lock"),
+    ]
+    originals = []
+    for obj, attr, name in swapped:
+        originals.append((obj, attr, getattr(obj, attr)))
+        setattr(obj, attr, witness.witness_lock(name))
+    yield
+    try:
+        assert witness.violations() == [], (
+            "lock-order inversion(s) under contention: "
+            f"{witness.violations()}")
+    finally:
+        for obj, attr, orig in originals:
+            setattr(obj, attr, orig)
+        witness.disable()
+        witness.reset()
+
+
 class TestBrokerContention:
     def test_concurrent_enqueue_dequeue_ack(self):
         """Producers enqueue while consumers dequeue/ack: every eval is

@@ -1,6 +1,5 @@
 """Tail-latency observability (ISSUE 8): streaming histograms,
-per-eval critical-path waterfalls, the slow-eval flight recorder, and
-the span-name drift guard.
+per-eval critical-path waterfalls, and the slow-eval flight recorder.
 
 Covers the acceptance surface directly:
 - histogram quantile estimates vs numpy.percentile within the bucket
@@ -12,8 +11,9 @@ Covers the acceptance surface directly:
   no captures when tracing is disabled
 - waterfall reduction: segment claims, applier-envelope overlap,
   coverage accounting, p50-vs-p99 aggregation
-- the drift guard: every literal span name the instrumented code
-  emits appears in docs/TELEMETRY.md's span table, and vice versa
+
+(The span-name drift guard moved to graftcheck R5 — see
+tests/test_graftcheck.py and docs/ANALYSIS.md.)
 """
 
 import math
@@ -413,68 +413,8 @@ class TestContentionCell:
         assert cell["tail"]["p50_coverage"] >= 0.85, cell["tail"]
 
 
-class TestSpanNameDriftGuard:
-    """Instrumentation and docs cannot silently diverge: every literal
-    span name emitted under nomad_tpu/ must appear in
-    docs/TELEMETRY.md's span table, and every documented span must
-    still exist in code. ``bg.*`` loop spans are named dynamically
-    after their loop functions and are covered as a prefix."""
-
-    #: the only dynamic span-name sites allowed, and what they expand
-    #: to (a new f-string site must either be added here with its
-    #: value set, or use a literal)
-    DYNAMIC = {
-        "kernel.{stage}": ("kernel.compile", "kernel.dispatch"),
-    }
-
-    def _emitted_names(self):
-        pat = re.compile(
-            r'tracer\.(?:span|record)\(\s*f?"([a-z0-9_.{}]+)"')
-        names = set()
-        src_root = os.path.join(REPO, "nomad_tpu")
-        for dirpath, _dirs, files in os.walk(src_root):
-            if "__pycache__" in dirpath:
-                continue
-            for fn in files:
-                if not fn.endswith(".py"):
-                    continue
-                with open(os.path.join(dirpath, fn)) as f:
-                    for m in pat.finditer(f.read()):
-                        names.add(m.group(1))
-        expanded = set()
-        for name in names:
-            if "{" in name:
-                assert name in self.DYNAMIC, (
-                    f"dynamic span name {name!r} is not registered in "
-                    "TestSpanNameDriftGuard.DYNAMIC — register its "
-                    "expansion or use a literal")
-                expanded.update(self.DYNAMIC[name])
-            elif not name.startswith("bg."):
-                expanded.add(name)
-        return expanded
-
-    def _documented_names(self):
-        doc = open(os.path.join(REPO, "docs", "TELEMETRY.md")).read()
-        section = doc.split("## Instrumented spans", 1)[1]
-        block = section.split("```", 2)[1]
-        names = set()
-        for line in block.splitlines():
-            tok = line.strip().split(" ", 1)[0]
-            if re.fullmatch(r"[a-z][a-z0-9_]*\.[a-z0-9_.]+", tok):
-                names.add(tok)
-        return names
-
-    def test_emitted_and_documented_span_names_agree(self):
-        emitted = self._emitted_names()
-        documented = self._documented_names()
-        # sanity: the scan actually found the hot path
-        assert "eval.schedule" in emitted
-        assert "eval.e2e" in emitted
-        undocumented = emitted - documented
-        assert not undocumented, (
-            f"spans emitted but missing from docs/TELEMETRY.md's "
-            f"span table: {sorted(undocumented)}")
-        stale = documented - emitted
-        assert not stale, (
-            f"spans documented in docs/TELEMETRY.md but no longer "
-            f"emitted: {sorted(stale)}")
+# The span-name drift guard that lived here (TestSpanNameDriftGuard)
+# became graftcheck's R5 engine rule — tools/graftcheck/
+# rules_telemetry.py, gated tier-1 by tests/test_graftcheck.py — which
+# keeps the both-direction span coverage and extends it to Prometheus
+# series names and bench emission keys.

@@ -1,0 +1,46 @@
+"""graftcheck: the repo's own static-analysis gate.
+
+Every throughput win since PR 2 rests on conventions the compiler
+cannot see — identity-shared frozen planes that must be *replaced,
+never mutated*; lock regions that must never contain device dispatch,
+blocking waits, or global-RNG serialization; jit-boundary functions
+that must stay pure so the warmup manifest keeps steady-state misses
+at 0; store access that must go through the snapshot / ``*_direct``
+accessors. Upstream Nomad leans on ``go vet`` and the race detector
+for exactly this class of invariant; this package is the Python port's
+equivalent: a stdlib-``ast`` rule engine with project-specific rules,
+run as a tier-1 gate against a committed baseline that may only
+shrink.
+
+Usage::
+
+    python -m tools.graftcheck nomad_tpu/
+    python -m tools.graftcheck --write-baseline   # after triage
+
+Rules (see docs/ANALYSIS.md for the catalog and rationale):
+
+- R1 frozen-plane mutation (`# graft: frozen` producer annotations)
+- R2 lock discipline (blocking/device work under a lock) + static
+  lock-acquisition-order graph with cycle detection
+- R3 jit-boundary hygiene (impure calls / mutable globals reachable
+  from ``jax.jit`` roots)
+- R4 store-access discipline (raw internal state outside state/store.py)
+- R5 telemetry drift (span names, Prometheus series, bench emission
+  keys vs docs/TELEMETRY.md, both directions)
+- H1-H4 stock hygiene (mutable default args, bare except, non-daemon
+  threads, dead locks)
+
+Suppression: append ``# graft: ok <RULE> - <justification>`` to the
+flagged line (or the line above). A justification is mandatory; an
+empty one is itself a finding. The runtime companion to R2 is
+``nomad_tpu/utils/witness.py``, the lock witness.
+"""
+
+from tools.graftcheck.engine import (  # noqa: F401
+    Engine,
+    Finding,
+    SourceFile,
+    default_engine,
+    load_baseline,
+    repo_root,
+)

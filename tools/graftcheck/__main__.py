@@ -1,0 +1,93 @@
+"""CLI: ``python -m tools.graftcheck [paths...]``.
+
+Exit status:
+  0  clean (no findings beyond the baseline, no stale baseline entries)
+  1  new findings, stale baseline entries, or unjustified suppressions
+
+The baseline may only shrink: a fixed finding whose fingerprint is
+still listed fails the run until the line is deleted (use
+``--write-baseline`` to regenerate after triage — the diff shows
+exactly what you are accepting or retiring).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.graftcheck.engine import default_engine, load_baseline, repo_root
+
+DEFAULT_BASELINE = os.path.join("tools", "graftcheck", "baseline.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="project static-analysis gate (rules R1-R5, H1-H4)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to scan (default: nomad_tpu/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline fingerprint file (relative to repo "
+                         "root)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="list inline-suppressed findings too")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    root = repo_root()
+    paths = args.paths or ["nomad_tpu"]
+    findings = default_engine().run_paths(paths, root)
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    baseline_path = os.path.join(root, args.baseline)
+    if args.write_baseline:
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write("# graftcheck baseline — may only shrink. Each "
+                    "entry is accepted debt;\n# delete lines as "
+                    "findings are fixed (the gate fails on stale "
+                    "entries).\n")
+            for fp in sorted({x.fingerprint for x in active}):
+                f.write(fp + "\n")
+        print(f"wrote {len(active)} fingerprint(s) to {baseline_path}")
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    current = {f.fingerprint for f in active}
+    new = [f for f in active if f.fingerprint not in baseline]
+    stale = sorted(baseline - current)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars_of(f) for f in new],
+            "stale_baseline": stale,
+            "suppressed": [vars_of(f) for f in suppressed],
+            "total": len(active),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for fp in stale:
+            print(f"stale baseline entry (fixed? delete it): {fp}")
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"suppressed: {f.render()} — {f.justification}")
+        n_base = len(current & baseline)
+        print(f"graftcheck: {len(new)} new finding(s), {len(stale)} "
+              f"stale baseline entr(ies), {n_base} baselined, "
+              f"{len(suppressed)} suppressed")
+    return 1 if (new or stale) else 0
+
+
+def vars_of(f) -> dict:
+    return {"rule": f.rule, "path": f.path, "line": f.line,
+            "message": f.message, "fingerprint": f.fingerprint}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

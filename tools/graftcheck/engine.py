@@ -1,0 +1,289 @@
+"""graftcheck core: source model, suppressions, baseline, runner.
+
+The engine is deliberately small: a ``SourceFile`` wraps one parsed
+module (AST + per-line comment directives), rules are objects with a
+``check(ctx)`` method that yield ``Finding``s over the whole file set
+(cross-file rules — the lock-order graph, the frozen-producer
+registry, the telemetry contract — need repo scope, so every rule
+gets it), and the runner folds in suppressions and the committed
+baseline.
+
+Baseline discipline: the baseline file may only SHRINK. A finding not
+in the baseline fails the gate (new debt), and a baseline entry whose
+finding no longer exists ALSO fails (stale entries must be deleted, so
+the file monotonically approaches empty instead of fossilizing).
+Fingerprints carry no line numbers — they survive unrelated edits.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "SourceFile", "Context", "Engine", "default_engine",
+    "load_baseline", "repo_root", "dotted_name",
+]
+
+#: suppression directive: ``# graft: ok R2 - why this is sound``
+_SUPPRESS_RE = re.compile(
+    r"#\s*graft:\s*ok\s+(?P<rules>[A-Z]\d+(?:\s*,\s*[A-Z]\d+)*)"
+    r"\s*(?:[-—:]\s*(?P<why>.*))?$")
+#: producer annotation consumed by R1: ``# graft: frozen``
+_FROZEN_RE = re.compile(r"#\s*graft:\s*frozen\b")
+
+
+def repo_root() -> str:
+    """The repository root (parent of the ``tools`` package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for a Name/Attribute chain, '' when not one."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        # rooted in a call/subscript/constant: keep the attr tail
+        parts.append("")
+    return ".".join(reversed(parts)).lstrip(".")
+
+
+class Finding:
+    """One rule hit. ``fingerprint`` is line-number-free on purpose:
+    baseline entries must survive edits elsewhere in the file."""
+
+    __slots__ = ("rule", "path", "line", "scope", "slug", "message",
+                 "suppressed", "justification")
+
+    def __init__(self, rule: str, path: str, line: int, scope: str,
+                 slug: str, message: str) -> None:
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.line = line
+        self.scope = scope
+        self.slug = slug
+        self.message = message
+        self.suppressed = False
+        self.justification = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.scope}|{self.slug}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+                f"  ({self.scope or '<module>'})")
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Finding {self.render()}>"
+
+
+class SourceFile:
+    """One parsed module plus its comment directives and parent map."""
+
+    def __init__(self, rel: str, text: str) -> None:
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        #: line -> (rules, justification) suppressions
+        self.suppressions: Dict[int, Tuple[Set[str], str]] = {}
+        #: lines carrying a ``# graft: frozen`` producer annotation
+        self.frozen_lines: Set[int] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group("rules").split(",")}
+                self.suppressions[i] = (rules, (m.group("why") or "").strip())
+            if _FROZEN_RE.search(line):
+                self.frozen_lines.add(i)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    @classmethod
+    def from_path(cls, path: str, root: str) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            return cls(os.path.relpath(path, root), f.read())
+
+    @property
+    def module(self) -> str:
+        """Module basename without extension (lock-graph qualifier)."""
+        return os.path.splitext(os.path.basename(self.rel))[0]
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Qualified enclosing def/class chain, e.g. ``EvalBroker.nack``."""
+        names: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(names))
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a def nested in a def inside a class: still that class
+                cur = self._parents.get(cur)
+                continue
+            cur = self._parents.get(cur)
+        return None
+
+    def suppression_for(self, line: int, rule: str):
+        """(found, justification) for ``rule`` at ``line`` — the
+        directive may sit on the flagged line or the one above."""
+        for ln in (line, line - 1):
+            ent = self.suppressions.get(ln)
+            if ent and rule in ent[0]:
+                return True, ent[1]
+        return False, ""
+
+    def has_frozen_annotation(self, node: ast.AST) -> bool:
+        """``# graft: frozen`` on the node's first line or the line
+        above (covers decorated defs via the line above the def)."""
+        line = getattr(node, "lineno", 0)
+        return line in self.frozen_lines or (line - 1) in self.frozen_lines
+
+
+class Context:
+    """Everything a rule may look at: the scanned file set plus repo
+    side-channels (docs, bench sources) resolved lazily so fixture
+    tests can inject their own."""
+
+    def __init__(self, files: Sequence[SourceFile], root: str,
+                 extra_texts: Optional[Dict[str, str]] = None) -> None:
+        self.files = list(files)
+        self.root = root
+        #: relpath -> raw text overrides (fixture tests inject docs/bench)
+        self.extra_texts = dict(extra_texts or {})
+
+    def read(self, rel: str) -> Optional[str]:
+        """Raw text of a repo file (override-aware); None if absent."""
+        if rel in self.extra_texts:
+            return self.extra_texts[rel]
+        path = os.path.join(self.root, rel)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+
+class Engine:
+    def __init__(self, rules: Sequence[object]) -> None:
+        self.rules = list(rules)
+
+    def run(self, ctx: Context) -> List[Finding]:
+        """All findings, suppressions folded in (suppressed findings
+        are returned flagged, so callers can list them; an empty
+        justification downgrades the suppression to a finding of its
+        own — the baseline's honesty depends on the inline reasons)."""
+        findings: List[Finding] = []
+        by_rel = {src.rel: src for src in self.files_of(ctx)}
+        for rule in self.rules:
+            for f in rule.check(ctx):
+                src = by_rel.get(f.path)
+                if src is not None:
+                    hit, why = src.suppression_for(f.line, f.rule)
+                    if hit:
+                        if not why:
+                            findings.append(Finding(
+                                f.rule, f.path, f.line, f.scope,
+                                f.slug + "|unjustified",
+                                "suppression without a justification: "
+                                "append '- <why>' to the graft: ok "
+                                "directive"))
+                            continue
+                        f.suppressed = True
+                        f.justification = why
+                findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return findings
+
+    @staticmethod
+    def files_of(ctx: Context) -> List[SourceFile]:
+        return ctx.files
+
+    # --- convenience entry points ---------------------------------------
+
+    def run_paths(self, paths: Sequence[str],
+                  root: Optional[str] = None) -> List[Finding]:
+        root = root or repo_root()
+        files: List[SourceFile] = []
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isdir(ap):
+                for dirpath, dirs, names in os.walk(ap):
+                    dirs[:] = sorted(d for d in dirs
+                                     if d != "__pycache__")
+                    for fn in sorted(names):
+                        if fn.endswith(".py"):
+                            files.append(SourceFile.from_path(
+                                os.path.join(dirpath, fn), root))
+            elif ap.endswith(".py"):
+                files.append(SourceFile.from_path(ap, root))
+        return self.run(Context(files, root))
+
+    def run_texts(self, texts: Dict[str, str],
+                  extra_texts: Optional[Dict[str, str]] = None,
+                  root: Optional[str] = None) -> List[Finding]:
+        """Fixture entry point: ``texts`` maps relpath -> source."""
+        files = [SourceFile(rel, text) for rel, text in texts.items()]
+        return self.run(Context(files, root or repo_root(), extra_texts))
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Baseline fingerprints (one per line; ``#`` comments allowed)."""
+    if not os.path.exists(path):
+        return set()
+    out: Set[str] = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def default_engine() -> Engine:
+    """The full production rule set (what the CLI and the tier-1 gate
+    run). Imported lazily so fixture tests can build partial engines
+    without paying for every rule's setup."""
+    from tools.graftcheck.rules_frozen import FrozenPlaneRule
+    from tools.graftcheck.rules_hygiene import (
+        BareExceptRule,
+        DeadLockRule,
+        MutableDefaultRule,
+        NonDaemonThreadRule,
+    )
+    from tools.graftcheck.rules_jit import JitHygieneRule
+    from tools.graftcheck.rules_locks import LockDisciplineRule
+    from tools.graftcheck.rules_store import StoreAccessRule
+    from tools.graftcheck.rules_telemetry import TelemetryDriftRule
+
+    return Engine([
+        FrozenPlaneRule(),
+        LockDisciplineRule(),
+        JitHygieneRule(),
+        StoreAccessRule(),
+        TelemetryDriftRule(),
+        MutableDefaultRule(),
+        BareExceptRule(),
+        NonDaemonThreadRule(),
+        DeadLockRule(),
+    ])

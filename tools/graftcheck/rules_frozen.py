@@ -1,0 +1,176 @@
+"""R1: frozen-plane mutation.
+
+The sharing layers (frozen neutral singletons in ops/kernel.py, the
+feasibility mask cache, the lean-placement skeletons in
+scheduler/scaffold.py, the device-resident frozen registry) hand the
+SAME object to every wave member by identity. The repo-wide soundness
+convention is *replace, never mutate*: one in-place write on a shared
+plane corrupts every eval holding it — numpy's ``writeable=False``
+catches array writes at runtime, but dict/struct skeletons have no
+such guard, and a runtime raise in a rare wave shape is still a prod
+incident a static rule prevents for free.
+
+Producers are seeded by the ``# graft: frozen`` annotation on the
+``def`` line (or the line above): any value assigned from a call to an
+annotated producer — including tuple unpacking — is tainted in that
+function, and in-place mutation of a tainted name is a finding:
+
+- subscript assignment / deletion (``x[...] = v``, ``del x[...]``)
+- augmented assignment (``x += v`` mutates ndarrays in place; for a
+  tainted name the rebinding reading is never what the author meant)
+- mutating method calls (``fill``, ``sort``, ``setflags``, ``put``,
+  ``resize``, ``update``, ``pop``, ``clear``, ``append``, ...)
+- ``np.copyto(x, ...)`` / ``np.place`` / ``np.putmask`` first-arg
+
+Attribute reads off a tainted name stay tainted (``planes.zeros_f32``
+is as frozen as ``planes``); REBINDING a tainted name un-taints it
+(that is exactly the sanctioned copy-on-write move).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from tools.graftcheck.engine import Context, Finding, SourceFile, dotted_name
+
+RULE = "R1"
+
+#: method calls that mutate their receiver in place
+_MUTATORS = {
+    "fill", "sort", "setflags", "put", "resize", "partition",
+    "byteswap", "update", "pop", "popitem", "clear", "append",
+    "extend", "insert", "remove", "setdefault", "add", "discard",
+}
+#: numpy free functions that mutate their FIRST argument
+_NP_FIRSTARG_MUTATORS = {"copyto", "place", "putmask"}
+
+
+def _collect_producers(files) -> Set[str]:
+    """Names of ``# graft: frozen`` annotated defs across the file set."""
+    producers: Set[str] = set()
+    for src in files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and src.has_frozen_annotation(node):
+                producers.add(node.name)
+    return producers
+
+
+class FrozenPlaneRule:
+    rule_id = RULE
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        producers = _collect_producers(ctx.files)
+        if not producers:
+            return
+        for src in ctx.files:
+            for fn in ast.walk(src.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_function(src, fn, producers)
+
+    # -- per-function dataflow -------------------------------------------
+
+    def _check_function(self, src: SourceFile, fn, producers: Set[str]):
+        tainted: Set[str] = set()
+        # one forward pass in source order: taint propagation and
+        # mutation checks interleave, and rebinding un-taints — good
+        # enough for the straight-line producer/consumer code this
+        # repo writes (no fixpoint needed for the invariant to hold:
+        # a miss is a false negative, never a false positive)
+        body_nodes: List[ast.stmt] = list(fn.body)
+        seen: Set[tuple] = set()
+        for stmt in body_nodes:
+            for f in self._visit_stmt(src, stmt, tainted, producers):
+                key = (f.line, f.slug)
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+
+    def _is_producer_call(self, node: ast.AST, producers: Set[str],
+                          tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func).rsplit(".", 1)[-1]
+            return name in producers
+        # attribute read off a tainted name stays tainted
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            return self._root_tainted(node, tainted)
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        return False
+
+    @staticmethod
+    def _root_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id in tainted
+
+    def _visit_stmt(self, src: SourceFile, stmt: ast.stmt,
+                    tainted: Set[str], producers: Set[str]):
+        # --- taint bookkeeping on assignments ---
+        if isinstance(stmt, ast.Assign):
+            is_frozen_src = self._is_producer_call(
+                stmt.value, producers, tainted)
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    (tainted.add if is_frozen_src
+                     else tainted.discard)(tgt.id)
+                elif isinstance(tgt, ast.Tuple) and is_frozen_src:
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            tainted.add(el.id)
+                elif isinstance(tgt, (ast.Subscript,)):
+                    if self._root_tainted(tgt, tainted):
+                        yield self._finding(
+                            src, stmt, tgt,
+                            "subscript assignment into a frozen value")
+        elif isinstance(stmt, ast.AugAssign):
+            tgt = stmt.target
+            if self._root_tainted(tgt, tainted):
+                yield self._finding(
+                    src, stmt, tgt,
+                    "augmented assignment mutates a frozen value in "
+                    "place")
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and self._root_tainted(tgt, tainted):
+                    yield self._finding(
+                        src, stmt, tgt, "del into a frozen value")
+        # --- mutating calls anywhere in the statement ---
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in _MUTATORS \
+                        and self._root_tainted(func.value, tainted):
+                    yield self._finding(
+                        src, node, func.value,
+                        f".{func.attr}() mutates a frozen value in "
+                        "place")
+                d = dotted_name(func)
+                if d.rsplit(".", 1)[-1] in _NP_FIRSTARG_MUTATORS \
+                        and node.args \
+                        and self._root_tainted(node.args[0], tainted):
+                    yield self._finding(
+                        src, node, node.args[0],
+                        f"{d}() writes into a frozen first argument")
+        # --- recurse into compound statements (same taint scope) ---
+        for field in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, field, []) or []:
+                yield from self._visit_stmt(src, sub, tainted, producers)
+        for handler in getattr(stmt, "handlers", []) or []:
+            for sub in handler.body:
+                yield from self._visit_stmt(src, sub, tainted, producers)
+
+    def _finding(self, src: SourceFile, node: ast.AST, target: ast.AST,
+                 what: str) -> Finding:
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        tname = dotted_name(target) or "<expr>"
+        return Finding(
+            RULE, src.rel, getattr(node, "lineno", 0),
+            src.scope_of(node), f"mutate:{tname}",
+            f"frozen-plane mutation: {what} ({tname}); shared planes "
+            f"are replaced, never mutated (copy first)")

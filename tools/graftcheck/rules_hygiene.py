@@ -1,0 +1,154 @@
+"""Stock hygiene rules (H1-H4): generic Python thread/footgun classes.
+
+These stay ON in the gate — they are cheap, their false-positive rate
+in this codebase is zero, and each guards a failure mode this repo has
+already paid for once (PR 1's flap race came from an unjoined
+thread-per-event dispatch; a leaked non-daemon thread is how a test
+suite wedges CI).
+
+- H1 mutable default argument (``def f(x=[])`` shares one list across
+  calls — with 65 thread-using modules that is shared mutable state)
+- H2 bare ``except:`` (swallows KeyboardInterrupt/SystemExit; the
+  repo's convention is ``except Exception`` + noqa with a reason)
+- H3 non-daemon thread spawn (a forgotten ``daemon=True`` turns any
+  crash path into a process that never exits)
+- H4 dead lock (a lock created but never acquired documents a
+  synchronization intent the code does not actually have — either the
+  guarded accesses are racy or the lock is vestigial)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftcheck.engine import Context, Finding, SourceFile, dotted_name
+
+_MUTABLE_CALLS = {"list", "dict", "set", "bytearray"}
+
+
+class MutableDefaultRule:
+    rule_id = "H1"
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        for src in ctx.files:
+            for fn in ast.walk(src.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                for default in list(fn.args.defaults) + [
+                        d for d in fn.args.kw_defaults if d is not None]:
+                    bad = isinstance(default, (ast.List, ast.Dict,
+                                               ast.Set))
+                    if isinstance(default, ast.Call):
+                        bad = dotted_name(default.func) in _MUTABLE_CALLS
+                    if bad:
+                        yield Finding(
+                            "H1", src.rel, default.lineno,
+                            src.scope_of(fn), f"default:{fn.name}",
+                            f"mutable default argument in {fn.name}(): "
+                            f"one instance is shared across every "
+                            f"call — default to None and allocate "
+                            f"inside")
+
+
+class BareExceptRule:
+    rule_id = "H2"
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        for src in ctx.files:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ExceptHandler) \
+                        and node.type is None:
+                    yield Finding(
+                        "H2", src.rel, node.lineno, src.scope_of(node),
+                        "bare-except",
+                        "bare `except:` swallows KeyboardInterrupt/"
+                        "SystemExit — catch Exception (with a reason) "
+                        "instead")
+
+
+class NonDaemonThreadRule:
+    rule_id = "H3"
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        for src in ctx.files:
+            # names that get `.daemon = True` assigned somewhere in the
+            # file (the two-step construction idiom)
+            daemonized: Set[str] = set()
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and tgt.attr == "daemon":
+                            root = dotted_name(tgt.value)
+                            if root:
+                                daemonized.add(root)
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted_name(node.func).rsplit(".", 1)[-1] != "Thread":
+                    continue
+                if "Thread" not in dotted_name(node.func):
+                    continue
+                if any(kw.arg == "daemon" for kw in node.keywords):
+                    continue
+                parent = src.parent(node)
+                tgt_name = ""
+                if isinstance(parent, ast.Assign) and parent.targets:
+                    tgt_name = dotted_name(parent.targets[0])
+                if tgt_name and tgt_name in daemonized:
+                    continue
+                yield Finding(
+                    "H3", src.rel, node.lineno, src.scope_of(node),
+                    "non-daemon-thread",
+                    "threading.Thread(...) without daemon=True: a "
+                    "crash elsewhere leaves the process wedged on "
+                    "this thread")
+
+
+class DeadLockRule:
+    """H4: lock attributes created but never used anywhere."""
+
+    rule_id = "H4"
+
+    _CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore"}
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        created: Dict[str, Tuple[SourceFile, ast.AST, str]] = {}
+        used: Set[str] = set()
+        for src in ctx.files:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    ctor = dotted_name(node.value.func).rsplit(".", 1)[-1]
+                    if ctor in self._CTORS:
+                        for tgt in node.targets:
+                            d = dotted_name(tgt)
+                            if d.startswith("self."):
+                                attr = d[5:]
+                                cls = src.enclosing_class(node)
+                                owner = (cls.name if cls is not None
+                                         else src.module)
+                                created[f"{owner}.{attr}"] = (
+                                    src, node, attr)
+                elif isinstance(node, ast.Attribute) \
+                        and not self._is_creation_target(src, node):
+                    used.add(node.attr)
+                elif isinstance(node, ast.Name):
+                    used.add(node.id)
+        for key, (src, node, attr) in sorted(created.items()):
+            if attr in used:
+                continue
+            yield Finding(
+                "H4", src.rel, node.lineno, src.scope_of(node),
+                f"dead-lock:{key}",
+                f"lock `{key}` is created but never acquired anywhere "
+                f"— either the accesses it was meant to guard are "
+                f"racy, or it is vestigial and should be deleted")
+
+    @staticmethod
+    def _is_creation_target(src: SourceFile, node: ast.Attribute) -> bool:
+        parent = src.parent(node)
+        return isinstance(parent, ast.Assign) and node in parent.targets

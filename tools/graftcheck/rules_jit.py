@@ -1,0 +1,167 @@
+"""R3: jit-boundary hygiene.
+
+Functions compiled by ``jax.jit`` trace once per (shape, static-arg)
+signature and then replay the traced program. Host-side effects inside
+them either silently vanish (logging, counters), leak tracers (reads
+of mutable module globals captured at trace time), or — worst —
+introduce trace-time dependence on process state that forks compiled
+variants the AOT warmup manifest (ops/warmup.py) can never enumerate,
+re-opening the steady-state recompile tax PR 2 closed. The manifest
+only DETECTS that drift after the fact (a miss counter in CI); this
+rule rejects the introduction.
+
+Jit roots are found syntactically: ``X = jax.jit(f, ...)`` at module
+level, ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators, and
+``jax.jit(inner)`` over a nested def. From each root the rule walks
+same-module callees transitively and flags:
+
+- calls into ``time.*`` / ``random.*`` / ``np.random.*`` /
+  ``logging.*`` / ``print`` / ``open`` (host effects at trace time)
+- ``global`` statements (trace-time mutation of module state)
+- reads of *mutable* module globals — names the module rebinds via
+  ``global`` in any function or augments at module level. Module
+  CONSTANTS (bucket tables, feature defaults) are fine and common.
+
+``jax.debug.print`` / ``jax.random`` are the sanctioned in-graph
+equivalents and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.graftcheck.engine import Context, Finding, SourceFile, dotted_name
+
+RULE = "R3"
+
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "logging.")
+_IMPURE_TERMINALS = {"print", "open", "getLogger", "perf_counter",
+                     "monotonic", "thread_time", "urandom"}
+_SANCTIONED_PREFIXES = ("jax.random.", "jax.debug.", "jrandom.")
+
+
+def _jit_wrapped_names(src: SourceFile) -> Set[str]:
+    """Names of defs reachable as jit roots in this module."""
+    roots: Set[str] = set()
+
+    def is_jit(call: ast.Call) -> Optional[ast.AST]:
+        d = dotted_name(call.func)
+        if d in ("jax.jit", "jit", "pjit", "jax.pjit"):
+            return call.args[0] if call.args else None
+        if d.rsplit(".", 1)[-1] == "partial" and call.args:
+            inner = dotted_name(call.args[0])
+            if inner in ("jax.jit", "jit"):
+                # functools.partial(jax.jit, static_argnums=...)
+                # used as a decorator: the decorated def is the root
+                return True
+        return None
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if dotted_name(dec) in ("jax.jit", "jit"):
+                    roots.add(node.name)
+                elif isinstance(dec, ast.Call) and is_jit(dec) is not None:
+                    roots.add(node.name)
+        elif isinstance(node, ast.Call):
+            target = is_jit(node)
+            if isinstance(target, ast.AST):
+                name = dotted_name(target)
+                if name and "." not in name:
+                    roots.add(name)
+    return roots
+
+
+def _mutable_globals(src: SourceFile) -> Set[str]:
+    """Module names rebound at runtime: ``global X`` targets that are
+    assigned in some function, plus module-level augmented targets."""
+    out: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    for node in src.tree.body:
+        if isinstance(node, ast.AugAssign) and isinstance(node.target,
+                                                          ast.Name):
+            out.add(node.target.id)
+    return out
+
+
+class JitHygieneRule:
+    rule_id = RULE
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        for src in ctx.files:
+            roots = _jit_wrapped_names(src)
+            if not roots:
+                continue
+            defs: Dict[str, ast.AST] = {}
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    defs.setdefault(node.name, node)
+            mutable = _mutable_globals(src)
+            visited: Set[str] = set()
+            queue: List[str] = sorted(roots)
+            while queue:
+                name = queue.pop()
+                if name in visited or name not in defs:
+                    continue
+                visited.add(name)
+                fn = defs[name]
+                yield from self._check_fn(src, fn, mutable)
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call):
+                        callee = dotted_name(sub.func)
+                        if callee and "." not in callee \
+                                and callee in defs:
+                            queue.append(callee)
+
+    def _check_fn(self, src: SourceFile, fn, mutable: Set[str]):
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        local_names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = (node.targets if isinstance(node, ast.Assign)
+                        else [node.target])
+                for t in tgts:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            local_names.add(sub.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        local_names.add(sub.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                yield Finding(
+                    RULE, src.rel, node.lineno, src.scope_of(node),
+                    f"global:{','.join(node.names)}",
+                    f"`global {', '.join(node.names)}` inside a "
+                    f"jit-reachable function {fn.name}(): trace-time "
+                    f"module mutation")
+            elif isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if not d or d.startswith(_SANCTIONED_PREFIXES):
+                    continue
+                term = d.rsplit(".", 1)[-1]
+                if d.startswith(_IMPURE_PREFIXES) \
+                        or (term in _IMPURE_TERMINALS and "." not in d):
+                    yield Finding(
+                        RULE, src.rel, node.lineno, src.scope_of(node),
+                        f"impure:{d}",
+                        f"impure call {d}() inside jit-reachable "
+                        f"{fn.name}(): host effects do not survive "
+                        f"tracing and fork compiled variants")
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in mutable \
+                    and node.id not in params \
+                    and node.id not in local_names:
+                yield Finding(
+                    RULE, src.rel, node.lineno, src.scope_of(node),
+                    f"mutable-global:{node.id}",
+                    f"jit-reachable {fn.name}() reads mutable module "
+                    f"global `{node.id}`: the value is baked in at "
+                    f"trace time (pass it as an argument instead)")

@@ -1,0 +1,312 @@
+"""R2: lock discipline + static lock-acquisition-order graph.
+
+Two findings classes:
+
+**Blocking work under a lock.** A ``with <lock>:`` region must never
+contain device dispatch (``device_put`` / ``block_until_ready`` /
+kernel launches), plane fetches (``np.asarray`` on the d2h path),
+sleeps, serialization (``pickle``/``json`` dumps/loads), thread joins,
+blocking waits on FOREIGN synchronization objects, or global-RNG
+serialization (``generate_uuid`` routes every caller through one
+module lock — the PR 5 lesson). One ``device_put`` under the broker
+lock serializes the whole pipeline behind a PCIe transfer; nothing
+else catches it until a bench regresses. ``Condition.wait`` on a
+condition constructed over the SAME held lock is whitelisted (wait
+releases it) — the rule resolves ``self._cond =
+threading.Condition(self._lock)`` wiring per class.
+
+**Lock-order cycles.** Every syntactic nesting ``with A: ... with B:``
+contributes an edge A→B; calls to same-class methods and to uniquely
+named repo functions that acquire locks contribute edges one level
+deep. A cycle in the resulting graph is a potential deadlock the
+interleaving just hasn't hit yet. The runtime companion
+(``nomad_tpu/utils/witness.py``) checks the same property on the
+orders that actually executed.
+
+Lock identity is best-effort static naming (``Class.attr`` for
+``self.X``, ``module:NAME`` for globals, ``recv.attr`` otherwise);
+the witness is the ground truth for identities the static view cannot
+unify.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftcheck.engine import Context, Finding, SourceFile, dotted_name
+
+RULE = "R2"
+
+#: what counts as a lock expression in a ``with``: terminal-name match
+LOCKISH = re.compile(r"(?i)(?:^|_)(?:lock|cv|cond|mutex)$|(?<![a-z])lock$")
+
+#: full dotted names that block / dispatch / serialize
+_BLOCKING_DOTTED = {
+    "time.sleep", "jax.device_put", "np.asarray", "jnp.asarray",
+    "numpy.asarray", "pickle.dumps", "pickle.loads", "json.dumps",
+    "json.loads", "os.urandom",
+}
+#: terminal call names that block regardless of receiver
+_BLOCKING_TERMINAL = {
+    "device_put", "block_until_ready", "launch_wave",
+    "default_kernel_launch", "place_taskgroup_jit",
+    "place_taskgroup_topk_jit", "place_taskgroups_joint_jit",
+    "apply_batch", "raft_apply", "_raft_apply", "generate_uuid",
+    "urandom", "block_until",
+}
+#: ``x.join()`` blocks only for thread-ish receivers (str.join is not
+#: a finding); receiver terminal name must match
+_JOINISH_RECV = re.compile(r"(?i)thread|proc|worker|in_flight|future")
+
+
+def _lock_id(src: SourceFile, node: ast.AST, expr: ast.AST) -> Optional[str]:
+    """Best-effort stable name for a lock expression."""
+    d = dotted_name(expr)
+    if not d:
+        return None
+    term = d.rsplit(".", 1)[-1]
+    if not LOCKISH.search(term):
+        return None
+    parts = d.split(".")
+    if parts[0] in ("self", "cls"):
+        cls = src.enclosing_class(node)
+        owner = cls.name if cls is not None else src.module
+        return f"{owner}.{'.'.join(parts[1:])}"
+    if len(parts) == 1:
+        return f"{src.module}:{d}"
+    return d
+
+
+class _ClassInfo:
+    """Per-class lock wiring: which conditions wrap which locks, and
+    which locks each method acquires directly."""
+
+    def __init__(self) -> None:
+        self.cond_of: Dict[str, str] = {}       # cond attr -> lock attr
+        self.method_locks: Dict[str, Set[str]] = {}
+        #: method -> unambiguous blocking calls lexically in its body
+        #: (one-level resolution: a helper the hot path calls under a
+        #: lock must not hide device/RNG/serialization work)
+        self.method_blocking: Dict[str, List[Tuple[str, int]]] = {}
+
+
+def _collect_class_info(src: SourceFile) -> Dict[str, _ClassInfo]:
+    out: Dict[str, _ClassInfo] = {}
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        info = out.setdefault(cls.name, _ClassInfo())
+        for node in ast.walk(cls):
+            # self._cond = threading.Condition(self._lock)
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                callee = dotted_name(node.value.func)
+                if callee.rsplit(".", 1)[-1] == "Condition":
+                    for tgt in node.targets:
+                        td = dotted_name(tgt)
+                        if td.startswith("self.") and node.value.args:
+                            lk = dotted_name(node.value.args[0])
+                            if lk.startswith("self."):
+                                info.cond_of[td[5:]] = lk[5:]
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            locks: Set[str] = set()
+            blocking: List[Tuple[str, int]] = []
+            for node in ast.walk(meth):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lid = _lock_id(src, node, item.context_expr)
+                        if lid:
+                            locks.add(lid)
+                elif isinstance(node, ast.Call):
+                    d = dotted_name(node.func)
+                    term = d.rsplit(".", 1)[-1] if d else ""
+                    if d in _BLOCKING_DOTTED or term in _BLOCKING_TERMINAL:
+                        blocking.append((d or term, node.lineno))
+            info.method_locks[meth.name] = locks
+            if blocking:
+                info.method_blocking[meth.name] = blocking
+    return out
+
+
+class LockDisciplineRule:
+    rule_id = RULE
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        class_infos: Dict[str, _ClassInfo] = {}
+        # uniquely named module functions that acquire module locks
+        # (cross-module edge resolution, e.g. generate_uuid)
+        fn_locks: Dict[str, List[Set[str]]] = {}
+        for src in ctx.files:
+            for name, info in _collect_class_info(src).items():
+                class_infos[name] = info
+            for node in src.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    locks: Set[str] = set()
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.With):
+                            for item in sub.items:
+                                lid = _lock_id(src, sub, item.context_expr)
+                                if lid:
+                                    locks.add(lid)
+                    if locks:
+                        fn_locks.setdefault(node.name, []).append(locks)
+        unique_fn_locks = {name: lst[0] for name, lst in fn_locks.items()
+                           if len(lst) == 1}
+
+        edges: Dict[str, Set[str]] = {}
+        edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+        for src in ctx.files:
+            info_map = _collect_class_info(src)
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.With):
+                    continue
+                held = [
+                    lid for item in node.items
+                    if (lid := _lock_id(src, node, item.context_expr))
+                ]
+                if not held:
+                    continue
+                cls = src.enclosing_class(node)
+                cinfo = info_map.get(cls.name) if cls is not None else None
+                yield from self._scan_region(
+                    src, node, held, cinfo, class_infos,
+                    unique_fn_locks, edges, edge_sites)
+
+        yield from self._cycles(edges, edge_sites)
+
+    # -- one with-lock region --------------------------------------------
+
+    def _scan_region(self, src: SourceFile, region: ast.With,
+                     held: List[str], cinfo, class_infos,
+                     unique_fn_locks, edges, edge_sites):
+        held_attrs = {h.rsplit(".", 1)[-1] for h in held}
+        for node in self._walk_region(region):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = _lock_id(src, node, item.context_expr)
+                    if lid:
+                        for h in held:
+                            if lid != h:
+                                self._edge(h, lid, src, node,
+                                           edges, edge_sites)
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            term = d.rsplit(".", 1)[-1] if d else ""
+            # cross-function lock edges: self-method calls + unique
+            # repo functions that acquire locks
+            callee_locks: Set[str] = set()
+            if d.startswith("self.") and cinfo is not None:
+                callee_locks = cinfo.method_locks.get(term, set())
+            elif term in unique_fn_locks and "." not in d:
+                callee_locks = unique_fn_locks[term]
+            for lid in callee_locks:
+                for h in held:
+                    if lid != h:
+                        self._edge(h, lid, src, node, edges, edge_sites)
+            # one-level blocking resolution: a self-method called under
+            # the lock must not hide blocking work in its body
+            if d.startswith("self.") and cinfo is not None:
+                for what, line in cinfo.method_blocking.get(term, ()):
+                    yield Finding(
+                        RULE, src.rel, node.lineno, src.scope_of(node),
+                        f"blocking-via:{term}:{what}",
+                        f"self.{term}() called inside `with "
+                        f"{'/'.join(held)}` runs blocking call "
+                        f"{what}() (line {line}): move it off the "
+                        f"lock")
+            # blocking-call findings
+            blocked = None
+            if d in _BLOCKING_DOTTED:
+                blocked = d
+            elif term in _BLOCKING_TERMINAL:
+                blocked = d or term
+            elif term == "wait" and isinstance(node.func, ast.Attribute):
+                if not self._is_same_lock_condition(
+                        node.func.value, held, held_attrs, cinfo):
+                    blocked = d or "wait"
+            elif term == "join" and isinstance(node.func, ast.Attribute):
+                recv = dotted_name(node.func.value)
+                if recv and _JOINISH_RECV.search(recv.rsplit(".", 1)[-1]):
+                    blocked = d
+            if blocked:
+                yield Finding(
+                    RULE, src.rel, node.lineno, src.scope_of(node),
+                    f"blocking:{blocked}",
+                    f"blocking call {blocked}() inside `with "
+                    f"{'/'.join(held)}`: move device/IO/serialization "
+                    f"work off the lock")
+
+    @staticmethod
+    def _walk_region(region: ast.With):
+        """Region body, excluding nested defs (they run later)."""
+        stack: List[ast.AST] = list(region.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _is_same_lock_condition(recv: ast.AST, held: List[str],
+                                held_attrs: Set[str], cinfo) -> bool:
+        """wait() on the held condition itself, or on a condition the
+        class constructed over a held lock, releases the lock: fine."""
+        d = dotted_name(recv)
+        if not d:
+            return False
+        term = d.rsplit(".", 1)[-1]
+        if term in held_attrs:
+            return True
+        if cinfo is not None and d.startswith("self."):
+            wrapped = cinfo.cond_of.get(d[5:])
+            if wrapped is not None and wrapped in held_attrs:
+                return True
+        return False
+
+    # -- order graph ------------------------------------------------------
+
+    @staticmethod
+    def _edge(a: str, b: str, src: SourceFile, node: ast.AST,
+              edges, edge_sites) -> None:
+        edges.setdefault(a, set()).add(b)
+        edge_sites.setdefault((a, b), (src.rel, node.lineno))
+
+    def _cycles(self, edges: Dict[str, Set[str]],
+                edge_sites) -> Iterable[Finding]:
+        """Report each strongly-connected cycle once, canonically."""
+        seen: Set[Tuple[str, ...]] = set()
+        for start in sorted(edges):
+            path: List[str] = []
+            on_path: Set[str] = set()
+
+            def dfs(n: str):
+                if n in on_path:
+                    cyc = path[path.index(n):] + [n]
+                    nodes = tuple(sorted(set(cyc)))
+                    if nodes not in seen:
+                        seen.add(nodes)
+                        a, b = cyc[0], cyc[1]
+                        rel, line = edge_sites.get((a, b), ("", 0))
+                        yield Finding(
+                            RULE, rel, line, "",
+                            "lock-cycle:" + "->".join(nodes),
+                            "lock-acquisition-order cycle: "
+                            + " -> ".join(cyc)
+                            + " (potential deadlock; fix the order or "
+                              "document a witness-verified exemption)")
+                    return
+                path.append(n)
+                on_path.add(n)
+                for m in sorted(edges.get(n, ())):
+                    yield from dfs(m)
+                path.pop()
+                on_path.discard(n)
+
+            yield from dfs(start)

@@ -1,0 +1,69 @@
+"""R4: store-access discipline.
+
+``StateStore``'s tables and lock are implementation details; every
+consumer outside ``nomad_tpu/state/store.py`` must go through the
+snapshot (``store.snapshot()``), the locked ``*_direct`` readers
+(``node_by_id_direct`` / ``alloc_by_id_direct`` /
+``allocs_by_node_direct``), or the scoped view helpers
+(``with_usage_view`` / ``with_allocs``) PR 6 introduced. Raw
+``store._tables`` access re-opens the exact coupling those accessors
+were built to close: a reader that grabs ``_allocs`` under its own
+idea of the lock (or none) races the FSM's writes, and a change to
+the store's internal layout silently breaks every out-of-module
+reader instead of one accessor.
+
+The rule flags attribute access to a known-internal name when the
+receiver smells like a store (``store`` / ``_store`` / ``state`` /
+``state_store`` terminal name). ``nomad_tpu/state/store.py`` itself is
+exempt (the internals live there).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tools.graftcheck.engine import Context, Finding, dotted_name
+
+RULE = "R4"
+
+#: StateStore internals (tables, indexes, the lock) — keep in sync
+#: with state/store.py's __init__
+_INTERNALS = {
+    "_lock", "_tables", "_nodes", "_jobs", "_job_versions", "_evals",
+    "_allocs", "_allocs_by_job", "_allocs_by_node", "_allocs_by_eval",
+    "_deployments", "_namespaces", "_index", "_watchers",
+    "_csi_volumes", "_services", "_acl_policies", "_acl_tokens",
+}
+
+_STOREISH = re.compile(r"(?i)(?:^|_)(?:store|state|state_store)$")
+
+#: files where the internals legitimately live
+_EXEMPT = ("nomad_tpu/state/store.py",)
+
+
+class StoreAccessRule:
+    rule_id = RULE
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        for src in ctx.files:
+            if src.rel in _EXEMPT:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                if node.attr not in _INTERNALS:
+                    continue
+                recv = dotted_name(node.value)
+                if not recv:
+                    continue
+                term = recv.rsplit(".", 1)[-1]
+                if not _STOREISH.search(term):
+                    continue
+                yield Finding(
+                    RULE, src.rel, node.lineno, src.scope_of(node),
+                    f"internal:{term}.{node.attr}",
+                    f"raw store internal `{recv}.{node.attr}` outside "
+                    f"state/store.py: use snapshot(), the *_direct "
+                    f"readers, or with_usage_view()/with_allocs()")
