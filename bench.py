@@ -1166,6 +1166,11 @@ def main() -> None:
                 # feasibility mask-program cache hit ratio
                 trace_steady_sched_host_share=steady.get(
                     "sched_host_share"),
+                # ISSUE 10: the reconcile slice's own trajectory line
+                # (the fused single-pass classifier's share of steady
+                # wall)
+                trace_steady_reconcile_share=steady.get(
+                    "reconcile_share"),
                 trace_feasibility_hit_ratio=steady.get(
                     "feasibility_hit_ratio"),
                 # ISSUE 6 steady gates: plan-path share of steady wall

@@ -61,9 +61,11 @@ _ATTRIBUTED = {
     "worker.batch": ("worker-fanout", "cpu"),
     # sched-host sub-decomposition (ISSUE 5): the eval.schedule span's
     # exclusive CPU is the residue; the feasibility / tensor-assembly /
-    # plan-build slices carry their own child spans. The steady gate
-    # sums all four (steady_state.sched_host_share).
+    # plan-build slices carry their own child spans — ISSUE 10 adds the
+    # reconcile slice. The steady gate sums all five
+    # (steady_state.sched_host_share).
     "eval.schedule": ("sched-host", "cpu"),
+    "sched.reconcile": ("sched-reconcile", "cpu"),
     "sched.feasibility": ("sched-feasibility", "cpu"),
     "feas.evaluate": ("sched-feasibility", "cpu"),
     "sched.assembly": ("sched-assembly", "cpu"),
@@ -488,8 +490,15 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
             # the feasibility mask-program cache effectiveness
             "sched_host_share": round(sum(
                 decomp["stages"].get(s, {}).get("share_of_wall", 0.0)
-                for s in ("sched-host", "sched-feasibility",
-                          "sched-assembly", "sched-planbuild")), 4),
+                for s in ("sched-host", "sched-reconcile",
+                          "sched-feasibility", "sched-assembly",
+                          "sched-planbuild")), 4),
+            # ISSUE 10: the reconcile slice on its own — the fused
+            # single-pass classifier's trajectory line (share of the
+            # steady burst's wall; per-eval ms rides the stage table)
+            "reconcile_share": round(
+                decomp["stages"].get("sched-reconcile", {})
+                .get("share_of_wall", 0.0), 4),
             "feasibility_hit_ratio": decomp.get(
                 "feasibility", {}).get("hit_ratio", 0.0),
             # ISSUE 6 steady gates: total plan-path share (applier

@@ -1307,6 +1307,10 @@ def build_kernel_in(
     if ev.ask.reserved_ports:
         words = cluster.port_words | ev.port_conflict_words
         conflict = np.any(words & ev.ask.port_mask[None, :], axis=1)
+        if ev.port_live_conflict is not None:
+            # live-alloc port occupancy (usage-index bitmaps): the
+            # node plane only carries agent-reserved ports
+            conflict = conflict | ev.port_live_conflict
         has_res = True
     else:
         conflict = neutral.zeros_bool

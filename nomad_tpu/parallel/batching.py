@@ -30,6 +30,37 @@ def device_put_shared(kin: KernelIn) -> KernelIn:
     return jax.tree_util.tree_map(jnp.asarray, kin)
 
 
+def _jit_donating(fn, donate_argnums):
+    """``jax.jit`` with donation, taking OWNERSHIP of the donated args.
+
+    ``jnp.asarray(numpy_plane)`` is zero-copy on the CPU backend when
+    the allocator happens to hand back an aligned block — the device
+    buffer then ALIASES memory the caller still owns. Donating such a
+    buffer lets the runtime write the loop's carry in place into the
+    caller's numpy array (observed through the pallas interpret path:
+    the 1-in-5 ``test_pallas_kernel`` top-k parity flake — the first
+    loop call silently rewrote the test's ``used`` planes before the
+    second backend ran). Aliasing is undetectable from the array, so
+    every donated arg is copied into a buffer this wrapper owns; the
+    copy is O(plane) once per loop call, noise against the T-batch scan
+    it feeds, and donation still aliases the carry inside the loop.
+    """
+    if not donate_argnums:
+        return jax.jit(fn)
+    jitted = jax.jit(fn, donate_argnums=donate_argnums)
+    donated = frozenset(donate_argnums)
+
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        args = tuple(
+            jnp.array(a, copy=True) if i in donated else a
+            for i, a in enumerate(args)
+        )
+        return jitted(*args, **kwargs)
+
+    return call
+
+
 def _bound_fallback(valid, primary, full_thunk):
     """Candidate-set bound contract: evals whose bound broke are served
     by the full-width kernel INSIDE the loop. Batch-level ``lax.cond``:
@@ -81,7 +112,7 @@ def make_schedule_apply_step(k_steps: int, features: KernelFeatures = FULL_FEATU
             used_cpu, used_mem, out.chosen, out.found, ask_cpu, ask_mem)
         return out, used_cpu2, used_mem2
 
-    return jax.jit(step, donate_argnums=(1, 2))
+    return _jit_donating(step, (1, 2))
 
 
 @functools.lru_cache(maxsize=32)
@@ -202,7 +233,7 @@ def make_schedule_apply_loop(k_steps: int,
             return scan_loop(one_batch, used_cpu, used_mem,
                              ask_cpu, ask_mem)
 
-        return jax.jit(loop, donate_argnums=donate)
+        return _jit_donating(loop, donate)
 
     def loop(shared: KernelIn, used_cpu, used_mem, ask_cpu, ask_mem, n_steps):
         def one_batch(carry, asks):
@@ -242,7 +273,7 @@ def make_schedule_apply_loop(k_steps: int,
 
         return scan_loop(one_batch, used_cpu, used_mem, ask_cpu, ask_mem)
 
-    return jax.jit(loop, donate_argnums=donate)
+    return _jit_donating(loop, donate)
 
 
 def _scan_with_reset(one_batch, planes, asks, reset_every: int):
@@ -334,7 +365,7 @@ def make_device_apply_loop(k_steps: int, reset_every: int = 0):
     # only in the no-reset steady loop, where carry in aliases carry
     # out (BENCH_r05's "donated buffers were not usable" tail came
     # from exactly this misalignment).
-    return jax.jit(loop, donate_argnums=() if reset_every else (1, 2, 3))
+    return _jit_donating(loop, () if reset_every else (1, 2, 3))
 
 
 @functools.lru_cache(maxsize=8)
@@ -468,7 +499,7 @@ def make_preemption_apply_loop(k_steps: int, reset_every: int = 0):
     # even uc/um are unusable: _scan_with_reset hands the scan COPIES
     # (``p + 0``) and the donated originals never reach an output
     # (the BENCH_r05 device/preemption-path warning) — donate nothing.
-    return jax.jit(loop, donate_argnums=() if reset_every else (1, 2))
+    return _jit_donating(loop, () if reset_every else (1, 2))
 
 
 def commit_placements(used_cpu, used_mem, chosen, found, ask_cpu, ask_mem):

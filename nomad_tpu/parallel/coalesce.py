@@ -42,6 +42,7 @@ from nomad_tpu.telemetry.histogram import histograms, percentile
 from nomad_tpu.telemetry.kernel_profile import profiler
 from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.tensors.device_state import default_device_state
+from nomad_tpu.utils.wavecohort import wave_cohorts
 from nomad_tpu.utils.witness import witness_lock
 
 #: B is bucketed to limit recompiles. Coarse on purpose: every
@@ -797,6 +798,12 @@ class LaunchCoalescer:
                     )
                 for r, out in zip(grp, outs):
                     r.out = out
+                # wave-boundary plan batching: the members are about
+                # to resume and submit ~len(grp) plans — arm the plan
+                # queue's drain window BEFORE releasing them, so the
+                # whole wave commits as one raft entry
+                # (utils/wavecohort + PlanQueue.dequeue_batch)
+                wave_cohorts.note_wave(len(grp))
             except BaseException as e:              # noqa: BLE001
                 for r in grp:
                     r.error = e

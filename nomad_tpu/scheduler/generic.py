@@ -193,17 +193,23 @@ class GenericScheduler(Scheduler):
     # -- reconcile + placements (generic_sched.go:358,499) ---------------
 
     def _compute_job_allocs(self) -> Optional[Exception]:
-        allocs = self.state.allocs_by_job(self.eval.namespace, self.eval.job_id)
-        tainted = tainted_nodes(self.state, allocs)
-        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
+        # the reconcile slice of sched-host, spanned on its own: the
+        # largest single Python cost of the steady state post-PR9
+        # (TRACE_DECOMP stage "sched-reconcile"; see docs/PERF.md
+        # "The reconcile fast path")
+        with tracer.span("sched.reconcile"):
+            allocs = self.state.allocs_by_job(
+                self.eval.namespace, self.eval.job_id)
+            tainted = tainted_nodes(self.state, allocs)
+            update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
 
-        job = self.job if self.job is not None else _dead_job_stub(self.eval)
-        reconciler = AllocReconciler(
-            generic_alloc_update_fn(self.ctx, self.stack, self.eval.id),
-            self.batch, self.eval.job_id, job, self.deployment, allocs, tainted,
-            self.eval.id, self.eval.priority,
-        )
-        results = reconciler.compute()
+            job = self.job if self.job is not None else _dead_job_stub(self.eval)
+            reconciler = AllocReconciler(
+                generic_alloc_update_fn(self.ctx, self.stack, self.eval.id),
+                self.batch, self.eval.job_id, job, self.deployment, allocs,
+                tainted, self.eval.id, self.eval.priority,
+            )
+            results = reconciler.compute()
 
         if self.eval.annotate_plan:
             from nomad_tpu.structs.eval_plan import PlanAnnotations

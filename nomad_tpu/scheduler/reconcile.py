@@ -20,7 +20,9 @@ Round-1 scope notes (each tracked for later rounds):
 
 from __future__ import annotations
 
+import threading as _threading
 import time as _time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -219,6 +221,62 @@ def _alloc_expired(alloc, now: float) -> bool:
     return (last_unknown / 1e9) + tg.max_client_disconnect_s < now
 
 
+# ---------------------------------------------------------------------------
+# per-(job, tg) reconcile invariants (the PR5 scaffold-cache idea applied
+# to the reconciler): everything below depends only on the job SPEC, so
+# re-deriving it per alloc per eval (task-group scans, reschedule-policy
+# copies) is pure reconcile-slice overhead. Identity-keyed like
+# scheduler/scaffold.py — store job rows are immutable and shared.
+# ---------------------------------------------------------------------------
+
+
+class _TGReconcileInfo:
+    __slots__ = ("supports_disconnect", "max_client_disconnect_s",
+                 "stop_after_client_disconnect_s", "policy",
+                 "policy_enabled")
+
+    def __init__(self, job, tg_name: str) -> None:
+        tg = job.lookup_task_group(tg_name)
+        self.supports_disconnect = (
+            tg is not None and tg.max_client_disconnect_s is not None)
+        self.max_client_disconnect_s = (
+            tg.max_client_disconnect_s if tg is not None else None)
+        self.stop_after_client_disconnect_s = (
+            tg.stop_after_client_disconnect_s if tg is not None else None)
+        # reschedule_policy_for returns a fresh DEFAULT copy per call;
+        # the reconciler only READS the policy, so one shared instance
+        # per (job, tg) is sound
+        policy = job.reschedule_policy_for(tg_name)
+        self.policy = policy
+        self.policy_enabled = policy is not None and policy.enabled()
+
+
+_RECON_INFO_MAX = 2048
+_RECON_INFO: "OrderedDict[Tuple[int, str], Tuple[object, _TGReconcileInfo]]" \
+    = OrderedDict()
+_RECON_INFO_LOCK = _threading.Lock()
+
+
+def reconcile_info_for(job, tg_name: str) -> _TGReconcileInfo:
+    """The (job, tg) reconcile invariants, memoized per job OBJECT
+    (entries pin the job and re-check identity, so a recycled ``id()``
+    can never alias a dead job version)."""
+    key = (id(job), tg_name)
+    ent = _RECON_INFO.get(key)
+    if ent is not None and ent[0] is job:
+        return ent[1]
+    built = _TGReconcileInfo(job, tg_name)
+    with _RECON_INFO_LOCK:
+        ent = _RECON_INFO.get(key)
+        if ent is not None and ent[0] is job:
+            return ent[1]
+        _RECON_INFO[key] = (job, built)
+        _RECON_INFO.move_to_end(key)
+        while len(_RECON_INFO) > _RECON_INFO_MAX:
+            _RECON_INFO.popitem(last=False)
+    return built
+
+
 def should_filter(alloc, is_batch: bool) -> Tuple[bool, bool]:
     """(untainted, ignore) -- reconcile_util.go:415 shouldFilter."""
     if is_batch:
@@ -308,19 +366,275 @@ def _update_by_reschedulable(
 
 
 # ---------------------------------------------------------------------------
+# fused group classification (the reconcile fast path)
+#
+# The legacy pipeline walks every alloc of a group FOUR times
+# (filter_by_tainted -> should_filter -> filter_by_rescheduleable ->
+# _update_by_reschedulable) and rebuilds an AllocSet dict per stage.
+# ``classify_group`` computes each alloc's full disposition in ONE pass
+# over one stable table, using the memoized per-(job, tg) invariants —
+# bit-identical to the legacy composition (property-tested in
+# tests/test_reconcile_fast.py, including result-list ORDER, which the
+# dict insertion orders here reproduce exactly).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GroupClassification:
+    """One group's alloc dispositions, computed in a single pass."""
+
+    untainted: AllocSet
+    migrate: AllocSet
+    lost: AllocSet
+    disconnecting: AllocSet
+    reconnecting: AllocSet
+    ignore: int
+    reschedule_now: AllocSet
+    reschedule_later: List[DelayedRescheduleInfo]
+
+
+def _alloc_expired_info(alloc, now: float, info) -> bool:
+    """``_alloc_expired`` with the (job, tg) lookup memoized away."""
+    if alloc.client_status != consts.ALLOC_CLIENT_UNKNOWN:
+        return False
+    if info is None or info.max_client_disconnect_s is None:
+        return False
+    last_unknown = None
+    for ts in alloc.task_states.values():
+        for e in ts.events:
+            if e.type == "Disconnected":
+                last_unknown = max(last_unknown or 0, e.time_ns)
+    if last_unknown is None:
+        return False
+    return (last_unknown / 1e9) + info.max_client_disconnect_s < now
+
+
+def _alloc_reconnected_info(alloc, now: float, info) -> Tuple[bool, bool]:
+    """``_alloc_reconnected`` with the memoized invariants."""
+    last_disconnect = None
+    last_reconnect = None
+    for ts in alloc.task_states.values():
+        for e in ts.events:
+            if e.type == "Disconnected":
+                last_disconnect = max(last_disconnect or 0, e.time_ns)
+            if e.type == "Reconnected":
+                last_reconnect = max(last_reconnect or 0, e.time_ns)
+    if last_reconnect is None:
+        return False, False
+    reconnected = last_disconnect is None or last_reconnect >= last_disconnect
+    return reconnected, _alloc_expired_info(alloc, now, info)
+
+
+def _update_by_reschedulable_info(
+    alloc, now: float, eval_id: str, d: Optional[Deployment],
+    d_active: bool, is_disconnecting: bool, info,
+) -> Tuple[bool, bool, float]:
+    """``_update_by_reschedulable`` riding the memoized policy."""
+    if d is not None and alloc.deployment_id == d.id and d_active \
+            and not alloc.desired_transition.reschedule:
+        return False, False, 0.0
+    if alloc.desired_transition.force_reschedule:
+        return True, False, 0.0
+    if not is_disconnecting \
+            and alloc.client_status != consts.ALLOC_CLIENT_FAILED:
+        # every remaining branch of the reference ends at the
+        # ``eligible`` check, which needs FAILED-or-disconnecting —
+        # the policy/eligibility/delay walk below cannot change this
+        # alloc's (False, False, 0.0) outcome, and it is the entire
+        # per-alloc cost of the steady RUNNING population
+        return False, False, 0.0
+    if info is None or not info.policy_enabled:
+        return False, False, 0.0
+    policy = info.policy
+    fail_time = now if is_disconnecting else (alloc.modify_time_ns / 1e9)
+    if not alloc.reschedule_eligible(policy, fail_time):
+        return False, False, 0.0
+    num_prior = len(alloc.reschedule_tracker.events) if alloc.reschedule_tracker else 0
+    resched_time = fail_time + alloc._next_delay(policy, num_prior)
+    eligible = alloc.client_status == consts.ALLOC_CLIENT_FAILED or is_disconnecting
+    if not eligible:
+        return False, False, 0.0
+    if alloc.follow_up_eval_id == eval_id or (resched_time - now) <= RESCHEDULE_WINDOW_S:
+        return True, False, resched_time
+    if not alloc.follow_up_eval_id:
+        return False, True, resched_time
+    return False, False, 0.0
+
+
+def classify_group(
+    a: AllocSet, tainted_nodes: Dict[str, object], supports_disconnected: bool,
+    now: float, is_batch: bool, eval_id: str, deployment: Optional[Deployment],
+) -> GroupClassification:
+    """The fused single pass: filter_by_tainted + both
+    filter_by_rescheduleable calls + their union, with one disposition
+    computation per alloc."""
+    untainted: AllocSet = {}
+    migrate: AllocSet = {}
+    lost: AllocSet = {}
+    disconnecting: AllocSet = {}
+    reconnecting: AllocSet = {}
+    n_ignore = 0
+    # reschedule_now's legacy order: the untainted-pass entries first
+    # (in untainted order), then the disconnecting-pass entries (the
+    # union(reschedule_now, resched_disc) semantics)
+    resched_unt: AllocSet = {}
+    resched_disc: AllocSet = {}
+    later: List[DelayedRescheduleInfo] = []
+
+    d_active = deployment is not None and deployment.active()
+    any_tainted = bool(tainted_nodes)
+    # per-call memo over the module cache: within one group the allocs
+    # share a handful of job objects (task_group is constant — the
+    # matrix groups by it), so the common lookup is one dict hit
+    info_cache: Dict[int, object] = {}
+
+    for aid, alloc in a.items():
+        job = alloc.job
+        if job is None:
+            info = None
+        else:
+            jkey = id(job)
+            info = info_cache.get(jkey)
+            if info is None:
+                info = info_cache[jkey] = reconcile_info_for(
+                    job, alloc.task_group)
+        supports = supports_disconnected and info is not None \
+            and info.supports_disconnect
+
+        # ---- the filter_by_tainted disposition, verbatim ----
+        reconnected = False
+        expired = False
+        if supports and alloc.client_status in (
+            consts.ALLOC_CLIENT_UNKNOWN,
+            consts.ALLOC_CLIENT_RUNNING,
+            consts.ALLOC_CLIENT_FAILED,
+        ):
+            reconnected, expired = _alloc_reconnected_info(alloc, now, info)
+
+        if supports and reconnected \
+                and alloc.desired_status == consts.ALLOC_DESIRED_RUN \
+                and alloc.client_status == consts.ALLOC_CLIENT_FAILED:
+            reconnecting[aid] = alloc
+            continue
+
+        if any_tainted:
+            node = tainted_nodes.get(alloc.node_id)
+            node_is_tainted = alloc.node_id in tainted_nodes
+        else:
+            node = None
+            node_is_tainted = False
+        if node is not None:
+            if node.status == consts.NODE_STATUS_DISCONNECTED:
+                if supports:
+                    if alloc.client_status == consts.ALLOC_CLIENT_RUNNING:
+                        # -> disconnecting (kept in the set AND run
+                        # through the disc-side reschedule filter below)
+                        disconnecting[aid] = alloc
+                        # disc-side reschedule filter: client status is
+                        # RUNNING here, so the is_disconnecting UNKNOWN
+                        # skip can never hit; every survivor of the
+                        # shared early filters joins reschedule_now
+                        # regardless of policy eligibility (legacy
+                        # filter_by_rescheduleable(is_disconnecting=True))
+                        if alloc.next_allocation and alloc.terminal_status():
+                            continue
+                        is_unt, ign = should_filter(alloc, is_batch)
+                        if is_unt or ign:
+                            continue
+                        resched_disc[aid] = alloc
+                        continue
+                    if alloc.client_status == consts.ALLOC_CLIENT_PENDING:
+                        lost[aid] = alloc
+                        continue
+                else:
+                    lost[aid] = alloc
+                    continue
+            elif node.status == consts.NODE_STATUS_READY and reconnected:
+                if expired:
+                    lost[aid] = alloc
+                else:
+                    reconnecting[aid] = alloc
+                continue
+
+        if alloc.terminal_status() and not reconnected:
+            pass        # -> untainted (reschedule filter below)
+        elif alloc.desired_transition.migrate:
+            migrate[aid] = alloc
+            continue
+        elif supports and _alloc_expired_info(alloc, now, info):
+            lost[aid] = alloc
+            continue
+        elif supports and alloc.client_status == consts.ALLOC_CLIENT_UNKNOWN \
+                and alloc.desired_status == consts.ALLOC_DESIRED_RUN:
+            n_ignore += 1
+            continue
+        elif supports and reconnected \
+                and alloc.client_status == consts.ALLOC_CLIENT_FAILED \
+                and alloc.desired_status == consts.ALLOC_DESIRED_STOP:
+            n_ignore += 1
+            continue
+        elif not node_is_tainted:
+            if reconnected:
+                if expired:
+                    lost[aid] = alloc
+                else:
+                    reconnecting[aid] = alloc
+                continue
+            # -> untainted
+        elif node is None or node.terminal_status():
+            lost[aid] = alloc
+            continue
+        # else -> untainted
+
+        # ---- the untainted-side reschedule filter, verbatim ----
+        if alloc.next_allocation and alloc.terminal_status():
+            continue
+        is_unt, ign = should_filter(alloc, is_batch)
+        if is_unt:
+            untainted[aid] = alloc
+            continue
+        if ign:
+            continue
+        eligible_now, eligible_later, resched_time = \
+            _update_by_reschedulable_info(
+                alloc, now, eval_id, deployment, d_active, False, info)
+        if not eligible_now:
+            untainted[aid] = alloc
+            if eligible_later:
+                later.append(DelayedRescheduleInfo(aid, alloc, resched_time))
+        else:
+            resched_unt[aid] = alloc
+
+    if resched_disc:
+        resched_unt.update(resched_disc)
+    return GroupClassification(
+        untainted=untainted, migrate=migrate, lost=lost,
+        disconnecting=disconnecting, reconnecting=reconnecting,
+        ignore=n_ignore, reschedule_now=resched_unt,
+        reschedule_later=later,
+    )
+
+
+# ---------------------------------------------------------------------------
 # allocNameIndex (reconcile_util.go:591)
 # ---------------------------------------------------------------------------
 
 
 class AllocNameIndex:
-    """Tracks which "<job>.<group>[i]" indexes are in use."""
+    """Tracks which "<job>.<group>[i]" indexes are in use.
 
-    def __init__(self, job_id: str, group: str, count: int, in_use: AllocSet) -> None:
+    ``in_use`` accepts an AllocSet dict or any iterable of allocs —
+    callers with several sets chain them instead of building a union
+    dict just to read the indexes out of it.
+    """
+
+    def __init__(self, job_id: str, group: str, count: int, in_use) -> None:
         self.job_id = job_id
         self.group = group
         self.count = count
         self.taken: set = set()
-        for a in in_use.values():
+        values = in_use.values() if hasattr(in_use, "values") else in_use
+        for a in values:
             idx = a.index()
             if idx >= 0:
                 self.taken.add(idx)
@@ -474,6 +788,7 @@ class AllocReconciler:
         eval_priority: int,
         supports_disconnected_clients: bool = True,
         now: Optional[float] = None,
+        use_legacy_filters: bool = False,
     ) -> None:
         self.alloc_update_fn = alloc_update_fn
         self.batch = batch
@@ -487,6 +802,10 @@ class AllocReconciler:
         self.eval_priority = eval_priority
         self.supports_disconnected = supports_disconnected_clients
         self.now = now if now is not None else _time.time()
+        # False = the fused single-pass classifier (classify_group);
+        # True = the reference multi-pass composition it is
+        # property-tested bit-identical against
+        self.use_legacy_filters = use_legacy_filters
         self.deployment_paused = False
         self.deployment_failed = False
         self.result = ReconcileResults()
@@ -642,18 +961,35 @@ class AllocReconciler:
 
         canaries, all_allocs = self._cancel_unneeded_canaries(all_allocs, du)
 
-        untainted, migrate, lost, disconnecting, reconnecting, ignore = filter_by_tainted(
-            all_allocs, self.tainted_nodes, self.supports_disconnected, self.now
-        )
-        du.ignore += len(ignore)
-
-        untainted, reschedule_now, reschedule_later = filter_by_rescheduleable(
-            untainted, self.batch, False, self.now, self.eval_id, self.deployment
-        )
-        _, resched_disc, _ = filter_by_rescheduleable(
-            disconnecting, self.batch, True, self.now, self.eval_id, self.deployment
-        )
-        reschedule_now = union(reschedule_now, resched_disc)
+        if self.use_legacy_filters:
+            # the reference multi-pass composition: kept as the
+            # semantics definition the fused pass is property-tested
+            # against (tests/test_reconcile_fast.py)
+            untainted, migrate, lost, disconnecting, reconnecting, ignore = \
+                filter_by_tainted(
+                    all_allocs, self.tainted_nodes,
+                    self.supports_disconnected, self.now)
+            du.ignore += len(ignore)
+            untainted, reschedule_now, reschedule_later = \
+                filter_by_rescheduleable(
+                    untainted, self.batch, False, self.now, self.eval_id,
+                    self.deployment)
+            _, resched_disc, _ = filter_by_rescheduleable(
+                disconnecting, self.batch, True, self.now, self.eval_id,
+                self.deployment)
+            reschedule_now = union(reschedule_now, resched_disc)
+        else:
+            cls = classify_group(
+                all_allocs, self.tainted_nodes, self.supports_disconnected,
+                self.now, self.batch, self.eval_id, self.deployment)
+            untainted = cls.untainted
+            migrate = cls.migrate
+            lost = cls.lost
+            disconnecting = cls.disconnecting
+            reconnecting = cls.reconnecting
+            du.ignore += cls.ignore
+            reschedule_now = cls.reschedule_now
+            reschedule_later = cls.reschedule_later
 
         # lost allocs with stop_after_client_disconnect delay
         lost_later = self._delay_by_stop_after_disconnect(lost)
@@ -667,7 +1003,8 @@ class AllocReconciler:
 
         name_index = AllocNameIndex(
             self.job_id, group_name, tg.count,
-            union(untainted, migrate, reschedule_now, lost),
+            (a for s in (untainted, migrate, reschedule_now, lost)
+             for a in s.values()),
         )
 
         is_canarying = (
@@ -678,7 +1015,11 @@ class AllocReconciler:
             is_canarying, lost_later_evals,
         )
         du.stop += len(stop)
-        untainted = difference(untainted, stop)
+        # in-place removal (both classification paths hand this method
+        # a fresh dict it owns): same content and order as
+        # ``difference(untainted, stop)`` without building another dict
+        for aid in stop:
+            untainted.pop(aid, None)
 
         self._compute_reconnecting(reconnecting)
         du.ignore += len(self.result.reconnect_updates)
@@ -772,6 +1113,11 @@ class AllocReconciler:
         return filtered, ignored
 
     def _cancel_unneeded_canaries(self, all_allocs: AllocSet, du: DesiredUpdates):
+        if self.old_deployment is None and self.deployment is None:
+            # no deployment anywhere: no canaries can exist, and the
+            # legacy fall-through would only rebuild all_allocs as an
+            # identical dict (difference against nothing)
+            return {}, all_allocs
         stop_ids: List[str] = []
         if self.old_deployment is not None:
             for ds in self.old_deployment.task_groups.values():

@@ -41,8 +41,8 @@ class TGScaffold:
 
     __slots__ = ("ask", "affinities", "distinct_hosts_job",
                  "distinct_hosts_tg", "has_devices", "program",
-                 "program_compiled", "lean_assign", "_tg", "_lean_res",
-                 "_lean_lock")
+                 "program_compiled", "lean_assign", "lean_ports",
+                 "static_port_mask", "_tg", "_lean_res", "_lean_lock")
 
     def __init__(self, job, tg) -> None:
         from nomad_tpu.structs import consts
@@ -72,6 +72,30 @@ class TGScaffold:
             and not any(t.resources.devices for t in tg.tasks)
             and not any(t.resources.cores > 0 for t in tg.tasks)
         )
+        # static-port lean (ISSUE 10): ONE group network asking only
+        # for concrete in-range reserved ports — no dynamic ports (the
+        # stochastic picker reads node state), no bandwidth, no task
+        # networks/devices/cores. For such asks the exact assigner's
+        # only node-dependent work is the collision re-check, which the
+        # kernel's port-conflict plane + the usage index's live port
+        # bitmaps already prove — so placement can skip the
+        # NetworkIndex build entirely (stack.select_many) and the plan
+        # applier's ports-aware group check re-validates the claim.
+        # Duplicate ports in the ask stay on the exact path.
+        self.lean_ports = False
+        self.static_port_mask = 0
+        if (not self.lean_assign and len(tg.networks) == 1
+                and not any(t.resources.networks for t in tg.tasks)
+                and not any(t.resources.devices for t in tg.tasks)
+                and not any(t.resources.cores > 0 for t in tg.tasks)):
+            net = tg.networks[0]
+            vals = [p.value for p in net.reserved_ports]
+            if (vals and not net.dynamic_ports and not net.mbits
+                    and all(0 <= v < 65536 for v in vals)
+                    and len(set(vals)) == len(vals)):
+                self.lean_ports = True
+                for v in vals:
+                    self.static_port_mask |= 1 << v
         self._tg = tg
         self._lean_res: Dict[bool, Tuple] = {}
         self._lean_lock = witness_lock("TGScaffold._lean_lock")

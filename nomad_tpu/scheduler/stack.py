@@ -42,7 +42,7 @@ from nomad_tpu.structs import consts
 from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.structs.alloc import AllocMetric
 from nomad_tpu.structs.constraints import matches_affinity, resolve_target
-from nomad_tpu.structs.network import NetworkIndex, NetworkResource
+from nomad_tpu.structs.network import NetworkIndex, NetworkResource, Port
 from nomad_tpu.structs.resources import (
     AllocatedCpuResources,
     AllocatedMemoryResources,
@@ -208,6 +208,9 @@ class XLAGenericStack:
             # every non-lean ask.
             scaffold = scaffold_for(self.job, tg)
             lean = scaffold.lean_assign
+            lean_ports = scaffold.lean_ports
+            static_info: Dict[int, Tuple[bool, int]] = {}
+            usage = getattr(snapshot, "usage", None)
             oversub = getattr(self.ctx.state.scheduler_config,
                               "memory_oversubscription_enabled", False)
             proto = self._metrics_proto(out)
@@ -247,6 +250,10 @@ class XLAGenericStack:
                         metrics=None,
                         resources=res,
                     )
+                elif lean_ports and self._lean_port_slot_ok(
+                        scaffold, row, node, usage, ev, static_info):
+                    option = self._lean_port_option(
+                        scaffold, tg, node, oversub, scores_l[slot])
                 else:
                     asg = assigners.get(row)
                     if asg is None:
@@ -267,6 +274,97 @@ class XLAGenericStack:
                 break
             pending = retry
         return results
+
+    def _lean_port_slot_ok(self, scaffold, row: int, node, usage,
+                           ev: EvalTensors, static_info: Dict) -> bool:
+        """Whether a static-port lean placement on ``node`` is provably
+        collision-free WITHOUT building a NetworkIndex: the exact
+        assigner for such an ask reads node state only for the
+        collision re-check, so when every collision source is provable
+        from planes — agent-reserved bits (cluster.port_words), live
+        alloc bits (the usage index's port bitmaps), in-plan/accepted
+        bits (ev.port_conflict_words) — assignment is pure struct
+        building. Any unprovable case (multi-address node, poisoned
+        bitmap row, staged stops that would free ports, a live-vs-
+        static collision the assigner would fail on) returns False and
+        the slot takes the exact ``_NodeAssigner`` path unchanged."""
+        info = static_info.get(row)
+        if info is None:
+            sok = True
+            smask = 0
+            ips = {nt.ip or "0.0.0.0"
+                   for nt in node.node_resources.networks if nt.device}
+            if len(ips) > 1:
+                sok = False
+            else:
+                for p in getattr(node.reserved_resources,
+                                 "networks_ports", []):
+                    if p < 0 or p >= 65536 or (smask >> p) & 1:
+                        sok = False
+                        break
+                    smask |= 1 << p
+            info = static_info[row] = (sok, smask)
+        if not info[0]:
+            return False
+        if usage is None:
+            return False
+        urow = usage.rows.get(node.id)
+        if urow is None or urow in usage.port_dirty:
+            return False
+        live = usage.port_masks.get(urow, 0)
+        if live & (scaffold.static_port_mask | info[1]):
+            # ask conflicts with a live alloc, or a live alloc already
+            # collides with the agent-reserved set (the assigner's
+            # add_allocs would fail the whole node)
+            return False
+        plan = self.ctx.plan
+        if node.id in plan.node_update or node.id in plan.node_preemptions:
+            # staged stops free ports the snapshot planes still count
+            return False
+        c = self.cluster
+        words = c.port_words[row] | ev.port_conflict_words[row]
+        if np.any(words & ev.ask.port_mask):
+            return False
+        return True
+
+    def _lean_port_option(self, scaffold, tg, node, oversub: bool,
+                          final_score: float) -> SelectedOption:
+        """The static-port placement structs, mirroring the assigner's
+        group-network branch (same offer/NetworkResource shapes) with
+        the (job, tg)-shared task skeletons."""
+        task_res, lifecycles, _ = scaffold.lean_planes(oversub)
+        net = tg.networks[0]
+        offer = [Port(label=p.label, value=p.value, to=p.to,
+                      host_network=p.host_network)
+                 for p in net.reserved_ports]
+        nw = NetworkResource(
+            mode=net.mode,
+            device=(node.node_resources.networks[0].device
+                    if node.node_resources.networks else ""),
+            ip=(node.node_resources.networks[0].ip
+                if node.node_resources.networks else ""),
+            reserved_ports=list(offer),
+        )
+        shared = AllocatedSharedResources(
+            disk_mb=tg.ephemeral_disk.size_mb,
+            networks=[nw],
+            ports=offer,
+        )
+        res = AllocatedResources(
+            tasks=task_res,
+            task_lifecycles=lifecycles,
+            shared=shared,
+        )
+        return SelectedOption(
+            node_id=node.id,
+            node=node,
+            final_score=final_score,
+            task_resources=task_res,
+            task_lifecycles=lifecycles,
+            alloc_resources=shared,
+            metrics=None,
+            resources=res,
+        )
 
     def _apply_accepted(self, ev: EvalTensors, row: int) -> None:
         """Re-apply one already-accepted placement's resources to freshly
@@ -557,6 +655,29 @@ class XLAGenericStack:
         avail_mbits = (c.avail_mbits if c.avail_mbits is not None
                        else neutral.zeros_i32)
 
+        # live-port conflict overlay for reserved-port asks: sparse
+        # walk of the usage index's per-node port bitmaps (only nodes
+        # holding ports have entries; poisoned rows stay unflagged —
+        # the exact assigner arbitrates them). Sound only when the
+        # plan stages no stops (a stop would free its ports); the
+        # empty-plan fast path above is exactly that case.
+        port_live = None
+        if (ask.reserved_ports and u is not None
+                and (u.port_masks or u.port_dirty)
+                and not plan.node_update and not plan.node_preemptions):
+            ask_mask_int = 0
+            for v in ask.reserved_ports:
+                ask_mask_int |= 1 << v
+            for urow, mask in u.port_masks.items():
+                if mask & ask_mask_int and urow not in u.port_dirty:
+                    nid = u.ids[urow] if urow < len(u.ids) else None
+                    row = c.index.get(nid) if nid is not None else None
+                    if row is None:
+                        continue
+                    if port_live is None:
+                        port_live = np.zeros(n, bool)
+                    port_live[row] = True
+
         # device planes
         dev_free = neutral.zeros_dev
         dev_aff = neutral.zeros_f32
@@ -628,6 +749,7 @@ class XLAGenericStack:
             ask=ask,
             desired_count=tg.count,
             algorithm=self.ctx.state.scheduler_config.effective_algorithm(),
+            port_live_conflict=port_live,
         )
 
     def _accumulate_usage(

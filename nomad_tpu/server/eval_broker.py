@@ -78,8 +78,16 @@ class EvalBroker:
         delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
         initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
         subsequent_nack_delay: float = DEFAULT_SUBSEQUENT_NACK_DELAY,
+        batch_fill_window_s: float = 0.005,
     ) -> None:
         self.nack_timeout = nack_timeout
+        # wave-boundary feed (ISSUE 10): after the FIRST eval of a
+        # multi-eval dequeue, hold the batch open this long for more
+        # ready evals. A ragged hand-out fragments the worker's wave —
+        # fewer members per kernel launch AND fewer plans per batched
+        # raft entry — so a few ms of fill (bounded; idle and
+        # single-eval dequeues pay nothing) buys whole-wave commits.
+        self.batch_fill_window_s = batch_fill_window_s
         self.delivery_limit = delivery_limit
         self.initial_nack_delay = initial_nack_delay
         self.subsequent_nack_delay = subsequent_nack_delay
@@ -263,6 +271,8 @@ class EvalBroker:
         t0 = time.monotonic() if tracer.enabled else 0.0
         t1 = 0.0
         out: List[Tuple[Evaluation, str]] = []
+        fill_cap = None
+        last_arrival = 0.0
         notify_nack = False
         with self._lock:
             while True:
@@ -270,12 +280,33 @@ class EvalBroker:
                 if ev is not None:
                     if t0 and not out:
                         t1 = time.monotonic()
+                    if fill_cap is None:
+                        fill_cap = time.monotonic() \
+                            + 4 * self.batch_fill_window_s
+                    last_arrival = time.monotonic()
                     out.append((ev, self._track_unacked_locked(ev)))
                     if len(out) >= batch:
                         break
                     continue
-                if out or not self._enabled:
+                if not self._enabled:
                     break
+                if out:
+                    # batch-fill window: the queue ran dry mid-batch —
+                    # wait (bounded) for the producer burst to catch
+                    # up rather than hand out a wave fragment. The
+                    # window slides with each arrival (a burst keeps
+                    # it open until the batch fills) under a hard cap
+                    # of 4 windows from the first eval, so a slow
+                    # trickle can never pin latency to batch x window.
+                    if batch <= 1 or self.batch_fill_window_s <= 0:
+                        break
+                    fill_wait = min(
+                        last_arrival + self.batch_fill_window_s,
+                        fill_cap) - time.monotonic()
+                    if fill_wait <= 0:
+                        break
+                    self._cond.wait(fill_wait)
+                    continue
                 wait = None if deadline is None else deadline - time.time()
                 if wait is not None and wait <= 0:
                     break

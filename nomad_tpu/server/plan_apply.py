@@ -21,12 +21,20 @@ re-walking every touched node's alloc list per plan, the applier takes
 ONE snapshot of the store's live utilization planes (state/usage.py)
 plus the in-flight overlay, re-validates the whole wave with per-node
 float arithmetic (``_GroupFitChecker``), and commits every surviving
-plan as ONE raft entry and one FSM apply (``_commit_batch``). Any node
-the planes cannot prove (ports, devices, reserved cores, stale rows)
-falls back to the exact ``evaluateNodePlan`` walk — counted in
-``plan_group_stats.fallback_plans``, which the steady-state CI gate
-requires to be zero. Bit-identity of the group pass against serialized
-``apply_one`` is property-tested (tests/test_plan_group_commit.py).
+plan as ONE raft entry and one FSM apply (``_commit_batch``).
+
+Ports-aware plane (ISSUE 10): port-bearing plans no longer always fall
+back — the usage planes carry a per-node reserved-port bitmap
+(``UsagePlanes.port_masks``), so a placement's port claim re-validates
+as one AND against (live | static | overlay) bits next to the three
+float compares. Any node the planes cannot prove (devices, reserved
+cores, bandwidth accounting, multi-address port layouts, poisoned
+bitmap rows, stale rows) falls back to the exact ``evaluateNodePlan``
+walk — counted in ``plan_group_stats.fallback_plans``, which the
+steady-state CI gate requires to be zero. Bit-identity of the group
+pass against serialized ``apply_one`` is property-tested
+(tests/test_plan_group_commit.py, including randomized port-conflict
+mixes).
 """
 
 from __future__ import annotations
@@ -74,9 +82,16 @@ class PlanGroupStats:
             self.commit_batches = 0
             self.committed_plans = 0
             self.batch_bytes = 0
+            # port-coverage: plans carrying >= 1 port-bearing
+            # placement, split by whether the ports plane proved them
+            # (ISSUE 10 extends group-commit coverage beyond lean-only;
+            # these counters are how the extension's health is gated)
+            self.port_plans = 0
+            self.port_vector_plans = 0
+            self.port_fallback_plans = 0
 
     def note_plan(self, vector_nodes: int, fallback_nodes: int,
-                  rejected: int) -> None:
+                  rejected: int, has_ports: bool = False) -> None:
         with self._lock:
             self.plans += 1
             self.vector_nodes += vector_nodes
@@ -86,6 +101,12 @@ class PlanGroupStats:
                 self.fallback_plans += 1
             else:
                 self.vector_plans += 1
+            if has_ports:
+                self.port_plans += 1
+                if fallback_nodes:
+                    self.port_fallback_plans += 1
+                else:
+                    self.port_vector_plans += 1
 
     def note_commit(self, n_plans: int, n_bytes: int = 0) -> None:
         with self._lock:
@@ -105,6 +126,9 @@ class PlanGroupStats:
                 "commit_batches": self.commit_batches,
                 "committed_plans": self.committed_plans,
                 "batch_bytes": self.batch_bytes,
+                "port_plans": self.port_plans,
+                "port_vector_plans": self.port_vector_plans,
+                "port_fallback_plans": self.port_fallback_plans,
                 "group_size_avg": (
                     self.committed_plans / self.commit_batches
                     if self.commit_batches else 0.0),
@@ -235,16 +259,31 @@ def _result_alloc_ids(result: "PlanResult") -> set:
     return ids
 
 
-def _lean_usage(alloc: Allocation):
-    """(cpu, mem, disk) when the alloc is lean (no ports/networks,
-    devices, or reserved cores), else None. Lean allocs are the only
-    ones the vectorized group check may re-validate: every other
-    dimension needs the exact per-node walk (NetworkIndex /
-    DeviceAccounter / core-overlap sets)."""
+def _vector_usage(alloc: Allocation):
+    """(cpu, mem, disk, port_mask, has_net) when the alloc is provable
+    by the vectorized group check, else None.
+
+    Lean allocs (no ports/networks/devices/cores) prove as pure float
+    arithmetic. Port-bearing allocs prove too — ISSUE 10's ports
+    plane — as long as their ports are a valid flat bitmap
+    (``port_meta``) and they carry no bandwidth (the NetworkIndex
+    accounts mbits per device; planes cannot). Devices and reserved
+    cores always need the exact per-node walk (DeviceAccounter /
+    core-overlap sets). ``has_net`` marks allocs the exact walk would
+    build a NetworkIndex for (``uses_ports`` — networks with or
+    without concrete ports): it decides whether a node's port proof
+    obligations apply at all."""
     cr, uses_ports, uses_devices = alloc.fit_meta()
-    if uses_ports or uses_devices or cr.reserved_cores:
+    if uses_devices or cr.reserved_cores:
         return None
-    return cr.cpu_shares, cr.memory_mb, cr.disk_mb
+    if not uses_ports:
+        return cr.cpu_shares, cr.memory_mb, cr.disk_mb, 0, False
+    if any(net.mbits for net in cr.networks):
+        return None
+    mask, ok = alloc.port_meta()
+    if not ok:
+        return None
+    return cr.cpu_shares, cr.memory_mb, cr.disk_mb, mask, True
 
 
 class _GroupFitChecker:
@@ -254,10 +293,12 @@ class _GroupFitChecker:
     — the SAME aggregates the scheduler's eval tensors gather from)
     plus per-node float deltas folded from the in-flight overlay and
     from each plan of this batch as it is accepted. A node plan whose
-    placements are lean, whose node carries no special (ports/devices)
-    or reserved-core usage, and whose dimensions stay inside float32's
-    exact-integer range is then re-validated with three comparisons —
-    no per-alloc walk, no NetworkIndex, no ComparableResources sums.
+    placements are provable (lean, or port-bearing with a valid flat
+    bitmap), whose node carries no device or reserved-core usage, and
+    whose dimensions stay inside float32's exact-integer range is then
+    re-validated with three comparisons plus (for port-bearing plans)
+    one bitmap AND per placement — no per-alloc walk, no NetworkIndex,
+    no ComparableResources sums.
 
     Exactness: the merge rules mirror ``_LiveView.allocs_by_node`` +
     ``evaluate_plan`` bit for bit (entries replay in commit order —
@@ -280,6 +321,13 @@ class _GroupFitChecker:
         self._placed: Dict[str, Dict[str, Tuple]] = {}
         self._tainted: set = set()
         self._caps: Dict[str, Tuple] = {}
+        # port overlay deltas (the ports-aware plane, ISSUE 10):
+        # bits ADDED by in-flight/batch placements, bits FREED by
+        # their removals, and the nodes where overlay allocs would
+        # make the exact walk build a NetworkIndex at all
+        self._padd: Dict[str, int] = {}
+        self._psub: Dict[str, int] = {}
+        self._pflags: set = set()
         # entries read BEFORE the planes snapshot: an entry that
         # commits in between is deduped by the fold's committed-row
         # check (`prev is a` for placements; terminal rows for
@@ -297,6 +345,10 @@ class _GroupFitChecker:
             self._disk = planes.used_disk
             self._cores = planes.used_cores
             self._special = planes.used_special
+            self._devices = planes.used_devices
+            self._mbits = planes.used_mbits
+            self._pmasks = planes.port_masks
+            self._pdirty = planes.port_dirty
             # prefetch ONLY the rows the fold will read — rows are
             # replaced, never mutated, so handing them out is safe
             return {i: allocs.get(i) for i in ids}
@@ -361,6 +413,23 @@ class _GroupFitChecker:
         d[1] += sign * usage[1]
         d[2] += sign * usage[2]
 
+    def _port_add(self, nid: str, mask: int) -> None:
+        if mask:
+            self._padd[nid] = self._padd.get(nid, 0) | mask
+
+    def _port_drop_placed(self, nid: str, mask: int) -> None:
+        """Clear an in-flight placement's bits from the add-overlay.
+        Sound because accepted placements on a provable node are
+        mutually conflict-free — each overlay bit belongs to exactly
+        one placed alloc (the same invariant the live plane relies
+        on)."""
+        if mask:
+            self._padd[nid] = self._padd.get(nid, 0) & ~mask
+
+    def _port_free(self, nid: str, mask: int) -> None:
+        if mask:
+            self._psub[nid] = self._psub.get(nid, 0) | mask
+
     def _fold_result(self, r: "PlanResult", store_allocs) -> None:
         """Fold one result's deltas. Runs OFF the store lock:
         ``store_allocs`` is the prefetched ``{id: row}`` dict read
@@ -379,6 +448,7 @@ class _GroupFitChecker:
                         # store row — if one exists — was already
                         # subtracted by the placed handler
                         self._bump(nid, -1.0, old)
+                        self._port_drop_placed(nid, old[3])
                         rm.add(a.id)
                         continue
                     if a.id in rm:
@@ -388,11 +458,14 @@ class _GroupFitChecker:
                     if (prev is None or prev.terminal_status()
                             or prev.node_id != nid):
                         continue
-                    lean = _lean_usage(prev)
-                    if lean is None:
+                    vu = _vector_usage(prev)
+                    if vu is None:
                         self._tainted.add(nid)
                         continue
-                    self._bump(nid, -1.0, lean)
+                    self._bump(nid, -1.0, vu)
+                    self._port_free(nid, vu[3])
+                    if vu[4]:
+                        self._pflags.add(nid)
         for nid, allocs in r.node_allocation.items():
             pl = self._placed.setdefault(nid, {})
             for a in allocs:
@@ -408,38 +481,76 @@ class _GroupFitChecker:
                     # live store row of the same id, so the fold
                     # records a ZERO-usage entry after backing that
                     # row out
-                    lean = (0, 0, 0)
+                    vu = (0, 0, 0, 0, False)
                 else:
-                    lean = _lean_usage(a)
-                    if lean is None:
+                    vu = _vector_usage(a)
+                    if vu is None:
                         self._tainted.add(nid)
                         continue
                 old = pl.get(a.id)
                 if old is not None:
                     # last placement wins the by_id merge
                     self._bump(nid, -1.0, old)
+                    self._port_drop_placed(nid, old[3])
                 elif (prev is not None and not prev.terminal_status()
                         and prev.node_id == nid
                         and a.id not in self._removed.get(nid, set())):
                     # in-place update: the merged view replaces the
                     # store row with the placed version
-                    plean = _lean_usage(prev)
-                    if plean is None:
+                    pvu = _vector_usage(prev)
+                    if pvu is None:
                         self._tainted.add(nid)
                         continue
-                    self._bump(nid, -1.0, plean)
-                pl[a.id] = lean
-                self._bump(nid, 1.0, lean)
+                    self._bump(nid, -1.0, pvu)
+                    self._port_free(nid, pvu[3])
+                pl[a.id] = vu
+                self._bump(nid, 1.0, vu)
+                if vu[3]:
+                    # an accepted placement's ports overlapping the
+                    # node's effective mask means the node was proven
+                    # by the exact walk under semantics the flat
+                    # bitmap cannot express (multi-address) — or the
+                    # planes drifted; either way, stop proving it
+                    row = self._rows.get(nid)
+                    live = self._pmasks.get(row, 0) if row is not None else 0
+                    eff = (live & ~self._psub.get(nid, 0)) \
+                        | self._padd.get(nid, 0)
+                    if vu[3] & eff:
+                        self._tainted.add(nid)
+                    self._port_add(nid, vu[3])
+                if vu[4]:
+                    self._pflags.add(nid)
 
     # -- the vector check -------------------------------------------------
 
     def _node_cap(self, node) -> Tuple:
+        """(cpu, mem, disk, static_port_mask, ports_ok) per node.
+
+        ``ports_ok`` is the node-level port-proof gate: False when the
+        node has more than one address (the NetworkIndex keys its
+        bitmaps per ip — a flat mask over-rejects the legal
+        same-port-two-addresses state), a duplicated or out-of-range
+        agent-reserved port (set_node itself collides), so any
+        port-involved plan on such a node must take the exact walk.
+        """
         cap = self._caps.get(node.id)
         if cap is None:
             avail = node.comparable_resources()
             avail.subtract(node.comparable_reserved_resources())
+            smask = 0
+            sok = True
+            ips = {n.ip or "0.0.0.0"
+                   for n in node.node_resources.networks if n.device}
+            if len(ips) > 1:
+                sok = False
+            for port in getattr(node.reserved_resources,
+                                "networks_ports", []):
+                if port < 0 or port >= 65536 or (smask >> port) & 1:
+                    sok = False
+                    break
+                smask |= 1 << port
             cap = (float(avail.cpu_shares), float(avail.memory_mb),
-                   float(avail.disk_mb))
+                   float(avail.disk_mb), smask, sok)
             self._caps[node.id] = cap
         return cap
 
@@ -452,9 +563,40 @@ class _GroupFitChecker:
         row = self._rows.get(node_id)
         if row is None:
             return None
-        if self._special[row] or self._cores[row]:
+        if self._devices[row] or self._cores[row]:
             return None
         placements = plan.node_allocation.get(node_id) or ()
+        # pass 1 over placements: usage tuples + port involvement (the
+        # exact walk builds its NetworkIndex iff ANY proposed alloc
+        # carries networks/ports — live, overlaid, or placed here)
+        place_vu = []
+        place_ports = False
+        for p in placements:
+            if p.terminal_status():
+                # allocs_fit skips terminal allocs entirely (neither
+                # usage nor ports/devices), so a lost/unknown
+                # transition costs nothing and needs no proof
+                continue
+            vu = _vector_usage(p)
+            if vu is None:
+                return None
+            place_vu.append(vu)
+            place_ports = place_ports or vu[4]
+        cap = self._node_cap(node)
+        # devices are gated to zero above, so used_special counts
+        # exactly the node's live network/port-bearing allocs
+        ports_involved = bool(self._special[row]) or place_ports \
+            or node_id in self._pflags
+        eff_mask = 0
+        if ports_involved:
+            if row in self._pdirty or self._mbits[row] or not cap[4]:
+                # unprovable live bitmap, live bandwidth accounting,
+                # or a node whose address/static-port layout the flat
+                # mask cannot express: exact walk
+                return None
+            eff_mask = (self._pmasks.get(row, 0)
+                        & ~self._psub.get(node_id, 0)) \
+                | self._padd.get(node_id, 0)
         cpu = float(self._cpu[row])
         mem = float(self._mem[row])
         disk = float(self._disk[row])
@@ -465,7 +607,7 @@ class _GroupFitChecker:
             disk += d[2]
         # this plan's own staged stops/preemptions on the node: their
         # store rows leave the proposed set (dedup against ids already
-        # removed or overlaid by earlier plans)
+        # removed or overlaid by earlier plans), freeing their ports
         removals = ((plan.node_update.get(node_id) or [])
                     + (plan.node_preemptions.get(node_id) or []))
         if removals:
@@ -483,6 +625,7 @@ class _GroupFitChecker:
                     cpu -= pl_usage[0]
                     mem -= pl_usage[1]
                     disk -= pl_usage[2]
+                    eff_mask &= ~pl_usage[3]
                     continue
                 if a.id in rm_seen:
                     continue
@@ -490,31 +633,38 @@ class _GroupFitChecker:
                 if (prev is None or prev.terminal_status()
                         or prev.node_id != node_id):
                     continue
-                lean = _lean_usage(prev)
-                if lean is None:
-                    # a live special alloc would have shown in the
-                    # planes; a cored one likewise — unreachable
-                    # unless the planes drifted: fall back
+                vu = _vector_usage(prev)
+                if vu is None:
+                    # a live device/core/bandwidth alloc would have
+                    # shown in the planes — unreachable unless the
+                    # planes drifted: fall back
                     return None
-                cpu -= lean[0]
-                mem -= lean[1]
-                disk -= lean[2]
-        for p in placements:
-            if p.terminal_status():
-                # allocs_fit skips terminal allocs entirely (neither
-                # usage nor ports/devices), so a lost/unknown
-                # transition costs nothing and needs no lean proof
-                continue
-            lean = _lean_usage(p)
-            if lean is None:
-                return None
-            # NOTE: no dedup against a live same-id store row — the
+                cpu -= vu[0]
+                mem -= vu[1]
+                disk -= vu[2]
+                eff_mask &= ~vu[3]
+        if eff_mask & cap[3]:
+            # a PROPOSED live/overlay alloc holds an agent-reserved
+            # port: any port bit surviving into the proposed set
+            # implies the exact walk builds its NetworkIndex, whose
+            # set_node pass already marked the static port used — the
+            # whole node plan rejects regardless of what it places
+            return False
+        for vu in place_vu:
+            # NOTE: no id-dedup against a live same-id store row — the
             # exact walk appends placements to the proposed list
-            # without one, and bit-identity tracks the exact walk
-            cpu += lean[0]
-            mem += lean[1]
-            disk += lean[2]
-        cap = self._node_cap(node)
+            # without one (usage AND ports), and bit-identity tracks
+            # the exact walk
+            cpu += vu[0]
+            mem += vu[1]
+            disk += vu[2]
+            if vu[3]:
+                if vu[3] & (eff_mask | cap[3]):
+                    # port collision against live/static/earlier
+                    # placements: the exact walk rejects, so this IS
+                    # the verdict, not a fallback
+                    return False
+                eff_mask |= vu[3]
         if max(cap[0], cap[1], cap[2], cpu, mem, disk) >= _F32_EXACT_MAX:
             return None
         return cpu <= cap[0] and mem <= cap[1] and disk <= cap[2]
@@ -765,6 +915,9 @@ class Planner:
         vector_nodes = 0
         fits: Dict[str, bool] = {}
         pending_exact: List[str] = []
+        has_ports = any(
+            not a.terminal_status() and a.fit_meta()[1]
+            for allocs in plan.node_allocation.values() for a in allocs)
         for node_id in plan.node_allocation:
             placements = plan.node_allocation[node_id]
             if not placements:
@@ -791,7 +944,8 @@ class Planner:
                     snapshot, plan, pending_exact).items():
                 fits[node_id] = fit
         rejected = sum(1 for f in fits.values() if not f)
-        plan_group_stats.note_plan(vector_nodes, fallback_nodes, rejected)
+        plan_group_stats.note_plan(vector_nodes, fallback_nodes, rejected,
+                                   has_ports=has_ports)
         return self._assemble_result(snapshot, plan, fits)
 
     # --- evaluation (plan_apply.go:403 evaluatePlan) --------------------

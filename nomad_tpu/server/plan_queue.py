@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs.eval_plan import Plan, PlanResult
 from nomad_tpu.utils.metrics import global_registry
+from nomad_tpu.utils.wavecohort import wave_cohorts
 from nomad_tpu.utils.witness import witness_lock
 
 
@@ -84,6 +85,11 @@ class PlanQueue:
             heapq.heappush(
                 self._heap, (-plan.priority, next(self._seq), pending)
             )
+            # drain the wave cohort BEFORE the notify: the waiter in
+            # dequeue_batch re-checks the tracker on wakeup, so the
+            # cohort's last plan must already be accounted or the
+            # applier would sleep its full window for nothing
+            wave_cohorts.note_plan()
             self._update_depth_gauge()
             self._cond.notify_all()
             return pending
@@ -107,10 +113,23 @@ class PlanQueue:
         whole burst against one view and commit it as ONE raft entry
         (the TPU build's plan-side analog of eval batching). An empty
         list means the timeout passed with nothing queued.
+
+        Wave-boundary drain (ISSUE 10): while a fired wave's plan
+        cohort is still landing (utils/wavecohort — armed by the
+        coalescer, drained per enqueue, bounded by the adaptive
+        deadline), the pop WAITS for the stragglers instead of
+        committing a wave as ~6 raft entries. The deadline caps the
+        added latency; cohort shortfalls expire it.
         """
         with self._lock:
             if not self._heap:
                 self._cond.wait(timeout)
+            if self._heap:
+                while len(self._heap) < max_n and self._enabled:
+                    wait_s = wave_cohorts.pending_wait_s()
+                    if wait_s <= 0.0:
+                        break
+                    self._cond.wait(wait_s)
             out = []
             while self._heap and len(out) < max_n:
                 out.append(heapq.heappop(self._heap)[2])

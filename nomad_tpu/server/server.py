@@ -67,6 +67,7 @@ class ServerConfig:
         coalesce_window_min_ms: float = 1.0,
         coalesce_window_max_ms: float = 50.0,
         coalesce_adaptive: bool = True,
+        broker_fill_window_ms: float = 5.0,
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -112,6 +113,10 @@ class ServerConfig:
         self.coalesce_window_min_ms = coalesce_window_min_ms
         self.coalesce_window_max_ms = coalesce_window_max_ms
         self.coalesce_adaptive = coalesce_adaptive
+        # broker batch-fill window (ISSUE 10): how long dequeue_batch
+        # holds a partially-filled multi-eval hand-out open for the
+        # producer burst; 0 disables (pre-ISSUE-10 behavior)
+        self.broker_fill_window_ms = broker_fill_window_ms
 
 
 class _EvalCommitBatch:
@@ -155,6 +160,7 @@ class Server:
         self.eval_broker = EvalBroker(
             nack_timeout=self.config.nack_timeout,
             delivery_limit=self.config.eval_delivery_limit,
+            batch_fill_window_s=self.config.broker_fill_window_ms / 1e3,
         )
         self.blocked_evals = BlockedEvals(self.eval_broker.enqueue)
         from nomad_tpu.server.stream import EventBroker

@@ -52,6 +52,7 @@ class UsagePlanes:
 
     n: int                                   # row axis length (padded)
     rows: Dict[str, int]                     # node id -> row (shared ref)
+    ids: Tuple                               # row -> node id (None = free)
     used_cpu: np.ndarray                     # f32[n]
     used_mem: np.ndarray
     used_disk: np.ndarray
@@ -64,6 +65,22 @@ class UsagePlanes:
     #: nodes and falls back to the exact walk otherwise
     #: (server/plan_apply.py).
     used_special: np.ndarray                 # i32[n]
+    #: count of live allocs on the node that use DEVICES — the only
+    #: part of used_special the ports-aware group check cannot prove
+    #: from planes (DeviceAccounter needs the exact walk)
+    used_devices: np.ndarray                 # i32[n]
+    #: row -> int bitmap of every concrete port held by the node's
+    #: live allocs (task networks reserved+dynamic, group shared
+    #: ports — exactly the set NetworkIndex.add_allocs indexes). Rows
+    #: with no ports carry no entry. The plan applier's ports-aware
+    #: vector check validates port-bearing plans against this plane
+    #: with one AND per placement.
+    port_masks: Dict[int, int] = field(default_factory=dict)
+    #: rows whose bitmap is NOT provable (out-of-range ports, an
+    #: add-overlap — the legal multi-address same-port state a flat
+    #: bitmap cannot represent — or a remove of unseen bits): the
+    #: checker must take the exact walk for these nodes
+    port_dirty: frozenset = frozenset()
     version: int = 0
     structure_version: int = 0
     uid: str = ""                            # owning store's identity
@@ -100,6 +117,11 @@ class UsageIndex:
         self.used_cores = np.zeros(0, np.int32)
         self.used_mbits = np.zeros(0, np.int32)
         self.used_special = np.zeros(0, np.int32)
+        self.used_devices = np.zeros(0, np.int32)
+        # live reserved-port bitmaps: row -> int mask; rows whose mask
+        # stopped being provable are poisoned until drop/rebuild
+        self.port_masks = {}
+        self.port_dirty = set()
         self.version = 0
         self.structure_version = 0
         # structural change log: (structure_version, node_id or None)
@@ -119,8 +141,8 @@ class UsageIndex:
         new_cap = pad_bucket(max(need, 1))
         if new_cap <= self.cap:
             return
-        for name in ("used_cpu", "used_mem", "used_disk",
-                     "used_cores", "used_mbits", "used_special"):
+        for name in ("used_cpu", "used_mem", "used_disk", "used_cores",
+                     "used_mbits", "used_special", "used_devices"):
             old = getattr(self, name)
             grown = np.zeros(new_cap, old.dtype)
             grown[: old.shape[0]] = old
@@ -156,8 +178,10 @@ class UsageIndex:
             return
         self.ids[row] = None
         self._free.append(row)
-        for name in ("used_cpu", "used_mem", "used_disk",
-                     "used_cores", "used_mbits", "used_special"):
+        self.port_masks.pop(row, None)
+        self.port_dirty.discard(row)
+        for name in ("used_cpu", "used_mem", "used_disk", "used_cores",
+                     "used_mbits", "used_special", "used_devices"):
             getattr(self, name)[row] = 0
         self._touch(structural=True, node_id=node_id)
         self._log_row(node_id)
@@ -184,6 +208,46 @@ class UsageIndex:
         self.used_mbits[row] += sign * mbits
         if uses_ports or uses_devices:
             self.used_special[row] += sign
+        if uses_devices:
+            self.used_devices[row] += sign
+        if uses_ports:
+            self._port_delta(row, a, sign)
+
+    def _port_delta(self, row: int, a, sign: int) -> None:
+        """Fold one port-bearing alloc into the row's bitmap.
+
+        Sound states stay provable: live allocs on a node are mutually
+        collision-free (the plan applier re-validates every commit), so
+        each used port belongs to exactly ONE live alloc and a removal
+        may clear its bits. Anything else — out-of-range ports, an
+        add that overlaps (the legal multi-address same-port state a
+        flat bitmap cannot represent), a remove of bits never added —
+        poisons the row: the group checker then takes the exact walk
+        for that node, which is always bit-identical.
+        """
+        if row in self.port_dirty:
+            return
+        mask, ok = a.port_meta()
+        if not ok:
+            self.port_dirty.add(row)
+            return
+        if not mask:
+            return
+        cur = self.port_masks.get(row, 0)
+        if sign > 0:
+            if cur & mask:
+                self.port_dirty.add(row)
+                return
+            self.port_masks[row] = cur | mask
+        else:
+            if mask & ~cur:
+                self.port_dirty.add(row)
+                return
+            cur &= ~mask
+            if cur:
+                self.port_masks[row] = cur
+            else:
+                self.port_masks.pop(row, None)
 
     def alloc_changed(self, old, new) -> None:
         """Apply one allocation transition (upsert/update/delete)."""
@@ -207,9 +271,11 @@ class UsageIndex:
         self.rows.clear()
         self.ids.clear()
         self._free.clear()
+        self.port_masks.clear()
+        self.port_dirty.clear()
         self.cap = 0
-        for name in ("used_cpu", "used_mem", "used_disk",
-                     "used_cores", "used_mbits", "used_special"):
+        for name in ("used_cpu", "used_mem", "used_disk", "used_cores",
+                     "used_mbits", "used_special", "used_devices"):
             setattr(self, name, np.zeros(0, getattr(self, name).dtype))
         for node in nodes:
             self.node_row(node.id)
@@ -253,12 +319,16 @@ class UsageIndex:
         self._copy = UsagePlanes(
             n=n,
             rows=dict(self.rows),
+            ids=tuple(self.ids),
             used_cpu=self.used_cpu[:n].copy(),
             used_mem=self.used_mem[:n].copy(),
             used_disk=self.used_disk[:n].copy(),
             used_cores=self.used_cores[:n].copy(),
             used_mbits=self.used_mbits[:n].copy(),
             used_special=self.used_special[:n].copy(),
+            used_devices=self.used_devices[:n].copy(),
+            port_masks=dict(self.port_masks),
+            port_dirty=frozenset(self.port_dirty),
             version=self.version,
             structure_version=self.structure_version,
             uid=self.uid,

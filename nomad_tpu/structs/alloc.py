@@ -245,24 +245,79 @@ class Allocation:
         self._fit_meta_cache = (ar, meta)
         return meta
 
+    def port_meta(self):
+        """(port_mask, ok), memoized against ``allocated_resources``.
+
+        ``port_mask`` is an int bitmap of every concrete port this
+        alloc holds (task networks' reserved + dynamic ports, group
+        shared ports — exactly the set NetworkIndex.add_allocs
+        indexes) — the per-node reserved-port usage plane
+        (state/usage.py) and the plan applier's vectorized port check
+        (server/plan_apply.py) are built from it. ``ok`` is False when
+        any port is out of range: the exact walk REJECTS such an alloc
+        as a collision, which a bitmap cannot express, so consumers
+        must fall back. Multi-address soundness (the same port on two
+        node IPs) is a NODE property — the checker gates on the node's
+        address count, not here.
+        """
+        ar = self.allocated_resources
+        cached = getattr(self, "_port_meta_cache", None)
+        if cached is not None and cached[0] is ar:
+            return cached[1]
+        mask = 0
+        ok = True
+        if ar is not None:
+            # 0 <= port < network.MAX_VALID_PORT; a port listed twice
+            # WITHIN the alloc collides with itself in the exact walk
+            # (NetworkIndex sets bits one port at a time), which a
+            # bitmap cannot express — not ok
+            for tr in ar.tasks.values():
+                for net in tr.networks:
+                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                        if p.value < 0 or p.value >= 65536 \
+                                or (mask >> p.value) & 1:
+                            ok = False
+                            continue
+                        mask |= 1 << p.value
+            for p in ar.shared.ports:
+                if p.value < 0 or p.value >= 65536 or (mask >> p.value) & 1:
+                    ok = False
+                    continue
+                mask |= 1 << p.value
+        meta = (mask, ok)
+        self._port_meta_cache = (ar, meta)
+        return meta
+
     def __getstate__(self):
         """Allocs ride raft entries, snapshots, and the client state DB
         (pickle); derived scratch (the fit_meta memo) must not bloat
         those wire/disk payloads."""
         state = dict(self.__dict__)
         state.pop("_fit_meta_cache", None)
+        state.pop("_port_meta_cache", None)
+        state.pop("_index_cache", None)
         return state
 
     def index(self) -> int:
-        """Alloc index parsed from Name "job.group[idx]" (structs.go)."""
+        """Alloc index parsed from Name "job.group[idx]" (structs.go).
+
+        Memoized: the reconciler's name-index bitmaps and name-ordered
+        walks re-parse the same immutable name several times per eval.
+        """
+        cached = getattr(self, "_index_cache", None)
+        if cached is not None:
+            return cached
         l = self.name.rfind("[")
         r = self.name.rfind("]")
         if l == -1 or r == -1 or r < l:
-            return -1
-        try:
-            return int(self.name[l + 1 : r])
-        except ValueError:
-            return -1
+            idx = -1
+        else:
+            try:
+                idx = int(self.name[l + 1 : r])
+            except ValueError:
+                idx = -1
+        self._index_cache = idx
+        return idx
 
     def job_namespaced_id(self) -> str:
         return f"{self.namespace}@{self.job_id}"

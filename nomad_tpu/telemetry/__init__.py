@@ -100,6 +100,15 @@ def reset() -> None:
         plan_group_stats.reset()
     except Exception:                           # noqa: BLE001
         pass
+    try:
+        # wave-cohort drain counters (plan-queue wave-boundary
+        # batching) follow the burst window; the learned drain EWMA
+        # survives like any other timing calibration
+        from nomad_tpu.utils.wavecohort import wave_cohorts
+
+        wave_cohorts.reset_stats()
+    except Exception:                           # noqa: BLE001
+        pass
 
 
 if os.environ.get("NOMAD_TPU_TRACE", "") not in ("", "0"):

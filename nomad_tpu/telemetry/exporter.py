@@ -199,6 +199,13 @@ def prometheus_text(registry=None) -> str:
             lines.append(
                 f'nomad_tpu_plan_group_plans_total{{kind="{kind}"}} '
                 f'{g[key]}')
+        lines.append(
+            "# TYPE nomad_tpu_plan_group_port_plans_total counter")
+        for kind, key in (("vector", "port_vector_plans"),
+                          ("fallback", "port_fallback_plans")):
+            lines.append(
+                f'nomad_tpu_plan_group_port_plans_total{{kind="{kind}"}} '
+                f'{g[key]}')
         lines.append("# TYPE nomad_tpu_plan_group_rejects_total counter")
         lines.append(
             f"nomad_tpu_plan_group_rejects_total "

@@ -431,6 +431,12 @@ class EvalTensors:
     ask: AskTensor
     desired_count: int               # tg.count (anti-affinity denominator)
     algorithm: str = "binpack"       # binpack | spread (cluster config)
+    #: bool[n_pad] overlay for reserved-port asks: nodes whose LIVE
+    #: allocs already hold an asked port (from the usage index's port
+    #: bitmaps — state/usage.py). The static node plane only covers
+    #: agent-reserved ports; without this the kernel picks occupied
+    #: nodes and placement burns an assigner-fail + masked relaunch.
+    port_live_conflict: Optional[np.ndarray] = None
 
 
 class IncrementalClusterCache:

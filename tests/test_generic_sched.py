@@ -480,3 +480,88 @@ class TestPlanRejection:
             e.triggered_by == consts.EVAL_TRIGGER_MAX_PLAN_ATTEMPTS
             for e in h.create_evals
         )
+
+
+class TestLeanStaticPorts:
+    """ISSUE 10: static-port lean asks skip the per-slot _NodeAssigner
+    (scaffold.lean_ports) — placement proves port freedom from the
+    kernel conflict plane + the usage index's live port bitmaps."""
+
+    def _port_job(self, port=8080, count=3):
+        job = mock.simple_job()
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.networks = [structs.NetworkResource(
+            mode="host",
+            reserved_ports=[structs.Port(label="http", value=port)],
+        )]
+        return job
+
+    def test_static_port_job_is_lean_ports(self):
+        from nomad_tpu.scheduler.scaffold import scaffold_for
+
+        job = self._port_job()
+        s = scaffold_for(job, job.task_groups[0])
+        assert s.lean_ports
+        assert not s.lean_assign
+        assert s.static_port_mask == 1 << 8080
+
+    def test_placement_skips_assigner(self, monkeypatch):
+        from nomad_tpu.scheduler import stack as stack_mod
+
+        calls = []
+        orig = stack_mod._NodeAssigner.assign
+
+        def spy(self, tg, score):
+            calls.append(tg.name)
+            return orig(self, tg, score)
+
+        monkeypatch.setattr(stack_mod._NodeAssigner, "assign", spy)
+        h, nodes = make_harness(5)
+        job = self._port_job(count=3)
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 3
+        assert not calls, "static-port ask walked the exact assigner"
+        # each placement landed on its own node (one port per node)
+        assert len({a.node_id for a in placed}) == 3
+        for a in placed:
+            shared = a.allocated_resources.shared
+            assert [p.value for p in shared.ports] == [8080]
+            assert shared.networks and \
+                [p.value for p in shared.networks[0].reserved_ports] == [8080]
+            # the tasks skeleton is the (job, tg)-shared one
+            assert a.allocated_resources.tasks["web"].cpu.cpu_shares == 500
+
+    def test_live_port_occupancy_respected(self):
+        """A second job asking the same static port must avoid nodes
+        whose LIVE allocs hold it (usage-index bitmaps feed the kernel
+        conflict plane and the slot check)."""
+        h, nodes = make_harness(4)
+        job1 = self._port_job(count=2)
+        h.state.upsert_job(job1)
+        run_eval(h, job1)
+        first_nodes = {a.node_id for a in h.placed_allocs()}
+        assert len(first_nodes) == 2
+
+        job2 = self._port_job(count=2)
+        h.state.upsert_job(job2)
+        run_eval(h, job2)
+        placed2 = [a for a in h.placed_allocs() if a.job_id == job2.id]
+        assert len(placed2) == 2
+        second_nodes = {a.node_id for a in placed2}
+        assert not (first_nodes & second_nodes), \
+            "same static port double-placed on a node"
+
+    def test_port_exhaustion_fails_placement(self):
+        """More asks than nodes: the surplus slot must fail (blocked
+        eval), not double-claim a port."""
+        h, nodes = make_harness(2)
+        job = self._port_job(count=3)
+        h.state.upsert_job(job)
+        run_eval(h, job)
+        placed = h.placed_allocs()
+        assert len(placed) == 2
+        assert len({a.node_id for a in placed}) == 2
+        assert h.create_evals, "surplus ask should create a blocked eval"

@@ -69,6 +69,7 @@ def _scenario(seed: int) -> dict:
     rng = random.Random(seed)
     nodes = [f"node-{seed}-{i}" for i in range(N_NODES)]
     port_counter = [20000]
+    issued_ports: list = []
 
     def alloc_spec(i: str, node_id: str, big: bool = False,
                    port: bool = False) -> dict:
@@ -82,12 +83,26 @@ def _scenario(seed: int) -> dict:
             "disk": rng.choice([100, 1000]),
         }
         if port:
-            port_counter[0] += 1
-            spec["port"] = port_counter[0]
+            roll = rng.random()
+            if issued_ports and roll < 0.35:
+                # deliberate conflict mix: reuse a port some other
+                # alloc of this scenario already holds — the ports
+                # plane must reject exactly where serialized
+                # NetworkIndex walks reject
+                spec["port"] = rng.choice(issued_ports)
+            elif roll < 0.45:
+                # mock nodes agent-reserve port 22: collides with the
+                # node's static bitmap
+                spec["port"] = 22
+            else:
+                port_counter[0] += 1
+                spec["port"] = port_counter[0]
+            issued_ports.append(spec["port"])
         return spec
 
     existing = [
-        alloc_spec(f"pre-{i}", rng.choice(nodes))
+        alloc_spec(f"pre-{i}", rng.choice(nodes),
+                   port=rng.random() < 0.25)
         for i in range(rng.randint(0, 10))
     ]
     plans = []
@@ -104,7 +119,7 @@ def _scenario(seed: int) -> dict:
             spec = alloc_spec(
                 f"{p}-{s}", node_id,
                 big=rng.random() < 0.5,
-                port=rng.random() < 0.15,   # non-lean -> exact fallback
+                port=rng.random() < 0.35,   # ports-plane vector check
             )
             if existing and rng.random() < 0.15:
                 # in-place update: placement re-uses a live alloc id
@@ -181,7 +196,9 @@ def _store_fingerprint(store) -> tuple:
     u = snap.usage
     usage = tuple(sorted(
         (nid, float(u.used_cpu[row]), float(u.used_mem[row]),
-         float(u.used_disk[row]), int(u.used_special[row]))
+         float(u.used_disk[row]), int(u.used_special[row]),
+         int(u.used_devices[row]), u.port_masks.get(row, 0),
+         row in u.port_dirty)
         for nid, row in u.rows.items()))
     return rows, usage
 
@@ -255,7 +272,9 @@ class TestGroupCommitBitIdentity:
         assert g["fallback_nodes"] == 0      # both proven by the planes
         assert g["rejected_node_plans"] == 1
 
-    def test_non_lean_plan_counts_as_fallback(self):
+    def test_port_plan_proven_by_vector_check(self):
+        """ISSUE 10: a static-port plan is proven by the ports plane —
+        no exact walk — and the port-coverage counters say so."""
         plan_group_stats.reset()
         store, _ = _build_universe(
             {"seed": 2, "nodes": ["node-f-0"], "existing": [],
@@ -266,7 +285,78 @@ class TestGroupCommitBitIdentity:
                               "port": 23456})
         results = planner.apply_batch(
             [Plan(priority=50, node_allocation={"node-f-0": [ported]})])
-        assert results[0].node_allocation    # fits via the exact walk
+        assert results[0].node_allocation
+        g = plan_group_stats.snapshot()
+        assert g["fallback_plans"] == 0
+        assert g["vector_plans"] == 1
+        assert g["port_plans"] == 1
+        assert g["port_vector_plans"] == 1
+        assert g["port_fallback_plans"] == 0
+
+    def test_port_conflict_rejected_by_vector_check(self):
+        """Same port twice — live alloc vs new placement — rejects
+        through the bitmap AND, without an exact walk."""
+        plan_group_stats.reset()
+        store, _ = _build_universe(
+            {"seed": 7, "nodes": ["node-p-0"],
+             "existing": [{"id": "pre-p", "node_id": "node-p-0",
+                           "cpu": 200, "mem": 64, "disk": 10,
+                           "port": 24000}],
+             "plans": []})
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        clash = _make_alloc({"id": "p-1", "node_id": "node-p-0",
+                             "cpu": 200, "mem": 64, "disk": 10,
+                             "port": 24000})
+        free = _make_alloc({"id": "p-2", "node_id": "node-p-0",
+                            "cpu": 200, "mem": 64, "disk": 10,
+                            "port": 24001})
+        results = planner.apply_batch([
+            Plan(priority=50, node_allocation={"node-p-0": [clash]}),
+            Plan(priority=50, node_allocation={"node-p-0": [free]}),
+        ])
+        assert not results[0].node_allocation
+        assert results[0].refresh_index > 0
+        assert results[1].node_allocation
+        g = plan_group_stats.snapshot()
+        assert g["fallback_nodes"] == 0
+        assert g["rejected_node_plans"] == 1
+
+    def test_static_reserved_port_conflict_rejected(self):
+        """mock nodes agent-reserve port 22: a placement claiming it
+        must reject against the static bitmap (NetworkIndex.set_node
+        marks agent-reserved ports used)."""
+        plan_group_stats.reset()
+        store, _ = _build_universe(
+            {"seed": 8, "nodes": ["node-r-0"], "existing": [],
+             "plans": []})
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        ssh = _make_alloc({"id": "r-1", "node_id": "node-r-0",
+                           "cpu": 200, "mem": 64, "disk": 10,
+                           "port": 22})
+        results = planner.apply_batch(
+            [Plan(priority=50, node_allocation={"node-r-0": [ssh]})])
+        assert not results[0].node_allocation
+        g = plan_group_stats.snapshot()
+        assert g["fallback_nodes"] == 0
+        assert g["rejected_node_plans"] == 1
+
+    def test_device_plan_still_falls_back(self):
+        """Devices stay exact-walk territory (DeviceAccounter)."""
+        from nomad_tpu.structs.resources import AllocatedDeviceResource
+
+        plan_group_stats.reset()
+        store, _ = _build_universe(
+            {"seed": 9, "nodes": ["node-d-0"], "existing": [],
+             "plans": []})
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        dev = _make_alloc({"id": "d-1", "node_id": "node-d-0",
+                           "cpu": 200, "mem": 64, "disk": 10})
+        dev.allocated_resources.tasks["web"].devices.append(
+            AllocatedDeviceResource(vendor="nvidia", type="gpu",
+                                    name="t4", device_ids=["gpu0"]))
+        results = planner.apply_batch(
+            [Plan(priority=50, node_allocation={"node-d-0": [dev]})])
+        assert results[0].node_allocation
         g = plan_group_stats.snapshot()
         assert g["fallback_plans"] == 1
         assert g["vector_plans"] == 0
@@ -288,3 +378,83 @@ class TestGroupCommitBitIdentity:
         results = planner.apply_batch(plans)
         assert store.latest_index() == before + 1
         assert all(r.alloc_index == before + 1 for r in results)
+
+
+class TestWaveCohortDrain:
+    """Wave-boundary plan batching (ISSUE 10): the plan queue's
+    dequeue_batch holds its drain window open while a fired wave's
+    cohort is still landing, so a wave commits as ONE raft entry."""
+
+    def _tracker(self):
+        from nomad_tpu.utils.wavecohort import WaveCohortTracker
+
+        return WaveCohortTracker()
+
+    def test_cohort_drains_and_learns(self):
+        t = self._tracker()
+        assert t.pending_wait_s() == 0.0
+        t.note_wave(3)
+        assert t.pending_wait_s() > 0.0
+        for _ in range(3):
+            t.note_plan()
+        assert t.pending_wait_s() == 0.0
+        snap = t.snapshot()
+        assert snap["drained_cohorts"] == 1
+        assert snap["cohort_plans"] == 3
+        assert snap["drain_ewma_ms"] >= 0.0
+
+    def test_cohort_shortfall_expires(self):
+        t = self._tracker()
+        t.WINDOW_DEFAULT_S = 0.01
+        t.note_wave(2)
+        t.note_plan()
+        import time
+
+        deadline = time.monotonic() + 2.0
+        while t.pending_wait_s() > 0.0 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert t.pending_wait_s() == 0.0
+        assert t.snapshot()["expired_cohorts"] == 1
+
+    def test_dequeue_batch_waits_for_cohort(self):
+        """Enqueue plan 1, arm a 2-plan cohort, enqueue plan 2 shortly
+        after from another thread: dequeue_batch must return BOTH."""
+        import threading
+        import time
+
+        from nomad_tpu.server import plan_queue as pq_mod
+        from nomad_tpu.utils.wavecohort import WaveCohortTracker
+
+        tracker = WaveCohortTracker()
+        orig = pq_mod.wave_cohorts
+        pq_mod.wave_cohorts = tracker
+        try:
+            q = pq_mod.PlanQueue()
+            q.set_enabled(True)
+            tracker.note_wave(2)
+            q.enqueue(Plan(priority=50))
+
+            def late():
+                time.sleep(0.01)
+                q.enqueue(Plan(priority=50))
+
+            th = threading.Thread(target=late, daemon=True)
+            th.start()
+            batch = q.dequeue_batch(128, timeout=0.2)
+            th.join()
+            assert len(batch) == 2, "applier popped a partial cohort"
+        finally:
+            pq_mod.wave_cohorts = orig
+
+    def test_dequeue_batch_unaffected_without_cohort(self):
+        from nomad_tpu.server.plan_queue import PlanQueue
+
+        q = PlanQueue()
+        q.set_enabled(True)
+        q.enqueue(Plan(priority=50))
+        import time
+
+        t0 = time.monotonic()
+        batch = q.dequeue_batch(128, timeout=0.2)
+        assert len(batch) == 1
+        assert time.monotonic() - t0 < 0.05

@@ -1,0 +1,311 @@
+"""Reconcile fast-path bit-identity: the fused single-pass classifier
+(scheduler/reconcile.classify_group + the memoized per-(job, tg)
+invariants) must produce ReconcileResults identical to the legacy
+multi-pass composition (filter_by_tainted -> should_filter ->
+filter_by_rescheduleable -> _update_by_reschedulable) over randomized
+alloc populations — tainted/disconnected/canary/reschedule/drain mixes
+— INCLUDING the order of every result list (stops, placements,
+followup evals), which downstream plan construction observes.
+
+Run ids (followup eval ids, new deployment ids) are generated fresh
+per run, so fingerprints normalize them by order of first appearance;
+everything else must match exactly.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.reconcile import (
+    AllocReconciler,
+    classify_group,
+    filter_by_rescheduleable,
+    filter_by_tainted,
+    union,
+)
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import (
+    AllocDeploymentStatus,
+    DesiredTransition,
+    RescheduleEvent,
+    RescheduleTracker,
+    TaskEvent,
+    TaskState,
+)
+from nomad_tpu.structs.eval_plan import Deployment, DeploymentState
+from nomad_tpu.structs.job import ReschedulePolicy
+
+NOW = 1_700_000_000.0
+
+CLIENT_STATUSES = (
+    consts.ALLOC_CLIENT_PENDING, consts.ALLOC_CLIENT_RUNNING,
+    consts.ALLOC_CLIENT_COMPLETE, consts.ALLOC_CLIENT_FAILED,
+    consts.ALLOC_CLIENT_LOST, consts.ALLOC_CLIENT_UNKNOWN,
+)
+DESIRED_STATUSES = (
+    consts.ALLOC_DESIRED_RUN, consts.ALLOC_DESIRED_STOP,
+    consts.ALLOC_DESIRED_EVICT,
+)
+
+
+def _build_scenario(seed: int):
+    """(reconciler_kwargs...) for one randomized population."""
+    rng = random.Random(seed)
+    is_batch = rng.random() < 0.3
+
+    job = mock.job(id=f"recon-{seed}")
+    if is_batch:
+        job.type = consts.JOB_TYPE_BATCH
+    tg = job.task_groups[0]
+    tg.count = rng.randint(1, 8)
+    # reschedule-policy mix: disabled / constant / unlimited / default
+    roll = rng.random()
+    if roll < 0.25:
+        tg.reschedule_policy = ReschedulePolicy(attempts=0, interval_s=0)
+    elif roll < 0.5:
+        tg.reschedule_policy = ReschedulePolicy(
+            attempts=2, interval_s=600, delay_s=5, delay_function="constant")
+    elif roll < 0.75:
+        tg.reschedule_policy = ReschedulePolicy(
+            delay_s=5, delay_function="exponential", max_delay_s=300,
+            unlimited=True)
+    else:
+        tg.reschedule_policy = None
+    if rng.random() < 0.4:
+        tg.max_client_disconnect_s = rng.choice([30.0, 600.0])
+    if rng.random() < 0.3:
+        tg.stop_after_client_disconnect_s = 60.0
+
+    # older job version for the batch terminal filter
+    old_job = mock.job(id=job.id)
+    old_job.type = job.type
+    old_job.version = 0
+    old_job.create_index = 1
+    job.version = rng.randint(0, 2)
+    job.create_index = 42
+
+    nodes = {}
+    tainted = {}
+    node_ids = []
+    for i in range(6):
+        status = rng.choice([
+            consts.NODE_STATUS_READY, consts.NODE_STATUS_READY,
+            consts.NODE_STATUS_DOWN, consts.NODE_STATUS_DISCONNECTED,
+        ])
+        drain = status == consts.NODE_STATUS_READY and rng.random() < 0.2
+        n = mock.node(status=status, drain=drain)
+        nodes[n.id] = n
+        node_ids.append(n.id)
+        if drain or status in (consts.NODE_STATUS_DOWN,
+                               consts.NODE_STATUS_DISCONNECTED):
+            tainted[n.id] = n
+    missing_id = f"missing-node-{seed}"
+    node_ids.append(missing_id)
+    if rng.random() < 0.7:
+        tainted[missing_id] = None
+
+    deployment = None
+    if rng.random() < 0.5:
+        deployment = Deployment(
+            id=f"dep-{seed}",
+            job_id=job.id,
+            job_version=job.version,
+            job_create_index=job.create_index,
+            status=rng.choice([
+                consts.DEPLOYMENT_STATUS_RUNNING,
+                consts.DEPLOYMENT_STATUS_PAUSED,
+                consts.DEPLOYMENT_STATUS_FAILED,
+                consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+            ]),
+        )
+        ds = DeploymentState(
+            desired_total=tg.count,
+            desired_canaries=rng.choice([0, 0, 2]),
+            promoted=rng.random() < 0.3,
+        )
+        deployment.task_groups[tg.name] = ds
+
+    allocs = []
+    for i in range(rng.randint(0, 18)):
+        a_job = old_job if (is_batch and rng.random() < 0.3) else job
+        a = mock.alloc(
+            id=f"alloc-{seed}-{i:02d}",
+            job=a_job,
+            job_id=job.id,
+            task_group=tg.name,
+            name=f"{job.id}.{tg.name}[{rng.randint(0, tg.count + 2)}]",
+            node_id=rng.choice(node_ids),
+            desired_status=rng.choice(DESIRED_STATUSES),
+            client_status=rng.choice(CLIENT_STATUSES),
+            job_version=a_job.version,
+            modify_time_ns=int((NOW - rng.uniform(0, 1200)) * 1e9),
+        )
+        if rng.random() < 0.3:
+            a.desired_transition = DesiredTransition(
+                migrate=rng.random() < 0.5,
+                reschedule=rng.random() < 0.3,
+                force_reschedule=rng.random() < 0.2,
+            )
+        if rng.random() < 0.3:
+            events = []
+            t0 = int((NOW - rng.uniform(10, 900)) * 1e9)
+            events.append(TaskEvent(type="Disconnected", time_ns=t0))
+            if rng.random() < 0.6:
+                events.append(TaskEvent(
+                    type="Reconnected",
+                    time_ns=t0 + int(rng.uniform(-5, 60) * 1e9)))
+            a.task_states = {"web": TaskState(events=events)}
+        if rng.random() < 0.25:
+            a.reschedule_tracker = RescheduleTracker(events=[
+                RescheduleEvent(
+                    reschedule_time_ns=int((NOW - rng.uniform(0, 700)) * 1e9),
+                    prev_alloc_id=f"prev-{i}", prev_node_id=rng.choice(node_ids))
+                for _ in range(rng.randint(1, 3))
+            ])
+        if rng.random() < 0.15:
+            a.follow_up_eval_id = f"eval-follow-{seed}"
+        if rng.random() < 0.1:
+            a.next_allocation = f"alloc-next-{i}"
+        if deployment is not None and rng.random() < 0.4:
+            a.deployment_id = deployment.id
+            a.deployment_status = AllocDeploymentStatus(
+                healthy=rng.choice([True, False, None]),
+                canary=rng.random() < 0.3,
+            )
+            if a.deployment_status.canary:
+                deployment.task_groups[tg.name].placed_canaries.append(a.id)
+        allocs.append(a)
+
+    update_rolls = {a.id: rng.random() for a in allocs}
+
+    def update_fn(existing, new_job, new_tg):
+        r = update_rolls.get(existing.id, 0.0)
+        if r < 0.6:
+            return True, False, None
+        if r < 0.8:
+            return False, True, None
+        return False, False, existing.copy_skip_job()
+
+    return {
+        "alloc_update_fn": update_fn,
+        "batch": is_batch,
+        "job_id": job.id,
+        "job": job,
+        "deployment": deployment,
+        "existing_allocs": allocs,
+        "tainted_nodes": tainted,
+        "eval_id": f"eval-{seed}",
+        "eval_priority": 50,
+        "now": NOW,
+    }
+
+
+def _fingerprint(results):
+    """Order-preserving fingerprint with generated ids normalized by
+    first appearance (followup eval ids, new deployment ids)."""
+    norm = {}
+
+    def nid(x):
+        if not x:
+            return ""
+        return norm.setdefault(x, f"gen-{len(norm)}")
+
+    place = [
+        (p.name, getattr(p, "canary", False), p.previous_alloc.id
+         if p.previous_alloc is not None else "",
+         getattr(p, "reschedule", False), getattr(p, "lost", False),
+         getattr(p, "downgrade_non_canary", False),
+         getattr(p, "min_job_version", 0))
+        for p in results.place
+    ]
+    destructive = [
+        (d.place_name, d.stop_alloc.id if d.stop_alloc else "",
+         d.stop_status_description)
+        for d in results.destructive_update
+    ]
+    stop = [
+        (s.alloc.id, s.client_status, s.status_description,
+         nid(s.followup_eval_id))
+        for s in results.stop
+    ]
+    inplace = [a.id for a in results.inplace_update]
+    attr = {aid: nid(a.follow_up_eval_id)
+            for aid, a in results.attribute_updates.items()}
+    disco = {
+        aid: (a.client_status, nid(a.follow_up_eval_id),
+              tuple(sorted(
+                  (name, tuple((e.type, e.time_ns) for e in ts.events))
+                  for name, ts in a.task_states.items())))
+        for aid, a in results.disconnect_updates.items()
+    }
+    reco = {aid: a.client_status
+            for aid, a in results.reconnect_updates.items()}
+    du = {
+        g: (d.ignore, d.place, d.migrate, d.stop, d.in_place_update,
+            d.destructive_update, d.canary, d.preemptions)
+        for g, d in results.desired_tg_updates.items()
+    }
+    followups = {
+        g: [(ev.triggered_by, round(ev.wait_until_s, 6), nid(ev.id))
+            for ev in evs]
+        for g, evs in results.desired_followup_evals.items()
+    }
+    dep = None
+    if results.deployment is not None:
+        d = results.deployment
+        dep = (nid(d.id), d.status, d.status_description, sorted(
+            (g, s.desired_total, s.desired_canaries, s.promoted,
+             tuple(nid(c) if c in norm else c for c in s.placed_canaries))
+            for g, s in d.task_groups.items()))
+    dep_updates = [
+        (nid(u["deployment_id"]) if u["deployment_id"] in norm
+         else u["deployment_id"], u["status"])
+        for u in results.deployment_updates
+    ]
+    return (place, destructive, stop, inplace, attr, disco, reco, du,
+            followups, dep, dep_updates)
+
+
+class TestReconcileFastBitIdentity:
+    @pytest.mark.parametrize("seed", range(40))
+    def test_fast_matches_legacy(self, seed):
+        kwargs = _build_scenario(seed)
+        legacy = AllocReconciler(use_legacy_filters=True, **kwargs).compute()
+        fast = AllocReconciler(use_legacy_filters=False, **kwargs).compute()
+        assert _fingerprint(legacy) == _fingerprint(fast), f"seed {seed}"
+
+    @pytest.mark.parametrize("seed", range(25))
+    def test_classify_group_matches_filter_pipeline(self, seed):
+        """The fused classifier against the raw legacy pipeline,
+        checking set MEMBERSHIP AND ORDER for every partition."""
+        kwargs = _build_scenario(seed)
+        allocs = {a.id: a for a in kwargs["existing_allocs"]}
+        tainted = kwargs["tainted_nodes"]
+        is_batch = kwargs["batch"]
+        eval_id = kwargs["eval_id"]
+        deployment = kwargs["deployment"]
+
+        unt, mig, lost, disc, reco, ign = filter_by_tainted(
+            allocs, tainted, True, NOW)
+        unt2, res_now, res_later = filter_by_rescheduleable(
+            unt, is_batch, False, NOW, eval_id, deployment)
+        _, res_disc, _ = filter_by_rescheduleable(
+            disc, is_batch, True, NOW, eval_id, deployment)
+        res_all = union(res_now, res_disc)
+
+        cls = classify_group(
+            allocs, tainted, True, NOW, is_batch, eval_id, deployment)
+
+        assert list(cls.untainted) == list(unt2), f"seed {seed}"
+        assert list(cls.migrate) == list(mig)
+        assert list(cls.lost) == list(lost)
+        assert list(cls.disconnecting) == list(disc)
+        assert list(cls.reconnecting) == list(reco)
+        assert cls.ignore == len(ign)
+        assert list(cls.reschedule_now) == list(res_all)
+        assert [(i.alloc_id, i.reschedule_time_s)
+                for i in cls.reschedule_later] == \
+            [(i.alloc_id, i.reschedule_time_s) for i in res_later]

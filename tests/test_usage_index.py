@@ -155,3 +155,74 @@ class TestEvalTensorFastPathParity:
                      "base_mask", "avail_mbits", "free_dyn_delta"):
             f, s = getattr(fast, name), getattr(slow, name)
             assert np.array_equal(f, s), (name, f, s)
+
+
+class TestPortPlane:
+    """The per-node reserved-port bitmap plane (ISSUE 10): maintained
+    from port_meta on alloc transitions, poisoned whenever the flat
+    bitmap stops being provable."""
+
+    def _ported_alloc(self, node_id, port, aid=None):
+        from nomad_tpu.structs.network import Port
+        from nomad_tpu.structs.resources import AllocatedSharedResources
+
+        a = mock.alloc(node_id=node_id,
+                       client_status=consts.ALLOC_CLIENT_RUNNING)
+        if aid:
+            a.id = aid
+        a.allocated_resources.shared = AllocatedSharedResources(
+            disk_mb=150, ports=[Port(label="p", value=port)])
+        return a
+
+    def test_add_and_remove_port_bits(self):
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        a = self._ported_alloc(node.id, 8080)
+        store.upsert_allocs([a])
+        u = store.snapshot().usage
+        row = u.rows[node.id]
+        assert u.port_masks.get(row, 0) == 1 << 8080
+        assert row not in u.port_dirty
+        stop = a.copy_skip_job()
+        stop.desired_status = consts.ALLOC_DESIRED_STOP
+        store.upsert_allocs([stop])
+        u = store.snapshot().usage
+        assert u.port_masks.get(row, 0) == 0
+
+    def test_overlapping_add_poisons_row(self):
+        """Two live allocs sharing a port (the multi-address state a
+        flat bitmap cannot express) poison the row — consumers fall
+        back to the exact walk."""
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        store.upsert_allocs([self._ported_alloc(node.id, 9000, "pa-1"),
+                             self._ported_alloc(node.id, 9000, "pa-2")])
+        u = store.snapshot().usage
+        assert u.rows[node.id] in u.port_dirty
+
+    def test_out_of_range_port_poisons_row(self):
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        store.upsert_allocs([self._ported_alloc(node.id, 70000)])
+        u = store.snapshot().usage
+        assert u.rows[node.id] in u.port_dirty
+
+    def test_devices_plane_counts(self):
+        from nomad_tpu.structs.resources import AllocatedDeviceResource
+
+        store = StateStore()
+        node = mock.node()
+        store.upsert_node(node)
+        a = mock.alloc(node_id=node.id,
+                       client_status=consts.ALLOC_CLIENT_RUNNING)
+        a.allocated_resources.tasks["web"].devices.append(
+            AllocatedDeviceResource(vendor="nvidia", type="gpu",
+                                    name="t4", device_ids=["g0"]))
+        store.upsert_allocs([a])
+        u = store.snapshot().usage
+        row = u.rows[node.id]
+        assert int(u.used_devices[row]) == 1
+        assert int(u.used_special[row]) == 1
