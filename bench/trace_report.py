@@ -220,6 +220,26 @@ def decompose(stage_totals: Dict, wall_s: float, n_evals: int,
     return out
 
 
+def serving_snapshot(server) -> Dict:
+    """The TRACE_DECOMP ``serving`` section (ISSUE 11): the serving
+    plane's burst-window state — event-ring publish/deliver/lost
+    accounting, blocking-query wakeups, heartbeat fan-in coalescing,
+    and the delivery-lag distribution. The same numbers
+    ``GET /v1/operator/stream-health`` serves live."""
+    from nomad_tpu.server.server import client_update_stats
+    from nomad_tpu.state.store import watch_stats
+    from nomad_tpu.telemetry.histogram import STREAM_DELIVER, histograms
+
+    deliver = histograms.peek(STREAM_DELIVER)
+    return {
+        "stream": server.event_broker.snapshot(),
+        "watch": watch_stats.snapshot(),
+        "heartbeat": client_update_stats.snapshot(),
+        "deliver_latency": deliver.snapshot() if deliver is not None
+        else {},
+    }
+
+
 def _settle_committed(server, done0: int, timeout_s: float = 5.0) -> int:
     """Processed-counter delta once the counter stops moving.
 
@@ -375,6 +395,10 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
             # on the other would break the count-equality gate
             _settle_committed(server, 0)
             telemetry.reset()
+            # serving-plane counters window with the burst like every
+            # other stats source (broker stats are per-server, so the
+            # global telemetry.reset cannot reach them)
+            server.event_broker.reset_stats()
             done0 = sum(w.processed for w in server.workers)
             cpu0 = time.process_time()
             t0 = time.perf_counter()
@@ -450,6 +474,11 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                 (t["E2eMs"] for t in flight_recorder.trees()),
                 default=0.0)
             decomp["tail"] = tail
+            # the serving section (ISSUE 11): even a burst with no
+            # external subscribers publishes every FSM apply into the
+            # ring — the section's publish/watch/heartbeat counters
+            # are the steady burst's serving-side cost accounting
+            decomp["serving"] = serving_snapshot(server)
             history.append(decomp)
         decomp = history[-1]
         if len(history) > 1:
@@ -681,6 +710,7 @@ def run_contention_burst(n_nodes: int = 400, n_jobs: int = 80,
                     time.sleep(0.001)
 
         telemetry.reset()
+        server.event_broker.reset_stats()
         done0 = sum(w.processed for w in server.workers)
         for k in range(heartbeat_threads):
             th = threading.Thread(target=storm, args=(k,), daemon=True,
@@ -725,10 +755,245 @@ def run_contention_burst(n_nodes: int = 400, n_jobs: int = 80,
             "flight_recorder": fr,
             "slow_trees_captured": fr["captured"],
             "latency": histograms.snapshot(),
+            "serving": serving_snapshot(server),
         }
     finally:
         stop.set()
         for th in storm_threads:
+            th.join(timeout=2.0)
+        if not was_enabled:
+            telemetry.disable()
+        server.shutdown()
+
+
+def run_fleet_burst(n_clients: int = 10_000, n_nodes: int = 400,
+                    n_jobs: int = 60, allocs_per_job: int = 5,
+                    batch_size: int = 16, warmup_jobs: int = 10,
+                    heartbeat_threads: int = 6,
+                    watcher_threads: int = 8,
+                    subscriber_threads: int = 3,
+                    drain_per_sweep: int = 256,
+                    submit_group: int = 4,
+                    submit_pace_s: float = 0.08,
+                    deadline_s: float = 150.0) -> Dict:
+    """ISSUE 11 / ROADMAP open item 4: the standing FLEET cell — the
+    serving plane under fleet-scale read/watch load while the steady
+    eval burst runs.
+
+    ``n_clients`` simulated clients are multiplexed over a handful of
+    threads (a real fleet is mostly parked sockets; the server-side
+    state per client — a ring cursor, a heartbeat timer, watch
+    registrations — is what scales, and THAT is per-client here):
+
+    - every client holds an event-stream ``Subscription`` (a ring
+      cursor; topics rotated all/Allocation/Job), drained by
+      ``subscriber_threads`` in rotating windows of ``drain_per_sweep``
+      — the sparse-polling pattern of a real UI fleet, which makes the
+      max-lag / lost-events ring metrics do real work;
+    - heartbeat threads hammer ``node_heartbeat`` round-robin over the
+      node population on the clients' behalf (the fan-in path ISSUE 11
+      batches);
+    - watcher threads hold blocking queries (``block_until`` on the
+      alloc/job tables) back to back — the wakeup counters measure the
+      watch plane server-side.
+
+    Emits the ``fleet_*`` trend lines: heartbeats/sec, watch
+    wakeups/sec, the stream delivery-lag distribution (FSM apply →
+    consumer hand-off), lost events, and the e2e eval latency
+    distribution under fleet load — the standing gate every
+    serving-plane PR is judged against.
+    """
+    from nomad_tpu import mock, telemetry
+    from nomad_tpu.server.server import Server, ServerConfig
+    from nomad_tpu.state.store import watch_stats
+    from nomad_tpu.telemetry.histogram import (
+        STREAM_DELIVER,
+        histograms,
+    )
+
+    server = Server(ServerConfig(
+        num_workers=1,
+        worker_batch_size=batch_size,
+        heartbeat_ttl=3600.0,
+    ))
+    server.start()
+    was_enabled = telemetry.enabled()
+    stop = threading.Event()
+    hb_counts = [0] * heartbeat_threads
+    watch_counts = [0] * watcher_threads
+    drained_counts = [0] * subscriber_threads
+    fleet_threads = []
+    try:
+        node_ids = []
+        for _ in range(n_nodes):
+            node = mock.node()
+            node_ids.append(node.id)
+            server.node_register(node)
+        telemetry.enable()
+
+        def submit(count):
+            jobs = []
+            for _ in range(count):
+                job = mock.simple_job()
+                job.task_groups[0].count = allocs_per_job
+                jobs.append(job)
+                server.job_register(job)
+            return jobs
+
+        def wait_placed(jobs, deadline, done0=0):
+            want = len(jobs) * allocs_per_job
+            placed = 0
+            t_done = time.perf_counter()
+            target = len(jobs)
+            while time.time() < deadline:
+                if sum(w.processed for w in server.workers) - done0 \
+                        >= target:
+                    snap = server.state.snapshot()
+                    placed = sum(
+                        len(snap.allocs_by_job(j.namespace, j.id))
+                        for j in jobs)
+                    t_done = time.perf_counter()
+                    if placed >= want:
+                        break
+                    target += max(1, (want - placed) // allocs_per_job)
+                time.sleep(0.02)
+            if placed < want:
+                snap = server.state.snapshot()
+                placed = sum(len(snap.allocs_by_job(j.namespace, j.id))
+                             for j in jobs)
+                t_done = time.perf_counter()
+            return placed, t_done
+
+        warm_done0 = sum(w.processed for w in server.workers)
+        warm = submit(warmup_jobs)
+        wait_placed(warm, time.time() + min(deadline_s * 0.5, 90.0),
+                    done0=warm_done0)
+        _settle_committed(server, 0)
+        # the warm burst's placed allocs: the storm re-reports their
+        # client status alongside heartbeats (the real agent's alloc
+        # sync), exercising the Node.UpdateAlloc fan-in batcher
+        warm_snap = server.state.snapshot()
+        warm_allocs = [a for j in warm
+                       for a in warm_snap.allocs_by_job(j.namespace, j.id)]
+
+        # the fleet: one ring cursor per simulated client, topic mix
+        # rotated so the consumer-side filter does real work
+        topic_mix = ({"*": ["*"]}, {"Allocation": ["*"]}, {"Job": ["*"]})
+        subs = [
+            server.event_broker.subscribe(dict(topic_mix[i % 3]))
+            for i in range(n_clients)
+        ]
+
+        def heartbeat_storm(k: int) -> None:
+            ids = node_ids[k::heartbeat_threads]
+            allocs = warm_allocs[k::heartbeat_threads] or warm_allocs
+            i = 0
+            while not stop.is_set():
+                try:
+                    server.node_heartbeat(ids[i % len(ids)], "ready")
+                    hb_counts[k] += 1
+                    if allocs and i % 10 == 0:
+                        # alloc status sync rides every few heartbeats
+                        # (the agent's periodic alloc re-report): this
+                        # is the Node.UpdateAlloc fan-in the ISSUE 11
+                        # group-commit batches — blocking the storm
+                        # thread for the batched apply is exactly the
+                        # real client's RPC shape
+                        server.update_allocs_from_client(
+                            [allocs[(i // 10) % len(allocs)]])
+                except Exception:               # noqa: BLE001
+                    pass
+                i += 1
+                time.sleep(0.0005)
+
+        def watch_storm(k: int) -> None:
+            tables = ["allocs", "jobs"] if k % 2 else ["allocs"]
+            while not stop.is_set():
+                idx = server.state.table_index(tables)
+                server.state.block_until(tables, idx, timeout=0.3)
+                watch_counts[k] += 1
+
+        def subscriber_sweep(k: int) -> None:
+            mine = subs[k::subscriber_threads]
+            offset = 0
+            while not stop.is_set():
+                window = [mine[(offset + j) % len(mine)]
+                          for j in range(min(drain_per_sweep, len(mine)))]
+                offset += drain_per_sweep
+                for sub in window:
+                    if stop.is_set():
+                        return
+                    drained_counts[k] += len(
+                        sub.next_events(timeout=0.0, max_events=512))
+                time.sleep(0.02)
+
+        telemetry.reset()
+        server.event_broker.reset_stats()
+        done0 = sum(w.processed for w in server.workers)
+        for k in range(heartbeat_threads):
+            th = threading.Thread(target=heartbeat_storm, args=(k,),
+                                  daemon=True, name=f"fleet-hb-{k}")
+            th.start()
+            fleet_threads.append(th)
+        for k in range(watcher_threads):
+            th = threading.Thread(target=watch_storm, args=(k,),
+                                  daemon=True, name=f"fleet-watch-{k}")
+            th.start()
+            fleet_threads.append(th)
+        for k in range(subscriber_threads):
+            th = threading.Thread(target=subscriber_sweep, args=(k,),
+                                  daemon=True, name=f"fleet-sub-{k}")
+            th.start()
+            fleet_threads.append(th)
+        t0 = time.perf_counter()
+        jobs = []
+        for start in range(0, n_jobs, submit_group):
+            jobs.extend(submit(min(submit_group, n_jobs - start)))
+            time.sleep(submit_pace_s)
+        placed, t_done = wait_placed(jobs, time.time() + deadline_s,
+                                     done0=done0)
+        wall = t_done - t0
+        stop.set()
+        for th in fleet_threads:
+            th.join(timeout=2.0)
+        committed = _settle_committed(server, done0)
+
+        e2e = histograms.get("e2e").snapshot()
+        deliver_h = histograms.peek(STREAM_DELIVER)
+        deliver = deliver_h.snapshot() if deliver_h is not None else {}
+        serving = serving_snapshot(server)
+        heartbeats = sum(hb_counts)
+        wakeups = watch_stats.snapshot()
+        wakeup_total = wakeups["wakeups"] + wakeups["spurious_wakeups"]
+        for sub in subs:
+            sub.close()
+        return {
+            "wall_s": round(wall, 3),
+            "clients": n_clients,
+            "n_evals": n_jobs,
+            "evals_per_sec": round(n_jobs / wall, 2) if wall else 0.0,
+            "allocs_placed": placed,
+            "allocs_wanted": n_jobs * allocs_per_job,
+            "committed_evals": committed,
+            "heartbeats": heartbeats,
+            "heartbeats_per_sec": round(heartbeats / wall, 1)
+            if wall else 0.0,
+            "watch_wakeups": wakeup_total,
+            "watch_wakeups_per_sec": round(wakeup_total / wall, 1)
+            if wall else 0.0,
+            "events_delivered": sum(drained_counts),
+            "stream_deliver_p50_ms": deliver.get("p50_ms", 0.0),
+            "stream_deliver_p99_ms": deliver.get("p99_ms", 0.0),
+            "stream_deliver_count": deliver.get("count", 0),
+            "e2e_p50_ms": e2e["p50_ms"],
+            "e2e_p99_ms": e2e["p99_ms"],
+            "e2e_count": e2e["count"],
+            "serving": serving,
+            "latency": histograms.snapshot(),
+        }
+    finally:
+        stop.set()
+        for th in fleet_threads:
             th.join(timeout=2.0)
         if not was_enabled:
             telemetry.disable()

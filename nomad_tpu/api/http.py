@@ -695,6 +695,7 @@ class HTTPAgent:
         add("PUT", r"/v1/operator/traces", self.operator_traces_put)
         add("POST", r"/v1/operator/traces", self.operator_traces_put)
         add("GET", r"/v1/operator/slow-evals", self.operator_slow_evals)
+        add("GET", r"/v1/operator/stream-health", self.operator_stream_health)
         add("GET", r"/v1/operator/scheduler/configuration", self.sched_config_get)
         add("PUT", r"/v1/operator/scheduler/configuration", self.sched_config_put)
         add("POST", r"/v1/operator/scheduler/configuration", self.sched_config_put)
@@ -1389,9 +1390,14 @@ class HTTPAgent:
 
         if req.q("format") == "prometheus":
             # real text exposition (text/plain), not a JSON-quoted
-            # string: Prometheus scrapers parse the raw body
+            # string: Prometheus scrapers parse the raw body. The
+            # event broker is per-server state — pass it so the
+            # nomad_tpu_stream_* serving-plane gauges ride the scrape
+            broker = self.agent.server.event_broker \
+                if self.agent.server is not None else None
             self._send_text(req.handler,
-                            exporter.prometheus_text(m.global_registry))
+                            exporter.prometheus_text(
+                                m.global_registry, event_broker=broker))
             return StreamedResponse
         return m.global_registry.summary()
 
@@ -1424,6 +1430,17 @@ class HTTPAgent:
         except ValueError:
             limit = 0
         return exporter.slow_evals_json(limit=limit)
+
+    def operator_stream_health(self, req: Request):
+        """Serving-plane health in one pull (ISSUE 11): event-ring
+        publish/deliver/lost counters + subscriber lag, blocking-query
+        wakeup accounting, heartbeat fan-in coalescing, and the
+        delivery-lag histogram summary. Same ACL as the trace dump
+        (operator:read)."""
+        from nomad_tpu.telemetry import exporter
+
+        self._acl(req, "allow_operator_read")
+        return exporter.stream_health_json(self._server.event_broker)
 
     def operator_traces_put(self, req: Request):
         """Toggle tracing at runtime: {"Enable": true|false}, optional
@@ -1828,7 +1845,12 @@ class HTTPAgent:
         def _visible(ev) -> bool:
             """Namespace/topic capability filter (aclAllowsSubscription):
             Node/ACL topics need node:read / management; namespaced
-            topics need read-job capability on the event's namespace."""
+            topics need read-job capability on the event's namespace.
+            LostEvents markers always pass — a slow consumer must learn
+            it lost events (the marker carries a count and a resume
+            index, never another namespace's payload)."""
+            if ev.topic == "LostEvents":
+                return True
             if acl is None or acl.is_management():
                 return True
             if ev.topic in ("ACLToken", "ACLPolicy"):
@@ -1863,13 +1885,19 @@ class HTTPAgent:
                         "Index": events[-1].index,
                         "Events": [encode(e) for e in events],
                     }
-                    write_chunk((json.dumps(batch) + "\n").encode())
+                    payload = (json.dumps(batch) + "\n").encode()
+                    write_chunk(payload)
+                    broker.note_delivered_bytes(len(payload))
                     last_write = time.time()
                 elif time.time() - last_write >= 5.0:
-                    # heartbeat on ELAPSED TIME, not on queue state:
+                    # keepalive on ELAPSED TIME, not on queue state:
                     # an instant {} per filtered batch would leak
                     # hidden-namespace activity timing, and pure
-                    # silence would trip client/proxy idle timeouts
+                    # silence would trip client/proxy idle timeouts.
+                    # A reconnecting client resumes with ?index=<last
+                    # Index it saw>: the ring replays from there, or
+                    # delivers a LostEvents marker if that span was
+                    # trimmed (stream/ndjson.go keepalive + resume)
                     write_chunk(b"{}\n")
                     last_write = time.time()
         except (BrokenPipeError, ConnectionResetError):

@@ -76,6 +76,8 @@ class NomadFSM:
         self._lock = threading.Lock()
 
     def apply(self, msg_type: str, req: Dict) -> int:
+        import time
+
         from nomad_tpu.telemetry.trace import tracer
 
         handler = self._DISPATCH.get(msg_type)
@@ -84,10 +86,16 @@ class NomadFSM:
         with tracer.span("fsm.apply"):
             with self._lock:
                 index = handler(self, req)
-            self._publish_events(msg_type, req, index)
+            # stamp at apply-commit time: the event-stream delivery-lag
+            # histogram (op="stream_deliver") measures from HERE to the
+            # consumer hand-off, so publish/ring/drain overhead is all
+            # inside the measured window
+            self._publish_events(msg_type, req, index,
+                                 stamp=time.monotonic())
         return index
 
-    def _publish_events(self, msg_type: str, req: Dict, index: int) -> None:
+    def _publish_events(self, msg_type: str, req: Dict, index: int,
+                        stamp: float = 0.0) -> None:
         if self.event_broker is None:
             return
         from nomad_tpu.server import stream
@@ -135,7 +143,7 @@ class NomadFSM:
                 ev(stream.TOPIC_DEPLOYMENT, "DeploymentUpdate",
                    req["deployment_id"], d, d.namespace or "")
         if events:
-            self.event_broker.publish(events)
+            self.event_broker.publish(events, stamp=stamp or None)
 
     # --- node (fsm.go applyUpsertNode etc.) -----------------------------
 

@@ -68,6 +68,7 @@ class ServerConfig:
         coalesce_window_max_ms: float = 50.0,
         coalesce_adaptive: bool = True,
         broker_fill_window_ms: float = 5.0,
+        client_update_fill_window_ms: float = 2.0,
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -117,6 +118,92 @@ class ServerConfig:
         # holds a partially-filled multi-eval hand-out open for the
         # producer burst; 0 disables (pre-ISSUE-10 behavior)
         self.broker_fill_window_ms = broker_fill_window_ms
+        # heartbeat fan-in batching (ISSUE 11): how long the
+        # client-update group-commit leader holds its batch open for
+        # concurrent Node.UpdateAlloc arrivals before the one raft
+        # apply (sliding with arrivals, hard-capped at 4 windows —
+        # the broker batch-fill discipline); 0 disables the window
+        # (drain-while-busy coalescing still applies)
+        self.client_update_fill_window_ms = client_update_fill_window_ms
+
+
+class ClientUpdateStats:
+    """Heartbeat fan-in accounting (ISSUE 11): how many
+    Node.UpdateAlloc callers coalesced into how many raft entries, and
+    the raw heartbeat rate — the serving-plane counters the fleet cell
+    and ``nomad_tpu_client_update_fanin_total`` /
+    ``nomad_tpu_heartbeats_total`` expose."""
+
+    __slots__ = ("_lock", "callers", "batches", "allocs", "heartbeats")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.callers = 0
+        self.batches = 0
+        self.allocs = 0
+        self.heartbeats = 0
+
+    def note_caller(self, n_allocs: int) -> None:
+        with self._lock:
+            self.callers += 1
+            self.allocs += n_allocs
+
+    def note_batch(self) -> None:
+        with self._lock:
+            self.batches += 1
+
+    def note_heartbeat(self) -> None:
+        with self._lock:
+            self.heartbeats += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "callers": self.callers,
+                "batches": self.batches,
+                "allocs": self.allocs,
+                "heartbeats": self.heartbeats,
+                "coalesce_ratio": round(self.callers / self.batches, 4)
+                if self.batches else 0.0,
+            }
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.callers = 0
+            self.batches = 0
+            self.allocs = 0
+            self.heartbeats = 0
+
+
+#: process-wide (every Server feeds it; windowed by telemetry.reset)
+client_update_stats = ClientUpdateStats()
+
+
+class _ClientUpdateBatch:
+    """One group-committed ALLOC_CLIENT_UPDATE raft entry's future:
+    concurrent client status updates (the heartbeat fan-in path) merge
+    their alloc + eval lists and ride one apply."""
+
+    def __init__(self) -> None:
+        self.allocs: List = []
+        self.evals: List[Evaluation] = []
+        self.first_arrival = 0.0
+        self._done = threading.Event()
+        self._index = 0
+        self._error: Optional[Exception] = None
+
+    def resolve(self, index: int, error: Optional[Exception]) -> None:
+        if self._done.is_set():
+            return
+        self._index, self._error = index, error
+        self._done.set()
+
+    def wait(self, timeout: float = 30.0) -> int:
+        if not self._done.wait(timeout):
+            raise TimeoutError("client update group commit timed out")
+        if self._error is not None:
+            raise self._error
+        return self._index
 
 
 class _EvalCommitBatch:
@@ -155,6 +242,13 @@ class Server:
         self._eval_commit_lock = threading.Lock()
         self._eval_commit_batch: Optional[_EvalCommitBatch] = None
         self._eval_commit_busy = False
+        # heartbeat fan-in batcher (ISSUE 11): Node.UpdateAlloc storms
+        # coalesce into one ALLOC_CLIENT_UPDATE raft entry per drain
+        self._client_update_lock = threading.Lock()
+        self._client_update_cond = threading.Condition(
+            self._client_update_lock)
+        self._client_update_batch: Optional[_ClientUpdateBatch] = None
+        self._client_update_busy = False
         self.raft = None
         self.state = StateStore()
         self.eval_broker = EvalBroker(
@@ -820,12 +914,19 @@ class Server:
         return {"heartbeat_ttl": ttl, "index": index}
 
     def node_update_status(self, node_id: str, status: str) -> Dict:
-        """Heartbeat + status transitions (node_endpoint.go UpdateStatus)."""
-        snap = self.state.snapshot()
-        node = snap.node_by_id(node_id)
+        """Heartbeat + status transitions (node_endpoint.go UpdateStatus).
+
+        Direct locked node read, NOT a snapshot (ISSUE 11): the steady
+        heartbeat path (no status change) needs exactly one node row —
+        a full snapshot per heartbeat marks every table shared and
+        forces whole-table COW copies on the next write, which at
+        fleet heartbeat rates (10k+ clients) taxes every commit with
+        copies the heartbeats caused."""
+        client_update_stats.note_heartbeat()
+        node = self.state.node_by_id_direct(node_id)
         if node is None:
             raise KeyError(f"unknown node {node_id}")
-        index = snap.latest_index()
+        index = self.state.latest_index()
         if node.status != status:
             index = self.raft_apply(
                 fsm_msgs.NODE_UPDATE_STATUS,
@@ -999,9 +1100,89 @@ class Server:
                     status=consts.EVAL_STATUS_PENDING,
                 )
             )
-        return self.raft_apply(
-            fsm_msgs.ALLOC_CLIENT_UPDATE, {"allocs": allocs, "evals": evals}
-        )
+        return self._client_update_group_commit(allocs, evals)
+
+    def _client_update_group_commit(self, allocs: List,
+                                    evals: List[Evaluation]) -> int:
+        """Heartbeat fan-in batching (ISSUE 11): concurrent
+        Node.UpdateAlloc callers merge into ONE ALLOC_CLIENT_UPDATE
+        raft entry — one FSM apply, one COW write-set, one event batch
+        per drain instead of one per client. Same leader-drains
+        discipline as ``_eval_update_group_commit``, plus a bounded
+        FILL WINDOW (the ISSUE 10 broker batch-fill pattern): the
+        leader holds a fresh batch open ``client_update_fill_window_ms``
+        for the rest of the storm to land, sliding with arrivals under
+        a hard cap of 4 windows, so a fleet's heartbeat burst commits
+        as a handful of entries while a solo update pays at most one
+        window."""
+        client_update_stats.note_caller(len(allocs))
+        window_s = self.config.client_update_fill_window_ms / 1e3
+        with self._client_update_cond:
+            my_batch = self._client_update_batch
+            if my_batch is None:
+                my_batch = self._client_update_batch = _ClientUpdateBatch()
+                my_batch.first_arrival = time.monotonic()
+            my_batch.allocs.extend(allocs)
+            my_batch.evals.extend(evals)
+            self._client_update_cond.notify_all()
+            if self._client_update_busy:
+                leader = False
+            else:
+                self._client_update_busy = True
+                leader = True
+        if not leader:
+            return my_batch.wait()
+        completed = False
+        batch: Optional[_ClientUpdateBatch] = None
+        try:
+            while True:
+                with self._client_update_cond:
+                    batch = self._client_update_batch
+                    if batch is None:
+                        self._client_update_busy = False
+                        break
+                    if window_s > 0:
+                        # fill window: hold the batch open for the rest
+                        # of the concurrent storm; each arrival slides
+                        # the window (notify above), capped at 4 windows
+                        # from the first arrival so a trickle can never
+                        # pin latency
+                        cap = batch.first_arrival + 4 * window_s
+                        last_size = -1
+                        while time.monotonic() < cap:
+                            if len(batch.allocs) == last_size:
+                                break       # window elapsed, no arrival
+                            last_size = len(batch.allocs)
+                            self._client_update_cond.wait(
+                                min(window_s,
+                                    cap - time.monotonic()))
+                    self._client_update_batch = None
+                try:
+                    client_update_stats.note_batch()
+                    batch.resolve(self.raft_apply(
+                        fsm_msgs.ALLOC_CLIENT_UPDATE,
+                        {"allocs": batch.allocs, "evals": batch.evals},
+                    ), None)
+                except Exception as e:               # noqa: BLE001
+                    batch.resolve(0, e)
+            completed = True
+        finally:
+            if not completed:
+                # abnormal unwind (BaseException inside raft_apply):
+                # fail the popped batch and any batch queued behind the
+                # dead leader, then reset — same discipline as the eval
+                # group commit
+                err = RuntimeError("client update group-commit leader "
+                                   "aborted")
+                if batch is not None:
+                    batch.resolve(0, err)
+                with self._client_update_cond:
+                    self._client_update_busy = False
+                    orphan = self._client_update_batch
+                    self._client_update_batch = None
+                if orphan is not None and orphan is not batch:
+                    orphan.resolve(0, err)
+        return my_batch.wait()
 
     def derive_vault_tokens(self, alloc_id: str,
                             task_names: List[str]) -> Dict[str, str]:

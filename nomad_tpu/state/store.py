@@ -50,6 +50,65 @@ class SchedulerConfiguration:
         }.get(scheduler_type, False)
 
 
+class WatchStats:
+    """Blocking-query wakeup accounting (ISSUE 11): how many watchers
+    ``block_until`` currently holds parked, how often they wake for a
+    real index advance vs spuriously (a shared Event set by an
+    unrelated table's commit callback racing the re-check), and how
+    many waits expire. The serving plane is mostly reads and watches —
+    without these counters a fleet-scale watch storm is invisible in
+    every exposition surface."""
+
+    __slots__ = ("_lock", "held", "wakeups", "spurious", "timeouts")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.held = 0
+        self.wakeups = 0
+        self.spurious = 0
+        self.timeouts = 0
+
+    def enter(self) -> None:
+        with self._lock:
+            self.held += 1
+
+    def leave(self) -> None:
+        with self._lock:
+            self.held -= 1
+
+    def note_wakeup(self, spurious: bool) -> None:
+        with self._lock:
+            if spurious:
+                self.spurious += 1
+            else:
+                self.wakeups += 1
+
+    def note_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "held_watchers": self.held,
+                "wakeups": self.wakeups,
+                "spurious_wakeups": self.spurious,
+                "timeouts": self.timeouts,
+            }
+
+    def reset_stats(self) -> None:
+        """Counters only; the held gauge tracks live waiters."""
+        with self._lock:
+            self.wakeups = 0
+            self.spurious = 0
+            self.timeouts = 0
+
+
+#: process-wide (every StateStore's block_until feeds it; exported as
+#: nomad_tpu_watch_* and ridden into TRACE_DECOMP's serving section)
+watch_stats = WatchStats()
+
+
 #: tables a snapshot shares copy-on-write with the store. Index tables
 #: (allocs_by_*) hold immutable frozenset values so sharing the dict is
 #: enough; every mutator replaces values instead of mutating them.
@@ -354,16 +413,31 @@ class StateStore:
             return max(self.table_index(tables), min_index)
         event = threading.Event()
         unwatchers = [self.watch(t, lambda _i: event.set()) for t in tables]
+        watch_stats.enter()
         try:
             deadline = time.time() + timeout
-            while self.table_index(tables) <= min_index:
+            idx = self.table_index(tables)
+            while idx <= min_index:
                 remaining = deadline - time.time()
                 if remaining <= 0:
+                    watch_stats.note_timeout()
                     break
-                event.wait(remaining)
+                woke = event.wait(remaining)
                 event.clear()
-            return max(self.table_index(tables), min_index)
+                # ONE index read per wakeup serves both the spurious
+                # check and the loop condition (the watch path is the
+                # store-lock traffic this PR is measuring — no second
+                # acquisition per wakeup)
+                idx = self.table_index(tables)
+                if woke:
+                    # spurious = a commit callback fired but the watched
+                    # tables' index has not actually advanced (callback
+                    # raced the registration, or a second wait loop
+                    # consumed a stale set) — re-park without progress
+                    watch_stats.note_wakeup(spurious=idx <= min_index)
+            return max(idx, min_index)
         finally:
+            watch_stats.leave()
             for unwatch in unwatchers:
                 unwatch()
 

@@ -109,6 +109,24 @@ def reset() -> None:
         wave_cohorts.reset_stats()
     except Exception:                           # noqa: BLE001
         pass
+    try:
+        # blocking-query wakeup counters (state/store.py watch_stats)
+        # cover the same burst window; the held-watcher gauge tracks
+        # live waiters and is never reset
+        from nomad_tpu.state.store import watch_stats
+
+        watch_stats.reset_stats()
+    except Exception:                           # noqa: BLE001
+        pass
+    try:
+        # heartbeat fan-in counters (server/server.py) follow the
+        # burst window; event-broker stats are per-broker and are
+        # windowed by the bench cells via broker.reset_stats()
+        from nomad_tpu.server.server import client_update_stats
+
+        client_update_stats.reset_stats()
+    except Exception:                           # noqa: BLE001
+        pass
 
 
 if os.environ.get("NOMAD_TPU_TRACE", "") not in ("", "0"):

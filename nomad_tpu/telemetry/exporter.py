@@ -32,8 +32,11 @@ def _esc(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"')
 
 
-def prometheus_text(registry=None) -> str:
-    """The full exposition: metrics registry + telemetry series."""
+def prometheus_text(registry=None, event_broker=None) -> str:
+    """The full exposition: metrics registry + telemetry series.
+    ``event_broker`` is the serving server's broker (per-server state,
+    unlike every other source here); the HTTP layer passes it so the
+    ``nomad_tpu_stream_*`` gauges ride the same scrape."""
     reg = registry if registry is not None else _metrics.global_registry
     base = reg.prometheus_text().strip("\n")
     lines: List[str] = [base] if base else []
@@ -227,6 +230,98 @@ def prometheus_text(registry=None) -> str:
             f"{round(g['group_size_avg'], 4)}")
     except Exception:                           # noqa: BLE001
         pass                # plan applier unavailable: skip
+    # wave-cohort drain accounting (utils/wavecohort.py): the plan
+    # queue's wave-boundary batching — armed waves, landed plans,
+    # whole-cohort drains vs expirations vs hard-cap clamps, and the
+    # learned drain-window EWMA (ISSUE 11 satellite: the tracker
+    # landed in ISSUE 10 without metrics)
+    try:
+        from nomad_tpu.utils.wavecohort import wave_cohorts
+
+        c = wave_cohorts.snapshot()
+        lines.append("# TYPE nomad_tpu_wave_cohort_waves_total counter")
+        lines.append(f"nomad_tpu_wave_cohort_waves_total {c['waves']}")
+        lines.append("# TYPE nomad_tpu_wave_cohort_plans_total counter")
+        lines.append(
+            f"nomad_tpu_wave_cohort_plans_total {c['cohort_plans']}")
+        lines.append(
+            "# TYPE nomad_tpu_wave_cohort_outcomes_total counter")
+        for kind, key in (("drained", "drained_cohorts"),
+                          ("expired", "expired_cohorts"),
+                          ("hard_cap", "hard_cap_hits")):
+            lines.append(
+                f'nomad_tpu_wave_cohort_outcomes_total'
+                f'{{kind="{kind}"}} {c[key]}')
+        lines.append(
+            "# TYPE nomad_tpu_wave_cohort_drain_ewma_seconds gauge")
+        lines.append(
+            f"nomad_tpu_wave_cohort_drain_ewma_seconds "
+            f"{c['drain_ewma_ms'] / 1e3:.6f}")
+    except Exception:                           # noqa: BLE001
+        pass                # tracker unavailable: skip series
+    # blocking-query wakeups (state/store.py watch_stats): the watch
+    # side of the serving plane — parked watchers, real vs spurious
+    # wakeups, expired waits
+    try:
+        from nomad_tpu.state.store import watch_stats
+
+        w = watch_stats.snapshot()
+        lines.append("# TYPE nomad_tpu_watch_held_watchers gauge")
+        lines.append(
+            f"nomad_tpu_watch_held_watchers {w['held_watchers']}")
+        lines.append("# TYPE nomad_tpu_watch_wakeups_total counter")
+        for kind, key in (("real", "wakeups"),
+                          ("spurious", "spurious_wakeups"),
+                          ("timeout", "timeouts")):
+            lines.append(
+                f'nomad_tpu_watch_wakeups_total{{kind="{kind}"}} '
+                f'{w[key]}')
+    except Exception:                           # noqa: BLE001
+        pass                # store unavailable: skip series
+    # heartbeat fan-in (server/server.py client_update_stats): raw
+    # heartbeat rate plus the Node.UpdateAlloc group-commit's
+    # coalescing (callers vs batched raft entries)
+    try:
+        from nomad_tpu.server.server import client_update_stats
+
+        u = client_update_stats.snapshot()
+        lines.append("# TYPE nomad_tpu_heartbeats_total counter")
+        lines.append(f"nomad_tpu_heartbeats_total {u['heartbeats']}")
+        lines.append(
+            "# TYPE nomad_tpu_client_update_fanin_total counter")
+        for kind, key in (("callers", "callers"),
+                          ("batches", "batches"),
+                          ("allocs", "allocs")):
+            lines.append(
+                f'nomad_tpu_client_update_fanin_total'
+                f'{{kind="{kind}"}} {u[key]}')
+    except Exception:                           # noqa: BLE001
+        pass                # server module unavailable: skip series
+    # event-stream ring health (server/stream.py): publish/deliver
+    # volume, slow-consumer losses, the widest subscriber lag, and the
+    # wire bytes the NDJSON endpoint shipped — per-broker state, so
+    # only present when the HTTP layer passes its server's broker
+    if event_broker is not None:
+        s = event_broker.snapshot()
+        lines.append("# TYPE nomad_tpu_stream_subscribers gauge")
+        lines.append(f"nomad_tpu_stream_subscribers {s['subscribers']}")
+        lines.append("# TYPE nomad_tpu_stream_events_total counter")
+        for kind, key in (("published", "published_events"),
+                          ("delivered", "delivered_events"),
+                          ("lost", "lost_events")):
+            lines.append(
+                f'nomad_tpu_stream_events_total{{kind="{kind}"}} '
+                f'{s[key]}')
+        lines.append("# TYPE nomad_tpu_stream_delivered_bytes_total counter")
+        lines.append(
+            f"nomad_tpu_stream_delivered_bytes_total "
+            f"{s['delivered_bytes']}")
+        lines.append("# TYPE nomad_tpu_stream_max_lag_events gauge")
+        lines.append(
+            f"nomad_tpu_stream_max_lag_events {s['max_lag_events']}")
+        lines.append("# TYPE nomad_tpu_stream_retained_events gauge")
+        lines.append(
+            f"nomad_tpu_stream_retained_events {s['retained_events']}")
     # streaming latency histograms (telemetry/histogram.py): the real
     # Prometheus histogram type — log-bucketed cumulative _bucket
     # series per op (e2e eval latency, plan queue/evaluate/commit,
@@ -277,6 +372,26 @@ def traces_json(limit: int = 2000, trace_id: str = "") -> Dict:
             for name, agg in tracer.stage_totals().items()
         },
         "Kernel": profiler.summary(),
+    }
+
+
+def stream_health_json(event_broker) -> Dict:
+    """The /v1/operator/stream-health body: the serving plane's state
+    in one pull — event-ring health, blocking-query wakeup accounting,
+    heartbeat fan-in coalescing, and the delivery-lag distribution
+    (the same ``stream_deliver`` series /v1/metrics exposes)."""
+    from nomad_tpu.server.server import client_update_stats
+    from nomad_tpu.state.store import watch_stats
+    from nomad_tpu.telemetry.histogram import STREAM_DELIVER
+
+    deliver = histograms.peek(STREAM_DELIVER)
+    return {
+        "Stream": event_broker.snapshot() if event_broker is not None
+        else {},
+        "Watch": watch_stats.snapshot(),
+        "Heartbeat": client_update_stats.snapshot(),
+        "DeliverLatency": deliver.snapshot() if deliver is not None
+        else {},
     }
 
 

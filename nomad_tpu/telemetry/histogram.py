@@ -232,6 +232,9 @@ PLAN_EVALUATE = "plan_evaluate"
 PLAN_COMMIT = "plan_commit"
 WAVE_PARK = "wave_park"
 SNAPSHOT_WAIT = "snapshot_wait"
+#: event-stream delivery lag: FSM-apply stamp -> consumer hand-off
+#: (server/stream.py; the serving plane's headline distribution)
+STREAM_DELIVER = "stream_deliver"
 
 
 class HistogramRegistry:

@@ -60,6 +60,7 @@ class WaveCohortTracker:
         self.cohort_plans = 0
         self.drained_cohorts = 0
         self.expired_cohorts = 0
+        self.hard_cap_hits = 0
 
     def _window_s(self) -> float:
         if self._drain_ewma is None:
@@ -79,8 +80,10 @@ class WaveCohortTracker:
                 self._fire_t = now
             self._due += members
             self._hard = max(self._hard, now + self.HARD_CAP_S)
-            self._deadline = min(
-                max(self._deadline, now + self._window_s()), self._hard)
+            want = max(self._deadline, now + self._window_s())
+            if want > self._hard:
+                self.hard_cap_hits += 1
+            self._deadline = min(want, self._hard)
 
     def note_plan(self) -> None:
         """One plan enqueued. A flowing cohort keeps its window open
@@ -102,9 +105,10 @@ class WaveCohortTracker:
                 self.drained_cohorts += 1
                 self._deadline = 0.0
             else:
-                self._deadline = min(
-                    max(self._deadline, now + self.ARRIVAL_GAP_S),
-                    self._hard)
+                want = max(self._deadline, now + self.ARRIVAL_GAP_S)
+                if want > self._hard:
+                    self.hard_cap_hits += 1
+                self._deadline = min(want, self._hard)
 
     def pending_wait_s(self) -> float:
         """Seconds the applier should keep its drain window open
@@ -130,6 +134,7 @@ class WaveCohortTracker:
             self.cohort_plans = 0
             self.drained_cohorts = 0
             self.expired_cohorts = 0
+            self.hard_cap_hits = 0
 
     def snapshot(self) -> Dict:
         with self._lock:
@@ -138,6 +143,7 @@ class WaveCohortTracker:
                 "cohort_plans": self.cohort_plans,
                 "drained_cohorts": self.drained_cohorts,
                 "expired_cohorts": self.expired_cohorts,
+                "hard_cap_hits": self.hard_cap_hits,
                 "drain_ewma_ms": (self._drain_ewma or 0.0) * 1e3,
                 "due": self._due,
             }

@@ -22,14 +22,16 @@ from nomad_tpu.server import fsm as fsm_msgs
 from nomad_tpu.structs.namespace import Namespace
 
 
-def _open_stream(addr: str, token: str):
+def _open_stream(addr: str, token: str, query: str = ""):
     """Raw chunked NDJSON reader over the event stream endpoint;
-    returns (socket, line-iterator)."""
+    returns (socket, line-iterator). ``query`` narrows topics
+    (e.g. "topic=Allocation&topic=Deployment")."""
     host, port = addr.rsplit(":", 1)
     host = host.replace("http://", "")
+    path = "/v1/event/stream" + (f"?{query}" if query else "")
     s = socket.create_connection((host, int(port)), timeout=30)
     s.sendall((
-        "GET /v1/event/stream HTTP/1.1\r\n"
+        f"GET {path} HTTP/1.1\r\n"
         f"Host: {host}\r\nX-Nomad-Token: {token}\r\n\r\n"
     ).encode())
     f = s.makefile("rb")
@@ -119,6 +121,105 @@ class TestEventStreamACL:
             assert not any(e.get("Key") == "hidden-job" for e in got)
         finally:
             stop.set()
+            s.close()
+
+    def test_namespaced_token_topic_filter_scopes_alloc_events(
+            self, acl_agent):
+        """ISSUE 11 satellite: topic/key/namespace filtering under
+        ACLs — a namespaced token subscribed to Allocation/Deployment
+        topics sees only its own namespace's events; synthetic events
+        published straight into the ring keep the test about the
+        filter, not the scheduler."""
+        from nomad_tpu.server import stream
+
+        server = acl_agent.server
+        server.raft_apply(fsm_msgs.NAMESPACE_UPSERT, {
+            "namespaces": [Namespace(name="secret")]})
+        policy = ACLPolicy(name="default-read",
+                          rules='namespace "default" { policy = "read" }')
+        server.raft_apply(fsm_msgs.ACL_POLICY_UPSERT,
+                          {"policies": [policy]})
+        tok = ACLToken.create(name="scoped", type="client",
+                              policies=["default-read"])
+        server.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [tok]})
+
+        s, status, lines = _open_stream(
+            acl_agent.http.addr, tok.secret_id,
+            query="topic=Allocation&topic=Deployment")
+        assert " 200 " in status
+        got = []
+        threading.Thread(
+            target=lambda: [got.append(json.loads(ln))
+                            for ln in lines],
+            daemon=True).start()
+        idx = server.state.latest_index() + 1
+        server.event_broker.publish([
+            stream.Event("Allocation", "AllocationUpdated", "a-vis",
+                         idx, namespace="default"),
+            stream.Event("Allocation", "AllocationUpdated", "a-hid",
+                         idx, namespace="secret"),
+            stream.Event("Deployment", "DeploymentUpdate", "d-hid",
+                         idx, namespace="secret"),
+            stream.Event("Job", "JobRegistered", "j-wrong-topic",
+                         idx, namespace="default"),
+            stream.Event("Deployment", "DeploymentUpdate", "d-vis",
+                         idx, namespace="default"),
+        ])
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            keys = {e.get("Key") for b in got
+                    for e in (b.get("Events") or [])}
+            if {"a-vis", "d-vis"} <= keys:
+                break
+            time.sleep(0.2)
+        try:
+            keys = {e.get("Key") for b in got
+                    for e in (b.get("Events") or [])}
+            assert {"a-vis", "d-vis"} <= keys, keys
+            # namespace scope: the secret namespace's events never cross
+            assert "a-hid" not in keys and "d-hid" not in keys
+            # topic scope: unsubscribed topics never cross either
+            assert "j-wrong-topic" not in keys
+        finally:
+            s.close()
+
+    def test_management_token_sees_all_namespaces(self, acl_agent):
+        from nomad_tpu.server import stream
+
+        server = acl_agent.server
+        server.raft_apply(fsm_msgs.NAMESPACE_UPSERT, {
+            "namespaces": [Namespace(name="secret")]})
+        mgmt = ACLToken.create(name="root", type="management")
+        server.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [mgmt]})
+
+        s, status, lines = _open_stream(acl_agent.http.addr,
+                                        mgmt.secret_id)
+        assert " 200 " in status
+        got = []
+        threading.Thread(
+            target=lambda: [got.append(json.loads(ln))
+                            for ln in lines],
+            daemon=True).start()
+        idx = server.state.latest_index() + 1
+        server.event_broker.publish([
+            stream.Event("Job", "JobRegistered", "j-default", idx,
+                         namespace="default"),
+            stream.Event("Job", "JobRegistered", "j-secret", idx,
+                         namespace="secret"),
+        ])
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            keys = {e.get("Key") for b in got
+                    for e in (b.get("Events") or [])}
+            if {"j-default", "j-secret"} <= keys:
+                break
+            time.sleep(0.2)
+        try:
+            keys = {e.get("Key") for b in got
+                    for e in (b.get("Events") or [])}
+            # the operator's stream spans every namespace
+            assert {"j-default", "j-secret"} <= keys, keys
+        finally:
             s.close()
 
     def test_revoked_token_loses_stream(self, acl_agent):

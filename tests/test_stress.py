@@ -182,6 +182,43 @@ class TestCoalescerContention:
             assert c.requests == sum(launchers)
 
 
+class TestFleetCell:
+    def test_fleet_cell_under_lock_witness(self):
+        """ISSUE 11: the fleet cell (ring-cursor subscribers +
+        heartbeat storm + held blocking queries over the new broker/
+        watch paths) runs under the runtime lock witness — the autouse
+        fixture fails the test on ANY executed acquisition-order
+        inversion in the rebuilt EventBroker, the store's block_until,
+        or the client-update fan-in batcher. One rep at reduced scale:
+        the cell is itself a multi-thread contention storm; N=20 of it
+        would dominate the tier for no added interleaving coverage."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench"))
+        import trace_report
+
+        cell = trace_report.run_fleet_burst(
+            n_clients=2000, n_nodes=150, n_jobs=16, allocs_per_job=3,
+            warmup_jobs=6, batch_size=8, deadline_s=120.0)
+        assert cell["allocs_placed"] == cell["allocs_wanted"], cell
+        assert cell["heartbeats"] > 0
+        assert cell["watch_wakeups"] > 0
+        assert cell["events_delivered"] > 0
+        serving = cell["serving"]
+        assert serving["stream"]["subscribers"] == 2000
+        assert serving["stream"]["published_events"] > 0
+        # the fan-in batcher coalesced the storm's alloc syncs
+        assert serving["heartbeat"]["batches"] >= 1
+        assert serving["heartbeat"]["callers"] >= \
+            serving["heartbeat"]["batches"]
+        # every committed eval landed in the e2e distribution
+        assert cell["e2e_count"] == cell["committed_evals"]
+        # delivery lag was measured (the serving plane's headline)
+        assert cell["stream_deliver_count"] > 0
+
+
 class TestMembershipContention:
     def test_reconcile_queue_preserves_event_order(self):
         """The satellite fix itself: MEMBER_FAILED/MEMBER_ALIVE flap

@@ -366,6 +366,179 @@ class TestHTTPEndpoints:
             flight_recorder.MIN_SAMPLES + 1
 
 
+class TestServingPlane:
+    """ISSUE 11: the serving-plane observability surface — the
+    stream-health endpoint, the nomad_tpu_stream_*/watch/heartbeat/
+    wave-cohort Prometheus series, and the fleet_* bench-key contract."""
+
+    @pytest.fixture()
+    def agent(self):
+        from nomad_tpu.api.agent import Agent, AgentConfig
+
+        a = Agent(AgentConfig(serf_enabled=False))
+        a.start()
+        try:
+            yield a
+        finally:
+            a.shutdown()
+
+    def test_stream_health_endpoint(self, agent, clean_telemetry):
+        from nomad_tpu import mock
+
+        server = agent.server
+        sub = server.event_broker.subscribe({"*": ["*"]})
+        server.job_register(mock.job())
+        evs = sub.next_events(timeout=5.0)
+        assert evs
+        status, _, body = _get(agent.http.addr,
+                               "/v1/operator/stream-health")
+        assert status == 200
+        data = json.loads(body)
+        assert data["Stream"]["published_events"] >= 1
+        assert data["Stream"]["delivered_events"] >= 1
+        assert data["Stream"]["subscribers"] >= 1
+        assert "held_watchers" in data["Watch"]
+        assert "wakeups" in data["Watch"]
+        assert "heartbeats" in data["Heartbeat"]
+        assert "batches" in data["Heartbeat"]
+        # the delivery-lag histogram recorded the hand-off above
+        assert data["DeliverLatency"].get("count", 0) >= 1
+        sub.close()
+
+    def test_serving_prometheus_series(self, agent, clean_telemetry):
+        """The serving-plane series ride the standard scrape: stream
+        ring gauges (per-server, passed by the HTTP layer), watch
+        wakeups, heartbeat fan-in, and the ISSUE 11 satellite's
+        wave-cohort gauges."""
+        from nomad_tpu import mock
+
+        server = agent.server
+        sub = server.event_broker.subscribe({"*": ["*"]})
+        node = mock.node()
+        server.node_register(node)
+        server.node_heartbeat(node.id, "ready")
+        # a held-then-woken blocking query feeds the watch counters
+        idx = server.state.table_index(["jobs"])
+        waiter = threading.Thread(
+            target=lambda: server.state.block_until(["jobs"], idx, 5.0),
+            daemon=True)
+        waiter.start()
+        time.sleep(0.1)
+        server.job_register(mock.job())
+        waiter.join(timeout=5.0)
+        sub.next_events(timeout=5.0)
+        status, _, body = _get(
+            agent.http.addr, "/v1/metrics?format=prometheus")
+        assert status == 200
+        text = body.decode()
+        for series in (
+            "nomad_tpu_stream_subscribers",
+            'nomad_tpu_stream_events_total{kind="published"}',
+            'nomad_tpu_stream_events_total{kind="delivered"}',
+            'nomad_tpu_stream_events_total{kind="lost"}',
+            "nomad_tpu_stream_max_lag_events",
+            "nomad_tpu_stream_retained_events",
+            "nomad_tpu_stream_delivered_bytes_total",
+            "nomad_tpu_watch_held_watchers",
+            'nomad_tpu_watch_wakeups_total{kind="real"}',
+            'nomad_tpu_watch_wakeups_total{kind="spurious"}',
+            "nomad_tpu_heartbeats_total",
+            'nomad_tpu_client_update_fanin_total{kind="batches"}',
+            "nomad_tpu_wave_cohort_waves_total",
+            "nomad_tpu_wave_cohort_plans_total",
+            'nomad_tpu_wave_cohort_outcomes_total{kind="drained"}',
+            'nomad_tpu_wave_cohort_outcomes_total{kind="hard_cap"}',
+            "nomad_tpu_wave_cohort_drain_ewma_seconds",
+            'nomad_tpu_latency_seconds_bucket{op="stream_deliver"',
+        ):
+            assert series in text, series
+        # the watch thread above must have produced a real wakeup
+        import re as _re
+
+        m = _re.search(
+            r'nomad_tpu_watch_wakeups_total\{kind="real"\} (\d+)', text)
+        assert m and int(m.group(1)) >= 1, m
+        sub.close()
+
+    def test_fleet_bench_keys_emitted(self):
+        """The fleet cell's trend lines are contract: bench.py must
+        emit the fleet_* keys the serving-plane work gates on (the
+        graftcheck R5 rule holds them against TELEMETRY.md both
+        directions; this pins the REQUIRED core set)."""
+        import ast
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(repo, "bench.py")) as f:
+            tree = ast.parse(f.read())
+        emitted = {
+            kw.arg
+            for node in ast.walk(tree) if isinstance(node, ast.Call)
+            for kw in node.keywords
+            if kw.arg and kw.arg.startswith("fleet_")
+        }
+        assert {
+            "fleet_clients",
+            "fleet_heartbeats_per_sec",
+            "fleet_watch_wakeups_per_sec",
+            "fleet_stream_deliver_p99_ms",
+            "fleet_e2e_p99_ms",
+            "fleet_e2e_p99_held",
+        } <= emitted, emitted
+
+    def test_client_update_fan_in_coalesces_concurrent_callers(self):
+        """Heartbeat fan-in batching: concurrent Node.UpdateAlloc
+        callers must merge into fewer ALLOC_CLIENT_UPDATE raft entries
+        (one per drain) with every caller seeing a committed index."""
+        from nomad_tpu import mock
+        from nomad_tpu.server.server import (
+            Server,
+            ServerConfig,
+            client_update_stats,
+        )
+
+        server = Server(ServerConfig(num_workers=0,
+                                     heartbeat_ttl=3600.0,
+                                     client_update_fill_window_ms=5.0))
+        server.start()
+        try:
+            node = mock.node()
+            server.node_register(node)
+            allocs = []
+            for _ in range(16):
+                a = mock.alloc(node_id=node.id)
+                server.state.upsert_allocs([a])
+                allocs.append(a)
+            client_update_stats.reset_stats()
+            applies0 = server.state.latest_index()
+            results = [None] * len(allocs)
+
+            def report(k):
+                results[k] = server.update_allocs_from_client(
+                    [allocs[k]])
+
+            threads = [threading.Thread(target=report, args=(k,))
+                       for k in range(len(allocs))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            snap = client_update_stats.snapshot()
+            assert snap["callers"] == len(allocs)
+            assert snap["allocs"] == len(allocs)
+            # coalescing happened: strictly fewer raft entries than
+            # callers (16 concurrent updates against a >=5ms window
+            # cannot all land in distinct batches)
+            assert snap["batches"] < len(allocs), snap
+            assert all(isinstance(r, int) and r > applies0
+                       for r in results)
+            # every alloc's update actually committed
+            state_snap = server.state.snapshot()
+            assert all(state_snap.alloc_by_id(a.id) is not None
+                       for a in allocs)
+        finally:
+            server.shutdown()
+
+
 class TestTracesACL:
     """/v1/operator/traces is gated like the event stream: a token
     without operator:read is rejected outright."""
@@ -638,6 +811,25 @@ class TestTraceDecomposition:
         # the distribution's shape; observation must not)
         assert tail["flight_recorder"]["observed"] == \
             tail["committed_evals"]
+        # ISSUE 11: the serving section rides the artifact — even a
+        # burst with no external subscribers publishes every FSM apply
+        # into the event ring, so the publish/watch/heartbeat counters
+        # must exist and the ring must have seen the burst's applies
+        serving = decomp["serving"]
+        assert serving["stream"]["published_events"] > 0, serving
+        assert serving["stream"]["lost_events"] == 0
+        for section, keys in (
+            ("stream", ("subscribers", "published_events",
+                        "delivered_events", "lost_events",
+                        "max_lag_events", "delivered_bytes")),
+            ("watch", ("held_watchers", "wakeups", "spurious_wakeups",
+                       "timeouts")),
+            ("heartbeat", ("heartbeats", "callers", "batches",
+                           "coalesce_ratio")),
+        ):
+            assert set(keys) <= set(serving[section]), (
+                section, serving[section])
+        assert "deliver_latency" in serving
 
     def test_disabled_tracing_leaves_no_spans(self):
         """The disabled live path must record nothing (the <5%

@@ -13,10 +13,10 @@ extends it to the whole observable surface:
   code must appear in the "## Prometheus series" fenced list, and vice
   versa (a scraper alerting on a renamed series is an outage, not a
   diff).
-- **bench keys**: every ``trace_*`` / ``contention_*`` keyword bench.py
-  emits into BENCH_*.json must appear in the "## Bench emission keys"
-  fenced list, and vice versa (trend lines silently going dark is how
-  perf regressions hide).
+- **bench keys**: every ``trace_*`` / ``contention_*`` / ``fleet_*``
+  keyword bench.py emits into BENCH_*.json must appear in the
+  "## Bench emission keys" fenced list, and vice versa (trend lines
+  silently going dark is how perf regressions hide).
 
 The docs sections are the contract; prose may mention whatever it
 likes — only the fenced blocks are parsed.
@@ -47,7 +47,9 @@ _SPAN_NAME = re.compile(r"[a-z][a-z0-9_]*\.[a-z0-9_.{}]+")
 #: does: subsystem + metric) — this keeps cache-file path strings like
 #: "nomad_tpu_warmup.json" / "nomad_tpu_xla" out of the contract
 _PROM_NAME = re.compile(r"\bnomad_tpu_[a-z0-9]+(?:_[a-z0-9]+)+\b")
-_BENCH_KEY = re.compile(r"^(?:trace|contention)_[a-z0-9_]+$")
+#: fleet_* joined in ISSUE 11 (the serving-plane fleet cell's trend
+#: lines are contract like every other bench emission)
+_BENCH_KEY = re.compile(r"^(?:trace|contention|fleet)_[a-z0-9_]+$")
 #: bench kwargs that are not emission keys
 _BENCH_KEY_EXCLUDE = {"trace_id"}
 
