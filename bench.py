@@ -1316,6 +1316,54 @@ def main() -> None:
         print("bench budget: skipping fleet cell "
               f"({budget.remaining():.0f}s left)", file=sys.stderr)
 
+    # ISSUE 12: the chaos cell — every standing fault schedule
+    # (leader-kill-mid-wave, plan-commit raft failure, crash-and-drop)
+    # against a live 3-node raft cluster, pinned seed, convergence
+    # invariants asserted after quiesce. chaos_evals_converged_ok is
+    # the acceptance line: 1 means every schedule converged with zero
+    # invariant violations. Reproduce any failure with
+    # trace_report.run_chaos_burst(schedule=<name>, seed=chaos_seed)
+    # (docs/ROBUSTNESS.md).
+    if budget.remaining() > 300:
+        try:
+            _phase("chaos cell")
+            sys.path.insert(0, os.path.join(REPO, "bench"))
+            import trace_report
+
+            # three schedules run sequentially, each paying warmup
+            # (~deadline/2) + burst deadline + settle — size ALL of
+            # those from the remaining budget (leaving headroom for
+            # the replay headline), not just the burst phase
+            per_schedule = max((budget.remaining() - 90.0) / 3.0, 60.0)
+            suite = trace_report.run_chaos_suite(
+                deadline_s=min(max(per_schedule * 0.4, 30.0), 90.0),
+                settle_s=min(max(per_schedule * 0.25, 20.0), 60.0))
+            em.update(
+                chaos_seed=suite["seed"],
+                chaos_evals_converged_ok=(
+                    1 if suite["converged_ok"] else 0),
+                chaos_faults_fired=suite["faults_fired"],
+                chaos_violations=suite["violations"][:8],
+                chaos_schedule_stats={
+                    name: {
+                        "converged": r["converged_ok"],
+                        "evals_per_sec": r["evals_per_sec"],
+                        "faults_fired": r["faults_fired"],
+                        "failover_resumes": r["failover_resumes"],
+                        "nodes_down": r["nodes_down"],
+                        "stream_lost_markers": r["stream_lost_markers"],
+                        "plan_rejections": r["plan_rejections"],
+                    }
+                    for name, r in suite["schedules"].items()},
+            )
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: chaos cell failed ({e})", file=sys.stderr)
+    else:
+        print("bench budget: skipping chaos cell "
+              f"({budget.remaining():.0f}s left)", file=sys.stderr)
+
     replay = None
     if planes is not None and budget.remaining() <= 60:
         print("bench budget: skipping C2M replay headline "

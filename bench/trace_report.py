@@ -1000,6 +1000,430 @@ def run_fleet_burst(n_clients: int = 10_000, n_nodes: int = 400,
         server.shutdown()
 
 
+#: the chaos cell's pinned seed: every schedule below is reproduced by
+#: re-arming the SAME (faults, seed) pair (docs/ROBUSTNESS.md, "how to
+#: reproduce a chaos failure from its seed")
+CHAOS_SEED = 12012
+
+#: the standing chaos schedules (ISSUE 12). Each is a bounded,
+#: deterministic fault program over the wired points
+#: (nomad_tpu/utils/faultpoints.py) plus an optional set of nodes
+#: whose heartbeats simply stop (expiry -> node-down -> allocs lost ->
+#: reschedule). Every schedule is BOUNDED (nth / max_fires) so the
+#: pipeline can converge while still armed — convergence through the
+#: failures, not after them.
+CHAOS_SCHEDULES = {
+    # the leader dies mid-wave: the raft ticker's step-down point
+    # deposes whoever leads ~1s into the burst (tick cadence 25ms ->
+    # nth 40). Plan futures fail over, the broker flushes + restores
+    # from the replicated store, workers pause/unpause, heartbeat
+    # timers re-arm on the new leader. Replication latency jitter
+    # keeps commit timing honest around the transition.
+    "leader-kill-mid-wave": {
+        "faults": {
+            "raft.leader.stepdown": {"kind": "error", "nth": 40},
+            "raft.replicate.send": {"kind": "latency", "p": 0.05,
+                                    "sleep_s": 0.01, "max_fires": 40},
+        },
+        "drop_nodes": 0,
+    },
+    # the plan pipeline fails under a half-committed cohort: commit
+    # batches 2 and 4 fail at the raft seam (every future in the batch
+    # errors, every worker nacks), occasional submits never reach the
+    # queue, and one eval group-commit drain leader is KILLED mid-
+    # flush — the abnormal-unwind path runs for real.
+    "plan-commit-raft-failure": {
+        "faults": {
+            "plan.commit.raft": {"kind": "error", "every": 2,
+                                 "max_fires": 2},
+            "plan.queue.enqueue": {"kind": "error", "p": 0.05,
+                                   "max_fires": 4},
+            "server.eval_commit.raft": {"kind": "kill", "nth": 6},
+        },
+        "drop_nodes": 0,
+    },
+    # crashed waves + a dying fleet: an eval thread is killed mid-
+    # cohort (no ack, no nack — only the broker's deadline recovers
+    # it), a whole wave launch fails, acks fail sporadically,
+    # heartbeat delivery drops, the publish seam drops one event batch
+    # (surfacing as explicit LostEvents), and three nodes stop
+    # heartbeating entirely until they expire.
+    "crash-and-drop": {
+        "faults": {
+            "worker.eval": {"kind": "kill", "nth": 9},
+            "wave.launch": {"kind": "error", "nth": 4},
+            "broker.ack": {"kind": "error", "p": 0.2, "max_fires": 3},
+            "heartbeat.deliver": {"kind": "error", "p": 0.05,
+                                  "max_fires": 30},
+            "stream.publish": {"kind": "error", "nth": 10},
+        },
+        "drop_nodes": 3,
+    },
+}
+
+
+def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
+                    seed: int = CHAOS_SEED,
+                    n_nodes: int = 48, n_jobs: int = 18,
+                    allocs_per_job: int = 3, batch_size: int = 8,
+                    warmup_jobs: int = 5,
+                    heartbeat_ttl: float = 2.0,
+                    deadline_s: float = 120.0,
+                    settle_s: float = 60.0) -> Dict:
+    """ISSUE 12: one chaos schedule against a live 3-node raft cluster.
+
+    A steady eval burst runs through the full pipeline (broker ->
+    batched worker -> coalesced waves -> group-commit applier -> raft
+    -> FSM on three replicas) while the schedule's fault program
+    executes; heartbeat storm threads keep the fleet alive except for
+    the schedule's drop set; an event-stream monitor follows the
+    leader's ring across failovers with ``?index=`` resumes. After the
+    burst the cell waits for quiesce and then asserts the convergence
+    invariants (docs/ROBUSTNESS.md):
+
+    1. every enqueued eval reached a terminal state (no store-pending,
+       no broker-held, no stuck-blocked evals);
+    2. every job is fully placed EXACTLY once — no duplicate slot
+       names, no live alloc on a down/missing node;
+    3. every replica's usage planes are bit-identical to a from-
+       scratch rebuild of its surviving store
+       (state/usage.usage_rebuild_diff);
+    4. heartbeat-dropped nodes went down and hold no live allocs (their
+       work rescheduled — covered by 2);
+    5. the event-stream monitor saw every burst alloc id, or explicit
+       ``LostEvents`` markers — never a silent gap;
+    6. (stress tier) zero lock-witness inversions — the autouse
+       fixture in tests/test_stress.py enforces it around this cell.
+
+    Returns the stats + a ``converged_ok`` verdict with the violation
+    list; never raises on invariant failure (bench cells report).
+    """
+    from nomad_tpu import mock
+    from nomad_tpu.server.plan_rejection import plan_rejections
+    from nomad_tpu.server.server import ServerConfig
+    from nomad_tpu.server.stream import TOPIC_LOST
+    from nomad_tpu.server.testing import make_cluster, wait_for_leader
+    from nomad_tpu.state.usage import usage_rebuild_diff
+    from nomad_tpu.structs import consts
+    from nomad_tpu.utils import faultpoints
+
+    spec = CHAOS_SCHEDULES[schedule]
+    servers, registry = make_cluster(3, ServerConfig(
+        num_workers=1,
+        worker_batch_size=batch_size,
+        heartbeat_ttl=heartbeat_ttl,
+        nack_timeout=1.5,
+        eval_delivery_limit=4,
+        failed_eval_follow_up_wait=0.4,
+        # chaos rejections are injected, not a misbehaving node; the
+        # tracker must not convert them into eligibility flips that
+        # shrink the cell's capacity mid-run
+        plan_rejection_threshold=500,
+    ))
+    for s in servers:
+        # redelivery must be fast enough to converge inside the cell
+        s.eval_broker.initial_nack_delay = 0.05
+        s.eval_broker.subsequent_nack_delay = 0.25
+    stop = threading.Event()
+    threads = []
+    violations: list = []
+    faultpoints.reset()
+    plan_rejections.reset_stats()
+
+    def cur_leader():
+        for s in servers:
+            if s.raft is not None and s.raft.is_leader() and s.is_leader():
+                return s
+        return None
+
+    def with_leader(fn, timeout=15.0):
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            s = cur_leader()
+            if s is not None:
+                try:
+                    return fn(s)
+                except Exception as e:          # noqa: BLE001
+                    last = e
+            time.sleep(0.05)
+        raise RuntimeError(f"no leader accepted the call: {last!r}")
+
+    # event-stream monitor state (the cross-failover resume invariant)
+    mon = {"alloc_ids": set(), "lost_markers": 0, "last_index": 0,
+           "events": 0, "failover_resumes": 0}
+
+    try:
+        leader = wait_for_leader(servers, timeout=10.0)
+        node_ids = []
+        for _ in range(n_nodes):
+            node = mock.node()
+            node_ids.append(node.id)
+            with_leader(lambda s, n=node: s.node_register(n))
+        drop_set = set(node_ids[-spec["drop_nodes"]:]) \
+            if spec["drop_nodes"] else set()
+
+        def monitor() -> None:
+            """Follow the leader's ring; on failover, resume on the
+            new leader with from_index=<last seen> — the reconnect
+            contract the invariant checks (replay from the ring, or an
+            explicit LostEvents marker; never a silent gap)."""
+            sub = None
+            sub_broker = None
+            while not stop.is_set():
+                s = cur_leader()
+                if s is None:
+                    time.sleep(0.05)
+                    continue
+                if sub is None or sub_broker is not s.event_broker:
+                    if sub is not None:
+                        sub.close()
+                        mon["failover_resumes"] += 1
+                    sub = s.event_broker.subscribe(
+                        from_index=mon["last_index"])
+                    sub_broker = s.event_broker
+                for ev in sub.next_events(timeout=0.2, max_events=256):
+                    if ev.topic == TOPIC_LOST:
+                        mon["lost_markers"] += 1
+                        continue
+                    mon["events"] += 1
+                    if ev.index > mon["last_index"]:
+                        mon["last_index"] = ev.index
+                    if ev.topic == "Allocation":
+                        mon["alloc_ids"].add(ev.key)
+            if sub is not None:
+                sub.close()
+
+        th = threading.Thread(target=monitor, daemon=True,
+                              name="chaos-monitor")
+        th.start()
+        threads.append(th)
+
+        def heartbeat_storm(k: int, nthreads: int) -> None:
+            ids = [n for n in node_ids if n not in drop_set][k::nthreads]
+            i = 0
+            while not stop.is_set() and ids:
+                s = cur_leader()
+                if s is not None:
+                    try:
+                        s.node_heartbeat(ids[i % len(ids)], "ready")
+                    except Exception:           # noqa: BLE001
+                        pass                    # chaos drops are the point
+                i += 1
+                time.sleep(max(heartbeat_ttl / 4.0 / max(len(ids), 1),
+                               0.002))
+
+        for k in range(2):
+            th = threading.Thread(target=heartbeat_storm, args=(k, 2),
+                                  daemon=True, name=f"chaos-hb-{k}")
+            th.start()
+            threads.append(th)
+
+        def submit(count):
+            jobs = []
+            for _ in range(count):
+                job = mock.simple_job()
+                job.task_groups[0].count = allocs_per_job
+                with_leader(lambda s, j=job: s.job_register(j))
+                jobs.append(job)
+            return jobs
+
+        def placed_count(jobs):
+            s = cur_leader() or servers[0]
+            snap = s.state.snapshot()
+            return sum(
+                1
+                for j in jobs
+                for a in snap.allocs_by_job(j.namespace, j.id)
+                if not a.terminal_status()), s
+
+        def wait_fully_placed(jobs, deadline):
+            want = len(jobs) * allocs_per_job
+            placed = 0
+            while time.time() < deadline:
+                placed, _ = placed_count(jobs)
+                if placed >= want:
+                    return placed
+                time.sleep(0.1)
+            return placed
+
+        # warmup OUTSIDE the fault window: compile the wave buckets
+        warm = submit(warmup_jobs)
+        wait_fully_placed(warm, time.time() + min(deadline_s / 2, 90.0))
+
+        # ---- the chaos window -------------------------------------------
+        faultpoints.arm(spec["faults"], seed=seed)
+        t0 = time.perf_counter()
+        jobs = []
+        for start in range(0, n_jobs, 3):
+            jobs.extend(submit(min(3, n_jobs - start)))
+            time.sleep(0.15)
+        placed = wait_fully_placed(jobs, time.time() + deadline_s)
+        wall = time.perf_counter() - t0
+
+        # ---- settle to quiesce (faults stay armed: every schedule is
+        # bounded, so convergence must happen THROUGH them) ---------------
+        def quiesced() -> bool:
+            s = cur_leader()
+            if s is None:
+                return False
+            snap = s.state.snapshot()
+            for ev in snap.evals_iter():
+                if ev.status == consts.EVAL_STATUS_PENDING:
+                    return False
+            b = s.eval_broker.stats()
+            return (b["total_ready"] == 0 and b["total_unacked"] == 0
+                    and b["total_pending"] == 0
+                    and b["total_waiting"] == 0)
+
+        settle_deadline = time.time() + settle_s
+        quiet = False
+        while time.time() < settle_deadline:
+            if quiesced():
+                # require two consecutive quiet reads 0.5s apart (a
+                # delayed follow-up eval landing between polls must not
+                # fake a quiesce)
+                time.sleep(0.5)
+                if quiesced():
+                    quiet = True
+                    break
+            time.sleep(0.25)
+        if not quiet:
+            violations.append("pipeline did not quiesce: pending evals "
+                              "or broker work remained after settle")
+        placed = wait_fully_placed(jobs, time.time() + 5.0)
+        fault_stats = faultpoints.stats()
+        total_fires = faultpoints.fires()
+        faultpoints.disarm()
+
+        # ---- convergence invariants -------------------------------------
+        leader = wait_for_leader(servers, timeout=10.0)
+        # replicas caught up (raft converged) before per-replica checks
+        idx = leader.state.latest_index()
+        catch_deadline = time.time() + 10.0
+        while time.time() < catch_deadline:
+            if all(s.state.latest_index() >= idx for s in servers):
+                break
+            time.sleep(0.05)
+        else:
+            violations.append(
+                "replica lag: " + ", ".join(
+                    f"{s.config.name}={s.state.latest_index()}/{idx}"
+                    for s in servers))
+
+        snap = leader.state.snapshot()
+        # 1. terminal evals
+        for ev in snap.evals_iter():
+            if ev.status in (consts.EVAL_STATUS_PENDING,
+                             consts.EVAL_STATUS_BLOCKED):
+                violations.append(
+                    f"eval {ev.id[:8]} stuck {ev.status} "
+                    f"(trigger {ev.triggered_by})")
+        # 2. exact placement, no dups, no orphans
+        nodes = {n.id: n for n in snap.nodes()}
+        burst_alloc_ids = set()
+        for j in warm + jobs:
+            rows = snap.allocs_by_job(j.namespace, j.id)
+            if j in jobs:
+                burst_alloc_ids |= {a.id for a in rows}
+            live = [a for a in rows if not a.terminal_status()]
+            if len(live) != allocs_per_job:
+                violations.append(
+                    f"job {j.id[:8]}: {len(live)} live allocs, "
+                    f"want {allocs_per_job}")
+            names = [a.name for a in live]
+            if len(set(names)) != len(names):
+                violations.append(f"job {j.id[:8]}: duplicate live "
+                                  f"slot names {sorted(names)}")
+            for a in live:
+                node = nodes.get(a.node_id)
+                if node is None:
+                    violations.append(
+                        f"alloc {a.id[:8]} orphaned on missing node "
+                        f"{a.node_id[:8]}")
+                elif node.status != consts.NODE_STATUS_READY:
+                    violations.append(
+                        f"alloc {a.id[:8]} live on {node.status} node "
+                        f"{a.node_id[:8]}")
+        # 3. usage planes bit-identical to rebuild, per replica
+        for s in servers:
+            diffs = usage_rebuild_diff(s.state)
+            for d in diffs[:5]:
+                violations.append(f"{s.config.name} usage drift: {d}")
+        # 4. dropped nodes expired + drained
+        nodes_down = 0
+        for nid in drop_set:
+            node = nodes.get(nid)
+            if node is None or node.status == consts.NODE_STATUS_READY:
+                violations.append(
+                    f"dropped node {nid[:8]} never expired "
+                    f"(status {'gone' if node is None else node.status})")
+            else:
+                nodes_down += 1
+        # 5. gap-free stream (or explicit markers). Markers carry
+        # counts, not keys, so when one was seen the invariant weakens
+        # to marker-presence — the missed count is still REPORTED
+        # (stream_missed_alloc_events) so a ring/resume regression
+        # hiding behind an expected marker shows in the trend line.
+        stop.set()
+        for th in threads:
+            th.join(timeout=3.0)
+        missing = burst_alloc_ids - mon["alloc_ids"]
+        if missing and mon["lost_markers"] == 0:
+            violations.append(
+                f"stream silently missed {len(missing)} burst "
+                f"alloc events (no LostEvents marker)")
+
+        return {
+            "schedule": schedule,
+            "seed": seed,
+            "converged_ok": not violations,
+            "violations": violations,
+            "wall_s": round(wall, 3),
+            "n_evals": len(warm) + len(jobs),
+            "evals_per_sec": round(len(jobs) / wall, 2) if wall else 0.0,
+            "allocs_placed": placed,
+            "allocs_wanted": len(jobs) * allocs_per_job,
+            "faults": fault_stats,
+            "faults_fired": total_fires,
+            "failover_resumes": mon["failover_resumes"],
+            "nodes_dropped": len(drop_set),
+            "nodes_down": nodes_down,
+            "stream_events": mon["events"],
+            "stream_lost_markers": mon["lost_markers"],
+            "stream_missed_alloc_events": len(missing),
+            "plan_rejections": plan_rejections.snapshot()["rejections"],
+        }
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=3.0)
+        faultpoints.reset()
+        registry.heal()
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:                   # noqa: BLE001
+                pass
+
+
+def run_chaos_suite(seed: int = CHAOS_SEED, **kw) -> Dict:
+    """All standing chaos schedules, each against a fresh cluster.
+    ``converged_ok`` is the AND across schedules — the acceptance bar
+    (bench.py emits it as ``chaos_evals_converged_ok``)."""
+    results = {}
+    for name in CHAOS_SCHEDULES:
+        results[name] = run_chaos_burst(schedule=name, seed=seed, **kw)
+    return {
+        "seed": seed,
+        "converged_ok": all(r["converged_ok"] for r in results.values()),
+        "schedules": results,
+        "faults_fired": sum(r["faults_fired"] for r in results.values()),
+        "violations": [f"{n}: {v}" for n, r in results.items()
+                       for v in r["violations"]],
+    }
+
+
 def main() -> None:
     import argparse
 

@@ -42,6 +42,7 @@ from nomad_tpu.telemetry.histogram import histograms, percentile
 from nomad_tpu.telemetry.kernel_profile import profiler
 from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.tensors.device_state import default_device_state
+from nomad_tpu.utils.faultpoints import fault
 from nomad_tpu.utils.wavecohort import wave_cohorts
 from nomad_tpu.utils.witness import witness_lock
 
@@ -126,6 +127,12 @@ class _WaveTopK:
                 done = self._done
             done.wait()
         try:
+            # deferred-drain seam (chaos plane): the shared top-k fetch
+            # runs in the plan window; a failure here hits whichever
+            # member claimed the fetch — losers retry the claim (the
+            # while-loop above) so one injected error never wedges the
+            # whole wave's score_meta drain
+            fault("wave.d2h.drain")
             idx = np.asarray(self._idx)
             scores = np.asarray(self._scores)
             profiler.add_bytes("d2h", idx.nbytes + scores.nbytes)
@@ -440,6 +447,11 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
     """
     if mesh is _USE_GLOBAL:
         mesh = _WAVE_MESH
+    # wave-launch seam (chaos plane): an injected failure lands on
+    # EVERY member of the wave (the coalescer's _fire propagates it to
+    # each parked request) — a crashed wave, mid-cohort; the armed
+    # wavecohort window must expire and the broker must redeliver
+    fault("wave.launch")
     with tracer.span("wave.assemble"):
         k_max = max(k_steps)
         feats = union_features(features)

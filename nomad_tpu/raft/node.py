@@ -19,6 +19,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from nomad_tpu.raft.log import LOG_COMMAND, LOG_NOOP, LogEntry, LogStore
+from nomad_tpu.utils.faultpoints import FaultError, fault
 
 # reserved msg_types for replicated membership changes, handled by the
 # raft layer itself instead of the FSM (hashicorp/raft
@@ -181,6 +182,9 @@ class RaftNode:
     def apply(self, msg_type: str, req: Dict, timeout: float = 10.0) -> Any:
         """Append a command; block until committed + FSM-applied locally.
         On followers raises NotLeaderError (callers forward)."""
+        # the leader-side entry seam: an injected error here is a raft
+        # apply that failed before the append (chaos plane, ISSUE 12)
+        fault("raft.apply.pre")
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
@@ -236,6 +240,17 @@ class RaftNode:
                 if self._removed:
                     continue   # voted off the cluster: never campaign
             if state == LEADER:
+                try:
+                    # leader step-down seam: an armed error here (the
+                    # chaos cell's leader-kill schedule) deposes this
+                    # leader mid-flight — elections, broker flush +
+                    # restore, and plan-future failover all follow the
+                    # exact production paths
+                    fault("raft.leader.stepdown")
+                except FaultError:
+                    LOG.info("%s: injected leader step-down", self.id)
+                    self.step_down()
+                    continue
                 self._wake_replicators()   # heartbeat
                 continue
             if elapsed >= timeout:
@@ -318,6 +333,17 @@ class RaftNode:
                     self._advance_commit_locked()
             self._wake_replicators()
 
+    def step_down(self) -> None:
+        """Voluntarily abandon leadership (hashicorp/raft's leadership
+        transfer, minus the hand-off): become a follower in the current
+        term, fail pending futures, and let a peer's election timeout
+        pick the next leader. The chaos cell's leader-kill schedule
+        drives this through the ``raft.leader.stepdown`` fault point."""
+        with self._lock:
+            if self.state != LEADER:
+                return
+            self._step_down_locked(self.current_term)
+
     def _step_down_locked(self, term: int) -> None:
         was_leader = self.state == LEADER
         self.state = FOLLOWER
@@ -397,6 +423,10 @@ class RaftNode:
                     next_idx, self.config.max_append_entries
                 )
                 commit = self.commit_index
+        # replication seam: injected errors/latency here are dropped or
+        # slow AppendEntries RPCs — the replicator's retry-next-wake
+        # path (ConnectionError treatment below) must absorb them
+        fault("raft.replicate.send")
         try:
             if snapshot_req is not None:
                 resp = self.transport.send(peer, "install_snapshot", snapshot_req)
@@ -496,6 +526,14 @@ class RaftNode:
                             self._apply_add_peer(req["peer"])
                             result = index
                         else:
+                            # committed-entry apply seam. NOTE: error
+                            # injection here on a REPLICATED cluster
+                            # diverges replicas (the entry applies on
+                            # the others) — the reference panics for
+                            # the same reason; chaos schedules use
+                            # latency only on clusters, errors only
+                            # single-server (docs/ROBUSTNESS.md)
+                            fault("raft.fsm.apply")
                             result = self.fsm_apply(msg_type, req)
                     except Exception as e:          # noqa: BLE001
                         error = e

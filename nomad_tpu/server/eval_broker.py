@@ -24,6 +24,7 @@ from nomad_tpu.structs import consts
 from nomad_tpu.structs.eval_plan import Evaluation, generate_uuid
 from nomad_tpu.telemetry.trace import tracer
 from nomad_tpu.utils.delayheap import DelayHeap
+from nomad_tpu.utils.faultpoints import fault
 from nomad_tpu.utils.witness import witness_lock
 
 # Queue that unackable evals land on after the delivery limit
@@ -374,6 +375,11 @@ class EvalBroker:
                            (un.nack_deadline, eval_id, token))
 
     def ack(self, eval_id: str, token: str) -> None:
+        # ack seam (chaos plane): a failed ack leaves the eval unacked
+        # after its work committed — the worker nacks, the redelivered
+        # eval re-schedules to a no-op plan and acks clean (the
+        # convergence path the chaos cell asserts)
+        fault("broker.ack")
         with self._lock:
             un = self._unack.get(eval_id)
             if un is None:
@@ -402,6 +408,9 @@ class EvalBroker:
             self._enqueue_locked(requeued, requeued.type)
 
     def nack(self, eval_id: str, token: str) -> None:
+        # nack seam (chaos plane): a failed nack strands the eval
+        # unacked until the shared deadline watcher auto-nacks it
+        fault("broker.nack")
         with self._lock:
             un = self._unack.get(eval_id)
             if un is None or un.token != token:
@@ -453,7 +462,25 @@ class EvalBroker:
                     due.append((eid, token))
                 head = self._nack_heap[0][0] if self._nack_heap else None
             for eid, token in due:
-                self.nack(eid, token)
+                try:
+                    self.nack(eid, token)
+                except Exception:               # noqa: BLE001
+                    # a failed auto-nack (chaos-plane injection, or any
+                    # real error) must not kill the SHARED watcher —
+                    # with it dead, every future deadline would strand
+                    # its eval unacked forever. Re-arm a short retry
+                    # deadline instead so the eval still converges
+                    # (found by the ISSUE 12 chaos cell)
+                    with self._lock:
+                        un = self._unack.get(eid)
+                        if un is not None and un.token == token:
+                            retry = time.time() + min(
+                                max(self.nack_timeout / 4.0, 0.1), 5.0)
+                            un.nack_deadline = retry
+                            heapq.heappush(self._nack_heap,
+                                           (retry, eid, token))
+                            if head is None or retry < head:
+                                head = retry
             wait = max(head - time.time(), 0.01) if head else 1.0
             self._nack_wake.wait(wait)
             self._nack_wake.clear()

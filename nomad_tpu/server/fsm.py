@@ -79,7 +79,12 @@ class NomadFSM:
         import time
 
         from nomad_tpu.telemetry.trace import tracer
+        from nomad_tpu.utils.faultpoints import fault
 
+        # the FSM dispatch seam (chaos plane): single-server error
+        # injection fails the whole raft_apply before any mutation;
+        # latency injection stalls the apply loop (replicated-safe)
+        fault("fsm.apply.pre")
         handler = self._DISPATCH.get(msg_type)
         if handler is None:
             raise ValueError(f"unknown FSM message type {msg_type}")

@@ -51,6 +51,7 @@ from nomad_tpu.structs.resources import allocs_fit
 from nomad_tpu.server.plan_queue import PendingPlan, PlanQueue
 from nomad_tpu.telemetry.histogram import histograms
 from nomad_tpu.telemetry.trace import tracer
+from nomad_tpu.utils.faultpoints import fault
 from nomad_tpu.utils.witness import witness_lock
 
 
@@ -679,10 +680,15 @@ class Planner:
         plan_queue: PlanQueue,
         pool_workers: int = 4,
         raft_apply=None,
+        on_node_rejection_threshold=None,
     ) -> None:
         self.state = state_store
         self.queue = plan_queue
         self.pool_workers = pool_workers
+        # plan rejection tracker (server/plan_rejection.py): fired with
+        # a node id when its in-window rejection count crosses the
+        # threshold; the server marks it ineligible through raft
+        self._on_node_rejection_threshold = on_node_rejection_threshold
         # commits go through the Raft boundary so FSM side effects
         # (blocked-eval unblock on freed capacity) fire; standalone use
         # falls back to direct store writes
@@ -897,6 +903,11 @@ class Planner:
             except Exception:               # noqa: BLE001 - metric only
                 n_bytes = 0
         plan_group_stats.note_commit(len(items), n_bytes)
+        # the commit seam (chaos plane): an injected error is a raft
+        # apply that failed under a half-committed cohort — every plan
+        # future in the batch gets the error, every worker nacks, the
+        # broker redelivers against refreshed state
+        fault("plan.commit.raft")
         if self._raft_apply is not None:
             # fsm.go applyPlanResults: Raft commit + blocked-eval unblock
             from nomad_tpu.server.fsm import APPLY_PLAN_RESULTS
@@ -994,6 +1005,7 @@ class Planner:
                     result.node_preemptions[node_id] = plan.node_preemptions[node_id]
             else:
                 partial = True
+                self._note_node_rejection(node_id)
         if partial:
             # scheduler must refresh past this state and retry
             result.refresh_index = snapshot.latest_index()
@@ -1005,6 +1017,27 @@ class Planner:
         else:
             self.plans_full += 1
         return result
+
+    def _note_node_rejection(self, node_id: str) -> None:
+        """One rejected node plan into the process-wide tracker
+        (server/plan_rejection.py). Crossing the threshold fires the
+        server's mark-ineligible callback SYNCHRONOUSLY on the applier
+        thread — a raft apply, but a rare one (once per node per
+        window at most), and serializing it here keeps the eligibility
+        flip ordered before the batch's own commit responses. Failures
+        never reach the applier loop."""
+        try:
+            from nomad_tpu.server.plan_rejection import plan_rejections
+
+            if plan_rejections.note_rejection(node_id) \
+                    and self._on_node_rejection_threshold is not None:
+                self._on_node_rejection_threshold(node_id)
+        except Exception:                       # noqa: BLE001
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "plan-rejection tracking failed for node %s",
+                node_id, exc_info=True)
 
     @staticmethod
     def _node_status_gates(node, placements) -> Optional[Tuple[bool, str]]:

@@ -16,6 +16,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs.eval_plan import Plan, PlanResult
+from nomad_tpu.utils.faultpoints import fault
 from nomad_tpu.utils.metrics import global_registry
 from nomad_tpu.utils.wavecohort import wave_cohorts
 from nomad_tpu.utils.witness import witness_lock
@@ -78,6 +79,11 @@ class PlanQueue:
         self._update_depth_gauge()
 
     def enqueue(self, plan: Plan) -> PendingPlan:
+        # submit seam (chaos plane): an injected error is a plan that
+        # never reached the applier — the worker nacks its eval and the
+        # broker redelivers (outside the lock on purpose: latency
+        # injection must not stretch the queue's critical section)
+        fault("plan.queue.enqueue")
         with self._lock:
             if not self._enabled:
                 raise RuntimeError("plan queue is disabled")

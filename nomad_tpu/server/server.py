@@ -22,12 +22,14 @@ from nomad_tpu.server.eval_broker import FAILED_QUEUE, EvalBroker
 from nomad_tpu.server.fsm import NomadFSM
 from nomad_tpu.server.heartbeat import HeartbeatTimers
 from nomad_tpu.server import plan_apply as _plan_apply
+from nomad_tpu.server import plan_rejection as _plan_rejection
 from nomad_tpu.server.plan_apply import Planner
 from nomad_tpu.server.plan_queue import PlanQueue
 from nomad_tpu.server.worker import Worker
 from nomad_tpu.state.store import StateStore
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.eval_plan import Evaluation, Plan, PlanResult
+from nomad_tpu.utils.faultpoints import fault
 
 LOG = logging.getLogger(__name__)
 
@@ -69,6 +71,8 @@ class ServerConfig:
         coalesce_adaptive: bool = True,
         broker_fill_window_ms: float = 5.0,
         client_update_fill_window_ms: float = 2.0,
+        plan_rejection_threshold: int = 15,
+        plan_rejection_window_s: float = 300.0,
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -125,6 +129,12 @@ class ServerConfig:
         # the broker batch-fill discipline); 0 disables the window
         # (drain-while-busy coalescing still applies)
         self.client_update_fill_window_ms = client_update_fill_window_ms
+        # plan rejection tracker (server/plan_rejection.py; Nomad 1.3's
+        # plan_rejection_tracker): a node whose applier rejections
+        # cross the threshold inside the window is marked ineligible
+        # through raft. 0 disables the marking (counting stays on).
+        self.plan_rejection_threshold = plan_rejection_threshold
+        self.plan_rejection_window_s = plan_rejection_window_s
 
 
 class ClientUpdateStats:
@@ -268,9 +278,13 @@ class Server:
 
         # rolling plan-latency observations (submit -> applied result)
         self.plan_latencies = deque(maxlen=100_000)
+        from nomad_tpu.server.plan_rejection import plan_rejections
+        plan_rejections.configure(self.config.plan_rejection_threshold,
+                                  self.config.plan_rejection_window_s)
         self.planner = Planner(
             self.state, self.plan_queue, self.config.plan_pool_workers,
             raft_apply=self.raft_apply,
+            on_node_rejection_threshold=self._mark_node_plan_rejected,
         )
         self.heartbeats = HeartbeatTimers(
             self._on_heartbeat_expire, ttl=self.config.heartbeat_ttl
@@ -922,6 +936,11 @@ class Server:
         forces whole-table COW copies on the next write, which at
         fleet heartbeat rates (10k+ clients) taxes every commit with
         copies the heartbeats caused."""
+        # heartbeat delivery seam (chaos plane): an injected error is a
+        # dropped heartbeat — enough of them in a row and the TTL
+        # expires, driving the node-down -> allocs-lost -> reschedule
+        # pipeline this endpoint normally keeps at bay
+        fault("heartbeat.deliver")
         client_update_stats.note_heartbeat()
         node = self.state.node_by_id_direct(node_id)
         if node is None:
@@ -1057,6 +1076,33 @@ class Server:
             self.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": evals})
         return [e.id for e in evals]
 
+    def _mark_node_plan_rejected(self, node_id: str) -> None:
+        """A node crossed the plan-rejection threshold (Nomad 1.3's
+        BadNodeTracker): mark it ineligible through the normal raft
+        path so the scheduler stops proposing onto it. Skipped when
+        disabled (threshold 0) or the node is already ineligible."""
+        if self.config.plan_rejection_threshold <= 0:
+            return
+        try:
+            node = self.state.node_by_id_direct(node_id)
+            if node is None or node.scheduling_eligibility == \
+                    consts.NODE_SCHEDULING_INELIGIBLE:
+                return
+            LOG.warning(
+                "node %s crossed the plan rejection threshold (%d in "
+                "%.0fs): marking ineligible", node_id,
+                self.config.plan_rejection_threshold,
+                self.config.plan_rejection_window_s)
+            self.raft_apply(
+                fsm_msgs.NODE_UPDATE_ELIGIBILITY,
+                {"node_id": node_id,
+                 "eligibility": consts.NODE_SCHEDULING_INELIGIBLE},
+            )
+            _plan_rejection.plan_rejections.note_marked()
+        except Exception as e:                  # noqa: BLE001
+            LOG.warning("failed to mark plan-rejected node %s "
+                        "ineligible: %s", node_id, e)
+
     def update_allocs_from_client(self, allocs: List) -> int:
         """Node.UpdateAlloc: client status batch + reschedule evals for
         failures (node_endpoint.go:1155)."""
@@ -1159,6 +1205,11 @@ class Server:
                     self._client_update_batch = None
                 try:
                     client_update_stats.note_batch()
+                    # fan-in flush seam (chaos plane): error fails the
+                    # whole batch (every caller sees it); kind="kill"
+                    # kills the drain leader mid-flush and exercises
+                    # the abnormal-unwind discipline in the finally
+                    fault("server.client_update.raft")
                     batch.resolve(self.raft_apply(
                         fsm_msgs.ALLOC_CLIENT_UPDATE,
                         {"allocs": batch.allocs, "evals": batch.evals},
@@ -1272,6 +1323,11 @@ class Server:
                         self._eval_commit_busy = False
                         break
                 try:
+                    # group-commit raft seam (chaos plane): same
+                    # semantics as the client-update seam above — the
+                    # kill schedule finally exercises the abnormal
+                    # unwind below for real
+                    fault("server.eval_commit.raft")
                     batch.resolve(self.raft_apply(
                         fsm_msgs.EVAL_UPDATE, {"evals": batch.evals}), None)
                 except Exception as e:               # noqa: BLE001
@@ -1744,6 +1800,9 @@ class Server:
             # group commit: vector-proven vs exact-fallback plan
             # re-validation + batched raft entry shape
             "plan_group": _plan_apply.plan_group_stats.snapshot(),
+            # plan rejection tracker (Nomad 1.3): per-node rejection
+            # pressure + eligibility flips it drove
+            "plan_rejection": _plan_rejection.plan_rejections.snapshot(),
             # exact host-side assignment disagreed with the kernel and
             # forced a masked re-run (should stay near zero)
             "assign_retry_launches":

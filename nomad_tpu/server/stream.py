@@ -44,6 +44,7 @@ from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from nomad_tpu.telemetry.histogram import STREAM_DELIVER, histograms
 from nomad_tpu.telemetry.trace import tracer
+from nomad_tpu.utils.faultpoints import FaultError, fault
 from nomad_tpu.utils.witness import witness_lock
 
 TOPIC_ALL = "*"
@@ -169,6 +170,9 @@ class EventBroker:
         self._delivered_batches = 0
         self._delivered_bytes = 0         # fed by the NDJSON endpoint
         self._lost_events = 0
+        # batches the publish seam dropped (chaos plane): each one was
+        # converted into per-subscriber LostEvents markers above
+        self._publish_failures = 0
 
     # --- publish ---------------------------------------------------------
 
@@ -177,6 +181,29 @@ class EventBroker:
         ``stamp`` is the FSM-apply monotonic time (defaults to now);
         it anchors the ``stream_deliver`` lag histogram."""
         if not events:
+            return
+        try:
+            # publish seam (chaos plane): the ring append failing (or
+            # stalling, with kind="latency") between FSM commit and
+            # fan-out. The contract survives it: a failed publish
+            # becomes an EXPLICIT LostEvents marker for every live
+            # cursor — never a silent gap the subscriber cannot see.
+            fault("stream.publish")
+        except FaultError:
+            with self._cond:
+                self._publish_failures += 1
+                # live cursors get an exact-count marker; FUTURE
+                # resumes must see the gap too — record the dropped
+                # indexes in the trimmed-history watermark so a later
+                # subscribe(from_index <= dropped) gets the unknown-
+                # size LostEvents marker instead of a silent gap
+                top = max(e.index for e in events)
+                if top > self._trimmed_latest_index:
+                    self._trimmed_latest_index = top
+                for sub in self._subs:
+                    if sub._pending_lost >= 0:
+                        sub._pending_lost += len(events)
+                self._cond.notify_all()
             return
         with tracer.span("stream.publish"):
             batch_stamp = stamp if stamp is not None else time.monotonic()
@@ -369,6 +396,7 @@ class EventBroker:
                 "delivered_batches": self._delivered_batches,
                 "delivered_bytes": self._delivered_bytes,
                 "lost_events": self._lost_events,
+                "publish_failures": self._publish_failures,
                 "retained_events": self._retained_events,
                 "retained_batches": len(self._batches),
                 "max_lag_events": self._max_lag_locked(),
@@ -386,5 +414,6 @@ class EventBroker:
             self._delivered_batches = 0
             self._delivered_bytes = 0
             self._lost_events = 0
+            self._publish_failures = 0
             self._published_batches = 0
             self._published_origin = self._published_events

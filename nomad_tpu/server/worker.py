@@ -33,6 +33,7 @@ from nomad_tpu.structs import consts
 from nomad_tpu.structs.eval_plan import Evaluation, Plan, PlanResult
 from nomad_tpu.telemetry.histogram import histograms
 from nomad_tpu.telemetry.trace import flight_recorder, tracer
+from nomad_tpu.utils.faultpoints import fault
 
 LOG = logging.getLogger(__name__)
 
@@ -112,15 +113,55 @@ class _EvalPool:
         return task
 
     def _run(self) -> None:
-        while True:
-            task = self._q.get()
-            if task is None:
-                return
-            try:
-                task.run()
-            finally:
-                with self._lock:
-                    self._active -= 1
+        try:
+            while True:
+                task = self._q.get()
+                if task is None:
+                    # retire sentinel. Normally shutdown() already
+                    # un-booked this thread (reset _spawned to 0) and
+                    # the floor makes this a no-op — but a RESPAWNED
+                    # replacement (kill racing shutdown) that eats a
+                    # stale sentinel is still booked, and leaving it
+                    # counted would starve the next submit's spawn
+                    # check with zero live threads behind it
+                    with self._lock:
+                        if self._spawned > 0:
+                            self._spawned -= 1
+                    return
+                try:
+                    task.run()
+                finally:
+                    with self._lock:
+                        self._active -= 1
+        except BaseException:
+            # a task KILLED its thread (task.run confines Exception;
+            # only BaseException — the chaos plane's FaultThreadKill,
+            # or a real crash — escapes). The pool must not keep
+            # counting the corpse as a server: un-book it, and if
+            # tasks are still outstanding spawn a replacement so a
+            # queued eval never waits on a thread that no longer
+            # exists (found by the ISSUE 12 chaos cell — a killed
+            # wave member otherwise wedged the whole batch's reap).
+            respawn = False
+            with self._lock:
+                # floor at 0: shutdown() may have already reset the
+                # spawn count (this corpse is then unbooked); going
+                # negative would make the respawn check below — and
+                # every later submit's spawn check — silently skip a
+                # needed replacement
+                if self._spawned > 0:
+                    self._spawned -= 1
+                if self._active > 0 and \
+                        self._spawned < min(self._active, self._max):
+                    self._spawned += 1
+                    respawn = True
+                    n = self._spawned
+            if respawn:
+                threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"{self._name}-{n}r",
+                ).start()
+            raise
 
     def shutdown(self) -> None:
         """Retire the current threads; in-flight tasks finish on their
@@ -281,7 +322,19 @@ class Worker:
             if self._pause.is_set():
                 self._stop.wait(0.05)
                 continue
-            self.run_once(timeout=0.2)
+            try:
+                self.run_once(timeout=0.2)
+            except BaseException:               # noqa: BLE001
+                # the DISPATCH loop is infrastructure: in single-eval
+                # mode _process runs on THIS thread, so a killed eval
+                # (chaos FaultThreadKill, or any real crash past the
+                # Exception confinement) would otherwise take the
+                # whole worker down and strand every future eval —
+                # the chaos cell found exactly that. The eval itself
+                # stays abandoned (unacked; the broker's deadline
+                # recovers it), the loop survives.
+                LOG.warning("worker %d: eval dispatch crashed; "
+                            "continuing", self.id, exc_info=True)
 
     # --- one dequeue->process->ack cycle --------------------------------
 
@@ -328,6 +381,16 @@ class Worker:
         # inside the span below drops it (the stamp lives in a
         # broker-local map, never on the store's immutable eval row)
         t_enq = self.server.eval_broker.enqueue_stamp(eval_id)
+        # eval-thread seam (chaos plane): kind="kill" raises a
+        # BaseException the except-Exception confinement below does NOT
+        # catch — the thread dies mid-cohort with neither ack nor nack
+        # (only the finallys unwind), and recovery must come from the
+        # broker's auto-nack deadline. Placed BEFORE the _live
+        # registration: past it, the try/finally owns the cleanup — a
+        # kill between registering and the try would leave a stale
+        # _live entry whose heartbeat resets would keep the dead eval
+        # alive against the auto-nack forever.
+        fault("worker.eval")
         with self._live_lock:
             self._live[ev.id] = token
         try:

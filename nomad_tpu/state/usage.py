@@ -100,6 +100,69 @@ class UsagePlanes:
     row_events_floor: int = 0
 
 
+def usage_rebuild_diff(store) -> List[str]:
+    """Verify the store's incrementally-maintained usage planes against
+    a FROM-SCRATCH rebuild over the same nodes + allocs (the chaos
+    cell's bit-identity invariant, ISSUE 12; also an operator
+    debugging aid). Returns human-readable mismatch strings — empty
+    means every per-node value and port bitmap is exactly equal.
+
+    Reads are taken consistent-by-retry: the snapshot and the planes
+    copy must come from the same store index (a write landing between
+    them would be a false positive); call on a quiesced store or
+    accept the bounded retry."""
+    planes = None
+    snap = None
+    for _ in range(8):
+        snap = store.snapshot()
+        planes = store.with_usage_view(lambda p, _a: p)
+        if store.latest_index() == snap.latest_index():
+            break
+    else:
+        # diffing a torn pair would report phantom drift; say so
+        # explicitly instead (the chaos cell surfaces this verbatim)
+        return ["unstable store: snapshot/planes could not be read at "
+                "one index after 8 attempts (diff skipped)"]
+    fresh = UsageIndex()
+    fresh.rebuild(snap.nodes(), list(snap.allocs_iter()))
+    fp = fresh.planes_copy()
+    diffs: List[str] = []
+
+    def row_vals(pl: UsagePlanes, row):
+        if row is None:
+            return (0.0, 0.0, 0.0, 0, 0, 0, 0, 0)
+        return (
+            float(pl.used_cpu[row]), float(pl.used_mem[row]),
+            float(pl.used_disk[row]), int(pl.used_cores[row]),
+            int(pl.used_mbits[row]), int(pl.used_special[row]),
+            int(pl.used_devices[row]), int(pl.port_masks.get(row, 0)),
+        )
+
+    names = ("cpu", "mem", "disk", "cores", "mbits", "special",
+             "devices", "port_mask")
+    for nid in sorted(set(planes.rows) | set(fp.rows)):
+        live_row = planes.rows.get(nid)
+        fresh_row = fp.rows.get(nid)
+        lv = row_vals(planes, live_row)
+        fv = row_vals(fp, fresh_row)
+        # a poisoned live bitmap is unprovable by design — the group
+        # checker already exact-walks those rows, so only the provable
+        # plane values participate in bit-identity
+        live_dirty = live_row is not None and live_row in planes.port_dirty
+        fresh_dirty = fresh_row is not None and fresh_row in fp.port_dirty
+        for name, a, b in zip(names, lv, fv):
+            if name == "port_mask" and (live_dirty or fresh_dirty):
+                continue
+            if a != b:
+                diffs.append(
+                    f"node {nid}: {name} live={a!r} rebuild={b!r}")
+        if live_dirty != fresh_dirty:
+            diffs.append(
+                f"node {nid}: port_dirty live={live_dirty} "
+                f"rebuild={fresh_dirty}")
+    return diffs
+
+
 class UsageIndex:
     """Live planes owned by the state store; mutate under its lock."""
 

@@ -230,6 +230,58 @@ def prometheus_text(registry=None, event_broker=None) -> str:
             f"{round(g['group_size_avg'], 4)}")
     except Exception:                           # noqa: BLE001
         pass                # plan applier unavailable: skip
+    # plan rejection tracker (server/plan_rejection.py; Nomad 1.3's
+    # plan_rejection_tracker): per-node applier-rejection pressure and
+    # the eligibility flips it drove — a node "eating the cluster"
+    # shows up here before it shows up as a throughput mystery
+    try:
+        from nomad_tpu.server.plan_rejection import plan_rejections
+
+        pr = plan_rejections.snapshot()
+        lines.append(
+            "# TYPE nomad_tpu_plan_rejection_node_rejections_total "
+            "counter")
+        lines.append(
+            f"nomad_tpu_plan_rejection_node_rejections_total "
+            f"{pr['rejections']}")
+        lines.append(
+            "# TYPE nomad_tpu_plan_rejection_marked_ineligible_total "
+            "counter")
+        lines.append(
+            f"nomad_tpu_plan_rejection_marked_ineligible_total "
+            f"{pr['nodes_marked']}")
+        lines.append(
+            "# TYPE nomad_tpu_plan_rejection_tracked_nodes gauge")
+        lines.append(
+            f"nomad_tpu_plan_rejection_tracked_nodes "
+            f"{pr['tracked_nodes']}")
+    except Exception:                           # noqa: BLE001
+        pass                # tracker unavailable: skip series
+    # fault-injection plane (utils/faultpoints.py, ISSUE 12): per-point
+    # hit/fire counters plus the armed gauge. Disarmed processes show
+    # armed=0 and no per-point series — exactly the no-op promise.
+    try:
+        from nomad_tpu.utils import faultpoints
+
+        fp = faultpoints.stats()
+        lines.append("# TYPE nomad_tpu_fault_armed gauge")
+        lines.append(
+            f"nomad_tpu_fault_armed {1 if faultpoints.armed() else 0}")
+        if fp:
+            lines.append("# TYPE nomad_tpu_fault_hits_total counter")
+            for point, row in fp.items():
+                lines.append(
+                    f'nomad_tpu_fault_hits_total'
+                    f'{{point="{_esc(point)}"}} {row["hits"]}')
+            lines.append("# TYPE nomad_tpu_fault_fires_total counter")
+            for point, row in fp.items():
+                kind = row["kind"] or "none"
+                lines.append(
+                    f'nomad_tpu_fault_fires_total'
+                    f'{{point="{_esc(point)}",kind="{kind}"}} '
+                    f'{row["fires"]}')
+    except Exception:                           # noqa: BLE001
+        pass                # fault plane unavailable: skip series
     # wave-cohort drain accounting (utils/wavecohort.py): the plan
     # queue's wave-boundary batching — armed waves, landed plans,
     # whole-cohort drains vs expirations vs hard-cap clamps, and the

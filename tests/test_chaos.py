@@ -1,0 +1,440 @@
+"""Chaos-plane integration tests (ISSUE 12).
+
+Tier-1 coverage for the fault-injection seams and the hardening fixes
+the chaos cell drove: the eval-pool thread-kill respawn, the broker's
+auto-nack watcher surviving failed nacks, the delivery-limit path end
+to end (always-nacking worker -> failed queue -> backoff follow-up),
+heartbeat expiry driven through an open client-update fan-in window,
+the plan rejection tracker (Nomad 1.3), explicit LostEvents on a
+failed publish, and the pinned-seed MINI CHAOS smoke — a single-server
+burst that converges through injected plan-commit/submit/ack failures
+and a killed eval thread. The full 3-node cell runs in the stress
+tier (tests/test_stress.py::TestChaosCell).
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import consts
+from nomad_tpu.utils import faultpoints
+from nomad_tpu.utils.faultpoints import FaultThreadKill
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+def _wait(fn, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+class TestEvalPoolRespawn:
+    def test_killed_thread_does_not_strand_queued_tasks(self):
+        """A task that kills its pool thread (BaseException past the
+        Exception confinement) must not leave queued tasks with no
+        server — the pool un-books the corpse and spawns a
+        replacement (the chaos cell's wedged-batch finding)."""
+        from nomad_tpu.server.worker import _EvalPool
+
+        pool = _EvalPool(1, "chaos-test")
+        ran = threading.Event()
+
+        def boom():
+            raise FaultThreadKill("test")
+
+        t1 = pool.submit(boom)
+        t2 = pool.submit(ran.set)
+        t1.wait()
+        t2.wait()
+        assert ran.is_set()
+        # bookkeeping is clean: a fresh task still runs
+        again = threading.Event()
+        pool.submit(again.set).wait()
+        assert again.is_set()
+        pool.shutdown()
+
+
+class TestWorkerLoopSurvivesKill:
+    def test_single_eval_dispatch_survives_thread_kill(self):
+        """In single-eval mode _process runs ON the worker's dispatch
+        thread — a killed eval there must abandon the eval (auto-nack
+        recovers it) but never take the dispatch loop down (the chaos
+        cell's stuck-pending-evals finding)."""
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(
+            num_workers=1, worker_batch_size=1, heartbeat_ttl=60.0,
+            nack_timeout=0.4))
+        server.start()
+        try:
+            server.eval_broker.initial_nack_delay = 0.02
+            server.eval_broker.subsequent_nack_delay = 0.05
+            for _ in range(4):
+                server.node_register(mock.node())
+            faultpoints.arm({"worker.eval": {"kind": "kill", "nth": 1}},
+                            seed=1)
+            job = mock.simple_job()
+            job.task_groups[0].count = 2
+            server.job_register(job)
+            # the first eval is killed mid-dispatch; the auto-nack
+            # deadline redelivers it and the SAME worker loop (still
+            # alive) must place the job
+            _wait(lambda: len([
+                a for a in server.state.snapshot().allocs_by_job(
+                    job.namespace, job.id)
+                if not a.terminal_status()]) == 2,
+                timeout=30.0, msg="job placed after dispatch kill")
+            assert faultpoints.stats()["worker.eval"]["fires"] == 1
+            assert server.workers[0]._thread.is_alive()
+        finally:
+            server.shutdown()
+
+
+class TestNackWatcherSurvives:
+    def test_auto_nack_retries_through_injected_failure(self):
+        """The SHARED deadline watcher must survive a failed nack and
+        retry: one dead watcher would strand every future deadline's
+        eval unacked forever."""
+        from nomad_tpu.server.eval_broker import EvalBroker
+
+        broker = EvalBroker(nack_timeout=0.3, delivery_limit=10,
+                            initial_nack_delay=0.0,
+                            subsequent_nack_delay=0.0)
+        broker.set_enabled(True)
+        try:
+            ev = mock.eval()
+            broker.enqueue(ev)
+            got, _token = broker.dequeue(["service"], timeout=2.0)
+            assert got is not None
+            # the watcher's FIRST auto-nack attempt fails; its retry
+            # deadline (<= nack_timeout/4) must redeliver anyway
+            faultpoints.arm({"broker.nack": {"kind": "error", "nth": 1}})
+            got2, _ = broker.dequeue(["service"], timeout=5.0)
+            assert got2 is not None and got2.id == ev.id
+            assert faultpoints.stats()["broker.nack"]["fires"] == 1
+        finally:
+            broker.set_enabled(False)
+
+
+class TestDeliveryLimit:
+    def test_always_nacking_worker_lands_failed_queue_and_follow_up(self):
+        """ISSUE 12 satellite: the delivery-limit path end to end. An
+        eval nacked to exhaustion must land on the failed queue, the
+        leader's reap loop must mark it failed AND create a delayed
+        backoff follow-up eval, and the follow-up must become
+        dequeueable once its wait elapses."""
+        from nomad_tpu.server import fsm as fsm_msgs
+        from nomad_tpu.server.eval_broker import FAILED_QUEUE
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(
+            num_workers=0, eval_delivery_limit=3,
+            failed_eval_follow_up_wait=0.3, heartbeat_ttl=60.0))
+        server.start()
+        try:
+            server.eval_broker.initial_nack_delay = 0.0
+            server.eval_broker.subsequent_nack_delay = 0.0
+            ev = mock.eval()
+            server.raft_apply(fsm_msgs.EVAL_UPDATE, {"evals": [ev]})
+            # the always-nacking worker
+            for i in range(3):
+                got, token = server.eval_broker.dequeue(
+                    ["service"], timeout=2.0)
+                assert got is not None, f"redelivery {i} lost"
+                assert got.id == ev.id
+                server.eval_broker.nack(got.id, token)
+            # exhausted: routed to the failed queue, not redelivered
+            assert server.eval_broker.dequeue(["service"], timeout=0.2)[0] \
+                is None
+            # the leader's reap loop (0.2s cadence) — or this manual
+            # call, whoever wins the race — must mark it failed and
+            # create the backoff follow-up
+            server.reap_failed_evals_once()
+            _wait(lambda: any(
+                e.id == ev.id
+                and e.status == consts.EVAL_STATUS_FAILED
+                for e in server.state.snapshot().evals_iter()),
+                timeout=5.0, msg="failed-queue eval marked failed")
+            snap = server.state.snapshot()
+            rows = {e.id: e for e in snap.evals_iter()}
+            failed = rows[ev.id]
+            assert failed.status == consts.EVAL_STATUS_FAILED
+            assert "delivery limit" in failed.status_description
+            follow_ups = [e for e in rows.values()
+                          if e.previous_eval == ev.id
+                          and e.triggered_by == "failed-follow-up"]
+            assert len(follow_ups) == 1
+            fu = follow_ups[0]
+            assert fu.status == consts.EVAL_STATUS_PENDING
+            assert fu.wait_until_s > time.time() - 0.1
+            # parked in the delay heap until due
+            assert server.eval_broker.stats()["delayed_evals"] == 1
+            got, token = server.eval_broker.dequeue(
+                ["service"], timeout=5.0)
+            assert got is not None and got.id == fu.id
+            server.eval_broker.ack(got.id, token)
+        finally:
+            server.shutdown()
+
+
+class TestHeartbeatExpiryDuringFanIn:
+    def test_expiry_fires_while_fan_in_window_holds_a_batch_open(self):
+        """ISSUE 12 satellite: the heartbeat-expiry timer thread must
+        drive the node-down transition even while the client-update
+        fan-in leader is holding its fill window open back to back —
+        the two paths share raft but never each other's locks, and
+        this pins that interleaving."""
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(
+            num_workers=1, worker_batch_size=1, heartbeat_ttl=0.5,
+            client_update_fill_window_ms=120.0))
+        server.start()
+        stop = threading.Event()
+        storm_errors = []
+        try:
+            live = mock.node()
+            server.node_register(live)
+            victim = mock.node()
+            server.node_register(victim)
+            job = mock.simple_job()
+            job.task_groups[0].count = 1
+            server.job_register(job)
+            _wait(lambda: any(
+                not a.terminal_status() for a in
+                server.state.snapshot().allocs_by_job(
+                    job.namespace, job.id)), timeout=30.0,
+                msg="job placed")
+            snap = server.state.snapshot()
+            alloc = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                     if not a.terminal_status()][0]
+
+            def fan_in_storm():
+                while not stop.is_set():
+                    try:
+                        a = alloc.copy()
+                        a.client_status = consts.ALLOC_CLIENT_RUNNING
+                        server.update_allocs_from_client([a])
+                    except Exception as e:          # noqa: BLE001
+                        storm_errors.append(e)
+
+            def keep_live_alive():
+                while not stop.is_set():
+                    try:
+                        server.node_heartbeat(live.id, "ready")
+                    except Exception:               # noqa: BLE001
+                        pass
+                    time.sleep(0.1)
+
+            for fn in (fan_in_storm, fan_in_storm, keep_live_alive):
+                threading.Thread(target=fn, daemon=True).start()
+            # the victim is never heartbeated: TTL (0.5s + jitter)
+            # must expire UNDER the storm and mark it down
+            _wait(lambda: server.state.snapshot().node_by_id(
+                victim.id).status == consts.NODE_STATUS_DOWN,
+                timeout=6.0, msg="victim node marked down under fan-in")
+            stop.set()
+            time.sleep(0.2)
+            assert not storm_errors, storm_errors[:3]
+            # the placed job still runs exactly once, nowhere stale
+            snap = server.state.snapshot()
+            final = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                     if not a.terminal_status()]
+            assert len(final) == 1
+            assert final[0].node_id != victim.id or \
+                snap.node_by_id(victim.id).status != \
+                consts.NODE_STATUS_DOWN
+        finally:
+            stop.set()
+            server.shutdown()
+
+
+class TestPlanRejection:
+    def test_tracker_threshold_and_window(self):
+        from nomad_tpu.server.plan_rejection import PlanRejectionTracker
+
+        tr = PlanRejectionTracker(threshold=3, window_s=0.15)
+        assert not tr.note_rejection("n1")
+        assert not tr.note_rejection("n1")
+        time.sleep(0.2)                     # window lapses: count resets
+        assert not tr.note_rejection("n1")
+        assert not tr.note_rejection("n1")
+        assert tr.note_rejection("n1")      # third inside the window
+        s = tr.snapshot()
+        # the crossing alone does NOT count as a marking — only the
+        # caller's committed eligibility flip does
+        assert s["nodes_marked"] == 0 and s["rejections"] == 5
+        tr.note_marked()
+        assert tr.snapshot()["nodes_marked"] == 1
+        # crossing reset the node: it must re-cross cleanly
+        assert not tr.note_rejection("n1")
+
+    def test_rejected_node_marked_ineligible_through_raft(self):
+        """Nomad 1.3's plan_rejection_tracker: a node whose plans keep
+        getting rejected by the applier crosses the threshold and is
+        marked ineligible through the normal raft path."""
+        from nomad_tpu.server.plan_rejection import plan_rejections
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.eval_plan import Plan
+
+        server = Server(ServerConfig(
+            num_workers=0, heartbeat_ttl=60.0,
+            plan_rejection_threshold=3))
+        server.start()
+        try:
+            plan_rejections.reset_stats()
+            plan_rejections.configure(3, 300.0)
+            node = mock.node()
+            server.node_register(node)
+
+            def over_plan():
+                big = mock.alloc(node_id=node.id)
+                big.allocated_resources.tasks["web"].cpu.cpu_shares = \
+                    1_000_000
+                return Plan(eval_id="chaos-test",
+                            node_allocation={node.id: [big]})
+
+            for _ in range(3):
+                result = server.planner.apply_one(over_plan())
+                assert not result.node_allocation, "must be rejected"
+            _wait(lambda: server.state.snapshot().node_by_id(
+                node.id).scheduling_eligibility ==
+                consts.NODE_SCHEDULING_INELIGIBLE,
+                timeout=5.0, msg="node marked ineligible")
+            assert plan_rejections.snapshot()["nodes_marked"] == 1
+        finally:
+            plan_rejections.reset_stats()
+            server.shutdown()
+
+
+class TestStreamPublishFault:
+    def test_failed_publish_becomes_explicit_lost_marker(self):
+        """The publish seam's contract: a dropped event batch surfaces
+        to every live cursor as a LostEvents marker with the exact
+        count — never a silent gap."""
+        from nomad_tpu.server import stream
+
+        broker = stream.EventBroker()
+        sub = broker.subscribe({stream.TOPIC_ALL: ["*"]})
+        faultpoints.arm({"stream.publish": {"kind": "error", "nth": 1}})
+        dropped = [
+            stream.Event(topic=stream.TOPIC_JOB, type="JobRegistered",
+                         key=f"j{i}", index=5) for i in range(3)]
+        broker.publish(dropped)             # injected publish failure
+        broker.publish([stream.Event(
+            topic=stream.TOPIC_JOB, type="JobRegistered", key="after",
+            index=6)])
+        evs = sub.next_events(timeout=2.0)
+        assert evs[0].topic == stream.TOPIC_LOST
+        assert evs[0].payload["LostEvents"] == 3
+        assert [e.key for e in evs[1:]] == ["after"]
+        assert broker.snapshot()["publish_failures"] == 1
+        assert broker.snapshot()["lost_events"] == 3
+
+    def test_resume_spanning_dropped_publish_gets_marker(self):
+        """A subscriber ABSENT during the dropped publish must still
+        see the gap on a later from_index resume (the drop joins the
+        trimmed-history watermark — never a silent gap)."""
+        from nomad_tpu.server import stream
+
+        broker = stream.EventBroker()
+        broker.publish([stream.Event(
+            topic=stream.TOPIC_JOB, type="JobRegistered", key="seen",
+            index=4)])
+        faultpoints.arm({"stream.publish": {"kind": "error", "nth": 1}})
+        broker.publish([stream.Event(
+            topic=stream.TOPIC_JOB, type="JobRegistered", key="gone",
+            index=7)])                      # dropped, nobody subscribed
+        broker.publish([stream.Event(
+            topic=stream.TOPIC_JOB, type="JobRegistered", key="after",
+            index=9)])
+        sub = broker.subscribe({stream.TOPIC_ALL: ["*"]}, from_index=4)
+        evs = sub.next_events(timeout=2.0)
+        assert evs[0].topic == stream.TOPIC_LOST
+        assert evs[0].payload["LostEvents"] == -1   # unknown-size gap
+        assert [e.key for e in evs[1:] if e.index > 4] == ["after"]
+
+
+#: the tier-1 mini chaos schedule — pinned seed, bounded faults, one
+#: server. Reproduce failures with faultpoints.arm(MINI_CHAOS, 4242).
+MINI_CHAOS = {
+    "plan.queue.enqueue": {"kind": "error", "nth": 1},
+    "plan.commit.raft": {"kind": "error", "nth": 1},
+    "broker.ack": {"kind": "error", "nth": 2},
+    "worker.eval": {"kind": "kill", "nth": 3},
+}
+MINI_CHAOS_SEED = 4242
+
+
+class TestMiniChaosSmoke:
+    def test_pinned_seed_burst_converges_through_faults(self):
+        """The tier-1 chaos smoke: a single-server burst with a failed
+        plan submit, a failed commit batch, a failed ack, and a KILLED
+        eval thread — every eval must still reach a terminal state,
+        every job place exactly once, and the usage planes stay
+        bit-identical to a from-scratch rebuild."""
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.state.usage import usage_rebuild_diff
+
+        server = Server(ServerConfig(
+            num_workers=1, worker_batch_size=4, heartbeat_ttl=60.0,
+            nack_timeout=0.5, eval_delivery_limit=6,
+            failed_eval_follow_up_wait=0.2))
+        server.start()
+        try:
+            server.eval_broker.initial_nack_delay = 0.02
+            server.eval_broker.subsequent_nack_delay = 0.05
+            for _ in range(12):
+                server.node_register(mock.node())
+            faultpoints.arm(MINI_CHAOS, seed=MINI_CHAOS_SEED)
+            jobs = []
+            for _ in range(8):
+                job = mock.simple_job()
+                job.task_groups[0].count = 2
+                server.job_register(job)
+                jobs.append(job)
+
+            def converged():
+                snap = server.state.snapshot()
+                live = sum(
+                    1 for j in jobs
+                    for a in snap.allocs_by_job(j.namespace, j.id)
+                    if not a.terminal_status())
+                if live != 16:
+                    return False
+                if any(e.status == consts.EVAL_STATUS_PENDING
+                       for e in snap.evals_iter()):
+                    return False
+                b = server.eval_broker.stats()
+                return (b["total_ready"] == 0
+                        and b["total_unacked"] == 0
+                        and b["total_waiting"] == 0)
+
+            _wait(converged, timeout=90.0,
+                  msg="mini chaos burst converged")
+            fired = faultpoints.fires()
+            stats = faultpoints.stats()
+            faultpoints.disarm()
+            assert fired >= 3, stats
+            assert stats["worker.eval"]["fires"] == 1, stats
+            assert usage_rebuild_diff(server.state) == []
+            # no duplicate live slots anywhere
+            snap = server.state.snapshot()
+            for j in jobs:
+                live = [a for a in snap.allocs_by_job(j.namespace, j.id)
+                        if not a.terminal_status()]
+                names = [a.name for a in live]
+                assert len(set(names)) == len(names) == 2
+        finally:
+            server.shutdown()

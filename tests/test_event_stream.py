@@ -403,6 +403,113 @@ class TestNDJSONResume:
         finally:
             s.close()
 
+class TestFailoverReconnect:
+    """ISSUE 12 satellite: /v1/event/stream reconnect semantics across
+    a LEADER FAILOVER. Every replica's FSM publishes every committed
+    apply into its own ring, so a subscriber that loses its leader
+    resumes on the new one with ``?index=<last seen>`` and gets either
+    a gap-free replay from the new leader's ring or an explicit
+    LostEvents marker — never a silent gap. (The HTTP-level resume
+    plumbing is covered by TestNDJSONResume; this exercises the same
+    subscribe(from_index=...) path the endpoint calls, against the
+    surviving server.)"""
+
+    def _make_cluster(self):
+        from nomad_tpu.server.server import ServerConfig
+        from nomad_tpu.server.testing import make_cluster, wait_for_leader
+
+        servers, registry = make_cluster(3, ServerConfig(
+            num_workers=0, heartbeat_ttl=60.0))
+        return servers, registry, wait_for_leader(servers, timeout=10.0)
+
+    def _drain(self, sub, want, timeout=10.0):
+        got = []
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            got.extend(sub.next_events(timeout=0.2, max_events=256))
+            if want(got):
+                break
+        return got
+
+    def test_resume_on_new_leader_ring_is_gap_free(self):
+        from nomad_tpu.server.testing import wait_for_leader
+
+        servers, registry, leader = self._make_cluster()
+        try:
+            sub = leader.event_broker.subscribe({stream.TOPIC_ALL: ["*"]})
+            before = [mock.node() for _ in range(3)]
+            for n in before:
+                leader.node_register(n)
+            got = self._drain(
+                sub, lambda g: {n.id for n in before} <=
+                {e.key for e in g})
+            last_index = max(e.index for e in got)
+            sub.close()
+            # the leader dies outright
+            leader.shutdown()
+            rest = [s for s in servers if s is not leader]
+            new_leader = wait_for_leader(rest, timeout=10.0)
+            after = [mock.node() for _ in range(2)]
+            for n in after:
+                new_leader.node_register(n)
+            # resume on the NEW leader's ring from the last index the
+            # old stream served: replay is gap-free, no marker, no
+            # duplicates of what was already seen
+            sub2 = new_leader.event_broker.subscribe(
+                {stream.TOPIC_ALL: ["*"]}, from_index=last_index)
+            got2 = self._drain(
+                sub2, lambda g: {n.id for n in after} <=
+                {e.key for e in g})
+            assert all(e.topic != stream.TOPIC_LOST for e in got2), \
+                [e.topic for e in got2]
+            assert all(e.index > last_index for e in got2)
+            assert {n.id for n in before} & {e.key for e in got2} \
+                == set(), "pre-failover events replayed twice"
+            sub2.close()
+        finally:
+            registry.heal()
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:               # noqa: BLE001
+                    pass
+
+    def test_resume_past_new_leaders_trimmed_ring_gets_marker(self):
+        from nomad_tpu.server.testing import wait_for_leader
+
+        servers, registry, leader = self._make_cluster()
+        try:
+            sub = leader.event_broker.subscribe({stream.TOPIC_ALL: ["*"]})
+            first = mock.node()
+            leader.node_register(first)
+            got = self._drain(sub, lambda g: any(
+                e.key == first.id for e in g))
+            last_index = max(e.index for e in got)
+            sub.close()
+            leader.shutdown()
+            rest = [s for s in servers if s is not leader]
+            new_leader = wait_for_leader(rest, timeout=10.0)
+            # shrink the survivor's ring and blow past it while away
+            new_leader.event_broker.buffer_size = 4
+            for _ in range(24):
+                new_leader.node_register(mock.node())
+            sub2 = new_leader.event_broker.subscribe(
+                {stream.TOPIC_ALL: ["*"]}, from_index=last_index)
+            got2 = self._drain(sub2, lambda g: len(g) >= 1)
+            # the gap is EXPLICIT: LostEvents first, with a resume index
+            assert got2[0].topic == stream.TOPIC_LOST
+            assert got2[0].payload["ResumeIndex"] > last_index
+            sub2.close()
+        finally:
+            registry.heal()
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:               # noqa: BLE001
+                    pass
+
+
+class TestNDJSONKeepalive:
     @pytest.mark.slow
     def test_idle_stream_sends_keepalive_newlines(self, agent):
         s, status, lines = _open_stream(agent.http.addr)

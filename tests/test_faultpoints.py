@@ -1,0 +1,157 @@
+"""Fault-injection plane unit tests (ISSUE 12).
+
+The registry itself: disarmed no-op, deterministic seeded schedules
+(fail-Nth, every-Nth, Bernoulli, latency, thread-kill), counters, and
+the exporter series. The wired seams are exercised by the mini chaos
+smoke (tests/test_chaos.py) and the full chaos cell (stress tier).
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.utils import faultpoints
+from nomad_tpu.utils.faultpoints import (
+    FaultError,
+    FaultThreadKill,
+    fault,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+class TestDisarmedPath:
+    def test_disarmed_fault_is_a_noop(self):
+        # no exception, no registry entry, no lock taken
+        for _ in range(1000):
+            fault("some.point")
+        assert faultpoints.stats() == {}
+        assert not faultpoints.armed()
+
+    def test_disarm_stops_firing_but_keeps_stats(self):
+        faultpoints.arm({"p1": {"kind": "error"}})
+        with pytest.raises(FaultError):
+            fault("p1")
+        faultpoints.disarm()
+        fault("p1")                      # no-op again
+        assert faultpoints.stats()["p1"]["fires"] == 1
+
+
+class TestSchedules:
+    def test_error_nth_fires_exactly_once_at_nth(self):
+        faultpoints.arm({"p": {"kind": "error", "nth": 3}})
+        fault("p")
+        fault("p")
+        with pytest.raises(FaultError) as ei:
+            fault("p")
+        assert ei.value.point == "p"
+        for _ in range(10):
+            fault("p")                   # nth defaults max_fires=1
+        s = faultpoints.stats()["p"]
+        assert s["hits"] == 13 and s["fires"] == 1
+
+    def test_every_nth_with_max_fires(self):
+        faultpoints.arm({"p": {"kind": "error", "every": 2,
+                               "max_fires": 2}})
+        fired = 0
+        for _ in range(10):
+            try:
+                fault("p")
+            except FaultError:
+                fired += 1
+        assert fired == 2
+        assert faultpoints.stats()["p"]["fires"] == 2
+
+    def test_probability_is_seed_deterministic(self):
+        def pattern(seed):
+            faultpoints.reset()
+            faultpoints.arm({"p": {"kind": "error", "p": 0.5}},
+                            seed=seed)
+            out = []
+            for _ in range(64):
+                try:
+                    fault("p")
+                    out.append(0)
+                except FaultError:
+                    out.append(1)
+            return out
+
+        a = pattern(42)
+        b = pattern(42)
+        assert a == b, "same seed must replay the same decisions"
+        assert 0 < sum(a) < 64, "p=0.5 over 64 hits fires some, not all"
+
+    def test_latency_sleeps(self):
+        faultpoints.arm({"p": {"kind": "latency", "sleep_s": 0.05}})
+        t0 = time.perf_counter()
+        fault("p")
+        assert time.perf_counter() - t0 >= 0.045
+        assert faultpoints.stats()["p"]["fires"] == 1
+
+    def test_kill_is_baseexception_not_exception(self):
+        faultpoints.arm({"p": {"kind": "kill", "nth": 1}})
+        caught_by_except_exception = False
+        try:
+            try:
+                fault("p")
+            except Exception:            # the worker's confinement
+                caught_by_except_exception = True
+        except FaultThreadKill:
+            pass
+        assert not caught_by_except_exception
+        # kill defaults to one-shot
+        fault("p")
+
+    def test_kill_escapes_a_thread_but_finally_unwinds(self):
+        faultpoints.arm({"p": {"kind": "kill", "nth": 1}})
+        unwound = threading.Event()
+
+        def victim():
+            try:
+                fault("p")
+            finally:
+                unwound.set()
+
+        th = threading.Thread(target=victim, daemon=True)
+        th.start()
+        th.join(timeout=5)
+        assert unwound.is_set()
+
+    def test_unknown_kind_rejected_at_arm(self):
+        with pytest.raises(ValueError):
+            faultpoints.arm({"p": {"kind": "nonsense"}})
+
+    def test_unscheduled_point_counts_hits_while_armed(self):
+        faultpoints.arm({"scheduled": {"kind": "error", "nth": 99}})
+        fault("unscheduled")
+        s = faultpoints.stats()["unscheduled"]
+        assert s["hits"] == 1 and s["fires"] == 0 and s["kind"] is None
+
+    def test_fires_total(self):
+        faultpoints.arm({"a": {"kind": "error"}, "b": {"kind": "error"}})
+        for name in ("a", "b", "a"):
+            with pytest.raises(FaultError):
+                fault(name)
+        assert faultpoints.fires() == 3
+
+
+class TestExporterSeries:
+    def test_fault_series_in_prometheus_text(self):
+        from nomad_tpu.telemetry.exporter import prometheus_text
+
+        faultpoints.arm({"pt": {"kind": "error", "nth": 1}})
+        with pytest.raises(FaultError):
+            fault("pt")
+        text = prometheus_text()
+        assert "nomad_tpu_fault_armed 1" in text
+        assert 'nomad_tpu_fault_hits_total{point="pt"} 1' in text
+        assert ('nomad_tpu_fault_fires_total{point="pt",kind="error"} 1'
+                in text)
+        faultpoints.reset()
+        assert "nomad_tpu_fault_armed 0" in prometheus_text()

@@ -219,6 +219,41 @@ class TestFleetCell:
         assert cell["stream_deliver_count"] > 0
 
 
+class TestChaosCell:
+    def test_chaos_suite_under_lock_witness(self):
+        """ISSUE 12: every standing chaos schedule (leader-kill-mid-
+        wave, plan-commit raft failure, crash-and-drop) against a live
+        3-node raft cluster, pinned seed, under the runtime lock
+        witness (the autouse fixture fails the test on ANY executed
+        acquisition-order inversion in the failover/unwind paths the
+        faults force). All convergence invariants must hold — every
+        eval terminal, exact placement, usage planes bit-identical to
+        a from-scratch rebuild on every replica, dropped nodes down
+        and drained, stream gap-free or explicitly lost. One rep: the
+        cell is itself a three-server fault storm; its coverage comes
+        from the schedules, not repetition."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench"))
+        import trace_report
+
+        suite = trace_report.run_chaos_suite(deadline_s=90.0,
+                                             settle_s=60.0)
+        assert suite["converged_ok"], suite["violations"]
+        assert suite["faults_fired"] >= 3
+        for name, r in suite["schedules"].items():
+            assert r["converged_ok"], (name, r["violations"])
+            assert r["allocs_placed"] == r["allocs_wanted"], (name, r)
+        # the schedules did what they say on the tin
+        assert suite["schedules"]["leader-kill-mid-wave"][
+            "faults"]["raft.leader.stepdown"]["fires"] == 1
+        assert suite["schedules"]["crash-and-drop"]["nodes_down"] == 3
+        assert suite["schedules"]["plan-commit-raft-failure"][
+            "faults"]["plan.commit.raft"]["fires"] >= 1
+
+
 class TestMembershipContention:
     def test_reconcile_queue_preserves_event_order(self):
         """The satellite fix itself: MEMBER_FAILED/MEMBER_ALIVE flap

@@ -14,7 +14,7 @@ extends it to the whole observable surface:
   versa (a scraper alerting on a renamed series is an outage, not a
   diff).
 - **bench keys**: every ``trace_*`` / ``contention_*`` / ``fleet_*``
-  keyword bench.py emits into BENCH_*.json must appear in the
+  / ``chaos_*`` keyword bench.py emits into BENCH_*.json must appear in the
   "## Bench emission keys" fenced list, and vice versa (trend lines
   silently going dark is how perf regressions hide).
 
@@ -48,8 +48,9 @@ _SPAN_NAME = re.compile(r"[a-z][a-z0-9_]*\.[a-z0-9_.{}]+")
 #: "nomad_tpu_warmup.json" / "nomad_tpu_xla" out of the contract
 _PROM_NAME = re.compile(r"\bnomad_tpu_[a-z0-9]+(?:_[a-z0-9]+)+\b")
 #: fleet_* joined in ISSUE 11 (the serving-plane fleet cell's trend
-#: lines are contract like every other bench emission)
-_BENCH_KEY = re.compile(r"^(?:trace|contention|fleet)_[a-z0-9_]+$")
+#: lines are contract like every other bench emission); chaos_* in
+#: ISSUE 12 (the chaos cell's convergence verdict + per-schedule stats)
+_BENCH_KEY = re.compile(r"^(?:trace|contention|fleet|chaos)_[a-z0-9_]+$")
 #: bench kwargs that are not emission keys
 _BENCH_KEY_EXCLUDE = {"trace_id"}
 
