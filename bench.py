@@ -1364,6 +1364,47 @@ def main() -> None:
         print("bench budget: skipping chaos cell "
               f"({budget.remaining():.0f}s left)", file=sys.stderr)
 
+    # ISSUE 13: the restart cell — kill→restart recovery through the
+    # durability plane (torn-write kill + clean leader kill against a
+    # data_dir-backed 3-node cluster) plus the seeded torn-tail fuzz.
+    # restart_converged_ok is the acceptance line: 1 means every
+    # recovery invariant held (no acked write lost, usage bit-identity
+    # on restarted replicas, no double-vote, explicit stream resume)
+    # AND no fuzz seed ever silently diverged. Reproduce with
+    # trace_report.run_restart_chaos(seed=restart_seed)
+    # (docs/ROBUSTNESS.md "Durability").
+    if budget.remaining() > 180:
+        try:
+            _phase("restart cell")
+            sys.path.insert(0, os.path.join(REPO, "bench"))
+            import trace_report
+
+            cell = trace_report.run_restart_chaos(
+                deadline_s=min(budget.share(0.3), 120.0),
+                settle_s=min(budget.share(0.15), 60.0))
+            fuzz = trace_report.run_torn_tail_fuzz(seeds=200)
+            em.update(
+                restart_seed=cell["seed"],
+                restart_converged_ok=(
+                    1 if cell["converged_ok"]
+                    and fuzz["silent_divergences"] == 0 else 0),
+                restart_recovery_ms=cell["recovery_ms_max"],
+                restart_replayed_entries=cell["replayed_entries"],
+                restart_fsync_p99_ms=cell["fsync_p99_ms"],
+                restart_violations=cell["violations"][:8],
+                restart_torn_fuzz_seeds=fuzz["seeds"],
+                restart_torn_fuzz_silent_divergences=fuzz[
+                    "silent_divergences"],
+            )
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: restart cell failed ({e})",
+                  file=sys.stderr)
+    else:
+        print("bench budget: skipping restart cell "
+              f"({budget.remaining():.0f}s left)", file=sys.stderr)
+
     replay = None
     if planes is not None and budget.remaining() <= 60:
         print("bench budget: skipping C2M replay headline "

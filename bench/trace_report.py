@@ -1005,6 +1005,32 @@ def run_fleet_burst(n_clients: int = 10_000, n_nodes: int = 400,
 #: reproduce a chaos failure from its seed")
 CHAOS_SEED = 12012
 
+
+def _cluster_leader(servers):
+    """The one server that is BOTH raft leader and has established
+    server-side leadership (shared by the chaos + restart cells; the
+    ``servers`` list may be mutated by restarts — read it live)."""
+    for s in servers:
+        if s.raft is not None and s.raft.is_leader() and s.is_leader():
+            return s
+    return None
+
+
+def _call_on_leader(servers, fn, timeout=15.0):
+    """Retry ``fn(leader)`` against whichever server currently leads
+    until it succeeds (failovers/restarts mid-call are the point)."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        s = _cluster_leader(servers)
+        if s is not None:
+            try:
+                return fn(s)
+            except Exception as e:              # noqa: BLE001
+                last = e
+        time.sleep(0.05)
+    raise RuntimeError(f"no leader accepted the call: {last!r}")
+
 #: the standing chaos schedules (ISSUE 12). Each is a bounded,
 #: deterministic fault program over the wired points
 #: (nomad_tpu/utils/faultpoints.py) plus an optional set of nodes
@@ -1131,23 +1157,10 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
     plan_rejections.reset_stats()
 
     def cur_leader():
-        for s in servers:
-            if s.raft is not None and s.raft.is_leader() and s.is_leader():
-                return s
-        return None
+        return _cluster_leader(servers)
 
     def with_leader(fn, timeout=15.0):
-        deadline = time.time() + timeout
-        last = None
-        while time.time() < deadline:
-            s = cur_leader()
-            if s is not None:
-                try:
-                    return fn(s)
-                except Exception as e:          # noqa: BLE001
-                    last = e
-            time.sleep(0.05)
-        raise RuntimeError(f"no leader accepted the call: {last!r}")
+        return _call_on_leader(servers, fn, timeout)
 
     # event-stream monitor state (the cross-failover resume invariant)
     mon = {"alloc_ids": set(), "lost_markers": 0, "last_index": 0,
@@ -1405,6 +1418,581 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
                 s.shutdown()
             except Exception:                   # noqa: BLE001
                 pass
+
+
+#: the restart cell's pinned seed (ISSUE 13): re-arming the same
+#: (faults, seed) pair replays the same torn-write decision sequence
+RESTART_SEED = 13013
+
+
+def _watch_votes(server, votes: list) -> None:
+    """Record every granted vote (voter, term, candidate) on a server
+    — including across its restarts (re-wrap the new instance). The
+    restart cell's transcript check: a voter that grants two DIFFERENT
+    candidates in one term double-voted, the raft safety violation a
+    volatile term/vote store allows after a crash."""
+    node = server.raft
+    orig_rv = node._on_request_vote
+
+    def wrapped_rv(req):
+        resp = orig_rv(req)
+        if resp.get("granted"):
+            votes.append((node.id, resp["term"], req["candidate"]))
+        return resp
+
+    node._on_request_vote = wrapped_rv
+    orig_se = node._start_election
+
+    def wrapped_se():
+        orig_se()
+        with node._lock:
+            if node.voted_for == node.id:
+                votes.append((node.id, node.current_term, node.id))
+
+    node._start_election = wrapped_se
+
+
+def _double_votes(votes: list) -> list:
+    """[(voter, term, {candidates})] for every (voter, term) that
+    granted more than one distinct candidate."""
+    by_key: Dict = {}
+    for voter, term, candidate in votes:
+        by_key.setdefault((voter, term), set()).add(candidate)
+    return [(v, t, sorted(c)) for (v, t), c in sorted(by_key.items())
+            if len(c) > 1]
+
+
+def run_restart_chaos(seed: int = RESTART_SEED,
+                      n_nodes: int = 36, n_jobs: int = 12,
+                      allocs_per_job: int = 3, batch_size: int = 8,
+                      warmup_jobs: int = 4,
+                      heartbeat_ttl: float = 3.0,
+                      deadline_s: float = 120.0,
+                      settle_s: float = 60.0,
+                      torn_kill: bool = True,
+                      fsync_policy: str = "batch") -> Dict:
+    """ISSUE 13: the kill→restart recovery cell — PR 12's failure
+    story completed down to the disk.
+
+    A steady eval burst runs against a live 3-node raft cluster whose
+    servers persist under per-server data dirs (raft/wal.py). Mid-
+    burst, two servers are killed DEAD (in-memory state discarded
+    wholesale; only the durability plane survives) and restarted from
+    their data dirs into the live cluster:
+
+    1. a TORN-WRITE kill: the ``wal.frame.torn`` fault point tears a
+       frame on whichever server journals next (half the frame reaches
+       the file — exactly a crash mid-write), the server fail-stops
+       and is killed; recovery must truncate the torn tail cleanly;
+    2. a clean kill of the then-current leader (or a follower, when
+       the torn victim already was the leader) — failover + rejoin.
+
+    Post-quiesce invariants (docs/ROBUSTNESS.md "Durability"):
+
+    1. no client-acked committed write lost: every job_register that
+       RETURNED is fully placed on the converged cluster;
+    2. every replica's UsagePlanes — restarted ones included — are
+       bit-identical to a from-scratch rebuild (usage_rebuild_diff);
+    3. no double-vote in any term, transcript-checked across every
+       server lifetime (the stable-store safety property);
+    4. stream resume across restarts is explicit: the monitor saw
+       every burst alloc event or LostEvents markers — never a silent
+       gap, never a replayed duplicate;
+    5. evals terminal, exact placement, replicas index-converged (the
+       PR 12 invariants, inherited).
+
+    Returns stats + a ``converged_ok`` verdict; never raises on
+    invariant failure (bench cells report).
+    """
+    import random as _random
+    import shutil
+    import tempfile
+
+    from nomad_tpu import mock
+    from nomad_tpu.raft.wal import wal_stats
+    from nomad_tpu.server.server import ServerConfig
+    from nomad_tpu.server.stream import TOPIC_LOST
+    from nomad_tpu.server.testing import (
+        hard_kill,
+        make_cluster,
+        restart_server,
+        wait_for_leader,
+    )
+    from nomad_tpu.state.usage import usage_rebuild_diff
+    from nomad_tpu.structs import consts
+    from nomad_tpu.telemetry.histogram import WAL_FSYNC, histograms
+    from nomad_tpu.utils import faultpoints
+
+    rng = _random.Random(seed)
+    base_dir = tempfile.mkdtemp(prefix="nomad-tpu-restart-")
+    data_dirs = [os.path.join(base_dir, f"srv-{i}") for i in range(3)]
+    servers, registry = make_cluster(3, ServerConfig(
+        num_workers=1,
+        worker_batch_size=batch_size,
+        heartbeat_ttl=heartbeat_ttl,
+        nack_timeout=1.5,
+        eval_delivery_limit=4,
+        failed_eval_follow_up_wait=0.4,
+        plan_rejection_threshold=500,
+        raft_fsync_policy=fsync_policy,
+    ), data_dirs=data_dirs)
+    for s in servers:
+        s.eval_broker.initial_nack_delay = 0.05
+        s.eval_broker.subsequent_nack_delay = 0.25
+    stop = threading.Event()
+    threads: list = []
+    violations: list = []
+    votes: list = []
+    recoveries: list = []          # (label, seconds, replayed_entries)
+    faultpoints.reset()
+    for s in servers:
+        _watch_votes(s, votes)
+    wal0 = wal_stats.snapshot()
+
+    def cur_leader():
+        return _cluster_leader(servers)
+
+    def with_leader(fn, timeout=20.0):
+        return _call_on_leader(servers, fn, timeout)
+
+    mon = {"alloc_ids": set(), "lost_markers": 0, "last_index": 0,
+           "events": 0, "resumes": 0, "duplicates": 0, "seen": set()}
+
+    def monitor() -> None:
+        """Follow the leader's ring; on failover OR restart, resume on
+        the current leader with from_index=<last seen>. The resume
+        contract under restarts: replay from the fresh ring is
+        duplicate-free (the from_index filter), and anything the fresh
+        ring cannot replay arrives as an explicit LostEvents marker
+        (the boot-index trimmed-history floor) — never silent."""
+        sub = None
+        sub_broker = None
+        while not stop.is_set():
+            s = cur_leader()
+            if s is None:
+                time.sleep(0.05)
+                continue
+            if sub is None or sub_broker is not s.event_broker:
+                if sub is not None:
+                    sub.close()
+                    mon["resumes"] += 1
+                sub = s.event_broker.subscribe(
+                    from_index=mon["last_index"])
+                sub_broker = s.event_broker
+            for ev in sub.next_events(timeout=0.2, max_events=256):
+                if ev.topic == TOPIC_LOST:
+                    mon["lost_markers"] += 1
+                    continue
+                mon["events"] += 1
+                key = (ev.index, ev.topic, ev.type, ev.key)
+                if key in mon["seen"]:
+                    mon["duplicates"] += 1
+                mon["seen"].add(key)
+                if ev.index > mon["last_index"]:
+                    mon["last_index"] = ev.index
+                if ev.topic == "Allocation":
+                    mon["alloc_ids"].add(ev.key)
+        if sub is not None:
+            sub.close()
+
+    try:
+        wait_for_leader(servers, timeout=15.0)
+        node_ids = []
+        for _ in range(n_nodes):
+            node = mock.node()
+            node_ids.append(node.id)
+            with_leader(lambda s, n=node: s.node_register(n))
+
+        th = threading.Thread(target=monitor, daemon=True,
+                              name="restart-monitor")
+        th.start()
+        threads.append(th)
+
+        def heartbeat_storm(k: int, nthreads: int) -> None:
+            ids = node_ids[k::nthreads]
+            i = 0
+            while not stop.is_set() and ids:
+                s = cur_leader()
+                if s is not None:
+                    try:
+                        s.node_heartbeat(ids[i % len(ids)], "ready")
+                    except Exception:           # noqa: BLE001
+                        pass                    # restarts drop some
+                i += 1
+                time.sleep(max(heartbeat_ttl / 4.0 / max(len(ids), 1),
+                               0.002))
+
+        for k in range(2):
+            th = threading.Thread(target=heartbeat_storm, args=(k, 2),
+                                  daemon=True, name=f"restart-hb-{k}")
+            th.start()
+            threads.append(th)
+
+        acked_jobs: list = []
+        unacked = 0
+
+        def submit(count) -> None:
+            nonlocal unacked
+            for _ in range(count):
+                job = mock.simple_job()
+                job.task_groups[0].count = allocs_per_job
+                try:
+                    with_leader(lambda s, j=job: s.job_register(j))
+                except RuntimeError:
+                    unacked += 1    # never acked: allowed to be lost
+                    continue
+                acked_jobs.append(job)
+
+        def placed_count(jobs):
+            s = cur_leader() or servers[0]
+            snap = s.state.snapshot()
+            return sum(
+                1
+                for j in jobs
+                for a in snap.allocs_by_job(j.namespace, j.id)
+                if not a.terminal_status())
+
+        def wait_fully_placed(jobs, deadline) -> int:
+            want = len(jobs) * allocs_per_job
+            placed = 0
+            while time.time() < deadline:
+                placed = placed_count(jobs)
+                if placed >= want:
+                    return placed
+                time.sleep(0.1)
+            return placed
+
+        def kill_and_restart(victim, label: str):
+            """Kill one server dead, restart it from its data dir,
+            and wait until it has caught the survivors up."""
+            idx = servers.index(victim)
+            dead = servers[idx]
+            hard_kill(dead)
+            t0 = time.perf_counter()
+            fresh = restart_server(dead, registry)
+            servers[idx] = fresh
+            _watch_votes(fresh, votes)
+            # caught up = the fresh replica reaches the highest
+            # surviving committed index from the moment of restart
+            target = max(s.state.latest_index() for s in servers
+                         if s is not fresh)
+            catch_deadline = time.time() + 30.0
+            while time.time() < catch_deadline:
+                if fresh.state.latest_index() >= target:
+                    break
+                time.sleep(0.05)
+            recoveries.append((label,
+                               time.perf_counter() - t0,
+                               fresh.raft.replayed_entries))
+            return fresh
+
+        # warmup OUTSIDE the kill window: compile the wave buckets
+        submit(warmup_jobs)
+        wait_fully_placed(acked_jobs,
+                          time.time() + min(deadline_s / 2, 90.0))
+
+        t0 = time.perf_counter()
+        submit(max(n_jobs // 3, 1))
+        wait_fully_placed(acked_jobs, time.time() + deadline_s / 3)
+
+        # ---- kill 1: the torn-write crash ---------------------------
+        if torn_kill:
+            # the next journaled frame (on whichever server writes
+            # first) is torn mid-write and the WAL fail-stops; the
+            # victim is killed and must recover by truncating the tail.
+            # Submission runs on a side thread: a torn LEADER keeps
+            # erroring until the kill lands, and the detection loop
+            # must not sit behind those retries.
+            faultpoints.arm(
+                {"wal.frame.torn": {"kind": "error", "nth": 1}},
+                seed=seed)
+            sub_th = threading.Thread(
+                target=submit, args=(max(n_jobs // 3, 1),),
+                daemon=True, name="restart-submit")
+            sub_th.start()
+            fire_deadline = time.time() + 20.0
+            victim = None
+            while time.time() < fire_deadline and victim is None:
+                for s in servers:
+                    if getattr(s.raft.log, "wal_failed", False):
+                        victim = s
+                        break
+                time.sleep(0.02)
+            faultpoints.disarm()
+            if victim is None:
+                violations.append(
+                    "torn-write fault armed but no WAL fail-stopped")
+            else:
+                kill_and_restart(victim, "torn-kill")
+            sub_th.join(timeout=40.0)
+        else:
+            submit(max(n_jobs // 3, 1))
+
+        wait_fully_placed(acked_jobs, time.time() + deadline_s / 3)
+
+        # ---- kill 2: the (new) leader, cleanly ----------------------
+        leader = cur_leader()
+        if leader is None:
+            leader = servers[rng.randrange(3)]
+        submit(n_jobs - 2 * max(n_jobs // 3, 1))
+        kill_and_restart(leader, "leader-kill")
+        wall = time.perf_counter() - t0
+
+        # ---- settle + invariants ------------------------------------
+        placed = wait_fully_placed(acked_jobs, time.time() + deadline_s)
+
+        def quiesced() -> bool:
+            s = cur_leader()
+            if s is None:
+                return False
+            snap = s.state.snapshot()
+            for ev in snap.evals_iter():
+                if ev.status == consts.EVAL_STATUS_PENDING:
+                    return False
+            b = s.eval_broker.stats()
+            return (b["total_ready"] == 0 and b["total_unacked"] == 0
+                    and b["total_pending"] == 0
+                    and b["total_waiting"] == 0)
+
+        settle_deadline = time.time() + settle_s
+        quiet = False
+        while time.time() < settle_deadline:
+            if quiesced():
+                time.sleep(0.5)
+                if quiesced():
+                    quiet = True
+                    break
+            time.sleep(0.25)
+        if not quiet:
+            violations.append("pipeline did not quiesce after settle")
+        placed = wait_fully_placed(acked_jobs, time.time() + 5.0)
+
+        leader = wait_for_leader(servers, timeout=15.0)
+        idx = leader.state.latest_index()
+        catch_deadline = time.time() + 15.0
+        while time.time() < catch_deadline:
+            if all(s.state.latest_index() >= idx for s in servers):
+                break
+            time.sleep(0.05)
+        else:
+            violations.append(
+                "replica lag: " + ", ".join(
+                    f"{s.config.name}={s.state.latest_index()}/{idx}"
+                    for s in servers))
+
+        snap = leader.state.snapshot()
+        # 1. no acked write lost + exact placement + terminal evals
+        for ev in snap.evals_iter():
+            if ev.status in (consts.EVAL_STATUS_PENDING,
+                             consts.EVAL_STATUS_BLOCKED):
+                violations.append(
+                    f"eval {ev.id[:8]} stuck {ev.status} "
+                    f"(trigger {ev.triggered_by})")
+        burst_alloc_ids = set()
+        for j in acked_jobs:
+            rows = snap.allocs_by_job(j.namespace, j.id)
+            burst_alloc_ids |= {a.id for a in rows}
+            if snap.job_by_id(j.namespace, j.id) is None:
+                violations.append(
+                    f"ACKED job {j.id[:8]} lost across restart")
+                continue
+            live = [a for a in rows if not a.terminal_status()]
+            if len(live) != allocs_per_job:
+                violations.append(
+                    f"job {j.id[:8]}: {len(live)} live allocs, "
+                    f"want {allocs_per_job}")
+            names = [a.name for a in live]
+            if len(set(names)) != len(names):
+                violations.append(f"job {j.id[:8]}: duplicate live "
+                                  f"slot names {sorted(names)}")
+        # 2. usage bit-identity on every replica (restarted included)
+        for s in servers:
+            diffs = usage_rebuild_diff(s.state)
+            for d in diffs[:5]:
+                violations.append(f"{s.config.name} usage drift: {d}")
+        # 3. the double-vote transcript
+        for voter, term, candidates in _double_votes(votes):
+            violations.append(
+                f"DOUBLE VOTE: {voter} granted {candidates} in term "
+                f"{term}")
+        # 4. stream explicit across restarts
+        stop.set()
+        for th in threads:
+            th.join(timeout=3.0)
+        missing = burst_alloc_ids - mon["alloc_ids"]
+        if missing and mon["lost_markers"] == 0:
+            violations.append(
+                f"stream silently missed {len(missing)} alloc events "
+                "(no LostEvents marker across restarts)")
+        if mon["duplicates"]:
+            violations.append(
+                f"stream replayed {mon['duplicates']} duplicate "
+                "events across restart resumes")
+        # the torn kill must actually have exercised torn-tail recovery
+        wal1 = wal_stats.snapshot()
+        torn = wal1["torn_truncations"] - wal0["torn_truncations"]
+        if torn_kill and torn < 1 and not any(
+                "torn-write" in v for v in violations):
+            violations.append(
+                "torn kill ran but recovery truncated no torn tail")
+
+        fsync_h = histograms.peek(WAL_FSYNC)
+        fsync = fsync_h.snapshot() if fsync_h is not None else {}
+        return {
+            "seed": seed,
+            "converged_ok": not violations,
+            "violations": violations,
+            "wall_s": round(wall, 3),
+            "n_evals": len(acked_jobs),
+            "unacked_submits": unacked,
+            "allocs_placed": placed,
+            "allocs_wanted": len(acked_jobs) * allocs_per_job,
+            "restarts": len(recoveries),
+            "recovery_ms": {
+                label: round(secs * 1e3, 1)
+                for label, secs, _ in recoveries},
+            "recovery_ms_max": round(
+                max((secs for _, secs, _ in recoveries), default=0.0)
+                * 1e3, 1),
+            "replayed_entries": sum(r for _, _, r in recoveries),
+            "torn_truncations": torn,
+            "fsyncs": wal1["fsyncs"] - wal0["fsyncs"],
+            "fsync_p99_ms": fsync.get("p99_ms", 0.0),
+            "votes_recorded": len(votes),
+            "stream_events": mon["events"],
+            "stream_lost_markers": mon["lost_markers"],
+            "stream_resumes": mon["resumes"],
+            "stream_missed_alloc_events": len(missing),
+        }
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=3.0)
+        faultpoints.reset()
+        registry.heal()
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:                   # noqa: BLE001
+                pass
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+
+def run_torn_tail_fuzz(seeds: int = 200, entries: int = 120,
+                       segment_bytes: int = 2048) -> Dict:
+    """Seeded torn-tail fuzz over a recorded WAL (ISSUE 13): random
+    tail truncations and byte flips, asserting recovery either (a)
+    yields a log equal to SOME clean prefix of the recorded record
+    stream, or (b) raises WalCorruptionError — loudly. A recovery that
+    succeeds with anything else is a SILENT DIVERGENCE, the one
+    unacceptable outcome (``silent_divergences`` must stay 0).
+    """
+    import random as _random
+    import shutil
+    import tempfile
+
+    from nomad_tpu.raft.log import LogEntry
+    from nomad_tpu.raft.wal import (
+        DurableLogStore,
+        WalCorruptionError,
+        WriteAheadLog,
+        replay_records,
+    )
+
+    base = tempfile.mkdtemp(prefix="nomad-tpu-tornfuzz-")
+    ref_dir = os.path.join(base, "ref")
+    try:
+        # record a reference WAL with heterogeneous records spanning
+        # several segments (appends + a conflict truncation + a
+        # compaction so every record kind is in the stream)
+        ref = DurableLogStore(ref_dir, fsync_policy="batch",
+                              segment_max_bytes=segment_bytes)
+        index = 0
+        records = []     # the logical record stream, in order
+        for i in range(entries):
+            index += 1
+            e = LogEntry(index=index, term=1 + i // 50, kind="command",
+                         data=("op", {"i": i, "pad": "x" * (i % 17)}))
+            ref.append(e)
+            records.append(("entry", e))
+            if i == entries // 2:
+                index -= 2
+                ref.truncate_from(index + 1)
+                records.append(("truncate", index + 1))
+            if i == (2 * entries) // 3:
+                ref.compact_to(index - 20, e.term)
+                records.append(("compact", index - 20, e.term))
+        ref.sync()
+        ref.close()
+
+        # the divergence oracle: every valid PREFIX of the on-disk
+        # record stream, reconstructed through the same index-keyed
+        # replay the recovery path uses (wal.replay_records). NOTE the
+        # prefixes come from what is actually on disk — compaction
+        # already deleted superseded segments — not the logical list.
+        replay_wal = WriteAheadLog(ref_dir)
+        disk_records = replay_wal.replay()
+        replay_wal.close()
+
+        def fingerprint(base_index, base_term, entry_list):
+            return (base_index, base_term,
+                    tuple((e.index, e.term, e.kind, repr(e.data))
+                          for e in entry_list))
+
+        valid_prefixes = {
+            fingerprint(*replay_records(disk_records[:k]))
+            for k in range(len(disk_records) + 1)}
+
+        def store_fingerprint(store):
+            return fingerprint(store.base_index(), store._base_term,
+                               store._entries)
+
+        outcomes = {"clean_prefix": 0, "loud_corruption": 0,
+                    "silent_divergences": 0}
+        diverged: list = []
+        for seed in range(seeds):
+            rng = _random.Random(seed)
+            case = os.path.join(base, f"case-{seed}")
+            shutil.copytree(ref_dir, case)
+            segs = sorted(f for f in os.listdir(case)
+                          if f.endswith(".seg"))
+            mode = rng.choice(("cut", "flip", "cutflip"))
+            if mode in ("cut", "cutflip"):
+                tail = os.path.join(case, segs[-1])
+                size = os.path.getsize(tail)
+                with open(tail, "r+b") as f:
+                    f.truncate(max(size - rng.randrange(1, 61), 0))
+            if mode in ("flip", "cutflip"):
+                target = os.path.join(case, rng.choice(segs))
+                size = os.path.getsize(target)
+                if size:
+                    with open(target, "r+b") as f:
+                        for _ in range(rng.randrange(1, 5)):
+                            pos = rng.randrange(size)
+                            f.seek(pos)
+                            byte = f.read(1)
+                            f.seek(pos)
+                            f.write(bytes([byte[0] ^ (1 << rng.randrange(8))]))
+            try:
+                recovered = DurableLogStore(case)
+            except WalCorruptionError:
+                outcomes["loud_corruption"] += 1
+            else:
+                recovered.close()
+                if store_fingerprint(recovered) in valid_prefixes:
+                    outcomes["clean_prefix"] += 1
+                else:
+                    outcomes["silent_divergences"] += 1
+                    if len(diverged) < 5:
+                        diverged.append((seed, mode))
+            shutil.rmtree(case, ignore_errors=True)
+        return {
+            "seeds": seeds,
+            "diverged_cases": diverged,
+            **outcomes,
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
 
 
 def run_chaos_suite(seed: int = CHAOS_SEED, **kw) -> Dict:

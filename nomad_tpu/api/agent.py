@@ -123,6 +123,12 @@ class AgentConfig:
     coalesce_adaptive: bool = True
     coalesce_window_min_ms: float = 1.0
     coalesce_window_max_ms: float = 50.0
+    # crash-safe raft durability (raft/wal.py, ISSUE 13): the agent's
+    # state dir (reference top-level `data_dir`); empty = in-memory
+    # raft. raft_fsync_policy: "always" (per-record) or "batch"
+    # (group-fsync at ack boundaries; the default)
+    data_dir: str = ""
+    raft_fsync_policy: str = "batch"
 
     @classmethod
     def dev(cls, **overrides) -> "AgentConfig":
@@ -176,6 +182,8 @@ class Agent:
             coalesce_adaptive=self.config.coalesce_adaptive,
             coalesce_window_min_ms=self.config.coalesce_window_min_ms,
             coalesce_window_max_ms=self.config.coalesce_window_max_ms,
+            data_dir=self.config.data_dir,
+            raft_fsync_policy=self.config.raft_fsync_policy,
         )
         self.server = Server(cfg)
         self.raft_transport = None

@@ -92,6 +92,11 @@ def _apply_body(cfg, body: Body):
     # a top-level attribute)
     if "encrypt" in a:
         cfg.encrypt = str(a["encrypt"])
+    # agent state dir (reference top-level `data_dir`): turns on the
+    # crash-safe raft durability plane — term/vote, WAL, snapshots
+    # persist under <data_dir>/raft (docs/ROBUSTNESS.md "Durability")
+    if "data_dir" in a:
+        cfg.data_dir = str(a["data_dir"])
 
     ports = body.first_block("ports")
     if ports is not None and "http" in ports[1].attrs:
@@ -128,6 +133,10 @@ def _apply_body(cfg, body: Body):
             cfg.coalesce_window_min_ms = float(sa["coalesce_window_min_ms"])
         if "coalesce_window_max_ms" in sa:
             cfg.coalesce_window_max_ms = float(sa["coalesce_window_max_ms"])
+        # WAL fsync policy ("always" per record / "batch" group-fsync
+        # at ack boundaries; raft/wal.py)
+        if "raft_fsync_policy" in sa:
+            cfg.raft_fsync_policy = str(sa["raft_fsync_policy"])
         # gossip membership seeds ("host:port"; DNS names expand to
         # every A record — join-by-DNS)
         if "server_join" in sa and isinstance(sa["server_join"], list):

@@ -53,6 +53,12 @@ class LogStore:
         self._base_index = payload["base_index"]
         self._base_term = payload["base_term"]
 
+    def sync(self) -> None:
+        """Durability boundary: a no-op for the in-memory store.
+        DurableLogStore (raft/wal.py) overrides it with the WAL's
+        group fsync; raft/node.py calls it before any ack that
+        promises the entries survive a crash."""
+
     def persist(self) -> None:
         if not self._path:
             return

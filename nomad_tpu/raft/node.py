@@ -13,10 +13,11 @@ lagging followers).
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from nomad_tpu.raft.log import LOG_COMMAND, LOG_NOOP, LogEntry, LogStore
 from nomad_tpu.utils.faultpoints import FaultError, fault
@@ -89,6 +90,8 @@ class RaftNode:
         on_leader: Optional[Callable[[], None]] = None,
         on_follower: Optional[Callable[[], None]] = None,
         log_store: Optional[LogStore] = None,
+        data_dir: Optional[str] = None,
+        fsync_policy: str = "batch",
     ) -> None:
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
@@ -105,8 +108,62 @@ class RaftNode:
         self.state = FOLLOWER
         self.current_term = 0
         self.voted_for: Optional[str] = None
+        # crash-safe durability plane (raft/wal.py, ISSUE 13). With a
+        # data_dir this node recovers its HARD state from disk in
+        # strict order: stable store (term/vote — a node that forgets
+        # its vote can vote twice in one term, a raft SAFETY
+        # violation) -> newest valid snapshot -> restore_fn -> WAL
+        # replay into the log. Committed replayed entries re-apply
+        # into the FSM through the normal apply loop once the commit
+        # index advances (leader election / AppendEntries).
+        self._stable = None
+        self._snapshots = None
+        self._durable = bool(data_dir)
+        self.recovered_snapshot_index = 0
+        self.replayed_entries = 0
+        if data_dir:
+            from nomad_tpu.raft import wal as _wal
+
+            os.makedirs(data_dir, exist_ok=True)
+            self._stable = _wal.StableStore(data_dir)
+            self.current_term, self.voted_for = self._stable.load()
+            self._snapshots = _wal.SnapshotStore(data_dir, owner=node_id)
+            snap = self._snapshots.load_newest()
+            if snap is not None and self.restore_fn is not None:
+                self.recovered_snapshot_index = snap[0]
+                self.restore_fn(snap[2])
+            store = _wal.DurableLogStore(
+                os.path.join(data_dir, "wal"), fsync_policy=fsync_policy)
+            self.replayed_entries = store.replayed_entries
+            if snap is not None and store.base_index() < snap[0]:
+                # crash between snapshot write and the compact record:
+                # the snapshot is authoritative for everything <= its
+                # index, so compact the replayed log up to it
+                store.compact_to(snap[0], snap[1])
+            if (snap is None or snap[0] < store.base_index()) \
+                    and store.base_index() > 0:
+                # no snapshot at all, OR only an OLDER fallback (the
+                # newest failed its CRC): either way the span up to
+                # the compacted base cannot be reconstructed
+                have = "no valid snapshot" if snap is None else \
+                    f"newest valid snapshot is only {snap[0]}"
+                raise _wal.WalCorruptionError(
+                    f"{node_id}: log compacted to {store.base_index()} "
+                    f"but {have} — the state below the base is "
+                    "unrecoverable (refusing to boot with silent "
+                    "data loss)")
+            log_store = store
+            _wal.wal_stats.note_recovery()
+            if self.replayed_entries or snap is not None:
+                LOG.info(
+                    "%s: recovered from %s (term=%d vote=%s "
+                    "snapshot=%d wal_entries=%d)", node_id, data_dir,
+                    self.current_term, self.voted_for,
+                    self.recovered_snapshot_index, self.replayed_entries)
         self.log = log_store or LogStore()
-        self.commit_index = 0
+        # everything at or below the base was snapshotted from applied
+        # state: committed by definition
+        self.commit_index = self.log.base_index()
         self.last_applied = self.log.base_index()
         self.leader_id: Optional[str] = None
         self._last_contact = time.monotonic()
@@ -168,6 +225,39 @@ class RaftNode:
             t.join(timeout=2)
         self._threads.clear()
         self.transport.close()
+        close = getattr(self.log, "close", None)
+        if close is not None:
+            close()
+        if self._durable:
+            from nomad_tpu.raft.wal import wal_stats
+
+            wal_stats.note_cache(self.id, 0)
+
+    # --- durability helpers (raft/wal.py, ISSUE 13) ---------------------
+
+    def _persist_hard_state_locked(self) -> None:
+        """Persist (current_term, voted_for). MUST complete before any
+        RPC response that grants a vote or adopts the term leaves this
+        node — a crash after responding but before persisting would
+        let the restarted node vote again in the same term. Called
+        under self._lock; the stable store's writes are monotone so a
+        racing later persist can never be regressed by this one."""
+        if self._stable is not None:
+            self._stable.put(self.current_term, self.voted_for)
+
+    def _sync_log(self) -> None:
+        """The ack durability boundary: group-fsync every journaled
+        frame (no-op for the in-memory store). Called OUTSIDE
+        self._lock — an fsync must never stretch the RPC/apply
+        critical sections."""
+        if self._durable:
+            self.log.sync()
+
+    def _note_snapshot_cache_locked(self) -> None:
+        from nomad_tpu.raft.wal import wal_stats
+
+        cache = self._snapshot_cache
+        wal_stats.note_cache(self.id, len(cache[2]) if cache else 0)
 
     def is_leader(self) -> bool:
         with self._lock:
@@ -197,10 +287,14 @@ class RaftNode:
             self.log.append(entry)
             fut = _ApplyFuture(entry.index)
             self._futures[entry.index] = fut
-            self.match_index[self.id] = entry.index
-            if not self.peers:
-                self._advance_commit_locked()
+        # replicators ship the in-memory entry while the leader's own
+        # fsync runs (disk overlaps network — followers fsync before
+        # acking anyway); the leader's own log vote counts toward
+        # commit only once the entry is DURABLE, so _count_self_match
+        # stays behind the sync
         self._wake_replicators()
+        self._sync_log()
+        self._count_self_match(entry)
         return fut.wait(timeout)
 
     def barrier(self, timeout: float = 5.0) -> None:
@@ -217,11 +311,22 @@ class RaftNode:
             self.log.append(entry)
             fut = _ApplyFuture(entry.index)
             self._futures[entry.index] = fut
-            self.match_index[self.id] = entry.index
+        self._wake_replicators()
+        self._sync_log()
+        self._count_self_match(entry)
+        fut.wait(timeout)
+
+    def _count_self_match(self, entry: LogEntry) -> None:
+        """Advance the leader's own match index for a just-synced
+        entry. Concurrent appliers can sync out of order (the group
+        fsync covers both), so the match only ever moves forward."""
+        with self._lock:
+            if self.state != LEADER or self.current_term != entry.term:
+                return
+            if entry.index > self.match_index.get(self.id, 0):
+                self.match_index[self.id] = entry.index
             if not self.peers:
                 self._advance_commit_locked()
-        self._wake_replicators()
-        fut.wait(timeout)
 
     # --- ticker: elections + heartbeats ---------------------------------
 
@@ -232,8 +337,26 @@ class RaftNode:
 
     def _run_ticker(self) -> None:
         timeout = self._election_timeout()
+        wal_halted = False
         while not self._shutdown.is_set():
             time.sleep(self.config.heartbeat_interval / 2)
+            if self._durable and getattr(self.log, "wal_failed", False):
+                # fail-stop demotion (the reference panics on a boltdb
+                # write error and failover follows the process death;
+                # in-process we demote instead): a node that cannot
+                # persist must stop LEADING — its heartbeats would
+                # otherwise suppress elections forever while every
+                # write fails — and must never campaign. It keeps
+                # answering reads/votes; an operator (or the restart
+                # harness) kills + recovers it.
+                if not wal_halted:
+                    wal_halted = True
+                    LOG.error(
+                        "%s: WAL failed — halting raft leadership/"
+                        "campaigns (kill + restart to recover)", self.id)
+                if self.is_leader():
+                    self.step_down()
+                continue
             with self._lock:
                 state = self.state
                 elapsed = time.monotonic() - self._last_contact
@@ -269,6 +392,10 @@ class RaftNode:
             last_index = self.log.last_index()
             last_term = self.log.last_term()
             peers = list(self.peers)
+            # the self-vote is a vote: durable before any RequestVote
+            # RPC leaves (a restarted candidate must not re-vote
+            # differently in this term)
+            self._persist_hard_state_locked()
         LOG.debug("%s starting election term %d", self.id, term)
         if not peers:
             self._maybe_win_locked_check(term)
@@ -327,11 +454,10 @@ class RaftNode:
                     data=None,
                 )
                 self.log.append(entry)
-                self.match_index[self.id] = entry.index
                 self._leader_barrier_term = term
-                if not self.peers:
-                    self._advance_commit_locked()
             self._wake_replicators()
+            self._sync_log()
+            self._count_self_match(entry)
 
     def step_down(self) -> None:
         """Voluntarily abandon leadership (hashicorp/raft's leadership
@@ -352,6 +478,10 @@ class RaftNode:
             # same term would allow double-voting
             self.current_term = term
             self.voted_for = None
+            # adopted term durable before any response built on it
+            # leaves this node (the stable store's no-change fast path
+            # makes the equal-term calls free)
+            self._persist_hard_state_locked()
         self._last_contact = time.monotonic()
         if was_leader:
             # fail pending futures; a new leader owns them now
@@ -399,9 +529,13 @@ class RaftNode:
             need_snapshot = next_idx <= base
         if need_snapshot and self._snapshot_cache is None:
             # log is compacted past the peer but no snapshot bytes are
-            # in memory (e.g. restart from a persisted compacted log):
-            # capture one now, never ship data=None
-            self.force_snapshot()
+            # in memory (restart from a compacted log, or the cache was
+            # dropped after the fleet caught up): PREFER the on-disk
+            # snapshot file over re-forcing an FSM capture (ISSUE 13
+            # satellite) — only capture anew when no durable file
+            # covers the base
+            if not self._load_disk_snapshot_cache():
+                self.force_snapshot()
         with self._lock:
             if self.state != LEADER or self.current_term != term:
                 return
@@ -437,6 +571,7 @@ class RaftNode:
                     self.next_index[peer] = snapshot_req["last_index"] + 1
                     self.match_index[peer] = snapshot_req["last_index"]
                     self.peer_last_contact[peer] = time.monotonic()
+                    self._maybe_drop_snapshot_cache_locked()
                 return
             resp = self.transport.send(
                 peer, "append_entries",
@@ -458,6 +593,7 @@ class RaftNode:
                     self.match_index[peer] = entries[-1].index
                     self.next_index[peer] = entries[-1].index + 1
                     self._advance_commit_locked()
+                    self._maybe_drop_snapshot_cache_locked()
                     if self.next_index[peer] <= self.log.last_index():
                         self._wake_replicators()
             else:
@@ -469,13 +605,47 @@ class RaftNode:
                 self._wake_replicators()
 
     def _build_snapshot_req_locked(self) -> Dict:
+        # the request carries the CACHE's own (index, term) — never
+        # pair base_index with possibly-newer cache bytes (a capture
+        # racing a replicator between cache-set and compact would
+        # otherwise ship state@applied labeled as state@base, and the
+        # follower would re-apply the span in between twice)
+        index, term, data = self._snapshot_cache
         return {
             "term": self.current_term,
             "leader": self.id,
-            "last_index": self.log.base_index(),
-            "last_term": self.log.term_at(self.log.base_index()) or 0,
-            "data": self._snapshot_cache,
+            "last_index": index,
+            "last_term": term,
+            "data": data,
         }
+
+    def _load_disk_snapshot_cache(self) -> bool:
+        """Re-arm the in-memory snapshot cache from the newest on-disk
+        snapshot file when it covers the compacted base. Returns True
+        when the cache is usable afterward."""
+        if self._snapshots is None:
+            return False
+        snap = self._snapshots.load_newest()
+        if snap is None:
+            return False
+        with self._lock:
+            if snap[0] < self.log.base_index():
+                return False     # disk older than the base: re-force
+            self._snapshot_cache = snap
+            self._note_snapshot_cache_locked()
+        return True
+
+    def _maybe_drop_snapshot_cache_locked(self) -> None:
+        """ISSUE 13 satellite: the cache was unbounded and unmetered.
+        Once every peer's match index covers the base, no lagging
+        follower can need it — drop the bytes (the on-disk file, or a
+        fresh force, serves any later straggler)."""
+        if self._snapshot_cache is None:
+            return
+        base = self.log.base_index()
+        if all(self.match_index.get(p, 0) >= base for p in self.peers):
+            self._snapshot_cache = None
+            self._note_snapshot_cache_locked()
 
     def _advance_commit_locked(self) -> None:
         """Majority match with current-term guard (Raft section 5.4.2)."""
@@ -514,6 +684,22 @@ class RaftNode:
                 continue
             result, error = None, None
             with self._fsm_lock:
+                with self._lock:
+                    if self.last_applied + 1 != index:
+                        # a snapshot install moved the applied
+                        # frontier while this entry waited on
+                        # _fsm_lock: the restored state already
+                        # CONTAINS it — applying it now would
+                        # double-apply and regress the frontier
+                        stale = True
+                    else:
+                        stale = False
+                if stale:
+                    if fut is not None:
+                        # committed and folded into the snapshot; the
+                        # per-entry result is gone with it
+                        fut.respond(None, None)
+                    continue
                 if entry.kind == LOG_COMMAND:
                     msg_type, req = entry.data
                     try:
@@ -553,7 +739,9 @@ class RaftNode:
 
     # --- snapshots ------------------------------------------------------
 
-    _snapshot_cache: Optional[bytes] = None
+    #: (index, term, data) of the newest captured snapshot — the index
+    #: pairing travels WITH the bytes (see _build_snapshot_req_locked)
+    _snapshot_cache: Optional[Tuple[int, int, bytes]] = None
 
     def _maybe_snapshot(self) -> None:
         if self.snapshot_fn is None:
@@ -570,7 +758,12 @@ class RaftNode:
 
         Holding _fsm_lock quiesces the apply loop so the captured bytes
         are exactly the state at last_applied -- compacting to any other
-        index would lose or double-apply entries on restore."""
+        index would lose or double-apply entries on restore.
+
+        Durable order (ISSUE 13): snapshot FILE first, then the WAL
+        compact record, then superseded-segment deletion — a crash at
+        any seam recovers from the newer of (previous snapshot + full
+        WAL) or (new snapshot + suffix)."""
         if self.snapshot_fn is None:
             return
         with self._fsm_lock:
@@ -579,8 +772,11 @@ class RaftNode:
             data = self.snapshot_fn()
             with self._lock:
                 term = self.log.term_at(applied) or self.current_term
-                self.log.compact_to(applied, term)
-                self._snapshot_cache = data
+                self._snapshot_cache = (applied, term, data)
+                self._note_snapshot_cache_locked()
+            if self._snapshots is not None:
+                self._snapshots.save(applied, term, data)
+            self.log.compact_to(applied, term)
         self.log.persist()
 
     # --- RPC handlers ---------------------------------------------------
@@ -616,54 +812,71 @@ class RaftNode:
                     granted = True
                     self.voted_for = req["candidate"]
                     self._last_contact = time.monotonic()
+                    # the vote is durable BEFORE the grant leaves: a
+                    # crash after responding must restart remembering
+                    # who this term's vote went to
+                    self._persist_hard_state_locked()
             return {"term": self.current_term, "granted": granted}
 
     def _on_append_entries(self, req: Dict) -> Dict:
         with self._lock:
-            if req["term"] < self.current_term:
-                return {"term": self.current_term, "success": False}
-            if req["term"] > self.current_term or self.state != FOLLOWER:
-                self._step_down_locked(req["term"])
-            self.current_term = req["term"]
-            self.leader_id = req["leader"]
-            self._last_contact = time.monotonic()
+            resp, dirty = self._append_entries_locked(req)
+        if dirty:
+            # the success ack PROMISES the appended/truncated suffix
+            # survives a crash: group-fsync before it leaves (outside
+            # the lock — an fsync must not stall the RPC plane).
+            # Heartbeats and rejections stay fsync-free.
+            self._sync_log()
+        return resp
 
-            prev_index = req["prev_log_index"]
-            prev_term = req["prev_log_term"]
-            if prev_index > 0:
-                local_term = self.log.term_at(prev_index)
-                if local_term is None:
-                    return {
-                        "term": self.current_term, "success": False,
-                        "conflict_index": self.log.last_index() + 1,
-                    }
-                if local_term != prev_term:
-                    return {
-                        "term": self.current_term, "success": False,
-                        "conflict_index": max(1, prev_index - 1),
-                    }
-            for entry in req["entries"]:
-                local = self.log.get(entry.index)
-                if local is not None and local.term != entry.term:
-                    self.log.truncate_from(entry.index)
-                    local = None
-                if local is None:
-                    if self.log.last_index() + 1 == entry.index:
-                        self.log.append(entry)
-                    # else: gap; leader will back off via conflict_index
-            # commit may only advance to the last entry VERIFIED by this
-            # batch -- a stale uncommitted tail beyond it must not be
-            # applied (Raft figure 2: min(leaderCommit, index of last
-            # new entry))
-            last_verified = (
-                req["entries"][-1].index if req["entries"] else prev_index
-            )
-            if req["leader_commit"] > self.commit_index:
-                new_commit = min(req["leader_commit"], last_verified)
-                if new_commit > self.commit_index:
-                    self.commit_index = new_commit
-                    self._apply_cond.notify_all()
-            return {"term": self.current_term, "success": True}
+    def _append_entries_locked(self, req: Dict) -> Tuple[Dict, bool]:
+        if req["term"] < self.current_term:
+            return {"term": self.current_term, "success": False}, False
+        if req["term"] > self.current_term or self.state != FOLLOWER:
+            self._step_down_locked(req["term"])
+        self.current_term = req["term"]
+        self.leader_id = req["leader"]
+        self._last_contact = time.monotonic()
+
+        prev_index = req["prev_log_index"]
+        prev_term = req["prev_log_term"]
+        if prev_index > 0:
+            local_term = self.log.term_at(prev_index)
+            if local_term is None:
+                return {
+                    "term": self.current_term, "success": False,
+                    "conflict_index": self.log.last_index() + 1,
+                }, False
+            if local_term != prev_term:
+                return {
+                    "term": self.current_term, "success": False,
+                    "conflict_index": max(1, prev_index - 1),
+                }, False
+        dirty = False
+        for entry in req["entries"]:
+            local = self.log.get(entry.index)
+            if local is not None and local.term != entry.term:
+                self.log.truncate_from(entry.index)
+                local = None
+                dirty = True
+            if local is None:
+                if self.log.last_index() + 1 == entry.index:
+                    self.log.append(entry)
+                    dirty = True
+                # else: gap; leader will back off via conflict_index
+        # commit may only advance to the last entry VERIFIED by this
+        # batch -- a stale uncommitted tail beyond it must not be
+        # applied (Raft figure 2: min(leaderCommit, index of last
+        # new entry))
+        last_verified = (
+            req["entries"][-1].index if req["entries"] else prev_index
+        )
+        if req["leader_commit"] > self.commit_index:
+            new_commit = min(req["leader_commit"], last_verified)
+            if new_commit > self.commit_index:
+                self.commit_index = new_commit
+                self._apply_cond.notify_all()
+        return {"term": self.current_term, "success": True}, dirty
 
     def _on_install_snapshot(self, req: Dict) -> Dict:
         with self._lock:
@@ -676,13 +889,46 @@ class RaftNode:
             if req["data"] is None:
                 # never wipe local state for an empty snapshot
                 return {"term": self.current_term}
-            if self.restore_fn is not None:
-                self.restore_fn(req["data"])
-            self.log.compact_to(req["last_index"], req["last_term"])
-            self.log.truncate_from(req["last_index"] + 1)
-            self.commit_index = req["last_index"]
-            self.last_applied = req["last_index"]
-            return {"term": self.current_term}
+        if self._snapshots is not None:
+            # the multi-MB durable file write runs OUTSIDE self._lock
+            # (an fsync must not stall the RPC/ticker plane) and disk
+            # lands BEFORE the log compaction: a crash in between
+            # recovers from this file plus the uncompacted WAL; the
+            # reverse order is the seed's unrecoverable
+            # compacted-log-without-snapshot state
+            self._snapshots.save(
+                req["last_index"], req["last_term"], req["data"])
+        # _fsm_lock quiesces the apply loop for the whole swap (the
+        # force_snapshot lock order); restore + compact + truncate +
+        # counter updates are ONE section under self._lock — the
+        # re-validate, the restore, and the log surgery must be atomic
+        # against a concurrent AppendEntries from a newer leader, or
+        # the truncate could delete an entry the append already
+        # counted into commit_index (the apply loop would then skip it
+        # silently — replica divergence). The restore/compact cost
+        # under the raft lock is the pre-existing trade on this rare
+        # path; the multi-MB file save above stays outside.
+        with self._fsm_lock:
+            with self._lock:
+                # re-validate after the unlocked write: a newer term
+                # may have arrived, or this snapshot may be STALE
+                # (local state already at/past it — restoring would
+                # rewind the FSM); the file above is kept either way
+                if req["term"] < self.current_term:
+                    return {"term": self.current_term}
+                if req["last_index"] <= max(self.log.base_index(),
+                                            self.last_applied):
+                    return {"term": self.current_term}
+                if self.restore_fn is not None:
+                    self.restore_fn(req["data"])
+                self.log.compact_to(req["last_index"], req["last_term"])
+                self.log.truncate_from(req["last_index"] + 1)
+                if req["last_index"] > self.commit_index:
+                    self.commit_index = req["last_index"]
+                self.last_applied = req["last_index"]
+                resp = {"term": self.current_term}
+        self._sync_log()
+        return resp
 
     def _on_forward_apply(self, req: Dict) -> Dict:
         """Leader-side handler for follower-forwarded applies

@@ -30,8 +30,18 @@ class TransportRegistry:
         self._cut: set = set()
 
     def register(self, addr: str, transport: "InmemTransport") -> None:
+        """Register (or RE-register: a restarted server takes over its
+        address — the old, closed transport stops resolving)."""
         with self._lock:
             self._nodes[addr] = transport
+
+    def unregister(self, addr: str, transport: "InmemTransport") -> None:
+        """Identity-guarded removal: only the transport that owns the
+        address slot may vacate it (a restarted server's replacement
+        must not be torn down by the dead one's late close)."""
+        with self._lock:
+            if self._nodes.get(addr) is transport:
+                del self._nodes[addr]
 
     def lookup(self, addr: str) -> Optional["InmemTransport"]:
         with self._lock:
@@ -59,21 +69,28 @@ class InmemTransport:
         self.addr = addr
         self.registry = registry
         self._handler: Optional[Handler] = None
+        self._closed = False
         registry.register(addr, self)
 
     def set_handler(self, handler: Handler) -> None:
         self._handler = handler
 
     def send(self, target: str, method: str, req: Dict, timeout: float = 1.0) -> Dict:
+        if self._closed:
+            raise ConnectionError(f"transport at {self.addr} is closed")
         if self.registry.is_cut(self.addr, target):
             raise ConnectionError(f"partitioned: {self.addr} -> {target}")
         peer = self.registry.lookup(target)
-        if peer is None or peer._handler is None:
+        if peer is None or peer._handler is None or peer._closed:
             raise ConnectionError(f"no transport at {target}")
         return peer._handler(method, req)
 
     def close(self) -> None:
-        pass
+        """Go dark: a killed/shut-down node must stop answering AND
+        stop originating (the restart harness re-registers a fresh
+        transport at the same address)."""
+        self._closed = True
+        self.registry.unregister(self.addr, self)
 
 
 class _TcpHandler(socketserver.StreamRequestHandler):

@@ -73,6 +73,8 @@ class ServerConfig:
         client_update_fill_window_ms: float = 2.0,
         plan_rejection_threshold: int = 15,
         plan_rejection_window_s: float = 300.0,
+        data_dir: str = "",
+        raft_fsync_policy: str = "batch",
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -135,6 +137,16 @@ class ServerConfig:
         # through raft. 0 disables the marking (counting stays on).
         self.plan_rejection_threshold = plan_rejection_threshold
         self.plan_rejection_window_s = plan_rejection_window_s
+        # crash-safe raft durability (raft/wal.py, ISSUE 13): a data
+        # dir makes term/vote, the log, and snapshots survive a kill —
+        # setup_raft recovers from it (stable store -> newest snapshot
+        # -> WAL replay). Empty = in-memory raft (the seed behavior).
+        # fsync policy: "always" fsyncs per journaled record;
+        # "batch" (default) group-fsyncs at the ack boundaries, which
+        # the PR 10/11 batched-commit windows amortize to roughly one
+        # fsync per wave.
+        self.data_dir = data_dir
+        self.raft_fsync_policy = raft_fsync_policy
 
 
 class ClientUpdateStats:
@@ -355,9 +367,16 @@ class Server:
     # --- lifecycle ------------------------------------------------------
 
     def setup_raft(self, node_id: str, peers: List[str], transport, raft_config=None) -> None:
-        """Attach a replication log (server.go:1228 setupRaft)."""
+        """Attach a replication log (server.go:1228 setupRaft). With
+        ``config.data_dir`` set, the raft layer recovers its durable
+        state (term/vote, snapshot, WAL) from ``<data_dir>/raft``
+        before the node participates — the RaftNode constructor runs
+        restore_fn into this server's state store."""
         from nomad_tpu.raft.node import RaftNode
 
+        data_dir = ""
+        if self.config.data_dir:
+            data_dir = os.path.join(self.config.data_dir, "raft")
         self.raft = RaftNode(
             node_id=node_id,
             peers=peers,
@@ -368,7 +387,19 @@ class Server:
             restore_fn=self.state.restore_from_bytes,
             on_leader=self.establish_leadership,
             on_follower=self.revoke_leadership,
+            data_dir=data_dir or None,
+            fsync_policy=self.config.raft_fsync_policy,
         )
+        if data_dir:
+            # the fresh event ring knows nothing before this boot:
+            # everything the restored snapshot covers is trimmed
+            # history, so a client resuming `?index=` below it gets an
+            # explicit LostEvents marker instead of a silent gap.
+            # WAL-replayed entries re-publish through the normal FSM
+            # path with their original indexes (resumes above the
+            # floor stay gap-free and the `index <= from_index` filter
+            # keeps them duplicate-free).
+            self.event_broker.note_trimmed_through(self.state.latest_index())
 
     def start(self) -> None:
         """Start workers; leadership comes from raft when attached,

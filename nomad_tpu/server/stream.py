@@ -229,6 +229,18 @@ class EventBroker:
                         self._trimmed_latest_index = old.events[-1].index
                 self._cond.notify_all()
 
+    def note_trimmed_through(self, index: int) -> None:
+        """Declare everything at or below ``index`` trimmed history
+        (ISSUE 13): a restarted server's fresh ring holds none of the
+        events its restored snapshot covers, so a client resuming
+        ``?index=`` below the boot index must get the explicit
+        unknown-size ``LostEvents`` marker — never a silent gap."""
+        with self._lock:
+            if index > self._trimmed_latest_index:
+                self._trimmed_latest_index = index
+            if index > self.latest_index:
+                self.latest_index = index
+
     # --- subscribe / drain -----------------------------------------------
 
     def subscribe(

@@ -882,6 +882,19 @@ class StateStore:
             node.modify_index = idx
             if node.create_index == 0:
                 node.create_index = idx
+            existing = self._nodes.get(node.id)
+            if existing is not None:
+                # re-registration keeps OPERATOR intent (state_store.go
+                # upsertNodeTxn): a client restarting — including one
+                # whose server restarted underneath it (ISSUE 13) —
+                # sends a fresh Node struct, but drain state and
+                # scheduling eligibility were set through the drain/
+                # eligibility endpoints and must survive it
+                node.drain = existing.drain
+                node.drain_strategy = existing.drain_strategy
+                node.scheduling_eligibility = existing.scheduling_eligibility
+                if node.create_index == idx:
+                    node.create_index = existing.create_index
             self._nodes[node.id] = node
             self.usage.node_row(node.id)
             self.usage.note_node_change(node.id)

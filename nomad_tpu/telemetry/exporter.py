@@ -282,6 +282,59 @@ def prometheus_text(registry=None, event_broker=None) -> str:
                     f'{row["fires"]}')
     except Exception:                           # noqa: BLE001
         pass                # fault plane unavailable: skip series
+    # raft durability plane (raft/wal.py, ISSUE 13): WAL frame/fsync
+    # volume, recovery accounting (replayed entries, torn-tail
+    # truncations), and the snapshot byte meters (in-memory cache vs
+    # on-disk files). In-memory raft shows zeros — the disarmed-cost
+    # promise, like the fault plane's.
+    try:
+        from nomad_tpu.raft.wal import wal_stats
+
+        d = wal_stats.snapshot()
+        lines.append(
+            "# TYPE nomad_tpu_raft_durability_fsyncs_total counter")
+        lines.append(
+            f"nomad_tpu_raft_durability_fsyncs_total {d['fsyncs']}")
+        lines.append(
+            "# TYPE nomad_tpu_raft_durability_frames_total counter")
+        lines.append(
+            f"nomad_tpu_raft_durability_frames_total {d['frames']}")
+        lines.append(
+            "# TYPE nomad_tpu_raft_durability_bytes_total counter")
+        lines.append(
+            f"nomad_tpu_raft_durability_bytes_total {d['bytes_written']}")
+        lines.append(
+            "# TYPE nomad_tpu_raft_durability_replayed_entries_total "
+            "counter")
+        lines.append(
+            f"nomad_tpu_raft_durability_replayed_entries_total "
+            f"{d['replayed_entries']}")
+        lines.append(
+            "# TYPE nomad_tpu_raft_durability_torn_truncations_total "
+            "counter")
+        lines.append(
+            f"nomad_tpu_raft_durability_torn_truncations_total "
+            f"{d['torn_truncations']}")
+        lines.append(
+            "# TYPE nomad_tpu_raft_durability_recoveries_total counter")
+        lines.append(
+            f"nomad_tpu_raft_durability_recoveries_total "
+            f"{d['recoveries']}")
+        lines.append("# TYPE nomad_tpu_raft_snapshots_total counter")
+        for kind, key in (("written", "snapshots_written"),
+                          ("pruned", "snapshots_pruned"),
+                          ("invalid", "snapshots_invalid")):
+            lines.append(
+                f'nomad_tpu_raft_snapshots_total{{kind="{kind}"}} '
+                f'{d[key]}')
+        lines.append("# TYPE nomad_tpu_raft_snapshot_bytes gauge")
+        for kind, key in (("cache", "snapshot_cache_bytes"),
+                          ("disk", "snapshot_disk_bytes")):
+            lines.append(
+                f'nomad_tpu_raft_snapshot_bytes{{kind="{kind}"}} '
+                f'{d[key]}')
+    except Exception:                           # noqa: BLE001
+        pass                # durability plane unavailable: skip series
     # wave-cohort drain accounting (utils/wavecohort.py): the plan
     # queue's wave-boundary batching — armed waves, landed plans,
     # whole-cohort drains vs expirations vs hard-cap clamps, and the

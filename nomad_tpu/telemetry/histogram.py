@@ -235,6 +235,9 @@ SNAPSHOT_WAIT = "snapshot_wait"
 #: event-stream delivery lag: FSM-apply stamp -> consumer hand-off
 #: (server/stream.py; the serving plane's headline distribution)
 STREAM_DELIVER = "stream_deliver"
+#: raft WAL group-fsync latency (raft/wal.py, ISSUE 13): the disk
+#: cost every durable ack amortizes across the batched-commit windows
+WAL_FSYNC = "wal_fsync"
 
 
 class HistogramRegistry:

@@ -1,0 +1,480 @@
+"""Kill→restart recovery tests (ISSUE 13): the durability plane wired
+through the server — the tier-1 pinned mini restart smoke, raft hard-
+state safety across restarts (no double vote), event-stream resume
+semantics over a full server restart, and the heartbeat-expired node
+re-registering into a restarted cluster. The full restart chaos cell
+runs in the stress tier (tests/test_stress.py::TestRestartCell)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft.node import RaftConfig, RaftNode
+from nomad_tpu.raft.transport import InmemTransport, TransportRegistry
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.server.testing import (
+    hard_kill,
+    make_cluster,
+    restart_server,
+    wait_for_leader,
+)
+from nomad_tpu.state.usage import usage_rebuild_diff
+from nomad_tpu.structs import consts
+from nomad_tpu.utils import faultpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+def _wait(fn, timeout=30.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _live_allocs(server, jobs):
+    snap = server.state.snapshot()
+    return [a for j in jobs
+            for a in snap.allocs_by_job(j.namespace, j.id)
+            if not a.terminal_status()]
+
+
+def _one_server(data_dir, **cfg_overrides):
+    cfg = dict(num_workers=1, worker_batch_size=4, heartbeat_ttl=60.0,
+               data_dir=data_dir)
+    cfg.update(cfg_overrides)
+    servers, registry = make_cluster(1, ServerConfig(**cfg),
+                                     data_dirs=[data_dir])
+    return servers[0], registry
+
+
+class TestMiniRestartSmoke:
+    def test_commit_kill_restart_bit_identical(self, tmp_path):
+        """The tier-1 pinned restart smoke (ISSUE 13 satellite): a
+        single durable server commits N evals, the node object is
+        hard-dropped (in-memory state discarded wholesale), and a
+        fresh server restarted from the data dir must converge to
+        bit-identical usage planes with every eval terminal and every
+        acked placement intact."""
+        d = str(tmp_path / "srv")
+        server, registry = _one_server(d)
+        s2 = None
+        try:
+            wait_for_leader([server], timeout=15.0)
+            for _ in range(6):
+                server.node_register(mock.node())
+            jobs = []
+            for _ in range(4):
+                j = mock.simple_job()
+                j.task_groups[0].count = 2
+                server.job_register(j)      # returning = acked
+                jobs.append(j)
+            _wait(lambda: len(_live_allocs(server, jobs)) == 8,
+                  timeout=60.0, msg="burst placed")
+            idx0 = server.state.latest_index()
+
+            hard_kill(server)
+            s2 = restart_server(server, registry)
+            wait_for_leader([s2], timeout=15.0)
+            _wait(lambda: s2.state.latest_index() >= idx0,
+                  timeout=30.0, msg="recovery caught up")
+            assert s2.raft.replayed_entries > 0
+            # acked placements intact, exactly once
+            live = _live_allocs(s2, jobs)
+            assert len(live) == 8
+            for j in jobs:
+                mine = [a for a in live if a.job_id == j.id]
+                assert len({a.name for a in mine}) == len(mine) == 2
+            # usage planes bit-identical to a from-scratch rebuild
+            assert usage_rebuild_diff(s2.state) == []
+
+            def terminal():
+                snap = s2.state.snapshot()
+                if any(e.status == consts.EVAL_STATUS_PENDING
+                       for e in snap.evals_iter()):
+                    return False
+                b = s2.eval_broker.stats()
+                return b["total_ready"] == 0 and b["total_unacked"] == 0
+
+            _wait(terminal, timeout=30.0, msg="evals terminal")
+        finally:
+            for s in (server, s2):
+                if s is not None:
+                    try:
+                        s.shutdown()
+                    except Exception:           # noqa: BLE001
+                        pass
+
+
+class TestHardStateDurability:
+    FAST = RaftConfig(heartbeat_interval=0.02,
+                      election_timeout_min=0.06,
+                      election_timeout_max=0.12)
+
+    def _bare_node(self, d, registry, peers=("n0", "peer-a", "peer-b")):
+        node = RaftNode(
+            node_id="n0", peers=list(peers),
+            transport=InmemTransport("n0", registry),
+            fsm_apply=lambda t, r: 0,
+            config=self.FAST, data_dir=d,
+        )
+        return node
+
+    def test_vote_survives_restart_no_double_vote(self, tmp_path):
+        """The raft SAFETY half of the tentpole: a node that granted
+        its term-5 vote to candidate A, crashed, and restarted must
+        refuse candidate B in term 5 (the seed's in-memory term/vote
+        allowed the double vote)."""
+        d = str(tmp_path / "raft")
+        registry = TransportRegistry()
+        node = self._bare_node(d, registry)
+        req = {"term": 5, "candidate": "peer-a",
+               "last_log_index": 0, "last_log_term": 0}
+        resp = node._on_request_vote(dict(req))
+        assert resp["granted"]
+        # crash: drop the object, no graceful anything
+        node.transport.close()
+
+        again = self._bare_node(d, registry)
+        assert again.current_term == 5
+        assert again.voted_for == "peer-a"
+        steal = {"term": 5, "candidate": "peer-b",
+                 "last_log_index": 99, "last_log_term": 5}
+        assert not again._on_request_vote(steal)["granted"]
+        # the same candidate may re-ask (lost response retry)
+        assert again._on_request_vote(dict(req))["granted"]
+        again.transport.close()
+
+    def test_fallback_snapshot_behind_base_refuses_to_boot(self, tmp_path):
+        """Keep-last-2 fallback meets a compacted log: when the newest
+        snapshot fails its CRC and the older fallback sits BELOW the
+        WAL's compacted base, the span in between is unreconstructable
+        — recovery must refuse loudly, never boot an FSM silently
+        missing committed state."""
+        import os
+
+        from nomad_tpu.raft.wal import (
+            DurableLogStore,
+            SnapshotStore,
+            WalCorruptionError,
+        )
+        from nomad_tpu.raft.log import LogEntry
+
+        d = str(tmp_path / "raft")
+        os.makedirs(d)
+        sn = SnapshotStore(d)
+        sn.save(5, 1, b"older-fallback")
+        newest = sn.save(20, 1, b"newest")
+        log = DurableLogStore(os.path.join(d, "wal"))
+        for i in range(1, 26):
+            log.append(LogEntry(index=i, term=1, data=("op", i)))
+        log.compact_to(20, 1)
+        log.close()
+        # bit-rot the newest snapshot: load falls back to index 5 < 20
+        size = os.path.getsize(newest)
+        with open(newest, "r+b") as f:
+            f.seek(size - 1)
+            last = f.read(1)
+            f.seek(size - 1)
+            f.write(bytes([last[0] ^ 0xFF]))
+        registry = TransportRegistry()
+        with pytest.raises(WalCorruptionError):
+            RaftNode(
+                node_id="n0", peers=["n0"],
+                transport=InmemTransport("n0", registry),
+                fsm_apply=lambda t, r: 0,
+                restore_fn=lambda b: None,
+                config=self.FAST, data_dir=d,
+            )
+
+    def test_failed_wal_demotes_leader_and_fails_over(self, tmp_path):
+        """Fail-stop demotion: a leader whose WAL dies (torn write /
+        IO error) must stop leading — its heartbeats would otherwise
+        suppress elections forever while every write fails — and a
+        healthy peer must take over (the reference's panic-and-
+        failover, in-process)."""
+        registry = TransportRegistry()
+        addrs = ["d0", "d1", "d2"]
+        nodes = []
+        for addr in addrs:
+            nodes.append(RaftNode(
+                node_id=addr, peers=addrs,
+                transport=InmemTransport(addr, registry),
+                fsm_apply=lambda t, r: 0,
+                config=self.FAST,
+                data_dir=str(tmp_path / addr),
+            ))
+        for n in nodes:
+            n.start()
+        try:
+            _wait(lambda: sum(n.is_leader() for n in nodes) == 1,
+                  timeout=10.0, msg="initial leader")
+            leader = next(n for n in nodes if n.is_leader())
+            leader.apply("op", {"i": 0})
+            # tear the LEADER's next journaled frame: WAL fail-stops
+            faultpoints.arm(
+                {"wal.frame.torn": {"kind": "error", "nth": 1}})
+            with pytest.raises(faultpoints.FaultError):
+                leader.apply("op", {"i": 1})
+            faultpoints.disarm()
+            assert leader.log.wal_failed
+            _wait(lambda: not leader.is_leader(), timeout=10.0,
+                  msg="failed-WAL leader demoted")
+            _wait(lambda: any(n.is_leader() for n in nodes
+                              if n is not leader),
+                  timeout=10.0, msg="healthy peer took over")
+            new_leader = next(n for n in nodes
+                              if n is not leader and n.is_leader())
+            assert new_leader.apply("op", {"i": 2}) is not None
+            # the dead-disk node never reclaims leadership
+            time.sleep(0.5)
+            assert not leader.is_leader()
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_term_adoption_durable_before_response(self, tmp_path):
+        """AppendEntries carrying a newer term persists it before the
+        ack: a restart must come back in the adopted term, not behind
+        it."""
+        d = str(tmp_path / "raft")
+        registry = TransportRegistry()
+        node = self._bare_node(d, registry)
+        resp = node._on_append_entries({
+            "term": 9, "leader": "peer-a", "prev_log_index": 0,
+            "prev_log_term": 0, "entries": [], "leader_commit": 0,
+        })
+        assert resp["success"]
+        node.transport.close()
+        again = self._bare_node(d, registry)
+        assert again.current_term == 9
+        again.transport.close()
+
+
+class TestStreamResumeAcrossRestart:
+    def test_resume_above_boot_index_gap_free_no_duplicates(self, tmp_path):
+        """ISSUE 13 satellite: a client holding ``?index=`` across a
+        full server restart. With the whole history in the WAL, replay
+        republishes every event with its original index — the resume
+        delivers exactly the events past the client's index, no silent
+        gap, no replayed duplicate."""
+        d = str(tmp_path / "srv")
+        server, registry = _one_server(d)
+        s2 = None
+        try:
+            wait_for_leader([server], timeout=15.0)
+            sub = server.event_broker.subscribe()
+            for _ in range(3):
+                server.node_register(mock.node())
+            jobs = [mock.simple_job() for _ in range(2)]
+            for j in jobs:
+                j.task_groups[0].count = 1
+                server.job_register(j)
+            _wait(lambda: len(_live_allocs(server, jobs)) == 2,
+                  timeout=60.0, msg="placed")
+            seen = [e for e in sub.next_events(timeout=2.0,
+                                               max_events=4096)]
+            assert seen
+            last_index = max(e.index for e in seen)
+            seen_keys = {(e.index, e.topic, e.type, e.key) for e in seen}
+            sub.close()
+
+            hard_kill(server)
+            s2 = restart_server(server, registry)
+            wait_for_leader([s2], timeout=15.0)
+            _wait(lambda: s2.state.latest_index() >= last_index,
+                  timeout=30.0, msg="replay caught up")
+            # register one more node so there is post-restart traffic
+            post = mock.node()
+            s2.node_register(post)
+            resumed = s2.event_broker.subscribe(from_index=last_index)
+            got = resumed.next_events(timeout=3.0, max_events=4096)
+            from nomad_tpu.server.stream import TOPIC_LOST
+
+            # everything the client already saw stays unseen (no
+            # replayed duplicates) ...
+            dupes = [e for e in got
+                     if (e.index, e.topic, e.type, e.key) in seen_keys]
+            assert not dupes, dupes[:3]
+            # ... and the new event arrives without a loss marker
+            assert any(e.key == post.id for e in got
+                       if e.topic != TOPIC_LOST)
+            assert not any(e.topic == TOPIC_LOST for e in got)
+            resumed.close()
+        finally:
+            for s in (server, s2):
+                if s is not None:
+                    try:
+                        s.shutdown()
+                    except Exception:           # noqa: BLE001
+                        pass
+
+    def test_resume_below_boot_index_gets_explicit_lost_marker(
+            self, tmp_path):
+        """A snapshot compacts history the fresh ring can never
+        replay: a client resuming below the boot index must get the
+        explicit unknown-size LostEvents marker with a resume point —
+        never a silent gap (the fresh-ring trimmed-history floor)."""
+        d = str(tmp_path / "srv")
+        server, registry = _one_server(d)
+        s2 = None
+        try:
+            wait_for_leader([server], timeout=15.0)
+            for _ in range(3):
+                server.node_register(mock.node())
+            job = mock.simple_job()
+            job.task_groups[0].count = 1
+            server.job_register(job)
+            _wait(lambda: len(_live_allocs(server, [job])) == 1,
+                  timeout=60.0, msg="placed")
+            early_index = 1                 # a long-gone client cursor
+            server.raft.force_snapshot()    # history compacted to disk
+
+            hard_kill(server)
+            s2 = restart_server(server, registry)
+            wait_for_leader([s2], timeout=15.0)
+            assert s2.raft.recovered_snapshot_index > early_index
+            resumed = s2.event_broker.subscribe(from_index=early_index)
+            s2.node_register(mock.node())   # wake the stream
+            got = resumed.next_events(timeout=3.0, max_events=4096)
+            from nomad_tpu.server.stream import TOPIC_LOST
+
+            assert got and got[0].topic == TOPIC_LOST
+            assert got[0].payload["LostEvents"] == -1
+            assert got[0].payload["ResumeIndex"] >= 0
+            resumed.close()
+        finally:
+            for s in (server, s2):
+                if s is not None:
+                    try:
+                        s.shutdown()
+                    except Exception:           # noqa: BLE001
+                        pass
+
+
+class TestExpiredNodeReregisterAcrossRestart:
+    def test_expiry_reregister_reconcile_drain_preserved(self, tmp_path):
+        """ISSUE 13 satellite: a node heartbeat-expires while its
+        server cluster rides a leader kill→restart (step_down +
+        restart interplay), then re-registers under the SAME id with a
+        fresh struct. The drain-derived state (ineligibility, drain
+        strategy) must survive the re-registration and the job must
+        end exactly-once placed — no duplicate live allocs, nothing
+        resurrected on the victim."""
+        dirs = [str(tmp_path / f"srv-{i}") for i in range(3)]
+        servers, registry = make_cluster(3, ServerConfig(
+            num_workers=1, worker_batch_size=2, heartbeat_ttl=1.5,
+            nack_timeout=1.5, data_dir=""), data_dirs=dirs)
+        stop = threading.Event()
+        try:
+            wait_for_leader(servers, timeout=15.0)
+
+            def cur_leader():
+                for s in servers:
+                    if s.raft.is_leader() and s.is_leader():
+                        return s
+                return None
+
+            def with_leader(fn, timeout=20.0):
+                deadline = time.time() + timeout
+                last = None
+                while time.time() < deadline:
+                    s = cur_leader()
+                    if s is not None:
+                        try:
+                            return fn(s)
+                        except Exception as e:  # noqa: BLE001
+                            last = e
+                    time.sleep(0.05)
+                raise AssertionError(f"no leader took the call: {last!r}")
+
+            worker_node = mock.node()
+            victim = mock.node()
+            with_leader(lambda s: s.node_register(worker_node))
+            with_leader(lambda s: s.node_register(victim))
+
+            def keep_worker_alive():
+                while not stop.is_set():
+                    s = cur_leader()
+                    if s is not None:
+                        try:
+                            s.node_heartbeat(worker_node.id, "ready")
+                        except Exception:       # noqa: BLE001
+                            pass
+                    time.sleep(0.2)
+
+            hb = threading.Thread(target=keep_worker_alive, daemon=True)
+            hb.start()
+
+            job = mock.simple_job()
+            job.task_groups[0].count = 2
+            with_leader(lambda s: s.job_register(job))
+            _wait(lambda: len(_live_allocs(
+                cur_leader() or servers[0], [job])) == 2,
+                timeout=60.0, msg="job placed")
+
+            # drain the victim: allocs migrate off; completion leaves
+            # it ineligible (drainer semantics)
+            with_leader(lambda s: s.node_update_drain(
+                victim.id, True, None))
+            _wait(lambda: all(
+                a.node_id != victim.id for a in _live_allocs(
+                    cur_leader() or servers[0], [job])),
+                timeout=60.0, msg="victim drained")
+
+            # kill the leader (deposed mid-flight) and restart it; the
+            # VICTIM never heartbeats, so its TTL expires on whichever
+            # leader owns the timers during the transition
+            leader = cur_leader()
+            idx = servers.index(leader)
+            hard_kill(leader)
+            fresh = restart_server(leader, registry)
+            servers[idx] = fresh
+            _wait(lambda: cur_leader() is not None, timeout=30.0,
+                  msg="re-elected")
+            _wait(lambda: (cur_leader() or servers[0]).state.snapshot()
+                  .node_by_id(victim.id).status == consts.NODE_STATUS_DOWN,
+                  timeout=30.0, msg="victim expired down")
+
+            # the client restarts and re-registers: SAME id, fresh
+            # struct (no drain fields — clients never set those)
+            reborn = mock.node(id=victim.id)
+            with_leader(lambda s, n=reborn: s.node_register(n))
+
+            def settled():
+                s = cur_leader()
+                if s is None:
+                    return False
+                snap = s.state.snapshot()
+                row = snap.node_by_id(victim.id)
+                if row is None or row.status != consts.NODE_STATUS_READY:
+                    return False
+                live = _live_allocs(s, [job])
+                names = [a.name for a in live]
+                return (len(live) == 2 and len(set(names)) == 2
+                        and all(a.node_id != victim.id for a in live))
+
+            _wait(settled, timeout=60.0,
+                  msg="reconciled exactly-once off the drained victim")
+            row = (cur_leader() or servers[0]).state.snapshot() \
+                .node_by_id(victim.id)
+            # operator intent survived BOTH the server restart and the
+            # client re-registration
+            assert row.scheduling_eligibility == \
+                consts.NODE_SCHEDULING_INELIGIBLE
+        finally:
+            stop.set()
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:               # noqa: BLE001
+                    pass

@@ -254,6 +254,40 @@ class TestChaosCell:
             "faults"]["plan.commit.raft"]["fires"] >= 1
 
 
+class TestRestartCell:
+    def test_restart_chaos_and_torn_fuzz_under_lock_witness(self):
+        """ISSUE 13: the kill→restart recovery cell (torn-write kill +
+        clean leader kill against a data_dir-backed 3-node cluster)
+        under the runtime lock witness — the new WAL/stable-store
+        locks are witness-created, so any executed acquisition-order
+        inversion in the durability paths fails the cell. All recovery
+        invariants must hold: no acked committed write lost, usage
+        planes bit-identical on every restarted replica, no double
+        vote in any term, stream resume explicit. Plus the full
+        ≥200-seed torn-tail fuzz: recovery either truncates cleanly or
+        fails loudly — never silently diverges."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench"))
+        import trace_report
+
+        cell = trace_report.run_restart_chaos(deadline_s=90.0,
+                                              settle_s=45.0)
+        assert cell["converged_ok"], cell["violations"]
+        assert cell["restarts"] == 2, cell
+        assert cell["torn_truncations"] >= 1, cell
+        assert cell["replayed_entries"] > 0, cell
+        assert cell["allocs_placed"] == cell["allocs_wanted"], cell
+        assert cell["stream_missed_alloc_events"] == 0 or \
+            cell["stream_lost_markers"] > 0, cell
+
+        fuzz = trace_report.run_torn_tail_fuzz(seeds=200)
+        assert fuzz["silent_divergences"] == 0, fuzz
+        assert fuzz["clean_prefix"] > 0 and fuzz["loud_corruption"] > 0
+
+
 class TestMembershipContention:
     def test_reconcile_queue_preserves_event_order(self):
         """The satellite fix itself: MEMBER_FAILED/MEMBER_ALIVE flap

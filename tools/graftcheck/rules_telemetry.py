@@ -49,8 +49,11 @@ _SPAN_NAME = re.compile(r"[a-z][a-z0-9_]*\.[a-z0-9_.{}]+")
 _PROM_NAME = re.compile(r"\bnomad_tpu_[a-z0-9]+(?:_[a-z0-9]+)+\b")
 #: fleet_* joined in ISSUE 11 (the serving-plane fleet cell's trend
 #: lines are contract like every other bench emission); chaos_* in
-#: ISSUE 12 (the chaos cell's convergence verdict + per-schedule stats)
-_BENCH_KEY = re.compile(r"^(?:trace|contention|fleet|chaos)_[a-z0-9_]+$")
+#: ISSUE 12 (the chaos cell's convergence verdict + per-schedule
+#: stats); restart_* in ISSUE 13 (kill→restart recovery + torn-tail
+#: fuzz verdicts)
+_BENCH_KEY = re.compile(
+    r"^(?:trace|contention|fleet|chaos|restart)_[a-z0-9_]+$")
 #: bench kwargs that are not emission keys
 _BENCH_KEY_EXCLUDE = {"trace_id"}
 
