@@ -264,8 +264,14 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                      allocs_per_job: int = 10, batch_size: int = 32,
                      warmup_jobs: int = 20,
                      deadline_s: float = 300.0,
-                     bursts: int = 1) -> Dict:
+                     bursts: int = 1,
+                     use_device_mesh=None) -> Dict:
     """The bench e2e shape with telemetry on; returns the decomposition.
+
+    ``use_device_mesh=True`` runs the burst's waves sharded over the
+    host's device mesh (the ISSUE 14 default on a >=2-device server;
+    tests force it on the conftest 8-virtual-CPU mesh) — the steady
+    gates then also cover sharded_wave_launches/fallbacks.
 
     Warmup compiles the wave buckets OUTSIDE the traced window (the
     steady state is what the metric is defined on — bench.py's e2e
@@ -290,6 +296,7 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
         num_workers=1,
         worker_batch_size=batch_size,
         heartbeat_ttl=3600.0,
+        use_device_mesh=use_device_mesh,
     ))
     server.start()
     was_enabled = telemetry.enabled()
@@ -368,7 +375,11 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
         observed = kernel_warmup.manifest_from_profiler(profiler)
         entries = kernel_warmup.expand_lattice(observed,
                                                max_wave=batch_size)
-        compiled, failed = kernel_warmup.warmup_entries(entries)
+        # a mesh server's steady waves dispatch SHARDED: warm those
+        # signatures too (mesh-specific, so the manifest pass alone
+        # cannot cover them)
+        compiled, failed = kernel_warmup.warmup_entries(
+            entries, mesh=server.wave_mesh)
         warmed = {"entries": len(entries), "compiled": compiled,
                   "failed": failed}
 
@@ -386,7 +397,8 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                         profiler))
                 expanded = kernel_warmup.expand_lattice(
                     observed, max_wave=batch_size)
-                c2, f2 = kernel_warmup.warmup_entries(expanded)
+                c2, f2 = kernel_warmup.warmup_entries(
+                    expanded, mesh=server.wave_mesh)
                 warmed = {"entries": len(expanded), "compiled": c2,
                           "failed": f2}
             # drain straggler acks from the previous phase (warmup or
@@ -439,13 +451,17 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
             decomp["batch_size"] = batch_size
             decomp["warmup"] = warmed
             from nomad_tpu.feasibility import default_mask_cache
-            from nomad_tpu.parallel.coalesce import wave_stats
+            from nomad_tpu.parallel.coalesce import (
+                sharded_wave_stats,
+                wave_stats,
+            )
             from nomad_tpu.server.plan_apply import plan_group_stats
             from nomad_tpu.tensors.device_state import (
                 default_device_state,
             )
 
             decomp["wave"] = wave_stats.snapshot()
+            decomp["wave_sharded"] = sharded_wave_stats.snapshot()
             decomp["device_state"] = default_device_state.snapshot()
             decomp["feasibility"] = default_mask_cache.snapshot()
             decomp["plan_group"] = plan_group_stats.snapshot()
@@ -554,6 +570,18 @@ def run_traced_burst(n_nodes: int = 1000, n_jobs: int = 100,
                 "p50_coverage", 0.0),
             "tail_p99_coverage": decomp["tail"].get(
                 "p99_coverage", 0.0),
+            # ISSUE 14 steady gates: on a mesh server every steady
+            # wave must dispatch SHARDED (launches > 0) with zero
+            # single-device fallbacks (a fallback means a node axis
+            # the mesh cannot divide leaked into the steady path);
+            # mesh_devices says how wide the slice was (0 = unsharded
+            # server, where launches is 0 by construction)
+            "sharded_wave_launches": decomp.get(
+                "wave_sharded", {}).get("launches", 0),
+            "sharded_wave_fallbacks": decomp.get(
+                "wave_sharded", {}).get("fallbacks", 0),
+            "mesh_devices": decomp.get(
+                "wave_sharded", {}).get("mesh_devices", 0),
         }
         return decomp
     finally:
@@ -998,6 +1026,339 @@ def run_fleet_burst(n_clients: int = 10_000, n_nodes: int = 400,
         if not was_enabled:
             telemetry.disable()
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The mesh cell (ISSUE 14): C2M-style replay grown to 100k heterogeneous
+# nodes / 1M resident allocs, waves sharded over the device mesh.
+# ---------------------------------------------------------------------------
+
+MESH_CELL_SEED = 14014
+
+#: heterogeneous node classes, the bench/c2m.py mix (share, cpu MHz,
+#: cores, mem MB, disk MB) — scale proof wants C2M's shape, not a
+#: uniform grid
+_MESH_NODE_CLASSES = (
+    (0.60, 4_000.0, 4, 8_192.0, 100 * 1024.0),
+    (0.25, 16_000.0, 16, 32_768.0, 200 * 1024.0),
+    (0.10, 32_000.0, 32, 65_536.0, 400 * 1024.0),
+    (0.05, 16_000.0, 16, 65_536.0, 400 * 1024.0),
+)
+
+
+class _MeshUsage:
+    """UsagePlanes stand-in for the kernel-side mesh cell: the exact
+    surface tensors/device_state.py and ClusterTensors.gathered_usage
+    consume — versioned utilization planes, a row-event log, and a
+    wave-apply that marks dirty rows. Rows are identity-mapped to
+    cluster rows (the cell owns both axes)."""
+
+    def __init__(self, node_ids) -> None:
+        import numpy as np
+
+        self.uid = "mesh-cell"
+        self.version = 1
+        self.structure_version = 0
+        self.n = len(node_ids)
+        self.rows = {nid: i for i, nid in enumerate(node_ids)}
+        self._ids = node_ids
+        self.used_cpu = np.zeros(self.n, np.float32)
+        self.used_mem = np.zeros(self.n, np.float32)
+        self.used_disk = np.zeros(self.n, np.float32)
+        self.used_cores = np.zeros(self.n, np.int32)
+        self.used_mbits = np.zeros(self.n, np.int32)
+        self.row_events: list = []
+        self.row_events_floor = 0
+        self.node_events = ()
+
+    def apply_placements(self, rows, cpu: float, mem: float,
+                         disk: float) -> None:
+        """Commit a wave's placements: deduct per chosen row, bump the
+        version, log the dirty rows — what plan apply + the usage
+        index do on the live path, collapsed to the tensor core."""
+        import numpy as np
+
+        if not len(rows):
+            return
+        np.add.at(self.used_cpu, rows, np.float32(cpu))
+        np.add.at(self.used_mem, rows, np.float32(mem))
+        np.add.at(self.used_disk, rows, np.float32(disk))
+        self.version += 1
+        v = self.version
+        self.row_events.extend((v, self._ids[int(r)])
+                               for r in set(int(r) for r in rows))
+
+
+def _mesh_cluster(n_nodes: int, seed: int):
+    """A heterogeneous ClusterTensors built VECTORIZED (the structs
+    round-trip at 100k nodes is minutes of NetworkIndex port scans the
+    cell is not about; the per-plane values are what the kernel sees
+    either way)."""
+    import numpy as np
+
+    from nomad_tpu.tensors.schema import ClusterTensors, pad_bucket
+
+    rng = np.random.default_rng(seed)
+    npad = pad_bucket(n_nodes)
+    shares = np.array([c[0] for c in _MESH_NODE_CLASSES])
+    cls = rng.choice(len(_MESH_NODE_CLASSES), size=n_nodes,
+                     p=shares / shares.sum())
+    cpu = np.array([c[1] for c in _MESH_NODE_CLASSES])[cls]
+    cores = np.array([c[2] for c in _MESH_NODE_CLASSES])[cls]
+    mem = np.array([c[3] for c in _MESH_NODE_CLASSES])[cls]
+    disk = np.array([c[4] for c in _MESH_NODE_CLASSES])[cls]
+
+    def plane(vals, dtype):
+        out = np.zeros(npad, dtype)
+        out[:n_nodes] = vals
+        return out
+
+    ready = np.zeros(npad, bool)
+    ready[:n_nodes] = True
+    ids = [f"mesh-node-{i:06d}" for i in range(n_nodes)]
+    racks = rng.integers(0, 64, size=n_nodes)
+    from nomad_tpu.tensors.schema import PORT_WORDS
+    cluster = ClusterTensors(
+        n_real=n_nodes, n_pad=npad, node_ids=ids,
+        index={nid: i for i, nid in enumerate(ids)},
+        cap_cpu=plane(cpu, np.float32),
+        cap_mem=plane(mem, np.float32),
+        cap_disk=plane(disk, np.float32),
+        ready=ready,
+        port_words=np.zeros((npad, PORT_WORDS), np.uint32),
+        free_dyn=plane(np.full(n_nodes, 12001), np.int32),
+        free_cores=plane(cores, np.int32),
+        shares_per_core=plane(cpu / np.maximum(cores, 1), np.float32),
+        datacenters=[f"dc{r % 10}" for r in racks],
+        node_classes=[""] * n_nodes,
+        computed_classes=[f"rack-{r}" for r in racks],
+        node_pools=["default"] * n_nodes,
+        avail_mbits=plane(np.full(n_nodes, 1000), np.int32),
+        _gather_lock=threading.Lock(),
+    )
+    return cluster
+
+
+def _mesh_pack_allocs(cluster, usage, n_allocs: int, seed: int) -> int:
+    """Make ``n_allocs`` C2M-ish allocations resident in the usage
+    planes, capacity-weighted over the heterogeneous nodes and clipped
+    to 90% of per-node capacity (the C2M replays run partially
+    packed). Returns the rows clipped (reported, not hidden)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed + 1)
+    n = cluster.n_real
+    cap_cpu = cluster.cap_cpu[:n].astype(np.float64)
+    picks = rng.choice(n, size=n_allocs, p=cap_cpu / cap_cpu.sum())
+    # the c2m.py JOB_SHAPES cpu/mem mix, drawn per alloc
+    shape_cpu = np.array([250, 500, 1000, 500, 2000, 4000], np.float32)
+    shape_mem = np.array([128, 256, 1024, 512, 4096, 8192], np.float32)
+    shape_p = np.array([0.35, 0.25, 0.15, 0.15, 0.07, 0.03])
+    shapes = rng.choice(len(shape_cpu), size=n_allocs,
+                        p=shape_p / shape_p.sum())
+    np.add.at(usage.used_cpu, picks, shape_cpu[shapes])
+    np.add.at(usage.used_mem, picks, shape_mem[shapes])
+    np.add.at(usage.used_disk, picks, np.float32(150.0))
+    clip_cpu = cluster.cap_cpu[:n] * 0.9
+    clip_mem = cluster.cap_mem[:n] * 0.9
+    clipped = int(np.sum((usage.used_cpu > clip_cpu)
+                         | (usage.used_mem > clip_mem)))
+    np.minimum(usage.used_cpu, clip_cpu, out=usage.used_cpu)
+    np.minimum(usage.used_mem, clip_mem, out=usage.used_mem)
+    return clipped
+
+
+def run_mesh_burst(n_nodes: int = 100_000, n_allocs: int = 1_000_000,
+                   batch_size: int = 32, steps_per_eval: int = 4,
+                   deadline_s: float = 60.0, min_waves: int = 4,
+                   max_waves: int = 200, n_devices: int = 0,
+                   seed: int = MESH_CELL_SEED) -> Dict:
+    """The ISSUE 14 scale proof: a C2M-style cluster grown to 100k
+    heterogeneous nodes / 1M resident allocs, scheduled through the
+    LIVE wave launcher with the node axis sharded over the device
+    mesh. Between waves the placements commit into the usage planes
+    and the resident device state advances by SHARDED dirty-row
+    scatter — the no-full-gather invariant is measured, not assumed:
+
+    - every wave dispatches sharded (fallbacks gated 0);
+    - d2h per wave stays the small replicated per-placement rows
+      (``no_full_gather_ok``: less than ONE [n_pad] f32 plane);
+    - dirty-row advancement stays sharded (delta advances, zero
+      usage-full re-uploads, the dirty-row byte ratio);
+    - a reference wave re-runs UNSHARDED on the same inputs and must
+      match chosen/scores/found exactly (``parity_ok``) — the same
+      bit-identity the property suite proves, standing in the cell;
+    - ``collective_share`` = per-wave overhead of sharded vs perfect
+      D-way scaling of the single-device program (on a 1-core CPU
+      host this includes the serialization of the virtual devices —
+      read it as a trajectory line per box, like every other cell).
+    """
+    import jax
+    import numpy as np
+
+    from nomad_tpu import telemetry
+    from nomad_tpu.ops.kernel import (
+        LEAN_FEATURES,
+        build_kernel_in,
+        neutral_planes,
+    )
+    from nomad_tpu.parallel import coalesce
+    from nomad_tpu.parallel.sharded import wave_mesh
+    from nomad_tpu.parallel.synthetic import synthetic_eval
+    from nomad_tpu.telemetry.histogram import percentile
+    from nomad_tpu.telemetry.kernel_profile import profiler
+    from nomad_tpu.tensors.device_state import default_device_state
+
+    mesh = wave_mesh(n_devices)
+    mesh_size = int(mesh.size)
+    cluster = _mesh_cluster(n_nodes, seed)
+    usage = _MeshUsage(cluster.node_ids)
+    clipped = _mesh_pack_allocs(cluster, usage, n_allocs, seed)
+
+    # one base eval; per-member/per-wave planes come from _replace
+    ev = synthetic_eval(cluster, desired_count=steps_per_eval)
+    neutral = neutral_planes(cluster.n_pad)
+    base_mask = cluster.ready.copy()
+    base_mask.setflags(write=False)
+    rng = np.random.default_rng(seed + 2)
+    feats = [LEAN_FEATURES._replace(with_topk=True)] * batch_size
+    steps = [steps_per_eval] * batch_size
+    # member asks: the C2M service mix again, pinned per member slot
+    ask_cpu = rng.choice([250.0, 500.0, 1000.0], size=batch_size)
+    ask_mem = rng.choice([128.0, 256.0, 1024.0], size=batch_size)
+
+    def build_wave_kins():
+        shared = cluster.wave_shared_planes(usage)
+        base = build_kernel_in(cluster, ev, steps_per_eval)
+        base = base._replace(
+            **{f: shared[f] for f in shared},
+            port_conflict=neutral.zeros_bool,
+            dev_free=neutral.zeros_dev,
+            dev_aff_score=neutral.zeros_f32,
+            job_tg_count=neutral.zeros_i32,
+            job_any_count=neutral.zeros_i32,
+            penalty=neutral.zeros_bool,
+            aff_score=neutral.zeros_f32,
+            base_mask=base_mask,
+        )
+        return [base._replace(
+            ask_cpu=np.asarray(ask_cpu[i], np.float32),
+            ask_mem=np.asarray(ask_mem[i], np.float32),
+        ) for i in range(batch_size)]
+
+    def apply_wave(outs) -> int:
+        placed = 0
+        rows = []
+        for i, out in enumerate(outs):
+            chosen = np.asarray(out.chosen)
+            found = np.asarray(out.found)
+            ok = chosen[found]
+            placed += int(found.sum())
+            rows.append(ok)
+        allrows = np.concatenate(rows) if rows else np.zeros(0, np.int64)
+        # one averaged ask per committed row keeps the apply O(rows);
+        # the kernel already deducted exact asks inside the wave
+        usage.apply_placements(allrows, float(ask_cpu.mean()),
+                               float(ask_mem.mean()), 150.0)
+        return placed
+
+    was_enabled = telemetry.enabled()
+    prior_mesh = default_device_state.mesh
+    telemetry.enable()
+    try:
+        default_device_state.configure_mesh(mesh)
+        default_device_state.ensure(cluster, usage)
+        # compile pass OUTSIDE the timed window (the steady state is
+        # the metric, like every cell): one sharded wave + its advance
+        warm_kins = build_wave_kins()
+        outs = coalesce.launch_wave(warm_kins, steps, feats, mesh=mesh)
+        apply_wave(outs)
+        default_device_state.ensure(cluster, usage)
+        telemetry.reset()
+
+        waves = 0
+        placed = 0
+        wave_ms = []
+        t0 = time.perf_counter()
+        deadline = t0 + deadline_s
+        while waves < max_waves and (
+                waves < min_waves or time.perf_counter() < deadline):
+            kins = build_wave_kins()
+            tw = time.perf_counter()
+            outs = coalesce.launch_wave(kins, steps, feats, mesh=mesh)
+            wave_ms.append((time.perf_counter() - tw) * 1e3)
+            placed += apply_wave(outs)
+            # the between-wave advance: sharded dirty-row scatter
+            default_device_state.ensure(cluster, usage)
+            waves += 1
+        wall = time.perf_counter() - t0
+        ds = default_device_state.snapshot()
+        sw = coalesce.sharded_wave_stats.snapshot()
+        prof = profiler.summary()
+        d2h_per_wave = prof["TransferBytes"]["d2h"] / max(waves, 1)
+        h2d_per_wave = prof["TransferBytes"]["h2d"] / max(waves, 1)
+        full_plane_bytes = cluster.n_pad * 4
+        misses = prof["JitCacheMisses"]
+
+        # parity + collective share: the SAME kins, sharded vs
+        # unsharded (compile excluded — first unsharded call pays it)
+        kins = build_wave_kins()
+        t_sh = time.perf_counter()
+        outs_sharded = coalesce.launch_wave(kins, steps, feats,
+                                            mesh=mesh)
+        t_sh = time.perf_counter() - t_sh
+        coalesce.launch_wave(kins, steps, feats, mesh=None)
+        t_un = time.perf_counter()
+        outs_single = coalesce.launch_wave(kins, steps, feats,
+                                           mesh=None)
+        t_un = time.perf_counter() - t_un
+        parity_ok = True
+        for a, b in zip(outs_sharded, outs_single):
+            if not (np.array_equal(np.asarray(a.chosen),
+                                   np.asarray(b.chosen))
+                    and np.array_equal(np.asarray(a.found),
+                                       np.asarray(b.found))
+                    and np.allclose(np.asarray(a.scores),
+                                    np.asarray(b.scores),
+                                    rtol=1e-6, atol=1e-7)):
+                parity_ok = False
+        collective_share = max(
+            0.0, (t_sh - t_un / mesh_size) / t_sh) if t_sh > 0 else 0.0
+
+        evals = waves * batch_size
+        return {
+            "backend": jax.default_backend(),
+            "devices": mesh_size,
+            "nodes": n_nodes,
+            "n_pad": cluster.n_pad,
+            "allocs_resident": n_allocs,
+            "allocs_clipped_rows": clipped,
+            "allocs_placed": placed,
+            "waves": waves,
+            "evals": evals,
+            "wall_s": round(wall, 3),
+            "evals_per_sec": round(evals / wall, 2) if wall else 0.0,
+            "wave_ms_p50": round(percentile(wave_ms, 0.5), 2),
+            "sharded_wave_ms": round(t_sh * 1e3, 2),
+            "single_wave_ms": round(t_un * 1e3, 2),
+            "collective_share": round(collective_share, 4),
+            "parity_ok": parity_ok,
+            "jit_cache_misses": misses,
+            "sharded_launches": sw["launches"],
+            "sharded_fallbacks": sw["fallbacks"],
+            "d2h_bytes_per_wave": round(d2h_per_wave),
+            "h2d_bytes_per_wave": round(h2d_per_wave),
+            "no_full_gather_ok": bool(
+                d2h_per_wave < full_plane_bytes),
+            "delta_advances": ds["delta_advances"],
+            "usage_full_uploads": ds["usage_full_uploads"],
+            "dirty_row_upload_ratio": ds["dirty_row_upload_ratio"],
+            "device_state": ds,
+        }
+    finally:
+        default_device_state.configure_mesh(prior_mesh)
+        if not was_enabled:
+            telemetry.disable()
 
 
 #: the chaos cell's pinned seed: every schedule below is reproduced by
@@ -2024,12 +2385,16 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--warmup-jobs", type=int, default=20)
     ap.add_argument("--bursts", type=int, default=2)
+    ap.add_argument("--mesh", action="store_true",
+                    help="shard waves over the host device mesh "
+                         "(use_device_mesh=True)")
     args = ap.parse_args()
     out_path = args.out
     decomp = run_traced_burst(
         n_nodes=args.nodes, n_jobs=args.jobs,
         allocs_per_job=args.allocs_per_job, batch_size=args.batch,
-        warmup_jobs=args.warmup_jobs, bursts=args.bursts)
+        warmup_jobs=args.warmup_jobs, bursts=args.bursts,
+        use_device_mesh=True if args.mesh else None)
     with open(out_path, "w") as f:
         json.dump(decomp, f, indent=2)
         f.write("\n")

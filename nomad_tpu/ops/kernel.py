@@ -44,6 +44,42 @@ from nomad_tpu.tensors.schema import (
     EvalTensors,
 )
 
+def _machine_cache_tag() -> str:
+    """A fingerprint of what makes an XLA:CPU AOT artifact loadable on
+    THIS host: the CPU feature set (plus arch and jax version, which
+    change the serialized format).
+
+    The persistent compilation cache stores machine-code artifacts;
+    XLA's ``cpu_aot_loader`` loads them back with only a LOG-AND-FALL-
+    BACK check against the host's features, so a cache dir carried
+    across machines (a baked container image, a shared home volume, a
+    migrated VM) floods stderr with "Target machine feature
+    +prefer-no-gather is not supported" walls on every variant load —
+    hundreds of them per warmup pass. Namespacing the cache dir by
+    this tag makes a foreign machine's artifacts simply invisible:
+    stale caches degrade to a clean recompile (into the new
+    namespace), never a spew."""
+    import hashlib
+    import platform
+
+    bits = [platform.machine(), jax.__version__]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 "flags", arm64 "Features" — the first CPU's line
+                # is the loadability contract cpu_aot_loader checks
+                if line.startswith(("flags", "Features")):
+                    bits.append(" ".join(sorted(
+                        line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        # no /proc (macOS, containers without procfs): arch + version
+        # still split caches across the incompatibility classes that
+        # have bitten (different container hosts)
+        pass
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:16]
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache (set up when the kernel module
     loads, i.e. only for consumers that actually touch the device path).
@@ -55,6 +91,12 @@ def _enable_compile_cache() -> None:
     full compiles mid-scheduling can outlive the eval broker's nack
     timeout and thrash redeliveries. Respects an existing user-set
     cache dir; disable with NOMAD_TPU_COMPILE_CACHE=0.
+
+    The cache lives in a per-machine-fingerprint subdirectory
+    (``_machine_cache_tag``): AOT artifacts are machine code, and a
+    cache dir that outlives its machine (image bake, shared volume)
+    otherwise floods stderr through XLA's cpu_aot_loader on every
+    load attempt before falling back.
     """
     import os
 
@@ -66,6 +108,7 @@ def _enable_compile_cache() -> None:
             os.path.join(os.path.expanduser("~"), ".cache", "nomad_tpu_xla"),
         )
         if cache_dir and cache_dir != "0":
+            cache_dir = os.path.join(cache_dir, _machine_cache_tag())
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

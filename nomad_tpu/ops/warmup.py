@@ -56,9 +56,12 @@ def _features_from_dict(d: Dict):
 
 def manifest_from_profiler(profiler=None) -> List[Dict]:
     """Flatten the kernel profiler's observed (kernel, bucket-key)
-    launches into JSON-able manifest entries. Sharded-wave keys are
-    skipped: their compiled program is mesh-specific and the mesh is
-    only known at runtime."""
+    launches into JSON-able manifest entries. Sharded-wave keys fold
+    into the SAME mesh-agnostic joint entries (their trailing devices
+    tuple dropped): the compiled program is mesh-specific, but the
+    bucket lattice a mesh server observes is exactly what the next
+    start must precompile — unsharded always, sharded again once its
+    own mesh probe lands (warmup_entries' ``mesh``)."""
     if profiler is None:
         from nomad_tpu.telemetry.kernel_profile import profiler as _p
 
@@ -66,6 +69,9 @@ def manifest_from_profiler(profiler=None) -> List[Dict]:
     entries: List[Dict] = []
     for kernel, key in profiler.keys():
         try:
+            if kernel == "joint_sharded" and len(key) == 8:
+                # (joint 7-key, devices-tuple): mesh-agnostic manifest
+                kernel, key = "joint", key[:7]
             if kernel == "joint" and len(key) in (6, 7):
                 # len 6: pre-job-group keys from persisted manifests
                 # (job_shared defaults True, the common layout)
@@ -364,6 +370,88 @@ def _warm_joint(e: Dict) -> bool:
     return True
 
 
+def _warm_joint_sharded(e: Dict, mesh) -> bool:
+    """Populate the SHARDED joint program's jit cache for a manifest
+    entry (parallel/sharded.make_joint_sharded) — the live signatures
+    a mesh server's waves hit:
+
+    1. every leaf host numpy (telemetry off, nothing resident — the
+       jit itself uploads per its in_shardings);
+    2. every leaf committed WITH the jit's shardings (the profiled
+       path pre-places host leaves, and resident leaves arrive
+       mesh-placed);
+    3. mixed: the layout's shared leaves committed sharded (the
+       resident cluster state + frozen singletons), the rest host.
+
+    All three trace onto ONE XLA program; the extra traces are cache
+    hits on the compilation cache. Entries whose node axis the mesh
+    does not divide are skipped — the live launcher falls back to
+    single-device dispatch for those (and counts it)."""
+    import jax
+
+    from nomad_tpu.ops.kernel import KernelIn
+    from nomad_tpu.parallel.coalesce import wave_field_is_shared
+    from nomad_tpu.parallel.sharded import (
+        joint_in_shardings,
+        make_joint_sharded,
+    )
+
+    n = int(e["nodes"])
+    if mesh is None or mesh.size < 2 or n % mesh.size != 0:
+        return False
+    b_pad = int(e["wave"])
+    t_pad = int(e["steps"])
+    shared = bool(e.get("shared", True))
+    neutral_shared = bool(e.get("neutral_shared", True))
+    job_shared = bool(e.get("job_shared", True))
+    feats = _features_from_dict(e["features"])
+    k_max = max(t_pad // max(b_pad, 1), 1)
+    kin = _dummy_kin(n, k_max)
+
+    def stack_field(f, x):
+        if wave_field_is_shared(f, shared, neutral_shared, job_shared):
+            return np.asarray(x)
+        return np.stack([np.asarray(x)] * b_pad)
+
+    stacked = KernelIn(*[
+        stack_field(f, getattr(kin, f)) for f in KernelIn._fields
+    ])
+    step_member = np.full(t_pad, -1, np.int32)
+    step_local = np.zeros(t_pad, np.int32)
+    pos = 0
+    for i in range(b_pad):
+        step_member[pos:pos + k_max] = i
+        step_local[pos:pos + k_max] = np.arange(k_max)
+        pos += k_max
+    fn = make_joint_sharded(mesh, shared, neutral_shared, job_shared)
+    kin_shardings, repl = joint_in_shardings(
+        mesh, shared, neutral_shared, job_shared)
+    arrays = (stacked, step_member, step_local)
+    shardings = (kin_shardings, repl, repl)
+    # all-host signature (jit uploads per in_shardings)
+    out = fn(*arrays, t_pad, feats)
+    jax.block_until_ready(out)
+    # all-committed signature (the profiled path)
+    placed = jax.device_put(arrays, shardings)
+    out = fn(*placed, t_pad, feats)
+    jax.block_until_ready(out)
+    # mixed signature: shared leaves resident (mesh-placed), rest host
+    # — only meaningful when the layout shares something (all-stacked
+    # waves have no resident leaves, and the mixed call would just
+    # repeat the all-host trace)
+    subs = {
+        f: jax.device_put(getattr(stacked, f),
+                          getattr(kin_shardings, f))
+        for f in KernelIn._fields
+        if wave_field_is_shared(f, shared, neutral_shared, job_shared)
+    }
+    if subs:
+        out = fn(stacked._replace(**subs), step_member, step_local,
+                 t_pad, feats)
+        jax.block_until_ready(out)
+    return True
+
+
 def _warm_single(e: Dict) -> bool:
     from nomad_tpu.ops.kernel import (
         KernelIn,
@@ -391,18 +479,29 @@ def _warm_single(e: Dict) -> bool:
     return True
 
 
-def warmup_entries(entries: List[Dict]) -> Tuple[int, int]:
+def warmup_entries(entries: List[Dict], mesh=None,
+                   mesh_only: bool = False) -> Tuple[int, int]:
     """Compile every manifest entry; returns (compiled, failed).
     Failures are logged and skipped — warmup is an optimization, never
-    a liveness dependency."""
+    a liveness dependency.
+
+    ``mesh``: ALSO warm the sharded joint signatures for this mesh
+    (the default dispatch on a >=2-device server). ``mesh_only`` skips
+    the single-device programs — the pass a server runs when its mesh
+    probe adopts a mesh AFTER the main warmup already covered them."""
     compiled = failed = 0
     node_sizes = set()
     for e in _dedupe(entries):
         try:
+            did = False
             if e.get("kernel") == "joint":
-                did = _warm_joint(e)
+                if not mesh_only:
+                    did = _warm_joint(e)
+                if mesh is not None:
+                    did = _warm_joint_sharded(e, mesh) or did
             elif e.get("kernel") in ("single_topk", "single_full"):
-                did = _warm_single(e)
+                if not mesh_only:
+                    did = _warm_single(e)
             else:
                 continue
             if did:
@@ -426,10 +525,13 @@ def warmup_entries(entries: List[Dict]) -> Tuple[int, int]:
 
 
 def warmup_from_manifest(path: str, expand: bool = True,
-                         max_wave: Optional[int] = None) -> Tuple[int, int]:
+                         max_wave: Optional[int] = None,
+                         mesh=None,
+                         mesh_only: bool = False) -> Tuple[int, int]:
     """Load ``path`` and precompile its lattice (expanded across the
     wave-bucket axis unless ``expand=False``; see ``expand_lattice``
-    for ``max_wave``). Missing/corrupt manifests are a no-op."""
+    for ``max_wave``, ``warmup_entries`` for ``mesh``/``mesh_only``).
+    Missing/corrupt manifests are a no-op."""
     try:
         entries = load_manifest(path)
     except FileNotFoundError:
@@ -439,11 +541,12 @@ def warmup_from_manifest(path: str, expand: bool = True,
         return (0, 0)
     if expand:
         entries = expand_lattice(entries, max_wave=max_wave)
-    return warmup_entries(entries)
+    return warmup_entries(entries, mesh=mesh, mesh_only=mesh_only)
 
 
 def start_background_warmup(path: str, expand: bool = True,
                             max_wave: Optional[int] = None,
+                            mesh=None,
                             on_done=None) -> threading.Thread:
     """Server-start entry point: warm the manifest on a daemon thread
     (compiles hold the XLA compile lock, not the GIL, so the server
@@ -452,7 +555,7 @@ def start_background_warmup(path: str, expand: bool = True,
     def run() -> None:
         try:
             compiled, failed = warmup_from_manifest(
-                path, expand=expand, max_wave=max_wave)
+                path, expand=expand, max_wave=max_wave, mesh=mesh)
             if compiled or failed:
                 LOG.info("kernel warmup: %d compiled, %d failed (%s)",
                          compiled, failed, path)

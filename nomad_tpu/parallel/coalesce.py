@@ -67,8 +67,57 @@ _WAVE_MESH = None
 #: sentinel: "caller did not choose" — fall back to the global; a
 #: coalescer always chooses (its server's mesh, possibly None=unsharded)
 _USE_GLOBAL = object()
-#: waves dispatched through the sharded path (asserted by tests)
+#: waves dispatched through the sharded path (asserted by tests;
+#: the richer accounting lives in ``sharded_wave_stats`` below)
 sharded_wave_launches = 0
+
+
+class _ShardedWaveStats:
+    """Sharded-dispatch accounting (exported as the
+    ``nomad_tpu_wave_sharded_*`` Prometheus series by
+    telemetry/exporter.py; reset with telemetry.reset()).
+
+    ``launches`` counts waves that ran the joint program with the node
+    axis sharded over a mesh; ``fallbacks`` counts waves that HAD a
+    mesh but dispatched single-device anyway (a node axis the device
+    count does not divide) — on a healthy mesh server this must sit at
+    ZERO, and the steady-burst gate holds it there. ``mesh_devices``
+    is the device count of the newest sharded launch (0 = never
+    sharded)."""
+
+    def __init__(self) -> None:
+        self._lock = witness_lock("ShardedWaveStats._lock")
+        self.launches = 0
+        self.fallbacks = 0
+        self.mesh_devices = 0
+
+    def note_launch(self, devices: int) -> None:
+        with self._lock:
+            self.launches += 1
+            self.mesh_devices = devices
+
+    def note_fallback(self, devices: int) -> None:
+        with self._lock:
+            self.fallbacks += 1
+            self.mesh_devices = devices
+
+    def reset(self) -> None:
+        with self._lock:
+            self.launches = 0
+            self.fallbacks = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "launches": self.launches,
+                "fallbacks": self.fallbacks,
+                "mesh_devices": self.mesh_devices,
+            }
+
+
+#: process-wide sharded-wave stats (coalescers are per-chunk and too
+#: short-lived to carry their own history, like wave_stats)
+sharded_wave_stats = _ShardedWaveStats()
 
 #: JointOut fields the launcher fetches to host EAGERLY per wave (the
 #: wave-critical d2h payload): the per-step placements the scheduler
@@ -461,6 +510,13 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
             # inert filler rows: first member with zero active steps
             filler = padded[0]._replace(n_steps=np.asarray(0, np.int32))
             padded = padded + [filler] * (b_pad - len(padded))
+        # sharded dispatch needs the node axis to split evenly over the
+        # mesh; pad_bucket's power-of-two floor (64) covers every
+        # power-of-two slice, so a fallback here means an exotic device
+        # count — counted, and gated to zero on the steady burst
+        n_nodes = int(np.asarray(padded[0].cap_cpu).shape[-1])
+        mesh_size = int(mesh.size) if mesh is not None else 0
+        wave_sharded = mesh_size >= 2 and n_nodes % mesh_size == 0
         # stack on HOST (numpy): the jit call below uploads each stacked
         # leaf once; stacking device arrays would dispatch per leaf per
         # member — thousands of round trips on a remote-device
@@ -468,12 +524,13 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
         # snapshot's utilization) are usually IDENTICAL across members;
         # when every one of _SHAREABLE_FIELDS is identity-shared, they
         # ship UNBATCHED (the joint kernel broadcasts on device) so wave
-        # upload bytes stay flat in wave size instead of B-fold. Exactly
-        # TWO layouts exist — all-shared or all-stacked — so each
-        # (bucket, features) pair costs at most two XLA variants, not
-        # one per sharing pattern.
+        # upload bytes stay flat in wave size instead of B-fold —
+        # sharded waves included: a resident sharded twin costs ZERO
+        # upload, exactly like the single-device path. Three
+        # all-or-nothing groups -> at most eight layouts per
+        # (bucket, features) pair, enumerable by warmup either way.
         def _group_shared(fields) -> bool:
-            return mesh is None and all(
+            return all(
                 all(getattr(k, f) is getattr(padded[0], f)
                     for k in padded[1:])
                 for f in fields
@@ -483,18 +540,27 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
         neutral_shareable = _group_shared(_NEUTRAL_SHAREABLE_FIELDS)
         job_shareable = _group_shared(_JOB_SHAREABLE_FIELDS)
 
+        if wave_sharded:
+            from nomad_tpu.parallel.sharded import shared_field_spec
+
         def _stack_field(f, xs):
             if wave_field_is_shared(f, shareable, neutral_shareable,
                                     job_shareable):
                 # device-resident twin when one exists (the cluster
                 # state advanced at snapshot time, frozen neutral
                 # singletons uploaded once): jit's device_put then
-                # moves ZERO bytes for this leaf. The snapshot group
+                # moves ZERO bytes for this leaf. The lookup carries
+                # the wave's placement — a sharded wave is only served
+                # mesh-placed twins (tensors/device_state.py), so the
+                # jit's in_shardings never reshard. The snapshot group
                 # is registry-only (frozen_ok=False): a STALE
                 # snapshot's read-only gathered planes must ship as
                 # host numpy, not masquerade as singletons.
                 dev = default_device_state.lookup(
-                    xs[0], frozen_ok=f not in _SHAREABLE_FIELDS)
+                    xs[0], frozen_ok=f not in _SHAREABLE_FIELDS,
+                    spec=(shared_field_spec(f) if wave_sharded
+                          else None),
+                    mesh=mesh if wave_sharded else None)
                 if dev is not None:
                     return dev
                 return np.asarray(xs[0])
@@ -526,7 +592,6 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
 
     # the jit-cache identity the bucketing scheme promises: a repeat of
     # this key must NOT recompile (the profiler counts violations)
-    n_nodes = int(stacked.cap_cpu.shape[-1])
     wave_key = (b_pad, t_pad, n_nodes, shareable, neutral_shareable,
                 job_shareable, feats)
     t_launch = time.perf_counter()
@@ -534,20 +599,28 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
     with _INFLIGHT_LOCK:
         _INFLIGHT_STARTS[token] = t_launch
     try:
-        if mesh is not None:
-            from nomad_tpu.parallel.sharded import make_joint_sharded
+        if wave_sharded:
+            from nomad_tpu.parallel.sharded import joint_sharded_entry
 
             global sharded_wave_launches
             sharded_wave_launches += 1
-            fn = make_joint_sharded(mesh)
+            sharded_wave_stats.note_launch(mesh_size)
+            # host leaves pre-place with the jit's exact in_shardings
+            # (the profiler's explicit upload would otherwise commit
+            # them to one device and the call would pay a reshard);
+            # step planes ship replicated, raw numpy on purpose
+            fn, kin_shardings, repl = joint_sharded_entry(
+                mesh, shareable, neutral_shareable, job_shareable)
             out = profiler.call(
                 "joint_sharded", fn,
-                (stacked, jnp.asarray(step_member),
-                 jnp.asarray(step_local)),
+                (stacked, step_member, step_local),
                 (t_pad, feats),
                 wave_key + (tuple(mesh.devices.flat),), jit_fn=fn,
+                shardings=(kin_shardings, repl, repl),
             )
         else:
+            if mesh is not None:
+                sharded_wave_stats.note_fallback(mesh_size)
             out = profiler.call(
                 "joint", place_taskgroups_joint_jit,
                 (stacked, jnp.asarray(step_member),

@@ -152,37 +152,96 @@ _JOINT_SPECS = dict(
 
 assert set(_JOINT_SPECS) == set(KernelIn._fields)
 
+
+def shared_field_spec(field: str) -> P:
+    """PartitionSpec of a WAVE-SHARED (unbatched) KernelIn leaf: the
+    stacked layout's spec minus the leading member axis. Single source
+    of truth for the sharded launcher, the device-resident state's
+    frozen-singleton placement, and the AOT warmup — a drift here
+    would make a resident plane's sharding miss the jit's
+    ``in_shardings`` and silently reshard every wave."""
+    return P(*tuple(_JOINT_SPECS[field])[1:])
+
+
+def node_axis_sharding(mesh: Mesh) -> NamedSharding:
+    """The [n_pad] node-plane sharding: rows split over the mesh's
+    nodes axis (tensors/device_state.py places resident generations
+    with this)."""
+    return NamedSharding(mesh, P(_N))
+
+
+def joint_in_shardings(mesh: Mesh, shared: bool = False,
+                       neutral_shared: bool = False,
+                       job_shared: bool = False):
+    """(KernelIn-of-NamedSharding, replicated) for a wave layout: a
+    field that ships UNBATCHED under the layout flags loses the member
+    axis and keeps its node-axis split; stacked fields keep the full
+    joint spec. The launcher pre-places host leaves with exactly these
+    shardings so the jit's ``in_shardings`` never reshard."""
+    from nomad_tpu.parallel.coalesce import wave_field_is_shared
+
+    kin = KernelIn(**{
+        f: NamedSharding(
+            mesh,
+            shared_field_spec(f)
+            if wave_field_is_shared(f, shared, neutral_shared,
+                                    job_shared)
+            else s)
+        for f, s in _JOINT_SPECS.items()
+    })
+    return kin, NamedSharding(mesh, P())
+
+
 import weakref
 
 # keyed by the live mesh OBJECT (weakly): a freed mesh's entry
 # evicts itself, and an unrelated mesh allocated at the same address
-# can never collide with a stale jit bound to dead devices
+# can never collide with a stale jit bound to dead devices. Each
+# mesh maps sharing-layout flags -> the compiled wrapper (the sharing
+# groups change leaf SHAPES, so every layout is its own in_shardings
+# pytree; the (t_steps, features) variants are cached by jit itself).
 _joint_sharded_cache: "weakref.WeakKeyDictionary" = \
     weakref.WeakKeyDictionary()
 
 
-def make_joint_sharded(mesh: Mesh):
-    """jit of place_taskgroups_joint with the node axis sharded over
-    ``mesh``'s nodes axis. Cached per mesh; the (t_steps, features)
-    variants are cached by jit itself (static args)."""
+def joint_sharded_entry(mesh: Mesh, shared: bool = False,
+                        neutral_shared: bool = False,
+                        job_shared: bool = False):
+    """(jit fn, KernelIn-of-NamedSharding, replicated) for the joint
+    wave program with the node axis sharded over ``mesh``'s nodes axis
+    under the given sharing layout. Cached per (mesh, layout) as ONE
+    entry — the launcher needs the shardings on every wave (to
+    pre-place host leaves), so rebuilding ~40 NamedShardings per
+    launch would be repeated dispatch-path work; the (t_steps,
+    features) variants are cached by jit itself (static args)."""
     from nomad_tpu.ops.kernel import place_taskgroups_joint
 
-    key = mesh
-    hit = _joint_sharded_cache.get(key)
+    layouts = _joint_sharded_cache.get(mesh)
+    if layouts is None:
+        layouts = _joint_sharded_cache[mesh] = {}
+    key = (shared, neutral_shared, job_shared)
+    hit = layouts.get(key)
     if hit is not None:
         return hit
-    kin_shardings = KernelIn(
-        **{f: NamedSharding(mesh, s) for f, s in _JOINT_SPECS.items()}
-    )
-    repl = NamedSharding(mesh, P())
+    kin_shardings, repl = joint_in_shardings(
+        mesh, shared, neutral_shared, job_shared)
     fn = jax.jit(
         place_taskgroups_joint,
         static_argnums=(3, 4),
         in_shardings=(kin_shardings, repl, repl),
         out_shardings=repl,      # outputs are small per-step rows
     )
-    _joint_sharded_cache[key] = fn
-    return fn
+    entry = (fn, kin_shardings, repl)
+    layouts[key] = entry
+    return entry
+
+
+def make_joint_sharded(mesh: Mesh, shared: bool = False,
+                       neutral_shared: bool = False,
+                       job_shared: bool = False):
+    """The compiled wrapper alone (see ``joint_sharded_entry``)."""
+    return joint_sharded_entry(mesh, shared, neutral_shared,
+                               job_shared)[0]
 
 
 def wave_mesh(n_devices: int = 0, devices=None) -> Mesh:

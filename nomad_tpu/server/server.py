@@ -363,6 +363,9 @@ class Server:
         # sharding); per-server, so co-resident servers with different
         # meshes cannot clobber each other
         self.wave_mesh = None
+        # whether THIS server configured the process-wide resident
+        # cluster state's mesh (released at shutdown)
+        self._owns_device_state_mesh = False
 
     # --- lifecycle ------------------------------------------------------
 
@@ -482,14 +485,8 @@ class Server:
         forces (a missing manifest is then just zero entries); False
         disables."""
         self._warmup_thread = None
-        if self.config.kernel_warmup is False:
-            return
-        path = self.config.warmup_manifest_path
-        if not path:
-            from nomad_tpu.ops.warmup import DEFAULT_MANIFEST_PATH
-
-            path = DEFAULT_MANIFEST_PATH
-        if self.config.kernel_warmup is None and not os.path.exists(path):
+        path = self._warmup_manifest_path()
+        if path is None:
             return
         try:
             from nomad_tpu.ops.warmup import start_background_warmup
@@ -506,6 +503,21 @@ class Server:
                     1))
         except Exception as e:                  # noqa: BLE001
             LOG.warning("kernel warmup unavailable: %s", e)
+
+    def _warmup_manifest_path(self):
+        """The manifest path AOT warmup should compile from, or None
+        when warmup is disabled (kernel_warmup=False) or auto mode
+        finds no manifest to warm."""
+        if self.config.kernel_warmup is False:
+            return None
+        path = self.config.warmup_manifest_path
+        if not path:
+            from nomad_tpu.ops.warmup import DEFAULT_MANIFEST_PATH
+
+            path = DEFAULT_MANIFEST_PATH
+        if self.config.kernel_warmup is None and not os.path.exists(path):
+            return None
+        return path
 
     def _maybe_persist_warmup_manifest(self) -> None:
         """Union the profiler's observed bucket keys into the warmup
@@ -582,6 +594,80 @@ class Server:
                              "devices", len(devs), backend)
                 except Exception as e:          # noqa: BLE001
                     LOG.warning("device mesh unavailable: %s", e)
+                    return
+                try:
+                    # adopt the mesh into the process-wide resident
+                    # cluster state so generations shard their node
+                    # axis (tensors/device_state.py) and this server's
+                    # sharded waves find mesh-placed twins. First mesh
+                    # wins: a co-resident server with a DIFFERENT mesh
+                    # keeps launching sharded but ships host planes
+                    # (correct, just unassisted) instead of evicting
+                    # the first server's residency per interleave.
+                    from nomad_tpu.tensors.device_state import (
+                        default_device_state,
+                    )
+
+                    if default_device_state.mesh is None \
+                            and not self._shutdown.is_set():
+                        default_device_state.configure_mesh(
+                            self.wave_mesh)
+                        self._owns_device_state_mesh = True
+                        if self._shutdown.is_set():
+                            # shutdown raced the adoption (it read
+                            # _owns_device_state_mesh=False and has no
+                            # release left to run): undo here so the
+                            # process-global state never outlives its
+                            # owner mesh-configured
+                            default_device_state.configure_mesh(None)
+                            self._owns_device_state_mesh = False
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("device-state mesh adoption "
+                                "failed: %s", e)
+                try:
+                    # the sharded joint programs are mesh-specific, so
+                    # the manifest pass in _maybe_start_kernel_warmup
+                    # cannot precompile them before the probe finishes
+                    # — warm them under the same manifest gating, on
+                    # their OWN daemon thread: an explicit-opt-in
+                    # start joins the probe for deterministic mesh
+                    # availability and must not also wait out a
+                    # compile pass
+                    path = self._warmup_manifest_path()
+                    if path is not None and not self._shutdown.is_set():
+                        mesh = self.wave_mesh
+
+                        def _warm_sharded() -> None:
+                            try:
+                                from nomad_tpu.ops.warmup import (
+                                    warmup_from_manifest,
+                                )
+                                from nomad_tpu.server.worker import (
+                                    Worker,
+                                )
+
+                                compiled, failed = \
+                                    warmup_from_manifest(
+                                        path,
+                                        max_wave=max(min(
+                                            self.config
+                                            .worker_batch_size,
+                                            Worker.MAX_WAVE), 1),
+                                        mesh=mesh, mesh_only=True)
+                                if compiled or failed:
+                                    LOG.info(
+                                        "sharded kernel warmup: %d "
+                                        "compiled, %d failed",
+                                        compiled, failed)
+                            except Exception as e:  # noqa: BLE001
+                                LOG.warning("sharded kernel warmup "
+                                            "failed: %s", e)
+
+                        threading.Thread(
+                            target=_warm_sharded, daemon=True,
+                            name="sharded-kernel-warmup").start()
+                except Exception as e:          # noqa: BLE001
+                    LOG.warning("sharded kernel warmup failed: %s", e)
 
             t = threading.Thread(target=_probe, daemon=True,
                                  name="device-mesh-probe")
@@ -595,6 +681,19 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        if getattr(self, "_owns_device_state_mesh", False):
+            # release the resident state's mesh placement so a later
+            # unsharded server (or a test after this one) gets
+            # single-device residency back instead of permanent misses
+            try:
+                from nomad_tpu.tensors.device_state import (
+                    default_device_state,
+                )
+
+                default_device_state.configure_mesh(None)
+            except Exception:                   # noqa: BLE001
+                pass
+            self._owns_device_state_mesh = False
         self.wave_mesh = None
         self._maybe_persist_warmup_manifest()
         self.vault.stop()

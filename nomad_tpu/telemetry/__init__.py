@@ -71,9 +71,13 @@ def reset() -> None:
         # coalescer; reset them with the rest so burst decompositions
         # cover exactly their window. Import is lazy/guarded: telemetry
         # must stay importable without jax.
-        from nomad_tpu.parallel.coalesce import wave_stats
+        from nomad_tpu.parallel.coalesce import (
+            sharded_wave_stats,
+            wave_stats,
+        )
 
         wave_stats.reset()
+        sharded_wave_stats.reset()
     except Exception:                           # noqa: BLE001
         pass
     try:

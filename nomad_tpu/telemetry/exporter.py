@@ -111,6 +111,26 @@ def prometheus_text(registry=None, event_broker=None) -> str:
         lines.append(
             'nomad_tpu_wave_launches_total{fired="deadline"} '
             f"{w['deadline_launches']}")
+        # sharded dispatch (ISSUE 14): waves that ran the joint program
+        # over a device mesh vs mesh-present single-device fallbacks
+        # (a node axis the device count does not divide) — fallbacks
+        # must sit at 0 on a healthy mesh server, and the mesh-device
+        # gauge says how wide the slice is
+        from nomad_tpu.parallel.coalesce import sharded_wave_stats
+
+        s = sharded_wave_stats.snapshot()
+        lines.append(
+            "# TYPE nomad_tpu_wave_sharded_launches_total counter")
+        lines.append(
+            f"nomad_tpu_wave_sharded_launches_total {s['launches']}")
+        lines.append(
+            "# TYPE nomad_tpu_wave_sharded_fallbacks_total counter")
+        lines.append(
+            f"nomad_tpu_wave_sharded_fallbacks_total {s['fallbacks']}")
+        lines.append(
+            "# TYPE nomad_tpu_wave_sharded_mesh_devices gauge")
+        lines.append(
+            f"nomad_tpu_wave_sharded_mesh_devices {s['mesh_devices']}")
     except Exception:                           # noqa: BLE001
         pass                # coalescer (jax) unavailable: skip series
     # device-resident cluster state (tensors/device_state.py): how the

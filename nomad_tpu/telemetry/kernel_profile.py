@@ -131,7 +131,8 @@ class KernelProfiler:
     # --- the profiled launch -------------------------------------------
 
     def call(self, kernel: str, fn: Callable, dev_args: tuple,
-             static_args: tuple, key: tuple, jit_fn=None):
+             static_args: tuple, key: tuple, jit_fn=None,
+             shardings=None):
         """Run ``fn(*dev_args, *static_args)`` decomposed into h2d /
         compile-or-dispatch / execute stages. ``dev_args`` is the array
         pytree uploaded to the device; ``static_args`` (jit static
@@ -139,7 +140,10 @@ class KernelProfiler:
         ``key`` is the bucket-shape identity the compile cache SHOULD
         be keyed by; ``jit_fn`` (when it differs from ``fn``, e.g. a
         sharded wrapper) is the object whose ``_cache_size`` is
-        consulted for the cross-check."""
+        consulted for the cross-check. ``shardings`` (a pytree
+        matching ``dev_args``) places host leaves at upload time — a
+        sharded wave's explicit h2d must land each leaf with the jit's
+        in_shardings, or the call would pay a hidden reshard."""
         if not self._enabled:
             return fn(*dev_args, *static_args)
         import time
@@ -168,6 +172,12 @@ class KernelProfiler:
         host_idx = [i for i, x in enumerate(leaves)
                     if not isinstance(x, jax.Array)]
         host_leaves = [leaves[i] for i in host_idx]
+        shard_leaves = None
+        if shardings is not None and host_leaves:
+            flat_shards = jax.tree_util.tree_flatten(
+                shardings, is_leaf=lambda x: x is None)[0]
+            if len(flat_shards) == len(leaves):
+                shard_leaves = [flat_shards[i] for i in host_idx]
         up_bytes = sum(getattr(x, "nbytes", 0) for x in host_leaves)
         with tracer.span("kernel.h2d"):
             t0 = time.perf_counter()
@@ -176,7 +186,7 @@ class KernelProfiler:
                 # call arrays with in-flight transfers makes the
                 # dispatch itself stall holding the GIL, which
                 # serializes every eval thread behind this launch
-                put = jax.device_put(host_leaves)
+                put = jax.device_put(host_leaves, shard_leaves)
                 jax.block_until_ready(put)
                 for i, v in zip(host_idx, put):
                     leaves[i] = v

@@ -23,6 +23,21 @@ construction and property-tested against a fresh
 ``ClusterTensors.build`` + upload (tests/test_device_state.py, the
 device mirror of tests/test_cluster_delta.py).
 
+Mesh sharding (ISSUE 14): when a device mesh is configured
+(``configure_mesh``; the server adopts its wave mesh here), resident
+generations are placed with a ``NamedSharding`` that splits the node
+axis over the mesh's ``nodes`` axis — each device holds its shard of
+every wave-shared plane, and the dirty-row scatter advances THOSE
+sharded buffers in place-of-layout (a per-mesh jit with sharded
+in/out shardings, so wave-to-wave advancement never gathers a plane
+to one device and never reshards). Frozen singletons are placed per
+KernelIn-field partition spec (parallel/sharded.shared_field_spec) and
+keyed by (array identity, spec), so the same neutral plane can be
+resident both unsharded and sharded. Lookups carry the caller's mesh:
+a single-device launch never receives a sharded buffer (it would
+reshard inside the jit), and vice versa — mismatches just miss and
+ship host planes, which is always correct.
+
 Dirty-row provenance:
 
 - utilization planes: ``UsagePlanes.row_events`` (state/usage.py), the
@@ -71,49 +86,130 @@ def _row_bucket(r: int) -> int:
     return b
 
 
-@jax.jit
-def _scatter_rows(plane, rows, vals):
+def _scatter_rows_impl(plane, rows, vals):
     """``plane.at[rows].set(vals)``; padding rows are out of bounds on
     purpose — scatter drops OOB updates, so a bucketed row batch never
     touches rows it wasn't given."""
     return plane.at[rows].set(vals)
 
 
+_scatter_rows = jax.jit(_scatter_rows_impl)
+
+#: per-mesh sharded scatter jits (weak: a freed mesh drops its entry).
+#: The plane stays split over the nodes axis IN and OUT — advancement
+#: of a sharded generation never gathers the plane to one device; row
+#: indices address the GLOBAL node axis and ship replicated, each
+#: shard applies the updates that land in its slice.
+import weakref
+
+_sharded_scatter_cache: "weakref.WeakKeyDictionary" = \
+    weakref.WeakKeyDictionary()
+
+
+def _sharded_scatter(mesh):
+    fn = _sharded_scatter_cache.get(mesh)
+    if fn is None:
+        from nomad_tpu.parallel.sharded import node_axis_sharding
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        plane_s = node_axis_sharding(mesh)
+        repl = NamedSharding(mesh, PartitionSpec())
+        fn = jax.jit(_scatter_rows_impl,
+                     in_shardings=(plane_s, repl, repl),
+                     out_shardings=plane_s)
+        _sharded_scatter_cache[mesh] = fn
+    return fn
+
+
+def _mesh_match(a, b) -> bool:
+    """Two mesh handles name the same placement (None = single device;
+    jax Mesh compares by devices + axis names)."""
+    if a is None or b is None:
+        return a is None and b is None
+    return a is b or a == b
+
+
 class _Generation:
     """One resident (uid, structure_version) generation."""
 
-    __slots__ = ("key", "cluster", "version", "planes", "host_ids")
+    __slots__ = ("key", "cluster", "version", "planes", "host_ids",
+                 "mesh")
 
-    def __init__(self, key, cluster, version, planes):
+    def __init__(self, key, cluster, version, planes, mesh=None):
         self.key = key
         self.cluster = cluster          # host build (identity anchor)
         self.version = version          # usage version of the planes
         self.planes: Dict[str, object] = planes   # field -> device array
         self.host_ids: Tuple[int, ...] = ()
+        self.mesh = mesh                # placement (None = one device)
 
 
 class DeviceClusterState:
     """LRU of device-resident wave-shared plane generations."""
 
     def __init__(self, max_generations: int = 4,
-                 max_frozen: int = 256) -> None:
+                 max_frozen: int = 256, mesh=None) -> None:
         self._lock = threading.Lock()
         self._gens: "OrderedDict[tuple, _Generation]" = OrderedDict()
         #: uid -> newest resident structure_version (the fork base)
         self._latest: Dict[str, int] = {}
-        #: id(host array) -> (host array, device array). Strong host
-        #: refs pin ids against reuse; entries leave with their
-        #: generation (or the frozen LRU).
+        #: id(host array) -> (host array, device array, mesh). Strong
+        #: host refs pin ids against reuse; entries leave with their
+        #: generation. Generations only — frozen singletons live in
+        #: the spec-keyed LRU below.
         self._registry: Dict[int, tuple] = {}
-        self._frozen: "OrderedDict[int, tuple]" = OrderedDict()
-        #: id(arr) -> Event for frozen uploads in flight: the upload
+        #: (id(host array), spec key) -> (host array, device array).
+        #: The spec key is None for single-device placement or the
+        #: field's PartitionSpec tuple under the configured mesh — the
+        #: same neutral singleton can be resident under both.
+        self._frozen: "OrderedDict[tuple, tuple]" = OrderedDict()
+        #: frozen-cache key -> Event for uploads in flight: the upload
         #: itself runs OUTSIDE self._lock (graftcheck R2 — a first-
         #: sight frozen upload under the registry lock stalled every
         #: concurrent snapshot-time advance behind one h2d transfer)
-        self._frozen_inflight: Dict[int, threading.Event] = {}
+        self._frozen_inflight: Dict[tuple, threading.Event] = {}
         self.max_generations = max_generations
         self.max_frozen = max_frozen
+        #: device mesh future generations shard their node axis over
+        #: (None = single-device placement, the default)
+        self._mesh = mesh
         self.reset_stats()
+
+    # --- mesh -----------------------------------------------------------
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def configure_mesh(self, mesh) -> None:
+        """Shard future resident generations' node axis over ``mesh``
+        (None restores single-device placement). A CHANGE of placement
+        evicts everything resident: a plane placed for the old mesh
+        can only mis-serve the new dispatch path. The server adopts
+        its wave mesh here when it comes up; tests and the bench mesh
+        cell configure/restore around their bursts."""
+        with self._lock:
+            if _mesh_match(mesh, self._mesh):
+                return
+            self._mesh = mesh
+            for gen in list(self._gens.values()):
+                self._evict(gen)
+            self._gens.clear()
+            self._latest.clear()
+            self._registry.clear()
+            self._frozen.clear()
+
+    def _node_sharding(self, n_pad: int):
+        """NamedSharding for [n_pad] node planes under the configured
+        mesh, or None for single-device placement (no mesh, or a node
+        axis the mesh's device count does not divide — the launcher
+        makes the same divisibility call and falls back unsharded)."""
+        mesh = self._mesh
+        if mesh is None or mesh.size < 2 or n_pad % mesh.size != 0:
+            return None
+        from nomad_tpu.parallel.sharded import node_axis_sharding
+
+        return node_axis_sharding(mesh)
 
     # --- stats ----------------------------------------------------------
 
@@ -143,15 +239,25 @@ class DeviceClusterState:
                     round(self.bytes_uploaded / self.bytes_full_equiv, 4)
                     if self.bytes_full_equiv else 0.0),
                 "resident_generations": len(self._gens),
+                "mesh_devices": (int(self._mesh.size)
+                                 if self._mesh is not None else 0),
             }
 
     # --- registry -------------------------------------------------------
 
-    def lookup(self, arr, frozen_ok: bool = True) -> Optional[object]:
-        """Committed device twin of ``arr``, or None. With
-        ``frozen_ok``, frozen host arrays (read-only singletons) are
-        made resident on first sight; mutable arrays are served only
-        when a generation registered them.
+    def lookup(self, arr, frozen_ok: bool = True, spec=None,
+               mesh=None) -> Optional[object]:
+        """Committed device twin of ``arr`` placed for ``mesh``, or
+        None. With ``frozen_ok``, frozen host arrays (read-only
+        singletons) are made resident on first sight; mutable arrays
+        are served only when a generation registered them.
+
+        ``mesh``/``spec`` are the caller's dispatch placement: a
+        single-device launch (mesh None) never receives a sharded
+        buffer, a sharded wave never receives a single-device one —
+        either would reshard inside the jit and fork its cache.
+        ``spec`` (a PartitionSpec, sharded callers only) is the
+        KernelIn field's partition for frozen-singleton placement.
 
         Callers pass ``frozen_ok=False`` for the snapshot-plane group:
         gathered utilization planes are ALSO read-only, and a stale
@@ -161,13 +267,27 @@ class DeviceClusterState:
         if not isinstance(arr, np.ndarray):
             return None
         ent = self._registry.get(id(arr))
-        if ent is not None and ent[0] is arr:
+        if ent is not None and ent[0] is arr \
+                and _mesh_match(ent[2], mesh):
             return ent[1]
         if frozen_ok and not arr.flags.writeable:
-            return self._frozen_resident(arr)
+            # lock-free fast path (like the registry read above): a
+            # resident frozen singleton is served without touching the
+            # lock the advance path holds — only a MISS pays the
+            # claim-and-upload dance. Sharded entries are placed for
+            # THIS state's mesh, so a caller on a foreign mesh must
+            # fall through (and be rejected by the slow path) — the
+            # spec key alone would collide across meshes.
+            spec_key = None if (spec is None or mesh is None) \
+                else tuple(spec)
+            if spec_key is None or _mesh_match(mesh, self._mesh):
+                ent = self._frozen.get((id(arr), spec_key))
+                if ent is not None and ent[0] is arr:
+                    return ent[1]
+            return self._frozen_resident(arr, spec, mesh)
         return None
 
-    def _frozen_resident(self, arr: np.ndarray):
+    def _frozen_resident(self, arr: np.ndarray, spec=None, mesh=None):
         # claim under the lock, upload outside it: the device_put of a
         # first-sight frozen singleton must not hold the registry lock
         # (it is shared with the dirty-row advance path every eval
@@ -175,7 +295,19 @@ class DeviceClusterState:
         # callers for the same array wait on the claim's event; a
         # caller who finds the upload failed just misses (residency is
         # an optimization, the host array still works).
-        key = id(arr)
+        sharding = None
+        if mesh is not None:
+            # sharded placement only under THIS state's configured
+            # mesh: uploading under a foreign mesh would pin arrays no
+            # dispatch path of this state ever serves
+            if not _mesh_match(mesh, self._mesh) or spec is None:
+                return None
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(self._mesh, spec)
+        spec_key = None if spec is None or mesh is None \
+            else tuple(spec)
+        key = (id(arr), spec_key)
         while True:
             with self._lock:
                 ent = self._frozen.get(key)
@@ -190,15 +322,22 @@ class DeviceClusterState:
                 return None     # uploader wedged: serve the host array
         dev = None
         try:
-            dev = self._upload({"_frozen": arr})["_frozen"]
+            dev = self._upload({"_frozen": arr},
+                               sharding=sharding)["_frozen"]
             with self._lock:
-                self._frozen[key] = (arr, dev)
-                self._registry[key] = (arr, dev)
-                while len(self._frozen) > self.max_frozen:
-                    old_id, (old_arr, _) = self._frozen.popitem(last=False)
-                    ent = self._registry.get(old_id)
-                    if ent is not None and ent[0] is old_arr:
-                        self._registry.pop(old_id, None)
+                # re-validate placement before inserting: the upload
+                # ran off-lock, and a racing configure_mesh may have
+                # cleared the cache for a NEW mesh — a sharded buffer
+                # placed for the old one must not be re-inserted under
+                # a spec key the new mesh's lookups would hit (the key
+                # encodes the spec, not the mesh). Unsharded entries
+                # stay valid under any mesh.
+                if spec_key is None or _mesh_match(mesh, self._mesh):
+                    self._frozen[key] = (arr, dev)
+                    while len(self._frozen) > self.max_frozen:
+                        self._frozen.popitem(last=False)
+                else:
+                    dev = None      # stale placement: callers miss
         finally:
             with self._lock:
                 self._frozen_inflight.pop(key, None)
@@ -211,7 +350,7 @@ class DeviceClusterState:
             self._registry.pop(hid, None)
         ids = []
         for f, host in host_planes.items():
-            self._registry[id(host)] = (host, gen.planes[f])
+            self._registry[id(host)] = (host, gen.planes[f], gen.mesh)
             ids.append(id(host))
         gen.host_ids = tuple(ids)
 
@@ -224,9 +363,12 @@ class DeviceClusterState:
 
     # --- uploads --------------------------------------------------------
 
-    def _upload(self, host_planes: Dict[str, np.ndarray]) -> Dict:
-        """Full upload of ``host_planes``; spans + byte-counts the real
-        h2d it performs (the kernel profiler's transfer accounting)."""
+    def _upload(self, host_planes: Dict[str, np.ndarray],
+                sharding=None) -> Dict:
+        """Full upload of ``host_planes`` (placed with ``sharding``
+        when given — the mesh path's node-axis split); spans +
+        byte-counts the real h2d it performs (the kernel profiler's
+        transfer accounting)."""
         from nomad_tpu.telemetry.kernel_profile import profiler
         from nomad_tpu.telemetry.trace import tracer
 
@@ -236,7 +378,12 @@ class DeviceClusterState:
         # decomposition must not sum it into the wave-critical-path
         # kernel.h2d wall stage
         with tracer.span("state.h2d"):
-            dev = {f: jax.device_put(a) for f, a in host_planes.items()}
+            if sharding is None:
+                dev = {f: jax.device_put(a)
+                       for f, a in host_planes.items()}
+            else:
+                dev = {f: jax.device_put(a, sharding)
+                       for f, a in host_planes.items()}
             if tracer.enabled:
                 jax.block_until_ready(list(dev.values()))
         profiler.add_bytes("h2d", n_bytes)
@@ -244,14 +391,23 @@ class DeviceClusterState:
         return dev
 
     def _scatter(self, planes: Dict, host_planes: Dict[str, np.ndarray],
-                 rows) -> Dict:
+                 rows, mesh=None) -> Dict:
         """Advance ``planes`` to match ``host_planes`` given that only
         ``rows`` differ: upload rows + per-plane values, scatter on
         device. Row indices are bucketed with out-of-bounds padding
-        (dropped by the scatter)."""
+        (dropped by the scatter). Sharded generations advance through
+        the per-mesh sharded scatter: the plane stays split over the
+        nodes axis end to end, only the dirty rows and their GLOBAL
+        indices ship (replicated — they are a few KB)."""
         from nomad_tpu.telemetry.kernel_profile import profiler
         from nomad_tpu.telemetry.trace import tracer
 
+        scatter = _scatter_rows if mesh is None else _sharded_scatter(mesh)
+        repl = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(mesh, PartitionSpec())
         rows = np.asarray(sorted(rows), np.int32)
         any_plane = next(iter(host_planes.values()))
         n_pad = any_plane.shape[0]
@@ -260,14 +416,16 @@ class DeviceClusterState:
         rows_p[:len(rows)] = rows
         n_bytes = rows_p.nbytes
         with tracer.span("state.h2d"):
-            rows_dev = jax.device_put(rows_p)
+            rows_dev = jax.device_put(rows_p) if repl is None \
+                else jax.device_put(rows_p, repl)
             out = dict(planes)
             for f, host in host_planes.items():
                 vals = np.zeros(rb, host.dtype)
                 vals[:len(rows)] = host[rows]
                 n_bytes += vals.nbytes
-                out[f] = _scatter_rows(planes[f], rows_dev,
-                                       jax.device_put(vals))
+                vals_dev = jax.device_put(vals) if repl is None \
+                    else jax.device_put(vals, repl)
+                out[f] = scatter(planes[f], rows_dev, vals_dev)
             if tracer.enabled:
                 jax.block_until_ready(list(out.values()))
         profiler.add_bytes("h2d", n_bytes)
@@ -278,20 +436,36 @@ class DeviceClusterState:
     def warm_scatter(self, n_pad: int) -> int:
         """AOT-compile the dirty-row scatter for every row bucket and
         plane dtype of a node size (ops/warmup.py calls this with the
-        manifest's node shapes). The scatter is raw ``jax.jit`` — its
+        manifest's node shapes), including the sharded variant when a
+        mesh is configured. The scatter is raw ``jax.jit`` — its
         compiles never show in the profiler's miss accounting, but a
         steady burst whose dirty-row count crosses into a fresh bucket
         used to pay a cold compile INSIDE an eval's snapshot phase.
         Returns the number of (bucket, dtype) programs touched."""
         done = 0
+        sharding = self._node_sharding(n_pad)
+        variants = [(_scatter_rows, None)]
+        if sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            variants.append((_sharded_scatter(self._mesh),
+                             NamedSharding(self._mesh, PartitionSpec())))
         b = _MIN_ROW_BUCKET
         while b <= max(n_pad, _MIN_ROW_BUCKET):
-            rows = jax.device_put(np.full(b, n_pad, np.int32))
-            for dtype in (np.float32, np.int32):
-                plane = jax.device_put(np.zeros(n_pad, dtype))
-                vals = jax.device_put(np.zeros(b, dtype))
-                jax.block_until_ready(_scatter_rows(plane, rows, vals))
-                done += 1
+            for scatter, repl in variants:
+                rows_h = np.full(b, n_pad, np.int32)
+                rows = jax.device_put(rows_h) if repl is None \
+                    else jax.device_put(rows_h, repl)
+                for dtype in (np.float32, np.int32):
+                    if repl is None:
+                        plane = jax.device_put(np.zeros(n_pad, dtype))
+                        vals = jax.device_put(np.zeros(b, dtype))
+                    else:
+                        plane = jax.device_put(np.zeros(n_pad, dtype),
+                                               sharding)
+                        vals = jax.device_put(np.zeros(b, dtype), repl)
+                    jax.block_until_ready(scatter(plane, rows, vals))
+                    done += 1
             if b >= n_pad:
                 break
             b *= 2
@@ -379,21 +553,32 @@ class DeviceClusterState:
         return {nid for v, nid in getattr(usage, "row_events", ())
                 if v > since_version}
 
+    def _gen_sharding(self, gen: _Generation):
+        if gen.mesh is None:
+            return None
+        from nomad_tpu.parallel.sharded import node_axis_sharding
+
+        return node_axis_sharding(gen.mesh)
+
     def _advance_usage(self, gen: _Generation,
                        host: Dict[str, np.ndarray], usage) -> None:
         """Same (uid, structure_version), newer usage version: only
-        utilization rows can have moved."""
+        utilization rows can have moved. A sharded generation advances
+        sharded — the scatter and the unprovable-log full-upload
+        fallback both keep the generation's placement."""
         changed = self._usage_rows_changed(usage, gen.version)
         usage_host = {f: host[f]
                       for f in ClusterTensors.WAVE_USAGE_FIELDS}
         if changed is None:
             self.usage_full_uploads += 1
-            gen.planes.update(self._upload(usage_host))
+            gen.planes.update(self._upload(
+                usage_host, sharding=self._gen_sharding(gen)))
             return
         rows = {gen.cluster.index[nid] for nid in changed
                 if nid in gen.cluster.index}
         if rows:
-            gen.planes = self._scatter(gen.planes, usage_host, rows)
+            gen.planes = self._scatter(gen.planes, usage_host, rows,
+                                       mesh=gen.mesh)
         self.delta_advances += 1
 
     def _fork_or_build(self, key, cluster: ClusterTensors,
@@ -402,20 +587,27 @@ class DeviceClusterState:
         from the newest resident generation of the same store by
         dirty-row scatter when the node-change log proves the dirty
         set AND surviving rows kept their positions; otherwise a full
-        upload."""
+        upload. Placement follows the configured mesh (the fork path
+        requires the base's placement to match — the same n_pad under
+        the same mesh always does)."""
+        sharding = self._node_sharding(cluster.n_pad)
+        gen_mesh = self._mesh if sharding is not None else None
         uid, sv = key
         base_sv = self._latest.get(uid)
         base = (self._gens.get((uid, base_sv))
                 if base_sv is not None else None)
         if base is not None and base_sv < sv \
-                and base.cluster.n_pad == cluster.n_pad:
+                and base.cluster.n_pad == cluster.n_pad \
+                and _mesh_match(base.mesh, gen_mesh):
             forked = self._try_fork(base, cluster, host, usage)
             if forked is not None:
                 self.fork_deltas += 1
-                return _Generation(key, cluster, usage.version, forked)
+                return _Generation(key, cluster, usage.version, forked,
+                                   mesh=gen_mesh)
         self.full_uploads += 1
         return _Generation(key, cluster, usage.version,
-                           self._upload(host))
+                           self._upload(host, sharding=sharding),
+                           mesh=gen_mesh)
 
     def _try_fork(self, base: _Generation, cluster: ClusterTensors,
                   host: Dict[str, np.ndarray], usage) -> Optional[Dict]:
@@ -445,9 +637,11 @@ class DeviceClusterState:
                           for f in ClusterTensors.WAVE_USAGE_FIELDS}
             planes = dict(base.planes)
             if rows:
-                planes = self._scatter(planes, static_host, rows)
+                planes = self._scatter(planes, static_host, rows,
+                                       mesh=base.mesh)
             self.usage_full_uploads += 1
-            planes.update(self._upload(usage_host))
+            planes.update(self._upload(
+                usage_host, sharding=self._gen_sharding(base)))
             return planes
         rows_usage = rows | {cluster.index[nid] for nid in dirty_usage
                              if nid in cluster.index}
@@ -457,9 +651,11 @@ class DeviceClusterState:
         usage_host = {f: host[f]
                       for f in ClusterTensors.WAVE_USAGE_FIELDS}
         if rows:
-            planes = self._scatter(planes, static_host, rows)
+            planes = self._scatter(planes, static_host, rows,
+                                   mesh=base.mesh)
         if rows_usage:
-            planes = self._scatter(planes, usage_host, rows_usage)
+            planes = self._scatter(planes, usage_host, rows_usage,
+                                   mesh=base.mesh)
         return planes
 
 

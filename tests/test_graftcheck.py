@@ -868,11 +868,11 @@ class TestR2FixRegressions:
         uploads = []
         orig = DeviceClusterState._upload
 
-        def checking_upload(self, planes):
+        def checking_upload(self, planes, sharding=None):
             assert not self._lock.locked(), "upload ran under the lock"
             uploads.append(1)
             time.sleep(0.01)
-            return orig(self, planes)
+            return orig(self, planes, sharding=sharding)
 
         DeviceClusterState._upload = checking_upload
         try:

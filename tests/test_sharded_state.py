@@ -1,0 +1,279 @@
+"""Sharded resident cluster state (ISSUE 14 tentpole): generations
+placed over the device mesh's nodes axis must stay BIT-IDENTICAL to a
+fresh ``ClusterTensors.build`` + upload through every advance path the
+single-device suite proves (tests/test_device_state.py) — dirty-row
+scatter, structure forks, eviction/miss rebuilds, trimmed-log
+fallbacks — while every resident plane actually lives split across the
+8 conftest host devices, and placement-mismatched lookups MISS instead
+of leaking a sharded buffer into a single-device dispatch (or vice
+versa).
+"""
+
+import numpy as np
+import numpy.testing as npt
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from nomad_tpu import mock  # noqa: E402
+from nomad_tpu.parallel.sharded import (  # noqa: E402
+    shared_field_spec,
+    wave_mesh,
+)
+from nomad_tpu.state.store import StateStore  # noqa: E402
+from nomad_tpu.tensors.device_state import DeviceClusterState  # noqa: E402
+from nomad_tpu.tensors.schema import (  # noqa: E402
+    ClusterTensors,
+    IncrementalClusterCache,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return wave_mesh(8)
+
+
+def assert_sharded_matches_fresh(ds, snap, mesh) -> None:
+    """The resident generation for ``snap`` is bit-identical to a
+    fresh host build AND split over the mesh's devices."""
+    u = snap.usage
+    fresh = ClusterTensors.build(snap.nodes())
+    want = fresh.wave_shared_planes(u)
+    gen = ds._gens[(u.uid, u.structure_version)]
+    assert gen.mesh is mesh
+    for f, host in want.items():
+        dev = gen.planes[f]
+        got = np.asarray(dev)
+        assert got.dtype == host.dtype, f
+        npt.assert_array_equal(got, host, err_msg=f)
+        # placement is REAL sharding, not replication on one device
+        assert len(dev.sharding.device_set) == mesh.size, \
+            (f, dev.sharding)
+
+
+def _store(n_nodes: int) -> StateStore:
+    s = StateStore()
+    for _ in range(n_nodes):
+        s.upsert_node(mock.node())
+    return s
+
+
+def _ensure(ds, cache, store):
+    snap = store.snapshot()
+    ds.ensure(cache.get(snap), snap.usage)
+    return snap
+
+
+class TestShardedDeltaParity:
+    def test_alloc_churn_advances_by_sharded_scatter(self, mesh):
+        store = _store(24)
+        ds = DeviceClusterState(mesh=mesh)
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        nodes = store.snapshot().nodes()
+        store.upsert_allocs(
+            [mock.alloc(node_id=nodes[i % 8].id) for i in range(20)])
+        snap = _ensure(ds, cache, store)
+        assert ds.delta_advances == 1
+        assert ds.full_uploads == 1          # only the initial build
+        assert ds.usage_full_uploads == 0
+        assert_sharded_matches_fresh(ds, snap, mesh)
+
+    def test_structure_fork_stays_sharded(self, mesh):
+        store = _store(24)
+        ds = DeviceClusterState(mesh=mesh)
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        node = store.snapshot().nodes()[5].copy()
+        node.node_resources.cpu.cpu_shares = 12345
+        store.upsert_node(node)
+        snap = _ensure(ds, cache, store)
+        assert ds.fork_deltas == 1
+        assert_sharded_matches_fresh(ds, snap, mesh)
+
+    @pytest.mark.parametrize("n_nodes", [9, 24, 63])
+    def test_uneven_node_counts_pad_to_shard_multiples(self, mesh,
+                                                       n_nodes):
+        """Real node counts that do NOT divide the mesh: the pad
+        bucket (power of two, min 64) always does, so real rows land
+        unevenly across shards — parity must hold through churn."""
+        store = _store(n_nodes)
+        ds = DeviceClusterState(mesh=mesh)
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        nodes = store.snapshot().nodes()
+        store.upsert_allocs(
+            [mock.alloc(node_id=nodes[i % n_nodes].id)
+             for i in range(min(n_nodes * 2, 30))])
+        snap = _ensure(ds, cache, store)
+        assert ds.delta_advances == 1
+        assert_sharded_matches_fresh(ds, snap, mesh)
+
+    def test_random_sharded_sequences(self, mesh):
+        """Property-style: random interleavings of alloc transitions
+        and node adds/updates/drains/deletes, sharded-device-vs-fresh
+        parity after every round (the device mirror of the
+        single-device suite's random walk)."""
+        rng = np.random.default_rng(41)
+        store = _store(24)
+        ds = DeviceClusterState(mesh=mesh)
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        live = []
+        for _round in range(6):
+            for _ in range(int(rng.integers(1, 5))):
+                nodes = store.snapshot().nodes()
+                pick = nodes[int(rng.integers(0, len(nodes)))]
+                op = rng.integers(0, 6)
+                if op == 0:
+                    a = mock.alloc(node_id=pick.id)
+                    live.append(a)
+                    store.upsert_allocs([a])
+                elif op == 1 and live:
+                    a = live.pop(int(rng.integers(0, len(live))))
+                    store.stop_alloc(a.id, [])
+                elif op == 2:
+                    store.upsert_node(mock.node())
+                elif op == 3:
+                    n = pick.copy()
+                    n.node_resources.cpu.cpu_shares = int(
+                        rng.integers(1000, 9000))
+                    store.upsert_node(n)
+                elif op == 4:
+                    store.update_node_drain(pick.id,
+                                            bool(rng.integers(0, 2)))
+                elif len(nodes) > 4:
+                    store.delete_node(pick.id)
+            snap = _ensure(ds, cache, store)
+            assert_sharded_matches_fresh(ds, snap, mesh)
+        assert ds.delta_advances + ds.fork_deltas >= 2
+
+    def test_trimmed_row_log_full_upload_stays_sharded(self, mesh):
+        """The unprovable-log fallback re-uploads the usage planes —
+        WITH the generation's sharded placement, not to one device."""
+        from nomad_tpu.state import usage as usage_mod
+
+        store = _store(24)
+        ds = DeviceClusterState(mesh=mesh)
+        cache = IncrementalClusterCache()
+        _ensure(ds, cache, store)
+        nodes = store.snapshot().nodes()
+        for i in range(usage_mod.ROW_LOG_MAX + 8):
+            store.upsert_allocs([mock.alloc(node_id=nodes[i % 8].id)])
+        snap = _ensure(ds, cache, store)
+        assert ds.usage_full_uploads == 1
+        assert ds.delta_advances == 0
+        assert_sharded_matches_fresh(ds, snap, mesh)
+
+    def test_eviction_and_miss_rebuild_sharded(self, mesh):
+        store = _store(24)
+        ds = DeviceClusterState(max_generations=2, mesh=mesh)
+        cache = IncrementalClusterCache()
+        first = store.snapshot()
+        first_cluster = cache.get(first)
+        ds.ensure(first_cluster, first.usage)
+        first_host = first_cluster.wave_shared_planes(first.usage)
+        for _ in range(3):
+            store.upsert_node(mock.node())
+            _ensure(ds, cache, store)
+        assert len(ds._gens) == 2
+        assert ds.lookup(first_host["cap_cpu"], mesh=mesh) is None
+        full_before = ds.full_uploads
+        ds.ensure(first_cluster, first.usage)
+        assert ds.full_uploads == full_before + 1
+        gen = ds._gens[(first.usage.uid,
+                        first.usage.structure_version)]
+        for f, host in first_host.items():
+            npt.assert_array_equal(np.asarray(gen.planes[f]), host,
+                                   err_msg=f)
+
+
+class TestPlacementIsolation:
+    def test_single_device_lookup_misses_sharded_generation(self, mesh):
+        """A direct (unsharded) dispatch must never receive a sharded
+        buffer: it would reshard inside the jit and fork its cache."""
+        store = _store(16)
+        ds = DeviceClusterState(mesh=mesh)
+        cache = IncrementalClusterCache()
+        snap = store.snapshot()
+        cluster = cache.get(snap)
+        ds.ensure(cluster, snap.usage)
+        host = cluster.wave_shared_planes(snap.usage)
+        # frozen_ok=False: the launcher's contract for the snapshot
+        # group (the gathered planes are read-only, and the frozen-
+        # singleton path would otherwise mint an unsharded twin)
+        for f, arr in host.items():
+            assert ds.lookup(arr, frozen_ok=False,
+                             mesh=mesh) is not None, f
+            assert ds.lookup(arr, frozen_ok=False) is None, f
+            assert ds.lookup(arr, frozen_ok=False,
+                             mesh=wave_mesh(4)) is None, f
+
+    def test_frozen_singleton_resident_under_both_placements(self, mesh):
+        from nomad_tpu.ops.kernel import neutral_planes
+
+        ds = DeviceClusterState(mesh=mesh)
+        host = neutral_planes(64).zeros_f32
+        spec = shared_field_spec("cap_cpu")
+        dev_sharded = ds.lookup(host, spec=spec, mesh=mesh)
+        dev_single = ds.lookup(host)
+        assert dev_sharded is not None and dev_single is not None
+        assert dev_sharded is not dev_single
+        assert len(dev_sharded.sharding.device_set) == mesh.size
+        npt.assert_array_equal(np.asarray(dev_sharded), host)
+        npt.assert_array_equal(np.asarray(dev_single), host)
+        # repeat lookups serve the SAME resident arrays (no re-upload)
+        assert ds.lookup(host, spec=spec, mesh=mesh) is dev_sharded
+        assert ds.lookup(host) is dev_single
+
+    def test_foreign_mesh_frozen_lookup_misses(self, mesh):
+        from nomad_tpu.ops.kernel import neutral_planes
+
+        ds = DeviceClusterState(mesh=mesh)
+        host = neutral_planes(64).zeros_f32
+        spec = shared_field_spec("cap_cpu")
+        other = wave_mesh(4)
+        assert ds.lookup(host, spec=spec, mesh=other) is None
+        # ... including once an entry for the SAME spec is resident
+        # under the state's own mesh (the spec key alone would
+        # collide across meshes and hand the foreign caller a buffer
+        # placed for the wrong device set)
+        assert ds.lookup(host, spec=spec, mesh=mesh) is not None
+        assert ds.lookup(host, spec=spec, mesh=other) is None
+
+    def test_configure_mesh_change_evicts_everything(self, mesh):
+        store = _store(16)
+        ds = DeviceClusterState()                    # single-device
+        cache = IncrementalClusterCache()
+        snap = store.snapshot()
+        cluster = cache.get(snap)
+        ds.ensure(cluster, snap.usage)
+        host = cluster.wave_shared_planes(snap.usage)
+        assert ds.lookup(host["cap_cpu"]) is not None
+        ds.configure_mesh(mesh)
+        assert ds.lookup(host["cap_cpu"]) is None
+        assert ds.lookup(host["cap_cpu"], mesh=mesh) is None
+        assert len(ds._gens) == 0 and len(ds._frozen) == 0
+        # re-ensure builds the sharded generation
+        ds.ensure(cluster, snap.usage)
+        assert ds.lookup(host["cap_cpu"], mesh=mesh) is not None
+        # equal mesh (a NEW object over the same devices) is a no-op
+        gen_before = dict(ds._gens)
+        ds.configure_mesh(wave_mesh(8))
+        assert dict(ds._gens) == gen_before
+
+    def test_indivisible_node_axis_places_single_device(self):
+        """A mesh whose device count does not divide the pad bucket
+        (3 devices x 64-row bucket): generations place single-device
+        and the registry serves UNSHARDED callers — the launcher makes
+        the same call and counts an unsharded fallback."""
+        store = _store(16)
+        ds = DeviceClusterState(mesh=wave_mesh(3))
+        cache = IncrementalClusterCache()
+        snap = store.snapshot()
+        cluster = cache.get(snap)
+        gen = ds.ensure(cluster, snap.usage)
+        assert gen.mesh is None
+        host = cluster.wave_shared_planes(snap.usage)
+        assert ds.lookup(host["cap_cpu"]) is not None

@@ -73,6 +73,188 @@ class TestShardedWaveParity:
         assert any(np.asarray(s.found).any() for s in single)
 
 
+def _shared_layout_wave(n_nodes=200, members=4, k=3, seed=5):
+    """B kins whose three sharing groups are ALL identity-shared (the
+    live stack.py build's steady shape): wave-shared planes from one
+    (cluster, usage) pair, neutral/job groups from frozen singletons."""
+    from nomad_tpu.ops.kernel import (
+        LEAN_FEATURES,
+        build_kernel_in,
+        neutral_planes,
+    )
+    from nomad_tpu.parallel.synthetic import synthetic_cluster, synthetic_eval
+
+    cluster = synthetic_cluster(n_nodes, seed=seed)
+    cluster.avail_mbits = np.zeros(cluster.n_pad, np.int32)
+    cluster.avail_mbits[:n_nodes] = 1000
+
+    class _U:
+        pass
+
+    u = _U()
+    u.uid = "wave-test"
+    u.version = 1
+    u.structure_version = 0
+    u.rows = {nid: i for i, nid in enumerate(cluster.node_ids)}
+    u.n = cluster.n_real
+    for f, dt in (("used_cpu", np.float32), ("used_mem", np.float32),
+                  ("used_disk", np.float32), ("used_cores", np.int32),
+                  ("used_mbits", np.int32)):
+        setattr(u, f, np.zeros(cluster.n_real, dt))
+    u.row_events = ()
+    u.row_events_floor = 0
+    u.node_events = ()
+
+    shared = cluster.wave_shared_planes(u)
+    neutral = neutral_planes(cluster.n_pad)
+    base_mask = cluster.ready.copy()
+    base_mask.setflags(write=False)
+    ev = synthetic_eval(cluster, desired_count=k)
+    kins, steps, feats = [], [], []
+    for i in range(members):
+        kin = build_kernel_in(cluster, ev, k)
+        kin = kin._replace(
+            ask_cpu=np.asarray(100.0 + 50 * i, np.float32),
+            **{f: shared[f] for f in shared},
+            port_conflict=neutral.zeros_bool,
+            dev_free=neutral.zeros_dev,
+            dev_aff_score=neutral.zeros_f32,
+            job_tg_count=neutral.zeros_i32,
+            job_any_count=neutral.zeros_i32,
+            penalty=neutral.zeros_bool,
+            aff_score=neutral.zeros_f32,
+            base_mask=base_mask,
+        )
+        kins.append(kin)
+        steps.append(k)
+        feats.append(LEAN_FEATURES._replace(with_topk=True))
+    return cluster, u, kins, steps, feats
+
+
+class TestShardedSharedLayout:
+    def test_shared_layout_parity_and_resident_h2d(self, wave_mesh):
+        """The ISSUE 14 steady shape: identity-shared planes resident
+        SHARDED via the device state — bit-identical to single-device
+        dispatch, zero fallbacks, and the second sharded wave's h2d is
+        just node_perm + scalars (the resident planes move nothing)."""
+        from nomad_tpu import telemetry
+        from nomad_tpu.telemetry.kernel_profile import profiler
+        from nomad_tpu.tensors.device_state import default_device_state
+
+        cluster, u, kins, steps, feats = _shared_layout_wave()
+        prior = default_device_state.mesh
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            default_device_state.configure_mesh(wave_mesh)
+            default_device_state.ensure(cluster, u)
+            sharded = coalesce.launch_wave(kins, steps, feats,
+                                           mesh=wave_mesh)
+            h2d_1 = profiler.summary()["TransferBytes"]["h2d"]
+            coalesce.launch_wave(kins, steps, feats, mesh=wave_mesh)
+            h2d_2 = profiler.summary()["TransferBytes"]["h2d"] - h2d_1
+            single = coalesce.launch_wave(kins, steps, feats,
+                                          mesh=None)
+            for s, m in zip(single, sharded):
+                np.testing.assert_array_equal(np.asarray(s.chosen),
+                                              np.asarray(m.chosen))
+                np.testing.assert_array_equal(np.asarray(s.found),
+                                              np.asarray(m.found))
+                np.testing.assert_allclose(np.asarray(s.scores),
+                                           np.asarray(m.scores),
+                                           rtol=1e-6, atol=1e-7)
+            assert any(np.asarray(s.found).any() for s in single)
+            stats = coalesce.sharded_wave_stats.snapshot()
+            assert stats["launches"] == 2
+            assert stats["fallbacks"] == 0
+            assert stats["mesh_devices"] == 8
+            # resident sharded planes upload NOTHING on the repeat
+            # wave: node_perm ([B, N] i32) + step planes + scalars
+            # only — far under one [N] f32 node plane per member
+            assert h2d_2 < 40_000, h2d_2
+        finally:
+            default_device_state.configure_mesh(prior)
+            telemetry.disable()
+            telemetry.reset()
+
+    def test_indivisible_mesh_falls_back_unsharded(self):
+        """A 3-device mesh over a 256-row pad bucket cannot split the
+        node axis: the wave must dispatch single-device, count a
+        fallback, and still place identically."""
+        from nomad_tpu.parallel.sharded import wave_mesh as make
+
+        mesh3 = make(3)
+        _, _, kins, steps, feats = _shared_layout_wave(seed=7)
+        before = coalesce.sharded_wave_stats.snapshot()
+        sharded_before = coalesce.sharded_wave_launches
+        out_m = coalesce.launch_wave(kins, steps, feats, mesh=mesh3)
+        out_s = coalesce.launch_wave(kins, steps, feats, mesh=None)
+        after = coalesce.sharded_wave_stats.snapshot()
+        assert coalesce.sharded_wave_launches == sharded_before
+        assert after["fallbacks"] == before["fallbacks"] + 1
+        for a, b in zip(out_m, out_s):
+            np.testing.assert_array_equal(np.asarray(a.chosen),
+                                          np.asarray(b.chosen))
+
+
+class TestShardedWarmup:
+    def test_warmup_populates_sharded_jit_signatures(self, wave_mesh):
+        """ops/warmup learns the sharded joint programs: a manifest
+        entry warmed with ``mesh`` makes the live sharded launch of
+        that bucket shape a cache HIT (0 joint_sharded misses) — the
+        steady-state-keeps-0-compiles contract, mesh edition."""
+        from nomad_tpu import telemetry
+        from nomad_tpu.ops import warmup as kernel_warmup
+        from nomad_tpu.ops.kernel import LEAN_FEATURES, pad_steps
+        from nomad_tpu.telemetry.kernel_profile import profiler
+
+        _, _, kins, steps, feats = _shared_layout_wave(seed=11)
+        n_pad = int(np.asarray(kins[0].cap_cpu).shape[0])
+        b_pad = coalesce.pad_wave(len(kins))
+        feat_union = coalesce.union_features(feats)
+        entry = {
+            "kernel": "joint", "wave": b_pad,
+            "steps": pad_steps(b_pad * steps[0]), "nodes": n_pad,
+            # the all-stacked layout (no residency installed here)
+            "shared": False, "neutral_shared": False,
+            "job_shared": False,
+            "features": dict(feat_union._asdict()),
+        }
+        compiled, failed = kernel_warmup.warmup_entries(
+            [entry], mesh=wave_mesh, mesh_only=True)
+        assert compiled == 1 and failed == 0
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            coalesce.launch_wave(kins, steps, feats, mesh=wave_mesh)
+            assert profiler.misses_for("joint_sharded") == 0, \
+                profiler.summary()["PerKey"]
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+    def test_sharded_launch_keys_fold_into_manifest(self, wave_mesh):
+        """A mesh server's manifest must not go empty just because
+        every wave dispatched sharded: joint_sharded profiler keys
+        fold into mesh-agnostic joint entries."""
+        from nomad_tpu import telemetry
+        from nomad_tpu.ops import warmup as kernel_warmup
+        from nomad_tpu.telemetry.kernel_profile import profiler
+
+        _, _, kins, steps, feats = _shared_layout_wave(seed=13)
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            coalesce.launch_wave(kins, steps, feats, mesh=wave_mesh)
+            entries = kernel_warmup.manifest_from_profiler(profiler)
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+        joints = [e for e in entries if e["kernel"] == "joint"]
+        assert joints, entries
+        assert joints[0]["nodes"] == 256
+
+
 class TestServerOverMesh:
     def test_server_places_through_sharded_waves(self, wave_mesh):
         """A live server with use_device_mesh=True places a batched
@@ -114,3 +296,86 @@ class TestServerOverMesh:
             assert float(u.used_cpu.sum()) >= 24 * 500
         finally:
             server.shutdown()
+
+
+class TestMiniMeshSmoke:
+    def test_steady_sharded_bursts_keep_zero_new_compiles(self):
+        """Tier-1 mini-mesh smoke (ISSUE 14 satellite): a live mesh
+        server places two bursts through sharded waves; the SECOND
+        burst re-uses burst 1's compiled sharded programs (0 new
+        joint_sharded misses), every wave dispatches sharded
+        (fallbacks 0), and the resident cluster state advances by
+        dirty-row scatter between waves."""
+        import time
+
+        from nomad_tpu import mock, telemetry
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.telemetry.kernel_profile import profiler
+        from nomad_tpu.tensors.device_state import default_device_state
+
+        server = Server(ServerConfig(
+            num_workers=1, worker_batch_size=8, heartbeat_ttl=3600.0,
+            use_device_mesh=True,
+        ))
+        telemetry.enable()
+        telemetry.reset()
+        server.start()
+        try:
+            assert server.wave_mesh is not None
+            # the server adopted its mesh into the resident state
+            assert default_device_state.mesh is server.wave_mesh
+            for _ in range(30):
+                server.node_register(mock.node())
+
+            def burst(n_jobs: int) -> None:
+                jobs = []
+                for _ in range(n_jobs):
+                    job = mock.simple_job()
+                    job.task_groups[0].count = 3
+                    jobs.append(job)
+                    server.job_register(job)
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    snap = server.state.snapshot()
+                    placed = sum(
+                        len(snap.allocs_by_job(j.namespace, j.id))
+                        for j in jobs)
+                    if placed >= 3 * n_jobs:
+                        return
+                    time.sleep(0.05)
+                raise AssertionError("burst did not place in time")
+
+            burst(8)
+            stats1 = coalesce.sharded_wave_stats.snapshot()
+            assert stats1["launches"] >= 1, stats1
+            assert stats1["fallbacks"] == 0, stats1
+            # the warmup-manifest flow, mesh edition: burst 1's
+            # observed keys (sharded keys fold into joint entries)
+            # expand over the bucket lattice and AOT-compile the
+            # sharded signatures — burst 2 then cannot hit a tail
+            # bucket cold (a deadline-fired partial wave lands on a
+            # smaller, pre-warmed bucket)
+            from nomad_tpu.ops import warmup as kernel_warmup
+
+            entries = kernel_warmup.expand_lattice(
+                kernel_warmup.manifest_from_profiler(profiler),
+                max_wave=8)
+            compiled, failed = kernel_warmup.warmup_entries(
+                entries, mesh=server.wave_mesh, mesh_only=True)
+            assert compiled >= 1 and failed == 0, (compiled, failed)
+            misses1 = profiler.misses_for("joint_sharded")
+            burst(8)
+            stats2 = coalesce.sharded_wave_stats.snapshot()
+            assert stats2["launches"] > stats1["launches"], stats2
+            assert stats2["fallbacks"] == 0, stats2
+            # steady state: burst 2's sharded waves are all cache hits
+            assert profiler.misses_for("joint_sharded") == misses1, \
+                profiler.summary()["PerKey"]
+            # dirty-row advancement ran (the between-wave scatter)
+            assert default_device_state.snapshot()["delta_advances"] \
+                >= 1, default_device_state.snapshot()
+        finally:
+            server.shutdown()
+            telemetry.disable()
+            telemetry.reset()
+            assert default_device_state.mesh is None

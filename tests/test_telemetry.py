@@ -831,6 +831,51 @@ class TestTraceDecomposition:
                 section, serving[section])
         assert "deliver_latency" in serving
 
+    def test_mesh_steady_burst_gates_sharded_keys(self, tmp_path):
+        """ISSUE 14 steady gates: with the device mesh on (the
+        conftest 8-virtual-CPU mesh via use_device_mesh=True), the
+        steady burst's TRACE_DECOMP steady_state must report every
+        wave dispatched SHARDED (launches > 0), ZERO single-device
+        fallbacks, and — like the unsharded burst — zero jit cache
+        misses on the second (steady) burst: the AOT warmup learned
+        the sharded signatures. Subprocess for the same reason as the
+        main decomposition test (a clean process measures the system);
+        smaller shape — the perf share gates stay with the unsharded
+        artifact, this one gates the sharding plumbing."""
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = tmp_path / "TRACE_DECOMP_MESH.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench",
+                                          "trace_report.py"),
+             str(out), "--nodes", "200", "--jobs", "96",
+             "--allocs-per-job", "3", "--batch", "16",
+             "--warmup-jobs", "10", "--bursts", "2", "--mesh"],
+            capture_output=True, timeout=360,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        decomp = json.loads(out.read_text())
+        assert decomp["allocs_placed"] == decomp["allocs_wanted"]
+        ss = decomp["steady_state"]
+        # the new steady keys exist and hold: sharded is THE path on a
+        # mesh server (fallbacks would mean single-device dispatches
+        # leaked into the steady state)
+        assert ss["mesh_devices"] == 8, ss
+        assert ss["sharded_wave_launches"] > 0, ss
+        assert ss["sharded_wave_launches"] == \
+            decomp["wave"]["launches"], (ss, decomp["wave"])
+        assert ss["sharded_wave_fallbacks"] == 0, ss
+        # steady-state compile discipline holds under sharding too
+        assert ss["jit_cache_misses"] == 0, \
+            decomp["kernel"]["PerKey"]
+        # group-commit health is dispatch-independent
+        assert ss["plan_group_fallbacks"] == 0, decomp.get("plan_group")
+        # the resident state advanced sharded between waves
+        assert decomp["device_state"]["delta_advances"] >= 1, \
+            decomp["device_state"]
+
     def test_disabled_tracing_leaves_no_spans(self):
         """The disabled live path must record nothing (the <5%
         overhead claim rests on the no-op fast path actually being

@@ -51,9 +51,10 @@ _PROM_NAME = re.compile(r"\bnomad_tpu_[a-z0-9]+(?:_[a-z0-9]+)+\b")
 #: lines are contract like every other bench emission); chaos_* in
 #: ISSUE 12 (the chaos cell's convergence verdict + per-schedule
 #: stats); restart_* in ISSUE 13 (kill→restart recovery + torn-tail
-#: fuzz verdicts)
+#: fuzz verdicts); mesh_* in ISSUE 14 (the 100k-node sharded mesh
+#: cell's scale/parity/collective-share lines)
 _BENCH_KEY = re.compile(
-    r"^(?:trace|contention|fleet|chaos|restart)_[a-z0-9_]+$")
+    r"^(?:trace|contention|fleet|chaos|restart|mesh)_[a-z0-9_]+$")
 #: bench kwargs that are not emission keys
 _BENCH_KEY_EXCLUDE = {"trace_id"}
 
