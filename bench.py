@@ -1413,7 +1413,9 @@ def main() -> None:
             per_schedule = max((budget.remaining() - 90.0) / 3.0, 60.0)
             suite = trace_report.run_chaos_suite(
                 deadline_s=min(max(per_schedule * 0.4, 30.0), 90.0),
-                settle_s=min(max(per_schedule * 0.25, 20.0), 60.0))
+                settle_s=min(max(per_schedule * 0.25, 20.0), 60.0),
+                timeline_path=os.path.join(REPO, "CHAOS_TIMELINE.json"))
+            tl = suite["timeline"]
             em.update(
                 chaos_seed=suite["seed"],
                 chaos_evals_converged_ok=(
@@ -1431,6 +1433,15 @@ def main() -> None:
                         "plan_rejections": r["plan_rejections"],
                     }
                     for name, r in suite["schedules"].items()},
+                # ISSUE 15: the failover timeline's attribution lines —
+                # CHAOS_TIMELINE.json carries the full causally-ordered
+                # artifact; these are its CI-gated trend keys
+                timeline_failovers=tl["failovers"],
+                timeline_events=tl["events"],
+                timeline_attributed_share=tl["attributed_share"],
+                timeline_attributed_ok=(
+                    1 if tl["attributed_share"] >= 0.9 else 0),
+                timeline_phase_ms=tl["phase_ms_max"],
             )
         except Exception as e:                   # noqa: BLE001
             import traceback
@@ -1457,7 +1468,8 @@ def main() -> None:
 
             cell = trace_report.run_restart_chaos(
                 deadline_s=min(budget.share(0.3), 120.0),
-                settle_s=min(budget.share(0.15), 60.0))
+                settle_s=min(budget.share(0.15), 60.0),
+                timeline_path=os.path.join(REPO, "CHAOS_TIMELINE.json"))
             fuzz = trace_report.run_torn_tail_fuzz(seeds=200)
             em.update(
                 restart_seed=cell["seed"],
@@ -1471,6 +1483,10 @@ def main() -> None:
                 restart_torn_fuzz_seeds=fuzz["seeds"],
                 restart_torn_fuzz_silent_divergences=fuzz[
                     "silent_divergences"],
+                # the restart leg's failover timeline attribution
+                # (merged into the same CHAOS_TIMELINE.json artifact)
+                timeline_restart_attributed_share=cell[
+                    "timeline"]["attribution"]["share"],
             )
         except Exception as e:                   # noqa: BLE001
             import traceback

@@ -1392,6 +1392,28 @@ def _call_on_leader(servers, fn, timeout=15.0):
         time.sleep(0.05)
     raise RuntimeError(f"no leader accepted the call: {last!r}")
 
+
+def _capture_timeline(cell_name: str, obs_start: float, fire_log,
+                      converged_mono) -> Dict:
+    """Fold this cell's consensus events + fault firings + consensus
+    span stream into the CHAOS_TIMELINE shape (ISSUE 15). Span counts
+    are windowed to the cell (start >= obs_start); events likewise."""
+    from nomad_tpu.raft.observe import raft_observer
+    from nomad_tpu.telemetry.timeline import build_timeline
+    from nomad_tpu.telemetry.trace import tracer
+
+    span_summary: Dict[str, int] = {}
+    for sp in tracer.spans():
+        if sp.start_s < obs_start:
+            continue
+        if sp.name.startswith("raft.") or sp.name == "fsm.apply":
+            span_summary[sp.name] = span_summary.get(sp.name, 0) + 1
+    return build_timeline(
+        raft_observer.events(since_mono=obs_start),
+        [f for f in fire_log if f["t"] >= obs_start],
+        span_summary=span_summary, converged_mono=converged_mono,
+        cell=cell_name)
+
 #: the standing chaos schedules (ISSUE 12). Each is a bounded,
 #: deterministic fault program over the wired points
 #: (nomad_tpu/utils/faultpoints.py) plus an optional set of nodes
@@ -1494,7 +1516,15 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
     from nomad_tpu.structs import consts
     from nomad_tpu.utils import faultpoints
 
+    from nomad_tpu import telemetry
+
     spec = CHAOS_SCHEDULES[schedule]
+    # tracing ON for the cell: the failover timeline merges the
+    # consensus span stream with events + fault firings (ISSUE 15)
+    was_traced = telemetry.enabled()
+    if not was_traced:
+        telemetry.enable()
+    obs_start = time.monotonic()
     servers, registry = make_cluster(3, ServerConfig(
         num_workers=1,
         worker_batch_size=batch_size,
@@ -1662,12 +1692,14 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
                     quiet = True
                     break
             time.sleep(0.25)
+        converged_mono = time.monotonic() if quiet else None
         if not quiet:
             violations.append("pipeline did not quiesce: pending evals "
                               "or broker work remained after settle")
         placed = wait_fully_placed(jobs, time.time() + 5.0)
         fault_stats = faultpoints.stats()
         total_fires = faultpoints.fires()
+        fire_window = faultpoints.fire_log()
         faultpoints.disarm()
 
         # ---- convergence invariants -------------------------------------
@@ -1767,6 +1799,9 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
             "stream_lost_markers": mon["lost_markers"],
             "stream_missed_alloc_events": len(missing),
             "plan_rejections": plan_rejections.snapshot()["rejections"],
+            "timeline": _capture_timeline(
+                f"chaos:{schedule}", obs_start, fire_window,
+                converged_mono),
         }
     finally:
         stop.set()
@@ -1779,6 +1814,8 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
                 s.shutdown()
             except Exception:                   # noqa: BLE001
                 pass
+        if not was_traced:
+            telemetry.disable()
 
 
 #: the restart cell's pinned seed (ISSUE 13): re-arming the same
@@ -1831,7 +1868,8 @@ def run_restart_chaos(seed: int = RESTART_SEED,
                       deadline_s: float = 120.0,
                       settle_s: float = 60.0,
                       torn_kill: bool = True,
-                      fsync_policy: str = "batch") -> Dict:
+                      fsync_policy: str = "batch",
+                      timeline_path: Optional[str] = None) -> Dict:
     """ISSUE 13: the kill→restart recovery cell — PR 12's failure
     story completed down to the disk.
 
@@ -1884,9 +1922,16 @@ def run_restart_chaos(seed: int = RESTART_SEED,
     from nomad_tpu.telemetry.histogram import WAL_FSYNC, histograms
     from nomad_tpu.utils import faultpoints
 
+    from nomad_tpu import telemetry
+
     rng = _random.Random(seed)
     base_dir = tempfile.mkdtemp(prefix="nomad-tpu-restart-")
     data_dirs = [os.path.join(base_dir, f"srv-{i}") for i in range(3)]
+    # tracing ON for the cell (the timeline's span stream, ISSUE 15)
+    was_traced = telemetry.enabled()
+    if not was_traced:
+        telemetry.enable()
+    obs_start = time.monotonic()
     servers, registry = make_cluster(3, ServerConfig(
         num_workers=1,
         worker_batch_size=batch_size,
@@ -2124,6 +2169,7 @@ def run_restart_chaos(seed: int = RESTART_SEED,
                     quiet = True
                     break
             time.sleep(0.25)
+        converged_mono = time.monotonic() if quiet else None
         if not quiet:
             violations.append("pipeline did not quiesce after settle")
         placed = wait_fully_placed(acked_jobs, time.time() + 5.0)
@@ -2199,8 +2245,17 @@ def run_restart_chaos(seed: int = RESTART_SEED,
 
         fsync_h = histograms.peek(WAL_FSYNC)
         fsync = fsync_h.snapshot() if fsync_h is not None else {}
+        timeline = _capture_timeline(
+            "restart", obs_start, faultpoints.fire_log(),
+            converged_mono)
+        if timeline_path:
+            from nomad_tpu.telemetry.timeline import merge_into_artifact
+
+            merge_into_artifact(timeline_path, "restart", timeline,
+                                summary_extra={"restart_seed": seed})
         return {
             "seed": seed,
+            "timeline": timeline,
             "converged_ok": not violations,
             "violations": violations,
             "wall_s": round(wall, 3),
@@ -2237,6 +2292,8 @@ def run_restart_chaos(seed: int = RESTART_SEED,
             except Exception:                   # noqa: BLE001
                 pass
         shutil.rmtree(base_dir, ignore_errors=True)
+        if not was_traced:
+            telemetry.disable()
 
 
 def run_torn_tail_fuzz(seeds: int = 200, entries: int = 120,
@@ -2356,13 +2413,39 @@ def run_torn_tail_fuzz(seeds: int = 200, entries: int = 120,
         shutil.rmtree(base, ignore_errors=True)
 
 
-def run_chaos_suite(seed: int = CHAOS_SEED, **kw) -> Dict:
+def run_chaos_suite(seed: int = CHAOS_SEED,
+                    timeline_path: Optional[str] = None, **kw) -> Dict:
     """All standing chaos schedules, each against a fresh cluster.
     ``converged_ok`` is the AND across schedules — the acceptance bar
-    (bench.py emits it as ``chaos_evals_converged_ok``)."""
+    (bench.py emits it as ``chaos_evals_converged_ok``).
+
+    ISSUE 15: each schedule's failover timeline merges into the
+    ``CHAOS_TIMELINE.json`` artifact when ``timeline_path`` is given
+    (bench.py passes the repo path; tests pass tmp), and the returned
+    ``timeline`` summary carries the aggregate phase attribution —
+    ≥ 0.90 of failover wall time must land in named phases."""
+    from nomad_tpu.telemetry.timeline import merge_into_artifact
+
     results = {}
     for name in CHAOS_SCHEDULES:
         results[name] = run_chaos_burst(schedule=name, seed=seed, **kw)
+    total_ms = sum(r["timeline"]["attribution"]["failover_wall_ms"]
+                   for r in results.values())
+    attributed_ms = sum(r["timeline"]["attribution"]["attributed_ms"]
+                        for r in results.values())
+    phase_ms = {p: 0.0 for p in ("detect", "elect", "replay",
+                                 "converge")}
+    failovers = 0
+    for r in results.values():
+        for fo in r["timeline"]["failovers"]:
+            failovers += 1
+            for p in phase_ms:
+                phase_ms[p] = max(phase_ms[p], fo["phases_ms"][p])
+    if timeline_path:
+        for name, r in results.items():
+            merge_into_artifact(timeline_path, f"chaos:{name}",
+                                r["timeline"],
+                                summary_extra={"chaos_seed": seed})
     return {
         "seed": seed,
         "converged_ok": all(r["converged_ok"] for r in results.values()),
@@ -2370,7 +2453,171 @@ def run_chaos_suite(seed: int = CHAOS_SEED, **kw) -> Dict:
         "faults_fired": sum(r["faults_fired"] for r in results.values()),
         "violations": [f"{n}: {v}" for n, r in results.items()
                        for v in r["violations"]],
+        "timeline": {
+            "failovers": failovers,
+            "events": sum(len(r["timeline"]["events"])
+                          for r in results.values()),
+            "failover_wall_ms": round(total_ms, 3),
+            "attributed_ms": round(attributed_ms, 3),
+            "attributed_share": round(attributed_ms / total_ms, 4)
+            if total_ms > 0 else 1.0,
+            "phase_ms_max": {p: round(v, 3)
+                             for p, v in phase_ms.items()},
+        },
     }
+
+
+#: the mini-timeline smoke's pinned seed (tier-1, ISSUE 15)
+TIMELINE_SMOKE_SEED = 15015
+
+
+def run_timeline_smoke(out_path: Optional[str] = None,
+                       seed: int = TIMELINE_SMOKE_SEED,
+                       n_nodes: int = 8, n_jobs: int = 12,
+                       allocs_per_job: int = 2, batch_size: int = 4,
+                       warmup_jobs: int = 3,
+                       deadline_s: float = 90.0) -> Dict:
+    """ISSUE 15 tier-1 smoke: a single-server DURABLE raft cluster
+    rides one injected leader step-down mid-burst and must emit a
+    valid CHAOS_TIMELINE — one failover with ≥ 0.90 of its wall time
+    attributed to named phases (detect → elect → replay → converge) —
+    while the burst's e2e waterfalls pick up the raft segments
+    (raft-fsync / raft-quorum / raft-apply inside the commit window)
+    at ≥ 0.90 named-segment coverage. Small enough for tier-1 (~10s);
+    the 3-node versions are the stress-tier chaos/restart cells."""
+    import shutil
+    import tempfile
+
+    from nomad_tpu import mock, telemetry
+    from nomad_tpu.server.server import ServerConfig
+    from nomad_tpu.server.testing import make_cluster, wait_for_leader
+    from nomad_tpu.structs import consts
+    from nomad_tpu.telemetry.timeline import (
+        merge_into_artifact,
+        validate_timeline,
+    )
+    from nomad_tpu.telemetry.trace import tracer
+    from nomad_tpu.telemetry.waterfall import (
+        aggregate_tail,
+        build_waterfalls,
+    )
+    from nomad_tpu.utils import faultpoints
+
+    base_dir = tempfile.mkdtemp(prefix="nomad-tpu-timeline-")
+    was_traced = telemetry.enabled()
+    if not was_traced:
+        telemetry.enable()
+    servers, registry = make_cluster(1, ServerConfig(
+        num_workers=1, worker_batch_size=batch_size,
+        heartbeat_ttl=60.0, nack_timeout=1.0, eval_delivery_limit=4,
+        failed_eval_follow_up_wait=0.2,
+    ), data_dirs=[os.path.join(base_dir, "srv-0")])
+    server = servers[0]
+    server.eval_broker.initial_nack_delay = 0.02
+    server.eval_broker.subsequent_nack_delay = 0.1
+    faultpoints.reset()
+    try:
+        wait_for_leader(servers, timeout=15.0)
+        for _ in range(n_nodes):
+            server.node_register(mock.node())
+
+        def submit(count):
+            jobs = []
+            for _ in range(count):
+                job = mock.simple_job()
+                job.task_groups[0].count = allocs_per_job
+                _call_on_leader(servers, lambda s, j=job:
+                                s.job_register(j), timeout=20.0)
+                jobs.append(job)
+            return jobs
+
+        def placed(jobs):
+            snap = server.state.snapshot()
+            return sum(1 for j in jobs
+                       for a in snap.allocs_by_job(j.namespace, j.id)
+                       if not a.terminal_status())
+
+        def wait_placed(jobs, deadline):
+            want = len(jobs) * allocs_per_job
+            while time.time() < deadline:
+                if placed(jobs) >= want:
+                    return True
+                time.sleep(0.05)
+            return False
+
+        # warmup outside the window: compile the wave buckets
+        warm = submit(warmup_jobs)
+        wait_placed(warm, time.time() + deadline_s / 2)
+
+        # ---- the windowed burst + one injected step-down ------------
+        telemetry.reset()
+        obs_start = time.monotonic()
+        faultpoints.arm(
+            {"raft.leader.stepdown": {"kind": "error", "nth": 2}},
+            seed=seed)
+        jobs = []
+        for start in range(0, n_jobs, 3):
+            jobs.extend(submit(min(3, n_jobs - start)))
+            time.sleep(0.05)
+        placed_ok = wait_placed(jobs, time.time() + deadline_s)
+
+        def quiesced() -> bool:
+            snap = server.state.snapshot()
+            for ev in snap.evals_iter():
+                if ev.status == consts.EVAL_STATUS_PENDING:
+                    return False
+            b = server.eval_broker.stats()
+            return (b["total_ready"] == 0 and b["total_unacked"] == 0
+                    and b["total_waiting"] == 0)
+
+        quiet = False
+        settle_deadline = time.time() + 30.0
+        while time.time() < settle_deadline:
+            if quiesced():
+                quiet = True
+                break
+            time.sleep(0.1)
+        converged_mono = time.monotonic() if quiet else None
+        fire_log = faultpoints.fire_log()
+        stepdowns = faultpoints.stats().get(
+            "raft.leader.stepdown", {}).get("fires", 0)
+        faultpoints.disarm()
+
+        timeline = _capture_timeline("mini", obs_start, fire_log,
+                                     converged_mono)
+        problems = validate_timeline(timeline)
+        if out_path:
+            merge_into_artifact(out_path, "mini", timeline,
+                                summary_extra={"smoke_seed": seed})
+        waterfalls = build_waterfalls(tracer.spans())
+        tail = aggregate_tail(waterfalls)
+        segments = sorted({seg for w in waterfalls
+                           for seg in w["segments"]})
+        return {
+            "seed": seed,
+            "placed_ok": placed_ok,
+            "quiesced": quiet,
+            "stepdowns_fired": stepdowns,
+            "timeline": timeline,
+            "timeline_problems": problems,
+            "failovers": len(timeline["failovers"]),
+            "attributed_share": timeline["attribution"]["share"],
+            "waterfall_count": len(waterfalls),
+            "waterfall_segments": segments,
+            "p50_coverage": tail["p50_coverage"],
+        }
+    finally:
+        faultpoints.reset()
+        registry.heal()
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:                   # noqa: BLE001
+                pass
+        shutil.rmtree(base_dir, ignore_errors=True)
+        if not was_traced:
+            telemetry.disable()
+        telemetry.reset()
 
 
 def main() -> None:

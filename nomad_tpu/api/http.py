@@ -695,7 +695,10 @@ class HTTPAgent:
         add("PUT", r"/v1/operator/traces", self.operator_traces_put)
         add("POST", r"/v1/operator/traces", self.operator_traces_put)
         add("GET", r"/v1/operator/slow-evals", self.operator_slow_evals)
+        add("GET", r"/v1/operator/slow-raft", self.operator_slow_raft)
         add("GET", r"/v1/operator/stream-health", self.operator_stream_health)
+        add("GET", r"/v1/operator/cluster-health",
+            self.operator_cluster_health)
         add("GET", r"/v1/operator/scheduler/configuration", self.sched_config_get)
         add("PUT", r"/v1/operator/scheduler/configuration", self.sched_config_put)
         add("POST", r"/v1/operator/scheduler/configuration", self.sched_config_put)
@@ -1430,6 +1433,31 @@ class HTTPAgent:
         except ValueError:
             limit = 0
         return exporter.slow_evals_json(limit=limit)
+
+    def operator_slow_raft(self, req: Request):
+        """Consensus flight recorder dump (ISSUE 15): slow raft
+        appends / WAL fsync batches / elections past their adaptive
+        thresholds — the slow-evals recorder's sibling. Same ACL
+        (operator:read)."""
+        from nomad_tpu.telemetry import exporter
+
+        self._acl(req, "allow_operator_read")
+        try:
+            limit = int(req.q("limit", "0") or 0)
+        except ValueError:
+            limit = 0
+        return exporter.slow_raft_json(limit=limit)
+
+    def operator_cluster_health(self, req: Request):
+        """Autopilot-style consensus health (ISSUE 15): this server's
+        raft identity/term/state, per-peer match/lag/last-contact
+        (leader-side), WAL occupancy + durability counters, consensus
+        latency distributions, transition counters, and the fault
+        plane's arm state. ACL: operator:read."""
+        from nomad_tpu.telemetry import exporter
+
+        self._acl(req, "allow_operator_read")
+        return exporter.cluster_health_json(self._server)
 
     def operator_stream_health(self, req: Request):
         """Serving-plane health in one pull (ISSUE 11): event-ring

@@ -20,6 +20,16 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from nomad_tpu.raft.log import LOG_COMMAND, LOG_NOOP, LogEntry, LogStore
+from nomad_tpu.raft.observe import raft_observer
+from nomad_tpu.telemetry.histogram import (
+    RAFT_APPEND,
+    RAFT_ELECTION,
+    RAFT_QUORUM,
+    RAFT_REPLICATION,
+    RAFT_SNAPSHOT_XFER,
+    histograms,
+)
+from nomad_tpu.telemetry.trace import consensus_recorder, tracer
 from nomad_tpu.utils.faultpoints import FaultError, fault
 
 # reserved msg_types for replicated membership changes, handled by the
@@ -125,7 +135,7 @@ class RaftNode:
             from nomad_tpu.raft import wal as _wal
 
             os.makedirs(data_dir, exist_ok=True)
-            self._stable = _wal.StableStore(data_dir)
+            self._stable = _wal.StableStore(data_dir, owner=node_id)
             self.current_term, self.voted_for = self._stable.load()
             self._snapshots = _wal.SnapshotStore(data_dir, owner=node_id)
             snap = self._snapshots.load_newest()
@@ -133,7 +143,8 @@ class RaftNode:
                 self.recovered_snapshot_index = snap[0]
                 self.restore_fn(snap[2])
             store = _wal.DurableLogStore(
-                os.path.join(data_dir, "wal"), fsync_policy=fsync_policy)
+                os.path.join(data_dir, "wal"), fsync_policy=fsync_policy,
+                owner=node_id)
             self.replayed_entries = store.replayed_entries
             if snap is not None and store.base_index() < snap[0]:
                 # crash between snapshot write and the compact record:
@@ -153,7 +164,7 @@ class RaftNode:
                     "unrecoverable (refusing to boot with silent "
                     "data loss)")
             log_store = store
-            _wal.wal_stats.note_recovery()
+            _wal.wal_stats.note_recovery(node_id)
             if self.replayed_entries or snap is not None:
                 LOG.info(
                     "%s: recovered from %s (term=%d vote=%s "
@@ -180,6 +191,35 @@ class RaftNode:
 
         self._futures: Dict[int, _ApplyFuture] = {}
         self._apply_cond = threading.Condition(self._lock)
+        # --- consensus-plane observability (ISSUE 15) -------------------
+        # leader-side append stamps (index -> monotonic) feed the
+        # always-on quorum/replication-lag histograms: O(1) dict ops
+        # per apply, pruned as the commit index advances
+        self._append_stamps: Dict[int, float] = {}
+        #: highest index already pruned from _append_stamps — lets the
+        #: per-ack prune skip its O(stamps) scan when the floor is
+        #: pinned by a lagging/dead peer
+        self._stamp_floor = 0
+        # (histogram op, seconds) samples collected under self._lock,
+        # recorded OUTSIDE it by _obs_flush (R2: no foreign locks
+        # inside the raft critical sections)
+        self._obs_pending: List[Tuple[str, float]] = []
+        # the newest applier's trace context, shipped inside raft RPCs
+        # so one eval's span tree spans leader and followers (batch
+        # envelope semantics — the waterfall claims by overlap)
+        self._repl_trace_ctx: Optional[Tuple[str, int]] = None
+        # open-election stamp for the election-duration observation
+        self._election_started_mono: Optional[float] = None
+        raft_observer.register(node_id, self)
+        if self._durable and (self.replayed_entries
+                              or self.recovered_snapshot_index):
+            # recovered indexes ride in detail, NOT as a causal pin: a
+            # recovery replays OLD indexes and must order by clock,
+            # not be sorted back to where those entries first landed
+            raft_observer.note_event(
+                node_id, "recovery", term=self.current_term,
+                detail={"replayed": self.replayed_entries,
+                        "snapshot_index": self.recovered_snapshot_index})
         # one persistent replicator per peer, individually woken -- a
         # slow peer must not delay heartbeats to the others
         self._peer_wakes: Dict[str, threading.Event] = {
@@ -232,6 +272,7 @@ class RaftNode:
             from nomad_tpu.raft.wal import wal_stats
 
             wal_stats.note_cache(self.id, 0)
+        raft_observer.unregister(self.id)
 
     # --- durability helpers (raft/wal.py, ISSUE 13) ---------------------
 
@@ -251,7 +292,10 @@ class RaftNode:
         self._lock — an fsync must never stretch the RPC/apply
         critical sections."""
         if self._durable:
-            self.log.sync()
+            # raft-fsync is a waterfall segment: the span window is
+            # the disk wait an eval's commit actually sat behind
+            with tracer.span("raft.fsync"):
+                self.log.sync()
 
     def _note_snapshot_cache_locked(self) -> None:
         from nomad_tpu.raft.wal import wal_stats
@@ -275,6 +319,11 @@ class RaftNode:
         # the leader-side entry seam: an injected error here is a raft
         # apply that failed before the append (chaos plane, ISSUE 12)
         fault("raft.apply.pre")
+        if tracer.enabled:
+            # cross-server propagation: the applier's trace context
+            # rides the next AppendEntries so follower-side spans
+            # join this eval's tree (last-writer-wins batch envelope)
+            self._repl_trace_ctx = tracer.context()
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
@@ -285,6 +334,7 @@ class RaftNode:
                 data=(msg_type, req),
             )
             self.log.append(entry)
+            self._append_stamps[entry.index] = time.monotonic()
             fut = _ApplyFuture(entry.index)
             self._futures[entry.index] = fut
         # replicators ship the in-memory entry while the leader's own
@@ -295,6 +345,7 @@ class RaftNode:
         self._wake_replicators()
         self._sync_log()
         self._count_self_match(entry)
+        self._obs_flush()
         return fut.wait(timeout)
 
     def barrier(self, timeout: float = 5.0) -> None:
@@ -309,11 +360,13 @@ class RaftNode:
                 data=None,
             )
             self.log.append(entry)
+            self._append_stamps[entry.index] = time.monotonic()
             fut = _ApplyFuture(entry.index)
             self._futures[entry.index] = fut
         self._wake_replicators()
         self._sync_log()
         self._count_self_match(entry)
+        self._obs_flush()
         fut.wait(timeout)
 
     def _count_self_match(self, entry: LogEntry) -> None:
@@ -351,6 +404,9 @@ class RaftNode:
                 # harness) kills + recovers it.
                 if not wal_halted:
                     wal_halted = True
+                    raft_observer.note_event(
+                        self.id, "wal_failed", term=self.current_term,
+                        detail={"was_leader": self.is_leader()})
                     LOG.error(
                         "%s: WAL failed — halting raft leadership/"
                         "campaigns (kill + restart to recover)", self.id)
@@ -396,6 +452,13 @@ class RaftNode:
             # RPC leaves (a restarted candidate must not re-vote
             # differently in this term)
             self._persist_hard_state_locked()
+            if self._election_started_mono is None:
+                # first round of this election sequence: the elect
+                # phase of a failover runs from HERE to leader_won,
+                # covering failed rounds in between
+                self._election_started_mono = time.monotonic()
+        raft_observer.note_transition(self.id, "election")
+        raft_observer.note_event(self.id, "election_start", term=term)
         LOG.debug("%s starting election term %d", self.id, term)
         if not peers:
             self._maybe_win_locked_check(term)
@@ -442,8 +505,21 @@ class RaftNode:
                 self.match_index = {p: 0 for p in self.peers}
                 self.match_index[self.id] = last
                 became_leader = True
+                election_dur = (
+                    time.monotonic() - self._election_started_mono
+                    if self._election_started_mono is not None else None)
+                self._election_started_mono = None
                 LOG.info("%s became leader for term %d", self.id, term)
         if became_leader:
+            raft_observer.note_transition(self.id, "leader")
+            raft_observer.note_event(self.id, "leader_won", term=term)
+            if election_dur is not None:
+                # election duration feeds the histogram + consensus
+                # recorder: a slow election (repeated timeouts, vote
+                # churn) is a tail event worth a captured record
+                histograms.get(RAFT_ELECTION).record(election_dur)
+                consensus_recorder.observe(
+                    RAFT_ELECTION, election_dur, server_id=self.id)
             # commit a barrier noop from this term; on_leader fires when
             # it applies (guarantees the FSM has all prior state)
             with self._lock:
@@ -473,6 +549,7 @@ class RaftNode:
     def _step_down_locked(self, term: int) -> None:
         was_leader = self.state == LEADER
         self.state = FOLLOWER
+        self._election_started_mono = None
         if term > self.current_term:
             # only a NEW term clears the vote -- resetting within the
             # same term would allow double-voting
@@ -482,8 +559,14 @@ class RaftNode:
             # leaves this node (the stable store's no-change fast path
             # makes the equal-term calls free)
             self._persist_hard_state_locked()
+            raft_observer.note_transition(self.id, "term")
+            raft_observer.note_event(self.id, "term_adopt", term=term)
         self._last_contact = time.monotonic()
         if was_leader:
+            raft_observer.note_transition(self.id, "stepdown")
+            raft_observer.note_event(
+                self.id, "stepdown", term=self.current_term,
+                detail={"was_leader": True})
             # fail pending futures; a new leader owns them now
             for fut in self._futures.values():
                 fut.respond(None, NotLeaderError(self.leader_id))
@@ -563,7 +646,19 @@ class RaftNode:
         fault("raft.replicate.send")
         try:
             if snapshot_req is not None:
+                # index-pinned CREATOR event: the send precedes every
+                # follower's snapshot_install for this index, so the
+                # timeline's skew estimator can anchor the index at
+                # this stamp (telemetry/timeline._estimate_offsets)
+                raft_observer.note_event(
+                    self.id, "snapshot_sent", term=term,
+                    index=snapshot_req["last_index"])
+                xfer_t0 = time.monotonic()
                 resp = self.transport.send(peer, "install_snapshot", snapshot_req)
+                histograms.get(RAFT_SNAPSHOT_XFER).record(
+                    time.monotonic() - xfer_t0)
+                raft_observer.note_snapshot_xfer(
+                    self.id, "sent", len(snapshot_req["data"] or b""))
                 with self._lock:
                     if resp["term"] > self.current_term:
                         self._step_down_locked(resp["term"])
@@ -573,14 +668,23 @@ class RaftNode:
                     self.peer_last_contact[peer] = time.monotonic()
                     self._maybe_drop_snapshot_cache_locked()
                 return
-            resp = self.transport.send(
-                peer, "append_entries",
-                {"term": term, "leader": self.id,
-                 "prev_log_index": prev_index, "prev_log_term": prev_term,
-                 "entries": entries, "leader_commit": commit},
-            )
+            req = {"term": term, "leader": self.id,
+                   "prev_log_index": prev_index,
+                   "prev_log_term": prev_term,
+                   "entries": entries, "leader_commit": commit}
+            if entries and tracer.enabled:
+                # ship the applier's trace context and span the RPC:
+                # raft-replicate is the waterfall's network segment
+                ctx = self._repl_trace_ctx
+                if ctx is not None:
+                    req["trace"] = ctx
+                with tracer.attach(ctx), tracer.span("raft.replicate"):
+                    resp = self.transport.send(peer, "append_entries", req)
+            else:
+                resp = self.transport.send(peer, "append_entries", req)
         except ConnectionError:
             return
+        lag_s = None
         with self._lock:
             if self.state != LEADER or self.current_term != term:
                 return
@@ -590,8 +694,13 @@ class RaftNode:
             self.peer_last_contact[peer] = time.monotonic()
             if resp.get("success"):
                 if entries:
-                    self.match_index[peer] = entries[-1].index
-                    self.next_index[peer] = entries[-1].index + 1
+                    newest = entries[-1].index
+                    stamp = self._append_stamps.get(newest)
+                    if stamp is not None:
+                        lag_s = time.monotonic() - stamp
+                        self._obs_pending.append((RAFT_REPLICATION, lag_s))
+                    self.match_index[peer] = newest
+                    self.next_index[peer] = newest + 1
                     self._advance_commit_locked()
                     self._maybe_drop_snapshot_cache_locked()
                     if self.next_index[peer] <= self.log.last_index():
@@ -603,6 +712,12 @@ class RaftNode:
                     1, hint if hint else self.next_index.get(peer, 2) - 1
                 )
                 self._wake_replicators()
+        if entries and resp.get("success"):
+            raft_observer.note_replicated(
+                self.id, peer, len(entries),
+                lag_ms=round(lag_s * 1e3, 3) if lag_s is not None
+                else None)
+        self._obs_flush()
 
     def _build_snapshot_req_locked(self) -> Dict:
         # the request carries the CACHE's own (index, term) — never
@@ -656,7 +771,52 @@ class RaftNode:
             term_at = self.log.term_at(majority_idx)
             if term_at == self.current_term:
                 self.commit_index = majority_idx
+                # quorum latency = leader append -> majority commit;
+                # sampled at the advancing index, recorded outside
+                # this lock by whichever caller flushes next
+                stamp = self._append_stamps.get(majority_idx)
+                if stamp is not None:
+                    self._obs_pending.append(
+                        (RAFT_QUORUM, time.monotonic() - stamp))
                 self._apply_cond.notify_all()
+        # prune stamps only once EVERY peer has acked them (and commit
+        # has passed): the laggard's stamp must survive to its own ack
+        # so the per-peer replication-lag sample and cluster_health's
+        # LagMs measure the slowest peer, not just the majority
+        floor = self.commit_index
+        if self.peers:
+            floor = min(min(self.match_index.get(p, 0)
+                            for p in self.peers), floor)
+        if floor > self._stamp_floor:
+            for idx in [i for i in self._append_stamps if i <= floor]:
+                del self._append_stamps[idx]
+            self._stamp_floor = floor
+
+    def _obs_flush(self) -> None:
+        """Record the latency samples the locked sections collected.
+        Called OUTSIDE self._lock; histogram records are the always-on
+        O(µs) budget, the quorum waterfall span only exists when
+        tracing is on."""
+        with self._lock:
+            if len(self._append_stamps) > 4096:
+                # a dead peer pins the min-match prune floor; shed the
+                # oldest stamps but keep the live tail so quorum and
+                # healthy-peer ack samples survive the guard. Runs
+                # BEFORE the empty-pending bail: a leader without
+                # quorum collects no samples at all, which is exactly
+                # when stamps grow unboundedly
+                for idx in sorted(self._append_stamps)[:-1024]:
+                    del self._append_stamps[idx]
+            if not self._obs_pending:
+                return
+            pending, self._obs_pending = self._obs_pending, []
+        enabled = tracer.enabled
+        for op, dur in pending:
+            histograms.get(op).record(dur)
+            if enabled and op == RAFT_QUORUM:
+                # retroactive leaf record: the waterfall claims it by
+                # overlap with the eval's commit window
+                tracer.record("raft.quorum", dur)
 
     # --- apply loop -----------------------------------------------------
 
@@ -720,7 +880,12 @@ class RaftNode:
                             # latency only on clusters, errors only
                             # single-server (docs/ROBUSTNESS.md)
                             fault("raft.fsm.apply")
-                            result = self.fsm_apply(msg_type, req)
+                            # raft-apply is the waterfall envelope
+                            # around the FSM's own fsm.apply span
+                            # (leaf-out: fsm claims first, this span
+                            # keeps the dispatch residue)
+                            with tracer.span("raft.apply"):
+                                result = self.fsm_apply(msg_type, req)
                     except Exception as e:          # noqa: BLE001
                         error = e
                         LOG.warning(
@@ -819,6 +984,17 @@ class RaftNode:
             return {"term": self.current_term, "granted": granted}
 
     def _on_append_entries(self, req: Dict) -> Dict:
+        if req.get("entries") and tracer.enabled:
+            # adopt the leader-shipped trace context so this
+            # follower's spans land in the originating eval's tree —
+            # the cross-server propagation ISSUE 15 adds
+            with tracer.attach(req.get("trace")), \
+                    tracer.span("raft.follower.append"):
+                return self._append_entries_observed(req)
+        return self._append_entries_observed(req)
+
+    def _append_entries_observed(self, req: Dict) -> Dict:
+        t0 = time.monotonic() if req.get("entries") else 0.0
         with self._lock:
             resp, dirty = self._append_entries_locked(req)
         if dirty:
@@ -827,6 +1003,14 @@ class RaftNode:
             # the lock — an fsync must not stall the RPC plane).
             # Heartbeats and rejections stay fsync-free.
             self._sync_log()
+        if t0:
+            # follower append handling incl. its group fsync: the
+            # always-on distribution + the consensus flight recorder
+            dur = time.monotonic() - t0
+            histograms.get(RAFT_APPEND).record(dur)
+            consensus_recorder.observe(
+                RAFT_APPEND, dur, server_id=self.id,
+                trace_id=(req.get("trace") or ("",))[0])
         return resp
 
     def _append_entries_locked(self, req: Dict) -> Tuple[Dict, bool]:
@@ -889,6 +1073,11 @@ class RaftNode:
             if req["data"] is None:
                 # never wipe local state for an empty snapshot
                 return {"term": self.current_term}
+        raft_observer.note_snapshot_xfer(
+            self.id, "received", len(req["data"]))
+        raft_observer.note_event(
+            self.id, "snapshot_install", term=req["term"],
+            index=req["last_index"])
         if self._snapshots is not None:
             # the multi-MB durable file write runs OUTSIDE self._lock
             # (an fsync must not stall the RPC/ticker plane) and disk
@@ -941,7 +1130,18 @@ class RaftNode:
                 if request_id in self._forward_results:
                     return {"ok": True, "result": self._forward_results[request_id]}
         try:
-            result = self.apply(req["msg_type"], req["req"], timeout=10.0)
+            ctx = req.get("trace")
+            if ctx is not None and tracer.enabled:
+                # forwarded applies keep the origin server's trace id:
+                # the leader-side spans (fsync/quorum/apply) join the
+                # forwarding eval's tree
+                with tracer.attach(tuple(ctx)), \
+                        tracer.span("raft.forward.apply"):
+                    result = self.apply(req["msg_type"], req["req"],
+                                        timeout=10.0)
+            else:
+                result = self.apply(req["msg_type"], req["req"],
+                                    timeout=10.0)
         except NotLeaderError as e:
             return {"ok": False, "not_leader": True, "leader": e.leader}
         if request_id is not None:
@@ -964,11 +1164,15 @@ class RaftNode:
                     return self.apply(msg_type, req, timeout)
                 time.sleep(0.05)
                 continue
+            fwd = {"msg_type": msg_type, "req": req,
+                   "request_id": request_id}
+            if tracer.enabled:
+                ctx = tracer.context()
+                if ctx is not None:
+                    fwd["trace"] = ctx
             try:
                 resp = self.transport.send(
-                    leader, "forward_apply",
-                    {"msg_type": msg_type, "req": req,
-                     "request_id": request_id},
+                    leader, "forward_apply", fwd,
                     timeout=timeout,
                 )
             except ConnectionError:
@@ -988,6 +1192,80 @@ class RaftNode:
                 "commit_index": self.commit_index,
                 "last_applied": self.last_applied,
                 "last_log_index": self.log.last_index(),
+            }
+
+    # --- consensus-plane observability (ISSUE 15) -----------------------
+
+    def observe_gauges(self) -> Dict:
+        """Live gauges for the observer's per-server snapshot (the
+        exporter's ``server_id``-labeled series)."""
+        now = time.monotonic()
+        with self._lock:
+            last_log = self.log.last_index()
+            return {
+                "state": self.state,
+                "is_leader": 1 if self.state == LEADER else 0,
+                "term": self.current_term,
+                "commit_index": self.commit_index,
+                "last_applied": self.last_applied,
+                "last_log_index": last_log,
+                "peer_lag_entries": {
+                    p: last_log - self.match_index.get(p, 0)
+                    for p in self.peers
+                } if self.state == LEADER else {},
+                "peer_last_contact_s": {
+                    p: round(now - self.peer_last_contact[p], 3)
+                    for p in self.peers if p in self.peer_last_contact
+                },
+            }
+
+    def cluster_health(self) -> Dict:
+        """The autopilot-style per-peer view /v1/operator/
+        cluster-health renders: this server's identity + raft state,
+        and (leader-side) each peer's match index, entry/ms lag, and
+        last-contact age. Lag in ms is the age of the oldest entry the
+        peer has NOT acked — 0 when fully caught up, null when the
+        age is UNKNOWN (this leader holds no stamp for that entry:
+        inherited from a previous leader after failover, or shed by
+        the growth guard) so a lagging peer can never read as
+        "caught up in ms"."""
+        now = time.monotonic()
+        with self._lock:
+            last_log = self.log.last_index()
+            peers = []
+            for p in self.peers:
+                match = self.match_index.get(p, 0)
+                lag_entries = max(last_log - match, 0)
+                lag_ms: Optional[float] = 0.0
+                if lag_entries and self.state == LEADER:
+                    stamp = self._append_stamps.get(match + 1)
+                    lag_ms = round((now - stamp) * 1e3, 3) \
+                        if stamp is not None else None
+                contact = self.peer_last_contact.get(p)
+                contact_ms = round((now - contact) * 1e3, 3) \
+                    if contact is not None else None
+                peers.append({
+                    "Id": p,
+                    "MatchIndex": match,
+                    "LagEntries": lag_entries,
+                    "LagMs": lag_ms,
+                    "LastContactMs": contact_ms,
+                    "Healthy": bool(
+                        contact is not None
+                        and now - contact
+                        < 10 * self.config.heartbeat_interval
+                        and lag_entries < 1024),
+                })
+            return {
+                "ServerId": self.id,
+                "State": self.state,
+                "Term": self.current_term,
+                "Leader": self.id if self.state == LEADER
+                else self.leader_id,
+                "CommitIndex": self.commit_index,
+                "LastApplied": self.last_applied,
+                "LastLogIndex": last_log,
+                "Peers": peers,
             }
 
     # --- membership + health (autopilot's raft surface) -----------------

@@ -74,6 +74,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from nomad_tpu.raft.log import LogEntry, LogStore
 from nomad_tpu.telemetry.histogram import WAL_FSYNC, histograms
+from nomad_tpu.telemetry.trace import consensus_recorder
 from nomad_tpu.utils.faultpoints import FaultError, fault
 from nomad_tpu.utils.witness import witness_lock
 
@@ -98,7 +99,17 @@ class DurabilityStats:
     """Process-wide durability accounting (every WAL/StableStore/
     SnapshotStore feeds it; multi-server tests share one). Gauge-like
     values (cache/disk snapshot bytes) are kept per owner and summed
-    at snapshot time so co-resident servers never clobber each other."""
+    at snapshot time so co-resident servers never clobber each other.
+
+    ISSUE 15: every note site also carries an ``owner`` (the server
+    id), accumulated per owner so co-resident ``make_cluster`` servers
+    stop blending into one truth — the exporter renders
+    :meth:`per_server` with a ``server_id`` label next to the
+    process-wide aggregates."""
+
+    _PER_KEYS = ("frames", "fsyncs", "wal_fsyncs",
+                 "fsync_batch_frames", "bytes_written",
+                 "replayed_entries", "torn_truncations", "recoveries")
 
     def __init__(self) -> None:
         self._lock = witness_lock("wal.DurabilityStats._lock")
@@ -113,27 +124,75 @@ class DurabilityStats:
         self.snapshots_invalid = 0
         self._cache_bytes: Dict[str, int] = {}
         self._disk_bytes: Dict[str, int] = {}
+        #: owner -> per-server counters (_PER_KEYS)
+        self._per: Dict[str, Dict[str, int]] = {}
+        #: owner -> live WAL occupancy (segments, pending frames, ...)
+        self._occupancy: Dict[str, Dict[str, int]] = {}
 
-    def note_frame(self, nbytes: int) -> None:
+    def _bump_locked(self, owner: str, key: str, n: int) -> None:
+        if not owner:
+            return
+        row = self._per.get(owner)
+        if row is None:
+            row = self._per[owner] = {k: 0 for k in self._PER_KEYS}
+        row[key] += n
+
+    def note_frame(self, nbytes: int, owner: str = "") -> None:
         with self._lock:
             self.frames += 1
             self.bytes_written += nbytes
+            self._bump_locked(owner, "frames", 1)
+            self._bump_locked(owner, "bytes_written", nbytes)
 
-    def note_fsync(self) -> None:
+    def note_fsync(self, owner: str = "", covered_frames: int = 0,
+                   wal: bool = False) -> None:
+        """One fsync; ``covered_frames`` is the group-fsync batch
+        occupancy (how many journaled frames this sync made durable —
+        the amortization the batched-commit windows buy). ``wal``
+        marks WAL record fsyncs (group syncs + rotation seals): only
+        those enter ``fsync_batch_avg``'s denominator, so stable-store
+        term persists and snapshot-file fsyncs — which cover no frames
+        by construction — cannot dilute the amortization gauge."""
         with self._lock:
             self.fsyncs += 1
+            self._bump_locked(owner, "fsyncs", 1)
+            if wal:
+                self._bump_locked(owner, "wal_fsyncs", 1)
+            if covered_frames:
+                self._bump_locked(owner, "fsync_batch_frames",
+                                  covered_frames)
 
-    def note_replay(self, entries: int) -> None:
+    def note_replay(self, entries: int, owner: str = "") -> None:
         with self._lock:
             self.replayed_entries += entries
+            self._bump_locked(owner, "replayed_entries", entries)
 
-    def note_torn(self) -> None:
+    def note_torn(self, owner: str = "") -> None:
         with self._lock:
             self.torn_truncations += 1
+            self._bump_locked(owner, "torn_truncations", 1)
 
-    def note_recovery(self) -> None:
+    def note_recovery(self, owner: str = "") -> None:
         with self._lock:
             self.recoveries += 1
+            self._bump_locked(owner, "recoveries", 1)
+
+    def note_wal_state(self, owner: str, segments: int,
+                       pending_frames: int, live_segment_bytes: int,
+                       failed: bool) -> None:
+        """WAL occupancy gauge feed (segment count, frames written but
+        not yet covered by an fsync, live-segment fill, fail-stop
+        flag). Updated at sync/rotate/close — gauge cadence, not
+        per-frame."""
+        if not owner:
+            return
+        with self._lock:
+            self._occupancy[owner] = {
+                "segments": segments,
+                "pending_frames": pending_frames,
+                "live_segment_bytes": live_segment_bytes,
+                "wal_failed": 1 if failed else 0,
+            }
 
     def note_snapshot(self, written: int = 0, pruned: int = 0,
                       invalid: int = 0) -> None:
@@ -174,6 +233,22 @@ class DurabilityStats:
                 "snapshot_disk_bytes": sum(self._disk_bytes.values()),
             }
 
+    def per_server(self) -> Dict[str, Dict]:
+        """Per-owner durability counters + WAL occupancy (ISSUE 15:
+        the per-replica view the exporter labels with ``server_id``)."""
+        with self._lock:
+            out: Dict[str, Dict] = {}
+            for owner in set(self._per) | set(self._occupancy):
+                row = dict(self._per.get(
+                    owner, {k: 0 for k in self._PER_KEYS}))
+                row.update(self._occupancy.get(owner, {}))
+                wal_fsyncs = row.get("wal_fsyncs", 0)
+                row["fsync_batch_avg"] = round(
+                    row.get("fsync_batch_frames", 0) / wal_fsyncs, 4) \
+                    if wal_fsyncs else 0.0
+                out[owner] = row
+            return out
+
     def reset_stats(self) -> None:
         with self._lock:
             self.fsyncs = 0
@@ -187,6 +262,8 @@ class DurabilityStats:
             self.snapshots_invalid = 0
             self._cache_bytes.clear()
             self._disk_bytes.clear()
+            self._per.clear()
+            self._occupancy.clear()
 
 
 #: process-wide durability counters (telemetry/exporter.py source)
@@ -259,9 +336,10 @@ class StableStore:
     free (the heartbeat path calls through here every term touch).
     """
 
-    def __init__(self, data_dir: str) -> None:
+    def __init__(self, data_dir: str, owner: str = "") -> None:
         self._dir = data_dir
         self._path = os.path.join(data_dir, "stable")
+        self._owner = owner
         self._lock = witness_lock("wal.StableStore._lock")
         self._term = 0
         self._vote: Optional[str] = None
@@ -310,7 +388,7 @@ class StableStore:
             os.replace(tmp, self._path)
             _fsync_dir(self._dir)
             self._term, self._vote = term, voted_for
-            wal_stats.note_fsync()
+            wal_stats.note_fsync(self._owner)
 
 
 # --- snapshot store ------------------------------------------------------
@@ -372,7 +450,7 @@ class SnapshotStore:
                 os.fsync(f.fileno())
             os.replace(tmp, path)
             _fsync_dir(self._dir)
-            wal_stats.note_fsync()
+            wal_stats.note_fsync(self._owner)
             wal_stats.note_snapshot(written=1)
             pruned = 0
             for _, _, old in self._paths()[_SNAP_KEEP:]:
@@ -425,7 +503,8 @@ class WriteAheadLog:
     """
 
     def __init__(self, wal_dir: str, fsync_policy: str = "batch",
-                 segment_max_bytes: int = DEFAULT_SEGMENT_BYTES) -> None:
+                 segment_max_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 owner: str = "") -> None:
         if fsync_policy not in ("always", "batch"):
             raise ValueError(
                 f"fsync_policy must be 'always' or 'batch', "
@@ -434,6 +513,7 @@ class WriteAheadLog:
         self.dir = wal_dir
         self.fsync_policy = fsync_policy
         self.segment_max_bytes = segment_max_bytes
+        self.owner = owner
         self._lock = witness_lock("wal.WriteAheadLog._lock")
         self._sync_lock = witness_lock("wal.WriteAheadLog._sync_lock")
         self._file = None
@@ -497,7 +577,7 @@ class WriteAheadLog:
                         f.truncate(offset)
                         f.flush()
                         os.fsync(f.fileno())
-                    wal_stats.note_torn()
+                    wal_stats.note_torn(self.owner)
                     break
                 offset, payload = parsed
                 record = pickle.loads(payload)
@@ -513,6 +593,7 @@ class WriteAheadLog:
             self._file = open(segments[-1][1], "ab")
         else:
             self._open_segment(0)
+        self._note_occupancy_locked()
         return records
 
     def _open_segment(self, seq: int) -> None:
@@ -560,7 +641,7 @@ class WriteAheadLog:
             self._written += 1
             self._size += len(blob)
             self._max_touched = max(self._max_touched, touched)
-            wal_stats.note_frame(len(blob))
+            wal_stats.note_frame(len(blob), self.owner)
             if self._size >= self.segment_max_bytes:
                 self._rotate_locked()
         if self.fsync_policy == "always":
@@ -578,11 +659,20 @@ class WriteAheadLog:
         f.flush()
         os.fsync(f.fileno())
         f.close()
-        wal_stats.note_fsync()
+        wal_stats.note_fsync(self.owner,
+                             covered_frames=self._written - self._synced,
+                             wal=True)
         path = os.path.join(self.dir, f"wal-{self._seq:08d}.seg")
         self._sealed.append((self._seq, self._max_touched, path))
         self._synced = self._written
         self._open_segment(self._seq + 1)
+        self._note_occupancy_locked()
+
+    def _note_occupancy_locked(self) -> None:
+        wal_stats.note_wal_state(
+            self.owner, segments=len(self._sealed) + 1,
+            pending_frames=self._written - self._synced,
+            live_segment_bytes=self._size, failed=self._failed)
 
     def sync(self) -> None:
         """Make every written frame durable. Group-coalesced: the
@@ -619,10 +709,21 @@ class WriteAheadLog:
                     self._failed = True
                 raise
             with self._lock:
+                # batch occupancy is claimed AT the watermark move: a
+                # rotation racing this sync already counted (and
+                # advanced past) these frames — claiming them again
+                # would double-count fsync_batch_frames
+                covered = max(target - self._synced, 0)
                 if target > self._synced:
                     self._synced = target
-        wal_stats.note_fsync()
-        histograms.get(WAL_FSYNC).record(time.perf_counter() - t0)
+                self._note_occupancy_locked()
+        dur = time.perf_counter() - t0
+        wal_stats.note_fsync(self.owner, covered_frames=covered,
+                             wal=True)
+        histograms.get(WAL_FSYNC).record(dur)
+        # consensus flight recorder: a group fsync past the adaptive
+        # p99 bar gets captured for /v1/operator/slow-raft (ISSUE 15)
+        consensus_recorder.observe(WAL_FSYNC, dur, server_id=self.owner)
 
     def compact_through(self, index: int) -> None:
         """Delete sealed segments wholly superseded by a snapshot at
@@ -717,10 +818,12 @@ class DurableLogStore(LogStore):
     """
 
     def __init__(self, wal_dir: str, fsync_policy: str = "batch",
-                 segment_max_bytes: int = DEFAULT_SEGMENT_BYTES) -> None:
+                 segment_max_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 owner: str = "") -> None:
         super().__init__()
         self._wal = WriteAheadLog(wal_dir, fsync_policy=fsync_policy,
-                                  segment_max_bytes=segment_max_bytes)
+                                  segment_max_bytes=segment_max_bytes,
+                                  owner=owner)
         records = self._wal.replay()
         base_index, base_term, entries = replay_records(records)
         # the recovered log must be contiguous from its base — a hole
@@ -739,7 +842,7 @@ class DurableLogStore(LogStore):
         self._base_term = base_term
         self._entries = entries
         self.replayed_entries = len(entries)
-        wal_stats.note_replay(self.replayed_entries)
+        wal_stats.note_replay(self.replayed_entries, owner)
 
     @property
     def wal(self) -> WriteAheadLog:

@@ -751,6 +751,16 @@ class Server:
                 )
                 self._leader_threads.append(t)
                 t.start()
+            if self.raft is not None:
+                # consensus event: server-side leadership is live
+                # (broker restored, watchers enabled) — the failover
+                # timeline's `replay` phase ends here (ISSUE 15)
+                from nomad_tpu.raft.observe import raft_observer
+
+                raft_observer.note_event(
+                    self.raft.id, "established",
+                    term=self.raft.current_term,
+                    detail={"state_index": self.state.latest_index()})
 
     def revoke_leadership(self) -> None:
         """leader.go revokeLeadership."""
@@ -774,6 +784,12 @@ class Server:
             for w in self.workers:
                 w.set_pause(True)
             self._leader_threads.clear()
+            if self.raft is not None:
+                from nomad_tpu.raft.observe import raft_observer
+
+                raft_observer.note_event(
+                    self.raft.id, "revoked",
+                    term=self.raft.current_term)
 
     def _leader_loop(self, fn, interval: float, gen: int) -> None:
         from nomad_tpu.telemetry.trace import tracer

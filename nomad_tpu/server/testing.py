@@ -78,6 +78,15 @@ def hard_kill(server: Server) -> None:
     mid-write crash states are produced by the ``wal.frame.torn`` /
     ``wal.sync`` / ``wal.snapshot.write`` fault points instead
     (docs/ROBUSTNESS.md), which the restart cell's torn leg drives."""
+    from nomad_tpu.raft.observe import raft_observer
+
+    if server.raft is not None:
+        # the timeline's loss marker: a killed LEADER opens a failover
+        # window (telemetry/timeline.py); a killed follower is an
+        # event but not a loss
+        raft_observer.note_event(
+            server.raft.id, "killed", term=server.raft.current_term,
+            detail={"was_leader": server.raft.is_leader()})
     server.shutdown()
 
 

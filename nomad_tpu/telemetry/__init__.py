@@ -29,9 +29,11 @@ from nomad_tpu.telemetry.kernel_profile import (  # noqa: F401
     profiler,
 )
 from nomad_tpu.telemetry.trace import (  # noqa: F401
+    ConsensusRecorder,
     FlightRecorder,
     Span,
     Tracer,
+    consensus_recorder,
     flight_recorder,
     tracer,
 )
@@ -41,6 +43,7 @@ __all__ = [
     "KernelProfiler", "profiler", "profiled_call",
     "LatencyHistogram", "HistogramRegistry", "histograms", "percentile",
     "FlightRecorder", "flight_recorder",
+    "ConsensusRecorder", "consensus_recorder",
     "enable", "disable", "enabled", "reset",
 ]
 
@@ -66,6 +69,15 @@ def reset() -> None:
     # same burst window as the tracer aggregates
     histograms.reset()
     flight_recorder.reset()
+    # the consensus-plane recorder + per-server raft observer counters
+    # follow the same burst window (live-node registrations survive)
+    consensus_recorder.reset()
+    try:
+        from nomad_tpu.raft.observe import raft_observer
+
+        raft_observer.reset_stats()
+    except Exception:                           # noqa: BLE001
+        pass
     try:
         # wave-shape stats (fill ratio, park latency) live with the
         # coalescer; reset them with the rest so burst decompositions

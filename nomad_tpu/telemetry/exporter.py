@@ -24,12 +24,29 @@ from typing import Dict, List
 
 from nomad_tpu.telemetry.histogram import histograms
 from nomad_tpu.telemetry.kernel_profile import profiler
-from nomad_tpu.telemetry.trace import flight_recorder, tracer
+from nomad_tpu.telemetry.trace import (
+    consensus_recorder,
+    flight_recorder,
+    tracer,
+)
 from nomad_tpu.utils import metrics as _metrics
 
 
 def _esc(v: str) -> str:
-    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    """Prometheus label-value escaping: backslash, quote, AND newline
+    (the text exposition is line-framed — an unescaped newline in a
+    label value corrupts every series after it). ISSUE 15 routes every
+    labeled series through this one helper (via :func:`_lbl`); server
+    ids and trace ids now flow into labels, so hygiene is load-bearing
+    rather than cosmetic."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _lbl(**kv) -> str:
+    """Render ``k="v"`` label pairs, every value escaped. The single
+    seam all labeled series go through."""
+    return ",".join(f'{k}="{_esc(v)}"' for k, v in kv.items())
 
 
 def prometheus_text(registry=None, event_broker=None) -> str:
@@ -46,26 +63,26 @@ def prometheus_text(registry=None, event_broker=None) -> str:
         lines.append("# TYPE nomad_tpu_trace_span_seconds_total counter")
         for name, agg in stages.items():
             lines.append(
-                f'nomad_tpu_trace_span_seconds_total{{span="{_esc(name)}"}} '
+                f'nomad_tpu_trace_span_seconds_total{{{_lbl(span=name)}}} '
                 f"{agg['total_s']:.6f}")
         lines.append(
             "# TYPE nomad_tpu_trace_span_exclusive_seconds_total counter")
         for name, agg in stages.items():
             lines.append(
                 f'nomad_tpu_trace_span_exclusive_seconds_total'
-                f'{{span="{_esc(name)}"}} '
+                f'{{{_lbl(span=name)}}} '
                 f"{agg['exclusive_s']:.6f}")
         lines.append("# TYPE nomad_tpu_trace_span_count counter")
         for name, agg in stages.items():
             lines.append(
-                f'nomad_tpu_trace_span_count{{span="{_esc(name)}"}} '
+                f'nomad_tpu_trace_span_count{{{_lbl(span=name)}}} '
                 f"{agg['count']}")
 
     prof = profiler.summary()
     lines.append("# TYPE nomad_tpu_kernel_stage_seconds_total counter")
     for stage, secs in sorted(prof["StageSeconds"].items()):
         lines.append(
-            f'nomad_tpu_kernel_stage_seconds_total{{stage="{stage}"}} '
+            f'nomad_tpu_kernel_stage_seconds_total{{{_lbl(stage=stage)}}} '
             f"{secs}")
     # transfer BYTES per direction (ISSUE 3): seconds say how long the
     # PCIe stages took, bytes say whether the payload shrank — the
@@ -74,14 +91,13 @@ def prometheus_text(registry=None, event_broker=None) -> str:
     for direction, n in sorted(prof.get("TransferBytes", {}).items()):
         lines.append(
             f'nomad_tpu_kernel_transfer_bytes_total'
-            f'{{direction="{direction}"}} {n}')
+            f'{{{_lbl(direction=direction)}}} {n}')
     if prof["PerKey"]:
         lines.append(
             "# TYPE nomad_tpu_kernel_jit_cache_misses_total counter")
         lines.append("# TYPE nomad_tpu_kernel_launches_total counter")
         for row in prof["PerKey"]:
-            labels = (f'kernel="{_esc(row["Kernel"])}",'
-                      f'key="{_esc(row["Key"])}"')
+            labels = _lbl(kernel=row["Kernel"], key=row["Key"])
             lines.append(
                 f"nomad_tpu_kernel_jit_cache_misses_total{{{labels}}} "
                 f"{row['Misses']}")
@@ -292,13 +308,13 @@ def prometheus_text(registry=None, event_broker=None) -> str:
             for point, row in fp.items():
                 lines.append(
                     f'nomad_tpu_fault_hits_total'
-                    f'{{point="{_esc(point)}"}} {row["hits"]}')
+                    f'{{{_lbl(point=point)}}} {row["hits"]}')
             lines.append("# TYPE nomad_tpu_fault_fires_total counter")
             for point, row in fp.items():
                 kind = row["kind"] or "none"
                 lines.append(
                     f'nomad_tpu_fault_fires_total'
-                    f'{{point="{_esc(point)}",kind="{kind}"}} '
+                    f'{{{_lbl(point=point, kind=kind)}}} '
                     f'{row["fires"]}')
     except Exception:                           # noqa: BLE001
         pass                # fault plane unavailable: skip series
@@ -355,6 +371,106 @@ def prometheus_text(registry=None, event_broker=None) -> str:
                 f'{d[key]}')
     except Exception:                           # noqa: BLE001
         pass                # durability plane unavailable: skip series
+    # per-replica consensus plane (ISSUE 15): raft state/term/lag and
+    # WAL counters with a server_id label, so co-resident
+    # make_cluster servers report three distinguishable truths
+    # instead of one blended process-global one. Aggregate series
+    # above stay for single-server scrapes; these are the per-replica
+    # view the cluster-health endpoint renders.
+    try:
+        from nomad_tpu.raft.observe import raft_observer
+        from nomad_tpu.raft.wal import wal_stats as _wal_stats
+
+        per = raft_observer.snapshot()
+        live = {sid: row for sid, row in sorted(per.items())
+                if row.get("live")}
+        if live:
+            for series, key in (("nomad_tpu_raft_term", "term"),
+                                ("nomad_tpu_raft_is_leader",
+                                 "is_leader"),
+                                ("nomad_tpu_raft_commit_index",
+                                 "commit_index"),
+                                ("nomad_tpu_raft_last_applied",
+                                 "last_applied")):
+                lines.append(f"# TYPE {series} gauge")
+                for sid, row in live.items():
+                    lines.append(
+                        f'{series}{{{_lbl(server_id=sid)}}} {row[key]}')
+            lines.append(
+                "# TYPE nomad_tpu_raft_peer_lag_entries gauge")
+            lines.append(
+                "# TYPE nomad_tpu_raft_peer_last_contact_seconds gauge")
+            for sid, row in live.items():
+                for peer, lag in sorted(
+                        row.get("peer_lag_entries", {}).items()):
+                    lines.append(
+                        f'nomad_tpu_raft_peer_lag_entries'
+                        f'{{{_lbl(server_id=sid, peer=peer)}}} {lag}')
+                for peer, age in sorted(
+                        row.get("peer_last_contact_s", {}).items()):
+                    lines.append(
+                        f'nomad_tpu_raft_peer_last_contact_seconds'
+                        f'{{{_lbl(server_id=sid, peer=peer)}}} {age}')
+        if any(row.get("transitions") or row.get("replicated_entries")
+               or row.get("snapshot_xfer_bytes")
+               for row in per.values()):
+            lines.append(
+                "# TYPE nomad_tpu_raft_transitions_total counter")
+            lines.append(
+                "# TYPE nomad_tpu_raft_replicated_entries_total counter")
+            lines.append("# TYPE nomad_tpu_raft_peer_lag_seconds gauge")
+            lines.append(
+                "# TYPE nomad_tpu_raft_snapshot_transfer_bytes_total "
+                "counter")
+            for sid, row in sorted(per.items()):
+                for kind, n in sorted(row["transitions"].items()):
+                    lines.append(
+                        f'nomad_tpu_raft_transitions_total'
+                        f'{{{_lbl(server_id=sid, kind=kind)}}} {n}')
+                for peer, n in sorted(
+                        row["replicated_entries"].items()):
+                    lines.append(
+                        f'nomad_tpu_raft_replicated_entries_total'
+                        f'{{{_lbl(server_id=sid, peer=peer)}}} {n}')
+                for peer, ms in sorted(row["peer_lag_ms"].items()):
+                    lines.append(
+                        f'nomad_tpu_raft_peer_lag_seconds'
+                        f'{{{_lbl(server_id=sid, peer=peer)}}} '
+                        f'{ms / 1e3:.6f}')
+                for direction, n in sorted(
+                        row["snapshot_xfer_bytes"].items()):
+                    lines.append(
+                        f'nomad_tpu_raft_snapshot_transfer_bytes_total'
+                        f'{{{_lbl(server_id=sid, direction=direction)}}} '
+                        f'{n}')
+        walper = _wal_stats.per_server()
+        if walper:
+            for series, key, mtype in (
+                    ("nomad_tpu_raft_wal_frames_total", "frames",
+                     "counter"),
+                    ("nomad_tpu_raft_wal_fsyncs_total", "fsyncs",
+                     "counter"),
+                    ("nomad_tpu_raft_wal_bytes_total", "bytes_written",
+                     "counter"),
+                    ("nomad_tpu_raft_wal_replayed_entries_total",
+                     "replayed_entries", "counter"),
+                    ("nomad_tpu_raft_wal_torn_truncations_total",
+                     "torn_truncations", "counter"),
+                    ("nomad_tpu_raft_wal_segments", "segments",
+                     "gauge"),
+                    ("nomad_tpu_raft_wal_pending_frames",
+                     "pending_frames", "gauge"),
+                    ("nomad_tpu_raft_wal_fsync_batch_avg",
+                     "fsync_batch_avg", "gauge"),
+                    ("nomad_tpu_raft_wal_failed", "wal_failed",
+                     "gauge")):
+                lines.append(f"# TYPE {series} {mtype}")
+                for sid, row in sorted(walper.items()):
+                    lines.append(
+                        f'{series}{{{_lbl(server_id=sid)}}} '
+                        f'{row.get(key, 0)}')
+    except Exception:                           # noqa: BLE001
+        pass                # consensus plane unavailable: skip series
     # wave-cohort drain accounting (utils/wavecohort.py): the plan
     # queue's wave-boundary batching — armed waves, landed plans,
     # whole-cohort drains vs expirations vs hard-cap clamps, and the
@@ -458,7 +574,7 @@ def prometheus_text(registry=None, event_broker=None) -> str:
         lines.append("# TYPE nomad_tpu_latency_seconds histogram")
         for name, h in hist_items:
             lines.extend(h.prometheus_lines(
-                "nomad_tpu_latency_seconds", f'op="{_esc(name)}"'))
+                "nomad_tpu_latency_seconds", _lbl(op=name)))
     # slow-eval flight recorder health: captures say the tail is being
     # recorded, threshold says where the adaptive p99 bar sits
     fr = flight_recorder.snapshot()
@@ -470,6 +586,12 @@ def prometheus_text(registry=None, event_broker=None) -> str:
     lines.append(
         f"nomad_tpu_slow_eval_threshold_seconds "
         f"{fr['threshold_ms'] / 1e3:.6f}")
+    # consensus flight recorder health (ISSUE 15): slow raft appends /
+    # fsync batches / elections captured past the adaptive bar
+    cr = consensus_recorder.snapshot()
+    lines.append("# TYPE nomad_tpu_slow_raft_captured_total counter")
+    lines.append(
+        f"nomad_tpu_slow_raft_captured_total {cr['captured']}")
     lines.append(
         "# TYPE nomad_tpu_telemetry_enabled gauge")
     lines.append(
@@ -517,6 +639,80 @@ def stream_health_json(event_broker) -> Dict:
         "Heartbeat": client_update_stats.snapshot(),
         "DeliverLatency": deliver.snapshot() if deliver is not None
         else {},
+    }
+
+
+def cluster_health_json(server) -> Dict:
+    """The ``GET /v1/operator/cluster-health`` body (ISSUE 15): the
+    autopilot-style per-peer consensus picture from THIS server's
+    vantage — raft identity/term/state + per-peer match/lag/contact
+    (leader-side), its WAL occupancy + durability counters, the
+    consensus latency distributions, election/term transition
+    counters, the fault plane's arm state, and the consensus flight
+    recorder's health."""
+    from nomad_tpu.raft.observe import raft_observer
+    from nomad_tpu.raft.wal import wal_stats
+    from nomad_tpu.telemetry.histogram import (
+        RAFT_APPEND,
+        RAFT_ELECTION,
+        RAFT_QUORUM,
+        RAFT_REPLICATION,
+        WAL_FSYNC,
+    )
+    from nomad_tpu.utils import faultpoints
+
+    raft = server.raft
+    if raft is not None:
+        body = raft.cluster_health()
+    else:
+        body = {
+            "ServerId": server.config.name,
+            "State": "leader" if server.is_leader() else "follower",
+            "Term": 0,
+            "Leader": server.config.name if server.is_leader() else None,
+            "CommitIndex": server.state.latest_index(),
+            "LastApplied": server.state.latest_index(),
+            "LastLogIndex": server.state.latest_index(),
+            "Peers": [],
+        }
+    sid = body["ServerId"]
+    obs = raft_observer.snapshot().get(sid, {})
+    body["Transitions"] = obs.get("transitions", {})
+    body["ReplicatedEntries"] = obs.get("replicated_entries", {})
+    body["PeerLagMs"] = obs.get("peer_lag_ms", {})
+    body["SnapshotTransferBytes"] = obs.get("snapshot_xfer_bytes", {})
+    body["Wal"] = wal_stats.per_server().get(sid, {})
+    body["Faults"] = {
+        "Armed": faultpoints.armed(),
+        "Points": faultpoints.stats(),
+    }
+    lat = {}
+    for op in (RAFT_REPLICATION, RAFT_QUORUM, RAFT_APPEND,
+               RAFT_ELECTION, WAL_FSYNC):
+        h = histograms.peek(op)
+        if h is not None and h.count > 0:
+            lat[op] = h.snapshot()
+    body["Latency"] = lat
+    body["SlowRaft"] = consensus_recorder.snapshot()
+    return body
+
+
+def slow_raft_json(limit: int = 0) -> Dict:
+    """The ``GET /v1/operator/slow-raft`` body: the consensus flight
+    recorder's captured slow-op records (appends, fsync batches,
+    elections past their adaptive thresholds), newest last, plus its
+    health counters — the eval recorder's sibling (ISSUE 15)."""
+    cr = consensus_recorder.snapshot()
+    trees = consensus_recorder.trees()
+    if limit and len(trees) > limit:
+        trees = trees[-limit:]
+    return {
+        "Enabled": tracer.enabled,
+        "Captured": cr["captured"],
+        "Retained": cr["retained"],
+        "ThresholdsMs": cr["thresholds_ms"],
+        "Observed": cr["observed"],
+        "Trees": trees,
     }
 
 

@@ -238,6 +238,17 @@ STREAM_DELIVER = "stream_deliver"
 #: raft WAL group-fsync latency (raft/wal.py, ISSUE 13): the disk
 #: cost every durable ack amortizes across the batched-commit windows
 WAL_FSYNC = "wal_fsync"
+#: consensus-plane latency ops (raft/node.py, ISSUE 15) — always-on
+#: like e2e. raft_replication = leader append -> peer ack (per-peer
+#: lag in ms); raft_quorum = leader append -> commit-index advance;
+#: raft_append = follower AppendEntries handling incl. its group
+#: fsync; raft_snapshot_xfer = one InstallSnapshot send
+RAFT_REPLICATION = "raft_replication"
+RAFT_QUORUM = "raft_quorum"
+RAFT_APPEND = "raft_append"
+RAFT_SNAPSHOT_XFER = "raft_snapshot_xfer"
+#: full election duration (first round -> leadership won)
+RAFT_ELECTION = "raft_election"
 
 
 class HistogramRegistry:

@@ -39,7 +39,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Span", "Tracer", "tracer", "FlightRecorder", "flight_recorder"]
+__all__ = ["Span", "Tracer", "tracer", "FlightRecorder", "flight_recorder",
+           "ConsensusRecorder", "consensus_recorder"]
 
 _ids = itertools.count(1)
 
@@ -390,7 +391,72 @@ class Tracer:
 tracer = Tracer()
 
 
-class FlightRecorder:
+class _CaptureRing:
+    """Shared bounded-capture machinery for the flight recorders: the
+    capture ring, the double-checked rate-limited append, serve-time
+    span rendering, and the adaptive-threshold constants. Subclasses
+    own their threshold POLICY (:class:`FlightRecorder`: one scalar
+    e2e threshold; :class:`ConsensusRecorder`: per-op rows) — the
+    capture-cost discipline lives here once so a fix to it cannot
+    drift between the two recorders."""
+
+    #: records retained (newest win)
+    CAPACITY = 32
+    #: observations before a threshold arms
+    MIN_SAMPLES = 32
+    #: EWMA smoothing for the p99 estimate
+    ALPHA = 0.25
+    #: p99 re-estimation cadence (bucket walks are cheap but not free)
+    REFRESH_EVERY = 16
+    #: capture rate limit (seconds between captures)
+    MIN_CAPTURE_INTERVAL_S = 0.05
+
+    def __init__(self, capacity: int) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._last_capture_mono = 0.0
+        self.min_capture_interval_s = self.MIN_CAPTURE_INTERVAL_S
+        self.captured = 0
+
+    def _capture_due(self) -> bool:
+        """Pre-scan rate-limit check (cheap bail before the span-ring
+        scan)."""
+        with self._lock:
+            return (time.monotonic() - self._last_capture_mono
+                    >= self.min_capture_interval_s)
+
+    def _try_append(self, record: Dict) -> bool:
+        """Double-checked rate-limited append: a racing capture may
+        have landed while the caller scanned the span ring (both are
+        valid records; the limit is a cost bound, not a semantic
+        one)."""
+        with self._lock:
+            if time.monotonic() - self._last_capture_mono \
+                    < self.min_capture_interval_s:
+                return False
+            self._last_capture_mono = time.monotonic()
+            self._ring.append(record)
+            self.captured += 1
+        return True
+
+    def trees(self) -> List[Dict]:
+        """Captured records in API shape (span dicts rendered here, at
+        serve time — never on the thread that captured)."""
+        with self._lock:
+            raw = list(self._ring)
+        return [
+            {**{k: v for k, v in t.items() if k != "_spans"},
+             "Spans": [s.to_api() for s in t["_spans"]]}
+            for t in raw
+        ]
+
+    def _reset_ring_locked(self) -> None:
+        self._ring.clear()
+        self._last_capture_mono = 0.0
+        self.captured = 0
+
+
+class FlightRecorder(_CaptureRing):
     """Slow-eval flight recorder: a bounded ring of COMPLETE span trees
     for evals whose e2e latency crossed an adaptive threshold.
 
@@ -423,28 +489,14 @@ class FlightRecorder:
     time, not on the hot path.
     """
 
-    #: trees retained (newest win)
-    CAPACITY = 32
     #: per-tree span cap (a runaway instrumented loop must not make
     #: one tree unbounded)
     MAX_SPANS_PER_TREE = 256
-    #: observations before the threshold arms
-    MIN_SAMPLES = 32
-    #: EWMA smoothing for the p99 estimate
-    ALPHA = 0.25
-    #: p99 re-estimation cadence (bucket walks are cheap but not free)
-    REFRESH_EVERY = 16
-    #: capture rate limit (seconds between captures)
-    MIN_CAPTURE_INTERVAL_S = 0.05
 
-    def __init__(self, capacity: int = CAPACITY) -> None:
-        self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=capacity)
+    def __init__(self, capacity: int = _CaptureRing.CAPACITY) -> None:
+        super().__init__(capacity)
         self._threshold_s: Optional[float] = None
         self._observed = 0
-        self._last_capture_mono = 0.0
-        self.min_capture_interval_s = self.MIN_CAPTURE_INTERVAL_S
-        self.captured = 0
 
     def observe(self, trace_id: str, e2e_s: float) -> bool:
         """Called once per committed eval with its e2e latency; captures
@@ -472,10 +524,8 @@ class FlightRecorder:
             return False
         if not tracer.enabled or not trace_id:
             return False
-        with self._lock:
-            if time.monotonic() - self._last_capture_mono \
-                    < self.min_capture_interval_s:
-                return False
+        if not self._capture_due():
+            return False
         # bounded scan of the NEWEST ring entries: the slow eval just
         # finished, so its tree is at the ring's tail — a full-ring
         # copy under the tracer lock would stall every concurrent
@@ -492,32 +542,11 @@ class FlightRecorder:
             # raw Span refs; to_api conversion deferred to trees()
             "_spans": spans[:self.MAX_SPANS_PER_TREE],
         }
-        with self._lock:
-            # the interval is re-checked at append: a racing capture
-            # may have landed while this one scanned (both are valid
-            # trees; the limit is a cost bound, not a semantic one)
-            if time.monotonic() - self._last_capture_mono \
-                    < self.min_capture_interval_s:
-                return False
-            self._last_capture_mono = time.monotonic()
-            self._ring.append(tree)
-            self.captured += 1
-        return True
+        return self._try_append(tree)
 
     def threshold_s(self) -> Optional[float]:
         with self._lock:
             return self._threshold_s
-
-    def trees(self) -> List[Dict]:
-        """Captured trees in API shape (span dicts rendered here, at
-        serve time — never on the eval thread that captured)."""
-        with self._lock:
-            raw = list(self._ring)
-        return [
-            {**{k: v for k, v in t.items() if k != "_spans"},
-             "Spans": [s.to_api() for s in t["_spans"]]}
-            for t in raw
-        ]
 
     def snapshot(self) -> Dict:
         with self._lock:
@@ -531,12 +560,109 @@ class FlightRecorder:
 
     def reset(self) -> None:
         with self._lock:
-            self._ring.clear()
+            self._reset_ring_locked()
             self._threshold_s = None
             self._observed = 0
-            self._last_capture_mono = 0.0
-            self.captured = 0
 
 
 #: process-wide slow-eval recorder; reset via telemetry.reset()
 flight_recorder = FlightRecorder()
+
+
+class ConsensusRecorder(_CaptureRing):
+    """Consensus-plane flight recorder (ISSUE 15): the PR 8 slow-eval
+    discipline extended to raft — slow follower appends, slow WAL
+    group-fsync batches, and slow elections past a per-op adaptive
+    EWMA threshold, served at ``GET /v1/operator/slow-raft`` alongside
+    the eval recorder.
+
+    Same bounded-cost rules as :class:`FlightRecorder` (the recorder
+    runs on the raft/WAL threads it measures): per-op thresholds adapt
+    as an EWMA of that op's histogram p99 (log-bucketed, always-on),
+    disarmed until ``MIN_SAMPLES`` observations, captures rate-limited
+    to one per ``MIN_CAPTURE_INTERVAL_S``, a bounded newest-first ring
+    scan when a trace id exists, and span->JSON conversion deferred to
+    serve time. Each captured record keeps the op, the owning
+    ``server_id``, the duration vs the threshold at capture time, and
+    (when tracing was on and the op carried a trace id) the span tree.
+    """
+
+    MAX_SPANS_PER_TREE = 128
+
+    def __init__(self, capacity: int = _CaptureRing.CAPACITY) -> None:
+        super().__init__(capacity)
+        #: op -> [threshold_s or None, observed]
+        self._ops: Dict[str, List] = {}
+
+    def observe(self, op: str, dur_s: float, server_id: str = "",
+                trace_id: str = "") -> bool:
+        """Called per consensus op with its duration (the histogram
+        record has already happened at the call site); captures when
+        the duration lands beyond the op's adaptive threshold."""
+        from nomad_tpu.telemetry.histogram import histograms
+
+        with self._lock:
+            row = self._ops.get(op)
+            if row is None:
+                row = self._ops[op] = [None, 0]
+            row[1] += 1
+            observed = row[1]
+            refresh = row[0] is None or observed % self.REFRESH_EVERY == 0
+            armed = observed >= self.MIN_SAMPLES
+        if refresh:
+            h = histograms.peek(op)
+            p99 = h.quantile(0.99) if h is not None else 0.0
+            if p99 > 0.0:
+                with self._lock:
+                    # re-fetch with a default: a concurrent reset()
+                    # may have cleared _ops between the locked
+                    # sections — this runs on the WAL-fsync/append
+                    # path, where a KeyError would fail a raft ack,
+                    # not just drop a telemetry sample
+                    row = self._ops.setdefault(op, [None, 0])
+                    if row[0] is None:
+                        row[0] = p99
+                    else:
+                        row[0] += self.ALPHA * (p99 - row[0])
+        with self._lock:
+            row = self._ops.get(op)
+            thr = row[0] if row is not None else None
+        if not armed or thr is None or dur_s < thr:
+            return False
+        if not self._capture_due():
+            return False
+        spans = []
+        if tracer.enabled and trace_id:
+            spans = tracer.recent_spans(trace_id, scan=512)
+        record = {
+            "Op": op,
+            "ServerId": server_id,
+            "TraceID": trace_id,
+            "DurMs": round(dur_s * 1e3, 3),
+            "ThresholdMs": round(thr * 1e3, 3),
+            "CapturedAtS": round(time.time(), 3),
+            "_spans": spans[:self.MAX_SPANS_PER_TREE],
+        }
+        return self._try_append(record)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "captured": self.captured,
+                "retained": len(self._ring),
+                "thresholds_ms": {
+                    op: round((row[0] or 0.0) * 1e3, 3)
+                    for op, row in sorted(self._ops.items())
+                },
+                "observed": {op: row[1]
+                             for op, row in sorted(self._ops.items())},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._reset_ring_locked()
+            self._ops.clear()
+
+
+#: process-wide consensus-plane recorder; reset via telemetry.reset()
+consensus_recorder = ConsensusRecorder()

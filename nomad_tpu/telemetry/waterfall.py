@@ -39,10 +39,15 @@ from nomad_tpu.telemetry.histogram import percentile
 __all__ = ["build_waterfall", "build_waterfalls", "aggregate_tail",
            "SEGMENT_ORDER"]
 
-#: waterfall display order (≈ lifecycle order)
+#: waterfall display order (≈ lifecycle order). The raft-* segments
+#: (ISSUE 15) live INSIDE the commit window: replicate (AppendEntries
+#: on the wire), fsync (the leader's group fsync), quorum (append →
+#: majority commit residue), apply (raft apply-loop dispatch around
+#: the FSM).
 SEGMENT_ORDER = [
     "dequeue-wait", "snapshot", "schedule", "park", "launch",
-    "plan-queue", "evaluate", "commit", "fsm", "plan-wait", "other",
+    "plan-queue", "evaluate", "commit", "raft-replicate", "raft-fsync",
+    "raft-quorum", "raft-apply", "fsm", "plan-wait", "other",
 ]
 
 #: per-trace span name -> (segment, claim priority). Higher priority
@@ -59,9 +64,20 @@ _PER_TRACE = {
 #: batch-envelope span names (no per-eval trace id): claimed by
 #: overlap with the eval's plan.wait window. fsm nests inside commit
 #: and per-plan evaluation inside the evaluate envelope, so priority
-#: runs leaf-out.
+#: runs leaf-out. The raft segments (ISSUE 15) follow the same
+#: greedy-interval discipline inside the commit envelope: fsync and
+#: replicate are disjoint leaf windows on the disk/network threads
+#: (claimed first), quorum is the append→commit window residue those
+#: two leave behind, raft-apply wraps the FSM dispatch so fsm (110)
+#: claims first and raft-apply keeps the dispatch residue — together
+#: they PARTITION the commit window exactly (property-tested in
+#: tests/test_consensus_observability.py).
 _GLOBAL = {
+    "raft.fsync": ("raft-fsync", 130),
+    "raft.replicate": ("raft-replicate", 125),
+    "raft.quorum": ("raft-quorum", 112),
     "fsm.apply": ("fsm", 110),
+    "raft.apply": ("raft-apply", 108),
     "plan.commit": ("commit", 105),
     "plan.evaluate": ("evaluate", 100),
 }

@@ -61,11 +61,12 @@ from __future__ import annotations
 import threading
 import time
 import zlib
-from typing import Dict, Optional
+from collections import deque
+from typing import Dict, List, Optional
 
 __all__ = [
     "FaultError", "FaultThreadKill", "fault", "arm", "disarm", "armed",
-    "reset", "stats", "fires",
+    "reset", "stats", "fires", "fire_log",
 ]
 
 
@@ -99,6 +100,10 @@ _seed = 0
 #: lazily on first hit for wired-but-unscheduled ones, so stats()
 #: reports hit counts for every point the run actually crossed)
 _points: Dict[str, "_Point"] = {}
+#: bounded log of FIRED faults (ISSUE 15: the failover timeline
+#: merges firings with the consensus event stream). Only appended
+#: while armed — the disarmed path stays one boolean check.
+_fire_log: deque = deque(maxlen=1024)
 
 
 class _Point:
@@ -157,6 +162,7 @@ def arm(schedule: Dict[str, Dict], seed: int = 0) -> None:
     with _lock:
         _seed = seed
         _points.clear()
+        _fire_log.clear()
         for name, spec in schedule.items():
             _points[name] = _Point(name, dict(spec), seed)
         _ARMED = True
@@ -178,6 +184,7 @@ def reset() -> None:
     with _lock:
         _ARMED = False
         _points.clear()
+        _fire_log.clear()
 
 
 def stats() -> Dict[str, Dict]:
@@ -193,6 +200,15 @@ def stats() -> Dict[str, Dict]:
 def fires() -> int:
     with _lock:
         return sum(p.fires for p in _points.values())
+
+
+def fire_log() -> List[Dict]:
+    """Every fired fault this arming window, oldest first:
+    ``{"t": monotonic, "point": name, "kind": action}`` — the failover
+    timeline's fault feed (telemetry/timeline.py). Cleared by arm()
+    and reset()."""
+    with _lock:
+        return [dict(f) for f in _fire_log]
 
 
 def fault(name: str) -> None:
@@ -212,6 +228,9 @@ def fault(name: str) -> None:
             point = _points[name] = _Point(name, None, _seed)
         action = point.decide()
         sleep_s = point.sleep_s if action == "latency" else 0.0
+        if action is not None:
+            _fire_log.append({"t": time.monotonic(), "point": name,
+                              "kind": action})
     if action is None:
         return
     if action == "error":

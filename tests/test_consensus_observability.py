@@ -1,0 +1,649 @@
+"""Consensus-plane observability (ISSUE 15).
+
+Covers the acceptance surface directly:
+- waterfall raft segments partition the commit window exactly (no
+  overlap, no double-claim against the applier batch envelope) across
+  randomized wave/failover interleavings
+- per-server metric attribution: two in-process servers'
+  ``nomad_tpu_raft_*`` series are distinguishable (the make_cluster
+  blending regression)
+- exporter label hygiene: quotes/backslashes/newlines in label values
+  survive exposition line-framing
+- /v1/operator/cluster-health shape + ACL; /v1/operator/slow-raft
+- the timeline builder: phase attribution, index-pinned causal order,
+  artifact merging
+- the tier-1 mini-timeline smoke: a single-server chaos smoke emits a
+  valid CHAOS_TIMELINE with >= 0.90 failover attribution AND e2e
+  waterfalls carrying the raft segments at >= 0.90 coverage
+"""
+
+import json
+import os
+import random
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import telemetry
+from nomad_tpu.telemetry.exporter import (
+    cluster_health_json,
+    prometheus_text,
+    slow_raft_json,
+    _esc,
+)
+from nomad_tpu.telemetry.histogram import histograms
+from nomad_tpu.telemetry.timeline import (
+    build_timeline,
+    merge_into_artifact,
+    validate_timeline,
+)
+from nomad_tpu.telemetry.trace import ConsensusRecorder, Span, tracer
+from nomad_tpu.telemetry.waterfall import SEGMENT_ORDER, build_waterfall
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "bench"))
+
+
+def _span(name, trace_id, start, dur, thread="t"):
+    return Span(name, trace_id, 0, 0, start, dur, 0.0, 0.0, 0.0, thread)
+
+
+def _get(addr: str, path: str, token: str = ""):
+    req = urllib.request.Request(addr + path)
+    if token:
+        req.add_header("X-Nomad-Token", token)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.headers, resp.read()
+
+
+class TestWaterfallRaftPartition:
+    """Satellite: the raft segments partition the commit window
+    exactly — greedy-interval claims, leaf-out priorities, no
+    double-claim against the applier batch envelope."""
+
+    def test_exact_partition_of_commit_window(self):
+        trace = [
+            _span("eval.e2e", "ev1", 0.0, 10.0),
+            _span("plan.wait", "ev1", 0.0, 10.0),
+        ]
+        global_spans = [
+            _span("plan.commit", "", 0.0, 10.0),
+            _span("raft.fsync", "", 0.5, 1.5),       # [0.5, 2.0)
+            _span("raft.replicate", "", 1.0, 2.0),   # [1.0, 3.0)
+            _span("raft.quorum", "", 0.0, 5.5),      # [0.0, 5.5)
+            _span("raft.apply", "", 5.5, 3.0),       # [5.5, 8.5)
+            _span("fsm.apply", "", 6.0, 2.0),        # [6.0, 8.0)
+        ]
+        wf = build_waterfall(trace, global_spans)
+        segs = wf["segments"]
+        assert segs["raft-fsync"] == pytest.approx(1.5)
+        # replicate keeps only what fsync left: [2.0, 3.0)
+        assert segs["raft-replicate"] == pytest.approx(1.0)
+        # quorum is the append->commit residue: [0, 0.5) + [3.0, 5.5)
+        assert segs["raft-quorum"] == pytest.approx(3.0)
+        assert segs["fsm"] == pytest.approx(2.0)
+        # raft-apply is the dispatch residue around fsm (leaf-out)
+        assert segs["raft-apply"] == pytest.approx(1.0)
+        # commit keeps only what raft left: [8.5, 10.0)
+        assert segs["commit"] == pytest.approx(1.5)
+        assert sum(segs.values()) == pytest.approx(10.0)
+        assert wf["coverage"] == pytest.approx(1.0)
+
+    def test_random_interleavings_never_overlap_or_overclaim(self):
+        """Property: across randomized wave/failover interleavings the
+        claimed segments always partition the e2e window (sum ==
+        e2e_s including ``other``; coverage <= 1)."""
+        for seed in range(50):
+            rng = random.Random(seed)
+            n_evals = rng.randint(1, 4)
+            global_spans = []
+            # a wave's applier envelopes + raft spans, overlapping
+            # arbitrary eval windows (failover = gaps + repeats)
+            for _ in range(rng.randint(1, 3)):
+                base = rng.uniform(0, 8)
+                width = rng.uniform(0.5, 6)
+                global_spans.append(
+                    _span("plan.commit", "", base, width))
+                for name in ("raft.fsync", "raft.replicate",
+                             "raft.quorum", "raft.apply", "fsm.apply",
+                             "plan.evaluate"):
+                    if rng.random() < 0.8:
+                        s = base + rng.uniform(-0.5, width)
+                        global_spans.append(_span(
+                            name, "", s, rng.uniform(0.1, width)))
+            for i in range(n_evals):
+                a = rng.uniform(0, 4)
+                b = a + rng.uniform(1, 8)
+                trace = [
+                    _span("eval.e2e", f"ev{i}", a, b - a),
+                    _span("eval.schedule", f"ev{i}", a + 0.1,
+                          rng.uniform(0.1, 1.0)),
+                    _span("plan.wait", f"ev{i}",
+                          rng.uniform(a, b - 0.5), rng.uniform(0.2, 4)),
+                ]
+                wf = build_waterfall(trace, global_spans)
+                assert wf is not None
+                total = sum(wf["segments"].values())
+                assert total == pytest.approx(wf["e2e_s"], abs=1e-9), \
+                    (seed, i, wf)
+                assert wf["coverage"] <= 1.0 + 1e-9, (seed, i, wf)
+                assert wf["covered_s"] == pytest.approx(
+                    wf["e2e_s"] - wf["segments"].get("other", 0.0),
+                    abs=1e-9)
+                for seg in wf["segments"]:
+                    assert seg in SEGMENT_ORDER, seg
+
+
+class TestPerServerSeries:
+    """Satellite: two in-process servers' raft series must be
+    distinguishable (the process-global blending regression)."""
+
+    def test_cluster_servers_report_distinct_raft_series(self):
+        from nomad_tpu.server.server import ServerConfig
+        from nomad_tpu.server.testing import make_cluster, wait_for_leader
+
+        servers, registry = make_cluster(3, ServerConfig(
+            num_workers=0, heartbeat_ttl=60.0))
+        try:
+            leader = wait_for_leader(servers, timeout=10.0)
+            leader.raft.barrier()
+            text = prometheus_text()
+            for sid in ("server-0", "server-1", "server-2"):
+                assert f'nomad_tpu_raft_term{{server_id="{sid}"}}' \
+                    in text, text[:400]
+            # exactly one of the three reports leadership
+            leaders = [
+                line for line in text.splitlines()
+                if line.startswith("nomad_tpu_raft_is_leader")
+                and line.endswith(" 1")
+            ]
+            assert len(leaders) == 1
+            # leader-side per-peer lag series carry (server_id, peer)
+            lid = leader.raft.id
+            assert f'nomad_tpu_raft_peer_lag_entries{{server_id="{lid}"' \
+                in text
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_append_stamps_survive_until_slowest_peer_acks(self):
+        """Review regression: pruning stamps at MAJORITY commit
+        dropped the laggard's — its later ack found no stamp (no
+        replication-lag sample) and cluster_health reported LagMs 0.0
+        for the one peer actually behind. Stamps must live until
+        EVERY peer has acked them."""
+        import time as _time
+
+        from nomad_tpu.raft.log import LogEntry
+        from nomad_tpu.raft.node import LEADER, RaftConfig, RaftNode
+        from nomad_tpu.raft.transport import (
+            InmemTransport,
+            TransportRegistry,
+        )
+
+        node = RaftNode(
+            node_id="n0", peers=["n0", "n1", "n2"],
+            transport=InmemTransport("n0", TransportRegistry()),
+            fsm_apply=lambda t, r: 0, config=RaftConfig())
+        try:
+            for i in (1, 2, 3):
+                node.log.append(LogEntry(index=i, term=1))
+            stamp_t = _time.monotonic() - 0.05
+            with node._lock:
+                node.state = LEADER
+                node.current_term = 1
+                node.match_index = {"n0": 3, "n1": 3, "n2": 1}
+                node._append_stamps = {1: stamp_t, 2: stamp_t,
+                                       3: stamp_t}
+                node._advance_commit_locked()
+                assert node.commit_index == 3
+                # entry 1 is acked by all; 2 and 3 await the laggard
+                assert sorted(node._append_stamps) == [2, 3]
+            # the laggard's oldest unacked entry still has its stamp,
+            # so LagMs ages it instead of reading 0.0
+            health = node.cluster_health()
+            lag = {p["Id"]: p for p in health["Peers"]}
+            assert lag["n2"]["LagEntries"] == 2
+            assert lag["n2"]["LagMs"] >= 40.0
+            assert lag["n1"]["LagEntries"] == 0
+            # once the laggard acks, the stamps prune
+            with node._lock:
+                node.match_index["n2"] = 3
+                node._advance_commit_locked()
+                assert node._append_stamps == {}
+        finally:
+            node.transport.close()
+
+    def test_wal_series_distinguish_owners(self, tmp_path):
+        from nomad_tpu.raft.log import LogEntry
+        from nomad_tpu.raft.wal import DurableLogStore, wal_stats
+
+        stores = {}
+        for owner, n in (("srv-a", 3), ("srv-b", 7)):
+            store = DurableLogStore(str(tmp_path / owner), owner=owner)
+            for i in range(1, n + 1):
+                store.append(LogEntry(index=i, term=1, kind=0,
+                                      data=("x", {})))
+            store.sync()
+            stores[owner] = store
+        try:
+            per = wal_stats.per_server()
+            assert per["srv-a"]["frames"] == 3
+            assert per["srv-b"]["frames"] == 7
+            assert per["srv-a"]["fsyncs"] >= 1
+            assert per["srv-b"]["fsync_batch_avg"] > 0
+            # review regression: stable-store fsyncs (term persists,
+            # covered_frames == 0 by construction) must not dilute the
+            # group-fsync amortization gauge
+            from nomad_tpu.raft.wal import StableStore
+
+            before = per["srv-b"]["fsync_batch_avg"]
+            stable = StableStore(str(tmp_path / "srv-b"), owner="srv-b")
+            for term in (2, 3, 4, 5):
+                stable.put(term, None)
+            per = wal_stats.per_server()
+            assert per["srv-b"]["fsync_batch_avg"] == before
+            assert per["srv-b"]["fsyncs"] > per["srv-b"]["wal_fsyncs"]
+            text = prometheus_text()
+            assert 'nomad_tpu_raft_wal_frames_total' \
+                '{server_id="srv-a"} 3' in text
+            assert 'nomad_tpu_raft_wal_frames_total' \
+                '{server_id="srv-b"} 7' in text
+            assert 'nomad_tpu_raft_wal_pending_frames' \
+                '{server_id="srv-a"} 0' in text
+        finally:
+            for store in stores.values():
+                store.close()
+
+
+class TestExporterLabelHygiene:
+    """Satellite: every labeled series goes through one escaping
+    helper; quotes/backslashes/newlines cannot break line framing."""
+
+    def test_esc_escapes_quote_backslash_newline(self):
+        assert _esc('a"b') == 'a\\"b'
+        assert _esc("a\\b") == "a\\\\b"
+        assert _esc("a\nb") == "a\\nb"
+
+    def test_evil_label_values_stay_line_framed(self):
+        evil = 'evil"op\\with\nnewline'
+        telemetry.enable()
+        try:
+            histograms.get(evil).record(0.001)
+            with tracer.span(evil):
+                pass
+            text = prometheus_text()
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+        assert 'op="evil\\"op\\\\with\\nnewline"' in text
+        assert 'span="evil\\"op\\\\with\\nnewline"' in text
+        # no line may contain an unescaped quote run that breaks the
+        # exposition: every non-comment line is `name{labels} value`
+        # or `name value`
+        import re
+
+        line_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{([^"]|"([^"\\]|\\.)*")*\})? '
+            r'[^ ]+$')
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert line_re.match(line), line
+
+
+class TestClusterHealth:
+    @pytest.fixture()
+    def agent(self):
+        from nomad_tpu.api.agent import Agent, AgentConfig
+
+        a = Agent(AgentConfig(serf_enabled=False))
+        a.start()
+        try:
+            yield a
+        finally:
+            a.shutdown()
+
+    def test_endpoint_shape_single_process(self, agent):
+        status, _, body = _get(agent.http.addr,
+                               "/v1/operator/cluster-health")
+        assert status == 200
+        data = json.loads(body)
+        for key in ("ServerId", "State", "Term", "Peers", "Wal",
+                    "Faults", "Transitions", "Latency", "SlowRaft"):
+            assert key in data, sorted(data)
+        assert data["State"] == "leader"
+        assert data["Peers"] == []
+        assert data["Faults"]["Armed"] in (False, True)
+
+    def test_live_cluster_reports_per_peer_lag(self):
+        from nomad_tpu.server.server import ServerConfig
+        from nomad_tpu.server.testing import make_cluster, wait_for_leader
+
+        servers, _registry = make_cluster(3, ServerConfig(
+            num_workers=0, heartbeat_ttl=60.0))
+        try:
+            leader = wait_for_leader(servers, timeout=10.0)
+            for _ in range(3):
+                leader.raft.barrier()
+            # a barrier resolves at MAJORITY commit; give the slower
+            # peer a beat to ack the newest entry before asserting a
+            # fully-caught-up view
+            import time as _time
+
+            deadline = _time.time() + 5.0
+            health = cluster_health_json(leader)
+            while _time.time() < deadline:
+                health = cluster_health_json(leader)
+                if all(p["LagEntries"] == 0 for p in health["Peers"]):
+                    break
+                _time.sleep(0.05)
+            assert health["State"] == "leader"
+            assert len(health["Peers"]) == 2
+            for peer in health["Peers"]:
+                assert peer["MatchIndex"] >= 1
+                assert peer["LagEntries"] == 0
+                assert peer["LastContactMs"] is not None
+                assert peer["Healthy"] is True
+            # a follower's view names the leader
+            follower = next(s for s in servers if s is not leader)
+            fh = cluster_health_json(follower)
+            assert fh["State"] == "follower"
+            assert fh["Leader"] == leader.raft.id
+        finally:
+            for s in servers:
+                s.shutdown()
+
+    def test_slow_raft_endpoint_shape(self, agent):
+        status, _, body = _get(agent.http.addr,
+                               "/v1/operator/slow-raft")
+        assert status == 200
+        data = json.loads(body)
+        for key in ("Captured", "Retained", "ThresholdsMs", "Trees"):
+            assert key in data
+
+
+class TestClusterHealthACL:
+    @pytest.fixture()
+    def acl_agent(self):
+        from nomad_tpu.acl.policy import ACLPolicy, ACLToken
+        from nomad_tpu.api.agent import Agent, AgentConfig
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        agent = Agent(AgentConfig(acl_enabled=True, serf_enabled=False))
+        agent.start()
+        server = agent.server
+        mgmt = ACLToken.create(name="mgmt", type="management")
+        server.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [mgmt]})
+        policy = ACLPolicy(name="job-read",
+                           rules='namespace "default" { policy = "read" }')
+        server.raft_apply(fsm_msgs.ACL_POLICY_UPSERT,
+                          {"policies": [policy]})
+        weak = ACLToken.create(name="weak", type="client",
+                               policies=["job-read"])
+        server.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [weak]})
+        try:
+            yield agent, mgmt.secret_id, weak.secret_id
+        finally:
+            agent.shutdown()
+
+    def test_weak_and_anonymous_rejected_management_allowed(
+            self, acl_agent):
+        agent, mgmt, weak = acl_agent
+        for path in ("/v1/operator/cluster-health",
+                     "/v1/operator/slow-raft"):
+            for token in ("", weak):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(agent.http.addr, path, token=token)
+                assert ei.value.code == 403
+            status, _, body = _get(agent.http.addr, path, token=mgmt)
+            assert status == 200
+            assert json.loads(body)
+
+
+class TestConsensusRecorder:
+    def test_adaptive_capture_past_threshold(self):
+        rec = ConsensusRecorder()
+        rec.min_capture_interval_s = 0.0
+        op = "raft_append"
+        h = histograms.get(op)
+        try:
+            # the histogram (threshold source) sees ~1ms ops; the
+            # observations stay clearly below its p99 bar
+            for _ in range(64):
+                h.record(0.001)
+                rec.observe(op, 0.0001, server_id="s0")
+            assert rec.captured == 0
+            assert rec.observe(op, 0.5, server_id="s0") is True
+            trees = rec.trees()
+            assert trees[-1]["Op"] == op
+            assert trees[-1]["ServerId"] == "s0"
+            assert trees[-1]["DurMs"] == pytest.approx(500.0)
+            assert trees[-1]["ThresholdMs"] > 0
+            snap = rec.snapshot()
+            assert snap["captured"] == 1
+            assert op in snap["thresholds_ms"]
+        finally:
+            h.reset()
+
+    def test_disarmed_until_min_samples(self):
+        rec = ConsensusRecorder()
+        rec.min_capture_interval_s = 0.0
+        op = "raft_election"
+        h = histograms.get(op)
+        try:
+            for _ in range(8):
+                h.record(0.001)
+                assert rec.observe(op, 10.0, server_id="s0") is False
+        finally:
+            h.reset()
+
+    def test_json_body_shape(self):
+        body = slow_raft_json()
+        assert set(body) >= {"Captured", "Retained", "ThresholdsMs",
+                             "Observed", "Trees"}
+
+
+class TestTimelineBuilder:
+    def test_failover_phase_attribution(self):
+        events = [
+            {"t": 10.0, "server": "a", "kind": "stepdown", "term": 3,
+             "detail": {"was_leader": True}},
+            {"t": 10.4, "server": "b", "kind": "election_start",
+             "term": 4},
+            {"t": 10.6, "server": "b", "kind": "leader_won", "term": 4},
+            {"t": 10.9, "server": "b", "kind": "established",
+             "term": 4},
+        ]
+        tl = build_timeline(events, converged_mono=11.4, cell="unit")
+        assert validate_timeline(tl) == []
+        assert len(tl["failovers"]) == 1
+        fo = tl["failovers"][0]
+        assert fo["leader_from"] == "a"
+        assert fo["leader_to"] == "b"
+        assert fo["phases_ms"]["detect"] == pytest.approx(400, abs=1)
+        assert fo["phases_ms"]["elect"] == pytest.approx(200, abs=1)
+        assert fo["phases_ms"]["replay"] == pytest.approx(300, abs=1)
+        assert fo["phases_ms"]["converge"] == pytest.approx(500, abs=1)
+        assert fo["attributed_share"] == pytest.approx(1.0)
+        assert tl["attribution"]["share"] == pytest.approx(1.0)
+
+    def test_non_leader_stepdown_is_not_a_failover(self):
+        events = [
+            {"t": 1.0, "server": "a", "kind": "stepdown", "term": 2,
+             "detail": {}},
+            {"t": 1.5, "server": "b", "kind": "election_start",
+             "term": 3},
+        ]
+        tl = build_timeline(events)
+        assert tl["failovers"] == []
+        assert tl["attribution"]["share"] == 1.0   # nothing to attribute
+
+    def test_killed_follower_does_not_open_failover(self):
+        """Review regression: a killed FOLLOWER is an event, not a
+        leadership loss — the window must open at the real leader
+        kill, not the earlier follower death."""
+        events = [
+            {"t": 1.0, "server": "b", "kind": "killed", "term": 1,
+             "detail": {"was_leader": False}},
+            {"t": 5.0, "server": "a", "kind": "killed", "term": 1,
+             "detail": {"was_leader": True}},
+            {"t": 5.2, "server": "c", "kind": "election_start",
+             "term": 2},
+            {"t": 5.4, "server": "c", "kind": "leader_won", "term": 2},
+            {"t": 5.6, "server": "c", "kind": "established",
+             "term": 2},
+        ]
+        tl = build_timeline(events)
+        assert len(tl["failovers"]) == 1
+        fo = tl["failovers"][0]
+        assert fo["leader_from"] == "a"
+        assert fo["total_ms"] == pytest.approx(600, abs=1)
+        assert fo["phases_ms"]["detect"] == pytest.approx(200, abs=1)
+
+    def test_index_pins_override_clock_order(self):
+        events = [
+            {"t": 1.0, "server": "b", "kind": "snapshot_install",
+             "index": 3},
+            {"t": 2.0, "server": "b", "kind": "snapshot_install",
+             "index": 5},
+            {"t": 100.0, "server": "a", "kind": "snapshot_install",
+             "index": 4},
+        ]
+        tl = build_timeline(events)
+        assert [e["index"] for e in tl["events"]] == [3, 4, 5]
+        assert validate_timeline(tl) == []
+
+    def test_skew_correction_shifts_lagging_clock(self):
+        """Review regression: the old estimator anchored each index at
+        the MINIMUM observer stamp, so lag was always <= 0 and the
+        correction was dead code. Anchors now come from the index's
+        CREATOR event (the leader's snapshot_sent) — a server whose
+        same-index event precedes the creation is provably behind."""
+        events = [
+            {"t": 49.0, "server": "leader", "kind": "snapshot_sent",
+             "index": 7},
+            {"t": 50.0, "server": "a", "kind": "snapshot_install",
+             "index": 7},
+            # b's clock says it installed BEFORE the leader sent:
+            # impossible — b is behind by >= 29s
+            {"t": 20.0, "server": "b", "kind": "snapshot_install",
+             "index": 7},
+            {"t": 21.0, "server": "b", "kind": "election_start",
+             "term": 2},
+        ]
+        tl = build_timeline(events)
+        assert tl["clock_offsets_ms"]["b"] == pytest.approx(29000,
+                                                            abs=1)
+        # a installed after the send: no correction for it
+        assert "a" not in tl["clock_offsets_ms"]
+        assert validate_timeline(tl) == []
+        # b's unpinned event moved with its offset: election_start at
+        # local 21 renders AFTER the leader's send at 49
+        by_kind = {e["kind"]: e["t_ms"] for e in tl["events"]}
+        assert by_kind["election_start"] > by_kind["snapshot_sent"]
+
+    def test_observer_only_indexes_produce_no_offsets(self):
+        # without a creator event an early observer stamp proves
+        # nothing (observers legally lag creation by transfer time)
+        events = [
+            {"t": 50.0, "server": "a", "kind": "snapshot_install",
+             "index": 7},
+            {"t": 20.0, "server": "b", "kind": "snapshot_install",
+             "index": 7},
+        ]
+        tl = build_timeline(events)
+        assert tl["clock_offsets_ms"] == {}
+        assert validate_timeline(tl) == []
+
+    def test_unrecovered_leadership_loss_stays_on_the_timeline(self):
+        """Review regression: a leader lost with NO winner before the
+        cell ended must not vanish — the window closes at the cell's
+        end stamp with the un-elected tail unattributed, so the share
+        drops instead of reading 1.0."""
+        events = [
+            {"t": 1.0, "server": "a", "kind": "killed", "term": 1,
+             "detail": {"was_leader": True}},
+            {"t": 1.2, "server": "b", "kind": "election_start",
+             "term": 2},
+        ]
+        tl = build_timeline(events, converged_mono=3.0, cell="unit")
+        assert validate_timeline(tl) == []
+        assert len(tl["failovers"]) == 1
+        fo = tl["failovers"][0]
+        assert fo["resolved"] is False
+        assert fo["leader_from"] == "a"
+        assert fo["leader_to"] is None
+        # window runs loss -> cell end (2s); only detect (200ms) is
+        # attributable
+        assert fo["total_ms"] == pytest.approx(2000, abs=1)
+        assert fo["phases_ms"]["detect"] == pytest.approx(200, abs=1)
+        assert fo["attributed_share"] == pytest.approx(0.1, abs=0.01)
+        assert tl["attribution"]["share"] == pytest.approx(0.1,
+                                                           abs=0.01)
+        # a resolved window still reports resolved=True
+        events += [
+            {"t": 2.0, "server": "b", "kind": "leader_won", "term": 2},
+            {"t": 2.2, "server": "b", "kind": "established", "term": 2},
+        ]
+        tl2 = build_timeline(events, converged_mono=3.0, cell="unit")
+        assert tl2["failovers"][0]["resolved"] is True
+        assert tl2["attribution"]["share"] == pytest.approx(1.0)
+
+    def test_artifact_merge_aggregates_sections(self, tmp_path):
+        path = str(tmp_path / "CHAOS_TIMELINE.json")
+        events = [
+            {"t": 0.0, "server": "a", "kind": "killed", "term": 1,
+             "detail": {"was_leader": True}},
+            {"t": 0.2, "server": "b", "kind": "election_start",
+             "term": 2},
+            {"t": 0.3, "server": "b", "kind": "leader_won", "term": 2},
+            {"t": 0.5, "server": "b", "kind": "established", "term": 2},
+        ]
+        tl = build_timeline(events, cell="one")
+        merge_into_artifact(path, "one", tl,
+                            summary_extra={"seed": 999})
+        doc = merge_into_artifact(path, "two",
+                                  build_timeline([], cell="two"))
+        assert set(doc["cells"]) == {"one", "two"}
+        assert doc["failovers"] == 1
+        assert 0.0 <= doc["attribution"]["share"] <= 1.0
+        # review regression: an earlier section's summary_extra keys
+        # survive later merges that pass none
+        assert doc["seed"] == 999
+        with open(path) as f:
+            assert json.load(f) == doc
+
+
+class TestMiniTimelineSmoke:
+    def test_single_server_chaos_emits_valid_timeline(self, tmp_path):
+        """Tier-1 acceptance: the mini smoke (durable single-server
+        cluster + one injected leader step-down mid-burst) emits a
+        valid CHAOS_TIMELINE with >= 0.90 failover attribution, and
+        the burst's e2e waterfalls include the raft segments at
+        >= 0.90 named-segment coverage."""
+        import trace_report
+
+        out = str(tmp_path / "CHAOS_TIMELINE.json")
+        cell = trace_report.run_timeline_smoke(out_path=out)
+        assert cell["placed_ok"], cell
+        assert cell["quiesced"], cell
+        assert cell["stepdowns_fired"] == 1, cell
+        assert cell["timeline_problems"] == [], cell["timeline_problems"]
+        assert cell["failovers"] >= 1, cell["timeline"]["events"]
+        assert cell["attributed_share"] >= 0.9, cell["timeline"]
+        # the artifact exists and carries the mini section
+        with open(out) as f:
+            doc = json.load(f)
+        assert "mini" in doc["cells"]
+        assert doc["failovers"] >= 1
+        # e2e waterfalls picked up the raft segments (single durable
+        # server: fsync/quorum/apply; replicate needs peers and is
+        # covered by the stress-tier 3-node cells)
+        assert cell["waterfall_count"] > 0
+        for seg in ("raft-fsync", "raft-quorum", "raft-apply"):
+            assert seg in cell["waterfall_segments"], \
+                cell["waterfall_segments"]
+        assert cell["p50_coverage"] >= 0.9, cell

@@ -279,19 +279,36 @@ class TestChaosCell:
             os.path.dirname(os.path.abspath(__file__))), "bench"))
         import trace_report
 
-        suite = trace_report.run_chaos_suite(deadline_s=90.0,
-                                             settle_s=60.0)
+        import tempfile
+
+        from nomad_tpu.telemetry.timeline import validate_timeline
+
+        with tempfile.TemporaryDirectory() as td:
+            tl_path = os.path.join(td, "CHAOS_TIMELINE.json")
+            suite = trace_report.run_chaos_suite(deadline_s=90.0,
+                                                 settle_s=60.0,
+                                                 timeline_path=tl_path)
+            assert os.path.exists(tl_path)
         assert suite["converged_ok"], suite["violations"]
         assert suite["faults_fired"] >= 3
         for name, r in suite["schedules"].items():
             assert r["converged_ok"], (name, r["violations"])
             assert r["allocs_placed"] == r["allocs_wanted"], (name, r)
+            # ISSUE 15: every schedule's timeline is a valid artifact
+            assert validate_timeline(r["timeline"]) == [], \
+                (name, validate_timeline(r["timeline"]))
         # the schedules did what they say on the tin
         assert suite["schedules"]["leader-kill-mid-wave"][
             "faults"]["raft.leader.stepdown"]["fires"] == 1
         assert suite["schedules"]["crash-and-drop"]["nodes_down"] == 3
         assert suite["schedules"]["plan-commit-raft-failure"][
             "faults"]["plan.commit.raft"]["fires"] >= 1
+        # ISSUE 15: the leader-kill schedule produced a failover and
+        # >= 0.90 of the suite's failover wall is phase-attributed
+        tl = suite["timeline"]
+        assert tl["failovers"] >= 1, suite["schedules"][
+            "leader-kill-mid-wave"]["timeline"]["events"]
+        assert tl["attributed_share"] >= 0.9, tl
 
 
 class TestRestartCell:
@@ -313,6 +330,8 @@ class TestRestartCell:
             os.path.dirname(os.path.abspath(__file__))), "bench"))
         import trace_report
 
+        from nomad_tpu.telemetry.timeline import validate_timeline
+
         cell = trace_report.run_restart_chaos(deadline_s=90.0,
                                               settle_s=45.0)
         assert cell["converged_ok"], cell["violations"]
@@ -322,6 +341,15 @@ class TestRestartCell:
         assert cell["allocs_placed"] == cell["allocs_wanted"], cell
         assert cell["stream_missed_alloc_events"] == 0 or \
             cell["stream_lost_markers"] > 0, cell
+        # ISSUE 15: the restart legs produced a valid, attributed
+        # failover timeline (killed leader -> elect -> replay ->
+        # converge), recovery events included
+        tl = cell["timeline"]
+        assert validate_timeline(tl) == [], validate_timeline(tl)
+        assert len(tl["failovers"]) >= 1, tl["events"]
+        assert tl["attribution"]["share"] >= 0.9, tl["failovers"]
+        assert any(e["kind"] == "recovery" for e in tl["events"]), \
+            tl["events"]
 
         fuzz = trace_report.run_torn_tail_fuzz(seeds=200)
         assert fuzz["silent_divergences"] == 0, fuzz

@@ -52,11 +52,14 @@ _PROM_NAME = re.compile(r"\bnomad_tpu_[a-z0-9]+(?:_[a-z0-9]+)+\b")
 #: ISSUE 12 (the chaos cell's convergence verdict + per-schedule
 #: stats); restart_* in ISSUE 13 (kill→restart recovery + torn-tail
 #: fuzz verdicts); mesh_* in ISSUE 14 (the 100k-node sharded mesh
-#: cell's scale/parity/collective-share lines)
+#: cell's scale/parity/collective-share lines); timeline_* in
+#: ISSUE 15 (the failover timeline's phase-attribution lines riding
+#: CHAOS_TIMELINE.json)
 _BENCH_KEY = re.compile(
-    r"^(?:trace|contention|fleet|chaos|restart|mesh)_[a-z0-9_]+$")
+    r"^(?:trace|contention|fleet|chaos|restart|mesh|timeline)"
+    r"_[a-z0-9_]+$")
 #: bench kwargs that are not emission keys
-_BENCH_KEY_EXCLUDE = {"trace_id"}
+_BENCH_KEY_EXCLUDE = {"trace_id", "timeline_path"}
 
 
 def _fenced_block(doc: str, section: str) -> Optional[str]:
