@@ -249,8 +249,9 @@ class NomadFSM:
             self._upsert_eval(ev, index)
         # terminal client status frees capacity: unblock by node class
         # (fsm.go applyAllocClientUpdate -> blockedEvals.Unblock).
-        # Direct locked node reads — a full snapshot per heartbeat
-        # batch forced whole-table COW copies on the next write.
+        # Single-row reads off the current MVCC root (under the seed
+        # store a full snapshot per heartbeat batch forced whole-table
+        # COW copies on the next write; now both are free).
         if self.blocked_evals is not None:
             for a in allocs:
                 if a.client_terminal_status():
@@ -291,8 +292,8 @@ class NomadFSM:
             for nid in list(p["node_update"]) + list(p["node_preemptions"])
         }
         if self.blocked_evals is not None and freed_nodes:
-            # direct locked reads: one batched plan apply is the FSM's
-            # hottest entry — a snapshot here taxed every wave commit
+            # lock-free single-row reads: one batched plan apply is
+            # the FSM's hottest entry
             classes = set()
             for nid in freed_nodes:
                 node = self.state.node_by_id_direct(nid)

@@ -203,17 +203,15 @@ class _PlanOverlay:
 
 
 class _LiveView:
-    """Store-lock read proxy for plan evaluation.
+    """Freshest-generation read proxy for plan evaluation.
 
-    The reference evaluates plans against a go-memdb snapshot that is
-    free to take (immutable radix); this store's ``snapshot()`` copies
-    whole tables, O(cluster) per plan. The applier only reads the few
-    nodes a plan touches, so a locked live view keeps plan apply
-    O(plan). The read-then-apply window this opens is the same
-    optimistic window the reference already has between its snapshot
-    and the raft commit (plan_apply.go:209): client-side alloc updates
-    landing inside it never add resource usage, so a fit that passed
-    cannot become an over-commit.
+    The MVCC store's ``snapshot()`` is free (one root-pointer read,
+    go-memdb parity), so this view is no longer dodging snapshot cost —
+    it exists to read each node at the FRESHEST generation at lookup
+    time, shrinking the optimistic window between read and raft commit
+    to the same one the reference has (plan_apply.go:209): client-side
+    alloc updates landing inside it never add resource usage, so a fit
+    that passed cannot become an over-commit.
 
     ``overlay`` adds the in-flight plans' results on top (the
     pipelining optimism, plan_apply.go:159).
@@ -227,9 +225,9 @@ class _LiveView:
         return self._store.latest_index()
 
     def node_by_id(self, node_id: str):
-        # the locked *_direct readers replace the raw _nodes/_lock
-        # reach-through this view used to do (graftcheck R4): the
-        # store's internals stay the store's
+        # the *_direct readers (lock-free MVCC root reads) replace the
+        # raw _nodes/_lock reach-through this view used to do
+        # (graftcheck R4): the store's internals stay the store's
         return self._store.node_by_id_direct(node_id)
 
     def allocs_by_node(self, node_id: str) -> List[Allocation]:
@@ -250,7 +248,7 @@ class _LiveView:
 def _result_alloc_ids(result: "PlanResult") -> set:
     """Every alloc id a result's fold will look up in the store: the
     prefetch set that lets ``_GroupFitChecker`` read O(result) rows
-    under the store lock and run the fold itself OUTSIDE it."""
+    from the same MVCC root the planes came from."""
     ids = set()
     for src in (result.node_update, result.node_preemptions,
                 result.node_allocation):
@@ -354,15 +352,15 @@ class _GroupFitChecker:
             # replaced, never mutated, so handing them out is safe
             return {i: allocs.get(i) for i in ids}
 
-        # planes copy + row prefetch under ONE store-lock hold
+        # planes + row prefetch from ONE MVCC root
         # (StateStore.with_usage_view): the fold checks store-row
-        # liveness, which must be consistent with the copied planes —
-        # prefetching the rows at the same locked instant preserves
-        # that, while the fold itself (O(entries) Python) runs OFF the
-        # store lock instead of stalling every store reader through it
-        # (graftcheck R2 / witness hold-time finding). An init failure
-        # degrades to the exact walk for the batch — it must never
-        # take the applier thread down.
+        # liveness, which must be consistent with the planes — both
+        # were frozen by the same commit, so the pairing is consistent
+        # BY CONSTRUCTION, with no lock held by anyone (the seed
+        # needed a store-lock hold across both reads; graftcheck R2 /
+        # witness hold-time finding). An init failure degrades to the
+        # exact walk for the batch — it must never take the applier
+        # thread down.
         try:
             rows = store.with_usage_view(_init)
             for r in entries:
@@ -432,11 +430,12 @@ class _GroupFitChecker:
             self._psub[nid] = self._psub.get(nid, 0) | mask
 
     def _fold_result(self, r: "PlanResult", store_allocs) -> None:
-        """Fold one result's deltas. Runs OFF the store lock:
-        ``store_allocs`` is the prefetched ``{id: row}`` dict read
-        under the lock at the planes-consistent instant
-        (``_result_alloc_ids(r)`` is the complete set of ids this fold
-        looks up — extend it if a new ``.get`` is added here)."""
+        """Fold one result's deltas. ``store_allocs`` is the
+        prefetched ``{id: row}`` dict read from the same MVCC root
+        as the planes, so liveness checks and plane baselines agree
+        by construction (``_result_alloc_ids(r)`` is the complete set
+        of ids this fold looks up — extend it if a new ``.get`` is
+        added here)."""
         for src in (r.node_update, r.node_preemptions):
             for nid, allocs in src.items():
                 rm = self._removed.setdefault(nid, set())

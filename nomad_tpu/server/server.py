@@ -1076,12 +1076,13 @@ class Server:
     def node_update_status(self, node_id: str, status: str) -> Dict:
         """Heartbeat + status transitions (node_endpoint.go UpdateStatus).
 
-        Direct locked node read, NOT a snapshot (ISSUE 11): the steady
-        heartbeat path (no status change) needs exactly one node row —
-        a full snapshot per heartbeat marks every table shared and
-        forces whole-table COW copies on the next write, which at
-        fleet heartbeat rates (10k+ clients) taxes every commit with
-        copies the heartbeats caused."""
+        Lock-free single-row read off the current MVCC root: the
+        steady heartbeat path (no status change) needs exactly one
+        node row. (Under the seed store a full snapshot per heartbeat
+        marked every table shared and forced whole-table COW copies on
+        the next write — the MVCC store removed that tax, but one row
+        still beats materializing a snapshot object per heartbeat at
+        fleet rates, 10k+ clients.)"""
         # heartbeat delivery seam (chaos plane): an injected error is a
         # dropped heartbeat — enough of them in a row and the TTL
         # expires, driving the node-down -> allocs-lost -> reschedule
@@ -1298,7 +1299,7 @@ class Server:
                                     evals: List[Evaluation]) -> int:
         """Heartbeat fan-in batching (ISSUE 11): concurrent
         Node.UpdateAlloc callers merge into ONE ALLOC_CLIENT_UPDATE
-        raft entry — one FSM apply, one COW write-set, one event batch
+        raft entry — one FSM apply, one store write txn, one event batch
         per drain instead of one per client. Same leader-drains
         discipline as ``_eval_update_group_commit``, plus a bounded
         FILL WINDOW (the ISSUE 10 broker batch-fill pattern): the

@@ -107,22 +107,13 @@ def usage_rebuild_diff(store) -> List[str]:
     debugging aid). Returns human-readable mismatch strings — empty
     means every per-node value and port bitmap is exactly equal.
 
-    Reads are taken consistent-by-retry: the snapshot and the planes
-    copy must come from the same store index (a write landing between
-    them would be a false positive); call on a quiesced store or
-    accept the bounded retry."""
-    planes = None
-    snap = None
-    for _ in range(8):
-        snap = store.snapshot()
-        planes = store.with_usage_view(lambda p, _a: p)
-        if store.latest_index() == snap.latest_index():
-            break
-    else:
-        # diffing a torn pair would report phantom drift; say so
-        # explicitly instead (the chaos cell surfaces this verbatim)
-        return ["unstable store: snapshot/planes could not be read at "
-                "one index after 8 attempts (diff skipped)"]
+    The MVCC store makes the read trivially consistent: one snapshot
+    carries the tables AND the planes frozen by the same commit, so
+    the torn-pair retry loop the lock-based store needed is gone —
+    this can run against a store under full write load and never
+    report phantom drift."""
+    snap = store.snapshot()
+    planes = snap.usage
     fresh = UsageIndex()
     fresh.rebuild(snap.nodes(), list(snap.allocs_iter()))
     fp = fresh.planes_copy()
@@ -164,7 +155,10 @@ def usage_rebuild_diff(store) -> List[str]:
 
 
 class UsageIndex:
-    """Live planes owned by the state store; mutate under its lock."""
+    """Live planes owned by the state store; mutated only inside the
+    store's single-writer transaction scope (the write lock). Readers
+    never touch this object — they read the frozen ``UsagePlanes`` the
+    commit stamped into its :class:`~nomad_tpu.state.store.StoreRoot`."""
 
     def __init__(self) -> None:
         import uuid
@@ -195,8 +189,24 @@ class UsageIndex:
         self.row_log: deque = deque()
         self.row_log_floor = 0
         # planes_copy cache: reused until the next mutation; guarded by
-        # the owning store's lock (all callers hold it)
+        # the owning store's write lock (all callers hold it)
         self._copy: Optional[UsagePlanes] = None
+        # copy-on-write discipline for the row map: planes_copy hands
+        # out self.rows BY REFERENCE (copying a 100k-entry dict per
+        # usage-touching commit would dominate MVCC commit cost); the
+        # flag makes the next STRUCTURAL mutator replace the dict
+        # first. ids is likewise cached as a tuple until structure
+        # changes — alloc transitions touch neither.
+        self._rows_shared = False
+        self._ids_tuple: Optional[Tuple] = None
+
+    def _own_rows(self) -> None:
+        """Detach self.rows from any frozen planes sharing it; call
+        before any structural rows/ids mutation."""
+        if self._rows_shared:
+            self.rows = dict(self.rows)
+            self._rows_shared = False
+        self._ids_tuple = None
 
     # -- structure -------------------------------------------------------
 
@@ -216,6 +226,7 @@ class UsageIndex:
         row = self.rows.get(node_id)
         if row is not None:
             return row
+        self._own_rows()
         if self._free:
             row = self._free.pop()
         else:
@@ -236,9 +247,10 @@ class UsageIndex:
         self._touch(structural=True, node_id=node_id)
 
     def drop_node(self, node_id: str) -> None:
-        row = self.rows.pop(node_id, None)
-        if row is None:
+        if node_id not in self.rows:
             return
+        self._own_rows()
+        row = self.rows.pop(node_id)
         self.ids[row] = None
         self._free.append(row)
         self.port_masks.pop(row, None)
@@ -331,7 +343,11 @@ class UsageIndex:
 
     def rebuild(self, nodes, allocs) -> None:
         """Full rebuild (snapshot restore / FSM restore)."""
-        self.rows.clear()
+        # REPLACE rows (never clear in place): frozen planes may share
+        # the old dict by reference
+        self.rows = {}
+        self._rows_shared = False
+        self._ids_tuple = None
         self.ids.clear()
         self._free.clear()
         self.port_masks.clear()
@@ -372,17 +388,24 @@ class UsageIndex:
                 self.row_log_floor = v
 
     def planes_copy(self) -> UsagePlanes:
-        """Point-in-time copy; cached until the next mutation (bursts of
-        snapshots between writes share one copy). Call under the store
-        lock."""
+        """Point-in-time copy; cached until the next mutation (commits
+        that did not touch usage stamp the SAME frozen planes into the
+        next root for free). Call under the store's write lock."""
         if self._copy is not None:
             return self._copy
         n = pad_bucket(max(len(self.ids), 1))
         self._grow(n)
+        if self._ids_tuple is None:
+            self._ids_tuple = tuple(self.ids)
+        # rows is handed out BY REFERENCE under the COW flag: the next
+        # structural mutator replaces the dict, so the frozen planes'
+        # view never moves (alloc transitions — the per-commit common
+        # case — touch only the arrays, copied below)
+        self._rows_shared = True
         self._copy = UsagePlanes(
             n=n,
-            rows=dict(self.rows),
-            ids=tuple(self.ids),
+            rows=self.rows,
+            ids=self._ids_tuple,
             used_cpu=self.used_cpu[:n].copy(),
             used_mem=self.used_mem[:n].copy(),
             used_disk=self.used_disk[:n].copy(),

@@ -135,6 +135,15 @@ def reset() -> None:
     except Exception:                           # noqa: BLE001
         pass
     try:
+        # MVCC store rate counters (state/store.py store_stats) cover
+        # the same burst window; the generation and live-root gauges
+        # track durable store state and are never reset
+        from nomad_tpu.state.store import store_stats
+
+        store_stats.reset_stats()
+    except Exception:                           # noqa: BLE001
+        pass
+    try:
         # heartbeat fan-in counters (server/server.py) follow the
         # burst window; event-broker stats are per-broker and are
         # windowed by the bench cells via broker.reset_stats()

@@ -519,6 +519,31 @@ def prometheus_text(registry=None, event_broker=None) -> str:
                 f'{w[key]}')
     except Exception:                           # noqa: BLE001
         pass                # store unavailable: skip series
+    # MVCC store plane (state/store.py store_stats): write-transaction
+    # and snapshot volume, the last committed generation, and how many
+    # generation roots are still alive (pinned by snapshots or the
+    # registry) — the retention gauge that catches a generation leak
+    try:
+        from nomad_tpu.state.store import store_stats
+
+        st = store_stats.snapshot()
+        lines.append("# TYPE nomad_tpu_store_write_txns_total counter")
+        lines.append(
+            f"nomad_tpu_store_write_txns_total {st['write_txns']}")
+        lines.append("# TYPE nomad_tpu_store_snapshots_total counter")
+        lines.append(
+            f"nomad_tpu_store_snapshots_total {st['snapshots']}")
+        lines.append("# TYPE nomad_tpu_store_restores_total counter")
+        lines.append(
+            f"nomad_tpu_store_restores_total {st['restores']}")
+        lines.append("# TYPE nomad_tpu_store_generation gauge")
+        lines.append(
+            f"nomad_tpu_store_generation {st['last_generation']}")
+        lines.append("# TYPE nomad_tpu_store_live_roots gauge")
+        lines.append(
+            f"nomad_tpu_store_live_roots {st['live_roots']}")
+    except Exception:                           # noqa: BLE001
+        pass                # store unavailable: skip series
     # heartbeat fan-in (server/server.py client_update_stats): raw
     # heartbeat rate plus the Node.UpdateAlloc group-commit's
     # coalescing (callers vs batched raft entries)

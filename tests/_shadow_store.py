@@ -1,0 +1,1397 @@
+"""The SEED lock-based StateStore, frozen as the MVCC shadow oracle.
+
+This is the pre-ISSUE-16 store verbatim (single RLock, COW-shared
+table snapshots). tests/test_mvcc_store.py replays every randomized
+op stream against BOTH stores and asserts bit-identical post-state —
+the MVCC rebuild must be a pure representation change. Do not "fix"
+or modernize this file; its value is that it does not move.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.alloc import Allocation
+from nomad_tpu.structs.eval_plan import Deployment, Evaluation, Plan, PlanResult
+from nomad_tpu.utils.witness import witness_lock
+
+
+class SchedulerConfiguration:
+    """Runtime-mutable scheduler config (reference structs.go
+    SchedulerConfiguration; stored in raft, schema.go:65)."""
+
+    def __init__(self) -> None:
+        self.scheduler_algorithm = consts.SCHEDULER_ALGORITHM_BINPACK
+        self.preemption_system_enabled = True
+        self.preemption_batch_enabled = False
+        self.preemption_service_enabled = False
+        self.memory_oversubscription_enabled = False
+        self.pause_eval_broker = False
+
+    def effective_algorithm(self) -> str:
+        return self.scheduler_algorithm
+
+    def preemption_enabled(self, scheduler_type: str) -> bool:
+        return {
+            consts.JOB_TYPE_SERVICE: self.preemption_service_enabled,
+            consts.JOB_TYPE_BATCH: self.preemption_batch_enabled,
+            consts.JOB_TYPE_SYSTEM: self.preemption_system_enabled,
+            consts.JOB_TYPE_SYSBATCH: self.preemption_system_enabled,
+        }.get(scheduler_type, False)
+
+
+class WatchStats:
+    """Blocking-query wakeup accounting (ISSUE 11): how many watchers
+    ``block_until`` currently holds parked, how often they wake for a
+    real index advance vs spuriously (a shared Event set by an
+    unrelated table's commit callback racing the re-check), and how
+    many waits expire. The serving plane is mostly reads and watches —
+    without these counters a fleet-scale watch storm is invisible in
+    every exposition surface."""
+
+    __slots__ = ("_lock", "held", "wakeups", "spurious", "timeouts")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.held = 0
+        self.wakeups = 0
+        self.spurious = 0
+        self.timeouts = 0
+
+    def enter(self) -> None:
+        with self._lock:
+            self.held += 1
+
+    def leave(self) -> None:
+        with self._lock:
+            self.held -= 1
+
+    def note_wakeup(self, spurious: bool) -> None:
+        with self._lock:
+            if spurious:
+                self.spurious += 1
+            else:
+                self.wakeups += 1
+
+    def note_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "held_watchers": self.held,
+                "wakeups": self.wakeups,
+                "spurious_wakeups": self.spurious,
+                "timeouts": self.timeouts,
+            }
+
+    def reset_stats(self) -> None:
+        """Counters only; the held gauge tracks live waiters."""
+        with self._lock:
+            self.wakeups = 0
+            self.spurious = 0
+            self.timeouts = 0
+
+
+#: process-wide (every StateStore's block_until feeds it; exported as
+#: nomad_tpu_watch_* and ridden into TRACE_DECOMP's serving section)
+watch_stats = WatchStats()
+
+
+#: tables a snapshot shares copy-on-write with the store. Index tables
+#: (allocs_by_*) hold immutable frozenset values so sharing the dict is
+#: enough; every mutator replaces values instead of mutating them.
+_COW_TABLES = (
+    "nodes", "jobs", "job_versions", "evals", "allocs", "deployments",
+    "allocs_by_job", "allocs_by_node", "allocs_by_eval", "csi_volumes",
+)
+
+
+class StateSnapshot:
+    """A point-in-time read view (memdb Snapshot analog).
+
+    Implements the scheduler's ``State`` interface
+    (reference scheduler/scheduler.go:67-141).
+
+    Construction is O(1): the snapshot takes REFERENCES to the store's
+    tables and marks them shared; the first mutation of a shared table
+    copies that table (``StateStore._own``). This is the dict analog of
+    go-memdb's immutable-radix snapshots — the reference's snapshots
+    are free (state_store.go Snapshot), and at C2M scale (100k allocs)
+    eager per-snapshot table copies were the next scaling wall.
+    """
+
+    def __init__(self, store: "StateStore") -> None:
+        with store._lock:
+            self.index = store._index
+            store._shared.update(_COW_TABLES)
+            self._nodes = store._nodes
+            self._jobs = store._jobs
+            self._job_versions = store._job_versions
+            self._evals = store._evals
+            self._allocs = store._allocs
+            self._deployments = store._deployments
+            self._allocs_by_job = store._allocs_by_job
+            self._allocs_by_node = store._allocs_by_node
+            self._allocs_by_eval = store._allocs_by_eval
+            self._csi_volumes = store._csi_volumes
+            self.scheduler_config = store.scheduler_config
+            # live utilization planes for the scheduler fast path
+            # (state/usage.py); cached until the next mutation
+            self.usage = store.usage.planes_copy()
+
+    # --- State interface (scheduler.go:67-141) ---
+
+    def nodes(self) -> List:
+        return list(self._nodes.values())
+
+    def node_by_id(self, node_id: str):
+        return self._nodes.get(node_id)
+
+    def ready_nodes_in_pool(self, pool: str = "default") -> List:
+        return [n for n in self._nodes.values() if n.ready()]
+
+    def job_by_id(self, namespace: str, job_id: str):
+        return self._jobs.get((namespace, job_id))
+
+    def job_by_id_and_version(self, namespace: str, job_id: str, version: int):
+        return self._job_versions.get((namespace, job_id, version))
+
+    def jobs(self) -> List:
+        return list(self._jobs.values())
+
+    def eval_by_id(self, eval_id: str):
+        return self._evals.get(eval_id)
+
+    def evals_iter(self):
+        return self._evals.values()
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return [
+            e for e in self._evals.values()
+            if e.namespace == namespace and e.job_id == job_id
+        ]
+
+    def allocs_by_job(self, namespace: str, job_id: str, anyCreateIndex: bool = True) -> List[Allocation]:
+        ids = self._allocs_by_job.get((namespace, job_id), ())
+        return [self._allocs[i] for i in ids]
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        ids = self._allocs_by_node.get(node_id, ())
+        return [self._allocs[i] for i in ids]
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id) if a.terminal_status() == terminal]
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        ids = self._allocs_by_eval.get(eval_id, ())
+        return [self._allocs[i] for i in ids]
+
+    def alloc_by_id(self, alloc_id: str):
+        return self._allocs.get(alloc_id)
+
+    def allocs_iter(self):
+        return self._allocs.values()
+
+    def latest_deployment_by_job_id(self, namespace: str, job_id: str):
+        best = None
+        for d in self._deployments.values():
+            if d.namespace == namespace and d.job_id == job_id:
+                if best is None or d.create_index > best.create_index:
+                    best = d
+        return best
+
+    def deployments_by_job_id(self, namespace: str, job_id: str) -> List[Deployment]:
+        return [
+            d for d in self._deployments.values()
+            if d.namespace == namespace and d.job_id == job_id
+        ]
+
+    def deployment_by_id(self, deployment_id: str):
+        return self._deployments.get(deployment_id)
+
+    def deployments_iter(self):
+        return self._deployments.values()
+
+    def csi_volume_by_id(self, namespace: str, volume_id: str):
+        return self._csi_volumes.get((namespace, volume_id))
+
+    def csi_volumes_iter(self):
+        return self._csi_volumes.values()
+
+    def latest_index(self) -> int:
+        return self.index
+
+
+class StateStore:
+    """The writable store. One per server; FSM applies Raft entries here."""
+
+    def __init__(self) -> None:
+        from nomad_tpu.state.usage import UsageIndex
+
+        self._lock = witness_lock("StateStore._lock", rlock=True)
+        self._index = 0
+        # incrementally-scattered per-node utilization planes; every
+        # alloc/node mutation below routes its transition through it
+        self.usage = UsageIndex()
+        self._nodes: Dict[str, object] = {}
+        self._jobs: Dict[Tuple[str, str], object] = {}
+        self._job_versions: Dict[Tuple[str, str, int], object] = {}
+        self._evals: Dict[str, Evaluation] = {}
+        self._allocs: Dict[str, Allocation] = {}
+        self._deployments: Dict[str, Deployment] = {}
+        # index tables hold FROZENSET values (immutable): updates
+        # replace the value, so snapshots can share the dict by
+        # reference (see _COW_TABLES)
+        self._allocs_by_job: Dict[Tuple[str, str], frozenset] = {}
+        self._allocs_by_node: Dict[str, frozenset] = {}
+        self._allocs_by_eval: Dict[str, frozenset] = {}
+        # tables currently shared by-reference with >=1 snapshot; a
+        # mutator copies the table first (_own) — copy-on-write
+        self._shared: set = set()
+        # aux tables (schema.go:50-72: namespaces, scaling_event,
+        # scaling_policy, acl_policy, acl_token)
+        self._namespaces: Dict[str, object] = {}
+        self._scaling_events: Dict[Tuple[str, str], List] = {}
+        self._acl_policies: Dict[str, object] = {}
+        self._acl_tokens: Dict[str, object] = {}
+        # CSI volumes keyed (namespace, id) (schema.go csi_volumes;
+        # plugins are derived from node fingerprints on read)
+        self._csi_volumes: Dict[Tuple[str, str], object] = {}
+        # native service registrations keyed by instance id
+        # (schema.go service_registrations)
+        self._services: Dict[str, object] = {}
+        # one-time ACL tokens keyed by one-time secret
+        # (schema.go one_time_token): {"accessor_id", "expires_at"}
+        self._one_time_tokens: Dict[str, Dict] = {}
+        # periodic launch ledger keyed (namespace, job_id) -> last
+        # launch unix time (schema.go periodic_launch)
+        self._periodic_launches: Dict[Tuple[str, str], float] = {}
+        # WAN federation registry: region -> HTTP address of a server
+        # there (serf WAN member list analog; replicated so failover
+        # keeps forwarding + ACL replication working)
+        self._regions: Dict[str, str] = {}
+        # autopilot config (schema.go autopilot-config)
+        self.autopilot_config: Dict = {
+            "cleanup_dead_servers": True,
+            "last_contact_threshold_s": 10.0,
+            "server_stabilization_time_s": 10.0,
+        }
+        self.scheduler_config = SchedulerConfiguration()
+        # table name -> [callback(index)]; fired outside the lock
+        self._watchers: Dict[str, List[Callable[[int], None]]] = {}
+        # table name -> index of its last commit (memdb per-table index
+        # rows; lets blocking queries ignore unrelated tables)
+        self._table_indexes: Dict[str, int] = {}
+
+    # --- infrastructure ---
+
+    def snapshot(self) -> StateSnapshot:
+        return StateSnapshot(self)
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def watch(self, table: str, cb: Callable[[int], None]) -> Callable[[], None]:
+        """Register a commit callback for a table; returns unwatch fn."""
+        with self._lock:
+            self._watchers.setdefault(table, []).append(cb)
+
+        def unwatch() -> None:
+            with self._lock:
+                lst = self._watchers.get(table, [])
+                if cb in lst:
+                    lst.remove(cb)
+
+        return unwatch
+
+    def _notify(self, tables: List[str], index: int) -> None:
+        cbs: List[Callable[[int], None]] = []
+        with self._lock:
+            for t in tables:
+                self._table_indexes[t] = max(self._table_indexes.get(t, 0), index)
+                cbs.extend(self._watchers.get(t, ()))
+        for cb in cbs:
+            cb(index)
+
+    def table_index(self, tables: List[str]) -> int:
+        """Highest commit index across the given tables."""
+        with self._lock:
+            return max((self._table_indexes.get(t, 0) for t in tables), default=0)
+
+    def _next_index(self) -> int:
+        self._index += 1
+        return self._index
+
+    def has_draining_nodes(self) -> bool:
+        """Cheap pre-check for the drainer: whether ANY node is
+        draining, without constructing a snapshot (snapshot
+        construction copies the usage planes — too expensive to pay
+        on every alloc commit just to discover there is no drain)."""
+        with self._lock:
+            return any(getattr(n, "drain", False)
+                       for n in self._nodes.values())
+
+    def csi_volume_count(self) -> int:
+        """Cheap pre-check for the volume watcher (same rationale as
+        has_draining_nodes)."""
+        with self._lock:
+            return len(self._csi_volumes)
+
+    def node_by_id_direct(self, node_id: str):
+        """Direct locked read of one node row (no COW snapshot): for
+        hot paths that need a single node — building a snapshot marks
+        every table shared and forces whole-table copies on the next
+        mutation. Rows are replaced (never mutated) on update, so
+        handing one out is safe."""
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def alloc_by_id_direct(self, alloc_id: str):
+        """Direct locked read of one alloc row (same rationale as
+        node_by_id_direct)."""
+        with self._lock:
+            return self._allocs.get(alloc_id)
+
+    def allocs_by_node_direct(self, node_id: str) -> List:
+        """Direct locked read of one node's alloc rows (no COW
+        snapshot) — the plan applier's per-plan view reads exactly one
+        node's list; rows are replaced, never mutated, so handing them
+        out is safe (graftcheck R4: this accessor replaces raw
+        ``_allocs_by_node`` reaching from server/plan_apply.py)."""
+        with self._lock:
+            ids = self._allocs_by_node.get(node_id, ())
+            return [self._allocs[i] for i in ids]
+
+    def with_usage_view(self, fn):
+        """Run ``fn(planes, allocs)`` under the store lock: ``planes``
+        is the cached utilization planes copy (state/usage.py),
+        ``allocs`` the live alloc table — both READ-ONLY to the
+        callee. The plan applier's group checker uses this to fold
+        in-flight plan results against a planes snapshot that is
+        CONSISTENT with its per-alloc liveness reads: a commit landing
+        between the two reads would otherwise double-count its
+        allocs (server/plan_apply._GroupFitChecker)."""
+        with self._lock:
+            return fn(self.usage.planes_copy(), self._allocs)
+
+    def with_allocs(self, fn):
+        """Run ``fn(allocs)`` under the store lock with the live alloc
+        table (READ-ONLY to the callee) — ``with_usage_view`` without
+        the planes copy, for callers that only need consistent
+        per-alloc liveness reads."""
+        with self._lock:
+            return fn(self._allocs)
+
+    def _own(self, *tables: str) -> None:
+        """Copy-on-write: detach the named tables from any snapshots
+        sharing them. Call under the lock BEFORE mutating a table."""
+        for name in tables:
+            if name in self._shared:
+                setattr(self, "_" + name, dict(getattr(self, "_" + name)))
+                self._shared.discard(name)
+
+    def block_until(self, tables: List[str], min_index: int, timeout: float) -> int:
+        """Block until one of `tables` commits past min_index or the
+        timeout passes; returns those tables' current index. This is the
+        memdb WatchSet + min-index contract behind blocking queries
+        (reference rpc.go:808 blockingRPC). Keyed on per-table indexes
+        so unrelated commits don't wake every watcher."""
+        if self.table_index(tables) > min_index or timeout <= 0:
+            return max(self.table_index(tables), min_index)
+        event = threading.Event()
+        unwatchers = [self.watch(t, lambda _i: event.set()) for t in tables]
+        watch_stats.enter()
+        try:
+            deadline = time.time() + timeout
+            idx = self.table_index(tables)
+            while idx <= min_index:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    watch_stats.note_timeout()
+                    break
+                woke = event.wait(remaining)
+                event.clear()
+                # ONE index read per wakeup serves both the spurious
+                # check and the loop condition (the watch path is the
+                # store-lock traffic this PR is measuring — no second
+                # acquisition per wakeup)
+                idx = self.table_index(tables)
+                if woke:
+                    # spurious = a commit callback fired but the watched
+                    # tables' index has not actually advanced (callback
+                    # raced the registration, or a second wait loop
+                    # consumed a stale set) — re-park without progress
+                    watch_stats.note_wakeup(spurious=idx <= min_index)
+            return max(idx, min_index)
+        finally:
+            watch_stats.leave()
+            for unwatch in unwatchers:
+                unwatch()
+
+    # --- snapshot persist/restore (fsm.go:1393 Snapshot, :1407 Restore) -
+
+    # --- aux tables: namespaces / scaling / ACL / stability -------------
+
+    def upsert_namespace(self, ns) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._namespaces[ns.name] = ns
+        self._notify(["namespaces"], idx)
+        return idx
+
+    def delete_namespace(self, name: str) -> int:
+        with self._lock:
+            if any(key[0] == name for key in self._jobs):
+                raise ValueError(f"namespace '{name}' has registered jobs")
+            idx = self._next_index()
+            self._namespaces.pop(name, None)
+        self._notify(["namespaces"], idx)
+        return idx
+
+    def namespaces(self) -> List:
+        with self._lock:
+            return list(self._namespaces.values())
+
+    def namespace_by_name(self, name: str):
+        with self._lock:
+            return self._namespaces.get(name)
+
+    def record_scaling_event(self, namespace: str, job_id: str, group: str,
+                             event: Dict) -> int:
+        """state_store.go UpsertScalingEvent (bounded history per group)."""
+        with self._lock:
+            idx = self._next_index()
+            event = dict(event)
+            event.setdefault("task_group", group)
+            events = self._scaling_events.setdefault((namespace, job_id), [])
+            events.insert(0, event)
+            del events[20:]  # structs.go JobTrackedScalingEvents
+        self._notify(["scaling_event"], idx)
+        return idx
+
+    def scaling_events(self, namespace: str, job_id: str) -> List[Dict]:
+        with self._lock:
+            return list(self._scaling_events.get((namespace, job_id), []))
+
+    def scaling_policies(self) -> List[Dict]:
+        """Derived view: one policy per task group with a scaling stanza
+        (reference stores these in a table keyed by target; deriving
+        from the jobs table keeps them trivially consistent)."""
+        with self._lock:
+            out = []
+            for (ns, jid), job in self._jobs.items():
+                for tg in job.task_groups:
+                    if tg.scaling is not None:
+                        out.append({
+                            "id": f"{ns}/{jid}/{tg.name}",
+                            "namespace": ns, "job_id": jid, "group": tg.name,
+                            "policy": tg.scaling, "enabled": tg.scaling.enabled,
+                        })
+            return out
+
+    def scaling_policy_by_id(self, policy_id: str):
+        for p in self.scaling_policies():
+            if p["id"] == policy_id:
+                return p
+        return None
+
+    def set_job_stability(self, namespace: str, job_id: str, version: int,
+                          stable: bool) -> int:
+        with self._lock:
+            idx = self._next_index()
+            job = self._job_versions.get((namespace, job_id, version))
+            if job is not None:
+                job.stable = stable
+                job.modify_index = idx
+        self._notify(["jobs"], idx)
+        return idx
+
+    def upsert_acl_policy(self, policy) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._acl_policies[policy.name] = policy
+        self._notify(["acl_policy"], idx)
+        return idx
+
+    def delete_acl_policy(self, name: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._acl_policies.pop(name, None)
+        self._notify(["acl_policy"], idx)
+        return idx
+
+    def acl_policies(self) -> List:
+        with self._lock:
+            return list(self._acl_policies.values())
+
+    def acl_policy_by_name(self, name: str):
+        with self._lock:
+            return self._acl_policies.get(name)
+
+    def deployment_by_id(self, deployment_id: str):
+        """Direct locked read (no COW snapshot): for hot paths that
+        need one row — a snapshot here would mark every table shared
+        and force whole-table copies on the next mutation."""
+        with self._lock:
+            return self._deployments.get(deployment_id)
+
+    def active_deployments(self) -> List[Deployment]:
+        """Direct locked read of the active deployment rows (no COW
+        snapshot): the deployments watcher polls this on every state
+        change, and rows are replaced (never mutated) on update, so
+        handing them out is safe."""
+        with self._lock:
+            return [d for d in self._deployments.values() if d.active()]
+
+    def multiregion_terminal_deployment_ids(self) -> List[str]:
+        """Ids of terminal multiregion deployments (the candidates for
+        cross-region kicks) — the cheap gate that lets the watcher skip
+        whole-state snapshots when there is no multiregion work."""
+        with self._lock:
+            return [
+                d.id for d in self._deployments.values()
+                if d.is_multiregion and d.status in (
+                    consts.DEPLOYMENT_STATUS_SUCCESSFUL,
+                    consts.DEPLOYMENT_STATUS_FAILED,
+                )
+            ]
+
+    def upsert_acl_token(self, token) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._acl_tokens[token.accessor_id] = token
+        self._notify(["acl_token"], idx)
+        return idx
+
+    def delete_acl_token(self, accessor_id: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._acl_tokens.pop(accessor_id, None)
+        self._notify(["acl_token"], idx)
+        return idx
+
+    def acl_tokens(self) -> List:
+        with self._lock:
+            return list(self._acl_tokens.values())
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        with self._lock:
+            return self._acl_tokens.get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        with self._lock:
+            for t in self._acl_tokens.values():
+                if t.secret_id == secret_id:
+                    return t
+            return None
+
+    # --- CSI volumes (state_store.go UpsertCSIVolume/CSIVolumeClaim) ----
+
+    def upsert_csi_volumes(self, volumes: List) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("csi_volumes")
+            for v in volumes:
+                existing = self._csi_volumes.get((v.namespace, v.id))
+                if existing is not None:
+                    # re-register keeps live claims (csi_endpoint.go
+                    # Register merge semantics)
+                    v.read_claims = existing.read_claims
+                    v.write_claims = existing.write_claims
+                    v.past_claims = existing.past_claims
+                    v.create_index = existing.create_index
+                else:
+                    v.create_index = idx
+                v.modify_index = idx
+                self._csi_volumes[(v.namespace, v.id)] = v
+        self._notify(["csi_volumes"], idx)
+        return idx
+
+    def csi_volume_deregister(self, namespace: str, volume_id: str,
+                              force: bool = False) -> int:
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, volume_id))
+            if vol is None:
+                raise ValueError(f"volume not found: {volume_id}")
+            if vol.in_use() and not force:
+                raise ValueError(f"volume in use: {volume_id}")
+            idx = self._next_index()
+            self._own("csi_volumes")
+            del self._csi_volumes[(namespace, volume_id)]
+        self._notify(["csi_volumes"], idx)
+        return idx
+
+    def csi_volume_claim(self, namespace: str, volume_id: str, claim) -> int:
+        """Apply a claim transition copy-on-write (state_store.go
+        CSIVolumeClaim)."""
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, volume_id))
+            if vol is None:
+                raise ValueError(f"volume not found: {volume_id}")
+            vol = vol.copy()
+            vol.claim(claim)
+            idx = self._next_index()
+            self._own("csi_volumes")
+            vol.modify_index = idx
+            self._csi_volumes[(namespace, volume_id)] = vol
+        self._notify(["csi_volumes"], idx)
+        return idx
+
+    def csi_volumes(self) -> List:
+        with self._lock:
+            return list(self._csi_volumes.values())
+
+    def csi_volume_by_id(self, namespace: str, volume_id: str):
+        with self._lock:
+            return self._csi_volumes.get((namespace, volume_id))
+
+    def csi_volumes_by_plugin(self, plugin_id: str) -> List:
+        with self._lock:
+            return [v for v in self._csi_volumes.values()
+                    if v.plugin_id == plugin_id]
+
+    # --- service registrations (state_store_service_registration.go) ----
+
+    def upsert_service_registrations(self, regs: List) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for r in regs:
+                existing = self._services.get(r.id)
+                r.create_index = existing.create_index if existing else idx
+                r.modify_index = idx
+                self._services[r.id] = r
+        self._notify(["services"], idx)
+        return idx
+
+    def delete_service_registration(self, reg_id: str) -> int:
+        with self._lock:
+            if reg_id not in self._services:
+                raise ValueError(f"service registration not found: {reg_id}")
+            idx = self._next_index()
+            del self._services[reg_id]
+        self._notify(["services"], idx)
+        return idx
+
+    def delete_service_registrations_by_alloc(self, alloc_ids: List[str]) -> int:
+        """Client dereg batches + alloc GC
+        (DeleteServiceRegistrationByAllocID)."""
+        doomed_allocs = set(alloc_ids)
+        with self._lock:
+            doomed = [r.id for r in self._services.values()
+                      if r.alloc_id in doomed_allocs]
+            if not doomed:
+                return self._index
+            idx = self._next_index()
+            for rid in doomed:
+                del self._services[rid]
+        self._notify(["services"], idx)
+        return idx
+
+    def delete_service_registrations_by_node(self, node_id: str) -> int:
+        """Node down/deregister reaping (DeleteServiceRegistrationByNodeID)."""
+        with self._lock:
+            doomed = [r.id for r in self._services.values()
+                      if r.node_id == node_id]
+            if not doomed:
+                return self._index
+            idx = self._next_index()
+            for rid in doomed:
+                del self._services[rid]
+        self._notify(["services"], idx)
+        return idx
+
+    def service_registrations(self, namespace: str = "*") -> List:
+        with self._lock:
+            return [r for r in self._services.values()
+                    if namespace in ("*", r.namespace)]
+
+    def service_registrations_by_name(self, namespace: str, name: str) -> List:
+        with self._lock:
+            return [r for r in self._services.values()
+                    if r.namespace == namespace and r.service_name == name]
+
+    def service_registration_by_id(self, reg_id: str):
+        with self._lock:
+            return self._services.get(reg_id)
+
+    # --- one-time tokens (state_store.go UpsertOneTimeToken) -----------
+
+    def upsert_one_time_token(self, ott: Dict) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._one_time_tokens[ott["one_time_secret_id"]] = dict(ott)
+        self._notify(["one_time_token"], idx)
+        return idx
+
+    def one_time_token_by_secret(self, secret: str):
+        with self._lock:
+            return self._one_time_tokens.get(secret)
+
+    def delete_one_time_tokens(self, secrets: List[str]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            for s in secrets:
+                self._one_time_tokens.pop(s, None)
+        self._notify(["one_time_token"], idx)
+        return idx
+
+    def expire_one_time_tokens(self, now: float) -> List[str]:
+        with self._lock:
+            return [s for s, t in self._one_time_tokens.items()
+                    if t.get("expires_at", 0) <= now]
+
+    # --- periodic launch ledger (state_store.go UpsertPeriodicLaunch) ---
+
+    def upsert_periodic_launch(self, namespace: str, job_id: str,
+                               launch_time: float) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._periodic_launches[(namespace, job_id)] = launch_time
+        self._notify(["periodic_launch"], idx)
+        return idx
+
+    def delete_periodic_launch(self, namespace: str, job_id: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._periodic_launches.pop((namespace, job_id), None)
+        self._notify(["periodic_launch"], idx)
+        return idx
+
+    def periodic_launch_by_id(self, namespace: str, job_id: str) -> float:
+        with self._lock:
+            return self._periodic_launches.get((namespace, job_id), 0.0)
+
+    # --- federation registry --------------------------------------------
+
+    def upsert_region(self, region: str, http_addr: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._regions[region] = http_addr
+        self._notify(["regions"], idx)
+        return idx
+
+    def regions(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._regions)
+
+    # --- autopilot config (state_store.go AutopilotConfig) --------------
+
+    def set_autopilot_config(self, config: Dict) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self.autopilot_config = dict(config)
+        self._notify(["autopilot-config"], idx)
+        return idx
+
+    def to_snapshot_bytes(self) -> bytes:
+        """Serialize every table for raft snapshots / operator backup."""
+        with self._lock:
+            payload = {
+                "index": self._index,
+                "nodes": dict(self._nodes),
+                "jobs": dict(self._jobs),
+                "job_versions": dict(self._job_versions),
+                "evals": dict(self._evals),
+                "allocs": dict(self._allocs),
+                "deployments": dict(self._deployments),
+                "allocs_by_job": {k: set(v) for k, v in self._allocs_by_job.items()},
+                "allocs_by_node": {k: set(v) for k, v in self._allocs_by_node.items()},
+                "allocs_by_eval": {k: set(v) for k, v in self._allocs_by_eval.items()},
+                "scheduler_config": self.scheduler_config,
+                "namespaces": dict(self._namespaces),
+                "scaling_events": {k: list(v) for k, v in self._scaling_events.items()},
+                "acl_policies": dict(self._acl_policies),
+                "acl_tokens": dict(self._acl_tokens),
+                "csi_volumes": dict(self._csi_volumes),
+                "services": dict(self._services),
+                "one_time_tokens": dict(self._one_time_tokens),
+                "periodic_launches": dict(self._periodic_launches),
+                "autopilot_config": dict(self.autopilot_config),
+                "regions": dict(self._regions),
+            }
+        # serialize OUTSIDE the lock (graftcheck R2): the payload holds
+        # shallow table copies and rows are replaced, never mutated, so
+        # pickling them unlocked reads a consistent snapshot — and a
+        # large cluster's dump no longer stalls every store reader for
+        # the whole serialization
+        return pickle.dumps(payload)
+
+    def restore_from_bytes(self, data: bytes) -> None:
+        payload = pickle.loads(data)
+        with self._lock:
+            self._index = payload["index"]
+            self._nodes = payload["nodes"]
+            self._jobs = payload["jobs"]
+            self._job_versions = payload["job_versions"]
+            self._evals = payload["evals"]
+            self._allocs = payload["allocs"]
+            self._deployments = payload["deployments"]
+            self._allocs_by_job = {
+                k: frozenset(v) for k, v in payload["allocs_by_job"].items()}
+            self._allocs_by_node = {
+                k: frozenset(v) for k, v in payload["allocs_by_node"].items()}
+            self._allocs_by_eval = {
+                k: frozenset(v) for k, v in payload["allocs_by_eval"].items()}
+            # replaced wholesale: nothing is shared with snapshots now
+            self._shared.clear()
+            self.scheduler_config = payload["scheduler_config"]
+            self._namespaces = payload.get("namespaces", {})
+            self._scaling_events = payload.get("scaling_events", {})
+            self._acl_policies = payload.get("acl_policies", {})
+            self._acl_tokens = payload.get("acl_tokens", {})
+            self._csi_volumes = payload.get("csi_volumes", {})
+            self._services = payload.get("services", {})
+            self._one_time_tokens = payload.get("one_time_tokens", {})
+            self._periodic_launches = payload.get("periodic_launches", {})
+            self.autopilot_config = payload.get(
+                "autopilot_config", self.autopilot_config
+            )
+            self._regions = payload.get("regions", {})
+            self.usage.rebuild(self._nodes.values(), self._allocs.values())
+        self._notify(
+            ["nodes", "jobs", "evals", "allocs", "deployment",
+             "scheduler_config", "csi_volumes", "services",
+             # restored ACLs must bump their table indexes, or the
+             # token resolver's index-keyed compiled-ACL cache keeps
+             # serving pre-restore policies
+             "acl_policy", "acl_token"],
+            payload["index"],
+        )
+
+    # --- writes (FSM apply targets, fsm.go:194-280 dispatch) ---
+
+    def upsert_node(self, node) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("nodes")
+            if not node.computed_class:
+                node.compute_class()
+            node.modify_index = idx
+            if node.create_index == 0:
+                node.create_index = idx
+            existing = self._nodes.get(node.id)
+            if existing is not None:
+                # re-registration keeps OPERATOR intent (state_store.go
+                # upsertNodeTxn): a client restarting — including one
+                # whose server restarted underneath it (ISSUE 13) —
+                # sends a fresh Node struct, but drain state and
+                # scheduling eligibility were set through the drain/
+                # eligibility endpoints and must survive it
+                node.drain = existing.drain
+                node.drain_strategy = existing.drain_strategy
+                node.scheduling_eligibility = existing.scheduling_eligibility
+                if node.create_index == idx:
+                    node.create_index = existing.create_index
+            self._nodes[node.id] = node
+            self.usage.node_row(node.id)
+            self.usage.note_node_change(node.id)
+        self._notify(["nodes"], idx)
+        return idx
+
+    def delete_node(self, node_id: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("nodes")
+            self._nodes.pop(node_id, None)
+            self.usage.drop_node(node_id)
+        self._notify(["nodes"], idx)
+        return idx
+
+    def update_node_status(self, node_id: str, status: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("nodes")
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node = node.copy()
+                node.status = status
+                node.modify_index = idx
+                self._nodes[node_id] = node
+                self.usage.note_node_change(node_id)
+        self._notify(["nodes"], idx)
+        return idx
+
+    def update_node_eligibility(self, node_id: str, eligibility: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("nodes")
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node = node.copy()
+                node.scheduling_eligibility = eligibility
+                node.modify_index = idx
+                self._nodes[node_id] = node
+                self.usage.note_node_change(node_id)
+        self._notify(["nodes"], idx)
+        return idx
+
+    def update_node_drain(self, node_id: str, drain: bool, strategy=None,
+                          mark_eligible: bool = True) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("nodes")
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node = node.copy()
+                node.drain = drain
+                node.drain_strategy = strategy
+                if drain or not mark_eligible:
+                    # drain completion keeps the node ineligible until
+                    # the operator re-enables (drainer semantics)
+                    node.scheduling_eligibility = consts.NODE_SCHEDULING_INELIGIBLE
+                else:
+                    node.scheduling_eligibility = consts.NODE_SCHEDULING_ELIGIBLE
+                node.modify_index = idx
+                self._nodes[node_id] = node
+                self.usage.note_node_change(node_id)
+        self._notify(["nodes"], idx)
+        return idx
+
+    def upsert_job(self, job) -> int:
+        """UpsertJob: bumps version when the spec changed
+        (state_store.go upsertJobImpl semantics)."""
+        with self._lock:
+            idx = self._next_index()
+            self._own("jobs", "job_versions")
+            key = (job.namespace, job.id)
+            existing = self._jobs.get(key)
+            if existing is not None:
+                if existing.spec_hash() != job.spec_hash():
+                    job.version = existing.version + 1
+                else:
+                    job.version = existing.version
+                job.create_index = existing.create_index
+            else:
+                job.create_index = idx
+                job.version = 0
+            job.modify_index = idx
+            job.job_modify_index = idx
+            job.status = _job_status(job)
+            self._jobs[key] = job
+            self._job_versions[(job.namespace, job.id, job.version)] = job
+        self._notify(["jobs"], idx)
+        return idx
+
+    def delete_job(self, namespace: str, job_id: str) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("jobs", "job_versions")
+            self._jobs.pop((namespace, job_id), None)
+            # purge version history too (state_store.go DeleteJobTxn
+            # deletes from the job_version table)
+            for key in [
+                k for k in self._job_versions
+                if k[0] == namespace and k[1] == job_id
+            ]:
+                del self._job_versions[key]
+        self._notify(["jobs"], idx)
+        return idx
+
+    def upsert_evals(self, evals: List[Evaluation]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("evals")
+            for e in evals:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["evals"], idx)
+        return idx
+
+    def delete_evals(self, eval_ids: List[str]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("evals")
+            for eid in eval_ids:
+                self._evals.pop(eid, None)
+        self._notify(["evals"], idx)
+        return idx
+
+    def upsert_allocs(self, allocs: List[Allocation]) -> int:
+        dep_touched = False
+        with self._lock:
+            idx = self._next_index()
+            for a in allocs:
+                dep_touched |= self._upsert_alloc_locked(a, idx)
+        self._notify(["allocs", "deployment"] if dep_touched
+                     else ["allocs"], idx)
+        return idx
+
+    def _upsert_alloc_locked(self, a: Allocation, idx: int) -> bool:
+        """Returns True when the upsert also wrote a deployment row."""
+        self._own("allocs", "allocs_by_job", "allocs_by_node",
+                  "allocs_by_eval")
+        existing = self._allocs.get(a.id)
+        if existing is not None:
+            # merge client-only fields if this is a server-side update
+            a.create_index = existing.create_index
+            if a.job is None:
+                a.job = existing.job
+        else:
+            a.create_index = idx
+        a.modify_index = idx
+        self._allocs[a.id] = a
+        self.usage.alloc_changed(existing, a)
+        dep_touched = self._update_deployment_with_alloc_locked(
+            existing, a, idx)
+        for table, key in (
+            (self._allocs_by_job, (a.namespace, a.job_id)),
+            (self._allocs_by_node, a.node_id),
+            (self._allocs_by_eval, a.eval_id),
+        ):
+            ids = table.get(key)
+            if ids is None or a.id not in ids:
+                # frozenset replacement, never in-place (snapshots share)
+                table[key] = (ids or frozenset()) | {a.id}
+        return dep_touched
+
+    def update_allocs_from_client(self, allocs: List[Allocation]) -> int:
+        """Client status updates (state_store.go UpdateAllocsFromClient)."""
+        dep_touched = False
+        with self._lock:
+            idx = self._next_index()
+            self._own("allocs")
+            for update in allocs:
+                existing = self._allocs.get(update.id)
+                if existing is None:
+                    continue
+                new = existing.copy_skip_job()
+                new.client_status = update.client_status
+                new.client_description = update.client_description
+                new.task_states = dict(update.task_states)
+                if update.deployment_status is not None:
+                    new.deployment_status = update.deployment_status
+                if update.network_status is not None:
+                    new.network_status = update.network_status
+                new.modify_index = idx
+                new.modify_time_ns = update.modify_time_ns
+                self._allocs[new.id] = new
+                self.usage.alloc_changed(existing, new)
+                # health transitions roll up into the deployment
+                # (state_store.go updateDeploymentWithAlloc)
+                dep_touched |= self._update_deployment_with_alloc_locked(
+                    existing, new, idx)
+        self._notify(["allocs", "deployment"] if dep_touched
+                     else ["allocs"], idx)
+        return idx
+
+    def _update_deployment_with_alloc_locked(
+        self, old: Optional[Allocation], new: Allocation, idx: int
+    ) -> bool:
+        """Bump DeploymentState counters on placement/health changes
+        (state_store.go updateDeploymentWithAlloc). Returns True when a
+        deployment row was actually written — callers notify the
+        "deployment" table only then, so the deployments watcher's
+        index-gated early-out actually fires on deployment-less
+        placement bursts (the common case)."""
+        if not new.deployment_id:
+            return False
+        d = self._deployments.get(new.deployment_id)
+        if d is None or not d.active():
+            return False
+        state = d.task_groups.get(new.task_group)
+        if state is None:
+            return False
+        placed = 1 if old is None else 0
+        old_h = old.deployment_status.healthy \
+            if old is not None and old.deployment_status is not None else None
+        new_h = new.deployment_status.healthy \
+            if new.deployment_status is not None else None
+        d_healthy = (1 if new_h is True else 0) - (1 if old_h is True else 0)
+        d_unhealthy = (1 if new_h is False else 0) - (1 if old_h is False else 0)
+        if not (placed or d_healthy or d_unhealthy):
+            return False
+        self._own("deployments")
+        d = d.copy()
+        state = d.task_groups[new.task_group]
+        state.placed_allocs += placed
+        state.healthy_allocs += d_healthy
+        state.unhealthy_allocs += d_unhealthy
+        d.modify_index = idx
+        self._deployments[d.id] = d
+        return True
+
+    def update_allocs_desired_transition(self, transitions: Dict[str, object], evals: List[Evaluation]) -> int:
+        """{alloc_id: DesiredTransition} -- drainer/operator migrate
+        requests (state_store.go UpdateAllocsDesiredTransitions)."""
+        with self._lock:
+            idx = self._next_index()
+            self._own("allocs", "evals")
+            for alloc_id, transition in transitions.items():
+                existing = self._allocs.get(alloc_id)
+                if existing is None:
+                    continue
+                new = existing.copy_skip_job()
+                new.desired_transition = transition
+                new.modify_index = idx
+                self._allocs[alloc_id] = new
+                self.usage.alloc_changed(existing, new)
+            for e in evals:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["allocs", "evals"], idx)
+        return idx
+
+    def stop_alloc(self, alloc_id: str, evals: List[Evaluation]) -> int:
+        """Mark one alloc desired=stop (`nomad alloc stop`;
+        state_store.go UpdateAllocDesiredTransition + stop)."""
+        with self._lock:
+            idx = self._next_index()
+            self._own("allocs", "evals")
+            existing = self._allocs.get(alloc_id)
+            if existing is not None:
+                new = existing.copy_skip_job()
+                new.desired_status = consts.ALLOC_DESIRED_STOP
+                new.modify_index = idx
+                self._allocs[alloc_id] = new
+                self.usage.alloc_changed(existing, new)
+            for e in evals:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["allocs", "evals"], idx)
+        return idx
+
+    def upsert_deployment(self, d: Deployment) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("deployments")
+            d.modify_index = idx
+            if d.create_index == 0:
+                d.create_index = idx
+            self._deployments[d.id] = d
+        self._notify(["deployment"], idx)
+        return idx
+
+    def update_deployment_status(self, deployment_id: str, status: str, description: str = "") -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("deployments")
+            d = self._deployments.get(deployment_id)
+            if d is not None:
+                d = d.copy()
+                d.status = status
+                d.status_description = description or d.status_description
+                d.modify_index = idx
+                self._deployments[deployment_id] = d
+        self._notify(["deployment"], idx)
+        return idx
+
+    def delete_allocs(self, alloc_ids: List[str]) -> int:
+        """GC path (state_store.go DeleteEval also reaps allocs; service
+        registrations of reaped allocs go with them)."""
+        with self._lock:
+            idx = self._next_index()
+            self._own("allocs", "allocs_by_job", "allocs_by_node",
+                      "allocs_by_eval")
+            doomed = set(alloc_ids)
+            for aid in alloc_ids:
+                a = self._allocs.pop(aid, None)
+                if a is None:
+                    continue
+                self.usage.alloc_changed(a, None)
+                for table, key in (
+                    (self._allocs_by_job, (a.namespace, a.job_id)),
+                    (self._allocs_by_node, a.node_id),
+                    (self._allocs_by_eval, a.eval_id),
+                ):
+                    ids = table.get(key)
+                    if ids and aid in ids:
+                        remaining = ids - {aid}
+                        if remaining:
+                            table[key] = remaining
+                        else:
+                            del table[key]
+            stale_regs = [r.id for r in self._services.values()
+                          if r.alloc_id in doomed]
+            for rid in stale_regs:
+                del self._services[rid]
+        self._notify(["allocs", "services"] if stale_regs else ["allocs"], idx)
+        return idx
+
+    def delete_deployments(self, deployment_ids: List[str]) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self._own("deployments")
+            for did in deployment_ids:
+                self._deployments.pop(did, None)
+        self._notify(["deployment"], idx)
+        return idx
+
+    def update_deployment_alloc_health(
+        self,
+        deployment_id: str,
+        healthy_ids: List[str],
+        unhealthy_ids: List[str],
+        deployment_update: Optional[Dict] = None,
+        evals: Optional[List[Evaluation]] = None,
+    ) -> int:
+        """state_store.go UpdateDeploymentAllocHealth: record per-alloc
+        deployment health and bump the DeploymentState counters."""
+        from nomad_tpu.structs.alloc import AllocDeploymentStatus
+
+        with self._lock:
+            idx = self._next_index()
+            self._own("deployments", "allocs", "evals")
+            d = self._deployments.get(deployment_id)
+            if d is not None:
+                d = d.copy()
+                for aid, healthy in [(i, True) for i in healthy_ids] + [
+                    (i, False) for i in unhealthy_ids
+                ]:
+                    a = self._allocs.get(aid)
+                    if a is None:
+                        continue
+                    new = a.copy_skip_job()
+                    new.job = a.job
+                    status = new.deployment_status or AllocDeploymentStatus()
+                    was = status.healthy
+                    status.healthy = healthy
+                    status.modify_index = idx
+                    new.deployment_status = status
+                    new.modify_index = idx
+                    self._allocs[aid] = new
+                    self.usage.alloc_changed(a, new)
+                    state = d.task_groups.get(new.task_group)
+                    if state is not None and was != healthy:
+                        if healthy:
+                            state.healthy_allocs += 1
+                            if was is False:
+                                state.unhealthy_allocs -= 1
+                        else:
+                            state.unhealthy_allocs += 1
+                            if was is True:
+                                state.healthy_allocs -= 1
+                d.modify_index = idx
+                if deployment_update:
+                    d.status = deployment_update.get("status", d.status)
+                    d.status_description = deployment_update.get(
+                        "status_description", d.status_description
+                    )
+                self._deployments[deployment_id] = d
+            for e in evals or []:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["allocs", "deployment", "evals"], idx)
+        return idx
+
+    def update_deployment_promotion(
+        self, deployment_id: str, groups: Optional[List[str]] = None,
+        evals: Optional[List[Evaluation]] = None,
+    ) -> int:
+        """state_store.go UpdateDeploymentPromotion: mark canaries
+        promoted for all (or the given) groups."""
+        with self._lock:
+            idx = self._next_index()
+            self._own("deployments", "evals")
+            d = self._deployments.get(deployment_id)
+            if d is not None:
+                d = d.copy()
+                for name, state in d.task_groups.items():
+                    if groups is None or name in groups:
+                        state.promoted = True
+                d.modify_index = idx
+                self._deployments[deployment_id] = d
+            for e in evals or []:
+                e.modify_index = idx
+                if e.create_index == 0:
+                    e.create_index = idx
+                self._evals[e.id] = e
+        self._notify(["deployment", "evals"], idx)
+        return idx
+
+    def set_scheduler_config(self, config: SchedulerConfiguration) -> int:
+        with self._lock:
+            idx = self._next_index()
+            self.scheduler_config = config
+        self._notify(["scheduler_config"], idx)
+        return idx
+
+    # --- plan application (FSM ApplyPlanResults, fsm.go applyPlanResults) ---
+
+    def upsert_plan_results(
+        self,
+        alloc_index: int,
+        plan: Plan,
+        node_allocation: Dict[str, List[Allocation]],
+        node_update: Dict[str, List[Allocation]],
+        node_preemptions: Dict[str, List[Allocation]],
+        deployment: Optional[Deployment] = None,
+        deployment_updates: Optional[List[Dict]] = None,
+    ) -> int:
+        """Commit one (possibly partial) plan the applier validated."""
+        return self.upsert_plan_results_batch(alloc_index, [{
+            "plan": plan,
+            "node_allocation": node_allocation,
+            "node_update": node_update,
+            "node_preemptions": node_preemptions,
+            "deployment": deployment,
+            "deployment_updates": deployment_updates,
+        }])
+
+    def upsert_plan_results_batch(self, alloc_index: int,
+                                  plans: List[Dict]) -> int:
+        """Commit a batch of evaluated plans as ONE index bump / one
+        watcher notification (the applier merges a burst of plans into
+        one raft entry; fsm.go applyPlanResults semantics per plan,
+        applied in batch order)."""
+        dep_touched = False
+        with self._lock:
+            idx = self._next_index()
+            self._own("deployments")
+            for p in plans:
+                plan = p["plan"]
+                for allocs in p["node_update"].values():
+                    for a in allocs:
+                        dep_touched |= self._upsert_alloc_locked(a, idx)
+                for allocs in p["node_preemptions"].values():
+                    for a in allocs:
+                        dep_touched |= self._upsert_alloc_locked(a, idx)
+                for allocs in p["node_allocation"].values():
+                    for a in allocs:
+                        if a.job is None:
+                            a.job = plan.job
+                        dep_touched |= self._upsert_alloc_locked(a, idx)
+                deployment = p.get("deployment")
+                if deployment is not None:
+                    deployment.modify_index = idx
+                    if deployment.create_index == 0:
+                        deployment.create_index = idx
+                    self._deployments[deployment.id] = deployment
+                    dep_touched = True
+                for du in p.get("deployment_updates") or []:
+                    d = self._deployments.get(du.get("deployment_id"))
+                    if d is not None:
+                        d = d.copy()
+                        d.status = du.get("status", d.status)
+                        d.status_description = du.get(
+                            "status_description", d.status_description)
+                        d.modify_index = idx
+                        self._deployments[d.id] = d
+                        dep_touched = True
+        # notify "deployment" only when a row actually changed: the
+        # deployments watcher's idle gate keys on this index, and a
+        # deployment-less placement burst (the common case) must not
+        # defeat it by bumping the index on every plan commit
+        self._notify(["allocs", "deployment"] if dep_touched
+                     else ["allocs"], idx)
+        return idx
+
+
+def _job_status(job) -> str:
+    if job.stop:
+        return consts.JOB_STATUS_DEAD
+    return consts.JOB_STATUS_PENDING

@@ -347,6 +347,44 @@ class TestR4StoreAccess:
                "        return state_store._nodes\n")
         assert self._run(src, rel="nomad_tpu/state/store.py") == []
 
+    def test_mvcc_internals_flagged(self):
+        src = ("def f(store):\n"
+               "    return store._root.tables\n")
+        assert ("R4", "internal:store._root") in self._run(src)
+
+    def test_snapshot_row_attribute_write_flagged(self):
+        # the exact shape of the seed set_job_stability bug: a row read
+        # off a snapshot is shared across generations — writing an
+        # attribute in place corrupts history for every holder
+        src = ("def f(store, nid):\n"
+               "    snap = store.snapshot()\n"
+               "    node = snap.node_by_id(nid)\n"
+               "    node.status = 'down'\n")
+        assert ("R4", "snapshot-mutate:node") in self._run(src)
+
+    def test_direct_reader_row_mutation_flagged(self):
+        src = ("def f(store, nid):\n"
+               "    node = store.node_by_id_direct(nid)\n"
+               "    node.meta.update({'k': 'v'})\n")
+        assert ("R4", "snapshot-mutate:node.meta") in self._run(src)
+
+    def test_copy_launders_taint(self):
+        # .copy() is the sanctioned copy-on-write move: the copy is
+        # caller-owned and free to mutate before the write txn
+        src = ("def f(store, nid):\n"
+               "    node = store.node_by_id_direct(nid)\n"
+               "    mine = node.copy()\n"
+               "    mine.status = 'down'\n"
+               "    return mine\n")
+        assert self._run(src) == []
+
+    def test_rebinding_untaints(self):
+        src = ("def f(store, nid):\n"
+               "    snap = store.snapshot()\n"
+               "    snap = {}\n"
+               "    snap['k'] = 1\n")
+        assert self._run(src) == []
+
 
 # ---------------------------------------------------------------------------
 # R5 telemetry drift
@@ -744,8 +782,9 @@ class TestR2FixRegressions:
         assert len(fetches) == 2        # idx + scores, fetched once
 
     def test_store_snapshot_bytes_pickles_off_lock(self):
-        """store fix: to_snapshot_bytes serializes outside the store
-        lock (readers keep flowing during a big dump)."""
+        """store fix (now structural): to_snapshot_bytes pins one MVCC
+        root and serializes it without EVER taking the write lock —
+        writers keep committing during a big dump."""
         import nomad_tpu.state.store as store_mod
         from nomad_tpu.state.store import StateStore
 
@@ -754,7 +793,7 @@ class TestR2FixRegressions:
         orig = store_mod.pickle.dumps
 
         def checking_dumps(obj, *a, **kw):
-            seen.append(store._lock._is_owned())
+            seen.append(store._write_lock._is_owned())
             return orig(obj, *a, **kw)
 
         store_mod.pickle = type("P", (), {
@@ -768,8 +807,8 @@ class TestR2FixRegressions:
         assert data and seen == [False]
 
     def test_group_checker_folds_off_store_lock(self):
-        """plan_apply fix: _GroupFitChecker folds overlay entries
-        OUTSIDE the store lock (O(result) row prefetch under it)."""
+        """plan_apply fix (now structural): _GroupFitChecker reads one
+        MVCC root — the fold never holds the store's write lock."""
         from nomad_tpu import mock
         from nomad_tpu.server.plan_apply import (
             _GroupFitChecker,
@@ -791,7 +830,7 @@ class TestR2FixRegressions:
         orig = _GroupFitChecker._fold_result
 
         def checking_fold(self, r, rows):
-            owned_during_fold.append(store._lock._is_owned())
+            owned_during_fold.append(store._write_lock._is_owned())
             return orig(self, r, rows)
 
         _GroupFitChecker._fold_result = checking_fold

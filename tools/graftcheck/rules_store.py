@@ -1,45 +1,84 @@
-"""R4: store-access discipline.
+"""R4: store-access discipline for the MVCC store.
 
-``StateStore``'s tables and lock are implementation details; every
-consumer outside ``nomad_tpu/state/store.py`` must go through the
-snapshot (``store.snapshot()``), the locked ``*_direct`` readers
-(``node_by_id_direct`` / ``alloc_by_id_direct`` /
-``allocs_by_node_direct``), or the scoped view helpers
-(``with_usage_view`` / ``with_allocs``) PR 6 introduced. Raw
-``store._tables`` access re-opens the exact coupling those accessors
-were built to close: a reader that grabs ``_allocs`` under its own
-idea of the lock (or none) races the FSM's writes, and a change to
-the store's internal layout silently breaks every out-of-module
-reader instead of one accessor.
+Two obligations, one rule:
 
-The rule flags attribute access to a known-internal name when the
-receiver smells like a store (``store`` / ``_store`` / ``state`` /
-``state_store`` terminal name). ``nomad_tpu/state/store.py`` itself is
-exempt (the internals live there).
+**Internals stay internal.** ``StateStore``'s root pointer, locks and
+legacy table attributes are implementation details; every consumer
+outside ``nomad_tpu/state/store.py`` must go through ``snapshot()``,
+the lock-free ``*_direct`` readers (``node_by_id_direct`` /
+``alloc_by_id_direct`` / ``allocs_by_node_direct``), or the scoped
+view helpers (``with_usage_view`` / ``with_allocs``). Raw
+``store._root`` / ``store._tables`` access re-opens the exact coupling
+those accessors were built to close: a change to the store's internal
+layout silently breaks every out-of-module reader instead of one
+accessor, and a reader that grabs internals under its own idea of the
+locking discipline (or none) is exactly the bug class MVCC removed.
+
+**No mutation escapes a snapshot.** The MVCC store shares rows ACROSS
+generations by reference: a snapshot is one immutable root, and the
+row objects inside it are the same objects every other generation —
+and every other reader — sees. The write path's contract is *replace,
+never mutate* (copy the row, write the copy through a raft-applied
+write transaction). An in-place write on a row read from a snapshot or
+a ``*_direct`` reader corrupts history for every holder of every
+generation at once. Values produced by ``snapshot()`` /
+``snapshot_at()`` / the ``*_direct`` readers are tainted (R1-style
+forward taint, per function body); rows read off a tainted value stay
+tainted; in-place mutation of a tainted name — attribute assignment,
+subscript assignment/deletion, augmented assignment, mutating method
+calls — is a finding. Rebinding un-taints, and ``.copy()`` (the
+sanctioned copy-on-write move) launders: ``node = node.copy()`` is
+the fix the finding asks for.
+
+``nomad_tpu/state/store.py`` itself is exempt (the internals live
+there, and its write transactions are the one sanctioned mutation
+scope).
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Iterable
+from typing import Iterable, List, Set
 
-from tools.graftcheck.engine import Context, Finding, dotted_name
+from tools.graftcheck.engine import Context, Finding, SourceFile, dotted_name
 
 RULE = "R4"
 
-#: StateStore internals (tables, indexes, the lock) — keep in sync
-#: with state/store.py's __init__
+#: StateStore internals — keep in sync with state/store.py. The legacy
+#: seed-store names stay listed: reaching for them is wrong whether or
+#: not the attribute still exists (a fork or an old pattern pasted in).
 _INTERNALS = {
+    # MVCC store internals
+    "_root", "_write_lock", "_watch_lock", "_watchers",
+    # legacy seed-store internals (pre-MVCC layout)
     "_lock", "_tables", "_nodes", "_jobs", "_job_versions", "_evals",
     "_allocs", "_allocs_by_job", "_allocs_by_node", "_allocs_by_eval",
-    "_deployments", "_namespaces", "_index", "_watchers",
+    "_deployments", "_namespaces", "_index",
     "_csi_volumes", "_services", "_acl_policies", "_acl_tokens",
 }
 
 _STOREISH = re.compile(r"(?i)(?:^|_)(?:store|state|state_store)$")
 
-#: files where the internals legitimately live
+#: calls whose return value is shared MVCC state (taint sources)
+_TAINT_SOURCES = {
+    "snapshot", "snapshot_at",
+    "node_by_id_direct", "alloc_by_id_direct", "allocs_by_node_direct",
+}
+
+#: method calls on a tainted receiver whose RESULT is a fresh object
+#: the caller owns (taint laundering — the sanctioned copy-before-write
+#: move and plain materializations)
+_LAUNDERERS = {"copy", "deepcopy", "to_dict", "snapshot_bytes"}
+
+#: method calls that mutate their receiver in place
+_MUTATORS = {
+    "update", "pop", "popitem", "clear", "append", "extend", "insert",
+    "remove", "setdefault", "add", "discard", "sort", "fill",
+}
+
+#: files where the internals legitimately live and rows are
+#: legitimately built/owned (the write-transaction scope)
 _EXEMPT = ("nomad_tpu/state/store.py",)
 
 
@@ -50,20 +89,133 @@ class StoreAccessRule:
         for src in ctx.files:
             if src.rel in _EXEMPT:
                 continue
-            for node in ast.walk(src.tree):
-                if not isinstance(node, ast.Attribute):
-                    continue
-                if node.attr not in _INTERNALS:
-                    continue
-                recv = dotted_name(node.value)
-                if not recv:
-                    continue
-                term = recv.rsplit(".", 1)[-1]
-                if not _STOREISH.search(term):
-                    continue
-                yield Finding(
-                    RULE, src.rel, node.lineno, src.scope_of(node),
-                    f"internal:{term}.{node.attr}",
-                    f"raw store internal `{recv}.{node.attr}` outside "
-                    f"state/store.py: use snapshot(), the *_direct "
-                    f"readers, or with_usage_view()/with_allocs()")
+            yield from self._check_internals(src)
+            for fn in ast.walk(src.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_function(src, fn)
+
+    # -- part 1: raw internals access ------------------------------------
+
+    def _check_internals(self, src: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if node.attr not in _INTERNALS:
+                continue
+            recv = dotted_name(node.value)
+            if not recv:
+                continue
+            term = recv.rsplit(".", 1)[-1]
+            if not _STOREISH.search(term):
+                continue
+            yield Finding(
+                RULE, src.rel, node.lineno, src.scope_of(node),
+                f"internal:{term}.{node.attr}",
+                f"raw store internal `{recv}.{node.attr}` outside "
+                f"state/store.py: use snapshot(), the *_direct "
+                f"readers, or with_usage_view()/with_allocs()")
+
+    # -- part 2: snapshot-row mutation (R1-style forward taint) ----------
+
+    def _check_function(self, src: SourceFile, fn) -> Iterable[Finding]:
+        tainted: Set[str] = set()
+        # one forward pass in source order (same discipline as R1): a
+        # miss is a false negative, never a false positive
+        seen: Set[tuple] = set()
+        body: List[ast.stmt] = list(fn.body)
+        for stmt in body:
+            for f in self._visit_stmt(src, stmt, tainted):
+                key = (f.line, f.slug)
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+
+    def _is_tainted_value(self, node: ast.AST, tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = dotted_name(func).rsplit(".", 1)[-1]
+            if name in _TAINT_SOURCES:
+                return True
+            # a method call ON shared state returns shared state
+            # (``snap.node_by_id(x)``) — unless it launders
+            if isinstance(func, ast.Attribute) \
+                    and self._root_tainted(func.value, tainted):
+                return func.attr not in _LAUNDERERS
+            return False
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            return self._root_tainted(node, tainted)
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        return False
+
+    @staticmethod
+    def _root_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id in tainted
+
+    def _visit_stmt(self, src: SourceFile, stmt: ast.stmt,
+                    tainted: Set[str]) -> Iterable[Finding]:
+        if isinstance(stmt, ast.Assign):
+            is_shared = self._is_tainted_value(stmt.value, tainted)
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    (tainted.add if is_shared
+                     else tainted.discard)(tgt.id)
+                elif isinstance(tgt, ast.Tuple) and is_shared:
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            tainted.add(el.id)
+                elif isinstance(tgt, ast.Attribute):
+                    if self._root_tainted(tgt.value, tainted):
+                        yield self._finding(
+                            src, stmt, tgt.value,
+                            f"attribute assignment `.{tgt.attr} =` "
+                            "writes a shared MVCC row in place")
+                elif isinstance(tgt, ast.Subscript):
+                    if self._root_tainted(tgt, tainted):
+                        yield self._finding(
+                            src, stmt, tgt,
+                            "subscript assignment into shared MVCC "
+                            "state")
+        elif isinstance(stmt, ast.AugAssign):
+            if self._root_tainted(stmt.target, tainted):
+                yield self._finding(
+                    src, stmt, stmt.target,
+                    "augmented assignment mutates shared MVCC state "
+                    "in place")
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, (ast.Subscript, ast.Attribute)) \
+                        and self._root_tainted(tgt, tainted):
+                    yield self._finding(
+                        src, stmt, tgt, "del into shared MVCC state")
+        # mutating method calls anywhere in the statement
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS \
+                    and self._root_tainted(node.func.value, tainted):
+                yield self._finding(
+                    src, node, node.func.value,
+                    f".{node.func.attr}() mutates shared MVCC state "
+                    "in place")
+        # recurse into compound statements (same taint scope)
+        for field in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, field, []) or []:
+                yield from self._visit_stmt(src, sub, tainted)
+        for handler in getattr(stmt, "handlers", []) or []:
+            for sub in handler.body:
+                yield from self._visit_stmt(src, sub, tainted)
+
+    def _finding(self, src: SourceFile, node: ast.AST, target: ast.AST,
+                 what: str) -> Finding:
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        tname = dotted_name(target) or "<expr>"
+        return Finding(
+            RULE, src.rel, getattr(node, "lineno", 0),
+            src.scope_of(node), f"snapshot-mutate:{tname}",
+            f"snapshot-row mutation: {what} ({tname}); MVCC rows are "
+            f"shared across generations — copy the row and write the "
+            f"copy through a store write transaction")

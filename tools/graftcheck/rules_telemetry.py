@@ -54,9 +54,10 @@ _PROM_NAME = re.compile(r"\bnomad_tpu_[a-z0-9]+(?:_[a-z0-9]+)+\b")
 #: fuzz verdicts); mesh_* in ISSUE 14 (the 100k-node sharded mesh
 #: cell's scale/parity/collective-share lines); timeline_* in
 #: ISSUE 15 (the failover timeline's phase-attribution lines riding
-#: CHAOS_TIMELINE.json)
+#: CHAOS_TIMELINE.json); store_* in ISSUE 16 (the MVCC store cell's
+#: snapshot/write-txn latency and read-lock-share lines)
 _BENCH_KEY = re.compile(
-    r"^(?:trace|contention|fleet|chaos|restart|mesh|timeline)"
+    r"^(?:trace|contention|fleet|chaos|restart|mesh|timeline|store)"
     r"_[a-z0-9_]+$")
 #: bench kwargs that are not emission keys
 _BENCH_KEY_EXCLUDE = {"trace_id", "timeline_path"}
