@@ -1429,6 +1429,58 @@ def main() -> None:
         print("bench budget: skipping store cell "
               f"({budget.remaining():.0f}s left)", file=sys.stderr)
 
+    # ISSUE 17: the worker cell — A/B the multi-process scheduler
+    # plane (scheduler_workers=4, snapshot frames + eval leases over
+    # IPC) against the in-process 4-thread baseline on the same steady
+    # burst. worker_speedup is the headline (gate: >= 1.5x on a
+    # >= 4-core host); parity + the 0-jit-miss / 0-fallback steady
+    # gates make a speedup that costs placement correctness a FAILURE,
+    # not a win. Reproduce with trace_report.run_worker_burst().
+    if budget.remaining() > 180:
+        try:
+            _phase("worker cell")
+            sys.path.insert(0, os.path.join(REPO, "bench"))
+            import trace_report
+
+            cell = trace_report.run_worker_burst(
+                deadline_s=min(budget.share(0.3), 150.0))
+            em.update(
+                worker_procs=cell["procs"],
+                worker_evals_per_sec=cell["evals_per_sec"],
+                worker_evals_per_sec_baseline=cell[
+                    "evals_per_sec_baseline"],
+                worker_speedup=cell["speedup"],
+                worker_lease_reissues=cell["lease_reissues"],
+                worker_ipc_p99_ms=cell["ipc_p99_ms"],
+                worker_parity_ok=1 if cell["parity_ok"] else 0,
+            )
+            if not cell["parity_ok"]:
+                print("warning: worker cell placement parity FAILED "
+                      "(speedup is void without it)", file=sys.stderr)
+            if cell["jit_cache_misses"]:
+                print("warning: worker cell steady burst had "
+                      f"{cell['jit_cache_misses']} jit cache misses",
+                      file=sys.stderr)
+            if cell["plan_group_fallbacks"]:
+                print("warning: worker cell steady burst had "
+                      f"{cell['plan_group_fallbacks']} plan-group "
+                      "fallbacks", file=sys.stderr)
+            if cell["leases_leaked"]:
+                print("warning: worker cell leaked "
+                      f"{cell['leases_leaked']} generation leases "
+                      "after shutdown", file=sys.stderr)
+            if cell["speedup"] < 1.5 and os.cpu_count() >= 4:
+                print("warning: worker_speedup "
+                      f"{cell['speedup']} below the 1.5x gate on a "
+                      f"{os.cpu_count()}-core host", file=sys.stderr)
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: worker cell failed ({e})", file=sys.stderr)
+    else:
+        print("bench budget: skipping worker cell "
+              f"({budget.remaining():.0f}s left)", file=sys.stderr)
+
     # ISSUE 12: the chaos cell — every standing fault schedule
     # (leader-kill-mid-wave, plan-commit raft failure, crash-and-drop)
     # against a live 3-node raft cluster, pinned seed, convergence

@@ -1616,6 +1616,159 @@ def run_store_burst(n_nodes: int = 100_000, n_allocs: int = 200_000,
             witness.disable()
 
 
+def run_worker_burst(n_workers: int = 4, n_nodes: int = 200,
+                     n_jobs: int = 48, allocs_per_job: int = 3,
+                     batch_size: int = 8, warmup_jobs: int = 8,
+                     deadline_s: float = 150.0) -> Dict:
+    """The ISSUE-17 worker cell: A/B the multi-process scheduler plane
+    against the in-process baseline on the SAME steady burst.
+
+    Arm A (``worker_procs=0``) runs ``n_workers`` in-process worker
+    THREADS — the pre-17 topology, every feasibility/reconcile/plan
+    walk sharing one GIL with plan apply and serving. Arm B
+    (``worker_procs=n_workers``) runs one in-process core worker plus
+    ``n_workers`` worker PROCESSES fed ``(gen, delta)`` snapshot
+    frames and eval leases over the IPC channel. Same node fleet, same
+    job shapes, same batch size — the only variable is where the
+    host-side scheduling CPU burns.
+
+    Both arms must converge to exact placement (every eval terminal,
+    no duplicate live slots, usage planes rebuild-identical): a
+    speedup at the cost of placement parity is a regression, not a
+    win. The B arm additionally reports the lease-reissue count (0 in
+    a fault-free burst), the worker_ipc round-trip p99, and the two
+    steady-state gates every perf PR is judged on — 0 owner-side jit
+    cache misses and 0 plan-group fallbacks inside the timed window.
+    """
+    from nomad_tpu import mock
+    from nomad_tpu.server.plan_apply import plan_group_stats
+    from nomad_tpu.server.server import Server, ServerConfig
+    from nomad_tpu.state.store import leased_generation_count
+    from nomad_tpu.state.usage import usage_rebuild_diff
+    from nomad_tpu.structs import consts
+    from nomad_tpu.telemetry.histogram import histograms
+    from nomad_tpu.telemetry.kernel_profile import profiler
+
+    def run_arm(procs: int) -> Dict:
+        server = Server(ServerConfig(
+            num_workers=(1 if procs else n_workers),
+            worker_batch_size=batch_size,
+            heartbeat_ttl=3600.0,
+            scheduler_workers=procs,
+        ))
+        server.start()
+        try:
+            for _ in range(n_nodes):
+                server.node_register(mock.node())
+
+            def submit(count):
+                jobs = []
+                for _ in range(count):
+                    job = mock.simple_job()
+                    job.task_groups[0].count = allocs_per_job
+                    jobs.append(job)
+                    server.job_register(job)
+                return jobs
+
+            def wait_converged(jobs, deadline):
+                # The in-process ``w.processed`` counters only cover
+                # the core queue when procs > 0 (the scheduling planes
+                # live in the worker processes), so the drain trigger
+                # here is the broker itself going empty — cheap
+                # dict-len stats every tick, with the O(allocs)
+                # snapshot taken only once the trigger fires.
+                want = len(jobs) * allocs_per_job
+                placed = 0
+                t_done = time.perf_counter()
+                while time.time() < deadline:
+                    bs = server.eval_broker.stats()
+                    if (bs["total_ready"] == 0
+                            and bs["total_unacked"] == 0
+                            and bs["total_waiting"] == 0):
+                        snap = server.state.snapshot()
+                        placed = sum(
+                            len(snap.allocs_by_job(j.namespace, j.id))
+                            for j in jobs)
+                        t_done = time.perf_counter()
+                        if placed >= want:
+                            break
+                    time.sleep(0.02)
+                return placed, t_done
+
+            warm = submit(warmup_jobs)
+            wait_converged(warm,
+                           time.time() + min(deadline_s * 0.5, 60.0))
+
+            # open the measurement window AFTER warmup: the steady
+            # gates below judge only the timed burst
+            profiler.reset()
+            plan_group_stats.reset()
+            t0 = time.perf_counter()
+            jobs = submit(n_jobs)
+            placed, t_done = wait_converged(
+                jobs, time.time() + deadline_s)
+            wall = t_done - t0
+
+            snap = server.state.snapshot()
+            nonterminal = sum(
+                1 for e in snap.evals_iter()
+                if e.status in (consts.EVAL_STATUS_PENDING,
+                                consts.EVAL_STATUS_BLOCKED))
+            dup_slots = 0
+            for j in jobs:
+                names = [a.name for a in
+                         snap.allocs_by_job(j.namespace, j.id)
+                         if not a.terminal_status()]
+                dup_slots += len(names) - len(set(names))
+            want = n_jobs * allocs_per_job
+            parity_ok = bool(placed >= want and nonterminal == 0
+                             and dup_slots == 0
+                             and usage_rebuild_diff(server.state) == [])
+            wp = (server.worker_supervisor.stats()
+                  if server.worker_supervisor is not None else None)
+            return {
+                "wall_s": round(wall, 3),
+                "evals_per_sec": round(n_jobs / wall, 2)
+                if wall else 0.0,
+                "allocs_placed": placed,
+                "allocs_wanted": want,
+                "parity_ok": parity_ok,
+                "jit_cache_misses": profiler.summary()["JitCacheMisses"],
+                "plan_group_fallbacks":
+                    plan_group_stats.snapshot()["fallback_plans"],
+                "supervisor": wp,
+            }
+        finally:
+            server.shutdown()
+
+    base = run_arm(0)
+    multi = run_arm(n_workers)
+    sup = multi["supervisor"] or {}
+    ipc = histograms.get("worker_ipc").snapshot()
+    speedup = (multi["evals_per_sec"] / base["evals_per_sec"]
+               if base["evals_per_sec"] else 0.0)
+    return {
+        "procs": n_workers,
+        "n_nodes": n_nodes,
+        "n_evals": n_jobs,
+        "baseline": base,
+        "multi": multi,
+        "evals_per_sec_baseline": base["evals_per_sec"],
+        "evals_per_sec": multi["evals_per_sec"],
+        "speedup": round(speedup, 3),
+        "lease_reissues": sup.get("lease_reissues", 0),
+        "respawns": sup.get("respawns", 0),
+        "ipc_p99_ms": ipc["p99_ms"],
+        "ipc_rtts": ipc["count"],
+        "jit_cache_misses": multi["jit_cache_misses"],
+        "plan_group_fallbacks": multi["plan_group_fallbacks"],
+        "parity_ok": bool(base["parity_ok"] and multi["parity_ok"]),
+        # both arms torn down: every worker-held generation lease must
+        # be released or the retention split leaks roots fleet-wide
+        "leases_leaked": leased_generation_count(),
+    }
+
+
 #: the chaos cell's pinned seed: every schedule below is reproduced by
 #: re-arming the SAME (faults, seed) pair (docs/ROBUSTNESS.md, "how to
 #: reproduce a chaos failure from its seed")
@@ -1723,6 +1876,23 @@ CHAOS_SCHEDULES = {
         },
         "drop_nodes": 3,
     },
+    # REAL process death (ISSUE 17): the burst runs through two
+    # multi-process scheduler workers; `workerproc.kill` SIGKILLs a
+    # worker process mid-lease — evals leased, replica synced, no
+    # chance to ack/nack/unwind — twice, and acks fail sporadically on
+    # top. The supervisor's liveness monitor must re-enqueue each dead
+    # worker's lease ledger and respawn; convergence then asserts the
+    # standard invariants (every eval terminal, exact placement,
+    # usage planes rebuild-identical) plus leases-reissued > 0.
+    "worker-kill-mid-lease": {
+        "faults": {
+            "workerproc.kill": {"kind": "error", "every": 3,
+                                "max_fires": 2},
+            "broker.ack": {"kind": "error", "p": 0.1, "max_fires": 2},
+        },
+        "drop_nodes": 0,
+        "scheduler_workers": 2,
+    },
 }
 
 
@@ -1791,6 +1961,9 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
         # tracker must not convert them into eligibility flips that
         # shrink the cell's capacity mid-run
         plan_rejection_threshold=500,
+        # worker-kill schedules run the burst through multi-process
+        # scheduler workers (server/workerproc.py, ISSUE 17)
+        scheduler_workers=spec.get("scheduler_workers", 0),
     ))
     for s in servers:
         # redelivery must be fast enough to converge inside the cell
@@ -1957,6 +2130,26 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
         fire_window = faultpoints.fire_log()
         faultpoints.disarm()
 
+        # worker-process plane (ISSUE 17): lease recovery must have
+        # actually run when the schedule killed worker processes
+        worker_reissues = worker_respawns = 0
+        for s in servers:
+            sup = getattr(s, "worker_supervisor", None)
+            if sup is not None:
+                wp = sup.stats()
+                worker_reissues += wp["lease_reissues"]
+                worker_respawns += wp["respawns"]
+        kill_fires = fault_stats.get(
+            "workerproc.kill", {}).get("fires", 0)
+        if kill_fires and worker_respawns == 0:
+            violations.append(
+                f"workerproc.kill fired {kill_fires}x but no worker "
+                f"process was respawned")
+        if kill_fires and worker_reissues == 0:
+            violations.append(
+                f"workerproc.kill fired {kill_fires}x but no leased "
+                f"eval was re-enqueued")
+
         # ---- convergence invariants -------------------------------------
         leader = wait_for_leader(servers, timeout=10.0)
         # replicas caught up (raft converged) before per-replica checks
@@ -2053,6 +2246,9 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
             "stream_events": mon["events"],
             "stream_lost_markers": mon["lost_markers"],
             "stream_missed_alloc_events": len(missing),
+            "worker_procs": spec.get("scheduler_workers", 0),
+            "worker_lease_reissues": worker_reissues,
+            "worker_respawns": worker_respawns,
             "plan_rejections": plan_rejections.snapshot()["rejections"],
             "timeline": _capture_timeline(
                 f"chaos:{schedule}", obs_start, fire_window,

@@ -129,6 +129,11 @@ class AgentConfig:
     # (group-fsync at ack boundaries; the default)
     data_dir: str = ""
     raft_fsync_policy: str = "batch"
+    # multi-process scheduler workers (server/workerproc.py, ISSUE 17):
+    # N worker processes running feasibility/reconcile/plan-build over
+    # MVCC snapshot frames; 0 = in-process threads (the default, and
+    # bit-identical to pre-17 behavior)
+    scheduler_workers: int = 0
 
     @classmethod
     def dev(cls, **overrides) -> "AgentConfig":
@@ -184,6 +189,7 @@ class Agent:
             coalesce_window_max_ms=self.config.coalesce_window_max_ms,
             data_dir=self.config.data_dir,
             raft_fsync_policy=self.config.raft_fsync_policy,
+            scheduler_workers=self.config.scheduler_workers,
         )
         self.server = Server(cfg)
         self.raft_transport = None

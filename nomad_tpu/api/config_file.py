@@ -11,8 +11,9 @@ This module parses the same shape of file into AgentConfig:
     bind_addr  = "0.0.0.0"
     ports { http = 4646 }
     server {
-      enabled          = true
-      num_schedulers   = 2
+      enabled           = true
+      num_schedulers    = 2
+      scheduler_workers = 0   # N>0: multi-process scheduler workers
     }
     client {
       enabled    = true
@@ -111,6 +112,10 @@ def _apply_body(cfg, body: Body):
             cfg.server_enabled = bool(sa["enabled"])
         if "num_schedulers" in sa:
             cfg.num_schedulers = int(sa["num_schedulers"])
+        # multi-process scheduler workers (server/workerproc.py):
+        # 0 = in-process threads, the bit-identical default
+        if "scheduler_workers" in sa:
+            cfg.scheduler_workers = int(sa["scheduler_workers"])
         if "raft_port" in sa:
             cfg.raft_port = int(sa["raft_port"])
         if "raft_peers" in sa:

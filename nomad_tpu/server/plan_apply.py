@@ -680,10 +680,17 @@ class Planner:
         pool_workers: int = 4,
         raft_apply=None,
         on_node_rejection_threshold=None,
+        validate_token=None,
     ) -> None:
         self.state = state_store
         self.queue = plan_queue
         self.pool_workers = pool_workers
+        # plan_endpoint.go token check, re-run at DEQUEUE time: a plan
+        # can sit in the queue across a lease re-enqueue (dead worker
+        # recovery, auto-nack deadline) — committing it then would
+        # race the redelivered eval into duplicate placements. The
+        # callable returns an error string for a stale plan, else None.
+        self._validate_token = validate_token
         # plan rejection tracker (server/plan_rejection.py): fired with
         # a node id when its in-window rejection count crosses the
         # threshold; the server marks it ineligible through raft
@@ -778,6 +785,11 @@ class Planner:
                 # the lean steady burst)
                 checker = _GroupFitChecker(self.state, overlay)
                 for pending in batch:
+                    if self._validate_token is not None:
+                        stale = self._validate_token(pending.plan)
+                        if stale:
+                            pending.respond(None, ValueError(stale))
+                            continue
                     try:
                         result = self.evaluate_plan_group(
                             checker, snapshot, pending.plan)

@@ -75,6 +75,7 @@ class ServerConfig:
         plan_rejection_window_s: float = 300.0,
         data_dir: str = "",
         raft_fsync_policy: str = "batch",
+        scheduler_workers: int = 0,
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -147,6 +148,13 @@ class ServerConfig:
         # fsync per wave.
         self.data_dir = data_dir
         self.raft_fsync_policy = raft_fsync_policy
+        # multi-process scheduler workers (ISSUE 17): N worker
+        # PROCESSES run the GIL-heavy scheduling host side against
+        # (gen, delta)-fed MVCC replicas, leased eval batches by the
+        # leader (server/workerproc.py); the consensus process keeps
+        # the device mesh, plan apply, raft, and serving plane. 0 =
+        # everything in-process, today's behavior, bit-identical.
+        self.scheduler_workers = scheduler_workers
 
 
 class ClientUpdateStats:
@@ -297,14 +305,27 @@ class Server:
             self.state, self.plan_queue, self.config.plan_pool_workers,
             raft_apply=self.raft_apply,
             on_node_rejection_threshold=self._mark_node_plan_rejected,
+            validate_token=self._validate_plan_token,
         )
         self.heartbeats = HeartbeatTimers(
             self._on_heartbeat_expire, ttl=self.config.heartbeat_ttl
         )
+        # with worker processes enabled, the in-process workers shrink
+        # to the core (GC) queue — its schedulers mutate owner-only
+        # state; every other eval type is leased out by the supervisor
+        in_proc_schedulers = None
+        if self.config.scheduler_workers > 0:
+            in_proc_schedulers = [consts.JOB_TYPE_CORE]
         self.workers: List[Worker] = [
-            Worker(self, i, batch_size=self.config.worker_batch_size)
+            Worker(self, i, schedulers=in_proc_schedulers,
+                   batch_size=self.config.worker_batch_size)
             for i in range(self.config.num_workers)
         ]
+        self.worker_supervisor = None
+        if self.config.scheduler_workers > 0:
+            from nomad_tpu.server.workerproc import WorkerProcSupervisor
+
+            self.worker_supervisor = WorkerProcSupervisor(self)
         # leader-only lifecycle subsystems (leader.go establishLeadership
         # enables: periodic dispatcher, deployment watcher, drainer)
         from nomad_tpu.server.deployment_watcher import DeploymentsWatcher
@@ -728,6 +749,8 @@ class Server:
             self._init_heartbeats()
             for w in self.workers:
                 w.set_pause(False)
+            if self.worker_supervisor is not None:
+                self.worker_supervisor.start()
             self.periodic_dispatcher.set_enabled(True)
             self.periodic_dispatcher.restore(self.state.snapshot())
             self.deployments_watcher.set_enabled(True)
@@ -771,6 +794,10 @@ class Server:
                 if not self._leader and self.raft is not None:
                     return
             self._leader = False
+            # stop leasing BEFORE the broker flushes: a lease issued
+            # against a flushed broker would strand its tokens
+            if self.worker_supervisor is not None:
+                self.worker_supervisor.stop()
             self.eval_broker.set_enabled(False)
             self.blocked_evals.set_enabled(False)
             self.plan_queue.set_enabled(False)
@@ -1510,11 +1537,29 @@ class Server:
 
     # --- Plan endpoint (nomad/plan_endpoint.go) -------------------------
 
+    def _validate_plan_token(self, plan: Plan) -> Optional[str]:
+        """plan_endpoint.go Submit: a plan is valid only while its
+        worker still HOLDS the eval lease. A plan landing after the
+        broker re-enqueued the eval (worker-process death, auto-nack
+        deadline) would commit placements a redelivered twin is about
+        to make again from a pre-commit snapshot — duplicate live
+        slots. Token-less plans (tests, core GC) skip the check."""
+        if not plan.eval_token:
+            return None
+        held = self.eval_broker.outstanding(plan.eval_id)
+        if held != plan.eval_token:
+            return (f"plan for evaluation {plan.eval_id} rejected: "
+                    f"stale eval token (lease re-enqueued)")
+        return None
+
     def submit_plan(self, plan: Plan) -> PlanResult:
         import time as _time
 
         from nomad_tpu.telemetry.trace import tracer
 
+        err = self._validate_plan_token(plan)
+        if err:
+            raise ValueError(err)
         # safety net for planners that didn't drain the deferred
         # post-processing in their own (overlapped) window; idempotent
         plan.run_deferred()
@@ -1956,6 +2001,10 @@ class Server:
                 _stack.STATS["assign_retry_launches"],
             "heartbeats": self.heartbeats.count(),
             "workers": len(self.workers),
+            # multi-process scheduler workers (ISSUE 17): lease ledger
+            # + liveness of the worker-process fleet, when enabled
+            "worker_procs": self.worker_supervisor.stats()
+            if self.worker_supervisor is not None else None,
             "state_index": self.state.latest_index(),
         }
 

@@ -1,0 +1,759 @@
+"""Multi-process scheduler workers over MVCC snapshot generations.
+
+PAPER.md layer 4 at process granularity (ISSUE 17): the consensus
+process keeps exclusive ownership of the device mesh, wave launcher,
+plan apply/group-commit, raft, and the serving plane; N worker
+PROCESSES run the GIL-heavy host side of scheduling — dequeue →
+snapshot → feasibility → reconcile → assembly → plan-build — each
+against its own replica of the MVCC store, and submit built plans back
+over IPC. Reference shape: Nomad's many ``worker.go`` loops against one
+go-memdb store, here spread over interpreters so scheduler Python stops
+sharing the consensus process's GIL.
+
+Topology (one supervisor in the consensus process):
+
+    consensus process                     worker process k
+    -----------------                     ----------------
+    EvalBroker --dequeue_batch--> WorkerProcSupervisor
+         (lease: evals+tokens+stamps) --> _ProxyBroker --> Worker
+         (state: bootstrap/(gen,delta)) -> apply_frame -> replica store
+    Planner/raft <------- rpc: submit_plan/update_eval <-- _EvalRun
+    EvalBroker  <------- ack/nack (+span rows) ---------- _ProxyBroker
+
+Protocol invariants:
+
+- The broker's ``dequeue_batch`` fill window (PR 10) is the shard
+  point: the supervisor dequeues whole batches and LEASES each to one
+  worker, so the wave-batching shape survives the process split. The
+  broker's unacked tracking is the lease ledger — on worker death the
+  supervisor re-enqueues everything that worker still held via
+  ``enqueue_all`` (ack-if-held then enqueue, the broker's own recovery
+  primitive) and respawns the process.
+- State ships as ONE bootstrap frame at attach, then ``(gen, delta)``
+  frames (state/store.delta_frame — identity-pruned pmap diffs, the
+  WAL's CRC framing underneath via utils/ipc). The owner pins each
+  shipped generation with a liveness-bounded lease
+  (state/store.lease_generation) renewed on worker heartbeats, so the
+  weak registry cannot free a root a remote reader still addresses.
+- Frames and RPC replies share one FIFO pipe and the owner sends the
+  state frame BEFORE the rpc result that references it, so a worker's
+  ``snapshot_min_index(refresh_index)`` finds its replica already
+  caught up (same-pipe ordering, no cross-process index wait).
+- Worker span rows ship back with heartbeats and acks; the owner
+  ingests them into its tracer (trace ids are eval ids on both sides),
+  so per-worker stages still land in ONE e2e waterfall. The e2e
+  histogram sample itself is recorded owner-side at ack receipt —
+  broker enqueue stamp to ack, same origin as in-process workers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.state.store import (
+    StateStore,
+    apply_frame,
+    bootstrap_frame,
+    delta_frame,
+    release_generation_lease,
+    release_owner_leases,
+    renew_owner_leases,
+    expire_generation_leases,
+)
+from nomad_tpu.structs import consts
+from nomad_tpu.structs.eval_plan import Evaluation
+from nomad_tpu.telemetry.histogram import histograms
+from nomad_tpu.telemetry.trace import flight_recorder, tracer
+from nomad_tpu.utils.faultpoints import FaultError, fault
+from nomad_tpu.utils.ipc import (
+    Channel,
+    FrameError,
+    channel_from_fd,
+    socket_pair,
+)
+
+LOG = logging.getLogger(__name__)
+
+#: queues leased out to worker processes; the core (GC) scheduler runs
+#: its store-mutating callbacks in the owner and stays in-process
+WORKER_SCHEDULERS = [
+    consts.JOB_TYPE_SERVICE,
+    consts.JOB_TYPE_BATCH,
+    consts.JOB_TYPE_SYSTEM,
+    consts.JOB_TYPE_SYSBATCH,
+]
+
+#: per-worker span-id offset: child span ids start at (id+1) * 1e12 so
+#: they never collide with the owner's counter in the merged waterfall
+_SPAN_ID_STRIDE = 10 ** 12
+
+#: worker-side heartbeat cadence (liveness + lease renewal + span flush)
+_HB_INTERVAL_S = 0.2
+
+#: owner-side ping cadence feeding the worker_ipc round-trip histogram
+_PING_INTERVAL_S = 0.5
+
+
+# ---------------------------------------------------------------------------
+# worker-process side
+# ---------------------------------------------------------------------------
+
+
+class _ProxyBroker:
+    """The worker process's stand-in for the owner's EvalBroker.
+
+    ``dequeue_batch`` hands out leased evals; acks/nacks/heartbeat
+    resets become messages. Enqueue stamps ship with the lease (Linux
+    monotonic clocks are system-wide, so owner stamps compare against
+    worker clocks), keeping the worker's local latency view honest.
+    """
+
+    def __init__(self, chan: Channel, nack_timeout: float) -> None:
+        self.chan = chan
+        self.nack_timeout = nack_timeout
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[Tuple[Evaluation, str]] = []
+        self._stamps: Dict[str, float] = {}
+
+    def feed(self, evals: List[Tuple[Evaluation, str]],
+             stamps: Dict[str, float]) -> None:
+        with self._lock:
+            self._queue.extend(evals)
+            self._stamps.update(stamps)
+            self._cond.notify_all()
+
+    def dequeue_batch(self, schedulers: List[str], batch: int,
+                      timeout: Optional[float] = None,
+                      ) -> List[Tuple[Evaluation, str]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while not self._queue:
+                wait = None if deadline is None \
+                    else deadline - time.monotonic()
+                if wait is not None and wait <= 0:
+                    return []
+                self._cond.wait(wait)
+            out, self._queue = self._queue[:batch], self._queue[batch:]
+            return out
+
+    def ack(self, eval_id: str, token: str) -> None:
+        self.chan.send({"t": "ack", "eval_id": eval_id, "token": token,
+                        "spans": tracer.drain_rows()
+                        if tracer.enabled else None})
+        with self._lock:
+            self._stamps.pop(eval_id, None)
+
+    def nack(self, eval_id: str, token: str) -> None:
+        self.chan.send({"t": "nack", "eval_id": eval_id, "token": token})
+        with self._lock:
+            self._stamps.pop(eval_id, None)
+
+    def enqueue_stamp(self, eval_id: str) -> float:
+        with self._lock:
+            return self._stamps.get(eval_id, 0.0)
+
+    def outstanding_reset(self, eval_id: str, token: str) -> None:
+        # the owner applies the reset against the real broker AND
+        # treats it as a liveness signal (lease renewal)
+        self.chan.send({"t": "hb", "resets": [(eval_id, token)]})
+
+
+class _OwnerProxy:
+    """The worker process's stand-in for the Server: the exact surface
+    ``Worker``/``_EvalRun`` touch, backed by the replica store for
+    reads and request/reply RPCs for every state mutation."""
+
+    def __init__(self, chan: Channel, replica: StateStore, broker:
+                 _ProxyBroker, config) -> None:
+        self.chan = chan
+        self.state = replica
+        self.eval_broker = broker
+        self.config = config
+        # device ownership stays with the consensus process: no mesh,
+        # so worker feasibility/plan kernels run host/CPU-local
+        self.wave_mesh = None
+        self._rpc_lock = threading.Lock()
+        self._rpc_seq = itertools.count(1)
+        self._rpc_pending: Dict[int, List] = {}
+        self._index_cond = threading.Condition()
+
+    # -- replica upkeep (reader loop) -----------------------------------
+
+    def note_state_advanced(self) -> None:
+        with self._index_cond:
+            self._index_cond.notify_all()
+
+    def resolve_rpc(self, msg: Dict) -> None:
+        with self._rpc_lock:
+            entry = self._rpc_pending.pop(msg["rid"], None)
+        if entry is None:
+            return
+        entry[1] = msg
+        entry[0].set()
+
+    # -- Server surface --------------------------------------------------
+
+    def _rpc(self, payload: Dict):
+        rid = next(self._rpc_seq)
+        done = threading.Event()
+        entry = [done, None]
+        with self._rpc_lock:
+            self._rpc_pending[rid] = entry
+        payload["t"] = "rpc"
+        payload["rid"] = rid
+        self.chan.send(payload)
+        if not done.wait(60.0):
+            with self._rpc_lock:
+                self._rpc_pending.pop(rid, None)
+            raise TimeoutError(f"worker rpc {payload['m']} timed out")
+        msg = entry[1]
+        if not msg["ok"]:
+            raise RuntimeError(msg["error"])
+        return msg.get("value")
+
+    def submit_plan(self, plan):
+        # deferred thunks already ran worker-side (_EvalRun calls
+        # run_deferred before submit); what crosses the pipe is data
+        return self._rpc({"m": "submit_plan", "plan": plan})
+
+    def update_eval(self, ev: Evaluation, token: str = "") -> None:
+        self._rpc({"m": "update_eval", "eval": ev, "token": token})
+
+    def create_eval(self, ev: Evaluation, token: str = "") -> None:
+        self._rpc({"m": "create_eval", "eval": ev, "token": token})
+
+    def reblock_eval(self, ev: Evaluation, token: str = "") -> None:
+        self._rpc({"m": "reblock_eval", "eval": ev, "token": token})
+
+    def snapshot_min_index(self, index: int, timeout: float = 5.0):
+        """Replica-local SnapshotMinIndex: the owner pushes a state
+        frame down the same FIFO pipe before any reply that references
+        its index, so this normally returns immediately; the bounded
+        wait covers reordering bugs loudly rather than scheduling
+        against stale state."""
+        deadline = time.monotonic() + timeout
+        with self._index_cond:
+            while self.state.latest_index() < index:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    raise TimeoutError(
+                        f"replica index {self.state.latest_index()} "
+                        f"< {index}")
+                self._index_cond.wait(min(wait, 0.05))
+        return self.state.snapshot()
+
+    def new_core_scheduler(self, snapshot, planner):
+        raise RuntimeError("core evals are owner-only; a worker "
+                           "process must never receive one")
+
+
+def _child_main() -> None:
+    """``python -c`` entry of a worker process: reconstruct the channel
+    from the inherited socketpair fd, receive the hello (config +
+    scheduler list — config objects ride the framed channel, never
+    argv), run the worker loop until stop/EOF."""
+    worker_id, fd = int(sys.argv[1]), int(sys.argv[2])
+    chan = channel_from_fd(fd)
+    hello = chan.recv()
+    worker_main(worker_id, chan, hello["config"], hello["schedulers"])
+
+
+def worker_main(worker_id: int, chan: Channel, config,
+                schedulers: List[str]) -> None:
+    """Body of one scheduler worker process.
+
+    Builds a replica StateStore fed by transport frames, a proxy
+    broker/server pair, and a REAL ``Worker`` on top — the scheduling
+    loop, wave batching, heartbeats, and eval pool are the in-process
+    code paths, unchanged. The main thread is the channel reader.
+    """
+    from nomad_tpu.telemetry import trace as trace_mod
+    from nomad_tpu.server.worker import Worker
+
+    # span ids from this process never collide with the owner's
+    trace_mod._ids = itertools.count((worker_id + 1) * _SPAN_ID_STRIDE)
+
+    replica = StateStore()
+    broker = _ProxyBroker(chan, config.nack_timeout)
+    proxy = _OwnerProxy(chan, replica, broker, config)
+    worker = Worker(proxy, worker_id, schedulers=list(schedulers),
+                    batch_size=config.worker_batch_size)
+    worker.start()
+
+    stop = threading.Event()
+
+    def heartbeat() -> None:
+        # liveness + lease renewal + span flush, even when idle
+        while not stop.wait(_HB_INTERVAL_S):
+            try:
+                rows = tracer.drain_rows() if tracer.enabled else None
+                chan.send({"t": "hb", "resets": [], "spans": rows})
+            except (OSError, EOFError):
+                return
+
+    threading.Thread(target=heartbeat, daemon=True,
+                     name=f"workerproc-{worker_id}-hb").start()
+
+    try:
+        while True:
+            try:
+                msg = chan.recv()
+            except (EOFError, OSError):
+                break           # owner is gone; daemon process exits
+            except FrameError as e:
+                LOG.warning("worker %d: dropped frame: %s", worker_id, e)
+                continue
+            t = msg["t"]
+            if t == "state":
+                apply_frame(replica, msg["frame"])
+                proxy.note_state_advanced()
+            elif t == "lease":
+                if msg["trace"] and not tracer.enabled:
+                    tracer.enable()
+                elif not msg["trace"] and tracer.enabled:
+                    tracer.disable()
+                broker.feed(msg["evals"], msg["stamps"])
+            elif t == "rpc_result":
+                proxy.resolve_rpc(msg)
+            elif t == "ping":
+                chan.send({"t": "pong", "ts": msg["ts"]})
+            elif t == "stop":
+                break
+    finally:
+        stop.set()
+        worker.stop()
+        chan.close()
+
+
+# ---------------------------------------------------------------------------
+# consensus-process side
+# ---------------------------------------------------------------------------
+
+
+class _WorkerHandle:
+    """Owner-side record of one worker process: its channel, lease
+    ledger, and the generation its replica is synced to."""
+
+    def __init__(self, supervisor: "WorkerProcSupervisor",
+                 worker_id: int) -> None:
+        self.sup = supervisor
+        self.server = supervisor.server
+        self.worker_id = worker_id
+        #: generation-lease owner key (state/store lease registry)
+        self.owner_key = f"workerproc-{id(supervisor):x}-{worker_id}"
+        #: eval_id -> (eval, token) this worker currently holds
+        self.outstanding: Dict[str, Tuple[Evaluation, str]] = {}
+        self.out_lock = threading.Lock()
+        #: serializes frame generation order per worker
+        self.state_lock = threading.Lock()
+        self.synced_gen: Optional[int] = None
+        self.acked = 0
+        self.last_hb = time.monotonic()
+        self.last_ping = 0.0
+        self.recovered = False
+        self.proc = None
+        self.chan: Optional[Channel] = None
+        self._reader: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def spawn(self) -> None:
+        """Spawn a FRESH interpreter (subprocess, not fork: forking
+        would clone the owner's JAX runtime, locks, and mesh handles)
+        and hand it one socketpair end by fd. Config crosses as the
+        hello message over the framed channel, never argv."""
+        ours, theirs = socket_pair()
+        self.chan = Channel(ours)
+        env = dict(os.environ)
+        # device ownership stays with the consensus process: worker
+        # processes run the host side of scheduling on CPU, always
+        env["JAX_PLATFORMS"] = "cpu"
+        # the child resolves nomad_tpu exactly as this process does
+        # (test runs are often cwd-rooted, not installed)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in sys.path if p) or env.get("PYTHONPATH", "")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "from nomad_tpu.server.workerproc import _child_main; "
+             "_child_main()",
+             str(self.worker_id), str(theirs.fileno())],
+            pass_fds=(theirs.fileno(),),
+            env=env,
+            close_fds=True,
+        )
+        # the child holds its end now; closing ours-side copy makes the
+        # child's recv raise EOF if this process dies
+        theirs.close()
+        self.chan.send({"t": "hello", "config": self.server.config,
+                        "schedulers": WORKER_SCHEDULERS})
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"workerproc-{self.worker_id}-reader")
+        self._reader.start()
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def _join(self, timeout: float) -> None:
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def close(self, stop_msg: bool = False) -> None:
+        if self.chan is not None and stop_msg:
+            try:
+                self.chan.send({"t": "stop"})
+            except (OSError, EOFError):
+                pass
+        if self.proc is not None:
+            self._join(2.0 if stop_msg else 0.2)
+            if self.proc.poll() is None:
+                self.proc.terminate()
+                self._join(1.0)
+            if self.proc.poll() is None:
+                self.proc.kill()
+                self._join(1.0)
+        if self.chan is not None:
+            self.chan.close()
+            self.chan = None
+        release_owner_leases(self.owner_key)
+        self.synced_gen = None
+
+    # -- leasing ---------------------------------------------------------
+
+    def lease(self, batch: List[Tuple[Evaluation, str]]) -> None:
+        broker = self.server.eval_broker
+        with self.out_lock:
+            for ev, token in batch:
+                self.outstanding[ev.id] = (ev, token)
+        stamps = {ev.id: broker.enqueue_stamp(ev.id) for ev, _ in batch}
+        self.sync_state()
+        self.chan.send({"t": "lease", "evals": batch, "stamps": stamps,
+                        "trace": tracer.enabled})
+        # chaos seam (ISSUE 17 satellite 1): REAL process death mid-
+        # lease — the worker holds the evals, its replica is synced,
+        # and SIGKILL gives it no chance to ack, nack, or clean up.
+        # Recovery must come entirely from the supervisor's liveness
+        # monitor re-enqueueing the lease ledger.
+        try:
+            fault("workerproc.kill")
+        except FaultError:
+            LOG.warning("chaos: SIGKILL worker process %d mid-lease",
+                        self.worker_id)
+            os.kill(self.proc.pid, signal.SIGKILL)
+
+    def sync_state(self) -> None:
+        """Bring the worker's replica to the owner's current root:
+        one (gen, delta) frame — bootstrap only at attach or if the
+        base generation's root was lost (lease expiry after a long
+        wedge). Holds state_lock through the send so frames always
+        arrive in generation order."""
+        with self.state_lock:
+            store = self.server.state
+            if store.current_generation() == self.synced_gen:
+                return
+            frame = None
+            if self.synced_gen is not None:
+                frame = delta_frame(store, self.synced_gen,
+                                    pin_owner=self.owner_key)
+            if frame is None:
+                if store.current_generation() == self.synced_gen:
+                    return      # writer raced us back to synced
+                frame = bootstrap_frame(store, pin_owner=self.owner_key)
+            self.chan.send({"t": "state", "frame": frame})
+            prev, self.synced_gen = self.synced_gen, frame["generation"]
+            if prev is not None and prev != self.synced_gen:
+                release_generation_lease(prev, self.owner_key)
+
+    # -- message handling ------------------------------------------------
+
+    def _read_loop(self) -> None:
+        chan = self.chan
+        while True:
+            try:
+                msg = chan.recv()
+            except (EOFError, OSError):
+                return
+            except FrameError as e:
+                LOG.warning("workerproc %d: dropped frame: %s",
+                            self.worker_id, e)
+                continue
+            try:
+                t = msg["t"]
+                if t == "ack":
+                    self._on_ack(msg)
+                elif t == "nack":
+                    self._on_nack(msg)
+                elif t == "hb":
+                    self._on_hb(msg)
+                elif t == "pong":
+                    histograms.get("worker_ipc").record(
+                        time.monotonic() - msg["ts"])
+                elif t == "rpc":
+                    # NEVER inline: submit_plan blocks on the applier
+                    # (up to 30s) and the reader must keep draining
+                    self.sup.rpc_pool.submit(self._on_rpc, msg)
+            except Exception:                   # noqa: BLE001
+                LOG.warning("workerproc %d: message %s failed",
+                            self.worker_id, msg.get("t"), exc_info=True)
+
+    def _on_ack(self, msg: Dict) -> None:
+        eid, token = msg["eval_id"], msg["token"]
+        broker = self.server.eval_broker
+        # e2e origin read BEFORE the ack drops the stamp — the same
+        # discipline as the in-process worker
+        t_enq = broker.enqueue_stamp(eid)
+        try:
+            broker.ack(eid, token)
+        except Exception as e:                  # noqa: BLE001
+            # in-process parity: a failed ack (chaos seam, or a lease
+            # already recovered after a presumed-dead worker revived)
+            # converges through nack/auto-nack redelivery
+            LOG.warning("workerproc %d: ack %s failed: %s",
+                        self.worker_id, eid, e)
+            try:
+                broker.nack(eid, token)
+            except Exception:                   # noqa: BLE001
+                pass
+            with self.out_lock:
+                self.outstanding.pop(eid, None)
+            return
+        if msg.get("spans") and tracer.enabled:
+            tracer.ingest(msg["spans"])
+        if t_enq:
+            e2e_s = time.monotonic() - t_enq
+            histograms.get("e2e").record(e2e_s)
+            if tracer.enabled:
+                tracer.record("eval.e2e", e2e_s, trace_id=eid)
+                flight_recorder.observe(eid, e2e_s)
+        with self.out_lock:
+            self.outstanding.pop(eid, None)
+            self.acked += 1
+
+    def _on_nack(self, msg: Dict) -> None:
+        try:
+            self.server.eval_broker.nack(msg["eval_id"], msg["token"])
+        except Exception:                       # noqa: BLE001
+            pass
+        with self.out_lock:
+            self.outstanding.pop(msg["eval_id"], None)
+
+    def _on_hb(self, msg: Dict) -> None:
+        self.last_hb = time.monotonic()
+        broker = self.server.eval_broker
+        for eid, token in msg["resets"]:
+            try:
+                broker.outstanding_reset(eid, token)
+            except Exception:                   # noqa: BLE001
+                pass
+        renew_owner_leases(self.owner_key)
+        if msg.get("spans") and tracer.enabled:
+            tracer.ingest(msg["spans"])
+
+    def _on_rpc(self, msg: Dict) -> None:
+        rid, method = msg["rid"], msg["m"]
+        value, ok, err = None, True, ""
+        try:
+            server = self.server
+            if method == "submit_plan":
+                value = server.submit_plan(msg["plan"])
+            elif method == "update_eval":
+                server.update_eval(msg["eval"], token=msg["token"])
+            elif method == "create_eval":
+                server.create_eval(msg["eval"], token=msg["token"])
+            elif method == "reblock_eval":
+                server.reblock_eval(msg["eval"], token=msg["token"])
+            else:
+                raise ValueError(f"unknown worker rpc {method!r}")
+            # push the post-commit state BEFORE the reply: the frame
+            # rides the same FIFO pipe, so the worker's
+            # snapshot_min_index(refresh_index) finds its replica
+            # already at (or past) the index the reply references
+            self.sync_state()
+        except Exception as e:                  # noqa: BLE001
+            ok, err = False, f"{type(e).__name__}: {e}"
+        try:
+            self.chan.send({"t": "rpc_result", "rid": rid, "ok": ok,
+                            "value": value, "error": err})
+        except (OSError, EOFError):
+            pass
+
+
+class WorkerProcSupervisor:
+    """Leader-side device-owner service: leases eval batches to worker
+    processes, tracks their liveness, recovers leases on death.
+
+    Started on establish_leadership when ``scheduler_workers > 0``,
+    stopped on revoke. The in-process Workers shrink to the core (GC)
+    queue; everything else flows through here.
+    """
+
+    def __init__(self, server) -> None:
+        self.server = server
+        self.n_workers = server.config.scheduler_workers
+        self.handles: List[_WorkerHandle] = []
+        self.lease_reissues = 0
+        self.respawns = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._rr = 0
+        # RPC execution pool, shared across workers: submit_plan can
+        # block on the serialized applier; reader threads never do.
+        # Reuses the worker eval pool (daemon, kill-respawn semantics)
+        from nomad_tpu.server.worker import _EvalPool
+
+        self.rpc_pool = _EvalPool(4 * max(self.n_workers, 1),
+                                  "workerproc-rpc")
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._threads:
+                return
+            self._stop.clear()
+            self.handles = [_WorkerHandle(self, i)
+                            for i in range(self.n_workers)]
+            for h in self.handles:
+                h.spawn()
+            self._threads = [
+                threading.Thread(target=self._dispatch_loop, daemon=True,
+                                 name="workerproc-dispatch"),
+                threading.Thread(target=self._monitor_loop, daemon=True,
+                                 name="workerproc-monitor"),
+            ]
+            for t in self._threads:
+                t.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._threads and not self.handles:
+                return
+            self._stop.set()
+            threads, self._threads = self._threads, []
+            handles, self.handles = self.handles, []
+        for t in threads:
+            t.join(timeout=2.0)
+        for h in handles:
+            h.close(stop_msg=True)
+        self.rpc_pool.shutdown()
+
+    # -- loops -----------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        cfg = self.server.config
+        broker = self.server.eval_broker
+        while not self._stop.is_set():
+            batch = broker.dequeue_batch(
+                WORKER_SCHEDULERS, cfg.worker_batch_size, timeout=0.2)
+            if not batch:
+                continue
+            h = self._pick_worker()
+            if h is None:
+                # no live worker this instant (mass kill mid-respawn):
+                # hand the batch straight back; the monitor respawns
+                broker.enqueue_all(batch)
+                self._stop.wait(0.05)
+                continue
+            try:
+                h.lease(batch)
+            except (OSError, EOFError):
+                # died between liveness check and send: the lease
+                # ledger already has the batch; recovery re-enqueues
+                LOG.warning("workerproc %d: lease send failed",
+                            h.worker_id)
+
+    def _pick_worker(self) -> Optional[_WorkerHandle]:
+        with self._lock:
+            handles = list(self.handles)
+        if not handles:
+            return None
+        for i in range(len(handles)):
+            h = handles[(self._rr + i) % len(handles)]
+            if h.alive():
+                self._rr = (self._rr + i + 1) % len(handles)
+                return h
+        return None
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(0.05):
+            now = time.monotonic()
+            with self._lock:
+                handles = list(self.handles)
+            for h in handles:
+                if not h.alive():
+                    self._recover(h)
+                    continue
+                if now - h.last_ping >= _PING_INTERVAL_S:
+                    h.last_ping = now
+                    try:
+                        h.chan.send({"t": "ping", "ts": now})
+                    except (OSError, EOFError):
+                        pass
+            # TTL sweep: leases of wedged/defunct owners expire here
+            expire_generation_leases()
+
+    def _recover(self, h: _WorkerHandle) -> None:
+        """A worker died: re-enqueue every eval it still held (the
+        broker's ack-if-held-then-enqueue keeps tokens consistent),
+        drop its generation leases, respawn."""
+        if h.recovered:
+            return
+        h.recovered = True
+        with h.out_lock:
+            pending = list(h.outstanding.values())
+            h.outstanding.clear()
+        if pending:
+            try:
+                self.server.eval_broker.enqueue_all(pending)
+            except Exception:                   # noqa: BLE001
+                LOG.warning("workerproc %d: lease re-enqueue failed",
+                            h.worker_id, exc_info=True)
+        with self._lock:
+            self.lease_reissues += len(pending)
+            if self._stop.is_set():
+                h.close()
+                return
+            self.respawns += 1
+        LOG.warning("worker process %d died; re-enqueued %d leased "
+                    "evals, respawning", h.worker_id, len(pending))
+        h.close()
+        replacement = _WorkerHandle(self, h.worker_id)
+        replacement.spawn()
+        with self._lock:
+            try:
+                self.handles[self.handles.index(h)] = replacement
+            except ValueError:
+                replacement.close()
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            handles = list(self.handles)
+            reissues, respawns = self.lease_reissues, self.respawns
+        out_total = 0
+        acked = 0
+        for h in handles:
+            with h.out_lock:
+                out_total += len(h.outstanding)
+                acked += h.acked
+        return {
+            "workers": len(handles),
+            "alive": sum(1 for h in handles if h.alive()),
+            "acked": acked,
+            "outstanding": out_total,
+            "lease_reissues": reissues,
+            "respawns": respawns,
+        }

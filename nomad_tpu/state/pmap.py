@@ -174,6 +174,46 @@ def _iter_node(node) -> Iterator[Tuple[Any, Any]]:
             yield from _iter_node(child)
 
 
+def _diff_node(old, new, out: Dict) -> None:
+    """Fold the changes turning ``old`` into ``new`` into ``out`` as a
+    ``{key: new_value-or-TOMBSTONE}`` overlay, pruning ``is``-identical
+    subtrees without descending into them."""
+    if old is new:
+        return
+    if isinstance(old, tuple) and isinstance(new, tuple):
+        # both branches: recurse only into slots whose child changed
+        for a, b in zip(old, new):
+            if a is not b:
+                _diff_node(a, b, out)
+        return
+    # shape change (leaf grew into a branch, subtree emptied, ...):
+    # materialize both sides. Shape changes happen at leaf granularity,
+    # so the materialized set is small.
+    old_items = dict(_iter_node(old))
+    for k, v in _iter_node(new):
+        if old_items.pop(k, TOMBSTONE) is not v:
+            out[k] = v
+    for k in old_items:
+        out[k] = TOMBSTONE
+
+
+def pmap_diff(old: "PMap", new: "PMap") -> Dict:
+    """The ``{key: new_value-or-TOMBSTONE}`` overlay turning ``old``
+    into ``new`` — the wire shape of a cross-process snapshot delta
+    frame (state/store.delta_frame).
+
+    Structural sharing makes this O(changes): two maps of the same
+    lineage share every untouched subtree BY IDENTITY, so the walk
+    prunes on ``is`` and only descends path-copied spines. Values are
+    compared by identity too (the store replaces rows, never mutates
+    them); a re-set of an equal-but-distinct row therefore appears in
+    the diff — a harmless superset, still exact under ``update_with``.
+    """
+    out: Dict = {}
+    _diff_node(old._root, new._root, out)
+    return out
+
+
 class PMap:
     """Immutable hash map with O(log n) persistent updates.
 

@@ -45,7 +45,7 @@ import weakref
 from contextlib import contextmanager
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
-from nomad_tpu.state.pmap import EMPTY, PMap, TOMBSTONE
+from nomad_tpu.state.pmap import EMPTY, PMap, TOMBSTONE, pmap_diff
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.alloc import Allocation
 from nomad_tpu.structs.eval_plan import Deployment, Evaluation, Plan, PlanResult
@@ -165,12 +165,21 @@ class StoreStats:
         self.snapshots += 1
 
     def snapshot(self) -> Dict:
+        leased = leased_generation_count()
+        total = len(_ROOT_REGISTRY)
         return {
             "write_txns": self.write_txns,
             "snapshots": self.snapshots,
             "restores": self.restores,
             "last_generation": self.last_generation,
-            "live_roots": len(_ROOT_REGISTRY),
+            "live_roots": total,
+            # split (ISSUE 17): roots alive only because a worker
+            # process leased them vs roots some in-process reader
+            # still holds. A root can be both; the split attributes
+            # it to the lease (the lease is what would retain it if
+            # every in-process reader dropped).
+            "live_roots_leased": leased,
+            "live_roots_in_process": max(total - leased, 0),
         }
 
     def reset_stats(self) -> None:
@@ -206,6 +215,264 @@ def snapshot_at(generation: int) -> Optional["StateSnapshot"]:
     if root is None:
         return None
     return StateSnapshot(root)
+
+
+# --- cross-process generation leases (ISSUE 17) ------------------------
+#
+# The weak registry frees a root the moment no IN-PROCESS reader holds
+# it — but a worker process reading a snapshot it reconstructed from a
+# ``(gen, delta)`` frame holds nothing in the owner's process, so the
+# owner could release the very root the next delta must diff against.
+# A lease is an explicit STRONG pin, keyed by (owner, generation), with
+# a liveness-bounded TTL: the supervisor renews its workers' leases on
+# their heartbeats and releases them on advance or death; a wedged
+# supervisor's pins expire rather than retaining roots forever.
+
+#: default lease TTL — several heartbeat intervals of slack
+LEASE_TTL_S = 30.0
+
+_lease_lock = threading.Lock()
+#: (owner, generation) -> [root strong ref, expires_at (monotonic)]
+_GENERATION_LEASES: Dict[Tuple[str, int], List] = {}
+
+
+def _expire_leases_locked(now: float) -> int:
+    doomed = [k for k, (_root, exp) in _GENERATION_LEASES.items()
+              if exp <= now]
+    for k in doomed:
+        del _GENERATION_LEASES[k]
+    return len(doomed)
+
+
+def lease_generation(generation: int, owner: str,
+                     ttl_s: float = LEASE_TTL_S) -> bool:
+    """Pin ``generation``'s root for ``owner`` (a worker-process id);
+    False when the root is already gone. Renewing an existing lease
+    just extends its expiry."""
+    now = time.monotonic()
+    root = _ROOT_REGISTRY.get(generation)
+    with _lease_lock:
+        _expire_leases_locked(now)
+        if root is None:
+            return False
+        _GENERATION_LEASES[(owner, generation)] = [root, now + ttl_s]
+    return True
+
+
+def release_generation_lease(generation: int, owner: str) -> bool:
+    with _lease_lock:
+        return _GENERATION_LEASES.pop((owner, generation), None) is not None
+
+
+def release_owner_leases(owner: str) -> int:
+    """Drop every lease held by ``owner`` (worker death / shutdown)."""
+    with _lease_lock:
+        doomed = [k for k in _GENERATION_LEASES if k[0] == owner]
+        for k in doomed:
+            del _GENERATION_LEASES[k]
+    return len(doomed)
+
+
+def renew_owner_leases(owner: str, ttl_s: float = LEASE_TTL_S) -> int:
+    """Heartbeat-driven renewal: extend every lease ``owner`` holds."""
+    now = time.monotonic()
+    with _lease_lock:
+        _expire_leases_locked(now)
+        n = 0
+        for (o, _gen), row in _GENERATION_LEASES.items():
+            if o == owner:
+                row[1] = now + ttl_s
+                n += 1
+    return n
+
+
+def expire_generation_leases() -> int:
+    """Drop expired leases (the supervisor's liveness sweep calls this;
+    every lease call expires lazily too). Returns the drop count."""
+    with _lease_lock:
+        return _expire_leases_locked(time.monotonic())
+
+
+def leased_generation_count() -> int:
+    """Distinct generations currently pinned by a live lease."""
+    now = time.monotonic()
+    with _lease_lock:
+        _expire_leases_locked(now)
+        return len({gen for (_o, gen) in _GENERATION_LEASES})
+
+
+# --- snapshot transport frames (ISSUE 17) ------------------------------
+#
+# The wire shapes for feeding worker-process replicas: one ``bootstrap``
+# frame at attach (the only full-state ship), then ``(gen, delta)``
+# frames — per-table overlays computed by pmap_diff's identity-pruned
+# walk, O(changes) not O(store). Frames adopt the OWNER's generation
+# ids, so a worker-side snapshot names the same state the owner's
+# registry does, and the replica's usage planes are advanced by
+# replaying the same transitions the owner's write paths took — the
+# `usage_rebuild_diff` bit-identity invariant holds on both sides.
+
+
+def bootstrap_frame(store: "StateStore", pin_owner: Optional[str] = None,
+                    ttl_s: float = LEASE_TTL_S) -> Dict:
+    """Full-state frame off ONE root, lock-free (the to_snapshot_bytes
+    discipline). With ``pin_owner`` the target generation is leased
+    while the root is still strongly held here — no window where a
+    commit storm could release it before the pin lands."""
+    root = store._root
+    frame = {
+        "kind": "bootstrap",
+        "generation": root.generation,
+        "index": root.index,
+        "tables": {name: root.tables[name].to_dict()
+                   for name in _TABLE_NAMES},
+        "table_indexes": dict(root.table_indexes),
+        "scheduler_config": root.scheduler_config,
+        "autopilot_config": dict(root.autopilot_config),
+        "draining_nodes": root.draining_nodes,
+    }
+    if pin_owner is not None:
+        lease_generation(root.generation, pin_owner, ttl_s)
+    return frame
+
+
+def delta_frame(store: "StateStore", from_generation: int,
+                pin_owner: Optional[str] = None,
+                ttl_s: float = LEASE_TTL_S) -> Optional[Dict]:
+    """The ``(gen, delta)`` frame turning ``from_generation``'s root
+    into the store's current root; None when the base root is gone
+    (caller falls back to a bootstrap frame) or nothing changed.
+    Never re-pickles the whole store: per-table overlays come from
+    pmap_diff, and unchanged config/draining fields ship as None."""
+    new_root = store._root
+    if new_root.generation == from_generation:
+        return None
+    old_root = _ROOT_REGISTRY.get(from_generation)
+    if old_root is None:
+        return None
+    tables: Dict[str, Dict] = {}
+    for name in _TABLE_NAMES:
+        ot, nt = old_root.tables[name], new_root.tables[name]
+        if ot is nt:
+            continue
+        changes = pmap_diff(ot, nt)
+        if not changes:
+            continue
+        # TOMBSTONE is an unpicklable-by-identity sentinel: encode
+        # deletes as a key list instead
+        sets = {k: v for k, v in changes.items() if v is not TOMBSTONE}
+        dels = [k for k, v in changes.items() if v is TOMBSTONE]
+        tables[name] = {"set": sets, "del": dels}
+    frame = {
+        "kind": "delta",
+        "from_generation": from_generation,
+        "generation": new_root.generation,
+        "index": new_root.index,
+        "tables": tables,
+        "table_indexes": (dict(new_root.table_indexes)
+                          if new_root.table_indexes
+                          is not old_root.table_indexes else None),
+        "scheduler_config": (new_root.scheduler_config
+                             if new_root.scheduler_config
+                             is not old_root.scheduler_config else None),
+        "autopilot_config": (dict(new_root.autopilot_config)
+                             if new_root.autopilot_config
+                             is not old_root.autopilot_config else None),
+        "draining_nodes": (new_root.draining_nodes
+                           if new_root.draining_nodes
+                           is not old_root.draining_nodes else None),
+    }
+    if pin_owner is not None:
+        lease_generation(new_root.generation, pin_owner, ttl_s)
+    return frame
+
+
+def apply_frame(store: "StateStore", frame: Dict) -> None:
+    """Apply a transport frame to a REPLICA store (a worker process's
+    follower copy). Adopts the owner's generation id — the replica's
+    snapshot at gen G is the owner's state at gen G — and replays
+    node/alloc transitions through the replica's UsageIndex exactly as
+    the owner's write paths did, so ``usage_rebuild_diff`` stays empty
+    on the replica. Delta frames must apply in order: a frame whose
+    base is not the replica's current generation raises (the transport
+    serializes frames per connection, so this only fires on a protocol
+    bug). Replica roots are NOT registered in the process-wide
+    generation registry: the replica is a follower view, not a root
+    provider."""
+    kind = frame.get("kind")
+    if kind == "bootstrap":
+        tables = {name: PMap.from_dict(frame["tables"][name])
+                  for name in _TABLE_NAMES}
+        with store._write_lock:
+            store.usage.rebuild(frame["tables"]["nodes"].values(),
+                                frame["tables"]["allocs"].values())
+            root = StoreRoot(
+                generation=frame["generation"],
+                index=frame["index"],
+                tables=tables,
+                table_indexes=dict(frame["table_indexes"]),
+                usage=store.usage.planes_copy(),
+                scheduler_config=frame["scheduler_config"],
+                autopilot_config=dict(frame["autopilot_config"]),
+                draining_nodes=frame["draining_nodes"],
+            )
+            store._root = root
+        return
+    if kind != "delta":
+        raise ValueError(f"unknown frame kind {kind!r}")
+    with store._write_lock:
+        base = store._root
+        if frame["from_generation"] != base.generation:
+            raise ValueError(
+                f"out-of-order delta frame: base gen "
+                f"{frame['from_generation']} != replica gen "
+                f"{base.generation}")
+        tables = dict(base.tables)
+        allocs_before = base.tables["allocs"]
+        for name in _TABLE_NAMES:
+            chg = frame["tables"].get(name)
+            if chg is None:
+                continue
+            overlay = dict(chg["set"])
+            for k in chg["del"]:
+                overlay[k] = TOMBSTONE
+            if name == "nodes":
+                # same transitions the owner's node write paths took
+                # (delete before upsert: a recycled node id must land
+                # in a fresh row, not inherit the old one's planes)
+                for nid in chg["del"]:
+                    store.usage.drop_node(nid)
+                for nid in chg["set"]:
+                    store.usage.node_row(nid)
+                    store.usage.note_node_change(nid)
+            elif name == "allocs":
+                for aid in chg["del"]:
+                    old_a = allocs_before.get(aid)
+                    if old_a is not None:
+                        store.usage.alloc_changed(old_a, None)
+                for aid, new_a in chg["set"].items():
+                    store.usage.alloc_changed(
+                        allocs_before.get(aid), new_a)
+            tables[name] = tables[name].update_with(overlay)
+        root = StoreRoot(
+            generation=frame["generation"],
+            index=frame["index"],
+            tables=tables,
+            table_indexes=(dict(frame["table_indexes"])
+                           if frame["table_indexes"] is not None
+                           else base.table_indexes),
+            usage=store.usage.planes_copy(),
+            scheduler_config=(frame["scheduler_config"]
+                              if frame["scheduler_config"] is not None
+                              else base.scheduler_config),
+            autopilot_config=(dict(frame["autopilot_config"])
+                              if frame["autopilot_config"] is not None
+                              else base.autopilot_config),
+            draining_nodes=(frame["draining_nodes"]
+                            if frame["draining_nodes"] is not None
+                            else base.draining_nodes),
+        )
+        store._root = root
 
 
 #: every table in a root, in payload order. Index tables (allocs_by_*)

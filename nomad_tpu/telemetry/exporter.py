@@ -542,6 +542,15 @@ def prometheus_text(registry=None, event_broker=None) -> str:
         lines.append("# TYPE nomad_tpu_store_live_roots gauge")
         lines.append(
             f"nomad_tpu_store_live_roots {st['live_roots']}")
+        # retention split (ISSUE 17): roots held by in-process snapshot
+        # refs vs pinned by worker-process generation leases — a stuck
+        # lease shows up as `holder="leased"` climbing while
+        # `holder="in_process"` stays flat
+        for holder, key in (("in_process", "live_roots_in_process"),
+                            ("leased", "live_roots_leased")):
+            lines.append(
+                f'nomad_tpu_store_live_roots{{holder="{holder}"}} '
+                f'{st[key]}')
     except Exception:                           # noqa: BLE001
         pass                # store unavailable: skip series
     # heartbeat fan-in (server/server.py client_update_stats): raw

@@ -351,6 +351,33 @@ class Tracer:
             return _NOOP
         return _Attach(self, ctx)
 
+    # --- cross-process shipping (ISSUE 17) ------------------------------
+
+    def drain_rows(self) -> List[Tuple]:
+        """Pop every ring entry as a plain tuple row — the wire shape a
+        worker process ships its spans to the consensus process in
+        (server/workerproc.py). Aggregates stay: they are this
+        process's own stage_totals. Rows are positional Span fields, so
+        ``Span(*row)`` reconstructs on the other side."""
+        with self._lock:
+            rows = [(s.name, s.trace_id, s.span_id, s.parent_id,
+                     s.start_s, s.dur_s, s.child_s, s.cpu_s,
+                     s.child_cpu_s, s.thread) for s in self._ring]
+            self._ring.clear()
+        return rows
+
+    def ingest(self, rows: List[Tuple]) -> None:
+        """Adopt span rows recorded in ANOTHER process into this ring +
+        aggregates, so worker-process spans land in the same e2e
+        waterfall as the owner's (trace ids are eval ids on both sides;
+        worker span ids are offset per process, so they never collide
+        with local ones). Monotonic clocks are system-wide on Linux —
+        the shipped start stamps order correctly against local spans."""
+        if not self._enabled:
+            return
+        for row in rows:
+            self._append(Span(*row), 1)
+
     # --- introspection --------------------------------------------------
 
     def spans(self, name: Optional[str] = None,

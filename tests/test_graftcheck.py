@@ -30,6 +30,7 @@ from tools.graftcheck.rules_hygiene import (
     MutableDefaultRule,
     NonDaemonThreadRule,
 )
+from tools.graftcheck.rules_ipc import IpcBoundaryRule
 from tools.graftcheck.rules_jit import JitHygieneRule
 from tools.graftcheck.rules_locks import LockDisciplineRule
 from tools.graftcheck.rules_store import StoreAccessRule
@@ -383,6 +384,81 @@ class TestR4StoreAccess:
                "    snap = store.snapshot()\n"
                "    snap = {}\n"
                "    snap['k'] = 1\n")
+        assert self._run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# R6 IPC boundary
+
+
+class TestR6IpcBoundary:
+    IMPORT = "from nomad_tpu.utils.ipc import Channel\n"
+
+    def _run(self, src, rel="nomad_tpu/server/wp.py"):
+        return rules_of(run_rule(IpcBoundaryRule(), {rel: src}))
+
+    def test_lock_in_send_payload_flagged(self):
+        src = (self.IMPORT +
+               "class H:\n"
+               "    def f(self):\n"
+               "        self.chan.send({'t': 'x', 'l': self._lock})\n")
+        assert ("R6", "ipc-send:self._lock") in self._run(src)
+
+    def test_witness_and_tracer_handles_flagged(self):
+        src = (self.IMPORT +
+               "def f(chan, witness_lock, tracer):\n"
+               "    chan.send([witness_lock])\n"
+               "    chan.send({'h': tracer})\n")
+        out = self._run(src)
+        assert ("R6", "ipc-send:witness_lock") in out
+        assert ("R6", "ipc-send:tracer") in out
+
+    def test_device_and_process_objects_flagged(self):
+        src = (self.IMPORT +
+               "def f(chan, h):\n"
+               "    chan.send({'m': h.wave_mesh})\n"
+               "    chan.send((h.proc, 1))\n"
+               "    chan.send({'s': h.sock})\n")
+        out = self._run(src)
+        assert ("R6", "ipc-send:h.wave_mesh") in out
+        assert ("R6", "ipc-send:h.proc") in out
+        assert ("R6", "ipc-send:h.sock") in out
+
+    def test_constructed_denylisted_object_flagged(self):
+        src = (self.IMPORT +
+               "import threading\n"
+               "import jax.numpy as jnp\n"
+               "def f(chan):\n"
+               "    chan.send(threading.Lock())\n"
+               "    chan.send({'a': jnp.zeros(4)})\n")
+        out = self._run(src)
+        assert ("R6", "ipc-send:threading.Lock()") in out
+        assert ("R6", "ipc-send:jnp.zeros()") in out
+
+    def test_plain_data_and_serializer_shims_ok(self):
+        # the production message shapes: rows from drain_rows(), ids,
+        # stamps, conditional None — call results are presumed data
+        src = (self.IMPORT +
+               "def f(chan, tracer, eid, token, stamps, batch):\n"
+               "    chan.send({'t': 'lease', 'evals': batch,\n"
+               "               'stamps': stamps, 'trace': tracer.enabled})\n"
+               "    chan.send({'t': 'ack', 'eval_id': eid,\n"
+               "               'token': token,\n"
+               "               'spans': tracer.drain_rows()\n"
+               "               if tracer.enabled else None})\n")
+        assert self._run(src) == []
+
+    def test_non_channel_send_not_flagged(self):
+        # membership/transport sockets have their own send(); the rule
+        # only polices channel-ish receivers
+        src = (self.IMPORT +
+               "def f(sock, data, lock):\n"
+               "    sock.send(lock)\n")
+        assert self._run(src) == []
+
+    def test_file_without_ipc_import_not_scanned(self):
+        src = ("def f(chan, lock):\n"
+               "    chan.send(lock)\n")
         assert self._run(src) == []
 
 

@@ -259,6 +259,47 @@ class TestMeshCell:
         assert cell["no_full_gather_ok"], cell
 
 
+class TestWorkerCell:
+    def test_worker_cell_under_lock_witness(self):
+        """ISSUE 17: the multi-process worker cell's A/B burst under
+        the runtime lock witness — the owner-side supervisor (dispatch
+        loop, per-worker handles, lease ledger, state-sync lock) plus
+        the generation-lease registry run with order-checked locks and
+        the test fails on ANY executed acquisition-order inversion.
+        Reduced scale, one rep: the cell already runs two full server
+        topologies (in-process threads, then worker processes); the
+        witness coverage comes from the owner side — the child
+        processes have their own interpreters the witness cannot see.
+        Speedup is NOT asserted (this tier runs on whatever cores CI
+        gives it); parity, drained leases, and fault-free lease
+        accounting are."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench"))
+        import trace_report
+
+        cell = trace_report.run_worker_burst(
+            n_workers=2, n_nodes=60, n_jobs=16, allocs_per_job=3,
+            warmup_jobs=4, batch_size=8, deadline_s=120.0)
+        assert cell["parity_ok"], cell
+        assert cell["baseline"]["allocs_placed"] == \
+            cell["baseline"]["allocs_wanted"], cell
+        assert cell["multi"]["allocs_placed"] == \
+            cell["multi"]["allocs_wanted"], cell
+        # fault-free burst: no lease ever timed out or was reissued
+        assert cell["lease_reissues"] == 0, cell
+        assert cell["respawns"] == 0, cell
+        # the supervisor pinged its workers and measured round-trips
+        assert cell["ipc_rtts"] > 0
+        # steady-window gates (owner-side)
+        assert cell["jit_cache_misses"] == 0, cell
+        assert cell["plan_group_fallbacks"] == 0, cell
+        # both topologies torn down: no generation lease survives
+        assert cell["leases_leaked"] == 0, cell
+
+
 class TestChaosCell:
     def test_chaos_suite_under_lock_witness(self):
         """ISSUE 12: every standing chaos schedule (leader-kill-mid-
@@ -303,6 +344,14 @@ class TestChaosCell:
         assert suite["schedules"]["crash-and-drop"]["nodes_down"] == 3
         assert suite["schedules"]["plan-commit-raft-failure"][
             "faults"]["plan.commit.raft"]["fires"] >= 1
+        # ISSUE 17: the worker-kill schedule SIGKILLed real worker
+        # processes mid-lease and lease recovery ran (re-enqueue +
+        # respawn) — converged_ok above already proved every eval
+        # terminal and placement exact THROUGH the process deaths
+        wk = suite["schedules"]["worker-kill-mid-lease"]
+        assert wk["faults"]["workerproc.kill"]["fires"] >= 1, wk
+        assert wk["worker_lease_reissues"] >= 1, wk
+        assert wk["worker_respawns"] >= 1, wk
         # ISSUE 15: the leader-kill schedule produced a failover and
         # >= 0.90 of the suite's failover wall is phase-attributed
         tl = suite["timeline"]

@@ -1,0 +1,408 @@
+"""Multi-process scheduler workers (ISSUE 17).
+
+Tier-1 coverage for the process split: the framed IPC channel (WAL
+CRC framing over a socketpair), cross-process generation leases (the
+explicit strong pin the weak root registry needs once a reader lives
+in another process), the snapshot transport property — a replica
+reconstructed from one bootstrap frame plus ``(gen, delta)`` frames is
+BIT-IDENTICAL to the owner's root at the same generation, usage planes
+included — and the live plane: a server running ``scheduler_workers=2``
+places real jobs through real worker processes, and a pinned-seed
+SIGKILL mid-lease converges through supervisor lease recovery.
+
+The full 3-node worker-kill chaos schedule runs in the stress tier
+(tests/test_stress.py::TestChaosCell via bench/trace_report
+``worker-kill-mid-lease``).
+"""
+
+import gc
+import pickle
+import time
+
+import pytest
+
+from test_mvcc_store import _apply, _gen_ops
+
+from nomad_tpu import mock
+from nomad_tpu.state.store import (
+    StateStore,
+    _TABLE_NAMES,
+    apply_frame,
+    bootstrap_frame,
+    delta_frame,
+    expire_generation_leases,
+    lease_generation,
+    leased_generation_count,
+    release_owner_leases,
+    renew_owner_leases,
+    snapshot_at,
+    store_stats,
+)
+from nomad_tpu.state.usage import usage_rebuild_diff
+from nomad_tpu.structs import consts
+from nomad_tpu.utils import faultpoints
+from nomad_tpu.utils.ipc import Channel, FrameError, channel_pair, socket_pair
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+def _wait(fn, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# framed channel
+
+
+class TestChannel:
+    def test_roundtrip_and_order(self):
+        a, b = channel_pair()
+        try:
+            a.send({"t": "x", "n": 1})
+            # well under the socketpair buffer: send blocks (by
+            # design, flow control) once the peer stops draining
+            a.send(["big", b"\x00" * 65_536])
+            assert b.recv() == {"t": "x", "n": 1}
+            assert b.recv() == ["big", b"\x00" * 65_536]
+            b.send("reply")
+            assert a.recv() == "reply"
+        finally:
+            a.close()
+            b.close()
+
+    def test_recv_timeout_returns_none(self):
+        a, b = channel_pair()
+        try:
+            assert b.recv(timeout=0.05) is None
+        finally:
+            a.close()
+            b.close()
+
+    def test_closed_peer_raises_eof(self):
+        a, b = channel_pair()
+        a.close()
+        try:
+            with pytest.raises(EOFError):
+                b.recv()
+        finally:
+            b.close()
+
+    def test_corrupt_frame_raises_frame_error(self):
+        import struct
+        import zlib
+
+        raw_a, raw_b = socket_pair()
+        chan = Channel(raw_b)
+        try:
+            payload = pickle.dumps({"k": "v"})
+            bad_crc = (zlib.crc32(payload) ^ 0xDEAD) & 0xFFFFFFFF
+            raw_a.sendall(struct.pack(">II", len(payload), bad_crc)
+                          + payload)
+            with pytest.raises(FrameError):
+                chan.recv()
+        finally:
+            raw_a.close()
+            chan.close()
+
+
+# ---------------------------------------------------------------------------
+# generation leases
+
+
+class TestGenerationLeases:
+    def test_lease_pins_root_past_reader_release(self):
+        store = StateStore()
+        store.upsert_node(mock.node())
+        snap = store.snapshot()
+        gen = store.current_generation()
+        assert lease_generation(gen, "test-owner")
+        store.upsert_node(mock.node())     # advance past the leased gen
+        del snap
+        gc.collect()
+        # the weak registry alone would have freed it; the lease pins
+        assert snapshot_at(gen) is not None
+        assert leased_generation_count() >= 1
+        st = store_stats.snapshot()
+        assert st["live_roots_leased"] >= 1
+        assert st["live_roots"] == (st["live_roots_leased"]
+                                    + st["live_roots_in_process"])
+        release_owner_leases("test-owner")
+        gc.collect()
+        assert snapshot_at(gen) is None
+
+    def test_ttl_expiry_and_renewal(self):
+        store = StateStore()
+        store.upsert_node(mock.node())
+        gen = store.current_generation()
+        assert lease_generation(gen, "ttl-owner", ttl_s=0.08)
+        store.upsert_node(mock.node())
+        assert renew_owner_leases("ttl-owner", ttl_s=0.08) == 1
+        time.sleep(0.12)
+        # liveness-bounded: no heartbeat -> the sweep drops the pin
+        assert expire_generation_leases() >= 1
+        gc.collect()
+        assert snapshot_at(gen) is None
+        assert release_owner_leases("ttl-owner") == 0
+
+    def test_lease_on_dead_generation_refuses(self):
+        store = StateStore()
+        store.upsert_node(mock.node())
+        gen = store.current_generation()
+        store.upsert_node(mock.node())
+        gc.collect()
+        assert not lease_generation(gen, "late-owner")
+
+
+# ---------------------------------------------------------------------------
+# snapshot transport frames: the bit-identity property
+
+
+def _ship(frame):
+    """Frames cross a pickle boundary in production; make the test
+    cross it too (catches identity-dependent encodings, e.g. the
+    TOMBSTONE sentinel)."""
+    return pickle.loads(pickle.dumps(frame,
+                                     protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def _assert_replica_identical(owner, replica):
+    ro, rr = owner._root, replica._root
+    assert rr.generation == ro.generation
+    assert rr.index == ro.index
+    assert rr.table_indexes == ro.table_indexes
+    for name in _TABLE_NAMES:
+        ot = ro.tables[name].to_dict()
+        nt = rr.tables[name].to_dict()
+        assert sorted(ot) == sorted(nt), f"table {name} keys diverged"
+        for k, row in ot.items():
+            if isinstance(row, (set, frozenset)):
+                # index-table rows are sets: bucket layout (and so
+                # pickle bytes) depends on insertion/removal history,
+                # content equality is the invariant
+                assert nt[k] == row, f"table {name} row {k!r} diverged"
+            else:
+                # struct rows have identity __eq__; serialized-bytes
+                # equality is the bit-identity check
+                assert pickle.dumps(nt[k]) == pickle.dumps(row), \
+                    f"table {name} row {k!r} diverged"
+    # the replica's usage planes were advanced by replaying the same
+    # transitions the owner took — same oracle as the owner's invariant
+    assert usage_rebuild_diff(replica) == []
+
+
+def _run_frame_reconstruction(seed, n_ops=60):
+    ops = _gen_ops(seed, n_ops=n_ops)
+    owner = StateStore()
+    _apply(owner, ops[: n_ops // 2])
+
+    replica = StateStore()
+    apply_frame(replica, _ship(bootstrap_frame(
+        owner, pin_owner=f"prop-{seed}")))
+    _assert_replica_identical(owner, replica)
+
+    synced = owner.current_generation()
+    rest = ops[n_ops // 2:]
+    step = 5
+    for i in range(0, len(rest), step):
+        _apply(owner, rest[i:i + step])
+        frame = delta_frame(owner, synced, pin_owner=f"prop-{seed}")
+        if frame is None:
+            # nothing changed (or base lost — must not happen while
+            # our own pin holds it)
+            assert owner.current_generation() == synced
+            continue
+        apply_frame(replica, _ship(frame))
+        synced = frame["generation"]
+        _assert_replica_identical(owner, replica)
+    release_owner_leases(f"prop-{seed}")
+
+
+class TestFrameReconstruction:
+    @pytest.mark.parametrize("seed", range(25))
+    def test_delta_reconstructed_replica_bit_identical(self, seed):
+        """The CI sweep: 25 seeds; after every delta frame the worker-
+        side replica is bit-identical to the owner root at the same
+        generation (rows, indexes, usage planes)."""
+        _run_frame_reconstruction(seed)
+
+    @pytest.mark.slow
+    def test_delta_reconstruction_200_seed_sweep(self):
+        for seed in range(25, 200):
+            _run_frame_reconstruction(seed, n_ops=40)
+
+    def test_out_of_order_delta_raises(self):
+        owner = StateStore()
+        owner.upsert_node(mock.node())
+        replica = StateStore()
+        apply_frame(replica, _ship(bootstrap_frame(owner)))
+        base = owner.current_generation()
+        snap = owner.snapshot()             # pin base for the diff
+        owner.upsert_node(mock.node())
+        frame = delta_frame(owner, base)
+        assert frame is not None
+        apply_frame(replica, _ship(frame))
+        with pytest.raises(ValueError, match="out-of-order"):
+            apply_frame(replica, _ship(frame))   # replay: base moved on
+        del snap
+
+    def test_delta_none_when_base_root_gone(self):
+        owner = StateStore()
+        owner.upsert_node(mock.node())
+        base = owner.current_generation()
+        owner.upsert_node(mock.node())
+        gc.collect()
+        assert delta_frame(owner, base) is None   # bootstrap fallback
+
+
+# ---------------------------------------------------------------------------
+# the live plane: real worker processes
+
+
+def _make_server(scheduler_workers=2, **kw):
+    from nomad_tpu.server.server import Server, ServerConfig
+
+    cfg = ServerConfig(
+        num_workers=1, worker_batch_size=4, heartbeat_ttl=60.0,
+        nack_timeout=2.0, scheduler_workers=scheduler_workers, **kw)
+    server = Server(cfg)
+    server.start()
+    return server
+
+
+def _submit_jobs(server, n, count=2):
+    jobs = []
+    for _ in range(n):
+        job = mock.simple_job()
+        job.task_groups[0].count = count
+        server.job_register(job)
+        jobs.append(job)
+    return jobs
+
+
+def _converged(server, jobs, want_per_job=2):
+    snap = server.state.snapshot()
+    live = sum(1 for j in jobs
+               for a in snap.allocs_by_job(j.namespace, j.id)
+               if not a.terminal_status())
+    if live != len(jobs) * want_per_job:
+        return False
+    if any(e.status in (consts.EVAL_STATUS_PENDING,
+                        consts.EVAL_STATUS_BLOCKED)
+           for e in snap.evals_iter()):
+        return False
+    b = server.eval_broker.stats()
+    return (b["total_ready"] == 0 and b["total_unacked"] == 0
+            and b["total_waiting"] == 0)
+
+
+class TestWorkerProcesses:
+    def test_end_to_end_scheduling_through_worker_processes(self):
+        """scheduler_workers=2: jobs place through real worker
+        processes (dequeue → replica snapshot → plan-build → submit
+        over IPC), in-process workers shrink to the core queue, and
+        the usage planes stay rebuild-identical."""
+        server = _make_server()
+        try:
+            assert server.worker_supervisor is not None
+            # the in-process workers serve ONLY the core (GC) queue
+            assert all(w.schedulers == [consts.JOB_TYPE_CORE]
+                       for w in server.workers)
+            for _ in range(8):
+                server.node_register(mock.node())
+            jobs = _submit_jobs(server, 6)
+            _wait(lambda: _converged(server, jobs), timeout=90.0,
+                  msg="jobs placed through worker processes")
+            wp = server.stats()["worker_procs"]
+            assert wp["workers"] == 2 and wp["alive"] == 2
+            assert wp["acked"] >= len(jobs)
+            assert wp["outstanding"] == 0
+            assert wp["lease_reissues"] == 0
+            assert usage_rebuild_diff(server.state) == []
+            # exact placement: no duplicate live slots
+            snap = server.state.snapshot()
+            for j in jobs:
+                names = [a.name for a in
+                         snap.allocs_by_job(j.namespace, j.id)
+                         if not a.terminal_status()]
+                assert len(set(names)) == len(names) == 2
+        finally:
+            server.shutdown()
+        # shutdown released every worker generation lease
+        assert leased_generation_count() == 0
+
+    def test_sigkill_mid_lease_recovers_pinned_seed(self):
+        """ISSUE 17 satellite: REAL process death. The pinned-seed
+        schedule SIGKILLs one worker process right after it receives a
+        lease (evals held, replica synced, no chance to ack/nack or
+        unwind) — the supervisor's liveness monitor must re-enqueue
+        the dead worker's lease ledger, respawn the process, and the
+        burst must converge to exact placement anyway."""
+        server = _make_server()
+        try:
+            for _ in range(8):
+                server.node_register(mock.node())
+            faultpoints.arm(
+                {"workerproc.kill": {"kind": "error", "nth": 2}},
+                seed=17017)
+            jobs = _submit_jobs(server, 6)
+            _wait(lambda: _converged(server, jobs), timeout=120.0,
+                  msg="burst converged through worker SIGKILL")
+            assert faultpoints.stats()["workerproc.kill"]["fires"] == 1
+            faultpoints.disarm()
+            wp = server.stats()["worker_procs"]
+            assert wp["respawns"] >= 1, wp
+            assert wp["lease_reissues"] >= 1, wp
+            assert wp["alive"] == 2, wp
+            assert wp["outstanding"] == 0, wp
+            assert usage_rebuild_diff(server.state) == []
+            snap = server.state.snapshot()
+            for j in jobs:
+                names = [a.name for a in
+                         snap.allocs_by_job(j.namespace, j.id)
+                         if not a.terminal_status()]
+                assert len(set(names)) == len(names) == 2, \
+                    "placement must be exact through the kill"
+        finally:
+            server.shutdown()
+        assert leased_generation_count() == 0
+
+
+class TestStalePlanToken:
+    """plan_endpoint.go Submit token-check parity, found by the
+    worker-kill-mid-lease chaos schedule: a dead worker's in-flight
+    plan can reach the applier AFTER the supervisor re-enqueued its
+    lease — committing it would race the redelivered eval (scheduling
+    from a pre-commit snapshot) into duplicate live slots. A plan is
+    valid only while its worker still holds the eval lease."""
+
+    def test_stale_token_plan_rejected_live_token_accepted(self):
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.eval_plan import Plan
+
+        server = Server(ServerConfig(num_workers=1))
+        broker = server.eval_broker
+        broker.set_enabled(True)
+        ev = mock.eval()
+        broker.enqueue(ev)
+        out, token = broker.dequeue([ev.type], timeout=1)
+        assert out.id == ev.id
+        plan = Plan(eval_id=ev.id, eval_token=token)
+        # lease held: the plan is valid
+        assert server._validate_plan_token(plan) is None
+        # the lease is re-enqueued (dead worker recovery / auto-nack
+        # deadline) — the old token goes stale
+        broker.nack(ev.id, token)
+        with pytest.raises(ValueError, match="stale eval token"):
+            server.submit_plan(plan)
+        # token-less plans (tests, synchronous harnesses) skip the check
+        assert server._validate_plan_token(Plan(eval_id=ev.id)) is None

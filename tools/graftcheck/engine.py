@@ -271,6 +271,7 @@ def default_engine() -> Engine:
         MutableDefaultRule,
         NonDaemonThreadRule,
     )
+    from tools.graftcheck.rules_ipc import IpcBoundaryRule
     from tools.graftcheck.rules_jit import JitHygieneRule
     from tools.graftcheck.rules_locks import LockDisciplineRule
     from tools.graftcheck.rules_store import StoreAccessRule
@@ -281,6 +282,7 @@ def default_engine() -> Engine:
         LockDisciplineRule(),
         JitHygieneRule(),
         StoreAccessRule(),
+        IpcBoundaryRule(),
         TelemetryDriftRule(),
         MutableDefaultRule(),
         BareExceptRule(),

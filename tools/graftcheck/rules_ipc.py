@@ -1,0 +1,150 @@
+"""R6: IPC-boundary hygiene for the worker-process channel.
+
+Everything sent through ``nomad_tpu/utils/ipc.Channel`` crosses a
+pickle + process boundary into (or out of) a scheduler worker process
+(server/workerproc.py, ISSUE 17). The channel's contract is PLAIN DATA
+ONLY: evals, plans, snapshot frames, span rows, dicts of scalars.
+Objects that are unpicklable or process-bound — locks and witness
+locks, condition variables, tracer/mesh/launcher handles, sockets and
+channels, thread/process/pool objects, raw fds, device-resident jax
+arrays — either fail to pickle at runtime (best case) or pickle into a
+USELESS copy in the other interpreter (a lock that guards nothing, an
+array rematerialized on the wrong device), which is the worst case:
+the bug ships silently.
+
+The rule flags a denylisted terminal reachable as a VALUE in any
+``*.send(...)`` / ``*chan*.send(...)`` argument, in files that import
+``nomad_tpu.utils.ipc``. "Reachable as a value" means the argument
+itself, dict/list/tuple/set literal elements, and conditional-
+expression branches — the expressions whose objects actually end up
+inside the pickled message. Call RESULTS are presumed data (that is
+what serializer shims like ``tracer.drain_rows()`` are for), except
+calls that CONSTRUCT a denylisted object right in the send
+(``threading.Lock()``, ``jnp.asarray(...)``, ``socket.socket()``).
+
+Like R1-R5 the production tree holds no finding: the baseline ships
+(and must stay) empty.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tools.graftcheck.engine import Context, Finding, SourceFile, dotted_name
+
+RULE = "R6"
+
+#: terminal attribute/name segments that are process-bound or
+#: device-resident — sending one through the channel is always wrong
+_DENYLIST = re.compile(
+    r"(?i)(?:^|_)(?:"
+    r"lock|rlock|cond|condition|sem|semaphore|witness|"
+    r"tracer|mesh|launcher|wave_mesh|"
+    r"pool|executor|thread|threads|proc|process|popen|"
+    r"sock|socket|conn|connection|chan|channel|fd|fileno|"
+    r"device_buffer|sharding"
+    r")s?$")
+
+#: constructor roots whose call RESULT is itself a denylisted object
+#: (``chan.send(threading.Lock())`` must not hide behind call-is-data)
+_DENY_CALL_ROOTS = {"threading", "socket", "subprocess", "select",
+                    "jax", "jnp"}
+
+#: the receiver of ``.send`` must look like an ipc channel, so the
+#: rule never fires on socket sends in the membership/transport planes
+_CHANNELISH = re.compile(r"(?i)(?:^|_)chan(?:nel)?$")
+
+_IPC_MODULE = "nomad_tpu.utils.ipc"
+
+
+def _imports_ipc(src: SourceFile) -> bool:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == _IPC_MODULE or
+                   a.name.startswith(_IPC_MODULE + ".")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == _IPC_MODULE or mod.startswith(_IPC_MODULE + "."):
+                return True
+            if mod == "nomad_tpu.utils" and any(
+                    a.name == "ipc" for a in node.names):
+                return True
+    return False
+
+
+class IpcBoundaryRule:
+    rule_id = RULE
+
+    def check(self, ctx: Context) -> Iterable[Finding]:
+        for src in ctx.files:
+            if src.rel == "nomad_tpu/utils/ipc.py":
+                continue            # the channel itself sends payloads
+            if not _imports_ipc(src):
+                continue
+            for node in ast.walk(src.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "send"):
+                    continue
+                recv = dotted_name(node.func.value)
+                term = recv.rsplit(".", 1)[-1] if recv else ""
+                if not _CHANNELISH.search(term):
+                    continue
+                for arg in node.args:
+                    yield from self._check_value(src, node, arg)
+
+    # -- value walk ------------------------------------------------------
+
+    def _check_value(self, src: SourceFile, call: ast.Call,
+                     node: ast.AST) -> Iterable[Finding]:
+        """Expressions whose OBJECT lands inside the pickled message."""
+        if isinstance(node, ast.Dict):
+            for v in node.values:
+                if v is not None:   # None key-slot = ** expansion
+                    yield from self._check_value(src, call, v)
+            return
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            for el in node.elts:
+                yield from self._check_value(src, call, el)
+            return
+        if isinstance(node, ast.Starred):
+            yield from self._check_value(src, call, node.value)
+            return
+        if isinstance(node, ast.IfExp):
+            yield from self._check_value(src, call, node.body)
+            yield from self._check_value(src, call, node.orelse)
+            return
+        if isinstance(node, ast.Call):
+            # a call result is presumed plain data (serializer shims),
+            # UNLESS it constructs a process-bound object on the spot
+            name = dotted_name(node.func)
+            root = name.split(".", 1)[0]
+            if root in _DENY_CALL_ROOTS:
+                yield Finding(
+                    RULE, src.rel, node.lineno, src.scope_of(node),
+                    f"ipc-send:{name}()",
+                    f"`{name}(...)` constructed inside a channel send: "
+                    f"process-bound objects must never cross the IPC "
+                    f"boundary (utils/ipc.py contract)")
+            return
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = dotted_name(node)
+            if not name:
+                return
+            term = name.rsplit(".", 1)[-1]
+            if _DENYLIST.search(term):
+                yield Finding(
+                    RULE, src.rel, node.lineno, src.scope_of(node),
+                    f"ipc-send:{name}",
+                    f"`{name}` sent through the IPC channel: locks, "
+                    f"witness locks, tracer/mesh handles, sockets, "
+                    f"threads/processes, and device-resident arrays "
+                    f"are process-bound — ship plain data (rows, "
+                    f"frames, ids) instead")
+
+
+__all__ = ["IpcBoundaryRule", "RULE"]
