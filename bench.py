@@ -1481,6 +1481,51 @@ def main() -> None:
         print("bench budget: skipping worker cell "
               f"({budget.remaining():.0f}s left)", file=sys.stderr)
 
+    # ISSUE 18: the raft cell — pipelined AppendEntries
+    # (max_in_flight=8) A/B'd against the synchronous send->ack->send
+    # replicator on the same burst under injected 5ms per-peer send
+    # latency. raft_speedup and raft_lag_improvement are the headline
+    # (gate: both >= 2x); raft_logs_identical makes a throughput win
+    # that diverges a replica a FAILURE. Reproduce with
+    # trace_report.run_raft_burst() (docs/PERF.md).
+    if budget.remaining() > 120:
+        try:
+            _phase("raft cell")
+            sys.path.insert(0, os.path.join(REPO, "bench"))
+            import trace_report
+
+            cell = trace_report.run_raft_burst()
+            em.update(
+                raft_seed=cell["seed"],
+                raft_applies_per_sec=cell["applies_per_sec"],
+                raft_applies_per_sec_sync=cell["applies_per_sec_sync"],
+                raft_speedup=cell["speedup"],
+                raft_lag_improvement=cell["lag_improvement"],
+                raft_speedup_ok=1 if cell["speedup_ok"] else 0,
+                raft_quorum_p99_ms=cell["pipelined"]["quorum_p99_ms"],
+                raft_quorum_p99_ms_sync=cell["sync"]["quorum_p99_ms"],
+                raft_pipeline_drains=cell["pipelined"][
+                    "pipeline_drains"],
+                raft_logs_identical=(
+                    1 if cell["logs_identical"] else 0),
+            )
+            if not cell["logs_identical"]:
+                print("warning: raft cell replica logs DIVERGED "
+                      "(speedup is void without log equivalence)",
+                      file=sys.stderr)
+            if not cell["speedup_ok"]:
+                print("warning: raft cell speedup "
+                      f"{cell['speedup']}x / lag improvement "
+                      f"{cell['lag_improvement']}x below the 2x gate",
+                      file=sys.stderr)
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: raft cell failed ({e})", file=sys.stderr)
+    else:
+        print("bench budget: skipping raft cell "
+              f"({budget.remaining():.0f}s left)", file=sys.stderr)
+
     # ISSUE 12: the chaos cell — every standing fault schedule
     # (leader-kill-mid-wave, plan-commit raft failure, crash-and-drop)
     # against a live 3-node raft cluster, pinned seed, convergence
@@ -1495,11 +1540,13 @@ def main() -> None:
             sys.path.insert(0, os.path.join(REPO, "bench"))
             import trace_report
 
-            # three schedules run sequentially, each paying warmup
+            # the schedules run sequentially, each paying warmup
             # (~deadline/2) + burst deadline + settle — size ALL of
             # those from the remaining budget (leaving headroom for
             # the replay headline), not just the burst phase
-            per_schedule = max((budget.remaining() - 90.0) / 3.0, 60.0)
+            n_schedules = len(trace_report.CHAOS_SCHEDULES)
+            per_schedule = max(
+                (budget.remaining() - 90.0) / n_schedules, 60.0)
             suite = trace_report.run_chaos_suite(
                 deadline_s=min(max(per_schedule * 0.4, 30.0), 90.0),
                 settle_s=min(max(per_schedule * 0.25, 20.0), 60.0),

@@ -1769,6 +1769,196 @@ def run_worker_burst(n_workers: int = 4, n_nodes: int = 200,
     }
 
 
+#: the raft cell's pinned seed (ISSUE 18): per-peer latency injection
+#: is deterministic per (schedule, seed)
+RAFT_CELL_SEED = 18018
+
+
+def run_raft_burst(n_appliers: int = 32, applies_per_thread: int = 30,
+                   send_latency_s: float = 0.005,
+                   max_in_flight: int = 8,
+                   max_append_entries: int = 4,
+                   seed: int = RAFT_CELL_SEED) -> Dict:
+    """The ISSUE-18 raft cell: A/B pipelined AppendEntries against the
+    synchronous send->ack->send replicator on the SAME burst under
+    injected per-peer send latency (the ``raft.replicate.send`` fault
+    seam, armed at ``send_latency_s`` with p=1.0).
+
+    Arm A runs ``max_in_flight=1`` — the dispatcher never consults the
+    pipeline, so this IS the pre-18 path. Arm B runs the pipelined
+    window. Both arms cap ``max_append_entries`` low so the window —
+    not batch growth — is the variable under test: synchronous
+    replication ships one capped batch per RTT no matter how deep the
+    backlog, the pipeline ships up to ``max_in_flight`` of them.
+    ``n_appliers`` threads apply concurrently (a group-commit wave's
+    concurrency, without the scheduling plane in the way).
+
+    Reported per arm: applies/sec, the RAFT_QUORUM and
+    RAFT_REPLICATION histogram percentiles (append->majority-commit
+    and append->peer-ack — the commit-window partition PR 15
+    attributes), sampled peer lag entries, pipeline batch/drain
+    counters, and a replica log-equality verdict (all three FSMs must
+    hold identical sequences — a throughput win that diverges a
+    replica is a failed run, not a fast one).
+    """
+    from nomad_tpu.raft.node import RaftConfig, RaftNode
+    from nomad_tpu.raft.transport import InmemTransport, TransportRegistry
+    from nomad_tpu.telemetry.histogram import (
+        RAFT_QUORUM,
+        RAFT_REPLICATION,
+        histograms,
+    )
+    from nomad_tpu.utils import faultpoints
+
+    def run_arm(in_flight: int) -> Dict:
+        config = RaftConfig(
+            heartbeat_interval=0.05,
+            election_timeout_min=0.5,
+            election_timeout_max=1.0,
+            max_append_entries=max_append_entries,
+            max_in_flight=in_flight,
+        )
+        registry = TransportRegistry()
+        addrs = [f"r{i}" for i in range(3)]
+        nodes, fsm_logs = [], []
+        for addr in addrs:
+            applied: list = []
+            fsm_logs.append(applied)
+            nodes.append(RaftNode(
+                node_id=addr,
+                peers=addrs,
+                transport=InmemTransport(addr, registry),
+                fsm_apply=(lambda a: lambda t, r:
+                           a.append((t, r)) or len(a))(applied),
+                config=config,
+            ))
+        for node in nodes:
+            node.start()
+        stop = threading.Event()
+        try:
+            leader = None
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                leaders = [n for n in nodes if n.is_leader()]
+                if len(leaders) == 1:
+                    leader = leaders[0]
+                    break
+                time.sleep(0.01)
+            if leader is None:
+                raise TimeoutError("raft cell: no leader elected")
+            # warmup OUTSIDE the fault window: prove next_index, arm
+            # the pipeline, settle the election
+            for i in range(4):
+                leader.apply("warm", {"i": i}, timeout=10.0)
+            histograms.get(RAFT_QUORUM).reset()
+            histograms.get(RAFT_REPLICATION).reset()
+            faultpoints.arm({"raft.replicate.send": {
+                "kind": "latency", "p": 1.0,
+                "sleep_s": send_latency_s}}, seed=seed)
+
+            lag_samples: list = []
+
+            def sample_lag() -> None:
+                while not stop.is_set():
+                    lags = (leader.observe_gauges()
+                            .get("peer_lag_entries") or {}).values()
+                    if lags:
+                        lag_samples.append(max(lags))
+                    time.sleep(0.003)
+
+            sampler = threading.Thread(target=sample_lag, daemon=True,
+                                       name="raft-cell-lag")
+            sampler.start()
+
+            errors: list = []
+
+            def applier(k: int) -> None:
+                for i in range(applies_per_thread):
+                    try:
+                        leader.apply("set", {"k": k, "i": i},
+                                     timeout=30.0)
+                    except Exception as e:      # noqa: BLE001
+                        errors.append(repr(e))
+                        return
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=applier, args=(k,),
+                                        daemon=True,
+                                        name=f"raft-cell-apply-{k}")
+                       for k in range(n_appliers)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            stop.set()
+            sampler.join(timeout=1.0)
+            faultpoints.disarm()
+
+            # convergence: every replica applied the identical
+            # sequence (warmup + burst; noops are not FSM-visible)
+            want = 4 + n_appliers * applies_per_thread - len(errors)
+            deadline = time.time() + 15.0
+            while time.time() < deadline:
+                if all(len(log) >= want for log in fsm_logs):
+                    break
+                time.sleep(0.01)
+            logs_identical = (
+                fsm_logs[0] == fsm_logs[1] == fsm_logs[2]
+                and len(fsm_logs[0]) >= want)
+            gauges = leader.observe_gauges()
+            quorum = histograms.get(RAFT_QUORUM).snapshot()
+            repl = histograms.get(RAFT_REPLICATION).snapshot()
+            applies = n_appliers * applies_per_thread - len(errors)
+            return {
+                "max_in_flight": in_flight,
+                "wall_s": round(wall, 3),
+                "applies": applies,
+                "applies_per_sec": round(applies / wall, 1)
+                if wall else 0.0,
+                "quorum_p50_ms": quorum["p50_ms"],
+                "quorum_p99_ms": quorum["p99_ms"],
+                "replication_p50_ms": repl["p50_ms"],
+                "replication_p99_ms": repl["p99_ms"],
+                "lag_entries_max": max(lag_samples) if lag_samples
+                else 0,
+                "pipeline_batches": gauges.get("pipeline_batches", 0),
+                "pipeline_drains": gauges.get("pipeline_drains", 0),
+                "logs_identical": logs_identical,
+                "errors": errors[:3],
+            }
+        finally:
+            stop.set()
+            faultpoints.reset()
+            for node in nodes:
+                node.shutdown()
+
+    sync = run_arm(1)
+    pipe = run_arm(max_in_flight)
+    speedup = (pipe["applies_per_sec"] / sync["applies_per_sec"]
+               if sync["applies_per_sec"] else 0.0)
+    # append->ack latency is the replication-lag attribution the
+    # pipeline exists to shrink: synchronously a queued entry waits
+    # out every batch ahead of it, pipelined it waits ~one RTT
+    lag_improvement = (sync["replication_p99_ms"]
+                       / pipe["replication_p99_ms"]
+                       if pipe["replication_p99_ms"] else 0.0)
+    return {
+        "seed": seed,
+        "send_latency_ms": send_latency_s * 1e3,
+        "n_appliers": n_appliers,
+        "sync": sync,
+        "pipelined": pipe,
+        "applies_per_sec_sync": sync["applies_per_sec"],
+        "applies_per_sec": pipe["applies_per_sec"],
+        "speedup": round(speedup, 3),
+        "lag_improvement": round(lag_improvement, 3),
+        "speedup_ok": bool(speedup >= 2.0 and lag_improvement >= 2.0),
+        "logs_identical": bool(sync["logs_identical"]
+                               and pipe["logs_identical"]),
+    }
+
+
 #: the chaos cell's pinned seed: every schedule below is reproduced by
 #: re-arming the SAME (faults, seed) pair (docs/ROBUSTNESS.md, "how to
 #: reproduce a chaos failure from its seed")
@@ -1892,6 +2082,23 @@ CHAOS_SCHEDULES = {
         },
         "drop_nodes": 0,
         "scheduler_workers": 2,
+    },
+    # lease safety under partition (ISSUE 18): mid-burst the current
+    # leader is cut from BOTH peers for longer than its lease window
+    # (0.75 * election_timeout_min); the peers elect and keep
+    # committing. A probe thread interrogates the deposed leader's
+    # lease the whole window — a lease reported valid at any instant
+    # AFTER the new leader committed an entry the old one lacks is a
+    # stale linearizable read, the safety violation leases must make
+    # impossible. Replication jitter keeps the lease-refresh acks
+    # honest before the cut.
+    "lease-leader-partition": {
+        "faults": {
+            "raft.replicate.send": {"kind": "latency", "p": 0.05,
+                                    "sleep_s": 0.01, "max_fires": 40},
+        },
+        "drop_nodes": 0,
+        "leader_partition_s": 1.5,
     },
 }
 
@@ -2031,6 +2238,46 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
         th.start()
         threads.append(th)
 
+        # lease-safety probe (ISSUE 18): cut the leader off mid-burst
+        # and interrogate its lease for the whole window. Ordering
+        # makes the check sound: the new leader's committed index is
+        # read BEFORE the old leader's lease, so a valid lease paired
+        # with a lower local index proves a stale-read window existed.
+        lease_probe = {"fast_ok": 0, "fast_stale": 0, "barrier": 0,
+                       "partitioned": False}
+
+        def partition_leader(window_s: float) -> None:
+            time.sleep(1.0)                     # let the burst start
+            old = cur_leader()
+            if old is None or stop.is_set():
+                return
+            addr = old.raft.id
+            for p in old.raft.peers:
+                if p != addr:
+                    registry.partition(addr, p)
+            lease_probe["partitioned"] = True
+            try:
+                deadline = time.monotonic() + window_s
+                while time.monotonic() < deadline and not stop.is_set():
+                    new = next(
+                        (s for s in servers
+                         if s is not old and s.raft is not None
+                         and s.raft.is_leader()), None)
+                    new_idx = (new.state.latest_index()
+                               if new is not None else None)
+                    fast = old.raft.lease_valid()
+                    old_idx = old.state.latest_index()
+                    if fast:
+                        if new_idx is not None and new_idx > old_idx:
+                            lease_probe["fast_stale"] += 1
+                        else:
+                            lease_probe["fast_ok"] += 1
+                    else:
+                        lease_probe["barrier"] += 1
+                    time.sleep(0.005)
+            finally:
+                registry.heal()
+
         def heartbeat_storm(k: int, nthreads: int) -> None:
             ids = [n for n in node_ids if n not in drop_set][k::nthreads]
             i = 0
@@ -2085,6 +2332,13 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
 
         # ---- the chaos window -------------------------------------------
         faultpoints.arm(spec["faults"], seed=seed)
+        if spec.get("leader_partition_s"):
+            th = threading.Thread(
+                target=partition_leader,
+                args=(spec["leader_partition_s"],),
+                daemon=True, name="chaos-partition")
+            th.start()
+            threads.append(th)
         t0 = time.perf_counter()
         jobs = []
         for start in range(0, n_jobs, 3):
@@ -2149,6 +2403,24 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
             violations.append(
                 f"workerproc.kill fired {kill_fires}x but no leased "
                 f"eval was re-enqueued")
+
+        # lease safety (ISSUE 18): zero stale reads, and the probe
+        # must actually have seen the lease lapse — a partition that
+        # never demoted a read proves nothing
+        if spec.get("leader_partition_s"):
+            if not lease_probe["partitioned"]:
+                violations.append(
+                    "lease probe never partitioned a leader")
+            if lease_probe["fast_stale"]:
+                violations.append(
+                    f"LEASE SAFETY: deposed leader served "
+                    f"{lease_probe['fast_stale']} lease-valid probes "
+                    f"after a new leader committed past it")
+            if lease_probe["partitioned"] \
+                    and lease_probe["barrier"] == 0:
+                violations.append(
+                    "lease never lapsed during the partition window "
+                    "(probe saw no barrier-demoted reads)")
 
         # ---- convergence invariants -------------------------------------
         leader = wait_for_leader(servers, timeout=10.0)
@@ -2249,6 +2521,9 @@ def run_chaos_burst(schedule: str = "leader-kill-mid-wave",
             "worker_procs": spec.get("scheduler_workers", 0),
             "worker_lease_reissues": worker_reissues,
             "worker_respawns": worker_respawns,
+            "lease_fast_stale_reads": lease_probe["fast_stale"],
+            "lease_fast_reads": lease_probe["fast_ok"],
+            "lease_barrier_reads": lease_probe["barrier"],
             "plan_rejections": plan_rejections.snapshot()["rejections"],
             "timeline": _capture_timeline(
                 f"chaos:{schedule}", obs_start, fire_window,

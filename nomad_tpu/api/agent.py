@@ -134,6 +134,14 @@ class AgentConfig:
     # MVCC snapshot frames; 0 = in-process threads (the default, and
     # bit-identical to pre-17 behavior)
     scheduler_workers: int = 0
+    # pipelined AppendEntries + leader leases (raft/node.py, ISSUE 18):
+    # raft_max_in_flight bounds the per-peer replication window (1 =
+    # the synchronous path); raft_leader_lease gates the quorum-free
+    # linearizable-read fast path; raft_lease_fraction is the lease
+    # window as a fraction of election_timeout_min
+    raft_max_in_flight: int = 8
+    raft_leader_lease: bool = True
+    raft_lease_fraction: float = 0.75
 
     @classmethod
     def dev(cls, **overrides) -> "AgentConfig":
@@ -190,6 +198,9 @@ class Agent:
             data_dir=self.config.data_dir,
             raft_fsync_policy=self.config.raft_fsync_policy,
             scheduler_workers=self.config.scheduler_workers,
+            raft_max_in_flight=self.config.raft_max_in_flight,
+            raft_leader_lease=self.config.raft_leader_lease,
+            raft_lease_fraction=self.config.raft_lease_fraction,
         )
         self.server = Server(cfg)
         self.raft_transport = None

@@ -142,6 +142,13 @@ def _apply_body(cfg, body: Body):
         # at ack boundaries; raft/wal.py)
         if "raft_fsync_policy" in sa:
             cfg.raft_fsync_policy = str(sa["raft_fsync_policy"])
+        # replication pipeline + leader leases (raft/node.py, ISSUE 18)
+        if "raft_max_in_flight" in sa:
+            cfg.raft_max_in_flight = int(sa["raft_max_in_flight"])
+        if "raft_leader_lease" in sa:
+            cfg.raft_leader_lease = bool(sa["raft_leader_lease"])
+        if "raft_lease_fraction" in sa:
+            cfg.raft_lease_fraction = float(sa["raft_lease_fraction"])
         # gossip membership seeds ("host:port"; DNS names expand to
         # every A record — join-by-DNS)
         if "server_join" in sa and isinstance(sa["server_join"], list):

@@ -501,6 +501,10 @@ def make_schedule_apply_step_pallas(k_steps: int, interpret: bool = False):
     variant: same signature, same optimistic-batch + scatter-commit
     semantics, pallas placement inside."""
 
+    # deferred: batching lazily imports this module for the fused
+    # top-k scan, so a module-level import here would be circular
+    from nomad_tpu.parallel.batching import _jit_donating
+
     def step(shared, used_cpu, used_mem, ask_cpu, ask_mem, n_steps):
         out = pallas_place_batch(
             shared.cap_cpu, shared.cap_mem, shared.cap_disk,
@@ -522,4 +526,9 @@ def make_schedule_apply_step_pallas(k_steps: int, interpret: bool = False):
         used_mem2 = used_mem.at[safe].add(jnp.where(ok, w_mem, 0.0))
         return out, used_cpu2, used_mem2
 
-    return jax.jit(step, donate_argnums=(1, 2))
+    # donation through the owning wrapper (PR 2/10 discipline): a raw
+    # donate_argnums jit here is handed caller-owned ``jnp.asarray``
+    # planes — the runtime can't always use them ("Some donated
+    # buffers were not usable: float32[16384]" leaking into the bench
+    # tail), and when it CAN they alias caller memory
+    return _jit_donating(step, (1, 2))

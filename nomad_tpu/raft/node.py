@@ -31,6 +31,7 @@ from nomad_tpu.telemetry.histogram import (
 )
 from nomad_tpu.telemetry.trace import consensus_recorder, tracer
 from nomad_tpu.utils.faultpoints import FaultError, fault
+from nomad_tpu.utils.witness import witness_lock
 
 # reserved msg_types for replicated membership changes, handled by the
 # raft layer itself instead of the FSM (hashicorp/raft
@@ -59,12 +60,32 @@ class RaftConfig:
         election_timeout_max: float = 0.30,
         max_append_entries: int = 64,
         snapshot_threshold: int = 8192,
+        max_in_flight: int = 8,
+        leader_lease: bool = True,
+        lease_fraction: float = 0.75,
     ) -> None:
         self.heartbeat_interval = heartbeat_interval
         self.election_timeout_min = election_timeout_min
         self.election_timeout_max = election_timeout_max
         self.max_append_entries = max_append_entries
         self.snapshot_threshold = snapshot_threshold
+        #: AppendEntries batches a per-peer replicator may keep in
+        #: flight before waiting for acks (hashicorp/raft's pipeline);
+        #: 1 disables pipelining entirely — the replicator then runs
+        #: the original synchronous send->ack->send path, bit for bit
+        self.max_in_flight = max_in_flight
+        #: clock-based leader lease: a quorum of AppendEntries acks
+        #: within ``election_timeout_min * lease_fraction`` of their
+        #: SEND stamps lets leader-side linearizable reads skip the
+        #: barrier round-trip. Safety leans on the paired follower
+        #: rule: no vote against a live leader within
+        #: election_timeout_min of its last contact (raft §6), so a
+        #: deposed leader's lease always expires before its successor
+        #: can win — as long as clock RATES stay within the
+        #: 1 - lease_fraction margin (offsets don't matter, both
+        #: sides measure durations)
+        self.leader_lease = leader_lease
+        self.lease_fraction = lease_fraction
 
 
 class _ApplyFuture:
@@ -94,6 +115,8 @@ class RaftNode:
         peers: List[str],
         transport,
         fsm_apply: Callable[[str, Dict], Any],
+        fsm_apply_batch: Optional[
+            Callable[[List[Tuple[str, Dict]]], List]] = None,
         config: Optional[RaftConfig] = None,
         snapshot_fn: Optional[Callable[[], bytes]] = None,
         restore_fn: Optional[Callable[[bytes], None]] = None,
@@ -108,13 +131,20 @@ class RaftNode:
         self.transport = transport
         transport.set_handler(self._handle_rpc)
         self.fsm_apply = fsm_apply
+        # optional batched FSM doorway: the apply loop hands a whole
+        # committed run of plain commands to one call (one FSM-lock +
+        # store-root-swap span on the other side); absent, it falls
+        # back to per-entry fsm_apply inside the same batch drain
+        self.fsm_apply_batch = fsm_apply_batch
         self.config = config or RaftConfig()
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self.on_leader = on_leader
         self.on_follower = on_follower
 
-        self._lock = threading.RLock()
+        # witness-created (PR 9): the stress tier checks the pipeline
+        # window bookkeeping below for lock-order inversions
+        self._lock = witness_lock("raft_node", rlock=True)
         self.state = FOLLOWER
         self.current_term = 0
         self.voted_for: Optional[str] = None
@@ -189,6 +219,52 @@ class RaftNode:
         # last-contact health signal)
         self.peer_last_contact: Dict[str, float] = {}
 
+        # --- pipelined replication (ISSUE 18) --------------------------
+        # Per-peer window state, all under self._lock. A peer's
+        # pipeline arms (_pipe_ok) only after a successful synchronous
+        # ack proved next_index correct; any failure, term change,
+        # conflict backoff, or snapshot need DRAINS the window (epoch
+        # bump discards in-flight acks) and falls back to the sync
+        # path. Acks are processed strictly in send order (_pipe_seq /
+        # _pipe_ack_turn) so match_index/commit advance per batch
+        # exactly as the synchronous path would.
+        self._pipe_ok: Dict[str, bool] = {}
+        self._pipe_epoch: Dict[str, int] = {}
+        self._pipe_seq: Dict[str, int] = {}
+        self._pipe_ack_turn: Dict[str, int] = {}
+        self._pipe_inflight: Dict[str, int] = {}
+        #: speculative send frontier — entries below it are in flight
+        self._pipe_next: Dict[str, int] = {}
+        self._pipe_cond = threading.Condition(self._lock)
+        self._pipe_batches = 0
+        self._pipe_drains = 0
+        # per-peer wire turnstile: concurrent sender threads overlap
+        # their TRANSIT (the fault seam's injected latency sleeps
+        # concurrently) but hit the transport strictly in sequence
+        # order — the ordered-stream property a TCP pipeline gets for
+        # free, without which scheduler jitter reorders arrivals at
+        # the follower and every reorder costs a conflict + drain.
+        # LEAF under raft_node: _pipe_drain_locked mirrors the epoch
+        # into it while holding self._lock; senders never take
+        # self._lock while holding it
+        self._wire_lock = witness_lock("raft_pipe_wire")
+        self._wire_cond = threading.Condition(self._wire_lock)
+        self._wire_turn: Dict[str, int] = {}
+        self._wire_epoch: Dict[str, int] = {}
+
+        # --- leader lease (ISSUE 18) -----------------------------------
+        # per-peer newest SEND-start stamp among acked AppendEntries /
+        # InstallSnapshot RPCs: the follower's no-vote window opens at
+        # its RECEIVE time >= our send time, so a lease computed from
+        # send stamps can never outlive the window that protects it
+        self._lease_contact: Dict[str, float] = {}
+        self._lease_reads_fast = 0
+        self._lease_reads_barrier = 0
+        # edge-detect lease expiry for the consensus event log: set on
+        # a fast-path read, cleared (with one "lease_expired" timeline
+        # event) the first time a read demotes to the barrier
+        self._lease_was_valid = False
+
         self._futures: Dict[int, _ApplyFuture] = {}
         self._apply_cond = threading.Condition(self._lock)
         # --- consensus-plane observability (ISSUE 15) -------------------
@@ -230,8 +306,10 @@ class RaftNode:
         # the term whose noop barrier marks leadership fully established
         self._leader_barrier_term = -1
         # serializes FSM apply against snapshot capture so a snapshot is
-        # exactly the state at last_applied (no torn snapshots)
-        self._fsm_lock = threading.Lock()
+        # exactly the state at last_applied (no torn snapshots);
+        # witness-created so the batched drain's fsm->node->store
+        # ordering is checked under the stress tier
+        self._fsm_lock = witness_lock("raft_fsm")
         # request-id -> result for forwarded applies (at-most-once: a
         # retry after a dropped response must not re-apply the command)
         self._forward_results: Dict[str, Any] = {}
@@ -504,6 +582,11 @@ class RaftNode:
                 self.next_index = {p: last + 1 for p in self.peers}
                 self.match_index = {p: 0 for p in self.peers}
                 self.match_index[self.id] = last
+                # fresh leadership: every pipeline re-arms through a
+                # synchronous ack, the lease starts from zero (stamps
+                # from a previous term must not validate this one)
+                self._pipe_drain_all_locked()
+                self._lease_contact = {}
                 became_leader = True
                 election_dur = (
                     time.monotonic() - self._election_started_mono
@@ -563,6 +646,12 @@ class RaftNode:
             raft_observer.note_event(self.id, "term_adopt", term=term)
         self._last_contact = time.monotonic()
         if was_leader:
+            # deposed: in-flight pipeline acks are void, and the lease
+            # dies with the leadership (lease_valid gates on LEADER
+            # anyway; clearing the stamps keeps a re-election from
+            # inheriting them)
+            self._pipe_drain_all_locked()
+            self._lease_contact = {}
             raft_observer.note_transition(self.id, "stepdown")
             raft_observer.note_event(
                 self.id, "stepdown", term=self.current_term,
@@ -603,6 +692,21 @@ class RaftNode:
                 LOG.debug("%s: replicate to %s failed: %s", self.id, peer, e)
 
     def _replicate_to(self, peer: str) -> None:
+        """Per-peer replication dispatch. The pipelined path needs an
+        ARMED window (a prior synchronous ack proved next_index) and
+        ``max_in_flight > 1``; everything else — first contact,
+        conflict backoff, snapshot catch-up, and the
+        ``max_in_flight=1`` configuration — runs the original
+        synchronous send->ack->send path unchanged."""
+        if self.config.max_in_flight > 1:
+            with self._lock:
+                pipelined = self._pipe_ok.get(peer, False)
+            if pipelined:
+                self._replicate_pipelined(peer)
+                return
+        self._replicate_sync(peer)
+
+    def _replicate_sync(self, peer: str) -> None:
         with self._lock:
             if self.state != LEADER:
                 return
@@ -640,6 +744,11 @@ class RaftNode:
                     next_idx, self.config.max_append_entries
                 )
                 commit = self.commit_index
+        # lease stamp = SEND-start (before the seam: an injected delay
+        # only makes the stamp conservative). The follower's no-vote
+        # window opens at its receive time >= this stamp, so a lease
+        # extended from here can never outlive that window.
+        t_start = time.monotonic()
         # replication seam: injected errors/latency here are dropped or
         # slow AppendEntries RPCs — the replicator's retry-next-wake
         # path (ConnectionError treatment below) must absorb them
@@ -666,6 +775,7 @@ class RaftNode:
                     self.next_index[peer] = snapshot_req["last_index"] + 1
                     self.match_index[peer] = snapshot_req["last_index"]
                     self.peer_last_contact[peer] = time.monotonic()
+                    self._note_lease_contact_locked(peer, t_start)
                     self._maybe_drop_snapshot_cache_locked()
                 return
             req = {"term": term, "leader": self.id,
@@ -692,7 +802,15 @@ class RaftNode:
                 self._step_down_locked(resp["term"])
                 return
             self.peer_last_contact[peer] = time.monotonic()
+            # the follower answered IN OUR TERM: its election timer
+            # reset on receipt, so even a conflict reply extends the
+            # lease window (the stamp is the send start, see above)
+            self._note_lease_contact_locked(peer, t_start)
             if resp.get("success"):
+                # next_index is now PROVEN for this peer: arm the
+                # pipelined window (no-op at max_in_flight=1 — the
+                # dispatch never consults _pipe_ok then)
+                self._pipe_ok[peer] = True
                 if entries:
                     newest = entries[-1].index
                     stamp = self._append_stamps.get(newest)
@@ -718,6 +836,287 @@ class RaftNode:
                 lag_ms=round(lag_s * 1e3, 3) if lag_s is not None
                 else None)
         self._obs_flush()
+
+    # --- pipelined replication (ISSUE 18) -------------------------------
+
+    def _replicate_pipelined(self, peer: str) -> None:
+        """Fill the peer's in-flight window: cut AppendEntries batches
+        from the speculative frontier (``_pipe_next``) and hand each to
+        a short-lived sender thread — up to ``max_in_flight`` at once.
+        Acks are serialized in send order by :meth:`_pipe_ack`. The
+        transport send itself always happens OUTSIDE self._lock (R2)."""
+        cfg = self.config
+        while not self._shutdown.is_set():
+            with self._lock:
+                if self.state != LEADER or not self._pipe_ok.get(peer):
+                    return
+                if self._pipe_inflight.get(peer, 0) >= cfg.max_in_flight:
+                    return      # window full; freed slots re-wake us
+                term = self.current_term
+                epoch = self._pipe_epoch.get(peer, 0)
+                next_idx = self._pipe_next.get(
+                    peer, 0) or self.next_index.get(
+                        peer, self.log.last_index() + 1)
+                if next_idx <= self.log.base_index():
+                    # compacted past the peer: InstallSnapshot stays
+                    # serial — drain and let the sync path take over
+                    self._pipe_drain_locked(peer)
+                    self._wake_peer(peer)
+                    return
+                prev_index = next_idx - 1
+                prev_term = self.log.term_at(prev_index)
+                if prev_term is None:
+                    self._pipe_drain_locked(peer)
+                    self._wake_peer(peer)
+                    return
+                entries = self.log.entries_from(
+                    next_idx, cfg.max_append_entries)
+                if not entries:
+                    if self._pipe_inflight.get(peer, 0):
+                        return  # in-flight batches double as heartbeats
+                    break       # idle: sync heartbeat keeps the lease
+                commit = self.commit_index
+                seq = self._pipe_seq.get(peer, 0)
+                self._pipe_seq[peer] = seq + 1
+                self._pipe_inflight[peer] = (
+                    self._pipe_inflight.get(peer, 0) + 1)
+                self._pipe_next[peer] = entries[-1].index + 1
+                self._pipe_batches += 1
+                ctx = self._repl_trace_ctx
+            req = {"term": term, "leader": self.id,
+                   "prev_log_index": prev_index,
+                   "prev_log_term": prev_term,
+                   "entries": entries, "leader_commit": commit}
+            threading.Thread(
+                target=self._pipe_send,
+                args=(peer, epoch, seq, req, ctx),
+                daemon=True,
+                name=f"raft-pipe-{self.id}-{peer}-{seq}",
+            ).start()
+        # fell through: nothing in flight and nothing to send — run an
+        # idle heartbeat on the sync path (leadership + lease refresh)
+        self._replicate_sync(peer)
+
+    def _pipe_send(self, peer: str, epoch: int, seq: int, req: Dict,
+                   ctx: Optional[Tuple[str, int]]) -> None:
+        """One in-flight batch: transit outside every lock, then send
+        through the peer's wire turnstile (strict sequence order —
+        the ordered stream a real pipeline rides), then hand the
+        response (None on any failure) to the in-order ack stage."""
+        t_start = time.monotonic()
+        resp = None
+        stale = False
+        try:
+            # same replication seam as the sync path: injected
+            # errors/latency are dropped or slow pipelined RPCs and
+            # surface as a drain + sync retry. Runs BEFORE the
+            # turnstile so in-flight transits overlap.
+            fault("raft.replicate.send")
+            with self._wire_cond:
+                while (not self._shutdown.is_set()
+                       and self._wire_epoch.get(peer, 0) == epoch
+                       and self._wire_turn.get(peer, 0) != seq):
+                    self._wire_cond.wait(0.05)
+                stale = (self._shutdown.is_set()
+                         or self._wire_epoch.get(peer, 0) != epoch)
+            if not stale:
+                # we OWN the turn until we bump it below: no later
+                # batch can reach the transport before us, and the
+                # turnstile lock itself is not held across the send
+                try:
+                    if req["entries"] and tracer.enabled:
+                        if ctx is not None:
+                            req["trace"] = ctx
+                        with tracer.attach(ctx), \
+                                tracer.span("raft.replicate"):
+                            resp = self.transport.send(
+                                peer, "append_entries", req)
+                    else:
+                        resp = self.transport.send(
+                            peer, "append_entries", req)
+                finally:
+                    with self._wire_cond:
+                        if self._wire_epoch.get(peer, 0) == epoch:
+                            self._wire_turn[peer] = seq + 1
+                        self._wire_cond.notify_all()
+        except (ConnectionError, FaultError):
+            resp = None
+        except Exception as e:                      # noqa: BLE001
+            LOG.debug("%s: pipelined send to %s failed: %s",
+                      self.id, peer, e)
+            resp = None
+        self._pipe_ack(peer, epoch, seq, req, resp, t_start)
+
+    def _pipe_ack(self, peer: str, epoch: int, seq: int, req: Dict,
+                  resp: Optional[Dict], t_start: float) -> None:
+        """Process one batch's ack IN SEND ORDER: wait for our turn,
+        then run the exact synchronous success/failure bookkeeping.
+        A failed or out-of-term ack drains the window — every batch
+        behind it is discarded (their acks become stale-epoch no-ops)
+        and the peer falls back to the sync path."""
+        entries = req["entries"]
+        lag_s = None
+        ok = False
+        refill = False
+        with self._lock:
+            while (not self._shutdown.is_set()
+                   and self._pipe_epoch.get(peer, 0) == epoch
+                   and self._pipe_ack_turn.get(peer, 0) != seq):
+                self._pipe_cond.wait(0.1)
+            if (self._shutdown.is_set()
+                    or self._pipe_epoch.get(peer, 0) != epoch):
+                # drained while we waited: the window was reset; the
+                # follower may have appended anyway — duplicates are
+                # idempotent on the sync retry
+                self._pipe_cond.notify_all()
+                return
+            self._pipe_ack_turn[peer] = seq + 1
+            self._pipe_inflight[peer] = max(
+                0, self._pipe_inflight.get(peer, 0) - 1)
+            self._pipe_cond.notify_all()
+            if self.state != LEADER or self.current_term != req["term"]:
+                self._pipe_drain_locked(peer)
+                return
+            if resp is None:
+                self._pipe_drain_locked(peer)
+                self._wake_peer(peer)
+                return
+            if resp["term"] > self.current_term:
+                self._pipe_drain_locked(peer)
+                self._step_down_locked(resp["term"])
+                return
+            self.peer_last_contact[peer] = time.monotonic()
+            self._note_lease_contact_locked(peer, t_start)
+            if resp.get("success"):
+                ok = True
+                newest = entries[-1].index
+                stamp = self._append_stamps.get(newest)
+                if stamp is not None:
+                    lag_s = time.monotonic() - stamp
+                    self._obs_pending.append((RAFT_REPLICATION, lag_s))
+                if newest > self.match_index.get(peer, 0):
+                    self.match_index[peer] = newest
+                if newest + 1 > self.next_index.get(peer, 0):
+                    self.next_index[peer] = newest + 1
+                self._advance_commit_locked()
+                self._maybe_drop_snapshot_cache_locked()
+                frontier = self._pipe_next.get(
+                    peer, 0) or self.next_index.get(peer, 0)
+                if frontier <= self.log.last_index():
+                    refill = True
+            else:
+                # conflict: resolution is SERIAL by design — back off
+                # next_index with the hint, drain, go sync
+                hint = resp.get("conflict_index")
+                self.next_index[peer] = max(
+                    1, hint if hint else self.next_index.get(peer, 2) - 1)
+                self._pipe_drain_locked(peer)
+                self._wake_peer(peer)
+                return
+        if ok:
+            raft_observer.note_replicated(
+                self.id, peer, len(entries),
+                lag_ms=round(lag_s * 1e3, 3) if lag_s is not None
+                else None)
+        self._obs_flush()
+        if refill:
+            self._wake_peer(peer)
+
+    def _wake_peer(self, peer: str) -> None:
+        with self._lock:
+            wake = self._peer_wakes.get(peer)
+        if wake is not None:
+            wake.set()
+
+    def _pipe_drain_locked(self, peer: str) -> None:
+        """Reset the peer's window (caller holds self._lock): bump the
+        epoch so in-flight acks discard themselves, zero the sequence
+        counters, disarm — the next contact goes through the sync path
+        and re-arms on success."""
+        self._pipe_epoch[peer] = self._pipe_epoch.get(peer, 0) + 1
+        self._pipe_seq[peer] = 0
+        self._pipe_ack_turn[peer] = 0
+        self._pipe_inflight[peer] = 0
+        self._pipe_next.pop(peer, None)
+        if self._pipe_ok.get(peer):
+            self._pipe_drains += 1
+        self._pipe_ok[peer] = False
+        self._pipe_cond.notify_all()
+        # release wire-turnstile waiters: they see the epoch move and
+        # discard without sending (raft_node -> raft_pipe_wire is the
+        # only edge between these locks; senders never take self._lock
+        # while holding the turnstile)
+        with self._wire_cond:
+            self._wire_epoch[peer] = self._pipe_epoch[peer]
+            self._wire_turn[peer] = 0
+            self._wire_cond.notify_all()
+
+    def _pipe_drain_all_locked(self) -> None:
+        for p in self.peers:
+            self._pipe_drain_locked(p)
+
+    # --- leader lease (ISSUE 18) ----------------------------------------
+
+    def _note_lease_contact_locked(self, peer: str, t_start: float) -> None:
+        """Record an acked RPC's SEND-start stamp (monotone per peer)."""
+        if t_start > self._lease_contact.get(peer, 0.0):
+            self._lease_contact[peer] = t_start
+
+    def _lease_window(self) -> float:
+        return (self.config.election_timeout_min
+                * self.config.lease_fraction)
+
+    def _lease_quorum_stamp_locked(self) -> Optional[float]:
+        """The send stamp at which a quorum (self + enough peers) had
+        acked — the lease extends ``_lease_window()`` past it. None
+        when no quorum of peers has ever acked this leadership."""
+        if not self.peers:
+            return time.monotonic()
+        need = (len(self.peers) + 1) // 2   # peers needed beyond self
+        if need == 0:
+            return time.monotonic()
+        stamps = sorted((self._lease_contact.get(p, 0.0)
+                         for p in self.peers), reverse=True)
+        stamp = stamps[need - 1]
+        return stamp if stamp > 0.0 else None
+
+    def lease_valid(self) -> bool:
+        """True while this leader's clock-based lease holds: a quorum
+        of AppendEntries acks with send stamps within
+        ``election_timeout_min * lease_fraction``. While True,
+        leader-side linearizable reads may skip the barrier round-trip
+        (server.py linearizable_read); on False they demote to the
+        leader barrier. Never true off-leader or with leases off."""
+        with self._lock:
+            return self._lease_valid_locked()
+
+    def _lease_valid_locked(self) -> bool:
+        if self.state != LEADER or not self.config.leader_lease:
+            return False
+        stamp = self._lease_quorum_stamp_locked()
+        if stamp is None:
+            return False
+        return time.monotonic() - stamp <= self._lease_window()
+
+    def note_lease_read(self, fast: bool) -> None:
+        """Server-side accounting: a linearizable read served off the
+        lease fast path (True) or demoted to the barrier (False). A
+        held->lapsed transition lands one ``lease_expired`` event in
+        the consensus timeline (raft/observe.py) so chaos cells can
+        line lease loss up against partitions and elections."""
+        expired_term = None
+        with self._lock:
+            if fast:
+                self._lease_reads_fast += 1
+                self._lease_was_valid = True
+            else:
+                self._lease_reads_barrier += 1
+                if self._lease_was_valid:
+                    self._lease_was_valid = False
+                    expired_term = self.current_term
+        if expired_term is not None:
+            raft_observer.note_event(
+                self.id, "lease_expired", term=expired_term)
 
     def _build_snapshot_req_locked(self) -> Dict:
         # the request carries the CACHE's own (index, term) — never
@@ -820,7 +1219,16 @@ class RaftNode:
 
     # --- apply loop -----------------------------------------------------
 
+    #: committed entries drained per apply wakeup — bounds one batch's
+    #: future-response burst and event list during post-restart catch-up
+    _APPLY_BATCH_MAX = 1024
+
     def _run_apply(self) -> None:
+        """Batched apply drain (ISSUE 18): each wakeup takes the FULL
+        committed-but-unapplied range (capped) and applies it as ONE
+        batch — one _fsm_lock span, and (through fsm_apply_batch) one
+        store write-txn root swap + one event-stream publish stamp —
+        instead of the seed's per-entry lock/notify churn."""
         while not self._shutdown.is_set():
             with self._lock:
                 if self.last_applied >= self.commit_index:
@@ -829,78 +1237,137 @@ class RaftNode:
                     return
                 if self.last_applied >= self.commit_index:
                     continue
-                index = self.last_applied + 1
-                entry = self.log.get(index)
-                fut = self._futures.pop(index, None)
-                barrier_hit = (
-                    entry is not None
-                    and entry.kind == LOG_NOOP
-                    and entry.term == self._leader_barrier_term
-                    and self.state == LEADER
-                )
-            if entry is None:
-                with self._lock:
-                    self.last_applied = index
-                continue
-            result, error = None, None
-            with self._fsm_lock:
-                with self._lock:
-                    if self.last_applied + 1 != index:
-                        # a snapshot install moved the applied
-                        # frontier while this entry waited on
-                        # _fsm_lock: the restored state already
-                        # CONTAINS it — applying it now would
-                        # double-apply and regress the frontier
-                        stale = True
-                    else:
-                        stale = False
-                if stale:
-                    if fut is not None:
-                        # committed and folded into the snapshot; the
-                        # per-entry result is gone with it
-                        fut.respond(None, None)
-                    continue
-                if entry.kind == LOG_COMMAND:
-                    msg_type, req = entry.data
-                    try:
-                        if msg_type == RAFT_REMOVE_PEER:
-                            # replicated membership change: applied on
-                            # every replica at the same log position
-                            self._apply_remove_peer(req["peer"])
-                            result = index
-                        elif msg_type == RAFT_ADD_PEER:
-                            self._apply_add_peer(req["peer"])
-                            result = index
-                        else:
-                            # committed-entry apply seam. NOTE: error
-                            # injection here on a REPLICATED cluster
-                            # diverges replicas (the entry applies on
-                            # the others) — the reference panics for
-                            # the same reason; chaos schedules use
-                            # latency only on clusters, errors only
-                            # single-server (docs/ROBUSTNESS.md)
-                            fault("raft.fsm.apply")
-                            # raft-apply is the waterfall envelope
-                            # around the FSM's own fsm.apply span
-                            # (leaf-out: fsm claims first, this span
-                            # keeps the dispatch residue)
-                            with tracer.span("raft.apply"):
-                                result = self.fsm_apply(msg_type, req)
-                    except Exception as e:          # noqa: BLE001
-                        error = e
-                        LOG.warning(
-                            "%s: FSM apply %s failed: %s", self.id, msg_type, e
-                        )
-                with self._lock:
-                    self.last_applied = index
-            if fut is not None:
-                fut.respond(result, error)
+                start = self.last_applied + 1
+                end = min(self.commit_index,
+                          start + self._APPLY_BATCH_MAX - 1)
+                batch = [(i, self.log.get(i), self._futures.pop(i, None))
+                         for i in range(start, end + 1)]
+                barrier_term = self._leader_barrier_term
+                is_leader = self.state == LEADER
+            barrier_hit = self._apply_committed_batch(
+                batch, barrier_term, is_leader)
             if barrier_hit:
                 with self._lock:
                     self._leader_barrier_term = -1
                 if self.on_leader is not None:
-                    threading.Thread(target=self.on_leader, daemon=True).start()
+                    threading.Thread(
+                        target=self.on_leader, daemon=True).start()
             self._maybe_snapshot()
+
+    def _apply_committed_batch(self, batch, barrier_term: int,
+                               is_leader: bool) -> bool:
+        """Apply one committed range under ONE _fsm_lock hold.
+
+        Contiguous runs of plain commands go through ``fsm_apply_batch``
+        (one store root swap on the other side) when wired, else
+        per-entry ``fsm_apply`` inside the same hold. Membership
+        changes and noops break runs and apply inline, preserving
+        strict log order. Futures respond AFTER the lock drops.
+        Returns whether the leadership barrier noop applied."""
+        barrier_hit = False
+        responses: List[Tuple[Optional[_ApplyFuture], Any,
+                              Optional[Exception]]] = []
+        with self._fsm_lock:
+            with self._lock:
+                frontier = self.last_applied
+            run: List[Tuple[str, Dict]] = []
+            run_futs: List[Optional[_ApplyFuture]] = []
+
+            def flush_run() -> None:
+                if not run:
+                    return
+                if self.fsm_apply_batch is not None:
+                    # raft-apply is the waterfall envelope around the
+                    # FSM's own fsm.apply span (leaf-out: fsm claims
+                    # first, this span keeps the dispatch residue)
+                    with tracer.span("raft.apply"):
+                        try:
+                            results = self.fsm_apply_batch(list(run))
+                        except Exception as e:      # noqa: BLE001
+                            # the batch doorway contains per-entry
+                            # failures itself; anything escaping it
+                            # must not kill the apply loop
+                            results = [(None, e)] * len(run)
+                else:
+                    results = []
+                    with tracer.span("raft.apply"):
+                        for msg_type, req in run:
+                            try:
+                                results.append(
+                                    (self.fsm_apply(msg_type, req), None))
+                            except Exception as e:  # noqa: BLE001
+                                results.append((None, e))
+                for fut, (result, error) in zip(run_futs, results):
+                    if error is not None:
+                        LOG.warning("%s: FSM apply failed: %s",
+                                    self.id, error)
+                    responses.append((fut, result, error))
+                run.clear()
+                run_futs.clear()
+
+            applied_to = frontier
+            for index, entry, fut in batch:
+                if index <= frontier:
+                    # a snapshot install moved the applied frontier
+                    # while this batch waited on _fsm_lock: the
+                    # restored state already CONTAINS these entries —
+                    # applying them now would double-apply
+                    if fut is not None:
+                        responses.append((fut, None, None))
+                    continue
+                applied_to = index
+                if entry is None:
+                    continue
+                if entry.kind == LOG_COMMAND:
+                    msg_type, req = entry.data
+                    if msg_type in (RAFT_REMOVE_PEER, RAFT_ADD_PEER):
+                        # replicated membership change: applied on
+                        # every replica at the same log position —
+                        # flush first so log order is preserved
+                        flush_run()
+                        try:
+                            if msg_type == RAFT_REMOVE_PEER:
+                                self._apply_remove_peer(req["peer"])
+                            else:
+                                self._apply_add_peer(req["peer"])
+                            responses.append((fut, index, None))
+                        except Exception as e:      # noqa: BLE001
+                            LOG.warning(
+                                "%s: FSM apply %s failed: %s",
+                                self.id, msg_type, e)
+                            responses.append((fut, None, e))
+                        continue
+                    # committed-entry apply seam, fired per entry as
+                    # the run assembles. NOTE: error injection here on
+                    # a REPLICATED cluster diverges replicas (the
+                    # entry applies on the others) — the reference
+                    # panics for the same reason; chaos schedules use
+                    # latency only on clusters, errors only
+                    # single-server (docs/ROBUSTNESS.md)
+                    try:
+                        fault("raft.fsm.apply")
+                    except Exception as e:          # noqa: BLE001
+                        LOG.warning("%s: FSM apply %s failed: %s",
+                                    self.id, msg_type, e)
+                        responses.append((fut, None, e))
+                        continue
+                    run.append((msg_type, req))
+                    run_futs.append(fut)
+                    continue
+                # noop (possibly the leadership barrier)
+                if (entry.kind == LOG_NOOP and is_leader
+                        and entry.term == barrier_term):
+                    barrier_hit = True
+                if fut is not None:
+                    responses.append((fut, None, None))
+            flush_run()
+            with self._lock:
+                if applied_to > self.last_applied:
+                    self.last_applied = applied_to
+        for fut, result, error in responses:
+            if fut is not None:
+                fut.respond(result, error)
+        return barrier_hit
 
     # --- snapshots ------------------------------------------------------
 
@@ -959,6 +1426,20 @@ class RaftNode:
 
     def _on_request_vote(self, req: Dict) -> Dict:
         with self._lock:
+            if (self.config.leader_lease
+                    and self.state == FOLLOWER
+                    and self.leader_id is not None
+                    and req["candidate"] != self.leader_id
+                    and time.monotonic() - self._last_contact
+                    < self.config.election_timeout_min):
+                # lease-safety half of the leader lease (raft §6 /
+                # CheckQuorum): while this follower heard its leader
+                # within election_timeout_min it refuses votes WITHOUT
+                # adopting the candidate's term — otherwise any
+                # partitioned rejoiner could depose a leader whose
+                # clock lease (a strict fraction of this window) is
+                # still live, and a lease-read would go stale
+                return {"term": self.current_term, "granted": False}
             if req["term"] > self.current_term:
                 self._step_down_locked(req["term"])
             granted = False
@@ -1202,9 +1683,12 @@ class RaftNode:
         now = time.monotonic()
         with self._lock:
             last_log = self.log.last_index()
+            leader = self.state == LEADER
+            lease_stamp = (self._lease_quorum_stamp_locked()
+                           if leader else None)
             return {
                 "state": self.state,
-                "is_leader": 1 if self.state == LEADER else 0,
+                "is_leader": 1 if leader else 0,
                 "term": self.current_term,
                 "commit_index": self.commit_index,
                 "last_applied": self.last_applied,
@@ -1212,11 +1696,30 @@ class RaftNode:
                 "peer_lag_entries": {
                     p: last_log - self.match_index.get(p, 0)
                     for p in self.peers
-                } if self.state == LEADER else {},
+                } if leader else {},
                 "peer_last_contact_s": {
                     p: round(now - self.peer_last_contact[p], 3)
                     for p in self.peers if p in self.peer_last_contact
                 },
+                # pipeline window health (ISSUE 18)
+                "pipeline_inflight": {
+                    p: self._pipe_inflight.get(p, 0)
+                    for p in self.peers
+                } if leader else {},
+                # _pipe_ok is recorded even at max_in_flight=1 (the
+                # sync path arms it; the dispatcher just never asks) —
+                # the gauge reports 0 unless the window is enabled
+                "pipeline_armed": sum(
+                    1 for p in self.peers if self._pipe_ok.get(p))
+                if leader and self.config.max_in_flight > 1 else 0,
+                "pipeline_batches": self._pipe_batches,
+                "pipeline_drains": self._pipe_drains,
+                # leader lease (ISSUE 18)
+                "lease_valid": 1 if self._lease_valid_locked() else 0,
+                "lease_age_s": round(now - lease_stamp, 4)
+                if lease_stamp is not None else None,
+                "lease_reads_fast": self._lease_reads_fast,
+                "lease_reads_barrier": self._lease_reads_barrier,
             }
 
     def cluster_health(self) -> Dict:
@@ -1333,6 +1836,11 @@ class RaftNode:
             self.next_index.pop(peer, None)
             self.match_index.pop(peer, None)
             self.peer_last_contact.pop(peer, None)
+            # stranded in-flight acks see the epoch bump and discard
+            # (the bumped epoch entry itself stays so they CAN see it)
+            self._pipe_drain_locked(peer)
+            self._pipe_ok.pop(peer, None)
+            self._lease_contact.pop(peer, None)
             wake = self._peer_wakes.pop(peer, None)
         if wake is not None:
             wake.set()

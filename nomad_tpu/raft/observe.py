@@ -41,7 +41,7 @@ __all__ = ["RaftObserver", "raft_observer"]
 EVENT_KINDS = (
     "election_start", "leader_won", "term_adopt", "stepdown",
     "killed", "wal_failed", "recovery", "snapshot_install",
-    "established", "revoked", "converged",
+    "established", "revoked", "converged", "lease_expired",
 )
 
 #: most servers ever tracked (tests boot hundreds of short-lived

@@ -12,7 +12,7 @@ a real log.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.eval_plan import Evaluation
@@ -99,13 +99,65 @@ class NomadFSM:
                                  stamp=time.monotonic())
         return index
 
+    def apply_batch(self, entries: List[Tuple[str, Dict]]) -> List:
+        """Apply a committed run of entries as ONE store batch: one
+        FSM-lock span, one root swap (``StateStore.batch_txn``), one
+        event-broker publish stamp. Returns one ``(index, error)`` per
+        entry, in order — an entry that raises poisons only itself
+        (its slot carries the exception, its writes fold away with its
+        aborted inner txn) and the rest of the batch still commits,
+        matching the per-entry apply's containment.
+
+        Events are collected per entry (each carries its own commit
+        index) but published once, AFTER the batch root is visible —
+        so a consumer woken by the stream can always read the state
+        that produced it, and deployment lookups resolve against the
+        committed batch."""
+        import time
+
+        from nomad_tpu.telemetry.trace import tracer
+        from nomad_tpu.utils.faultpoints import fault
+
+        results: List = []
+        pending_events: List[Tuple[str, Dict, int]] = []
+        with tracer.span("fsm.apply"):
+            with self._lock:
+                with self.state.batch_txn():
+                    for msg_type, req in entries:
+                        try:
+                            fault("fsm.apply.pre")
+                            handler = self._DISPATCH.get(msg_type)
+                            if handler is None:
+                                raise ValueError(
+                                    f"unknown FSM message type {msg_type}")
+                            index = handler(self, req)
+                        except Exception as exc:  # noqa: BLE001
+                            results.append((None, exc))
+                        else:
+                            results.append((index, None))
+                            pending_events.append((msg_type, req, index))
+            # one stamp for the whole batch: the delivery-lag window
+            # starts when the batch commits, same as the per-entry path
+            stamp = time.monotonic()
+            events = []
+            for msg_type, req, index in pending_events:
+                self._collect_events(events, msg_type, req, index)
+            if events and self.event_broker is not None:
+                self.event_broker.publish(events, stamp=stamp)
+        return results
+
     def _publish_events(self, msg_type: str, req: Dict, index: int,
                         stamp: float = 0.0) -> None:
         if self.event_broker is None:
             return
-        from nomad_tpu.server import stream
+        events: List = []
+        self._collect_events(events, msg_type, req, index)
+        if events:
+            self.event_broker.publish(events, stamp=stamp or None)
 
-        events = []
+    def _collect_events(self, events: List, msg_type: str, req: Dict,
+                        index: int) -> None:
+        from nomad_tpu.server import stream
 
         def ev(topic, etype, key, payload=None, ns=""):
             events.append(stream.Event(
@@ -147,8 +199,6 @@ class NomadFSM:
             if d is not None:
                 ev(stream.TOPIC_DEPLOYMENT, "DeploymentUpdate",
                    req["deployment_id"], d, d.namespace or "")
-        if events:
-            self.event_broker.publish(events, stamp=stamp or None)
 
     # --- node (fsm.go applyUpsertNode etc.) -----------------------------
 
@@ -191,7 +241,7 @@ class NomadFSM:
         if req.get("purge"):
             index = self.state.delete_job(ns, job_id)
         else:
-            job = self.state.snapshot().job_by_id(ns, job_id)
+            job = self.state.job_by_id_direct(ns, job_id)
             if job is None:
                 index = self.state.latest_index()
             else:

@@ -201,6 +201,30 @@ class _PlanOverlay:
                 placed[a.id] = a
         return placed, removed
 
+    def job_adjustment(self, namespace: str, job_id: str):
+        """(placements_by_id, removed_ids) for one JOB across entries —
+        ``node_adjustment``'s replay semantics keyed by job instead of
+        node. The duplicate-slot guard needs job-wide visibility: a
+        redelivered eval's twin plan can re-place a committed slot on a
+        DIFFERENT node, so a per-node merge would never see the
+        collision. ``removed`` may carry other jobs' ids; callers only
+        use it to filter rows of this job."""
+        with self._lock:
+            entries = list(self._entries.values())
+        placed: Dict[str, Allocation] = {}
+        removed = set()
+        for r in entries:
+            for src in (r.node_update, r.node_preemptions):
+                for allocs in src.values():
+                    for a in allocs:
+                        removed.add(a.id)
+                        placed.pop(a.id, None)
+            for allocs in r.node_allocation.values():
+                for a in allocs:
+                    if a.namespace == namespace and a.job_id == job_id:
+                        placed[a.id] = a
+        return placed, removed
+
 
 class _LiveView:
     """Freshest-generation read proxy for plan evaluation.
@@ -240,6 +264,18 @@ class _LiveView:
         else:
             placed, removed = {}, set()
         rows = self._store.allocs_by_node_direct(node_id)
+        by_id = {a.id: a for a in rows if a.id not in removed}
+        by_id.update(placed)
+        return list(by_id.values())
+
+    def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
+        # same overlay-before-store merge as allocs_by_node, keyed by
+        # job: the duplicate-slot guard's job-wide read
+        if self._overlay is not None:
+            placed, removed = self._overlay.job_adjustment(namespace, job_id)
+        else:
+            placed, removed = {}, set()
+        rows = self._store.allocs_by_job_direct(namespace, job_id)
         by_id = {a.id: a for a in rows if a.id not in removed}
         by_id.update(placed)
         return list(by_id.values())
@@ -706,7 +742,12 @@ class Planner:
         # seconds per applier stage (where plan latency actually goes)
         self.plans_full = 0
         self.plans_partial = 0
-        self.stage_s = {"queue_wait": 0.0, "evaluate": 0.0, "commit": 0.0}
+        # duplicate-slot rejections (see _duplicate_slot_nodes): a
+        # correctness backstop firing only on redelivered-eval races,
+        # so any nonzero count is worth a look
+        self.plans_duplicate_slot = 0
+        self.stage_s = {"queue_wait": 0.0, "evaluate": 0.0, "commit": 0.0,
+                        "commit_wait": 0.0}
         # persistent re-check pool (plan_apply_pool.go:18 EvaluatePool)
         self._pool = (
             ThreadPoolExecutor(
@@ -810,9 +851,16 @@ class Planner:
             if not evaluated:
                 continue
             # serialize commits: wait for the previous apply before
-            # launching this one (evaluation above already overlapped)
+            # launching this one (evaluation above already overlapped).
+            # commit_wait is the head-of-line block the raft
+            # replication pipeline (ISSUE 18) is meant to shrink —
+            # while batch N's quorum is in flight, N+1 can only sit
+            # here, so this stage counter is the applier-side view of
+            # the commit window.
             if in_flight is not None:
+                t_wait = time.perf_counter()
                 in_flight.join()
+                self.stage_s["commit_wait"] += time.perf_counter() - t_wait
             in_flight = threading.Thread(
                 target=self._apply_batch_async,
                 args=(evaluated, overlay),
@@ -1009,11 +1057,17 @@ class Planner:
             deployment_updates=list(plan.deployment_updates),
         )
         partial = False
+        dup_nodes = self._duplicate_slot_nodes(snapshot, plan, fits)
         for node_id in plan.node_allocation:
-            if fits[node_id]:
+            if fits[node_id] and node_id not in dup_nodes:
                 result.node_allocation[node_id] = plan.node_allocation[node_id]
                 if node_id in plan.node_preemptions:
                     result.node_preemptions[node_id] = plan.node_preemptions[node_id]
+            elif node_id in dup_nodes:
+                # NOT the node's fault — keep it out of the
+                # plan-rejection / mark-ineligible tracker
+                partial = True
+                self.plans_duplicate_slot += 1
             else:
                 partial = True
                 self._note_node_rejection(node_id)
@@ -1028,6 +1082,65 @@ class Planner:
         else:
             self.plans_full += 1
         return result
+
+    def _duplicate_slot_nodes(self, snapshot, plan: Plan,
+                              fits: Dict[str, bool]) -> set:
+        """Nodes whose placements would duplicate a live slot name.
+
+        The token check at dequeue (``_validate_token``) catches plans
+        whose broker lease was re-enqueued under THEM — but not the
+        mirror race: after a leader failover the broker restore
+        redelivers a still-pending eval whose previous plan ALREADY
+        committed (the commit replicated; the worker's EVAL_UPDATE to
+        complete did not). The twin holds a legitimately current token
+        and a snapshot that can predate the first commit, so it
+        re-places the same slots — on any node — and nothing downstream
+        would object. This guard is the objection: a placement whose
+        (namespace, job, slot name) already has a live alloc that this
+        plan neither supersedes (same id re-placed: in-place update)
+        nor removes (node_update / preemption) is rejected, and the
+        partial-commit ``refresh_index`` sends the scheduler back for a
+        fresh-snapshot retry, where reconcile sees the committed slots
+        and places nothing. Canary placements are exempt both ways —
+        a canary legitimately shares its slot name with the alloc it
+        shadows, and rejecting it forever would wedge the deployment.
+        System/sysbatch jobs place ``group[0]`` on EVERY node, so for
+        them the collision scope narrows to the placement's own node —
+        which still catches the twin (it re-places the same nodes).
+        """
+        job = plan.job
+        same_node_only = job is not None and getattr(job, "type", "") in (
+            consts.JOB_TYPE_SYSTEM, consts.JOB_TYPE_SYSBATCH)
+        dup: set = set()
+        remove_ids: set = set()
+        for src in (plan.node_update, plan.node_preemptions):
+            for allocs in src.values():
+                remove_ids.update(a.id for a in allocs)
+        plan_ids = {a.id for allocs in plan.node_allocation.values()
+                    for a in allocs}
+        live_cache: Dict[Tuple[str, str], List[Allocation]] = {}
+        for node_id, placements in plan.node_allocation.items():
+            if not fits.get(node_id):
+                continue                    # already rejected
+            for p in placements:
+                if p.deployment_status is not None \
+                        and p.deployment_status.canary:
+                    continue
+                key = (p.namespace, p.job_id)
+                rows = live_cache.get(key)
+                if rows is None:
+                    rows = live_cache[key] = snapshot.allocs_by_job(*key)
+                if any(a.name == p.name and a.id != p.id
+                       and (not same_node_only or a.node_id == p.node_id)
+                       and a.id not in remove_ids
+                       and a.id not in plan_ids
+                       and not a.terminal_status()
+                       and not (a.deployment_status is not None
+                                and a.deployment_status.canary)
+                       for a in rows):
+                    dup.add(node_id)
+                    break
+        return dup
 
     def _note_node_rejection(self, node_id: str) -> None:
         """One rejected node plan into the process-wide tracker

@@ -76,6 +76,9 @@ class ServerConfig:
         data_dir: str = "",
         raft_fsync_policy: str = "batch",
         scheduler_workers: int = 0,
+        raft_max_in_flight: int = 8,
+        raft_leader_lease: bool = True,
+        raft_lease_fraction: float = 0.75,
     ) -> None:
         self.num_workers = num_workers
         self.worker_batch_size = worker_batch_size
@@ -155,6 +158,18 @@ class ServerConfig:
         # the device mesh, plan apply, raft, and serving plane. 0 =
         # everything in-process, today's behavior, bit-identical.
         self.scheduler_workers = scheduler_workers
+        # pipelined AppendEntries + leader leases (ISSUE 18,
+        # raft/node.py RaftConfig): max_in_flight bounds the per-peer
+        # replication window (1 = the synchronous send->ack->send
+        # path, bit-identical to pre-pipeline behavior); leader_lease
+        # lets leader-side linearizable reads skip the quorum barrier
+        # while a quorum of AppendEntries acks landed within
+        # lease_fraction of election_timeout_min. Only consulted when
+        # setup_raft builds the RaftConfig itself (an explicit
+        # raft_config argument wins, knobs and all).
+        self.raft_max_in_flight = raft_max_in_flight
+        self.raft_leader_lease = raft_leader_lease
+        self.raft_lease_fraction = raft_lease_fraction
 
 
 class ClientUpdateStats:
@@ -396,16 +411,23 @@ class Server:
         state (term/vote, snapshot, WAL) from ``<data_dir>/raft``
         before the node participates — the RaftNode constructor runs
         restore_fn into this server's state store."""
-        from nomad_tpu.raft.node import RaftNode
+        from nomad_tpu.raft.node import RaftConfig, RaftNode
 
         data_dir = ""
         if self.config.data_dir:
             data_dir = os.path.join(self.config.data_dir, "raft")
+        if raft_config is None:
+            raft_config = RaftConfig(
+                max_in_flight=self.config.raft_max_in_flight,
+                leader_lease=self.config.raft_leader_lease,
+                lease_fraction=self.config.raft_lease_fraction,
+            )
         self.raft = RaftNode(
             node_id=node_id,
             peers=peers,
             transport=transport,
             fsm_apply=self.fsm.apply,
+            fsm_apply_batch=self.fsm.apply_batch,
             config=raft_config,
             snapshot_fn=self.state.to_snapshot_bytes,
             restore_fn=self.state.restore_from_bytes,
@@ -727,6 +749,28 @@ class Server:
 
     def is_leader(self) -> bool:
         return self._leader
+
+    def linearizable_read(self) -> None:
+        """Gate a leader-side read so it is linearizable (ISSUE 18).
+
+        With a valid leader lease (a quorum of AppendEntries acks
+        landed within ``lease_fraction`` of the minimum election
+        timeout — see raft/node.py lease clock math) the local store
+        is provably current and the read proceeds immediately. When
+        the lease lapsed (partition, quiet cluster with heartbeats
+        failing) the read demotes to the leader barrier: a no-op entry
+        committed through quorum, the pre-lease path. Deposed leaders
+        fail here (NotLeaderError from the barrier) instead of serving
+        stale state. No raft attached = single-process authority, the
+        local store IS the state."""
+        raft = self.raft
+        if raft is None:
+            return
+        if raft.lease_valid():
+            raft.note_lease_read(True)
+            return
+        raft.note_lease_read(False)
+        raft.barrier()
 
     def establish_leadership(self) -> None:
         """leader.go:277 establishLeadership: enable the leader-only
@@ -1441,7 +1485,12 @@ class Server:
                           timeout: float = 0.0) -> Dict:
         """Node.GetClientAllocs: the client's blocking query for its
         assigned allocations (node_endpoint.go GetClientAllocs;
-        client.go:2063 watchAllocations)."""
+        client.go:2063 watchAllocations).
+
+        Linearizable: lease-gated (fast path) or barrier-demoted, so a
+        client polling a just-deposed leader never sees a stale
+        assignment set presented as current."""
+        self.linearizable_read()
         index = self.state.block_until(["allocs"], min_index, timeout)
         snap = self.state.snapshot()
         allocs = snap.allocs_by_node(node_id)

@@ -411,6 +411,45 @@ def prometheus_text(registry=None, event_broker=None) -> str:
                     lines.append(
                         f'nomad_tpu_raft_peer_last_contact_seconds'
                         f'{{{_lbl(server_id=sid, peer=peer)}}} {age}')
+            # replication pipeline + leader lease (ISSUE 18): window
+            # occupancy per peer, arm/drain counters, and the lease
+            # fast-path/barrier read split
+            for series, key, mtype in (
+                    ("nomad_tpu_raft_pipeline_armed_peers",
+                     "pipeline_armed", "gauge"),
+                    ("nomad_tpu_raft_pipeline_batches_total",
+                     "pipeline_batches", "counter"),
+                    ("nomad_tpu_raft_pipeline_drains_total",
+                     "pipeline_drains", "counter"),
+                    ("nomad_tpu_raft_lease_valid", "lease_valid",
+                     "gauge"),
+                    ("nomad_tpu_raft_lease_age_seconds", "lease_age_s",
+                     "gauge")):
+                lines.append(f"# TYPE {series} {mtype}")
+                for sid, row in live.items():
+                    val = row.get(key)
+                    if val is None:
+                        continue
+                    lines.append(
+                        f'{series}{{{_lbl(server_id=sid)}}} {val}')
+            lines.append(
+                "# TYPE nomad_tpu_raft_pipeline_inflight_batches gauge")
+            lines.append(
+                "# TYPE nomad_tpu_raft_lease_reads_total counter")
+            for sid, row in live.items():
+                for peer, n in sorted(
+                        (row.get("pipeline_inflight") or {}).items()):
+                    lines.append(
+                        f'nomad_tpu_raft_pipeline_inflight_batches'
+                        f'{{{_lbl(server_id=sid, peer=peer)}}} {n}')
+                for path, key in (("fast", "lease_reads_fast"),
+                                  ("barrier", "lease_reads_barrier")):
+                    val = row.get(key)
+                    if val is None:
+                        continue
+                    lines.append(
+                        f'nomad_tpu_raft_lease_reads_total'
+                        f'{{{_lbl(server_id=sid, path=path)}}} {val}')
         if any(row.get("transitions") or row.get("replicated_entries")
                or row.get("snapshot_xfer_bytes")
                for row in per.values()):

@@ -112,21 +112,51 @@ def _failovers(ordered: List[Dict],
     """Scan the ordered events into failover windows with per-phase
     attribution. Phases partition loss→established by construction;
     anything un-spanned (a missing event) stays unattributed and
-    lowers the share — honest, never hidden."""
+    lowers the share — honest, never hidden.
+
+    A PARTITIONED leader never emits a loss event (it still thinks it
+    leads until the heal), so its failover is detected from the other
+    side: a server winning leadership away from a tracked leader that
+    never reported loss opens a ``partition`` window, backdated to
+    that server's election start. The mirror case — the stale
+    leader's stepdown when the heal delivers it the higher term — is
+    NOT a leadership loss (the cluster already moved on), so loss
+    events from a superseded leader are dropped rather than opening a
+    window that no election will ever close."""
     out: List[Dict] = []
     open_fo: Optional[Dict] = None
+    cur_leader: Optional[str] = None
+    last_elect: Dict[str, float] = {}
     for ev in ordered:
         kind, t = ev["kind"], ev["t_corrected"]
         was_leader = bool((ev.get("detail") or {}).get("was_leader"))
+        if kind == "election_start":
+            last_elect[ev["server"]] = t
         # only the LEADER's loss opens a failover — a killed or
         # fail-stopped follower is an event, not a leadership loss
         # (every loss-kind emitter stamps detail.was_leader)
         if kind in _LOSS_KINDS and was_leader:
+            if cur_leader is not None and ev["server"] != cur_leader:
+                # stale-leader correction after a heal: leadership
+                # already moved (tracked from the winner's side)
+                continue
             if open_fo is None:
                 open_fo = {"loss_t": t, "loss_kind": kind,
                            "leader_from": ev["server"],
                            "term_from": ev.get("term")}
             continue
+        if kind == "leader_won" and open_fo is None \
+                and cur_leader is not None and ev["server"] != cur_leader:
+            # leadership moved without a loss event: the old leader is
+            # partitioned, not dead. Backdate to the winner's election
+            # start so detect/elect stay honestly attributed.
+            loss_t = last_elect.get(ev["server"], t)
+            open_fo = {"loss_t": loss_t, "loss_kind": "partition",
+                       "leader_from": cur_leader,
+                       "term_from": None,
+                       "elect_t": loss_t}
+        if kind == "leader_won":
+            cur_leader = ev["server"]
         if open_fo is None:
             continue
         if kind == "election_start" and "elect_t" not in open_fo:

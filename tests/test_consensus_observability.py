@@ -470,6 +470,38 @@ class TestTimelineBuilder:
         assert fo["attributed_share"] == pytest.approx(1.0)
         assert tl["attribution"]["share"] == pytest.approx(1.0)
 
+    def test_partitioned_leader_failover_tracked_from_winner(self):
+        """ISSUE 18 lease-partition schedule: a partitioned leader
+        never emits a loss event (it still thinks it leads), so the
+        failover is detected from the winner's side — backdated to the
+        winner's election start — and the stale leader's stepdown at
+        heal time must NOT open a second, never-resolving window."""
+        events = [
+            {"t": 1.0, "server": "a", "kind": "leader_won", "term": 3},
+            {"t": 1.1, "server": "a", "kind": "established", "term": 3},
+            # partition: b elects itself away from a silent leader a
+            {"t": 5.0, "server": "b", "kind": "election_start",
+             "term": 4},
+            {"t": 5.3, "server": "b", "kind": "leader_won", "term": 4},
+            {"t": 5.6, "server": "b", "kind": "established", "term": 4},
+            # heal: a learns of term 4 and corrects itself — leadership
+            # already moved, this is not a new loss
+            {"t": 7.0, "server": "a", "kind": "stepdown", "term": 4,
+             "detail": {"was_leader": True}},
+        ]
+        tl = build_timeline(events, converged_mono=7.5, cell="unit")
+        assert validate_timeline(tl) == []
+        assert len(tl["failovers"]) == 1
+        fo = tl["failovers"][0]
+        assert fo["loss_kind"] == "partition"
+        assert fo["leader_from"] == "a"
+        assert fo["leader_to"] == "b"
+        assert fo["resolved"]
+        assert fo["phases_ms"]["elect"] == pytest.approx(300, abs=1)
+        assert fo["phases_ms"]["replay"] == pytest.approx(300, abs=1)
+        assert fo["attributed_share"] == pytest.approx(1.0)
+        assert tl["attribution"]["share"] == pytest.approx(1.0)
+
     def test_non_leader_stepdown_is_not_a_failover(self):
         events = [
             {"t": 1.0, "server": "a", "kind": "stepdown", "term": 2,

@@ -458,3 +458,108 @@ class TestWaveCohortDrain:
         batch = q.dequeue_batch(128, timeout=0.2)
         assert len(batch) == 1
         assert time.monotonic() - t0 < 0.05
+
+
+class TestDuplicateSlotGuard:
+    """ISSUE 18 failover regression: after a leader partition, the
+    broker restore redelivers a still-pending eval whose previous plan
+    ALREADY committed (the commit replicated; the worker's EVAL_UPDATE
+    to complete did not). The twin holds a legitimately current token
+    and evaluates from a snapshot predating the first commit, so it
+    re-places the same slots — possibly on different nodes. The
+    applier's duplicate-slot guard (`_duplicate_slot_nodes`) must
+    reject the twin and send it back partial (refresh_index) so the
+    retry reconciles against the committed slots; legitimate
+    same-name flows (stop-and-replace in one plan, in-place updates,
+    replacing terminal allocs, canaries, system jobs fanning out)
+    must pass untouched."""
+
+    def _store_with(self, node_ids):
+        store = StateStore()
+        for nid in node_ids:
+            store.upsert_node(mock.node(id=nid))
+        return store
+
+    def _placement(self, i, node_id, job_id="mock-ser"):
+        return _make_alloc({"id": f"dup-{i}", "node_id": node_id,
+                            "cpu": 500, "mem": 256, "disk": 100,
+                            "job_id": job_id})
+
+    def test_redelivered_twin_rejected_even_cross_node(self):
+        store = self._store_with(["dn-0", "dn-1"])
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        r1 = planner.apply_one(Plan(
+            node_allocation={"dn-0": [self._placement(1, "dn-0")]}))
+        assert r1.node_allocation and r1.refresh_index == 0
+        # the twin re-places the same (job, slot name) on a DIFFERENT
+        # node — a per-node check would never see the collision
+        r2 = planner.apply_one(Plan(
+            node_allocation={"dn-1": [self._placement(2, "dn-1")]}))
+        assert not r2.node_allocation
+        assert r2.refresh_index > 0
+        assert planner.plans_duplicate_slot == 1
+        live = [a for a in store.snapshot().allocs_by_job(
+                    "default", "mock-ser") if not a.terminal_status()]
+        assert [a.id for a in live] == ["dup-1"]
+
+    def test_twin_in_same_batch_rejected_via_overlay(self):
+        store = self._store_with(["dn-0", "dn-1"])
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        r1, r2 = planner.apply_batch([
+            Plan(node_allocation={"dn-0": [self._placement(1, "dn-0")]}),
+            Plan(node_allocation={"dn-1": [self._placement(2, "dn-1")]}),
+        ])
+        assert r1.node_allocation
+        assert not r2.node_allocation and r2.refresh_index > 0
+
+    def test_stop_and_replace_in_one_plan_passes(self):
+        store = self._store_with(["dn-0", "dn-1"])
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        planner.apply_one(Plan(
+            node_allocation={"dn-0": [self._placement(1, "dn-0")]}))
+        old = store.snapshot().alloc_by_id("dup-1")
+        plan = Plan(node_allocation={"dn-1": [self._placement(2, "dn-1")]})
+        plan.append_stopped_alloc(old, "migrated")
+        r = planner.apply_one(plan)
+        assert r.node_allocation and r.refresh_index == 0
+        assert planner.plans_duplicate_slot == 0
+
+    def test_in_place_update_same_id_passes(self):
+        store = self._store_with(["dn-0"])
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        planner.apply_one(Plan(
+            node_allocation={"dn-0": [self._placement(1, "dn-0")]}))
+        r = planner.apply_one(Plan(
+            node_allocation={"dn-0": [self._placement(1, "dn-0")]}))
+        assert r.node_allocation and r.refresh_index == 0
+
+    def test_replacing_terminal_alloc_passes(self):
+        store = self._store_with(["dn-0"])
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        planner.apply_one(Plan(
+            node_allocation={"dn-0": [self._placement(1, "dn-0")]}))
+        dead = _make_alloc({"id": "dup-1", "node_id": "dn-0",
+                            "cpu": 500, "mem": 256, "disk": 100,
+                            "job_id": "mock-ser",
+                            "client_status": consts.ALLOC_CLIENT_FAILED})
+        store.upsert_allocs([dead])
+        r = planner.apply_one(Plan(
+            node_allocation={"dn-0": [self._placement(2, "dn-0")]}))
+        assert r.node_allocation and r.refresh_index == 0
+
+    def test_system_job_fans_out_but_twin_on_same_node_rejected(self):
+        store = self._store_with(["dn-0", "dn-1"])
+        planner = Planner(store, PlanQueue(), pool_workers=1)
+        sysjob = mock.job(id="mock-ser", type=consts.JOB_TYPE_SYSTEM)
+        # one group[0] per node is the system scheduler's shape — the
+        # job-wide collision scope must NOT reject the fan-out
+        r1 = planner.apply_one(Plan(
+            job=sysjob,
+            node_allocation={"dn-0": [self._placement(1, "dn-0")],
+                             "dn-1": [self._placement(2, "dn-1")]}))
+        assert len(r1.node_allocation) == 2 and r1.refresh_index == 0
+        # but a stale twin re-placing an occupied NODE is still caught
+        r2 = planner.apply_one(Plan(
+            job=sysjob,
+            node_allocation={"dn-0": [self._placement(3, "dn-0")]}))
+        assert not r2.node_allocation and r2.refresh_index > 0

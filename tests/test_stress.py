@@ -358,6 +358,46 @@ class TestChaosCell:
         assert tl["failovers"] >= 1, suite["schedules"][
             "leader-kill-mid-wave"]["timeline"]["events"]
         assert tl["attributed_share"] >= 0.9, tl
+        # ISSUE 18: the lease-partition schedule's probe actually ran
+        # (the lease lapsed — barrier reads observed) and the deposed
+        # leader NEVER served a lease-valid read after the new side
+        # committed past it (the zero-stale-reads safety gate)
+        ls = suite["schedules"]["lease-leader-partition"]
+        assert ls["lease_fast_stale_reads"] == 0, ls
+        assert ls["lease_barrier_reads"] >= 1, ls
+        assert ls["lease_fast_reads"] >= 1, ls
+
+
+class TestRaftCell:
+    def test_raft_cell_under_lock_witness(self):
+        """ISSUE 18: the pipelined-vs-synchronous A/B under the
+        runtime lock witness — the per-peer wire turnstile
+        (raft_pipe_wire) is a new witnessed leaf under raft_node, so
+        any executed acquisition-order inversion in the window
+        fill/ack/drain paths fails the cell. The bench gates are
+        asserted too: the speedup comes from overlapping INJECTED 5ms
+        send latency (not from cores), so it holds on whatever box CI
+        gives this tier — and a speedup with diverged logs or a
+        drain storm is a regression, not a win."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench"))
+        import trace_report
+
+        cell = trace_report.run_raft_burst()
+        assert cell["logs_identical"], cell
+        assert not cell["sync"]["errors"], cell["sync"]["errors"]
+        assert not cell["pipelined"]["errors"], \
+            cell["pipelined"]["errors"]
+        # the sync arm must never touch the window; the pipelined arm
+        # must actually use it
+        assert cell["sync"]["pipeline_batches"] == 0, cell["sync"]
+        assert cell["pipelined"]["pipeline_batches"] > 0, \
+            cell["pipelined"]
+        assert cell["speedup_ok"], (cell["speedup"],
+                                    cell["lag_improvement"])
 
 
 class TestRestartCell:

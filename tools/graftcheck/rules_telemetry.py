@@ -57,10 +57,12 @@ _PROM_NAME = re.compile(r"\bnomad_tpu_[a-z0-9]+(?:_[a-z0-9]+)+\b")
 #: CHAOS_TIMELINE.json); store_* in ISSUE 16 (the MVCC store cell's
 #: snapshot/write-txn latency and read-lock-share lines); worker_* in
 #: ISSUE 17 (the multi-process scheduler worker cell's A/B speedup,
-#: lease-reissue, and IPC round-trip lines)
+#: lease-reissue, and IPC round-trip lines); raft_* in ISSUE 18 (the
+#: raft cell's pipelined-vs-synchronous commit-window attribution and
+#: lease-read split)
 _BENCH_KEY = re.compile(
     r"^(?:trace|contention|fleet|chaos|restart|mesh|timeline|store"
-    r"|worker)_[a-z0-9_]+$")
+    r"|worker|raft)_[a-z0-9_]+$")
 #: bench kwargs that are not emission keys (worker_batch_size is the
 #: ServerConfig in-process dequeue window, not a trend line)
 _BENCH_KEY_EXCLUDE = {"trace_id", "timeline_path", "worker_batch_size"}
