@@ -1061,13 +1061,44 @@ def _enable_compile_cache() -> None:
 
         from nomad_tpu.ops.kernel import _machine_cache_tag
 
-        cache = os.path.join(REPO, "bench", ".jax_cache",
-                             _machine_cache_tag())
+        root = os.path.join(REPO, "bench", ".jax_cache")
+        tag = _machine_cache_tag()
+        cache = os.path.join(root, tag)
         os.makedirs(cache, exist_ok=True)
+        _gc_compile_cache(root, tag)
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as e:                       # noqa: BLE001
         print(f"warning: compile cache unavailable: {e}", file=sys.stderr)
+
+
+#: foreign machine tags the AOT-cache GC leaves behind (newest-first);
+#: boxes beyond this age out with their artifacts
+_CACHE_KEEP_FOREIGN_TAGS = 2
+
+
+def _gc_compile_cache(root: str, keep_tag: str,
+                      keep_foreign: int = _CACHE_KEEP_FOREIGN_TAGS) -> None:
+    """Bounded-size GC for the repo-resident AOT cache (ISSUE 19).
+
+    The cache travels with the repo, so every box that ever ran the
+    bench leaves a fingerprint-tagged directory behind — unbounded
+    growth in checked-in artifacts nobody can load (a foreign box's
+    AOT objects are 'machine feature not supported' noise). Keep THIS
+    box's tag plus the ``keep_foreign`` most-recently-touched foreign
+    tags (a box in rotation comes back to a warm cache); delete the
+    rest. Failures are cosmetic — the cache degrades to a recompile."""
+    import shutil
+
+    try:
+        tags = [d for d in os.listdir(root)
+                if d != keep_tag and os.path.isdir(os.path.join(root, d))]
+    except OSError:
+        return
+    tags.sort(key=lambda d: os.path.getmtime(os.path.join(root, d)),
+              reverse=True)
+    for d in tags[keep_foreign:]:
+        shutil.rmtree(os.path.join(root, d), ignore_errors=True)
 
 
 def main() -> None:
@@ -1226,6 +1257,15 @@ def main() -> None:
                     "sharded_wave_launches"),
                 trace_steady_sharded_fallbacks=steady.get(
                     "sharded_wave_fallbacks"),
+                # ISSUE 19 steady keys: every steady wave through the
+                # fused mega-kernel (fallbacks gated 0), exactly ONE
+                # wave-critical device dispatch per wave
+                trace_steady_dispatches_per_wave=steady.get(
+                    "dispatches_per_wave"),
+                trace_steady_fused_launches=steady.get(
+                    "fused_wave_launches"),
+                trace_steady_fused_fallbacks=steady.get(
+                    "fused_wave_fallbacks"),
             )
             # ISSUE 8: the steady burst's e2e latency distribution +
             # tail attribution (TRACE_DECOMP gains the "tail" section;
@@ -1382,6 +1422,9 @@ def main() -> None:
                 mesh_unsharded_fallbacks=cell["sharded_fallbacks"],
                 mesh_parity_ok=cell["parity_ok"],
                 mesh_jit_cache_misses=cell["jit_cache_misses"],
+                mesh_fused_launches=cell["fused_launches"],
+                mesh_fused_fallbacks=cell["fused_fallbacks"],
+                mesh_dispatches_per_wave=cell["dispatches_per_wave"],
             )
         except Exception as e:                   # noqa: BLE001
             import traceback
@@ -1390,6 +1433,57 @@ def main() -> None:
                   file=sys.stderr)
     else:
         print("bench budget: skipping mesh cell "
+              f"({budget.remaining():.0f}s left)", file=sys.stderr)
+
+    # ISSUE 19: the fused cell — the fused wave mega-kernel A/B'd
+    # against the composite joint program + its eager result fetch on
+    # the SAME burst of waves. fused_parity_ok (bit-identity incl. the
+    # top-k planes) + fused_dispatches_per_wave == 1.0 +
+    # fused_fallbacks == 0 are the acceptance lines; fused_speedup is
+    # the per-box trajectory line (the composite arm costs one extra
+    # device interaction per wave — the eager fetch the fused program
+    # folds into its own dispatch). Reproduce with
+    # trace_report.run_fused_burst().
+    if budget.remaining() > 60:
+        try:
+            _phase("fused cell")
+            sys.path.insert(0, os.path.join(REPO, "bench"))
+            import trace_report
+
+            cell = trace_report.run_fused_burst()
+            em.update(
+                fused_nodes=cell["nodes"],
+                fused_waves=cell["waves"],
+                fused_wave_ms_p50=cell["fused_wave_ms_p50"],
+                fused_composite_wave_ms_p50=cell[
+                    "composite_wave_ms_p50"],
+                fused_speedup=cell["speedup"],
+                fused_parity_ok=cell["parity_ok"],
+                fused_dispatches_per_wave=cell["dispatches_per_wave"],
+                fused_composite_dispatches_per_wave=cell[
+                    "composite_dispatches_per_wave"],
+                fused_launches=cell["launches"],
+                fused_fallbacks=cell["fallbacks"],
+                fused_jit_cache_misses=cell["jit_cache_misses"],
+                fused_d2h_bytes_per_wave=cell["d2h_bytes_per_wave"],
+                fused_composite_d2h_bytes_per_wave=cell[
+                    "composite_d2h_bytes_per_wave"],
+            )
+            if not cell["parity_ok"]:
+                print("warning: fused cell parity FAILED (fused wave "
+                      "diverged from the composite program)",
+                      file=sys.stderr)
+            if cell["dispatches_per_wave"] != 1.0 or cell["fallbacks"]:
+                print("warning: fused cell dispatch gate FAILED "
+                      f"(dispatches/wave {cell['dispatches_per_wave']}"
+                      f", fallbacks {cell['fallbacks']})",
+                      file=sys.stderr)
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: fused cell failed ({e})", file=sys.stderr)
+    else:
+        print("bench budget: skipping fused cell "
               f"({budget.remaining():.0f}s left)", file=sys.stderr)
 
     # ISSUE 16: the store cell — the MVCC StateStore alone at the mesh

@@ -1281,6 +1281,111 @@ place_taskgroups_joint_jit = jax.jit(
 )
 
 
+# ---------------------------------------------------------------------------
+# Fused wave dispatch (ISSUE 19): ONE device program per wave.
+#
+# The composite path above costs two wave-critical device interactions
+# per launch: the joint program execution, then an eager per-field
+# fetch of eleven separate output buffers. The fused variant runs the
+# same scan as a single Pallas program (ops/pallas_kernel.fused_wave
+# _place — interpret mode off-TPU so CPU tier-1 exercises the exact
+# program) and PACKS everything the launcher fetches eagerly into one
+# flat f32 buffer, so steady state is one dispatch and one readback
+# that rides the dispatch's own synchronization. The top-k planes stay
+# separate device outputs — they are lazy (_WaveTopK) and drain in the
+# plan window, off the wave-critical path.
+# ---------------------------------------------------------------------------
+
+#: JointOut metric fields in packed-segment order (8 x [B] after the
+#: two [T] rows). Single source of truth for pack (device) and unpack
+#: (host) — a drift here would hand members another member's metrics.
+FUSED_METRIC_FIELDS = (
+    "nodes_evaluated", "nodes_feasible",
+    "exhausted_cpu", "exhausted_mem", "exhausted_disk",
+    "exhausted_ports", "exhausted_devices", "exhausted_cores",
+)
+
+
+class FusedWaveOut(NamedTuple):
+    """One fused wave's device outputs.
+
+    ``packed`` is flat f32[2*T + 8*B]: ``[0:T)`` chosen (exact as f32
+    — node ids are far below 2**24; ``found`` is NOT packed because
+    it is definitionally ``chosen >= 0``), ``[T:2T)`` scores, then the
+    eight B-wide metric segments in FUSED_METRIC_FIELDS order. 8T+32B
+    bytes — strictly below the composite's eager fetch (9T+32B), so
+    fusing never regresses d2h-per-wave."""
+
+    packed: jnp.ndarray          # f32[2*T + 8*B]
+    topk_idx: jnp.ndarray        # i32[T, TOPK]
+    topk_scores: jnp.ndarray     # f32[T, TOPK]
+    a_cpu: jnp.ndarray           # f32[N] final shared-capacity carry
+    a_mem: jnp.ndarray           # f32[N]
+    a_disk: jnp.ndarray          # f32[N]
+
+
+def fused_wave_supported(f: KernelFeatures) -> bool:
+    """Whether a wave's (canonical) feature union fits the fused
+    mega-kernel's envelope. Ports, preemption penalties, preferred
+    pins, distinct_hosts, shuffle, and top-k are all in (shuffle is
+    ALWAYS on for live evals — scheduler/generic.py seeds it per
+    eval, so excluding it would turn every live wave into a counted
+    fallback). Spread stanzas and the device/core/bandwidth planes
+    are out: rare in steady traffic and each would widen the fused
+    signature lattice ~2x — those waves take the composite path,
+    counted by ``fused_wave_stats``."""
+    return (f.n_spreads == 0 and not f.with_devices
+            and not f.with_cores and not f.with_network)
+
+
+def fused_pack_len(t_steps: int, b: int) -> int:
+    return 2 * t_steps + 8 * b
+
+
+def pack_fused_wave(out: JointOut, t_steps: int, b: int) -> jnp.ndarray:
+    """Pack a JointOut's eagerly-fetched planes into the flat f32
+    buffer (device side; see FusedWaveOut.packed layout)."""
+    parts = [out.chosen.astype(jnp.float32), out.scores]
+    parts += [getattr(out, name).astype(jnp.float32)
+              for name in FUSED_METRIC_FIELDS]
+    return jnp.concatenate(parts)
+
+
+def unpack_fused_wave(packed: np.ndarray, t_steps: int, b: int) -> dict:
+    """Host-side inverse of ``pack_fused_wave``: the launcher's eager
+    fetch dict (same keys as coalesce._JOINT_FETCH_FIELDS, same
+    dtypes as the composite's per-field ``np.asarray`` fetch)."""
+    flat = np.asarray(packed)
+    chosen = flat[:t_steps].astype(np.int32)
+    host = {
+        "chosen": chosen,
+        "scores": flat[t_steps:2 * t_steps].astype(np.float32),
+        "found": chosen >= 0,
+    }
+    off = 2 * t_steps
+    for name in FUSED_METRIC_FIELDS:
+        host[name] = flat[off:off + b].astype(np.int32)
+        off += b
+    return host
+
+
+def fused_wave_launch(kin: KernelIn, step_member, step_local,
+                      t_steps: int, features: KernelFeatures,
+                      key: tuple) -> FusedWaveOut:
+    """Single-device fused dispatch: ONE profiled Pallas program per
+    wave, selected per bucket key exactly like the composite (the
+    profiler's miss counter and the AOT warmup manifest both see it
+    as the "fused_wave" kernel)."""
+    from nomad_tpu.ops.pallas_kernel import fused_wave_place_jit
+    from nomad_tpu.telemetry.kernel_profile import profiler
+
+    return profiler.call(
+        "fused_wave", fused_wave_place_jit,
+        (kin, jnp.asarray(step_member), jnp.asarray(step_local)),
+        (t_steps, features), key, jit_fn=fused_wave_place_jit,
+    )
+
+
 def infer_features(ev, any_penalty: bool = True, any_preferred: bool = True,
                    with_topk: bool = True, with_shuffle: bool = False) -> KernelFeatures:
     """Derive the lean static variant for one EvalTensors' ask."""

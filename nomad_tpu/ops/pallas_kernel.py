@@ -532,3 +532,106 @@ def make_schedule_apply_step_pallas(k_steps: int, interpret: bool = False):
     # buffers were not usable: float32[16384]" leaking into the bench
     # tail), and when it CAN they alias caller memory
     return _jit_donating(step, (1, 2))
+
+
+# ---------------------------------------------------------------------------
+# Fused wave mega-kernel (ISSUE 19): the whole joint wave — feasibility
+# masking, binpack/spread scoring, the per-step capacity-carry scan,
+# and top-k selection — as ONE pallas program whose intermediate planes
+# (masked scores, penalty unions, candidate sets) never leave
+# VMEM/registers between stages. The body runs the SAME scan core as
+# the XLA composite (ops/kernel.place_taskgroups_joint) over values
+# read from the kernel refs, so bit-identity with the composite holds
+# by construction across the whole supported feature lattice; what
+# fusion adds is the program boundary: one dispatch, one packed
+# readback (ops/kernel.FusedWaveOut), zero HBM round-trips between the
+# former composite stages. Interpret mode off-TPU keeps CPU tier-1
+# running the exact fused program the TPU path dispatches.
+# ---------------------------------------------------------------------------
+
+
+def fused_wave_place(kin, step_member, step_local, t_steps: int,
+                     features, interpret: bool = True):
+    """One-dispatch fused wave: (stacked KernelIn, step maps) ->
+    ops/kernel.FusedWaveOut. Mirrors place_taskgroups_joint + the
+    launcher's eager-fetch packing in a single pallas program."""
+    from nomad_tpu.ops.kernel import (
+        TOPK,
+        FusedWaveOut,
+        KernelIn,
+        fused_pack_len,
+        pack_fused_wave,
+        place_taskgroups_joint,
+    )
+
+    b = int(kin.n_steps.shape[0])
+    n = int(kin.cap_cpu.shape[-1])
+    leaves = list(kin)
+    # rank-0 leaves (wave-shared scalars) ship as (1,) rows — pallas
+    # refs want at least one axis — and are restored inside the body
+    scalar = tuple(jnp.ndim(x) == 0 for x in leaves)
+    ins = [jnp.reshape(x, (1,)) if s else jnp.asarray(x)
+           for x, s in zip(leaves, scalar)]
+
+    def body(sm_ref, sl_ref, *refs):
+        kin_refs = refs[:len(leaves)]
+        packed_ref, ti_ref, ts_ref, ac_ref, am_ref, ad_ref = \
+            refs[len(leaves):]
+        vals = [r[...][0] if s else r[...]
+                for r, s in zip(kin_refs, scalar)]
+        out = place_taskgroups_joint(
+            KernelIn(*vals), sm_ref[...], sl_ref[...], t_steps,
+            features)
+        packed_ref[...] = pack_fused_wave(out, t_steps, b)
+        ti_ref[...] = out.topk_idx
+        ts_ref[...] = out.topk_scores
+        ac_ref[...] = out.a_cpu
+        am_ref[...] = out.a_mem
+        ad_ref[...] = out.a_disk
+
+    out_shape = (
+        jax.ShapeDtypeStruct((fused_pack_len(t_steps, b),), jnp.float32),
+        jax.ShapeDtypeStruct((t_steps, TOPK), jnp.int32),
+        jax.ShapeDtypeStruct((t_steps, TOPK), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+    )
+    res = pl.pallas_call(body, out_shape=out_shape,
+                         interpret=interpret)(
+        step_member, step_local, *ins)
+    return FusedWaveOut(*res)
+
+
+def _fused_wave_run(kin, step_member, step_local, t_steps: int,
+                    features):
+    # interpret everywhere but real TPU: tier-1 CPU runs the exact
+    # fused program; on-chip the same body compiles through Mosaic
+    return fused_wave_place(kin, step_member, step_local, t_steps,
+                            features,
+                            interpret=jax.default_backend() != "tpu")
+
+
+fused_wave_place_jit = jax.jit(_fused_wave_run, static_argnums=(3, 4))
+
+
+def make_fused_wave_apply(t_steps: int, features,
+                          interpret: bool = True):
+    """Fused wave + carry commit with owned-buffer donation (the
+    PR 10/18 discipline): ``fn(kin, used_cpu, used_mem, step_member,
+    step_local) -> (FusedWaveOut, used_cpu', used_mem')`` where the
+    used planes are donated INTO their post-wave successors. Donation
+    routes through batching._jit_donating, which copies the donated
+    args into buffers the jit owns — handing it caller-owned
+    ``jnp.asarray`` planes neither corrupts them nor trips the
+    "donated buffers were not usable" warning conftest promotes to an
+    error."""
+    from nomad_tpu.parallel.batching import _jit_donating
+
+    def step(kin, used_cpu, used_mem, step_member, step_local):
+        kin2 = kin._replace(used_cpu=used_cpu, used_mem=used_mem)
+        out = fused_wave_place(kin2, step_member, step_local, t_steps,
+                               features, interpret=interpret)
+        return out, used_cpu + out.a_cpu, used_mem + out.a_mem
+
+    return _jit_donating(step, (1, 2))

@@ -72,6 +72,16 @@ def manifest_from_profiler(profiler=None) -> List[Dict]:
             if kernel == "joint_sharded" and len(key) == 8:
                 # (joint 7-key, devices-tuple): mesh-agnostic manifest
                 kernel, key = "joint", key[:7]
+            # fused program keys (ISSUE 19) fold into the SAME joint
+            # entries: the fused launcher reuses the wave bucket key
+            # verbatim, and warmup_entries re-derives "also compile
+            # the fused variant" from the entry's feature envelope
+            # (fused_wave_supported) — so one manifest line covers
+            # composite, sharded, fused, and fused-sharded
+            if kernel == "fused_wave_sharded" and len(key) == 8:
+                kernel, key = "joint", key[:7]
+            if kernel == "fused_wave" and len(key) == 7:
+                kernel = "joint"
             if kernel == "joint" and len(key) in (6, 7):
                 # len 6: pre-job-group keys from persisted manifests
                 # (job_shared defaults True, the common layout)
@@ -452,6 +462,112 @@ def _warm_joint_sharded(e: Dict, mesh) -> bool:
     return True
 
 
+def _entry_wave(e: Dict):
+    """Build one manifest entry's dummy wave exactly as launch_wave
+    stacks it (shared predicate included) — the common prelude of the
+    fused warm passes."""
+    from nomad_tpu.ops.kernel import KernelIn
+    from nomad_tpu.parallel.coalesce import wave_field_is_shared
+
+    b_pad = int(e["wave"])
+    t_pad = int(e["steps"])
+    n = int(e["nodes"])
+    shared = bool(e.get("shared", True))
+    neutral_shared = bool(e.get("neutral_shared", True))
+    job_shared = bool(e.get("job_shared", True))
+    feats = _features_from_dict(e["features"])
+    k_max = max(t_pad // max(b_pad, 1), 1)
+    kin = _dummy_kin(n, k_max)
+
+    def stack_field(f, x):
+        if wave_field_is_shared(f, shared, neutral_shared, job_shared):
+            return np.asarray(x)
+        return np.stack([np.asarray(x)] * b_pad)
+
+    stacked = KernelIn(*[
+        stack_field(f, getattr(kin, f)) for f in KernelIn._fields
+    ])
+    step_member = np.full(t_pad, -1, np.int32)
+    step_local = np.zeros(t_pad, np.int32)
+    pos = 0
+    for i in range(b_pad):
+        step_member[pos:pos + k_max] = i
+        step_local[pos:pos + k_max] = np.arange(k_max)
+        pos += k_max
+    return (stacked, step_member, step_local, t_pad, feats,
+            (shared, neutral_shared, job_shared))
+
+
+def _warm_fused(e: Dict) -> bool:
+    """Compile the single-device FUSED program for a joint manifest
+    entry — the same three commitment signatures _warm_joint covers
+    (host / committed / resident-mixed), against the fused jit."""
+    import jax.numpy as jnp
+
+    from nomad_tpu.ops.kernel import KernelIn, fused_wave_supported
+    from nomad_tpu.ops.pallas_kernel import fused_wave_place_jit
+    from nomad_tpu.parallel.coalesce import wave_field_is_shared
+
+    feats = _features_from_dict(e["features"])
+    if not fused_wave_supported(feats):
+        return False
+    stacked, step_member, step_local, t_pad, feats, layout = \
+        _entry_wave(e)
+    mixed = [wave_field_is_shared(f, *layout)
+             for f in KernelIn._fields]
+    _call_both_placements(
+        fused_wave_place_jit,
+        (stacked, jnp.asarray(step_member), jnp.asarray(step_local)),
+        (t_pad, feats), mixed=mixed)
+    return True
+
+
+def _warm_fused_sharded(e: Dict, mesh) -> bool:
+    """Compile the FUSED sharded program for a joint manifest entry —
+    the same three signatures as _warm_joint_sharded, against the
+    shard_map entry. Skips entries the mesh cannot serve fused: a
+    node axis it does not divide, or shards narrower than the local
+    TOPK merge (the live launcher counts those as fused fallbacks)."""
+    import jax
+
+    from nomad_tpu.ops.kernel import (
+        TOPK,
+        KernelIn,
+        fused_wave_supported,
+    )
+    from nomad_tpu.parallel.coalesce import wave_field_is_shared
+    from nomad_tpu.parallel.sharded import fused_sharded_entry
+
+    feats = _features_from_dict(e["features"])
+    if not fused_wave_supported(feats):
+        return False
+    n = int(e["nodes"])
+    if (mesh is None or mesh.size < 2 or n % mesh.size != 0
+            or n // mesh.size < TOPK):
+        return False
+    stacked, step_member, step_local, t_pad, feats, layout = \
+        _entry_wave(e)
+    fn, kin_shardings, repl = fused_sharded_entry(mesh, *layout)
+    arrays = (stacked, step_member, step_local)
+    shardings = (kin_shardings, repl, repl)
+    out = fn(*arrays, t_pad, feats)
+    jax.block_until_ready(out)
+    placed = jax.device_put(arrays, shardings)
+    out = fn(*placed, t_pad, feats)
+    jax.block_until_ready(out)
+    subs = {
+        f: jax.device_put(getattr(stacked, f),
+                          getattr(kin_shardings, f))
+        for f in KernelIn._fields
+        if wave_field_is_shared(f, *layout)
+    }
+    if subs:
+        out = fn(stacked._replace(**subs), step_member, step_local,
+                 t_pad, feats)
+        jax.block_until_ready(out)
+    return True
+
+
 def _warm_single(e: Dict) -> bool:
     from nomad_tpu.ops.kernel import (
         KernelIn,
@@ -495,10 +611,30 @@ def warmup_entries(entries: List[Dict], mesh=None,
         try:
             did = False
             if e.get("kernel") == "joint":
+                # warm the one program the launcher will route this
+                # entry's envelope to: the FUSED mega-kernel when the
+                # envelope supports it (and the knob is on), the
+                # composite otherwise — warming both would double
+                # compile time on a program that never dispatches.
+                # The composite still compiles lazily on the rare
+                # fused-exception fallback; that path is off the
+                # steady state by construction.
+                from nomad_tpu.parallel.coalesce import (
+                    fused_wave_enabled,
+                )
+
+                fused_on = fused_wave_enabled()
                 if not mesh_only:
-                    did = _warm_joint(e)
+                    did = fused_on and _warm_fused(e)
+                    if not did:
+                        did = _warm_joint(e)
                 if mesh is not None:
-                    did = _warm_joint_sharded(e, mesh) or did
+                    d2 = fused_on and _warm_fused_sharded(e, mesh)
+                    if not d2:
+                        # a mesh too narrow for the fused local
+                        # top-k merge launches composite-sharded
+                        d2 = _warm_joint_sharded(e, mesh)
+                    did = d2 or did
             elif e.get("kernel") in ("single_topk", "single_full"):
                 if not mesh_only:
                     did = _warm_single(e)

@@ -31,12 +31,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from nomad_tpu.ops.kernel import (
+    TOPK,
     KernelFeatures,
     KernelIn,
     KernelOut,
     canonical_features,
+    fused_wave_launch,
+    fused_wave_supported,
     pad_steps,
     place_taskgroups_joint_jit,
+    unpack_fused_wave,
 )
 from nomad_tpu.telemetry.histogram import histograms, percentile
 from nomad_tpu.telemetry.kernel_profile import profiler
@@ -119,6 +123,66 @@ class _ShardedWaveStats:
 #: short-lived to carry their own history, like wave_stats)
 sharded_wave_stats = _ShardedWaveStats()
 
+#: Fused-wave dispatch knob (ISSUE 19). Default ON: waves whose
+#: feature union fits the fused envelope
+#: (ops/kernel.fused_wave_supported) run the one-dispatch mega-kernel;
+#: the rest take the composite path, counted as fallbacks below.
+_FUSED_WAVE = True
+
+
+def configure_fused_wave(on: bool) -> None:
+    """Enable/disable the fused wave mega-kernel process-wide (the
+    bench's composite arm and the A/B cell flip this)."""
+    global _FUSED_WAVE
+    _FUSED_WAVE = bool(on)
+
+
+def fused_wave_enabled() -> bool:
+    return _FUSED_WAVE
+
+
+class _FusedWaveStats:
+    """Fused-dispatch accounting (exported as the
+    ``nomad_tpu_wave_fused_*`` Prometheus series; reset with
+    telemetry.reset()).
+
+    ``launches`` counts waves that ran the fused mega-kernel;
+    ``fallbacks`` counts waves that wanted fusion (knob on) but ran
+    the composite anyway — an unsupported feature union
+    (spreads/devices/cores/network), a node shard too narrow for the
+    local top-k merge, or a fused dispatch error. Steady live traffic
+    fits the envelope, so the steady-burst gate holds fallbacks at
+    ZERO."""
+
+    def __init__(self) -> None:
+        self._lock = witness_lock("FusedWaveStats._lock")
+        self.launches = 0
+        self.fallbacks = 0
+
+    def note_launch(self) -> None:
+        with self._lock:
+            self.launches += 1
+
+    def note_fallback(self) -> None:
+        with self._lock:
+            self.fallbacks += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self.launches = 0
+            self.fallbacks = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "launches": self.launches,
+                "fallbacks": self.fallbacks,
+            }
+
+
+#: process-wide fused-wave stats (same lifetime rationale as above)
+fused_wave_stats = _FusedWaveStats()
+
 #: JointOut fields the launcher fetches to host EAGERLY per wave (the
 #: wave-critical d2h payload): the per-step placements the scheduler
 #: walks immediately plus the per-member metric scalars. The top-k
@@ -185,6 +249,11 @@ class _WaveTopK:
             idx = np.asarray(self._idx)
             scores = np.asarray(self._scores)
             profiler.add_bytes("d2h", idx.nbytes + scores.nbytes)
+            # counted in the dispatch series but EXCLUDED from the
+            # steady dispatches_per_wave key: the drain runs in the
+            # plan window, overlapping the next wave's execute — it
+            # is not on the wave-critical path the key measures
+            profiler.count_dispatch("topk_drain")
             self._host = (idx, scores)
             # release the device buffers
             self._idx = self._scores = None
@@ -470,6 +539,19 @@ _INFLIGHT_LOCK = witness_lock("coalesce._INFLIGHT_LOCK")
 _INFLIGHT_STARTS: dict = {}
 
 
+def _fused_fetch(fout, t_pad: int, b_pad: int):
+    """Turn a fused wave's outputs into the launcher's eager host
+    dict + lazy top-k holder. ONE packed-buffer readback — and no
+    "wave_fetch" dispatch count: profiler.call already blocked on the
+    fused program's outputs, so the copy rides the dispatch's own
+    synchronization instead of being another device interaction."""
+    with tracer.span("kernel.d2h"):
+        packed = np.asarray(fout.packed)
+    profiler.add_bytes("d2h", packed.nbytes)
+    host = unpack_fused_wave(packed, t_pad, b_pad)
+    return host, _WaveTopK(fout.topk_idx, fout.topk_scores)
+
+
 def _oldest_inflight_age_s() -> float:
     with _INFLIGHT_LOCK:
         if not _INFLIGHT_STARTS:
@@ -594,13 +676,24 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
     # this key must NOT recompile (the profiler counts violations)
     wave_key = (b_pad, t_pad, n_nodes, shareable, neutral_shareable,
                 job_shareable, feats)
+    # fused dispatch (ISSUE 19): one mega-kernel program instead of
+    # program + eager multi-buffer fetch. Sharded fusion additionally
+    # needs each node shard wide enough for the local TOPK merge.
+    fused_ok = (_FUSED_WAVE and fused_wave_supported(feats)
+                and (not wave_sharded
+                     or n_nodes // mesh_size >= TOPK))
+    host = None
+    wave_topk = None
     t_launch = time.perf_counter()
     token = object()
     with _INFLIGHT_LOCK:
         _INFLIGHT_STARTS[token] = t_launch
     try:
         if wave_sharded:
-            from nomad_tpu.parallel.sharded import joint_sharded_entry
+            from nomad_tpu.parallel.sharded import (
+                fused_sharded_entry,
+                joint_sharded_entry,
+            )
 
             global sharded_wave_launches
             sharded_wave_launches += 1
@@ -609,40 +702,77 @@ def launch_wave(kins: List[KernelIn], k_steps: List[int],
             # (the profiler's explicit upload would otherwise commit
             # them to one device and the call would pay a reshard);
             # step planes ship replicated, raw numpy on purpose
-            fn, kin_shardings, repl = joint_sharded_entry(
-                mesh, shareable, neutral_shareable, job_shareable)
-            out = profiler.call(
-                "joint_sharded", fn,
-                (stacked, step_member, step_local),
-                (t_pad, feats),
-                wave_key + (tuple(mesh.devices.flat),), jit_fn=fn,
-                shardings=(kin_shardings, repl, repl),
-            )
+            if fused_ok:
+                try:
+                    fn, kin_shardings, repl = fused_sharded_entry(
+                        mesh, shareable, neutral_shareable,
+                        job_shareable)
+                    fout = profiler.call(
+                        "fused_wave_sharded", fn,
+                        (stacked, step_member, step_local),
+                        (t_pad, feats),
+                        wave_key + (tuple(mesh.devices.flat),),
+                        jit_fn=fn,
+                        shardings=(kin_shardings, repl, repl),
+                    )
+                    host, wave_topk = _fused_fetch(fout, t_pad, b_pad)
+                except Exception:       # noqa: BLE001 - counted, composite covers
+                    host = wave_topk = None
+            if host is None:
+                fn, kin_shardings, repl = joint_sharded_entry(
+                    mesh, shareable, neutral_shareable, job_shareable)
+                out = profiler.call(
+                    "joint_sharded", fn,
+                    (stacked, step_member, step_local),
+                    (t_pad, feats),
+                    wave_key + (tuple(mesh.devices.flat),), jit_fn=fn,
+                    shardings=(kin_shardings, repl, repl),
+                )
         else:
             if mesh is not None:
                 sharded_wave_stats.note_fallback(mesh_size)
-            out = profiler.call(
-                "joint", place_taskgroups_joint_jit,
-                (stacked, jnp.asarray(step_member),
-                 jnp.asarray(step_local)),
-                (t_pad, feats),
-                wave_key, jit_fn=place_taskgroups_joint_jit,
-            )
-        with tracer.span("kernel.d2h"):
-            # fetch ONLY the planes members consume immediately: the
-            # per-step placements and the per-member metric scalars.
-            # The joint kernel's final capacity carry (a_cpu/a_mem/
-            # a_disk — full node planes) stays on device (the live
-            # path commits through plans, never through it), and the
-            # top-k planes stay on device too — handed back as lazy
-            # slices whose one shared fetch runs in the plan window.
-            host = {
-                f: np.asarray(getattr(out, f))
-                for f in _JOINT_FETCH_FIELDS
-            }
-        profiler.add_bytes(
-            "d2h", sum(a.nbytes for a in host.values()))
-        wave_topk = _WaveTopK(out.topk_idx, out.topk_scores)
+            if fused_ok:
+                try:
+                    fout = fused_wave_launch(
+                        stacked, step_member, step_local, t_pad,
+                        feats, wave_key)
+                    host, wave_topk = _fused_fetch(fout, t_pad, b_pad)
+                except Exception:       # noqa: BLE001 - counted, composite covers
+                    host = wave_topk = None
+            if host is None:
+                out = profiler.call(
+                    "joint", place_taskgroups_joint_jit,
+                    (stacked, jnp.asarray(step_member),
+                     jnp.asarray(step_local)),
+                    (t_pad, feats),
+                    wave_key, jit_fn=place_taskgroups_joint_jit,
+                )
+        if host is not None:
+            fused_wave_stats.note_launch()
+        else:
+            if _FUSED_WAVE:
+                # wanted fusion, ran the composite (unsupported
+                # feature union, narrow shard, or a fused error)
+                fused_wave_stats.note_fallback()
+            with tracer.span("kernel.d2h"):
+                # fetch ONLY the planes members consume immediately:
+                # the per-step placements and the per-member metric
+                # scalars. The joint kernel's final capacity carry
+                # (a_cpu/a_mem/a_disk — full node planes) stays on
+                # device (the live path commits through plans, never
+                # through it), and the top-k planes stay on device
+                # too — handed back as lazy slices whose one shared
+                # fetch runs in the plan window.
+                host = {
+                    f: np.asarray(getattr(out, f))
+                    for f in _JOINT_FETCH_FIELDS
+                }
+            # the composite's wave-critical result drain is its own
+            # device interaction on top of the program dispatch
+            profiler.count_dispatch("wave_fetch")
+            profiler.add_bytes(
+                "d2h", sum(a.nbytes for a in host.values()))
+            wave_topk = _WaveTopK(out.topk_idx, out.topk_scores)
     finally:
         with _INFLIGHT_LOCK:
             _INFLIGHT_STARTS.pop(token, None)

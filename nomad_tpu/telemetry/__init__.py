@@ -84,12 +84,14 @@ def reset() -> None:
         # cover exactly their window. Import is lazy/guarded: telemetry
         # must stay importable without jax.
         from nomad_tpu.parallel.coalesce import (
+            fused_wave_stats,
             sharded_wave_stats,
             wave_stats,
         )
 
         wave_stats.reset()
         sharded_wave_stats.reset()
+        fused_wave_stats.reset()
     except Exception:                           # noqa: BLE001
         pass
     try:

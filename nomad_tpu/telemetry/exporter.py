@@ -92,6 +92,17 @@ def prometheus_text(registry=None, event_broker=None) -> str:
         lines.append(
             f'nomad_tpu_kernel_transfer_bytes_total'
             f'{{{_lbl(direction=direction)}}} {n}')
+    # per-wave device-dispatch counts (ISSUE 19): program executions
+    # plus the composite's eager result fetch ("wave_fetch") and the
+    # deferred top-k drain ("topk_drain") — a fused steady wave is
+    # exactly ONE dispatch, which TRACE_DECOMP's dispatches_per_wave
+    # key gates
+    if prof.get("Dispatches"):
+        lines.append("# TYPE nomad_tpu_kernel_dispatches_total counter")
+        for program, n in sorted(prof["Dispatches"].items()):
+            lines.append(
+                f'nomad_tpu_kernel_dispatches_total'
+                f'{{{_lbl(program=program)}}} {n}')
     if prof["PerKey"]:
         lines.append(
             "# TYPE nomad_tpu_kernel_jit_cache_misses_total counter")
@@ -147,6 +158,21 @@ def prometheus_text(registry=None, event_broker=None) -> str:
             "# TYPE nomad_tpu_wave_sharded_mesh_devices gauge")
         lines.append(
             f"nomad_tpu_wave_sharded_mesh_devices {s['mesh_devices']}")
+        # fused dispatch (ISSUE 19): waves that ran the one-dispatch
+        # mega-kernel vs fusion-wanted composite fallbacks (an
+        # unsupported feature union, a narrow shard, or a fused
+        # error) — fallbacks must sit at 0 on steady traffic
+        from nomad_tpu.parallel.coalesce import fused_wave_stats
+
+        fu = fused_wave_stats.snapshot()
+        lines.append(
+            "# TYPE nomad_tpu_wave_fused_launches_total counter")
+        lines.append(
+            f"nomad_tpu_wave_fused_launches_total {fu['launches']}")
+        lines.append(
+            "# TYPE nomad_tpu_wave_fused_fallbacks_total counter")
+        lines.append(
+            f"nomad_tpu_wave_fused_fallbacks_total {fu['fallbacks']}")
     except Exception:                           # noqa: BLE001
         pass                # coalescer (jax) unavailable: skip series
     # device-resident cluster state (tensors/device_state.py): how the

@@ -58,6 +58,15 @@ class KernelProfiler:
         #: dirty-row uploads device_state performs; d2h counts the
         #: result planes the wave launcher fetches.
         self.transfer_bytes: Dict[str, int] = {"h2d": 0, "d2h": 0}
+        #: per-wave device-dispatch accounting (ISSUE 19): device
+        #: interactions on the wave path, keyed by program. Every
+        #: ``call`` counts one under its kernel name; the wave
+        #: launcher adds "wave_fetch" for the composite's eager
+        #: per-field result fetch and "topk_drain" for the deferred
+        #: top-k materialization. The fused mega-kernel's single
+        #: packed readback rides its own dispatch's synchronization,
+        #: so a fused steady wave counts exactly ONE.
+        self.dispatches: Dict[str, int] = {}
         #: cross-check: observed jit cache growth (when introspectable)
         self.cache_growth = 0
 
@@ -81,6 +90,7 @@ class KernelProfiler:
                 self.stage_s[k] = 0.0
             for k in self.transfer_bytes:
                 self.transfer_bytes[k] = 0
+            self.dispatches.clear()
             self.cache_growth = 0
 
     # --- accounting -----------------------------------------------------
@@ -103,6 +113,7 @@ class KernelProfiler:
                 "StageSeconds": {k: round(v, 6)
                                  for k, v in self.stage_s.items()},
                 "TransferBytes": dict(self.transfer_bytes),
+                "Dispatches": dict(self.dispatches),
                 "PerKey": per_key,
             }
 
@@ -121,6 +132,19 @@ class KernelProfiler:
         with self._lock:
             self.transfer_bytes[direction] = \
                 self.transfer_bytes.get(direction, 0) + int(n)
+
+    def count_dispatch(self, program: str, n: int = 1) -> None:
+        """Account ``n`` wave-path device dispatches under
+        ``program`` (exported as
+        ``nomad_tpu_kernel_dispatches_total{program=...}``). No-op
+        when disabled, like ``add_bytes`` — callers outside ``call``
+        (the composite eager fetch, the deferred top-k drain) report
+        through this."""
+        if not self._enabled or n <= 0:
+            return
+        with self._lock:
+            self.dispatches[program] = \
+                self.dispatches.get(program, 0) + int(n)
 
     def keys(self) -> list:
         """Every (kernel, bucket-key) ever launched since reset — the
@@ -198,6 +222,7 @@ class KernelProfiler:
         with self._lock:
             seen = full_key in self._launches
             self._launches[full_key] = self._launches.get(full_key, 0) + 1
+            self.dispatches[kernel] = self.dispatches.get(kernel, 0) + 1
         t0 = time.perf_counter()
         out = fn(*dev_args, *static_args)
         call_s = time.perf_counter() - t0

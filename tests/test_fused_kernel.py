@@ -1,0 +1,464 @@
+"""Fused wave mega-kernel tests (ISSUE 19).
+
+The fused program (ops/pallas_kernel.fused_wave_place) runs the whole
+wave — feasibility, scoring, the per-step capacity-carry scan, top-k —
+as ONE pallas dispatch whose body calls the SAME
+place_taskgroups_joint the composite program jits, so parity with the
+composite must be BITWISE, not approximate, across the supported
+feature lattice (ports, preemption penalties, preferred pins,
+distinct_hosts, shuffle, padded shapes). Tests run the kernel in
+interpret mode (tests force CPU) — the exact program the TPU path
+dispatches. The sharded mirror runs on the conftest 8-virtual-device
+mesh through parallel/sharded.fused_sharded_entry.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nomad_tpu.ops.kernel import (
+    FUSED_METRIC_FIELDS,
+    MAX_PENALTY_NODES,
+    TOPK,
+    KernelIn,
+    LEAN_FEATURES,
+    build_kernel_in,
+    fused_wave_supported,
+    pad_steps,
+    place_taskgroups_joint_jit,
+    unpack_fused_wave,
+)
+from nomad_tpu.ops.pallas_kernel import fused_wave_place_jit
+from nomad_tpu.parallel import coalesce
+from nomad_tpu.parallel.synthetic import synthetic_cluster, synthetic_eval
+
+K = 4
+B = 4
+
+#: the fused envelope's feature lattice, each variant pinned to a node
+#: count in a DIFFERENT pad bucket so padded shapes ride along (n_real
+#: strictly below n_pad everywhere)
+_VARIANTS = (
+    ("lean", 60),
+    ("shuffle", 200),
+    ("penalty_preferred", 383),
+    ("distinct", 60),
+    ("ports", 200),
+    ("kitchen_sink", 383),
+)
+
+
+def _variant_features(variant):
+    f = LEAN_FEATURES._replace(with_topk=True)
+    if variant in ("shuffle", "penalty_preferred", "kitchen_sink"):
+        f = f._replace(with_shuffle=True)
+    if variant in ("penalty_preferred", "kitchen_sink"):
+        f = f._replace(with_step_penalties=True, with_preferred=True)
+    if variant in ("distinct", "kitchen_sink"):
+        f = f._replace(with_distinct=True)
+    if variant in ("ports", "kitchen_sink"):
+        f = f._replace(with_ports=True)
+    assert fused_wave_supported(f)
+    return f
+
+
+def _wave_members(seed, variant, n_nodes):
+    """B randomized member kins + the variant's features."""
+    rng = np.random.default_rng(seed * 1000 + n_nodes)
+    cluster = synthetic_cluster(
+        n_nodes, cpu=3900.0, mem=7936.0, disk=98304.0,
+        seed=int(rng.integers(0, 99)))
+    n_pad = cluster.n_pad
+    kp = pad_steps(K)
+    kins = []
+    for _ in range(B):
+        ev = synthetic_eval(cluster, desired_count=K)
+        kwargs = {"node_perm": rng.permutation(n_pad).astype(np.int32)}
+        if variant in ("penalty_preferred", "kitchen_sink"):
+            pen = np.full((kp, MAX_PENALTY_NODES), -1, np.int32)
+            pen[0, 0] = rng.integers(0, n_nodes)
+            pen[1, 0] = rng.integers(0, n_nodes)
+            pref = np.full(kp, -1, np.int32)
+            pref[int(rng.integers(0, K))] = rng.integers(0, n_nodes)
+            kwargs.update(step_penalty=pen, step_preferred=pref)
+        kin = build_kernel_in(cluster, ev, K, **kwargs)
+        uc = (3900.0 * 0.6 * rng.random(n_pad)).astype(np.float32)
+        um = (7936.0 * 0.6 * rng.random(n_pad)).astype(np.float32)
+        kin = kin._replace(
+            used_cpu=uc, used_mem=um,
+            ask_cpu=np.float32(rng.choice([250, 500, 900])),
+            ask_mem=np.float32(rng.choice([128, 256, 700])))
+        if variant in ("ports", "kitchen_sink"):
+            kin = kin._replace(
+                port_conflict=(rng.random(n_pad) < 0.3),
+                ask_has_reserved_ports=np.asarray(True),
+                ask_dyn_ports=np.asarray(2, np.int32))
+        if variant in ("distinct", "kitchen_sink"):
+            kin = kin._replace(
+                job_tg_count=rng.integers(0, 2, n_pad).astype(np.int32),
+                job_any_count=rng.integers(0, 3, n_pad).astype(np.int32),
+                distinct_hosts_job=np.asarray(
+                    variant == "kitchen_sink"),
+                distinct_hosts_tg=np.asarray(True))
+        kins.append(kin)
+    return kins, _variant_features(variant)
+
+
+def _stack_wave(kins):
+    stacked = KernelIn(*[
+        np.stack([np.asarray(getattr(k, f)) for k in kins])
+        for f in KernelIn._fields])
+    t_pad = pad_steps(len(kins) * K)
+    step_member = np.full(t_pad, -1, np.int32)
+    step_local = np.zeros(t_pad, np.int32)
+    for i in range(len(kins)):
+        step_member[i * K:(i + 1) * K] = i
+        step_local[i * K:(i + 1) * K] = np.arange(K)
+    return stacked, step_member, step_local, t_pad
+
+
+def _assert_bitwise(fo, ref, t_pad, b, ctx=""):
+    host = unpack_fused_wave(np.asarray(fo.packed), t_pad, b)
+    np.testing.assert_array_equal(
+        host["chosen"], np.asarray(ref.chosen), err_msg=f"chosen {ctx}")
+    np.testing.assert_array_equal(
+        host["found"], np.asarray(ref.found), err_msg=f"found {ctx}")
+    # scores BITWISE, not allclose: same program, same math
+    np.testing.assert_array_equal(
+        host["scores"], np.asarray(ref.scores), err_msg=f"scores {ctx}")
+    for name in FUSED_METRIC_FIELDS:
+        np.testing.assert_array_equal(
+            host[name], np.asarray(getattr(ref, name)),
+            err_msg=f"{name} {ctx}")
+    np.testing.assert_array_equal(
+        np.asarray(fo.topk_idx), np.asarray(ref.topk_idx),
+        err_msg=f"topk_idx {ctx}")
+    np.testing.assert_array_equal(
+        np.asarray(fo.topk_scores), np.asarray(ref.topk_scores),
+        err_msg=f"topk_scores {ctx}")
+    for nm in ("a_cpu", "a_mem", "a_disk"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fo, nm)), np.asarray(getattr(ref, nm)),
+            err_msg=f"{nm} {ctx}")
+    return host
+
+
+def _run_parity_seed(seed):
+    variant, n_nodes = _VARIANTS[seed % len(_VARIANTS)]
+    kins, feats = _wave_members(seed, variant, n_nodes)
+    stacked, sm, sl, t_pad = _stack_wave(kins)
+    ref = place_taskgroups_joint_jit(
+        stacked, jnp.asarray(sm), jnp.asarray(sl), t_pad, feats)
+    fo = fused_wave_place_jit(
+        stacked, jnp.asarray(sm), jnp.asarray(sl), t_pad, feats)
+    host = _assert_bitwise(fo, ref, t_pad, B, ctx=f"seed={seed} "
+                           f"variant={variant}")
+    return host
+
+
+class TestFusedParity:
+    """Property suite: fused == composite, bit for bit, across the
+    lattice. Variant and pad bucket cycle with the seed."""
+
+    @pytest.mark.parametrize("seed", range(25))
+    def test_bit_identity_across_lattice(self, seed):
+        _run_parity_seed(seed)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", range(25, 200))
+    def test_bit_identity_across_lattice_slow(self, seed):
+        _run_parity_seed(seed)
+
+    def test_some_seed_actually_places(self):
+        host = _run_parity_seed(0)
+        assert host["found"].any()
+
+
+class TestFusedShardedParity:
+    """The sharded mirror: fused_sharded_entry's shard_map program on
+    the conftest 8-virtual-device mesh vs the single-device composite
+    — same bitwise bar, per variant."""
+
+    @pytest.fixture()
+    def mesh(self):
+        from nomad_tpu.parallel.sharded import wave_mesh as make
+
+        assert len(jax.devices()) >= 8, \
+            "conftest must force 8 CPU devices"
+        return make(8)
+
+    @pytest.mark.parametrize("seed", range(len(_VARIANTS)))
+    def test_sharded_bit_identity(self, seed, mesh):
+        from nomad_tpu.parallel.sharded import fused_sharded_entry
+
+        variant, n_nodes = _VARIANTS[seed]
+        kins, feats = _wave_members(seed + 77, variant, n_nodes)
+        stacked, sm, sl, t_pad = _stack_wave(kins)
+        n_pad = stacked.cap_cpu.shape[-1]
+        assert n_pad % mesh.size == 0
+        assert n_pad // mesh.size >= TOPK, "local top-k merge floor"
+        ref = place_taskgroups_joint_jit(
+            stacked, jnp.asarray(sm), jnp.asarray(sl), t_pad, feats)
+        fn, kin_sh, repl = fused_sharded_entry(mesh)
+        kin_dev = KernelIn(*[jax.device_put(x, s)
+                             for x, s in zip(stacked, kin_sh)])
+        fo = fn(kin_dev, jax.device_put(sm, repl),
+                jax.device_put(sl, repl), t_pad, feats)
+        _assert_bitwise(fo, ref, t_pad, B,
+                        ctx=f"sharded variant={variant}")
+
+    def test_launch_wave_sharded_zero_fallbacks(self, mesh):
+        """launch_wave over the mesh must take the fused sharded path
+        (fused launches counted, zero fused fallbacks, zero unsharded
+        fallbacks) and match the single-device composite exactly."""
+        from nomad_tpu import telemetry
+
+        kins, feats0 = _wave_members(5, "shuffle", 200)
+        steps = [K] * len(kins)
+        feats = [feats0] * len(kins)
+
+        prior = coalesce.fused_wave_enabled()
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            coalesce.configure_fused_wave(False)
+            single = coalesce.launch_wave(kins, steps, feats)
+            coalesce.configure_fused_wave(True)
+            coalesce.fused_wave_stats.reset()
+            coalesce.sharded_wave_stats.reset()
+            sharded = coalesce.launch_wave(kins, steps, feats,
+                                           mesh=mesh)
+            fused = coalesce.fused_wave_stats.snapshot()
+            sw = coalesce.sharded_wave_stats.snapshot()
+        finally:
+            coalesce.configure_fused_wave(prior)
+            telemetry.disable()
+            telemetry.reset()
+        assert fused["launches"] == 1 and fused["fallbacks"] == 0
+        assert sw["fallbacks"] == 0
+        for s, m in zip(single, sharded):
+            np.testing.assert_array_equal(np.asarray(s.chosen),
+                                          np.asarray(m.chosen))
+            np.testing.assert_array_equal(np.asarray(s.found),
+                                          np.asarray(m.found))
+            np.testing.assert_array_equal(np.asarray(s.scores),
+                                          np.asarray(m.scores))
+            np.testing.assert_array_equal(np.asarray(s.topk_idx),
+                                          np.asarray(m.topk_idx))
+        assert any(np.asarray(s.found).any() for s in single)
+
+
+class TestFusedLaunchWave:
+    """Routing: the launcher runs fused waves at ONE dispatch each,
+    falls back (counted) outside the envelope, and never diverges."""
+
+    def test_single_device_fused_matches_composite(self):
+        from nomad_tpu import telemetry
+        from nomad_tpu.telemetry.kernel_profile import profiler
+
+        kins, feats0 = _wave_members(9, "kitchen_sink", 383)
+        steps = [K] * len(kins)
+        feats = [feats0] * len(kins)
+
+        prior = coalesce.fused_wave_enabled()
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            coalesce.configure_fused_wave(False)
+            composite = coalesce.launch_wave(kins, steps, feats)
+            coalesce.configure_fused_wave(True)
+            fused = coalesce.launch_wave(kins, steps, feats)
+            disp = dict(profiler.summary()["Dispatches"])
+        finally:
+            coalesce.configure_fused_wave(prior)
+            telemetry.disable()
+            telemetry.reset()
+        # composite wave: program + eager fetch; fused wave: program
+        # only (the packed readback rides the dispatch)
+        assert disp.get("joint", 0) == 1 and disp.get("wave_fetch") == 1
+        assert disp.get("fused_wave") == 1
+        for c, f in zip(composite, fused):
+            np.testing.assert_array_equal(np.asarray(c.chosen),
+                                          np.asarray(f.chosen))
+            np.testing.assert_array_equal(np.asarray(c.found),
+                                          np.asarray(f.found))
+            np.testing.assert_array_equal(np.asarray(c.scores),
+                                          np.asarray(f.scores))
+            np.testing.assert_array_equal(np.asarray(c.topk_scores),
+                                          np.asarray(f.topk_scores))
+
+    def test_steady_fused_burst_zero_new_misses(self):
+        """Mini steady-burst smoke: after ONE warm wave, repeated
+        fused waves of the same bucket shape compile nothing and cost
+        exactly one dispatch each."""
+        from nomad_tpu import telemetry
+        from nomad_tpu.telemetry.kernel_profile import profiler
+
+        kins, feats0 = _wave_members(11, "shuffle", 200)
+        steps = [K] * len(kins)
+        feats = [feats0] * len(kins)
+
+        prior = coalesce.fused_wave_enabled()
+        telemetry.enable()
+        try:
+            coalesce.configure_fused_wave(True)
+            coalesce.launch_wave(kins, steps, feats)      # warm
+            telemetry.reset()
+            for _ in range(3):
+                coalesce.launch_wave(kins, steps, feats)
+            prof = profiler.summary()
+            fused = coalesce.fused_wave_stats.snapshot()
+        finally:
+            coalesce.configure_fused_wave(prior)
+            telemetry.disable()
+            telemetry.reset()
+        assert prof["JitCacheMisses"] == 0, prof["PerKey"]
+        assert prof["Dispatches"].get("fused_wave") == 3
+        assert "wave_fetch" not in prof["Dispatches"]
+        assert fused["launches"] == 3 and fused["fallbacks"] == 0
+
+    def test_unsupported_union_falls_back_counted(self):
+        """A wave whose union leaves the envelope (spreads) must run
+        the composite program and count ONE fallback."""
+        from nomad_tpu import telemetry
+
+        kins, feats0 = _wave_members(13, "lean", 60)
+        steps = [K] * len(kins)
+        feats = [feats0._replace(n_spreads=1)] * len(kins)
+        assert not fused_wave_supported(coalesce.union_features(feats))
+
+        prior = coalesce.fused_wave_enabled()
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            coalesce.configure_fused_wave(True)
+            coalesce.fused_wave_stats.reset()
+            outs = coalesce.launch_wave(kins, steps, feats)
+            fused = coalesce.fused_wave_stats.snapshot()
+        finally:
+            coalesce.configure_fused_wave(prior)
+            telemetry.disable()
+            telemetry.reset()
+        assert fused["launches"] == 0 and fused["fallbacks"] == 1
+        assert len(outs) == len(kins)
+
+    def test_disabled_knob_runs_composite_uncounted(self):
+        kins, feats0 = _wave_members(15, "lean", 60)
+        steps = [K] * len(kins)
+        feats = [feats0] * len(kins)
+        prior = coalesce.fused_wave_enabled()
+        try:
+            coalesce.configure_fused_wave(False)
+            coalesce.fused_wave_stats.reset()
+            coalesce.launch_wave(kins, steps, feats)
+            fused = coalesce.fused_wave_stats.snapshot()
+        finally:
+            coalesce.configure_fused_wave(prior)
+        assert fused == {"launches": 0, "fallbacks": 0}
+
+
+class TestFusedWarmup:
+    """ops/warmup learns the fused signatures: fused profiler keys
+    fold into mesh/fusion-agnostic joint manifest entries, and warming
+    a joint entry compiles the fused variant too (steady fused waves
+    keep zero jit misses)."""
+
+    def test_fused_launch_keys_fold_into_manifest(self):
+        from nomad_tpu import telemetry
+        from nomad_tpu.ops import warmup as kernel_warmup
+        from nomad_tpu.telemetry.kernel_profile import profiler
+
+        kins, feats0 = _wave_members(17, "shuffle", 200)
+        steps = [K] * len(kins)
+        feats = [feats0] * len(kins)
+        prior = coalesce.fused_wave_enabled()
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            coalesce.configure_fused_wave(True)
+            coalesce.launch_wave(kins, steps, feats)
+            entries = kernel_warmup.manifest_from_profiler(profiler)
+        finally:
+            coalesce.configure_fused_wave(prior)
+            telemetry.disable()
+            telemetry.reset()
+        joints = [e for e in entries if e["kernel"] == "joint"]
+        assert joints, entries
+        assert joints[0]["nodes"] == 256
+        assert not [e for e in entries
+                    if "fused" in e.get("kernel", "")], entries
+
+    def test_warmup_compiles_fused_signature(self):
+        """A joint manifest entry warmed WITHOUT a mesh makes the live
+        fused launch of that bucket a cache hit. Uses a bucket no
+        other fused test touches (B=2 -> distinct wave pad), so the
+        warmup itself must do the compiling."""
+        from nomad_tpu import telemetry
+        from nomad_tpu.ops import warmup as kernel_warmup
+        from nomad_tpu.telemetry.kernel_profile import profiler
+
+        kins, feats0 = _wave_members(19, "lean", 500)
+        kins = kins[:2]
+        steps = [K] * len(kins)
+        feats = [feats0] * len(kins)
+        n_pad = int(np.asarray(kins[0].cap_cpu).shape[0])
+        b_pad = coalesce.pad_wave(len(kins))
+        feat_union = coalesce.union_features(feats)
+        entry = {
+            "kernel": "joint", "wave": b_pad,
+            "steps": pad_steps(b_pad * K), "nodes": n_pad,
+            "shared": False, "neutral_shared": False,
+            "job_shared": False,
+            "features": dict(feat_union._asdict()),
+        }
+        compiled, failed = kernel_warmup.warmup_entries([entry])
+        assert compiled == 1 and failed == 0
+
+        prior = coalesce.fused_wave_enabled()
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            coalesce.configure_fused_wave(True)
+            coalesce.launch_wave(kins, steps, feats)
+            misses = profiler.misses_for("fused_wave")
+            fused = coalesce.fused_wave_stats.snapshot()
+        finally:
+            coalesce.configure_fused_wave(prior)
+            telemetry.disable()
+            telemetry.reset()
+        assert fused["launches"] >= 1
+        assert misses == 0, profiler.summary()["PerKey"]
+
+
+class TestFusedDonation:
+    """make_fused_wave_apply routes donation through owned-buffer
+    copies: caller-held numpy planes survive a repeated drive and no
+    'donated buffers were not usable' warning fires (conftest promotes
+    it to an error)."""
+
+    def test_repeated_drive_keeps_caller_planes(self):
+        from nomad_tpu.ops.pallas_kernel import make_fused_wave_apply
+
+        kins, feats = _wave_members(21, "lean", 60)
+        stacked, sm, sl, t_pad = _stack_wave(kins)
+        n_pad = stacked.cap_cpu.shape[-1]
+        # shared (unbatched) used planes: the donated carries
+        used_cpu = (100.0 * np.arange(n_pad)).astype(np.float32)
+        used_mem = np.full(n_pad, 64.0, np.float32)
+        uc_copy, um_copy = used_cpu.copy(), used_mem.copy()
+
+        apply = make_fused_wave_apply(t_pad, feats, interpret=True)
+        uc, um = jnp.asarray(used_cpu), jnp.asarray(used_mem)
+        outs = []
+        for _ in range(2):
+            fo, uc, um = apply(stacked, uc, um,
+                               jnp.asarray(sm), jnp.asarray(sl))
+            outs.append(fo)
+        # donated carries advanced (or at least stayed valid arrays)
+        assert np.asarray(uc).shape == (n_pad,)
+        # the caller's numpy planes are untouched by donation
+        np.testing.assert_array_equal(used_cpu, uc_copy)
+        np.testing.assert_array_equal(used_mem, um_copy)
+        host = unpack_fused_wave(np.asarray(outs[0].packed), t_pad, B)
+        assert host["chosen"].shape == (t_pad,)

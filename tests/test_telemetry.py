@@ -666,7 +666,7 @@ class TestTraceDecomposition:
             assert proc.returncode == 0, proc.stderr.decode()[-2000:]
             decomp = json.loads(out.read_text())
             ss = decomp["steady_state"]
-            sched_ok = (ss["sched_host_share"] <= 0.45 or sum(
+            sched_ok = (ss["sched_host_share"] <= 0.65 or sum(
                 decomp["stages"].get(s, {}).get("per_eval_ms", 0.0)
                 for s in ("sched-host", "sched-reconcile",
                           "sched-feasibility", "sched-assembly",
@@ -731,6 +731,23 @@ class TestTraceDecomposition:
         # must be advancing by dirty-row scatter, not full re-uploads
         assert decomp["device_state"]["delta_advances"] >= 1, \
             decomp["device_state"]
+        # ISSUE 19 steady gates: every steady wave must run the fused
+        # mega-kernel — zero fused fallbacks, fused launches == wave
+        # launches — and cost exactly ONE wave-critical device
+        # dispatch (the composite's separate eager result fetch is
+        # gone; the deferred top-k drain is excluded by definition).
+        assert ss["fused_wave_fallbacks"] == 0, (
+            ss, decomp.get("wave_fused"))
+        assert ss["fused_wave_launches"] == \
+            decomp["wave"]["launches"] > 0, (ss, decomp["wave"])
+        assert ss["dispatches_per_wave"] == 1.0, (
+            ss, decomp["kernel"].get("Dispatches"))
+        # the per-program dispatch counter exported in the artifact:
+        # fused waves only, no composite program, no eager wave fetch
+        disp = decomp["kernel"].get("Dispatches", {})
+        assert disp.get("fused_wave", 0) > 0, disp
+        assert disp.get("joint", 0) == 0, disp
+        assert disp.get("wave_fetch", 0) == 0, disp
         # ISSUE 5 steady gates. sched_host_share sums the
         # eval.schedule residue + the feasibility/assembly/plan-build
         # sub-slices. Post-compiler, the feasibility slice itself is
@@ -744,13 +761,18 @@ class TestTraceDecomposition:
         # is thread CPU, so host contention stretches the wall
         # denominator and can only shrink it — the steal-invariant
         # fallback bound is the per-eval CPU milliseconds of the same
-        # four slices.
+        # four slices. ISSUE 19 recalibrated the share bound from
+        # 0.45: the fused wave cut the execute+fetch leg to one
+        # dispatch, shrinking the wall denominator while the Python
+        # numerator stayed put — the same healthy scheduler now reads
+        # ~0.55-0.60 of the smaller wall (a genuine host regression on
+        # fused walls would read 0.7+).
         sched_ms = sum(
             decomp["stages"].get(s, {}).get("per_eval_ms", 0.0)
             for s in ("sched-host", "sched-reconcile",
                       "sched-feasibility", "sched-assembly",
                       "sched-planbuild"))
-        assert ss["sched_host_share"] <= 0.45 or sched_ms <= 3.0, \
+        assert ss["sched_host_share"] <= 0.65 or sched_ms <= 3.0, \
             (ss["sched_host_share"], sched_ms)
         # ISSUE 10: the reconcile slice is spanned on its own (the
         # fused single-pass classifier's trajectory line)
@@ -875,6 +897,13 @@ class TestTraceDecomposition:
         # the resident state advanced sharded between waves
         assert decomp["device_state"]["delta_advances"] >= 1, \
             decomp["device_state"]
+        # ISSUE 19: sharded waves run FUSED too (fused_wave_sharded),
+        # still at one dispatch per wave
+        assert ss["fused_wave_fallbacks"] == 0, ss
+        assert ss["fused_wave_launches"] == \
+            decomp["wave"]["launches"], (ss, decomp["wave"])
+        assert ss["dispatches_per_wave"] == 1.0, (
+            ss, decomp["kernel"].get("Dispatches"))
 
     def test_disabled_tracing_leaves_no_spans(self):
         """The disabled live path must record nothing (the <5%
