@@ -108,6 +108,19 @@ STEADY_FLOOR_EVALS_PER_SEC = 85.0
 FLEET_DELIVER_P99_REF_MS = 2500.0
 FLEET_E2E_P99_REF_MS = 3000.0
 
+# ISSUE 20: the fleet cell's flagship shape — 100k clients spread
+# across a REAL 3-server cluster, a reader storm mixing
+# stale/default/linearizable against every server. The follower-share
+# floor is scale-free (2 of 3 servers are followers; clearing 0.66
+# means the read plane actually put them to work); the staleness p99
+# ceiling is box-relative like the other fleet gates (a follower's
+# serving lag is replication cadence + scheduler residue, both of
+# which stretch on slow boxes).
+FLEET_CLIENTS = 100_000
+FLEET_SERVERS = 3
+FLEET_READ_FOLLOWER_SHARE_FLOOR = 0.66
+FLEET_READ_STALENESS_P99_REF_MS = 750.0
+
 # box-relative mesh-cell floor (ISSUE 14): sharded 100k-node waves at
 # batch 32 on the 8-virtual-device host mesh. Reference measured on
 # the PR 14 container (host score ~8.0e6, 1 core: virtual devices
@@ -1334,15 +1347,19 @@ def main() -> None:
         print("bench budget: skipping contention cell "
               f"({budget.remaining():.0f}s left)", file=sys.stderr)
 
-    # ISSUE 11 / ROADMAP open item 4: the standing FLEET cell — 10k
-    # simulated clients (ring cursors + heartbeat storm + held
-    # blocking queries) while the steady eval burst runs. The
-    # trajectory lines are fleet_heartbeats_per_sec /
+    # ISSUE 11 / ROADMAP open item 4: the standing FLEET cell, grown
+    # to the ISSUE 20 flagship shape — 100k simulated clients (ring
+    # cursors + heartbeat storm + held blocking queries) spread across
+    # a REAL 3-server cluster while the steady eval burst runs, with a
+    # reader storm mixing stale/default/linearizable across every
+    # server. The trajectory lines are fleet_heartbeats_per_sec /
     # fleet_watch_wakeups_per_sec / fleet_stream_deliver_p99_ms /
-    # fleet_e2e_p99_ms; the held-flags gate box-relative (emitted, like
+    # fleet_e2e_p99_ms plus the read plane's fleet_read_* split; the
+    # held-flags gate box-relative (emitted, like
     # trace_steady_floor_ok, so fast and slow bench hosts stay
-    # comparable). The 100k flagship shape is documented in
-    # docs/PERF.md "The serving plane".
+    # comparable) except fleet_read_follower_share_ok, whose 0.66
+    # floor is scale-free. The flagship shape is documented in
+    # docs/PERF.md "The serving plane" / "Follower reads".
     if budget.remaining() > 120:
         try:
             _phase("fleet cell")
@@ -1350,14 +1367,17 @@ def main() -> None:
             import trace_report
 
             fleet = trace_report.run_fleet_burst(
-                deadline_s=min(budget.share(0.25), 150.0))
+                n_clients=FLEET_CLIENTS, n_servers=FLEET_SERVERS,
+                deadline_s=min(budget.share(0.25), 180.0))
             host_score = trace_report.host_speed_score()
             scale = STEADY_FLOOR_REF_HOST_SCORE / max(host_score, 1.0)
             deliver_ceiling = FLEET_DELIVER_P99_REF_MS * scale
             e2e_ceiling = FLEET_E2E_P99_REF_MS * scale
+            staleness_ceiling = FLEET_READ_STALENESS_P99_REF_MS * scale
             serving = fleet.get("serving", {})
             em.update(
                 fleet_clients=fleet["clients"],
+                fleet_servers=fleet["servers"],
                 fleet_heartbeats_per_sec=fleet["heartbeats_per_sec"],
                 fleet_watch_wakeups_per_sec=fleet[
                     "watch_wakeups_per_sec"],
@@ -1375,6 +1395,26 @@ def main() -> None:
                     "lost_events", 0),
                 fleet_heartbeat_coalesce_ratio=serving.get(
                     "heartbeat", {}).get("coalesce_ratio", 0.0),
+                fleet_reads=fleet["reads"],
+                fleet_read_follower_share=fleet["read_follower_share"],
+                fleet_read_follower_share_ok=(
+                    fleet["read_follower_share"]
+                    >= FLEET_READ_FOLLOWER_SHARE_FLOOR),
+                fleet_read_served_leader=fleet["read_served"]["leader"],
+                fleet_read_served_follower=fleet[
+                    "read_served"]["follower"],
+                fleet_read_forwards=fleet["read_forwards"],
+                fleet_read_demotions=fleet["read_demotions"],
+                fleet_read_lease_fast=fleet["read_lease_fast"],
+                fleet_read_stale_rejects=fleet["read_stale_rejects"],
+                fleet_read_unavailable_503s=fleet[
+                    "read_unavailable_503s"],
+                fleet_read_staleness_p99_ms=fleet[
+                    "read_staleness_p99_ms"],
+                fleet_read_staleness_ok=(
+                    fleet["read_staleness_p99_ms"]
+                    <= staleness_ceiling),
+                fleet_stale_violations=fleet["stale_violations"],
             )
         except Exception as e:                   # noqa: BLE001
             import traceback
@@ -1383,6 +1423,40 @@ def main() -> None:
                   file=sys.stderr)
     else:
         print("bench budget: skipping fleet cell "
+              f"({budget.remaining():.0f}s left)", file=sys.stderr)
+
+    # ISSUE 20: the read-plane mini smoke — a durable 3-server
+    # cluster; a stale read lands on a follower with bounded
+    # last-contact attribution, a default read forwards its fence
+    # across an injected leader step-down, and a linearizable read
+    # demotes to the quorum barrier under a forced lease lapse. The
+    # verdict rides BENCH_*.json so a routing regression reads as
+    # readplane_ok=false, not as silent follower-share drift.
+    # Reproduce with trace_report.run_readplane_smoke().
+    if budget.remaining() > 30:
+        try:
+            _phase("readplane smoke")
+            sys.path.insert(0, os.path.join(REPO, "bench"))
+            import trace_report
+
+            rp = trace_report.run_readplane_smoke()
+            em.update(
+                readplane_ok=rp["ok"],
+                readplane_stale_ok=rp["stale_ok"],
+                readplane_default_ok=rp["default_ok"],
+                readplane_demote_ok=rp["demote_ok"],
+                readplane_stale_last_contact_ms=rp[
+                    "stale_last_contact_ms"],
+                readplane_forwards=rp["default_forwards"],
+                readplane_demotions=rp["demotions"],
+            )
+        except Exception as e:                   # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            print(f"warning: readplane smoke failed ({e})",
+                  file=sys.stderr)
+    else:
+        print("bench budget: skipping readplane smoke "
               f"({budget.remaining():.0f}s left)", file=sys.stderr)
 
     # ISSUE 14 / ROADMAP open item 1: the MESH cell — the C2M replay

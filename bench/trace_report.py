@@ -828,6 +828,11 @@ def run_contention_burst(n_nodes: int = 400, n_jobs: int = 80,
         server.shutdown()
 
 
+#: the read-plane cell's pinned seed (ISSUE 20): re-arming the same
+#: (faults, seed) pair replays the same chaos decision sequence
+FLEET_READ_SEED = 20020
+
+
 def run_fleet_burst(n_clients: int = 10_000, n_nodes: int = 400,
                     n_jobs: int = 60, allocs_per_job: int = 5,
                     batch_size: int = 16, warmup_jobs: int = 10,
@@ -837,7 +842,12 @@ def run_fleet_burst(n_clients: int = 10_000, n_nodes: int = 400,
                     drain_per_sweep: int = 256,
                     submit_group: int = 4,
                     submit_pace_s: float = 0.08,
-                    deadline_s: float = 150.0) -> Dict:
+                    deadline_s: float = 150.0,
+                    n_servers: int = 1,
+                    reader_threads: int = 6,
+                    max_stale_s: float = 2.0,
+                    chaos: Optional[str] = None,
+                    seed: int = FLEET_READ_SEED) -> Dict:
     """ISSUE 11 / ROADMAP open item 4: the standing FLEET cell — the
     serving plane under fleet-scale read/watch load while the steady
     eval burst runs.
@@ -864,7 +874,28 @@ def run_fleet_burst(n_clients: int = 10_000, n_nodes: int = 400,
     consumer hand-off), lost events, and the e2e eval latency
     distribution under fleet load — the standing gate every
     serving-plane PR is judged against.
+
+    ``n_servers > 1`` is the ISSUE 20 flagship shape: the same storm
+    over a live raft cluster with clients spread across ALL servers,
+    ``reader_threads`` driving consistency-routed reads through each
+    server's read plane (stale on followers under ``max_stale_s``,
+    default round-robin exercising the ReadIndex fence, linearizable
+    on the leader), an optional ``chaos`` schedule mid-storm, and the
+    staleness/linearizability validators — see
+    ``_run_fleet_burst_cluster``.
     """
+    if n_servers > 1:
+        return _run_fleet_burst_cluster(
+            n_clients=n_clients, n_nodes=n_nodes, n_jobs=n_jobs,
+            allocs_per_job=allocs_per_job, batch_size=batch_size,
+            warmup_jobs=warmup_jobs,
+            heartbeat_threads=heartbeat_threads,
+            watcher_threads=watcher_threads,
+            subscriber_threads=subscriber_threads,
+            drain_per_sweep=drain_per_sweep,
+            deadline_s=deadline_s, n_servers=n_servers,
+            reader_threads=reader_threads, max_stale_s=max_stale_s,
+            chaos=chaos, seed=seed)
     from nomad_tpu import mock, telemetry
     from nomad_tpu.server.server import Server, ServerConfig
     from nomad_tpu.state.store import watch_stats
@@ -1060,6 +1091,523 @@ def run_fleet_burst(n_clients: int = 10_000, n_nodes: int = 400,
         if not was_enabled:
             telemetry.disable()
         server.shutdown()
+
+
+def _run_fleet_burst_cluster(n_clients: int, n_nodes: int, n_jobs: int,
+                             allocs_per_job: int, batch_size: int,
+                             warmup_jobs: int, heartbeat_threads: int,
+                             watcher_threads: int,
+                             subscriber_threads: int,
+                             drain_per_sweep: int, deadline_s: float,
+                             n_servers: int, reader_threads: int,
+                             max_stale_s: float, chaos: Optional[str],
+                             seed: int) -> Dict:
+    """ISSUE 20: the 100k-client flagship fleet cell over a live raft
+    cluster — the read plane under fleet-scale load, with validators.
+
+    The single-server storm (ring cursors + heartbeat hammer + held
+    blocking queries + steady eval burst) runs unchanged, but spread:
+    subscriptions land on EVERY server's own event ring, blocking
+    queries run against each server's own store (waking on local FSM
+    applies), and ``reader_threads`` drive consistency-routed reads
+    through each server's read plane — stale reads on followers under
+    ``max_stale_s``, default reads round-robin over all servers (the
+    follower ReadIndex fence does real work), linearizable reads on
+    the leader. An optional ``chaos`` schedule (CHAOS_SCHEDULES) runs
+    mid-storm.
+
+    Two validators turn the consistency contract into hard numbers:
+
+    - **staleness**: a sampler records the leader's committed index
+      every ~5ms. A ``max_stale``-bounded read that served index I at
+      time t, while an index > I was already committed at t - bound,
+      returned data OLDER than its bound — one violation, reported
+      verbatim. (The plane's staleness meter deliberately overstates,
+      so zero violations is the expected steady state.)
+    - **linearizability** (lease-partition schedule): the deposed
+      leader's read plane is interrogated through the partition
+      window; a linearizable read served off a still-valid lease AFTER
+      the new leader committed past the old one is the stale
+      linearizable read leases must make impossible. The probe must
+      also observe the lease actually lapse (demotions > 0) — a
+      partition that never demoted a read proves nothing.
+
+    Stream resume is exercised on every server: each per-server
+    monitor drops and resumes its subscription by index mid-storm;
+    after convergence every burst alloc id must have been seen on
+    every surviving server's own ring, or explicit LostEvents markers
+    — never a silent gap.
+    """
+    import bisect
+
+    from nomad_tpu import mock, telemetry
+    from nomad_tpu.server.readplane import (
+        ReadPlaneError,
+        StaleReadError,
+        read_stats,
+    )
+    from nomad_tpu.server.server import ServerConfig
+    from nomad_tpu.server.stream import TOPIC_LOST
+    from nomad_tpu.server.testing import make_cluster, wait_for_leader
+    from nomad_tpu.state.store import watch_stats
+    from nomad_tpu.telemetry.histogram import (
+        READ_STALENESS,
+        STREAM_DELIVER,
+        histograms,
+    )
+    from nomad_tpu.utils import faultpoints
+
+    spec = CHAOS_SCHEDULES[chaos] if chaos else None
+    was_enabled = telemetry.enabled()
+    servers, registry = make_cluster(n_servers, ServerConfig(
+        num_workers=1,
+        worker_batch_size=batch_size,
+        heartbeat_ttl=3600.0,
+        # chaos rejections are injected, not a misbehaving node
+        plan_rejection_threshold=500,
+    ))
+    stop = threading.Event()
+    mon_stop = threading.Event()
+    threads: list = []
+    mthreads: list = []
+    violations: list = []
+    hb_counts = [0] * heartbeat_threads
+    watch_counts = [0] * watcher_threads
+    drained_counts = [0] * max(subscriber_threads, 1)
+    read_counts = {"stale": 0, "default": 0, "linearizable": 0,
+                   "rejected_stale": 0, "unavailable_503": 0}
+    read_lock = threading.Lock()
+    # committed-frontier samples (monotonic stamp, leader index): the
+    # stale validator's ground truth. Append-only from one thread.
+    idx_times: list = []
+    idx_vals: list = []
+    stale_viol: list = []
+    lin_probe = {"fast_ok": 0, "fast_stale": 0, "demoted": 0,
+                 "partitioned": False}
+    faultpoints.reset()
+
+    def cur_leader():
+        return _cluster_leader(servers)
+
+    def with_leader(fn, timeout=15.0):
+        return _call_on_leader(servers, fn, timeout)
+
+    def followers():
+        return [s for s in servers
+                if s.raft is not None and not s.raft.is_leader()]
+
+    mons = [{"server": s.config.name, "alloc_ids": set(), "lost": 0,
+             "events": 0, "last_index": 0, "resumes": 0}
+            for s in servers]
+
+    try:
+        telemetry.enable()
+        wait_for_leader(servers, timeout=10.0)
+        node_ids = []
+        for _ in range(n_nodes):
+            node = mock.node()
+            node_ids.append(node.id)
+            with_leader(lambda s, n=node: s.node_register(n))
+
+        def submit(count):
+            jobs = []
+            for _ in range(count):
+                job = mock.simple_job()
+                job.task_groups[0].count = allocs_per_job
+                with_leader(lambda s, j=job: s.job_register(j))
+                jobs.append(job)
+            return jobs
+
+        def wait_fully_placed(jobs, deadline):
+            want = len(jobs) * allocs_per_job
+            placed = 0
+            while time.time() < deadline:
+                s = cur_leader() or servers[0]
+                snap = s.state.snapshot()
+                placed = sum(
+                    1 for j in jobs
+                    for a in snap.allocs_by_job(j.namespace, j.id)
+                    if not a.terminal_status())
+                if placed >= want:
+                    return placed
+                time.sleep(0.1)
+            return placed
+
+        # warmup OUTSIDE the chaos/measurement window
+        warm = submit(warmup_jobs)
+        wait_fully_placed(warm, time.time() + min(deadline_s / 2, 90.0))
+
+        # the fleet: ring cursors spread across EVERY server's own
+        # event ring — a follower's subscribers ride its local FSM
+        # applies, not the leader's
+        topic_mix = ({"*": ["*"]}, {"Allocation": ["*"]}, {"Job": ["*"]})
+        subs = [
+            servers[i % n_servers].event_broker.subscribe(
+                dict(topic_mix[i % 3]))
+            for i in range(n_clients)
+        ]
+
+        def monitor(k: int) -> None:
+            """Follow server k's OWN ring, dropping + resuming the
+            subscription by index mid-storm (the reconnect contract,
+            exercised per server)."""
+            s = servers[k]
+            m = mons[k]
+            sub = s.event_broker.subscribe()
+            drains = 0
+            while True:
+                done = mon_stop.is_set()
+                for ev in sub.next_events(timeout=0.1, max_events=512):
+                    if ev.topic == TOPIC_LOST:
+                        m["lost"] += 1
+                        continue
+                    m["events"] += 1
+                    if ev.index > m["last_index"]:
+                        m["last_index"] = ev.index
+                    if ev.topic == "Allocation":
+                        m["alloc_ids"].add(ev.key)
+                drains += 1
+                if done:
+                    break
+                if drains % 40 == 0:
+                    sub.close()
+                    sub = s.event_broker.subscribe(
+                        from_index=m["last_index"])
+                    m["resumes"] += 1
+            sub.close()
+
+        def index_sampler() -> None:
+            while not stop.is_set():
+                s = cur_leader()
+                if s is not None:
+                    now = time.monotonic()
+                    idx = s.state.latest_index()
+                    idx_times.append(now)
+                    idx_vals.append(idx)
+                time.sleep(0.005)
+
+        def heartbeat_storm(k: int) -> None:
+            ids = node_ids[k::heartbeat_threads]
+            i = 0
+            while not stop.is_set() and ids:
+                s = cur_leader()
+                if s is not None:
+                    try:
+                        s.node_heartbeat(ids[i % len(ids)], "ready")
+                        hb_counts[k] += 1
+                    except Exception:           # noqa: BLE001
+                        pass        # election windows are the point
+                i += 1
+                time.sleep(0.0005)
+
+        def watch_storm(k: int) -> None:
+            # each watcher holds blocking queries against ONE server's
+            # own store — followers wake on their own FSM applies
+            s = servers[k % n_servers]
+            tables = ["allocs", "jobs"] if k % 2 else ["allocs"]
+            while not stop.is_set():
+                idx = s.state.table_index(tables)
+                s.state.block_until(tables, idx, timeout=0.3)
+                watch_counts[k] += 1
+
+        def subscriber_sweep(k: int) -> None:
+            mine = subs[k::subscriber_threads]
+            offset = 0
+            while not stop.is_set():
+                window = [mine[(offset + j) % len(mine)]
+                          for j in range(min(drain_per_sweep, len(mine)))]
+                offset += drain_per_sweep
+                for sub in window:
+                    if stop.is_set():
+                        return
+                    drained_counts[k] += len(
+                        sub.next_events(timeout=0.0, max_events=512))
+                time.sleep(0.02)
+
+        def note_stale_read(ctx, t_served: float, bound: float) -> None:
+            j = bisect.bisect_right(idx_times, t_served - bound) - 1
+            if j >= 0 and idx_vals[j] > ctx.index:
+                stale_viol.append(
+                    f"stale read on {ctx.known_leader or '?'} served "
+                    f"index {ctx.index} under a {bound}s bound while "
+                    f"index {idx_vals[j]} was committed "
+                    f"{t_served - idx_times[j]:.3f}s earlier")
+
+        def reader_storm(k: int) -> None:
+            # read mix: stale-dominated like a real fleet (3 stale on
+            # followers / 2 default round-robin / 1 linearizable).
+            # Per-mode counters keep the server rotation decorrelated
+            # from the 6-step mode cycle (i%6 and i%3 share factors —
+            # one counter would pin default reads to two servers).
+            i, d = k, k
+            while not stop.is_set():
+                mode = ("stale", "stale", "stale",
+                        "default", "default", "linearizable")[i % 6]
+                i += 1
+                try:
+                    if mode == "stale":
+                        f = followers()
+                        s = f[i % len(f)] if f \
+                            else servers[i % n_servers]
+                        ctx = s.readplane.resolve("stale", max_stale_s)
+                        note_stale_read(ctx, time.monotonic(),
+                                        max_stale_s)
+                        with read_lock:
+                            read_counts["stale"] += 1
+                    elif mode == "default":
+                        s = servers[d % n_servers]
+                        d += 1
+                        s.readplane.resolve("default")
+                        with read_lock:
+                            read_counts["default"] += 1
+                    else:
+                        s = cur_leader()
+                        if s is None:
+                            continue
+                        s.readplane.resolve("linearizable")
+                        with read_lock:
+                            read_counts["linearizable"] += 1
+                except StaleReadError:
+                    with read_lock:
+                        read_counts["rejected_stale"] += 1
+                except ReadPlaneError:
+                    with read_lock:
+                        read_counts["unavailable_503"] += 1
+                except Exception:               # noqa: BLE001
+                    pass        # mid-election barrier timeouts
+                time.sleep(0.001)
+
+        def partition_probe(window_s: float) -> None:
+            """Lease-partition chaos: cut the leader from every peer
+            past its lease window, interrogating its READ PLANE the
+            whole time — the linearizability validator."""
+            time.sleep(1.0)
+            old = cur_leader()
+            if old is None or stop.is_set():
+                return
+            addr = old.raft.id
+            for p in old.raft.peers:
+                if p != addr:
+                    registry.partition(addr, p)
+            lin_probe["partitioned"] = True
+            try:
+                deadline = time.monotonic() + window_s
+                while time.monotonic() < deadline \
+                        and not stop.is_set():
+                    new = next(
+                        (s for s in servers
+                         if s is not old and s.raft is not None
+                         and s.raft.is_leader()), None)
+                    new_idx = (new.state.latest_index()
+                               if new is not None else None)
+                    # ordering makes the check sound: the NEW leader's
+                    # committed index is read BEFORE the old leader's
+                    # read plane answers
+                    if old.raft.lease_valid():
+                        try:
+                            ctx = old.readplane.resolve("linearizable")
+                        except Exception:       # noqa: BLE001
+                            lin_probe["demoted"] += 1
+                            continue
+                        if new_idx is not None and new_idx > ctx.index:
+                            lin_probe["fast_stale"] += 1
+                        else:
+                            lin_probe["fast_ok"] += 1
+                    else:
+                        lin_probe["demoted"] += 1
+                    time.sleep(0.005)
+            finally:
+                registry.heal()
+
+        telemetry.reset()       # windows read_stats with the rest
+        for s in servers:
+            s.event_broker.reset_stats()
+        for k in range(len(servers)):
+            th = threading.Thread(target=monitor, args=(k,),
+                                  daemon=True, name=f"fleet-mon-{k}")
+            th.start()
+            mthreads.append(th)
+        th = threading.Thread(target=index_sampler, daemon=True,
+                              name="fleet-idx")
+        th.start()
+        threads.append(th)
+        for k in range(heartbeat_threads):
+            th = threading.Thread(target=heartbeat_storm, args=(k,),
+                                  daemon=True, name=f"fleet-hb-{k}")
+            th.start()
+            threads.append(th)
+        for k in range(watcher_threads):
+            th = threading.Thread(target=watch_storm, args=(k,),
+                                  daemon=True, name=f"fleet-watch-{k}")
+            th.start()
+            threads.append(th)
+        for k in range(subscriber_threads):
+            th = threading.Thread(target=subscriber_sweep, args=(k,),
+                                  daemon=True, name=f"fleet-sub-{k}")
+            th.start()
+            threads.append(th)
+        for k in range(reader_threads):
+            th = threading.Thread(target=reader_storm, args=(k,),
+                                  daemon=True, name=f"fleet-read-{k}")
+            th.start()
+            threads.append(th)
+
+        if spec is not None:
+            faultpoints.arm(spec["faults"], seed=seed)
+            if spec.get("leader_partition_s"):
+                th = threading.Thread(
+                    target=partition_probe,
+                    args=(spec["leader_partition_s"],),
+                    daemon=True, name="fleet-partition")
+                th.start()
+                threads.append(th)
+
+        t0 = time.perf_counter()
+        jobs = []
+        for start in range(0, n_jobs, 3):
+            jobs.extend(submit(min(3, n_jobs - start)))
+            time.sleep(0.1)
+        placed = wait_fully_placed(jobs, time.time() + deadline_s)
+        wall = time.perf_counter() - t0
+        stop.set()
+        for th in threads:
+            th.join(timeout=3.0)
+        fault_fires = faultpoints.fires() if spec is not None else 0
+        if spec is not None:
+            faultpoints.disarm()
+        registry.heal()
+
+        # replicas converged before the per-server stream checks
+        leader = wait_for_leader(servers, timeout=10.0)
+        idx = leader.state.latest_index()
+        catch_deadline = time.time() + 10.0
+        while time.time() < catch_deadline:
+            if all(s.state.latest_index() >= idx for s in servers):
+                break
+            time.sleep(0.05)
+        else:
+            violations.append(
+                "replica lag: " + ", ".join(
+                    f"{s.config.name}={s.state.latest_index()}/{idx}"
+                    for s in servers))
+        time.sleep(0.3)         # let monitors drain the converged tail
+        mon_stop.set()
+        for th in mthreads:
+            th.join(timeout=3.0)
+
+        # stream resume: gap-free-or-explicit on every surviving server
+        snap = leader.state.snapshot()
+        burst_alloc_ids = {
+            a.id for j in jobs
+            for a in snap.allocs_by_job(j.namespace, j.id)}
+        for m in mons:
+            missing = burst_alloc_ids - m["alloc_ids"]
+            if missing and m["lost"] == 0:
+                violations.append(
+                    f"{m['server']}: stream silently missed "
+                    f"{len(missing)} burst alloc events "
+                    f"(no LostEvents marker, {m['resumes']} resumes)")
+
+        # consistency validators
+        violations.extend(stale_viol[:5])
+        if chaos and spec.get("leader_partition_s"):
+            if not lin_probe["partitioned"]:
+                violations.append(
+                    "lease probe never partitioned a leader")
+            if lin_probe["fast_stale"]:
+                violations.append(
+                    f"LINEARIZABILITY: deposed leader served "
+                    f"{lin_probe['fast_stale']} lease-fast reads after "
+                    f"a new leader committed past it")
+            if lin_probe["partitioned"] and lin_probe["demoted"] == 0:
+                violations.append(
+                    "lease never lapsed during the partition window "
+                    "(probe saw no demoted linearizable reads)")
+        if chaos == "leader-kill-mid-wave" and fault_fires == 0:
+            violations.append(
+                "leader-kill schedule armed but no fault fired")
+
+        rs = read_stats.snapshot()
+        stale_h = histograms.peek(READ_STALENESS)
+        stale_dist = stale_h.snapshot() if stale_h is not None else {}
+        e2e = histograms.get("e2e").snapshot()
+        deliver_h = histograms.peek(STREAM_DELIVER)
+        deliver = deliver_h.snapshot() if deliver_h is not None else {}
+        serving = serving_snapshot(leader)
+        # lost events are per-ring: the flagship gate covers ALL rings
+        lost_total = sum(s.event_broker.snapshot()["lost_events"]
+                         for s in servers)
+        serving["stream"]["lost_events"] = lost_total
+        heartbeats = sum(hb_counts)
+        wakeups = watch_stats.snapshot()
+        wakeup_total = wakeups["wakeups"] + wakeups["spurious_wakeups"]
+        for sub in subs:
+            sub.close()
+        reads_total = sum(rs["served"].values())
+        return {
+            "wall_s": round(wall, 3),
+            "clients": n_clients,
+            "servers": n_servers,
+            "chaos": chaos,
+            "seed": seed if chaos else None,
+            "faults_fired": fault_fires,
+            "converged_ok": not violations,
+            "violations": violations,
+            "n_evals": n_jobs,
+            "evals_per_sec": round(n_jobs / wall, 2) if wall else 0.0,
+            "allocs_placed": placed,
+            "allocs_wanted": n_jobs * allocs_per_job,
+            "heartbeats": heartbeats,
+            "heartbeats_per_sec": round(heartbeats / wall, 1)
+            if wall else 0.0,
+            "watch_wakeups": wakeup_total,
+            "watch_wakeups_per_sec": round(wakeup_total / wall, 1)
+            if wall else 0.0,
+            "events_delivered": sum(drained_counts),
+            "lost_events": lost_total,
+            "stream_deliver_p50_ms": deliver.get("p50_ms", 0.0),
+            "stream_deliver_p99_ms": deliver.get("p99_ms", 0.0),
+            "stream_deliver_count": deliver.get("count", 0),
+            "stream_monitors": [
+                {"server": m["server"], "events": m["events"],
+                 "lost_markers": m["lost"], "resumes": m["resumes"]}
+                for m in mons],
+            "e2e_p50_ms": e2e["p50_ms"],
+            "e2e_p99_ms": e2e["p99_ms"],
+            "e2e_count": e2e["count"],
+            "reads": reads_total,
+            "read_follower_share": rs["follower_share"],
+            "read_served": rs["served"],
+            "read_modes": rs["modes"],
+            "read_forwards": rs["forwards"],
+            "read_forward_retries": rs["forward_retries"],
+            "read_forward_failures": rs["forward_failures"],
+            "read_demotions": rs["demotions"],
+            "read_lease_fast": rs["lease_fast"],
+            "read_stale_rejects": rs["stale_rejects"],
+            "read_unavailable_503s": read_counts["unavailable_503"],
+            "read_staleness_p50_ms": stale_dist.get("p50_ms", 0.0),
+            "read_staleness_p99_ms": stale_dist.get("p99_ms", 0.0),
+            "stale_violations": len(stale_viol),
+            "linearizable_violations": lin_probe["fast_stale"],
+            "lease_probe": dict(lin_probe),
+            "serving": serving,
+            "latency": histograms.snapshot(),
+        }
+    finally:
+        stop.set()
+        mon_stop.set()
+        for th in threads + mthreads:
+            th.join(timeout=3.0)
+        faultpoints.reset()
+        registry.heal()
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:                   # noqa: BLE001
+                pass
+        if not was_enabled:
+            telemetry.disable()
 
 
 # ---------------------------------------------------------------------------
@@ -3548,6 +4096,167 @@ def run_timeline_smoke(out_path: Optional[str] = None,
         shutil.rmtree(base_dir, ignore_errors=True)
         if not was_traced:
             telemetry.disable()
+        telemetry.reset()
+
+
+#: the read-plane smoke's pinned seed (determinism bookkeeping only —
+#: the smoke injects its faults directly, no random program)
+READPLANE_SMOKE_SEED = 20021
+
+
+def run_readplane_smoke(seed: int = READPLANE_SMOKE_SEED,
+                        n_jobs: int = 4,
+                        deadline_s: float = 30.0) -> Dict:
+    """ISSUE 20 tier-1 smoke (~10s): a 3-server DURABLE cluster walks
+    the three consistency modes through their hard cases:
+
+    1. **stale on a follower** — serves from the follower's own MVCC
+       root with a finite, bounded last-contact stamp;
+    2. **default across a step-down** — a follower's reads keep
+       succeeding while the leader is deposed mid-stream (the
+       ReadIndex fence re-aims at the new leader; one
+       retry-on-election absorbs the gap);
+    3. **linearizable under lease lapse** — the leader is partitioned
+       from both peers past its lease window; its next linearizable
+       read must DEMOTE to the quorum barrier (never serve off the
+       lapsed lease). The heal lands the pending barrier, so the
+       demoted read completes — unless the peers elected first, in
+       which case the loud NoLeader refusal is equally correct.
+    """
+    import shutil
+    import tempfile
+
+    from nomad_tpu import telemetry
+    from nomad_tpu.server.readplane import ReadPlaneError, read_stats
+    from nomad_tpu.server.server import ServerConfig
+    from nomad_tpu.server.testing import (
+        make_cluster,
+        wait_for_leader,
+        wait_until,
+    )
+
+    base_dir = tempfile.mkdtemp(prefix="nomad-tpu-readplane-")
+    servers, registry = make_cluster(3, ServerConfig(
+        num_workers=1, worker_batch_size=4, heartbeat_ttl=60.0,
+    ), data_dirs=[os.path.join(base_dir, f"srv-{i}")
+                  for i in range(3)])
+    out: Dict = {"seed": seed}
+    try:
+        leader = wait_for_leader(servers, timeout=15.0)
+        from nomad_tpu import mock
+        for _ in range(4):
+            _call_on_leader(servers, lambda s, n=mock.node():
+                            s.node_register(n), timeout=20.0)
+        for _ in range(n_jobs):
+            _call_on_leader(servers, lambda s, j=mock.simple_job():
+                            s.job_register(j), timeout=20.0)
+        follower = next(s for s in servers if s is not leader)
+        # the follower's store must have caught up before the stale
+        # read's content check means anything
+        idx = leader.state.latest_index()
+        wait_until(lambda: follower.state.latest_index() >= idx,
+                   timeout=10.0, msg="follower catch-up")
+
+        # ---- 1. stale read on a follower ----------------------------
+        stats0 = read_stats.snapshot()
+        ctx = follower.readplane.resolve("stale", max_stale=10.0)
+        out["stale_served_by"] = ctx.served_by
+        out["stale_last_contact_ms"] = ctx.last_contact_ms
+        out["stale_known_leader"] = ctx.known_leader
+        out["stale_index"] = ctx.index
+        stale_ok = (ctx.served_by == "follower"
+                    and 0.0 < ctx.last_contact_ms < 10_000.0
+                    and ctx.index >= idx
+                    and ctx.known_leader == leader.raft.id)
+
+        # ---- 2. default read forwards across one step-down ----------
+        ctx = follower.readplane.resolve("default")
+        pre_ok = ctx.index >= idx
+        old_leader = leader
+        old_leader.raft.step_down()
+        # all three race the next election and the old leader can win
+        # it back (freshest log, same timers) — step down again,
+        # bounded, until leadership actually moved
+        new_leader = wait_for_leader(servers, timeout=15.0)
+        for _ in range(5):
+            if new_leader is not old_leader:
+                break
+            new_leader.raft.step_down()
+            new_leader = wait_for_leader(servers, timeout=15.0)
+        out["stepdown_new_leader"] = new_leader.raft.id
+        # reads from a follower of the NEW topology must succeed; the
+        # fence now aims at the new leader (possibly via one retry)
+        reader = next(s for s in servers
+                      if s is not new_leader and s is not old_leader)
+        forward_ok = False
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            try:
+                ctx = reader.readplane.resolve("default")
+                forward_ok = True
+                break
+            except ReadPlaneError:
+                time.sleep(0.05)
+        stats1 = read_stats.snapshot()
+        out["default_forwards"] = (stats1["forwards"]
+                                   - stats0["forwards"])
+        default_ok = (pre_ok and forward_ok
+                      and out["default_forwards"] >= 2)
+
+        # ---- 3. linearizable demotes to barrier on lease lapse ------
+        leader = new_leader
+        addr = leader.raft.id
+        for p in leader.raft.peers:
+            if p != addr:
+                registry.partition(addr, p)
+        lapsed = True
+        try:
+            # lease window = election_timeout_min * lease_fraction =
+            # 0.225s under CLUSTER_RAFT_CONFIG
+            wait_until(lambda: not leader.raft.lease_valid(),
+                       timeout=5.0, msg="lease lapse")
+        except Exception:                       # noqa: BLE001
+            lapsed = False
+        demote_result = {}
+
+        def demoted_read() -> None:
+            try:
+                c = leader.readplane.resolve("linearizable")
+                demote_result["outcome"] = "served"
+                demote_result["index"] = c.index
+            except ReadPlaneError as e:
+                demote_result["outcome"] = "refused"
+                demote_result["hint"] = e.known_leader
+            except Exception as e:              # noqa: BLE001
+                demote_result["outcome"] = f"error:{type(e).__name__}"
+
+        th = threading.Thread(target=demoted_read, daemon=True,
+                              name="readplane-demote")
+        th.start()
+        time.sleep(0.05)        # let the read demote + park on barrier
+        registry.heal()
+        th.join(timeout=10.0)
+        stats2 = read_stats.snapshot()
+        out["demotions"] = stats2["demotions"] - stats1["demotions"]
+        out["demote_outcome"] = demote_result.get("outcome", "hung")
+        demote_ok = (lapsed and out["demotions"] >= 1
+                     and out["demote_outcome"] in ("served", "refused"))
+
+        out.update(
+            stale_ok=stale_ok,
+            default_ok=default_ok,
+            demote_ok=demote_ok,
+            ok=bool(stale_ok and default_ok and demote_ok),
+        )
+        return out
+    finally:
+        registry.heal()
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:                   # noqa: BLE001
+                pass
+        shutil.rmtree(base_dir, ignore_errors=True)
         telemetry.reset()
 
 

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from nomad_tpu.api.codec import decode, encode
 from nomad_tpu.server import endpoints
+from nomad_tpu.server.readplane import ReadPlaneError
 from nomad_tpu.structs import consts
 from nomad_tpu.structs.job import Job
 
@@ -72,6 +73,24 @@ class Request:
             if parsed is not None:
                 timeout = parsed
         return index, min(timeout, 600.0)
+
+    def consistency_params(self) -> Tuple[str, Optional[float]]:
+        """parseConsistency (ISSUE 20): ``?stale`` / ``max_stale=<dur>``
+        / ``consistency=<mode>`` -> (mode, max_stale_s). An explicit
+        ``consistency=`` wins; ``max_stale`` alone implies stale."""
+        max_stale = None
+        raw = self.q("max_stale", "")
+        if raw:
+            max_stale = parse_duration(raw)
+            if max_stale is None:
+                raise HTTPError(400, f"invalid max_stale duration {raw!r}")
+        mode = self.q("consistency", "")
+        if not mode:
+            mode = ("stale" if (self.flag("stale") or max_stale is not None)
+                    else "default")
+        elif mode not in ("default", "stale", "linearizable"):
+            raise HTTPError(400, f"unknown consistency mode {mode!r}")
+        return mode, max_stale
 
 
 def parse_duration(v) -> Optional[float]:
@@ -255,6 +274,12 @@ class HTTPAgent:
                 result = fn(req)
             except HTTPError as e:
                 self._send(handler, e.status, {"error": e.message})
+            except ReadPlaneError as e:
+                # the read plane refused (no leader / over max_stale):
+                # loud 503 + the leader hint so callers can re-aim
+                if e.known_leader:
+                    handler._read_leader_hint = e.known_leader
+                self._send(handler, 503, {"error": str(e)})
             except PermissionError as e:
                 self._send(handler, 403, {"error": str(e)})
             except KeyError as e:
@@ -550,6 +575,20 @@ class HTTPAgent:
                 index = self.agent.server.state.latest_index() \
                     if self.agent.server else 0
             handler.send_header("X-Nomad-Index", str(index))
+            # read-plane attribution (ISSUE 20): every routed read
+            # carries how stale its data may be and where the leader
+            # is; a refused read still carries the leader hint
+            ctx = getattr(handler, "_read_ctx", None)
+            if ctx is not None:
+                handler.send_header("X-Nomad-Last-Contact",
+                                    str(ctx.last_contact_ms))
+                if ctx.known_leader:
+                    handler.send_header("X-Nomad-Known-Leader",
+                                        ctx.known_leader)
+            else:
+                hint = getattr(handler, "_read_leader_hint", "")
+                if hint:
+                    handler.send_header("X-Nomad-Known-Leader", hint)
             handler.end_headers()
             handler.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError):
@@ -573,6 +612,23 @@ class HTTPAgent:
         min_index, timeout = req.wait_params()
         if min_index > 0 and self.agent.server is not None:
             self.agent.server.state.block_until(tables, min_index + 1, timeout)
+
+    def _read(self, req: Request, tables: Optional[List[str]] = None):
+        """Consistency-routed read (ISSUE 20): resolve the mode fence
+        through the server's read plane, run the blocking-query wait
+        against the LOCAL store (followers wake on their own FSM
+        applies), then take the serving snapshot. Order matters: the
+        fence first (a default-mode follower read is ordered after the
+        leader's commit frontier before it blocks or serves), the
+        snapshot last (it sees everything the fence + wait admitted).
+        Raises ReadPlaneError -> 503 when the plane refuses."""
+        server = self._server
+        mode, max_stale = req.consistency_params()
+        ctx = server.readplane.resolve(mode, max_stale)
+        req.handler._read_ctx = ctx
+        if tables:
+            self._block(req, tables)
+        return server.state.snapshot()
 
     # -- ACL gate --------------------------------------------------------
 
@@ -815,8 +871,7 @@ class HTTPAgent:
 
     def jobs_list(self, req: Request):
         self._acl(req, "allow_ns_op", req.namespace, "read-job")
-        self._block(req, ["jobs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["jobs"])
         prefix = req.q("prefix")
         jobs = [
             _job_stub(j) for j in snap.jobs()
@@ -862,8 +917,7 @@ class HTTPAgent:
 
     def job_get(self, req: Request):
         self._acl(req, "allow_ns_op", req.namespace, "read-job")
-        self._block(req, ["jobs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["jobs"])
         job = snap.job_by_id(req.namespace, req.params["id"])
         if job is None:
             raise HTTPError(404, "job not found")
@@ -892,24 +946,20 @@ class HTTPAgent:
 
     def job_allocs(self, req: Request):
         self._acl(req, "allow_ns_op", req.namespace, "read-job")
-        self._block(req, ["allocs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["allocs"])
         allocs = snap.allocs_by_job(req.namespace, req.params["id"])
         return [_alloc_stub(a) for a in allocs]
 
     def job_evals(self, req: Request):
-        self._block(req, ["evals"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["evals"])
         return snap.evals_by_job(req.namespace, req.params["id"])
 
     def job_deployments(self, req: Request):
-        self._block(req, ["deployment"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["deployment"])
         return snap.deployments_by_job_id(req.namespace, req.params["id"])
 
     def job_latest_deployment(self, req: Request):
-        self._block(req, ["deployment"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["deployment"])
         return snap.latest_deployment_by_job_id(req.namespace, req.params["id"])
 
     def job_deployment_unblock(self, req: Request):
@@ -931,8 +981,7 @@ class HTTPAgent:
         return {"Index": index, "Failed": failed}
 
     def job_summary(self, req: Request):
-        self._block(req, ["allocs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["allocs"])
         job = snap.job_by_id(req.namespace, req.params["id"])
         if job is None:
             raise HTTPError(404, "job not found")
@@ -959,8 +1008,7 @@ class HTTPAgent:
         return {"JobID": job.id, "Namespace": job.namespace, "Summary": summary}
 
     def job_versions(self, req: Request):
-        self._block(req, ["jobs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["jobs"])
         versions = []
         v = 0
         job = snap.job_by_id(req.namespace, req.params["id"])
@@ -1015,7 +1063,7 @@ class HTTPAgent:
         return {"EvalID": res["eval_id"], "EvalCreateIndex": res["index"]}
 
     def job_scale_status(self, req: Request):
-        snap = self._server.state.snapshot()
+        snap = self._read(req)
         job = snap.job_by_id(req.namespace, req.params["id"])
         if job is None:
             raise HTTPError(404, "job not found")
@@ -1050,8 +1098,7 @@ class HTTPAgent:
 
     def nodes_list(self, req: Request):
         self._acl(req, "allow_node_read")
-        self._block(req, ["nodes"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["nodes"])
         prefix = req.q("prefix")
         with_res = req.flag("resources")
         return sorted(
@@ -1062,16 +1109,14 @@ class HTTPAgent:
 
     def node_get(self, req: Request):
         self._acl(req, "allow_node_read")
-        self._block(req, ["nodes"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["nodes"])
         node = snap.node_by_id(req.params["id"])
         if node is None:
             raise HTTPError(404, "node not found")
         return node
 
     def node_allocs(self, req: Request):
-        self._block(req, ["allocs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["allocs"])
         return snap.allocs_by_node(req.params["id"])
 
     def node_drain(self, req: Request):
@@ -1113,8 +1158,7 @@ class HTTPAgent:
     # -- alloc / eval handlers -------------------------------------------
 
     def allocs_list(self, req: Request):
-        self._block(req, ["allocs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["allocs"])
         prefix = req.q("prefix")
         with_res = req.flag("resources")
         out = [
@@ -1124,8 +1168,7 @@ class HTTPAgent:
         return sorted(out, key=lambda a: a["ID"])
 
     def alloc_get(self, req: Request):
-        self._block(req, ["allocs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["allocs"])
         alloc = snap.alloc_by_id(req.params["id"])
         if alloc is None:
             raise HTTPError(404, "alloc not found")
@@ -1136,8 +1179,7 @@ class HTTPAgent:
         return {"EvalID": res["eval_id"], "Index": res["index"]}
 
     def evals_list(self, req: Request):
-        self._block(req, ["evals"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["evals"])
         prefix = req.q("prefix")
         return sorted(
             (e for e in snap.evals_iter()
@@ -1146,38 +1188,34 @@ class HTTPAgent:
         )
 
     def eval_get(self, req: Request):
-        self._block(req, ["evals"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["evals"])
         ev = snap.eval_by_id(req.params["id"])
         if ev is None:
             raise HTTPError(404, "eval not found")
         return ev
 
     def eval_allocs(self, req: Request):
-        self._block(req, ["allocs"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["allocs"])
         return [_alloc_stub(a) for a in snap.allocs_by_eval(req.params["id"])]
 
     # -- deployment handlers ---------------------------------------------
 
     def deployments_list(self, req: Request):
-        self._block(req, ["deployment"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["deployment"])
         return sorted(
             (d for d in snap.deployments_iter() if d.namespace == req.namespace),
             key=lambda d: d.id,
         )
 
     def deployment_get(self, req: Request):
-        self._block(req, ["deployment"])
-        snap = self._server.state.snapshot()
+        snap = self._read(req, ["deployment"])
         d = snap.deployment_by_id(req.params["id"])
         if d is None:
             raise HTTPError(404, "deployment not found")
         return d
 
     def deployment_allocs(self, req: Request):
-        snap = self._server.state.snapshot()
+        snap = self._read(req)
         return [
             _alloc_stub(a) for a in snap.allocs_iter()
             if a.deployment_id == req.params["id"]
@@ -1678,7 +1716,7 @@ class HTTPAgent:
 
     def volumes_list(self, req: Request):
         self._acl(req, "allow_ns_op", req.namespace, "csi-list-volume")
-        self._block(req, ["csi_volumes"])
+        self._read(req, ["csi_volumes"])
         ns = req.namespace
         plugin_id = req.q("plugin_id")
         vols = [
@@ -1690,7 +1728,7 @@ class HTTPAgent:
 
     def volume_get(self, req: Request):
         self._acl(req, "allow_ns_op", req.namespace, "csi-read-volume")
-        self._block(req, ["csi_volumes"])
+        self._read(req, ["csi_volumes"])
         vol = self._server.state.csi_volume_by_id(
             req.namespace, req.params["id"]
         )
@@ -1783,13 +1821,13 @@ class HTTPAgent:
 
     def plugins_list(self, req: Request):
         self._acl(req, "allow_plugin_read")
-        self._block(req, ["nodes"])
+        self._read(req, ["nodes"])
         plugins = self._server.csi_plugins()
         return [p.stub() for p in sorted(plugins.values(), key=lambda p: p.id)]
 
     def plugin_get(self, req: Request):
         self._acl(req, "allow_plugin_read")
-        self._block(req, ["nodes"])
+        self._read(req, ["nodes"])
         p = self._server.csi_plugins().get(req.params["id"])
         if p is None:
             raise HTTPError(404, "plugin not found")
@@ -1804,7 +1842,7 @@ class HTTPAgent:
         """Grouped stubs: [{Namespace, Services: [{ServiceName, Tags}]}]
         (service_registration_endpoint.go List)."""
         self._acl(req, "allow_ns_op", req.namespace, "read-job")
-        self._block(req, ["services"])
+        self._read(req, ["services"])
         regs = self._server.state.service_registrations(req.namespace)
         by_ns: Dict[str, Dict[str, set]] = {}
         for r in regs:
@@ -1825,7 +1863,7 @@ class HTTPAgent:
 
     def service_get(self, req: Request):
         self._acl(req, "allow_ns_op", req.namespace, "read-job")
-        self._block(req, ["services"])
+        self._read(req, ["services"])
         regs = self._server.state.service_registrations_by_name(
             req.namespace, req.params["name"]
         )
@@ -1847,6 +1885,15 @@ class HTTPAgent:
 
     def event_stream(self, req: Request):
         broker = self._server.event_broker
+        # subscriptions are inherently local reads: each server's FSM
+        # feeds its own ring, so a follower serves its own events and
+        # resumes by raft index across failovers (ISSUE 12/20). Route
+        # through the read plane in stale mode so the subscriber gets
+        # the same staleness attribution + max_stale rejection as any
+        # other query — a follower over the caller's bound refuses the
+        # stream loudly instead of silently lagging it.
+        _, max_stale = req.consistency_params()
+        self._server.readplane.resolve("stale", max_stale)
         resolver = getattr(self.agent, "acl_resolver", None)
 
         # subscribe-time ACL (event_broker.go:55 SubscribeWithACLCheck):

@@ -389,6 +389,17 @@ class RaftNode:
         with self._lock:
             return self.id if self.state == LEADER else self.leader_id
 
+    def last_contact_s(self) -> float:
+        """Age of this server's last leader contact (AppendEntries /
+        InstallSnapshot receipt or vote grant), in seconds — the
+        follower-side staleness meter the read plane stamps into
+        ``X-Nomad-Last-Contact`` (ISSUE 20). 0.0 on the leader: its
+        store is the state, by definition not stale."""
+        with self._lock:
+            if self.state == LEADER:
+                return 0.0
+            return max(0.0, time.monotonic() - self._last_contact)
+
     # --- public apply ---------------------------------------------------
 
     def apply(self, msg_type: str, req: Dict, timeout: float = 10.0) -> Any:
@@ -1422,6 +1433,8 @@ class RaftNode:
             return self._on_install_snapshot(req)
         if method == "forward_apply":
             return self._on_forward_apply(req)
+        if method == "read_index":
+            return self._on_read_index(req)
         raise ValueError(f"unknown raft RPC {method}")
 
     def _on_request_vote(self, req: Dict) -> Dict:
@@ -1632,6 +1645,35 @@ class RaftNode:
                 while len(self._forward_order) > 1024:
                     self._forward_results.pop(self._forward_order.pop(0), None)
         return {"ok": True, "result": result}
+
+    def _on_read_index(self, req: Dict) -> Dict:
+        """Leader-side half of the ReadIndex fence (raft §6.4,
+        server/readplane.py ISSUE 20): confirm we are STILL leader —
+        via the lease when it holds, via a committed barrier when it
+        lapsed — then vouch for the current commit index. The
+        forwarding follower waits for its own apply loop to reach that
+        index and serves locally; only the fence crosses the wire."""
+        with self._lock:
+            if self.state != LEADER:
+                return {"ok": False, "not_leader": True,
+                        "leader": self.leader_id}
+            leased = self._lease_valid_locked()
+            index = self.commit_index
+            term = self.current_term
+        if not leased:
+            try:
+                self.barrier()
+            except NotLeaderError as e:
+                return {"ok": False, "not_leader": True,
+                        "leader": e.leader}
+            with self._lock:
+                if self.state != LEADER:
+                    return {"ok": False, "not_leader": True,
+                            "leader": self.leader_id}
+                index = self.commit_index
+                term = self.current_term
+        return {"ok": True, "index": index, "term": term,
+                "leader": self.id}
 
     def forward_apply(self, msg_type: str, req: Dict, timeout: float = 10.0) -> Any:
         """Follower-side: route an apply to the current leader."""

@@ -164,6 +164,21 @@ class RaftObserver:
 
     # --- introspection ---------------------------------------------------
 
+    def staleness_ms(self, server_id: str) -> Optional[float]:
+        """Leader-attributed replication staleness for ``server_id``:
+        the newest append->ack lag any leader recorded for it as a
+        peer (ISSUE 20 read plane). Multiple observers may carry an
+        entry (a deposed leader's last measurement lingers); the MAX
+        wins — the meter may overstate staleness, never understate
+        it. None when no leader ever measured this server."""
+        with self._lock:
+            worst: Optional[float] = None
+            for obs in self._servers.values():
+                lag = obs.peer_lag_ms.get(server_id)
+                if lag is not None and (worst is None or lag > worst):
+                    worst = lag
+            return worst
+
     def events(self, since_mono: float = 0.0) -> List[Dict]:
         """The consensus event ring, oldest first (the timeline feed).
         ``since_mono`` filters to events at/after a monotonic stamp."""

@@ -308,6 +308,10 @@ class Server:
             self.state, self.eval_broker, self.blocked_evals,
             event_broker=self.event_broker,
         )
+        # consistency-mode read routing (ISSUE 20): every server —
+        # leader or follower — resolves its reads through this plane
+        from nomad_tpu.server.readplane import ReadPlane
+        self.readplane = ReadPlane(self)
         self.plan_queue = PlanQueue()
         from collections import deque
 

@@ -684,6 +684,11 @@ class StateSnapshot:
         # consistent with the tables by construction
         self.usage = root.usage
 
+    def stamp(self) -> Dict[str, int]:
+        """The read plane's provenance stamp: which frozen root this
+        view serves (ISSUE 20 generation-stamped reads)."""
+        return {"generation": self.generation, "index": self.index}
+
     # --- State interface (scheduler.go:67-141) ---
 
     def nodes(self) -> List:
@@ -828,6 +833,15 @@ class StateStore:
 
     def latest_index(self) -> int:
         return self._root.index
+
+    def read_stamp(self) -> Tuple[int, int]:
+        """``(generation, index)`` from ONE atomic root load — the
+        generation-stamped read the read plane serves against
+        (ISSUE 20). Reading ``current_generation()`` and
+        ``latest_index()`` separately can straddle a root swap; this
+        cannot."""
+        root = self._root
+        return root.generation, root.index
 
     @property
     def scheduler_config(self) -> SchedulerConfiguration:

@@ -154,6 +154,15 @@ def reset() -> None:
         client_update_stats.reset_stats()
     except Exception:                           # noqa: BLE001
         pass
+    try:
+        # read-plane routing counters (server/readplane.py) follow the
+        # burst window; the staleness histogram rides the shared
+        # registry reset above
+        from nomad_tpu.server.readplane import read_stats
+
+        read_stats.reset_stats()
+    except Exception:                           # noqa: BLE001
+        pass
 
 
 if os.environ.get("NOMAD_TPU_TRACE", "") not in ("", "0"):

@@ -637,6 +637,47 @@ def prometheus_text(registry=None, event_broker=None) -> str:
                 f'{{kind="{kind}"}} {u[key]}')
     except Exception:                           # noqa: BLE001
         pass                # server module unavailable: skip series
+    # read plane (server/readplane.py, ISSUE 20): who served reads
+    # (role), per-mode volume, follower fence forwards + retries +
+    # failures, linearizable lease->barrier demotions, and max_stale
+    # rejections. The staleness distribution itself rides the shared
+    # histogram registry (op="read_staleness" below).
+    try:
+        from nomad_tpu.server.readplane import read_stats
+
+        r = read_stats.snapshot()
+        lines.append("# TYPE nomad_tpu_read_served_total counter")
+        for role, n in sorted(r["served"].items()):
+            lines.append(
+                f'nomad_tpu_read_served_total{{role="{role}"}} {n}')
+        lines.append("# TYPE nomad_tpu_read_requests_total counter")
+        for mode, n in sorted(r["modes"].items()):
+            lines.append(
+                f'nomad_tpu_read_requests_total{{mode="{mode}"}} {n}')
+        lines.append("# TYPE nomad_tpu_read_forwards_total counter")
+        lines.append(f"nomad_tpu_read_forwards_total {r['forwards']}")
+        lines.append(
+            "# TYPE nomad_tpu_read_forward_retries_total counter")
+        lines.append(
+            f"nomad_tpu_read_forward_retries_total "
+            f"{r['forward_retries']}")
+        lines.append(
+            "# TYPE nomad_tpu_read_forward_failures_total counter")
+        lines.append(
+            f"nomad_tpu_read_forward_failures_total "
+            f"{r['forward_failures']}")
+        lines.append("# TYPE nomad_tpu_read_demotions_total counter")
+        lines.append(f"nomad_tpu_read_demotions_total {r['demotions']}")
+        lines.append(
+            "# TYPE nomad_tpu_read_lease_fast_total counter")
+        lines.append(
+            f"nomad_tpu_read_lease_fast_total {r['lease_fast']}")
+        lines.append(
+            "# TYPE nomad_tpu_read_stale_rejects_total counter")
+        lines.append(
+            f"nomad_tpu_read_stale_rejects_total {r['stale_rejects']}")
+    except Exception:                           # noqa: BLE001
+        pass                # server module unavailable: skip series
     # event-stream ring health (server/stream.py): publish/deliver
     # volume, slow-consumer losses, the widest subscriber lag, and the
     # wire bytes the NDJSON endpoint shipped — per-broker state, so

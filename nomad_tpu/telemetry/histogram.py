@@ -249,6 +249,11 @@ RAFT_APPEND = "raft_append"
 RAFT_SNAPSHOT_XFER = "raft_snapshot_xfer"
 #: full election duration (first round -> leadership won)
 RAFT_ELECTION = "raft_election"
+#: read-plane staleness (server/readplane.py, ISSUE 20): how far
+#: behind the leader the data each served read was — 0 on the leader,
+#: the last-contact / attributed-lag age on followers. The serving
+#: plane's consistency distribution, exported per-op like the rest.
+READ_STALENESS = "read_staleness"
 
 
 class HistogramRegistry:

@@ -1,0 +1,298 @@
+"""The follower read plane: consistency-mode routing (ISSUE 20).
+
+Reference behavior: Nomad's QueryOptions consistency knobs
+(api/api.go AllowStale / nomad/rpc.go blockingOptions) — ``?stale`` +
+``max_stale=<dur>`` route reads to any server with
+``X-Nomad-LastContact`` / ``X-Nomad-KnownLeader`` attribution, the
+default mode is leader-preferred, and linearizable reads are
+leader-only (raft §6.4 ReadIndex fences follower default reads).
+
+Tier-1 coverage: query-param parsing at the HTTP boundary, the three
+modes over real HTTP against a REAL 3-server cluster (stale serves on
+followers with bounded attribution and rejects loudly over the bound;
+default forwards the read fence; linearizable 503s off-leader with a
+leader hint), ACL parity on followers (anonymous/weak tokens get the
+same 403s a leader hands out), and the pinned-seed mini smoke
+(bench/trace_report.py run_readplane_smoke: stale + forwarded default
++ lease-lapse demotion on a durable cluster).
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from nomad_tpu.api.http import HTTPAgent, HTTPError, Request
+from nomad_tpu.server.readplane import ReadStats, read_stats
+from nomad_tpu.server.testing import make_cluster, wait_for_leader, wait_until
+
+
+# -- query-param parsing (parseConsistency) ------------------------------
+
+def _req(query):
+    q = {k: v if isinstance(v, list) else [v] for k, v in query.items()}
+    return Request("GET", "/v1/jobs", {}, q, None, "", None)
+
+
+class TestConsistencyParams:
+    def test_no_params_is_default(self):
+        assert _req({}).consistency_params() == ("default", None)
+
+    def test_stale_flag(self):
+        assert _req({"stale": "true"}).consistency_params() == ("stale", None)
+        assert _req({"stale": "1"}).consistency_params() == ("stale", None)
+
+    def test_stale_false_stays_default(self):
+        assert _req({"stale": "false"}).consistency_params()[0] == "default"
+
+    def test_max_stale_implies_stale(self):
+        assert _req({"max_stale": "30s"}).consistency_params() == \
+            ("stale", 30.0)
+        assert _req({"max_stale": "500ms"}).consistency_params() == \
+            ("stale", 0.5)
+        assert _req({"max_stale": "1m"}).consistency_params() == \
+            ("stale", 60.0)
+
+    def test_bad_max_stale_is_400(self):
+        with pytest.raises(HTTPError) as e:
+            _req({"max_stale": "banana"}).consistency_params()
+        assert e.value.status == 400
+
+    def test_unknown_mode_is_400(self):
+        with pytest.raises(HTTPError) as e:
+            _req({"consistency": "quorum"}).consistency_params()
+        assert e.value.status == 400
+
+    def test_explicit_mode_wins_over_stale_flag(self):
+        mode, _ = _req({"consistency": "linearizable",
+                        "stale": "true"}).consistency_params()
+        assert mode == "linearizable"
+
+
+class TestReadStats:
+    def test_follower_share_and_reset(self):
+        rs = ReadStats()
+        rs.note_request("stale")
+        rs.note_served("follower", 0.01)
+        rs.note_served("follower", 0.02)
+        rs.note_served("leader", 0.0)
+        snap = rs.snapshot()
+        assert snap["served"] == {"leader": 1, "follower": 2}
+        assert snap["modes"]["stale"] == 1
+        assert snap["follower_share"] == round(2 / 3, 4)
+        rs.reset_stats()
+        empty = rs.snapshot()
+        assert empty["served"] == {"leader": 0, "follower": 0}
+        assert empty["follower_share"] == 0.0
+
+
+# -- HTTP over a real cluster --------------------------------------------
+
+class _ShimAgent:
+    """Just enough of api/agent.Agent for HTTPAgent to route against
+    one cluster Server. The real Agent always constructs its own
+    single-node Server; these tests need HTTP listeners on REAL
+    cluster followers."""
+
+    def __init__(self, server):
+        self.server = server
+        self.client = None
+        self.config = SimpleNamespace(region="global",
+                                      name=server.config.name)
+        self.acl_resolver = None
+
+
+def _get(addr, path, token=""):
+    """GET -> (status, headers, decoded-json body); 4xx/5xx bodies
+    decode too (the error payload + hint headers are the contract)."""
+    r = urllib.request.Request(addr + path)
+    if token:
+        r.add_header("X-Nomad-Token", token)
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            raw = resp.read().decode()
+            return resp.status, dict(resp.headers), \
+                json.loads(raw) if raw else None
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode()
+        try:
+            body = json.loads(raw) if raw else None
+        except json.JSONDecodeError:
+            body = raw
+        return e.code, dict(e.headers), body
+
+
+@pytest.fixture()
+def cluster():
+    servers, registry = make_cluster(3)
+    https = []
+    try:
+        wait_for_leader(servers)
+        for s in servers:
+            h = HTTPAgent(_ShimAgent(s), port=0)
+            h.start()
+            https.append(h)
+        yield servers, registry, https
+    finally:
+        registry.heal()
+        for h in https:
+            h.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+def _follower_idx(servers, leader):
+    return next(i for i, s in enumerate(servers) if s is not leader)
+
+
+class TestReadPlaneHTTP:
+    def test_stale_read_on_follower_stamps_attribution(self, cluster):
+        servers, _, https = cluster
+        leader = wait_for_leader(servers)
+        fidx = _follower_idx(servers, leader)
+        before = read_stats.snapshot()
+        status, headers, body = _get(https[fidx].addr, "/v1/jobs?stale=true")
+        assert status == 200
+        assert body == []
+        # attribution: how stale, and where to go for fresh
+        assert float(headers["X-Nomad-Last-Contact"]) >= 0.0
+        assert headers["X-Nomad-Known-Leader"] == leader.raft.id
+        after = read_stats.snapshot()
+        assert after["served"]["follower"] >= \
+            before["served"]["follower"] + 1
+        assert after["modes"]["stale"] >= before["modes"]["stale"] + 1
+
+    def test_default_read_on_follower_forwards_fence(self, cluster):
+        servers, _, https = cluster
+        leader = wait_for_leader(servers)
+        fidx = _follower_idx(servers, leader)
+        before = read_stats.snapshot()
+        status, headers, _ = _get(https[fidx].addr, "/v1/jobs")
+        assert status == 200
+        # the fence crossed the wire (one read_index RPC), the data
+        # came off the follower's own root
+        after = read_stats.snapshot()
+        assert after["forwards"] >= before["forwards"] + 1
+        assert after["served"]["follower"] >= \
+            before["served"]["follower"] + 1
+        assert headers["X-Nomad-Known-Leader"] == leader.raft.id
+
+    def test_linearizable_is_leader_only(self, cluster):
+        servers, _, https = cluster
+        leader = wait_for_leader(servers)
+        lidx = servers.index(leader)
+        fidx = _follower_idx(servers, leader)
+        # follower: loud 503 + leader hint, never an answer
+        status, headers, body = _get(
+            https[fidx].addr, "/v1/jobs?consistency=linearizable")
+        assert status == 503
+        assert headers["X-Nomad-Known-Leader"] == leader.raft.id
+        assert "leader-only" in (body or {}).get("error", "")
+        # leader at steady state: the lease fast path serves
+        before = read_stats.snapshot()
+        status, headers, _ = _get(
+            https[lidx].addr, "/v1/jobs?consistency=linearizable")
+        assert status == 200
+        assert float(headers["X-Nomad-Last-Contact"]) == 0.0
+        after = read_stats.snapshot()
+        assert after["lease_fast"] >= before["lease_fast"] + 1
+
+    def test_stale_read_rejected_over_max_stale(self, cluster):
+        servers, registry, https = cluster
+        leader = wait_for_leader(servers)
+        fidx = _follower_idx(servers, leader)
+        follower = servers[fidx]
+        # cut the follower from both peers: its leader-contact age
+        # grows unbounded while the other two keep a quorum
+        for s in servers:
+            if s is not follower:
+                registry.partition(follower.raft.id, s.raft.id)
+        try:
+            # < election_timeout_min (0.30s): the follower ages past
+            # the bound but does not start an election
+            time.sleep(0.2)
+            before = read_stats.snapshot()
+            status, headers, body = _get(
+                https[fidx].addr, "/v1/jobs?max_stale=50ms")
+            assert status == 503
+            assert "stale" in (body or {}).get("error", "")
+            after = read_stats.snapshot()
+            assert after["stale_rejects"] >= before["stale_rejects"] + 1
+            # a generous bound still serves, staleness stamped
+            status, headers, _ = _get(
+                https[fidx].addr, "/v1/jobs?max_stale=1h")
+            assert status == 200
+            assert float(headers["X-Nomad-Last-Contact"]) > 50.0
+        finally:
+            registry.heal()
+            wait_for_leader(servers)
+
+    def test_follower_acl_parity(self, cluster):
+        """ISSUE 20 satellite: a follower hands anonymous/weak tokens
+        exactly the 403s the leader does — reads routed to followers
+        must not become an ACL bypass."""
+        from nomad_tpu.acl.policy import ACLPolicy, ACLToken
+        from nomad_tpu.acl.resolver import TokenResolver
+        from nomad_tpu.server import fsm as fsm_msgs
+
+        servers, _, https = cluster
+        leader = wait_for_leader(servers)
+        lidx = servers.index(leader)
+        fidx = _follower_idx(servers, leader)
+        policy = ACLPolicy(name="default-read",
+                           rules='namespace "default" { policy = "read" }')
+        leader.raft_apply(fsm_msgs.ACL_POLICY_UPSERT, {"policies": [policy]})
+        tok = ACLToken.create(name="weak", type="client",
+                              policies=["default-read"])
+        leader.raft_apply(fsm_msgs.ACL_TOKEN_UPSERT, {"tokens": [tok]})
+        wait_until(lambda: servers[fidx].state.acl_tokens(),
+                   msg="token replication to follower")
+        for h in https:
+            h.agent.acl_resolver = TokenResolver(h.agent.server)
+        try:
+            for idx in (lidx, fidx):
+                # anonymous: 403 in every mode, follower or leader
+                for q in ("?stale=true", "", "?consistency=linearizable"):
+                    status, _, _ = _get(https[idx].addr, "/v1/jobs" + q)
+                    assert status == 403, (idx, q, status)
+                # weak token outside its namespace: same 403
+                status, _, _ = _get(
+                    https[idx].addr,
+                    "/v1/jobs?stale=true&namespace=secret",
+                    token=tok.secret_id)
+                assert status == 403, idx
+            # inside its namespace the weak token reads from the
+            # follower, attribution intact
+            status, headers, _ = _get(https[fidx].addr,
+                                      "/v1/jobs?stale=true",
+                                      token=tok.secret_id)
+            assert status == 200
+            assert "X-Nomad-Last-Contact" in headers
+        finally:
+            for h in https:
+                h.agent.acl_resolver = None
+
+
+# -- pinned-seed mini smoke ----------------------------------------------
+
+class TestReadPlaneSmoke:
+    def test_readplane_smoke_three_server_cluster(self):
+        """ISSUE 20 satellite: the ~10s pinned-seed smoke on a durable
+        3-server cluster — a stale read lands on a follower with
+        bounded last-contact, a default read forwards across one
+        injected step-down, and a linearizable read demotes to the
+        quorum barrier under a lease lapse."""
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench"))
+        import trace_report
+
+        r = trace_report.run_readplane_smoke()
+        assert r["stale_ok"], r
+        assert r["default_ok"], r
+        assert r["demote_ok"], r
+        assert r["ok"], r
